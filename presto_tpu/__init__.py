@@ -1,0 +1,21 @@
+"""presto_tpu — a TPU-native distributed SQL query engine.
+
+A ground-up reimagining of a coordinator/worker SQL engine (reference:
+Presto, see SURVEY.md) around the XLA execution model:
+
+- Columnar "Pages" of "Blocks" (reference: presto-spi/.../spi/Page.java:34)
+  become fixed-shape device arrays with validity masks (`presto_tpu.batch`).
+- The interpreted per-page operator loop (reference:
+  presto-main/.../operator/Driver.java:347) becomes whole-fragment
+  jit-compiled XLA programs (`presto_tpu.exec`).
+- JVM bytecode codegen (reference: presto-bytecode, sql/gen/) becomes JAX
+  tracing (`presto_tpu.functions`, `presto_tpu.exec.compiler`).
+- HTTP shuffle exchanges (reference: execution/buffer/, ExchangeClient)
+  become ICI collectives under shard_map (`presto_tpu.parallel`).
+"""
+
+from presto_tpu.session import Session, connect
+
+__version__ = "0.1.0"
+
+__all__ = ["Session", "connect", "__version__"]

@@ -1,0 +1,133 @@
+"""Catalog & connector interfaces.
+
+Reference parity: presto-spi/.../spi/connector/Connector.java:27
+(getMetadata / getSplitManager / getPageSourceProvider) and
+metadata/MetadataManager.  Trimmed to the TPU engine's needs: a connector
+exposes table schemas and serves host-columnar data per split; ingestion to
+device batches happens in the scan operator.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors import tpch as tpch_gen
+
+
+class ConnectorTable:
+    """Metadata + data access for one table."""
+
+    def __init__(self, name: str, schema: Dict[str, T.Type]):
+        self.name = name
+        self.schema = dict(schema)
+
+    def row_count(self) -> int:
+        raise NotImplementedError
+
+    def splits(self, n_splits: int) -> List[Tuple[int, int]]:
+        raise NotImplementedError
+
+    def read(self, columns: Optional[List[str]] = None,
+             split: Optional[Tuple[int, int]] = None) -> Dict[str, np.ndarray]:
+        """Host columnar data for the given columns (projection pushdown)."""
+        raise NotImplementedError
+
+
+class MemoryTable(ConnectorTable):
+    """In-memory table (reference: presto-memory connector)."""
+
+    def __init__(self, name, schema, data: Dict[str, np.ndarray]):
+        super().__init__(name, schema)
+        self.data = {k: np.asarray(v) for k, v in data.items()}
+        self._rows = len(next(iter(self.data.values()))) if self.data else 0
+
+    def row_count(self) -> int:
+        return self._rows
+
+    def splits(self, n_splits):
+        edges = np.linspace(0, self._rows, n_splits + 1).astype(int)
+        return [(int(a), int(b)) for a, b in zip(edges[:-1], edges[1:]) if a < b]
+
+    def read(self, columns=None, split=None):
+        cols = columns if columns is not None else list(self.schema)
+        a, b = split if split is not None else (0, self._rows)
+        return {c: self.data[c][a:b] for c in cols}
+
+
+class TpchTable(ConnectorTable):
+    """TPC-H generator table (reference: presto-tpch), with a host disk
+    cache so repeated test/bench runs skip regeneration."""
+
+    def __init__(self, name: str, sf: float, cache_dir: Optional[str] = None):
+        super().__init__(name, tpch_gen.SCHEMAS[name])
+        self.sf = sf
+        self.cache_dir = cache_dir
+
+    def row_count(self) -> int:
+        return tpch_gen.row_count(self.name, self.sf)
+
+    def splits(self, n_splits):
+        return tpch_gen.split_ranges(self.name, self.sf, n_splits)
+
+    def read(self, columns=None, split=None):
+        cols = columns if columns is not None else list(self.schema)
+        data = self._full_table()
+        if split is not None:
+            a, b = split
+            if self.name == "lineitem":
+                a, _ = tpch_gen.lineitem_offsets(a, b)
+                nb = len(tpch_gen.generate("lineitem", self.sf, split[0], split[1])["l_orderkey"])
+                return {c: data[c][a:a + nb] for c in cols}
+            return {c: data[c][a:b] for c in cols}
+        return {c: data[c] for c in cols}
+
+    def _full_table(self):
+        if not hasattr(self, "_data"):
+            path = None
+            if self.cache_dir:
+                os.makedirs(self.cache_dir, exist_ok=True)
+                path = os.path.join(self.cache_dir, f"tpch_{self.name}_sf{self.sf}.pkl")
+            if path and os.path.exists(path):
+                with open(path, "rb") as f:
+                    self._data = pickle.load(f)
+            else:
+                self._data = tpch_gen.generate(self.name, self.sf)
+                if path:
+                    with open(path, "wb") as f:
+                        pickle.dump(self._data, f, protocol=4)
+        return self._data
+
+
+class Catalog:
+    """Named schemas of tables (reference: MetadataManager + StaticCatalogStore)."""
+
+    def __init__(self):
+        self.tables: Dict[str, ConnectorTable] = {}
+
+    def register(self, table: ConnectorTable) -> None:
+        self.tables[table.name.lower()] = table
+
+    def register_memory(self, name: str, schema: Dict[str, T.Type],
+                        data: Dict[str, np.ndarray]) -> None:
+        self.register(MemoryTable(name, schema, data))
+
+    def get(self, name: str) -> ConnectorTable:
+        t = self.tables.get(name.lower())
+        if t is None:
+            raise KeyError(f"Table '{name}' does not exist")
+        return t
+
+    def __contains__(self, name: str) -> bool:
+        return name.lower() in self.tables
+
+
+def tpch_catalog(sf: float = 0.01, cache_dir: Optional[str] = None) -> Catalog:
+    cat = Catalog()
+    for name in tpch_gen.SCHEMAS:
+        cat.register(TpchTable(name, sf, cache_dir))
+    return cat

@@ -1,0 +1,74 @@
+"""Session: the user-facing entry point (reference: Session.java +
+SqlQueryManager orchestration, trimmed to an embeddable engine API).
+
+`connect()` returns a Session bound to a catalog of connectors;
+`Session.sql(text)` runs parse -> analyze -> plan -> optimize -> execute
+and returns a host-side result table — the in-process analog of the
+reference's LocalQueryRunner (presto-main/.../testing/LocalQueryRunner.java),
+which is also exactly how its own planner/operator tests drive the engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
+    # Reference: SystemSessionProperties.java:56 (81 typed properties).
+    "join_distribution_type": "AUTOMATIC",  # BROADCAST | PARTITIONED | AUTOMATIC
+    "hash_partition_count": 8,
+    "task_concurrency": 1,
+    "agg_capacity_hint": 0,  # 0 = derive from input size
+    "optimizer_enabled": True,
+}
+
+
+@dataclasses.dataclass
+class QueryResult:
+    """Host-side materialized result (reference: MaterializedResult)."""
+
+    columns: list  # [(name, Type)]
+    rows: list  # list of python tuples
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def __len__(self):
+        return len(self.rows)
+
+    def column(self, i: int) -> list:
+        return [r[i] for r in self.rows]
+
+    def to_dict(self) -> Dict[str, list]:
+        return {name: self.column(i) for i, (name, _) in enumerate(self.columns)}
+
+
+class Session:
+    def __init__(self, catalog=None, properties: Optional[Dict[str, Any]] = None):
+        from presto_tpu.catalog import Catalog
+
+        self.catalog = catalog if catalog is not None else Catalog()
+        self.properties = dict(DEFAULT_SESSION_PROPERTIES)
+        if properties:
+            self.properties.update(properties)
+
+    def set(self, name: str, value) -> None:
+        if name not in self.properties:
+            raise KeyError(f"unknown session property: {name}")
+        self.properties[name] = value
+
+    def sql(self, text: str) -> QueryResult:
+        from presto_tpu.exec.executor import execute_query
+
+        return execute_query(self, text)
+
+    def explain(self, text: str, analyze: bool = False) -> str:
+        from presto_tpu.exec.executor import explain_query
+
+        return explain_query(self, text, analyze=analyze)
+
+
+def connect(catalog=None, **properties) -> Session:
+    return Session(catalog, properties or None)

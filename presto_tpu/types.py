@@ -1,0 +1,215 @@
+"""Logical SQL type system.
+
+Reference parity: presto-spi/src/main/java/com/facebook/presto/spi/type/
+(45+ classes) and presto-main/.../type/TypeRegistry.  We keep the same
+*logical* surface (BOOLEAN..BIGINT, DOUBLE, DECIMAL, VARCHAR, DATE,
+TIMESTAMP, ARRAY/MAP/ROW stubs) but map each logical type onto a
+TPU-friendly *physical* representation:
+
+  BOOLEAN              -> bool_
+  TINYINT..BIGINT      -> int32 / int64
+  DOUBLE / REAL        -> float64 / float32
+  DECIMAL(p,s), p<=18  -> int64 scaled by 10**s (exact, MXU/ALU friendly;
+                          the reference uses Slice-backed Int128 for long
+                          decimals — long decimal is deferred)
+  VARCHAR / CHAR       -> int32 dictionary codes (dictionary on host);
+                          the reference's VariableWidthBlock/DictionaryBlock
+                          (presto-spi/.../spi/block/) collapse into
+                          dictionary-always, because TPUs hate ragged data
+  DATE                 -> int32 days since 1970-01-01
+  TIMESTAMP            -> int64 microseconds since epoch
+  INTERVAL DAY/MONTH   -> int64 (micros / months) — session-side only
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """A logical SQL type. Comparable/hashable; parametric via params."""
+
+    name: str
+    params: tuple = ()
+
+    def __str__(self) -> str:
+        if self.params:
+            return f"{self.name}({','.join(str(p) for p in self.params)})"
+        return self.name
+
+    # ---- classification helpers -------------------------------------
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("TINYINT", "SMALLINT", "INTEGER", "BIGINT")
+
+    @property
+    def is_floating(self) -> bool:
+        return self.name in ("REAL", "DOUBLE")
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.name == "DECIMAL"
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_floating or self.is_decimal
+
+    @property
+    def is_string(self) -> bool:
+        return self.name in ("VARCHAR", "CHAR")
+
+    @property
+    def is_temporal(self) -> bool:
+        return self.name in ("DATE", "TIMESTAMP")
+
+    @property
+    def is_orderable(self) -> bool:
+        return self.name not in ("UNKNOWN",)
+
+    @property
+    def decimal_scale(self) -> int:
+        assert self.is_decimal
+        return self.params[1] if len(self.params) > 1 else 0
+
+    @property
+    def decimal_precision(self) -> int:
+        assert self.is_decimal
+        return self.params[0] if self.params else 18
+
+    # ---- physical representation ------------------------------------
+    def numpy_dtype(self) -> np.dtype:
+        return np.dtype(_PHYSICAL[self.name])
+
+
+BOOLEAN = Type("BOOLEAN")
+TINYINT = Type("TINYINT")
+SMALLINT = Type("SMALLINT")
+INTEGER = Type("INTEGER")
+BIGINT = Type("BIGINT")
+REAL = Type("REAL")
+DOUBLE = Type("DOUBLE")
+VARCHAR = Type("VARCHAR")
+DATE = Type("DATE")
+TIMESTAMP = Type("TIMESTAMP")
+INTERVAL_DAY_TIME = Type("INTERVAL_DAY_TIME")
+INTERVAL_YEAR_MONTH = Type("INTERVAL_YEAR_MONTH")
+UNKNOWN = Type("UNKNOWN")  # the NULL literal's type
+
+
+def decimal(precision: int, scale: int) -> Type:
+    if precision > 18:
+        raise NotImplementedError("long DECIMAL (>18 digits) not supported yet")
+    return Type("DECIMAL", (precision, scale))
+
+
+def varchar(length: Optional[int] = None) -> Type:
+    return VARCHAR  # length is not semantically enforced (same as reference in practice)
+
+
+def char(length: int) -> Type:
+    return Type("CHAR", (length,))
+
+
+_PHYSICAL = {
+    "BOOLEAN": np.bool_,
+    "TINYINT": np.int32,
+    "SMALLINT": np.int32,
+    "INTEGER": np.int32,
+    "BIGINT": np.int64,
+    "REAL": np.float32,
+    "DOUBLE": np.float64,
+    "DECIMAL": np.int64,
+    "VARCHAR": np.int32,  # dictionary code
+    "CHAR": np.int32,  # dictionary code
+    "DATE": np.int32,
+    "TIMESTAMP": np.int64,
+    "INTERVAL_DAY_TIME": np.int64,
+    "INTERVAL_YEAR_MONTH": np.int64,
+    "UNKNOWN": np.bool_,
+}
+
+
+def parse_type(text: str) -> Type:
+    """Parse a type name as written in SQL (CAST target etc.)."""
+    t = text.strip().upper()
+    if "(" in t:
+        base, rest = t.split("(", 1)
+        args = [int(a) for a in rest.rstrip(")").split(",") if a.strip().isdigit()]
+        base = base.strip()
+        if base == "DECIMAL":
+            return decimal(*args) if args else decimal(18, 0)
+        if base in ("VARCHAR", "CHAR"):
+            return VARCHAR if base == "VARCHAR" else char(args[0] if args else 1)
+        raise ValueError(f"unknown parametric type: {text}")
+    aliases = {
+        "INT": INTEGER,
+        "INTEGER": INTEGER,
+        "BIGINT": BIGINT,
+        "SMALLINT": SMALLINT,
+        "TINYINT": TINYINT,
+        "BOOLEAN": BOOLEAN,
+        "DOUBLE": DOUBLE,
+        "DOUBLE PRECISION": DOUBLE,
+        "REAL": REAL,
+        "FLOAT": REAL,
+        "VARCHAR": VARCHAR,
+        "CHAR": Type("CHAR", (1,)),
+        "STRING": VARCHAR,
+        "DATE": DATE,
+        "TIMESTAMP": TIMESTAMP,
+        "DECIMAL": decimal(18, 0),
+    }
+    if t in aliases:
+        return aliases[t]
+    raise ValueError(f"unknown type: {text}")
+
+
+# ---------------------------------------------------------------------------
+# Coercion lattice — mirrors the reference's implicit-cast rules
+# (presto-main/.../type/TypeRegistry + sql/analyzer/ExpressionAnalyzer).
+# ---------------------------------------------------------------------------
+
+_NUMERIC_ORDER = ["TINYINT", "SMALLINT", "INTEGER", "BIGINT", "DECIMAL", "REAL", "DOUBLE"]
+
+
+def common_super_type(a: Type, b: Type) -> Optional[Type]:
+    """The least common type both operands coerce to, or None."""
+    if a == b:
+        return a
+    if a == UNKNOWN:
+        return b
+    if b == UNKNOWN:
+        return a
+    if a.is_numeric and b.is_numeric:
+        ia, ib = _NUMERIC_ORDER.index(a.name), _NUMERIC_ORDER.index(b.name)
+        hi = a if ia >= ib else b
+        lo = b if ia >= ib else a
+        if hi.is_decimal:
+            if lo.is_decimal:
+                scale = max(a.decimal_scale, b.decimal_scale)
+                intd = max(
+                    a.decimal_precision - a.decimal_scale,
+                    b.decimal_precision - b.decimal_scale,
+                )
+                return decimal(min(intd + scale, 18), scale)
+            return hi  # integer + decimal -> decimal
+        if hi.is_floating and lo.is_decimal:
+            return DOUBLE
+        return hi
+    if a.is_string and b.is_string:
+        return VARCHAR
+    if {a.name, b.name} == {"DATE", "TIMESTAMP"}:
+        return TIMESTAMP
+    if a.name == "DATE" and b.name == "INTERVAL_DAY_TIME":
+        return DATE
+    return None
+
+
+def can_coerce(frm: Type, to: Type) -> bool:
+    if frm == to or frm == UNKNOWN:
+        return True
+    return common_super_type(frm, to) == to

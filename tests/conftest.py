@@ -1,0 +1,28 @@
+"""Test config: force an 8-device virtual CPU mesh (SURVEY.md §4 tier-3 —
+the reference's DistributedQueryRunner boots a fake multi-node cluster in
+one JVM; we boot a fake 8-chip mesh in one process)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tpch_catalog_tiny():
+    from presto_tpu.catalog import tpch_catalog
+
+    return tpch_catalog(sf=0.01, cache_dir="/tmp/presto_tpu_cache")
+
+
+@pytest.fixture(scope="session")
+def tpch_sqlite_tiny():
+    """sqlite database loaded with the same SF0.01 TPC-H data (the
+    reference's H2QueryRunner differential-oracle role)."""
+    from tests.sqlite_oracle import build_sqlite
+
+    return build_sqlite(sf=0.01)

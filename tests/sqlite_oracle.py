@@ -1,0 +1,90 @@
+"""Differential-testing oracle: load generated data into sqlite and run the
+same SQL there (reference analog: H2QueryRunner + QueryAssertions,
+presto-tests/src/main/java/com/facebook/presto/tests/)."""
+
+from __future__ import annotations
+
+import math
+import sqlite3
+from typing import Iterable
+
+import numpy as np
+
+from presto_tpu.connectors import tpch as tpch_gen
+
+_CONNS: dict = {}
+
+
+def build_sqlite(sf: float = 0.01) -> sqlite3.Connection:
+    if sf in _CONNS:
+        return _CONNS[sf]
+    conn = sqlite3.connect(":memory:")
+    for table, schema in tpch_gen.SCHEMAS.items():
+        data = tpch_gen.generate(table, sf)
+        cols = list(schema)
+        decls = []
+        for c in cols:
+            t = schema[c]
+            if t.is_integer:
+                decls.append(f"{c} INTEGER")
+            elif t.name == "DATE":
+                decls.append(f"{c} INTEGER")  # days since epoch, matches engine repr
+            elif t.is_numeric:
+                decls.append(f"{c} REAL")
+            else:
+                decls.append(f"{c} TEXT")
+        conn.execute(f"CREATE TABLE {table} ({', '.join(decls)})")
+        arrays = []
+        for c in cols:
+            a = data[c]
+            if a.dtype == object:
+                arrays.append(a.tolist())
+            elif a.dtype.kind in "iu":
+                arrays.append([int(x) for x in a])
+            else:
+                arrays.append([float(x) for x in a])
+        rows = list(zip(*arrays))
+        conn.executemany(
+            f"INSERT INTO {table} VALUES ({','.join('?' * len(cols))})", rows
+        )
+    conn.commit()
+    _CONNS[sf] = conn
+    return conn
+
+
+def normalize(rows: Iterable[tuple]) -> list:
+    out = []
+    for row in rows:
+        norm = []
+        for v in row:
+            if isinstance(v, (np.generic,)):
+                v = v.item()
+            if isinstance(v, float):
+                norm.append(round(v, 4))
+            elif isinstance(v, np.ma.core.MaskedConstant):
+                norm.append(None)
+            else:
+                norm.append(v)
+        out.append(tuple(norm))
+    return out
+
+
+def assert_same_results(actual_rows, expected_rows, ordered: bool = False, rel_tol=1e-6):
+    a = normalize(actual_rows)
+    e = normalize(expected_rows)
+    if not ordered:
+        a = sorted(a, key=repr)
+        e = sorted(e, key=repr)
+    assert len(a) == len(e), f"row count {len(a)} != {len(e)}\nactual[:5]={a[:5]}\nexpected[:5]={e[:5]}"
+    for i, (ra, re_) in enumerate(zip(a, e)):
+        assert len(ra) == len(re_), f"row {i}: width {len(ra)} != {len(re_)}"
+        for j, (va, ve) in enumerate(zip(ra, re_)):
+            if isinstance(va, float) or isinstance(ve, float):
+                if va is None or ve is None:
+                    assert va is None and ve is None, f"row {i} col {j}: {va} != {ve}"
+                    continue
+                assert math.isclose(float(va), float(ve), rel_tol=rel_tol, abs_tol=1e-4), (
+                    f"row {i} col {j}: {va} != {ve}\nactual={ra}\nexpected={re_}"
+                )
+            else:
+                assert va == ve, f"row {i} col {j}: {va!r} != {ve!r}\nactual={ra}\nexpected={re_}"

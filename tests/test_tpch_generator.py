@@ -1,0 +1,81 @@
+"""Generator invariants: determinism, split independence, FK validity.
+(Reference analog: the airlift-tpch generator's determinism that all of
+presto-tests relies on.)"""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import tpch
+
+
+SF = 0.01
+
+
+def test_row_counts_scale():
+    assert tpch.row_count("nation", SF) == 25
+    assert tpch.row_count("region", SF) == 5
+    assert tpch.row_count("orders", SF) == 15_000
+    n = tpch.row_count("lineitem", SF)
+    assert 14_000 * 4 * SF * 100 / 100 < n < 7 * 15_000
+
+
+@pytest.mark.parametrize("table", ["orders", "customer", "part", "supplier", "partsupp"])
+def test_split_independence(table):
+    whole = tpch.generate(table, SF)
+    part = tpch.generate(table, SF, 500, 600)
+    for col in whole:
+        assert np.array_equal(whole[col][500:600], part[col]), col
+
+
+def test_lineitem_split_independence():
+    whole = tpch.generate("lineitem", SF)
+    a0, _ = tpch.lineitem_offsets(500, 600)
+    part = tpch.generate("lineitem", SF, 500, 600)
+    m = len(part["l_orderkey"])
+    for col in whole:
+        assert np.array_equal(whole[col][a0:a0 + m], part[col]), col
+
+
+def test_splits_cover_table():
+    ranges = tpch.split_ranges("orders", SF, 7)
+    assert ranges[0][0] == 0 and ranges[-1][1] == 15_000
+    got = np.concatenate(
+        [tpch.generate("orders", SF, a, b)["o_orderkey"] for a, b in ranges]
+    )
+    assert np.array_equal(got, tpch.generate("orders", SF)["o_orderkey"])
+
+
+def test_foreign_keys_valid():
+    li = tpch.generate("lineitem", SF)
+    ps = tpch.generate("partsupp", SF)
+    orders = tpch.generate("orders", SF)
+    pairs = set(zip(ps["ps_partkey"].tolist(), ps["ps_suppkey"].tolist()))
+    lpairs = set(zip(li["l_partkey"].tolist(), li["l_suppkey"].tolist()))
+    assert lpairs <= pairs
+    assert set(li["l_orderkey"].tolist()) <= set(orders["o_orderkey"].tolist())
+    assert orders["o_custkey"].min() >= 1
+    assert orders["o_custkey"].max() <= tpch.row_count("customer", SF)
+    cust = tpch.generate("customer", SF)
+    assert cust["c_nationkey"].max() <= 24
+
+
+def test_value_domains():
+    li = tpch.generate("lineitem", SF)
+    assert set(np.unique(li["l_returnflag"])) <= {"A", "N", "R"}
+    assert set(np.unique(li["l_linestatus"])) == {"F", "O"}
+    assert li["l_discount"].min() >= 0.0 and li["l_discount"].max() <= 0.1
+    assert li["l_quantity"].min() >= 1 and li["l_quantity"].max() <= 50
+    assert (li["l_shipdate"] > li["l_commitdate"] - 200).all()
+    assert (li["l_receiptdate"] > li["l_shipdate"]).all()
+
+
+def test_sqlite_oracle_loads():
+    from tests.sqlite_oracle import build_sqlite
+
+    conn = build_sqlite(SF)
+    (n,) = conn.execute("SELECT count(*) FROM lineitem").fetchone()
+    assert n == tpch.row_count("lineitem", SF)
+    (rev,) = conn.execute(
+        "SELECT sum(l_extendedprice * l_discount) FROM lineitem WHERE l_discount > 0.05"
+    ).fetchone()
+    assert rev > 0
