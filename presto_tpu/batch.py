@@ -169,6 +169,8 @@ def to_numpy(batch: Batch) -> tuple[Dict[str, np.ndarray], np.ndarray]:
         if col.dictionary is not None:
             codes = np.clip(data, 0, len(col.dictionary) - 1)
             data = col.dictionary.values[codes]
+        elif col.type.is_decimal:
+            data = data.astype(np.float64) / (10 ** col.type.decimal_scale)
         if col.valid is not None:
             data = np.ma.masked_array(data, mask=~np.asarray(col.valid))
         out[name] = data

@@ -80,9 +80,8 @@ class TpchTable(ConnectorTable):
         if split is not None:
             a, b = split
             if self.name == "lineitem":
-                a, _ = tpch_gen.lineitem_offsets(a, b)
-                nb = len(tpch_gen.generate("lineitem", self.sf, split[0], split[1])["l_orderkey"])
-                return {c: data[c][a:a + nb] for c in cols}
+                lo, hi = tpch_gen.lineitem_offsets(a, b)
+                return {c: data[c][lo:hi] for c in cols}
             return {c: data[c][a:b] for c in cols}
         return {c: data[c] for c in cols}
 

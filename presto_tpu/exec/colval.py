@@ -1,0 +1,85 @@
+"""Column values flowing through compiled expressions.
+
+A ColVal is (data, valid, dictionary, type):
+- data: jnp array (codes for strings), or a python scalar for literals
+  not yet broadcast (kept scalar so XLA folds constants).
+- valid: None (all valid) or bool jnp array / python bool.
+- dictionary: host-side Dictionary for string-typed values (sorted+unique
+  invariant — see batch.py).
+
+This is the value-plane analog of the reference's Block +
+DictionaryAwarePageProjection (operator/project/DictionaryAwarePageProjection.java):
+string compute happens once per dictionary entry on host, then flows to
+the device as gathers through int32 codes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Dictionary
+from presto_tpu.types import Type
+
+
+@dataclasses.dataclass
+class ColVal:
+    data: object  # jnp array | python scalar
+    valid: object  # None | jnp bool array | python bool
+    type: Type
+    dictionary: Optional[Dictionary] = None
+
+    @property
+    def is_scalar(self) -> bool:
+        return not hasattr(self.data, "shape") or getattr(self.data, "ndim", 0) == 0
+
+
+def and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def all_valid(*vals):
+    v = None
+    for x in vals:
+        v = and_valid(v, x.valid if isinstance(x, ColVal) else x)
+    return v
+
+
+def valid_array(v: ColVal, n: int):
+    if v.valid is None:
+        return jnp.ones((n,), dtype=bool)
+    if not hasattr(v.valid, "shape"):
+        return jnp.full((n,), bool(v.valid))
+    return v.valid
+
+
+def decode_strings(v: ColVal) -> np.ndarray:
+    """Host-side decode (only outside jit)."""
+    codes = np.asarray(v.data)
+    return v.dictionary.values[np.clip(codes, 0, len(v.dictionary) - 1)]
+
+
+def normalize_dictionary(values: np.ndarray, codes: ColVal) -> ColVal:
+    """Restore the sorted+unique dictionary invariant after a host
+    transform of dictionary values: unique the transformed values and remap
+    codes through a device-side LUT gather."""
+    uniq, inverse = np.unique(values.astype(str), return_inverse=True)
+    lut = jnp.asarray(inverse.astype(np.int32))
+    new_codes = lut[jnp.clip(codes.data, 0, len(inverse) - 1)]
+    return ColVal(new_codes, codes.valid, codes.type, Dictionary(uniq))
+
+
+def translate_codes(frm: Dictionary, to: Dictionary):
+    """Host LUT mapping codes in `frm` to codes in `to` (-1 = not present).
+    Used to compare/join string columns with different dictionaries."""
+    idx = np.searchsorted(to.values, frm.values)
+    idx = np.clip(idx, 0, max(len(to) - 1, 0))
+    ok = (len(to) > 0) & (to.values[idx] == frm.values)
+    return np.where(ok, idx, -1).astype(np.int32)

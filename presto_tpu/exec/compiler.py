@@ -1,0 +1,89 @@
+"""Expression evaluation: typed IR -> ColVal over a Batch.
+
+Reference parity: sql/gen/ExpressionCompiler + PageFunctionCompiler — the
+reference generates JVM bytecode per expression; here evaluation IS tracing,
+so "compilation" is just recursive emission of jnp ops (XLA fuses the
+result).  Dictionary-typed intermediates trigger host-side per-entry
+compute (see exec/colval.py)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch
+from presto_tpu.exec.colval import ColVal
+from presto_tpu.functions import scalar as scalar_fns
+from presto_tpu.plan import ir
+
+
+class EvalContext:
+    """Carries scalar-subquery results (python scalars) into evaluation."""
+
+    def __init__(self, scalar_results: Dict[int, tuple] | None = None):
+        self.scalar_results = scalar_results or {}  # plan_id -> (value, valid)
+
+
+def eval_expr(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> ColVal:
+    if isinstance(expr, ir.Ref):
+        c = batch.columns[expr.name]
+        return ColVal(c.data, c.valid, c.type, c.dictionary)
+    if isinstance(expr, ir.Lit):
+        if expr.value is None:
+            return ColVal(False, False, expr.type)
+        return ColVal(expr.value, None, expr.type)
+    if isinstance(expr, ir.ScalarSub):
+        v, valid = ctx.scalar_results[expr.plan_id]
+        return ColVal(v, None if valid else False, expr.type)
+    if isinstance(expr, ir.CastExpr):
+        return scalar_fns.emit_cast(eval_expr(expr.arg, batch, ctx), expr.type, expr.safe)
+    if isinstance(expr, ir.Call):
+        args = [eval_expr(a, batch, ctx) for a in expr.args]
+        return scalar_fns.lookup(expr.fn).emit(args)
+    raise TypeError(f"cannot evaluate {type(expr).__name__}")
+
+
+def eval_predicate(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> jnp.ndarray:
+    """Boolean expression -> row mask (SQL: NULL predicate == not selected)."""
+    v = eval_expr(expr, batch, ctx)
+    data = v.data
+    if not hasattr(data, "shape") or getattr(data, "ndim", 0) == 0:
+        data = jnp.full((batch.capacity,), bool(data) if not hasattr(data, "shape") else data)
+    mask = data
+    if v.valid is not None:
+        valid = v.valid
+        if not hasattr(valid, "shape") or getattr(valid, "ndim", 0) == 0:
+            valid = jnp.full((batch.capacity,), bool(valid))
+        mask = mask & valid
+    return mask
+
+
+def to_column(v: ColVal, capacity: int):
+    """Materialize a ColVal as a full-capacity Column."""
+    from presto_tpu.batch import Column
+
+    data = v.data
+    if not hasattr(data, "shape") or getattr(data, "ndim", 0) == 0:
+        if isinstance(data, str):
+            # string literal column: single-entry dictionary
+            import numpy as np
+
+            from presto_tpu.batch import Dictionary
+
+            d = Dictionary(np.asarray([data], dtype=object))
+            data = jnp.zeros((capacity,), dtype=jnp.int32)
+            valid = _expand_valid(v.valid, capacity)
+            return Column(data, valid, v.type, d)
+        data = jnp.full((capacity,), data, dtype=v.type.numpy_dtype())
+    valid = _expand_valid(v.valid, capacity)
+    return Column(data, valid, v.type, v.dictionary)
+
+
+def _expand_valid(valid, capacity):
+    if valid is None:
+        return None
+    if not hasattr(valid, "shape") or getattr(valid, "ndim", 0) == 0:
+        return jnp.full((capacity,), bool(valid))
+    return valid

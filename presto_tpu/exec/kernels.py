@@ -1,0 +1,280 @@
+"""Relational kernels over columnar device arrays.
+
+Reference parity: the operator layer (presto-main/.../operator/, §2.4 of
+SURVEY.md) re-expressed as whole-column array programs:
+
+- HashAggregationOperator + GroupByHash (operator/MultiChannelGroupByHash.java)
+  -> exact key packing + sort + segmented reductions.  TPUs have no
+  scatter-friendly hash tables; sort-based grouping is contention-free and
+  maps onto the sorting network + segmented-scan idioms XLA compiles well.
+- HashBuilderOperator/LookupJoinOperator (PagesIndex + JoinProbe)
+  -> sort build side + vectorized searchsorted probe; FK joins (unique
+  build keys) are a pure gather; one-to-many expands via repeat with a
+  computed total (the PositionLinks analog).
+- OrderByOperator/TopNOperator -> multi-key lexicographic argsort / sort+cut.
+- Masks replace selection: filters AND into `sel` (no compaction inside a
+  fragment), the static-shape answer to data-dependent page sizes.
+
+Eager-mode kernels pull capacities to host (dynamic result sizing); the
+jitted fragment path reuses the same functions with static capacities.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column, Dictionary
+from presto_tpu.exec.colval import translate_codes
+
+I64_MIN = np.iinfo(np.int64).min
+I64_MAX = np.iinfo(np.int64).max
+
+
+# ---------------------------------------------------------------------------
+# key packing: N key columns -> one int64 (exact, using runtime ranges)
+# ---------------------------------------------------------------------------
+
+
+def pack_keys(cols: List[Column], sel, extra_cols: Optional[List[Column]] = None):
+    """Pack key columns into a single int64 key per row. Masked-out rows get
+    sentinel I64_MAX (sorts last, never matches). NULL in any key column
+    gets its own code (SQL GROUP BY treats NULLs as one group).
+
+    Returns (key: i64[n], layout) where layout allows packing another
+    column set with the same strides (for join build/probe sides pass
+    `extra_cols` so both sides share ranges).
+    """
+    parts = []
+    for i, c in enumerate(cols):
+        d = _orderable_int(c)
+        lo = jnp.min(jnp.where(_valid_arr(c), d, I64_MAX))
+        hi = jnp.max(jnp.where(_valid_arr(c), d, I64_MIN))
+        if extra_cols is not None:
+            e = _orderable_int(extra_cols[i])
+            lo = jnp.minimum(lo, jnp.min(jnp.where(_valid_arr(extra_cols[i]), e, I64_MAX)))
+            hi = jnp.maximum(hi, jnp.max(jnp.where(_valid_arr(extra_cols[i]), e, I64_MIN)))
+        lo_h = int(lo)
+        hi_h = int(hi)
+        if hi_h < lo_h:  # all null / empty
+            lo_h, hi_h = 0, 0
+        parts.append((lo_h, hi_h - lo_h + 2))  # +1 for range, +1 for null code
+
+    total_bits = sum(int(np.ceil(np.log2(max(card, 2)))) for _, card in parts)
+    if total_bits > 62:
+        return _hash_keys(cols, sel), None
+
+    layout = []
+    stride = 1
+    for lo_h, card in parts:
+        width = int(np.ceil(np.log2(max(card, 2))))
+        layout.append((lo_h, stride, width))
+        stride <<= width
+    key = _apply_layout(cols, layout)
+    key = jnp.where(sel, key, I64_MAX)
+    return key, layout
+
+
+def _apply_layout(cols: List[Column], layout) -> jnp.ndarray:
+    key = None
+    for c, (lo, stride, width) in zip(cols, layout):
+        d = _orderable_int(c)
+        code = jnp.where(_valid_arr(c), d - lo + 1, 0)  # 0 = null code
+        contrib = code.astype(jnp.int64) * stride
+        key = contrib if key is None else key + contrib
+    return key
+
+
+def pack_with_layout(cols: List[Column], sel, layout) -> jnp.ndarray:
+    if layout is None:
+        return _hash_keys(cols, sel)
+    key = _apply_layout(cols, layout)
+    return jnp.where(sel, key, I64_MAX)
+
+
+def _orderable_int(c: Column) -> jnp.ndarray:
+    d = c.data
+    if d.dtype == jnp.bool_:
+        return d.astype(jnp.int64)
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        # order-preserving bit trick: positives keep their bits; negatives
+        # map to [I64_MIN, -1] reversed so the int order == float order
+        bits = jax.lax.bitcast_convert_type(d.astype(jnp.float64), jnp.int64)
+        return jnp.where(bits < 0, (~bits) + jnp.int64(I64_MIN), bits)
+    return d.astype(jnp.int64)
+
+
+def _valid_arr(c: Column) -> jnp.ndarray:
+    if c.valid is None:
+        return jnp.ones(c.data.shape, dtype=bool)
+    return c.valid
+
+
+def _hash_keys(cols: List[Column], sel) -> jnp.ndarray:
+    """64-bit mix fallback when exact packing exceeds 62 bits.
+    Collision probability for n rows ~ n^2/2^64 (documented engine limit;
+    an exact verification pass can be layered later)."""
+    h = jnp.zeros(cols[0].data.shape, dtype=jnp.uint64)
+    for c in cols:
+        d = _orderable_int(c).astype(jnp.uint64)
+        d = jnp.where(_valid_arr(c), d, jnp.uint64(0x9E3779B97F4A7C15))
+        h = h ^ (d + jnp.uint64(0x9E3779B97F4A7C15) + (h << jnp.uint64(6)) + (h >> jnp.uint64(2)))
+        z = h
+        z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        h = z ^ (z >> jnp.uint64(31))
+    key = (h >> jnp.uint64(1)).astype(jnp.int64)  # keep positive, below I64_MAX
+    return jnp.where(sel, key, I64_MAX)
+
+
+# ---------------------------------------------------------------------------
+# group-by
+# ---------------------------------------------------------------------------
+
+
+def group_ids(key: jnp.ndarray, sel) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Sort-based grouping. Returns (gid[n] in [0, n_groups) for live rows,
+    representative row index per group [n_groups], n_groups).
+    Masked rows get gid = n_groups (callers drop them via segment bounds)."""
+    n = key.shape[0]
+    order = jnp.argsort(key)  # masked rows (I64_MAX) sort last
+    skey = key[order]
+    newgrp = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
+    live_sorted = skey != I64_MAX
+    newgrp = newgrp & live_sorted
+    gid_sorted = jnp.cumsum(newgrp) - 1
+    n_groups = int(jnp.sum(newgrp))
+    gid_sorted = jnp.where(live_sorted, gid_sorted, n_groups)
+    gid = jnp.zeros((n,), dtype=gid_sorted.dtype).at[order].set(gid_sorted)
+    # representative row per group = first sorted occurrence
+    rep_sorted_pos = jnp.nonzero(newgrp, size=max(n_groups, 1), fill_value=0)[0]
+    rep_rows = order[rep_sorted_pos][:n_groups] if n_groups else jnp.zeros((0,), order.dtype)
+    return gid, rep_rows, n_groups
+
+
+def segment_sum(x, gid, n_groups):
+    return jax.ops.segment_sum(x, gid, num_segments=n_groups + 1)[:n_groups]
+
+
+def segment_min(x, gid, n_groups):
+    return jax.ops.segment_min(x, gid, num_segments=n_groups + 1)[:n_groups]
+
+
+def segment_max(x, gid, n_groups):
+    return jax.ops.segment_max(x, gid, num_segments=n_groups + 1)[:n_groups]
+
+
+# ---------------------------------------------------------------------------
+# join probe
+# ---------------------------------------------------------------------------
+
+
+def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray):
+    """Sort build side; binary-search each probe key.
+    Returns (order, lb, ub): build_key[order] sorted; matches for probe row
+    i are order[lb[i]:ub[i]]."""
+    order = jnp.argsort(build_key)
+    skey = build_key[order]
+    lb = jnp.searchsorted(skey, probe_key, side="left")
+    ub = jnp.searchsorted(skey, probe_key, side="right")
+    # sentinel keys (masked build rows) must not match masked probe rows
+    live = probe_key != I64_MAX
+    lb = jnp.where(live, lb, 0)
+    ub = jnp.where(live, ub, 0)
+    return order, lb, ub
+
+
+def gather_batch(batch: Batch, idx: jnp.ndarray, idx_valid=None) -> Batch:
+    """Gather rows of all columns at idx (clipped); optionally mask."""
+    n = batch.capacity
+    safe = jnp.clip(idx, 0, max(n - 1, 0))
+    cols = {}
+    for name, c in batch.columns.items():
+        data = c.data[safe]
+        valid = None if c.valid is None else c.valid[safe]
+        if idx_valid is not None:
+            valid = idx_valid if valid is None else (valid & idx_valid)
+        cols[name] = Column(data, valid, c.type, c.dictionary)
+    sel = batch.sel[safe]
+    if idx_valid is not None:
+        sel = sel & idx_valid
+    return Batch(cols, sel)
+
+
+def compact(batch: Batch) -> Batch:
+    """Drop masked rows (host-sync on the live count). Used at fragment
+    boundaries (exchange points), not inside fragments."""
+    n_live = int(jnp.sum(batch.sel))
+    idx = jnp.nonzero(batch.sel, size=max(n_live, 1), fill_value=0)[0]
+    if n_live == 0:
+        idx = idx[:0]
+    cols = {}
+    for name, c in batch.columns.items():
+        cols[name] = Column(c.data[idx], None if c.valid is None else c.valid[idx],
+                            c.type, c.dictionary)
+    return Batch(cols, jnp.ones((n_live,), bool))
+
+
+def concat_batches(batches: List[Batch]) -> Batch:
+    """Concatenate same-schema batches (dictionary columns are merged)."""
+    names = list(batches[0].columns)
+    cols: Dict[str, Column] = {}
+    for name in names:
+        parts = [b.columns[name] for b in batches]
+        dicts = [p.dictionary for p in parts]
+        if parts[0].type.is_string and len({id(d) for d in dicts}) > 1:
+            merged = Dictionary(np.unique(np.concatenate([d.values for d in dicts])))
+            datas = []
+            for p in parts:
+                lut = jnp.asarray(translate_codes(p.dictionary, merged))
+                datas.append(lut[jnp.clip(p.data, 0, len(p.dictionary) - 1)])
+            data = jnp.concatenate(datas)
+            dictionary = merged
+        else:
+            data = jnp.concatenate([p.data for p in parts])
+            dictionary = dicts[0]
+        if any(p.valid is not None for p in parts):
+            valid = jnp.concatenate([
+                p.valid if p.valid is not None else jnp.ones(p.data.shape, bool)
+                for p in parts])
+        else:
+            valid = None
+        cols[name] = Column(data, valid, parts[0].type, dictionary)
+    sel = jnp.concatenate([b.sel for b in batches])
+    return Batch(cols, sel)
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+
+def sort_perm(batch: Batch, keys: List[Tuple[Column, bool, Optional[bool]]]):
+    """Lexicographic permutation; masked rows last.
+    keys: (column, ascending, nulls_first). Default null order matches the
+    reference (NULLS LAST for ASC, NULLS FIRST for DESC —
+    presto-parser SortItem.NullOrdering defaults)."""
+    n = batch.capacity
+    perm = jnp.arange(n)
+    # stable sorts applied last-key-first
+    for col, asc, nulls_first in reversed(keys):
+        d = _orderable_int(col)[perm]
+        valid = _valid_arr(col)[perm]
+        if nulls_first is None:
+            nf = not asc
+        else:
+            nf = nulls_first
+        if not asc:
+            d = -d
+        null_sent = I64_MIN if nf else I64_MAX - 1
+        d = jnp.where(valid, d, null_sent)
+        order = jnp.argsort(d, stable=True)
+        perm = perm[order]
+    # push masked rows to the end (stable)
+    live = batch.sel[perm]
+    order = jnp.argsort(~live, stable=True)
+    return perm[order]

@@ -1,0 +1,86 @@
+"""Aggregate function catalog: signature resolution + reduction specs.
+
+Reference parity: the 98 aggregation files under presto-main/.../operator/
+aggregation/ and AccumulatorCompiler.  Here every aggregate is described as
+a (init, map, segment-combine, finalize) spec over fixed-shape arrays so
+group-by lowers to jax.ops.segment_* reductions — the TPU replacement for
+per-group accumulator objects.  PARTIAL/FINAL splitting (reference:
+AggregationNode.Step) works on the intermediate columns declared here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+
+
+@dataclasses.dataclass
+class AggSpec:
+    name: str
+    resolve: Callable  # (arg_types: List[Type]) -> Optional[Type]
+    # intermediate state columns: list of (suffix, init_value, combine)
+    # combine in {'sum', 'min', 'max', 'bitor'} — all segment-reducible
+    states: Callable  # (arg_types) -> List[Tuple[str, str]]  (suffix, combine-op)
+    # map inputs -> state columns (row-wise, pre-reduction)
+    # finalize state columns -> result
+
+
+RESOLVERS: Dict[str, Callable] = {}
+
+
+def _numeric_sum_type(t: T.Type) -> T.Type:
+    if t.is_integer:
+        return T.BIGINT
+    if t.is_decimal:
+        return t
+    return T.DOUBLE
+
+
+def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Type:
+    name = name.lower()
+    if name in ("count", "count_if"):
+        return T.BIGINT
+    if name == "approx_distinct":
+        return T.BIGINT
+    if name == "sum":
+        if not arg_types[0].is_numeric:
+            raise TypeError(f"sum over {arg_types[0]}")
+        return _numeric_sum_type(arg_types[0])
+    if name == "avg":
+        if not arg_types[0].is_numeric:
+            raise TypeError(f"avg over {arg_types[0]}")
+        return T.DOUBLE
+    if name in ("min", "max", "arbitrary", "any_value"):
+        return arg_types[0]
+    if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
+        return T.DOUBLE
+    if name in ("bool_and", "bool_or", "every"):
+        return T.BOOLEAN
+    if name in ("corr", "covar_samp", "covar_pop"):
+        return T.DOUBLE
+    raise KeyError(f"unknown aggregate function: {name}")
+
+
+AGG_NAMES = {
+    "count", "count_if", "sum", "avg", "min", "max", "arbitrary", "any_value",
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+    "bool_and", "bool_or", "every", "approx_distinct", "corr", "covar_samp",
+    "covar_pop",
+}
+
+
+def is_aggregate(name: str) -> bool:
+    return name.lower() in AGG_NAMES
+
+
+WINDOW_ONLY = {"row_number", "rank", "dense_rank", "ntile", "lag", "lead",
+               "first_value", "last_value", "nth_value", "cume_dist", "percent_rank"}
+
+
+def is_window(name: str) -> bool:
+    n = name.lower()
+    return n in WINDOW_ONLY or is_aggregate(n)

@@ -1,0 +1,70 @@
+"""Device mesh runtime: the distributed execution substrate.
+
+Reference parity: the coordinator/worker topology + HTTP exchanges
+(SURVEY.md §2.6) re-based on jax.sharding.Mesh + shard_map supersteps:
+- P1 hash repartition (FIXED_HASH_DISTRIBUTION / PartitionedOutputOperator)
+  -> lax.all_to_all over the 'x' mesh axis (parallel/exchange.py)
+- P2 broadcast (BroadcastOutputBuffer) -> lax.all_gather
+- P5 gather to coordinator (SINGLE_DISTRIBUTION) -> psum / device_get
+- partial->final aggregation (AddExchanges.java:239) -> per-shard segment
+  reduce + psum tree-combine, shown here as distributed_q1_step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+AXIS = "x"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None and len(devs) < n_devices:
+        # fall back to the virtual CPU backend (multi-chip dry-run path;
+        # XLA_FLAGS=--xla_force_host_platform_device_count=N must be set
+        # before backend init)
+        devs = jax.devices("cpu")
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {len(devs)} "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.asarray(devs), (AXIS,))
+
+
+def distributed_q1_step(mesh: Mesh, data: dict):
+    """Partial aggregation per shard + all-reduce combine: the canonical
+    scan->partial agg->FINAL agg distributed plan (TPC-H Q1 shape)."""
+    n_groups = 8
+
+    def shard_fn(shipdate, flag, status, qty, price, discount, tax):
+        sel = shipdate <= 10471
+        key = (flag * 2 + status).astype(jnp.int32)
+        key = jnp.where(sel, key, n_groups)
+        disc_price = price * (1.0 - discount)
+        charge = disc_price * (1.0 + tax)
+
+        def seg(x):
+            partial = jax.ops.segment_sum(
+                jnp.where(sel, x, jnp.zeros_like(x)), key,
+                num_segments=n_groups + 1)[:n_groups]
+            return jax.lax.psum(partial, AXIS)  # FINAL combine over ICI
+
+        return (seg(qty), seg(price), seg(disc_price), seg(charge),
+                seg(jnp.ones_like(qty)), seg(discount))
+
+    f = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(AXIS),) * 7,
+        out_specs=(P(),) * 6,
+    )
+    args = (data["shipdate"], data["flag"], data["status"], data["qty"],
+            data["price"], data["discount"], data["tax"])
+    return jax.jit(f)(*args)

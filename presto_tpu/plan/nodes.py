@@ -1,0 +1,263 @@
+"""Logical plan nodes.
+
+Reference parity: sql/planner/plan/ (41 node classes) trimmed to the set
+the engine executes; symbols are unique strings (reference: Symbol +
+SymbolAllocator), every node knows its output symbols and types.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.plan.ir import AggCall, RowExpr
+from presto_tpu.types import Type
+
+
+class PlanNode:
+    id_counter = itertools.count()
+
+    def outputs(self) -> List[Tuple[str, Type]]:
+        raise NotImplementedError
+
+    @property
+    def sources(self) -> list:
+        return []
+
+    def output_names(self):
+        return [n for n, _ in self.outputs()]
+
+    def output_types(self) -> Dict[str, Type]:
+        return dict(self.outputs())
+
+
+@dataclass
+class TableScan(PlanNode):
+    table: str
+    # symbol -> source column name (projection pushdown unit)
+    assignments: Dict[str, str] = field(default_factory=dict)
+    types: Dict[str, Type] = field(default_factory=dict)
+
+    def outputs(self):
+        return [(s, self.types[s]) for s in self.assignments]
+
+
+@dataclass
+class Values(PlanNode):
+    symbols: List[str] = field(default_factory=list)
+    types_: List[Type] = field(default_factory=list)
+    rows: List[list] = field(default_factory=list)  # python literal values
+
+    def outputs(self):
+        return list(zip(self.symbols, self.types_))
+
+
+@dataclass
+class Filter(PlanNode):
+    source: PlanNode
+    predicate: RowExpr
+
+    def outputs(self):
+        return self.source.outputs()
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Project(PlanNode):
+    source: PlanNode
+    assignments: Dict[str, RowExpr] = field(default_factory=dict)
+
+    def outputs(self):
+        return [(s, e.type) for s, e in self.assignments.items()]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Aggregate(PlanNode):
+    source: PlanNode
+    group_keys: List[str] = field(default_factory=list)
+    aggs: Dict[str, AggCall] = field(default_factory=dict)
+    # step: SINGLE | PARTIAL | FINAL (reference: AggregationNode.Step)
+    step: str = "SINGLE"
+
+    def outputs(self):
+        src_types = self.source.output_types()
+        out = [(k, src_types[k]) for k in self.group_keys]
+        out += [(s, a.type) for s, a in self.aggs.items()]
+        return out
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Join(PlanNode):
+    """INNER/LEFT/RIGHT/FULL/CROSS equi-join (+ residual filter), or
+    SEMI/ANTI (left row kept iff [no] right match passes the filter —
+    reference: SemiJoinNode, with the filtered-EXISTS generalization)."""
+
+    left: PlanNode
+    right: PlanNode
+    join_type: str  # INNER LEFT RIGHT FULL CROSS SEMI ANTI
+    criteria: List[Tuple[str, str]] = field(default_factory=list)  # (lsym, rsym)
+    filter: Optional[RowExpr] = None
+    # execution hints filled by the optimizer
+    distribution: str = "AUTOMATIC"  # PARTITIONED | BROADCAST | AUTOMATIC
+
+    def outputs(self):
+        if self.join_type in ("SEMI", "ANTI"):
+            return self.left.outputs()
+        lout = self.left.outputs()
+        rout = self.right.outputs()
+        if self.join_type in ("LEFT", "FULL"):
+            rout = [(s, t) for s, t in rout]
+        return lout + rout
+
+    @property
+    def sources(self):
+        return [self.left, self.right]
+
+
+@dataclass
+class Sort(PlanNode):
+    source: PlanNode
+    keys: List[Tuple[str, bool, Optional[bool]]] = field(default_factory=list)
+    # (symbol, ascending, nulls_first)
+
+    def outputs(self):
+        return self.source.outputs()
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Limit(PlanNode):
+    source: PlanNode
+    count: int = 0
+
+    def outputs(self):
+        return self.source.outputs()
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class TopN(PlanNode):
+    source: PlanNode
+    keys: List[Tuple[str, bool, Optional[bool]]] = field(default_factory=list)
+    count: int = 0
+
+    def outputs(self):
+        return self.source.outputs()
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Union(PlanNode):
+    sources_: List[PlanNode] = field(default_factory=list)
+    symbols: List[str] = field(default_factory=list)
+    # per-source mapping: output symbol -> source symbol
+    mappings: List[Dict[str, str]] = field(default_factory=list)
+    distinct: bool = False
+
+    def outputs(self):
+        t0 = self.sources_[0].output_types()
+        return [(s, t0[self.mappings[0][s]]) for s in self.symbols]
+
+    @property
+    def sources(self):
+        return list(self.sources_)
+
+
+@dataclass
+class Window(PlanNode):
+    source: PlanNode
+    partition_by: List[str] = field(default_factory=list)
+    order_by: List[Tuple[str, bool, Optional[bool]]] = field(default_factory=list)
+    functions: Dict[str, AggCall] = field(default_factory=dict)  # symbol -> call
+    frame: Optional[Tuple[str, str, str]] = None
+
+    def outputs(self):
+        return self.source.outputs() + [(s, c.type) for s, c in self.functions.items()]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Output(PlanNode):
+    source: PlanNode
+    names: List[str] = field(default_factory=list)  # user-visible column names
+    symbols: List[str] = field(default_factory=list)
+
+    def outputs(self):
+        t = self.source.output_types()
+        return [(s, t[s]) for s in self.symbols]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class QueryPlan:
+    """Root plan + uncorrelated scalar subplans it references.
+    Subplans are evaluated first (reference: uncorrelated Apply lowered to
+    an exchange from a separate stage)."""
+
+    root: Output
+    subplans: Dict[int, PlanNode] = field(default_factory=dict)
+
+
+def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style textual plan (reference: textLogicalPlan in
+    sql/planner/planPrinter/PlanPrinter.java)."""
+    pad = "  " * indent
+    name = type(node).__name__
+    detail = ""
+    if isinstance(node, TableScan):
+        detail = f" {node.table} {list(node.assignments.values())}"
+    elif isinstance(node, Filter):
+        detail = f" [{node.predicate}]"
+    elif isinstance(node, Project):
+        detail = " {" + ", ".join(f"{s} := {e}" for s, e in node.assignments.items()) + "}"
+    elif isinstance(node, Aggregate):
+        detail = (f" {node.step} keys={node.group_keys} "
+                  + "{" + ", ".join(f"{s} := {a}" for s, a in node.aggs.items()) + "}")
+    elif isinstance(node, Join):
+        detail = f" {node.join_type} {node.criteria}" + (
+            f" filter=[{node.filter}]" if node.filter is not None else "")
+    elif isinstance(node, (Sort, TopN)):
+        detail = f" {node.keys}" + (
+            f" limit={node.count}" if isinstance(node, TopN) else "")
+    elif isinstance(node, Limit):
+        detail = f" {node.count}"
+    elif isinstance(node, Output):
+        detail = f" {list(zip(node.names, node.symbols))}"
+    elif isinstance(node, Values):
+        detail = f" {len(node.rows)} rows"
+    elif isinstance(node, Window):
+        detail = f" partition={node.partition_by} order={node.order_by}"
+    lines = [pad + name + detail]
+    for s in node.sources:
+        lines.append(plan_tree_str(s, indent + 1))
+    return "\n".join(lines)

@@ -1,0 +1,856 @@
+"""Analyzer + logical planner: AST -> typed logical plan.
+
+Reference parity: sql/analyzer/StatementAnalyzer.java +
+ExpressionAnalyzer.java (scopes, name resolution, type checking, coercions)
+and sql/planner/{LogicalPlanner,RelationPlanner,QueryPlanner,SubqueryPlanner}.
+Collapsed into one pass that emits typed IR directly (the reference's
+separate Analysis object buys incremental re-analysis we don't need).
+
+Subquery handling (reference: SubqueryPlanner + TransformCorrelated* rules):
+- EXISTS / IN-subquery conjuncts  -> SEMI/ANTI join (+ residual filter)
+- correlated scalar-aggregate subquery -> grouped aggregate joined on the
+  correlation keys
+- uncorrelated scalar subquery -> separately-planned subplan referenced by
+  a ScalarSub IR leaf (evaluated first, like a gather-exchange stage)
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.functions import aggregate as agg_fns
+from presto_tpu.functions import scalar as scalar_fns
+from presto_tpu.plan import ir
+from presto_tpu.plan import nodes as P
+from presto_tpu.sql import ast
+
+
+class SemanticError(Exception):
+    pass
+
+
+@dataclass
+class Field_:
+    qualifier: Optional[str]
+    name: Optional[str]
+    symbol: str
+    type: T.Type
+
+
+@dataclass
+class Scope:
+    fields: List[Field_] = field(default_factory=list)
+    parent: Optional["Scope"] = None  # outer query scope (correlation)
+
+    def resolve(self, parts: Tuple[str, ...]) -> Tuple[Field_, bool]:
+        """Returns (field, is_outer)."""
+        matches = self._match(parts)
+        if len(matches) == 1:
+            return matches[0], False
+        if len(matches) > 1:
+            raise SemanticError(f"Column '{'.'.join(parts)}' is ambiguous")
+        if self.parent is not None:
+            f, _ = self.parent.resolve(parts)
+            return f, True
+        raise SemanticError(f"Column '{'.'.join(parts)}' cannot be resolved")
+
+    def _match(self, parts):
+        if len(parts) == 1:
+            return [f for f in self.fields if f.name == parts[0]]
+        if len(parts) >= 2:
+            q, n = parts[-2], parts[-1]
+            return [f for f in self.fields if f.name == n and f.qualifier == q]
+        return []
+
+    def visible(self):
+        return [f for f in self.fields if f.name is not None]
+
+
+class SymbolAllocator:
+    def __init__(self):
+        self.counter = itertools.count()
+
+    def new(self, hint: str) -> str:
+        return f"{hint}${next(self.counter)}"
+
+
+class Planner:
+    def __init__(self, session):
+        self.session = session
+        self.catalog = session.catalog
+        self.symbols = SymbolAllocator()
+        self.subplans: Dict[int, P.PlanNode] = {}
+        self.subplan_ids = itertools.count()
+        self.cte_stack: List[Dict[str, tuple]] = []
+
+    # ------------------------------------------------------------------
+    def plan_statement(self, stmt: ast.Statement) -> P.QueryPlan:
+        if isinstance(stmt, ast.QueryStatement):
+            node, scope, names = self.plan_query(stmt.query)
+            out = P.Output(node, names, [f.symbol for f in scope.fields])
+            return P.QueryPlan(out, self.subplans)
+        raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------
+    def plan_query(self, q: ast.Query, outer: Optional[Scope] = None):
+        """Returns (plan, scope, output names)."""
+        if q.ctes:
+            self.cte_stack.append({name.lower(): (query, cols) for name, query, cols in q.ctes})
+        try:
+            node, scope, names = self._plan_body(q.body, outer)
+            if q.order_by:
+                node, scope = self._plan_order_limit(node, scope, names, q.order_by, q.limit, outer)
+            elif q.limit is not None:
+                node = P.Limit(node, q.limit)
+            return node, scope, names
+        finally:
+            if q.ctes:
+                self.cte_stack.pop()
+
+    def _plan_body(self, body, outer):
+        if isinstance(body, ast.QuerySpec):
+            return self.plan_query_spec(body, outer)
+        if isinstance(body, ast.SetOp):
+            return self._plan_set_op(body, outer)
+        raise SemanticError(f"unsupported query body {type(body).__name__}")
+
+    def _plan_set_op(self, op: ast.SetOp, outer):
+        lnode, lscope, lnames = self._plan_body(op.left, outer)
+        rnode, rscope, rnames = self._plan_body(op.right, outer)
+        lf, rf = lscope.fields, rscope.fields
+        if len(lf) != len(rf):
+            raise SemanticError("set operation column count mismatch")
+        if op.op == "UNION":
+            out_syms, mappings_l, mappings_r = [], {}, {}
+            out_fields = []
+            for a, b in zip(lf, rf):
+                ct = T.common_super_type(a.type, b.type)
+                if ct is None:
+                    raise SemanticError(f"UNION type mismatch {a.type} vs {b.type}")
+                s = self.symbols.new(a.name or "col")
+                out_syms.append(s)
+                mappings_l[s] = a.symbol
+                mappings_r[s] = b.symbol
+                out_fields.append(Field_(None, a.name, s, ct))
+            node = P.Union([lnode, rnode], out_syms, [mappings_l, mappings_r])
+            scope = Scope(out_fields)
+            if not op.all:
+                node = P.Aggregate(node, out_syms, {}, "SINGLE")
+            return node, scope, lnames
+        # INTERSECT/EXCEPT via SEMI/ANTI join on all columns (distinct first)
+        join_type = "SEMI" if op.op == "INTERSECT" else "ANTI"
+        lnode = P.Aggregate(lnode, [f.symbol for f in lf], {}, "SINGLE")
+        criteria = [(a.symbol, b.symbol) for a, b in zip(lf, rf)]
+        node = P.Join(lnode, rnode, join_type, criteria)
+        return node, lscope, lnames
+
+    # ------------------------------------------------------------------
+    def plan_query_spec(self, spec: ast.QuerySpec, outer):
+        # FROM
+        if spec.from_ is not None:
+            node, scope = self.plan_relation(spec.from_, outer)
+        else:
+            sym = self.symbols.new("dual")
+            node = P.Values([sym], [T.BIGINT], [[0]])
+            scope = Scope([])
+        scope.parent = outer
+
+        # WHERE (with subquery conjuncts)
+        if spec.where is not None:
+            node = self._plan_where(node, scope, spec.where)
+
+        # aggregation analysis
+        agg_calls: List[Tuple[ast.FunctionCall, str]] = []  # (ast node, out symbol)
+        has_group = bool(spec.group_by)
+        exprs_to_scan = [it.expr for it in spec.select if not isinstance(it.expr, ast.Star)]
+        if spec.having is not None:
+            exprs_to_scan.append(spec.having)
+        for e in exprs_to_scan:
+            self._collect_aggs(e, agg_calls)
+        has_agg = bool(agg_calls) or has_group
+
+        select_scope = scope
+        if has_agg:
+            node, select_scope, agg_map, group_map = self._plan_aggregation(
+                node, scope, spec.group_by, agg_calls, outer)
+        else:
+            agg_map, group_map = {}, {}
+
+        # HAVING
+        if spec.having is not None:
+            node = self._plan_where(node, select_scope, spec.having,
+                                    agg_map=agg_map, group_map=group_map)
+
+        # SELECT projections
+        assignments: Dict[str, ir.RowExpr] = {}
+        out_fields: List[Field_] = []
+        names: List[str] = []
+        for item in spec.select:
+            if isinstance(item.expr, ast.Star):
+                for f in (select_scope.visible() if item.expr.qualifier is None else
+                          [f for f in select_scope.fields if f.qualifier == item.expr.qualifier]):
+                    s = self.symbols.new(f.name or "col")
+                    assignments[s] = ir.Ref(f.symbol, f.type)
+                    out_fields.append(Field_(None, f.name, s, f.type))
+                    names.append(f.name or "_col")
+                continue
+            e = self.analyze(item.expr, select_scope, agg_map=agg_map, group_map=group_map)
+            name = item.alias or self._derive_name(item.expr)
+            s = self.symbols.new(name or "expr")
+            assignments[s] = e
+            out_fields.append(Field_(None, name, s, e.type))
+            names.append(name or "_col")
+        node = P.Project(node, assignments)
+        scope_out = Scope(out_fields)
+
+        if spec.distinct:
+            node = P.Aggregate(node, [f.symbol for f in out_fields], {}, "SINGLE")
+
+        # stash for ORDER BY resolution: keep pre-projection scope available
+        scope_out.pre_projection = (select_scope, agg_map, group_map)  # type: ignore
+        return node, scope_out, names
+
+    def _derive_name(self, e: ast.Expr) -> Optional[str]:
+        if isinstance(e, ast.Identifier):
+            return e.name
+        if isinstance(e, ast.FunctionCall):
+            return e.name
+        return None
+
+    # ------------------------------------------------------------------
+    def _plan_order_limit(self, node, scope, names, order_by, limit, outer):
+        """Sort may reference select aliases, ordinals, or (for non-agg
+        queries) underlying columns; extra sort keys are projected then
+        trimmed (reference: QueryPlanner.planOrderBy)."""
+        keys = []
+        extra_assignments = {}
+        pre = getattr(scope, "pre_projection", None)
+        for si in order_by:
+            e = si.expr
+            sym = None
+            if isinstance(e, ast.Literal) and isinstance(e.value, int):
+                idx = e.value - 1
+                if not (0 <= idx < len(scope.fields)):
+                    raise SemanticError(f"ORDER BY position {e.value} out of range")
+                sym = scope.fields[idx].symbol
+            elif isinstance(e, ast.Identifier) and len(e.parts) == 1:
+                matches = [f for f in scope.fields if f.name == e.name]
+                if matches:
+                    sym = matches[0].symbol
+            if sym is None:
+                if pre is not None:
+                    sel_scope, agg_map, group_map = pre
+                    rex = self.analyze(e, sel_scope, agg_map=agg_map, group_map=group_map)
+                else:
+                    rex = self.analyze(e, scope)
+                s = self.symbols.new("sortkey")
+                extra_assignments[s] = rex
+                sym = s
+            keys.append((sym, si.ascending, si.nulls_first))
+        if extra_assignments:
+            if not isinstance(node, P.Project):
+                raise SemanticError("cannot add sort keys to non-projection")
+            node = P.Project(node.source, {**node.assignments, **extra_assignments})
+        if limit is not None:
+            node = P.TopN(node, keys, limit)
+        else:
+            node = P.Sort(node, keys)
+        if extra_assignments:
+            # trim the extra sort keys after sorting
+            keep = {f.symbol: ir.Ref(f.symbol, f.type) for f in scope.fields}
+            node = P.Project(node, keep)
+        return node, scope
+
+    # ------------------------------------------------------------------
+    # relations
+    # ------------------------------------------------------------------
+    def plan_relation(self, rel: ast.Relation, outer) -> Tuple[P.PlanNode, Scope]:
+        if isinstance(rel, ast.Table):
+            return self._plan_table(rel, outer)
+        if isinstance(rel, ast.SubqueryRelation):
+            node, scope, names = self.plan_query(rel.query, outer)
+            q = rel.alias
+            fields = []
+            for i, f in enumerate(scope.fields):
+                nm = (rel.column_aliases[i] if rel.column_aliases and i < len(rel.column_aliases)
+                      else f.name)
+                fields.append(Field_(q, nm, f.symbol, f.type))
+            return node, Scope(fields)
+        if isinstance(rel, ast.Join):
+            return self._plan_join(rel, outer)
+        if isinstance(rel, ast.ValuesRelation):
+            return self._plan_values(rel)
+        raise SemanticError(f"unsupported relation {type(rel).__name__}")
+
+    def _plan_table(self, rel: ast.Table, outer):
+        name = rel.name.lower()
+        for ctes in reversed(self.cte_stack):
+            if name in ctes:
+                query, col_aliases = ctes[name]
+                node, scope, names = self.plan_query(query, None)
+                q = rel.alias or rel.name
+                fields = []
+                for i, f in enumerate(scope.fields):
+                    nm = (col_aliases[i] if col_aliases and i < len(col_aliases) else f.name)
+                    fields.append(Field_(q, nm, f.symbol, f.type))
+                return node, Scope(fields)
+        table = self.catalog.get(name)
+        assignments, types, fields = {}, {}, []
+        q = rel.alias or rel.name
+        for i, (col, typ) in enumerate(table.schema.items()):
+            nm = (rel.column_aliases[i] if rel.column_aliases and i < len(rel.column_aliases)
+                  else col)
+            s = self.symbols.new(col)
+            assignments[s] = col
+            types[s] = typ
+            fields.append(Field_(q, nm, s, typ))
+        return P.TableScan(name, assignments, types), Scope(fields)
+
+    def _plan_values(self, rel: ast.ValuesRelation):
+        rows = []
+        col_types: List[T.Type] = []
+        for row in rel.rows:
+            vals = []
+            for j, e in enumerate(row):
+                rex = self.analyze(e, Scope([]))
+                if not isinstance(rex, ir.Lit):
+                    raise SemanticError("VALUES requires literals")
+                vals.append(rex.value)
+                if j >= len(col_types):
+                    col_types.append(rex.type)
+                else:
+                    ct = T.common_super_type(col_types[j], rex.type)
+                    if ct is None:
+                        raise SemanticError("VALUES type mismatch")
+                    col_types[j] = ct
+            rows.append(vals)
+        syms = [self.symbols.new(f"col{j}") for j in range(len(col_types))]
+        aliases = rel.column_aliases or [f"_col{j}" for j in range(len(col_types))]
+        fields = [Field_(rel.alias, aliases[j] if j < len(aliases) else f"_col{j}",
+                         syms[j], col_types[j]) for j in range(len(col_types))]
+        return P.Values(syms, col_types, rows), Scope(fields)
+
+    def _plan_join(self, rel: ast.Join, outer):
+        lnode, lscope = self.plan_relation(rel.left, outer)
+        rnode, rscope = self.plan_relation(rel.right, outer)
+        combined = Scope(lscope.fields + rscope.fields)
+        jt = rel.join_type
+        if jt == "CROSS":
+            return P.Join(lnode, rnode, "CROSS"), combined
+        criteria: List[Tuple[str, str]] = []
+        residual: List[ir.RowExpr] = []
+        left_only: List[ir.RowExpr] = []
+        right_only: List[ir.RowExpr] = []
+        lsyms = {f.symbol for f in lscope.fields}
+        rsyms = {f.symbol for f in rscope.fields}
+        conjs: List[ast.Expr] = []
+        if rel.using:
+            for col in rel.using:
+                conjs.append(ast.BinaryOp("=", ast.Identifier((col,)), ast.Identifier((col,))))
+                # resolve each side explicitly below
+        else:
+            conjs = _ast_conjuncts(rel.on)
+        for c in conjs:
+            if rel.using and isinstance(c, ast.BinaryOp) and c.op == "=":
+                colname = c.left.name  # type: ignore
+                lf = [f for f in lscope.fields if f.name == colname]
+                rf = [f for f in rscope.fields if f.name == colname]
+                if not lf or not rf:
+                    raise SemanticError(f"USING column {colname} missing")
+                criteria.append((lf[0].symbol, rf[0].symbol))
+                continue
+            rex = self.analyze(c, combined)
+            refs = rex.refs()
+            if isinstance(rex, ir.Call) and rex.fn == "eq":
+                a, b = rex.args
+                ar, br = a.refs(), b.refs()
+                if ar and br:
+                    if ar <= lsyms and br <= rsyms:
+                        criteria.append((self._as_symbol(a, "lk"), self._as_symbol(b, "rk")))
+                        lnode, rnode = self._attach_key(lnode, a), self._attach_key(rnode, b)
+                        continue
+                    if ar <= rsyms and br <= lsyms:
+                        criteria.append((self._as_symbol(b, "lk"), self._as_symbol(a, "rk")))
+                        lnode, rnode = self._attach_key(lnode, b), self._attach_key(rnode, a)
+                        continue
+            if refs and refs <= lsyms:
+                left_only.append(rex)
+            elif refs and refs <= rsyms:
+                right_only.append(rex)
+            else:
+                residual.append(rex)
+        # push single-side conjuncts (semantics-preserving placement by join type)
+        if jt == "INNER":
+            if left_only:
+                lnode = P.Filter(lnode, ir.combine_conjuncts(left_only))
+            if right_only:
+                rnode = P.Filter(rnode, ir.combine_conjuncts(right_only))
+        else:
+            if jt == "LEFT" and right_only:
+                rnode = P.Filter(rnode, ir.combine_conjuncts(right_only))
+            elif jt == "RIGHT" and left_only:
+                lnode = P.Filter(lnode, ir.combine_conjuncts(left_only))
+            else:
+                residual.extend(left_only + right_only)
+        node = P.Join(lnode, rnode, jt, criteria, ir.combine_conjuncts(residual))
+        return node, combined
+
+    def _as_symbol(self, e: ir.RowExpr, hint: str) -> str:
+        if isinstance(e, ir.Ref):
+            return e.name
+        s = self.symbols.new(hint)
+        e._planned_symbol = s  # type: ignore
+        return s
+
+    def _attach_key(self, node: P.PlanNode, e: ir.RowExpr) -> P.PlanNode:
+        """If a join key is a computed expression, project it onto the input."""
+        if isinstance(e, ir.Ref):
+            return node
+        sym = getattr(e, "_planned_symbol")
+        assigns = {s: ir.Ref(s, t) for s, t in node.outputs()}
+        assigns[sym] = e
+        return P.Project(node, assigns)
+
+    # ------------------------------------------------------------------
+    # WHERE / HAVING with subquery conjunct handling
+    # ------------------------------------------------------------------
+    def _plan_where(self, node, scope, pred: ast.Expr, agg_map=None, group_map=None):
+        plain: List[ir.RowExpr] = []
+        for conj in _ast_conjuncts(pred):
+            node, handled = self._try_subquery_conjunct(node, scope, conj, agg_map, group_map)
+            if handled:
+                continue
+            plain.append(self.analyze(conj, scope, agg_map=agg_map, group_map=group_map))
+        if plain:
+            node = P.Filter(node, ir.combine_conjuncts(plain))
+        return node
+
+    def _try_subquery_conjunct(self, node, scope, conj, agg_map, group_map):
+        neg = False
+        inner = conj
+        while isinstance(inner, ast.UnaryOp) and inner.op == "NOT":
+            neg = not neg
+            inner = inner.operand
+        if isinstance(inner, ast.Exists):
+            sub = inner.query
+            negated = neg != inner.negated
+            return self._plan_exists(node, scope, sub, negated), True
+        if isinstance(inner, ast.InSubquery):
+            negated = neg != inner.negated
+            return self._plan_in_subquery(node, scope, inner.value, inner.query, negated,
+                                          agg_map, group_map), True
+        if isinstance(inner, ast.BinaryOp) and inner.op in ("=", "<>", "<", "<=", ">", ">=") and not neg:
+            lhs, rhs = inner.left, inner.right
+            if isinstance(rhs, ast.ScalarSubquery) or isinstance(lhs, ast.ScalarSubquery):
+                if isinstance(lhs, ast.ScalarSubquery):
+                    lhs, rhs = rhs, lhs
+                    opmap = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                    inner = ast.BinaryOp(opmap.get(inner.op, inner.op), lhs, rhs)
+                return self._plan_scalar_compare(node, scope, inner.op, lhs,
+                                                 rhs.query, agg_map, group_map), True
+        return node, False
+
+    def _plan_exists(self, node, scope, sub: ast.Query, negated: bool):
+        if not isinstance(sub.body, ast.QuerySpec) or sub.body.group_by or sub.body.having:
+            raise SemanticError("EXISTS subquery too complex")
+        spec = sub.body
+        inner_node, inner_scope = self.plan_relation(spec.from_, None)
+        return self._correlated_semi_join(
+            node, scope, inner_node, inner_scope, spec.where, negated)
+
+    def _correlated_semi_join(self, node, scope, inner_node, inner_scope,
+                              where: Optional[ast.Expr], negated: bool,
+                              extra_criteria: Optional[list] = None):
+        inner_syms = {f.symbol for f in inner_scope.fields}
+        joint = Scope(inner_scope.fields, parent=scope)
+        criteria: List[Tuple[str, str]] = list(extra_criteria or [])
+        inner_only: List[ir.RowExpr] = []
+        residual: List[ir.RowExpr] = []
+        for c in _ast_conjuncts(where):
+            rex = self.analyze(c, joint)
+            refs = rex.refs()
+            if refs <= inner_syms:
+                inner_only.append(rex)
+                continue
+            if isinstance(rex, ir.Call) and rex.fn == "eq":
+                a, b = rex.args
+                if a.refs() <= inner_syms and isinstance(b, ir.Ref):
+                    criteria.append((b.name, self._as_symbol(a, "ck")))
+                    inner_node = self._attach_key(inner_node, a)
+                    continue
+                if b.refs() <= inner_syms and isinstance(a, ir.Ref):
+                    criteria.append((a.name, self._as_symbol(b, "ck")))
+                    inner_node = self._attach_key(inner_node, b)
+                    continue
+            residual.append(rex)
+        if inner_only:
+            inner_node = P.Filter(inner_node, ir.combine_conjuncts(inner_only))
+        if not criteria and residual:
+            raise SemanticError("unsupported correlated predicate (no equality)")
+        jt = "ANTI" if negated else "SEMI"
+        return P.Join(node, inner_node, jt, criteria, ir.combine_conjuncts(residual))
+
+    def _plan_in_subquery(self, node, scope, value: ast.Expr, sub: ast.Query,
+                          negated: bool, agg_map, group_map):
+        val = self.analyze(value, scope, agg_map=agg_map, group_map=group_map)
+        inner_node, inner_scope, _ = self.plan_query(sub, scope)
+        if len(inner_scope.fields) != 1:
+            raise SemanticError("IN subquery must return one column")
+        inner_sym = inner_scope.fields[0].symbol
+        lsym = self._as_symbol(val, "inval")
+        if not isinstance(val, ir.Ref):
+            node = self._attach_key(node, val)
+        jt = "ANTI" if negated else "SEMI"
+        return P.Join(node, inner_node, jt, [(lsym, inner_sym)])
+
+    def _plan_scalar_compare(self, node, scope, op: str, lhs: ast.Expr,
+                             sub: ast.Query, agg_map, group_map):
+        """lhs OP (scalar subquery): correlated-agg decorrelation or
+        uncorrelated subplan."""
+        opn = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}[op]
+        lval = self.analyze(lhs, scope, agg_map=agg_map, group_map=group_map)
+        # attempt correlated-aggregate decorrelation
+        if isinstance(sub.body, ast.QuerySpec) and sub.body.from_ is not None:
+            spec = sub.body
+            correlated = self._find_correlation(spec, scope)
+            if correlated:
+                return self._decorrelate_scalar_agg(node, scope, opn, lval, spec)
+        # uncorrelated: separate subplan
+        sub_node, sub_scope, _ = self.plan_query(sub, None)
+        if len(sub_scope.fields) != 1:
+            raise SemanticError("scalar subquery must return one column")
+        pid = next(self.subplan_ids)
+        self.subplans[pid] = sub_node
+        sref = ir.ScalarSub(pid, sub_scope.fields[0].type)
+        a, b = self._coerce_pair(lval, sref)
+        return P.Filter(node, ir.Call(opn, (a, b), T.BOOLEAN))
+
+    def _find_correlation(self, spec: ast.QuerySpec, outer_scope: Scope) -> bool:
+        """Cheap correlation test: try planning the FROM + analyzing WHERE
+        with no outer scope; resolution error mentioning outer columns =>
+        correlated."""
+        saved_symbols = self.symbols
+        saved_subplans = dict(self.subplans)
+        try:
+            inner_node, inner_scope = self.plan_relation(spec.from_, None)
+            for c in _ast_conjuncts(spec.where):
+                self.analyze(c, inner_scope)
+            return False
+        except SemanticError:
+            return True
+        finally:
+            self.subplans.clear()
+            self.subplans.update(saved_subplans)
+
+    def _decorrelate_scalar_agg(self, node, scope, opn, lval, spec: ast.QuerySpec):
+        """`lhs OP (SELECT f(aggs) FROM inner WHERE eqs AND rest)` ->
+        Aggregate(inner, group=correlation keys) JOIN outer ON eqs;
+        conjunct becomes lhs OP f(agg outputs).
+        (Reference: TransformCorrelatedScalarAggregationToJoin rule.)"""
+        if len(spec.select) != 1 or spec.group_by or spec.having:
+            raise SemanticError("unsupported correlated scalar subquery shape")
+        inner_node, inner_scope = self.plan_relation(spec.from_, None)
+        inner_syms = {f.symbol for f in inner_scope.fields}
+        joint = Scope(inner_scope.fields, parent=scope)
+        criteria: List[Tuple[str, str]] = []
+        inner_only: List[ir.RowExpr] = []
+        for c in _ast_conjuncts(spec.where):
+            rex = self.analyze(c, joint)
+            if rex.refs() <= inner_syms:
+                inner_only.append(rex)
+                continue
+            if isinstance(rex, ir.Call) and rex.fn == "eq":
+                a, b = rex.args
+                if a.refs() <= inner_syms and isinstance(b, ir.Ref):
+                    criteria.append((b.name, self._as_symbol(a, "ck")))
+                    inner_node = self._attach_key(inner_node, a)
+                    continue
+                if b.refs() <= inner_syms and isinstance(a, ir.Ref):
+                    criteria.append((a.name, self._as_symbol(b, "ck")))
+                    inner_node = self._attach_key(inner_node, b)
+                    continue
+            raise SemanticError("unsupported correlated predicate in scalar subquery")
+        if not criteria:
+            raise SemanticError("correlated scalar subquery without equality correlation")
+        if inner_only:
+            inner_node = P.Filter(inner_node, ir.combine_conjuncts(inner_only))
+        # aggregate over correlation keys
+        agg_calls: List[Tuple[ast.FunctionCall, str]] = []
+        self._collect_aggs(spec.select[0].expr, agg_calls)
+        if not agg_calls:
+            raise SemanticError("correlated scalar subquery must aggregate")
+        group_keys = [rk for _, rk in criteria]
+        pre_assigns = {s: ir.Ref(s, t) for s, t in inner_node.outputs()}
+        aggs: Dict[str, ir.AggCall] = {}
+        agg_map: Dict[int, Tuple[str, T.Type]] = {}
+        for fc, _ in agg_calls:
+            arg_exprs = tuple(self.analyze(a, inner_scope) for a in fc.args)
+            arg_syms = []
+            for ae in arg_exprs:
+                if isinstance(ae, ir.Ref):
+                    arg_syms.append(ae)
+                else:
+                    s2 = self.symbols.new("aggarg")
+                    pre_assigns[s2] = ae
+                    arg_syms.append(ir.Ref(s2, ae.type))
+            rt = agg_fns.resolve(fc.name, [a.type for a in arg_syms], fc.distinct)
+            s = self.symbols.new(fc.name)
+            aggs[s] = ir.AggCall(fc.name.lower(), tuple(arg_syms), rt, fc.distinct)
+            agg_map[id(fc)] = (s, rt)
+        inner_node = P.Project(inner_node, pre_assigns)
+        agg_node = P.Aggregate(inner_node, group_keys, aggs, "SINGLE")
+        # the subquery's select expression over agg outputs
+        agg_scope = Scope([Field_(None, None, s, t) for s, t in agg_node.outputs()])
+        sel_expr = self.analyze(spec.select[0].expr, agg_scope, agg_map=agg_map,
+                                group_map={})
+        ssym = self.symbols.new("scalar")
+        proj = {s: ir.Ref(s, t) for s, t in agg_node.outputs()}
+        proj[ssym] = sel_expr
+        sub_node = P.Project(agg_node, proj)
+        # join outer to the grouped aggregate
+        jcriteria = [(lk, rk) for (lk, rk) in criteria]
+        join = P.Join(node, sub_node, "INNER", jcriteria)
+        a, b = self._coerce_pair(lval, ir.Ref(ssym, sel_expr.type))
+        return P.Filter(join, ir.Call(opn, (a, b), T.BOOLEAN))
+
+    # ------------------------------------------------------------------
+    # aggregation planning
+    # ------------------------------------------------------------------
+    def _collect_aggs(self, e: ast.Expr, out: List[Tuple[ast.FunctionCall, str]]):
+        if isinstance(e, ast.FunctionCall) and agg_fns.is_aggregate(e.name) and e.window is None:
+            out.append((e, ""))
+            return  # no nested aggregates
+        for child in e.children():
+            if isinstance(child, (ast.Query, ast.QuerySpec)):
+                continue  # subquery boundaries
+            self._collect_aggs(child, out)
+
+    def _plan_aggregation(self, node, scope, group_by, agg_calls, outer):
+        pre_assigns = {s: ir.Ref(s, t) for s, t in node.outputs()}
+        group_keys: List[str] = []
+        group_map: Dict[str, str] = {}  # ast repr of group expr -> symbol
+        group_fields: List[Field_] = []
+        for ge in group_by:
+            if isinstance(ge, ast.Literal) and isinstance(ge.value, int):
+                raise SemanticError("GROUP BY ordinal not supported yet")
+            rex = self.analyze(ge, scope)
+            if isinstance(rex, ir.Ref):
+                sym = rex.name
+            else:
+                sym = self.symbols.new("groupkey")
+                pre_assigns[sym] = rex
+            group_keys.append(sym)
+            group_map[_ast_key(ge)] = sym
+            f = next((f for f in scope.fields if f.symbol == sym), None)
+            group_fields.append(Field_(f.qualifier if f else None,
+                                       f.name if f else None, sym, rex.type))
+        aggs: Dict[str, ir.AggCall] = {}
+        agg_map: Dict[int, Tuple[str, T.Type]] = {}
+        for fc, _ in agg_calls:
+            arg_refs = []
+            for a in fc.args:
+                ae = self.analyze(a, scope)
+                if isinstance(ae, ir.Ref):
+                    arg_refs.append(ae)
+                else:
+                    s2 = self.symbols.new("aggarg")
+                    pre_assigns[s2] = ae
+                    arg_refs.append(ir.Ref(s2, ae.type))
+            filt = None
+            if fc.filter is not None:
+                fe = self.analyze(fc.filter, scope)
+                filt = fe
+            rt = agg_fns.resolve(fc.name, [a.type for a in arg_refs], fc.distinct)
+            s = self.symbols.new(fc.name)
+            aggs[s] = ir.AggCall(fc.name.lower(), tuple(arg_refs), rt, fc.distinct, filt)
+            agg_map[id(fc)] = (s, rt)
+        node = P.Project(node, pre_assigns)
+        node = P.Aggregate(node, group_keys, aggs, "SINGLE")
+        post_fields = group_fields + [Field_(None, None, s, a.type) for s, a in aggs.items()]
+        post_scope = Scope(post_fields, parent=outer)
+        return node, post_scope, agg_map, group_map
+
+    # ------------------------------------------------------------------
+    # expression analysis -> typed IR
+    # ------------------------------------------------------------------
+    def analyze(self, e: ast.Expr, scope: Scope, agg_map=None, group_map=None) -> ir.RowExpr:
+        a = lambda x: self.analyze(x, scope, agg_map, group_map)
+        if agg_map and isinstance(e, ast.FunctionCall) and id(e) in agg_map:
+            sym, t = agg_map[id(e)]
+            return ir.Ref(sym, t)
+        if group_map and _ast_key(e) in (group_map or {}):
+            sym = group_map[_ast_key(e)]
+            # type from scope
+            f = next((f for f in scope.fields if f.symbol == sym), None)
+            if f is not None:
+                return ir.Ref(sym, f.type)
+        if isinstance(e, ast.Literal):
+            return _literal_to_ir(e)
+        if isinstance(e, ast.IntervalLiteral):
+            if e.unit in ("DAY", "WEEK"):
+                return ir.Lit(e.value * (7 if e.unit == "WEEK" else 1), T.INTERVAL_DAY_TIME)
+            if e.unit in ("MONTH", "YEAR"):
+                return ir.Lit(e.value * (12 if e.unit == "YEAR" else 1), T.INTERVAL_YEAR_MONTH)
+            raise SemanticError(f"unsupported interval unit {e.unit}")
+        if isinstance(e, ast.Identifier):
+            f, is_outer = scope.resolve(e.parts)
+            return ir.Ref(f.symbol, f.type)
+        if isinstance(e, ast.BinaryOp):
+            opn = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod",
+                   "=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt",
+                   ">=": "ge", "AND": "and", "OR": "or", "||": "concat"}[e.op]
+            l, r = a(e.left), a(e.right)
+            if opn in ("eq", "ne", "lt", "le", "gt", "ge", "add", "sub", "mul",
+                       "div", "mod"):
+                l, r = self._coerce_pair(l, r)
+            return self._call(opn, [l, r])
+        if isinstance(e, ast.UnaryOp):
+            if e.op == "-":
+                return self._call("neg", [a(e.operand)])
+            return self._call("not", [a(e.operand)])
+        if isinstance(e, ast.Between):
+            v, lo, hi = a(e.value), a(e.low), a(e.high)
+            v1, lo1 = self._coerce_pair(v, lo)
+            v2, hi1 = self._coerce_pair(v, hi)
+            rex = self._call("and", [self._call("ge", [v1, lo1]),
+                                     self._call("le", [v2, hi1])])
+            return self._call("not", [rex]) if e.negated else rex
+        if isinstance(e, ast.InList):
+            v = a(e.value)
+            terms = []
+            for item in e.items:
+                it = a(item)
+                x, y = self._coerce_pair(v, it)
+                terms.append(self._call("eq", [x, y]))
+            rex = terms[0]
+            for t_ in terms[1:]:
+                rex = self._call("or", [rex, t_])
+            return self._call("not", [rex]) if e.negated else rex
+        if isinstance(e, ast.Like):
+            args = [a(e.value), a(e.pattern)] + ([a(e.escape)] if e.escape else [])
+            rex = self._call("like", args)
+            return self._call("not", [rex]) if e.negated else rex
+        if isinstance(e, ast.IsNull):
+            rex = self._call("is_null", [a(e.value)])
+            return self._call("not", [rex]) if e.negated else rex
+        if isinstance(e, ast.Case):
+            args: List[ir.RowExpr] = []
+            whens = e.whens
+            if e.operand is not None:
+                op_ir = a(e.operand)
+                for c, v in whens:
+                    cc = a(c)
+                    x, y = self._coerce_pair(op_ir, cc)
+                    args.append(self._call("eq", [x, y]))
+                    args.append(a(v))
+            else:
+                for c, v in whens:
+                    args.append(a(c))
+                    args.append(a(v))
+            if e.default is not None:
+                args.append(a(e.default))
+            # coerce all value arms to common type
+            vals = [args[i] for i in range(1, len(args), 2)]
+            if e.default is not None:
+                vals.append(args[-1])
+            ct = vals[0].type
+            for v in vals[1:]:
+                ct2 = T.common_super_type(ct, v.type)
+                if ct2 is not None:
+                    ct = ct2
+            for i in range(1, len(args), 2):
+                args[i] = self._coerce(args[i], ct)
+            if e.default is not None:
+                args[-1] = self._coerce(args[-1], ct)
+            return self._call("case", args)
+        if isinstance(e, ast.Cast):
+            v = a(e.value)
+            to = T.parse_type(e.type_name)
+            return ir.CastExpr(v, to, e.safe)
+        if isinstance(e, ast.Extract):
+            return self._call(f"extract_{e.fld.lower()}", [a(e.value)])
+        if isinstance(e, ast.FunctionCall):
+            if agg_fns.is_aggregate(e.name) and e.window is None:
+                raise SemanticError(f"aggregate {e.name} not allowed here")
+            args = [a(x) for x in e.args]
+            return self._call(e.name.lower(), args)
+        if isinstance(e, ast.ScalarSubquery):
+            sub_node, sub_scope, _ = self.plan_query(e.query, None)
+            if len(sub_scope.fields) != 1:
+                raise SemanticError("scalar subquery must return one column")
+            pid = next(self.subplan_ids)
+            self.subplans[pid] = sub_node
+            return ir.ScalarSub(pid, sub_scope.fields[0].type)
+        if isinstance(e, (ast.Exists, ast.InSubquery)):
+            raise SemanticError(
+                f"{type(e).__name__} only supported as a top-level WHERE/HAVING conjunct")
+        raise SemanticError(f"unsupported expression {type(e).__name__}")
+
+    def _call(self, name: str, args: List[ir.RowExpr]) -> ir.RowExpr:
+        fn = scalar_fns.REGISTRY.get(name)
+        if fn is None:
+            raise SemanticError(f"unknown function {name}")
+        rt = fn.resolve([x.type for x in args])
+        if rt is None:
+            raise SemanticError(
+                f"no signature {name}({', '.join(str(x.type) for x in args)})")
+        return ir.Call(name, tuple(args), rt)
+
+    def _coerce(self, e: ir.RowExpr, to: T.Type) -> ir.RowExpr:
+        if e.type == to:
+            return e
+        if isinstance(e, ir.Lit) and e.type == T.UNKNOWN:
+            return ir.Lit(None, to)
+        return ir.CastExpr(e, to)
+
+    def _coerce_pair(self, l: ir.RowExpr, r: ir.RowExpr):
+        if l.type == r.type:
+            return l, r
+        # temporal/interval arithmetic keeps native types
+        if l.type.name in ("DATE", "TIMESTAMP", "INTERVAL_DAY_TIME", "INTERVAL_YEAR_MONTH") or \
+           r.type.name in ("DATE", "TIMESTAMP", "INTERVAL_DAY_TIME", "INTERVAL_YEAR_MONTH"):
+            return l, r
+        ct = T.common_super_type(l.type, r.type)
+        if ct is None:
+            return l, r
+        return self._coerce(l, ct), self._coerce(r, ct)
+
+
+def _literal_to_ir(e: ast.Literal) -> ir.Lit:
+    import numpy as np
+
+    if e.value is None:
+        return ir.Lit(None, T.UNKNOWN)
+    if e.type_hint == "date":
+        days = int((np.datetime64(e.value, "D") - np.datetime64("1970-01-01", "D"))
+                   / np.timedelta64(1, "D"))
+        return ir.Lit(days, T.DATE)
+    if e.type_hint == "timestamp":
+        us = int((np.datetime64(e.value) - np.datetime64("1970-01-01T00:00:00"))
+                 / np.timedelta64(1, "us"))
+        return ir.Lit(us, T.TIMESTAMP)
+    if isinstance(e.value, bool):
+        return ir.Lit(e.value, T.BOOLEAN)
+    if isinstance(e.value, int):
+        return ir.Lit(e.value, T.BIGINT if abs(e.value) > 2**31 - 1 else T.INTEGER)
+    if isinstance(e.value, float):
+        return ir.Lit(e.value, T.DOUBLE)
+    if isinstance(e.value, str):
+        return ir.Lit(e.value, T.VARCHAR)
+    raise SemanticError(f"bad literal {e.value!r}")
+
+
+def _ast_conjuncts(e: Optional[ast.Expr]) -> List[ast.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, ast.BinaryOp) and e.op == "AND":
+        return _ast_conjuncts(e.left) + _ast_conjuncts(e.right)
+    return [e]
+
+
+def _ast_key(e: ast.Expr) -> str:
+    """Structural key for GROUP BY expression matching in SELECT/HAVING."""
+    return repr(e)

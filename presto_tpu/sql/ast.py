@@ -1,0 +1,291 @@
+"""SQL abstract syntax tree.
+
+Reference parity: presto-parser/src/main/java/com/facebook/presto/sql/tree/
+(160 node classes).  Trimmed to the query language subset the engine
+executes (full TPC-H + general analytic SQL); dataclasses instead of the
+reference's visitor hierarchy — tree walks are plain pattern matches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+
+class Node:
+    def children(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Node):
+                yield v
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, Node):
+                        yield x
+
+
+# ---- expressions ----------------------------------------------------------
+
+
+@dataclass
+class Expr(Node):
+    pass
+
+
+@dataclass
+class Literal(Expr):
+    value: object  # python int/float/str/bool/None
+    type_hint: Optional[str] = None  # 'date' | 'timestamp' | 'decimal' | None
+
+
+@dataclass
+class IntervalLiteral(Expr):
+    value: int
+    unit: str  # DAY | MONTH | YEAR
+
+
+@dataclass
+class Identifier(Expr):
+    parts: Tuple[str, ...]  # possibly qualified: (table, column) or (column,)
+
+    @property
+    def name(self) -> str:
+        return self.parts[-1]
+
+
+@dataclass
+class Star(Expr):
+    qualifier: Optional[str] = None  # t.* or *
+
+
+@dataclass
+class BinaryOp(Expr):
+    op: str  # + - * / % || = <> < <= > >= AND OR
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class UnaryOp(Expr):
+    op: str  # - NOT
+    operand: Expr
+
+
+@dataclass
+class Between(Expr):
+    value: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass
+class InList(Expr):
+    value: Expr
+    items: List[Expr]
+    negated: bool = False
+
+
+@dataclass
+class InSubquery(Expr):
+    value: Expr
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass
+class Exists(Expr):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass
+class ScalarSubquery(Expr):
+    query: "Query"
+
+
+@dataclass
+class Like(Expr):
+    value: Expr
+    pattern: Expr
+    escape: Optional[Expr] = None
+    negated: bool = False
+
+
+@dataclass
+class IsNull(Expr):
+    value: Expr
+    negated: bool = False
+
+
+@dataclass
+class Case(Expr):
+    operand: Optional[Expr]  # CASE x WHEN ... vs CASE WHEN ...
+    whens: List[Tuple[Expr, Expr]]
+    default: Optional[Expr]
+
+
+@dataclass
+class Cast(Expr):
+    value: Expr
+    type_name: str
+    safe: bool = False  # TRY_CAST
+
+
+@dataclass
+class FunctionCall(Expr):
+    name: str
+    args: List[Expr]
+    distinct: bool = False
+    filter: Optional[Expr] = None
+    window: Optional["WindowSpec"] = None
+
+
+@dataclass
+class Extract(Expr):
+    fld: str  # YEAR MONTH DAY ...
+    value: Expr
+
+
+@dataclass
+class WindowSpec(Node):
+    partition_by: List[Expr] = field(default_factory=list)
+    order_by: List["SortItem"] = field(default_factory=list)
+    # frame support: (type, start, end) — ROWS/RANGE; None = default frame
+    frame: Optional[Tuple[str, str, str]] = None
+
+
+# ---- relations ------------------------------------------------------------
+
+
+@dataclass
+class Relation(Node):
+    pass
+
+
+@dataclass
+class Table(Relation):
+    name: str
+    alias: Optional[str] = None
+    column_aliases: Optional[List[str]] = None
+
+
+@dataclass
+class SubqueryRelation(Relation):
+    query: "Query"
+    alias: Optional[str] = None
+    column_aliases: Optional[List[str]] = None
+
+
+@dataclass
+class Join(Relation):
+    join_type: str  # INNER LEFT RIGHT FULL CROSS
+    left: Relation
+    right: Relation
+    on: Optional[Expr] = None
+    using: Optional[List[str]] = None
+
+
+@dataclass
+class Unnest(Relation):
+    exprs: List[Expr]
+    alias: Optional[str] = None
+    with_ordinality: bool = False
+
+
+@dataclass
+class ValuesRelation(Relation):
+    rows: List[List[Expr]]
+    alias: Optional[str] = None
+    column_aliases: Optional[List[str]] = None
+
+
+# ---- query structure ------------------------------------------------------
+
+
+@dataclass
+class SelectItem(Node):
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass
+class SortItem(Node):
+    expr: Expr
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None = default (last for asc, first for desc)
+
+
+@dataclass
+class QuerySpec(Node):
+    select: List[SelectItem]
+    distinct: bool = False
+    from_: Optional[Relation] = None
+    where: Optional[Expr] = None
+    group_by: List[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+
+
+@dataclass
+class SetOp(Node):
+    op: str  # UNION | INTERSECT | EXCEPT
+    all: bool
+    left: Union["QuerySpec", "SetOp"]
+    right: Union["QuerySpec", "SetOp"]
+
+
+@dataclass
+class Query(Node):
+    body: Union[QuerySpec, SetOp]
+    order_by: List[SortItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    ctes: List[Tuple[str, "Query", Optional[List[str]]]] = field(default_factory=list)
+
+
+# ---- statements -----------------------------------------------------------
+
+
+@dataclass
+class Statement(Node):
+    pass
+
+
+@dataclass
+class QueryStatement(Statement):
+    query: Query
+
+
+@dataclass
+class Explain(Statement):
+    statement: Statement
+    analyze: bool = False
+
+
+@dataclass
+class ShowTables(Statement):
+    pass
+
+
+@dataclass
+class ShowColumns(Statement):
+    table: str
+
+
+@dataclass
+class CreateTableAs(Statement):
+    name: str
+    query: Query
+
+
+@dataclass
+class InsertInto(Statement):
+    table: str
+    columns: Optional[List[str]]
+    query: Query
+
+
+@dataclass
+class SetSession(Statement):
+    name: str
+    value: object

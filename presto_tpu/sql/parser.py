@@ -1,0 +1,749 @@
+"""SQL lexer + recursive-descent/Pratt parser.
+
+Reference parity: presto-parser's ANTLR4 grammar SqlBase.g4 (785 lines) +
+SqlParser.java.  Hand-rolled (no parser generator in the image) covering
+the query-language subset the engine executes: full TPC-H, joins of all
+types, subqueries (scalar/IN/EXISTS), CTEs, set operations, window
+functions, CASE/CAST/EXTRACT/INTERVAL, EXPLAIN [ANALYZE], SHOW,
+CREATE TABLE AS, INSERT, SET SESSION.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from presto_tpu.sql import ast
+
+
+class ParseError(Exception):
+    pass
+
+
+_TOKEN_RE = re.compile(
+    r"""
+      (?P<ws>\s+|--[^\n]*|/\*.*?\*/)
+    | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+    | (?P<string>'(?:[^']|'')*')
+    | (?P<qident>"(?:[^"]|"")*")
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
+    | (?P<op><>|!=|>=|<=|\|\||=>|[-+*/%(),.;=<>\[\]?])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
+    "AS", "AND", "OR", "NOT", "IN", "EXISTS", "BETWEEN", "LIKE", "ESCAPE",
+    "IS", "NULL", "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE", "END",
+    "CAST", "TRY_CAST", "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER",
+    "CROSS", "ON", "USING", "UNION", "INTERSECT", "EXCEPT", "ALL", "DISTINCT",
+    "WITH", "ASC", "DESC", "NULLS", "FIRST", "LAST", "DATE", "TIME",
+    "TIMESTAMP", "INTERVAL", "EXTRACT", "SUBSTRING", "FOR", "VALUES",
+    "EXPLAIN", "ANALYZE", "SHOW", "TABLES", "COLUMNS", "CREATE", "TABLE",
+    "INSERT", "INTO", "SET", "SESSION", "OVER", "PARTITION", "ROWS", "RANGE",
+    "UNBOUNDED", "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "UNNEST",
+    "ORDINALITY", "FILTER",
+}
+
+
+class Token:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind, value, pos):
+        self.kind = kind  # 'number' | 'string' | 'ident' | 'kw' | 'op' | 'eof'
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):
+        return f"Token({self.kind},{self.value!r})"
+
+
+def tokenize(text: str) -> List[Token]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m:
+            raise ParseError(f"lex error at {pos}: {text[pos:pos+20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        val = m.group()
+        if kind == "ident":
+            up = val.upper()
+            if up in KEYWORDS:
+                out.append(Token("kw", up, m.start()))
+            else:
+                out.append(Token("ident", val.lower(), m.start()))
+        elif kind == "qident":
+            out.append(Token("ident", val[1:-1].replace('""', '"'), m.start()))
+        elif kind == "string":
+            out.append(Token("string", val[1:-1].replace("''", "'"), m.start()))
+        else:
+            out.append(Token(kind, val, m.start()))
+    out.append(Token("eof", None, len(text)))
+    return out
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.toks = tokenize(text)
+        self.i = 0
+
+    # ---- token helpers ----------------------------------------------
+    def peek(self, ahead=0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value in kws
+
+    def at_op(self, *ops) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def accept_kw(self, *kws) -> bool:
+        if self.at_kw(*kws):
+            self.i += 1
+            return True
+        return False
+
+    def accept_op(self, *ops) -> bool:
+        if self.at_op(*ops):
+            self.i += 1
+            return True
+        return False
+
+    def expect_kw(self, kw) -> None:
+        if not self.accept_kw(kw):
+            self.err(f"expected {kw}")
+
+    def expect_op(self, op) -> None:
+        if not self.accept_op(op):
+            self.err(f"expected '{op}'")
+
+    def err(self, msg):
+        t = self.peek()
+        ctx = self.text[max(0, t.pos - 30): t.pos + 30]
+        raise ParseError(f"{msg} at position {t.pos} near {ctx!r} (got {t!r})")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind == "ident":
+            self.i += 1
+            return t.value
+        # keywords usable as identifiers in non-reserved positions
+        if t.kind == "kw" and t.value in ("DATE", "TIME", "TIMESTAMP", "VALUES",
+                                          "FILTER", "ROW", "ANALYZE", "SESSION",
+                                          "TABLES", "COLUMNS", "FIRST", "LAST",
+                                          "ALL", "SET", "SHOW"):
+            self.i += 1
+            return t.value.lower()
+        self.err("expected identifier")
+
+    # ---- statements -------------------------------------------------
+    def parse_statement(self) -> ast.Statement:
+        stmt = self._statement()
+        self.accept_op(";")
+        if self.peek().kind != "eof":
+            self.err("unexpected trailing input")
+        return stmt
+
+    def _statement(self) -> ast.Statement:
+        if self.accept_kw("EXPLAIN"):
+            analyze = False
+            if self.accept_op("("):  # EXPLAIN (TYPE ...) — accept and ignore options
+                depth = 1
+                while depth:
+                    t = self.next()
+                    if t.kind == "op" and t.value == "(":
+                        depth += 1
+                    elif t.kind == "op" and t.value == ")":
+                        depth -= 1
+            if self.accept_kw("ANALYZE"):
+                analyze = True
+            return ast.Explain(self._statement(), analyze=analyze)
+        if self.accept_kw("SHOW"):
+            if self.accept_kw("TABLES"):
+                return ast.ShowTables()
+            if self.accept_kw("COLUMNS"):
+                self.expect_kw("FROM")
+                return ast.ShowColumns(self.ident())
+            self.err("expected TABLES or COLUMNS")
+        if self.accept_kw("CREATE"):
+            self.expect_kw("TABLE")
+            name = self.ident()
+            self.expect_kw("AS")
+            return ast.CreateTableAs(name, self.parse_query())
+        if self.accept_kw("INSERT"):
+            self.expect_kw("INTO")
+            name = self.ident()
+            cols = None
+            if self.accept_op("("):
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            return ast.InsertInto(name, cols, self.parse_query())
+        if self.at_kw("SET") and self.peek(1).kind == "kw" and self.peek(1).value == "SESSION":
+            self.next(), self.next()
+            name = self.ident()
+            while self.accept_op("."):
+                name += "." + self.ident()
+            self.expect_op("=")
+            v = self.next()
+            value = v.value
+            if v.kind == "number":
+                value = float(v.value) if "." in v.value else int(v.value)
+            elif v.kind == "kw" and v.value in ("TRUE", "FALSE"):
+                value = v.value == "TRUE"
+            return ast.SetSession(name, value)
+        return ast.QueryStatement(self.parse_query())
+
+    # ---- queries ----------------------------------------------------
+    def parse_query(self) -> ast.Query:
+        ctes = []
+        if self.accept_kw("WITH"):
+            while True:
+                name = self.ident()
+                col_aliases = None
+                if self.accept_op("("):
+                    col_aliases = [self.ident()]
+                    while self.accept_op(","):
+                        col_aliases.append(self.ident())
+                    self.expect_op(")")
+                self.expect_kw("AS")
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                ctes.append((name, q, col_aliases))
+                if not self.accept_op(","):
+                    break
+        body = self._set_op_body()
+        order_by, limit = self._order_limit()
+        return ast.Query(body, order_by, limit, ctes)
+
+    def _set_op_body(self):
+        left = self._query_term()
+        while self.at_kw("UNION", "INTERSECT", "EXCEPT"):
+            op = self.next().value
+            all_ = self.accept_kw("ALL")
+            self.accept_kw("DISTINCT")
+            right = self._query_term()
+            left = ast.SetOp(op, all_, left, right)
+        return left
+
+    def _query_term(self):
+        if self.accept_op("("):
+            body = self._set_op_body()
+            self.expect_op(")")
+            return body
+        if self.at_kw("VALUES"):
+            self.next()
+            rows = [self._values_row()]
+            while self.accept_op(","):
+                rows.append(self._values_row())
+            return ast.QuerySpec(
+                [ast.SelectItem(ast.Star())], from_=ast.ValuesRelation(rows)
+            )
+        return self._query_spec()
+
+    def _order_limit(self):
+        order_by = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self._sort_item())
+            while self.accept_op(","):
+                order_by.append(self._sort_item())
+        limit = None
+        if self.accept_kw("LIMIT"):
+            t = self.next()
+            if t.kind == "number":
+                limit = int(t.value)
+            elif t.kind == "kw" and t.value == "ALL":
+                limit = None
+            else:
+                self.err("expected LIMIT count")
+        return order_by, limit
+
+    def _sort_item(self) -> ast.SortItem:
+        e = self.expr()
+        asc = True
+        if self.accept_kw("ASC"):
+            asc = True
+        elif self.accept_kw("DESC"):
+            asc = False
+        nulls_first = None
+        if self.accept_kw("NULLS"):
+            if self.accept_kw("FIRST"):
+                nulls_first = True
+            else:
+                self.expect_kw("LAST")
+                nulls_first = False
+        return ast.SortItem(e, asc, nulls_first)
+
+    def _query_spec(self) -> ast.QuerySpec:
+        self.expect_kw("SELECT")
+        distinct = False
+        if self.accept_kw("DISTINCT"):
+            distinct = True
+        else:
+            self.accept_kw("ALL")
+        items = [self._select_item()]
+        while self.accept_op(","):
+            items.append(self._select_item())
+        from_ = None
+        if self.accept_kw("FROM"):
+            from_ = self._relation()
+            while self.accept_op(","):
+                right = self._relation()
+                from_ = ast.Join("CROSS", from_, right)
+        where = self.expr() if self.accept_kw("WHERE") else None
+        group_by = []
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by.append(self.expr())
+            while self.accept_op(","):
+                group_by.append(self.expr())
+        having = self.expr() if self.accept_kw("HAVING") else None
+        return ast.QuerySpec(items, distinct, from_, where, group_by, having)
+
+    def _select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.Star())
+        # t.* form
+        if (self.peek().kind == "ident" and self.peek(1).kind == "op"
+                and self.peek(1).value == "." and self.peek(2).kind == "op"
+                and self.peek(2).value == "*"):
+            q = self.next().value
+            self.next(), self.next()
+            return ast.SelectItem(ast.Star(qualifier=q))
+        e = self.expr()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return ast.SelectItem(e, alias)
+
+    # ---- relations --------------------------------------------------
+    def _relation(self) -> ast.Relation:
+        rel = self._relation_primary()
+        while True:
+            if self.accept_kw("CROSS"):
+                self.expect_kw("JOIN")
+                right = self._relation_primary()
+                rel = ast.Join("CROSS", rel, right)
+                continue
+            jt = None
+            if self.at_kw("JOIN"):
+                jt = "INNER"
+            elif self.at_kw("INNER") and self.peek(1).value == "JOIN":
+                self.next()
+                jt = "INNER"
+            elif self.at_kw("LEFT", "RIGHT", "FULL"):
+                jt = self.peek().value
+                nxt = self.peek(1)
+                if nxt.kind == "kw" and nxt.value in ("JOIN", "OUTER"):
+                    self.next()
+                    self.accept_kw("OUTER")
+                else:
+                    jt = None
+            if jt is None:
+                break
+            self.expect_kw("JOIN")
+            right = self._relation_primary()
+            if self.accept_kw("ON"):
+                rel = ast.Join(jt, rel, right, on=self.expr())
+            elif self.accept_kw("USING"):
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                rel = ast.Join(jt, rel, right, using=cols)
+            else:
+                self.err("expected ON or USING")
+        return rel
+
+    def _relation_primary(self) -> ast.Relation:
+        if self.accept_kw("UNNEST"):
+            self.expect_op("(")
+            exprs = [self.expr()]
+            while self.accept_op(","):
+                exprs.append(self.expr())
+            self.expect_op(")")
+            with_ord = False
+            if self.accept_kw("WITH"):
+                self.expect_kw("ORDINALITY")
+                with_ord = True
+            alias, _ = self._alias()
+            return ast.Unnest(exprs, alias, with_ord)
+        if self.at_kw("VALUES"):
+            self.next()
+            rows = [self._values_row()]
+            while self.accept_op(","):
+                rows.append(self._values_row())
+            alias, col_aliases = self._alias()
+            return ast.ValuesRelation(rows, alias, col_aliases)
+        if self.accept_op("("):
+            # subquery or parenthesized join
+            if self.at_kw("SELECT", "WITH") or (self.at_op("(")):
+                q = self.parse_query()
+                self.expect_op(")")
+                alias, col_aliases = self._alias()
+                return ast.SubqueryRelation(q, alias, col_aliases)
+            rel = self._relation()
+            self.expect_op(")")
+            alias, col_aliases = self._alias()
+            if alias is not None and hasattr(rel, "alias"):
+                rel.alias = alias
+                if col_aliases and hasattr(rel, "column_aliases"):
+                    rel.column_aliases = col_aliases
+            return rel
+        name = self.ident()
+        while self.accept_op("."):  # catalog.schema.table — keep last part
+            name = self.ident()
+        alias, col_aliases = self._alias()
+        return ast.Table(name, alias, col_aliases)
+
+    def _values_row(self):
+        if self.accept_op("("):
+            row = [self.expr()]
+            while self.accept_op(","):
+                row.append(self.expr())
+            self.expect_op(")")
+            return row
+        return [self.expr()]
+
+    def _alias(self):
+        alias = None
+        col_aliases = None
+        if self.accept_kw("AS"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        if alias and self.at_op("(") and self._looks_like_column_aliases():
+            self.next()
+            col_aliases = [self.ident()]
+            while self.accept_op(","):
+                col_aliases.append(self.ident())
+            self.expect_op(")")
+        return alias, col_aliases
+
+    def _looks_like_column_aliases(self) -> bool:
+        # after alias: "(ident [, ident]* )" not followed by an operator
+        j = self.i + 1
+        if self.toks[j].kind != "ident":
+            return False
+        while self.toks[j].kind == "ident":
+            j += 1
+            if self.toks[j].kind == "op" and self.toks[j].value == ",":
+                j += 1
+                continue
+            break
+        return self.toks[j].kind == "op" and self.toks[j].value == ")"
+
+    # ---- expressions (Pratt) ----------------------------------------
+    def expr(self) -> ast.Expr:
+        return self._or_expr()
+
+    def _or_expr(self):
+        left = self._and_expr()
+        while self.accept_kw("OR"):
+            left = ast.BinaryOp("OR", left, self._and_expr())
+        return left
+
+    def _and_expr(self):
+        left = self._not_expr()
+        while self.accept_kw("AND"):
+            left = ast.BinaryOp("AND", left, self._not_expr())
+        return left
+
+    def _not_expr(self):
+        if self.accept_kw("NOT"):
+            return ast.UnaryOp("NOT", self._not_expr())
+        return self._predicate()
+
+    def _predicate(self):
+        left = self._additive()
+        while True:
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.next().value
+                if op == "!=":
+                    op = "<>"
+                right = self._additive()
+                left = ast.BinaryOp(op, left, right)
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("NOT"):
+                negated = True
+            if self.accept_kw("BETWEEN"):
+                low = self._additive()
+                self.expect_kw("AND")
+                high = self._additive()
+                left = ast.Between(left, low, high, negated)
+                continue
+            if self.accept_kw("IN"):
+                self.expect_op("(")
+                if self.at_kw("SELECT", "WITH"):
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negated)
+                else:
+                    items = [self.expr()]
+                    while self.accept_op(","):
+                        items.append(self.expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, items, negated)
+                continue
+            if self.accept_kw("LIKE"):
+                pattern = self._additive()
+                escape = None
+                if self.accept_kw("ESCAPE"):
+                    escape = self._additive()
+                left = ast.Like(left, pattern, escape, negated)
+                continue
+            if negated:
+                self.i = save
+                break
+            if self.accept_kw("IS"):
+                neg = self.accept_kw("NOT")
+                self.expect_kw("NULL")
+                left = ast.IsNull(left, neg)
+                continue
+            break
+        return left
+
+    def _additive(self):
+        left = self._multiplicative()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().value
+                left = ast.BinaryOp(op, left, self._multiplicative())
+            elif self.at_op("||"):
+                self.next()
+                left = ast.BinaryOp("||", left, self._multiplicative())
+            else:
+                return left
+
+    def _multiplicative(self):
+        left = self._unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            left = ast.BinaryOp(op, left, self._unary())
+        return left
+
+    def _unary(self):
+        if self.accept_op("-"):
+            return ast.UnaryOp("-", self._unary())
+        if self.accept_op("+"):
+            return self._unary()
+        return self._primary()
+
+    def _primary(self) -> ast.Expr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            if "." in t.value or "e" in t.value.lower():
+                return ast.Literal(float(t.value))
+            return ast.Literal(int(t.value))
+        if t.kind == "string":
+            self.next()
+            return ast.Literal(t.value)
+        if self.accept_kw("TRUE"):
+            return ast.Literal(True)
+        if self.accept_kw("FALSE"):
+            return ast.Literal(False)
+        if self.accept_kw("NULL"):
+            return ast.Literal(None)
+        if self.at_kw("DATE") and self.peek(1).kind == "string":
+            self.next()
+            return ast.Literal(self.next().value, type_hint="date")
+        if self.at_kw("TIMESTAMP") and self.peek(1).kind == "string":
+            self.next()
+            return ast.Literal(self.next().value, type_hint="timestamp")
+        if self.accept_kw("INTERVAL"):
+            sign = -1 if self.accept_op("-") else 1
+            v = self.next()
+            if v.kind not in ("string", "number"):
+                self.err("expected interval value")
+            unit_tok = self.next()
+            unit = (unit_tok.value or "").upper().rstrip("S") if unit_tok.kind in ("ident", "kw") else None
+            if unit not in ("DAY", "MONTH", "YEAR", "HOUR", "MINUTE", "SECOND", "WEEK"):
+                self.err(f"unsupported interval unit {unit}")
+            return ast.IntervalLiteral(sign * int(str(v.value).strip("'")), unit)
+        if self.accept_kw("CASE"):
+            operand = None
+            if not self.at_kw("WHEN"):
+                operand = self.expr()
+            whens = []
+            while self.accept_kw("WHEN"):
+                c = self.expr()
+                self.expect_kw("THEN")
+                whens.append((c, self.expr()))
+            default = self.expr() if self.accept_kw("ELSE") else None
+            self.expect_kw("END")
+            return ast.Case(operand, whens, default)
+        if self.at_kw("CAST", "TRY_CAST"):
+            safe = self.next().value == "TRY_CAST"
+            self.expect_op("(")
+            v = self.expr()
+            self.expect_kw("AS")
+            type_name = self._type_name()
+            self.expect_op(")")
+            return ast.Cast(v, type_name, safe)
+        if self.accept_kw("EXTRACT"):
+            self.expect_op("(")
+            fld = self.next().value
+            self.expect_kw("FROM")
+            v = self.expr()
+            self.expect_op(")")
+            return ast.Extract(str(fld).upper(), v)
+        if self.accept_kw("SUBSTRING"):
+            self.expect_op("(")
+            v = self.expr()
+            if self.accept_kw("FROM"):
+                start = self.expr()
+                length = self.expr() if self.accept_kw("FOR") else None
+            else:
+                self.expect_op(",")
+                start = self.expr()
+                length = self.expr() if self.accept_op(",") else None
+            self.expect_op(")")
+            args = [v, start] + ([length] if length is not None else [])
+            return ast.FunctionCall("substring", args)
+        if self.accept_kw("EXISTS"):
+            self.expect_op("(")
+            q = self.parse_query()
+            self.expect_op(")")
+            return ast.Exists(q)
+        if self.accept_op("("):
+            if self.at_kw("SELECT", "WITH"):
+                q = self.parse_query()
+                self.expect_op(")")
+                return ast.ScalarSubquery(q)
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "ident" or (t.kind == "kw" and t.value in (
+                "DATE", "TIME", "TIMESTAMP", "FILTER", "ROW", "FIRST", "LAST", "SET", "VALUES")):
+            name = self.ident()
+            if self.at_op("("):
+                return self._function_call(name)
+            parts = [name]
+            while self.at_op(".") and self.peek(1).kind in ("ident", "kw"):
+                self.next()
+                parts.append(self.ident())
+            return ast.Identifier(tuple(parts))
+        self.err("expected expression")
+
+    def _type_name(self) -> str:
+        name = self.next()
+        if name.kind not in ("ident", "kw"):
+            self.err("expected type name")
+        tn = str(name.value)
+        if tn.upper() == "DOUBLE" and self.peek().kind == "ident" and self.peek().value == "precision":
+            self.next()
+        if self.accept_op("("):
+            args = []
+            while not self.at_op(")"):
+                args.append(self.next().value)
+                self.accept_op(",")
+            self.expect_op(")")
+            tn += "(" + ",".join(str(a) for a in args) + ")"
+        return tn
+
+    def _function_call(self, name: str) -> ast.Expr:
+        self.expect_op("(")
+        distinct = False
+        args: List[ast.Expr] = []
+        if self.at_op("*"):
+            self.next()
+            args = []  # count(*)
+        elif not self.at_op(")"):
+            if self.accept_kw("DISTINCT"):
+                distinct = True
+            else:
+                self.accept_kw("ALL")
+            args.append(self.expr())
+            while self.accept_op(","):
+                args.append(self.expr())
+        self.expect_op(")")
+        filt = None
+        if self.at_kw("FILTER"):
+            self.next()
+            self.expect_op("(")
+            self.expect_kw("WHERE")
+            filt = self.expr()
+            self.expect_op(")")
+        window = None
+        if self.accept_kw("OVER"):
+            window = self._window_spec()
+        return ast.FunctionCall(name.lower(), args, distinct, filt, window)
+
+    def _window_spec(self) -> ast.WindowSpec:
+        self.expect_op("(")
+        partition_by: List[ast.Expr] = []
+        order_by: List[ast.SortItem] = []
+        frame = None
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition_by.append(self.expr())
+            while self.accept_op(","):
+                partition_by.append(self.expr())
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self._sort_item())
+            while self.accept_op(","):
+                order_by.append(self._sort_item())
+        if self.at_kw("ROWS", "RANGE"):
+            ftype = self.next().value
+            if self.accept_kw("BETWEEN"):
+                start = self._frame_bound()
+                self.expect_kw("AND")
+                end = self._frame_bound()
+            else:
+                start = self._frame_bound()
+                end = "CURRENT ROW"
+            frame = (ftype, start, end)
+        self.expect_op(")")
+        return ast.WindowSpec(partition_by, order_by, frame)
+
+    def _frame_bound(self) -> str:
+        if self.accept_kw("UNBOUNDED"):
+            if self.accept_kw("PRECEDING"):
+                return "UNBOUNDED PRECEDING"
+            self.expect_kw("FOLLOWING")
+            return "UNBOUNDED FOLLOWING"
+        if self.accept_kw("CURRENT"):
+            self.expect_kw("ROW")
+            return "CURRENT ROW"
+        t = self.next()
+        if t.kind != "number":
+            self.err("expected frame bound")
+        if self.accept_kw("PRECEDING"):
+            return f"{t.value} PRECEDING"
+        self.expect_kw("FOLLOWING")
+        return f"{t.value} FOLLOWING"
+
+
+def parse(text: str) -> ast.Statement:
+    return Parser(text).parse_statement()
+
+
+def parse_query(text: str) -> ast.Query:
+    stmt = parse(text)
+    if not isinstance(stmt, ast.QueryStatement):
+        raise ParseError("expected a query")
+    return stmt.query

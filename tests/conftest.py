@@ -4,10 +4,15 @@ one JVM; we boot a fake 8-chip mesh in one process)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+
+import jax  # noqa: E402
+
+# the axon TPU plugin overrides JAX_PLATFORMS; config wins if set pre-init
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

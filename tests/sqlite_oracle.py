@@ -88,3 +88,60 @@ def assert_same_results(actual_rows, expected_rows, ordered: bool = False, rel_t
                 )
             else:
                 assert va == ve, f"row {i} col {j}: {va!r} != {ve!r}\nactual={ra}\nexpected={re_}"
+
+
+# ---------------------------------------------------------------------------
+# dialect translation: engine SQL -> sqlite SQL over the int-days date repr
+# ---------------------------------------------------------------------------
+
+import re as _re
+
+
+def _date_days(s: str) -> int:
+    return int((np.datetime64(s, "D") - np.datetime64("1970-01-01", "D"))
+               / np.timedelta64(1, "D"))
+
+
+def _shift(date_str: str, sign: int, n: int, unit: str) -> int:
+    d = np.datetime64(date_str, "D")
+    if unit in ("DAY", "WEEK"):
+        delta = n * (7 if unit == "WEEK" else 1)
+        return _date_days(str(d)) + sign * delta
+    months = n * (12 if unit == "YEAR" else 1)
+    m = np.datetime64(date_str[:7], "M") + sign * months
+    day = int(date_str[8:10])
+    # clamp to month end
+    next_m = m + 1
+    last = int((next_m.astype("datetime64[D]") - np.timedelta64(1, "D"))
+               .astype(object).day)
+    day = min(day, last)
+    return _date_days(f"{str(m)}-{day:02d}")
+
+
+def to_sqlite(sql: str) -> str:
+    """Translate engine SQL to sqlite SQL (dates are integer days there)."""
+    # DATE 'x' +/- INTERVAL 'n' UNIT  -> folded integer
+    pat = _re.compile(
+        r"DATE\s+'(\d{4}-\d{2}-\d{2})'\s*([+-])\s*INTERVAL\s+'(\d+)'\s+(DAY|WEEK|MONTH|YEAR)",
+        _re.IGNORECASE)
+    while True:
+        m = pat.search(sql)
+        if not m:
+            break
+        days = _shift(m.group(1), 1 if m.group(2) == "+" else -1,
+                      int(m.group(3)), m.group(4).upper())
+        sql = sql[:m.start()] + str(days) + sql[m.end():]
+    # bare DATE literals
+    sql = _re.sub(r"DATE\s+'(\d{4}-\d{2}-\d{2})'",
+                  lambda m: str(_date_days(m.group(1))), sql)
+    # EXTRACT(YEAR FROM e)
+    sql = _re.sub(
+        r"EXTRACT\s*\(\s*YEAR\s+FROM\s+([A-Za-z_][\w.]*)\s*\)",
+        r"CAST(strftime('%Y', (\1)*86400, 'unixepoch') AS INTEGER)", sql,
+        flags=_re.IGNORECASE)
+    sql = _re.sub(
+        r"EXTRACT\s*\(\s*MONTH\s+FROM\s+([A-Za-z_][\w.]*)\s*\)",
+        r"CAST(strftime('%m', (\1)*86400, 'unixepoch') AS INTEGER)", sql,
+        flags=_re.IGNORECASE)
+    sql = _re.sub(r"\bsubstring\s*\(", "substr(", sql, flags=_re.IGNORECASE)
+    return sql

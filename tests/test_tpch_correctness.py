@@ -1,0 +1,24 @@
+"""Differential correctness: all 22 TPC-H queries vs the sqlite oracle on
+identical generated data (reference analog: AbstractTestQueries vs
+H2QueryRunner, presto-tests)."""
+
+import pytest
+
+import presto_tpu
+from tests.sqlite_oracle import assert_same_results, to_sqlite
+from tests.tpch_queries import QUERIES
+
+ORDERED = {1, 2, 3, 4, 5, 7, 8, 9, 10, 11, 12, 13, 15, 16, 18, 20, 21, 22}
+
+
+@pytest.fixture(scope="module")
+def session(tpch_catalog_tiny):
+    return presto_tpu.connect(tpch_catalog_tiny)
+
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_tpch_query(qid, session, tpch_sqlite_tiny):
+    sql = QUERIES[qid]
+    actual = session.sql(sql)
+    expected = tpch_sqlite_tiny.execute(to_sqlite(sql)).fetchall()
+    assert_same_results(actual.rows, expected, ordered=qid in ORDERED)
