@@ -37,6 +37,18 @@ class ConnectorTable:
         """Host columnar data for the given columns (projection pushdown)."""
         raise NotImplementedError
 
+    # ---- statistics SPI (reference: ConnectorMetadata.getTableStatistics
+    # feeding cost/StatsCalculator; here also the source of STATIC shapes
+    # for the compiled execution mode — see plan/stats.py) ----
+    def column_stats(self, column: str):
+        return None
+
+    def unique_keys(self) -> List[tuple]:
+        return []
+
+    def max_rows_per_key(self) -> Dict[tuple, int]:
+        return {}
+
 
 class MemoryTable(ConnectorTable):
     """In-memory table (reference: presto-memory connector)."""
@@ -45,6 +57,17 @@ class MemoryTable(ConnectorTable):
         super().__init__(name, schema)
         self.data = {k: np.asarray(v) for k, v in data.items()}
         self._rows = len(next(iter(self.data.values()))) if self.data else 0
+
+    def column_stats(self, column: str):
+        from presto_tpu.plan.stats import ColStats
+
+        a = self.data.get(column)
+        if a is None or len(a) == 0:
+            return ColStats(ndv=0)
+        if a.dtype == object:  # strings: ndv only
+            return ColStats(ndv=len(set(a.tolist())))
+        return ColStats(min=float(np.min(a)), max=float(np.max(a)),
+                        ndv=int(len(np.unique(a))))
 
     def row_count(self) -> int:
         return self._rows
@@ -70,6 +93,17 @@ class TpchTable(ConnectorTable):
 
     def row_count(self) -> int:
         return tpch_gen.row_count(self.name, self.sf)
+
+    def column_stats(self, column: str):
+        from presto_tpu.plan.stats import ColStats
+
+        return tpch_gen.column_stats(self.name, column, self.sf, ColStats)
+
+    def unique_keys(self):
+        return tpch_gen.UNIQUE_KEYS.get(self.name, [])
+
+    def max_rows_per_key(self):
+        return tpch_gen.MAX_ROWS_PER_KEY.get(self.name, {})
 
     def splits(self, n_splits):
         return tpch_gen.split_ranges(self.name, self.sf, n_splits)
@@ -103,13 +137,18 @@ class TpchTable(ConnectorTable):
 
 
 class Catalog:
-    """Named schemas of tables (reference: MetadataManager + StaticCatalogStore)."""
+    """Named schemas of tables (reference: MetadataManager + StaticCatalogStore).
+    `version` bumps on registration so compiled-plan caches invalidate;
+    in-place mutation of a registered MemoryTable's arrays is unsupported —
+    re-register instead."""
 
     def __init__(self):
         self.tables: Dict[str, ConnectorTable] = {}
+        self.version = 0
 
     def register(self, table: ConnectorTable) -> None:
         self.tables[table.name.lower()] = table
+        self.version += 1
 
     def register_memory(self, name: str, schema: Dict[str, T.Type],
                         data: Dict[str, np.ndarray]) -> None:

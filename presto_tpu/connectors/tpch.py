@@ -478,3 +478,88 @@ def split_ranges(table: str, sf: float, n_splits: int) -> list[tuple[int, int]]:
     total = int(_TABLE_ROWS["orders"] * sf) if table == "lineitem" else row_count(table, sf)
     edges = np.linspace(0, total, n_splits + 1).astype(int)
     return [(int(a), int(b)) for a, b in zip(edges[:-1], edges[1:]) if a < b]
+
+
+# ---------------------------------------------------------------------------
+# statistics (arithmetic, no scanning) — reference: presto-tpch
+# TpchMetadata.getTableStatistics backed by precomputed stats files;
+# here derivable from the generator formulas directly.
+# ---------------------------------------------------------------------------
+
+UNIQUE_KEYS = {
+    "region": [("r_regionkey",)],
+    "nation": [("n_nationkey",)],
+    "part": [("p_partkey",)],
+    "supplier": [("s_suppkey",)],
+    "partsupp": [("ps_partkey", "ps_suppkey")],
+    "customer": [("c_custkey",)],
+    "orders": [("o_orderkey",)],
+    "lineitem": [("l_orderkey", "l_linenumber")],
+}
+
+# max rows sharing one value of the key set (join fanout upper bounds)
+MAX_ROWS_PER_KEY = {
+    "lineitem": {("l_orderkey",): 7, ("l_orderkey", "l_linenumber"): 1},
+    "partsupp": {("ps_partkey",): SUPP_PER_PART,
+                 ("ps_partkey", "ps_suppkey"): 1},
+}
+
+
+def column_stats(table: str, column: str, sf: float, ColStats):
+    n = row_count(table, sf)
+    nparts = max(int(200_000 * sf), 1)
+    n_ps = max(int(800_000 * sf), 1)
+    max_ps_partkey = (n_ps - 1) // SUPP_PER_PART + 1
+    nsupp = max(int(10_000 * sf), 1)
+    ncust = max(int(150_000 * sf), 1)
+    norders = max(int(1_500_000 * sf), 1)
+    max_orderkey = ((norders - 1) // 8) * 32 + (norders - 1) % 8 + 1
+    R = {
+        "r_regionkey": (0, 4, 5), "n_nationkey": (0, 24, 25),
+        "n_regionkey": (0, 4, 5),
+        "p_partkey": (1, nparts, nparts),
+        "p_size": (1, 50, 50),
+        "p_retailprice": (900.0, 2099.0, None),
+        "s_suppkey": (1, nsupp, nsupp),
+        "s_nationkey": (0, 24, 25),
+        "s_acctbal": (-999.99, 9999.99, None),
+        "ps_partkey": (1, max(nparts, max_ps_partkey), nparts),
+        "ps_suppkey": (1, nsupp, nsupp),
+        "ps_availqty": (1, 9999, 9999),
+        "ps_supplycost": (1.0, 1000.0, None),
+        "c_custkey": (1, ncust, ncust),
+        "c_nationkey": (0, 24, 25),
+        "c_acctbal": (-999.99, 9999.99, None),
+        "o_orderkey": (1, max_orderkey, norders),
+        "o_custkey": (1, ncust, ncust),
+        "o_totalprice": (850.0, 550000.0, None),
+        "o_orderdate": (START_DATE, END_DATE - 151, END_DATE - START_DATE),
+        "o_shippriority": (0, 0, 1),
+        "l_orderkey": (1, max_orderkey, norders),
+        "l_partkey": (1, nparts, nparts),
+        "l_suppkey": (1, nsupp, nsupp),
+        "l_linenumber": (1, 7, 7),
+        "l_quantity": (1.0, 50.0, 50),
+        "l_extendedprice": (900.0, 104950.0, None),
+        "l_discount": (0.0, 0.10, 11),
+        "l_tax": (0.0, 0.08, 9),
+        "l_shipdate": (START_DATE + 1, END_DATE - 151 + 121, None),
+        "l_commitdate": (START_DATE + 30, END_DATE - 151 + 90, None),
+        "l_receiptdate": (START_DATE + 2, END_DATE - 151 + 121 + 30, None),
+    }
+    NDV_ONLY = {
+        "r_name": 5, "n_name": 25, "p_mfgr": 5, "p_brand": 25,
+        "p_type": 150, "p_container": 40, "p_name": None,
+        "c_mktsegment": 5, "o_orderpriority": 5, "o_orderstatus": 3,
+        "l_returnflag": 3, "l_linestatus": 2, "l_shipinstruct": 4,
+        "l_shipmode": 7,
+    }
+    if column in R:
+        lo, hi, ndv = R[column]
+        schema_t = SCHEMAS[table][column]
+        if schema_t.name == "DOUBLE":
+            return ColStats(min=lo, max=hi, ndv=ndv)
+        return ColStats(min=float(lo), max=float(hi), ndv=ndv)
+    if column in NDV_ONLY:
+        return ColStats(ndv=NDV_ONLY[column])
+    return ColStats(ndv=min(n, 4_000_000))  # names/comments/phones etc.

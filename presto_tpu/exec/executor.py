@@ -38,6 +38,11 @@ class ExecutionError(Exception):
     pass
 
 
+class StaticFallback(Exception):
+    """Raised when a plan shape can't be made static (missing stats /
+    unbounded join fanout); auto mode falls back to eager execution."""
+
+
 def execute_query(session, text: str) -> QueryResult:
     stmt = parse(text)
     if isinstance(stmt, ast.SetSession):
@@ -62,9 +67,82 @@ def execute_query(session, text: str) -> QueryResult:
     if isinstance(stmt, ast.InsertInto):
         raise ExecutionError("INSERT INTO not supported yet")
 
+    mode = session.properties.get("execution_mode", "auto")
+    if mode in ("auto", "compiled"):
+        try:
+            return run_compiled(session, text, stmt)
+        except (StaticFallback, jax.errors.ConcretizationTypeError) as e:
+            if mode == "compiled":
+                raise StaticFallback(str(e)) from e
     plan = plan_statement(session, stmt)
     ex = Executor(session)
     return ex.run(plan)
+
+
+def _collect_tablescans(node: P.PlanNode, out: list):
+    if isinstance(node, P.TableScan):
+        out.append(node)
+    for s in node.sources:
+        _collect_tablescans(s, out)
+
+
+def run_compiled(session, text: str, stmt) -> QueryResult:
+    """Compiled execution: the WHOLE plan traces into one jitted XLA
+    program over the scan batches (the reference compiles expressions to
+    bytecode per operator, sql/gen/; we compile the entire fragment DAG —
+    XLA fuses scan->filter->project->agg->join chains end to end).
+
+    Static shapes come from connector stats (plan/stats.py).  Runtime
+    guards verify the static assumptions (group capacity, join fanout);
+    a tripped guard re-runs the query in dynamic eager mode."""
+    cache = getattr(session, "_compiled_cache", None)
+    if cache is None:
+        cache = session._compiled_cache = {}
+    key = (" ".join(text.split()),
+           getattr(session.catalog, "version", 0),
+           tuple(sorted((k, repr(v)) for k, v in session.properties.items())))
+    entry = cache.get(key)
+    if entry == "DYNAMIC":  # static assumptions known-violated for this query
+        plan = plan_statement(session, stmt)
+        return Executor(session).run(plan)
+    if entry is None:
+        plan = plan_statement(session, stmt)
+        # uncorrelated scalar subqueries: evaluate eagerly (tiny), bake in;
+        # populate ctx as we go — later subplans may reference earlier ones
+        ex0 = Executor(session)
+        scalar_results = ex0.ctx.scalar_results
+        for pid, sub in sorted(plan.subplans.items()):
+            scalar_results[pid] = _single_value(ex0.exec_node(sub))
+        scan_nodes: list = []
+        _collect_tablescans(plan.root, scan_nodes)
+
+        def fn(batches):
+            ex = Executor(session, static=True,
+                          scan_inputs={id(n): b for n, b in zip(scan_nodes, batches)})
+            ex.ctx.scalar_results = scalar_results
+            out = ex.exec_node(plan.root)
+            if ex.guards:
+                guard = jnp.any(jnp.stack([jnp.asarray(g) for g in ex.guards]))
+            else:
+                guard = jnp.asarray(False)
+            return out, guard
+
+        jitted = jax.jit(fn)
+        batches = [scan_batch(session.catalog.get(n.table), n) for n in scan_nodes]
+        out_batch, guard = jitted(batches)  # traces; may raise StaticFallback
+        cache[key] = (plan, jitted, scan_nodes)  # cache only after success
+    else:
+        plan, jitted, scan_nodes = entry
+        batches = [scan_batch(session.catalog.get(n.table), n) for n in scan_nodes]
+        out_batch, guard = jitted(batches)
+    if bool(guard):
+        # static assumption violated; data is static so it will trip again —
+        # remember to go straight to dynamic next time (no retrace loop)
+        cache[key] = "DYNAMIC"
+        plan2 = plan_statement(session, stmt)
+        return Executor(session).run(plan2)
+    ex = Executor(session)
+    return ex.materialize(plan, out_batch)
 
 
 def plan_statement(session, stmt) -> P.QueryPlan:
@@ -113,13 +191,19 @@ def explain_query(session, text: str, analyze: bool = False) -> str:
 
 
 class Executor:
-    def __init__(self, session):
+    def __init__(self, session, static: bool = False, scan_inputs=None):
         self.session = session
         self.ctx = EvalContext()
+        self.static = static  # compiled mode: no host syncs, static shapes
+        self.scan_inputs = scan_inputs  # {node id: Batch} traced jit args
+        self.guards = []  # traced bools: True => static assumption violated
 
     # ------------------------------------------------------------------
     def run(self, plan: P.QueryPlan) -> QueryResult:
         batch = self.evaluate(plan)
+        return self.materialize(plan, batch)
+
+    def materialize(self, plan: P.QueryPlan, batch: Batch) -> QueryResult:
         out = plan.root
         arrays, sel = to_numpy(batch)
         cols = []
@@ -159,15 +243,10 @@ class Executor:
 
     # ---- leaves ------------------------------------------------------
     def _exec_tablescan(self, node: P.TableScan) -> Batch:
+        if self.scan_inputs is not None:
+            return self.scan_inputs[id(node)]
         table = self.session.catalog.get(node.table)
-        cols = list(dict.fromkeys(node.assignments.values()))
-        data = table.read(cols)
-        arrays = {}
-        types = {}
-        for sym, col in node.assignments.items():
-            arrays[sym] = data[col]
-            types[sym] = node.types[sym]
-        return batch_from_numpy(arrays, types)
+        return scan_batch(table, node)
 
     def _exec_values(self, node: P.Values) -> Batch:
         arrays = {}
@@ -207,7 +286,7 @@ class Executor:
         b = self.exec_node(node.source)
         if any(a.distinct for a in node.aggs.values()):
             return self._exec_aggregate_with_distinct(node, b)
-        return self._aggregate(b, node.group_keys, node.aggs)
+        return self._aggregate(b, node.group_keys, node.aggs, node)
 
     def _exec_aggregate_with_distinct(self, node: P.Aggregate, b: Batch) -> Batch:
         """Rewrite: pre-group by (keys + distinct arg) then count non-null
@@ -233,10 +312,12 @@ class Executor:
         return self._aggregate(pre, node.group_keys, aggs2)
 
     def _aggregate(self, b: Batch, group_keys: List[str],
-                   aggs: Dict[str, ir.AggCall]) -> Batch:
+                   aggs: Dict[str, ir.AggCall], node: Optional[P.Aggregate] = None) -> Batch:
         if not group_keys:
             return self._global_aggregate(b, aggs)
         key_cols = [b.columns[k] for k in group_keys]
+        if self.static:
+            return self._aggregate_static(b, group_keys, key_cols, aggs, node)
         key, _ = K.pack_keys(key_cols, b.sel)
         gid, rep_rows, n_groups = K.group_ids(key, b.sel)
         out_cols: Dict[str, Column] = {}
@@ -253,6 +334,27 @@ class Executor:
             out_cols = {k: Column(c.data[:0], None if c.valid is None else c.valid[:0],
                                   c.type, c.dictionary) for k, c in out_cols.items()}
         return Batch(out_cols, sel)
+
+    def _aggregate_static(self, b: Batch, group_keys, key_cols, aggs, node) -> Batch:
+        cap = getattr(node, "capacity_hint", None) if node is not None else None
+        if cap is None:
+            cap = b.capacity
+        cap = min(cap, b.capacity) or 1
+        key_stats = getattr(node, "key_stats", {}) if node is not None else {}
+        layout = K.static_layout(key_cols, [key_stats.get(k) for k in group_keys])
+        key = K.pack_with_layout(key_cols, b.sel, layout)  # None -> hash, sync-free
+        if layout is not None:
+            self.guards.append(K.layout_range_guard(key_cols, b.sel, layout))
+        gid, rep_rows, exists, overflow = K.group_ids_static(key, cap)
+        self.guards.append(overflow)
+        out_cols: Dict[str, Column] = {}
+        for k in group_keys:
+            c = b.columns[k]
+            valid = None if c.valid is None else (c.valid[rep_rows] & exists)
+            out_cols[k] = Column(c.data[rep_rows], valid, c.type, c.dictionary)
+        for sym, a in aggs.items():
+            out_cols[sym] = self._agg_column(b, a, gid, cap)
+        return Batch(out_cols, exists)
 
     def _agg_column(self, b: Batch, a: ir.AggCall, gid, n_groups) -> Column:
         mask = b.sel
@@ -366,16 +468,47 @@ class Executor:
         for c in rkeys:
             if c.valid is not None:
                 rsel = rsel & c.valid
-        rkey, layout = K.pack_keys(rkeys, rsel, extra_cols=lkeys)
-        lkey = K.pack_with_layout(lkeys, lsel, layout)
+        if self.static:
+            # compile-time layout from stats/dictionaries (shared ranges
+            # across both sides); unknown ranges -> sync-free 64-bit hash
+            key_stats = getattr(node, "key_stats", {})
+            merged_stats = []
+            for (lk, rk), lc, rc in zip(node.criteria, lkeys, rkeys):
+                ls_, rs_ = key_stats.get(lk), key_stats.get(rk)
+                merged_stats.append(_merge_range(ls_, rs_))
+            layout = K.static_layout(rkeys, merged_stats)
+            rkey = K.pack_with_layout(rkeys, rsel, layout)
+            lkey = K.pack_with_layout(lkeys, lsel, layout)
+            if layout is not None:
+                self.guards.append(K.layout_range_guard(rkeys, rsel, layout))
+                self.guards.append(K.layout_range_guard(lkeys, lsel, layout))
+        else:
+            rkey, layout = K.pack_keys(rkeys, rsel, extra_cols=lkeys)
+            lkey = K.pack_with_layout(lkeys, lsel, layout)
         order, lb, ub = K.build_probe(rkey, lkey)
         counts = ub - lb
-        max_matches = int(jnp.max(counts)) if counts.shape[0] else 0
 
         if jt in ("SEMI", "ANTI") and node.filter is None:
             found = counts > 0
             sel = left.sel & (found if jt == "SEMI" else ~found)
             return left.with_sel(sel)
+
+        if self.static:
+            if getattr(node, "build_unique", False):
+                max_matches = 1
+                if counts.shape[0]:
+                    self.guards.append(jnp.max(counts) > 1)
+            else:
+                bound = getattr(node, "fanout_bound", None)
+                if bound is None:
+                    raise StaticFallback(
+                        f"join fanout unbounded ({node.join_type} on {node.criteria})")
+                if counts.shape[0]:
+                    self.guards.append(jnp.max(counts) > bound)
+                return self._expanding_join_static(left, right, node, order, lb,
+                                                   counts, bound)
+        else:
+            max_matches = int(jnp.max(counts)) if counts.shape[0] else 0
 
         if max_matches <= 1 and jt in ("INNER", "LEFT", "SEMI", "ANTI"):
             found = counts > 0
@@ -401,6 +534,52 @@ class Executor:
 
         # one-to-many: expand
         return self._expanding_join(left, right, node, order, lb, counts)
+
+    def _expanding_join_static(self, left: Batch, right: Batch, node: P.Join,
+                               order, lb, counts, bound: int) -> Batch:
+        """One-to-many join with a STATIC per-probe-row slot layout: probe
+        row i owns output slots [i*F, (i+1)*F), F = connector fanout bound
+        (e.g. <=7 lineitems per order).  Unmatched slots are masked, not
+        skipped — shape stays compile-time constant."""
+        jt = node.join_type
+        n = left.capacity
+        total = n * bound
+        if total > 100_000_000:
+            raise StaticFallback(
+                f"static expansion too large: {n} x fanout {bound}")
+        counts = jnp.where(left.sel, counts, 0)
+        lidx = jnp.repeat(jnp.arange(n), bound, total_repeat_length=total)
+        k = jnp.tile(jnp.arange(bound), n)
+        slot_live = k < jnp.minimum(counts, bound)[lidx]
+        rpos = jnp.clip(lb[lidx] + k, 0, max(order.shape[0] - 1, 0))
+        ridx = order[rpos]
+        lbatch = K.gather_batch(left, lidx)
+        rbatch = K.gather_batch(right, ridx, idx_valid=slot_live)
+        merged = dict(lbatch.columns)
+        merged.update(rbatch.columns)
+        out = Batch(merged, lbatch.sel & slot_live)
+        match_ok = out.sel
+        if node.filter is not None:
+            match_ok = match_ok & eval_predicate(node.filter, out, self.ctx)
+        if jt == "INNER":
+            return out.with_sel(match_ok)
+        if jt in ("SEMI", "ANTI"):
+            hit = jax.ops.segment_max(match_ok.astype(jnp.int32), lidx,
+                                      num_segments=n) > 0
+            want = hit if jt == "SEMI" else ~hit
+            return left.with_sel(left.sel & want)
+        if jt == "LEFT":
+            any_ok = jax.ops.segment_max(match_ok.astype(jnp.int32), lidx,
+                                         num_segments=n) > 0
+            first_slot = k == 0
+            keep = jnp.where(any_ok[lidx], match_ok, first_slot & left.sel[lidx])
+            rvalid = match_ok
+            for name in rbatch.columns:
+                c = merged[name]
+                v = rvalid if c.valid is None else (c.valid & rvalid)
+                merged[name] = Column(c.data, v, c.type, c.dictionary)
+            return Batch(merged, keep)
+        raise StaticFallback(f"static join type {jt} not supported")
 
     def _expanding_join(self, left: Batch, right: Batch, node: P.Join,
                         order, lb, counts) -> Batch:
@@ -463,10 +642,15 @@ class Executor:
         raise ExecutionError(f"join type {jt} not implemented")
 
     def _cross_join(self, left: Batch, right: Batch, node: P.Join) -> Batch:
-        left = K.compact(left)
-        right = K.compact(right)
+        if not self.static:  # compaction needs a host sync
+            left = K.compact(left)
+            right = K.compact(right)
         nl, nr = left.capacity, right.capacity
         if nl * nr > 50_000_000:
+            if self.static:
+                # uncompacted capacities can be huge where the compacted
+                # cross join is tiny — let the dynamic path try
+                raise StaticFallback(f"static cross join too large: {nl} x {nr}")
             raise ExecutionError(f"cross join too large: {nl} x {nr}")
         lidx = jnp.repeat(jnp.arange(nl), nr, total_repeat_length=max(nl * nr, 1))
         ridx = jnp.tile(jnp.arange(nr), nl)[:max(nl * nr, 1)]
@@ -515,6 +699,41 @@ class Executor:
     def _exec_output(self, node: P.Output) -> Batch:
         b = self.exec_node(node.source)
         return b.select([s for s in node.symbols])
+
+
+def scan_batch(table, node: P.TableScan) -> Batch:
+    """Read + ingest a table's columns, with a per-table device-column
+    cache (upload + dictionary-encode once per process; reference analog:
+    a connector page source feeding a cache — here the 'page' is the whole
+    column and lives in HBM)."""
+    cache = getattr(table, "_device_cols", None)
+    if cache is None:
+        cache = table._device_cols = {}
+    needed = list(dict.fromkeys(node.assignments.values()))
+    missing = [c for c in needed if c not in cache]
+    if missing:
+        from presto_tpu.batch import column_from_numpy
+
+        data = table.read(missing)
+        for c in missing:
+            cache[c] = column_from_numpy(data[c], table.schema[c])
+    cols = {}
+    n = None
+    for sym, col in node.assignments.items():
+        c = cache[col]
+        cols[sym] = Column(c.data, c.valid, node.types[sym], c.dictionary)
+        n = c.data.shape[0]
+    return Batch(cols, jnp.ones((n or 0,), bool))
+
+
+def _merge_range(a, b):
+    """Union of two ColStats ranges (None-safe) for shared join-key packing."""
+    from presto_tpu.plan.stats import ColStats
+
+    if a is None or b is None or a.min is None or b.min is None \
+            or a.max is None or b.max is None:
+        return None
+    return ColStats(min=min(a.min, b.min), max=max(a.max, b.max))
 
 
 def _unify_key_dictionaries(lkeys: List[Column], rkeys: List[Column]):

@@ -136,6 +136,69 @@ def _hash_keys(cols: List[Column], sel) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def static_layout(cols: List[Column], stats_list) -> Optional[list]:
+    """Compile-time pack layout from metadata: dictionary sizes for string
+    codes, connector ColStats ranges for numerics.  Returns None when any
+    column's range is unknown (callers fall back to 64-bit hashing, which
+    needs no range and no host sync)."""
+    parts = []
+    for c, st in zip(cols, stats_list):
+        if c.dictionary is not None:
+            lo, hi = 0, max(len(c.dictionary) - 1, 0)
+        elif c.data.dtype == jnp.bool_:
+            lo, hi = 0, 1
+        elif st is not None and st.min is not None and st.max is not None \
+                and not jnp.issubdtype(c.data.dtype, jnp.floating):
+            lo, hi = int(st.min), int(st.max)
+        else:
+            return None
+        parts.append((lo, hi - lo + 2))
+    total_bits = sum(int(np.ceil(np.log2(max(card, 2)))) for _, card in parts)
+    if total_bits > 62:
+        return None
+    layout = []
+    stride = 1
+    for lo, card in parts:
+        width = int(np.ceil(np.log2(max(card, 2))))
+        layout.append((lo, stride, width))
+        stride <<= width
+    return layout
+
+
+def layout_range_guard(cols: List[Column], sel, layout) -> jnp.ndarray:
+    """True if any live value falls outside its static layout range —
+    out-of-range values would bleed bits into adjacent packed fields and
+    silently corrupt keys, so the compiled path re-runs dynamically."""
+    bad = jnp.zeros((), bool)
+    for c, (lo, _stride, width) in zip(cols, layout):
+        d = _orderable_int(c)
+        live = sel & _valid_arr(c)
+        hi = lo + (1 << width) - 2  # code 0 reserved for NULL
+        bad = bad | jnp.any(live & ((d < lo) | (d > hi)))
+    return bad
+
+
+def group_ids_static(key: jnp.ndarray, cap: int):
+    """Static-shape grouping: same sort-based scheme as group_ids but with
+    a fixed group capacity.  Returns (gid, rep_rows[cap], exists[cap],
+    overflow) — overflow True means cap was too small (caller re-runs in
+    dynamic mode; the guard is checked once per query, not per op)."""
+    n = key.shape[0]
+    order = jnp.argsort(key)
+    skey = key[order]
+    newgrp = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
+    live_sorted = skey != I64_MAX
+    newgrp = newgrp & live_sorted
+    n_groups = jnp.sum(newgrp)
+    gid_sorted = jnp.cumsum(newgrp) - 1
+    gid_sorted = jnp.where(live_sorted & (gid_sorted < cap), gid_sorted, cap)
+    gid = jnp.zeros((n,), dtype=gid_sorted.dtype).at[order].set(gid_sorted)
+    rep_pos = jnp.nonzero(newgrp, size=cap, fill_value=0)[0]
+    rep_rows = order[rep_pos]
+    exists = jnp.arange(cap) < n_groups
+    return gid, rep_rows, exists, n_groups > cap
+
+
 def group_ids(key: jnp.ndarray, sel) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
     """Sort-based grouping. Returns (gid[n] in [0, n_groups) for live rows,
     representative row index per group [n_groups], n_groups).

@@ -25,7 +25,56 @@ def optimize(plan: P.QueryPlan, session) -> P.QueryPlan:
     root = plan.root
     subplans = {k: _optimize_node(v, session) for k, v in plan.subplans.items()}
     new_root = _optimize_node(root, session)
-    return P.QueryPlan(new_root, subplans)
+    out = P.QueryPlan(new_root, subplans)
+    annotate_static_hints(out, session)
+    return out
+
+
+def annotate_static_hints(plan: P.QueryPlan, session) -> None:
+    """Attach stats-derived static-shape hints used by the compiled
+    executor: group capacities, key ranges, join build-uniqueness and
+    fanout bounds (plan/stats.py docstring explains why)."""
+    from presto_tpu.plan import stats as S
+
+    catalog = getattr(session, "catalog", None)
+    if catalog is None:
+        return
+    memo = {}
+
+    def annotate(node):
+        for s in node.sources:
+            annotate(s)
+        try:
+            if isinstance(node, P.Aggregate):
+                src = S.derive(node.source, catalog, memo)
+                node.capacity_hint = S.capacity_for_groups(node, src)
+                node.key_stats = {k: src.cols.get(k) for k in node.group_keys}
+            elif isinstance(node, P.Join) and node.join_type not in ("CROSS",):
+                ls = S.derive(node.left, catalog, memo)
+                rs = S.derive(node.right, catalog, memo)
+                rkeys = frozenset(rk for _, rk in node.criteria)
+                node.build_unique = any(u <= rkeys for u in rs.unique)
+                best = S._best_fanout_key(rs, rkeys)
+                node.fanout_bound = rs.fanout.get(best) if best else None
+                if node.fanout_bound is None and len(node.criteria) == 1:
+                    # speculative bound from ndv: ~4x the average fanout.
+                    # Safe because the compiled path guards actual counts
+                    # and re-runs dynamically on overflow.
+                    cs = rs.cols.get(node.criteria[0][1])
+                    if cs is not None and cs.ndv:
+                        import math
+
+                        node.fanout_bound = max(4, math.ceil(rs.rows / cs.ndv) * 4)
+                node.key_stats = {}
+                for lk, rk in node.criteria:
+                    node.key_stats[lk] = ls.cols.get(lk)
+                    node.key_stats[rk] = rs.cols.get(rk)
+        except Exception:
+            pass  # hints are optional; executor falls back to dynamic mode
+
+    annotate(plan.root)
+    for sub in plan.subplans.values():
+        annotate(sub)
 
 
 def _optimize_node(node: P.PlanNode, session) -> P.PlanNode:
@@ -185,29 +234,48 @@ def _reassemble_join(root: P.Join, conjs: List[ir.RowExpr], session) -> P.PlanNo
         if not placed:
             remaining.append(c)
 
-    # greedy connected join order
-    current = sources[0]
-    cur_syms = set(src_syms[0])
-    todo = list(range(1, len(sources)))
+    # stats-guided greedy join order (reference: ReorderJoins CBO, greedy
+    # variant): start from the largest source (the fact table becomes the
+    # probe side), then repeatedly join a connected source, preferring
+    # unique-key builds (FK joins lower to pure gathers) then small ones.
+    from presto_tpu.plan import stats as S
+
+    catalog = getattr(session, "catalog", None)
+
+    def src_stats(i):
+        try:
+            return S.derive(sources[i], catalog)
+        except Exception:
+            return None
+
+    stats_list = [src_stats(i) for i in range(len(sources))]
+    rows = [s.rows if s else 1 << 30 for s in stats_list]
+    start = max(range(len(sources)), key=lambda i: rows[i])
+
+    current = sources[start]
+    cur_syms = set(src_syms[start])
+    todo = [i for i in range(len(sources)) if i != start]
     while todo:
-        picked = None
+        candidates = []
         for i in todo:
-            # find equality conjuncts connecting current to source i
             crits = []
             for c in remaining:
                 pair = _equi_pair(c, cur_syms, src_syms[i])
                 if pair is not None:
                     crits.append((c, pair))
             if crits:
-                picked = (i, crits)
-                break
-        if picked is None:
+                rkeys = frozenset(pair[1] for _, pair in crits)
+                st = stats_list[i]
+                unique_build = bool(st and any(u <= rkeys for u in st.unique))
+                candidates.append((not unique_build, rows[i], i, crits))
+        if not candidates:
             i = todo[0]
             current = P.Join(current, sources[i], "CROSS")
             cur_syms |= src_syms[i]
             todo.remove(i)
             continue
-        i, crits = picked
+        candidates.sort(key=lambda t: (t[0], t[1]))
+        _, _, i, crits = candidates[0]
         criteria = [pair for _, pair in crits]
         used = {id(c) for c, _ in crits}
         remaining = [c for c in remaining if id(c) not in used]

