@@ -41,7 +41,7 @@ class Dictionary:
     jit-cache-friendly (a new Dictionary object => new compilation key only
     when used as a static argument; codes arrays are ordinary operands)."""
 
-    __slots__ = ("values", "_id")
+    __slots__ = ("values", "_id", "_value_hashes")
 
     def __init__(self, values: np.ndarray):
         # values: 1-D object/str array; code i means values[i]. values[-1]

@@ -36,7 +36,9 @@ def eval_expr(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> ColVal:
         return ColVal(expr.value, None, expr.type)
     if isinstance(expr, ir.ScalarSub):
         v, valid = ctx.scalar_results[expr.plan_id]
-        return ColVal(v, None if valid else False, expr.type)
+        if isinstance(valid, (bool, type(None))):  # host-evaluated subplan
+            return ColVal(v, None if valid else False, expr.type)
+        return ColVal(v, valid, expr.type)  # traced 0-d value (distributed)
     if isinstance(expr, ir.CastExpr):
         return scalar_fns.emit_cast(eval_expr(expr.arg, batch, ctx), expr.type, expr.safe)
     if isinstance(expr, ir.Call):
@@ -53,9 +55,7 @@ def eval_predicate(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> jnp.ndar
         data = jnp.full((batch.capacity,), bool(data) if not hasattr(data, "shape") else data)
     mask = data
     if v.valid is not None:
-        valid = v.valid
-        if not hasattr(valid, "shape") or getattr(valid, "ndim", 0) == 0:
-            valid = jnp.full((batch.capacity,), bool(valid))
+        valid = _expand_valid(v.valid, batch.capacity)
         mask = mask & valid
     return mask
 
@@ -85,5 +85,7 @@ def _expand_valid(valid, capacity):
     if valid is None:
         return None
     if not hasattr(valid, "shape") or getattr(valid, "ndim", 0) == 0:
+        if hasattr(valid, "dtype"):  # 0-d traced value
+            return jnp.broadcast_to(valid, (capacity,))
         return jnp.full((capacity,), bool(valid))
     return valid

@@ -67,6 +67,15 @@ def execute_query(session, text: str) -> QueryResult:
     if isinstance(stmt, ast.InsertInto):
         raise ExecutionError("INSERT INTO not supported yet")
 
+    if session.properties.get("distributed", False):
+        from presto_tpu.parallel.dist_executor import run_distributed
+        from presto_tpu.plan.distribute import Undistributable
+
+        try:
+            return run_distributed(session, text, stmt)
+        except (Undistributable, StaticFallback,
+                jax.errors.ConcretizationTypeError):
+            pass  # single-device paths below
     mode = session.properties.get("execution_mode", "auto")
     if mode in ("auto", "compiled"):
         try:
@@ -369,6 +378,9 @@ class Executor:
             if v.valid is not None:
                 m = m & v.valid
             return Column(K.segment_sum(m.astype(jnp.int64), gid, n_groups), None, T.BIGINT)
+        if a.fn in ("merge_count", "merge_avg") or a.fn.startswith(
+                ("merge_stddev", "merge_var")):
+            return self._merge_agg_column(b, a, gid, n_groups, mask)
         v = eval_expr(a.args[0], b, self.ctx)
         col = to_column(v, b.capacity)
         valid = mask if col.valid is None else (mask & col.valid)
@@ -428,7 +440,57 @@ class Executor:
             x = jnp.where(valid, jnp.asarray(col.data, bool), False)
             r = K.segment_max(x.astype(jnp.int32), gid, n_groups) > 0
             return Column(r, nonempty, T.BOOLEAN)
+        if a.fn in ("partial_sum_double", "partial_sum_sq_double"):
+            # PARTIAL step of avg/stddev decomposition (plan/distribute.py):
+            # the float64 running sums the reference's accumulators keep
+            # (operator/aggregation/AverageAggregations, VarianceAggregation)
+            x = col.data.astype(jnp.float64)
+            if col.type.is_decimal:
+                x = x / (10 ** col.type.decimal_scale)
+            if a.fn.endswith("sq_double"):
+                x = x * x
+            s = K.segment_sum(jnp.where(valid, x, 0.0), gid, n_groups)
+            return Column(s, nonempty, T.DOUBLE)
         raise ExecutionError(f"aggregate {a.fn} not implemented")
+
+    def _merge_agg_column(self, b: Batch, a: ir.AggCall, gid, n_groups,
+                          mask) -> Column:
+        """FINAL-step merges over gathered partial states (reference:
+        AggregationNode.Step.FINAL combining intermediate accumulator
+        pages).  Args are Refs to partial-state columns."""
+
+        def summed(e, zero=0.0):
+            c = to_column(eval_expr(e, b, self.ctx), b.capacity)
+            valid = mask if c.valid is None else (mask & c.valid)
+            x = jnp.where(valid, c.data, jnp.asarray(zero, c.data.dtype))
+            return K.segment_sum(x, gid, n_groups), K.segment_sum(
+                valid.astype(jnp.int64), gid, n_groups)
+
+        if a.fn == "merge_count":
+            s, _ = summed(a.args[0], 0)
+            return Column(s.astype(jnp.int64), None, T.BIGINT)
+        if a.fn == "merge_avg":
+            s, _ = summed(a.args[0])
+            c, _ = summed(a.args[1], 0)
+            c = c.astype(jnp.int64)
+            return Column(s / jnp.maximum(c, 1), c > 0, T.DOUBLE)
+        # merge_stddev*/merge_var*: args (sum, sum_sq, count)
+        s1, _ = summed(a.args[0])
+        s2, _ = summed(a.args[1])
+        cnt, _ = summed(a.args[2], 0)
+        cnt = cnt.astype(jnp.int64)
+        n = jnp.maximum(cnt, 1).astype(jnp.float64)
+        var_pop = jnp.maximum(s2 / n - (s1 / n) ** 2, 0.0)
+        fn = a.fn[len("merge_"):]
+        if fn in ("stddev", "stddev_samp", "variance", "var_samp"):
+            denom = jnp.maximum(cnt - 1, 1).astype(jnp.float64)
+            var = var_pop * n / denom
+            ok = cnt > 1
+        else:
+            var = var_pop
+            ok = cnt > 0
+        r = jnp.sqrt(var) if fn.startswith("stddev") else var
+        return Column(r, ok, T.DOUBLE)
 
     def _global_aggregate(self, b: Batch, aggs: Dict[str, ir.AggCall]) -> Batch:
         gid = jnp.zeros((b.capacity,), dtype=jnp.int64)

@@ -1,0 +1,134 @@
+"""Collective exchange kernels: the data plane of distributed execution.
+
+Reference parity: the HTTP shuffle (SURVEY.md §2.6 — PartitionedOutputOperator
+-> PagesSerde -> OutputBuffer -> HttpPageBufferClient -> ExchangeClient)
+re-based on XLA collectives over the ICI mesh.  Where the reference
+serializes pages and pulls them over HTTP with ack tokens, here a whole
+repartition is ONE `lax.all_to_all` inside the jitted superstep: rows are
+bucketed by key hash into a fixed (ndev, C) send layout, exchanged, and
+received as a fixed (ndev*C,) batch with a validity mask.  Backpressure,
+framing, compression, and retry disappear — XLA schedules the transfer and
+overlap; capacity overflow is a traced guard that falls back to dynamic
+execution (the analog of the reference's spill-on-buffer-full, but chosen
+per-query instead of per-page).
+
+All functions here run INSIDE shard_map (per-shard view, axis name bound).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.exec import kernels as K
+
+
+def all_gather_batch(b: Batch, axis: str) -> Batch:
+    """P2/P5: replicate a sharded batch on every shard (broadcast build
+    sides, gather-to-coordinator).  Dictionaries are host-side and already
+    shared across shards (tracing happens once)."""
+    cols = {}
+    for name, c in b.columns.items():
+        data = jax.lax.all_gather(c.data, axis, tiled=True)
+        valid = None if c.valid is None else jax.lax.all_gather(c.valid, axis, tiled=True)
+        cols[name] = Column(data, valid, c.type, c.dictionary)
+    sel = jax.lax.all_gather(b.sel, axis, tiled=True)
+    return Batch(cols, sel)
+
+
+def scatter_batch(b: Batch, axis: str) -> Batch:
+    """Replicated -> sharded: keep rows on shard 0 only, so a replicated
+    input can feed a sharded union/concat without duplication."""
+    idx = jax.lax.axis_index(axis)
+    return b.with_sel(b.sel & (idx == 0))
+
+
+def partition_hash(key_cols: List[Column]) -> jnp.ndarray:
+    """Row -> uint32 bucket hash, STABLE across shards and across batches:
+    string columns hash their dictionary *values* (via a host-computed
+    per-code LUT) so two sides of a join agree even with different
+    dictionaries.  (Reference: InterpretedHashGenerator feeding
+    PartitionFunction, operator/repartition/PartitionedOutputOperator.java.)"""
+    h = jnp.zeros(key_cols[0].data.shape, dtype=jnp.uint64)
+    for c in key_cols:
+        if c.dictionary is not None:
+            lut = jnp.asarray(_dict_value_hashes(c.dictionary), dtype=jnp.uint64)
+            d = lut[jnp.clip(c.data, 0, len(c.dictionary) - 1)]
+        else:
+            d = K._orderable_int(c).astype(jnp.uint64)
+        d = jnp.where(K._valid_arr(c), d, jnp.uint64(0x9E3779B97F4A7C15))
+        h = h ^ (d + jnp.uint64(0x9E3779B97F4A7C15)
+                 + (h << jnp.uint64(6)) + (h >> jnp.uint64(2)))
+        z = h
+        z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        h = z ^ (z >> jnp.uint64(31))
+    return h
+
+
+def _dict_value_hashes(dictionary) -> np.ndarray:
+    """FNV-1a over utf-8 bytes of each dictionary value (host-side, once
+    per trace; cached on the Dictionary, lifetime-bound to it)."""
+    cached = getattr(dictionary, "_value_hashes", None)
+    if cached is not None:
+        return cached
+    out = np.empty(len(dictionary), dtype=np.uint64)
+    for i, v in enumerate(dictionary.values):
+        hv = 0xCBF29CE484222325
+        for byte in str(v).encode("utf-8"):
+            hv = ((hv ^ byte) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+        out[i] = hv
+    dictionary._value_hashes = out
+    return out
+
+
+def repartition_batch(b: Batch, key_cols: List[Column], ndev: int, axis: str,
+                      slack: float = 2.0) -> Tuple[Batch, jnp.ndarray]:
+    """P1 hash repartition: every live row moves to shard
+    hash(keys) % ndev via ONE all_to_all.
+
+    Static send layout: per-destination capacity C = ceil(slack * n/ndev);
+    rows are stably sorted by destination, positioned within their bucket,
+    and scattered into a (ndev*C,) send buffer.  Bucket overflow (skew
+    beyond `slack`) sets the returned guard — the caller falls back, the
+    distributed analog of the reference's skew pathology (SURVEY.md §7
+    hard-part 5).
+
+    Returns (received batch with capacity ndev*C, overflow guard)."""
+    n = b.capacity
+    c_cap = max(int(np.ceil(slack * n / ndev)), 1)
+    h = partition_hash(key_cols)
+    dest = (h % jnp.uint64(ndev)).astype(jnp.int32)
+    dest = jnp.where(b.sel, dest, ndev)  # dead rows -> overflow bucket, sorted last
+    order = jnp.argsort(dest, stable=True)
+    sdest = dest[order]
+    # position of each row within its destination bucket
+    first = jnp.searchsorted(sdest, jnp.arange(ndev + 1, dtype=sdest.dtype))
+    within = jnp.arange(n) - first[jnp.clip(sdest, 0, ndev)]
+    live = sdest < ndev
+    ok = live & (within < c_cap)
+    overflow = jnp.any(live & (within >= c_cap))
+    # send slot; dropped rows (overflow/dead) go to scratch slot ndev*c_cap
+    slot = jnp.where(ok, sdest * c_cap + within, ndev * c_cap)
+
+    def exchange(x, fill=0):
+        buf = jnp.full((ndev * c_cap + 1,) + x.shape[1:], fill, dtype=x.dtype)
+        buf = buf.at[slot].set(x[order])
+        send = buf[: ndev * c_cap]
+        return jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    # a received slot is live iff the sender placed a live row in it
+    sent_live = jnp.zeros((ndev * c_cap + 1,), dtype=bool).at[slot].set(ok)
+    sel_out = jax.lax.all_to_all(sent_live[: ndev * c_cap], axis,
+                                 split_axis=0, concat_axis=0, tiled=True)
+    cols = {}
+    for name, c in b.columns.items():
+        data = exchange(c.data)
+        valid = None if c.valid is None else exchange(c.valid)
+        cols[name] = Column(data, valid, c.type, c.dictionary)
+    return Batch(cols, sel_out), overflow

@@ -7,15 +7,13 @@ Reference parity: the coordinator/worker topology + HTTP exchanges
 - P2 broadcast (BroadcastOutputBuffer) -> lax.all_gather
 - P5 gather to coordinator (SINGLE_DISTRIBUTION) -> psum / device_get
 - partial->final aggregation (AddExchanges.java:239) -> per-shard segment
-  reduce + psum tree-combine, shown here as distributed_q1_step.
+  reduce + psum tree-combine.
 """
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh
 
 
 AXIS = "x"
@@ -37,34 +35,3 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     import numpy as np
 
     return Mesh(np.asarray(devs), (AXIS,))
-
-
-def distributed_q1_step(mesh: Mesh, data: dict):
-    """Partial aggregation per shard + all-reduce combine: the canonical
-    scan->partial agg->FINAL agg distributed plan (TPC-H Q1 shape)."""
-    n_groups = 8
-
-    def shard_fn(shipdate, flag, status, qty, price, discount, tax):
-        sel = shipdate <= 10471
-        key = (flag * 2 + status).astype(jnp.int32)
-        key = jnp.where(sel, key, n_groups)
-        disc_price = price * (1.0 - discount)
-        charge = disc_price * (1.0 + tax)
-
-        def seg(x):
-            partial = jax.ops.segment_sum(
-                jnp.where(sel, x, jnp.zeros_like(x)), key,
-                num_segments=n_groups + 1)[:n_groups]
-            return jax.lax.psum(partial, AXIS)  # FINAL combine over ICI
-
-        return (seg(qty), seg(price), seg(disc_price), seg(charge),
-                seg(jnp.ones_like(qty)), seg(discount))
-
-    f = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(AXIS),) * 7,
-        out_specs=(P(),) * 6,
-    )
-    args = (data["shipdate"], data["flag"], data["status"], data["qty"],
-            data["price"], data["discount"], data["tax"])
-    return jax.jit(f)(*args)

@@ -1,0 +1,318 @@
+"""Distribution planning: insert Exchange nodes + split aggregations.
+
+Reference parity: sql/planner/optimizations/AddExchanges.java (chooses
+SINGLE/FIXED_HASH/FIXED_BROADCAST distributions and inserts remote
+exchanges), DetermineJoinDistributionType (partitioned-vs-broadcast by
+build-side size), and the partial->final aggregation split
+(AddExchanges.java:239-265).  The output plan still executes single-pass —
+a DistExecutor traces it inside ONE shard_map where each Exchange becomes
+a collective (parallel/exchange.py).
+
+Distribution lattice per node:
+  any        — rows sharded arbitrarily over the mesh axis (SOURCE dist)
+  hashed(K)  — sharded; all rows with equal values of K on one shard
+  replicated — every shard holds every row (post-gather / broadcast)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from presto_tpu.plan import ir
+from presto_tpu.plan import nodes as P
+from presto_tpu import types as T
+
+
+@dataclasses.dataclass(frozen=True)
+class Dist:
+    kind: str  # 'any' | 'hashed' | 'replicated'
+    keys: Tuple[str, ...] = ()
+
+
+ANY = Dist("any")
+REPLICATED = Dist("replicated")
+
+
+class Undistributable(Exception):
+    """Plan shape the distributed planner can't place; caller runs the
+    single-device path instead."""
+
+
+def distribute(plan: P.QueryPlan, session, ndev: int) -> P.QueryPlan:
+    """Rewrite an optimized single-device plan into a distributed one.
+    Subplans (uncorrelated scalars) stay single-device — they are evaluated
+    host-side before the superstep, like the reference's pre-requisite
+    stages feeding a gather exchange."""
+    d = Distributer(session, ndev)
+    # subplans run in the SAME trace (not host-side) so float reduction
+    # order — and therefore sums compared against the main plan, e.g.
+    # TPC-H Q15's total_revenue = (select max(...)) — is bit-identical
+    subplans = {}
+    for pid, sub in sorted(plan.subplans.items()):
+        snode, sdist = d.visit(sub)
+        if sdist.kind != "replicated":
+            snode = P.Exchange(snode, "gather")
+        subplans[pid] = snode
+    root, dist = d.visit(plan.root.source)
+    if dist.kind != "replicated":
+        root = P.Exchange(root, "gather")
+    out = P.Output(root, plan.root.names, plan.root.symbols)
+    return P.QueryPlan(out, subplans)
+
+
+# aggregate fns that have a (partial fns -> final merge fn) decomposition
+_MERGEABLE = {"count", "count_if", "sum", "min", "max", "avg",
+              "bool_and", "every", "bool_or", "arbitrary", "any_value",
+              "stddev", "stddev_samp", "stddev_pop",
+              "variance", "var_samp", "var_pop"}
+
+
+class Distributer:
+    def __init__(self, session, ndev: int):
+        self.session = session
+        self.ndev = ndev
+        self.broadcast_rows = int(session.properties.get(
+            "broadcast_join_threshold_rows", 1_000_000))
+        self.partial_agg_groups = int(session.properties.get(
+            "partial_aggregation_max_groups", 8192))
+        self._ctr = 0
+
+    def fresh(self, base: str) -> str:
+        self._ctr += 1
+        return f"{base}$d{self._ctr}"
+
+    # ------------------------------------------------------------------
+    def visit(self, node: P.PlanNode) -> Tuple[P.PlanNode, Dist]:
+        m = getattr(self, f"_visit_{type(node).__name__.lower()}", None)
+        if m is None:
+            raise Undistributable(type(node).__name__)
+        return m(node)
+
+    def _visit_tablescan(self, node: P.TableScan):
+        return node, ANY
+
+    def _visit_values(self, node: P.Values):
+        return node, REPLICATED
+
+    def _visit_filter(self, node: P.Filter):
+        src, dist = self.visit(node.source)
+        node.source = src
+        return node, dist
+
+    def _visit_project(self, node: P.Project):
+        src, dist = self.visit(node.source)
+        node.source = src
+        if dist.kind == "hashed":
+            # hashed keys survive only through identity projections
+            rename = {}
+            for sym, e in node.assignments.items():
+                if isinstance(e, ir.Ref):
+                    rename.setdefault(e.name, sym)
+            if all(k in rename for k in dist.keys):
+                dist = Dist("hashed", tuple(rename[k] for k in dist.keys))
+            else:
+                dist = ANY
+        return node, dist
+
+    # ---- aggregation --------------------------------------------------
+    def _visit_aggregate(self, node: P.Aggregate):
+        src, dist = self.visit(node.source)
+        node.source = src
+        if dist.kind == "replicated":
+            return node, REPLICATED
+        if dist.kind == "hashed" and node.group_keys and \
+                set(dist.keys) <= set(node.group_keys):
+            # co-located: every group entirely on one shard
+            return node, Dist("hashed", dist.keys)
+        has_distinct = any(a.distinct for a in node.aggs.values())
+        mergeable = all(a.fn in _MERGEABLE and not a.distinct
+                        for a in node.aggs.values())
+        cap = getattr(node, "capacity_hint", None)
+        small = cap is not None and cap <= self.partial_agg_groups
+        if node.group_keys and (has_distinct or not mergeable or not small):
+            # repartition rows so each group lands wholly on one shard,
+            # then aggregate locally in a single phase (handles DISTINCT
+            # and non-decomposable aggregates for free)
+            node.source = P.Exchange(src, "repartition", list(node.group_keys))
+            return node, Dist("hashed", tuple(node.group_keys))
+        if not mergeable:
+            raise Undistributable(
+                f"global aggregate with non-mergeable fns "
+                f"{[a.fn for a in node.aggs.values()]}")
+        return self._split_partial_final(node, src)
+
+    def _split_partial_final(self, node: P.Aggregate, src: P.PlanNode):
+        """partial agg per shard -> gather -> final merge (the reference's
+        PARTIAL/FINAL AggregationNode pair around a repartition,
+        AddExchanges.java:239; here the combine is a gather because the
+        partial output is tiny — <= partial_aggregation_max_groups rows)."""
+        partial_aggs = {}
+        final_aggs = {}
+        for sym, a in node.aggs.items():
+            fn = a.fn
+            if fn in ("count", "count_if"):
+                p = self.fresh(sym)
+                partial_aggs[p] = a
+                final_aggs[sym] = ir.AggCall("merge_count", (ir.Ref(p, T.BIGINT),),
+                                             a.type)
+            elif fn == "sum":
+                p = self.fresh(sym)
+                partial_aggs[p] = a
+                final_aggs[sym] = ir.AggCall("sum", (ir.Ref(p, a.type),), a.type)
+            elif fn in ("min", "max", "bool_and", "every", "bool_or",
+                        "arbitrary", "any_value"):
+                p = self.fresh(sym)
+                partial_aggs[p] = a
+                final_aggs[sym] = ir.AggCall(a.fn, (ir.Ref(p, a.type),), a.type)
+            elif fn == "avg":
+                ps = self.fresh(sym + "_s")
+                pc = self.fresh(sym + "_c")
+                partial_aggs[ps] = ir.AggCall("partial_sum_double", a.args,
+                                              T.DOUBLE, False, a.filter)
+                partial_aggs[pc] = ir.AggCall("count", a.args, T.BIGINT,
+                                              False, a.filter)
+                final_aggs[sym] = ir.AggCall(
+                    "merge_avg", (ir.Ref(ps, T.DOUBLE), ir.Ref(pc, T.BIGINT)),
+                    T.DOUBLE)
+            else:  # stddev/variance family
+                s1 = self.fresh(sym + "_s1")
+                s2 = self.fresh(sym + "_s2")
+                pc = self.fresh(sym + "_c")
+                partial_aggs[s1] = ir.AggCall("partial_sum_double", a.args,
+                                              T.DOUBLE, False, a.filter)
+                partial_aggs[s2] = ir.AggCall("partial_sum_sq_double", a.args,
+                                              T.DOUBLE, False, a.filter)
+                partial_aggs[pc] = ir.AggCall("count", a.args, T.BIGINT,
+                                              False, a.filter)
+                final_aggs[sym] = ir.AggCall(
+                    f"merge_{fn}",
+                    (ir.Ref(s1, T.DOUBLE), ir.Ref(s2, T.DOUBLE),
+                     ir.Ref(pc, T.BIGINT)), T.DOUBLE)
+        partial = P.Aggregate(src, list(node.group_keys), partial_aggs, "PARTIAL")
+        partial.capacity_hint = getattr(node, "capacity_hint", None)
+        partial.key_stats = getattr(node, "key_stats", {})
+        gathered = P.Exchange(partial, "gather")
+        final = P.Aggregate(gathered, list(node.group_keys), final_aggs, "FINAL")
+        final.capacity_hint = getattr(node, "capacity_hint", None)
+        final.key_stats = getattr(node, "key_stats", {})
+        return final, REPLICATED
+
+    # ---- joins --------------------------------------------------------
+    def _visit_join(self, node: P.Join):
+        left, ldist = self.visit(node.left)
+        right, rdist = self.visit(node.right)
+        node.left, node.right = left, right
+        jt = node.join_type
+
+        if ldist.kind == "replicated" and rdist.kind == "replicated":
+            return node, REPLICATED
+
+        if jt in ("RIGHT", "FULL"):
+            # executed as a mirrored probe; correctness needs both sides
+            # whole — gather (rare in practice; distributed FULL later)
+            node.left = self._to_replicated(left, ldist)
+            node.right = self._to_replicated(right, rdist)
+            return node, REPLICATED
+
+        if jt == "CROSS":
+            if rdist.kind != "replicated":
+                node.right = P.Exchange(right, "broadcast")
+            if ldist.kind == "replicated":
+                return node, REPLICATED
+            return node, ANY
+
+        lkeys = [lk for lk, _ in node.criteria]
+        rkeys = [rk for _, rk in node.criteria]
+
+        # probe replicated + build sharded: each probe row would match on
+        # every shard; make the build side whole instead (small by stats)
+        if ldist.kind == "replicated":
+            node.right = self._to_replicated(right, rdist)
+            return node, REPLICATED
+
+        build_rows = self._estimated_rows(node.right)
+        broadcast_ok = (rdist.kind == "replicated"
+                        or (build_rows is not None
+                            and build_rows <= self.broadcast_rows))
+        colocated = (ldist.kind == "hashed" and rdist.kind == "hashed"
+                     and len(ldist.keys) == len(rdist.keys)
+                     and list(ldist.keys) == lkeys[: len(ldist.keys)]
+                     and list(rdist.keys) == rkeys[: len(rdist.keys)])
+        if colocated:
+            out_dist = Dist("hashed", ldist.keys)
+            return node, out_dist
+        if broadcast_ok and node.distribution != "PARTITIONED":
+            if rdist.kind != "replicated":
+                node.right = P.Exchange(right, "broadcast")
+            # probe side keeps its distribution
+            return node, ldist
+        # P1: repartition both sides on the join keys
+        node.left = P.Exchange(left, "repartition", lkeys)
+        node.right = P.Exchange(right, "repartition", rkeys)
+        if rdist.kind == "replicated":
+            # replicated build must be scattered first or every shard
+            # contributes a duplicate copy of each row to the exchange
+            node.right = P.Exchange(P.Exchange(right, "scatter"),
+                                    "repartition", rkeys)
+        return node, Dist("hashed", tuple(lkeys))
+
+    def _to_replicated(self, node: P.PlanNode, dist: Dist) -> P.PlanNode:
+        return node if dist.kind == "replicated" else P.Exchange(node, "gather")
+
+    def _estimated_rows(self, node: P.PlanNode) -> Optional[int]:
+        try:
+            from presto_tpu.plan import stats as S
+
+            return S.derive(node, self.session.catalog).rows
+        except Exception:
+            return None
+
+    # ---- order/limit/misc --------------------------------------------
+    def _visit_sort(self, node: P.Sort):
+        src, dist = self.visit(node.source)
+        node.source = self._to_replicated(src, dist)
+        return node, REPLICATED
+
+    def _visit_topn(self, node: P.TopN):
+        src, dist = self.visit(node.source)
+        if dist.kind == "replicated":
+            node.source = src
+            return node, REPLICATED
+        # local top-N per shard, then gather + final top-N: the
+        # distributed-sort pattern (partial sort + MergeOperator,
+        # SURVEY.md P11) with N small enough to replicate
+        local = P.TopN(src, list(node.keys), node.count)
+        node.source = P.Exchange(local, "gather")
+        return node, REPLICATED
+
+    def _visit_limit(self, node: P.Limit):
+        src, dist = self.visit(node.source)
+        if dist.kind == "replicated":
+            node.source = src
+            return node, REPLICATED
+        local = P.Limit(src, node.count)
+        node.source = P.Exchange(local, "gather")
+        return node, REPLICATED
+
+    def _visit_union(self, node: P.Union):
+        new_sources = []
+        for s in node.sources_:
+            src, dist = self.visit(s)
+            if dist.kind == "replicated":
+                src = P.Exchange(src, "scatter")
+            new_sources.append(src)
+        node.sources_ = new_sources
+        if node.distinct:
+            raise Undistributable("UNION DISTINCT")  # planner lowers it to agg
+        return node, ANY
+
+    def _visit_window(self, node: P.Window):
+        src, dist = self.visit(node.source)
+        node.source = self._to_replicated(src, dist)
+        return node, REPLICATED
+
+    def _visit_exchange(self, node: P.Exchange):
+        src, _ = self.visit(node.source)
+        node.source = src
+        return node, REPLICATED if node.kind in ("gather", "broadcast") else ANY

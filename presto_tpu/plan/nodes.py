@@ -201,6 +201,30 @@ class Window(PlanNode):
 
 
 @dataclass
+class Exchange(PlanNode):
+    """Data-movement boundary between distributions (reference:
+    sql/planner/plan/ExchangeNode.java — REPARTITION/REPLICATE/GATHER
+    over REMOTE_STREAMING scope).  On TPU these lower to collectives
+    inside one shard_mapped program instead of HTTP shuffles:
+    repartition -> lax.all_to_all on row-hash buckets (P1),
+    broadcast   -> lax.all_gather (P2),
+    gather      -> lax.all_gather to full replication (P5),
+    scatter     -> replicated input masked to one shard (inverse of P2,
+                   used to feed replicated rows into a sharded union)."""
+
+    source: PlanNode
+    kind: str = "gather"  # repartition | broadcast | gather | scatter
+    keys: List[str] = field(default_factory=list)  # hash keys (repartition)
+
+    def outputs(self):
+        return self.source.outputs()
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
 class Output(PlanNode):
     source: PlanNode
     names: List[str] = field(default_factory=list)  # user-visible column names
@@ -257,6 +281,8 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
         detail = f" {len(node.rows)} rows"
     elif isinstance(node, Window):
         detail = f" partition={node.partition_by} order={node.order_by}"
+    elif isinstance(node, Exchange):
+        detail = f" {node.kind}" + (f" keys={node.keys}" if node.keys else "")
     lines = [pad + name + detail]
     for s in node.sources:
         lines.append(plan_tree_str(s, indent + 1))

@@ -144,6 +144,9 @@ def _derive(node, catalog, memo) -> NodeStats:
         for sym in node.functions:
             cols[sym] = ColStats()
         return NodeStats(s.rows, cols, s.unique, s.fanout)
+    if isinstance(node, P.Exchange):
+        # exchanges move rows, they don't change global cardinality
+        return d(node.source)
     if isinstance(node, P.Output):
         s = d(node.source)
         return NodeStats(s.rows, s.cols, s.unique, s.fanout)

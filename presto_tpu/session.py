@@ -22,6 +22,12 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "task_concurrency": 1,
     "agg_capacity_hint": 0,  # 0 = derive from input size
     "optimizer_enabled": True,
+    "execution_mode": "auto",  # auto | compiled | dynamic
+    # distributed execution over the device mesh (parallel/dist_executor.py)
+    "distributed": False,
+    "mesh_devices": 0,  # 0 = all local devices
+    "broadcast_join_threshold_rows": 1_000_000,  # DetermineJoinDistributionType
+    "partial_aggregation_max_groups": 8192,  # partial+gather vs repartition agg
 }
 
 
