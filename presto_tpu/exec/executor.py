@@ -250,6 +250,11 @@ class Executor:
             raise ExecutionError(f"no executor for {type(node).__name__}")
         return method(node)
 
+    def _exec_window(self, node: P.Window) -> Batch:
+        from presto_tpu.exec.window import execute_window
+
+        return execute_window(self, node)
+
     # ---- leaves ------------------------------------------------------
     def _exec_tablescan(self, node: P.TableScan) -> Batch:
         if self.scan_inputs is not None:

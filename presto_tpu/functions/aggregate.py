@@ -84,3 +84,20 @@ WINDOW_ONLY = {"row_number", "rank", "dense_rank", "ntile", "lag", "lead",
 def is_window(name: str) -> bool:
     n = name.lower()
     return n in WINDOW_ONLY or is_aggregate(n)
+
+
+def resolve_window(name: str, arg_types: List[T.Type]) -> T.Type:
+    """Return type of a window function call (reference: the
+    WindowFunctionSupplier signatures in operator/window/)."""
+    n = name.lower()
+    if n in ("row_number", "rank", "dense_rank", "ntile"):
+        return T.BIGINT
+    if n in ("percent_rank", "cume_dist"):
+        return T.DOUBLE
+    if n in ("lag", "lead", "first_value", "last_value", "nth_value"):
+        if not arg_types:
+            raise KeyError(f"{name} requires an argument")
+        return arg_types[0]
+    if is_aggregate(n):
+        return resolve(n, arg_types)
+    raise KeyError(f"unknown window function: {name}")

@@ -93,47 +93,78 @@ def run_distributed(session, text: str, stmt):
         raise Undistributable("static assumptions previously violated")
 
     if entry is None:
-        mesh = make_mesh(ndev)
-        plan = X.plan_statement(session, stmt)
-        dplan = distribute(plan, session, ndev)
-        for sub in dplan.subplans.values():
-            t = next(iter(dict(sub.outputs()).values()))
-            if t.is_string:
-                raise Undistributable("string-valued scalar subquery")
-        scan_nodes: List[P.TableScan] = []
-        X._collect_tablescans(dplan.root, scan_nodes)
-        for sub in sorted(dplan.subplans):
-            X._collect_tablescans(dplan.subplans[sub], scan_nodes)
+        try:
+            return _build_and_run(session, stmt, cache, key, ndev)
+        except Exception as e:
+            # memoize undistributable/untraceable shapes so re-executions
+            # skip the failed plan+distribute+trace (the runtime-guard path
+            # below already memoizes via "DYNAMIC")
+            from presto_tpu.exec.executor import StaticFallback
 
-        def fn(batches):
-            ex = DistExecutor(session, ndev,
-                              {id(n): b for n, b in zip(scan_nodes, batches)})
-            # scalar subqueries evaluated inside the same trace so float
-            # reduction order matches the main plan bit-for-bit
-            for pid in sorted(dplan.subplans):
-                sb = ex.exec_node(dplan.subplans[pid])
-                ex.ctx.scalar_results[pid] = _traced_single_value(sb, ex.guards)
-            out = ex.exec_node(dplan.root)
-            if ex.guards:
-                g = jnp.any(jnp.stack([jnp.asarray(x) for x in ex.guards]))
-            else:
-                g = jnp.zeros((), bool)
-            # any shard's violation aborts the whole query
-            g = jax.lax.psum(g.astype(jnp.int32), AXIS) > 0
-            return out, g
+            if isinstance(e, (Undistributable, StaticFallback,
+                              jax.errors.ConcretizationTypeError)):
+                cache[key] = "DYNAMIC"
+            raise
+    return _run_entry(session, cache, key, entry, ndev)
 
-        sharded = shard_map(fn, mesh=mesh, in_specs=(PS(AXIS),),
-                            out_specs=PS(), check_vma=False)
-        jitted = jax.jit(sharded)
-        batches = [sharded_scan(session.catalog.get(n.table), n, mesh, ndev)
-                   for n in scan_nodes]
-        out_batch, guard = jitted(batches)
-        cache[key] = (dplan, jitted, scan_nodes, mesh)
-    else:
-        dplan, jitted, scan_nodes, mesh = entry
-        batches = [sharded_scan(session.catalog.get(n.table), n, mesh, ndev)
-                   for n in scan_nodes]
-        out_batch, guard = jitted(batches)
+
+def _build_and_run(session, stmt, cache, key, ndev):
+    from presto_tpu.exec import executor as X
+
+    mesh = make_mesh(ndev)
+    plan = X.plan_statement(session, stmt)
+    dplan = distribute(plan, session, ndev)
+    for sub in dplan.subplans.values():
+        t = next(iter(dict(sub.outputs()).values()))
+        if t.is_string:
+            raise Undistributable("string-valued scalar subquery")
+    scan_nodes: List[P.TableScan] = []
+    X._collect_tablescans(dplan.root, scan_nodes)
+    for sub in sorted(dplan.subplans):
+        X._collect_tablescans(dplan.subplans[sub], scan_nodes)
+
+    def fn(batches):
+        ex = DistExecutor(session, ndev,
+                          {id(n): b for n, b in zip(scan_nodes, batches)})
+        # scalar subqueries evaluated inside the same trace so float
+        # reduction order matches the main plan bit-for-bit
+        for pid in sorted(dplan.subplans):
+            sb = ex.exec_node(dplan.subplans[pid])
+            ex.ctx.scalar_results[pid] = _traced_single_value(sb, ex.guards)
+        out = ex.exec_node(dplan.root)
+        if ex.guards:
+            g = jnp.any(jnp.stack([jnp.asarray(x) for x in ex.guards]))
+        else:
+            g = jnp.zeros((), bool)
+        # any shard's violation aborts the whole query
+        g = jax.lax.psum(g.astype(jnp.int32), AXIS) > 0
+        return out, g
+
+    sharded = shard_map(fn, mesh=mesh, in_specs=(PS(AXIS),),
+                        out_specs=PS(), check_vma=False)
+    jitted = jax.jit(sharded)
+    entry = (dplan, jitted, scan_nodes, mesh)
+    # trace/compile before caching so failures propagate to the caller
+    out_batch, guard = jitted(
+        [sharded_scan(session.catalog.get(n.table), n, mesh, ndev)
+         for n in scan_nodes])
+    cache[key] = entry
+    return _finish(session, cache, key, dplan, out_batch, guard)
+
+
+def _run_entry(session, cache, key, entry, ndev):
+    from presto_tpu.exec import executor as X  # noqa: F401
+
+    dplan, jitted, scan_nodes, mesh = entry
+    batches = [sharded_scan(session.catalog.get(n.table), n, mesh, ndev)
+               for n in scan_nodes]
+    out_batch, guard = jitted(batches)
+    return _finish(session, cache, key, dplan, out_batch, guard)
+
+
+def _finish(session, cache, key, dplan, out_batch, guard):
+    from presto_tpu.exec import executor as X
+
     if bool(guard):
         cache[key] = "DYNAMIC"
         raise Undistributable("static assumption violated at runtime")

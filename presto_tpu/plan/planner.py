@@ -184,6 +184,17 @@ class Planner:
             node = self._plan_where(node, select_scope, spec.having,
                                     agg_map=agg_map, group_map=group_map)
 
+        # window functions: plan one Window node per distinct
+        # (partition, order, frame) spec, evaluated after aggregation
+        # (reference: sql/planner/QueryPlanner.window + WindowNode)
+        win_calls: List[ast.FunctionCall] = []
+        for e in exprs_to_scan:
+            self._collect_windows(e, win_calls)
+        if win_calls:
+            node, win_map = self._plan_windows(
+                node, select_scope, win_calls, agg_map, group_map)
+            agg_map = {**(agg_map or {}), **win_map}
+
         # SELECT projections
         assignments: Dict[str, ir.RowExpr] = {}
         out_fields: List[Field_] = []
@@ -627,6 +638,71 @@ class Planner:
             if isinstance(child, (ast.Query, ast.QuerySpec)):
                 continue  # subquery boundaries
             self._collect_aggs(child, out)
+
+    def _collect_windows(self, e: ast.Expr, out: List[ast.FunctionCall]):
+        if isinstance(e, ast.FunctionCall) and e.window is not None:
+            out.append(e)
+            return  # window functions cannot nest
+        for child in e.children():
+            if isinstance(child, (ast.Query, ast.QuerySpec)):
+                continue
+            self._collect_windows(child, out)
+
+    def _plan_windows(self, node, scope, win_calls, agg_map, group_map):
+        """Attach partition/order/arg columns below, then one P.Window per
+        distinct spec; returns (node, {id(ast call) -> (symbol, type)})."""
+        pre = {s: ir.Ref(s, t) for s, t in node.outputs()}
+
+        def to_sym(e_ast):
+            rex = self.analyze(e_ast, scope, agg_map=agg_map, group_map=group_map)
+            if isinstance(rex, ir.Ref) and rex.name in pre:
+                return rex.name, rex.type
+            s = self.symbols.new("winkey")
+            pre[s] = rex
+            return s, rex.type
+
+        planned = []
+        for fc in win_calls:
+            w = fc.window
+            part = tuple(to_sym(p)[0] for p in w.partition_by)
+            order = tuple((to_sym(si.expr)[0], si.ascending, si.nulls_first)
+                          for si in w.order_by)
+            args = []
+            for a_ast in fc.args:
+                rex = self.analyze(a_ast, scope, agg_map=agg_map, group_map=group_map)
+                if isinstance(rex, ir.Lit):
+                    args.append(rex)
+                elif isinstance(rex, ir.Ref) and rex.name in pre:
+                    args.append(rex)
+                else:
+                    s2 = self.symbols.new("winarg")
+                    pre[s2] = rex
+                    args.append(ir.Ref(s2, rex.type))
+            planned.append((fc, part, order, w.frame, tuple(args)))
+
+        node = P.Project(node, pre)
+        win_map: Dict[int, Tuple[str, T.Type]] = {}
+        groups: Dict[tuple, list] = {}
+        for fc, part, order, frame, args in planned:
+            groups.setdefault((part, order, frame), []).append((fc, args))
+        for (part, order, frame), calls in groups.items():
+            fns: Dict[str, ir.AggCall] = {}
+            for fc, args in calls:
+                if fc.distinct:
+                    raise SemanticError(
+                        f"DISTINCT not supported in window function {fc.name}")
+                if fc.filter is not None:
+                    raise SemanticError(
+                        f"FILTER not supported in window function {fc.name}")
+                try:
+                    rt = agg_fns.resolve_window(fc.name, [a.type for a in args])
+                except KeyError as e:
+                    raise SemanticError(str(e.args[0])) from None
+                s = self.symbols.new(fc.name)
+                fns[s] = ir.AggCall(fc.name.lower(), args, rt, fc.distinct, None)
+                win_map[id(fc)] = (s, rt)
+            node = P.Window(node, list(part), list(order), fns, frame)
+        return node, win_map
 
     def _plan_aggregation(self, node, scope, group_by, agg_calls, outer):
         pre_assigns = {s: ir.Ref(s, t) for s, t in node.outputs()}

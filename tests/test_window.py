@@ -1,0 +1,131 @@
+"""Window function differential tests vs the sqlite oracle (sqlite >= 3.25
+implements SQL window functions; reference analog: TestWindow* suites in
+presto-main/src/test and AbstractTestWindowQueries in presto-tests)."""
+
+import pytest
+
+import presto_tpu
+from tests.sqlite_oracle import assert_same_results, to_sqlite
+
+WINDOW_QUERIES = {
+    "row_number": (
+        "SELECT o_orderkey, row_number() OVER (ORDER BY o_orderkey) rn "
+        "FROM orders ORDER BY o_orderkey LIMIT 50"),
+    "rank_partition": (
+        "SELECT o_custkey, o_totalprice, "
+        "rank() OVER (PARTITION BY o_custkey ORDER BY o_totalprice DESC) rk "
+        "FROM orders ORDER BY o_custkey, rk, o_totalprice LIMIT 100"),
+    "dense_rank": (
+        "SELECT o_orderpriority, o_orderkey, "
+        "dense_rank() OVER (PARTITION BY o_orderpriority ORDER BY o_shippriority) dr "
+        "FROM orders ORDER BY o_orderpriority, o_orderkey LIMIT 100"),
+    "percent_cume": (
+        "SELECT c_custkey, "
+        "percent_rank() OVER (PARTITION BY c_nationkey ORDER BY c_acctbal) pr, "
+        "cume_dist() OVER (PARTITION BY c_nationkey ORDER BY c_acctbal) cd "
+        "FROM customer ORDER BY c_custkey LIMIT 100"),
+    "ntile": (
+        "SELECT o_orderkey, ntile(7) OVER (ORDER BY o_orderkey) t "
+        "FROM orders ORDER BY o_orderkey LIMIT 200"),
+    "running_sum": (
+        "SELECT o_custkey, o_orderkey, "
+        "sum(o_totalprice) OVER (PARTITION BY o_custkey ORDER BY o_orderkey) rs "
+        "FROM orders ORDER BY o_custkey, o_orderkey LIMIT 100"),
+    "running_count_avg": (
+        "SELECT o_custkey, o_orderkey, "
+        "count(*) OVER (PARTITION BY o_custkey ORDER BY o_orderkey) c, "
+        "avg(o_totalprice) OVER (PARTITION BY o_custkey ORDER BY o_orderkey) a "
+        "FROM orders ORDER BY o_custkey, o_orderkey LIMIT 100"),
+    "whole_partition_agg": (
+        "SELECT c_custkey, c_acctbal, "
+        "max(c_acctbal) OVER (PARTITION BY c_nationkey) mx, "
+        "min(c_acctbal) OVER (PARTITION BY c_nationkey) mn "
+        "FROM customer ORDER BY c_custkey LIMIT 100"),
+    "lag_lead": (
+        "SELECT o_custkey, o_orderkey, "
+        "lag(o_totalprice) OVER (PARTITION BY o_custkey ORDER BY o_orderkey) lg, "
+        "lead(o_totalprice) OVER (PARTITION BY o_custkey ORDER BY o_orderkey) ld "
+        "FROM orders ORDER BY o_custkey, o_orderkey LIMIT 100"),
+    "lag_offset_default": (
+        "SELECT o_orderkey, "
+        "lag(o_totalprice, 2, 0.0) OVER (ORDER BY o_orderkey) lg2 "
+        "FROM orders ORDER BY o_orderkey LIMIT 50"),
+    "first_last_value": (
+        "SELECT o_custkey, o_orderkey, "
+        "first_value(o_totalprice) OVER (PARTITION BY o_custkey ORDER BY o_orderkey) fv, "
+        "last_value(o_totalprice) OVER (PARTITION BY o_custkey ORDER BY o_orderkey) lv "
+        "FROM orders ORDER BY o_custkey, o_orderkey LIMIT 100"),
+    "rows_frame_sum": (
+        "SELECT o_orderkey, sum(o_totalprice) OVER "
+        "(ORDER BY o_orderkey ROWS BETWEEN 2 PRECEDING AND 1 FOLLOWING) s "
+        "FROM orders ORDER BY o_orderkey LIMIT 50"),
+    "rows_frame_minmax": (
+        "SELECT o_orderkey, "
+        "min(o_totalprice) OVER (ORDER BY o_orderkey ROWS BETWEEN 3 PRECEDING AND 2 FOLLOWING) mn, "
+        "max(o_totalprice) OVER (ORDER BY o_orderkey ROWS BETWEEN 3 PRECEDING AND 2 FOLLOWING) mx "
+        "FROM orders ORDER BY o_orderkey LIMIT 80"),
+    "unbounded_following": (
+        "SELECT o_custkey, o_orderkey, sum(o_totalprice) OVER "
+        "(PARTITION BY o_custkey ORDER BY o_orderkey "
+        "ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) s "
+        "FROM orders ORDER BY o_custkey, o_orderkey LIMIT 100"),
+    "window_over_group_agg": (
+        "SELECT c_nationkey, count(*) cnt, "
+        "rank() OVER (ORDER BY count(*) DESC, c_nationkey) rk "
+        "FROM customer GROUP BY c_nationkey ORDER BY rk"),
+    "multiple_specs": (
+        "SELECT o_orderkey, "
+        "row_number() OVER (ORDER BY o_orderkey) rn, "
+        "rank() OVER (PARTITION BY o_custkey ORDER BY o_totalprice) rk "
+        "FROM orders ORDER BY o_orderkey LIMIT 60"),
+    "string_minmax_window": (
+        "SELECT c_custkey, max(c_mktsegment) OVER (PARTITION BY c_nationkey) m "
+        "FROM customer ORDER BY c_custkey LIMIT 100"),
+    "expr_args_and_keys": (
+        "SELECT o_orderkey, sum(o_totalprice * 2.0) OVER "
+        "(PARTITION BY o_custkey % 10 ORDER BY o_orderkey) s "
+        "FROM orders ORDER BY o_orderkey LIMIT 60"),
+}
+
+
+@pytest.fixture(scope="module")
+def session(tpch_catalog_tiny):
+    return presto_tpu.connect(tpch_catalog_tiny)
+
+
+@pytest.mark.parametrize("name", sorted(WINDOW_QUERIES))
+def test_window_query(name, session, tpch_sqlite_tiny):
+    sql = WINDOW_QUERIES[name]
+    actual = session.sql(sql)
+    expected = tpch_sqlite_tiny.execute(to_sqlite(sql)).fetchall()
+    assert_same_results(actual.rows, expected, ordered=True)
+
+
+def test_window_distinct_rejected(session):
+    from presto_tpu.plan.planner import SemanticError
+
+    with pytest.raises(SemanticError):
+        session.sql("SELECT count(DISTINCT o_orderpriority) OVER () FROM orders")
+
+
+def test_window_filter_rejected(session):
+    from presto_tpu.plan.planner import SemanticError
+
+    with pytest.raises(SemanticError):
+        session.sql("SELECT count(*) FILTER (WHERE o_custkey > 5) OVER () "
+                    "FROM orders")
+
+
+def test_distributed_window_failure_memoized(tpch_catalog_tiny):
+    """A query the distributed path cannot trace must be memoized as
+    DYNAMIC so re-runs skip the failed distribution attempt."""
+    import presto_tpu
+
+    s = presto_tpu.connect(tpch_catalog_tiny)
+    s.set("distributed", True)
+    sql = ("SELECT o_orderkey, row_number() OVER (ORDER BY o_orderkey) rn "
+           "FROM orders ORDER BY o_orderkey LIMIT 5")
+    r1 = s.sql(sql)
+    assert any(v == "DYNAMIC" for v in getattr(s, "_dist_cache", {}).values())
+    r2 = s.sql(sql)
+    assert r1.rows == r2.rows
