@@ -44,7 +44,24 @@ class StaticFallback(Exception):
 
 
 def execute_query(session, text: str) -> QueryResult:
-    stmt = parse(text)
+    """Query lifecycle wrapper: stats + events around the actual dispatch
+    (reference: SqlQueryManager.createQuery + QueryStateMachine +
+    QueryMonitor events, execution/SqlQueryManager.java:299)."""
+    from presto_tpu.observe.stats import QueryMonitor
+
+    mon = QueryMonitor.begin(session, text)
+    try:
+        with mon.phase("parse"):
+            stmt = parse(text)
+        result = _dispatch_statement(session, text, stmt, mon)
+        mon.finish(result)
+        return result
+    except BaseException as e:
+        mon.fail(e)
+        raise
+
+
+def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
     if isinstance(stmt, ast.SetSession):
         session.set(stmt.name, stmt.value)
         return QueryResult([("result", T.BOOLEAN)], [(True,)])
@@ -56,7 +73,10 @@ def execute_query(session, text: str) -> QueryResult:
         rows = [(c, str(ty)) for c, ty in t.schema.items()]
         return QueryResult([("Column", T.VARCHAR), ("Type", T.VARCHAR)], rows)
     if isinstance(stmt, ast.Explain):
-        text_plan = explain_text(session, stmt.statement)
+        if stmt.analyze:
+            text_plan = explain_analyze_text(session, stmt.statement, mon)
+        else:
+            text_plan = explain_text(session, stmt.statement)
         return QueryResult([("Query Plan", T.VARCHAR)], [(text_plan,)])
     if isinstance(stmt, ast.CreateTableAs):
         inner = execute_plan_to_host(session, ast.QueryStatement(stmt.query))
@@ -72,20 +92,27 @@ def execute_query(session, text: str) -> QueryResult:
         from presto_tpu.plan.distribute import Undistributable
 
         try:
-            return run_distributed(session, text, stmt)
+            with mon.phase("execute"):
+                mon.stats.execution_mode = "distributed"
+                return run_distributed(session, text, stmt)
         except (Undistributable, StaticFallback,
                 jax.errors.ConcretizationTypeError):
             pass  # single-device paths below
     mode = session.properties.get("execution_mode", "auto")
     if mode in ("auto", "compiled"):
         try:
-            return run_compiled(session, text, stmt)
+            with mon.phase("execute"):
+                mon.stats.execution_mode = "compiled"
+                return run_compiled(session, text, stmt)
         except (StaticFallback, jax.errors.ConcretizationTypeError) as e:
             if mode == "compiled":
                 raise StaticFallback(str(e)) from e
-    plan = plan_statement(session, stmt)
-    ex = Executor(session)
-    return ex.run(plan)
+    mon.stats.execution_mode = "dynamic"
+    with mon.phase("plan"):
+        plan = plan_statement(session, stmt)
+    with mon.phase("execute"):
+        ex = Executor(session, monitor=mon if mon.collect_node_stats else None)
+        return ex.run(plan)
 
 
 def _collect_tablescans(node: P.PlanNode, out: list):
@@ -192,20 +219,50 @@ def explain_text(session, stmt) -> str:
     return "\n".join(lines)
 
 
+def explain_analyze_text(session, stmt, mon) -> str:
+    """EXPLAIN ANALYZE: execute in dynamic mode with per-node stats, then
+    render the plan annotated with rows/time (reference:
+    ExplainAnalyzeOperator + PlanPrinter stats rendering)."""
+    from presto_tpu.observe.stats import annotated_plan
+
+    mon.stats.execution_mode = "dynamic"
+    with mon.phase("plan"):
+        plan = plan_statement(session, stmt)
+    with mon.phase("execute"):
+        ex = Executor(session, monitor=mon)
+        result = ex.run(plan)
+    mon.stats.output_rows = len(result)
+    return annotated_plan(plan.root, plan.subplans, mon.stats)
+
+
 def explain_query(session, text: str, analyze: bool = False) -> str:
     stmt = parse(text)
     if isinstance(stmt, ast.Explain):
+        analyze = analyze or stmt.analyze
         stmt = stmt.statement
+    if analyze:
+        from presto_tpu.observe.stats import QueryMonitor
+
+        mon = QueryMonitor.begin(session, text)
+        try:
+            text_plan = explain_analyze_text(session, stmt, mon)
+        except BaseException as e:
+            mon.fail(e)
+            raise
+        mon.finish(None)
+        return text_plan
     return explain_text(session, stmt)
 
 
 class Executor:
-    def __init__(self, session, static: bool = False, scan_inputs=None):
+    def __init__(self, session, static: bool = False, scan_inputs=None,
+                 monitor=None):
         self.session = session
         self.ctx = EvalContext()
         self.static = static  # compiled mode: no host syncs, static shapes
         self.scan_inputs = scan_inputs  # {node id: Batch} traced jit args
         self.guards = []  # traced bools: True => static assumption violated
+        self.monitor = monitor  # QueryMonitor collecting per-node stats
 
     # ------------------------------------------------------------------
     def run(self, plan: P.QueryPlan) -> QueryResult:
@@ -248,7 +305,18 @@ class Executor:
         method = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
-        return method(node)
+        if self.monitor is None:
+            return method(node)
+        # stats collection (reference: OperationTimer around every operator
+        # call, operator/Driver.java:380); the row count forces a device
+        # sync, which is why this is opt-in / EXPLAIN ANALYZE only
+        import time as _time
+
+        t0 = _time.perf_counter_ns()
+        b = method(node)
+        rows = int(b.row_count())
+        self.monitor.record_node(node, rows, _time.perf_counter_ns() - t0)
+        return b
 
     def _exec_window(self, node: P.Window) -> Batch:
         from presto_tpu.exec.window import execute_window

@@ -1,0 +1,14 @@
+"""Observability: query/operator stats, events, EXPLAIN ANALYZE.
+
+Reference parity: the metrics pipeline of SURVEY.md §5 — OperatorStats/
+QueryStats recorded around every operator call (operator/Driver.java:380),
+QueryMonitor events to pluggable EventListeners (event/QueryMonitor.java),
+and EXPLAIN ANALYZE rendering (operator/ExplainAnalyzeOperator.java).
+"""
+
+from presto_tpu.observe.stats import NodeStats, QueryMonitor, QueryStats
+from presto_tpu.observe.events import (EventListener, QueryCompletedEvent,
+                                       QueryCreatedEvent)
+
+__all__ = ["NodeStats", "QueryMonitor", "QueryStats", "EventListener",
+           "QueryCreatedEvent", "QueryCompletedEvent"]

@@ -1,0 +1,47 @@
+"""Query event pipeline.
+
+Reference parity: presto-spi/.../spi/eventlistener/ (QueryCreatedEvent,
+QueryCompletedEvent, EventListener) dispatched by event/QueryMonitor.java;
+manager eventlistener/EventListenerManager.java.  Listeners registered on
+the Session receive created/completed events — the hook for query logs,
+audit, and external metrics sinks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class QueryCreatedEvent:
+    query_id: str
+    sql: str
+    create_time: float  # unix seconds
+
+
+@dataclasses.dataclass
+class QueryCompletedEvent:
+    query_id: str
+    sql: str
+    state: str  # FINISHED | FAILED
+    stats: "QueryStats"  # noqa: F821  (observe.stats)
+    error: Optional[str] = None
+
+
+class EventListener:
+    """Subclass and override; register via Session.add_event_listener."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        pass
+
+
+def dispatch(listeners, method: str, event) -> None:
+    for lis in listeners:
+        try:
+            getattr(lis, method)(event)
+        except Exception:
+            pass  # listener failures never fail the query (reference behavior)

@@ -1,0 +1,153 @@
+"""Query + per-operator statistics.
+
+Reference parity: execution/QueryStats.java + operator/OperatorStats.java
+(recorded by OperationTimer around every getOutput/addInput,
+operator/Driver.java:380) and the query lifecycle states of
+QueryStateMachine (execution/QueryStateMachine.java: QUEUED → PLANNING →
+RUNNING → FINISHED/FAILED).  Per-node stats are collected in dynamic
+execution; compiled/distributed execution reports fragment-level timings
+(the whole plan is one fused XLA program — there is no per-operator
+boundary at runtime, which is the point of the design).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+_query_ids = itertools.count(1)
+
+
+@dataclasses.dataclass
+class NodeStats:
+    """Per-plan-node runtime stats (reference: OperatorStats)."""
+
+    node_kind: str = ""
+    rows_out: int = 0
+    wall_ns: int = 0
+    invocations: int = 0
+
+
+@dataclasses.dataclass
+class QueryStats:
+    """Reference: execution/QueryStats.java, trimmed to the engine's
+    phases; phase_ns keys: parse, plan, execute (plan includes analysis
+    + optimization; execute includes any XLA compile)."""
+
+    query_id: str = ""
+    sql: str = ""
+    state: str = "QUEUED"
+    create_time: float = 0.0
+    end_time: float = 0.0
+    phase_ns: Dict[str, int] = dataclasses.field(default_factory=dict)
+    execution_mode: str = ""  # dynamic | compiled | distributed
+    output_rows: int = 0
+    error: Optional[str] = None
+    # id(plan node) -> NodeStats; populated in dynamic mode
+    node_stats: Dict[int, NodeStats] = dataclasses.field(default_factory=dict)
+
+    @property
+    def total_ns(self) -> int:
+        return sum(self.phase_ns.values())
+
+    def summary(self) -> str:
+        ph = ", ".join(f"{k}={v / 1e6:.1f}ms" for k, v in self.phase_ns.items())
+        return (f"[{self.query_id}] {self.state} mode={self.execution_mode} "
+                f"rows={self.output_rows} {ph}")
+
+
+class QueryMonitor:
+    """Tracks one query execution: phase timings, node stats, events
+    (reference: QueryStateMachine + event/QueryMonitor.java)."""
+
+    def __init__(self, session, sql: str):
+        self.session = session
+        self.stats = QueryStats(
+            query_id=f"q_{next(_query_ids)}",
+            sql=sql,
+            create_time=time.time(),
+        )
+        self.collect_node_stats = bool(
+            session.properties.get("collect_node_stats", False))
+
+    @classmethod
+    def begin(cls, session, sql: str):
+        from presto_tpu.observe.events import QueryCreatedEvent, dispatch
+
+        mon = cls(session, sql)
+        session.history.append(mon.stats)
+        dispatch(session.event_listeners, "query_created",
+                 QueryCreatedEvent(mon.stats.query_id, sql,
+                                   mon.stats.create_time))
+        return mon
+
+    @contextmanager
+    def phase(self, name: str):
+        self.stats.state = {"parse": "PLANNING", "plan": "PLANNING",
+                            "execute": "RUNNING"}.get(name, "RUNNING")
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.stats.phase_ns[name] = (
+                self.stats.phase_ns.get(name, 0) + time.perf_counter_ns() - t0)
+
+    def record_node(self, node, rows_out: int, wall_ns: int) -> None:
+        ns = self.stats.node_stats.setdefault(
+            id(node), NodeStats(node_kind=type(node).__name__))
+        ns.rows_out = rows_out
+        ns.wall_ns += wall_ns
+        ns.invocations += 1
+
+    def finish(self, result) -> None:
+        from presto_tpu.observe.events import QueryCompletedEvent, dispatch
+
+        self.stats.state = "FINISHED"
+        self.stats.end_time = time.time()
+        if not self.stats.output_rows:  # EXPLAIN ANALYZE pre-sets the
+            try:                        # analyzed query's count; keep it
+                self.stats.output_rows = len(result)
+            except TypeError:
+                pass
+        dispatch(self.session.event_listeners, "query_completed",
+                 QueryCompletedEvent(self.stats.query_id, self.stats.sql,
+                                     "FINISHED", self.stats))
+
+    def fail(self, error: BaseException) -> None:
+        from presto_tpu.observe.events import QueryCompletedEvent, dispatch
+
+        self.stats.state = "FAILED"
+        self.stats.end_time = time.time()
+        self.stats.error = f"{type(error).__name__}: {error}"
+        dispatch(self.session.event_listeners, "query_completed",
+                 QueryCompletedEvent(self.stats.query_id, self.stats.sql,
+                                     "FAILED", self.stats, self.stats.error))
+
+
+def annotated_plan(plan_root, subplans, stats: QueryStats) -> str:
+    """EXPLAIN ANALYZE rendering: the logical plan with per-node rows and
+    wall time (reference: PlanPrinter.textDistributedPlan with stats,
+    fed by ExplainAnalyzeOperator)."""
+    from presto_tpu.plan.nodes import plan_tree_str
+
+    def annotate(node):
+        ns = stats.node_stats.get(id(node))
+        if ns is None:
+            return ""
+        # recorded walls are inclusive of children; report self time
+        child = sum(stats.node_stats[id(c)].wall_ns for c in node.sources
+                    if id(c) in stats.node_stats)
+        excl = max(ns.wall_ns - child, 0)
+        return f"   <- rows={ns.rows_out} time={excl / 1e6:.2f}ms"
+
+    lines = [plan_tree_str(plan_root, annotate=annotate)]
+    for pid, sub in sorted(subplans.items()):
+        lines.append(f"\nSubplan {pid}:")
+        lines.append(plan_tree_str(sub, 1, annotate=annotate))
+    ph = ", ".join(f"{k}: {v / 1e6:.1f}ms" for k, v in stats.phase_ns.items())
+    lines.append(f"\nQuery {stats.query_id}: {ph}; output rows: "
+                 f"{stats.output_rows}")
+    return "\n".join(lines)

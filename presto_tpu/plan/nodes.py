@@ -252,9 +252,10 @@ class QueryPlan:
     subplans: Dict[int, PlanNode] = field(default_factory=dict)
 
 
-def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
+def plan_tree_str(node: PlanNode, indent: int = 0, annotate=None) -> str:
     """EXPLAIN-style textual plan (reference: textLogicalPlan in
-    sql/planner/planPrinter/PlanPrinter.java)."""
+    sql/planner/planPrinter/PlanPrinter.java); annotate(node) -> suffix
+    string appends runtime stats for EXPLAIN ANALYZE."""
     pad = "  " * indent
     name = type(node).__name__
     detail = ""
@@ -283,7 +284,7 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
         detail = f" partition={node.partition_by} order={node.order_by}"
     elif isinstance(node, Exchange):
         detail = f" {node.kind}" + (f" keys={node.keys}" if node.keys else "")
-    lines = [pad + name + detail]
+    lines = [pad + name + detail + (annotate(node) if annotate else "")]
     for s in node.sources:
-        lines.append(plan_tree_str(s, indent + 1))
+        lines.append(plan_tree_str(s, indent + 1, annotate))
     return "\n".join(lines)

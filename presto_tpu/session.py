@@ -28,6 +28,9 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "mesh_devices": 0,  # 0 = all local devices
     "broadcast_join_threshold_rows": 1_000_000,  # DetermineJoinDistributionType
     "partial_aggregation_max_groups": 8192,  # partial+gather vs repartition agg
+    # per-plan-node stats collection in dynamic mode (forced by EXPLAIN
+    # ANALYZE; costs one host sync per operator — reference: OperationTimer)
+    "collect_node_stats": False,
 }
 
 
@@ -53,17 +56,31 @@ class QueryResult:
 
 class Session:
     def __init__(self, catalog=None, properties: Optional[Dict[str, Any]] = None):
+        import collections
+
         from presto_tpu.catalog import Catalog
 
         self.catalog = catalog if catalog is not None else Catalog()
         self.properties = dict(DEFAULT_SESSION_PROPERTIES)
         if properties:
             self.properties.update(properties)
+        # query introspection + event pipeline (reference: QueryTracker
+        # bounded history + eventlistener/EventListenerManager)
+        self.history = collections.deque(maxlen=1000)
+        self.event_listeners: list = []
 
     def set(self, name: str, value) -> None:
         if name not in self.properties:
             raise KeyError(f"unknown session property: {name}")
         self.properties[name] = value
+
+    def add_event_listener(self, listener) -> None:
+        self.event_listeners.append(listener)
+
+    @property
+    def last_stats(self):
+        """QueryStats of the most recent query (reference: /v1/query)."""
+        return self.history[-1] if self.history else None
 
     def sql(self, text: str) -> QueryResult:
         from presto_tpu.exec.executor import execute_query
