@@ -111,7 +111,7 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
     with mon.phase("plan"):
         plan = plan_statement(session, stmt)
     with mon.phase("execute"):
-        ex = Executor(session, monitor=mon if mon.collect_node_stats else None)
+        ex = Executor(session, monitor=mon)
         return ex.run(plan)
 
 
@@ -226,12 +226,14 @@ def explain_analyze_text(session, stmt, mon) -> str:
     from presto_tpu.observe.stats import annotated_plan
 
     mon.stats.execution_mode = "dynamic"
+    mon.collect_node_stats = True  # ANALYZE implies per-node stats
     with mon.phase("plan"):
         plan = plan_statement(session, stmt)
     with mon.phase("execute"):
         ex = Executor(session, monitor=mon)
         result = ex.run(plan)
     mon.stats.output_rows = len(result)
+    mon.rows_preset = True  # finish() must not overwrite with the 1-row plan text
     return annotated_plan(plan.root, plan.subplans, mon.stats)
 
 
@@ -256,18 +258,39 @@ def explain_query(session, text: str, analyze: bool = False) -> str:
 
 class Executor:
     def __init__(self, session, static: bool = False, scan_inputs=None,
-                 monitor=None):
+                 monitor=None, mem=None):
         self.session = session
         self.ctx = EvalContext()
         self.static = static  # compiled mode: no host syncs, static shapes
         self.scan_inputs = scan_inputs  # {node id: Batch} traced jit args
         self.guards = []  # traced bools: True => static assumption violated
         self.monitor = monitor  # QueryMonitor collecting per-node stats
+        # memory accounting: only for monitored (top-level) executions —
+        # helper executors (subplan eval, CTAS materialization) must not
+        # leave reservations behind, since only run() releases them
+        if mem is None and not static and monitor is not None:
+            from presto_tpu.memory import MemoryPool, QueryMemoryContext
+
+            pool_cap = int(session.properties.get("memory_pool_bytes", 16 << 30))
+            pool = getattr(session, "_memory_pool", None)
+            if pool is None:
+                pool = session._memory_pool = MemoryPool(pool_cap)
+            pool.capacity = pool_cap  # honor property changes mid-session
+            mem = QueryMemoryContext(
+                monitor.stats.query_id, pool,
+                int(session.properties.get("query_max_memory_bytes", 4 << 30)))
+        self.mem = mem
 
     # ------------------------------------------------------------------
     def run(self, plan: P.QueryPlan) -> QueryResult:
-        batch = self.evaluate(plan)
-        return self.materialize(plan, batch)
+        try:
+            batch = self.evaluate(plan)
+            return self.materialize(plan, batch)
+        finally:
+            if self.mem is not None:
+                if self.monitor is not None:
+                    self.monitor.stats.peak_memory_bytes = self.mem.peak
+                self.mem.release_all()
 
     def materialize(self, plan: P.QueryPlan, batch: Batch) -> QueryResult:
         out = plan.root
@@ -305,17 +328,28 @@ class Executor:
         method = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
-        if self.monitor is None:
+        node_stats = self.monitor is not None and self.monitor.collect_node_stats
+        if not node_stats and self.mem is None:
             return method(node)
-        # stats collection (reference: OperationTimer around every operator
-        # call, operator/Driver.java:380); the row count forces a device
-        # sync, which is why this is opt-in / EXPLAIN ANALYZE only
+        # node stats collection (reference: OperationTimer around every
+        # operator call, operator/Driver.java:380); the row count forces a
+        # device sync, which is why it is opt-in / EXPLAIN ANALYZE only
         import time as _time
+
+        from presto_tpu.memory.context import batch_bytes
 
         t0 = _time.perf_counter_ns()
         b = method(node)
-        rows = int(b.row_count())
-        self.monitor.record_node(node, rows, _time.perf_counter_ns() - t0)
+        if self.mem is not None:
+            # live-set accounting: a node's output is resident until the
+            # parent consumes it; child outputs die here (GC'd by Python,
+            # mirroring operator page hand-off in Driver.processInternal)
+            self.mem.set_bytes(id(node), batch_bytes(b))
+            for child in node.sources:
+                self.mem.set_bytes(id(child), 0)
+        if node_stats:
+            rows = int(b.row_count())
+            self.monitor.record_node(node, rows, _time.perf_counter_ns() - t0)
         return b
 
     def _exec_window(self, node: P.Window) -> Batch:
@@ -365,10 +399,117 @@ class Executor:
 
     # ---- aggregation -------------------------------------------------
     def _exec_aggregate(self, node: P.Aggregate) -> Batch:
+        from presto_tpu.memory.context import batch_bytes
+
         b = self.exec_node(node.source)
         if any(a.distinct for a in node.aggs.values()):
             return self._exec_aggregate_with_distinct(node, b)
+        # hash/agg state is ~2x its input in the worst case
+        if node.group_keys and self._should_spill(2 * batch_bytes(b),
+                                                  b.capacity):
+            holder = [b]
+            del b  # holder owns the only reference; grace path frees it
+            return self._aggregate_grouped(node, holder)
         return self._aggregate(b, node.group_keys, node.aggs, node)
+
+    # ---- spill / grouped execution -----------------------------------
+    def _should_spill(self, est_bytes: int, capacity: int) -> bool:
+        """Grouped execution trigger: the operator's estimated working set
+        would blow the query memory budget (reference:
+        MemoryRevokingScheduler threshold -> operator startMemoryRevoke;
+        here we decide BEFORE building)."""
+        if self.static or self.mem is None:
+            return False
+        if not self.session.properties.get("spill_enabled", True):
+            return False
+        trigger = int(self.session.properties.get("spill_trigger_rows", 0))
+        if trigger and capacity >= trigger:
+            return True
+        return self.mem.would_exceed(est_bytes)
+
+    def _make_spiller(self):
+        from presto_tpu.memory.spill import (FileSpiller, SpillSpaceTracker,
+                                             default_spill_dir)
+
+        path = self.session.properties.get("spill_path") or default_spill_dir()
+        tracker = getattr(self.session, "_spill_tracker", None)
+        if tracker is None:
+            tracker = self.session._spill_tracker = SpillSpaceTracker(
+                int(self.session.properties.get("max_spill_bytes", 64 << 30)))
+        return FileSpiller(path, tracker)
+
+    def _record_spill(self, spiller) -> None:
+        if self.monitor is not None:
+            self.monitor.stats.spilled_partitions += len(spiller.files)
+            self.monitor.stats.spilled_bytes += sum(s for _, s in spiller.files)
+
+    def _partition_spill(self, b: Batch, part: np.ndarray, spiller,
+                         nparts: int):
+        """Fan rows out to per-partition spill files by precomputed
+        partition id (reference: GenericPartitioningSpiller)."""
+        sel = np.asarray(b.sel)
+        return [spiller.spill(b.with_sel(jnp.asarray(sel & (part == p))))
+                for p in range(nparts)]
+
+    def _join_grouped(self, holder: list, node: P.Join) -> Batch:
+        """Grace hash join: both sides partitioned by join-key hash into
+        disjoint buckets processed one at a time — the probe-side analog
+        of the reference's spilled HashBuilderOperator + per-partition
+        PartitionedConsumption.  Correct for INNER/LEFT/FULL equi-joins:
+        every match pair lands in one bucket, and unmatched rows surface
+        exactly once (in their own bucket).  SEMI/ANTI stay unspilled —
+        their null-semantics can couple buckets.  `holder` carries the
+        sole references to the inputs so their device arrays free once
+        both sides are spilled."""
+        left, right = holder
+        holder.clear()
+        nparts = int(self.session.properties.get("spill_partition_count", 8))
+        lkeys = [left.columns[lk] for lk, _ in node.criteria]
+        rkeys = [right.columns[rk] for _, rk in node.criteria]
+        lkeys, rkeys = _unify_key_dictionaries(lkeys, rkeys)
+        lpart = np.asarray(K._hash_keys(lkeys, left.sel)) % nparts
+        rpart = np.asarray(K._hash_keys(rkeys, right.sel)) % nparts
+        spiller = self._make_spiller()
+        try:
+            lh = self._partition_spill(left, lpart, spiller, nparts)
+            rh = self._partition_spill(right, rpart, spiller, nparts)
+            self._record_spill(spiller)
+            # last references: inputs (and unified key copies) free now;
+            # table-scan columns stay alive in the catalog cache by design
+            del left, right, lkeys, rkeys
+            outs = []
+            for p in range(nparts):
+                lb = spiller.unspill(lh[p])
+                rb = spiller.unspill(rh[p])
+                outs.append(K.compact(self._join_batches(lb, rb, node)))
+            return K.concat_batches(outs)
+        finally:
+            spiller.close()
+
+    def _aggregate_grouped(self, node: P.Aggregate, holder: list) -> Batch:
+        """Bucket-at-a-time aggregation (P8 Lifespan analog): partition
+        by group-key hash, aggregate each bucket independently, concat —
+        groups never span buckets so no merge step is needed (reference:
+        SpillableHashAggregationBuilder's partition-merge, simplified by
+        hash-disjointness).  `holder` carries the sole reference to the
+        input batch so its device arrays free once spilled."""
+        b = holder.pop()
+        nparts = int(self.session.properties.get("spill_partition_count", 8))
+        spiller = self._make_spiller()
+        try:
+            part = np.asarray(K._hash_keys(
+                [b.columns[k] for k in node.group_keys], b.sel)) % nparts
+            handles = self._partition_spill(b, part, spiller, nparts)
+            self._record_spill(spiller)
+            del b  # last reference: device input frees; buckets stream back
+            outs = []
+            for h in handles:
+                pb = spiller.unspill(h)
+                outs.append(K.compact(
+                    self._aggregate(pb, node.group_keys, node.aggs, node)))
+            return K.concat_batches(outs)
+        finally:
+            spiller.close()
 
     def _exec_aggregate_with_distinct(self, node: P.Aggregate, b: Batch) -> Batch:
         """Rewrite: pre-group by (keys + distinct arg) then count non-null
@@ -575,15 +716,23 @@ class Executor:
 
     # ---- joins -------------------------------------------------------
     def _exec_join(self, node: P.Join) -> Batch:
+        from presto_tpu.memory.context import batch_bytes
+
         left = self.exec_node(node.left)
         right = self.exec_node(node.right)
-        jt = node.join_type
-        if jt == "RIGHT":
+        if node.join_type == "RIGHT":
             # RIGHT = mirrored LEFT with output order left-cols-first
-            mirrored = P.Join(node.right, node.left, "LEFT",
-                              [(rk, lk) for lk, rk in node.criteria], node.filter)
-            b = self._join_batches(right, left, mirrored)
-            return b
+            node = P.Join(node.right, node.left, "LEFT",
+                          [(rk, lk) for lk, rk in node.criteria], node.filter)
+            left, right = right, left
+        # join build+probe state is ~2x the inputs in the worst case
+        if (node.join_type in ("INNER", "LEFT", "FULL") and node.criteria
+                and self._should_spill(
+                    2 * (batch_bytes(left) + batch_bytes(right)),
+                    left.capacity + right.capacity)):
+            holder = [left, right]
+            del left, right  # holder owns the refs; grace path frees them
+            return self._join_grouped(holder, node)
         return self._join_batches(left, right, node)
 
     def _join_batches(self, left: Batch, right: Batch, node: P.Join) -> Batch:

@@ -1,0 +1,17 @@
+"""Memory management: hierarchical accounting, pools, spill.
+
+Reference parity: the 3-level scheme of SURVEY.md §5 — per-allocation
+LocalMemoryContext -> AggregatedMemoryContext trees
+(presto-memory-context/), per-node MemoryPool (memory/MemoryPool.java),
+and spilling under pressure (MemoryRevokingScheduler + spiller/).  On
+TPU the budgeted resource is HBM: operators account device-batch bytes
+against a query budget, and over-budget hash builds switch to grouped
+(bucket-at-a-time, P8 Lifespan analog) execution with host/disk spill.
+"""
+
+from presto_tpu.memory.context import (ExceededMemoryLimitError,
+                                       MemoryPool, QueryMemoryContext)
+from presto_tpu.memory.spill import FileSpiller, SpillSpaceTracker
+
+__all__ = ["ExceededMemoryLimitError", "MemoryPool", "QueryMemoryContext",
+           "FileSpiller", "SpillSpaceTracker"]

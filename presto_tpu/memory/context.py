@@ -1,0 +1,105 @@
+"""Hierarchical memory accounting.
+
+Reference parity: presto-memory-context (LocalMemoryContext /
+AggregatedMemoryContext user+revocable trees) + memory/MemoryPool.java.
+Simplified to the engine's execution model: one QueryMemoryContext per
+query tracking reserved/revocable bytes per plan node against a pool;
+exceeding the query limit raises (the reference blocks the driver or
+revokes; here revocable reservations signal the spillable operators to
+switch to grouped execution before the limit trips).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class ExceededMemoryLimitError(Exception):
+    """Reference: ExceededMemoryLimitException (presto-spi
+    StandardErrorCode EXCEEDED_LOCAL_MEMORY_LIMIT)."""
+
+
+class MemoryPool:
+    """Per-process pool shared by concurrent queries (reference:
+    memory/MemoryPool.java general pool; the reserved pool's
+    biggest-query promotion is a no-op with one process)."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = capacity_bytes
+        self.reserved = 0
+        self.query_reservations: Dict[str, int] = {}
+
+    def reserve(self, query_id: str, bytes_: int) -> None:
+        if self.reserved + bytes_ > self.capacity:
+            raise ExceededMemoryLimitError(
+                f"memory pool exhausted: {(self.reserved + bytes_) / 1e6:.1f}"
+                f"MB > {self.capacity / 1e6:.1f}MB "
+                f"({len(self.query_reservations)} queries resident)")
+        self.reserved += bytes_
+        self.query_reservations[query_id] = (
+            self.query_reservations.get(query_id, 0) + bytes_)
+
+    def free(self, query_id: str, bytes_: int) -> None:
+        self.reserved = max(0, self.reserved - bytes_)
+        cur = self.query_reservations.get(query_id, 0) - bytes_
+        if cur <= 0:
+            self.query_reservations.pop(query_id, None)
+        else:
+            self.query_reservations[query_id] = cur
+
+    @property
+    def free_bytes(self) -> int:
+        return self.capacity - self.reserved
+
+
+class QueryMemoryContext:
+    """Per-query accounting tree, flattened to {node id: bytes}
+    (reference: AggregatedMemoryContext per operator/driver/task/query)."""
+
+    def __init__(self, query_id: str, pool: MemoryPool, limit_bytes: int):
+        self.query_id = query_id
+        self.pool = pool
+        self.limit = limit_bytes
+        self.by_node: Dict[int, int] = {}
+        self.current = 0
+        self.peak = 0
+
+    def set_bytes(self, node_id: int, bytes_: int) -> None:
+        """Absolute reservation for one node (operators re-declare as
+        their state grows, like LocalMemoryContext.setBytes)."""
+        delta = bytes_ - self.by_node.get(node_id, 0)
+        if delta == 0:
+            return
+        if delta > 0:
+            if self.current + delta > self.limit:
+                raise ExceededMemoryLimitError(
+                    f"query {self.query_id} exceeded memory limit: "
+                    f"{(self.current + delta) / 1e6:.1f}MB > "
+                    f"{self.limit / 1e6:.1f}MB")
+            self.pool.reserve(self.query_id, delta)  # may raise; state intact
+        else:
+            self.pool.free(self.query_id, -delta)
+        self.by_node[node_id] = bytes_
+        self.current += delta
+        self.peak = max(self.peak, self.current)
+
+    def would_exceed(self, extra_bytes: int) -> bool:
+        """Probe used by spillable operators to decide grouped execution
+        BEFORE allocating (the MemoryRevokingScheduler threshold role)."""
+        return self.current + extra_bytes > self.limit
+
+    def release_all(self) -> None:
+        self.pool.free(self.query_id, self.current)
+        self.by_node.clear()
+        self.current = 0
+
+
+def batch_bytes(batch) -> int:
+    """Device bytes of a Batch: column data + validity + selection.
+    Uses .nbytes metadata only — never materializes device arrays."""
+    total = getattr(batch.sel, "nbytes", 0)
+    for c in batch.columns.values():
+        total += getattr(c.data, "nbytes", 0)
+        if c.valid is not None:
+            total += getattr(c.valid, "nbytes", 0)
+    return int(total)

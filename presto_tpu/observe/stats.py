@@ -46,6 +46,9 @@ class QueryStats:
     execution_mode: str = ""  # dynamic | compiled | distributed
     output_rows: int = 0
     error: Optional[str] = None
+    peak_memory_bytes: int = 0
+    spilled_bytes: int = 0
+    spilled_partitions: int = 0
     # id(plan node) -> NodeStats; populated in dynamic mode
     node_stats: Dict[int, NodeStats] = dataclasses.field(default_factory=dict)
 
@@ -72,6 +75,7 @@ class QueryMonitor:
         )
         self.collect_node_stats = bool(
             session.properties.get("collect_node_stats", False))
+        self.rows_preset = False  # EXPLAIN ANALYZE pins the analyzed count
 
     @classmethod
     def begin(cls, session, sql: str):
@@ -107,8 +111,8 @@ class QueryMonitor:
 
         self.stats.state = "FINISHED"
         self.stats.end_time = time.time()
-        if not self.stats.output_rows:  # EXPLAIN ANALYZE pre-sets the
-            try:                        # analyzed query's count; keep it
+        if not self.rows_preset:
+            try:
                 self.stats.output_rows = len(result)
             except TypeError:
                 pass

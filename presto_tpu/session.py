@@ -31,6 +31,18 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # per-plan-node stats collection in dynamic mode (forced by EXPLAIN
     # ANALYZE; costs one host sync per operator — reference: OperationTimer)
     "collect_node_stats": False,
+    # memory management (reference: query.max-memory-per-node +
+    # experimental.spill-enabled, FeaturesConfig/MemoryManagerConfig)
+    "query_max_memory_bytes": 4 << 30,
+    "memory_pool_bytes": 16 << 30,  # per-process pool (MemoryPool capacity)
+    "spill_enabled": True,
+    "spill_path": "",  # "" = <tmp>/presto_tpu_spill
+    "spill_partition_count": 8,  # Grace hash fan-out (GenericPartitioningSpiller)
+    "max_spill_bytes": 64 << 30,
+    # force grouped execution above this input row count regardless of the
+    # memory probe (0 = memory-driven only); the deterministic test knob,
+    # like the reference's tiny operator-memory configs in spill tests
+    "spill_trigger_rows": 0,
 }
 
 

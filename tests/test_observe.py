@@ -98,6 +98,11 @@ def test_explain_analyze_sql_statement_keeps_analyzed_rowcount(session):
     assert session.last_stats.output_rows == 25
 
 
+def test_explain_analyze_zero_row_query(session):
+    session.sql("EXPLAIN ANALYZE SELECT n_name FROM nation WHERE n_nationkey < 0")
+    assert session.last_stats.output_rows == 0
+
+
 def test_history_tracks_queries(session):
     n0 = len(session.history)
     session.sql("SELECT 1")
