@@ -1,0 +1,194 @@
+"""Interactive SQL CLI.
+
+Reference parity: presto-cli (Console.java, StatusPrinter.java,
+OutputFormat) — interactive prompt, multiple output formats, \\timing,
+server or embedded operation.  Usage:
+
+    python -m presto_tpu.cli --catalog tpch --sf 0.01       # embedded
+    python -m presto_tpu.cli --server http://host:port      # remote
+    python -m presto_tpu.cli --execute "SELECT 1" --format CSV
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# output formatting (reference: presto-cli OutputFormat + AlignedTablePrinter)
+# ---------------------------------------------------------------------------
+
+def format_aligned(columns: List[str], rows: List[tuple]) -> str:
+    cells = [[_render(v) for v in row] for row in rows]
+    widths = [len(c) for c in columns]
+    for row in cells:
+        for i, v in enumerate(row):
+            widths[i] = max(widths[i], len(v))
+    sep = "-+-".join("-" * w for w in widths)
+    out = [" | ".join(c.ljust(w) for c, w in zip(columns, widths)), sep]
+    for row in cells:
+        out.append(" | ".join(v.ljust(w) for v, w in zip(row, widths)))
+    out.append(f"({len(rows)} row{'s' if len(rows) != 1 else ''})")
+    return "\n".join(out)
+
+
+def format_csv(columns: List[str], rows: List[tuple]) -> str:
+    import csv
+    import io
+
+    buf = io.StringIO()
+    w = csv.writer(buf)
+    w.writerow(columns)
+    for row in rows:
+        w.writerow(["" if v is None else v for v in row])
+    return buf.getvalue().rstrip("\n")
+
+
+def format_tsv(columns: List[str], rows: List[tuple]) -> str:
+    lines = ["\t".join(columns)]
+    for row in rows:
+        lines.append("\t".join("" if v is None else str(v) for v in row))
+    return "\n".join(lines)
+
+
+def format_json(columns: List[str], rows: List[tuple]) -> str:
+    import json
+
+    return json.dumps([dict(zip(columns, row)) for row in rows],
+                      default=str, indent=2)
+
+
+FORMATTERS = {"ALIGNED": format_aligned, "CSV": format_csv,
+              "TSV": format_tsv, "JSON": format_json}
+
+
+def _render(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# execution backends
+# ---------------------------------------------------------------------------
+
+class EmbeddedBackend:
+    def __init__(self, sf: float, cache_dir: Optional[str]):
+        import presto_tpu
+        from presto_tpu.catalog import tpch_catalog
+
+        self.session = presto_tpu.connect(
+            tpch_catalog(sf, cache_dir=cache_dir))
+
+    def run(self, sql: str) -> Tuple[List[str], List[tuple]]:
+        r = self.session.sql(sql)
+        return [n for n, _ in r.columns], r.rows
+
+
+class RemoteBackend:
+    def __init__(self, server_uri: str):
+        from presto_tpu.client import StatementClient
+
+        self.server_uri = server_uri
+        self._client_cls = StatementClient
+
+    def run(self, sql: str) -> Tuple[List[str], List[tuple]]:
+        client = self._client_cls(self.server_uri, sql)
+        rows = list(client.rows())
+        cols = ([c["name"] for c in client.columns] if client.columns
+                else [])
+        return cols, rows
+
+
+# ---------------------------------------------------------------------------
+
+BANNER = "presto-tpu CLI — \\q quits, \\timing toggles timing, \\f FORMAT"
+
+
+def repl(backend, fmt: str, show_timing: bool = False,
+         stdin=None, stdout=None) -> None:
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    print(BANNER, file=stdout)
+    buf: List[str] = []
+    while True:
+        try:
+            prompt = "presto-tpu> " if not buf else "        ...> "
+            if stdin is sys.stdin and sys.stdin.isatty():
+                line = input(prompt)
+            else:
+                line = stdin.readline()
+                if not line:
+                    break
+                line = line.rstrip("\n")
+        except (EOFError, KeyboardInterrupt):
+            break
+        stripped = line.strip()
+        if not buf and stripped.startswith("\\"):
+            cmd = stripped.split()
+            if cmd[0] in ("\\q", "\\quit"):
+                break
+            if cmd[0] == "\\timing":
+                show_timing = not show_timing
+                print(f"timing {'on' if show_timing else 'off'}", file=stdout)
+                continue
+            if cmd[0] == "\\f" and len(cmd) > 1 and cmd[1].upper() in FORMATTERS:
+                fmt = cmd[1].upper()
+                print(f"format {fmt}", file=stdout)
+                continue
+            print(f"unknown command {cmd[0]}", file=stdout)
+            continue
+        buf.append(line)
+        if not stripped.endswith(";"):
+            continue
+        sql = "\n".join(buf).rstrip().rstrip(";")
+        buf = []
+        if not sql.strip():
+            continue
+        try:
+            t0 = time.perf_counter()
+            cols, rows = backend.run(sql)
+            elapsed = time.perf_counter() - t0
+            print(FORMATTERS[fmt](cols, rows), file=stdout)
+            if show_timing:
+                print(f"Time: {elapsed:.3f}s", file=stdout)
+        except Exception as e:  # noqa: BLE001 — REPL reports and continues
+            print(f"ERROR: {e}", file=stdout)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=BANNER)
+    p.add_argument("--server", help="remote server URI (default: embedded)")
+    p.add_argument("--sf", type=float, default=0.01,
+                   help="embedded TPC-H scale factor")
+    p.add_argument("--cache-dir", default="/tmp/presto_tpu_cache")
+    p.add_argument("--execute", "-e", help="run one statement and exit")
+    p.add_argument("--format", "-f", default="ALIGNED",
+                   choices=sorted(FORMATTERS))
+    p.add_argument("--timing", action="store_true")
+    args = p.parse_args(argv)
+
+    backend = (RemoteBackend(args.server) if args.server
+               else EmbeddedBackend(args.sf, args.cache_dir))
+    if args.execute:
+        try:
+            t0 = time.perf_counter()
+            cols, rows = backend.run(args.execute.rstrip(";"))
+            print(FORMATTERS[args.format](cols, rows))
+            if args.timing:
+                print(f"Time: {time.perf_counter() - t0:.3f}s")
+            return 0
+        except Exception as e:  # noqa: BLE001
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 1
+    repl(backend, args.format, args.timing)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Client protocol implementation.
+
+Reference parity: presto-client StatementClientV1.java — submit via
+`POST /v1/statement`, follow `nextUri` pages until absent, surface
+columns/data/stats/error; `DELETE` cancels (QueryResults.java:35-55).
+"""
+
+from presto_tpu.client.statement import Cursor, StatementClient, connect_http
+
+__all__ = ["StatementClient", "Cursor", "connect_http"]

@@ -1,0 +1,117 @@
+"""HTTP statement client (stdlib urllib; no external deps).
+
+Reference parity: StatementClientV1 state machine — advance() fetches
+the next QueryResults page; duplicate token fetches are safe
+(at-least-once + dedup, server/TaskResource.java:244-307 analog).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Iterator, List, Optional, Tuple
+
+
+class QueryError(Exception):
+    pass
+
+
+class StatementClient:
+    def __init__(self, server_uri: str, sql: str, poll_interval: float = 0.05):
+        self.server_uri = server_uri.rstrip("/")
+        self.sql = sql
+        self.poll_interval = poll_interval
+        self.query_id: Optional[str] = None
+        self.columns: Optional[List[dict]] = None
+        self.stats: dict = {}
+        self._next_uri: Optional[str] = None
+        self._current_data: list = []
+        self._started = False
+
+    def _request(self, method: str, url: str, body: Optional[bytes] = None):
+        req = urllib.request.Request(url, data=body, method=method)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read().decode())
+
+    def _absorb(self, payload: dict) -> None:
+        self.query_id = payload.get("id", self.query_id)
+        if payload.get("columns"):
+            self.columns = payload["columns"]
+        self.stats = payload.get("stats", self.stats)
+        self._current_data = payload.get("data", [])
+        self._next_uri = payload.get("nextUri")
+        err = payload.get("error")
+        if err:
+            raise QueryError(err.get("message", "query failed"))
+        if self.stats.get("state") == "CANCELED":
+            # a silent stop would be indistinguishable from completion
+            raise QueryError("query was canceled")
+
+    def advance(self) -> bool:
+        """Fetch the next page; returns False when the stream is done."""
+        if not self._started:
+            self._started = True
+            payload = self._request("POST", f"{self.server_uri}/v1/statement",
+                                    self.sql.encode())
+            self._absorb(payload)
+            return True
+        if self._next_uri is None:
+            return False
+        payload = self._request("GET", self._next_uri)
+        self._absorb(payload)
+        return True
+
+    def rows(self) -> Iterator[tuple]:
+        """Stream all result rows, polling while queued/running."""
+        while self.advance():
+            for r in self._current_data:
+                yield tuple(r)
+            state = self.stats.get("state")
+            if state in ("QUEUED", "RUNNING") and not self._current_data:
+                time.sleep(self.poll_interval)
+
+    def cancel(self) -> None:
+        if self.query_id is not None:
+            try:
+                self._request(
+                    "DELETE",
+                    f"{self.server_uri}/v1/statement/{self.query_id}/0")
+            except urllib.error.URLError:
+                pass
+
+
+class Cursor:
+    """DB-API-flavored convenience over StatementClient (the role the
+    JDBC driver plays for the reference; reference: presto-jdbc)."""
+
+    def __init__(self, server_uri: str):
+        self.server_uri = server_uri
+        self.description: Optional[List[Tuple[str, str]]] = None
+        self._rows: list = []
+        self._idx = 0
+
+    def execute(self, sql: str) -> "Cursor":
+        client = StatementClient(self.server_uri, sql)
+        self._rows = list(client.rows())
+        self.description = ([(c["name"], c["type"]) for c in client.columns]
+                            if client.columns else None)
+        self._idx = 0
+        self.stats = client.stats
+        return self
+
+    def fetchall(self) -> list:
+        rows, self._idx = self._rows[self._idx:], len(self._rows)
+        return rows
+
+    def fetchone(self):
+        if self._idx >= len(self._rows):
+            return None
+        row = self._rows[self._idx]
+        self._idx += 1
+        return row
+
+
+def connect_http(server_uri: str) -> Cursor:
+    return Cursor(server_uri)

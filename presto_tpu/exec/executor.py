@@ -34,6 +34,11 @@ from presto_tpu.sql import ast
 from presto_tpu.sql.parser import parse
 
 
+import threading as _threading
+
+_pool_init_lock = _threading.Lock()
+
+
 class ExecutionError(Exception):
     pass
 
@@ -55,7 +60,8 @@ def execute_query(session, text: str) -> QueryResult:
             stmt = parse(text)
         result = _dispatch_statement(session, text, stmt, mon)
         mon.finish(result)
-        return result
+        result.stats = mon.stats  # this query's stats, race-free under
+        return result             # concurrent sessions (vs last_stats)
     except BaseException as e:
         mon.fail(e)
         raise
@@ -272,9 +278,10 @@ class Executor:
             from presto_tpu.memory import MemoryPool, QueryMemoryContext
 
             pool_cap = int(session.properties.get("memory_pool_bytes", 16 << 30))
-            pool = getattr(session, "_memory_pool", None)
-            if pool is None:
-                pool = session._memory_pool = MemoryPool(pool_cap)
+            with _pool_init_lock:
+                pool = getattr(session, "_memory_pool", None)
+                if pool is None:
+                    pool = session._memory_pool = MemoryPool(pool_cap)
             pool.capacity = pool_cap  # honor property changes mid-session
             mem = QueryMemoryContext(
                 monitor.stats.query_id, pool,

@@ -11,6 +11,7 @@ switch to grouped execution before the limit trips).
 
 from __future__ import annotations
 
+import threading
 from typing import Dict
 
 
@@ -28,24 +29,28 @@ class MemoryPool:
         self.capacity = capacity_bytes
         self.reserved = 0
         self.query_reservations: Dict[str, int] = {}
+        self._lock = threading.Lock()  # concurrent server queries share the pool
 
     def reserve(self, query_id: str, bytes_: int) -> None:
-        if self.reserved + bytes_ > self.capacity:
-            raise ExceededMemoryLimitError(
-                f"memory pool exhausted: {(self.reserved + bytes_) / 1e6:.1f}"
-                f"MB > {self.capacity / 1e6:.1f}MB "
-                f"({len(self.query_reservations)} queries resident)")
-        self.reserved += bytes_
-        self.query_reservations[query_id] = (
-            self.query_reservations.get(query_id, 0) + bytes_)
+        with self._lock:
+            if self.reserved + bytes_ > self.capacity:
+                raise ExceededMemoryLimitError(
+                    f"memory pool exhausted: "
+                    f"{(self.reserved + bytes_) / 1e6:.1f}"
+                    f"MB > {self.capacity / 1e6:.1f}MB "
+                    f"({len(self.query_reservations)} queries resident)")
+            self.reserved += bytes_
+            self.query_reservations[query_id] = (
+                self.query_reservations.get(query_id, 0) + bytes_)
 
     def free(self, query_id: str, bytes_: int) -> None:
-        self.reserved = max(0, self.reserved - bytes_)
-        cur = self.query_reservations.get(query_id, 0) - bytes_
-        if cur <= 0:
-            self.query_reservations.pop(query_id, None)
-        else:
-            self.query_reservations[query_id] = cur
+        with self._lock:
+            self.reserved = max(0, self.reserved - bytes_)
+            cur = self.query_reservations.get(query_id, 0) - bytes_
+            if cur <= 0:
+                self.query_reservations.pop(query_id, None)
+            else:
+                self.query_reservations[query_id] = cur
 
     @property
     def free_bytes(self) -> int:

@@ -52,6 +52,7 @@ class FileSpiller:
         self.dir = directory
         self.tracker = tracker
         self.files: List[Tuple[str, int]] = []
+        self._meta: Dict[str, dict] = {}
         os.makedirs(directory, exist_ok=True)
 
     def spill(self, batch: Batch) -> str:
@@ -76,7 +77,6 @@ class FileSpiller:
                 os.remove(path)  # enforce the bound; no orphan on disk
                 raise
         self.files.append((path, size))
-        self._meta = getattr(self, "_meta", {})
         self._meta[path] = meta
         return path
 
@@ -108,6 +108,7 @@ class FileSpiller:
             if self.tracker is not None:
                 self.tracker.free(size)
         self.files.clear()
+        self._meta.clear()
 
 
 def default_spill_dir() -> str:

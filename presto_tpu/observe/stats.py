@@ -82,7 +82,8 @@ class QueryMonitor:
         from presto_tpu.observe.events import QueryCreatedEvent, dispatch
 
         mon = cls(session, sql)
-        session.history.append(mon.stats)
+        with session.history_lock:
+            session.history.append(mon.stats)
         dispatch(session.event_listeners, "query_created",
                  QueryCreatedEvent(mon.stats.query_id, sql,
                                    mon.stats.create_time))

@@ -1,0 +1,11 @@
+"""HTTP server layer: client protocol, introspection, cluster control.
+
+Reference parity: presto-main server/ — StatementResource (client
+protocol), QueryResource (introspection), ClusterStatsResource,
+GracefulShutdownHandler — plus the discovery/failure-detection loop
+(failureDetector/HeartbeatFailureDetector.java).
+"""
+
+from presto_tpu.server.protocol import PrestoTpuServer
+
+__all__ = ["PrestoTpuServer"]

@@ -1,0 +1,120 @@
+"""Node discovery + heartbeat failure detection.
+
+Reference parity: failureDetector/HeartbeatFailureDetector.java:77-393 —
+the coordinator pings every discovered service's /v1/status, tracks an
+exponentially-decayed failure ratio per node, and marks nodes failed
+above a threshold; DiscoveryNodeManager announces membership and
+ClusterSizeMonitor gates query admission on a minimum node count
+(execution/ClusterSizeMonitor.java).  In the TPU runtime this guards the
+multi-host DCN control plane: each JAX host process runs a server; the
+coordinator host watches the rest.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+FAILURE_RATIO_THRESHOLD = 0.1  # HeartbeatFailureDetector.java FAILURE_RATIO
+DECAY_ALPHA = 0.2  # exponential decay weight per observation
+
+
+class NodeState:
+    def __init__(self, uri: str):
+        self.uri = uri
+        self.failure_ratio = 0.0
+        self.last_seen = 0.0
+        self.last_error: Optional[str] = None
+
+    @property
+    def alive(self) -> bool:
+        return self.failure_ratio < FAILURE_RATIO_THRESHOLD
+
+
+class HeartbeatFailureDetector:
+    def __init__(self, interval: float = 0.5,
+                 on_failure: Optional[Callable[[str], None]] = None):
+        self.nodes: Dict[str, NodeState] = {}
+        self.interval = interval
+        self.on_failure = on_failure
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def register(self, uri: str) -> None:
+        """A node announcing itself (reference: discovery announcement)."""
+        with self._lock:
+            if uri not in self.nodes:
+                self.nodes[uri] = NodeState(uri)
+
+    def unregister(self, uri: str) -> None:
+        with self._lock:
+            self.nodes.pop(uri, None)
+
+    def start(self) -> "HeartbeatFailureDetector":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.ping_all()
+
+    def ping_all(self) -> None:
+        with self._lock:
+            nodes = list(self.nodes.values())
+        for node in nodes:
+            was_alive = node.alive
+            ok = self._ping(node)
+            # exponentially-decayed failure ratio
+            # (HeartbeatFailureDetector.java:360 Stats.recordSuccess/Failure)
+            obs = 0.0 if ok else 1.0
+            node.failure_ratio = (DECAY_ALPHA * obs
+                                  + (1 - DECAY_ALPHA) * node.failure_ratio)
+            if ok:
+                node.last_seen = time.time()
+            if was_alive and not node.alive and self.on_failure is not None:
+                self.on_failure(node.uri)
+
+    def _ping(self, node: NodeState) -> bool:
+        try:
+            with urllib.request.urlopen(f"{node.uri}/v1/status",
+                                        timeout=1.0) as resp:
+                payload = json.loads(resp.read().decode())
+                return bool(payload.get("alive"))
+        except Exception as e:  # noqa: BLE001 — any failure counts
+            node.last_error = f"{type(e).__name__}: {e}"
+            return False
+
+    def alive_nodes(self) -> List[str]:
+        with self._lock:
+            return [u for u, n in self.nodes.items() if n.alive]
+
+    def failed_nodes(self) -> List[str]:
+        with self._lock:
+            return [u for u, n in self.nodes.items() if not n.alive]
+
+
+class ClusterSizeMonitor:
+    """Gates query admission on minimum cluster size (reference:
+    execution/ClusterSizeMonitor.java, used at SqlQueryExecution.java:342)."""
+
+    def __init__(self, detector: HeartbeatFailureDetector, min_nodes: int):
+        self.detector = detector
+        self.min_nodes = min_nodes
+
+    def wait_for_minimum_nodes(self, timeout: float = 10.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.detector.alive_nodes()) >= self.min_nodes:
+                return True
+            time.sleep(0.05)
+        return False

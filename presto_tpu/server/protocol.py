@@ -1,0 +1,280 @@
+"""The client protocol server.
+
+Reference parity: server/protocol/StatementResource.java:88-134 —
+`POST /v1/statement` returns QueryResults{id, nextUri, columns, data,
+stats, error}; the client polls nextUri
+(`GET /v1/statement/{queryId}/{token}`) until no nextUri remains;
+`DELETE /v1/statement/{queryId}` cancels.  Tokens are cumulative page
+sequence numbers: re-fetching a token re-serves the same page
+(at-least-once delivery with client dedup, the elasticity seam of
+SURVEY.md §2.6).  Also serves the introspection endpoints
+(server/QueryResource.java `/v1/query`, ClusterStatsResource
+`/v1/cluster`), node info/status for the failure detector, and the
+graceful-shutdown state machine (server/GracefulShutdownHandler.java).
+
+Execution is in-process on the embedded engine (the coordinator IS the
+mesh driver under SPMD — workers are TPU chips, not task servers; the
+reference ships plan fragments to worker JVMs, SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+PAGE_ROWS = 4096  # rows per protocol page (client re-chunks as needed)
+
+
+@dataclasses.dataclass
+class _QueryJob:
+    query_id: str
+    sql: str
+    state: str = "QUEUED"  # QUEUED RUNNING FINISHED FAILED CANCELED
+    columns: Optional[List[dict]] = None
+    rows: Optional[list] = None
+    error: Optional[str] = None
+    stats: Optional[dict] = None
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    cancel: threading.Event = dataclasses.field(default_factory=threading.Event)
+
+
+class PrestoTpuServer:
+    """Embeds a Session behind the REST protocol; queries run on a worker
+    thread pool so the HTTP loop never blocks on execution."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
+                 max_concurrent: int = 4):
+        self.session = session
+        self.jobs: Dict[str, _QueryJob] = {}
+        self.jobs_lock = threading.Lock()
+        self.node_id = f"node_{uuid.uuid4().hex[:8]}"
+        self.start_time = time.time()
+        self.shutting_down = threading.Event()
+        self.active_queries = 0
+        self._sema = threading.Semaphore(max_concurrent)
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "PrestoTpuServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def graceful_shutdown(self, timeout: float = 30.0) -> None:
+        """Drain: refuse new queries, wait for active ones, stop
+        (reference: GracefulShutdownHandler — worker waits for active
+        tasks before exiting)."""
+        self.shutting_down.set()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self.jobs_lock:
+                if self.active_queries == 0:
+                    break
+            time.sleep(0.05)
+        self.stop()
+
+    @property
+    def uri(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- query execution ----------------------------------------------
+    def submit(self, sql: str) -> _QueryJob:
+        if self.shutting_down.is_set():
+            raise RuntimeError("server is shutting down")
+        job = _QueryJob(query_id=f"qs_{uuid.uuid4().hex[:12]}", sql=sql)
+        with self.jobs_lock:
+            self.jobs[job.query_id] = job
+            self.active_queries += 1
+        threading.Thread(target=self._run_job, args=(job,), daemon=True).start()
+        return job
+
+    def _run_job(self, job: _QueryJob) -> None:
+        with self._sema:
+            try:
+                if job.cancel.is_set():
+                    job.state = "CANCELED"
+                    return
+                job.state = "RUNNING"
+                result = self.session.sql(job.sql)
+                if job.cancel.is_set():
+                    job.state = "CANCELED"
+                    return
+                job.columns = [{"name": n, "type": str(t).lower()}
+                               for n, t in result.columns]
+                job.rows = [list(r) for r in result.rows]
+                st = result.stats  # this query's stats (not last_stats —
+                job.stats = {      # concurrent jobs would race)
+                    "state": "FINISHED",
+                    "elapsedTimeMillis": int((st.total_ns if st else 0) / 1e6),
+                    "processedRows": len(job.rows),
+                    "peakMemoryBytes": getattr(st, "peak_memory_bytes", 0),
+                    "spilledBytes": getattr(st, "spilled_bytes", 0),
+                }
+                job.state = "FINISHED"
+            except Exception as e:  # noqa: BLE001 — protocol reports all errors
+                job.error = f"{type(e).__name__}: {e}"
+                job.state = "FAILED"
+            finally:
+                job.done.set()
+                with self.jobs_lock:
+                    self.active_queries -= 1
+
+    # -- protocol payloads --------------------------------------------
+    def results_payload(self, job: _QueryJob, token: int) -> dict:
+        base = f"{self.uri}/v1/statement/{job.query_id}"
+        out = {"id": job.query_id,
+               "infoUri": f"{self.uri}/v1/query/{job.query_id}"}
+        if job.state in ("QUEUED", "RUNNING"):
+            out["stats"] = {"state": job.state}
+            out["nextUri"] = f"{base}/{token}"  # poll same token until data
+            return out
+        if job.state == "FAILED":
+            out["error"] = {"message": job.error,
+                            "errorCode": "QUERY_FAILED"}
+            out["stats"] = {"state": "FAILED"}
+            return out
+        if job.state == "CANCELED":
+            out["stats"] = {"state": "CANCELED"}
+            return out
+        start = token * PAGE_ROWS
+        page = job.rows[start:start + PAGE_ROWS]
+        out["columns"] = job.columns
+        if page:
+            out["data"] = page
+        out["stats"] = job.stats
+        if start + PAGE_ROWS < len(job.rows):
+            out["nextUri"] = f"{base}/{token + 1}"
+        else:
+            self._prune_done()
+        return out
+
+    MAX_DONE_JOBS = 64
+
+    def _prune_done(self) -> None:
+        """Bound retained results: keep the newest MAX_DONE_JOBS finished
+        jobs so recent pages stay refetchable (at-least-once) while the
+        server never accumulates every result ever produced (reference:
+        QueryTracker expiry, execution/QueryTracker.java)."""
+        with self.jobs_lock:
+            done = [qid for qid, j in self.jobs.items() if j.done.is_set()]
+            for qid in done[:-self.MAX_DONE_JOBS]:
+                del self.jobs[qid]
+
+    def query_list_payload(self) -> list:
+        out = []
+        for st in self.session.history_snapshot():
+            out.append({
+                "queryId": st.query_id, "query": st.sql, "state": st.state,
+                "executionMode": st.execution_mode,
+                "elapsedTimeMillis": int(st.total_ns / 1e6),
+                "outputRows": st.output_rows, "error": st.error,
+                "peakMemoryBytes": st.peak_memory_bytes,
+            })
+        return out
+
+    def info_payload(self) -> dict:
+        return {
+            "nodeId": self.node_id,
+            "uptimeMillis": int((time.time() - self.start_time) * 1000),
+            "state": "SHUTTING_DOWN" if self.shutting_down.is_set()
+                     else "ACTIVE",
+            "coordinator": True,
+        }
+
+
+def _make_handler(server: PrestoTpuServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # silence default stderr noise
+            pass
+
+        def _json(self, payload, code: int = 200):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            if self.path != "/v1/statement":
+                return self._json({"error": "not found"}, 404)
+            if server.shutting_down.is_set():
+                return self._json({"error": "shutting down"}, 503)
+            n = int(self.headers.get("Content-Length", 0))
+            sql = self.rfile.read(n).decode()
+            job = server.submit(sql)
+            # brief grace so fast queries return data on the first response
+            job.done.wait(timeout=0.05)
+            self._json(server.results_payload(job, 0))
+
+        def do_GET(self):
+            parts = [p for p in self.path.split("/") if p]
+            if parts[:2] == ["v1", "statement"] and len(parts) == 4:
+                job = server.jobs.get(parts[2])
+                if job is None:
+                    return self._json({"error": "unknown query"}, 404)
+                if job.state in ("QUEUED", "RUNNING"):
+                    job.done.wait(timeout=1.0)  # long poll
+                return self._json(server.results_payload(job, int(parts[3])))
+            if parts == ["v1", "query"]:
+                return self._json(server.query_list_payload())
+            if parts[:2] == ["v1", "query"] and len(parts) == 3:
+                for st in server.session.history_snapshot():
+                    if st.query_id == parts[2]:
+                        return self._json({
+                            "queryId": st.query_id, "query": st.sql,
+                            "state": st.state, "error": st.error,
+                            "phaseMillis": {k: v / 1e6
+                                            for k, v in st.phase_ns.items()},
+                            "outputRows": st.output_rows})
+                return self._json({"error": "unknown query"}, 404)
+            if parts == ["v1", "info"]:
+                return self._json(server.info_payload())
+            if parts == ["v1", "status"]:  # heartbeat probe target
+                return self._json({"nodeId": server.node_id, "alive": True})
+            if parts == ["v1", "cluster"]:
+                with server.jobs_lock:
+                    active = server.active_queries
+                return self._json({
+                    "runningQueries": active,
+                    "totalQueries": len(server.session.history)})
+            return self._json({"error": "not found"}, 404)
+
+        def do_DELETE(self):
+            parts = [p for p in self.path.split("/") if p]
+            if parts[:2] == ["v1", "statement"] and len(parts) >= 3:
+                job = server.jobs.get(parts[2])
+                if job is not None:
+                    job.cancel.set()
+                    if job.state in ("QUEUED",):
+                        job.state = "CANCELED"
+                    return self._json({"canceled": True}, 200)
+            self._json({"error": "not found"}, 404)
+
+        def do_PUT(self):
+            if self.path == "/v1/info/state":
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode().strip().strip('"')
+                if body == "SHUTTING_DOWN":
+                    threading.Thread(target=server.graceful_shutdown,
+                                     daemon=True).start()
+                    return self._json({"state": "SHUTTING_DOWN"})
+            self._json({"error": "bad request"}, 400)
+
+    return Handler

@@ -52,6 +52,7 @@ class QueryResult:
 
     columns: list  # [(name, Type)]
     rows: list  # list of python tuples
+    stats: Any = None  # this query's QueryStats (observe.stats)
 
     def __iter__(self):
         return iter(self.rows)
@@ -77,8 +78,12 @@ class Session:
         if properties:
             self.properties.update(properties)
         # query introspection + event pipeline (reference: QueryTracker
-        # bounded history + eventlistener/EventListenerManager)
+        # bounded history + eventlistener/EventListenerManager); the lock
+        # covers concurrent server threads appending while others iterate
+        import threading
+
         self.history = collections.deque(maxlen=1000)
+        self.history_lock = threading.Lock()
         self.event_listeners: list = []
 
     def set(self, name: str, value) -> None:
@@ -91,8 +96,14 @@ class Session:
 
     @property
     def last_stats(self):
-        """QueryStats of the most recent query (reference: /v1/query)."""
-        return self.history[-1] if self.history else None
+        """QueryStats of the most recently begun query (reference:
+        /v1/query).  Under concurrent queries prefer QueryResult.stats."""
+        with self.history_lock:
+            return self.history[-1] if self.history else None
+
+    def history_snapshot(self) -> list:
+        with self.history_lock:
+            return list(self.history)
 
     def sql(self, text: str) -> QueryResult:
         from presto_tpu.exec.executor import execute_query
