@@ -1,0 +1,442 @@
+// ptnative — host-side native data plane for the TPU SQL engine.
+//
+// The reference engine's performance-critical host layer is runtime JVM
+// bytecode (presto-bytecode + sql/gen, see SURVEY.md §2.9) and its wire
+// format is PagesSerde with optional LZ4 (presto-main/.../execution/buffer/
+// PagesSerde.java:44-60).  Here the equivalent host hot loops are plain
+// C++ behind a C ABI consumed via ctypes:
+//
+//   - LZ4-block-format-compatible compressor/decompressor (page serde,
+//     spill files, shard storage)
+//   - xxHash64 (frame checksums, string dictionary hashing)
+//   - RLE + delta-bitpack integer encodings with zone (min/max) stats
+//     (shard file format, reference analog presto-orc encodings)
+//   - string dictionary builder (hash map over byte slices)
+//   - selection-mask utilities shared by the spill/scan paths
+//
+// Everything is single-threaded per call; parallelism comes from the
+// Python side issuing independent column encodes.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+// ---------------------------------------------------------------------------
+// xxHash64 (standard algorithm, public domain spec)
+// ---------------------------------------------------------------------------
+
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+static inline uint64_t read64(const uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+static inline uint32_t read32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+static inline uint16_t read16(const uint8_t* p) { uint16_t v; memcpy(&v, p, 2); return v; }
+
+static inline uint64_t xxh_round(uint64_t acc, uint64_t input) {
+    acc += input * P2;
+    acc = rotl64(acc, 31);
+    acc *= P1;
+    return acc;
+}
+
+static inline uint64_t xxh_merge(uint64_t acc, uint64_t val) {
+    val = xxh_round(0, val);
+    acc ^= val;
+    acc = acc * P1 + P4;
+    return acc;
+}
+
+PT_EXPORT uint64_t pt_xxh64(const uint8_t* p, int64_t len, uint64_t seed) {
+    const uint8_t* end = p + len;
+    uint64_t h;
+    if (len >= 32) {
+        uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+        const uint8_t* limit = end - 32;
+        do {
+            v1 = xxh_round(v1, read64(p)); p += 8;
+            v2 = xxh_round(v2, read64(p)); p += 8;
+            v3 = xxh_round(v3, read64(p)); p += 8;
+            v4 = xxh_round(v4, read64(p)); p += 8;
+        } while (p <= limit);
+        h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+        h = xxh_merge(h, v1); h = xxh_merge(h, v2);
+        h = xxh_merge(h, v3); h = xxh_merge(h, v4);
+    } else {
+        h = seed + P5;
+    }
+    h += (uint64_t)len;
+    while (p + 8 <= end) {
+        h ^= xxh_round(0, read64(p));
+        h = rotl64(h, 27) * P1 + P4;
+        p += 8;
+    }
+    if (p + 4 <= end) {
+        h ^= (uint64_t)read32(p) * P1;
+        h = rotl64(h, 23) * P2 + P3;
+        p += 4;
+    }
+    while (p < end) {
+        h ^= (*p) * P5;
+        h = rotl64(h, 11) * P1;
+        p++;
+    }
+    h ^= h >> 33; h *= P2; h ^= h >> 29; h *= P3; h ^= h >> 32;
+    return h;
+}
+
+// ---------------------------------------------------------------------------
+// LZ4 block format codec.
+//
+// Format (https://github.com/lz4/lz4/blob/dev/doc/lz4_Block_format.md):
+//   sequence = token | [literal-length ext] | literals | offset(2B LE)
+//              | [match-length ext]
+//   token: high nibble = literal length (15 => ext bytes), low nibble =
+//   match length - 4 (15 => ext bytes).  Last sequence is literals only.
+// ---------------------------------------------------------------------------
+
+static const int HASH_LOG = 16;
+static const int MIN_MATCH = 4;
+// Spec: last 5 bytes are always literals; last match cannot start within
+// the last 12 bytes.
+static const int MF_LIMIT = 12;
+
+static inline uint32_t lz_hash(uint32_t sequence) {
+    return (sequence * 2654435761U) >> (32 - HASH_LOG);
+}
+
+PT_EXPORT int64_t pt_lz4_max_compressed(int64_t n) {
+    return n + n / 255 + 16;
+}
+
+PT_EXPORT int64_t pt_lz4_compress(const uint8_t* src, int64_t n,
+                                  uint8_t* dst, int64_t cap) {
+    if (n < 0 || cap < pt_lz4_max_compressed(n)) return -1;
+    uint8_t* op = dst;
+    const uint8_t* ip = src;
+    const uint8_t* anchor = src;
+    const uint8_t* iend = src + n;
+
+    if (n >= MF_LIMIT + 1) {
+        const uint8_t* mflimit = iend - MF_LIMIT;
+        int32_t table[1 << HASH_LOG];
+        for (int i = 0; i < (1 << HASH_LOG); i++) table[i] = -1;
+
+        ip++;  // first byte can't match (no history)
+        while (ip < mflimit) {
+            uint32_t h = lz_hash(read32(ip));
+            int32_t ref = table[h];
+            table[h] = (int32_t)(ip - src);
+            if (ref < 0 || (ip - src) - ref > 65535 ||
+                read32(src + ref) != read32(ip)) {
+                ip++;
+                continue;
+            }
+            const uint8_t* match = src + ref;
+            // extend the match forward
+            const uint8_t* mip = ip + MIN_MATCH;
+            const uint8_t* mref = match + MIN_MATCH;
+            const uint8_t* matchlimit = iend - 5;
+            while (mip < matchlimit && *mip == *mref) { mip++; mref++; }
+            // extend backward over pending literals
+            while (ip > anchor && match > src && ip[-1] == match[-1]) { ip--; match--; }
+
+            int64_t lit_len = ip - anchor;
+            int64_t match_len = (mip - ip) - MIN_MATCH;
+            // token
+            uint8_t* token = op++;
+            if (lit_len >= 15) {
+                *token = (uint8_t)(15 << 4);
+                int64_t l = lit_len - 15;
+                for (; l >= 255; l -= 255) *op++ = 255;
+                *op++ = (uint8_t)l;
+            } else {
+                *token = (uint8_t)(lit_len << 4);
+            }
+            memcpy(op, anchor, (size_t)lit_len);
+            op += lit_len;
+            // offset
+            uint16_t offset = (uint16_t)(ip - match);
+            memcpy(op, &offset, 2);
+            op += 2;
+            // match length
+            if (match_len >= 15) {
+                *token |= 15;
+                int64_t m = match_len - 15;
+                for (; m >= 255; m -= 255) *op++ = 255;
+                *op++ = (uint8_t)m;
+            } else {
+                *token |= (uint8_t)match_len;
+            }
+            ip = mip;
+            anchor = ip;
+            if (ip < mflimit) {
+                table[lz_hash(read32(ip - 2))] = (int32_t)(ip - 2 - src);
+            }
+        }
+    }
+    // trailing literals
+    int64_t last = iend - anchor;
+    uint8_t* token = op++;
+    if (last >= 15) {
+        *token = (uint8_t)(15 << 4);
+        int64_t l = last - 15;
+        for (; l >= 255; l -= 255) *op++ = 255;
+        *op++ = (uint8_t)l;
+    } else {
+        *token = (uint8_t)(last << 4);
+    }
+    memcpy(op, anchor, (size_t)last);
+    op += last;
+    return op - dst;
+}
+
+PT_EXPORT int64_t pt_lz4_decompress(const uint8_t* src, int64_t n,
+                                    uint8_t* dst, int64_t cap) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + cap;
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        // literals
+        int64_t lit = token >> 4;
+        if (lit == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                lit += b;
+            } while (b == 255);
+        }
+        if (ip + lit > iend || op + lit > oend) return -1;
+        memcpy(op, ip, (size_t)lit);
+        ip += lit; op += lit;
+        if (ip >= iend) break;  // last sequence has no match
+        // match
+        if (ip + 2 > iend) return -1;
+        uint16_t offset = read16(ip);
+        ip += 2;
+        if (offset == 0 || op - dst < offset) return -1;
+        int64_t mlen = (token & 15) + MIN_MATCH;
+        if ((token & 15) == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                mlen += b;
+            } while (b == 255);
+        }
+        if (op + mlen > oend) return -1;
+        const uint8_t* match = op - offset;
+        // overlapping copy must be byte-wise
+        for (int64_t i = 0; i < mlen; i++) op[i] = match[i];
+        op += mlen;
+    }
+    return op - dst;
+}
+
+// ---------------------------------------------------------------------------
+// Integer encodings: RLE and delta-bitpack, with zone (min/max) stats.
+// Reference analog: the ORC integer readers/writers in presto-orc.
+// ---------------------------------------------------------------------------
+
+PT_EXPORT int64_t pt_rle_encode_i64(const int64_t* src, int64_t n,
+                                    int64_t* values, int64_t* runs,
+                                    int64_t max_runs) {
+    if (n == 0) return 0;
+    int64_t nr = 0;
+    int64_t cur = src[0];
+    int64_t len = 1;
+    for (int64_t i = 1; i < n; i++) {
+        if (src[i] == cur) {
+            len++;
+        } else {
+            if (nr >= max_runs) return -1;
+            values[nr] = cur; runs[nr] = len; nr++;
+            cur = src[i]; len = 1;
+        }
+    }
+    if (nr >= max_runs) return -1;
+    values[nr] = cur; runs[nr] = len; nr++;
+    return nr;
+}
+
+PT_EXPORT int64_t pt_rle_decode_i64(const int64_t* values, const int64_t* runs,
+                                    int64_t n_runs, int64_t* dst, int64_t cap) {
+    int64_t o = 0;
+    for (int64_t r = 0; r < n_runs; r++) {
+        int64_t len = runs[r];
+        if (o + len > cap) return -1;
+        int64_t v = values[r];
+        for (int64_t i = 0; i < len; i++) dst[o + i] = v;
+        o += len;
+    }
+    return o;
+}
+
+PT_EXPORT void pt_minmax_i64(const int64_t* src, int64_t n, int64_t* out) {
+    int64_t lo = INT64_MAX, hi = INT64_MIN;
+    for (int64_t i = 0; i < n; i++) {
+        if (src[i] < lo) lo = src[i];
+        if (src[i] > hi) hi = src[i];
+    }
+    out[0] = lo; out[1] = hi;
+}
+
+PT_EXPORT void pt_minmax_f64(const double* src, int64_t n, double* out) {
+    double lo = __builtin_inf(), hi = -__builtin_inf();
+    for (int64_t i = 0; i < n; i++) {
+        if (src[i] < lo) lo = src[i];
+        if (src[i] > hi) hi = src[i];
+    }
+    out[0] = lo; out[1] = hi;
+}
+
+// width in bits needed for the largest zigzag-delta; returns the width and
+// fills base (first value) — the caller sizes the output buffer from it.
+static inline uint64_t zigzag(int64_t v) {
+    return ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+}
+static inline int64_t unzigzag(uint64_t v) {
+    return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+}
+
+PT_EXPORT int32_t pt_delta_width_i64(const int64_t* src, int64_t n, int64_t* base) {
+    if (n == 0) { *base = 0; return 0; }
+    *base = src[0];
+    uint64_t maxz = 0;
+    for (int64_t i = 1; i < n; i++) {
+        uint64_t z = zigzag(src[i] - src[i - 1]);
+        if (z > maxz) maxz = z;
+    }
+    int32_t w = 0;
+    while (maxz) { w++; maxz >>= 1; }
+    return w;
+}
+
+// pack zigzag deltas at `width` bits each (width <= 56 — wider columns use
+// plain encoding; the <=7 pending bits + 56 payload bits always fit the
+// 64-bit accumulator); dst must hold ceil((n-1)*width/8)+8 bytes; returns
+// bytes written, or -1 for an unsupported width.
+PT_EXPORT int64_t pt_delta_pack_i64(const int64_t* src, int64_t n,
+                                    int32_t width, uint8_t* dst) {
+    if (width > 56 || width < 0) return -1;
+    uint64_t acc = 0;
+    int bits = 0;
+    int64_t o = 0;
+    for (int64_t i = 1; i < n; i++) {
+        uint64_t z = zigzag(src[i] - src[i - 1]);
+        acc |= z << bits;
+        bits += width;
+        while (bits >= 8) {
+            dst[o++] = (uint8_t)acc;
+            acc >>= 8;
+            bits -= 8;
+        }
+    }
+    if (bits > 0) dst[o++] = (uint8_t)acc;
+    return o;
+}
+
+PT_EXPORT int64_t pt_delta_unpack_i64(const uint8_t* src, int64_t nbytes,
+                                      int32_t width, int64_t base,
+                                      int64_t n, int64_t* dst) {
+    if (n == 0) return 0;
+    dst[0] = base;
+    uint64_t acc = 0;
+    int bits = 0;
+    int64_t o = 0;
+    uint64_t mask = (width == 64) ? ~0ULL : ((1ULL << width) - 1);
+    for (int64_t i = 1; i < n; i++) {
+        while (bits < width) {
+            if (o >= nbytes) return -1;
+            acc |= (uint64_t)src[o++] << bits;
+            bits += 8;
+        }
+        uint64_t z = acc & mask;
+        acc >>= width;
+        bits -= width;
+        dst[i] = dst[i - 1] + unzigzag(z);
+    }
+    return n;
+}
+
+// ---------------------------------------------------------------------------
+// String dictionary builder.
+//
+// Input: concatenated UTF-8 bytes + int64 offsets[n+1].  Output: int32
+// codes[n] (code = index of first occurrence among uniques, in
+// first-appearance order) and uniq_idx[] = row index of each unique's first
+// occurrence.  Python sorts the (small) unique set and remaps codes so code
+// order == lexicographic order (see presto_tpu/batch.py encode_strings).
+// Open-addressing hash map over byte slices, xxh64 hashed.
+// ---------------------------------------------------------------------------
+
+PT_EXPORT int64_t pt_dict_encode(const uint8_t* bytes, const int64_t* offsets,
+                                 int64_t n, int32_t* codes, int64_t* uniq_idx,
+                                 int64_t max_uniques) {
+    if (n == 0) return 0;
+    // table size: next pow2 >= 2n
+    int64_t tsize = 1;
+    while (tsize < 2 * n) tsize <<= 1;
+    int64_t* slots = (int64_t*)malloc((size_t)tsize * sizeof(int64_t));
+    if (!slots) return -2;
+    for (int64_t i = 0; i < tsize; i++) slots[i] = -1;
+    int64_t n_uniq = 0;
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t* s = bytes + offsets[i];
+        int64_t len = offsets[i + 1] - offsets[i];
+        uint64_t h = pt_xxh64(s, len, 0);
+        int64_t slot = (int64_t)(h & (uint64_t)(tsize - 1));
+        int32_t code = -1;
+        while (true) {
+            int64_t u = slots[slot];
+            if (u < 0) {
+                if (n_uniq >= max_uniques) { free(slots); return -1; }
+                slots[slot] = n_uniq;
+                uniq_idx[n_uniq] = i;
+                code = (int32_t)n_uniq;
+                n_uniq++;
+                break;
+            }
+            int64_t j = uniq_idx[u];
+            int64_t jlen = offsets[j + 1] - offsets[j];
+            if (jlen == len && memcmp(bytes + offsets[j], s, (size_t)len) == 0) {
+                code = (int32_t)u;
+                break;
+            }
+            slot = (slot + 1) & (tsize - 1);
+        }
+        codes[i] = code;
+    }
+    free(slots);
+    return n_uniq;
+}
+
+// ---------------------------------------------------------------------------
+// Selection utilities (spill/scan paths)
+// ---------------------------------------------------------------------------
+
+PT_EXPORT int64_t pt_sel_to_idx(const uint8_t* mask, int64_t n, int64_t* out) {
+    int64_t c = 0;
+    for (int64_t i = 0; i < n; i++) {
+        if (mask[i]) out[c++] = i;
+    }
+    return c;
+}
+
+// gather rows of a fixed-width column by int64 indices
+PT_EXPORT void pt_gather(const uint8_t* src, int64_t elem_size,
+                         const int64_t* idx, int64_t n_idx, uint8_t* dst) {
+    for (int64_t i = 0; i < n_idx; i++) {
+        memcpy(dst + i * elem_size, src + idx[i] * elem_size, (size_t)elem_size);
+    }
+}
+
+PT_EXPORT int32_t pt_version() { return 1; }

@@ -130,8 +130,18 @@ class Batch:
 def encode_strings(values: np.ndarray) -> tuple[np.ndarray, Dictionary]:
     """Dictionary-encode a host string column -> (int32 codes, Dictionary).
     The dictionary is SORTED so that code order == lexicographic order,
-    making ORDER BY / comparisons on strings pure integer ops on device."""
-    uniq, codes = np.unique(np.asarray(values, dtype=object).astype(str), return_inverse=True)
+    making ORDER BY / comparisons on strings pure integer ops on device.
+    The O(n) hashing pass runs in the native C++ library when available
+    (presto_tpu/native pt_dict_encode); numpy np.unique otherwise."""
+    from presto_tpu import native
+
+    arr = np.asarray(values, dtype=object).astype(str)
+    if len(arr) >= 4096:
+        encoded = native.dict_encode(arr)
+        if encoded is not None:
+            codes, uniq = encoded
+            return codes, Dictionary(uniq)
+    uniq, codes = np.unique(arr, return_inverse=True)
     return codes.astype(np.int32), Dictionary(uniq)
 
 

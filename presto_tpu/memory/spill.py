@@ -4,8 +4,11 @@ Reference parity: spiller/ (FileSingleStreamSpiller writing serialized
 pages to temp files, GenericPartitioningSpiller fanning rows out to
 per-partition spill files, SpillSpaceTracker accounting; docs
 admin/spill.rst).  Here a spill unit is a host-materialized column set
-(one partition of a Grace hash build), written as an .npz; device arrays
-are pulled to host exactly once on spill and re-uploaded on unspill.
+(one partition of a Grace hash build), written as a compressed,
+checksummed PTPG frame via the native C++ codec (presto_tpu/native,
+the PagesSerde/LZ4 analog of execution/buffer/PagesSerde.java:49-60);
+device arrays are pulled to host exactly once on spill and re-uploaded
+on unspill.
 """
 
 from __future__ import annotations
@@ -18,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from presto_tpu.batch import Batch, Column
+from presto_tpu.native import serde
 
 
 class SpillError(Exception):
@@ -66,9 +70,9 @@ class FileSpiller:
             if c.valid is not None:
                 arrays[f"v_{name}"] = np.asarray(c.valid)[sel]
             meta[name] = (c.type, c.dictionary)
-        path = os.path.join(self.dir, f"spill_{uuid.uuid4().hex}.npz")
+        path = os.path.join(self.dir, f"spill_{uuid.uuid4().hex}.ptpg")
         with open(path, "wb") as f:
-            np.savez(f, **arrays)
+            serde.write_stream(f, arrays)
         size = os.path.getsize(path)
         if self.tracker is not None:
             try:
@@ -82,14 +86,15 @@ class FileSpiller:
 
     def unspill(self, handle: str) -> Batch:
         meta = self._meta[handle]
-        with np.load(handle, allow_pickle=True) as z:
-            cols = {}
-            n = 0
-            for name, (typ, dictionary) in meta.items():
-                d = z[f"d_{name}"]
-                n = len(d)
-                v = z[f"v_{name}"] if f"v_{name}" in z.files else None
-                cols[name] = Column(d, v, typ, dictionary)
+        with open(handle, "rb") as f:
+            z = serde.read_stream(f)
+        cols = {}
+        n = 0
+        for name, (typ, dictionary) in meta.items():
+            d = z[f"d_{name}"]
+            n = len(d)
+            v = z.get(f"v_{name}")
+            cols[name] = Column(d, v, typ, dictionary)
         if n == 0:
             # kernels require capacity >= 1; an empty partition becomes one
             # dead (sel=False) row, the shape every operator already handles

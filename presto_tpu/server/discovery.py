@@ -18,8 +18,12 @@ import time
 import urllib.request
 from typing import Callable, Dict, List, Optional
 
+# A single observation contributes DECAY_ALPHA to the ratio, so the
+# threshold must exceed it by enough that one transient miss (GC pause,
+# dropped packet) cannot flip a node: with alpha=0.05, three consecutive
+# misses (~0.143) cross 0.1, one or two do not.
 FAILURE_RATIO_THRESHOLD = 0.1  # HeartbeatFailureDetector.java FAILURE_RATIO
-DECAY_ALPHA = 0.2  # exponential decay weight per observation
+DECAY_ALPHA = 0.05  # exponential decay weight per observation
 
 
 class NodeState:

@@ -229,9 +229,15 @@ def _make_handler(server: PrestoTpuServer):
                 job = server.jobs.get(parts[2])
                 if job is None:
                     return self._json({"error": "unknown query"}, 404)
+                try:
+                    token = int(parts[3])
+                except ValueError:
+                    return self._json({"error": "bad page token"}, 400)
+                if token < 0:
+                    return self._json({"error": "bad page token"}, 400)
                 if job.state in ("QUEUED", "RUNNING"):
                     job.done.wait(timeout=1.0)  # long poll
-                return self._json(server.results_payload(job, int(parts[3])))
+                return self._json(server.results_payload(job, token))
             if parts == ["v1", "query"]:
                 return self._json(server.query_list_payload())
             if parts[:2] == ["v1", "query"] and len(parts) == 3:

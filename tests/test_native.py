@@ -1,0 +1,168 @@
+"""Native C++ data plane tests: codec roundtrips (incl. fuzz), known
+hash vectors, encodings, serde framing, and spill integration.
+
+Reference analog: the PagesSerde/compression tests in
+presto-main/src/test/java/.../execution/buffer/TestPagesSerde.java.
+"""
+
+import numpy as np
+import pytest
+
+from presto_tpu import native
+from presto_tpu.native import serde
+
+
+def test_native_available():
+    # the image ships g++; the native path must actually build
+    assert native.available()
+
+
+def test_xxh64_vectors():
+    # spec vectors pin the implementation to real xxHash64
+    assert native.xxh64(b"") == 0xEF46DB3751D8E999
+    assert native.xxh64(b"abc") == 0x44BC2CF5AD770999
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_lz4_fuzz_roundtrip(seed):
+    rng = np.random.default_rng(seed)
+    kind = seed % 3
+    n = int(rng.integers(0, 300_000))
+    if kind == 0:  # highly compressible
+        data = bytes(rng.integers(0, 4, n, dtype=np.uint8))
+    elif kind == 1:  # incompressible
+        data = rng.bytes(n)
+    else:  # runs + structure
+        data = np.repeat(rng.integers(0, 255, max(n // 64, 1), dtype=np.uint8),
+                         64)[:n].tobytes()
+    c = native.lz4_compress(data)
+    assert c is not None
+    assert native.lz4_decompress(c, len(data)) == data
+
+
+def test_lz4_corruption_never_crashes():
+    # the block format carries no checksum (corruption detection is the
+    # PTPG frame's xxh64, tested below); the decoder's contract under
+    # corruption is: no crash / no overrun — either a clean error or a
+    # same-length-but-different output.
+    data = b"the quick brown fox " * 100
+    c = bytearray(native.lz4_compress(data))
+    for pos in range(0, len(c), 7):
+        bad = bytearray(c)
+        bad[pos] ^= 0xFF
+        try:
+            out = native.lz4_decompress(bytes(bad), len(data))
+        except ValueError:
+            continue
+        assert len(out) == len(data)
+
+
+def test_delta_pack_roundtrip():
+    rng = np.random.default_rng(1)
+    a = np.cumsum(rng.integers(-1000, 1000, 50_000)).astype(np.int64)
+    packed = native.delta_pack(a)
+    assert packed is not None
+    data, width, base = packed
+    assert (native.delta_unpack(data, width, base, len(a)) == a).all()
+    assert len(data) < a.nbytes // 2
+
+
+def test_delta_pack_declines_wide():
+    # random 64-bit values: width > 56 -> plain encoding upstream
+    rng = np.random.default_rng(2)
+    a = rng.integers(-(2**62), 2**62, 1000, dtype=np.int64)
+    assert native.delta_pack(a) is None
+
+
+def test_rle_roundtrip():
+    a = np.repeat(np.arange(100, dtype=np.int64), 77)
+    enc = native.rle_encode(a)
+    assert enc is not None
+    values, runs = enc
+    assert len(values) == 100
+    assert (native.rle_decode(values, runs, len(a)) == a).all()
+
+
+def test_dict_encode_matches_numpy():
+    rng = np.random.default_rng(3)
+    strs = np.array(
+        ["k%04d" % v for v in rng.integers(0, 500, 20_000)], dtype=object)
+    out = native.dict_encode(strs)
+    assert out is not None
+    codes, uniq = out
+    ref_uniq, ref_codes = np.unique(strs.astype(str), return_inverse=True)
+    assert (uniq.astype(str) == ref_uniq).all()
+    assert (codes == ref_codes).all()
+
+
+def test_minmax_gather_sel():
+    rng = np.random.default_rng(6)
+    a = rng.integers(-10_000, 10_000, 5000).astype(np.int64)
+    assert native.minmax(a) == (int(a.min()), int(a.max()))
+    f = rng.random(5000)
+    lo, hi = native.minmax(f)
+    assert lo == f.min() and hi == f.max()
+    assert native.minmax(np.empty(0, np.int64)) == (None, None)
+    mask = rng.random(5000) < 0.2
+    idx = native.sel_to_idx(mask)
+    assert (idx == np.flatnonzero(mask)).all()
+    for dt in (np.int64, np.int32, np.float64, np.bool_):
+        col = a.astype(dt)
+        assert (native.gather(col, idx) == col[idx]).all()
+
+
+def test_serde_stream_roundtrip(tmp_path):
+    rng = np.random.default_rng(7)
+    cols = {"a": np.cumsum(rng.integers(0, 9, 20_000)).astype(np.int64),
+            "b": rng.random(20_000),
+            "c": (rng.random(20_000) < 0.5)}
+    p = tmp_path / "stream.ptpg"
+    with open(p, "wb") as f:
+        n = serde.write_stream(f, cols)
+    assert n == p.stat().st_size
+    with open(p, "rb") as f:
+        back = serde.read_stream(f)
+    for k, v in cols.items():
+        assert (back[k] == v).all()
+
+
+def test_serde_roundtrip_and_checksum():
+    rng = np.random.default_rng(4)
+    cols = {
+        "i64": np.cumsum(rng.integers(0, 50, 10_000)).astype(np.int64),
+        "f64": rng.random(10_000),
+        "i32": rng.integers(0, 7, 10_000).astype(np.int32),
+        "mask": rng.random(10_000) < 0.5,
+        "empty": np.empty(0, dtype=np.float64),
+    }
+    buf = serde.serialize_columns(cols)
+    back = serde.deserialize_columns(buf)
+    for k, v in cols.items():
+        assert back[k].dtype == v.dtype
+        assert (back[k] == v).all()
+    # flip one payload byte -> checksum must catch it
+    bad = bytearray(buf)
+    bad[len(bad) // 2] ^= 0x01
+    with pytest.raises(ValueError):
+        serde.deserialize_columns(bytes(bad))
+
+
+def test_spiller_uses_native_frames(tmp_path):
+    from presto_tpu import types as T
+    from presto_tpu.batch import batch_from_numpy
+    from presto_tpu.memory.spill import FileSpiller
+
+    rng = np.random.default_rng(5)
+    b = batch_from_numpy(
+        {"x": rng.integers(0, 1000, 5000).astype(np.int64),
+         "s": np.array(["v%d" % v for v in rng.integers(0, 30, 5000)], dtype=object)},
+        {"x": T.BIGINT, "s": T.VARCHAR},
+    )
+    sp = FileSpiller(str(tmp_path))
+    handle = sp.spill(b)
+    assert handle.endswith(".ptpg")
+    back = sp.unspill(handle)
+    assert (np.asarray(back.columns["x"].data) == np.asarray(b.columns["x"].data)).all()
+    assert (np.asarray(back.columns["s"].data) == np.asarray(b.columns["s"].data)).all()
+    assert back.columns["s"].dictionary is b.columns["s"].dictionary
+    sp.close()
