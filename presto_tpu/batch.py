@@ -149,6 +149,10 @@ def column_from_numpy(data: np.ndarray, typ: Type, valid: Optional[np.ndarray] =
     dictionary = None
     if typ.is_string and data.dtype.kind in ("U", "S", "O"):
         data, dictionary = encode_strings(data)
+    if typ.is_decimal and data.dtype.kind == "f":
+        # host floats (e.g. a decoded decimal column re-ingested via
+        # CTAS/INSERT) carry the unscaled value; rescale, don't truncate
+        data = np.round(data * (10 ** typ.decimal_scale))
     data = np.ascontiguousarray(data, dtype=typ.numpy_dtype())
     v = None if valid is None else jnp.asarray(valid, dtype=bool)
     return Column(jnp.asarray(data), v, typ, dictionary)

@@ -49,6 +49,15 @@ class ConnectorTable:
     def max_rows_per_key(self) -> Dict[tuple, int]:
         return {}
 
+    def _invalidate(self) -> None:
+        """Drop cached device columns + bump the catalog version after a
+        write (compiled-plan caches key on catalog version)."""
+        if hasattr(self, "_device_cols"):
+            del self._device_cols
+        cat = getattr(self, "_catalog", None)
+        if cat is not None:
+            cat.version += 1
+
 
 class MemoryTable(ConnectorTable):
     """In-memory table (reference: presto-memory connector)."""
@@ -81,6 +90,27 @@ class MemoryTable(ConnectorTable):
         a, b = split if split is not None else (0, self._rows)
         return {c: self.data[c][a:b] for c in cols}
 
+    # ---- write SPI (reference: ConnectorPageSinkProvider; the memory
+    # connector's MemoryPagesStore.add) ----
+    def append(self, arrays: Dict[str, np.ndarray]) -> int:
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        if n == 0:
+            return 0
+        if self._rows == 0:
+            self.data = {c: np.asarray(arrays[c]) for c in self.schema}
+        else:
+            self.data = {c: np.concatenate([self.data[c], np.asarray(arrays[c])])
+                         for c in self.schema}
+        self._rows += n
+        self._invalidate()
+        return n
+
+    def delete_where(self, keep_mask: np.ndarray) -> int:
+        deleted = int((~keep_mask).sum())
+        self.data = {c: v[keep_mask] for c, v in self.data.items()}
+        self._rows -= deleted
+        self._invalidate()
+        return deleted
 
 class TpchTable(ConnectorTable):
     """TPC-H generator table (reference: presto-tpch), with a host disk
@@ -148,7 +178,17 @@ class Catalog:
 
     def register(self, table: ConnectorTable) -> None:
         self.tables[table.name.lower()] = table
+        table._catalog = self  # mutation hooks bump version (write path)
         self.version += 1
+
+    def drop(self, name: str, if_exists: bool = False) -> bool:
+        t = self.tables.pop(name.lower(), None)
+        if t is None:
+            if if_exists:
+                return False
+            raise KeyError(f"Table '{name}' does not exist")
+        self.version += 1
+        return True
 
     def register_memory(self, name: str, schema: Dict[str, T.Type],
                         data: Dict[str, np.ndarray]) -> None:
@@ -168,4 +208,57 @@ def tpch_catalog(sf: float = 0.01, cache_dir: Optional[str] = None) -> Catalog:
     cat = Catalog()
     for name in tpch_gen.SCHEMAS:
         cat.register(TpchTable(name, sf, cache_dir))
+    return cat
+
+
+class TpcdsTable(ConnectorTable):
+    """TPC-DS generator table (reference: presto-tpcds), same disk-cache
+    scheme as TpchTable."""
+
+    def __init__(self, name: str, sf: float, cache_dir: Optional[str] = None):
+        from presto_tpu.connectors import tpcds as tpcds_gen
+
+        super().__init__(name, tpcds_gen.SCHEMAS[name])
+        self._gen = tpcds_gen
+        self.sf = sf
+        self.cache_dir = cache_dir
+
+    def row_count(self) -> int:
+        return self._gen.row_count(self.name, self.sf)
+
+    def splits(self, n_splits):
+        return self._gen.split_ranges(self.name, self.sf, n_splits)
+
+    def read(self, columns=None, split=None):
+        cols = columns if columns is not None else list(self.schema)
+        data = self._full_table()
+        if split is not None:
+            a, b = split
+            return {c: data[c][a:b] for c in cols}
+        return {c: data[c] for c in cols}
+
+    def _full_table(self):
+        if not hasattr(self, "_data"):
+            path = None
+            if self.cache_dir:
+                os.makedirs(self.cache_dir, exist_ok=True)
+                path = os.path.join(self.cache_dir,
+                                    f"tpcds_{self.name}_sf{self.sf}.pkl")
+            if path and os.path.exists(path):
+                with open(path, "rb") as f:
+                    self._data = pickle.load(f)
+            else:
+                self._data = self._gen.generate(self.name, self.sf)
+                if path:
+                    with open(path, "wb") as f:
+                        pickle.dump(self._data, f, protocol=4)
+        return self._data
+
+
+def tpcds_catalog(sf: float = 0.01, cache_dir: Optional[str] = None) -> Catalog:
+    from presto_tpu.connectors import tpcds as tpcds_gen
+
+    cat = Catalog()
+    for name in tpcds_gen.SCHEMAS:
+        cat.register(TpcdsTable(name, sf, cache_dir))
     return cat

@@ -1,0 +1,179 @@
+"""Local-file connector over the native shard format.
+
+Reference parity: presto-local-file + the presto-raptor storage model
+(ORC shards on local disk, metadata in a store); here a table is a
+directory of .ptsh shard files written by the engine itself (CTAS /
+INSERT target) and scanned with stripe-level zone-map pruning
+(presto-orc's row-group pruning analog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.catalog import ConnectorTable
+from presto_tpu.storage.shard import Domain, ShardReader, write_shard
+
+
+class LocalFileTable(ConnectorTable):
+    """A directory of shard files + a schema.json sidecar."""
+
+    def __init__(self, name: str, directory: str,
+                 schema: Optional[Dict[str, T.Type]] = None):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        meta_path = os.path.join(directory, "schema.json")
+        if schema is None:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            schema = {c: T.parse_type(t) for c, t in meta["schema"].items()}
+        else:
+            with open(meta_path, "w") as f:
+                json.dump({"schema": {c: str(t) for c, t in schema.items()}}, f)
+        super().__init__(name, schema)
+
+    # ---- read path ---------------------------------------------------
+    def _shards(self) -> List[str]:
+        return sorted(
+            os.path.join(self.dir, p) for p in os.listdir(self.dir)
+            if p.endswith(".ptsh"))
+
+    def _readers(self) -> List[ShardReader]:
+        paths = tuple(self._shards())
+        cached = getattr(self, "_reader_cache", None)
+        if cached is None or cached[0] != paths:
+            self._reader_cache = (paths, [ShardReader(p) for p in paths])
+        return self._reader_cache[1]
+
+    def row_count(self) -> int:
+        return sum(r.nrows for r in self._readers())
+
+    def splits(self, n_splits: int) -> List[Tuple[int, int]]:
+        n = self.row_count()
+        edges = np.linspace(0, n, n_splits + 1).astype(int)
+        return [(int(a), int(b)) for a, b in zip(edges[:-1], edges[1:]) if a < b]
+
+    def read(self, columns=None, split=None,
+             domains: Optional[Dict[str, Domain]] = None) -> Dict[str, np.ndarray]:
+        """Read columns, decoding only what is needed: a split maps to
+        the overlapping stripes (stripe = the IO unit, as in the
+        reference's ORC row groups), and zone-map domains prune stripes
+        before any frame is decompressed."""
+        cols = columns if columns is not None else list(self.schema)
+        parts: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
+        base = 0  # global row offset of the current reader
+        a, b = split if split is not None else (0, None)
+        for r in self._readers():
+            if b is not None and base >= b:
+                break
+            pruned = set(r.select_stripes(domains)) if domains else None
+            take = []
+            slices = []
+            for si, (s0, s1) in enumerate(r.stripe_row_ranges()):
+                g0, g1 = base + s0, base + s1  # stripe's global row range
+                lo = max(g0, a)
+                hi = g1 if b is None else min(g1, b)
+                if lo >= hi:
+                    continue
+                if pruned is not None and si not in pruned:
+                    continue
+                take.append(si)
+                slices.append((lo - g0, hi - g0))
+            if take:
+                data = r.read(cols, take)
+                # offsets of each taken stripe within the concatenated read
+                ranges = r.stripe_row_ranges()
+                concat_off = 0
+                for si, (s_lo, s_hi) in zip(take, slices):
+                    n_stripe = ranges[si][1] - ranges[si][0]
+                    for c in cols:
+                        parts[c].append(
+                            data[c][concat_off + s_lo:concat_off + s_hi])
+                    concat_off += n_stripe
+            base += r.nrows
+        out = {}
+        for c in cols:
+            out[c] = (np.concatenate(parts[c]) if parts[c]
+                      else np.empty(0, self.schema[c].numpy_dtype()
+                                    if not self.schema[c].is_string else object))
+        return out
+
+    def pruned_stats(self, domains: Optional[Dict[str, Domain]]):
+        """(kept_stripes, total_stripes) — observability for EXPLAIN/tests."""
+        kept = total = 0
+        for r in self._readers():
+            total += r.n_stripes
+            kept += len(r.select_stripes(domains))
+        return kept, total
+
+    # ---- write path (reference: ConnectorPageSinkProvider) -----------
+    def append(self, arrays: Dict[str, np.ndarray]) -> int:
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        if n == 0:
+            return 0
+        idx = len(self._shards())
+        path = os.path.join(self.dir, f"shard_{idx:06d}.ptsh")
+        write_shard(path, {c: arrays[c] for c in self.schema}, self.schema)
+        self._invalidate()
+        return n
+
+    def delete_where(self, keep_mask: np.ndarray) -> int:
+        """Rewrite shards keeping only masked rows (reference: Raptor
+        compaction-style delete; row-level deletes rewrite the shard)."""
+        data = self.read()
+        deleted = int((~keep_mask).sum())
+        for p in self._shards():
+            os.remove(p)
+        kept = {c: v[keep_mask] for c, v in data.items()}
+        if len(next(iter(kept.values()), [])) > 0:
+            write_shard(os.path.join(self.dir, "shard_000000.ptsh"),
+                        kept, self.schema)
+        self._invalidate()
+        return deleted
+
+    def drop_data(self) -> None:
+        """Remove managed storage on DROP TABLE (the table owns its
+        directory; leaving shards behind would resurrect old data on a
+        same-name re-create)."""
+        for p in self._shards():
+            os.remove(p)
+        meta = os.path.join(self.dir, "schema.json")
+        if os.path.exists(meta):
+            os.remove(meta)
+        self._invalidate()
+
+    def _invalidate(self):
+        if hasattr(self, "_reader_cache"):
+            del self._reader_cache
+        super()._invalidate()
+
+
+class BlackholeTable(ConnectorTable):
+    """Null source/sink (reference: presto-blackhole) — swallows writes,
+    scans empty; perf testing the write path without storage cost."""
+
+    def __init__(self, name: str, schema: Dict[str, T.Type]):
+        super().__init__(name, schema)
+        self.rows_written = 0
+
+    def row_count(self) -> int:
+        return 0
+
+    def splits(self, n_splits):
+        return []
+
+    def read(self, columns=None, split=None):
+        cols = columns if columns is not None else list(self.schema)
+        return {c: np.empty(0, dtype=self.schema[c].numpy_dtype()
+                            if not self.schema[c].is_string else object)
+                for c in cols}
+
+    def append(self, arrays: Dict[str, np.ndarray]) -> int:
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        self.rows_written += n
+        return n

@@ -13,6 +13,7 @@ reference's gather exchanges from pre-requisite stages.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 import jax
@@ -85,13 +86,35 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
             text_plan = explain_text(session, stmt.statement)
         return QueryResult([("Query Plan", T.VARCHAR)], [(text_plan,)])
     if isinstance(stmt, ast.CreateTableAs):
-        inner = execute_plan_to_host(session, ast.QueryStatement(stmt.query))
-        arrays, types = inner
-        session.catalog.register_memory(stmt.name, types, arrays)
+        if stmt.name in session.catalog:
+            if stmt.if_not_exists:
+                return QueryResult([("rows", T.BIGINT)], [(0,)])
+            raise ExecutionError(f"Table '{stmt.name}' already exists")
+        arrays, types = execute_plan_to_host(session, ast.QueryStatement(stmt.query))
+        _create_table(session, stmt.name, types, stmt.properties, arrays)
         n = len(next(iter(arrays.values()))) if arrays else 0
         return QueryResult([("rows", T.BIGINT)], [(n,)])
+    if isinstance(stmt, ast.CreateTable):
+        if stmt.name in session.catalog:
+            if stmt.if_not_exists:
+                return QueryResult([("result", T.BOOLEAN)], [(True,)])
+            raise ExecutionError(f"Table '{stmt.name}' already exists")
+        schema = {c: T.parse_type(t) for c, t in stmt.columns}
+        _create_table(session, stmt.name, schema, stmt.properties, None)
+        return QueryResult([("result", T.BOOLEAN)], [(True,)])
+    if isinstance(stmt, ast.DropTable):
+        if stmt.name in session.catalog:
+            t = session.catalog.get(stmt.name)
+            if hasattr(t, "drop_data"):
+                t.drop_data()  # engine-managed storage goes with the table
+        session.catalog.drop(stmt.name, stmt.if_exists)
+        return QueryResult([("result", T.BOOLEAN)], [(True,)])
     if isinstance(stmt, ast.InsertInto):
-        raise ExecutionError("INSERT INTO not supported yet")
+        n = _insert_into(session, stmt)
+        return QueryResult([("rows", T.BIGINT)], [(n,)])
+    if isinstance(stmt, ast.Delete):
+        n = _delete_from(session, stmt)
+        return QueryResult([("rows", T.BIGINT)], [(n,)])
 
     if session.properties.get("distributed", False):
         from presto_tpu.parallel.dist_executor import run_distributed
@@ -119,6 +142,131 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
     with mon.phase("execute"):
         ex = Executor(session, monitor=mon)
         return ex.run(plan)
+
+
+def _create_table(session, name, schema, properties, arrays):
+    """Create + register a table on the connector chosen by WITH
+    properties (reference: StaticCatalogStore catalogs + per-connector
+    getPageSinkProvider; default is the memory connector)."""
+    connector = str(properties.get("connector", "memory")).lower()
+    if arrays is not None:
+        clean = {}
+        for c, a in arrays.items():
+            if isinstance(a, np.ma.MaskedArray):
+                if a.mask is not np.ma.nomask and np.any(a.mask):
+                    raise ExecutionError(
+                        f"CTAS with NULL values in column '{c}' is not "
+                        "supported by this connector")
+                a = a.data
+            clean[c] = np.asarray(a)
+        arrays = clean
+    if connector == "memory":
+        session.catalog.register_memory(name, schema,
+                                        arrays if arrays is not None else
+                                        {c: np.empty(0, t.numpy_dtype()
+                                                     if not t.is_string else object)
+                                         for c, t in schema.items()})
+        return
+    if connector == "blackhole":
+        from presto_tpu.connectors.localfile import BlackholeTable
+
+        t = BlackholeTable(name, schema)
+        session.catalog.register(t)
+        if arrays is not None:
+            t.append(arrays)
+        return
+    if connector == "localfile":
+        import tempfile
+
+        from presto_tpu.connectors.localfile import LocalFileTable
+
+        directory = properties.get("directory") or os.path.join(
+            session.properties.get("localfile_root",
+                                   os.path.join(tempfile.gettempdir(),
+                                                "presto_tpu_tables")),
+            name)
+        t = LocalFileTable(name, directory, schema)
+        session.catalog.register(t)
+        if arrays is not None:
+            t.append(arrays)
+        return
+    raise ExecutionError(f"unknown connector '{connector}'")
+
+
+def _insert_into(session, stmt: ast.InsertInto) -> int:
+    """INSERT INTO t [(cols)] query — reference: TableWriterOperator +
+    TableFinishOperator; here the query materializes to host columns that
+    are coerced to the target schema and appended via the connector sink."""
+    table = session.catalog.get(stmt.table)
+    if not hasattr(table, "append"):
+        raise ExecutionError(f"table '{stmt.table}' does not support INSERT")
+    arrays, types = execute_plan_to_host(session, ast.QueryStatement(stmt.query))
+    src_cols = list(arrays)
+    targets = stmt.columns if stmt.columns is not None else list(table.schema)
+    if len(src_cols) != len(targets):
+        raise ExecutionError(
+            f"INSERT column count mismatch: query produces {len(src_cols)}, "
+            f"target list has {len(targets)}")
+    unknown = [c for c in targets if c not in table.schema]
+    if unknown:
+        raise ExecutionError(f"unknown INSERT columns: {unknown}")
+    missing = [c for c in table.schema if c not in targets]
+    if missing:
+        raise ExecutionError(
+            f"INSERT must cover all columns (missing {missing}); "
+            "partial inserts with null fill are not supported yet")
+    out = {}
+    for tgt, src in zip(targets, src_cols):
+        want = table.schema[tgt]
+        a = arrays[src]
+        if isinstance(a, np.ma.MaskedArray):
+            if a.mask is not np.ma.nomask and np.any(a.mask):
+                # the memory/shard sinks store raw arrays (no validity
+                # mask); silently writing fill values would corrupt NULLs
+                raise ExecutionError(
+                    f"INSERT of NULL values into column '{tgt}' is not "
+                    "supported by this connector")
+            a = a.data
+        a = np.asarray(a)
+        have = types.get(src, want)
+        if have != want and not T.can_coerce(have, want) \
+                and not (have.is_numeric and want.is_numeric):
+            raise ExecutionError(
+                f"cannot insert {have} into {tgt} ({want})")
+        if want.is_decimal and a.dtype.kind == "f":
+            # decoded decimals arrive as unscaled floats; rescale like
+            # batch.column_from_numpy, never truncate
+            a = np.round(a * (10 ** want.decimal_scale)).astype(np.int64)
+        elif not want.is_string and a.dtype != want.numpy_dtype() \
+                and a.dtype != object:
+            a = a.astype(want.numpy_dtype())
+        out[tgt] = a
+    return table.append(out)
+
+
+def _delete_from(session, stmt: ast.Delete) -> int:
+    """DELETE FROM t [WHERE pred]: evaluate the predicate over the whole
+    table (a scan+project plan, preserving row order) and hand the keep
+    mask to the connector (reference: MetadataDeleteOperator /
+    DeleteOperator)."""
+    table = session.catalog.get(stmt.table)
+    if not hasattr(table, "delete_where"):
+        raise ExecutionError(f"table '{stmt.table}' does not support DELETE")
+    n = table.row_count()
+    if stmt.where is None:
+        keep = np.zeros(n, dtype=bool)
+        return table.delete_where(keep)
+    # SELECT <pred> FROM t  — project-only plan, row order == table order
+    q = ast.Query(
+        body=ast.QuerySpec(
+            select=[ast.SelectItem(stmt.where, "__pred__")],
+            from_=ast.Table(stmt.table)))
+    arrays, _types = execute_plan_to_host(session, ast.QueryStatement(q))
+    pred = next(iter(arrays.values()))
+    if isinstance(pred, np.ma.MaskedArray):
+        pred = pred.filled(False)
+    keep = ~np.asarray(pred, dtype=bool)  # NULL predicate rows are kept
+    return table.delete_where(keep)
 
 
 def _collect_tablescans(node: P.PlanNode, out: list):
@@ -211,7 +359,9 @@ def execute_plan_to_host(session, stmt):
         if i:
             n = f"{name}_{i}"
         a = arrays[sym]
-        result[n] = np.asarray(a[sel])
+        v = a[sel]
+        # keep the mask — write sinks must see NULLs to reject/handle them
+        result[n] = v if isinstance(v, np.ma.MaskedArray) else np.asarray(v)
         types[n] = dict(out.source.outputs())[sym] if sym in dict(out.source.outputs()) else T.VARCHAR
     return result, types
 

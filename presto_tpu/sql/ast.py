@@ -276,6 +276,8 @@ class ShowColumns(Statement):
 class CreateTableAs(Statement):
     name: str
     query: Query
+    properties: dict = field(default_factory=dict)
+    if_not_exists: bool = False
 
 
 @dataclass
@@ -283,6 +285,33 @@ class InsertInto(Statement):
     table: str
     columns: Optional[List[str]]
     query: Query
+
+
+@dataclass
+class CreateTable(Statement):
+    """CREATE TABLE t (col type, ...) [WITH (k = v, ...)] — reference:
+    SqlBase.g4 createTable; WITH properties select the connector
+    (connector = 'memory' | 'localfile' | 'blackhole')."""
+
+    name: str
+    columns: List[tuple]  # (name, type_text)
+    properties: dict
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class Delete(Statement):
+    """DELETE FROM t [WHERE pred] — reference: SqlBase.g4 delete,
+    executed as a keep-mask rewrite (MetadataDeleteOperator analog)."""
+
+    table: str
+    where: Optional[Expr]
 
 
 @dataclass

@@ -43,7 +43,7 @@ KEYWORDS = {
     "EXPLAIN", "ANALYZE", "SHOW", "TABLES", "COLUMNS", "CREATE", "TABLE",
     "INSERT", "INTO", "SET", "SESSION", "OVER", "PARTITION", "ROWS", "RANGE",
     "UNBOUNDED", "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "UNNEST",
-    "ORDINALITY", "FILTER",
+    "ORDINALITY", "FILTER", "DROP", "DELETE", "IF",
 }
 
 
@@ -144,7 +144,7 @@ class Parser:
         if t.kind == "kw" and t.value in ("DATE", "TIME", "TIMESTAMP", "VALUES",
                                           "FILTER", "ROW", "ANALYZE", "SESSION",
                                           "TABLES", "COLUMNS", "FIRST", "LAST",
-                                          "ALL", "SET", "SHOW"):
+                                          "ALL", "SET", "SHOW", "IF"):
             self.i += 1
             return t.value.lower()
         self.err("expected identifier")
@@ -180,9 +180,42 @@ class Parser:
             self.err("expected TABLES or COLUMNS")
         if self.accept_kw("CREATE"):
             self.expect_kw("TABLE")
+            if_not_exists = False
+            if self.accept_kw("IF"):
+                self.expect_kw("NOT")
+                self.expect_kw("EXISTS")
+                if_not_exists = True
             name = self.ident()
+            if self.accept_op("("):  # CREATE TABLE t (col type, ...)
+                columns = []
+                while True:
+                    cname = self.ident()
+                    columns.append((cname, self._type_name()))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                props = self._with_properties()
+                return ast.CreateTable(name, columns, props, if_not_exists)
+            props = self._with_properties()
             self.expect_kw("AS")
-            return ast.CreateTableAs(name, self.parse_query())
+            stmt = ast.CreateTableAs(name, self.parse_query())
+            stmt.properties = props  # connector choice rides WITH(...)
+            stmt.if_not_exists = if_not_exists
+            return stmt
+        if self.accept_kw("DROP"):
+            self.expect_kw("TABLE")
+            if_exists = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                if_exists = True
+            return ast.DropTable(self.ident(), if_exists)
+        if self.accept_kw("DELETE"):
+            self.expect_kw("FROM")
+            name = self.ident()
+            where = None
+            if self.accept_kw("WHERE"):
+                where = self.expr()
+            return ast.Delete(name, where)
         if self.accept_kw("INSERT"):
             self.expect_kw("INTO")
             name = self.ident()
@@ -637,7 +670,8 @@ class Parser:
             self.expect_op(")")
             return e
         if t.kind == "ident" or (t.kind == "kw" and t.value in (
-                "DATE", "TIME", "TIMESTAMP", "FILTER", "ROW", "FIRST", "LAST", "SET", "VALUES")):
+                "DATE", "TIME", "TIMESTAMP", "FILTER", "ROW", "FIRST", "LAST",
+                "SET", "VALUES", "IF")):
             name = self.ident()
             if self.at_op("("):
                 return self._function_call(name)
@@ -647,6 +681,30 @@ class Parser:
                 parts.append(self.ident())
             return ast.Identifier(tuple(parts))
         self.err("expected expression")
+
+    def _with_properties(self) -> dict:
+        """WITH (k = v, ...) table properties (reference: SqlBase.g4
+        `properties`; e.g. WITH (connector = 'localfile'))."""
+        props: dict = {}
+        if not (self.at_kw("WITH") and self.peek(1).kind == "op"
+                and self.peek(1).value == "("):
+            return props
+        self.next()
+        self.expect_op("(")
+        while True:
+            key = self.ident()
+            self.expect_op("=")
+            t = self.next()
+            if t.kind == "number":
+                props[key] = float(t.value) if "." in t.value else int(t.value)
+            elif t.kind == "kw" and t.value in ("TRUE", "FALSE"):
+                props[key] = t.value == "TRUE"
+            else:
+                props[key] = t.value
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return props
 
     def _type_name(self) -> str:
         name = self.next()

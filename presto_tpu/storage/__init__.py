@@ -1,0 +1,2 @@
+from presto_tpu.storage.shard import (  # noqa: F401
+    Domain, ShardReader, write_shard)

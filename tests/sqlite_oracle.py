@@ -15,12 +15,16 @@ from presto_tpu.connectors import tpch as tpch_gen
 _CONNS: dict = {}
 
 
-def build_sqlite(sf: float = 0.01) -> sqlite3.Connection:
-    if sf in _CONNS:
-        return _CONNS[sf]
+def build_sqlite(sf: float = 0.01, generator=None) -> sqlite3.Connection:
+    """Load a generator module's tables (default: TPC-H; pass
+    presto_tpu.connectors.tpcds for TPC-DS) into an in-memory sqlite."""
+    gen = generator or tpch_gen
+    key = (gen.__name__, sf)
+    if key in _CONNS:
+        return _CONNS[key]
     conn = sqlite3.connect(":memory:")
-    for table, schema in tpch_gen.SCHEMAS.items():
-        data = tpch_gen.generate(table, sf)
+    for table, schema in gen.SCHEMAS.items():
+        data = gen.generate(table, sf)
         cols = list(schema)
         decls = []
         for c in cols:
@@ -48,7 +52,7 @@ def build_sqlite(sf: float = 0.01) -> sqlite3.Connection:
             f"INSERT INTO {table} VALUES ({','.join('?' * len(cols))})", rows
         )
     conn.commit()
-    _CONNS[sf] = conn
+    _CONNS[key] = conn
     return conn
 
 
