@@ -368,10 +368,21 @@ def execute_plan_to_host(session, stmt):
 
 def explain_text(session, stmt) -> str:
     plan = plan_statement(session, stmt)
-    lines = [P.plan_tree_str(plan.root)]
+    from presto_tpu.plan import stats as S
+
+    memo = {}
+
+    def ann(node):
+        try:
+            st = S.derive(node, session.catalog, memo)
+            return f"  {{rows: {st.est_rows:,.0f}}}"
+        except Exception:
+            return ""
+
+    lines = [P.plan_tree_str(plan.root, annotate=ann)]
     for pid, sub in sorted(plan.subplans.items()):
         lines.append(f"\nSubplan {pid}:")
-        lines.append(P.plan_tree_str(sub, 1))
+        lines.append(P.plan_tree_str(sub, 1, annotate=ann))
     return "\n".join(lines)
 
 
@@ -675,7 +686,25 @@ class Executor:
         distinct_aggs = {s: a for s, a in node.aggs.items() if a.distinct}
         plain_aggs = {s: a for s, a in node.aggs.items() if not a.distinct}
         if plain_aggs:
-            raise ExecutionError("mixing DISTINCT and plain aggregates not supported yet")
+            # evaluate the two halves separately and merge: both group
+            # passes enumerate the same key set in the same slot order
+            # (sorted-unique dynamically; hash slots statically), so the
+            # outputs align column-wise without a join (reference:
+            # MarkDistinct keeps one pass; this is the two-pass analog)
+            pb = self._aggregate(b, node.group_keys, plain_aggs)
+            db = self._exec_aggregate_with_distinct(
+                P.Aggregate(node.source, node.group_keys, distinct_aggs,
+                            node.step), b)
+            if pb.capacity != db.capacity:
+                raise ExecutionError("distinct/plain group alignment failed")
+            merged = dict(db.columns)
+            for s in plain_aggs:
+                merged[s] = pb.columns[s]
+            # preserve the aggregate-declaration order for output mapping
+            cols = {k: merged[k] for k in list(db.columns) if k not in node.aggs}
+            for s in node.aggs:
+                cols[s] = merged[s]
+            return Batch(cols, db.sel)
         dargs = {a.args[0].name for a in distinct_aggs.values()}
         if len(dargs) != 1:
             raise ExecutionError("multiple DISTINCT columns not supported yet")
@@ -757,8 +786,47 @@ class Executor:
         valid = mask if col.valid is None else (mask & col.valid)
         cnt = K.segment_sum(valid.astype(jnp.int64), gid, n_groups)
         nonempty = cnt > 0
-        if a.fn in ("count", "approx_distinct"):
+        if a.fn == "count":
             return Column(cnt, None, T.BIGINT)
+        if a.fn == "approx_distinct":
+            h = K._hash_keys([col], valid).astype(jnp.uint64)
+            est = K.hll_registers_and_estimate(h, valid, gid, n_groups)
+            return Column(est, None, T.BIGINT)
+        if a.fn == "checksum":
+            # order-independent 64-bit checksum: wrapping sum of row
+            # hashes (reference: ChecksumAggregationFunction, xor-based;
+            # any commutative mix works for A/B verification)
+            h = K._hash_keys([col], valid).astype(jnp.int64)
+            s = K.segment_sum(jnp.where(valid, h, 0), gid, n_groups)
+            return Column(s, nonempty, T.BIGINT)
+        if a.fn == "approx_percentile":
+            pv = eval_expr(a.args[1], b, self.ctx)
+            p = pv.data if getattr(pv.data, "ndim", 0) == 0 else pv.data[0]
+            x = col.data
+            vals, ok = K.group_percentile(x, valid, gid, n_groups, p)
+            return Column(vals.astype(col.data.dtype), ok, a.type,
+                          col.dictionary)
+        if a.fn in ("min_by", "max_by"):
+            yv = to_column(eval_expr(a.args[1], b, self.ctx), b.capacity)
+            # rank by KEY validity only: the winning row's value may be
+            # NULL and must be returned as NULL (Presto MinMaxByNState)
+            yvalid = mask if yv.valid is None else (mask & yv.valid)
+            yi = K._orderable_int(yv)
+            big = jnp.iinfo(jnp.int64).max
+            ykey = jnp.where(yvalid, yi, big if a.fn == "min_by" else -big)
+            extremum = (K.segment_min if a.fn == "min_by"
+                        else K.segment_max)(ykey, gid, n_groups)
+            hit = yvalid & (ykey == extremum[gid])
+            idx = K.segment_max(
+                jnp.where(hit, jnp.arange(b.capacity), -1), gid, n_groups)
+            safe = jnp.clip(idx, 0, b.capacity - 1)
+            ok = idx >= 0
+            val_valid = ok if col.valid is None else (ok & col.valid[safe])
+            return Column(col.data[safe], val_valid, a.type, col.dictionary)
+        if a.fn == "geometric_mean":
+            x = jnp.where(valid, col.data.astype(jnp.float64), 1.0)
+            s = K.segment_sum(jnp.log(jnp.maximum(x, 1e-300)), gid, n_groups)
+            return Column(jnp.exp(s / jnp.maximum(cnt, 1)), nonempty, T.DOUBLE)
         if a.fn == "sum":
             x = jnp.where(valid, col.data, jnp.zeros_like(col.data))
             s = K.segment_sum(x, gid, n_groups)

@@ -234,10 +234,13 @@ def _reassemble_join(root: P.Join, conjs: List[ir.RowExpr], session) -> P.PlanNo
         if not placed:
             remaining.append(c)
 
-    # stats-guided greedy join order (reference: ReorderJoins CBO, greedy
-    # variant): start from the largest source (the fact table becomes the
-    # probe side), then repeatedly join a connected source, preferring
-    # unique-key builds (FK joins lower to pure gathers) then small ones.
+    # cost-based greedy join order (reference: ReorderJoins — ours is the
+    # greedy variant over the selectivity-aware estimates in plan/stats.py):
+    # start from the largest-estimate source (the fact table becomes the
+    # probe side so hash builds stay small), then repeatedly join the
+    # connected source minimizing the estimated output cardinality,
+    # tie-breaking toward unique-key builds (FK joins lower to pure
+    # gathers on TPU) and then smaller build sides.
     from presto_tpu.plan import stats as S
 
     catalog = getattr(session, "catalog", None)
@@ -250,9 +253,11 @@ def _reassemble_join(root: P.Join, conjs: List[ir.RowExpr], session) -> P.PlanNo
 
     stats_list = [src_stats(i) for i in range(len(sources))]
     rows = [s.rows if s else 1 << 30 for s in stats_list]
-    start = max(range(len(sources)), key=lambda i: rows[i])
+    ests = [s.est_rows if s else float(1 << 30) for s in stats_list]
+    start = max(range(len(sources)), key=lambda i: ests[i])
 
     current = sources[start]
+    cur_stats = stats_list[start]
     cur_syms = set(src_syms[start])
     todo = [i for i in range(len(sources)) if i != start]
     while todo:
@@ -267,15 +272,21 @@ def _reassemble_join(root: P.Join, conjs: List[ir.RowExpr], session) -> P.PlanNo
                 rkeys = frozenset(pair[1] for _, pair in crits)
                 st = stats_list[i]
                 unique_build = bool(st and any(u <= rkeys for u in st.unique))
-                candidates.append((not unique_build, rows[i], i, crits))
+                if cur_stats is not None and st is not None:
+                    out_est = S.join_cardinality(
+                        cur_stats, st, [pair for _, pair in crits])
+                else:
+                    out_est = float(1 << 30)
+                candidates.append((out_est, not unique_build, rows[i], i, crits))
         if not candidates:
             i = todo[0]
             current = P.Join(current, sources[i], "CROSS")
             cur_syms |= src_syms[i]
+            cur_stats = None
             todo.remove(i)
             continue
-        candidates.sort(key=lambda t: (t[0], t[1]))
-        _, _, i, crits = candidates[0]
+        candidates.sort(key=lambda t: (t[0], t[1], t[2]))
+        _, _, _, i, crits = candidates[0]
         criteria = [pair for _, pair in crits]
         used = {id(c) for c, _ in crits}
         remaining = [c for c in remaining if id(c) not in used]
@@ -286,6 +297,10 @@ def _reassemble_join(root: P.Join, conjs: List[ir.RowExpr], session) -> P.PlanNo
         now, remaining = _split(remaining, cur_syms)
         if now:
             current = P.Filter(current, ir.combine_conjuncts(now))
+        try:
+            cur_stats = S.derive(current, catalog)
+        except Exception:
+            cur_stats = None
     if remaining:
         current = P.Filter(current, ir.combine_conjuncts(remaining))
     return current

@@ -27,11 +27,20 @@ class ColStats:
 
 @dataclasses.dataclass
 class NodeStats:
-    rows: int  # row-count estimate (upper bound for static sizing)
+    rows: int  # row-count UPPER BOUND (static shape sizing must trust it)
     cols: Dict[str, ColStats]
     unique: List[FrozenSet[str]]  # symbol sets known unique per row
     # max rows matching any single value of these key sets (join fanout bound)
     fanout: Dict[FrozenSet[str], int]
+    # CBO cardinality ESTIMATE (selectivity-aware, may undershoot; used for
+    # join ordering + distribution choice, never for static sizing).
+    # None -> fall back to rows.  Reference: PlanNodeStatsEstimate
+    # outputRowCount vs our additional static-shape contract.
+    est: Optional[float] = None
+
+    @property
+    def est_rows(self) -> float:
+        return self.rows if self.est is None else self.est
 
 
 def derive(node: P.PlanNode, catalog, memo=None) -> NodeStats:
@@ -76,7 +85,9 @@ def _derive(node, catalog, memo) -> NodeStats:
                          [], {})
     if isinstance(node, P.Filter):
         s = d(node.source)
-        return NodeStats(s.rows, s.cols, s.unique, s.fanout)
+        sel, cols = filter_selectivity(s, node.predicate)
+        est = max(1.0, s.est_rows * sel)
+        return NodeStats(s.rows, cols, s.unique, s.fanout, est)
     if isinstance(node, P.Project):
         s = d(node.source)
         cols = {}
@@ -95,7 +106,7 @@ def _derive(node, catalog, memo) -> NodeStats:
         for k, b in s.fanout.items():
             if all(x in rename for x in k):
                 fanout[frozenset(rename[x] for x in k)] = b
-        return NodeStats(s.rows, cols, unique, fanout)
+        return NodeStats(s.rows, cols, unique, fanout, s.est)
     if isinstance(node, P.Aggregate):
         s = d(node.source)
         cap = capacity_for_groups(node, s)
@@ -104,17 +115,21 @@ def _derive(node, catalog, memo) -> NodeStats:
             cols[sym] = ColStats()
         keyset = frozenset(node.group_keys)
         return NodeStats(cap, cols, [keyset] if node.group_keys else [],
-                         {keyset: 1} if node.group_keys else {})
+                         {keyset: 1} if node.group_keys else {},
+                         min(float(cap), s.est_rows))
     if isinstance(node, P.Join):
         ls, rs = d(node.left), d(node.right)
         if node.join_type in ("SEMI", "ANTI"):
-            return NodeStats(ls.rows, ls.cols, ls.unique, ls.fanout)
+            est = ls.est_rows * (0.5 if node.join_type == "SEMI" else 0.5)
+            return NodeStats(ls.rows, ls.cols, ls.unique, ls.fanout, est)
         cols = {**ls.cols, **rs.cols}
         rkeys = frozenset(rk for _, rk in node.criteria)
         build_unique = any(u <= rkeys for u in rs.unique)
         if node.join_type == "CROSS":
             rows = ls.rows * rs.rows
-            return NodeStats(rows, cols, [], {})
+            return NodeStats(rows, cols, [], {},
+                             ls.est_rows * rs.est_rows)
+        est = join_cardinality(ls, rs, node.criteria)
         bound = rs.fanout.get(_best_fanout_key(rs, rkeys), None)
         if build_unique:
             rows = ls.rows
@@ -126,31 +141,147 @@ def _derive(node, catalog, memo) -> NodeStats:
         else:
             rows = ls.rows * 4  # heuristic expansion guess (eager fallback)
             unique, fanout = [], {}
-        return NodeStats(rows, cols, unique, fanout)
+        if node.join_type in ("LEFT", "FULL"):
+            est = max(est, ls.est_rows)  # outer side survives
+        return NodeStats(rows, cols, unique, fanout, min(est, float(rows)))
     if isinstance(node, (P.Sort, P.Limit, P.TopN)):
         s = d(node.source)
         rows = s.rows
+        est = s.est_rows
         if isinstance(node, (P.Limit, P.TopN)):
             rows = min(rows, node.count)
-        return NodeStats(rows, s.cols, s.unique, s.fanout)
+            est = min(est, float(node.count))
+        return NodeStats(rows, s.cols, s.unique, s.fanout, est)
     if isinstance(node, P.Union):
         subs = [d(x) for x in node.sources_]
         rows = sum(x.rows for x in subs)
         cols = {sym: ColStats() for sym in node.symbols}
-        return NodeStats(rows, cols, [], {})
+        return NodeStats(rows, cols, [], {}, sum(x.est_rows for x in subs))
     if isinstance(node, P.Window):
         s = d(node.source)
         cols = dict(s.cols)
         for sym in node.functions:
             cols[sym] = ColStats()
-        return NodeStats(s.rows, cols, s.unique, s.fanout)
+        return NodeStats(s.rows, cols, s.unique, s.fanout, s.est)
     if isinstance(node, P.Exchange):
         # exchanges move rows, they don't change global cardinality
         return d(node.source)
     if isinstance(node, P.Output):
         s = d(node.source)
-        return NodeStats(s.rows, s.cols, s.unique, s.fanout)
+        return NodeStats(s.rows, s.cols, s.unique, s.fanout, s.est)
     raise TypeError(f"no stats rule for {type(node).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# CBO estimation rules (reference: cost/FilterStatsCalculator.java,
+# cost/JoinStatsRule.java)
+# ---------------------------------------------------------------------------
+
+UNKNOWN_FILTER_COEFFICIENT = 0.9   # reference: FilterStatsCalculator default
+COMPARISON_UNKNOWN = 1.0 / 3.0     # range predicate with unknown bounds
+EQ_UNKNOWN = 0.1
+LIKE_COEFFICIENT = 0.25
+
+
+def _lit_value(e) -> Optional[float]:
+    if isinstance(e, ir.Lit) and isinstance(e.value, (int, float, bool)):
+        return float(e.value)
+    return None
+
+
+def filter_selectivity(src: NodeStats, pred: ir.RowExpr
+                       ) -> Tuple[float, Dict[str, ColStats]]:
+    """Estimated fraction of rows surviving `pred`, plus narrowed column
+    stats for range predicates (containment assumption, like the
+    reference's FilterStatsCalculator)."""
+    cols = dict(src.cols)
+    sel = 1.0
+    for c in ir.conjuncts(pred):
+        sel *= _conjunct_selectivity(c, cols)
+    return max(min(sel, 1.0), 1e-9), cols
+
+
+def _conjunct_selectivity(c: ir.RowExpr, cols: Dict[str, ColStats]) -> float:
+    if not isinstance(c, ir.Call):
+        return UNKNOWN_FILTER_COEFFICIENT
+    fn = c.fn
+    if fn == "and":
+        return (_conjunct_selectivity(c.args[0], cols)
+                * _conjunct_selectivity(c.args[1], cols))
+    if fn == "or":
+        a = _conjunct_selectivity(c.args[0], dict(cols))
+        b = _conjunct_selectivity(c.args[1], dict(cols))
+        return min(1.0, a + b - a * b)
+    if fn == "not":
+        return max(0.0, 1.0 - _conjunct_selectivity(c.args[0], dict(cols)))
+    if fn == "is_null":
+        return 0.1
+    if fn == "like":
+        return LIKE_COEFFICIENT
+    if fn == "in":
+        # lowered as OR of eq upstream; if present directly, treat as eq*k
+        return min(1.0, EQ_UNKNOWN * max(1, len(c.args) - 1))
+    if fn in ("eq", "ne", "lt", "le", "gt", "ge") and len(c.args) == 2:
+        a, b = c.args
+        if isinstance(b, ir.Ref) and not isinstance(a, ir.Ref):
+            a, b = b, a
+            fn = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(fn, fn)
+        if not isinstance(a, ir.Ref):
+            return UNKNOWN_FILTER_COEFFICIENT
+        if isinstance(b, ir.Ref):
+            # column-to-column comparison (non-join residual)
+            return 0.5 if fn != "eq" else EQ_UNKNOWN
+        v = _lit_value(b)
+        cs = cols.get(a.name)
+        if fn == "eq":
+            if cs is not None and cs.ndv:
+                return 1.0 / cs.ndv
+            return EQ_UNKNOWN
+        if fn == "ne":
+            if cs is not None and cs.ndv:
+                return 1.0 - 1.0 / cs.ndv
+            return 1.0 - EQ_UNKNOWN
+        if v is None or cs is None or cs.min is None or cs.max is None \
+                or cs.max <= cs.min:
+            return COMPARISON_UNKNOWN
+        span = cs.max - cs.min
+        if fn in ("lt", "le"):
+            frac = (v - cs.min) / span
+            new = ColStats(cs.min, min(cs.max, v), cs.ndv)
+        else:
+            frac = (cs.max - v) / span
+            new = ColStats(max(cs.min, v), cs.max, cs.ndv)
+        frac = max(0.0, min(1.0, frac))
+        if frac > 0:
+            # narrow only the RANGE (a guaranteed bound on surviving
+            # rows); ndv * frac is an estimate, not a bound, and these
+            # ColStats feed static group-capacity sizing which must
+            # never undershoot (join_cardinality caps ndv by est_rows
+            # itself, so estimates still benefit)
+            cols[a.name] = ColStats(new.min, new.max, cs.ndv)
+        return frac
+    return UNKNOWN_FILTER_COEFFICIENT
+
+
+def join_cardinality(ls: NodeStats, rs: NodeStats, criteria) -> float:
+    """|L join R| ~= |L|*|R| / prod(max(ndv_l, ndv_r)) over the equi-keys,
+    ndv capped by the side's estimated rows (containment assumption) —
+    reference: JoinStatsRule's formula."""
+    est = ls.est_rows * rs.est_rows
+    if not criteria:
+        return est
+    for lk, rk in criteria:
+        lcs, rcs = ls.cols.get(lk), rs.cols.get(rk)
+        ndv_l = min(lcs.ndv, max(ls.est_rows, 1)) if lcs and lcs.ndv else None
+        ndv_r = min(rcs.ndv, max(rs.est_rows, 1)) if rcs and rcs.ndv else None
+        if ndv_l and ndv_r:
+            denom = max(ndv_l, ndv_r)
+        elif ndv_l or ndv_r:
+            denom = ndv_l or ndv_r
+        else:
+            denom = max(ls.est_rows, rs.est_rows, 1.0) * EQ_UNKNOWN
+        est /= max(denom, 1.0)
+    return max(est, 1.0)
 
 
 def _best_fanout_key(stats: NodeStats, keys: FrozenSet[str]):
