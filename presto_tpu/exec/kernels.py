@@ -236,6 +236,62 @@ def segment_max(x, gid, n_groups):
 # ---------------------------------------------------------------------------
 
 
+def hll_registers_and_estimate(h: jnp.ndarray, valid: jnp.ndarray,
+                               gid: jnp.ndarray, n_groups: int,
+                               m: int = 1024) -> jnp.ndarray:
+    """Vectorized HyperLogLog per group — the TPU-native
+    approx_distinct (reference: ApproximateCountDistinctAggregation over
+    airlift HLL sketches).  Instead of per-row sketch objects, all
+    n_groups*m registers live in one array updated by a single
+    segment_max; the bias-corrected estimate with small-range linear
+    counting follows the standard HLL formula.  m=1024 registers gives
+    ~3.25% standard error (1.04/sqrt(m)); for very large group counts m
+    shrinks so the register matrix stays bounded (~64MB) instead of
+    scaling to gigabytes with a static capacity hint."""
+    max_registers = 1 << 23
+    while m > 64 and n_groups * m > max_registers:
+        m //= 2
+    log2m = int(np.log2(m))
+    reg = (h & jnp.uint64(m - 1)).astype(jnp.int64)
+    w = ((h >> jnp.uint64(log2m)) & jnp.uint64(0xFFFFFFFF)).astype(jnp.float64)
+    # rho = position of the leftmost 1-bit of the 32-bit w (1-based from
+    # the top); w == 0 -> 33.  float64 log2 is exact for ints < 2^53.
+    rho = jnp.where(w > 0, 32.0 - jnp.floor(jnp.log2(jnp.maximum(w, 1.0))),
+                    33.0)
+    seg = gid * m + reg
+    seg = jnp.where(valid, seg, n_groups * m)  # dead rows -> overflow slot
+    M = jax.ops.segment_max(
+        jnp.where(valid, rho, 0.0), seg, num_segments=n_groups * m + 1,
+    )[:-1].reshape(n_groups, m)
+    M = jnp.maximum(M, 0.0)  # empty registers: segment_max identity is -inf
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    E = alpha * m * m / jnp.sum(2.0 ** (-M), axis=1)
+    zeros = jnp.sum(M == 0.0, axis=1)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1).astype(jnp.float64))
+    est = jnp.where((E <= 2.5 * m) & (zeros > 0), linear, E)
+    return jnp.round(est).astype(jnp.int64)
+
+
+def group_percentile(x: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
+                     n_groups: int, p) -> tuple:
+    """Per-group percentile by global sort — the TPU replacement for
+    per-group quantile-digest accumulators (reference: approx_percentile
+    over QuantileDigest): sort all rows by (group, value) once, then
+    gather each group's p-th position.  Returns (values, nonempty)."""
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                              num_segments=n_groups)
+    xf = x.astype(jnp.float64)
+    xf = jnp.where(valid, xf, jnp.inf)        # invalid rows sort last
+    g = jnp.where(valid, gid, n_groups)       # ...and into a dead group
+    order = jnp.lexsort((xf, g))
+    starts = jnp.cumsum(cnt) - cnt
+    k = jnp.clip(jnp.floor(p * jnp.maximum(cnt - 1, 0).astype(jnp.float64))
+                 .astype(jnp.int64), 0, jnp.maximum(cnt - 1, 0))
+    pos = jnp.clip(starts + k, 0, x.shape[0] - 1)
+    vals = x[order[pos]]
+    return vals, cnt > 0
+
+
 def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray):
     """Sort build side; binary-search each probe key.
     Returns (order, lb, ub): build_key[order] sorted; matches for probe row

@@ -62,6 +62,18 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         return T.BOOLEAN
     if name in ("corr", "covar_samp", "covar_pop"):
         return T.DOUBLE
+    if name == "approx_percentile":
+        if not arg_types[0].is_numeric:
+            raise TypeError(f"approx_percentile over {arg_types[0]}")
+        return arg_types[0]
+    if name == "checksum":
+        return T.BIGINT
+    if name in ("min_by", "max_by"):
+        if len(arg_types) != 2:
+            raise TypeError(f"{name} takes (value, key)")
+        return arg_types[0]
+    if name == "geometric_mean":
+        return T.DOUBLE
     raise KeyError(f"unknown aggregate function: {name}")
 
 
@@ -69,7 +81,8 @@ AGG_NAMES = {
     "count", "count_if", "sum", "avg", "min", "max", "arbitrary", "any_value",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "every", "approx_distinct", "corr", "covar_samp",
-    "covar_pop",
+    "covar_pop", "approx_percentile", "checksum", "min_by", "max_by",
+    "geometric_mean",
 }
 
 

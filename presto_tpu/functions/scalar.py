@@ -887,3 +887,287 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
         elif to.name == "BOOLEAN":
             data = bool(data)
     return ColVal(data, v.valid, to)
+
+
+# ---- extended math (reference: presto-main operator/scalar/MathFunctions) --
+
+
+def _math_double1(name, fn):
+    return (lambda args: T.DOUBLE if args[0].is_numeric else None,
+            lambda args: ColVal(fn(jnp.asarray(args[0].data).astype(jnp.float64)),
+                                args[0].valid, T.DOUBLE))
+
+
+for _n, _f in [("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+               ("asin", jnp.arcsin), ("acos", jnp.arccos),
+               ("atan", jnp.arctan), ("sinh", jnp.sinh), ("cosh", jnp.cosh),
+               ("tanh", jnp.tanh), ("cbrt", jnp.cbrt),
+               ("degrees", jnp.degrees), ("radians", jnp.radians),
+               ("log2", jnp.log2)]:
+    register(_n)(_math_double1(_n, _f))
+
+register("atan2")((
+    lambda args: T.DOUBLE if len(args) == 2 else None,
+    lambda args: ColVal(jnp.arctan2(jnp.asarray(args[0].data).astype(jnp.float64),
+                                    jnp.asarray(args[1].data).astype(jnp.float64)),
+                        all_valid(*args), T.DOUBLE)))
+register("log")((
+    lambda args: T.DOUBLE if len(args) == 2 else None,
+    # Presto: log(base, value)
+    lambda args: ColVal(jnp.log(jnp.asarray(args[1].data).astype(jnp.float64))
+                        / jnp.log(jnp.asarray(args[0].data).astype(jnp.float64)),
+                        all_valid(*args), T.DOUBLE)))
+register("pi")((lambda args: T.DOUBLE if not args else None,
+                lambda args: ColVal(float(np.pi), None, T.DOUBLE)))
+register("e")((lambda args: T.DOUBLE if not args else None,
+               lambda args: ColVal(float(np.e), None, T.DOUBLE)))
+
+
+def _emit_truncate(args):
+    a = args[0]
+    d = int(args[1].data) if len(args) > 1 else 0
+    x = jnp.asarray(a.data)
+    if a.type.is_integer:
+        return a
+    if a.type.is_decimal:
+        keep = max(a.type.decimal_scale - d, 0)
+        s = 10 ** keep
+        return ColVal(jnp.sign(x) * (jnp.abs(x) // s) * s, a.valid, a.type)
+    scale = 10.0 ** d
+    return ColVal(jnp.trunc(x * scale) / scale, a.valid, a.type)
+
+
+register("truncate")((_resolve_round, _emit_truncate))
+
+
+def _emit_width_bucket(args):
+    x = jnp.asarray(args[0].data).astype(jnp.float64)
+    lo, hi = args[1].data, args[2].data
+    n = args[3].data
+    raw = jnp.floor((x - lo) / (hi - lo) * n) + 1
+    r = jnp.clip(raw, 0, jnp.asarray(n, jnp.float64) + 1)
+    return ColVal(r.astype(jnp.int64), all_valid(*args), T.BIGINT)
+
+
+register("width_bucket")((
+    lambda args: T.BIGINT if len(args) == 4 else None, _emit_width_bucket))
+
+
+# bitwise (reference: operator/scalar/BitwiseFunctions)
+def _bitwise2(fn):
+    return (lambda args: T.BIGINT if len(args) == 2
+            and all(a.is_integer for a in args) else None,
+            lambda args: ColVal(fn(jnp.asarray(args[0].data).astype(jnp.int64),
+                                   jnp.asarray(args[1].data).astype(jnp.int64)),
+                                all_valid(*args), T.BIGINT))
+
+
+register("bitwise_and")(_bitwise2(jnp.bitwise_and))
+register("bitwise_or")(_bitwise2(jnp.bitwise_or))
+register("bitwise_xor")(_bitwise2(jnp.bitwise_xor))
+register("bitwise_left_shift")(_bitwise2(lambda x, y: x << y))
+register("bitwise_right_shift")(_bitwise2(
+    lambda x, y: (x.astype(jnp.uint64) >> y.astype(jnp.uint64)).astype(jnp.int64)))
+register("bitwise_not")((
+    lambda args: T.BIGINT if len(args) == 1 and args[0].is_integer else None,
+    lambda args: ColVal(~jnp.asarray(args[0].data).astype(jnp.int64),
+                        args[0].valid, T.BIGINT)))
+
+
+# ---- extended strings (reference: operator/scalar/StringFunctions) ---------
+
+def _pad(v, n, p, left):
+    n = int(n)
+    p = str(p) or " "
+    if len(v) >= n:
+        return v[:n]
+    fill = (p * ((n - len(v)) // len(p) + 1))[:n - len(v)]
+    return fill + v if left else v + fill
+
+
+register("lpad")((_str_transform(
+    "lpad", lambda v, n, p=" ": _pad(v, n, p, True))))
+register("rpad")((_str_transform(
+    "rpad", lambda v, n, p=" ": _pad(v, n, p, False))))
+register("repeat")((_str_transform("repeat", lambda v, n: v * int(n))))
+
+
+def _split_part(v, delim, idx):
+    parts = v.split(str(delim))
+    i = int(idx)
+    return parts[i - 1] if 1 <= i <= len(parts) else ""
+
+
+register("split_part")((_str_transform("split_part", _split_part)))
+register("position")((_str_transform(
+    "position", lambda v, sub: v.find(str(sub)) + 1, T.BIGINT)))
+register("codepoint")((_str_transform(
+    "codepoint", lambda v: ord(v[0]) if v else 0, T.BIGINT)))
+register("contains_str")((_str_transform(
+    "contains_str", lambda v, sub: str(sub) in v, T.BOOLEAN)))
+register("ends_with")((_str_transform(
+    "ends_with", lambda v, p: v.endswith(str(p)), T.BOOLEAN)))
+register("chr")((
+    lambda args: T.VARCHAR if args[0].is_integer else None,
+    lambda args: ColVal(chr(int(args[0].data)), args[0].valid, T.VARCHAR)
+    if args[0].is_scalar else (_ for _ in ()).throw(
+        NotImplementedError("chr of non-constant")),
+))
+
+
+# regexes evaluate over the (small) dictionary on host — the mandatory
+# dictionary-aware projection (reference: operator/scalar/JoniRegexp* via
+# DictionaryAwarePageProjection)
+import re as _re_mod
+
+
+def _regexp_like(v, pattern):
+    return _re_mod.search(str(pattern), v) is not None
+
+
+def _regexp_extract(v, pattern, group=0):
+    m = _re_mod.search(str(pattern), v)
+    if m is None:
+        return ""
+    return m.group(int(group))
+
+
+def _regexp_replace(v, pattern, repl=""):
+    # Presto group references are $1..$9; literal '$' stays literal
+    py_repl = _re_mod.sub(r"\$(\d+)", r"\\\1", str(repl))
+    return _re_mod.sub(str(pattern), py_repl, v)
+
+
+register("regexp_like")((_str_transform("regexp_like", _regexp_like, T.BOOLEAN)))
+register("regexp_extract")((_str_transform("regexp_extract", _regexp_extract)))
+register("regexp_replace")((_str_transform("regexp_replace", _regexp_replace)))
+
+
+# ---- extended date/time (reference: operator/scalar/DateTimeFunctions) -----
+
+
+def _emit_day_name_style(field):
+    def emit(args):
+        v = args[0]
+        days = jnp.asarray(v.data)
+        if v.type.name == "TIMESTAMP":
+            days = jnp.floor_divide(days, 86_400_000_000).astype(jnp.int64)
+        y, m, d = civil_from_days(days)
+        if field == "day_of_week":   # ISO: Monday=1..Sunday=7
+            r = (days + 3) % 7 + 1
+        elif field == "day_of_year":
+            r = days - days_from_civil(y, jnp.asarray(1), jnp.asarray(1)) + 1
+        elif field == "week_of_year":
+            r = (days - days_from_civil(y, jnp.asarray(1), jnp.asarray(1))) // 7 + 1
+        elif field == "last_day_of_month":
+            nm_y = jnp.where(m == 12, y + 1, y)
+            nm_m = jnp.where(m == 12, 1, m + 1)
+            r = days_from_civil(nm_y, nm_m, jnp.asarray(1)) - 1
+            return ColVal(r.astype(jnp.int32), v.valid, T.DATE)
+        else:
+            raise AssertionError(field)
+        return ColVal(r.astype(jnp.int64), v.valid, T.BIGINT)
+
+    return emit
+
+
+for _fld in ("day_of_week", "day_of_year", "week_of_year"):
+    register(_fld)((lambda args: T.BIGINT if args[0].is_temporal else None,
+                    _emit_day_name_style(_fld)))
+register("dow")((REGISTRY["day_of_week"].resolve, REGISTRY["day_of_week"].emit))
+register("doy")((REGISTRY["day_of_year"].resolve, REGISTRY["day_of_year"].emit))
+register("week")((REGISTRY["week_of_year"].resolve, REGISTRY["week_of_year"].emit))
+register("last_day_of_month")((
+    lambda args: T.DATE if args[0].is_temporal else None,
+    _emit_day_name_style("last_day_of_month")))
+
+
+def _emit_date_trunc(args):
+    unit = _as_string_literal(args[0])
+    v = args[1]
+    if unit is None:
+        raise NotImplementedError("date_trunc with non-constant unit")
+    unit = unit.lower()
+    days = jnp.asarray(v.data)
+    is_ts = v.type.name == "TIMESTAMP"
+    us = days if is_ts else None
+    if is_ts:
+        days = jnp.floor_divide(days, 86_400_000_000).astype(jnp.int64)
+    y, m, d = civil_from_days(days)
+    if unit == "day":
+        r = days
+    elif unit == "week":  # ISO week starts Monday; 1970-01-01 is Thursday
+        r = days - (days + 3) % 7
+    elif unit == "month":
+        r = days_from_civil(y, m, jnp.asarray(1))
+    elif unit == "quarter":
+        qm = ((m - 1) // 3) * 3 + 1
+        r = days_from_civil(y, qm, jnp.asarray(1))
+    elif unit == "year":
+        r = days_from_civil(y, jnp.asarray(1), jnp.asarray(1))
+    elif unit in ("hour", "minute", "second") and is_ts:
+        step = {"hour": 3_600_000_000, "minute": 60_000_000,
+                "second": 1_000_000}[unit]
+        return ColVal(jnp.floor_divide(us, step) * step, v.valid, v.type)
+    else:
+        raise NotImplementedError(f"date_trunc({unit}, {v.type})")
+    if is_ts:
+        return ColVal(r.astype(jnp.int64) * 86_400_000_000, v.valid, v.type)
+    return ColVal(r.astype(jnp.int32), v.valid, T.DATE)
+
+
+register("date_trunc")((
+    lambda args: args[1] if len(args) == 2 and args[1].is_temporal else None,
+    _emit_date_trunc))
+
+
+def _emit_date_diff(args):
+    unit = _as_string_literal(args[0])
+    if unit is None:
+        raise NotImplementedError("date_diff with non-constant unit")
+    unit = unit.lower()
+    a, b = args[1], args[2]
+
+    def to_days(v):
+        x = jnp.asarray(v.data)
+        if v.type.name == "TIMESTAMP":
+            return jnp.floor_divide(x, 86_400_000_000).astype(jnp.int64)
+        return x.astype(jnp.int64)
+
+    da, db = to_days(a), to_days(b)
+    if unit == "day":
+        r = db - da
+    elif unit == "week":
+        r = (db - da) // 7
+    elif unit in ("month", "quarter", "year"):
+        ya, ma, dda = civil_from_days(da)
+        yb, mb, ddb = civil_from_days(db)
+        # COMPLETE periods elapsed (Presto/Joda): a partial trailing
+        # month does not count, in either direction
+        months = (yb - ya) * 12 + (mb - ma)
+        months = months - ((months > 0) & (ddb < dda)) \
+                        + ((months < 0) & (ddb > dda))
+        trunc_div = lambda x, k: jnp.sign(x) * (jnp.abs(x) // k)
+        r = {"month": months, "quarter": trunc_div(months, 3),
+             "year": trunc_div(months, 12)}[unit]
+    elif unit in ("hour", "minute", "second", "millisecond") and \
+            a.type.name == "TIMESTAMP" and b.type.name == "TIMESTAMP":
+        step = {"hour": 3_600_000_000, "minute": 60_000_000,
+                "second": 1_000_000, "millisecond": 1_000}[unit]
+        r = (jnp.asarray(b.data) - jnp.asarray(a.data)) // step
+    else:
+        raise NotImplementedError(f"date_diff({unit})")
+    return ColVal(r.astype(jnp.int64), all_valid(a, b), T.BIGINT)
+
+
+register("date_diff")((
+    lambda args: T.BIGINT if len(args) == 3 else None, _emit_date_diff))
+
+register("from_unixtime")((
+    lambda args: T.TIMESTAMP if args[0].is_numeric else None,
+    lambda args: ColVal((jnp.asarray(args[0].data).astype(jnp.float64)
+                         * 1e6).astype(jnp.int64), args[0].valid, T.TIMESTAMP)))
+register("to_unixtime")((
+    lambda args: T.DOUBLE if args[0].name == "TIMESTAMP" else None,
+    lambda args: ColVal(jnp.asarray(args[0].data).astype(jnp.float64) / 1e6,
+                        args[0].valid, T.DOUBLE)))

@@ -65,7 +65,8 @@ def distribute(plan: P.QueryPlan, session, ndev: int) -> P.QueryPlan:
 _MERGEABLE = {"count", "count_if", "sum", "min", "max", "avg",
               "bool_and", "every", "bool_or", "arbitrary", "any_value",
               "stddev", "stddev_samp", "stddev_pop",
-              "variance", "var_samp", "var_pop"}
+              "variance", "var_samp", "var_pop",
+              "min_by", "max_by", "checksum"}
 
 
 class Distributer:
@@ -175,7 +176,31 @@ class Distributer:
                 final_aggs[sym] = ir.AggCall(
                     "merge_avg", (ir.Ref(ps, T.DOUBLE), ir.Ref(pc, T.BIGINT)),
                     T.DOUBLE)
-            else:  # stddev/variance family
+            elif fn in ("min_by", "max_by"):
+                # partial keeps (winning value, winning key); final
+                # re-runs the same argmin/argmax over the partials
+                pv = self.fresh(sym + "_v")
+                pk = self.fresh(sym + "_k")
+                key_t = a.args[1].type if hasattr(a.args[1], "type") else a.type
+                partial_aggs[pv] = a
+                partial_aggs[pk] = ir.AggCall(
+                    "min" if fn == "min_by" else "max", (a.args[1],),
+                    key_t, False, a.filter)
+                final_aggs[sym] = ir.AggCall(
+                    fn, (ir.Ref(pv, a.type), ir.Ref(pk, key_t)), a.type)
+            elif fn == "checksum":
+                # wrapping sum is associative/commutative: sum the partials
+                p = self.fresh(sym)
+                partial_aggs[p] = a
+                final_aggs[sym] = ir.AggCall("sum", (ir.Ref(p, T.BIGINT),),
+                                             T.BIGINT)
+            elif fn in ("approx_distinct", "approx_percentile",
+                        "geometric_mean", "corr", "covar_samp", "covar_pop"):
+                # sketch-merge across shards not implemented yet ->
+                # single-device execution stays correct
+                raise Undistributable(f"aggregate {fn}")
+            elif fn in ("stddev", "stddev_samp", "stddev_pop", "variance",
+                        "var_samp", "var_pop"):
                 s1 = self.fresh(sym + "_s1")
                 s2 = self.fresh(sym + "_s2")
                 pc = self.fresh(sym + "_c")
@@ -189,6 +214,8 @@ class Distributer:
                     f"merge_{fn}",
                     (ir.Ref(s1, T.DOUBLE), ir.Ref(s2, T.DOUBLE),
                      ir.Ref(pc, T.BIGINT)), T.DOUBLE)
+            else:
+                raise Undistributable(f"aggregate {fn}")
         partial = P.Aggregate(src, list(node.group_keys), partial_aggs, "PARTIAL")
         partial.capacity_hint = getattr(node, "capacity_hint", None)
         partial.key_stats = getattr(node, "key_stats", {})

@@ -164,7 +164,20 @@ class Planner:
 
         # aggregation analysis
         agg_calls: List[Tuple[ast.FunctionCall, str]] = []  # (ast node, out symbol)
-        has_group = bool(spec.group_by)
+        # GROUP BY ordinals resolve to select-list expressions (reference:
+        # StatementAnalyzer.analyzeGroupBy ordinal handling)
+        group_by = []
+        for ge in (spec.group_by or []):
+            if isinstance(ge, ast.Literal) and isinstance(ge.value, int):
+                k = ge.value
+                if not (1 <= k <= len(spec.select)) \
+                        or isinstance(spec.select[k - 1].expr, ast.Star):
+                    raise SemanticError(
+                        f"GROUP BY position {k} is not in select list")
+                group_by.append(spec.select[k - 1].expr)
+            else:
+                group_by.append(ge)
+        has_group = bool(group_by)
         exprs_to_scan = [it.expr for it in spec.select if not isinstance(it.expr, ast.Star)]
         if spec.having is not None:
             exprs_to_scan.append(spec.having)
@@ -175,7 +188,7 @@ class Planner:
         select_scope = scope
         if has_agg:
             node, select_scope, agg_map, group_map = self._plan_aggregation(
-                node, scope, spec.group_by, agg_calls, outer)
+                node, scope, group_by, agg_calls, outer)
         else:
             agg_map, group_map = {}, {}
 
@@ -710,8 +723,6 @@ class Planner:
         group_map: Dict[str, str] = {}  # ast repr of group expr -> symbol
         group_fields: List[Field_] = []
         for ge in group_by:
-            if isinstance(ge, ast.Literal) and isinstance(ge.value, int):
-                raise SemanticError("GROUP BY ordinal not supported yet")
             rex = self.analyze(ge, scope)
             if isinstance(rex, ir.Ref):
                 sym = rex.name

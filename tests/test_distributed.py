@@ -51,3 +51,19 @@ def test_repartition_group_by(dsession, tpch_sqlite_tiny):
     actual = dsession.sql(sql)
     expected = tpch_sqlite_tiny.execute(to_sqlite(sql)).fetchall()
     assert_same_results(actual.rows, expected, ordered=True)
+
+
+def test_distributed_minby_checksum(dsession, tpch_sqlite_tiny):
+    """min_by/max_by/checksum decompose partial->final across shards
+    (distribute.py _split_partial_final); results must match the
+    single-device path."""
+    sql = ("SELECT l_returnflag, max_by(l_shipmode, l_extendedprice), "
+           "checksum(l_orderkey), min_by(l_partkey, l_extendedprice) "
+           "FROM lineitem GROUP BY l_returnflag")
+    dist = sorted(dsession.sql(sql).rows)
+    import presto_tpu
+    single = presto_tpu.connect(dsession.catalog)
+    assert sorted(single.sql(sql).rows) == dist
+    # global (no keys) goes through the same split
+    g = "SELECT checksum(l_orderkey), max_by(l_shipmode, l_extendedprice) FROM lineitem"
+    assert dsession.sql(g).rows == single.sql(g).rows

@@ -1,0 +1,160 @@
+"""Approximate aggregates + extended function library tests
+(reference analogs: TestApproximateCountDistinct, TestMathFunctions,
+TestStringFunctions, TestDateTimeFunctions in presto-main)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.catalog import Catalog, MemoryTable
+
+
+@pytest.fixture(scope="module")
+def session():
+    rng = np.random.default_rng(7)
+    n = 50_000
+    cat = Catalog()
+    cat.register(MemoryTable(
+        "t",
+        {"g": T.BIGINT, "k": T.BIGINT, "x": T.DOUBLE, "s": T.VARCHAR,
+         "d": T.DATE},
+        {"g": rng.integers(0, 4, n),
+         "k": rng.integers(0, 5000, n),
+         "x": rng.random(n) * 100,
+         "s": np.array([f"val_{v:04d}" for v in rng.integers(0, 300, n)],
+                       dtype=object),
+         "d": rng.integers(8000, 12000, n).astype(np.int32)}))
+    return presto_tpu.connect(cat)
+
+
+def test_approx_distinct_accuracy(session):
+    exact = session.sql("SELECT count(DISTINCT k) FROM t").rows[0][0]
+    approx = session.sql("SELECT approx_distinct(k) FROM t").rows[0][0]
+    assert abs(approx - exact) / exact < 0.12  # m=1024 -> ~3.25% stderr
+    # grouped
+    rows = session.sql(
+        "SELECT g, approx_distinct(k), count(DISTINCT k) FROM t "
+        "GROUP BY g ORDER BY g").rows
+    for _, ap, ex in rows:
+        assert abs(ap - ex) / ex < 0.15
+
+
+def test_approx_distinct_strings(session):
+    exact = session.sql("SELECT count(DISTINCT s) FROM t").rows[0][0]
+    approx = session.sql("SELECT approx_distinct(s) FROM t").rows[0][0]
+    assert abs(approx - exact) / exact < 0.15
+
+
+def test_approx_percentile(session):
+    x = session.sql("SELECT approx_percentile(x, 0.5) FROM t").rows[0][0]
+    assert abs(x - 50.0) < 2.0  # uniform [0, 100)
+    rows = session.sql(
+        "SELECT g, approx_percentile(x, 0.9) FROM t GROUP BY g").rows
+    for _, v in rows:
+        assert abs(v - 90.0) < 3.0
+
+
+def test_min_by_max_by(session):
+    r = session.sql("SELECT max_by(s, k), min_by(s, k) FROM t").rows[0]
+    km = session.sql("SELECT max(k), min(k) FROM t").rows[0]
+    # ties on the key are broken arbitrarily (Presto semantics): the
+    # result must be one of the tied rows' values
+    hi = {x[0] for x in session.sql(
+        f"SELECT s FROM t WHERE k = {km[0]}").rows}
+    lo = {x[0] for x in session.sql(
+        f"SELECT s FROM t WHERE k = {km[1]}").rows}
+    assert r[0] in hi and r[1] in lo
+
+
+def test_checksum_order_independent(session):
+    a = session.sql("SELECT checksum(k) FROM t").rows[0][0]
+    b = session.sql("SELECT checksum(k) FROM (SELECT k FROM t ORDER BY x) AS q"
+                    ).rows[0][0]
+    assert a == b
+    c = session.sql("SELECT checksum(k + 1) FROM t").rows[0][0]
+    assert a != c
+
+
+def test_geometric_mean(session):
+    g = session.sql("SELECT geometric_mean(x) FROM t WHERE x > 0").rows[0][0]
+    am = session.sql("SELECT avg(ln(x)) FROM t WHERE x > 0").rows[0][0]
+    assert abs(g - math.exp(am)) < 1e-6 * g
+
+
+@pytest.mark.parametrize("expr,expected", [
+    ("sin(0)", 0.0), ("cos(0)", 1.0), ("atan2(1, 1)", math.pi / 4),
+    ("cbrt(27)", 3.0), ("degrees(pi())", 180.0), ("radians(180) - pi()", 0.0),
+    ("log(2, 8)", 3.0), ("log2(32)", 5.0), ("truncate(3.99)", 3.0),
+    ("truncate(-3.99)", -3.0), ("width_bucket(35, 0, 100, 10)", 4),
+    ("bitwise_and(12, 10)", 8), ("bitwise_or(12, 10)", 14),
+    ("bitwise_xor(12, 10)", 6), ("bitwise_not(0)", -1),
+    ("bitwise_left_shift(1, 10)", 1024), ("bitwise_right_shift(1024, 3)", 128),
+])
+def test_math_scalars(session, expr, expected):
+    v = session.sql(f"SELECT {expr}").rows[0][0]
+    assert abs(float(v) - float(expected)) < 1e-9
+
+
+@pytest.mark.parametrize("expr,expected", [
+    ("lpad('7', 3, '0')", "007"), ("rpad('ab', 4, 'x')", "abxx"),
+    ("repeat('ab', 3)", "ababab"), ("split_part('a,b,c', ',', 2)", "b"),
+    ("position('c' IN 'abc')", 3) if False else ("position('abc', 'c')", 3),
+    ("codepoint('A')", 65), ("chr(66)", "B"),
+    ("regexp_extract('presto-1234-tpu', '[0-9]+')", "1234"),
+    ("regexp_replace('a1b2', '[0-9]', '_')", "a_b_"),
+])
+def test_string_scalars(session, expr, expected):
+    v = session.sql(f"SELECT {expr}").rows[0][0]
+    assert v == expected
+
+
+def test_string_functions_on_columns(session):
+    rows = session.sql(
+        "SELECT count(*) FROM t WHERE regexp_like(s, 'val_00[0-9][0-9]')"
+    ).rows
+    exact = session.sql("SELECT count(*) FROM t WHERE k >= 0 AND "
+                        "substr(s, 5, 2) = '00'").rows
+    assert rows[0][0] == exact[0][0]
+    r2 = session.sql("SELECT split_part(s, '_', 2) AS p, count(*) FROM t "
+                     "GROUP BY 1 ORDER BY 2 DESC LIMIT 1").rows
+    assert len(r2) == 1 and len(r2[0][0]) == 4
+
+
+def test_date_functions(session):
+    rows = session.sql(
+        "SELECT d, date_trunc('month', d) AS m, day_of_week(d) AS dw, "
+        "day_of_year(d) AS dy, last_day_of_month(d) AS ld "
+        "FROM t LIMIT 200").rows
+    for d, m, dw, dy, ld in rows:
+        dd = np.datetime64("1970-01-01") + np.timedelta64(int(d), "D")
+        first = dd.astype("datetime64[M]").astype("datetime64[D]")
+        assert (np.datetime64("1970-01-01") + np.timedelta64(int(m), "D")) == first
+        iso = (int(d) + 3) % 7 + 1
+        assert dw == iso
+        assert dy == int((dd - first.astype("datetime64[Y]").astype("datetime64[D]"))
+                         / np.timedelta64(1, "D")) + 1
+        nxt = (first.astype("datetime64[M]") + 1).astype("datetime64[D]")
+        assert (np.datetime64("1970-01-01") + np.timedelta64(int(ld), "D")) \
+            == nxt - np.timedelta64(1, "D")
+
+
+def test_date_diff(session):
+    r = session.sql("SELECT date_diff('day', DATE '2020-01-01', "
+                    "DATE '2020-03-01')").rows[0][0]
+    assert r == 60
+    # complete periods only (Presto/Joda semantics)
+    r = session.sql("SELECT date_diff('month', DATE '2020-01-15', "
+                    "DATE '2020-03-01')").rows[0][0]
+    assert r == 1
+    r = session.sql("SELECT date_diff('month', DATE '2024-01-31', "
+                    "DATE '2024-02-01')").rows[0][0]
+    assert r == 0
+    r = session.sql("SELECT date_diff('year', DATE '1999-06-01', "
+                    "DATE '2002-01-01')").rows[0][0]
+    assert r == 2
+    r = session.sql("SELECT date_diff('month', DATE '2020-03-01', "
+                    "DATE '2020-01-15')").rows[0][0]
+    assert r == -1
