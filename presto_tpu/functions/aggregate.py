@@ -63,6 +63,8 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
     if name in ("corr", "covar_samp", "covar_pop"):
         return T.DOUBLE
     if name == "approx_percentile":
+        if len(arg_types) != 2:
+            raise TypeError("approx_percentile takes (value, percentile)")
         if not arg_types[0].is_numeric:
             raise TypeError(f"approx_percentile over {arg_types[0]}")
         return arg_types[0]

@@ -978,6 +978,8 @@ register("bitwise_not")((
 
 def _pad(v, n, p, left):
     n = int(n)
+    if n < 0:
+        raise ValueError(f"pad target length must be >= 0 (got {n})")
     p = str(p) or " "
     if len(v) >= n:
         return v[:n]
@@ -1033,8 +1035,10 @@ def _regexp_extract(v, pattern, group=0):
 
 
 def _regexp_replace(v, pattern, repl=""):
-    # Presto group references are $1..$9; literal '$' stays literal
-    py_repl = _re_mod.sub(r"\$(\d+)", r"\\\1", str(repl))
+    # Presto group references are $0..$9; everything else is literal —
+    # escape backslashes first so they can't form Python re escapes
+    py_repl = str(repl).replace("\\", "\\\\")
+    py_repl = _re_mod.sub(r"\$(\d+)", r"\\g<\1>", py_repl)
     return _re_mod.sub(str(pattern), py_repl, v)
 
 
@@ -1058,7 +1062,12 @@ def _emit_day_name_style(field):
         elif field == "day_of_year":
             r = days - days_from_civil(y, jnp.asarray(1), jnp.asarray(1)) + 1
         elif field == "week_of_year":
-            r = (days - days_from_civil(y, jnp.asarray(1), jnp.asarray(1))) // 7 + 1
+            # ISO-8601: the week containing this date's Thursday, numbered
+            # within the Thursday's year (Presto week() semantics)
+            thursday = days - (days + 3) % 7 + 3
+            ty, _, _ = civil_from_days(thursday)
+            r = (thursday
+                 - days_from_civil(ty, jnp.asarray(1), jnp.asarray(1))) // 7 + 1
         elif field == "last_day_of_month":
             nm_y = jnp.where(m == 12, y + 1, y)
             nm_m = jnp.where(m == 12, 1, m + 1)
@@ -1143,10 +1152,20 @@ def _emit_date_diff(args):
         ya, ma, dda = civil_from_days(da)
         yb, mb, ddb = civil_from_days(db)
         # COMPLETE periods elapsed (Presto/Joda): a partial trailing
-        # month does not count, in either direction
+        # month does not count, in either direction; the start day is
+        # clamped to the end month's length (Jan 31 + 1 month = Feb 29)
+
+        def days_in_month(y, m):
+            ny = jnp.where(m == 12, y + 1, y)
+            nm = jnp.where(m == 12, 1, m + 1)
+            return (days_from_civil(ny, nm, jnp.asarray(1))
+                    - days_from_civil(y, m, jnp.asarray(1)))
+
         months = (yb - ya) * 12 + (mb - ma)
-        months = months - ((months > 0) & (ddb < dda)) \
-                        + ((months < 0) & (ddb > dda))
+        fwd_incomplete = ddb < jnp.minimum(dda, days_in_month(yb, mb))
+        bwd_incomplete = dda < jnp.minimum(ddb, days_in_month(ya, ma))
+        months = months - ((months > 0) & fwd_incomplete) \
+                        + ((months < 0) & bwd_incomplete)
         trunc_div = lambda x, k: jnp.sign(x) * (jnp.abs(x) // k)
         r = {"month": months, "quarter": trunc_div(months, 3),
              "year": trunc_div(months, 12)}[unit]

@@ -49,6 +49,7 @@ class QueryStats:
     peak_memory_bytes: int = 0
     spilled_bytes: int = 0
     spilled_partitions: int = 0
+    recovered_buckets: int = 0  # grouped-execution buckets loaded from ckpt
     # id(plan node) -> NodeStats; populated in dynamic mode
     node_stats: Dict[int, NodeStats] = dataclasses.field(default_factory=dict)
 

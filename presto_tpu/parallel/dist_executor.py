@@ -52,6 +52,11 @@ class DistExecutor(Executor):
             out, overflow = EX.repartition_batch(b, key_cols, self.ndev, AXIS)
             self.guards.append(overflow)
             return out
+        if node.kind == "range":
+            out, overflow = EX.range_partition_batch(
+                b, node.sort_keys, self.ndev, AXIS)
+            self.guards.append(overflow)
+            return out
         raise Undistributable(f"exchange kind {node.kind}")
 
 

@@ -86,25 +86,28 @@ def _dict_value_hashes(dictionary) -> np.ndarray:
     return out
 
 
-def repartition_batch(b: Batch, key_cols: List[Column], ndev: int, axis: str,
-                      slack: float = 2.0) -> Tuple[Batch, jnp.ndarray]:
-    """P1 hash repartition: every live row moves to shard
-    hash(keys) % ndev via ONE all_to_all.
+def _exchange_by_dest(b: Batch, dest: jnp.ndarray, ndev: int, axis: str,
+                      slack: float, order_key=None
+                      ) -> Tuple[Batch, jnp.ndarray]:
+    """Shared all_to_all machinery: move every live row to shard
+    `dest[row]` (dest in [0, ndev); dead rows may carry any value).
 
     Static send layout: per-destination capacity C = ceil(slack * n/ndev);
-    rows are stably sorted by destination, positioned within their bucket,
-    and scattered into a (ndev*C,) send buffer.  Bucket overflow (skew
-    beyond `slack`) sets the returned guard — the caller falls back, the
-    distributed analog of the reference's skew pathology (SURVEY.md §7
-    hard-part 5).
+    rows are stably sorted by (dest, order_key) — order_key preserves a
+    within-destination order for the range exchange — positioned within
+    their bucket, and scattered into a (ndev*C,) send buffer.  Bucket
+    overflow (skew beyond `slack`) sets the returned guard — the caller
+    falls back, the distributed analog of the reference's skew pathology
+    (SURVEY.md §7 hard-part 5).
 
     Returns (received batch with capacity ndev*C, overflow guard)."""
     n = b.capacity
     c_cap = max(int(np.ceil(slack * n / ndev)), 1)
-    h = partition_hash(key_cols)
-    dest = (h % jnp.uint64(ndev)).astype(jnp.int32)
-    dest = jnp.where(b.sel, dest, ndev)  # dead rows -> overflow bucket, sorted last
-    order = jnp.argsort(dest, stable=True)
+    dest = jnp.where(b.sel, dest, ndev)  # dead rows sort last
+    if order_key is None:
+        order = jnp.argsort(dest, stable=True)
+    else:
+        order = jnp.lexsort((order_key, dest))
     sdest = dest[order]
     # position of each row within its destination bucket
     first = jnp.searchsorted(sdest, jnp.arange(ndev + 1, dtype=sdest.dtype))
@@ -132,3 +135,59 @@ def repartition_batch(b: Batch, key_cols: List[Column], ndev: int, axis: str,
         valid = None if c.valid is None else exchange(c.valid)
         cols[name] = Column(data, valid, c.type, c.dictionary)
     return Batch(cols, sel_out), overflow
+
+
+def repartition_batch(b: Batch, key_cols: List[Column], ndev: int, axis: str,
+                      slack: float = 2.0) -> Tuple[Batch, jnp.ndarray]:
+    """P1 hash repartition: every live row moves to shard
+    hash(keys) % ndev via ONE all_to_all (see _exchange_by_dest)."""
+    h = partition_hash(key_cols)
+    dest = (h % jnp.uint64(ndev)).astype(jnp.int32)
+    return _exchange_by_dest(b, dest, ndev, axis, slack)
+
+
+def _sort_key_ints(col: Column, ascending: bool, nulls_first) -> jnp.ndarray:
+    """Order-preserving int64 image of a sort column: flip for DESC, send
+    NULLs to the requested end (defaults match ORDER BY: last for ASC,
+    first for DESC)."""
+    k = K._orderable_int(col).astype(jnp.int64)
+    if not ascending:
+        k = -k
+    if nulls_first is None:
+        nulls_first = not ascending
+    if col.valid is not None:
+        ext = jnp.iinfo(jnp.int64).min if nulls_first else jnp.iinfo(jnp.int64).max
+        k = jnp.where(col.valid, k, ext)
+    return k
+
+
+def range_partition_batch(b: Batch, sort_keys, ndev: int, axis: str,
+                         samples_per_shard: int = 64, slack: float = 2.0
+                         ) -> Tuple[Batch, jnp.ndarray]:
+    """P11 distributed sort, stage 1 — sample-sort range exchange: shard i
+    receives all rows whose primary sort key falls in the i-th key range,
+    with splitters chosen from a gathered sample (the TPU-native
+    replacement for per-task partial sort + MergeOperator's n-way merge;
+    reference: operator/MergeOperator.java + admin/dist-sort.rst).
+
+    dest is a pure function of the primary key VALUE (searchsorted over
+    shared splitters), so equal keys never split across shards and the
+    secondary sort keys stay a per-shard problem.  After each shard sorts
+    locally, an ordered all_gather concatenation is globally sorted."""
+    sym, asc, nf = sort_keys[0]
+    key = _sort_key_ints(b.columns[sym], asc, nf)
+    n = b.capacity
+    # evenly-spaced sample of the locally-sorted keys (dead rows last)
+    big = jnp.iinfo(jnp.int64).max
+    local_sorted = jnp.sort(jnp.where(b.sel, key, big))
+    pos = jnp.linspace(0, n - 1, samples_per_shard).astype(jnp.int32)
+    sample = local_sorted[pos]
+    all_samples = jnp.sort(jax.lax.all_gather(sample, axis, tiled=True))
+    total = ndev * samples_per_shard
+    cut = (jnp.arange(1, ndev) * total) // ndev
+    splitters = all_samples[cut]
+    dest = jnp.searchsorted(splitters, key, side="right").astype(jnp.int32)
+    # dead-row padding sampled as `big` skews splitters upward; real rows
+    # overflowing a range trip the guard and fall back
+    return _exchange_by_dest(b, jnp.clip(dest, 0, ndev - 1), ndev, axis,
+                             slack, order_key=key)

@@ -75,6 +75,8 @@ class Distributer:
         self.ndev = ndev
         self.broadcast_rows = int(session.properties.get(
             "broadcast_join_threshold_rows", 1_000_000))
+        self.dist_sort_threshold = int(session.properties.get(
+            "distributed_sort_threshold_rows", 100_000))
         self.partial_agg_groups = int(session.properties.get(
             "partial_aggregation_max_groups", 8192))
         self._ctr = 0
@@ -298,6 +300,18 @@ class Distributer:
     # ---- order/limit/misc --------------------------------------------
     def _visit_sort(self, node: P.Sort):
         src, dist = self.visit(node.source)
+        rows = self._estimated_rows(src)
+        small = rows is not None and rows <= self.dist_sort_threshold
+        if dist.kind != "replicated" and not small:
+            # P11 distributed sample-sort: range all_to_all on the primary
+            # key, local full sort per shard, ordered gather — shard i's
+            # rows all precede shard i+1's, so the concatenation IS the
+            # merge (reference: partial sort + MergeOperator,
+            # admin/dist-sort.rst)
+            ex = P.Exchange(src, "range")
+            ex.sort_keys = list(node.keys)
+            local = P.Sort(ex, list(node.keys))
+            return P.Exchange(local, "gather"), REPLICATED
         node.source = self._to_replicated(src, dist)
         return node, REPLICATED
 

@@ -168,7 +168,8 @@ class Planner:
         # StatementAnalyzer.analyzeGroupBy ordinal handling)
         group_by = []
         for ge in (spec.group_by or []):
-            if isinstance(ge, ast.Literal) and isinstance(ge.value, int):
+            if isinstance(ge, ast.Literal) and isinstance(ge.value, int) \
+                    and not isinstance(ge.value, bool):
                 k = ge.value
                 if not (1 <= k <= len(spec.select)) \
                         or isinstance(spec.select[k - 1].expr, ast.Star):

@@ -27,6 +27,14 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "distributed": False,
     "mesh_devices": 0,  # 0 = all local devices
     "broadcast_join_threshold_rows": 1_000_000,  # DetermineJoinDistributionType
+    # below this row estimate ORDER BY gathers + sorts on one shard
+    # instead of the P11 range-exchange sample sort
+    "distributed_sort_threshold_rows": 100_000,
+    # persist per-bucket grouped-execution results so a re-run after a
+    # failure resumes from completed buckets (P8 recoverable execution)
+    "recoverable_grouped_execution": False,
+    # test hook: abort after N grouped buckets (0 = off)
+    "fault_injection_fail_after_buckets": 0,
     "partial_aggregation_max_groups": 8192,  # partial+gather vs repartition agg
     # per-plan-node stats collection in dynamic mode (forced by EXPLAIN
     # ANALYZE; costs one host sync per operator — reference: OperationTimer)

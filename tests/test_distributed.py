@@ -67,3 +67,46 @@ def test_distributed_minby_checksum(dsession, tpch_sqlite_tiny):
     # global (no keys) goes through the same split
     g = "SELECT checksum(l_orderkey), max_by(l_shipmode, l_extendedprice) FROM lineitem"
     assert dsession.sql(g).rows == single.sql(g).rows
+
+
+def test_distributed_sample_sort(tpch_catalog_tiny, tpch_sqlite_tiny):
+    """P11: ORDER BY over sharded data goes through the range all_to_all +
+    local sort + ordered gather path and matches the oracle exactly."""
+    import presto_tpu
+    from presto_tpu.plan import nodes as P
+
+    s = presto_tpu.connect(tpch_catalog_tiny)
+    s.set("distributed", True)
+    s.set("distributed_sort_threshold_rows", 1000)
+    sql = ("SELECT l_orderkey, l_linenumber, l_extendedprice FROM lineitem "
+           "WHERE l_quantity < 30 ORDER BY l_extendedprice DESC, l_orderkey, "
+           "l_linenumber")
+    actual = s.sql(sql)
+    expected = tpch_sqlite_tiny.execute(to_sqlite(sql)).fetchall()
+    assert_same_results(actual.rows, expected, ordered=True)
+    # the plan must contain a range exchange (not a gather-then-sort)
+    entry = next(v for v in s._dist_cache.values() if v != "DYNAMIC")
+    dplan = entry[0]
+    kinds = []
+
+    def walk(n):
+        if isinstance(n, P.Exchange):
+            kinds.append(n.kind)
+        for src in n.sources:
+            walk(src)
+
+    walk(dplan.root)
+    assert "range" in kinds, kinds
+
+
+def test_distributed_sort_strings_and_nulls(tpch_catalog_tiny, tpch_sqlite_tiny):
+    import presto_tpu
+
+    s = presto_tpu.connect(tpch_catalog_tiny)
+    s.set("distributed", True)
+    s.set("distributed_sort_threshold_rows", 1000)
+    sql = ("SELECT l_shipmode, l_orderkey, l_linenumber FROM lineitem "
+           "ORDER BY l_shipmode, l_orderkey, l_linenumber LIMIT 5000")
+    actual = s.sql(sql)
+    expected = tpch_sqlite_tiny.execute(to_sqlite(sql)).fetchall()
+    assert_same_results(actual.rows, expected, ordered=True)

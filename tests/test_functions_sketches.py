@@ -101,7 +101,7 @@ def test_math_scalars(session, expr, expected):
 @pytest.mark.parametrize("expr,expected", [
     ("lpad('7', 3, '0')", "007"), ("rpad('ab', 4, 'x')", "abxx"),
     ("repeat('ab', 3)", "ababab"), ("split_part('a,b,c', ',', 2)", "b"),
-    ("position('c' IN 'abc')", 3) if False else ("position('abc', 'c')", 3),
+    ("position('abc', 'c')", 3),
     ("codepoint('A')", 65), ("chr(66)", "B"),
     ("regexp_extract('presto-1234-tpu', '[0-9]+')", "1234"),
     ("regexp_replace('a1b2', '[0-9]', '_')", "a_b_"),
@@ -158,3 +158,22 @@ def test_date_diff(session):
     r = session.sql("SELECT date_diff('month', DATE '2020-03-01', "
                     "DATE '2020-01-15')").rows[0][0]
     assert r == -1
+
+
+def test_date_semantics_review_fixes(session):
+    # Joda end-of-month clamping
+    assert session.sql("SELECT date_diff('month', DATE '2020-01-31', "
+                       "DATE '2020-02-29')").rows[0][0] == 1
+    assert session.sql("SELECT date_diff('year', DATE '2020-02-29', "
+                       "DATE '2021-02-28')").rows[0][0] == 1
+    # ISO week numbering
+    assert session.sql("SELECT week(DATE '2017-01-01')").rows[0][0] == 52
+    assert session.sql("SELECT week(DATE '2021-01-04')").rows[0][0] == 1
+    assert session.sql("SELECT week(DATE '2020-12-31')").rows[0][0] == 53
+    # regexp_replace group refs and literals
+    assert session.sql(
+        "SELECT regexp_replace('abc', 'b', '[$0]')").rows[0][0] == "a[b]c"
+    assert session.sql(
+        "SELECT regexp_replace('a1b', '([0-9])', '<$1>')").rows[0][0] == "a<1>b"
+    assert session.sql(
+        "SELECT regexp_replace('x', 'x', 'a$b')").rows[0][0] == "a$b"

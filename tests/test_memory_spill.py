@@ -125,3 +125,35 @@ def test_spill_space_tracker(tmp_path):
         tracker.reserve(5)
     tracker.free(8)
     tracker.reserve(5)
+
+
+def test_recoverable_grouped_execution(session, tpch_sqlite_tiny):
+    """P8 recoverable execution: a fault mid-grouped-join kills the query;
+    the re-run resumes from checkpointed buckets and matches the oracle
+    (reference: RECOVERABLE_GROUPED_EXECUTION lifespan rescheduling)."""
+    import pytest
+    from tests.sqlite_oracle import assert_same_results, to_sqlite
+
+    sql = ("SELECT n_name, count(*) AS c FROM customer, nation "
+           "WHERE c_nationkey = n_nationkey GROUP BY n_name ORDER BY c DESC, n_name")
+    baseline = session.sql(sql).rows
+
+    session.set("spill_trigger_rows", 100)       # force grouped execution
+    session.set("recoverable_grouped_execution", True)
+    session.set("fault_injection_fail_after_buckets", 3)
+    with pytest.raises(Exception, match="fault injection"):
+        session.sql(sql)
+
+    session.set("fault_injection_fail_after_buckets", 0)
+    r = session.sql(sql)
+    assert r.rows == baseline
+    assert session.last_stats.recovered_buckets == 3
+    expected = tpch_sqlite_tiny.execute(to_sqlite(sql)).fetchall()
+    assert_same_results(r.rows, expected, ordered=True)
+
+    # checkpoints are cleaned up on success: a fresh run recovers nothing
+    r2 = session.sql(sql)
+    assert r2.rows == baseline
+    assert session.last_stats.recovered_buckets == 0
+    session.set("spill_trigger_rows", 0)
+    session.set("recoverable_grouped_execution", False)
