@@ -175,17 +175,24 @@ def batch_from_numpy(
 
 
 def to_numpy(batch: Batch) -> tuple[Dict[str, np.ndarray], np.ndarray]:
-    """Materialize to host: (column arrays with strings decoded, live-row mask)."""
-    sel = np.asarray(batch.sel)
+    """Materialize to host: (column arrays with strings decoded, live-row
+    mask).  ONE device_get for the whole batch — per-column transfers pay
+    a full RPC round-trip each on tunneled TPU backends."""
+    pulled = jax.device_get(
+        (batch.sel,
+         {n: (c.data, c.valid) for n, c in batch.columns.items()}))
+    sel, datas = pulled
+    sel = np.asarray(sel)
     out = {}
     for name, col in batch.columns.items():
-        data = np.asarray(col.data)
+        data, valid = datas[name]
+        data = np.asarray(data)
         if col.dictionary is not None:
             codes = np.clip(data, 0, len(col.dictionary) - 1)
             data = col.dictionary.values[codes]
         elif col.type.is_decimal:
             data = data.astype(np.float64) / (10 ** col.type.decimal_scale)
-        if col.valid is not None:
-            data = np.ma.masked_array(data, mask=~np.asarray(col.valid))
+        if valid is not None:
+            data = np.ma.masked_array(data, mask=~np.asarray(valid))
         out[name] = data
     return out, sel

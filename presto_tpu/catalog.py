@@ -52,8 +52,9 @@ class ConnectorTable:
     def _invalidate(self) -> None:
         """Drop cached device columns + bump the catalog version after a
         write (compiled-plan caches key on catalog version)."""
-        if hasattr(self, "_device_cols"):
-            del self._device_cols
+        for attr in ("_device_cols", "_device_cols_f32"):
+            if hasattr(self, attr):
+                delattr(self, attr)
         cat = getattr(self, "_catalog", None)
         if cat is not None:
             cat.version += 1

@@ -318,12 +318,16 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
             return out, guard
 
         jitted = jax.jit(fn)
-        batches = [scan_batch(session.catalog.get(n.table), n) for n in scan_nodes]
+        f32 = bool(session.properties.get("float32_compute", False))
+        batches = [scan_batch(session.catalog.get(n.table), n, f32)
+                   for n in scan_nodes]
         out_batch, guard = jitted(batches)  # traces; may raise StaticFallback
         cache[key] = (plan, jitted, scan_nodes)  # cache only after success
     else:
         plan, jitted, scan_nodes = entry
-        batches = [scan_batch(session.catalog.get(n.table), n) for n in scan_nodes]
+        f32 = bool(session.properties.get("float32_compute", False))
+        batches = [scan_batch(session.catalog.get(n.table), n, f32)
+                   for n in scan_nodes]
         out_batch, guard = jitted(batches)
     if bool(guard):
         # static assumption violated; data is static so it will trip again —
@@ -530,7 +534,9 @@ class Executor:
         if self.scan_inputs is not None:
             return self.scan_inputs[id(node)]
         table = self.session.catalog.get(node.table)
-        return scan_batch(table, node)
+        return scan_batch(
+            table, node,
+            bool(self.session.properties.get("float32_compute", False)))
 
     def _exec_values(self, node: P.Values) -> Batch:
         arrays = {}
@@ -810,8 +816,9 @@ class Executor:
                 c.data[rep_rows],
                 None if c.valid is None else c.valid[rep_rows],
                 c.type, c.dictionary)
+        fused = self._fused_sum_aggs(b, aggs, gid, n_groups)
         for sym, a in aggs.items():
-            out_cols[sym] = self._agg_column(b, a, gid, n_groups)
+            out_cols[sym] = fused.get(sym) or self._agg_column(b, a, gid, n_groups)
         sel = jnp.ones((max(n_groups, 0),), dtype=bool)
         if n_groups == 0:
             out_cols = {k: Column(c.data[:0], None if c.valid is None else c.valid[:0],
@@ -835,9 +842,102 @@ class Executor:
             c = b.columns[k]
             valid = None if c.valid is None else (c.valid[rep_rows] & exists)
             out_cols[k] = Column(c.data[rep_rows], valid, c.type, c.dictionary)
+        fused = self._fused_sum_aggs(b, aggs, gid, cap)
         for sym, a in aggs.items():
-            out_cols[sym] = self._agg_column(b, a, gid, cap)
+            out_cols[sym] = fused.get(sym) or self._agg_column(b, a, gid, cap)
         return Batch(out_cols, exists)
+
+    def _fused_sum_aggs(self, b: Batch, aggs: Dict[str, ir.AggCall],
+                        gid, n_groups: int) -> Dict[str, Column]:
+        """Prepass: compute all sum-shaped aggregates (count/count_if/
+        sum/avg over DOUBLE) in ONE Pallas pass over the rows
+        (kernels.fused_group_sums) instead of one scatter-add per
+        aggregate.  Returns {} when not worthwhile; callers fall through
+        to _agg_column per aggregate."""
+        if not self.session.properties.get("pallas_fused_agg", True):
+            return {}
+        n = b.capacity
+        if n < 32_768 or not (1 <= n_groups <= 4096) or len(aggs) < 1:
+            return {}
+
+        # pre-select fusable aggregates from METADATA ONLY, so a below-
+        # threshold set bails out before any expression is evaluated
+        # (otherwise _agg_column would redo each eval)
+        def fusable(a):
+            if a.fn == "count" and not a.args:
+                return True
+            if a.fn == "count_if":
+                return True
+            if a.fn in ("sum", "avg", "partial_sum_double") and a.args:
+                t = getattr(a.args[0], "type", None)
+                return t is not None and t.name in ("DOUBLE", "REAL")
+            return False
+
+        chosen = {sym: a for sym, a in aggs.items() if fusable(a)}
+        f32_mode = bool(self.session.properties.get("float32_compute", False))
+        # with f32 compute even a single aggregate is worth fusing (the
+        # kernel's block-partial + f64 merge beats one long f32 reduce)
+        if len(chosen) < (1 if f32_mode else 2):
+            return {}
+
+        rows: List[jnp.ndarray] = []
+        plan: Dict[str, tuple] = {}
+        any_f32 = False
+        for sym, a in chosen.items():
+            mask = b.sel
+            if a.filter is not None:
+                mask = mask & eval_predicate(a.filter, b, self.ctx)
+            if a.fn == "count" and not a.args:
+                plan[sym] = ("count", len(rows))
+                rows.append(mask)
+            elif a.fn == "count_if":
+                v = eval_expr(a.args[0], b, self.ctx)
+                m = mask & jnp.asarray(v.data)
+                if v.valid is not None:
+                    m = m & v.valid
+                plan[sym] = ("count", len(rows))
+                rows.append(m)
+            else:
+                v = eval_expr(a.args[0], b, self.ctx)
+                col = to_column(v, n)
+                if col.data.dtype not in (jnp.float64, jnp.float32):
+                    continue
+                any_f32 = any_f32 or col.data.dtype == jnp.float32
+                valid = mask if col.valid is None else (mask & col.valid)
+                vi = len(rows)
+                rows.append(jnp.where(valid, col.data,
+                                      jnp.zeros((), col.data.dtype)))
+                ci = len(rows)
+                rows.append(valid)
+                plan[sym] = (a.fn, vi, ci, a.type)
+        if len(plan) < (1 if any_f32 else 2):
+            return {}
+        # on the TPU path the kernel uses f32 block partials with an f64
+        # cross-block merge either way; the interpreter path accumulates
+        # in acc_t across ALL blocks, so it must stay f64 (counts are
+        # exact-integer semantics)
+        acc_t = (jnp.float32 if any_f32 and not K._pallas_interpret()
+                 else jnp.float64)
+        sums = K.fused_group_sums(
+            jnp.stack([r.astype(acc_t) for r in rows]),
+            jnp.clip(gid, 0, n_groups - 1).astype(jnp.int32),
+            n_groups)
+        out: Dict[str, Column] = {}
+        for sym, p in plan.items():
+            if p[0] == "count":
+                # float counts are exact below 2^53
+                out[sym] = Column(jnp.round(sums[p[1]]).astype(jnp.int64),
+                                  None, T.BIGINT)
+                continue
+            fn, vi, ci, out_t = p
+            s = sums[vi]
+            cnt = sums[ci]
+            nonempty = cnt > 0.5
+            if fn == "avg":
+                out[sym] = Column(s / jnp.maximum(cnt, 1.0), nonempty, T.DOUBLE)
+            else:
+                out[sym] = Column(s, nonempty, out_t)
+        return out
 
     def _agg_column(self, b: Batch, a: ir.AggCall, gid, n_groups) -> Column:
         mask = b.sel
@@ -1284,14 +1384,17 @@ class Executor:
         return b.select([s for s in node.symbols])
 
 
-def scan_batch(table, node: P.TableScan) -> Batch:
+def scan_batch(table, node: P.TableScan, f32: bool = False) -> Batch:
     """Read + ingest a table's columns, with a per-table device-column
     cache (upload + dictionary-encode once per process; reference analog:
     a connector page source feeding a cache — here the 'page' is the whole
-    column and lives in HBM)."""
-    cache = getattr(table, "_device_cols", None)
+    column and lives in HBM).  f32=True stores DOUBLE columns as float32
+    (see the float32_compute session property)."""
+    attr = "_device_cols_f32" if f32 else "_device_cols"
+    cache = getattr(table, attr, None)
     if cache is None:
-        cache = table._device_cols = {}
+        cache = {}
+        setattr(table, attr, cache)
     needed = list(dict.fromkeys(node.assignments.values()))
     missing = [c for c in needed if c not in cache]
     if missing:
@@ -1299,7 +1402,11 @@ def scan_batch(table, node: P.TableScan) -> Batch:
 
         data = table.read(missing)
         for c in missing:
-            cache[c] = column_from_numpy(data[c], table.schema[c])
+            col = column_from_numpy(data[c], table.schema[c])
+            if f32 and table.schema[c].name == "DOUBLE":
+                col = Column(col.data.astype(jnp.float32), col.valid,
+                             col.type, col.dictionary)
+            cache[c] = col
     cols = {}
     n = None
     for sym, col in node.assignments.items():

@@ -96,15 +96,95 @@ def pack_with_layout(cols: List[Column], sel, layout) -> jnp.ndarray:
     return jnp.where(sel, key, I64_MAX)
 
 
+_POW2 = None  # lazily-built exact power-of-two table (host constants)
+
+
+def _f64_orderable_arith(d: jnp.ndarray) -> jnp.ndarray:
+    """Order-preserving, injective f64 -> i64 WITHOUT any 64-bit bitcast
+    (the axon TPU compile path cannot rewrite f64 bitcasts).  Decomposes
+    |x| = m * 2^e arithmetically: e from log2 with comparison fixups, m
+    recovered by an EXACT power-of-two table multiply, so mant = m*2^52
+    is the exact 53-bit significand.  Layout: subnormal magnitudes map to
+    [1, 2^52), normals to [(e+1023)*2^52 + mant52] <= 2047*2^52 < 2^63;
+    negatives mirror; +-0 both map to 0 (SQL-correct: they compare
+    equal); +-inf and NaN get sentinels with NaN largest (Presto sort
+    order).  Replaces the classic sign-flip bit trick, which is kept
+    out because jax.lax.bitcast_convert_type(f64) does not compile
+    on this TPU stack."""
+    global _POW2
+    if _POW2 is None:
+        # host-side numpy so the table is a fresh constant per trace
+        # (a traced global would leak tracers)
+        _POW2 = np.asarray([2.0 ** i for i in range(-1099, 1024)],
+                           dtype=np.float64)
+    pow2 = jnp.asarray(_POW2)
+
+    min_normal = 2.2250738585072014e-308
+    ax = jnp.abs(d)
+    e = jnp.floor(jnp.log2(jnp.maximum(ax, min_normal))).astype(jnp.int64)
+    e = jnp.clip(e, -1022, 1023)
+    # ax * 2^-e in two half-exponent steps: a single 2^-1023 constant is
+    # subnormal and DAZ-flushed to zero (which would collapse the whole
+    # top binade); both halves and both intermediates stay normal
+    e1 = e // 2
+    e2 = e - e1
+    m = (ax * pow2[1099 - e1]) * pow2[1099 - e2]  # exact
+    # log2 rounding can be off by one near power-of-two boundaries;
+    # two fixup rounds restore m in [1, 2) exactly
+    for _ in range(2):
+        too_big = m >= 2.0
+        e = jnp.where(too_big, e + 1, e)
+        m = jnp.where(too_big, m * 0.5, m)
+        too_small = m < 1.0
+        e = jnp.where(too_small & (e > -1022), e - 1, e)
+        m = jnp.where(too_small & (e >= -1022), m * 2.0, m)
+    mant = (m * (2.0 ** 52)).astype(jnp.int64) - (1 << 52)
+    # max key = 2047*2^52 - 1, safely below the +-inf/NaN sentinels and
+    # the masked-row sentinel I64_MAX
+    key_norm = (e + 1023) * (1 << 52) + mant
+    # subnormals: XLA runs with FTZ/DAZ, so every arithmetic op in the
+    # engine already sees them as zero — key 0 keeps grouping/joins
+    # consistent with that arithmetic
+    key_mag = jnp.where(ax < min_normal, 0, key_norm)
+    key = jnp.where(d < 0, -key_mag, key_mag)
+    key = jnp.where(jnp.isinf(d),
+                    jnp.where(d > 0, jnp.int64(I64_MAX - 16),
+                              jnp.int64(-(I64_MAX - 16))), key)
+    return jnp.where(jnp.isnan(d), jnp.int64(I64_MAX - 8), key)
+
+
+def _f64_orderable_pair(d: jnp.ndarray) -> jnp.ndarray:
+    """TPU orderable key for f64: lexicographic (hi, lo) float32 pair
+    packed into i64 via 32-bit bitcasts (the only bitcasts this TPU
+    stack compiles).  Monotone for ALL doubles; injective down to
+    48-bit significands — finer-grained values merge, which matches the
+    hardware reality that this TPU's f64 is itself emulated (its
+    floor/convert ops already round near bit 49, see
+    _f64_orderable_arith for the exact CPU path)."""
+    hi = jnp.clip(d.astype(jnp.float32), -3.4e38, 3.4e38)
+    lo = (d - hi.astype(jnp.float64)).astype(jnp.float32)
+    # finite values beyond f32 range merge near the top of the finite
+    # band but stay strictly below +-inf
+    lo = jnp.where(jnp.isfinite(d), jnp.clip(lo, -3.4e38, 3.4e38), lo)
+
+    def o32(f):
+        b = jax.lax.bitcast_convert_type(f, jnp.int32)
+        return jnp.where(b < 0, (~b) + jnp.int32(-(1 << 31)), b)
+
+    key = (o32(hi).astype(jnp.int64) * (1 << 32)
+           + o32(lo).astype(jnp.int64) + (1 << 31))
+    key = jnp.where(d == 0, 0, key)  # +-0 compare equal in SQL
+    return jnp.where(jnp.isnan(d), jnp.int64(I64_MAX - 8), key)
+
+
 def _orderable_int(c: Column) -> jnp.ndarray:
     d = c.data
     if d.dtype == jnp.bool_:
         return d.astype(jnp.int64)
     if jnp.issubdtype(d.dtype, jnp.floating):
-        # order-preserving bit trick: positives keep their bits; negatives
-        # map to [I64_MIN, -1] reversed so the int order == float order
-        bits = jax.lax.bitcast_convert_type(d.astype(jnp.float64), jnp.int64)
-        return jnp.where(bits < 0, (~bits) + jnp.int64(I64_MIN), bits)
+        if jax.default_backend() == "tpu":
+            return _f64_orderable_pair(d.astype(jnp.float64))
+        return _f64_orderable_arith(d.astype(jnp.float64))
     return d.astype(jnp.int64)
 
 
@@ -298,8 +378,12 @@ def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray):
     i are order[lb[i]:ub[i]]."""
     order = jnp.argsort(build_key)
     skey = build_key[order]
-    lb = jnp.searchsorted(skey, probe_key, side="left")
-    ub = jnp.searchsorted(skey, probe_key, side="right")
+    # method='sort' turns the probe into one co-sort instead of a
+    # 23-step binary-search gather chain: on TPU each of those gather
+    # steps costs a full memory pass, making 'scan' ~25x slower for a
+    # 6M-row probe (measured; the join dominates TPC-H Q3 either way)
+    lb = jnp.searchsorted(skey, probe_key, side="left", method="sort")
+    ub = jnp.searchsorted(skey, probe_key, side="right", method="sort")
     # sentinel keys (masked build rows) must not match masked probe rows
     live = probe_key != I64_MAX
     lb = jnp.where(live, lb, 0)
@@ -397,3 +481,101 @@ def sort_perm(batch: Batch, keys: List[Tuple[Column, bool, Optional[bool]]]):
     live = batch.sel[perm]
     order = jnp.argsort(~live, stable=True)
     return perm[order]
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernels (hot ops the XLA autovectorizer doesn't fuse:
+# the multi-aggregate segmented reduction).  CPU test meshes run the
+# same kernels under the Pallas interpreter.
+# ---------------------------------------------------------------------------
+
+
+def _pallas_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def fused_group_sums(vals: jnp.ndarray, gid: jnp.ndarray,
+                     n_groups: int) -> jnp.ndarray:
+    """ONE pass computing k segmented sums that share group ids.
+
+    The reference engine pays one hash-table probe per aggregate per row
+    (InMemoryHashAggregationBuilder); plain XLA pays one scatter-add
+    pass per aggregate column.  This Pallas kernel streams each row
+    block through VMEM once, expands gid to a one-hot (VPU compare
+    against a lane iota), and accumulates ALL k aggregate columns into a
+    VMEM-resident (k, G) table across the sequential TPU grid — the
+    aggregation becomes bandwidth-bound on a single read of the data.
+
+    vals: [k, n] float64 (dead rows must already be zeroed)
+    gid:  [n] int32 in [0, n_groups)
+    returns [k, n_groups] sums (float64).
+
+    Mosaic has no 64-bit types, so the TPU path computes PER-BLOCK f32
+    partial sums on the MXU (one [k,B]x[B,G] matmul per block, no
+    cross-block carry in f32) and XLA reduces the per-block partials in
+    f64 outside the kernel — block-local rounding only, never a long
+    f32 accumulation chain.  The CPU interpreter path keeps f64 inside
+    the kernel.
+    """
+    from jax.experimental import pallas as pl
+
+    k, n = vals.shape
+    G = max(int(np.ceil(n_groups / 128)) * 128, 128)
+    BLOCK = 8192
+    npad = int(np.ceil(n / BLOCK)) * BLOCK
+    if npad != n:
+        vals = jnp.pad(vals, ((0, 0), (0, npad - n)))
+        gid = jnp.pad(gid, (0, npad - n))  # padded rows carry zeros: harmless
+    steps = npad // BLOCK
+    gid2 = gid.reshape(1, -1)
+
+    if _pallas_interpret():
+        def kernel(vals_ref, gid_ref, out_ref):
+            @pl.when(pl.program_id(0) == 0)
+            def _init():
+                out_ref[:, :] = jnp.zeros_like(out_ref)
+
+            g = gid_ref[0, :]  # [BLOCK]
+            onehot = (g[:, None] == jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK, G), 1)).astype(vals_ref.dtype)
+            out_ref[:, :] += jax.lax.dot_general(
+                vals_ref[:, :], onehot, (((1,), (0,)), ((), ())),
+                preferred_element_type=vals_ref.dtype)
+
+        out = pl.pallas_call(
+            kernel,
+            grid=(steps,),
+            in_specs=[
+                pl.BlockSpec((k, BLOCK), lambda i: (0, i)),
+                pl.BlockSpec((1, BLOCK), lambda i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((k, G), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((k, G), vals.dtype),
+            interpret=True,
+        )(vals, gid2)
+        return out[:, :n_groups]
+
+    def kernel32(vals_ref, gid_ref, out_ref):
+        g = gid_ref[0, :]
+        onehot = (g[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (BLOCK, G), 1)).astype(jnp.float32)
+        out_ref[0, :, :] = jax.lax.dot_general(
+            vals_ref[:, :], onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+    vals32 = vals.astype(jnp.float32)
+    # the engine runs with x64 on; Mosaic only takes 32-bit types, so the
+    # kernel traces in an x64-off scope (operands are f32/i32 already)
+    with jax.enable_x64(False):
+        partials = pl.pallas_call(
+            kernel32,
+            grid=(steps,),
+            in_specs=[
+                pl.BlockSpec((k, BLOCK), lambda i: (0, i)),
+                pl.BlockSpec((1, BLOCK), lambda i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, k, G), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((steps, k, G), jnp.float32),
+        )(vals32, gid2)
+    return partials.astype(jnp.float64).sum(axis=0)[:, :n_groups]

@@ -35,6 +35,14 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "recoverable_grouped_execution": False,
     # test hook: abort after N grouped buckets (0 = off)
     "fault_injection_fail_after_buckets": 0,
+    # fuse sum-shaped aggregates into one Pallas pass (kernels.fused_group_sums)
+    "pallas_fused_agg": True,
+    # execute DOUBLE expressions in float32 on device (cross-block
+    # aggregate merges stay f64).  Default off: exact f64 semantics.  On
+    # TPU, f64 is software-emulated (~10-20x per op), so benchmarks turn
+    # this on; money-valued data (2-decimal) keeps comparisons stable
+    # because literals and data round identically.
+    "float32_compute": False,
     "partial_aggregation_max_groups": 8192,  # partial+gather vs repartition agg
     # per-plan-node stats collection in dynamic mode (forced by EXPLAIN
     # ANALYZE; costs one host sync per operator — reference: OperationTimer)
