@@ -69,7 +69,17 @@ def execute_query(session, text: str) -> QueryResult:
 
 
 def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
+    if isinstance(stmt, ast.TransactionStatement):
+        if stmt.action == "START":
+            session.txn.begin(stmt.read_only)
+        elif stmt.action == "COMMIT":
+            session.txn.commit()
+        else:
+            session.txn.rollback()
+        return QueryResult([("result", T.BOOLEAN)], [(True,)])
     if isinstance(stmt, ast.SetSession):
+        session.access_control.check_can_set_session_property(
+            session.user, stmt.name)
         session.set(stmt.name, stmt.value)
         return QueryResult([("result", T.BOOLEAN)], [(True,)])
     if isinstance(stmt, ast.ShowTables):
@@ -86,26 +96,32 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
             text_plan = explain_text(session, stmt.statement)
         return QueryResult([("Query Plan", T.VARCHAR)], [(text_plan,)])
     if isinstance(stmt, ast.CreateTableAs):
+        session.access_control.check_can_create_table(session.user, stmt.name)
         if stmt.name in session.catalog:
             if stmt.if_not_exists:
                 return QueryResult([("rows", T.BIGINT)], [(0,)])
             raise ExecutionError(f"Table '{stmt.name}' already exists")
         arrays, types = execute_plan_to_host(session, ast.QueryStatement(stmt.query))
+        session.txn.record_create(stmt.name)
         _create_table(session, stmt.name, types, stmt.properties, arrays)
         n = len(next(iter(arrays.values()))) if arrays else 0
         return QueryResult([("rows", T.BIGINT)], [(n,)])
     if isinstance(stmt, ast.CreateTable):
+        session.access_control.check_can_create_table(session.user, stmt.name)
         if stmt.name in session.catalog:
             if stmt.if_not_exists:
                 return QueryResult([("result", T.BOOLEAN)], [(True,)])
             raise ExecutionError(f"Table '{stmt.name}' already exists")
         schema = {c: T.parse_type(t) for c, t in stmt.columns}
+        session.txn.record_create(stmt.name)
         _create_table(session, stmt.name, schema, stmt.properties, None)
         return QueryResult([("result", T.BOOLEAN)], [(True,)])
     if isinstance(stmt, ast.DropTable):
+        session.access_control.check_can_drop_table(session.user, stmt.name)
         if stmt.name in session.catalog:
             t = session.catalog.get(stmt.name)
-            if hasattr(t, "drop_data"):
+            session.txn.record_drop(t)
+            if session.txn.current is None and hasattr(t, "drop_data"):
                 t.drop_data()  # engine-managed storage goes with the table
         session.catalog.drop(stmt.name, stmt.if_exists)
         return QueryResult([("result", T.BOOLEAN)], [(True,)])
@@ -197,9 +213,11 @@ def _insert_into(session, stmt: ast.InsertInto) -> int:
     """INSERT INTO t [(cols)] query — reference: TableWriterOperator +
     TableFinishOperator; here the query materializes to host columns that
     are coerced to the target schema and appended via the connector sink."""
+    session.access_control.check_can_insert(session.user, stmt.table)
     table = session.catalog.get(stmt.table)
     if not hasattr(table, "append"):
         raise ExecutionError(f"table '{stmt.table}' does not support INSERT")
+    session.txn.record_table_write(table)
     arrays, types = execute_plan_to_host(session, ast.QueryStatement(stmt.query))
     src_cols = list(arrays)
     targets = stmt.columns if stmt.columns is not None else list(table.schema)
@@ -249,9 +267,11 @@ def _delete_from(session, stmt: ast.Delete) -> int:
     table (a scan+project plan, preserving row order) and hand the keep
     mask to the connector (reference: MetadataDeleteOperator /
     DeleteOperator)."""
+    session.access_control.check_can_delete(session.user, stmt.table)
     table = session.catalog.get(stmt.table)
     if not hasattr(table, "delete_where"):
         raise ExecutionError(f"table '{stmt.table}' does not support DELETE")
+    session.txn.record_table_write(table)
     n = table.row_count()
     if stmt.where is None:
         keep = np.zeros(n, dtype=bool)
@@ -340,10 +360,19 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
 
 
 def plan_statement(session, stmt) -> P.QueryPlan:
+    """Plan + authorize: every table the plan scans is checked against
+    the session's access control (reference: AccessControlManager
+    .checkCanSelectFromColumns during analysis)."""
     planner = Planner(session)
     plan = planner.plan_statement(stmt)
     if session.properties.get("optimizer_enabled", True):
         plan = optimize(plan, session)
+    scans: list = []
+    _collect_tablescans(plan.root, scans)
+    for sub in plan.subplans.values():
+        _collect_tablescans(sub, scans)
+    for t in {n.table for n in scans}:
+        session.access_control.check_can_select(session.user, t)
     return plan
 
 
@@ -875,6 +904,11 @@ class Executor:
 
         chosen = {sym: a for sym, a in aggs.items() if fusable(a)}
         f32_mode = bool(self.session.properties.get("float32_compute", False))
+        if not f32_mode and not K._pallas_interpret():
+            # the TPU kernel accumulates f32 block partials; without the
+            # float32_compute opt-in the session promises full-precision
+            # f64, so stay on the (slower) exact scatter-add path
+            return {}
         # with f32 compute even a single aggregate is worth fusing (the
         # kernel's block-partial + f64 merge beats one long f32 reduce)
         if len(chosen) < (1 if f32_mode else 2):
@@ -1390,13 +1424,24 @@ def scan_batch(table, node: P.TableScan, f32: bool = False) -> Batch:
     a connector page source feeding a cache — here the 'page' is the whole
     column and lives in HBM).  f32=True stores DOUBLE columns as float32
     (see the float32_compute session property)."""
-    attr = "_device_cols_f32" if f32 else "_device_cols"
-    cache = getattr(table, attr, None)
-    if cache is None:
-        cache = {}
-        setattr(table, attr, cache)
+    base = getattr(table, "_device_cols", None)
+    if base is None:
+        base = table._device_cols = {}
+    f32cache = None
+    if f32:
+        # only DOUBLE columns differ in f32 mode; everything else shares
+        # the base cache (no duplicate uploads / HBM residency)
+        f32cache = getattr(table, "_device_cols_f32", None)
+        if f32cache is None:
+            f32cache = table._device_cols_f32 = {}
+
+    def cache_for(colname):
+        if f32 and table.schema[colname].name == "DOUBLE":
+            return f32cache
+        return base
+
     needed = list(dict.fromkeys(node.assignments.values()))
-    missing = [c for c in needed if c not in cache]
+    missing = [c for c in needed if c not in cache_for(c)]
     if missing:
         from presto_tpu.batch import column_from_numpy
 
@@ -1406,11 +1451,11 @@ def scan_batch(table, node: P.TableScan, f32: bool = False) -> Batch:
             if f32 and table.schema[c].name == "DOUBLE":
                 col = Column(col.data.astype(jnp.float32), col.valid,
                              col.type, col.dictionary)
-            cache[c] = col
+            cache_for(c)[c] = col
     cols = {}
     n = None
     for sym, col in node.assignments.items():
-        c = cache[col]
+        c = cache_for(col)[col]
         cols[sym] = Column(c.data, c.valid, node.types[sym], c.dictionary)
         n = c.data.shape[0]
     return Batch(cols, jnp.ones((n or 0,), bool))

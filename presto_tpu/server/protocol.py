@@ -48,8 +48,9 @@ class PrestoTpuServer:
     thread pool so the HTTP loop never blocks on execution."""
 
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
-                 max_concurrent: int = 4):
+                 max_concurrent: int = 4, resource_groups=None):
         self.session = session
+        self.resource_groups = resource_groups  # ResourceGroupManager | None
         self.jobs: Dict[str, _QueryJob] = {}
         self.jobs_lock = threading.Lock()
         self.node_id = f"node_{uuid.uuid4().hex[:8]}"
@@ -103,12 +104,36 @@ class PrestoTpuServer:
         return job
 
     def _run_job(self, job: _QueryJob) -> None:
+        group = None
+        rgm = getattr(self, "resource_groups", None)
+        try:
+            if rgm is not None:
+                # admission BEFORE the worker semaphore: a query queued on
+                # a saturated group must not hold a worker slot (it would
+                # starve other groups — head-of-line blocking)
+                group = rgm.acquire(self.session.user, self.session.source)
+        except Exception as e:  # noqa: BLE001 — rejection is a query error
+            job.error = f"{type(e).__name__}: {e}"
+            job.state = "FAILED"
+            job.done.set()
+            with self.jobs_lock:
+                self.active_queries -= 1
+            return
         with self._sema:
             try:
                 if job.cancel.is_set():
                     job.state = "CANCELED"
                     return
+                head = job.sql.lstrip().upper()
+                if head.startswith(("START", "COMMIT", "ROLLBACK")):
+                    # the protocol server multiplexes ONE session across
+                    # clients; an explicit transaction here could roll
+                    # back another client's acknowledged writes
+                    raise RuntimeError(
+                        "explicit transactions are not supported over the "
+                        "shared protocol server; use an embedded session")
                 job.state = "RUNNING"
+                self.session.apply_property_manager()
                 result = self.session.sql(job.sql)
                 if job.cancel.is_set():
                     job.state = "CANCELED"
@@ -129,6 +154,8 @@ class PrestoTpuServer:
                 job.error = f"{type(e).__name__}: {e}"
                 job.state = "FAILED"
             finally:
+                if group is not None:
+                    rgm.release(group)
                 job.done.set()
                 with self.jobs_lock:
                     self.active_queries -= 1
@@ -254,6 +281,9 @@ def _make_handler(server: PrestoTpuServer):
                 return self._json(server.info_payload())
             if parts == ["v1", "status"]:  # heartbeat probe target
                 return self._json({"nodeId": server.node_id, "alive": True})
+            if parts == ["v1", "resourceGroupState"]:
+                rgm = server.resource_groups
+                return self._json(rgm.info() if rgm is not None else [])
             if parts == ["v1", "cluster"]:
                 with server.jobs_lock:
                     active = server.active_queries

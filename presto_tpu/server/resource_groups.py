@@ -1,0 +1,165 @@
+"""Resource groups: admission control for concurrent queries.
+
+Reference parity: execution/resourceGroups/InternalResourceGroup(+Manager)
+and the file-backed config in presto-resource-group-managers — a tree of
+groups with concurrency/queue limits, selectors mapping (user, source) to
+a group, and fair scheduling of queued queries.  Trimmed to the engine's
+process model: admission happens at submit time (the protocol server or
+the embedded session), release at completion; weighted subgroup
+scheduling collapses to FIFO-fair per group.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional
+
+
+class ResourceGroup:
+    """One node of the group tree (reference: InternalResourceGroup)."""
+
+    def __init__(self, name: str, hard_concurrency_limit: int = 100,
+                 max_queued: int = 1000,
+                 parent: Optional["ResourceGroup"] = None):
+        self.name = name
+        self.hard_concurrency_limit = hard_concurrency_limit
+        self.max_queued = max_queued
+        self.parent = parent
+        self.children: Dict[str, ResourceGroup] = {}
+        self.running = 0
+        self.queued = 0
+        self.total_admitted = 0
+        self.total_rejected = 0
+
+    @property
+    def full_name(self) -> str:
+        if self.parent is None:
+            return self.name
+        return f"{self.parent.full_name}.{self.name}"
+
+    def can_run(self) -> bool:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            if g.running >= g.hard_concurrency_limit:
+                return False
+            g = g.parent
+        return True
+
+    def _for_ancestors(self, fn) -> None:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            fn(g)
+            g = g.parent
+
+
+class QueryRejected(Exception):
+    """Queue full (reference: QUERY_QUEUE_FULL error)."""
+
+
+class ResourceGroupManager:
+    """Selector-driven admission (reference: InternalResourceGroupManager
+    + StaticSelector).  `acquire` blocks while the group is saturated
+    (the QUEUED state), raises QueryRejected past max_queued."""
+
+    def __init__(self):
+        self.root = ResourceGroup("global")
+        self._lock = threading.Lock()
+        self._wakeup = threading.Condition(self._lock)
+        self.selectors: List[tuple] = []  # (user_re, source_re, group)
+
+    # ---- configuration ----------------------------------------------
+    def add_group(self, path: str, hard_concurrency_limit: int = 100,
+                  max_queued: int = 1000) -> ResourceGroup:
+        parts = path.split(".")
+        assert parts[0] == "global", "group paths are rooted at 'global'"
+        g = self.root
+        for p in parts[1:]:
+            if p not in g.children:
+                g.children[p] = ResourceGroup(p, parent=g)
+            g = g.children[p]
+        g.hard_concurrency_limit = hard_concurrency_limit
+        g.max_queued = max_queued
+        return g
+
+    def add_selector(self, group_path: str, user: Optional[str] = None,
+                     source: Optional[str] = None) -> None:
+        self.selectors.append(
+            (re.compile(user) if user else None,
+             re.compile(source) if source else None,
+             group_path))
+
+    def load_config(self, config: dict) -> None:
+        """File-config shape (reference: resource-groups.json):
+        {"groups": [{"name": "global.etl", "hardConcurrencyLimit": 2,
+                     "maxQueued": 5}],
+         "selectors": [{"user": "etl.*", "group": "global.etl"}]}"""
+        for g in config.get("groups", []):
+            self.add_group(g["name"],
+                           g.get("hardConcurrencyLimit", 100),
+                           g.get("maxQueued", 1000))
+        for s in config.get("selectors", []):
+            self.add_selector(s["group"], s.get("user"), s.get("source"))
+
+    # ---- admission ---------------------------------------------------
+    def select_group(self, user: str = "", source: str = "") -> ResourceGroup:
+        for user_re, source_re, path in self.selectors:
+            if user_re is not None and not user_re.fullmatch(user or ""):
+                continue
+            if source_re is not None and not source_re.fullmatch(source or ""):
+                continue
+            return self._resolve(path)
+        return self.root
+
+    def _resolve(self, path: str) -> ResourceGroup:
+        g = self.root
+        for p in path.split(".")[1:]:
+            g = g.children[p]
+        return g
+
+    def acquire(self, user: str = "", source: str = "",
+                timeout: float = 60.0) -> ResourceGroup:
+        group = self.select_group(user, source)
+        with self._lock:
+            if not group.can_run():
+                if group.queued >= group.max_queued:
+                    group.total_rejected += 1
+                    raise QueryRejected(
+                        f"Too many queued queries for '{group.full_name}'")
+                group.queued += 1
+                try:
+                    deadline = threading.TIMEOUT_MAX if timeout is None \
+                        else timeout
+                    ok = self._wakeup.wait_for(group.can_run, timeout=deadline)
+                    if not ok:
+                        group.total_rejected += 1
+                        raise QueryRejected(
+                            f"Query queue timeout in '{group.full_name}'")
+                finally:
+                    group.queued -= 1
+            group._for_ancestors(lambda g: setattr(g, "running", g.running + 1))
+            group.total_admitted += 1
+            return group
+
+    def release(self, group: ResourceGroup) -> None:
+        with self._lock:
+            group._for_ancestors(
+                lambda g: setattr(g, "running", max(0, g.running - 1)))
+            self._wakeup.notify_all()
+
+    def info(self) -> list:
+        """Flat group stats (reference: /v1/resourceGroupState)."""
+        out = []
+
+        def walk(g):
+            out.append({"name": g.full_name, "running": g.running,
+                        "queued": g.queued,
+                        "hardConcurrencyLimit": g.hard_concurrency_limit,
+                        "maxQueued": g.max_queued,
+                        "totalAdmitted": g.total_admitted,
+                        "totalRejected": g.total_rejected})
+            for c in g.children.values():
+                walk(c)
+
+        walk(self.root)
+        return out

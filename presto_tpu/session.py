@@ -84,15 +84,25 @@ class QueryResult:
 
 
 class Session:
-    def __init__(self, catalog=None, properties: Optional[Dict[str, Any]] = None):
+    def __init__(self, catalog=None, properties: Optional[Dict[str, Any]] = None,
+                 user: str = "user", source: str = "embedded"):
         import collections
 
         from presto_tpu.catalog import Catalog
+        from presto_tpu.security import ALLOW_ALL, SessionPropertyManager
+        from presto_tpu.transaction import TransactionManager
 
         self.catalog = catalog if catalog is not None else Catalog()
+        self.user = user
+        self.source = source
+        self.access_control = ALLOW_ALL  # security.FileBasedAccessControl to restrict
+        self.txn = TransactionManager(self)
+        self.property_manager: Optional[SessionPropertyManager] = None
         self.properties = dict(DEFAULT_SESSION_PROPERTIES)
+        self._explicit_props: set = set()
         if properties:
             self.properties.update(properties)
+            self._explicit_props.update(properties)
         # query introspection + event pipeline (reference: QueryTracker
         # bounded history + eventlistener/EventListenerManager); the lock
         # covers concurrent server threads appending while others iterate
@@ -106,6 +116,8 @@ class Session:
         if name not in self.properties:
             raise KeyError(f"unknown session property: {name}")
         self.properties[name] = value
+        # explicit settings outrank property-manager rule defaults
+        self._explicit_props.add(name)
 
     def add_event_listener(self, listener) -> None:
         self.event_listeners.append(listener)
@@ -120,6 +132,17 @@ class Session:
     def history_snapshot(self) -> list:
         with self.history_lock:
             return list(self.history)
+
+    def apply_property_manager(self) -> None:
+        """Apply rule-matched session property DEFAULTS (reference:
+        SessionPropertyConfigurationManager) — explicit SET SESSION /
+        constructor values outrank rules, matching the reference's
+        precedence."""
+        if self.property_manager is not None:
+            for k, v in self.property_manager.overrides(
+                    self.user, self.source).items():
+                if k in self.properties and k not in self._explicit_props:
+                    self.properties[k] = v
 
     def sql(self, text: str) -> QueryResult:
         from presto_tpu.exec.executor import execute_query

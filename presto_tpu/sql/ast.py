@@ -315,6 +315,15 @@ class Delete(Statement):
 
 
 @dataclass
+class TransactionStatement(Statement):
+    """START TRANSACTION [READ ONLY] | COMMIT | ROLLBACK (reference:
+    SqlBase.g4 startTransaction/commit/rollback)."""
+
+    action: str  # START | COMMIT | ROLLBACK
+    read_only: bool = False
+
+
+@dataclass
 class SetSession(Statement):
     name: str
     value: object

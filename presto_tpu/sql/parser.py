@@ -43,7 +43,8 @@ KEYWORDS = {
     "EXPLAIN", "ANALYZE", "SHOW", "TABLES", "COLUMNS", "CREATE", "TABLE",
     "INSERT", "INTO", "SET", "SESSION", "OVER", "PARTITION", "ROWS", "RANGE",
     "UNBOUNDED", "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "UNNEST",
-    "ORDINALITY", "FILTER", "DROP", "DELETE", "IF",
+    "ORDINALITY", "FILTER", "DROP", "DELETE", "IF", "START", "TRANSACTION",
+    "COMMIT", "ROLLBACK", "READ", "ONLY", "WRITE",
 }
 
 
@@ -144,7 +145,11 @@ class Parser:
         if t.kind == "kw" and t.value in ("DATE", "TIME", "TIMESTAMP", "VALUES",
                                           "FILTER", "ROW", "ANALYZE", "SESSION",
                                           "TABLES", "COLUMNS", "FIRST", "LAST",
-                                          "ALL", "SET", "SHOW", "IF"):
+                                          "ALL", "SET", "SHOW", "IF",
+                                          # txn words are only consumed at
+                                          # statement starts — non-reserved
+                                          "START", "TRANSACTION", "COMMIT",
+                                          "ROLLBACK", "READ", "ONLY", "WRITE"):
             self.i += 1
             return t.value.lower()
         self.err("expected identifier")
@@ -216,6 +221,19 @@ class Parser:
             if self.accept_kw("WHERE"):
                 where = self.expr()
             return ast.Delete(name, where)
+        if self.accept_kw("START"):
+            self.expect_kw("TRANSACTION")
+            read_only = False
+            if self.accept_kw("READ"):
+                if self.accept_kw("ONLY"):
+                    read_only = True
+                else:
+                    self.expect_kw("WRITE")
+            return ast.TransactionStatement("START", read_only)
+        if self.accept_kw("COMMIT"):
+            return ast.TransactionStatement("COMMIT")
+        if self.accept_kw("ROLLBACK"):
+            return ast.TransactionStatement("ROLLBACK")
         if self.accept_kw("INSERT"):
             self.expect_kw("INTO")
             name = self.ident()
@@ -671,7 +689,8 @@ class Parser:
             return e
         if t.kind == "ident" or (t.kind == "kw" and t.value in (
                 "DATE", "TIME", "TIMESTAMP", "FILTER", "ROW", "FIRST", "LAST",
-                "SET", "VALUES", "IF")):
+                "SET", "VALUES", "IF", "START", "READ", "ONLY", "WRITE",
+                "COMMIT", "ROLLBACK", "TRANSACTION")):
             name = self.ident()
             if self.at_op("("):
                 return self._function_call(name)

@@ -1,0 +1,98 @@
+"""Transaction manager: autocommit + explicit transactions with rollback.
+
+Reference parity: transaction/TransactionManager + the access-mode
+checks in transaction/TransactionAccessControl — START TRANSACTION
+[READ ONLY] / COMMIT / ROLLBACK, single-statement autocommit otherwise.
+Isolation is snapshot-by-undo: the first write to a table inside a
+transaction records an undo entry (memory-connector pre-image, or the
+inverse DDL action); ROLLBACK replays undos in reverse.  Connectors
+without pre-image support (localfile shards) reject transactional
+writes, like reference connectors that lack transaction support.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class TransactionError(Exception):
+    pass
+
+
+class Transaction:
+    def __init__(self, read_only: bool = False):
+        self.read_only = read_only
+        self.undo: List[tuple] = []  # (kind, payload) in apply order
+        self._snapshotted: set = set()
+
+
+class TransactionManager:
+    """One manager per session (the engine's session IS the reference's
+    transaction-bound client session)."""
+
+    def __init__(self, session):
+        self.session = session
+        self.current: Optional[Transaction] = None
+
+    # ---- statement surface ------------------------------------------
+    def begin(self, read_only: bool = False) -> None:
+        if self.current is not None:
+            raise TransactionError("transaction already in progress")
+        self.current = Transaction(read_only)
+
+    def commit(self) -> None:
+        if self.current is None:
+            raise TransactionError("no transaction in progress")
+        self.current = None  # writes already applied; drop undo log
+
+    def rollback(self) -> None:
+        if self.current is None:
+            raise TransactionError("no transaction in progress")
+        txn, self.current = self.current, None
+        cat = self.session.catalog
+        for kind, payload in reversed(txn.undo):
+            if kind == "table_preimage":
+                table, data, rows = payload
+                table.data = data
+                table._rows = rows
+                table._invalidate()
+            elif kind == "uncreate":
+                cat.drop(payload, if_exists=True)
+            elif kind == "reregister":
+                cat.register(payload)
+
+    # ---- write hooks (called by the executor's write paths) ----------
+    def check_write_allowed(self) -> None:
+        if self.current is not None and self.current.read_only:
+            raise TransactionError("read-only transaction")
+
+    def record_table_write(self, table) -> None:
+        """Before mutating `table`, snapshot its pre-image once."""
+        self.check_write_allowed()
+        if self.current is None:
+            return  # autocommit
+        if id(table) in self.current._snapshotted:
+            return
+        if not hasattr(table, "data"):
+            raise TransactionError(
+                f"table '{table.name}' does not support transactional "
+                "writes (memory connector only)")
+        self.current._snapshotted.add(id(table))
+        self.current.undo.append(
+            ("table_preimage",
+             (table, {k: v.copy() for k, v in table.data.items()},
+              table._rows)))
+
+    def record_create(self, name: str) -> None:
+        self.check_write_allowed()
+        if self.current is not None:
+            self.current.undo.append(("uncreate", name))
+
+    def record_drop(self, table) -> None:
+        self.check_write_allowed()
+        if self.current is not None:
+            if not hasattr(table, "data"):
+                raise TransactionError(
+                    f"DROP of '{table.name}' is not transactional "
+                    "(storage would be deleted); COMMIT first")
+            self.current.undo.append(("reregister", table))

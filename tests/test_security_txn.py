@@ -1,0 +1,236 @@
+"""Resource groups, access control, session property rules, and
+transactions (reference analogs: TestResourceGroups,
+TestFileBasedSystemAccessControl, TestSessionPropertyManager,
+TestTransactionManager in presto-main)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.catalog import Catalog, MemoryTable
+from presto_tpu.security import (AccessDeniedError, FileBasedAccessControl,
+                                 SessionPropertyManager)
+from presto_tpu.server.resource_groups import (QueryRejected,
+                                               ResourceGroupManager)
+from presto_tpu.transaction import TransactionError
+
+
+def _catalog():
+    cat = Catalog()
+    cat.register(MemoryTable("t1", {"x": T.BIGINT},
+                             {"x": np.arange(10)}))
+    cat.register(MemoryTable("secret", {"x": T.BIGINT},
+                             {"x": np.arange(5)}))
+    return cat
+
+
+# ---------------------------------------------------------------------------
+# resource groups
+# ---------------------------------------------------------------------------
+
+
+def test_resource_group_concurrency_and_queueing():
+    rgm = ResourceGroupManager()
+    rgm.load_config({
+        "groups": [{"name": "global.etl", "hardConcurrencyLimit": 2,
+                    "maxQueued": 1}],
+        "selectors": [{"user": "etl.*", "group": "global.etl"}],
+    })
+    g1 = rgm.acquire("etl_a")
+    g2 = rgm.acquire("etl_b")
+    assert g1.full_name == "global.etl" and g1.running == 2
+    # third acquire queues; release unblocks it
+    got = []
+
+    def worker():
+        got.append(rgm.acquire("etl_c", timeout=5))
+
+    th = threading.Thread(target=worker)
+    th.start()
+    time.sleep(0.1)
+    assert g1.queued == 1 and not got
+    rgm.release(g1)
+    th.join(timeout=5)
+    assert len(got) == 1  # queued query ran after a slot freed
+    rgm.release(g2)
+    g4 = rgm.acquire("etl_d", timeout=5)  # slot free, direct admission
+    rgm.release(got[0])
+    rgm.release(g4)
+    info = {g["name"]: g for g in rgm.info()}
+    assert info["global.etl"]["running"] == 0
+    assert info["global.etl"]["totalAdmitted"] == 4
+
+
+def test_resource_group_rejects_past_max_queued():
+    rgm = ResourceGroupManager()
+    rgm.load_config({
+        "groups": [{"name": "global.tiny", "hardConcurrencyLimit": 1,
+                    "maxQueued": 0}],
+        "selectors": [{"group": "global.tiny"}],
+    })
+    g = rgm.acquire("anyone")
+    with pytest.raises(QueryRejected):
+        rgm.acquire("other", timeout=0.2)
+    rgm.release(g)
+
+
+def test_resource_groups_in_protocol_server():
+    from presto_tpu.client.statement import StatementClient
+    from presto_tpu.server.protocol import PrestoTpuServer
+
+    rgm = ResourceGroupManager()
+    rgm.add_group("global", hard_concurrency_limit=2, max_queued=10)
+    s = presto_tpu.connect(_catalog())
+    server = PrestoTpuServer(s, resource_groups=rgm).start()
+    try:
+        client = StatementClient(server.uri, "SELECT count(*) FROM t1")
+        assert list(client.rows()) == [(10,)]
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/v1/resourceGroupState") as r:
+            info = json.loads(r.read())
+        assert info[0]["totalAdmitted"] >= 1
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# access control
+# ---------------------------------------------------------------------------
+
+
+def test_file_based_access_control():
+    s = presto_tpu.connect(_catalog())
+    s.user = "bob"
+    s.access_control = FileBasedAccessControl({
+        "tables": [
+            {"user": "bob", "table": "t1", "privileges": ["SELECT", "INSERT"]},
+            {"user": "admin", "table": ".*",
+             "privileges": ["SELECT", "INSERT", "DELETE", "OWNERSHIP"]},
+        ]})
+    assert s.sql("SELECT count(*) FROM t1").rows == [(10,)]
+    with pytest.raises(AccessDeniedError):
+        s.sql("SELECT * FROM secret")
+    with pytest.raises(AccessDeniedError):
+        s.sql("DELETE FROM t1")      # no DELETE privilege
+    with pytest.raises(AccessDeniedError):
+        s.sql("CREATE TABLE t2 (x bigint)")  # no OWNERSHIP
+    s.user = "admin"
+    assert s.sql("SELECT count(*) FROM secret").rows == [(5,)]
+    s.sql("CREATE TABLE t2 (x bigint)")
+    s.sql("DROP TABLE t2")
+
+
+# ---------------------------------------------------------------------------
+# session property manager
+# ---------------------------------------------------------------------------
+
+
+def test_session_property_manager_rules():
+    mgr = SessionPropertyManager([
+        {"user": "etl.*", "sessionProperties": {"spill_enabled": False}},
+        {"user": "etl_special", "sessionProperties": {"spill_enabled": True,
+                                                      "task_count": 9}},
+    ])
+    assert mgr.overrides("etl_x") == {"spill_enabled": False}
+    # later rules win on overlap
+    assert mgr.overrides("etl_special")["spill_enabled"] is True
+    assert mgr.overrides("someone") == {}
+    s = presto_tpu.connect(_catalog())
+    s.user = "etl_x"
+    s.property_manager = SessionPropertyManager(
+        [{"user": "etl.*", "sessionProperties": {"spill_enabled": False}}])
+    s.apply_property_manager()
+    assert s.properties["spill_enabled"] is False
+
+
+# ---------------------------------------------------------------------------
+# transactions
+# ---------------------------------------------------------------------------
+
+
+def test_transaction_rollback_restores_writes():
+    s = presto_tpu.connect(_catalog())
+    before = s.sql("SELECT sum(x) FROM t1").rows
+    s.sql("START TRANSACTION")
+    s.sql("INSERT INTO t1 SELECT x FROM t1")
+    assert s.sql("SELECT count(*) FROM t1").rows == [(20,)]
+    s.sql("ROLLBACK")
+    assert s.sql("SELECT count(*) FROM t1").rows == [(10,)]
+    assert s.sql("SELECT sum(x) FROM t1").rows == before
+
+
+def test_transaction_commit_keeps_writes():
+    s = presto_tpu.connect(_catalog())
+    s.sql("START TRANSACTION")
+    s.sql("DELETE FROM t1 WHERE x >= 5")
+    s.sql("COMMIT")
+    assert s.sql("SELECT count(*) FROM t1").rows == [(5,)]
+    with pytest.raises(TransactionError):
+        s.sql("COMMIT")  # nothing in progress
+
+
+def test_transaction_ddl_rollback():
+    s = presto_tpu.connect(_catalog())
+    s.sql("START TRANSACTION")
+    s.sql("CREATE TABLE tx1 AS SELECT 1 AS a")
+    s.sql("DROP TABLE t1")
+    assert "t1" not in s.catalog
+    s.sql("ROLLBACK")
+    assert "t1" in s.catalog
+    assert "tx1" not in s.catalog
+    assert s.sql("SELECT count(*) FROM t1").rows == [(10,)]
+
+
+def test_read_only_transaction_blocks_writes():
+    s = presto_tpu.connect(_catalog())
+    s.sql("START TRANSACTION READ ONLY")
+    assert s.sql("SELECT count(*) FROM t1").rows == [(10,)]
+    with pytest.raises(TransactionError):
+        s.sql("INSERT INTO t1 SELECT x FROM t1")
+    s.sql("ROLLBACK")
+
+
+def test_txn_words_usable_as_identifiers():
+    import numpy as np
+    from presto_tpu.catalog import Catalog, MemoryTable
+    from presto_tpu import types as T
+
+    cat = Catalog()
+    cat.register(MemoryTable("metrics", {"read": T.BIGINT, "write": T.BIGINT},
+                             {"read": np.arange(5), "write": np.arange(5) * 2}))
+    s = presto_tpu.connect(cat)
+    assert s.sql("SELECT read, write FROM metrics WHERE read > 2").rows \
+        == [(3, 6), (4, 8)]
+    assert s.sql("SELECT 1 AS start").rows == [(1,)]
+
+
+def test_server_rejects_transactions():
+    from presto_tpu.client.statement import QueryError, StatementClient
+    from presto_tpu.server.protocol import PrestoTpuServer
+
+    s = presto_tpu.connect(_catalog())
+    srv = PrestoTpuServer(s).start()
+    try:
+        c = StatementClient(srv.uri, "START TRANSACTION")
+        with pytest.raises(QueryError, match="embedded"):
+            list(c.rows())
+    finally:
+        srv.stop()
+
+
+def test_explicit_set_outranks_property_rules():
+    s = presto_tpu.connect(_catalog())
+    s.property_manager = SessionPropertyManager(
+        [{"user": ".*", "sessionProperties": {"spill_enabled": False}}])
+    s.apply_property_manager()
+    assert s.properties["spill_enabled"] is False
+    s.set("spill_enabled", True)     # explicit user choice
+    s.apply_property_manager()       # rules must NOT clobber it
+    assert s.properties["spill_enabled"] is True
