@@ -1,0 +1,157 @@
+"""Benchmark suite: named operator- and SQL-level microbenchmarks.
+
+Reference parity: presto-benchmark (BenchmarkSuite.java:32 over a
+LocalQueryRunner — HandTpchQuery1/6 hand-built pipelines, hash build
++join, aggregations) and presto-benchmark-driver's wall-time stats.
+Hand-built benchmarks call the kernel layer directly (the compiled
+fragment a query would lower to); SQL benchmarks run through the full
+engine.
+
+CLI:  python -m presto_tpu.benchmarks [--sf 0.1] [--runs 3] [--filter x]
+prints one line per benchmark: name, wall ms (median of runs), rows/sec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class BenchResult:
+    name: str
+    median_ms: float
+    rows_per_sec: float
+    runs_ms: List[float]
+
+
+class BenchmarkSuite:
+    def __init__(self, session, runs: int = 3):
+        self.session = session
+        self.runs = runs
+        self.benchmarks: Dict[str, tuple] = {}  # name -> (fn, row_count)
+
+    def add(self, name: str, fn: Callable[[], object], rows: int) -> None:
+        self.benchmarks[name] = (fn, rows)
+
+    def add_sql(self, name: str, sql: str, rows: int) -> None:
+        self.add(name, lambda: self.session.sql(sql), rows)
+
+    def run(self, pattern: Optional[str] = None) -> List[BenchResult]:
+        out = []
+        for name, (fn, rows) in self.benchmarks.items():
+            if pattern and pattern not in name:
+                continue
+            fn()  # prewarm (compile caches, device upload)
+            times = []
+            for _ in range(self.runs):
+                t0 = time.perf_counter()
+                fn()
+                times.append((time.perf_counter() - t0) * 1e3)
+            med = statistics.median(times)
+            out.append(BenchResult(name, med, rows / (med / 1e3), times))
+        return out
+
+
+def _hand_q1(session):
+    """Hand-built TPC-H Q1 fragment at the kernel layer (reference:
+    HandTpchQuery1.java building the operator pipeline by hand)."""
+    import jax
+    import jax.numpy as jnp
+
+    from presto_tpu.exec import kernels as K
+    from presto_tpu.exec.executor import scan_batch
+    from presto_tpu.plan import nodes as P
+
+    t = session.catalog.get("lineitem")
+    node = P.TableScan("lineitem", {c: c for c in (
+        "l_shipdate", "l_returnflag", "l_linestatus", "l_quantity",
+        "l_extendedprice", "l_discount", "l_tax")},
+        {c: t.schema[c] for c in (
+            "l_shipdate", "l_returnflag", "l_linestatus", "l_quantity",
+            "l_extendedprice", "l_discount", "l_tax")})
+    b = scan_batch(t, node)
+
+    @jax.jit
+    def frag(b):
+        sel = b.sel & (b.columns["l_shipdate"].data <= 10471)
+        key = (b.columns["l_returnflag"].data * 8
+               + b.columns["l_linestatus"].data).astype(jnp.int32)
+        qty = b.columns["l_quantity"].data
+        price = b.columns["l_extendedprice"].data
+        disc = b.columns["l_discount"].data
+        tax = b.columns["l_tax"].data
+        disc_price = price * (1.0 - disc)
+        charge = disc_price * (1.0 + tax)
+        vals = jnp.stack([
+            jnp.where(sel, qty, 0.0), jnp.where(sel, price, 0.0),
+            jnp.where(sel, disc_price, 0.0), jnp.where(sel, charge, 0.0),
+            jnp.where(sel, disc, 0.0), sel.astype(qty.dtype)])
+        return K.fused_group_sums(vals, key, 64)
+
+    return lambda: jax.block_until_ready(frag(b))
+
+
+def build_default_suite(session, sf: float) -> BenchmarkSuite:
+    from presto_tpu.connectors import tpch as tpch_gen
+    from tests.tpch_queries import QUERIES
+
+    suite = BenchmarkSuite(session)
+    li = tpch_gen.row_count("lineitem", sf)
+    orders = tpch_gen.row_count("orders", sf)
+    suite.add("hand_tpch_q1", _hand_q1(session), li)
+    suite.add_sql("sql_tpch_q1", QUERIES[1], li)
+    suite.add_sql("sql_tpch_q3", QUERIES[3], li + orders)
+    suite.add_sql("sql_tpch_q6", QUERIES[6], li)
+    suite.add_sql("hash_join",
+                  "SELECT count(*) FROM lineitem, orders "
+                  "WHERE l_orderkey = o_orderkey", li + orders)
+    suite.add_sql("group_by_bigkey",
+                  "SELECT l_orderkey, count(*) FROM lineitem "
+                  "GROUP BY l_orderkey", li)
+    suite.add_sql("order_by",
+                  "SELECT l_extendedprice FROM lineitem "
+                  "ORDER BY l_extendedprice DESC LIMIT 100", li)
+    suite.add_sql("window_rank",
+                  "SELECT l_orderkey, rank() OVER "
+                  "(PARTITION BY l_returnflag ORDER BY l_extendedprice) "
+                  "FROM lineitem LIMIT 10", li)
+    return suite
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    p = argparse.ArgumentParser()
+    p.add_argument("--sf", type=float, default=0.1)
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--filter", default=None)
+    p.add_argument("--device", default=None,
+                   help="jax platform override (e.g. cpu); default = "
+                        "the real backend, as benchmarks should be")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.device:
+        jax.config.update("jax_platforms", args.device)
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+
+    session = presto_tpu.connect(
+        tpch_catalog(args.sf, cache_dir="/tmp/presto_tpu_cache"))
+    suite = build_default_suite(session, args.sf)
+    suite.runs = args.runs
+    for r in suite.run(args.filter):
+        print(f"{r.name:<20} {r.median_ms:10.1f} ms   "
+              f"{r.rows_per_sec:14,.0f} rows/s   runs={['%.0f' % t for t in r.runs_ms]}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,163 @@
+"""Verifier: replay a query corpus against two targets and compare
+result checksums.
+
+Reference parity: presto-verifier (PrestoVerifier + checksum/
+ChecksumValidator + resolver/) — control vs test cluster A/B runs with
+order-insensitive checksums and float tolerance.  Targets here are any
+`sql -> rows` callables: two engine sessions (e.g. different session
+properties, or engine-vs-engine across versions) or the sqlite oracle.
+
+CLI:  python -m presto_tpu.verifier --sf 0.01 [--corpus tpch|tpcds]
+runs the bundled corpus engine-vs-sqlite and prints a report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, Dict, List, Optional
+
+Runner = Callable[[str], list]
+
+
+@dataclasses.dataclass
+class VerifyResult:
+    name: str
+    state: str  # MATCH | MISMATCH | CONTROL_FAIL | TEST_FAIL | SKIP
+    detail: str = ""
+    control_ms: float = 0.0
+    test_ms: float = 0.0
+
+
+def row_checksum(rows, float_digits: int = 4) -> int:
+    """Order-insensitive checksum with float canonicalization
+    (reference: checksum/FloatingPointColumnValidator's tolerance idea,
+    collapsed into rounding before hashing)."""
+    from presto_tpu import native
+
+    total = 0
+    for row in rows:
+        parts = []
+        for v in row:
+            if v is None:
+                parts.append("\\N")
+            elif isinstance(v, float):
+                if math.isnan(v):
+                    parts.append("nan")
+                elif v == 0:
+                    parts.append("0")
+                else:
+                    parts.append(f"{v:.{float_digits}e}")
+            else:
+                parts.append(str(v))
+        h = native.xxh64("|".join(parts).encode("utf-8"))
+        total = (total + h) & 0xFFFFFFFFFFFFFFFF  # commutative merge
+    return total
+
+
+class Verifier:
+    def __init__(self, control: Runner, test: Runner,
+                 float_digits: int = 4):
+        self.control = control
+        self.test = test
+        self.float_digits = float_digits
+
+    def verify_one(self, name: str, sql: str) -> VerifyResult:
+        t0 = time.perf_counter()
+        try:
+            control_rows = self.control(sql)
+        except Exception as e:  # noqa: BLE001 — report, don't crash the run
+            return VerifyResult(name, "CONTROL_FAIL", f"{type(e).__name__}: {e}")
+        t1 = time.perf_counter()
+        try:
+            test_rows = self.test(sql)
+        except Exception as e:  # noqa: BLE001
+            return VerifyResult(name, "TEST_FAIL", f"{type(e).__name__}: {e}",
+                                control_ms=(t1 - t0) * 1e3)
+        t2 = time.perf_counter()
+        r = VerifyResult(name, "MATCH", control_ms=(t1 - t0) * 1e3,
+                         test_ms=(t2 - t1) * 1e3)
+        if len(control_rows) != len(test_rows):
+            r.state = "MISMATCH"
+            r.detail = f"row count {len(control_rows)} != {len(test_rows)}"
+            return r
+        c1 = row_checksum(control_rows, self.float_digits)
+        c2 = row_checksum(test_rows, self.float_digits)
+        if c1 != c2:
+            r.state = "MISMATCH"
+            r.detail = f"checksum {c1:#x} != {c2:#x}"
+        return r
+
+    def run(self, corpus: Dict[str, str]) -> List[VerifyResult]:
+        return [self.verify_one(name, sql) for name, sql in corpus.items()]
+
+
+def session_runner(session) -> Runner:
+    return lambda sql: session.sql(sql).rows
+
+
+def sqlite_runner(conn) -> Runner:
+    from tests.sqlite_oracle import to_sqlite
+
+    return lambda sql: conn.execute(to_sqlite(sql)).fetchall()
+
+
+def report(results: List[VerifyResult]) -> str:
+    lines = []
+    counts: Dict[str, int] = {}
+    for r in results:
+        counts[r.state] = counts.get(r.state, 0) + 1
+        mark = {"MATCH": "ok", "MISMATCH": "DIFF"}.get(r.state, "FAIL")
+        lines.append(f"  [{mark:>4}] {r.name:<12} "
+                     f"control={r.control_ms:8.1f}ms test={r.test_ms:8.1f}ms"
+                     + (f"  {r.detail}" if r.detail else ""))
+    summary = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    return "\n".join([f"verifier: {summary}"] + lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    p = argparse.ArgumentParser()
+    p.add_argument("--sf", type=float, default=0.01)
+    p.add_argument("--corpus", choices=("tpch", "tpcds"), default="tpch")
+    p.add_argument("--device", default="cpu",
+                   help="jax platform (default cpu: a 22-query corpus "
+                        "pays per-query compiles; use 'tpu' deliberately)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.device:
+        jax.config.update("jax_platforms", args.device)
+    import presto_tpu
+    from tests.sqlite_oracle import build_sqlite
+
+    if args.corpus == "tpch":
+        from presto_tpu.catalog import tpch_catalog
+        from tests.tpch_queries import QUERIES
+
+        session = presto_tpu.connect(
+            tpch_catalog(args.sf, cache_dir="/tmp/presto_tpu_cache"))
+        oracle = build_sqlite(args.sf)
+    else:
+        from presto_tpu.catalog import tpcds_catalog
+        from presto_tpu.connectors import tpcds as tpcds_gen
+        from tests.tpcds_queries import QUERIES
+
+        session = presto_tpu.connect(
+            tpcds_catalog(args.sf, cache_dir="/tmp/presto_tpu_cache"))
+        oracle = build_sqlite(args.sf, generator=tpcds_gen)
+
+    v = Verifier(sqlite_runner(oracle), session_runner(session))
+    results = v.run({f"q{k}": sql for k, sql in sorted(QUERIES.items())})
+    print(report(results))
+    return 0 if all(r.state == "MATCH" for r in results) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
