@@ -69,6 +69,24 @@ def execute_query(session, text: str) -> QueryResult:
 
 
 def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
+    if isinstance(stmt, ast.Prepare):
+        if not hasattr(session, "prepared_statements"):
+            session.prepared_statements = {}
+        # validate now so PREPARE surfaces syntax errors immediately; `0`
+        # stands in for `?` because it parses in every placeholder
+        # position incl. number-only ones like LIMIT
+        parse(stmt.statement_text.replace("?", "0"))
+        session.prepared_statements[stmt.name] = stmt.statement_text
+        return QueryResult([("result", T.BOOLEAN)], [(True,)])
+    if isinstance(stmt, ast.Execute):
+        prepared = getattr(session, "prepared_statements", {}).get(stmt.name)
+        if prepared is None:
+            raise ExecutionError(f"prepared statement '{stmt.name}' not found")
+        sql = _substitute_parameters(prepared, stmt.parameters)
+        return _dispatch_statement(session, sql, parse(sql), mon)
+    if isinstance(stmt, ast.Deallocate):
+        getattr(session, "prepared_statements", {}).pop(stmt.name, None)
+        return QueryResult([("result", T.BOOLEAN)], [(True,)])
     if isinstance(stmt, ast.TransactionStatement):
         if stmt.action == "START":
             session.txn.begin(stmt.read_only)
@@ -158,6 +176,53 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
     with mon.phase("execute"):
         ex = Executor(session, monitor=mon)
         return ex.run(plan)
+
+
+def _substitute_parameters(sql: str, params) -> str:
+    """Replace `?` placeholders (outside string literals) with rendered
+    literal parameters (reference: ParameterRewriter)."""
+    rendered = []
+    for p in params:
+        neg = False
+        while isinstance(p, ast.UnaryOp) and p.op == "-" \
+                and isinstance(p.operand, ast.Literal) \
+                and isinstance(p.operand.value, (int, float)):
+            neg = not neg
+            p = p.operand
+        if not isinstance(p, ast.Literal):
+            raise ExecutionError("EXECUTE parameters must be literals")
+        v = p.value
+        if v is None:
+            rendered.append("NULL")
+        elif isinstance(v, bool):
+            rendered.append("TRUE" if v else "FALSE")
+        elif isinstance(v, (int, float)):
+            rendered.append(repr(-v if neg else v))
+        elif getattr(p, "type_hint", None) == "date":
+            rendered.append(f"DATE '{v}'")
+        elif getattr(p, "type_hint", None) == "timestamp":
+            rendered.append(f"TIMESTAMP '{v}'")
+        else:
+            rendered.append("'" + str(v).replace("'", "''") + "'")
+    out = []
+    i = n_used = 0
+    in_str = False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+        if ch == "?" and not in_str:
+            if n_used >= len(rendered):
+                raise ExecutionError(
+                    f"{len(rendered)} parameters for more placeholders")
+            out.append(rendered[n_used])
+            n_used += 1
+        else:
+            out.append(ch)
+        i += 1
+    if n_used != len(rendered):
+        raise ExecutionError(
+            f"{len(rendered)} parameters but {n_used} placeholders")
+    return "".join(out)
 
 
 def _create_table(session, name, schema, properties, arrays):
@@ -814,20 +879,45 @@ class Executor:
             for s in node.aggs:
                 cols[s] = merged[s]
             return Batch(cols, db.sel)
-        dargs = {a.args[0].name for a in distinct_aggs.values()}
-        if len(dargs) != 1:
-            raise ExecutionError("multiple DISTINCT columns not supported yet")
-        darg = next(iter(dargs))
-        pre = self._aggregate(b, node.group_keys + [darg], {})
-        aggs2 = {}
+        # one pre-group pass per distinct column; every pass enumerates
+        # the same final key set in the same sorted-unique order, so the
+        # outputs align column-wise (reference:
+        # MultipleDistinctAggregationToMarkDistinct generalization)
+        for a in distinct_aggs.values():
+            if a.filter is not None:
+                # the filter must apply BEFORE dedup, but the pre-group
+                # output no longer carries the filter's columns; a clear
+                # error beats a KeyError (or silently-wrong post-dedup
+                # filtering)
+                raise ExecutionError(
+                    "DISTINCT aggregates with FILTER are not supported yet")
+        by_col: Dict[str, Dict[str, ir.AggCall]] = {}
         for s, a in distinct_aggs.items():
-            if a.fn in ("count", "approx_distinct"):
-                aggs2[s] = ir.AggCall("count", a.args, a.type, False, a.filter)
-            elif a.fn == "sum":
-                aggs2[s] = ir.AggCall("sum", a.args, a.type, False, a.filter)
+            by_col.setdefault(a.args[0].name, {})[s] = a
+        result = None
+        for darg in sorted(by_col):
+            pre = self._aggregate(b, node.group_keys + [darg], {})
+            aggs2 = {}
+            for s, a in by_col[darg].items():
+                if a.fn in ("count", "approx_distinct"):
+                    aggs2[s] = ir.AggCall("count", a.args, a.type, False,
+                                          a.filter)
+                elif a.fn in ("sum", "avg"):
+                    aggs2[s] = ir.AggCall(a.fn, a.args, a.type, False,
+                                          a.filter)
+                else:
+                    raise ExecutionError(f"DISTINCT {a.fn} not supported")
+            db = self._aggregate(pre, node.group_keys, aggs2)
+            if result is None:
+                result = db
             else:
-                raise ExecutionError(f"DISTINCT {a.fn} not supported")
-        return self._aggregate(pre, node.group_keys, aggs2)
+                if result.capacity != db.capacity:
+                    raise ExecutionError("distinct group alignment failed")
+                cols = dict(result.columns)
+                for s in aggs2:
+                    cols[s] = db.columns[s]
+                result = Batch(cols, result.sel)
+        return result
 
     def _aggregate(self, b: Batch, group_keys: List[str],
                    aggs: Dict[str, ir.AggCall], node: Optional[P.Aggregate] = None) -> Batch:

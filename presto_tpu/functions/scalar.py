@@ -1190,3 +1190,105 @@ register("to_unixtime")((
     lambda args: T.DOUBLE if args[0].name == "TIMESTAMP" else None,
     lambda args: ColVal(jnp.asarray(args[0].data).astype(jnp.float64) / 1e6,
                         args[0].valid, T.DOUBLE)))
+
+
+# ---- JSON functions (reference: operator/scalar/JsonFunctions +
+# JsonExtract; JSON values ride VARCHAR columns, path evaluation is a
+# host dictionary transform like the other string functions) -----------
+
+import json as _json_mod
+
+
+def _json_path_get(v, path):
+    """Evaluate the JsonPath subset $.a.b[0] (reference:
+    JsonExtract.generateExtractor's supported grammar)."""
+    try:
+        doc = _json_mod.loads(v)
+    except (ValueError, TypeError):
+        return None
+    p = str(path)
+    if not p.startswith("$"):
+        return None
+    i = 1
+    cur = doc
+    while i < len(p) and cur is not None:
+        if p[i] == ".":
+            j = i + 1
+            while j < len(p) and p[j] not in ".[":
+                j += 1
+            key = p[i + 1:j]
+            cur = cur.get(key) if isinstance(cur, dict) else None
+            i = j
+        elif p[i] == "[":
+            j = p.find("]", i)
+            if j < 0:
+                return None  # unclosed bracket: invalid path, not a crash
+            token = p[i + 1:j].strip("\"'")
+            if isinstance(cur, list):
+                try:
+                    cur = cur[int(token)]
+                except (ValueError, IndexError):
+                    cur = None
+            elif isinstance(cur, dict):
+                cur = cur.get(token)
+            else:
+                cur = None
+            i = j + 1
+        else:
+            return None
+    return cur
+
+
+def _json_extract(v, path):
+    r = _json_path_get(v, path)
+    return "" if r is None else _json_mod.dumps(r, separators=(",", ":"))
+
+
+def _json_extract_scalar(v, path):
+    import math as _math
+
+    r = _json_path_get(v, path)
+    if r is None or isinstance(r, (dict, list)):
+        return ""
+    if isinstance(r, bool):
+        return "true" if r else "false"
+    if isinstance(r, float) and _math.isfinite(r) and r == int(r):
+        return str(int(r))
+    return str(r)
+
+
+def _json_array_length(v):
+    try:
+        doc = _json_mod.loads(v)
+    except (ValueError, TypeError):
+        return 0
+    return len(doc) if isinstance(doc, list) else 0
+
+
+def _json_size(v, path):
+    r = _json_path_get(v, path)
+    if isinstance(r, (dict, list)):
+        return len(r)
+    return 0
+
+
+register("json_extract")((_str_transform("json_extract", _json_extract)))
+register("json_extract_scalar")((_str_transform("json_extract_scalar",
+                                                _json_extract_scalar)))
+register("json_format")((_str_transform(
+    "json_format", lambda v: _json_mod.dumps(_json_mod.loads(v),
+                                             separators=(",", ":")))))
+register("json_parse")((_str_transform("json_parse", lambda v: v)))
+register("json_array_length")((_str_transform(
+    "json_array_length", _json_array_length, T.BIGINT)))
+register("json_size")((_str_transform("json_size", _json_size, T.BIGINT)))
+def _is_json_scalar(v):
+    try:
+        doc = _json_mod.loads(v)
+    except (ValueError, TypeError):
+        return False
+    return not isinstance(doc, (dict, list))  # JSON null IS a scalar
+
+
+register("is_json_scalar")((_str_transform(
+    "is_json_scalar", _is_json_scalar, T.BOOLEAN)))

@@ -315,6 +315,26 @@ class Delete(Statement):
 
 
 @dataclass
+class Prepare(Statement):
+    """PREPARE name FROM statement (reference: SqlBase.g4 prepare;
+    parameters are `?` placeholders substituted at EXECUTE)."""
+
+    name: str
+    statement_text: str
+
+
+@dataclass
+class Execute(Statement):
+    name: str
+    parameters: List[Expr]
+
+
+@dataclass
+class Deallocate(Statement):
+    name: str
+
+
+@dataclass
 class TransactionStatement(Statement):
     """START TRANSACTION [READ ONLY] | COMMIT | ROLLBACK (reference:
     SqlBase.g4 startTransaction/commit/rollback)."""

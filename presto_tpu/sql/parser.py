@@ -44,7 +44,8 @@ KEYWORDS = {
     "INSERT", "INTO", "SET", "SESSION", "OVER", "PARTITION", "ROWS", "RANGE",
     "UNBOUNDED", "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "UNNEST",
     "ORDINALITY", "FILTER", "DROP", "DELETE", "IF", "START", "TRANSACTION",
-    "COMMIT", "ROLLBACK", "READ", "ONLY", "WRITE",
+    "COMMIT", "ROLLBACK", "READ", "ONLY", "WRITE", "PREPARE", "EXECUTE",
+    "DEALLOCATE", "USING",
 }
 
 
@@ -221,6 +222,26 @@ class Parser:
             if self.accept_kw("WHERE"):
                 where = self.expr()
             return ast.Delete(name, where)
+        if self.accept_kw("PREPARE"):
+            name = self.ident()
+            self.expect_kw("FROM")
+            # the remaining raw text IS the statement (parameters are `?`
+            # placeholders, substituted at EXECUTE — reference:
+            # QueryPreparer.prepare)
+            start = self.peek().pos
+            self.i = len(self.toks) - 1  # consume everything
+            return ast.Prepare(name, self.text[start:].rstrip(" ;"))
+        if self.accept_kw("EXECUTE"):
+            name = self.ident()
+            params = []
+            if self.accept_kw("USING"):
+                params.append(self.expr())
+                while self.accept_op(","):
+                    params.append(self.expr())
+            return ast.Execute(name, params)
+        if self.accept_kw("DEALLOCATE"):
+            self.accept_kw("PREPARE")
+            return ast.Deallocate(self.ident())
         if self.accept_kw("START"):
             self.expect_kw("TRANSACTION")
             read_only = False

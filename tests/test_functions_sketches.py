@@ -177,3 +177,34 @@ def test_date_semantics_review_fixes(session):
         "SELECT regexp_replace('a1b', '([0-9])', '<$1>')").rows[0][0] == "a<1>b"
     assert session.sql(
         "SELECT regexp_replace('x', 'x', 'a$b')").rows[0][0] == "a$b"
+
+
+def test_multiple_distinct_columns(session):
+    r = session.sql(
+        "SELECT g, count(DISTINCT k), count(DISTINCT s), sum(DISTINCT k), "
+        "count(*) FROM t GROUP BY g ORDER BY g").rows
+    for g, dk, ds, sk, c in r:
+        ek = session.sql(f"SELECT count(DISTINCT k), sum(DISTINCT k) "
+                         f"FROM t WHERE g = {g}").rows[0]
+        es = session.sql(f"SELECT count(DISTINCT s) FROM t WHERE g = {g}"
+                         ).rows[0][0]
+        assert (dk, sk) == ek and ds == es
+
+
+def test_prepared_statements(session):
+    session.sql("PREPARE q1 FROM SELECT count(*) FROM t WHERE k < ? AND g = ?")
+    a = session.sql("EXECUTE q1 USING 1000, 2").rows
+    b = session.sql("SELECT count(*) FROM t WHERE k < 1000 AND g = 2").rows
+    assert a == b
+    c = session.sql("EXECUTE q1 USING 50, 0").rows
+    d = session.sql("SELECT count(*) FROM t WHERE k < 50 AND g = 0").rows
+    assert c == d
+    # string params quote/escape correctly
+    session.sql("PREPARE q2 FROM SELECT count(*) FROM t WHERE s = ?")
+    e = session.sql("EXECUTE q2 USING 'val_0007'").rows
+    f = session.sql("SELECT count(*) FROM t WHERE s = 'val_0007'").rows
+    assert e == f and e[0][0] > 0
+    session.sql("DEALLOCATE PREPARE q1")
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="not found"):
+        session.sql("EXECUTE q1 USING 1, 1")
