@@ -902,7 +902,9 @@ class Executor:
                 if a.fn in ("count", "approx_distinct"):
                     aggs2[s] = ir.AggCall("count", a.args, a.type, False,
                                           a.filter)
-                elif a.fn in ("sum", "avg"):
+                elif a.fn in ("sum", "avg", "array_agg", "min", "max"):
+                    # over the deduped pre-group these equal their
+                    # DISTINCT forms
                     aggs2[s] = ir.AggCall(a.fn, a.args, a.type, False,
                                           a.filter)
                 else:
@@ -1121,6 +1123,39 @@ class Executor:
             ok = idx >= 0
             val_valid = ok if col.valid is None else (ok & col.valid[safe])
             return Column(col.data[safe], val_valid, a.type, col.dictionary)
+        if a.fn == "array_agg":
+            # ragged output: host-side build (reference: ArrayAggregation
+            # over an ObjectBigArray); dynamic mode only
+            if self.static:
+                raise StaticFallback("array_agg is dynamic-mode only")
+            from presto_tpu.batch import Dictionary as _Dict
+
+            gidh = np.asarray(gid)
+            rows_live = np.asarray(mask)  # NULL inputs are kept as NULL
+            vh = np.asarray(valid)        # elements (Presto array_agg)
+            data = np.asarray(col.data)
+            if col.dictionary is not None:
+                data = col.dictionary.values[
+                    np.clip(data, 0, len(col.dictionary) - 1)]
+            groups = [[] for _ in range(n_groups)]
+            for row in np.flatnonzero(rows_live):
+                g = int(gidh[row])
+                if 0 <= g < n_groups:
+                    if not vh[row]:
+                        groups[g].append(None)
+                        continue
+                    groups[g].append(data[row].item()
+                                     if hasattr(data[row], "item")
+                                     else data[row])
+            tuples = np.empty(n_groups, dtype=object)
+            tuples[:] = [tuple(g) for g in groups]
+            uniq = sorted(set(tuples.tolist()), key=repr)
+            cmap = {t: i for i, t in enumerate(uniq)}
+            codes_out = np.fromiter((cmap[t] for t in tuples.tolist()),
+                                    np.int32, n_groups)
+            u = np.empty(len(uniq), dtype=object)
+            u[:] = uniq
+            return Column(jnp.asarray(codes_out), nonempty, a.type, _Dict(u))
         if a.fn == "geometric_mean":
             x = jnp.where(valid, col.data.astype(jnp.float64), 1.0)
             s = K.segment_sum(jnp.log(jnp.maximum(x, 1e-300)), gid, n_groups)
@@ -1492,6 +1527,49 @@ class Executor:
         return b.with_sel(b.sel & (rank <= n))
 
     # ---- set ops ------------------------------------------------------
+    def _exec_unnest(self, node: P.Unnest) -> Batch:
+        """Lateral explode (reference: UnnestOperator).  Host-side ragged
+        work — dynamic mode only; the compiled path falls back."""
+        if self.static:
+            raise StaticFallback("UNNEST is dynamic-mode only")
+        b = self.exec_node(node.source)
+        v = eval_expr(node.array_expr, b, self.ctx)
+        col = to_column(v, b.capacity)
+        codes = np.asarray(col.data)
+        sel = np.asarray(b.sel)
+        live = sel if col.valid is None else (sel & np.asarray(col.valid))
+        dvals = col.dictionary.values if col.dictionary is not None else []
+        lens = np.asarray([len(t) for t in dvals], dtype=np.int64)
+        counts = np.where(live, lens[np.clip(codes, 0, max(len(dvals) - 1, 0))]
+                          if len(dvals) else 0, 0)
+        total = int(counts.sum())
+        idx = np.repeat(np.arange(b.capacity), counts)
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        k = np.arange(total) - offs[idx]
+        elems = []
+        for row in np.flatnonzero(counts):
+            elems.extend(dvals[codes[row]])
+        from presto_tpu.batch import column_from_numpy
+
+        if total == 0:
+            elem_col = column_from_numpy(
+                np.empty(0, dtype=object if node.elem_type.is_string
+                         else node.elem_type.numpy_dtype()), node.elem_type)
+            out = K.gather_batch(b, jnp.zeros((0,), jnp.int64))
+        else:
+            arr = np.asarray(elems, dtype=object) \
+                if node.elem_type.is_string else \
+                np.asarray(elems, dtype=node.elem_type.numpy_dtype())
+            elem_col = column_from_numpy(arr, node.elem_type)
+            out = K.gather_batch(b, jnp.asarray(idx))
+        cols = dict(out.columns)
+        cols[node.out_sym] = elem_col
+        if node.ordinality_sym:
+            cols[node.ordinality_sym] = Column(
+                jnp.asarray(k + 1, jnp.int64), None, T.BIGINT)
+        return Batch(cols, jnp.ones((max(total, 0),), bool) if total else
+                     jnp.zeros((0,), bool))
+
     def _exec_union(self, node: P.Union) -> Batch:
         parts = []
         for src, mapping in zip(node.sources_, node.mappings):

@@ -429,11 +429,39 @@ def concat_batches(batches: List[Batch]) -> Batch:
     for name in names:
         parts = [b.columns[name] for b in batches]
         dicts = [p.dictionary for p in parts]
-        if parts[0].type.is_string and len({id(d) for d in dicts}) > 1:
-            merged = Dictionary(np.unique(np.concatenate([d.values for d in dicts])))
+        with_dict = [d for d in dicts if d is not None]
+        if with_dict and (len({id(d) for d in with_dict}) > 1
+                          or len(with_dict) < len(parts)):
+            # branches without a dictionary are typed-NULL columns
+            # (e.g. grouping-set padding): their codes are dead, any
+            # in-range value serves
+            all_vals = [v for d in with_dict for v in d.values.tolist()]
+            if all(isinstance(v, str) for v in all_vals):
+                # strings keep the np-sorted invariant (code order ==
+                # lexicographic order, which comparisons rely on)
+                merged = Dictionary(np.unique(np.concatenate(
+                    [d.values for d in with_dict])))
+                luts = {id(d): translate_codes(d, merged)
+                        for d in with_dict}
+            else:
+                # tuple dictionaries (ARRAY columns, possibly holding
+                # NULL elements): python-map merge, repr-keyed order
+                # (array code order is not semantically compared)
+                uniq = sorted(set(all_vals), key=repr)
+                cmap = {v: i for i, v in enumerate(uniq)}
+                u = np.empty(len(uniq), dtype=object)
+                u[:] = uniq
+                merged = Dictionary(u)
+                luts = {id(d): np.asarray(
+                    [cmap[v] for v in d.values.tolist()], dtype=np.int32)
+                    for d in with_dict}
             datas = []
             for p in parts:
-                lut = jnp.asarray(translate_codes(p.dictionary, merged))
+                if p.dictionary is None:
+                    datas.append(jnp.zeros_like(jnp.asarray(p.data),
+                                                dtype=jnp.int32))
+                    continue
+                lut = jnp.asarray(luts[id(p.dictionary)])
                 datas.append(lut[jnp.clip(p.data, 0, len(p.dictionary) - 1)])
             data = jnp.concatenate(datas)
             dictionary = merged

@@ -76,6 +76,8 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         return arg_types[0]
     if name == "geometric_mean":
         return T.DOUBLE
+    if name == "array_agg":
+        return T.array_of(arg_types[0])
     raise KeyError(f"unknown aggregate function: {name}")
 
 
@@ -84,7 +86,7 @@ AGG_NAMES = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "every", "approx_distinct", "corr", "covar_samp",
     "covar_pop", "approx_percentile", "checksum", "min_by", "max_by",
-    "geometric_mean",
+    "geometric_mean", "array_agg",
 }
 
 

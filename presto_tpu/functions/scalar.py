@@ -1292,3 +1292,174 @@ def _is_json_scalar(v):
 
 register("is_json_scalar")((_str_transform(
     "is_json_scalar", _is_json_scalar, T.BOOLEAN)))
+
+
+# ---- ARRAY functions (reference: operator/scalar/ArrayFunctions etc.) -----
+#
+# Arrays extend the dictionary-always policy to nested values: a column
+# of arrays is int32 codes into a sorted dictionary of element TUPLES
+# (the reference's ArrayBlock offsets would be ragged — hostile to the
+# static-shape model).  Array functions are host dictionary transforms,
+# exactly like the string functions above.
+
+
+def _is_array(t: T.Type) -> bool:
+    return t.name == "ARRAY"
+
+
+def _elem_type(t: T.Type) -> T.Type:
+    return t.params[0] if t.params else T.UNKNOWN
+
+
+def _tuple_dict_normalize(values: np.ndarray, codes: ColVal,
+                          out_type: T.Type) -> ColVal:
+    """normalize_dictionary for tuple dictionaries; repr-keyed sort is
+    deterministic even with NULL (None) elements mixed into tuples
+    (array code order is never compared semantically)."""
+    uniq = sorted(set(values.tolist()), key=repr)
+    code_map = {v: i for i, v in enumerate(uniq)}
+    inverse = np.fromiter((code_map[v] for v in values.tolist()),
+                          np.int32, len(values))
+    lut = jnp.asarray(inverse)
+    new_codes = lut[jnp.clip(codes.data, 0, len(values) - 1)]
+    u = np.empty(len(uniq), dtype=object)
+    u[:] = uniq
+    return ColVal(new_codes, codes.valid, out_type, Dictionary(u))
+
+
+def _array_transform(name, fn, out_type=None):
+    """out_type: None -> same ARRAY type (fn returns tuples);
+    a T.Type -> fixed scalar type; 'elem' -> the element type."""
+
+    def resolve(args):
+        if not _is_array(args[0]):
+            return None
+        if out_type is None:
+            return args[0]
+        if out_type == "elem":
+            return _elem_type(args[0])
+        return out_type
+
+    def emit(args):
+        col = args[0]
+        extra = []
+        for a in args[1:]:
+            if hasattr(a.data, "shape") and getattr(a.data, "ndim", 0) > 0:
+                raise NotImplementedError(f"{name} with non-constant arguments")
+            extra.append(a.data)
+        rt = resolve([a.type for a in args])
+        vals = col.dictionary.values if col.dictionary is not None \
+            else np.empty(0, object)
+        # per-entry errors become NULL for that entry (Presto returns
+        # NULL for e.g. out-of-range element_at) instead of poisoning
+        # the whole column because one dictionary value is unusual
+        outs = np.empty(len(vals), dtype=object)
+        null = np.zeros(len(vals), dtype=bool)
+        for i, v in enumerate(vals):
+            try:
+                r = fn(tuple(v), *extra)
+            except (ValueError, IndexError, TypeError):
+                r = None
+            if r is None:
+                null[i] = True
+                r = _NULL_PLACEHOLDER.get(
+                    rt.name if rt is not None else "", 0)
+            outs[i] = r
+        def and_null(base):
+            if not null.any():
+                return base
+            bad = jnp.asarray(null)[jnp.clip(col.data, 0,
+                                             max(len(vals) - 1, 0))]
+            return (~bad) if base is None else (base & ~bad)
+        if rt is not None and rt.name == "ARRAY":
+            r = _tuple_dict_normalize(outs, ColVal(col.data, col.valid,
+                                                   rt), rt)
+            return ColVal(r.data, and_null(r.valid), rt, r.dictionary)
+        if rt is not None and rt.is_string:
+            r = normalize_dictionary(
+                outs, ColVal(col.data, col.valid, T.VARCHAR))
+            return ColVal(r.data, and_null(r.valid), T.VARCHAR, r.dictionary)
+        lut = jnp.asarray(np.asarray(outs.tolist(),
+                                     dtype=rt.numpy_dtype()))
+        data = lut[jnp.clip(col.data, 0, max(len(vals) - 1, 0))]
+        return ColVal(data, and_null(col.valid), rt)
+
+    return resolve, emit
+
+
+_NULL_PLACEHOLDER = {"ARRAY": (), "VARCHAR": "", "BOOLEAN": False,
+                     "BIGINT": 0, "INTEGER": 0, "DOUBLE": 0.0}
+
+
+def _resolve_array_ctor(args):
+    if not args:
+        return T.array_of(T.UNKNOWN)
+    ct = args[0]
+    for a in args[1:]:
+        nxt = T.common_super_type(ct, a)
+        if nxt is None:
+            return None
+        ct = nxt
+    return T.array_of(ct)
+
+
+def _emit_array_ctor(args):
+    vals = []
+    for a in args:
+        if hasattr(a.data, "shape") and getattr(a.data, "ndim", 0) > 0:
+            raise NotImplementedError(
+                "ARRAY[...] over column values is not supported yet")
+        if a.valid is False or (a.valid is not None
+                                and not hasattr(a.valid, "shape")
+                                and not bool(a.valid)):
+            vals.append(None)  # NULL element, not its physical placeholder
+            continue
+        v = a.data
+        if isinstance(v, (jnp.ndarray, np.generic)):
+            v = v.item() if hasattr(v, "item") else v
+        vals.append(v)
+    t = _resolve_array_ctor([a.type for a in args])
+    d = np.empty(1, dtype=object)
+    d[0] = tuple(vals)
+    return ColVal(jnp.asarray(0, jnp.int32), None, t, Dictionary(d))
+
+
+register("array_constructor")((_resolve_array_ctor, _emit_array_ctor))
+register("cardinality")((_array_transform(
+    "cardinality", lambda v: len(v), T.BIGINT)))
+
+
+def _element_at(v, i):
+    i = int(i)
+    if i == 0:
+        raise ValueError("SQL array indices are 1-based")
+    if abs(i) > len(v):
+        return None  # Presto: NULL beyond the array bounds
+    return v[i - 1] if i > 0 else v[i]
+
+
+register("element_at")((_array_transform("element_at", _element_at, "elem")))
+register("contains")((_array_transform(
+    "contains", lambda v, x: any(e == x for e in v), T.BOOLEAN)))
+register("array_min")((_array_transform(
+    "array_min", lambda v: min((e for e in v if e is not None),
+                               default=None), "elem")))
+register("array_max")((_array_transform(
+    "array_max", lambda v: max((e for e in v if e is not None),
+                               default=None), "elem")))
+register("array_position")((_array_transform(
+    "array_position",
+    lambda v, x: next((i + 1 for i, e in enumerate(v) if e == x), 0),
+    T.BIGINT)))
+register("array_distinct")((_array_transform(
+    "array_distinct", lambda v: tuple(dict.fromkeys(v)))))
+register("array_sort")((_array_transform(
+    "array_sort", lambda v: tuple(sorted(v)))))
+register("array_join")((
+    lambda args: T.VARCHAR if _is_array(args[0]) else None,
+    _array_transform("array_join",
+                     lambda v, d: str(d).join(str(e) for e in v),
+                     T.VARCHAR)[1]))
+register("slice")((_array_transform(
+    "slice", lambda v, start, length: v[int(start) - 1:
+                                        int(start) - 1 + int(length)])))

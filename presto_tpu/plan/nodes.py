@@ -201,6 +201,31 @@ class Window(PlanNode):
 
 
 @dataclass
+class Unnest(PlanNode):
+    """Lateral array explode (reference: UnnestNode + operator/unnest/):
+    each source row fans out to one row per element of its array value."""
+
+    source: PlanNode
+    array_expr: object  # RowExpr yielding an ARRAY column
+    out_sym: str = ""
+    elem_type: Type = None
+    ordinality_sym: Optional[str] = None
+
+    def outputs(self):
+        out = list(self.source.outputs())
+        out.append((self.out_sym, self.elem_type))
+        if self.ordinality_sym:
+            from presto_tpu.types import BIGINT
+
+            out.append((self.ordinality_sym, BIGINT))
+        return out
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
 class Exchange(PlanNode):
     """Data-movement boundary between distributions (reference:
     sql/planner/plan/ExchangeNode.java — REPARTITION/REPLICATE/GATHER

@@ -148,7 +148,46 @@ class Planner:
         return node, lscope, lnames
 
     # ------------------------------------------------------------------
+    def _expand_grouping_sets(self, spec: ast.QuerySpec):
+        """GROUPING SETS/ROLLUP/CUBE -> UNION ALL of per-set aggregations
+        (reference: GroupIdNode + GroupIdOperator, expressed as a set
+        union instead of a group-id column).  Select items that are
+        grouping keys excluded from a set become typed NULLs (UNION
+        coercion settles the type)."""
+        all_keys = set()
+        for s in spec.grouping_sets:
+            for e in s:
+                all_keys.add(_ast_key(e))
+
+        def name_of(item):
+            if item.alias:
+                return item.alias
+            if isinstance(item.expr, ast.Identifier):
+                return item.expr.parts[-1]
+            return None
+
+        branches = []
+        for s in spec.grouping_sets:
+            in_set = {_ast_key(e) for e in s}
+            items = []
+            for item in spec.select:
+                k = _ast_key(item.expr)
+                if k in all_keys and k not in in_set:
+                    items.append(ast.SelectItem(ast.Literal(None),
+                                                name_of(item)))
+                else:
+                    items.append(item)
+            branches.append(ast.QuerySpec(
+                items, spec.distinct, spec.from_, spec.where, list(s),
+                spec.having))
+        body = branches[0]
+        for b in branches[1:]:
+            body = ast.SetOp("UNION", True, body, b)
+        return body
+
     def plan_query_spec(self, spec: ast.QuerySpec, outer):
+        if getattr(spec, "grouping_sets", None):
+            return self._plan_body(self._expand_grouping_sets(spec), outer)
         # FROM
         if spec.from_ is not None:
             node, scope = self.plan_relation(spec.from_, outer)
@@ -308,7 +347,35 @@ class Planner:
             return self._plan_join(rel, outer)
         if isinstance(rel, ast.ValuesRelation):
             return self._plan_values(rel)
+        if isinstance(rel, ast.Unnest):
+            # standalone FROM UNNEST(...): explode over a one-row source
+            sym = self.symbols.new("dual")
+            dual = P.Values([sym], [T.BIGINT], [[0]])
+            return self._plan_unnest(dual, Scope([]), rel)
         raise SemanticError(f"unsupported relation {type(rel).__name__}")
+
+    def _plan_unnest(self, lnode, lscope, rel: ast.Unnest):
+        """Lateral UNNEST: the array expression may reference the left
+        relation's columns (reference: UnnestNode planned from a lateral
+        Join in RelationPlanner.visitUnnest)."""
+        if len(rel.exprs) != 1:
+            raise SemanticError("UNNEST of multiple arrays not supported yet")
+        rex = self.analyze(rel.exprs[0], lscope)
+        if rex.type.name != "ARRAY":
+            raise SemanticError(f"UNNEST argument must be an ARRAY, got {rex.type}")
+        elem = rex.type.params[0] if rex.type.params else T.UNKNOWN
+        out_sym = self.symbols.new("unnest")
+        ord_sym = self.symbols.new("ordinality") if rel.with_ordinality else None
+        node = P.Unnest(lnode, rex, out_sym, elem, ord_sym)
+        q = rel.alias
+        aliases = getattr(rel, "column_aliases", None) or []
+        fields = list(lscope.fields)
+        fields.append(Field_(q, aliases[0] if aliases else (q or "col"),
+                             out_sym, elem))
+        if ord_sym:
+            fields.append(Field_(q, aliases[1] if len(aliases) > 1
+                                 else "ordinality", ord_sym, T.BIGINT))
+        return node, Scope(fields)
 
     def _plan_table(self, rel: ast.Table, outer):
         name = rel.name.lower()
@@ -360,6 +427,10 @@ class Planner:
 
     def _plan_join(self, rel: ast.Join, outer):
         lnode, lscope = self.plan_relation(rel.left, outer)
+        if isinstance(rel.right, ast.Unnest):
+            if rel.join_type != "CROSS":
+                raise SemanticError("UNNEST joins must be CROSS JOIN / comma")
+            return self._plan_unnest(lnode, lscope, rel.right)
         rnode, rscope = self.plan_relation(rel.right, outer)
         combined = Scope(lscope.fields + rscope.fields)
         jt = rel.join_type

@@ -163,6 +163,13 @@ def _derive(node, catalog, memo) -> NodeStats:
         for sym in node.functions:
             cols[sym] = ColStats()
         return NodeStats(s.rows, cols, s.unique, s.fanout, s.est)
+    if isinstance(node, P.Unnest):
+        s = d(node.source)
+        cols = dict(s.cols)
+        cols[node.out_sym] = ColStats()
+        # ragged fanout unknown; 3x is the planning guess (not a bound:
+        # UNNEST is dynamic-mode only, so nothing sizes statically off it)
+        return NodeStats(s.rows * 3, cols, [], {}, s.est_rows * 3)
     if isinstance(node, P.Exchange):
         # exchanges move rows, they don't change global cardinality
         return d(node.source)

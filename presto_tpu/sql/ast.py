@@ -225,6 +225,10 @@ class QuerySpec(Node):
     where: Optional[Expr] = None
     group_by: List[Expr] = field(default_factory=list)
     having: Optional[Expr] = None
+    # GROUPING SETS/ROLLUP/CUBE: list of grouping-key subsets; the
+    # planner expands to a UNION ALL of per-set aggregations
+    # (reference: GroupIdNode + GroupIdOperator)
+    grouping_sets: Optional[List[List[Expr]]] = None
 
 
 @dataclass

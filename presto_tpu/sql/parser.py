@@ -45,7 +45,7 @@ KEYWORDS = {
     "UNBOUNDED", "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "UNNEST",
     "ORDINALITY", "FILTER", "DROP", "DELETE", "IF", "START", "TRANSACTION",
     "COMMIT", "ROLLBACK", "READ", "ONLY", "WRITE", "PREPARE", "EXECUTE",
-    "DEALLOCATE", "USING",
+    "DEALLOCATE", "USING", "ROLLUP", "CUBE",
 }
 
 
@@ -380,13 +380,62 @@ class Parser:
                 from_ = ast.Join("CROSS", from_, right)
         where = self.expr() if self.accept_kw("WHERE") else None
         group_by = []
+        grouping_sets = None
         if self.accept_kw("GROUP"):
             self.expect_kw("BY")
-            group_by.append(self.expr())
-            while self.accept_op(","):
+            if (self.peek().kind == "ident"
+                    and self.peek().value == "grouping"
+                    and self.peek(1).kind == "ident"
+                    and self.peek(1).value == "sets"):
+                # contextual keywords: GROUPING/SETS stay usable as
+                # identifiers (non-reserved in the reference grammar)
+                self.next(), self.next()
+                grouping_sets = self._grouping_sets()
+            elif self.at_kw("ROLLUP", "CUBE"):
+                kind = self.next().value
+                exprs = self._paren_expr_list()
+                if kind == "ROLLUP":
+                    grouping_sets = [exprs[:k] for k in
+                                     range(len(exprs), -1, -1)]
+                else:  # CUBE: all subsets, preserving expr order
+                    grouping_sets = []
+                    for mask in range((1 << len(exprs)) - 1, -1, -1):
+                        grouping_sets.append(
+                            [e for i, e in enumerate(exprs)
+                             if mask & (1 << i)])
+                group_by = list(exprs)
+            else:
                 group_by.append(self.expr())
+                while self.accept_op(","):
+                    group_by.append(self.expr())
         having = self.expr() if self.accept_kw("HAVING") else None
-        return ast.QuerySpec(items, distinct, from_, where, group_by, having)
+        spec = ast.QuerySpec(items, distinct, from_, where, group_by, having)
+        spec.grouping_sets = grouping_sets
+        return spec
+
+    def _grouping_sets(self):
+        """((a, b), (a), ()) — each set is a parenthesized expr list."""
+        self.expect_op("(")
+        sets = []
+        while True:
+            if self.at_op("("):
+                sets.append(self._paren_expr_list())
+            else:
+                sets.append([self.expr()])  # bare expr = singleton set
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return sets
+
+    def _paren_expr_list(self):
+        self.expect_op("(")
+        out = []
+        if not self.at_op(")"):
+            out.append(self.expr())
+            while self.accept_op(","):
+                out.append(self.expr())
+        self.expect_op(")")
+        return out
 
     def _select_item(self) -> ast.SelectItem:
         if self.at_op("*"):
@@ -458,8 +507,10 @@ class Parser:
             if self.accept_kw("WITH"):
                 self.expect_kw("ORDINALITY")
                 with_ord = True
-            alias, _ = self._alias()
-            return ast.Unnest(exprs, alias, with_ord)
+            alias, col_aliases = self._alias()
+            u = ast.Unnest(exprs, alias, with_ord)
+            u.column_aliases = col_aliases
+            return u
         if self.at_kw("VALUES"):
             self.next()
             rows = [self._values_row()]
@@ -708,6 +759,16 @@ class Parser:
             e = self.expr()
             self.expect_op(")")
             return e
+        if t.kind == "ident" and t.value == "array" \
+                and self.peek(1).kind == "op" and self.peek(1).value == "[":
+            self.next(), self.next()
+            elems = []
+            if not self.at_op("]"):
+                elems.append(self.expr())
+                while self.accept_op(","):
+                    elems.append(self.expr())
+            self.expect_op("]")
+            return ast.FunctionCall("array_constructor", elems)
         if t.kind == "ident" or (t.kind == "kw" and t.value in (
                 "DATE", "TIME", "TIMESTAMP", "FILTER", "ROW", "FIRST", "LAST",
                 "SET", "VALUES", "IF", "START", "READ", "ONLY", "WRITE",

@@ -110,6 +110,13 @@ def varchar(length: Optional[int] = None) -> Type:
     return VARCHAR  # length is not semantically enforced (same as reference in practice)
 
 
+def array_of(elem: Type) -> Type:
+    """ARRAY(elem) — physically int32 codes into a dictionary of tuples
+    (the DictionaryBlock treatment extended to nested values; reference:
+    spi/block/ArrayBlock, which TPUs would hate as ragged offsets)."""
+    return Type("ARRAY", (elem,))
+
+
 def char(length: int) -> Type:
     return Type("CHAR", (length,))
 
@@ -130,6 +137,7 @@ _PHYSICAL = {
     "INTERVAL_DAY_TIME": np.int64,
     "INTERVAL_YEAR_MONTH": np.int64,
     "UNKNOWN": np.bool_,
+    "ARRAY": np.int32,  # dictionary code over unique element-tuples
 }
 
 

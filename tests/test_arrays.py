@@ -1,0 +1,99 @@
+"""ARRAY type, array functions, array_agg, UNNEST (reference analogs:
+TestArrayFunctions + TestUnnestOperator in presto-main)."""
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.catalog import Catalog, MemoryTable
+
+
+@pytest.fixture(scope="module")
+def session(tpch_catalog_tiny):
+    return presto_tpu.connect(tpch_catalog_tiny)
+
+
+def test_array_literal_and_functions(session):
+    assert session.sql("SELECT ARRAY[3,1,2]").rows == [((3, 1, 2),)]
+    r = session.sql(
+        "SELECT cardinality(ARRAY[1,2,3]), element_at(ARRAY[10,20], 2), "
+        "element_at(ARRAY[10,20], -1), contains(ARRAY[1,2,3], 2), "
+        "array_min(ARRAY[5,2,9]), array_max(ARRAY[5,2,9]), "
+        "array_position(ARRAY[7,8,9], 9), array_position(ARRAY[7], 99)").rows
+    assert r == [(3, 20, 20, True, 2, 9, 3, 0)]
+    assert session.sql("SELECT array_sort(ARRAY[3,1,2])").rows == [((1, 2, 3),)]
+    assert session.sql(
+        "SELECT array_distinct(ARRAY[1,2,1,3,2])").rows == [((1, 2, 3),)]
+    assert session.sql(
+        "SELECT array_join(ARRAY[1,2,3], '~')").rows == [("1~2~3",)]
+    assert session.sql("SELECT slice(ARRAY[1,2,3,4], 2, 2)").rows == [((2, 3),)]
+
+
+def test_unnest_basic_and_ordinality(session):
+    assert session.sql(
+        "SELECT x FROM UNNEST(ARRAY[10,20,30]) AS t(x)").rows \
+        == [(10,), (20,), (30,)]
+    assert session.sql(
+        "SELECT x, o FROM UNNEST(ARRAY['a','b']) WITH ORDINALITY AS t(x, o)"
+    ).rows == [("a", 1), ("b", 2)]
+
+
+def test_array_agg_and_lateral_unnest(session):
+    r = session.sql(
+        "SELECT n_regionkey, array_agg(n_nationkey) AS arr FROM nation "
+        "GROUP BY n_regionkey ORDER BY 1").rows
+    assert len(r) == 5
+    for rk, arr in r:
+        expected = {x[0] for x in session.sql(
+            f"SELECT n_nationkey FROM nation WHERE n_regionkey = {rk}").rows}
+        assert set(arr) == expected
+    # round-trip: unnesting the aggregation restores the rows
+    flat = session.sql(
+        "SELECT q.r, u.x FROM (SELECT n_regionkey AS r, "
+        "array_agg(n_nationkey) AS arr FROM nation GROUP BY n_regionkey) AS q "
+        "CROSS JOIN UNNEST(q.arr) AS u(x) ORDER BY 2").rows
+    base = session.sql(
+        "SELECT n_regionkey, n_nationkey FROM nation ORDER BY 2").rows
+    assert flat == base
+
+
+def test_array_agg_strings(session):
+    r = session.sql("SELECT array_agg(n_name) FROM nation "
+                    "WHERE n_regionkey = 0").rows[0][0]
+    assert set(r) == {"ALGERIA", "ETHIOPIA", "KENYA", "MOROCCO", "MOZAMBIQUE"}
+
+
+def test_unnest_empty_and_errors(session):
+    assert session.sql("SELECT x FROM UNNEST(ARRAY[]) AS t(x)").rows == []
+    with pytest.raises(Exception, match="ARRAY"):
+        session.sql("SELECT x FROM UNNEST(42) AS t(x)")
+
+
+def test_union_of_arrays_merges_dictionaries(session):
+    # regression: codes from different dictionaries must be remapped
+    r = session.sql("SELECT ARRAY[1] AS a UNION ALL SELECT ARRAY[2]").rows
+    assert sorted(x[0] for x in r) == [(1,), (2,)]
+
+
+def test_null_elements_and_bounds(session):
+    assert session.sql("SELECT ARRAY[1, NULL, 1]").rows == [((1, None, 1),)]
+    assert session.sql(
+        "SELECT array_distinct(ARRAY[1, NULL, 1])").rows == [((1, None),)]
+    # out-of-range element_at is NULL, not an error
+    assert session.sql("SELECT element_at(ARRAY[10,20], 5)").rows == [(None,)]
+    assert session.sql("SELECT array_min(ARRAY[])").rows == [(None,)]
+    assert session.sql(
+        "SELECT array_max(ARRAY[NULL, 3, 1])").rows == [(3,)]
+
+
+def test_array_agg_keeps_nulls(session):
+    r = session.sql(
+        "SELECT array_agg(CASE WHEN n_nationkey < 3 THEN n_nationkey END) "
+        "FROM nation WHERE n_nationkey < 5").rows[0][0]
+    assert sorted(x for x in r if x is not None) == [0, 1, 2]
+    assert sum(1 for x in r if x is None) == 2
+
+
+def test_grouping_sets_words_usable_as_identifiers(session):
+    assert session.sql("SELECT 1 AS sets, 2 AS grouping").rows == [(1, 2)]

@@ -208,3 +208,30 @@ def test_prepared_statements(session):
     import pytest as _pytest
     with _pytest.raises(Exception, match="not found"):
         session.sql("EXECUTE q1 USING 1, 1")
+
+
+def test_rollup_matches_manual_union(session):
+    a = session.sql(
+        "SELECT g, k % 3 AS k3, sum(x) AS s FROM t "
+        "GROUP BY ROLLUP (g, k % 3) ORDER BY 1, 2, 3").rows
+    b = session.sql(
+        "SELECT g, k % 3 AS k3, sum(x) AS s FROM t GROUP BY g, k % 3 "
+        "UNION ALL SELECT g, NULL, sum(x) FROM t GROUP BY g "
+        "UNION ALL SELECT NULL, NULL, sum(x) FROM t "
+        "ORDER BY 1, 2, 3").rows
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert ra[:2] == rb[:2] and abs(ra[2] - rb[2]) < 1e-6
+
+
+def test_cube_and_grouping_sets(session):
+    cube = session.sql("SELECT g, k % 2 AS k2, count(*) FROM t "
+                       "GROUP BY CUBE (g, k % 2) ORDER BY 1, 2").rows
+    # 4 groups x 2 + 4 + 2 + 1 = 15 rows for 4 g-values and 2 k2-values
+    assert len(cube) == 15
+    total = [r for r in cube if r[0] is None and r[1] is None]
+    assert total[0][2] == 50_000
+    gs = session.sql(
+        "SELECT g, k % 2 AS k2, count(*) FROM t "
+        "GROUP BY GROUPING SETS ((g), (k % 2), ()) ORDER BY 1, 2").rows
+    assert len(gs) == 4 + 2 + 1
