@@ -205,3 +205,111 @@ ORDER BY c_last_name, ss_ticket_number
 LIMIT 100
 """,
 }
+
+QUERIES.update({
+    25: """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) AS store_sales_profit,
+       sum(sr_net_loss) AS store_returns_loss,
+       sum(cs_net_profit) AS catalog_sales_profit
+FROM store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,
+     date_dim d3, store, item
+WHERE d1.d_moy = 4 AND d1.d_year = 2001
+  AND d1.d_date_sk = ss_sold_date_sk AND i_item_sk = ss_item_sk
+  AND s_store_sk = ss_store_sk AND ss_customer_sk = sr_customer_sk
+  AND ss_item_sk = sr_item_sk AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_moy BETWEEN 4 AND 10 AND d2.d_year = 2001
+  AND sr_customer_sk = cs_bill_customer_sk AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_moy BETWEEN 4 AND 10 AND d3.d_year = 2001
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id, i_item_desc, s_store_id, s_store_name
+LIMIT 100
+""",
+    34: """
+SELECT c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number AS ss_ticket_number,
+             ss_customer_sk AS ss_customer_sk, count(*) AS cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND (date_dim.d_dom BETWEEN 1 AND 3
+             OR date_dim.d_dom BETWEEN 25 AND 28)
+        AND (household_demographics.hd_buy_potential = '>10000'
+             OR household_demographics.hd_buy_potential = 'Unknown')
+        AND household_demographics.hd_vehicle_count > 0
+        AND date_dim.d_year IN (1999, 2000, 2001)
+      GROUP BY ss_ticket_number, ss_customer_sk) AS dn, customer
+WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 1 AND 5
+ORDER BY c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+         ss_ticket_number, cnt
+LIMIT 1000
+""",
+    42: QUERIES[42],
+    46: """
+SELECT c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       amt, profit
+FROM (SELECT ss_ticket_number AS ss_ticket_number,
+             ss_customer_sk AS ss_customer_sk, ca_city AS bought_city,
+             sum(ss_coupon_amt) AS amt, sum(ss_net_profit) AS profit
+      FROM store_sales, date_dim, store, household_demographics,
+           customer_address
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND store_sales.ss_addr_sk = customer_address.ca_address_sk
+        AND (household_demographics.hd_dep_count = 4
+             OR household_demographics.hd_vehicle_count = 3)
+        AND date_dim.d_dow IN (6, 0)
+        AND date_dim.d_year IN (1999, 2000, 2001)
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) AS dn,
+     customer, customer_address AS current_addr
+WHERE ss_customer_sk = c_customer_sk
+  AND customer.c_current_addr_sk = current_addr.ca_address_sk
+  AND current_addr.ca_city <> bought_city
+ORDER BY c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number
+LIMIT 100
+""",
+    73: """
+SELECT c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number AS ss_ticket_number,
+             ss_customer_sk AS ss_customer_sk, count(*) AS cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND date_dim.d_dom BETWEEN 1 AND 2
+        AND (household_demographics.hd_buy_potential = '>10000'
+             OR household_demographics.hd_buy_potential = 'Unknown')
+        AND household_demographics.hd_vehicle_count > 0
+        AND date_dim.d_year IN (1999, 2000, 2001)
+      GROUP BY ss_ticket_number, ss_customer_sk) AS dj, customer
+WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 1 AND 5
+ORDER BY cnt DESC, c_last_name ASC
+LIMIT 1000
+""",
+    79: """
+SELECT c_last_name, c_first_name, substr(s_city, 1, 30) AS city,
+       ss_ticket_number, amt, profit
+FROM (SELECT ss_ticket_number AS ss_ticket_number,
+             ss_customer_sk AS ss_customer_sk, s_city AS s_city,
+             sum(ss_coupon_amt) AS amt, sum(ss_net_profit) AS profit
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND (household_demographics.hd_dep_count = 6
+             OR household_demographics.hd_vehicle_count > 2)
+        AND date_dim.d_dow = 1
+        AND date_dim.d_year IN (1999, 2000, 2001)
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk, s_city) AS ms,
+     customer
+WHERE ss_customer_sk = c_customer_sk
+ORDER BY c_last_name, c_first_name, city, profit, ss_ticket_number
+LIMIT 100
+""",
+})
