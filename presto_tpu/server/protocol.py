@@ -281,6 +281,27 @@ def _make_handler(server: PrestoTpuServer):
                 return self._json(server.info_payload())
             if parts == ["v1", "status"]:  # heartbeat probe target
                 return self._json({"nodeId": server.node_id, "alive": True})
+            if parts == ["ui"] or parts == []:
+                # the web UI (reference: presto-main webapp/); the static
+                # page is cached on the server object at first request
+                body = getattr(server, "_ui_bytes", None)
+                if body is None:
+                    import os as _os
+
+                    path = _os.path.join(
+                        _os.path.dirname(_os.path.abspath(__file__)),
+                        "ui.html")
+                    try:
+                        with open(path, "rb") as f:
+                            body = server._ui_bytes = f.read()
+                    except OSError:
+                        return self._json({"error": "ui not installed"}, 404)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if parts == ["v1", "resourceGroupState"]:
                 rgm = server.resource_groups
                 return self._json(rgm.info() if rgm is not None else [])

@@ -203,3 +203,12 @@ def test_cli_repl_remote(server):
     repl(RemoteBackend(server.uri), "CSV",
          stdin=io.StringIO("SELECT 41 + 1;\n\\q\n"), stdout=out)
     assert "42" in out.getvalue()
+
+
+def test_web_ui_served(server):
+    import urllib.request
+
+    with urllib.request.urlopen(f"{server.uri}/ui") as r:
+        assert r.headers["Content-Type"].startswith("text/html")
+        html = r.read().decode()
+    assert "presto_tpu" in html and "/v1/statement" in html
