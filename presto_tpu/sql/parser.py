@@ -413,6 +413,70 @@ class Parser:
         spec.grouping_sets = grouping_sets
         return spec
 
+    def _quantified(self, op: str, left):
+        """`expr op ANY|SOME|ALL (subquery)` rewritten to the engine's
+        existing subquery forms (reference: QuantifiedComparisonExpression,
+        lowered by TransformQuantifiedComparisonApplyToLateralJoin):
+          = ANY  -> IN          <> ALL -> NOT IN
+          < ANY  -> < (max)     < ALL  -> < (min)     (and mirrors)
+        """
+        t = self.peek()
+        if not (t.kind == "ident" and t.value in ("any", "some")
+                or t.kind == "kw" and t.value == "ALL"):
+            return None
+        # commit only on the full `ANY (SELECT ...` shape — any/some are
+        # non-reserved and must keep working as column names on the RHS
+        if not (self.peek(1).kind == "op" and self.peek(1).value == "("
+                and self.peek(2).kind == "kw"
+                and self.peek(2).value in ("SELECT", "WITH")):
+            return None
+        quant = "ANY" if t.value in ("any", "some") else "ALL"
+        self.next()
+        self.expect_op("(")
+        q = self.parse_query()
+        self.expect_op(")")
+        # NB: the scalar rewrites below embed the subquery more than once
+        # (so it plans/executes per reference) — correctness-first v1; the
+        # reference lowers to one lateral join instead.
+
+        def scalar_agg(agg, star=False):
+            return ast.ScalarSubquery(ast.Query(body=ast.QuerySpec(
+                select=[ast.SelectItem(ast.FunctionCall(
+                    agg, [] if star else [ast.Identifier(("q_", "v_"))]))],
+                from_=ast.SubqueryRelation(q, "q_", ["v_"]))))
+
+        def cmp_extreme(op2):
+            # loosest bound: <: max, >: min (NULL when subquery is empty)
+            agg = "max" if op2 in ("<", "<=") else "min"
+            return ast.BinaryOp(op2, left, scalar_agg(agg))
+
+        nonempty = ast.BinaryOp(">", scalar_agg("count", star=True),
+                                ast.Literal(0))
+        # count(*) = count(v_): no NULL values among the subquery rows
+        no_nulls = ast.BinaryOp("=", scalar_agg("count", star=True),
+                                scalar_agg("count"))
+        if quant == "ANY":
+            if op == "=":
+                return ast.InSubquery(left, q, False)
+            if op == "<>":
+                self.err("<> ANY is not supported")
+            # empty subquery: ANY is FALSE (cmp vs NULL extreme alone
+            # would be NULL, which flips under NOT)
+            return ast.BinaryOp("AND", nonempty, cmp_extreme(op))
+        neg = {"=": "<>", "<>": "=", "<": ">=", "<=": ">",
+               ">": "<=", ">=": "<"}[op]
+        if neg == "<>":  # = ALL
+            self.err("= ALL is not supported")
+        if neg == "=":
+            return ast.InSubquery(left, q, True)  # <> ALL == NOT IN
+        # ALL == vacuously TRUE on empty; with NULLs present it can never
+        # be definitely TRUE (SQL NULL, which WHERE treats as exclusion)
+        empty = ast.UnaryOp("NOT", nonempty)
+        return ast.BinaryOp(
+            "OR", empty,
+            ast.BinaryOp("AND", no_nulls,
+                         ast.UnaryOp("NOT", cmp_extreme(neg))))
+
     def _grouping_sets(self):
         """((a, b), (a), ()) — each set is a parenthesized expr list."""
         self.expect_op("(")
@@ -604,6 +668,10 @@ class Parser:
                 op = self.next().value
                 if op == "!=":
                     op = "<>"
+                q = self._quantified(op, left)
+                if q is not None:
+                    left = q
+                    continue
                 right = self._additive()
                 left = ast.BinaryOp(op, left, right)
                 continue

@@ -235,3 +235,49 @@ def test_cube_and_grouping_sets(session):
         "SELECT g, k % 2 AS k2, count(*) FROM t "
         "GROUP BY GROUPING SETS ((g), (k % 2), ()) ORDER BY 1, 2").rows
     assert len(gs) == 4 + 2 + 1
+
+
+def test_quantified_comparisons(tpch_catalog_tiny):
+    import presto_tpu as pt
+
+    s = pt.connect(tpch_catalog_tiny)
+    a = s.sql("SELECT count(*) FROM nation WHERE n_regionkey = ANY "
+              "(SELECT r_regionkey FROM region WHERE r_name LIKE 'A%')").rows
+    b = s.sql("SELECT count(*) FROM nation WHERE n_regionkey IN "
+              "(SELECT r_regionkey FROM region WHERE r_name LIKE 'A%')").rows
+    assert a == b
+    g = s.sql("SELECT count(*) FROM nation WHERE n_regionkey <> ALL "
+              "(SELECT r_regionkey FROM region WHERE r_name = 'ASIA')").rows
+    h = s.sql("SELECT count(*) FROM nation WHERE n_regionkey NOT IN "
+              "(SELECT r_regionkey FROM region WHERE r_name = 'ASIA')").rows
+    assert g == h
+    mx = s.sql("SELECT max(o_totalprice) FROM orders "
+               "WHERE o_orderpriority = '1-URGENT'").rows[0][0]
+    c = s.sql("SELECT count(*) FROM orders WHERE o_totalprice > ALL "
+              "(SELECT o_totalprice FROM orders "
+              "WHERE o_orderpriority = '1-URGENT')").rows
+    d = s.sql(f"SELECT count(*) FROM orders WHERE o_totalprice > {mx}").rows
+    assert c == d
+    # vacuous ALL over an empty subquery is TRUE
+    assert s.sql("SELECT count(*) FROM nation WHERE n_nationkey > ALL "
+                 "(SELECT r_regionkey FROM region WHERE r_name = 'zzz')"
+                 ).rows == [(25,)]
+    # ANY/SOME words remain usable as identifiers
+    assert s.sql("SELECT 1 AS any, 2 AS some").rows == [(1, 2)]
+
+
+def test_quantified_null_and_empty_semantics(tpch_catalog_tiny):
+    import presto_tpu as pt
+
+    s = pt.connect(tpch_catalog_tiny)
+    # NULL in the ALL-set: never definitely true
+    assert s.sql("SELECT count(*) FROM (VALUES (5)) AS t(x) WHERE x > ALL "
+                 "(SELECT nullif(v, 2) FROM (VALUES (1),(2)) AS s(v))"
+                 ).rows == [(0,)]
+    # ANY over empty is FALSE, stable under NOT
+    assert s.sql("SELECT count(*) FROM (VALUES (5)) AS t(x) WHERE NOT "
+                 "(x < ANY (SELECT v FROM (VALUES (1)) AS s(v) "
+                 "WHERE v > 100))").rows == [(1,)]
+    # any/some still usable as column names on a comparison RHS
+    assert s.sql("SELECT x = some FROM (VALUES (1, 1)) AS t(x, some)"
+                 ).rows == [(True,)]
