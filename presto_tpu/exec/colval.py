@@ -37,6 +37,42 @@ class ColVal:
         return not hasattr(self.data, "shape") or getattr(self.data, "ndim", 0) == 0
 
 
+@dataclasses.dataclass
+class LambdaVal:
+    """An unevaluated lambda argument to a higher-order function.
+
+    `apply` evaluates the body over a synthetic batch whose columns are the
+    parameter bindings — i.e. the lambda is vectorized over array *elements*
+    with the same tracing machinery used for rows (the reference compiles
+    LambdaDefinitionExpression to a JVM method; here it traces to XLA)."""
+
+    params: tuple
+    param_types: tuple
+    body: object  # ir.RowExpr
+    ctx: object  # EvalContext
+    type: Type  # FUNCTION(ret)
+
+    @property
+    def ret_type(self) -> Type:
+        return self.type.params[0]
+
+    def free_refs(self) -> set:
+        return self.body.refs() - set(self.params)
+
+    def apply(self, cols: dict) -> "ColVal":
+        from presto_tpu.batch import Batch
+        from presto_tpu.exec import compiler
+
+        n = 0
+        for v in cols.values():
+            if hasattr(v.data, "shape") and getattr(v.data, "ndim", 0) > 0:
+                n = max(n, int(v.data.shape[0]))
+        n = max(n, 1)
+        batch = Batch({s: compiler.to_column(v, n) for s, v in cols.items()},
+                      jnp.ones((n,), dtype=bool))
+        return compiler.eval_expr(self.body, batch, self.ctx)
+
+
 def and_valid(a, b):
     if a is None:
         return b

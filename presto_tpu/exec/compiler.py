@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from presto_tpu import types as T
 from presto_tpu.batch import Batch
-from presto_tpu.exec.colval import ColVal
+from presto_tpu.exec.colval import ColVal, LambdaVal
 from presto_tpu.functions import scalar as scalar_fns
 from presto_tpu.plan import ir
 
@@ -42,7 +42,9 @@ def eval_expr(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> ColVal:
     if isinstance(expr, ir.CastExpr):
         return scalar_fns.emit_cast(eval_expr(expr.arg, batch, ctx), expr.type, expr.safe)
     if isinstance(expr, ir.Call):
-        args = [eval_expr(a, batch, ctx) for a in expr.args]
+        args = [LambdaVal(a.params, a.param_types, a.body, ctx, a.type)
+                if isinstance(a, ir.LambdaExpr)
+                else eval_expr(a, batch, ctx) for a in expr.args]
         return scalar_fns.lookup(expr.fn).emit(args)
     raise TypeError(f"cannot evaluate {type(expr).__name__}")
 

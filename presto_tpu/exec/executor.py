@@ -159,7 +159,8 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
                 mon.stats.execution_mode = "distributed"
                 return run_distributed(session, text, stmt)
         except (Undistributable, StaticFallback,
-                jax.errors.ConcretizationTypeError):
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError):
             pass  # single-device paths below
     mode = session.properties.get("execution_mode", "auto")
     if mode in ("auto", "compiled"):
@@ -167,7 +168,8 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
             with mon.phase("execute"):
                 mon.stats.execution_mode = "compiled"
                 return run_compiled(session, text, stmt)
-        except (StaticFallback, jax.errors.ConcretizationTypeError) as e:
+        except (StaticFallback, jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError) as e:
             if mode == "compiled":
                 raise StaticFallback(str(e)) from e
     mon.stats.execution_mode = "dynamic"

@@ -626,10 +626,43 @@ register("starts_with")((_str_transform(
 def _resolve_concat(args):
     if all(a.is_string for a in args):
         return T.VARCHAR
+    if args and all(a.name == "ARRAY" for a in args):
+        ct = args[0].params[0]
+        for a in args[1:]:
+            ct2 = T.common_super_type(ct, a.params[0])
+            ct = ct2 if ct2 is not None else ct
+        return T.array_of(ct)
     return None
 
 
+def _emit_concat_arrays(args):
+    """ARRAY || ARRAY / concat(arrays...) — dedups code tuples host-side
+    so the work is per distinct combination, not per row (concrete codes
+    only; compiled mode falls back)."""
+    codes_list = [np.asarray(a.data) for a in args]
+    scalar = all(c.ndim == 0 for c in codes_list)
+    n = max((len(c) for c in codes_list if c.ndim > 0), default=1)
+    cols = [np.broadcast_to(np.atleast_1d(c), (n,)) for c in codes_list]
+    stacked = np.stack(cols, axis=1)
+    uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
+    outs = np.empty(len(uniq), dtype=object)
+    for k, combo in enumerate(uniq):
+        t = ()
+        for a, code in zip(args, combo):
+            dv = a.dictionary.values if a.dictionary is not None \
+                else np.empty(0, dtype=object)
+            t = t + (tuple(dv[int(code)]) if 0 <= int(code) < len(dv) else ())
+        outs[k] = t
+    rt = _resolve_concat([a.type for a in args])
+    codes = jnp.asarray(int(inv[0]), jnp.int32) if scalar \
+        else jnp.asarray(inv.astype(np.int32))
+    return _tuple_dict_normalize(
+        outs, ColVal(codes, all_valid(*args), rt), rt)
+
+
 def _emit_concat(args):
+    if args and args[0].type.name == "ARRAY":
+        return _emit_concat_arrays(args)
     out = args[0]
     for nxt in args[1:]:
         lo, ln = _as_string_literal(out), _as_string_literal(nxt)
@@ -770,6 +803,25 @@ register("ceiling")((_math1("ceiling", lambda x: jnp.ceil(x))))
 register("sign")((_math1("sign", jnp.sign)))
 
 
+def _dmath1(name, fn):
+    """1-arg numeric -> DOUBLE (reference: MathFunctions.java)."""
+    return (lambda args: T.DOUBLE if len(args) == 1 and args[0].is_numeric
+            else None,
+            lambda args: ColVal(
+                fn(jnp.asarray(args[0].data).astype(jnp.float64)),
+                args[0].valid, T.DOUBLE))
+
+
+for _nm, _f in [("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+                ("asin", jnp.arcsin), ("acos", jnp.arccos),
+                ("atan", jnp.arctan), ("sinh", jnp.sinh),
+                ("cosh", jnp.cosh), ("tanh", jnp.tanh),
+                ("degrees", jnp.degrees), ("radians", jnp.radians),
+                ("cbrt", jnp.cbrt), ("log2", jnp.log2),
+                ("exp2", jnp.exp2)]:
+    register(_nm)(_dmath1(_nm, _f))
+
+
 def _resolve_round(args):
     if args[0].is_numeric:
         return args[0]
@@ -839,12 +891,79 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool) -> ColVal:
     raise NotImplementedError(f"CAST {frm} -> {to}")
 
 
+def _cast_to_varchar(v: ColVal) -> ColVal:
+    """Host-side render (reference: the type's cast-to-varchar operators,
+    e.g. operator/scalar/...CastToVarchar).  Needs concrete data — under
+    jit tracing np.asarray raises and the query falls back to dynamic."""
+    import datetime as _dt
+
+    frm = v.type
+
+    def fmt(x):
+        if frm.name == "BOOLEAN":
+            return "true" if x else "false"
+        if frm.is_integer:
+            return str(int(x))
+        if frm.is_floating:
+            f = float(x)
+            if f != f:
+                return "NaN"
+            if f == float("inf"):
+                return "Infinity"
+            if f == float("-inf"):
+                return "-Infinity"
+            # Java Double.toString: plain decimal in [1e-3, 1e7), else
+            # scientific with a [1,10) mantissa and no exponent sign
+            if 1e-3 <= abs(f) < 1e7 or f == 0.0:
+                if f == int(f):
+                    return f"{f:.1f}"
+                return repr(f)
+            mant, exp = f"{f:E}".split("E")
+            mant = mant.rstrip("0").rstrip(".")
+            if "." not in mant:
+                mant += ".0"
+            return f"{mant}E{int(exp)}"
+        if frm.is_decimal:
+            s = frm.decimal_scale
+            n = int(x)
+            sign = "-" if n < 0 else ""
+            n = abs(n)
+            if s == 0:
+                return sign + str(n)
+            return f"{sign}{n // 10 ** s}.{n % 10 ** s:0{s}d}"
+        if frm.name == "DATE":
+            return (_dt.date(1970, 1, 1)
+                    + _dt.timedelta(days=int(x))).isoformat()
+        if frm.name == "TIMESTAMP":  # int64 microseconds since epoch
+            t = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(x))
+            return t.strftime("%Y-%m-%d %H:%M:%S.%f")[:-3]
+        raise NotImplementedError(f"CAST {frm} -> VARCHAR")
+
+    if v.is_scalar:
+        x = v.data.item() if hasattr(v.data, "item") else v.data
+        out = _lit_to_dict_colval(ColVal(fmt(x), None, T.VARCHAR))
+        return ColVal(out.data, v.valid, T.VARCHAR, out.dictionary)
+    arr = np.asarray(v.data)
+    vals = [fmt(x) for x in arr.tolist()]
+    uniq, inv = np.unique(np.asarray(vals, dtype=str), return_inverse=True)
+    return ColVal(jnp.asarray(inv.astype(np.int32)), v.valid, T.VARCHAR,
+                  Dictionary(uniq.astype(object)))
+
+
 def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
     frm = v.type
     if frm == to:
         return v
+    if frm.name == "UNKNOWN":  # CAST(NULL AS anything) == typed NULL
+        if to.is_string:
+            return ColVal("", False, to)
+        if to.name == "ARRAY":
+            d = np.empty(1, dtype=object)
+            d[0] = ()
+            return ColVal(jnp.asarray(0, jnp.int32), False, to, Dictionary(d))
+        return ColVal(to.numpy_dtype().type(0), False, to)
     if to.is_string and not frm.is_string:
-        raise NotImplementedError("CAST to VARCHAR of non-string")
+        return _cast_to_varchar(v)
     if frm.is_string and not to.is_string:
         if to.name == "DATE":
             return _emit_date_from_str([v])
@@ -1346,7 +1465,12 @@ def _array_transform(name, fn, out_type=None):
         for a in args[1:]:
             if hasattr(a.data, "shape") and getattr(a.data, "ndim", 0) > 0:
                 raise NotImplementedError(f"{name} with non-constant arguments")
-            extra.append(a.data)
+            v = a.data
+            if a.dictionary is not None:  # constant string / array argument:
+                v = a.dictionary.values[int(v)]  # pass the value, not the code
+            elif hasattr(v, "item"):
+                v = v.item()
+            extra.append(v)
         rt = resolve([a.type for a in args])
         vals = col.dictionary.values if col.dictionary is not None \
             else np.empty(0, object)
@@ -1417,6 +1541,8 @@ def _emit_array_ctor(args):
         v = a.data
         if isinstance(v, (jnp.ndarray, np.generic)):
             v = v.item() if hasattr(v, "item") else v
+        if a.dictionary is not None:  # string / nested-array element:
+            v = a.dictionary.values[int(v)]  # decode the dictionary code
         vals.append(v)
     t = _resolve_array_ctor([a.type for a in args])
     d = np.empty(1, dtype=object)
@@ -1463,3 +1589,367 @@ register("array_join")((
 register("slice")((_array_transform(
     "slice", lambda v, start, length: v[int(start) - 1:
                                         int(start) - 1 + int(length)])))
+register("flatten")((_array_transform(
+    "flatten", lambda v: tuple(e for sub in v
+                               for e in (sub if sub is not None else ())),
+    "elem")))
+register("array_remove")((_array_transform(
+    "array_remove", lambda v, x: tuple(e for e in v
+                                       if e is None or e != x))))
+register("array_union")((_array_transform(
+    "array_union", lambda v, w: tuple(dict.fromkeys(tuple(v) + tuple(w))))))
+register("array_intersect")((_array_transform(
+    "array_intersect",
+    lambda v, w: tuple(dict.fromkeys(e for e in v if e in set(w))))))
+register("array_except")((_array_transform(
+    "array_except",
+    lambda v, w: tuple(dict.fromkeys(e for e in v if e not in set(w))))))
+register("arrays_overlap")((_array_transform(
+    "arrays_overlap",
+    lambda v, w: any(e in set(w) for e in v if e is not None),
+    T.BOOLEAN)))
+
+
+def _resolve_sequence(args):
+    if len(args) in (2, 3) and all(a.is_integer for a in args):
+        return T.array_of(T.BIGINT)
+    return None
+
+
+def _emit_sequence(args):
+    vals = []
+    for a in args:
+        if hasattr(a.data, "shape") and getattr(a.data, "ndim", 0) > 0:
+            raise NotImplementedError("sequence over column bounds")
+        vals.append(int(a.data))
+    start, stop = vals[0], vals[1]
+    step = vals[2] if len(vals) > 2 else (1 if stop >= start else -1)
+    if step == 0:
+        raise ValueError("sequence step cannot be zero")
+    if (stop - start) * step < 0:
+        raise ValueError(
+            "sequence stop value should be " +
+            ("greater than or equal to" if step > 0 else "less than or equal to")
+            + " start value" + (" if step is greater than zero"
+                                if step > 0 else " if step is less than zero"))
+    n = max(0, (stop - start) // step + 1)
+    if n > 10_000_000:
+        raise ValueError("sequence result is too large")
+    d = np.empty(1, dtype=object)
+    d[0] = tuple(range(start, start + n * step, step))
+    return ColVal(jnp.asarray(0, jnp.int32), all_valid(*args),
+                  T.array_of(T.BIGINT), Dictionary(d))
+
+
+register("sequence")((_resolve_sequence, _emit_sequence))
+
+
+def _resolve_split(args):
+    if len(args) in (2, 3) and args[0].is_string and args[1].is_string:
+        return T.array_of(T.VARCHAR)
+    return None
+
+
+def _emit_split(args):
+    col = args[0]
+    delim = _as_string_literal(args[1])
+    if delim is None:
+        raise NotImplementedError("split with a non-constant delimiter")
+    limit = None
+    if len(args) > 2:
+        limit = int(args[2].data)
+    if isinstance(col.data, str):
+        col = _lit_to_dict_colval(col)
+    rt = T.array_of(T.VARCHAR)
+    vals = col.dictionary.values
+    outs = np.empty(max(len(vals), 1), dtype=object)
+    outs[:] = [()] * len(outs)
+    for i, v in enumerate(vals):
+        outs[i] = tuple(str(v).split(delim) if limit is None
+                        else str(v).split(delim, limit - 1))
+    return _tuple_dict_normalize(
+        outs, ColVal(jnp.clip(col.data, 0, len(outs) - 1), col.valid, rt), rt)
+
+
+register("split")((_resolve_split, _emit_split))
+
+
+# ---- higher-order (lambda) functions --------------------------------
+# Reference: operator/scalar/ArrayTransformFunction.java, ArrayFilterFunction,
+# ArrayAnyMatchFunction / AllMatch / NoneMatch, ArrayReduceFunction,
+# ZipWithFunction.  The lambda body is traced over the *flattened dictionary
+# elements* (colval.LambdaVal.apply), so the work is per distinct array
+# value, vectorized on device — not per row.  Captures of enclosing row
+# columns would break that factoring and are rejected.
+
+
+def _is_function(t) -> bool:
+    return t is not None and getattr(t, "name", None) == "FUNCTION"
+
+
+def _fn_ret(t: T.Type) -> T.Type:
+    return t.params[0]
+
+
+def _check_lambda(lam, name):
+    from presto_tpu.exec.colval import LambdaVal
+
+    if not isinstance(lam, LambdaVal):
+        raise NotImplementedError(f"{name} expects a lambda argument")
+    if lam.free_refs():
+        raise NotImplementedError(
+            f"{name}: lambda captures of enclosing columns are not supported")
+
+
+def _colval_from_pylist(vals, t: T.Type) -> ColVal:
+    """Vector ColVal from host scalars (None == NULL)."""
+    n = len(vals)
+    valid = np.asarray([v is not None for v in vals], dtype=bool)
+    v_arg = None if valid.all() else jnp.asarray(valid)
+    if t.name == "ARRAY":
+        obj = np.empty(n, dtype=object)
+        for i, v in enumerate(vals):
+            obj[i] = tuple(v) if v is not None else ()
+        return _tuple_dict_normalize(
+            obj, ColVal(jnp.arange(n, dtype=jnp.int32), v_arg, t), t)
+    if t.is_string:
+        obj = np.asarray(["" if v is None else str(v) for v in vals],
+                         dtype=object)
+        return normalize_dictionary(
+            obj, ColVal(jnp.arange(n, dtype=jnp.int32), v_arg, T.VARCHAR))
+    if t.name == "UNKNOWN":
+        return ColVal(jnp.zeros((n,), jnp.int32), jnp.zeros((n,), bool), t)
+    data = np.asarray([(0 if v is None else v) for v in vals],
+                      dtype=t.numpy_dtype())
+    return ColVal(jnp.asarray(data), v_arg, t)
+
+
+def _pylist_from_colval(cv: ColVal, n: int) -> list:
+    """Host decode of a (concrete) ColVal to python scalars, None == NULL."""
+    data = cv.data
+    if not hasattr(data, "shape") or getattr(data, "ndim", 0) == 0:
+        codes = np.full(n, np.asarray(data))
+    else:
+        codes = np.asarray(data)
+    if cv.dictionary is not None:
+        dvals = cv.dictionary.values
+        if len(dvals) == 0:
+            out = [None] * n
+        else:
+            out = [dvals[int(c)] for c in np.clip(codes, 0, len(dvals) - 1)]
+    else:
+        out = codes.tolist()
+    if cv.valid is None:
+        return out
+    valid = cv.valid
+    if not hasattr(valid, "shape") or getattr(valid, "ndim", 0) == 0:
+        valid = np.full(n, bool(valid))
+    else:
+        valid = np.asarray(valid)
+    return [v if ok else None for v, ok in zip(out, valid)]
+
+
+def _arr_entries(col: ColVal) -> np.ndarray:
+    return col.dictionary.values if col.dictionary is not None \
+        else np.empty(0, dtype=object)
+
+
+def _flat_apply(lam, entries):
+    """Evaluate a 1-param lambda over every element of every entry; returns
+    (per-entry lengths, flat result list)."""
+    lens = [len(t) for t in entries]
+    flat = [e for t in entries for e in t]
+    if not flat:
+        return lens, []
+    elem = _colval_from_pylist(flat, lam.param_types[0])
+    res = lam.apply({lam.params[0]: elem})
+    return lens, _pylist_from_colval(res, len(flat))
+
+
+def _dict_lut_result(vals: list, col: ColVal, rt: T.Type) -> ColVal:
+    """Per-dictionary-entry host results -> ColVal via device LUT gather."""
+    if len(vals) == 0:
+        vals = [None]
+    ne = len(vals)
+    null = np.asarray([v is None for v in vals], dtype=bool)
+    codes = jnp.clip(col.data, 0, ne - 1)
+    bad = jnp.asarray(null)[codes]
+    if col.valid is None:
+        valid = ~bad
+    else:
+        valid = jnp.asarray(col.valid) & ~bad
+    if rt.name == "ARRAY":
+        obj = np.empty(ne, dtype=object)
+        for i, v in enumerate(vals):
+            obj[i] = tuple(v) if v is not None else ()
+        return _tuple_dict_normalize(obj, ColVal(codes, valid, rt), rt)
+    if rt.is_string:
+        obj = np.asarray(["" if v is None else str(v) for v in vals],
+                         dtype=object)
+        return normalize_dictionary(obj, ColVal(codes, valid, T.VARCHAR))
+    lut = jnp.asarray(np.asarray([0 if v is None else v for v in vals],
+                                 dtype=rt.numpy_dtype()))
+    return ColVal(lut[codes], valid, rt)
+
+
+def _emit_transform(args):
+    col, lam = args
+    _check_lambda(lam, "transform")
+    entries = _arr_entries(col)
+    rt = T.array_of(lam.ret_type)
+    lens, res_vals = _flat_apply(lam, entries)
+    outs = np.empty(max(len(entries), 1), dtype=object)
+    outs[:] = [()] * len(outs)
+    off = 0
+    for i, L in enumerate(lens):
+        outs[i] = tuple(res_vals[off:off + L])
+        off += L
+    return _tuple_dict_normalize(
+        outs, ColVal(jnp.clip(col.data, 0, len(outs) - 1), col.valid, rt), rt)
+
+
+def _emit_filter(args):
+    col, lam = args
+    _check_lambda(lam, "filter")
+    entries = _arr_entries(col)
+    lens, res_vals = _flat_apply(lam, entries)
+    outs = np.empty(max(len(entries), 1), dtype=object)
+    outs[:] = [()] * len(outs)
+    off = 0
+    for i, L in enumerate(lens):
+        outs[i] = tuple(e for e, k in zip(entries[i], res_vals[off:off + L])
+                        if k is not None and bool(k))
+        off += L
+    return _tuple_dict_normalize(
+        outs, ColVal(jnp.clip(col.data, 0, len(outs) - 1), col.valid,
+                     col.type), col.type)
+
+
+def _emit_match(name):
+    def emit(args):
+        col, lam = args
+        _check_lambda(lam, name)
+        entries = _arr_entries(col)
+        lens, res_vals = _flat_apply(lam, entries)
+        vals = []
+        off = 0
+        for L in lens:
+            window = res_vals[off:off + L]
+            off += L
+            any_true = any(v is not None and bool(v) for v in window)
+            any_false = any(v is not None and not bool(v) for v in window)
+            has_null = any(v is None for v in window)
+            if name == "any_match":
+                r = True if any_true else (None if has_null else False)
+            elif name == "all_match":
+                r = False if any_false else (None if has_null else True)
+            else:  # none_match
+                r = False if any_true else (None if has_null else True)
+            vals.append(r)
+        return _dict_lut_result(vals, col, T.BOOLEAN)
+
+    return emit
+
+
+def _emit_reduce(args):
+    arr, init, merge, out = args
+    _check_lambda(merge, "reduce")
+    _check_lambda(out, "reduce")
+    if hasattr(init.data, "shape") and getattr(init.data, "ndim", 0) > 0:
+        raise NotImplementedError("reduce with a non-constant initial state")
+    entries = _arr_entries(arr)
+    ne = len(entries)
+    init_null = init.valid is not None and not hasattr(init.valid, "shape") \
+        and not bool(init.valid)
+    iv = None if init_null else (
+        init.data.item() if hasattr(init.data, "item") else init.data)
+    states = [iv] * ne
+    maxlen = max((len(t) for t in entries), default=0)
+    # step-synchronous evaluation: one vectorized merge over all entries
+    # that still have an element at this position (lax.scan analog, but the
+    # per-entry work happens on dictionary values, host-driven)
+    for step in range(maxlen):
+        idxs = [i for i in range(ne) if len(entries[i]) > step]
+        sc = _colval_from_pylist([states[i] for i in idxs],
+                                 merge.param_types[0])
+        ec = _colval_from_pylist([entries[i][step] for i in idxs],
+                                 merge.param_types[1])
+        res = _pylist_from_colval(
+            merge.apply({merge.params[0]: sc, merge.params[1]: ec}),
+            len(idxs))
+        for j, i in enumerate(idxs):
+            states[i] = res[j]
+    if ne:
+        fc = _colval_from_pylist(states, out.param_types[0])
+        finals = _pylist_from_colval(out.apply({out.params[0]: fc}), ne)
+    else:
+        finals = []
+    return _dict_lut_result(finals, arr, out.ret_type)
+
+
+def _emit_zip_with(args):
+    a, b, lam = args
+    _check_lambda(lam, "zip_with")
+    # needs concrete codes to pair row-wise (falls back under tracing)
+    ca, cb = np.asarray(a.data), np.asarray(b.data)
+    av, bv = _arr_entries(a), _arr_entries(b)
+    scalar = ca.ndim == 0 and cb.ndim == 0
+    ca1, cb1 = np.atleast_1d(ca), np.atleast_1d(cb)
+    n = max(len(ca1), len(cb1))
+    if len(ca1) == 1:
+        ca1 = np.repeat(ca1, n)
+    if len(cb1) == 1:
+        cb1 = np.repeat(cb1, n)
+    pairs = np.stack([np.clip(ca1, 0, max(len(av) - 1, 0)),
+                      np.clip(cb1, 0, max(len(bv) - 1, 0))], axis=1)
+    uniq, inv = np.unique(pairs, axis=0, return_inverse=True)
+    e1t, e2t = lam.param_types
+    flat1, flat2, lens = [], [], []
+    for i, j in uniq:
+        t1 = av[i] if len(av) else ()
+        t2 = bv[j] if len(bv) else ()
+        L = max(len(t1), len(t2))  # Presto zip_with pads the shorter w/ NULL
+        lens.append(L)
+        flat1.extend(list(t1) + [None] * (L - len(t1)))
+        flat2.extend(list(t2) + [None] * (L - len(t2)))
+    if flat1:
+        r = lam.apply({lam.params[0]: _colval_from_pylist(flat1, e1t),
+                       lam.params[1]: _colval_from_pylist(flat2, e2t)})
+        res_vals = _pylist_from_colval(r, len(flat1))
+    else:
+        res_vals = []
+    outs = np.empty(max(len(uniq), 1), dtype=object)
+    outs[:] = [()] * len(outs)
+    off = 0
+    for k, L in enumerate(lens):
+        outs[k] = tuple(res_vals[off:off + L])
+        off += L
+    rt = T.array_of(lam.ret_type)
+    codes = jnp.asarray(inv.astype(np.int32))
+    if scalar:
+        codes = codes[0]
+    return _tuple_dict_normalize(outs, ColVal(codes, all_valid(a, b), rt), rt)
+
+
+register("transform")((
+    lambda args: T.array_of(_fn_ret(args[1])) if len(args) == 2
+    and _is_array(args[0]) and _is_function(args[1]) else None,
+    _emit_transform))
+register("filter")((
+    lambda args: args[0] if len(args) == 2 and _is_array(args[0])
+    and _is_function(args[1]) else None,
+    _emit_filter))
+for _m in ("any_match", "all_match", "none_match"):
+    register(_m)((
+        lambda args: T.BOOLEAN if len(args) == 2 and _is_array(args[0])
+        and _is_function(args[1]) else None,
+        _emit_match(_m)))
+register("zip_with")((
+    lambda args: T.array_of(_fn_ret(args[2])) if len(args) == 3
+    and _is_array(args[0]) and _is_array(args[1])
+    and _is_function(args[2]) else None,
+    _emit_zip_with))
+register("reduce")((
+    lambda args: _fn_ret(args[3]) if len(args) == 4 and _is_array(args[0])
+    and _is_function(args[2]) and _is_function(args[3]) else None,
+    _emit_reduce))

@@ -31,7 +31,27 @@ class RowExpr:
                         yield from x.walk()
 
     def refs(self) -> set:
-        return {e.name for e in self.walk() if isinstance(e, Ref)}
+        """Free column references (lambda-bound params excluded)."""
+        out = set()
+
+        def visit(e):
+            if isinstance(e, Ref):
+                out.add(e.name)
+                return
+            if isinstance(e, LambdaExpr):
+                out.update(e.body.refs() - set(e.params))
+                return
+            for f in dataclasses.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, RowExpr):
+                    visit(v)
+                elif isinstance(v, tuple):
+                    for x in v:
+                        if isinstance(x, RowExpr):
+                            visit(x)
+
+        visit(self)
+        return out
 
 
 @dataclass(frozen=True)
@@ -87,6 +107,22 @@ class ScalarSub(RowExpr):
 
 
 @dataclass(frozen=True)
+class LambdaExpr(RowExpr):
+    """A typed lambda passed to a higher-order function (reference:
+    spi/relation LambdaDefinitionExpression).  `params` are fresh symbols
+    bound over `body`; free refs beyond them are captures of the enclosing
+    row."""
+
+    params: Tuple[str, ...]
+    param_types: Tuple[Type, ...]
+    body: RowExpr
+    type: Type  # FUNCTION(body.type)
+
+    def __str__(self):
+        return f"({', '.join(self.params)}) -> {self.body}"
+
+
+@dataclass(frozen=True)
 class AggCall:
     fn: str
     args: Tuple[RowExpr, ...]
@@ -107,6 +143,10 @@ def substitute(expr: RowExpr, mapping: dict) -> RowExpr:
         return Call(expr.fn, tuple(substitute(a, mapping) for a in expr.args), expr.type)
     if isinstance(expr, CastExpr):
         return CastExpr(substitute(expr.arg, mapping), expr.type, expr.safe)
+    if isinstance(expr, LambdaExpr):
+        # params are allocator-fresh symbols, so they can't collide with keys
+        return LambdaExpr(expr.params, expr.param_types,
+                          substitute(expr.body, mapping), expr.type)
     return expr
 
 

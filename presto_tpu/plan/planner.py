@@ -934,8 +934,12 @@ class Planner:
         if isinstance(e, ast.FunctionCall):
             if agg_fns.is_aggregate(e.name) and e.window is None:
                 raise SemanticError(f"aggregate {e.name} not allowed here")
+            if any(isinstance(x, ast.Lambda) for x in e.args):
+                return self._analyze_lambda_call(e, scope, agg_map, group_map)
             args = [a(x) for x in e.args]
             return self._call(e.name.lower(), args)
+        if isinstance(e, ast.Lambda):
+            raise SemanticError("lambda is only valid as a function argument")
         if isinstance(e, ast.ScalarSubquery):
             sub_node, sub_scope, _ = self.plan_query(e.query, None)
             if len(sub_scope.fields) != 1:
@@ -947,6 +951,78 @@ class Planner:
             raise SemanticError(
                 f"{type(e).__name__} only supported as a top-level WHERE/HAVING conjunct")
         raise SemanticError(f"unsupported expression {type(e).__name__}")
+
+    def _analyze_lambda_call(self, e: ast.FunctionCall, scope, agg_map,
+                             group_map) -> ir.RowExpr:
+        """Higher-order functions (reference: analyzer lambda handling in
+        ExpressionAnalyzer.visitLambdaExpression + function resolution of
+        FunctionType arguments).  Lambda parameter types are driven by the
+        array arguments, so each function shape is typed explicitly here."""
+        name = e.name.lower()
+        a = lambda x: self.analyze(x, scope, agg_map, group_map)
+
+        def lam(l, ptypes):
+            if not isinstance(l, ast.Lambda):
+                raise SemanticError(f"{name} expects a lambda argument")
+            if len(l.params) != len(ptypes):
+                raise SemanticError(
+                    f"{name} lambda must take {len(ptypes)} argument(s)")
+            syms = [self.symbols.new(f"lam_{p}") for p in l.params]
+            inner = Scope([Field_(None, p, s, t) for p, s, t
+                           in zip(l.params, syms, ptypes)], parent=scope)
+            body = self.analyze(l.body, inner, agg_map, group_map)
+            return ir.LambdaExpr(tuple(syms), tuple(ptypes), body,
+                                 T.function_type(body.type))
+
+        def elem_of(v):
+            if v.type.name != "ARRAY":
+                raise SemanticError(
+                    f"{name} expects an array argument, got {v.type}")
+            return v.type.params[0]
+
+        if name in ("transform", "filter", "any_match", "all_match",
+                    "none_match"):
+            if len(e.args) != 2:
+                raise SemanticError(f"{name}(array, lambda) expected")
+            arr = a(e.args[0])
+            le = lam(e.args[1], (elem_of(arr),))
+            if name != "transform" and le.body.type not in (T.BOOLEAN,
+                                                            T.UNKNOWN):
+                raise SemanticError(f"{name} lambda must return BOOLEAN")
+            return self._call(name, [arr, le])
+        if name == "zip_with":
+            if len(e.args) != 3:
+                raise SemanticError("zip_with(array, array, lambda) expected")
+            arr1, arr2 = a(e.args[0]), a(e.args[1])
+            le = lam(e.args[2], (elem_of(arr1), elem_of(arr2)))
+            return self._call(name, [arr1, arr2, le])
+        if name == "reduce":
+            if len(e.args) not in (3, 4):
+                raise SemanticError(
+                    "reduce(array, init, merge_lambda[, output_lambda]) expected")
+            arr, init = a(e.args[0]), a(e.args[1])
+            merge = lam(e.args[2], (init.type, elem_of(arr)))
+            if merge.body.type != init.type:
+                # widen the state to cover the merge result (e.g. init 0 with
+                # DOUBLE elements), re-typing the merge under the wider state
+                ct = T.common_super_type(init.type, merge.body.type)
+                if ct is not None and ct != init.type:
+                    init = self._coerce(init, ct)
+                    merge = lam(e.args[2], (ct, elem_of(arr)))
+                if merge.body.type != init.type:
+                    merge = ir.LambdaExpr(
+                        merge.params, merge.param_types,
+                        ir.CastExpr(merge.body, init.type),
+                        T.function_type(init.type))
+            if len(e.args) > 3:
+                out = lam(e.args[3], (init.type,))
+            else:
+                s = self.symbols.new("lam_s")
+                out = ir.LambdaExpr((s,), (init.type,),
+                                    ir.Ref(s, init.type),
+                                    T.function_type(init.type))
+            return self._call("reduce", [arr, init, merge, out])
+        raise SemanticError(f"function {name} does not take lambda arguments")
 
     def _call(self, name: str, args: List[ir.RowExpr]) -> ir.RowExpr:
         fn = scalar_fns.REGISTRY.get(name)

@@ -143,6 +143,14 @@ class FunctionCall(Expr):
 
 
 @dataclass
+class Lambda(Expr):
+    """`x -> body` / `(x, y) -> body` — only valid as a function argument
+    (reference: sql/tree/LambdaExpression.java)."""
+    params: List[str]
+    body: Expr
+
+
+@dataclass
 class Extract(Expr):
     fld: str  # YEAR MONTH DAY ...
     value: Expr

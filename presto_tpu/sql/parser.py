@@ -27,7 +27,7 @@ _TOKEN_RE = re.compile(
     | (?P<string>'(?:[^']|'')*')
     | (?P<qident>"(?:[^"]|"")*")
     | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-    | (?P<op><>|!=|>=|<=|\|\||=>|[-+*/%(),.;=<>\[\]?])
+    | (?P<op><>|!=|>=|<=|\|\||=>|->|[-+*/%(),.;=<>\[\]?])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -418,7 +418,9 @@ class Parser:
         existing subquery forms (reference: QuantifiedComparisonExpression,
         lowered by TransformQuantifiedComparisonApplyToLateralJoin):
           = ANY  -> IN          <> ALL -> NOT IN
-          < ANY  -> < (max)     < ALL  -> < (min)     (and mirrors)
+          everything else -> a three-valued CASE over min/max/count
+          scalar aggregates of the subquery (TRUE/FALSE/NULL exactly per
+          SQL:2016 8.9, so NOT(...)/IS NULL stay correct)
         """
         t = self.peek()
         if not (t.kind == "ident" and t.value in ("any", "some")
@@ -445,37 +447,55 @@ class Parser:
                     agg, [] if star else [ast.Identifier(("q_", "v_"))]))],
                 from_=ast.SubqueryRelation(q, "q_", ["v_"]))))
 
-        def cmp_extreme(op2):
-            # loosest bound: <: max, >: min (NULL when subquery is empty)
-            agg = "max" if op2 in ("<", "<=") else "min"
-            return ast.BinaryOp(op2, left, scalar_agg(agg))
+        # Three-valued CASE lowering (SQL:2016 8.9): the result must be
+        # NULL — not FALSE — when no definite answer exists, so it stays
+        # correct under NOT / IS NULL.  Branch order encodes the decision
+        # table; a NULL WHEN condition falls through to the next branch.
+        null_lit = ast.Literal(None)
+        true_l, false_l = ast.Literal(True), ast.Literal(False)
+        left_null = ast.IsNull(left)
+        empty = ast.BinaryOp("=", scalar_agg("count", star=True),
+                             ast.Literal(0))
+        # count(*) <> count(v_): NULL values present among the rows
+        has_nulls = ast.BinaryOp("<>", scalar_agg("count", star=True),
+                                 scalar_agg("count"))
+        minv = lambda: scalar_agg("min")
+        maxv = lambda: scalar_agg("max")
 
-        nonempty = ast.BinaryOp(">", scalar_agg("count", star=True),
-                                ast.Literal(0))
-        # count(*) = count(v_): no NULL values among the subquery rows
-        no_nulls = ast.BinaryOp("=", scalar_agg("count", star=True),
-                                scalar_agg("count"))
+        def some_differs():  # TRUE iff a non-NULL element <> left
+            return ast.BinaryOp(
+                "OR", ast.BinaryOp("<>", minv(), left),
+                ast.BinaryOp("<>", maxv(), left))
+
         if quant == "ANY":
             if op == "=":
                 return ast.InSubquery(left, q, False)
             if op == "<>":
-                self.err("<> ANY is not supported")
-            # empty subquery: ANY is FALSE (cmp vs NULL extreme alone
-            # would be NULL, which flips under NOT)
-            return ast.BinaryOp("AND", nonempty, cmp_extreme(op))
-        neg = {"=": "<>", "<>": "=", "<": ">=", "<=": ">",
-               ">": "<=", ">=": "<"}[op]
-        if neg == "<>":  # = ALL
-            self.err("= ALL is not supported")
-        if neg == "=":
+                return ast.Case(None, [(empty, false_l),
+                                       (left_null, null_lit),
+                                       (some_differs(), true_l),
+                                       (has_nulls, null_lit)], false_l)
+            # loosest bound: <: max, >: min (over non-NULL elements)
+            ext = maxv() if op in ("<", "<=") else minv()
+            return ast.Case(None, [(empty, false_l),
+                                   (left_null, null_lit),
+                                   (ast.BinaryOp(op, left, ext), true_l),
+                                   (has_nulls, null_lit)], false_l)
+        # ALL
+        if op == "<>":
             return ast.InSubquery(left, q, True)  # <> ALL == NOT IN
-        # ALL == vacuously TRUE on empty; with NULLs present it can never
-        # be definitely TRUE (SQL NULL, which WHERE treats as exclusion)
-        empty = ast.UnaryOp("NOT", nonempty)
-        return ast.BinaryOp(
-            "OR", empty,
-            ast.BinaryOp("AND", no_nulls,
-                         ast.UnaryOp("NOT", cmp_extreme(neg))))
+        if op == "=":
+            return ast.Case(None, [(empty, true_l),
+                                   (left_null, null_lit),
+                                   (some_differs(), false_l),
+                                   (has_nulls, null_lit)], true_l)
+        # tightest bound: <: min, >: max (over non-NULL elements)
+        ext = minv() if op in ("<", "<=") else maxv()
+        failed = ast.UnaryOp("NOT", ast.BinaryOp(op, left, ext))
+        return ast.Case(None, [(empty, true_l),
+                               (left_null, null_lit),
+                               (failed, false_l),
+                               (has_nulls, null_lit)], true_l)
 
     def _grouping_sets(self):
         """((a, b), (a), ()) — each set is a parenthesized expr list."""
@@ -903,9 +923,9 @@ class Parser:
                 distinct = True
             else:
                 self.accept_kw("ALL")
-            args.append(self.expr())
+            args.append(self._lambda_or_expr())
             while self.accept_op(","):
-                args.append(self.expr())
+                args.append(self._lambda_or_expr())
         self.expect_op(")")
         filt = None
         if self.at_kw("FILTER"):
@@ -918,6 +938,41 @@ class Parser:
         if self.accept_kw("OVER"):
             window = self._window_spec()
         return ast.FunctionCall(name.lower(), args, distinct, filt, window)
+
+    def _lambda_or_expr(self) -> ast.Expr:
+        """Function argument: `x -> body`, `(x, y) -> body`, or an expression
+        (reference: SqlBase.g4 `lambda` primaryExpression alternative)."""
+        t = self.peek()
+        if t.kind == "ident" and self.peek(1).kind == "op" \
+                and self.peek(1).value == "->":
+            name = self.next().value
+            self.next()  # ->
+            return ast.Lambda([name], self.expr())
+        if t.kind == "op" and t.value == "(":
+            # lookahead for  ( ident [, ident]* ) ->
+            j = self.i + 1
+            params: List[str] = []
+            while True:
+                tk = self.toks[j]
+                if tk.kind != "ident":
+                    params = []
+                    break
+                params.append(tk.value)
+                j += 1
+                tk = self.toks[j]
+                if tk.kind == "op" and tk.value == ",":
+                    j += 1
+                    continue
+                if tk.kind == "op" and tk.value == ")":
+                    j += 1
+                    break
+                params = []
+                break
+            if params and self.toks[j].kind == "op" \
+                    and self.toks[j].value == "->":
+                self.i = j + 1
+                return ast.Lambda(params, self.expr())
+        return self.expr()
 
     def _window_spec(self) -> ast.WindowSpec:
         self.expect_op("(")

@@ -121,6 +121,12 @@ def char(length: int) -> Type:
     return Type("CHAR", (length,))
 
 
+def function_type(ret: Type) -> Type:
+    """FUNCTION(ret) — the type of a lambda argument (reference:
+    spi/type/FunctionType.java).  Never materialized as a column."""
+    return Type("FUNCTION", (ret,))
+
+
 _PHYSICAL = {
     "BOOLEAN": np.bool_,
     "TINYINT": np.int32,

@@ -97,3 +97,102 @@ def test_array_agg_keeps_nulls(session):
 
 def test_grouping_sets_words_usable_as_identifiers(session):
     assert session.sql("SELECT 1 AS sets, 2 AS grouping").rows == [(1, 2)]
+
+
+# ---- lambdas / higher-order functions (reference: TestArrayTransform,
+# TestArrayFilter, TestArrayReduce, TestZipWith, TestArrayMatch) ----------
+
+
+def test_lambda_transform_filter(session):
+    assert session.sql(
+        "SELECT transform(ARRAY[1,2,3], x -> x * 2)").rows == [((2, 4, 6),)]
+    assert session.sql(
+        "SELECT transform(ARRAY[1,2,NULL], x -> x + 1)").rows \
+        == [((2, 3, None),)]
+    assert session.sql(
+        "SELECT transform(ARRAY['a','bb'], x -> length(x))").rows \
+        == [((1, 2),)]
+    assert session.sql(
+        "SELECT transform(ARRAY[1,2], x -> cast(x AS varchar))").rows \
+        == [(("1", "2"),)]
+    assert session.sql(
+        "SELECT filter(ARRAY[1,2,3,4], x -> x % 2 = 0)").rows == [((2, 4),)]
+    # filter drops elements whose predicate is NULL
+    assert session.sql(
+        "SELECT filter(ARRAY[1,NULL,3], x -> x > 1)").rows == [((3,),)]
+
+
+def test_lambda_match(session):
+    r = session.sql(
+        "SELECT any_match(ARRAY[1,2,3], x -> x > 2), "
+        "all_match(ARRAY[1,2,3], x -> x > 0), "
+        "none_match(ARRAY[1,2,3], x -> x > 5)").rows
+    assert r == [(True, True, True)]
+    # NULL three-valued logic: no definite match but a NULL candidate
+    assert session.sql(
+        "SELECT any_match(ARRAY[1,NULL], x -> x > 5)").rows == [(None,)]
+    assert session.sql(
+        "SELECT any_match(ARRAY[], x -> x > 5)").rows == [(False,)]
+
+
+def test_lambda_reduce_zip_with(session):
+    assert session.sql(
+        "SELECT reduce(ARRAY[1,2,3,4], 0, (s, x) -> s + x, s -> s)"
+    ).rows == [(10,)]
+    assert session.sql(  # 3-arg form defaults to identity output
+        "SELECT reduce(ARRAY[1,2,3], 100, (s, x) -> s + x)").rows == [(106,)]
+    assert session.sql(
+        "SELECT reduce(ARRAY[5, 20, 50], 0.0, (s, x) -> s + x, s -> s / 3)"
+    ).rows == [(25.0,)]
+    assert session.sql(
+        "SELECT zip_with(ARRAY[1,2,3], ARRAY[10,20,30], (x, y) -> x + y)"
+    ).rows == [((11, 22, 33),)]
+    # shorter side padded with NULL
+    assert session.sql(
+        "SELECT zip_with(ARRAY[1,2], ARRAY[10,20,30], "
+        "(x, y) -> coalesce(x, 0) + y)").rows == [((11, 22, 30),)]
+
+
+def test_lambda_capture_rejected(session):
+    # captures of row columns are rejected (lambda factoring is per
+    # distinct array value), surfaced as an execution error
+    with pytest.raises(Exception, match="captures"):
+        session.sql(
+            "SELECT transform(ks, x -> x + k) FROM ("
+            "SELECT 1 AS k, ARRAY[1,2] AS ks)")
+
+
+def test_lambda_transform_on_aggregated_arrays(session):
+    r = session.sql(
+        "SELECT k, transform(a, x -> x * 10) FROM ("
+        "SELECT o_orderstatus AS k, array_agg(o_orderkey) AS a "
+        "FROM orders GROUP BY o_orderstatus) ORDER BY k").rows
+    base = session.sql(
+        "SELECT o_orderstatus, array_agg(o_orderkey) FROM orders "
+        "GROUP BY o_orderstatus ORDER BY o_orderstatus").rows
+    assert len(r) == len(base)
+    for (k1, scaled), (k2, orig) in zip(r, base):
+        assert k1 == k2 and scaled == tuple(x * 10 for x in orig)
+
+
+def test_array_set_functions(session):
+    assert session.sql(
+        "SELECT flatten(ARRAY[ARRAY[1,2], ARRAY[3]])").rows == [((1, 2, 3),)]
+    assert session.sql(
+        "SELECT array_remove(ARRAY[1,2,1,3], 1)").rows == [((2, 3),)]
+    r = session.sql(
+        "SELECT array_union(ARRAY[1,2], ARRAY[2,3]), "
+        "array_intersect(ARRAY[1,2,3], ARRAY[2,3,4]), "
+        "array_except(ARRAY[1,2,3], ARRAY[2]), "
+        "arrays_overlap(ARRAY[1,2], ARRAY[2,3])").rows
+    assert r == [((1, 2, 3), (2, 3), (1, 3), True)]
+    assert session.sql(
+        "SELECT sequence(1, 5), sequence(5, 1, -2)").rows \
+        == [((1, 2, 3, 4, 5), (5, 3, 1))]
+    assert session.sql(
+        "SELECT split('a,b,c', ','), split('a,b,c', ',', 2)").rows \
+        == [(("a", "b", "c"), ("a", "b,c"))]
+    assert session.sql(
+        "SELECT ARRAY[1,2] || ARRAY[3]").rows == [((1, 2, 3),)]
+    assert session.sql(
+        "SELECT ARRAY[ARRAY[1,2], ARRAY[3]]").rows == [(((1, 2), (3,)),)]

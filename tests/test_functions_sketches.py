@@ -281,3 +281,30 @@ def test_quantified_null_and_empty_semantics(tpch_catalog_tiny):
     # any/some still usable as column names on a comparison RHS
     assert s.sql("SELECT x = some FROM (VALUES (1, 1)) AS t(x, some)"
                  ).rows == [(True,)]
+
+
+def test_quantified_three_valued_logic(tpch_catalog_tiny):
+    """SQL:2016 8.9 decision table incl. NULL results under negation
+    (reference: TestQuantifiedComparisons semantics)."""
+    import presto_tpu as pt
+
+    s = pt.connect(tpch_catalog_tiny)
+    cases = [
+        ("SELECT 1 < ALL (SELECT v FROM (VALUES 2, NULL) t(v))", None),
+        ("SELECT 3 < ALL (SELECT v FROM (VALUES 2, NULL) t(v))", False),
+        ("SELECT 3 > ANY (SELECT v FROM (VALUES 5, NULL) t(v))", None),
+        ("SELECT 6 > ANY (SELECT v FROM (VALUES 5, NULL) t(v))", True),
+        ("SELECT 5 = ALL (SELECT v FROM (VALUES 5, 5) t(v))", True),
+        ("SELECT 5 = ALL (SELECT v FROM (VALUES 5, 6) t(v))", False),
+        ("SELECT 5 = ALL (SELECT v FROM (VALUES 5, NULL) t(v))", None),
+        ("SELECT 5 <> ANY (SELECT v FROM (VALUES 5, 6) t(v))", True),
+        ("SELECT 5 <> ANY (SELECT v FROM (VALUES 5, 5) t(v))", False),
+        ("SELECT 5 <> ANY (SELECT v FROM (VALUES 5, NULL) t(v))", None),
+        ("SELECT NULL < ALL (SELECT v FROM (VALUES 1) t(v))", None),
+    ]
+    for q, want in cases:
+        assert s.sql(q).rows == [(want,)], q
+    # a NULL quantified result must NOT become TRUE under NOT
+    assert s.sql(
+        "SELECT count(*) FROM (VALUES 1) WHERE NOT "
+        "(1 < ALL (SELECT v FROM (VALUES 2, NULL) t(v)))").rows == [(0,)]
