@@ -195,14 +195,38 @@ class Catalog:
                         data: Dict[str, np.ndarray]) -> None:
         self.register(MemoryTable(name, schema, data))
 
+    #: catalog/schema qualifiers accepted for flat registrations; a bogus
+    #: prefix must NOT silently resolve to the bare table
+    KNOWN_QUALIFIERS = {"tpch", "tpcds", "memory", "localfile", "blackhole",
+                        "presto_tpu", "default", "system"}
+
+    def _flat_name(self, name: str) -> Optional[str]:
+        parts = name.lower().split(".")
+        if len(parts) < 2:
+            return None
+        import re as _re
+
+        if all(p in self.KNOWN_QUALIFIERS
+               or _re.fullmatch(r"sf\d+(_\d+)?", p) for p in parts[:-1]):
+            return parts[-1]
+        return None
+
     def get(self, name: str) -> ConnectorTable:
         t = self.tables.get(name.lower())
+        if t is None and "." in name:
+            # catalog.schema.table written against a flat registration
+            flat = self._flat_name(name)
+            t = self.tables.get(flat) if flat else None
         if t is None:
             raise KeyError(f"Table '{name}' does not exist")
         return t
 
     def __contains__(self, name: str) -> bool:
-        return name.lower() in self.tables
+        n = name.lower()
+        if n in self.tables:
+            return True
+        flat = self._flat_name(n)
+        return flat is not None and flat in self.tables
 
 
 def tpch_catalog(sf: float = 0.01, cache_dir: Optional[str] = None) -> Catalog:

@@ -1,0 +1,211 @@
+"""System connector: engine runtime state as SQL tables.
+
+Reference parity: presto-main connector/system/ (SystemConnector with
+system.runtime.queries / system.runtime.nodes), the information_schema
+connector (connector/informationSchema/), and the presto-jmx module's
+"metrics queryable in SQL" role.  Tables are virtual: each read() pulls a
+fresh snapshot from the live Session, so they are always current and cost
+nothing when unused (no device residency — system tables are tiny and
+host-only by design; uploading them to HBM would waste transfers).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.catalog import ConnectorTable
+
+
+class SystemTable(ConnectorTable):
+    """Virtual table backed by a provider callback returning host columns."""
+
+    def __init__(self, name: str, schema: Dict[str, T.Type], provider):
+        super().__init__(name, schema)
+        self._provider = provider
+
+    def row_count(self) -> int:
+        cols = self._provider()
+        return len(next(iter(cols.values()))) if cols else 0
+
+    def splits(self, n_splits: int):
+        return [(0, self.row_count())]
+
+    def read(self, columns: Optional[List[str]] = None, split=None):
+        cols = self._provider()
+        want = columns if columns is not None else list(self.schema)
+        out = {}
+        for c in want:
+            a = cols[c]
+            out[c] = (np.asarray(a, dtype=object)
+                      if self.schema[c].is_string
+                      else np.asarray(a, dtype=self.schema[c].numpy_dtype()))
+        return out
+
+    def _invalidate(self):  # never cache device columns for live state
+        pass
+
+    @property
+    def _device_cols(self):
+        return None
+
+    @_device_cols.setter
+    def _device_cols(self, v):
+        pass  # discard: each scan re-ingests the fresh snapshot
+
+    @property
+    def _device_cols_f32(self):
+        return None
+
+    @_device_cols_f32.setter
+    def _device_cols_f32(self, v):
+        pass
+
+
+def _queries_provider(session):
+    def provide():
+        hist = session.history_snapshot()
+        return {
+            "query_id": [q.query_id for q in hist],
+            "state": [q.state for q in hist],
+            "query": [q.sql for q in hist],
+            "execution_mode": [q.execution_mode or "" for q in hist],
+            "output_rows": [int(q.output_rows) for q in hist],
+            "error": [q.error or "" for q in hist],
+            "created": [int(q.create_time * 1e6) for q in hist],
+            "ended": [int(q.end_time * 1e6) for q in hist],
+            "total_ms": [q.total_ns / 1e6 for q in hist],
+            "peak_memory_bytes": [int(q.peak_memory_bytes) for q in hist],
+            "spilled_bytes": [int(q.spilled_bytes) for q in hist],
+        }
+
+    return provide
+
+
+_QUERIES_SCHEMA = {
+    "query_id": T.VARCHAR, "state": T.VARCHAR, "query": T.VARCHAR,
+    "execution_mode": T.VARCHAR, "output_rows": T.BIGINT,
+    "error": T.VARCHAR, "created": T.TIMESTAMP, "ended": T.TIMESTAMP,
+    "total_ms": T.DOUBLE, "peak_memory_bytes": T.BIGINT,
+    "spilled_bytes": T.BIGINT,
+}
+
+
+def _nodes_provider(session):
+    start = time.time()
+
+    def provide():
+        import jax
+
+        try:
+            devs = jax.devices()
+        except Exception:
+            devs = []
+        node_ids, versions, coord, state, uptime = [], [], [], [], []
+        for d in devs:
+            node_ids.append(f"{d.platform}:{d.id}")
+            versions.append(jax.__version__)
+            coord.append(d.id == 0)
+            state.append("active")
+            uptime.append(time.time() - start)
+        return {"node_id": node_ids, "node_version": versions,
+                "coordinator": coord, "state": state,
+                "uptime_seconds": uptime}
+
+    return provide
+
+
+_NODES_SCHEMA = {
+    "node_id": T.VARCHAR, "node_version": T.VARCHAR,
+    "coordinator": T.BOOLEAN, "state": T.VARCHAR,
+    "uptime_seconds": T.DOUBLE,
+}
+
+
+def _tables_provider(session):
+    def provide():
+        names = sorted(n for n in session.catalog.tables
+                       if "." not in n or n.startswith(("system.",
+                                                        "information_schema.")))
+        return {
+            "table_catalog": ["presto_tpu"] * len(names),
+            "table_schema": [n.rsplit(".", 1)[0] if "." in n else "default"
+                             for n in names],
+            "table_name": [n.rsplit(".", 1)[-1] for n in names],
+        }
+
+    return provide
+
+
+_TABLES_SCHEMA = {
+    "table_catalog": T.VARCHAR, "table_schema": T.VARCHAR,
+    "table_name": T.VARCHAR,
+}
+
+
+def _columns_provider(session):
+    def provide():
+        cat, sch, tab, col, pos, typ = [], [], [], [], [], []
+        for n in sorted(session.catalog.tables):
+            t = session.catalog.tables[n]
+            if isinstance(t, SystemTable) and not n.startswith(
+                    ("system.", "information_schema.")):
+                continue
+            for i, (c, ct) in enumerate(t.schema.items()):
+                cat.append("presto_tpu")
+                sch.append(n.rsplit(".", 1)[0] if "." in n else "default")
+                tab.append(n.rsplit(".", 1)[-1])
+                col.append(c)
+                pos.append(i + 1)
+                typ.append(str(ct))
+        return {"table_catalog": cat, "table_schema": sch,
+                "table_name": tab, "column_name": col,
+                "ordinal_position": pos, "data_type": typ}
+
+    return provide
+
+
+_COLUMNS_SCHEMA = {
+    "table_catalog": T.VARCHAR, "table_schema": T.VARCHAR,
+    "table_name": T.VARCHAR, "column_name": T.VARCHAR,
+    "ordinal_position": T.BIGINT, "data_type": T.VARCHAR,
+}
+
+
+def _properties_provider(session):
+    def provide():
+        names = sorted(session.properties)
+        return {
+            "name": names,
+            "value": [str(session.properties[n]) for n in names],
+            "explicit": [n in session._explicit_props for n in names],
+        }
+
+    return provide
+
+
+_PROPERTIES_SCHEMA = {
+    "name": T.VARCHAR, "value": T.VARCHAR, "explicit": T.BOOLEAN,
+}
+
+
+def register_system_tables(session) -> None:
+    """Install the system/information_schema tables into the session's
+    catalog (reference: SystemConnector registration in
+    connector/ConnectorManager + the static information_schema catalog)."""
+    cat = session.catalog
+    for name, schema, provider in [
+        ("system.runtime.queries", _QUERIES_SCHEMA,
+         _queries_provider(session)),
+        ("system.runtime.nodes", _NODES_SCHEMA, _nodes_provider(session)),
+        ("system.session.properties", _PROPERTIES_SCHEMA,
+         _properties_provider(session)),
+        ("information_schema.tables", _TABLES_SCHEMA,
+         _tables_provider(session)),
+        ("information_schema.columns", _COLUMNS_SCHEMA,
+         _columns_provider(session)),
+    ]:
+        cat.tables[name] = SystemTable(name, schema, provider)

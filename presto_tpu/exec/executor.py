@@ -1130,8 +1130,6 @@ class Executor:
             # over an ObjectBigArray); dynamic mode only
             if self.static:
                 raise StaticFallback("array_agg is dynamic-mode only")
-            from presto_tpu.batch import Dictionary as _Dict
-
             gidh = np.asarray(gid)
             rows_live = np.asarray(mask)  # NULL inputs are kept as NULL
             vh = np.asarray(valid)        # elements (Presto array_agg)
@@ -1151,13 +1149,55 @@ class Executor:
                                      else data[row])
             tuples = np.empty(n_groups, dtype=object)
             tuples[:] = [tuple(g) for g in groups]
-            uniq = sorted(set(tuples.tolist()), key=repr)
-            cmap = {t: i for i, t in enumerate(uniq)}
-            codes_out = np.fromiter((cmap[t] for t in tuples.tolist()),
-                                    np.int32, n_groups)
-            u = np.empty(len(uniq), dtype=object)
-            u[:] = uniq
-            return Column(jnp.asarray(codes_out), nonempty, a.type, _Dict(u))
+            return _tuples_to_dict_column(tuples, nonempty, a.type)
+        if a.fn in ("map_agg", "multimap_agg"):
+            # ragged output, host-side like array_agg (reference:
+            # MapAggregationFunction / MultimapAggregationFunction over a
+            # KeyValuePairsState); dynamic mode only
+            if self.static:
+                raise StaticFallback(f"{a.fn} is dynamic-mode only")
+            vcol = to_column(eval_expr(a.args[1], b, self.ctx), b.capacity)
+            kh = np.asarray(col.data)
+            if col.dictionary is not None:
+                kh = col.dictionary.values[
+                    np.clip(kh, 0, len(col.dictionary) - 1)]
+            vhd = np.asarray(vcol.data)
+            if vcol.dictionary is not None:
+                vhd = vcol.dictionary.values[
+                    np.clip(vhd, 0, len(vcol.dictionary) - 1)]
+            vval = np.asarray(valid)
+            vok = np.ones(b.capacity, bool) if vcol.valid is None \
+                else np.asarray(vcol.valid)
+            gidh = np.asarray(gid)
+            groups = [dict() for _ in range(n_groups)]
+            for row in np.flatnonzero(vval):  # NULL keys are skipped
+                g = int(gidh[row])
+                if not (0 <= g < n_groups):
+                    continue
+                k = kh[row].item() if hasattr(kh[row], "item") else kh[row]
+                if isinstance(k, np.str_):
+                    k = str(k)
+                val = None
+                if vok[row]:
+                    val = vhd[row].item() if hasattr(vhd[row], "item") \
+                        else vhd[row]
+                    if isinstance(val, np.str_):
+                        val = str(val)
+                if a.fn == "multimap_agg":
+                    groups[g].setdefault(k, []).append(val)
+                else:
+                    groups[g].setdefault(k, val)  # first value wins
+            tuples = np.empty(n_groups, dtype=object)
+            if a.fn == "multimap_agg":
+                tuples[:] = [tuple(sorted(((k, tuple(v)) for k, v
+                                           in g.items()),
+                                          key=lambda p: repr(p[0])))
+                             for g in groups]
+            else:
+                tuples[:] = [tuple(sorted(g.items(),
+                                          key=lambda p: repr(p[0])))
+                             for g in groups]
+            return _tuples_to_dict_column(tuples, nonempty, a.type)
         if a.fn == "geometric_mean":
             x = jnp.where(valid, col.data.astype(jnp.float64), 1.0)
             s = K.segment_sum(jnp.log(jnp.maximum(x, 1e-300)), gid, n_groups)
@@ -1586,6 +1626,21 @@ class Executor:
     def _exec_output(self, node: P.Output) -> Batch:
         b = self.exec_node(node.source)
         return b.select([s for s in node.symbols])
+
+
+def _tuples_to_dict_column(tuples: np.ndarray, valid, typ) -> Column:
+    """Canonicalize host object tuples into a sorted-unique dictionary
+    column (shared by array_agg/map_agg/multimap_agg; the operator-side
+    twin of functions.scalar._tuple_dict_normalize)."""
+    from presto_tpu.batch import Dictionary as _Dict
+
+    uniq = sorted(set(tuples.tolist()), key=repr)
+    cmap = {t: i for i, t in enumerate(uniq)}
+    codes = np.fromiter((cmap[t] for t in tuples.tolist()),
+                        np.int32, len(tuples))
+    u = np.empty(len(uniq), dtype=object)
+    u[:] = uniq
+    return Column(jnp.asarray(codes), valid, typ, _Dict(u))
 
 
 def scan_batch(table, node: P.TableScan, f32: bool = False) -> Batch:

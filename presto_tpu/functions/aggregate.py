@@ -78,6 +78,14 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         return T.DOUBLE
     if name == "array_agg":
         return T.array_of(arg_types[0])
+    if name == "map_agg":
+        if len(arg_types) != 2:
+            raise TypeError("map_agg takes (key, value)")
+        return T.map_of(arg_types[0], arg_types[1])
+    if name == "multimap_agg":
+        if len(arg_types) != 2:
+            raise TypeError("multimap_agg takes (key, value)")
+        return T.map_of(arg_types[0], T.array_of(arg_types[1]))
     raise KeyError(f"unknown aggregate function: {name}")
 
 
@@ -86,7 +94,7 @@ AGG_NAMES = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "every", "approx_distinct", "corr", "covar_samp",
     "covar_pop", "approx_percentile", "checksum", "min_by", "max_by",
-    "geometric_mean", "array_agg",
+    "geometric_mean", "array_agg", "map_agg", "multimap_agg",
 }
 
 

@@ -481,6 +481,13 @@ def _emit_if(args):
         data = jnp.where(cond, jnp.asarray(a2.data), jnp.asarray(b2.data))
         valid = _merge_valid(cond, a2, b2)
         return ColVal(data, valid, a2.type, a2.dictionary)
+    if a.type.name in ("ARRAY", "MAP", "ROW") \
+            or b.type.name in ("ARRAY", "MAP", "ROW"):
+        a2, b2 = _unify_tuple_dictionaries(a, b)
+        data = jnp.where(cond, jnp.asarray(a2.data), jnp.asarray(b2.data))
+        return ColVal(data, _merge_valid(cond, a2, b2),
+                      a2.type if a2.type.name != "UNKNOWN" else b2.type,
+                      a2.dictionary)
     data = jnp.where(cond, jnp.asarray(a.data), jnp.asarray(b.data))
     return ColVal(data, _merge_valid(cond, a, b), a.type if a.type != T.UNKNOWN else b.type)
 
@@ -506,6 +513,34 @@ def _unify_dictionaries(a: ColVal, b: ColVal):
     ca = la[jnp.clip(a.data, 0, len(a.dictionary) - 1)]
     cb = lb[jnp.clip(b.data, 0, len(b.dictionary) - 1)]
     return (ColVal(ca, a.valid, a.type, merged), ColVal(cb, b.valid, b.type, merged))
+
+
+def _unify_tuple_dictionaries(a: ColVal, b: ColVal):
+    """Branch merge for container (tuple-dictionary) values: a NULL arm
+    adopts the other arm's dictionary; two dictionaries merge by entry
+    union with code translation (same role as _unify_dictionaries)."""
+    if a.dictionary is None and b.dictionary is None:
+        return a, b
+    if a.dictionary is None:
+        a = ColVal(jnp.asarray(0, jnp.int32), a.valid, b.type, b.dictionary)
+        return a, b
+    if b.dictionary is None:
+        b = ColVal(jnp.asarray(0, jnp.int32), b.valid, a.type, a.dictionary)
+        return a, b
+    if a.dictionary is b.dictionary:
+        return a, b
+    av, bv = a.dictionary.values.tolist(), b.dictionary.values.tolist()
+    uniq = sorted(set(av) | set(bv), key=repr)
+    cmap = {v: i for i, v in enumerate(uniq)}
+    u = np.empty(len(uniq), dtype=object)
+    u[:] = uniq
+    merged = Dictionary(u)
+    la = jnp.asarray(np.fromiter((cmap[v] for v in av), np.int32, len(av)))
+    lb = jnp.asarray(np.fromiter((cmap[v] for v in bv), np.int32, len(bv)))
+    ca = la[jnp.clip(a.data, 0, len(av) - 1)]
+    cb = lb[jnp.clip(b.data, 0, len(bv) - 1)]
+    return (ColVal(ca, a.valid, a.type, merged),
+            ColVal(cb, b.valid, b.type, merged))
 
 
 register("if")((_resolve_if, _emit_if))
@@ -891,53 +926,57 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool) -> ColVal:
     raise NotImplementedError(f"CAST {frm} -> {to}")
 
 
+def _container_same_elements(a: T.Type, b: T.Type) -> bool:
+    def same(x, y):
+        return x == y or y.name == "UNKNOWN" or x.name == "UNKNOWN"
+
+    if a.name == "ROW":
+        return len(a.params) == len(b.params) and all(
+            same(x[1], y[1]) for x, y in zip(a.params, b.params))
+    return all(same(x, y) for x, y in zip(a.params, b.params))
+
+
+def _py_cast_scalar(x, ft: T.Type, tt: T.Type):
+    if x is None:
+        return None
+    if ft == tt or tt.name == "UNKNOWN":
+        return x
+    if tt.name in ("ARRAY", "MAP", "ROW"):
+        return _py_cast_value(x, ft, tt)
+    if tt.is_string:
+        return x if ft.is_string else _render_varchar(x, ft)
+    if tt.is_integer:
+        return int(x)
+    if tt.is_floating:
+        return float(x)
+    if tt.name == "BOOLEAN":
+        return bool(x)
+    raise NotImplementedError(f"CAST {ft} -> {tt} inside a container")
+
+
+def _py_cast_value(t, frm: T.Type, to: T.Type):
+    """Convert one container dictionary entry between element types."""
+    if t is None:
+        return None
+    if frm.name == "ARRAY":
+        return tuple(_py_cast_scalar(e, frm.params[0], to.params[0])
+                     for e in t)
+    if frm.name == "MAP":
+        return _map_sort(
+            (_py_cast_scalar(k, frm.params[0], to.params[0]),
+             _py_cast_scalar(w, frm.params[1], to.params[1])) for k, w in t)
+    return tuple(_py_cast_scalar(e, ft[1], tt[1])
+                 for e, ft, tt in zip(t, frm.params, to.params))
+
+
 def _cast_to_varchar(v: ColVal) -> ColVal:
     """Host-side render (reference: the type's cast-to-varchar operators,
     e.g. operator/scalar/...CastToVarchar).  Needs concrete data — under
     jit tracing np.asarray raises and the query falls back to dynamic."""
-    import datetime as _dt
-
     frm = v.type
 
     def fmt(x):
-        if frm.name == "BOOLEAN":
-            return "true" if x else "false"
-        if frm.is_integer:
-            return str(int(x))
-        if frm.is_floating:
-            f = float(x)
-            if f != f:
-                return "NaN"
-            if f == float("inf"):
-                return "Infinity"
-            if f == float("-inf"):
-                return "-Infinity"
-            # Java Double.toString: plain decimal in [1e-3, 1e7), else
-            # scientific with a [1,10) mantissa and no exponent sign
-            if 1e-3 <= abs(f) < 1e7 or f == 0.0:
-                if f == int(f):
-                    return f"{f:.1f}"
-                return repr(f)
-            mant, exp = f"{f:E}".split("E")
-            mant = mant.rstrip("0").rstrip(".")
-            if "." not in mant:
-                mant += ".0"
-            return f"{mant}E{int(exp)}"
-        if frm.is_decimal:
-            s = frm.decimal_scale
-            n = int(x)
-            sign = "-" if n < 0 else ""
-            n = abs(n)
-            if s == 0:
-                return sign + str(n)
-            return f"{sign}{n // 10 ** s}.{n % 10 ** s:0{s}d}"
-        if frm.name == "DATE":
-            return (_dt.date(1970, 1, 1)
-                    + _dt.timedelta(days=int(x))).isoformat()
-        if frm.name == "TIMESTAMP":  # int64 microseconds since epoch
-            t = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(x))
-            return t.strftime("%Y-%m-%d %H:%M:%S.%f")[:-3]
-        raise NotImplementedError(f"CAST {frm} -> VARCHAR")
+        return _render_varchar(x, frm)
 
     if v.is_scalar:
         x = v.data.item() if hasattr(v.data, "item") else v.data
@@ -950,10 +989,69 @@ def _cast_to_varchar(v: ColVal) -> ColVal:
                   Dictionary(uniq.astype(object)))
 
 
+def _render_varchar(x, frm: T.Type) -> str:
+    import datetime as _dt
+
+    if frm.name == "BOOLEAN":
+        return "true" if x else "false"
+    if frm.is_integer:
+        return str(int(x))
+    if frm.is_floating:
+        f = float(x)
+        if f != f:
+            return "NaN"
+        if f == float("inf"):
+            return "Infinity"
+        if f == float("-inf"):
+            return "-Infinity"
+        # Java Double.toString: plain decimal in [1e-3, 1e7), else
+        # scientific with a [1,10) mantissa and no exponent sign
+        if 1e-3 <= abs(f) < 1e7 or f == 0.0:
+            if f == int(f):
+                return f"{f:.1f}"
+            return repr(f)
+        mant, exp = f"{f:E}".split("E")
+        mant = mant.rstrip("0").rstrip(".")
+        if "." not in mant:
+            mant += ".0"
+        return f"{mant}E{int(exp)}"
+    if frm.is_decimal:
+        s = frm.decimal_scale
+        n = int(x)
+        sign = "-" if n < 0 else ""
+        n = abs(n)
+        if s == 0:
+            return sign + str(n)
+        return f"{sign}{n // 10 ** s}.{n % 10 ** s:0{s}d}"
+    if frm.name == "DATE":
+        return (_dt.date(1970, 1, 1)
+                + _dt.timedelta(days=int(x))).isoformat()
+    if frm.name == "TIMESTAMP":  # int64 microseconds since epoch
+        t = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(x))
+        return t.strftime("%Y-%m-%d %H:%M:%S.%f")[:-3]
+    raise NotImplementedError(f"CAST {frm} -> VARCHAR")
+
+
 def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
     frm = v.type
     if frm == to:
         return v
+    if frm.name in ("ARRAY", "MAP", "ROW") and to.name == frm.name:
+        if frm.name == "ROW" and len(frm.params) != len(to.params):
+            raise ValueError(
+                f"cannot cast {frm} to {to}: field count mismatch")
+        if _container_same_elements(frm, to):
+            # pure re-tag (field renaming); shared dictionary unchanged
+            return ColVal(v.data, v.valid, to, v.dictionary)
+        # element types differ: convert every dictionary entry host-side
+        entries = v.dictionary.values if v.dictionary is not None \
+            else np.empty(0, dtype=object)
+        outs = np.empty(max(len(entries), 1), dtype=object)
+        outs[:] = [()] * len(outs)
+        for i, t in enumerate(entries):
+            outs[i] = _py_cast_value(t, frm, to)
+        codes = jnp.clip(v.data, 0, len(outs) - 1)
+        return _tuple_dict_normalize(outs, ColVal(codes, v.valid, to), to)
     if frm.name == "UNKNOWN":  # CAST(NULL AS anything) == typed NULL
         if to.is_string:
             return ColVal("", False, to)
@@ -1527,15 +1625,24 @@ def _resolve_array_ctor(args):
     return T.array_of(ct)
 
 
+def _scalar_is_null(a: ColVal) -> bool:
+    """NULL-ness of a scalar ColVal: covers python bools AND 0-dim
+    device/numpy bools (computed NULLs like element_at misses)."""
+    v = a.valid
+    if v is None:
+        return False
+    if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0:
+        return False  # vector validity — not a scalar context
+    return not bool(v)
+
+
 def _emit_array_ctor(args):
     vals = []
     for a in args:
         if hasattr(a.data, "shape") and getattr(a.data, "ndim", 0) > 0:
             raise NotImplementedError(
                 "ARRAY[...] over column values is not supported yet")
-        if a.valid is False or (a.valid is not None
-                                and not hasattr(a.valid, "shape")
-                                and not bool(a.valid)):
+        if _scalar_is_null(a):
             vals.append(None)  # NULL element, not its physical placeholder
             continue
         v = a.data
@@ -1551,8 +1658,7 @@ def _emit_array_ctor(args):
 
 
 register("array_constructor")((_resolve_array_ctor, _emit_array_ctor))
-register("cardinality")((_array_transform(
-    "cardinality", lambda v: len(v), T.BIGINT)))
+# cardinality / element_at registered below with MAP-aware dispatch
 
 
 def _element_at(v, i):
@@ -1562,9 +1668,6 @@ def _element_at(v, i):
     if abs(i) > len(v):
         return None  # Presto: NULL beyond the array bounds
     return v[i - 1] if i > 0 else v[i]
-
-
-register("element_at")((_array_transform("element_at", _element_at, "elem")))
 register("contains")((_array_transform(
     "contains", lambda v, x: any(e == x for e in v), T.BOOLEAN)))
 register("array_min")((_array_transform(
@@ -1706,7 +1809,7 @@ def _colval_from_pylist(vals, t: T.Type) -> ColVal:
     n = len(vals)
     valid = np.asarray([v is not None for v in vals], dtype=bool)
     v_arg = None if valid.all() else jnp.asarray(valid)
-    if t.name == "ARRAY":
+    if t.name in ("ARRAY", "MAP", "ROW"):
         obj = np.empty(n, dtype=object)
         for i, v in enumerate(vals):
             obj[i] = tuple(v) if v is not None else ()
@@ -1737,6 +1840,9 @@ def _pylist_from_colval(cv: ColVal, n: int) -> list:
             out = [None] * n
         else:
             out = [dvals[int(c)] for c in np.clip(codes, 0, len(dvals) - 1)]
+        # numpy string scalars must not leak into dictionary tuples: their
+        # repr differs from python str, breaking canonical entry ordering
+        out = [str(v) if isinstance(v, np.str_) else v for v in out]
     else:
         out = codes.tolist()
     if cv.valid is None:
@@ -1778,7 +1884,7 @@ def _dict_lut_result(vals: list, col: ColVal, rt: T.Type) -> ColVal:
         valid = ~bad
     else:
         valid = jnp.asarray(col.valid) & ~bad
-    if rt.name == "ARRAY":
+    if rt.name in ("ARRAY", "MAP", "ROW"):
         obj = np.empty(ne, dtype=object)
         for i, v in enumerate(vals):
             obj[i] = tuple(v) if v is not None else ()
@@ -1953,3 +2059,333 @@ register("reduce")((
     lambda args: _fn_ret(args[3]) if len(args) == 4 and _is_array(args[0])
     and _is_function(args[2]) and _is_function(args[3]) else None,
     _emit_reduce))
+
+
+# ---- MAP / ROW types -------------------------------------------------
+# Reference: spi/type/MapType + RowType, spi/block/MapBlock + RowBlock,
+# operator/scalar/MapFunctions + MapTransformValuesFunction etc.
+# Physical form mirrors ARRAY: int32 codes into a dictionary whose entries
+# are key-sorted tuples of (key, value) pairs (MAP) or field tuples (ROW).
+
+
+def _is_map(t: T.Type) -> bool:
+    return t.name == "MAP"
+
+
+def _map_sort(pairs) -> tuple:
+    return tuple(sorted(pairs, key=lambda p: repr(p[0])))
+
+
+def _map_build(keys, values) -> tuple:
+    keys = list(keys)
+    if any(k is None for k in keys):
+        raise ValueError("map key cannot be null")
+    if len(set(keys)) != len(keys):
+        raise ValueError("duplicate map keys are not allowed")
+    return _map_sort(zip(keys, values))
+
+
+def _pair_codes(args):
+    """Row-wise pairing of N dictionary-coded columns; returns
+    (uniq combos [k,N], inverse codes, scalar?).  NULL rows get code -1
+    so their (meaningless) stale codes never pair — the combined combo is
+    recognizably invalid instead of crashing entry construction.
+    Concrete codes only (compiled mode falls back)."""
+    codes_list = []
+    for a in args:
+        c = np.asarray(a.data)
+        if a.valid is not None and hasattr(a.valid, "shape") \
+                and getattr(a.valid, "ndim", 0) > 0:
+            c = np.where(np.asarray(a.valid), np.atleast_1d(c), -1)
+        codes_list.append(c)
+    scalar = all(c.ndim == 0 for c in codes_list)
+    n = max((len(c) for c in codes_list if c.ndim > 0), default=1)
+    cols = [np.broadcast_to(np.atleast_1d(c), (n,)) for c in codes_list]
+    uniq, inv = np.unique(np.stack(cols, axis=1), axis=0, return_inverse=True)
+    return uniq, inv, scalar, n
+
+
+def _resolve_map_ctor(args):
+    if len(args) == 0:
+        return T.map_of(T.UNKNOWN, T.UNKNOWN)
+    if len(args) == 2 and all(a.name == "ARRAY" for a in args):
+        return T.map_of(args[0].params[0], args[1].params[0])
+    return None
+
+
+def _emit_map_ctor(args):
+    rt = _resolve_map_ctor([a.type for a in args])
+    if not args:
+        d = np.empty(1, dtype=object)
+        d[0] = ()
+        return ColVal(jnp.asarray(0, jnp.int32), None, rt, Dictionary(d))
+    ka, va = args
+    uniq, inv, scalar, _ = _pair_codes(args)
+    kd, vd = _arr_entries(ka), _arr_entries(va)
+    outs = np.empty(len(uniq), dtype=object)
+    for i, (ck, cv) in enumerate(uniq):
+        if int(ck) < 0 or int(cv) < 0:  # NULL row — result NULL via valid
+            outs[i] = ()
+            continue
+        keys = kd[int(ck)] if int(ck) < len(kd) else ()
+        vals = vd[int(cv)] if int(cv) < len(vd) else ()
+        if len(keys) != len(vals):
+            raise ValueError("map key and value arrays must match in length")
+        outs[i] = _map_build(keys, vals)
+    codes = jnp.asarray(int(inv[0]), jnp.int32) if scalar \
+        else jnp.asarray(inv.astype(np.int32))
+    return _tuple_dict_normalize(
+        outs, ColVal(codes, all_valid(*args), rt), rt)
+
+
+register("map")((_resolve_map_ctor, _emit_map_ctor))
+
+
+def _map_value_fn(name, fn, rt_fn):
+    """Per-dictionary-entry map transform; extras decoded like
+    _array_transform."""
+
+    def resolve(args):
+        return rt_fn(args) if args and _is_map(args[0]) else None
+
+    def emit(args):
+        col = args[0]
+        extra = []
+        for a in args[1:]:
+            if hasattr(a.data, "shape") and getattr(a.data, "ndim", 0) > 0:
+                raise NotImplementedError(f"{name} with non-constant arguments")
+            v = a.data
+            if a.dictionary is not None:
+                v = a.dictionary.values[int(v)]
+            elif hasattr(v, "item"):
+                v = v.item()
+            extra.append(v)
+        rt = rt_fn([a.type for a in args])
+        entries = _arr_entries(col)
+        vals = []
+        for t in entries:
+            try:
+                vals.append(fn(t, *extra))
+            except (ValueError, IndexError, TypeError, KeyError):
+                vals.append(None)
+        return _dict_lut_result(vals, col, rt)
+
+    return resolve, emit
+
+
+register("map_keys")((_map_value_fn(
+    "map_keys", lambda t: tuple(k for k, _ in t),
+    lambda a: T.array_of(a[0].params[0]))))
+register("map_values")((_map_value_fn(
+    "map_values", lambda t: tuple(v for _, v in t),
+    lambda a: T.array_of(a[0].params[1]))))
+register("map_entries")((_map_value_fn(
+    "map_entries", lambda t: tuple(tuple(p) for p in t),
+    lambda a: T.array_of(T.row_of([(None, a[0].params[0]),
+                                   (None, a[0].params[1])])))))
+
+
+def _map_lookup(t, key):
+    for k, v in t:
+        if k == key:
+            return v
+    return None
+
+
+def _resolve_element_at(args):
+    if not args:
+        return None
+    if _is_array(args[0]):
+        return _elem_type(args[0])
+    if _is_map(args[0]):
+        return args[0].params[1]
+    return None
+
+
+def _emit_element_at(args):
+    if _is_map(args[0].type):
+        return _map_value_fn("element_at", _map_lookup,
+                             lambda a: a[0].params[1])[1](args)
+    return _array_transform("element_at", _element_at, "elem")[1](args)
+
+
+register("element_at")((_resolve_element_at, _emit_element_at))
+
+
+def _emit_subscript(args):
+    # a[i] / m[k] — lenient NULL-on-missing semantics (element_at;
+    # the reference's subscript operator raises on out-of-bounds)
+    return _emit_element_at(args)
+
+
+register("subscript")((_resolve_element_at, _emit_subscript))
+
+def _emit_cardinality(args):
+    col = args[0]
+    return _dict_lut_result([len(t) for t in _arr_entries(col)],
+                            col, T.BIGINT)
+
+
+register("cardinality")((
+    lambda args: T.BIGINT if args and args[0].name in ("ARRAY", "MAP")
+    else None,
+    _emit_cardinality))
+
+
+def _resolve_map_concat(args):
+    if args and all(_is_map(a) for a in args):
+        kt, vt = args[0].params
+        for a in args[1:]:
+            kt = T.common_super_type(kt, a.params[0]) or kt
+            vt = T.common_super_type(vt, a.params[1]) or vt
+        return T.map_of(kt, vt)
+    return None
+
+
+def _emit_map_concat(args):
+    rt = _resolve_map_concat([a.type for a in args])
+    uniq, inv, scalar, _ = _pair_codes(args)
+    dicts = [_arr_entries(a) for a in args]
+    outs = np.empty(len(uniq), dtype=object)
+    for i, combo in enumerate(uniq):
+        merged = {}
+        for dv, code in zip(dicts, combo):
+            if 0 <= int(code) < len(dv):
+                merged.update(dict(dv[int(code)]))  # later maps win
+        outs[i] = _map_sort(merged.items())
+    codes = jnp.asarray(int(inv[0]), jnp.int32) if scalar \
+        else jnp.asarray(inv.astype(np.int32))
+    return _tuple_dict_normalize(
+        outs, ColVal(codes, all_valid(*args), rt), rt)
+
+
+register("map_concat")((_resolve_map_concat, _emit_map_concat))
+
+def _resolve_map_from_entries(args):
+    if args and _is_array(args[0]) and args[0].params[0].name == "ROW" \
+            and len(args[0].params[0].params) == 2:
+        return T.map_of(args[0].params[0].params[0][1],
+                        args[0].params[0].params[1][1])
+    return None
+
+
+def _emit_map_from_entries(args):
+    col = args[0]
+    rt = _resolve_map_from_entries([a.type for a in args])
+    vals = []
+    for t in _arr_entries(col):
+        try:
+            vals.append(_map_build([p[0] for p in t], [p[1] for p in t]))
+        except (ValueError, IndexError, TypeError):
+            vals.append(None)
+    return _dict_lut_result(vals, col, rt)
+
+
+register("map_from_entries")((_resolve_map_from_entries,
+                              _emit_map_from_entries))
+
+
+def _emit_map_hof(name):
+    def emit(args):
+        col, lam = args
+        _check_lambda(lam, name)
+        entries = _arr_entries(col)
+        lens = [len(t) for t in entries]
+        ks = [k for t in entries for k, _ in t]
+        vs = [v for t in entries for _, v in t]
+        if ks:
+            kc = _colval_from_pylist(ks, lam.param_types[0])
+            vc = _colval_from_pylist(vs, lam.param_types[1])
+            res = _pylist_from_colval(
+                lam.apply({lam.params[0]: kc, lam.params[1]: vc}), len(ks))
+        else:
+            res = []
+        if name == "map_filter":
+            rt = col.type
+        elif name == "transform_values":
+            rt = T.map_of(col.type.params[0], lam.ret_type)
+        else:
+            rt = T.map_of(lam.ret_type, col.type.params[1])
+        outs = np.empty(max(len(entries), 1), dtype=object)
+        outs[:] = [()] * len(outs)
+        off = 0
+        for i, L in enumerate(lens):
+            window = res[off:off + L]
+            off += L
+            pairs = entries[i]
+            if name == "map_filter":
+                outs[i] = tuple(p for p, r in zip(pairs, window)
+                                if r is not None and bool(r))
+            elif name == "transform_values":
+                outs[i] = tuple((k, r) for (k, _), r in zip(pairs, window))
+            else:  # transform_keys
+                newk = list(window)
+                if any(k is None for k in newk):
+                    raise ValueError("map key cannot be null")
+                if len(set(newk)) != len(newk):
+                    raise ValueError("duplicate map keys from transform_keys")
+                outs[i] = _map_sort((r, v) for (_, v), r in zip(pairs, window))
+        return _tuple_dict_normalize(
+            outs, ColVal(jnp.clip(col.data, 0, len(outs) - 1),
+                         col.valid, rt), rt)
+
+    return emit
+
+
+register("map_filter")((
+    lambda args: args[0] if len(args) == 2 and _is_map(args[0])
+    and _is_function(args[1]) else None,
+    _emit_map_hof("map_filter")))
+register("transform_values")((
+    lambda args: T.map_of(args[0].params[0], _fn_ret(args[1]))
+    if len(args) == 2 and _is_map(args[0]) and _is_function(args[1])
+    else None,
+    _emit_map_hof("transform_values")))
+register("transform_keys")((
+    lambda args: T.map_of(_fn_ret(args[1]), args[0].params[1])
+    if len(args) == 2 and _is_map(args[0]) and _is_function(args[1])
+    else None,
+    _emit_map_hof("transform_keys")))
+
+
+# ---- ROW -------------------------------------------------------------
+
+
+def _resolve_row_ctor(args):
+    return T.row_of([(None, a) for a in args])
+
+
+def _emit_row_ctor(args):
+    vals = []
+    for a in args:
+        if hasattr(a.data, "shape") and getattr(a.data, "ndim", 0) > 0:
+            raise NotImplementedError(
+                "ROW(...) over column values is not supported yet")
+        if _scalar_is_null(a):
+            vals.append(None)
+            continue
+        v = a.data
+        if isinstance(v, (jnp.ndarray, np.generic)):
+            v = v.item() if hasattr(v, "item") else v
+        if a.dictionary is not None:
+            v = a.dictionary.values[int(v)]
+        vals.append(v)
+    t = _resolve_row_ctor([a.type for a in args])
+    d = np.empty(1, dtype=object)
+    d[0] = tuple(vals)
+    return ColVal(jnp.asarray(0, jnp.int32), None, t, Dictionary(d))
+
+
+register("row")((_resolve_row_ctor, _emit_row_ctor))
+
+
+def _emit_row_field(args):
+    col, idx_v = args
+    idx = int(idx_v.data)
+    ft = col.type.params[idx][1]
+    entries = _arr_entries(col)
+    vals = [t[idx] if idx < len(t) else None for t in entries]
+    return _dict_lut_result(vals, col, ft)
+
+
+register("row_field")((lambda args: None, _emit_row_field))

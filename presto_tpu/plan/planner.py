@@ -391,7 +391,9 @@ class Planner:
                 return node, Scope(fields)
         table = self.catalog.get(name)
         assignments, types, fields = {}, {}, []
-        q = rel.alias or rel.name
+        # implicit qualifier is the bare table name (reference: a qualified
+        # name's last part is the relation alias)
+        q = rel.alias or rel.name.split(".")[-1]
         for i, (col, typ) in enumerate(table.schema.items()):
             nm = (rel.column_aliases[i] if rel.column_aliases and i < len(rel.column_aliases)
                   else col)
@@ -855,8 +857,22 @@ class Planner:
                 return ir.Lit(e.value * (12 if e.unit == "YEAR" else 1), T.INTERVAL_YEAR_MONTH)
             raise SemanticError(f"unsupported interval unit {e.unit}")
         if isinstance(e, ast.Identifier):
-            f, is_outer = scope.resolve(e.parts)
-            return ir.Ref(f.symbol, f.type)
+            try:
+                f, is_outer = scope.resolve(e.parts)
+                return ir.Ref(f.symbol, f.type)
+            except SemanticError:
+                # r.field / t.r.field where r is a ROW-typed column
+                # (reference: ExpressionAnalyzer DereferenceExpression
+                # disambiguation between qualified names and row fields)
+                if len(e.parts) >= 2:
+                    try:
+                        f, _ = scope.resolve(e.parts[:-1])
+                    except SemanticError:
+                        f = None
+                    if f is not None and f.type.name == "ROW":
+                        return self._row_field(ir.Ref(f.symbol, f.type),
+                                               e.parts[-1])
+                raise
         if isinstance(e, ast.BinaryOp):
             opn = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod",
                    "=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt",
@@ -936,6 +952,25 @@ class Planner:
                 raise SemanticError(f"aggregate {e.name} not allowed here")
             if any(isinstance(x, ast.Lambda) for x in e.args):
                 return self._analyze_lambda_call(e, scope, agg_map, group_map)
+            if e.name == "$dereference":
+                base = a(e.args[0])
+                if base.type.name != "ROW":
+                    raise SemanticError(
+                        f"cannot dereference a {base.type} value")
+                return self._row_field(base, e.args[1].value)
+            if e.name == "subscript" and e.args and \
+                    isinstance(e.args[1], ast.Literal) and \
+                    isinstance(e.args[1].value, int):
+                base = a(e.args[0])
+                if base.type.name == "ROW":  # r[i], 1-based
+                    idx = int(e.args[1].value) - 1
+                    if not (0 <= idx < len(base.type.params)):
+                        raise SemanticError(f"ROW index {idx + 1} out of range")
+                    ft = base.type.params[idx][1]
+                    return ir.Call("row_field",
+                                   (base, ir.Lit(idx, T.INTEGER)), ft)
+                args = [base, a(e.args[1])]
+                return self._call("subscript", args)
             args = [a(x) for x in e.args]
             return self._call(e.name.lower(), args)
         if isinstance(e, ast.Lambda):
@@ -990,6 +1025,18 @@ class Planner:
                                                             T.UNKNOWN):
                 raise SemanticError(f"{name} lambda must return BOOLEAN")
             return self._call(name, [arr, le])
+        if name in ("map_filter", "transform_values", "transform_keys"):
+            if len(e.args) != 2:
+                raise SemanticError(f"{name}(map, lambda) expected")
+            m = a(e.args[0])
+            if m.type.name != "MAP":
+                raise SemanticError(f"{name} expects a MAP argument")
+            kt, vt = m.type.params
+            le = lam(e.args[1], (kt, vt))
+            if name == "map_filter" and le.body.type not in (T.BOOLEAN,
+                                                             T.UNKNOWN):
+                raise SemanticError(f"{name} lambda must return BOOLEAN")
+            return self._call(name, [m, le])
         if name == "zip_with":
             if len(e.args) != 3:
                 raise SemanticError("zip_with(array, array, lambda) expected")
@@ -1023,6 +1070,13 @@ class Planner:
                                     T.function_type(init.type))
             return self._call("reduce", [arr, init, merge, out])
         raise SemanticError(f"function {name} does not take lambda arguments")
+
+    def _row_field(self, base: ir.RowExpr, name: str) -> ir.RowExpr:
+        idx = T.row_field_index(base.type, name)
+        if idx is None:
+            raise SemanticError(f"ROW has no field named '{name}'")
+        ft = base.type.params[idx][1]
+        return ir.Call("row_field", (base, ir.Lit(idx, T.INTEGER)), ft)
 
     def _call(self, name: str, args: List[ir.RowExpr]) -> ir.RowExpr:
         fn = scalar_fns.REGISTRY.get(name)

@@ -111,6 +111,11 @@ class Session:
         self.history = collections.deque(maxlen=1000)
         self.history_lock = threading.Lock()
         self.event_listeners: list = []
+        # system/information_schema virtual tables over this session
+        # (reference: SystemConnector + information_schema connector)
+        from presto_tpu.connectors.system import register_system_tables
+
+        register_system_tables(self)
 
     def set(self, name: str, value) -> None:
         if name not in self.properties:

@@ -618,8 +618,8 @@ class Parser:
                     rel.column_aliases = col_aliases
             return rel
         name = self.ident()
-        while self.accept_op("."):  # catalog.schema.table — keep last part
-            name = self.ident()
+        while self.accept_op("."):  # catalog.schema.table — full dotted name
+            name += "." + self.ident()
         alias, col_aliases = self._alias()
         return ast.Table(name, alias, col_aliases)
 
@@ -760,7 +760,24 @@ class Parser:
             return ast.UnaryOp("-", self._unary())
         if self.accept_op("+"):
             return self._unary()
-        return self._primary()
+        e = self._primary()
+        # postfix: subscript a[i] / m['k'], and .field on non-identifier
+        # bases (identifier dot-chains are consumed by _primary itself)
+        while True:
+            if self.at_op("["):
+                self.next()
+                idx = self.expr()
+                self.expect_op("]")
+                e = ast.FunctionCall("subscript", [e, idx])
+                continue
+            if self.at_op(".") and self.peek(1).kind == "ident" \
+                    and not isinstance(e, ast.Identifier):
+                self.next()
+                e = ast.FunctionCall("$dereference",
+                                     [e, ast.Literal(self.ident())])
+                continue
+            break
+        return e
 
     def _primary(self) -> ast.Expr:
         t = self.peek()
@@ -903,12 +920,23 @@ class Parser:
         if tn.upper() == "DOUBLE" and self.peek().kind == "ident" and self.peek().value == "precision":
             self.next()
         if self.accept_op("("):
-            args = []
-            while not self.at_op(")"):
-                args.append(self.next().value)
-                self.accept_op(",")
-            self.expect_op(")")
-            tn += "(" + ",".join(str(a) for a in args) + ")"
+            # capture the balanced-paren argument list verbatim so nested
+            # types (MAP(VARCHAR, ARRAY(BIGINT)), ROW(x BIGINT, ...)) pass
+            # through to types.parse_type
+            depth = 1
+            parts = []
+            while True:
+                t = self.next()
+                if t.kind == "eof":
+                    self.err("unterminated type arguments")
+                if t.kind == "op" and t.value == "(":
+                    depth += 1
+                elif t.kind == "op" and t.value == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                parts.append(str(t.value))
+            tn += "(" + " ".join(parts) + ")"
         return tn
 
     def _function_call(self, name: str) -> ast.Expr:

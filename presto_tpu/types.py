@@ -127,6 +127,32 @@ def function_type(ret: Type) -> Type:
     return Type("FUNCTION", (ret,))
 
 
+def map_of(key: Type, value: Type) -> Type:
+    """MAP(K,V) — physically int32 codes into a dictionary whose entries
+    are key-sorted tuples of (key, value) pairs (reference: spi/type/MapType
+    + spi/block/MapBlock; same DictionaryBlock treatment as ARRAY)."""
+    return Type("MAP", (key, value))
+
+
+def row_of(fields) -> Type:
+    """ROW(name type, ...) — dictionary of value tuples; field names ride
+    the type (reference: spi/type/RowType).  `fields` is a sequence of
+    (name-or-None, Type)."""
+    return Type("ROW", tuple((n.lower() if n else None, t)
+                             for n, t in fields))
+
+
+def row_field_types(t: Type):
+    return tuple(ft for _, ft in t.params)
+
+
+def row_field_index(t: Type, name: str) -> Optional[int]:
+    for i, (n, _) in enumerate(t.params):
+        if n == name.lower():
+            return i
+    return None
+
+
 _PHYSICAL = {
     "BOOLEAN": np.bool_,
     "TINYINT": np.int32,
@@ -144,21 +170,50 @@ _PHYSICAL = {
     "INTERVAL_YEAR_MONTH": np.int64,
     "UNKNOWN": np.bool_,
     "ARRAY": np.int32,  # dictionary code over unique element-tuples
+    "MAP": np.int32,  # dictionary code over unique pair-tuples
+    "ROW": np.int32,  # dictionary code over unique field-tuples
 }
 
 
 def parse_type(text: str) -> Type:
-    """Parse a type name as written in SQL (CAST target etc.)."""
+    """Parse a type name as written in SQL (CAST target etc.), including
+    nested ARRAY(T) / MAP(K,V) / ROW(name T, ...) (reference:
+    TypeSignature.parseTypeSignature)."""
     t = text.strip().upper()
     if "(" in t:
         base, rest = t.split("(", 1)
-        args = [int(a) for a in rest.rstrip(")").split(",") if a.strip().isdigit()]
         base = base.strip()
+        inner = rest.rstrip()
+        if inner.endswith(")"):
+            inner = inner[:-1]
+        if base in ("ARRAY", "MAP", "ROW"):
+            parts = _split_type_args(inner)
+            if base == "ARRAY":
+                return array_of(parse_type(parts[0]))
+            if base == "MAP":
+                return map_of(parse_type(parts[0]), parse_type(parts[1]))
+            fields = []
+            for p in parts:
+                # `name TYPE` vs bare `TYPE`: try the named form first so
+                # field names that prefix a type word (rowid, mapping...)
+                # still parse; fall back to an anonymous field
+                bits = p.strip().split(None, 1)
+                if len(bits) == 2 and "(" not in bits[0]:
+                    try:
+                        fields.append((bits[0].lower(), parse_type(bits[1])))
+                        continue
+                    except ValueError:
+                        pass
+                fields.append((None, parse_type(p)))
+            return row_of(fields)
+        args = [int(a) for a in inner.split(",") if a.strip().isdigit()]
         if base == "DECIMAL":
             return decimal(*args) if args else decimal(18, 0)
         if base in ("VARCHAR", "CHAR"):
             return VARCHAR if base == "VARCHAR" else char(args[0] if args else 1)
         raise ValueError(f"unknown parametric type: {text}")
+    if t == "ARRAY":
+        return array_of(UNKNOWN)
     aliases = {
         "INT": INTEGER,
         "INTEGER": INTEGER,
@@ -180,6 +235,24 @@ def parse_type(text: str) -> Type:
     if t in aliases:
         return aliases[t]
     raise ValueError(f"unknown type: {text}")
+
+
+def _split_type_args(s: str):
+    """Split 'K, V' at top-level commas (parens may nest)."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+            continue
+        cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,105 @@
+"""MAP and ROW types (reference analogs: spi/type/MapType + RowType,
+TestMapOperators, TestRowOperators, MapAggregationFunction tests)."""
+
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+
+
+@pytest.fixture(scope="module")
+def session(tpch_catalog_tiny):
+    return presto_tpu.connect(tpch_catalog_tiny)
+
+
+def test_map_constructor_and_access(session):
+    assert session.sql(
+        "SELECT map(ARRAY['a','b'], ARRAY[1,2])").rows \
+        == [((("a", 1), ("b", 2)),)]
+    r = session.sql(
+        "SELECT cardinality(map(ARRAY['a'], ARRAY[1])), "
+        "element_at(map(ARRAY['a','b'], ARRAY[1,2]), 'b'), "
+        "element_at(map(ARRAY['a'], ARRAY[1]), 'z'), "
+        "map(ARRAY['a','b'], ARRAY[1,2])['a']").rows
+    assert r == [(1, 2, None, 1)]
+    assert session.sql("SELECT map()").rows == [((),)]
+    # canonical form is key-sorted: equal maps get equal entries
+    assert session.sql(
+        "SELECT map(ARRAY['b','a'], ARRAY[2,1])").rows \
+        == [((("a", 1), ("b", 2)),)]
+
+
+def test_map_keys_values_entries(session):
+    r = session.sql(
+        "SELECT map_keys(map(ARRAY['a','b'], ARRAY[1,2])), "
+        "map_values(map(ARRAY['b','a'], ARRAY[2,1]))").rows
+    assert r == [(("a", "b"), (1, 2))]
+    assert session.sql(
+        "SELECT map_from_entries(map_entries(map(ARRAY['a','b'], "
+        "ARRAY[1,2])))").rows == [((("a", 1), ("b", 2)),)]
+    assert session.sql(
+        "SELECT map_concat(map(ARRAY['a'], ARRAY[1]), "
+        "map(ARRAY['a','b'], ARRAY[9,2]))").rows \
+        == [((("a", 9), ("b", 2)),)]
+
+
+def test_map_lambdas(session):
+    assert session.sql(
+        "SELECT map_filter(map(ARRAY['a','b','c'], ARRAY[1,2,3]), "
+        "(k, v) -> v > 1)").rows == [((("b", 2), ("c", 3)),)]
+    assert session.sql(
+        "SELECT transform_values(map(ARRAY['a','b'], ARRAY[1,2]), "
+        "(k, v) -> v * 10)").rows == [((("a", 10), ("b", 20)),)]
+    assert session.sql(
+        "SELECT transform_keys(map(ARRAY['a','b'], ARRAY[1,2]), "
+        "(k, v) -> upper(k))").rows == [((("A", 1), ("B", 2)),)]
+
+
+def test_map_agg(session):
+    r = session.sql(
+        "SELECT n_regionkey, map_agg(n_name, n_nationkey) FROM nation "
+        "GROUP BY n_regionkey ORDER BY n_regionkey").rows
+    assert len(r) == 5
+    for rk, m in r:
+        assert all(isinstance(k, str) for k, _ in m)
+        keys = [k for k, _ in m]
+        assert keys == sorted(keys)
+    assert session.sql(
+        "SELECT element_at(map_agg(n_name, n_nationkey), 'ALGERIA') "
+        "FROM nation").rows == [(0,)]
+    mm = session.sql(
+        "SELECT multimap_agg(n_regionkey, n_nationkey) FROM nation "
+        "WHERE n_regionkey < 2").rows
+    assert mm == [(((0, (0, 5, 14, 15, 16)), (1, (1, 2, 3, 17, 24))),)]
+
+
+def test_row_type(session):
+    assert session.sql("SELECT ROW(1, 'x')").rows == [((1, "x"),)]
+    assert session.sql(
+        "SELECT ROW(1, 'x')[1], ROW(1, 'x')[2]").rows == [(1, "x")]
+    assert session.sql(
+        "SELECT CAST(ROW(1, 'x') AS ROW(a BIGINT, b VARCHAR)).a"
+    ).rows == [(1,)]
+    assert session.sql(
+        "SELECT r.a, r.b FROM (SELECT CAST(ROW(5, 'y') AS "
+        "ROW(a BIGINT, b VARCHAR)) AS r)").rows == [(5, "y")]
+
+
+def test_type_parsing_nested():
+    t = T.parse_type("MAP(VARCHAR, ARRAY(BIGINT))")
+    assert t.name == "MAP" and t.params[1].name == "ARRAY"
+    r = T.parse_type("ROW(x BIGINT, y MAP(VARCHAR, DOUBLE))")
+    assert r.name == "ROW"
+    assert r.params[0] == ("x", T.BIGINT)
+    assert r.params[1][1].name == "MAP"
+    assert T.row_field_index(r, "Y") == 1
+
+
+def test_null_semantics(session):
+    assert session.sql(
+        "SELECT CAST(NULL AS MAP(VARCHAR, BIGINT))").rows == [(None,)]
+    # NULL keys are skipped by map_agg (reference behavior)
+    r = session.sql(
+        "SELECT map_agg(nullif(n_name, 'ALGERIA'), n_nationkey) "
+        "FROM nation WHERE n_regionkey = 0").rows
+    assert len(r[0][0]) == 4

@@ -1,0 +1,72 @@
+"""System/information_schema connector (reference: connector/system/
+SystemConnector, connector/informationSchema/, presto-jmx's queryable
+metrics role)."""
+
+import pytest
+
+import presto_tpu
+
+
+@pytest.fixture()
+def session(tpch_catalog_tiny):
+    return presto_tpu.connect(tpch_catalog_tiny)
+
+
+def test_runtime_queries_reflects_history(session):
+    session.sql("SELECT count(*) FROM nation")
+    session.sql("SELECT 1")
+    r = session.sql(
+        "SELECT query_id, state, query FROM system.runtime.queries "
+        "ORDER BY created").rows
+    # the current query itself is RUNNING; the two before are FINISHED
+    assert len(r) == 3
+    assert r[0][1] == "FINISHED" and "nation" in r[0][2]
+    assert r[2][1] == "RUNNING"
+    n = session.sql(
+        "SELECT count(*) FROM system.runtime.queries "
+        "WHERE state = 'FINISHED'").rows
+    assert n == [(3,)]
+
+
+def test_runtime_nodes(session):
+    r = session.sql(
+        "SELECT node_id, coordinator, state FROM system.runtime.nodes").rows
+    assert len(r) >= 1
+    assert r[0][1] is True and r[0][2] == "active"
+
+
+def test_information_schema(session):
+    tables = session.sql(
+        "SELECT table_name FROM information_schema.tables "
+        "WHERE table_schema = 'default'").rows
+    names = {t[0] for t in tables}
+    assert {"nation", "region", "orders", "lineitem"} <= names
+    cols = session.sql(
+        "SELECT column_name, data_type FROM information_schema.columns "
+        "WHERE table_name = 'nation' ORDER BY ordinal_position").rows
+    assert cols[0] == ("n_nationkey", "BIGINT")
+    assert ("n_name", "VARCHAR") in cols
+    # joinable against itself / aggregable like any table
+    agg = session.sql(
+        "SELECT table_name, count(*) c FROM information_schema.columns "
+        "WHERE table_schema = 'default' GROUP BY table_name "
+        "ORDER BY c DESC LIMIT 1").rows
+    assert agg[0][0] == "lineitem"
+
+
+def test_session_properties_table(session):
+    session.sql("SET SESSION execution_mode = 'dynamic'")
+    r = session.sql(
+        "SELECT value, explicit FROM system.session.properties "
+        "WHERE name = 'execution_mode'").rows
+    assert r == [("dynamic", True)]
+
+
+def test_qualified_names_resolve_flat_tables(session):
+    # catalog.schema.table spelling against flat registrations
+    assert session.sql("SELECT count(*) FROM tpch.sf1.nation").rows \
+        == session.sql("SELECT count(*) FROM nation").rows
+    # the implicit alias is the bare last part
+    assert session.sql(
+        "SELECT nation.n_name FROM tpch.sf1.nation "
+        "ORDER BY n_nationkey LIMIT 1").rows == [("ALGERIA",)]
