@@ -49,15 +49,20 @@ def pack_keys(cols: List[Column], sel, extra_cols: Optional[List[Column]] = None
     column set with the same strides (for join build/probe sides pass
     `extra_cols` so both sides share ranges).
     """
+    def _minmax(col):
+        d = _orderable_int(col)
+        if d.shape[0] == 0:  # zero-capacity side (empty split/partition)
+            return jnp.asarray(I64_MAX), jnp.asarray(I64_MIN)
+        return (jnp.min(jnp.where(_valid_arr(col), d, I64_MAX)),
+                jnp.max(jnp.where(_valid_arr(col), d, I64_MIN)))
+
     parts = []
     for i, c in enumerate(cols):
-        d = _orderable_int(c)
-        lo = jnp.min(jnp.where(_valid_arr(c), d, I64_MAX))
-        hi = jnp.max(jnp.where(_valid_arr(c), d, I64_MIN))
+        lo, hi = _minmax(c)
         if extra_cols is not None:
-            e = _orderable_int(extra_cols[i])
-            lo = jnp.minimum(lo, jnp.min(jnp.where(_valid_arr(extra_cols[i]), e, I64_MAX)))
-            hi = jnp.maximum(hi, jnp.max(jnp.where(_valid_arr(extra_cols[i]), e, I64_MIN)))
+            elo, ehi = _minmax(extra_cols[i])
+            lo = jnp.minimum(lo, elo)
+            hi = jnp.maximum(hi, ehi)
         lo_h = int(lo)
         hi_h = int(hi)
         if hi_h < lo_h:  # all null / empty
