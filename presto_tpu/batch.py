@@ -174,15 +174,47 @@ def batch_from_numpy(
     return Batch(cols, sel)
 
 
-def to_numpy(batch: Batch) -> tuple[Dict[str, np.ndarray], np.ndarray]:
+_COMPACT_THRESHOLD = 262_144  # capacity above which selective fetch wins
+
+
+def to_numpy(batch: Batch, extra=None):
     """Materialize to host: (column arrays with strings decoded, live-row
-    mask).  ONE device_get for the whole batch — per-column transfers pay
-    a full RPC round-trip each on tunneled TPU backends."""
+    mask[, extra pulled value]).  ONE device_get for the whole batch —
+    per-column transfers pay a full RPC round-trip each on tunneled TPU
+    backends.  Large mostly-dead batches (a TopN mask over a scan-sized
+    capacity) are compacted on device first: pull the 1-byte/row sel,
+    gather the survivors, pull only those — the difference between 7s and
+    0.2s for a 10-row result over a 6M-row capacity on a tunneled chip."""
+    if batch.capacity > _COMPACT_THRESHOLD:
+        sel_h, extra_h = jax.device_get((batch.sel, extra))
+        sel_h = np.asarray(sel_h)
+        live = np.flatnonzero(sel_h)
+        if len(live) < batch.capacity // 4:
+            idx = jnp.asarray(live)
+            pulled = jax.device_get(
+                {n: (c.data[idx],
+                     None if c.valid is None else c.valid[idx])
+                 for n, c in batch.columns.items()})
+            out = _decode_pulled(batch, pulled)
+            ones = np.ones(len(live), dtype=bool)
+            return (out, ones) if extra is None else (out, ones, extra_h)
+        # dense batch: fall through to the single full fetch below (sel
+        # already pulled; extra too)
+        pulled = jax.device_get(
+            {n: (c.data, c.valid) for n, c in batch.columns.items()})
+        out = _decode_pulled(batch, pulled)
+        return (out, sel_h) if extra is None else (out, sel_h, extra_h)
     pulled = jax.device_get(
         (batch.sel,
-         {n: (c.data, c.valid) for n, c in batch.columns.items()}))
-    sel, datas = pulled
+         {n: (c.data, c.valid) for n, c in batch.columns.items()},
+         extra))
+    sel, datas, extra_h = pulled
     sel = np.asarray(sel)
+    out = _decode_pulled(batch, datas)
+    return (out, sel) if extra is None else (out, sel, extra_h)
+
+
+def _decode_pulled(batch: Batch, datas) -> Dict[str, np.ndarray]:
     out = {}
     for name, col in batch.columns.items():
         data, valid = datas[name]
@@ -195,4 +227,4 @@ def to_numpy(batch: Batch) -> tuple[Dict[str, np.ndarray], np.ndarray]:
         if valid is not None:
             data = np.ma.masked_array(data, mask=~np.asarray(valid))
         out[name] = data
-    return out, sel
+    return out

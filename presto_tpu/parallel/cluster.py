@@ -393,18 +393,23 @@ class _ClusterExecutor:
         ex.ctx = EvalContext(dict(self.spec.scalar_results))
         out = ex.exec_node(root)
 
-        # materialize to host with validity preserved
-        sel = np.asarray(jax.device_get(out.sel))
-        live = np.flatnonzero(sel)
+        # materialize to host with validity preserved — ONE device_get for
+        # the whole batch (per-column fetches pay a full RPC round trip
+        # each on remote XLA clients; see batch.to_numpy)
+        pulled = jax.device_get(
+            (out.sel, {sym: (out.columns[sym].data, out.columns[sym].valid)
+                       for sym in self.spec.out_symbols}))
+        sel, datas = pulled
+        live = np.flatnonzero(np.asarray(sel))
         cols: Dict[str, tuple] = {}
         for sym in self.spec.out_symbols:
             c = out.columns[sym]
-            data = np.asarray(jax.device_get(c.data))[live]
+            data, valid = datas[sym]
+            data = np.asarray(data)[live]
             if c.dictionary is not None:
                 data = c.dictionary.values[
                     np.clip(data, 0, max(len(c.dictionary.values) - 1, 0))]
-            valid = None if c.valid is None else np.asarray(
-                jax.device_get(c.valid))[live]
+            valid = None if valid is None else np.asarray(valid)[live]
             cols[sym] = (data, valid)
 
         buffers: Dict[int, bytes] = {}
@@ -621,6 +626,11 @@ class ClusterSession:
             dsub = distribute(splan, self.session, len(self.workers))
             res = self._schedule(cut_fragments(dsub.root), scalar_results)
             data, valid = res[syms[0]]
+            if len(data) > 1:
+                from presto_tpu.exec.executor import ExecutionError
+
+                raise ExecutionError(
+                    "scalar subquery returned more than one row")
             if len(data) == 0 or (valid is not None and not valid[0]):
                 return (0, False)
             v = data[0]
@@ -669,7 +679,6 @@ class ClusterSession:
         """Run fragments as BSP supersteps; returns the final fragment's
         unpacked columns (reference: SqlQueryScheduler's stage loop with
         an AllAtOnce-per-level policy)."""
-        nw = len(self.workers)
         nfr = len(fragments)
         # placement is a pure function of the fragment, so consumers'
         # bucket counts are known before producers run
@@ -688,6 +697,26 @@ class ClusterSession:
                        for frag in fragments for inp in frag.inputs}
 
         placements: Dict[int, List[Tuple[str, str]]] = {}
+        all_tasks: List[Tuple[str, str]] = []
+        coordinator_result = None
+        try:
+            coordinator_result = self._run_fragments(
+                fragments, scalar_results, run_on_of, consumer_of,
+                placements, all_tasks)
+        finally:
+            # free worker-side shuffle buffers (reference: DELETE
+            # /v1/task/{id} when the downstream is done with the data)
+            for url, tid in all_tasks:
+                try:
+                    _http(f"{url}/v1/task/{tid}", method="DELETE",
+                          timeout=5.0)
+                except Exception:
+                    pass
+        return coordinator_result
+
+    def _run_fragments(self, fragments, scalar_results, run_on_of,
+                       consumer_of, placements, all_tasks):
+        nfr = len(fragments)
         coordinator_result = None
         for frag in fragments:
             out_symbols = [s for s, _ in frag.root.outputs()]
@@ -729,6 +758,7 @@ class ClusterSession:
                           method="POST")
                     tasks.append((url, spec.task_id))
             if tasks:
+                all_tasks.extend(tasks)
                 self._wait(tasks)
                 placements[frag.fid] = tasks
         return coordinator_result
@@ -782,26 +812,29 @@ def launch_local_cluster(session, catalog_spec: str, nworkers: int = 2,
     import select
 
     deadline = time.time() + timeout
-    for p in procs:
-        while True:
-            remaining = deadline - time.time()
-            if remaining <= 0:
-                for q in procs:
-                    q.kill()
-                raise TimeoutError("cluster startup timed out")
-            ready, _, _ = select.select([p.stdout], [], [],
-                                        min(remaining, 1.0))
-            if not ready:
-                if p.poll() is not None:
-                    raise RuntimeError(
-                        f"worker process exited rc={p.returncode} "
-                        "during startup")
-                continue
-            line = p.stdout.readline()
-            if not line:
-                raise RuntimeError("worker process died during startup")
-            urls.append(json.loads(line)["url"])
-            break
+    try:
+        for p in procs:
+            while True:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError("cluster startup timed out")
+                ready, _, _ = select.select([p.stdout], [], [],
+                                            min(remaining, 1.0))
+                if not ready:
+                    if p.poll() is not None:
+                        raise RuntimeError(
+                            f"worker process exited rc={p.returncode} "
+                            "during startup")
+                    continue
+                line = p.stdout.readline()
+                if not line:
+                    raise RuntimeError("worker process died during startup")
+                urls.append(json.loads(line)["url"])
+                break
+    except BaseException:
+        for q in procs:  # no orphaned workers on a failed launch
+            q.kill()
+        raise
     cs = ClusterSession(session, urls)
     cs._procs = procs
     return cs

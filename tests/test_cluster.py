@@ -118,5 +118,25 @@ def test_cluster_scalar_subquery_and_nulls(cluster):
 
 def test_cluster_worker_failure_reported(cluster):
     session, cs = cluster
+    # coordinator-side planning error
     with pytest.raises(Exception):
         cs.sql("SELECT nonexistent_col FROM lineitem")
+    # genuine WORKER-side failure: a task whose fragment can't unpickle /
+    # execute must surface as FAILED -> RuntimeError at the coordinator
+    import pickle
+
+    import presto_tpu.parallel.cluster as CM
+
+    spec = CM.TaskSpec(
+        task_id="t_bad_fragment", fragment=pickle.dumps("not a plan"),
+        out_symbols=[], nworkers=1, windex=0, inputs=[])
+    url = cs.workers[0]
+    CM._http(f"{url}/v1/task", pickle.dumps(spec), method="POST")
+    with pytest.raises(RuntimeError, match="failed"):
+        cs._wait([(url, "t_bad_fragment")], timeout=30.0)
+    # buffers are cleaned up after successful queries (DELETE issued)
+    cs.sql("SELECT count(*) FROM nation")
+    import json as _json
+
+    st = CM._http(f"{url}/v1/task/t_bad_fragment/status")
+    assert _json.loads(st)["state"] == "FAILED"
