@@ -741,7 +741,8 @@ class Executor:
         return self.mem.would_exceed(est_bytes)
 
     def _make_spiller(self):
-        from presto_tpu.memory.spill import (FileSpiller, SpillSpaceTracker,
+        from presto_tpu.memory.spill import (FileSpiller, SpillCipher,
+                                             SpillSpaceTracker,
                                              default_spill_dir)
 
         path = self.session.properties.get("spill_path") or default_spill_dir()
@@ -749,7 +750,10 @@ class Executor:
         if tracker is None:
             tracker = self.session._spill_tracker = SpillSpaceTracker(
                 int(self.session.properties.get("max_spill_bytes", 64 << 30)))
-        return FileSpiller(path, tracker)
+        cipher = None
+        if self.session.properties.get("spill_encryption", False):
+            cipher = SpillCipher()  # ephemeral per-query key
+        return FileSpiller(path, tracker, cipher)
 
     def _record_spill(self, spiller) -> None:
         if self.monitor is not None:
@@ -1193,6 +1197,41 @@ class Executor:
             tuples = np.empty(n_groups, dtype=object)
             tuples[:] = [tuple(g) for g in groups]
             return _tuples_to_dict_column(tuples, nonempty, a.type)
+        if a.fn in ("approx_set", "merge", "qdigest_agg"):
+            # serializable sketch build/merge: host-side per group like
+            # array_agg (reference: ApproximateSetAggregation /
+            # MergeHyperLogLogAggregation / QuantileDigestAggregation);
+            # the vectorized approx_distinct/approx_percentile kernels
+            # remain the in-query fast path
+            if self.static:
+                raise StaticFallback(f"{a.fn} is dynamic-mode only")
+            from presto_tpu.functions import sketches as SK
+
+            gidh = np.asarray(gid)
+            vh = np.asarray(valid)
+            data = np.asarray(col.data)
+            if col.dictionary is not None:
+                data = col.dictionary.values[
+                    np.clip(data, 0, len(col.dictionary) - 1)]
+            elif col.type.is_decimal:
+                data = data.astype(np.float64) / (10 ** col.type.decimal_scale)
+            groups: list = [[] for _ in range(n_groups)]
+            for row in np.flatnonzero(vh):
+                g = int(gidh[row])
+                if 0 <= g < n_groups:
+                    v = data[row]
+                    groups[g].append(v.item() if hasattr(v, "item") else v)
+            blobs = np.empty(n_groups, dtype=object)
+            if a.fn == "approx_set":
+                blobs[:] = [SK.hll_from_values(g) for g in groups]
+            elif a.fn == "qdigest_agg":
+                blobs[:] = [SK.qdigest_from_values(g) for g in groups]
+            else:  # merge over serialized sketches
+                if a.type.name == "HLL":
+                    blobs[:] = [SK.hll_merge(g) for g in groups]
+                else:
+                    blobs[:] = [SK.qdigest_merge(g) for g in groups]
+            return _tuples_to_dict_column(blobs, nonempty, a.type)
         if a.fn in ("map_agg", "multimap_agg"):
             # ragged output, host-side like array_agg (reference:
             # MapAggregationFunction / MultimapAggregationFunction over a

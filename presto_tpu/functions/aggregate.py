@@ -78,6 +78,16 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         return T.DOUBLE
     if name == "array_agg":
         return T.array_of(arg_types[0])
+    if name == "approx_set":
+        return T.HLL
+    if name == "merge":
+        if arg_types[0].name not in ("HLL", "QDIGEST"):
+            raise TypeError("merge() takes an HLL or QDIGEST argument")
+        return arg_types[0]
+    if name == "qdigest_agg":
+        if not arg_types[0].is_numeric:
+            raise TypeError(f"qdigest_agg over {arg_types[0]}")
+        return T.qdigest_of(arg_types[0])
     if name == "map_agg":
         if len(arg_types) != 2:
             raise TypeError("map_agg takes (key, value)")
@@ -95,6 +105,7 @@ AGG_NAMES = {
     "bool_and", "bool_or", "every", "approx_distinct", "corr", "covar_samp",
     "covar_pop", "approx_percentile", "checksum", "min_by", "max_by",
     "geometric_mean", "array_agg", "map_agg", "multimap_agg",
+    "approx_set", "merge", "qdigest_agg",
 }
 
 

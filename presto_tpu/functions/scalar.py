@@ -1036,6 +1036,40 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
     frm = v.type
     if frm == to:
         return v
+    if frm.name in ("HLL", "QDIGEST") and to.is_string:
+        # export: serialized sketch -> base64 text (the role of casting
+        # HyperLogLog to varbinary in the reference)
+        import base64 as _b64
+
+        vals = v.dictionary.values if v.dictionary is not None \
+            else np.empty(0, dtype=object)
+        obj = np.asarray([_b64.b64encode(b).decode("ascii") for b in vals]
+                         or [""], dtype=object)
+        codes = jnp.clip(v.data, 0, max(len(obj) - 1, 0))
+        return normalize_dictionary(obj, ColVal(codes, v.valid, T.VARCHAR))
+    if frm.is_string and to.name in ("HLL", "QDIGEST"):
+        import base64 as _b64
+        import binascii
+
+        if isinstance(v.data, str):
+            v = _lit_to_dict_colval(v)
+        vals = v.dictionary.values
+        out = np.empty(max(len(vals), 1), dtype=object)
+        out[:] = [b""] * len(out)
+        bad = np.zeros(len(out), dtype=bool)
+        for i, s in enumerate(vals):
+            # per-entry: a malformed value NULLs that row, never the
+            # query (unreferenced dictionary entries must not poison it)
+            try:
+                out[i] = _b64.b64decode(str(s), validate=True)
+            except (binascii.Error, ValueError):
+                bad[i] = True
+        codes = jnp.clip(v.data, 0, len(out) - 1)
+        valid = v.valid
+        if bad.any():
+            ok = ~jnp.asarray(bad)[codes]
+            valid = ok if valid is None else (jnp.asarray(valid) & ok)
+        return _tuple_dict_normalize(out, ColVal(codes, valid, to), to)
     if frm.name in ("ARRAY", "MAP", "ROW") and to.name == frm.name:
         if frm.name == "ROW" and len(frm.params) != len(to.params):
             raise ValueError(
@@ -2389,3 +2423,94 @@ def _emit_row_field(args):
 
 
 register("row_field")((lambda args: None, _emit_row_field))
+
+
+# ---- sketch functions (HLL / QDIGEST) --------------------------------
+# Reference: operator/scalar/HyperLogLogFunctions.java (cardinality,
+# empty_approx_set) and QuantileDigestFunctions.java; sketches are
+# dictionary-encoded serialized byte strings (functions/sketches.py).
+
+
+def _sketch_dict_fn(name, fn, rt_fn, type_names):
+    def resolve(args):
+        if args and args[0].name in type_names:
+            return rt_fn(args)
+        return None
+
+    def emit(args):
+        col = args[0]
+        extra = []
+        for a in args[1:]:
+            if hasattr(a.data, "shape") and getattr(a.data, "ndim", 0) > 0:
+                raise NotImplementedError(f"{name} with non-constant arguments")
+            v = a.data
+            if a.dictionary is not None:
+                v = a.dictionary.values[int(v)]
+            elif hasattr(v, "item"):
+                v = v.item()
+            extra.append(v)
+        rt = rt_fn([a.type for a in args])
+        import struct as _struct
+
+        vals = []
+        for t in _arr_entries(col):
+            try:
+                vals.append(fn(t, *extra))
+            except (ValueError, IndexError, TypeError, _struct.error):
+                vals.append(None)  # malformed sketch -> NULL for that row
+        return _dict_lut_result(vals, col, rt)
+
+    return resolve, emit
+
+
+def _register_sketch_fns():
+    from presto_tpu.functions import sketches as SK
+
+    prev_card = REGISTRY["cardinality"]
+
+    def card_resolve(args):
+        if args and args[0].name in ("HLL", "QDIGEST"):
+            return T.BIGINT
+        return prev_card.resolve(args)
+
+    def card_emit(args):
+        if args[0].type.name in ("HLL", "QDIGEST"):
+            def card(blob):
+                if args[0].type.name == "HLL":
+                    return SK.hll_cardinality(blob)
+                return int(SK._qd_parse(blob)[1])
+
+            return _sketch_dict_fn("cardinality", card, lambda a: T.BIGINT,
+                                   ("HLL", "QDIGEST"))[1](args)
+        return prev_card.emit(args)
+
+    register("cardinality")((card_resolve, card_emit))
+
+    register("empty_approx_set")((
+        lambda args: T.HLL if not args else None,
+        lambda args: ColVal(jnp.asarray(0, jnp.int32), None, T.HLL,
+                            Dictionary(np.asarray([SK.hll_empty()],
+                                                  dtype=object)))))
+
+    register("value_at_quantile")((_sketch_dict_fn(
+        "value_at_quantile",
+        lambda blob, q: SK.qdigest_value_at_quantile(blob, float(q)),
+        lambda a: T.DOUBLE if a[0].params and a[0].params[0].is_floating
+        else (a[0].params[0] if a[0].params else T.DOUBLE),
+        ("QDIGEST",))))
+
+    register("values_at_quantiles")((_sketch_dict_fn(
+        "values_at_quantiles",
+        lambda blob, qs: tuple(SK.qdigest_value_at_quantile(blob, float(q))
+                               for q in qs),
+        lambda a: T.array_of(T.DOUBLE),
+        ("QDIGEST",))))
+
+    register("quantile_at_value")((_sketch_dict_fn(
+        "quantile_at_value",
+        lambda blob, v: SK.qdigest_quantile_at_value(blob, float(v)),
+        lambda a: T.DOUBLE,
+        ("QDIGEST",))))
+
+
+_register_sketch_fns()

@@ -1,0 +1,228 @@
+"""Serializable sketches: HyperLogLog and quantile digest.
+
+Reference parity: presto's HyperLogLog / P4HyperLogLog types over airlift
+sketches (`spi/type/HyperLogLogType`, `operator/aggregation/
+ApproximateSetAggregation` + `MergeHyperLogLogAggregation` +
+`HyperLogLogFunctions.cardinality`) and QDigest
+(`operator/aggregation/QuantileDigestAggregationFunction`,
+`operator/scalar/QuantileDigestFunctions.value_at_quantile`).
+
+These are the EXPORTABLE forms: byte strings that round-trip through
+query results, CAST to/from VARCHAR (base64), and merge across
+queries/nodes — the role airlift's serialized sketches play on the wire.
+The in-query vectorized approx_distinct/approx_percentile paths
+(exec/kernels.py) stay separate: they never materialize per-row sketch
+objects, which is the TPU-friendly formulation; these host-side sketches
+exist for the persist/merge-later workflow.
+
+Formats (little-endian):
+  HLL:     'PTH1' | m u16 | registers u8[m]
+  QDIGEST: 'PTQ1' | k u16 | n u64 | centroids (value f64, weight f64)[k]
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import native
+
+HLL_M = 1024  # ~3.25% standard error (1.04/sqrt(m))
+QDIGEST_K = 200  # centroid budget (t-digest-like accuracy in the tails)
+
+_HLL_MAGIC = b"PTH1"
+_QD_MAGIC = b"PTQ1"
+
+
+# ---------------------------------------------------------------------------
+# value hashing (must be stable across processes: xxh64 of a canonical
+# byte encoding per type family)
+# ---------------------------------------------------------------------------
+
+
+def hash_value(v) -> int:
+    import hashlib
+
+    if isinstance(v, bool) or isinstance(v, np.bool_):
+        enc = b"\x01" if v else b"\x00"
+    elif isinstance(v, (int, np.integer)):
+        enc = struct.pack("<q", int(v))
+    elif isinstance(v, (float, np.floating)):
+        enc = struct.pack("<d", float(v))
+    elif isinstance(v, bytes):
+        enc = v
+    else:
+        enc = str(v).encode("utf-8")
+    # blake2b, NOT native.xxh64: the native lib's fallback is 32-bit
+    # (crc32), which would starve the rho computation of bits and make
+    # sketches built on different hosts silently incompatible
+    return struct.unpack(
+        "<Q", hashlib.blake2b(enc, digest_size=8).digest())[0]
+
+
+# ---------------------------------------------------------------------------
+# HyperLogLog
+# ---------------------------------------------------------------------------
+
+
+def hll_empty(m: int = HLL_M) -> bytes:
+    return _HLL_MAGIC + struct.pack("<H", m) + b"\x00" * m
+
+
+def hll_from_values(values: Iterable) -> bytes:
+    m = HLL_M
+    log2m = m.bit_length() - 1
+    reg = np.zeros(m, dtype=np.uint8)
+    for v in values:
+        if v is None:
+            continue
+        h = hash_value(v)
+        j = h & (m - 1)
+        w = h >> log2m  # remaining 54 bits
+        rho = (64 - log2m) - w.bit_length() + 1
+        if rho > reg[j]:
+            reg[j] = rho
+    return _HLL_MAGIC + struct.pack("<H", m) + reg.tobytes()
+
+
+def _hll_registers(blob: bytes) -> np.ndarray:
+    if len(blob) < 6 or blob[:4] != _HLL_MAGIC:
+        raise ValueError("not a serialized HyperLogLog")
+    (m,) = struct.unpack("<H", blob[4:6])
+    reg = np.frombuffer(blob[6:6 + m], dtype=np.uint8)
+    if len(reg) != m:
+        raise ValueError("truncated HyperLogLog")
+    return reg
+
+
+def hll_merge(blobs: Iterable[bytes]) -> bytes:
+    out: Optional[np.ndarray] = None
+    m = HLL_M
+    for b in blobs:
+        if b is None:
+            continue
+        reg = _hll_registers(b)
+        if out is None:
+            out = reg.copy()
+            m = len(reg)
+        else:
+            if len(reg) != m:
+                raise ValueError("cannot merge HLLs of different precisions")
+            out = np.maximum(out, reg)
+    if out is None:
+        return hll_empty()
+    return _HLL_MAGIC + struct.pack("<H", m) + out.tobytes()
+
+
+def hll_cardinality(blob: bytes) -> int:
+    reg = _hll_registers(blob).astype(np.float64)
+    m = len(reg)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    E = alpha * m * m / np.sum(2.0 ** (-reg))
+    zeros = int(np.sum(reg == 0))
+    if E <= 2.5 * m and zeros > 0:
+        E = m * np.log(m / zeros)
+    return int(round(E))
+
+
+# ---------------------------------------------------------------------------
+# quantile digest (t-digest-flavored: merge-by-size compression keeps the
+# tails accurate; reference behavior of QuantileDigest within its error
+# bound)
+# ---------------------------------------------------------------------------
+
+
+def _qd_compress(cent: List[Tuple[float, float]],
+                 k: int = QDIGEST_K) -> List[Tuple[float, float]]:
+    cent = sorted(cent)
+    while len(cent) > k:
+        # merge the adjacent pair with the smallest combined weight,
+        # preferring the middle (keeps tail centroids sharp)
+        n = len(cent)
+        best, best_cost = 1, float("inf")
+        for i in range(1, n):
+            qmid = (i / n - 0.5)
+            cost = (cent[i - 1][1] + cent[i][1]) * (1.0 + 8.0 * qmid * qmid)
+            if cost < best_cost:
+                best, best_cost = i, cost
+        (v1, w1), (v2, w2) = cent[best - 1], cent[best]
+        cent[best - 1:best + 1] = [((v1 * w1 + v2 * w2) / (w1 + w2),
+                                    w1 + w2)]
+    return cent
+
+
+def qdigest_from_values(values: Iterable) -> bytes:
+    vals = np.asarray([float(v) for v in values if v is not None],
+                      dtype=np.float64)
+    if len(vals) == 0:
+        return _QD_MAGIC + struct.pack("<HQ", 0, 0)
+    vals.sort()
+    # bucket into ~4k evenly-populated runs first (bounds the python loop)
+    chunks = np.array_split(vals, min(len(vals), 20 * QDIGEST_K))
+    cent = [(float(c.mean()), float(len(c))) for c in chunks if len(c)]
+    cent = _qd_compress(cent)
+    return _qd_serialize(cent, len(vals))
+
+
+def _qd_serialize(cent: List[Tuple[float, float]], n: int) -> bytes:
+    out = [_QD_MAGIC, struct.pack("<HQ", len(cent), n)]
+    for v, w in cent:
+        out.append(struct.pack("<dd", v, w))
+    return b"".join(out)
+
+
+def _qd_parse(blob: bytes) -> Tuple[List[Tuple[float, float]], int]:
+    if len(blob) < 14 or blob[:4] != _QD_MAGIC:
+        raise ValueError("not a serialized qdigest")
+    k, n = struct.unpack("<HQ", blob[4:14])
+    if len(blob) < 14 + 16 * k:
+        raise ValueError("truncated qdigest")
+    cent = []
+    off = 14
+    for _ in range(k):
+        v, w = struct.unpack("<dd", blob[off:off + 16])
+        cent.append((v, w))
+        off += 16
+    return cent, n
+
+
+def qdigest_merge(blobs: Iterable[bytes]) -> bytes:
+    cent: List[Tuple[float, float]] = []
+    n = 0
+    for b in blobs:
+        if b is None:
+            continue
+        c, cn = _qd_parse(b)
+        cent.extend(c)
+        n += cn
+    return _qd_serialize(_qd_compress(cent), n)
+
+
+def qdigest_value_at_quantile(blob: bytes, q: float) -> Optional[float]:
+    cent, n = _qd_parse(blob)
+    if not cent:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    total = sum(w for _, w in cent)
+    target = q * total
+    cum = 0.0
+    for v, w in cent:
+        cum += w
+        if cum >= target:
+            return v
+    return cent[-1][0]
+
+
+def qdigest_quantile_at_value(blob: bytes, value: float) -> Optional[float]:
+    cent, n = _qd_parse(blob)
+    if not cent:
+        return None
+    total = sum(w for _, w in cent)
+    cum = 0.0
+    for v, w in cent:
+        if v > value:
+            break
+        cum += w
+    return min(cum / total, 1.0)

@@ -47,20 +47,49 @@ class SpillSpaceTracker:
         self.used = max(0, self.used - bytes_)
 
 
-class FileSpiller:
-    """Spills Batches to .npz files and reads them back (reference:
-    FileSingleStreamSpiller; encryption (AesSpillCipher) is out of scope
-    for v1 — spill dirs are assumed private, as the reference defaults)."""
+class SpillCipher:
+    """AES-256-CTR over whole spill files with an ephemeral per-query key
+    (reference: spiller/AesSpillCipher.java — the key lives only in
+    memory, so spilled data is unreadable after the process exits)."""
 
-    def __init__(self, directory: str, tracker: Optional[SpillSpaceTracker] = None):
+    def __init__(self):
+        self.key = os.urandom(32)
+
+    def _cipher(self, nonce: bytes):
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes)
+
+        return Cipher(algorithms.AES(self.key), modes.CTR(nonce))
+
+    def encrypt(self, data: bytes) -> bytes:
+        nonce = os.urandom(16)
+        enc = self._cipher(nonce).encryptor()
+        return nonce + enc.update(data) + enc.finalize()
+
+    def decrypt(self, data: bytes) -> bytes:
+        dec = self._cipher(data[:16]).decryptor()
+        return dec.update(data[16:]) + dec.finalize()
+
+
+class FileSpiller:
+    """Spills Batches to PTPG files and reads them back (reference:
+    FileSingleStreamSpiller); pass a SpillCipher to encrypt files at rest
+    (spill_encryption session property)."""
+
+    def __init__(self, directory: str,
+                 tracker: Optional[SpillSpaceTracker] = None,
+                 cipher: Optional[SpillCipher] = None):
         self.dir = directory
         self.tracker = tracker
+        self.cipher = cipher
         self.files: List[Tuple[str, int]] = []
         self._meta: Dict[str, dict] = {}
         os.makedirs(directory, exist_ok=True)
 
     def spill(self, batch: Batch) -> str:
         """Write a compacted host copy of the batch; returns a handle."""
+        import io
+
         arrays: Dict[str, np.ndarray] = {}
         meta: Dict[str, tuple] = {}
         sel = np.asarray(batch.sel)
@@ -71,8 +100,14 @@ class FileSpiller:
                 arrays[f"v_{name}"] = np.asarray(c.valid)[sel]
             meta[name] = (c.type, c.dictionary)
         path = os.path.join(self.dir, f"spill_{uuid.uuid4().hex}.ptpg")
-        with open(path, "wb") as f:
-            serde.write_stream(f, arrays)
+        if self.cipher is not None:
+            buf = io.BytesIO()
+            serde.write_stream(buf, arrays)
+            with open(path, "wb") as f:
+                f.write(self.cipher.encrypt(buf.getvalue()))
+        else:
+            with open(path, "wb") as f:
+                serde.write_stream(f, arrays)
         size = os.path.getsize(path)
         if self.tracker is not None:
             try:
@@ -85,9 +120,16 @@ class FileSpiller:
         return path
 
     def unspill(self, handle: str) -> Batch:
+        import io
+
         meta = self._meta[handle]
-        with open(handle, "rb") as f:
-            z = serde.read_stream(f)
+        if self.cipher is not None:
+            with open(handle, "rb") as f:
+                z = serde.read_stream(
+                    io.BytesIO(self.cipher.decrypt(f.read())))
+        else:
+            with open(handle, "rb") as f:
+                z = serde.read_stream(f)
         cols = {}
         n = 0
         for name, (typ, dictionary) in meta.items():

@@ -45,3 +45,39 @@ def dispatch(listeners, method: str, event) -> None:
             getattr(lis, method)(event)
         except Exception:
             pass  # listener failures never fail the query (reference behavior)
+
+
+class FileAuditLogListener(EventListener):
+    """JSON-lines audit sink (reference: the event-listener plugins used
+    for query audit logs — http-event-listener / custom sinks on
+    QueryCompletedEvent).  One line per event, flushed immediately so the
+    log survives crashes; attach via session.add_event_listener."""
+
+    def __init__(self, path: str, user: str = ""):
+        self.path = path
+        self.user = user
+
+    def _write(self, record: dict) -> None:
+        import json
+
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+            f.flush()
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        self._write({"event": "query_created", "query_id": event.query_id,
+                     "user": self.user, "sql": event.sql,
+                     "create_time": event.create_time})
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        s = event.stats
+        self._write({
+            "event": "query_completed", "query_id": event.query_id,
+            "user": self.user, "sql": event.sql, "state": event.state,
+            "error": event.error,
+            "execution_mode": s.execution_mode,
+            "output_rows": int(s.output_rows),
+            "total_ms": s.total_ns / 1e6,
+            "peak_memory_bytes": int(s.peak_memory_bytes),
+            "spilled_bytes": int(s.spilled_bytes),
+        })

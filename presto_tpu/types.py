@@ -172,7 +172,15 @@ _PHYSICAL = {
     "ARRAY": np.int32,  # dictionary code over unique element-tuples
     "MAP": np.int32,  # dictionary code over unique pair-tuples
     "ROW": np.int32,  # dictionary code over unique field-tuples
+    "HLL": np.int32,  # dictionary code over serialized sketch bytes
+    "QDIGEST": np.int32,  # dictionary code over serialized sketch bytes
 }
+
+HLL = Type("HLL")
+
+
+def qdigest_of(elem: Type) -> Type:
+    return Type("QDIGEST", (elem,))
 
 
 def parse_type(text: str) -> Type:
@@ -186,6 +194,8 @@ def parse_type(text: str) -> Type:
         inner = rest.rstrip()
         if inner.endswith(")"):
             inner = inner[:-1]
+        if base == "QDIGEST":
+            return qdigest_of(parse_type(inner))
         if base in ("ARRAY", "MAP", "ROW"):
             parts = _split_type_args(inner)
             if base == "ARRAY":
@@ -231,6 +241,9 @@ def parse_type(text: str) -> Type:
         "DATE": DATE,
         "TIMESTAMP": TIMESTAMP,
         "DECIMAL": decimal(18, 0),
+        "HLL": HLL,
+        "HYPERLOGLOG": HLL,
+        "QDIGEST": qdigest_of(DOUBLE),
     }
     if t in aliases:
         return aliases[t]

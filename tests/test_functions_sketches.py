@@ -308,3 +308,53 @@ def test_quantified_three_valued_logic(tpch_catalog_tiny):
     assert s.sql(
         "SELECT count(*) FROM (VALUES 1) WHERE NOT "
         "(1 < ALL (SELECT v FROM (VALUES 2, NULL) t(v)))").rows == [(0,)]
+
+
+def test_exportable_hll_sketches(tpch_catalog_tiny):
+    """Serializable HLL: approx_set/merge/cardinality + base64 export
+    (reference: HyperLogLogFunctions + MergeHyperLogLogAggregation)."""
+    import presto_tpu as pt
+
+    s = pt.connect(tpch_catalog_tiny)
+    est, exact = s.sql(
+        "SELECT cardinality(approx_set(c_custkey)), count(DISTINCT c_custkey)"
+        " FROM customer").rows[0]
+    assert abs(est - exact) <= 0.1 * exact
+    # merge of per-group sketches == sketch of the union
+    merged = s.sql(
+        "SELECT cardinality(merge(h)) FROM (SELECT c_nationkey, "
+        "approx_set(c_custkey) AS h FROM customer GROUP BY c_nationkey)"
+    ).rows[0][0]
+    assert merged == est
+    # export through text and back
+    rt = s.sql(
+        "SELECT cardinality(CAST(t AS HLL)) FROM (SELECT "
+        "CAST(approx_set(c_custkey) AS VARCHAR) AS t FROM customer)"
+    ).rows[0][0]
+    assert rt == est
+    assert s.sql("SELECT cardinality(empty_approx_set())").rows == [(0,)]
+
+
+def test_qdigest(tpch_catalog_tiny):
+    """qdigest_agg / value_at_quantile / quantile_at_value / merge
+    (reference: QuantileDigestAggregationFunction + Functions)."""
+    import presto_tpu as pt
+
+    s = pt.connect(tpch_catalog_tiny)
+    med, ref = s.sql(
+        "SELECT value_at_quantile(qdigest_agg(o_totalprice), 0.5), "
+        "approx_percentile(o_totalprice, 0.5) FROM orders").rows[0]
+    assert abs(med - ref) <= 0.05 * ref
+    q = s.sql(
+        "SELECT quantile_at_value(qdigest_agg(o_totalprice), "
+        f"{ref}) FROM orders").rows[0][0]
+    assert 0.4 <= q <= 0.6
+    vs = s.sql(
+        "SELECT values_at_quantiles(qdigest_agg(o_totalprice), "
+        "ARRAY[0.1, 0.9]) FROM orders").rows[0][0]
+    assert vs[0] < med < vs[1]
+    merged = s.sql(
+        "SELECT value_at_quantile(merge(d), 0.5) FROM (SELECT "
+        "o_orderpriority, qdigest_agg(o_totalprice) AS d FROM orders "
+        "GROUP BY o_orderpriority)").rows[0][0]
+    assert abs(merged - ref) <= 0.08 * ref

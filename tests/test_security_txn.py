@@ -234,3 +234,70 @@ def test_explicit_set_outranks_property_rules():
     s.set("spill_enabled", True)     # explicit user choice
     s.apply_property_manager()       # rules must NOT clobber it
     assert s.properties["spill_enabled"] is True
+
+
+def test_spill_encryption_roundtrip(tmp_path):
+    """AES-256-CTR spill files (reference: AesSpillCipher) decrypt only
+    through the in-memory cipher."""
+    import numpy as np
+
+    from presto_tpu import types as T
+    from presto_tpu.batch import batch_from_numpy
+    from presto_tpu.memory.spill import FileSpiller, SpillCipher
+
+    b = batch_from_numpy({"a": np.arange(100, dtype=np.int64)},
+                         {"a": T.BIGINT})
+    sp = FileSpiller(str(tmp_path), cipher=SpillCipher())
+    h = sp.spill(b)
+    # at rest: not a readable PTPG frame
+    raw = open(h, "rb").read()
+    assert b"PTPG" not in raw[:64]
+    back = sp.unspill(h)
+    assert np.asarray(back.columns["a"].data).tolist() == list(range(100))
+    # a different cipher (key) cannot decrypt
+    sp2 = FileSpiller(str(tmp_path), cipher=SpillCipher())
+    sp2._meta[h] = sp._meta[h]
+    try:
+        other = sp2.unspill(h)
+        assert False, "decrypt with wrong key should fail"
+    except Exception:
+        pass
+    sp.close()
+
+
+def test_spill_encryption_via_query(tpch_catalog_tiny, tmp_path):
+    import presto_tpu as pt
+
+    s = pt.connect(tpch_catalog_tiny)
+    s.set("spill_encryption", True)
+    s.set("spill_path", str(tmp_path))
+    s.set("spill_trigger_rows", 100)  # force the Grace-hash spill path
+    s.set("execution_mode", "dynamic")  # spilling lives in dynamic mode
+    r = s.sql("SELECT count(*) FROM orders o, customer c "
+              "WHERE o.o_custkey = c.c_custkey").rows
+    assert s.last_stats.spilled_bytes > 0  # the cipher path actually ran
+    r2 = s.sql("SELECT count(*) FROM orders").rows
+    assert r == r2  # FK join preserves row count
+
+
+def test_file_audit_log(tpch_catalog_tiny, tmp_path):
+    import json
+
+    import presto_tpu as pt
+    from presto_tpu.observe.events import FileAuditLogListener
+
+    s = pt.connect(tpch_catalog_tiny)
+    path = str(tmp_path / "audit.jsonl")
+    s.add_event_listener(FileAuditLogListener(path, user=s.user))
+    s.sql("SELECT count(*) FROM nation")
+    try:
+        s.sql("SELECT definitely_missing FROM nation")
+    except Exception:
+        pass
+    lines = [json.loads(x) for x in open(path)]
+    events = [(r["event"], r.get("state")) for r in lines]
+    assert ("query_created", None) in events
+    assert ("query_completed", "FINISHED") in events
+    assert ("query_completed", "FAILED") in events
+    done = [r for r in lines if r.get("state") == "FINISHED"]
+    assert done[0]["output_rows"] == 1 and done[0]["user"] == "user"
