@@ -17,6 +17,7 @@ from __future__ import annotations
 import re
 from typing import Callable, Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -286,13 +287,35 @@ def _decimal_to_double(v: ColVal) -> ColVal:
     return ColVal(x, v.valid, T.DOUBLE)
 
 
+def _dec_shadow_checkable(*vals) -> bool:
+    """Whether an int64-overflow shadow check is affordable here: data is
+    concrete (not a tracer) and not resident on an accelerator — pulling
+    a column off a TPU to guard an overflow would serialize the hot path."""
+    for x in vals:
+        if isinstance(x, jax.core.Tracer):
+            return False
+        if isinstance(x, jax.Array):
+            try:
+                if any(d.platform != "cpu" for d in x.devices()):
+                    return False
+            except Exception:
+                return False
+    return True
+
+
 def _rescale_dec(data, frm_scale: int, to_scale: int):
     """Rescale a scaled-int64 decimal; rounds half away from zero when
     reducing scale (Presto decimal rounding)."""
     if to_scale == frm_scale:
         return data
     if to_scale > frm_scale:
-        return data * (10 ** (to_scale - frm_scale))
+        factor = 10 ** (to_scale - frm_scale)
+        if _dec_shadow_checkable(data):
+            shadow = np.abs(np.asarray(data, dtype=np.float64)) * factor
+            if shadow.size and np.nanmax(shadow) >= 2.0 ** 62:
+                raise ValueError(
+                    "DECIMAL overflow: rescale exceeds 19 significant digits")
+        return data * factor
     f = 10 ** (frm_scale - to_scale)
     q = jnp.abs(data) + f // 2
     return jnp.sign(data) * (q // f)
@@ -318,6 +341,19 @@ def _emit_decimal_arith(name, a: ColVal, b: ColVal, out_t: T.Type, valid):
             r = jnp.sign(x) * (jnp.abs(x) % jnp.abs(y))
         return ColVal(r, valid, out_t)
     if name == "mul":
+        # int64 unscaled products wrap silently; a float64 shadow detects
+        # magnitudes past ~19 digits (long-decimal storage limit) when the
+        # data is host-resident — under jit tracing or on an accelerator
+        # the check is skipped (ingest/cast boundaries still guard)
+        if _dec_shadow_checkable(x, y, valid):
+            shadow = np.asarray(x).astype(np.float64) \
+                * np.asarray(y).astype(np.float64)
+            if valid is not None and hasattr(valid, "shape") \
+                    and getattr(valid, "ndim", 0) > 0:
+                shadow = np.where(np.asarray(valid), shadow, 0.0)
+            if shadow.size and np.nanmax(np.abs(shadow)) >= 2.0 ** 62:
+                raise ValueError(
+                    "DECIMAL overflow: unscaled product exceeds 19 digits")
         r = _rescale_dec(x * y, sa + sb, so)  # true product scale is sa+sb
         return ColVal(r, valid, out_t)
     raise AssertionError(name)
@@ -913,7 +949,14 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool) -> ColVal:
         if frm.is_floating:
             scaled = x.astype(jnp.float64) * (10 ** s)
             r = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
-            return ColVal(r.astype(jnp.int64), v.valid, to)
+            nan = jnp.isnan(scaled)  # e.g. TRY_CAST parse failures
+            r = jnp.where(nan, 0.0, r)
+            valid = v.valid
+            if hasattr(nan, "shape") and (getattr(nan, "ndim", 0) > 0
+                                          or bool(jnp.any(nan))):
+                valid = (~nan) if valid is None else (jnp.asarray(valid)
+                                                      & ~nan)
+            return ColVal(r.astype(jnp.int64), valid, to)
         raise NotImplementedError(f"CAST {frm} -> {to}")
     # from decimal
     s = frm.decimal_scale
@@ -1036,6 +1079,28 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
     frm = v.type
     if frm == to:
         return v
+    if frm.is_string and to.is_string:
+        if to.name == "JSON" and frm.name != "JSON":
+            # reference JsonType cast: the varchar becomes a JSON *string
+            # value* (quoted/escaped), not a parsed document — parsing is
+            # json_parse's job
+            return _host_string_transform(
+                v if not isinstance(v.data, str) else _lit_to_dict_colval(v),
+                lambda s: _json_mod.dumps(str(s)), T.JSON)
+        if frm.name == "JSON" and to.name != "JSON":
+            # JSON string values unquote; other documents render compact
+            def unwrap(s):
+                try:
+                    doc = _json_mod.loads(str(s))
+                except ValueError:
+                    return str(s)
+                return doc if isinstance(doc, str) else \
+                    _json_mod.dumps(doc, separators=(",", ":"))
+
+            src = v if not isinstance(v.data, str) else _lit_to_dict_colval(v)
+            return _host_string_transform(src, unwrap, T.VARCHAR)
+        # VARCHAR <-> CHAR: same physical form, re-tag only
+        return ColVal(v.data, v.valid, to, v.dictionary)
     if frm.name in ("HLL", "QDIGEST") and to.is_string:
         # export: serialized sketch -> base64 text (the role of casting
         # HyperLogLog to varbinary in the reference)
@@ -1102,21 +1167,42 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
         # parse numerics via dictionary LUT
         def parse(x):
             try:
-                return float(x)
+                f = float(x)
             except ValueError:
                 if safe:
                     return np.nan
                 raise
+            if to.is_decimal and \
+                    abs(f) * (10 ** to.decimal_scale) >= 2.0 ** 62:
+                # int64 unscaled storage limit (~19 digits); raise rather
+                # than silently wrapping (long-decimal Int128 boundary)
+                if safe:
+                    return np.nan
+                raise ValueError(
+                    f"DECIMAL overflow: '{x}' exceeds 19 significant digits")
+            return f
         lit = _as_string_literal(v)
         if lit is not None:
             val = parse(lit)
+            if val != val:  # safe-parse failure -> typed NULL
+                return emit_cast(ColVal(False, False, T.UNKNOWN), to, safe)
             if to.is_integer:
-                val = int(val)
+                return ColVal(int(val), v.valid, to)
+            if to.is_decimal:  # scale to the unscaled int64 representation
+                return _emit_cast_decimal(
+                    ColVal(val, v.valid, T.DOUBLE), to, safe)
             return ColVal(val, v.valid, to)
-        lut = jnp.asarray(np.asarray([parse(x) for x in v.dictionary.values],
-                                     dtype=np.float64))
+        lut_np = np.asarray([parse(x) for x in v.dictionary.values],
+                            dtype=np.float64)
+        lut = jnp.asarray(lut_np)
         data = lut[jnp.clip(v.data, 0, len(v.dictionary) - 1)]
-        return emit_cast(ColVal(data, v.valid, T.DOUBLE), to, safe)
+        valid = v.valid
+        if safe and np.isnan(lut_np).any():
+            # rows referencing unparseable entries become NULL, not 0
+            bad = jnp.asarray(np.isnan(lut_np))[
+                jnp.clip(v.data, 0, len(v.dictionary) - 1)]
+            valid = (~bad) if valid is None else (jnp.asarray(valid) & ~bad)
+        return emit_cast(ColVal(data, valid, T.DOUBLE), to, safe)
     if to.is_decimal or frm.is_decimal:
         return _emit_cast_decimal(v, to, safe)
     if frm == T.UNKNOWN:
@@ -1529,7 +1615,12 @@ register("json_extract_scalar")((_str_transform("json_extract_scalar",
 register("json_format")((_str_transform(
     "json_format", lambda v: _json_mod.dumps(_json_mod.loads(v),
                                              separators=(",", ":")))))
-register("json_parse")((_str_transform("json_parse", lambda v: v)))
+# json_parse returns the distinct JSON type in canonical form; invalid
+# input raises (reference: JsonFunctions.jsonParse over JsonType)
+register("json_parse")((_str_transform(
+    "json_parse",
+    lambda v: _json_mod.dumps(_json_mod.loads(v), separators=(",", ":")),
+    T.JSON)))
 register("json_array_length")((_str_transform(
     "json_array_length", _json_array_length, T.BIGINT)))
 register("json_size")((_str_transform("json_size", _json_size, T.BIGINT)))

@@ -60,7 +60,9 @@ class Type:
 
     @property
     def is_string(self) -> bool:
-        return self.name in ("VARCHAR", "CHAR")
+        # JSON is a distinct logical type (spi/type/JsonType) but shares
+        # the dictionary-encoded physical form and string compute paths
+        return self.name in ("VARCHAR", "CHAR", "JSON")
 
     @property
     def is_temporal(self) -> bool:
@@ -97,12 +99,18 @@ DATE = Type("DATE")
 TIMESTAMP = Type("TIMESTAMP")
 INTERVAL_DAY_TIME = Type("INTERVAL_DAY_TIME")
 INTERVAL_YEAR_MONTH = Type("INTERVAL_YEAR_MONTH")
+JSON = Type("JSON")
 UNKNOWN = Type("UNKNOWN")  # the NULL literal's type
 
 
 def decimal(precision: int, scale: int) -> Type:
-    if precision > 18:
-        raise NotImplementedError("long DECIMAL (>18 digits) not supported yet")
+    """DECIMAL(p<=38, s).  Declared precisions up to the reference's
+    Int128 limit are accepted; the unscaled value is stored as int64, so
+    actual magnitudes are bounded by ~9.2e18 (19 significant digits) —
+    ingest/arithmetic beyond that raises rather than silently wrapping
+    (reference: spi/type/DecimalType long decimals over Int128)."""
+    if precision > 38:
+        raise ValueError(f"DECIMAL precision {precision} exceeds 38")
     return Type("DECIMAL", (precision, scale))
 
 
@@ -164,6 +172,7 @@ _PHYSICAL = {
     "DECIMAL": np.int64,
     "VARCHAR": np.int32,  # dictionary code
     "CHAR": np.int32,  # dictionary code
+    "JSON": np.int32,  # dictionary code
     "DATE": np.int32,
     "TIMESTAMP": np.int64,
     "INTERVAL_DAY_TIME": np.int64,
@@ -241,6 +250,7 @@ def parse_type(text: str) -> Type:
         "DATE": DATE,
         "TIMESTAMP": TIMESTAMP,
         "DECIMAL": decimal(18, 0),
+        "JSON": JSON,
         "HLL": HLL,
         "HYPERLOGLOG": HLL,
         "QDIGEST": qdigest_of(DOUBLE),
@@ -295,7 +305,7 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
                     a.decimal_precision - a.decimal_scale,
                     b.decimal_precision - b.decimal_scale,
                 )
-                return decimal(min(intd + scale, 18), scale)
+                return decimal(min(intd + scale, 38), scale)
             return hi  # integer + decimal -> decimal
         if hi.is_floating and lo.is_decimal:
             return DOUBLE

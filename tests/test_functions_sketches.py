@@ -358,3 +358,43 @@ def test_qdigest(tpch_catalog_tiny):
         "o_orderpriority, qdigest_agg(o_totalprice) AS d FROM orders "
         "GROUP BY o_orderpriority)").rows[0][0]
     assert abs(merged - ref) <= 0.08 * ref
+
+
+def test_json_distinct_type(tpch_catalog_tiny):
+    """JSON as a distinct logical type (reference: spi/type/JsonType):
+    json_parse canonicalizes, json_format renders, CAST re-tags."""
+    import presto_tpu as pt
+
+    s = pt.connect(tpch_catalog_tiny)
+    assert s.sql("SELECT json_parse('{\"b\": 1,  \"a\": [1, 2]}')").rows \
+        == [('{"b":1,"a":[1,2]}',)]
+    assert s.sql(
+        "SELECT json_extract_scalar(json_parse('{\"a\": 5}'), '$.a')"
+    ).rows == [("5",)]
+    # CAST wraps the varchar as a JSON *string value* (reference JsonType
+    # cast); json_parse is the way to parse a document
+    assert s.sql("SELECT CAST('abc' AS JSON)").rows == [('"abc"',)]
+    assert s.sql("SELECT CAST(CAST('abc' AS JSON) AS VARCHAR)").rows \
+        == [("abc",)]
+    assert s.sql("SELECT is_json_scalar(json_parse('3'))").rows == [(True,)]
+    with pytest.raises(Exception):
+        s.sql("SELECT json_parse('{bad json')")
+
+
+def test_wide_decimal_declarations(tpch_catalog_tiny):
+    """DECIMAL up to precision 38 declared; int64 unscaled storage with
+    overflow errors past ~19 significant digits (the Int128 boundary is
+    rejected, never silently wrapped)."""
+    import presto_tpu as pt
+
+    s = pt.connect(tpch_catalog_tiny)
+    assert s.sql("SELECT CAST('12345678901234.56' AS DECIMAL(38,2)) "
+                 "+ CAST('0.44' AS DECIMAL(38,2))").rows \
+        == [(12345678901235.0,)]
+    assert s.sql(
+        "SELECT TRY_CAST('123456789012345678901234.5' AS DECIMAL(38,2))"
+    ).rows == [(None,)]
+    with pytest.raises(Exception):
+        s.sql("SELECT CAST('123456789012345678901234.5' AS DECIMAL(38,2))")
+    with pytest.raises(Exception):
+        s.sql("SELECT CAST(4e9 AS DECIMAL(38,2)) * CAST(4e9 AS DECIMAL(38,2))")
