@@ -152,12 +152,11 @@ def column_from_numpy(data: np.ndarray, typ: Type, valid: Optional[np.ndarray] =
     if typ.is_decimal and data.dtype.kind == "f":
         # host floats (e.g. a decoded decimal column re-ingested via
         # CTAS/INSERT) carry the unscaled value; rescale, don't truncate
-        data = np.round(data * (10 ** typ.decimal_scale))
-        with np.errstate(invalid="ignore"):
-            if data.size and np.nanmax(np.abs(data)) >= 2.0 ** 62:
-                raise ValueError(
-                    "DECIMAL overflow: value exceeds the int64 unscaled "
-                    "range (~19 significant digits)")
+        scaled = data * (10 ** typ.decimal_scale)
+        from presto_tpu.types import check_decimal_overflow
+
+        check_decimal_overflow(scaled, valid, "ingested value")
+        data = np.round(scaled)
     data = np.ascontiguousarray(data, dtype=typ.numpy_dtype())
     v = None if valid is None else jnp.asarray(valid, dtype=bool)
     return Column(jnp.asarray(data), v, typ, dictionary)

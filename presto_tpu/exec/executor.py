@@ -320,8 +320,10 @@ def _insert_into(session, stmt: ast.InsertInto) -> int:
                 f"cannot insert {have} into {tgt} ({want})")
         if want.is_decimal and a.dtype.kind == "f":
             # decoded decimals arrive as unscaled floats; rescale like
-            # batch.column_from_numpy, never truncate
-            a = np.round(a * (10 ** want.decimal_scale)).astype(np.int64)
+            # batch.column_from_numpy, never truncate (and never wrap)
+            scaled = a * (10 ** want.decimal_scale)
+            T.check_decimal_overflow(scaled, what="inserted value")
+            a = np.round(scaled).astype(np.int64)
         elif not want.is_string and a.dtype != want.numpy_dtype() \
                 and a.dtype != object:
             a = a.astype(want.numpy_dtype())

@@ -303,18 +303,18 @@ def _dec_shadow_checkable(*vals) -> bool:
     return True
 
 
-def _rescale_dec(data, frm_scale: int, to_scale: int):
+def _rescale_dec(data, frm_scale: int, to_scale: int, valid=None):
     """Rescale a scaled-int64 decimal; rounds half away from zero when
     reducing scale (Presto decimal rounding)."""
     if to_scale == frm_scale:
         return data
     if to_scale > frm_scale:
         factor = 10 ** (to_scale - frm_scale)
-        if _dec_shadow_checkable(data):
-            shadow = np.abs(np.asarray(data, dtype=np.float64)) * factor
-            if shadow.size and np.nanmax(shadow) >= 2.0 ** 62:
-                raise ValueError(
-                    "DECIMAL overflow: rescale exceeds 19 significant digits")
+        if _dec_shadow_checkable(data, valid):
+            T.check_decimal_overflow(
+                np.asarray(data, dtype=np.float64) * factor,
+                None if valid is None else np.asarray(valid),
+                "rescaled value")
         return data * factor
     f = 10 ** (frm_scale - to_scale)
     q = jnp.abs(data) + f // 2
@@ -331,8 +331,8 @@ def _emit_decimal_arith(name, a: ColVal, b: ColVal, out_t: T.Type, valid):
     x = jnp.asarray(a.data).astype(jnp.int64) if not a.is_scalar else jnp.int64(a.data)
     y = jnp.asarray(b.data).astype(jnp.int64) if not b.is_scalar else jnp.int64(b.data)
     if name in ("add", "sub", "mod"):
-        x = _rescale_dec(x, sa, so)
-        y = _rescale_dec(y, sb, so)
+        x = _rescale_dec(x, sa, so, a.valid)
+        y = _rescale_dec(y, sb, so, b.valid)
         if name == "add":
             r = x + y
         elif name == "sub":
@@ -346,14 +346,12 @@ def _emit_decimal_arith(name, a: ColVal, b: ColVal, out_t: T.Type, valid):
         # data is host-resident — under jit tracing or on an accelerator
         # the check is skipped (ingest/cast boundaries still guard)
         if _dec_shadow_checkable(x, y, valid):
-            shadow = np.asarray(x).astype(np.float64) \
-                * np.asarray(y).astype(np.float64)
-            if valid is not None and hasattr(valid, "shape") \
-                    and getattr(valid, "ndim", 0) > 0:
-                shadow = np.where(np.asarray(valid), shadow, 0.0)
-            if shadow.size and np.nanmax(np.abs(shadow)) >= 2.0 ** 62:
-                raise ValueError(
-                    "DECIMAL overflow: unscaled product exceeds 19 digits")
+            T.check_decimal_overflow(
+                np.asarray(x).astype(np.float64)
+                * np.asarray(y).astype(np.float64),
+                None if valid is None or not hasattr(valid, "shape")
+                else np.asarray(valid),
+                "unscaled product")
         r = _rescale_dec(x * y, sa + sb, so)  # true product scale is sa+sb
         return ColVal(r, valid, out_t)
     raise AssertionError(name)
@@ -654,9 +652,10 @@ def _str_transform(name, fn, resolve_type=T.VARCHAR):
             if resolve_type == T.VARCHAR:
                 return ColVal(v, col.valid, T.VARCHAR)  # still a literal
             return ColVal(v, col.valid, resolve_type)
-        if resolve_type == T.VARCHAR:
-            r = _host_string_transform(col, lambda v: fn(v, *extra))
-            return ColVal(r.data, col.valid, T.VARCHAR, r.dictionary)
+        if resolve_type.is_string:  # VARCHAR / JSON output
+            r = _host_string_transform(col, lambda v: fn(v, *extra),
+                                       resolve_type)
+            return ColVal(r.data, col.valid, resolve_type, r.dictionary)
         r = _host_string_pred(col, lambda v: fn(v, *extra))
         data = r.data
         if resolve_type != T.BOOLEAN:
@@ -942,7 +941,8 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool) -> ColVal:
     if to.is_decimal:
         s = to.decimal_scale
         if frm.is_decimal:
-            return ColVal(_rescale_dec(x.astype(jnp.int64), frm.decimal_scale, s),
+            return ColVal(_rescale_dec(x.astype(jnp.int64), frm.decimal_scale, s,
+                                       v.valid),
                           v.valid, to)
         if frm.is_integer:
             return ColVal(x.astype(jnp.int64) * (10 ** s), v.valid, to)
@@ -1164,42 +1164,53 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
     if frm.is_string and not to.is_string:
         if to.name == "DATE":
             return _emit_date_from_str([v])
-        # parse numerics via dictionary LUT
+        # parse numerics via dictionary LUT; None == parse failure (kept
+        # distinct from a genuine float('NaN') parse)
         def parse(x):
             try:
                 f = float(x)
             except ValueError:
                 if safe:
-                    return np.nan
+                    return None
                 raise
             if to.is_decimal and \
-                    abs(f) * (10 ** to.decimal_scale) >= 2.0 ** 62:
+                    abs(f) * (10 ** to.decimal_scale) \
+                    >= T.DECIMAL_UNSCALED_LIMIT:
                 # int64 unscaled storage limit (~19 digits); raise rather
                 # than silently wrapping (long-decimal Int128 boundary)
                 if safe:
-                    return np.nan
+                    return None
                 raise ValueError(
                     f"DECIMAL overflow: '{x}' exceeds 19 significant digits")
             return f
         lit = _as_string_literal(v)
         if lit is not None:
             val = parse(lit)
-            if val != val:  # safe-parse failure -> typed NULL
+            if val is None:  # safe-parse failure -> typed NULL
                 return emit_cast(ColVal(False, False, T.UNKNOWN), to, safe)
             if to.is_integer:
+                if val != val:  # CAST('NaN' AS INTEGER) has no value
+                    return emit_cast(ColVal(False, False, T.UNKNOWN),
+                                     to, safe)
                 return ColVal(int(val), v.valid, to)
             if to.is_decimal:  # scale to the unscaled int64 representation
                 return _emit_cast_decimal(
                     ColVal(val, v.valid, T.DOUBLE), to, safe)
-            return ColVal(val, v.valid, to)
-        lut_np = np.asarray([parse(x) for x in v.dictionary.values],
-                            dtype=np.float64)
-        lut = jnp.asarray(lut_np)
+            return ColVal(val, v.valid, to)  # 'NaN' parses to a real NaN
+        bad_np = np.zeros(len(v.dictionary), dtype=bool)
+        lut_vals = []
+        for i, x in enumerate(v.dictionary.values):
+            r = parse(x)
+            if r is None:  # failure marker, distinct from a genuine NaN
+                bad_np[i] = True
+                r = 0.0
+            lut_vals.append(r)
+        lut = jnp.asarray(np.asarray(lut_vals, dtype=np.float64))
         data = lut[jnp.clip(v.data, 0, len(v.dictionary) - 1)]
         valid = v.valid
-        if safe and np.isnan(lut_np).any():
+        if bad_np.any():
             # rows referencing unparseable entries become NULL, not 0
-            bad = jnp.asarray(np.isnan(lut_np))[
+            bad = jnp.asarray(bad_np)[
                 jnp.clip(v.data, 0, len(v.dictionary) - 1)]
             valid = (~bad) if valid is None else (jnp.asarray(valid) & ~bad)
         return emit_cast(ColVal(data, valid, T.DOUBLE), to, safe)

@@ -85,6 +85,9 @@ class Planner:
         self.subplans: Dict[int, P.PlanNode] = {}
         self.subplan_ids = itertools.count()
         self.cte_stack: List[Dict[str, tuple]] = []
+        # id(ast.ScalarSubquery) -> decorrelated column Ref (see
+        # _try_subquery_conjunct's general correlated form)
+        self._scalar_sub_overrides: Dict[int, ir.RowExpr] = {}
 
     # ------------------------------------------------------------------
     def plan_statement(self, stmt: ast.Statement) -> P.QueryPlan:
@@ -549,6 +552,23 @@ class Planner:
                     inner = ast.BinaryOp(opmap.get(inner.op, inner.op), lhs, rhs)
                 return self._plan_scalar_compare(node, scope, inner.op, lhs,
                                                  rhs.query, agg_map, group_map), True
+        # general form: ONE correlated scalar subquery anywhere in the
+        # conjunct (e.g. `price > 1.2 * (SELECT avg(...) WHERE corr)`) —
+        # decorrelate to a joined column, substitute, analyze as usual
+        subs: List[ast.ScalarSubquery] = []
+        _collect_scalar_subqueries(conj, subs)
+        if len(subs) == 1:
+            sq = subs[0].query
+            if isinstance(sq.body, ast.QuerySpec) and sq.body.from_ is not None \
+                    and self._find_correlation(sq.body, scope):
+                new_node, sref = self._decorrelate_scalar_to_column(
+                    node, scope, sq.body)
+                self._scalar_sub_overrides[id(subs[0])] = sref
+                try:
+                    rex = self.analyze(conj, scope, agg_map, group_map)
+                finally:
+                    self._scalar_sub_overrides.pop(id(subs[0]), None)
+                return P.Filter(new_node, rex), True
         return node, False
 
     def _plan_exists(self, node, scope, sub: ast.Query, negated: bool):
@@ -644,9 +664,14 @@ class Planner:
             self.subplans.update(saved_subplans)
 
     def _decorrelate_scalar_agg(self, node, scope, opn, lval, spec: ast.QuerySpec):
-        """`lhs OP (SELECT f(aggs) FROM inner WHERE eqs AND rest)` ->
-        Aggregate(inner, group=correlation keys) JOIN outer ON eqs;
-        conjunct becomes lhs OP f(agg outputs).
+        join, sref = self._decorrelate_scalar_to_column(node, scope, spec)
+        a, b = self._coerce_pair(lval, sref)
+        return P.Filter(join, ir.Call(opn, (a, b), T.BOOLEAN))
+
+    def _decorrelate_scalar_to_column(self, node, scope, spec: ast.QuerySpec):
+        """`(SELECT f(aggs) FROM inner WHERE eqs AND rest)` correlated on
+        eqs -> Aggregate(inner, group=correlation keys) JOIN outer ON eqs;
+        returns (join node, Ref to the scalar column).
         (Reference: TransformCorrelatedScalarAggregationToJoin rule.)"""
         if len(spec.select) != 1 or spec.group_by or spec.having:
             raise SemanticError("unsupported correlated scalar subquery shape")
@@ -708,11 +733,13 @@ class Planner:
         proj = {s: ir.Ref(s, t) for s, t in agg_node.outputs()}
         proj[ssym] = sel_expr
         sub_node = P.Project(agg_node, proj)
-        # join outer to the grouped aggregate
+        # join outer to the grouped aggregate: LEFT, so outer rows with no
+        # matching group survive with a NULL scalar (reference:
+        # TransformCorrelatedScalarAggregationToJoin uses a left join —
+        # matters under OR / coalesce / count(*)=0 shapes)
         jcriteria = [(lk, rk) for (lk, rk) in criteria]
-        join = P.Join(node, sub_node, "INNER", jcriteria)
-        a, b = self._coerce_pair(lval, ir.Ref(ssym, sel_expr.type))
-        return P.Filter(join, ir.Call(opn, (a, b), T.BOOLEAN))
+        join = P.Join(node, sub_node, "LEFT", jcriteria)
+        return join, ir.Ref(ssym, sel_expr.type)
 
     # ------------------------------------------------------------------
     # aggregation planning
@@ -976,6 +1003,9 @@ class Planner:
         if isinstance(e, ast.Lambda):
             raise SemanticError("lambda is only valid as a function argument")
         if isinstance(e, ast.ScalarSubquery):
+            override = self._scalar_sub_overrides.get(id(e))
+            if override is not None:
+                return override
             sub_node, sub_scope, _ = self.plan_query(e.query, None)
             if len(sub_scope.fields) != 1:
                 raise SemanticError("scalar subquery must return one column")
@@ -1130,6 +1160,16 @@ def _literal_to_ir(e: ast.Literal) -> ir.Lit:
     if isinstance(e.value, str):
         return ir.Lit(e.value, T.VARCHAR)
     raise SemanticError(f"bad literal {e.value!r}")
+
+
+def _collect_scalar_subqueries(e: ast.Expr, out: list) -> None:
+    if isinstance(e, ast.ScalarSubquery):
+        out.append(e)
+        return
+    for child in e.children():
+        if isinstance(child, (ast.Query, ast.QuerySpec)):
+            continue
+        _collect_scalar_subqueries(child, out)
 
 
 def _ast_conjuncts(e: Optional[ast.Expr]) -> List[ast.Expr]:

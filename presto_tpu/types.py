@@ -260,6 +260,26 @@ def parse_type(text: str) -> Type:
     raise ValueError(f"unknown type: {text}")
 
 
+DECIMAL_UNSCALED_LIMIT = 2.0 ** 62  # int64 headroom (~19 digits)
+
+
+def check_decimal_overflow(unscaled, valid=None, what: str = "value"):
+    """Shared float64-shadow guard for the int64 unscaled-decimal
+    boundary; NULL lanes are excluded (they carry garbage payloads)."""
+    shadow = np.abs(np.asarray(unscaled, dtype=np.float64))
+    if valid is not None:
+        v = np.asarray(valid)
+        if v.ndim > 0:
+            shadow = np.where(v, shadow, 0.0)
+        elif not bool(v):
+            return
+    with np.errstate(invalid="ignore"):
+        if shadow.size and np.nanmax(shadow) >= DECIMAL_UNSCALED_LIMIT:
+            raise ValueError(
+                f"DECIMAL overflow: {what} exceeds the int64 unscaled "
+                "range (~19 significant digits)")
+
+
 def _split_type_args(s: str):
     """Split 'K, V' at top-level commas (parens may nest)."""
     parts, depth, cur = [], 0, []

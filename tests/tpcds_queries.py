@@ -313,3 +313,79 @@ ORDER BY c_last_name, c_first_name, city, profit, ss_ticket_number
 LIMIT 100
 """,
 })
+
+# widened in round 1 continuation: reporting, multi-channel predicates,
+# derived-table self-comparison, and cross-joined scalar classes
+QUERIES.update({
+    6: """SELECT a.ca_state AS state, count(*) AS cnt
+FROM customer_address a, customer c, store_sales s, date_dim d, item i
+WHERE a.ca_address_sk = c.c_current_addr_sk AND c.c_customer_sk = s.ss_customer_sk
+  AND s.ss_sold_date_sk = d.d_date_sk AND s.ss_item_sk = i.i_item_sk
+  AND d.d_month_seq = (SELECT DISTINCT d_month_seq FROM date_dim WHERE d_year = 2000 AND d_moy = 1)
+  AND i.i_current_price > 1.2 * (SELECT avg(j.i_current_price) FROM item j WHERE j.i_category = i.i_category)
+GROUP BY a.ca_state HAVING count(*) >= 2 ORDER BY cnt, state LIMIT 100""",
+    15: """SELECT ca_zip AS ca_zip, sum(cs_sales_price) AS total
+FROM catalog_sales, customer, customer_address, date_dim
+WHERE cs_bill_customer_sk = c_customer_sk AND c_current_addr_sk = ca_address_sk
+  AND (substr(ca_zip, 1, 5) IN ('85669','86197','88274','83405','86475','85392','85460','80348','81792')
+       OR ca_state IN ('CA','WA','GA') OR cs_sales_price > 200)
+  AND cs_sold_date_sk = d_date_sk AND d_qoy = 1 AND d_year = 2000
+GROUP BY ca_zip ORDER BY ca_zip LIMIT 100""",
+    20: """SELECT i_item_id AS i_item_id, i_item_desc AS i_item_desc, i_category AS i_category,
+       i_class AS i_class, i_current_price AS i_current_price,
+       sum(cs_ext_sales_price) AS itemrevenue
+FROM catalog_sales, item, date_dim
+WHERE cs_item_sk = i_item_sk AND i_category IN ('Sports', 'Books', 'Home')
+  AND cs_sold_date_sk = d_date_sk AND d_year = 1999
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category, i_class, i_item_id, i_item_desc LIMIT 100""",
+    27: """SELECT i_item_id AS i_item_id, s_state AS s_state,
+       avg(ss_quantity) AS agg1, avg(ss_list_price) AS agg2,
+       avg(ss_coupon_amt) AS agg3, avg(ss_sales_price) AS agg4
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S' AND cd_education_status = 'College'
+  AND d_year = 2000 AND s_state IN ('TN', 'SD')
+GROUP BY i_item_id, s_state ORDER BY i_item_id, s_state LIMIT 100""",
+    43: """SELECT s_store_name AS s_store_name, s_store_id AS s_store_id,
+       sum(CASE WHEN (d_day_name = 'Sunday') THEN ss_sales_price ELSE NULL END) AS sun_sales,
+       sum(CASE WHEN (d_day_name = 'Monday') THEN ss_sales_price ELSE NULL END) AS mon_sales,
+       sum(CASE WHEN (d_day_name = 'Friday') THEN ss_sales_price ELSE NULL END) AS fri_sales
+FROM date_dim, store_sales, store
+WHERE d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk AND d_year = 2000
+GROUP BY s_store_name, s_store_id ORDER BY s_store_name, s_store_id LIMIT 100""",
+    48: """SELECT sum(ss_quantity) AS total
+FROM store_sales, store, customer_demographics, customer_address, date_dim
+WHERE s_store_sk = ss_store_sk AND ss_sold_date_sk = d_date_sk AND d_year = 2000
+  AND ((cd_demo_sk = ss_cdemo_sk AND cd_marital_status = 'M' AND cd_education_status = '4 yr Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00)
+    OR (cd_demo_sk = ss_cdemo_sk AND cd_marital_status = 'D' AND cd_education_status = '2 yr Degree'
+        AND ss_sales_price BETWEEN 50.00 AND 100.00))
+  AND ((ss_addr_sk = ca_address_sk AND ca_country = 'United States' AND ca_state IN ('CO','OH','TX')
+        AND ss_net_profit BETWEEN 0 AND 2000)
+    OR (ss_addr_sk = ca_address_sk AND ca_country = 'United States' AND ca_state IN ('OR','MN','KY')
+        AND ss_net_profit BETWEEN 150 AND 3000))""",
+    65: """SELECT s_store_name AS s_store_name, i_item_desc AS i_item_desc, sc.revenue AS revenue
+FROM store, item,
+     (SELECT ss_store_sk, avg(revenue) AS ave
+      FROM (SELECT ss_store_sk, ss_item_sk, sum(ss_sales_price) AS revenue
+            FROM store_sales, date_dim
+            WHERE ss_sold_date_sk = d_date_sk AND d_year = 2000
+            GROUP BY ss_store_sk, ss_item_sk) sa
+      GROUP BY ss_store_sk) sb,
+     (SELECT ss_store_sk, ss_item_sk, sum(ss_sales_price) AS revenue
+      FROM store_sales, date_dim
+      WHERE ss_sold_date_sk = d_date_sk AND d_year = 2000
+      GROUP BY ss_store_sk, ss_item_sk) sc
+WHERE sb.ss_store_sk = sc.ss_store_sk AND sc.revenue <= 0.1 * sb.ave
+  AND s_store_sk = sc.ss_store_sk AND i_item_sk = sc.ss_item_sk
+ORDER BY s_store_name, i_item_desc LIMIT 100""",
+    88: """SELECT * FROM
+ (SELECT count(*) AS h8_30_to_9 FROM store_sales, household_demographics, store
+  WHERE ss_hdemo_sk = hd_demo_sk AND ss_store_sk = s_store_sk
+    AND hd_dep_count = 2 AND s_store_name = 'ese') s1,
+ (SELECT count(*) AS h9_to_9_30 FROM store_sales, household_demographics, store
+  WHERE ss_hdemo_sk = hd_demo_sk AND ss_store_sk = s_store_sk
+    AND hd_dep_count = 1 AND s_store_name = 'ese') s2""",
+})
