@@ -146,6 +146,13 @@ def encode_strings(values: np.ndarray) -> tuple[np.ndarray, Dictionary]:
 
 
 def column_from_numpy(data: np.ndarray, typ: Type, valid: Optional[np.ndarray] = None) -> Column:
+    if isinstance(data, np.ma.MaskedArray):
+        # connectors return masked arrays for nullable columns (the SPI's
+        # null channel; reference: Block.isNull)
+        mask = np.ma.getmaskarray(data)
+        nv = ~mask
+        valid = nv if valid is None else (np.asarray(valid) & nv)
+        data = data.filled("" if typ.is_string else 0)
     dictionary = None
     if typ.is_string and data.dtype.kind in ("U", "S", "O"):
         data, dictionary = encode_strings(data)

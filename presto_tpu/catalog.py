@@ -176,6 +176,9 @@ class Catalog:
     def __init__(self):
         self.tables: Dict[str, ConnectorTable] = {}
         self.version = 0
+        # per-instance copy: a connector attaching a new qualifier (e.g.
+        # sqlite) must not change name resolution in OTHER catalogs
+        self.known_qualifiers = set(self.KNOWN_QUALIFIERS)
 
     def register(self, table: ConnectorTable) -> None:
         self.tables[table.name.lower()] = table
@@ -206,7 +209,7 @@ class Catalog:
             return None
         import re as _re
 
-        if all(p in self.KNOWN_QUALIFIERS
+        if all(p in self.known_qualifiers
                or _re.fullmatch(r"sf\d+(_\d+)?", p) for p in parts[:-1]):
             return parts[-1]
         return None

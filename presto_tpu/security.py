@@ -117,3 +117,62 @@ class SessionPropertyManager:
                 continue
             out.update(props)
         return out
+
+
+class AuthenticationError(Exception):
+    pass
+
+
+class PasswordAuthenticator:
+    """Base authenticator SPI (reference:
+    presto-spi/.../security/PasswordAuthenticator + the
+    presto-password-authenticators plugin module)."""
+
+    def authenticate(self, user: str, password: str) -> str:
+        """Returns the authenticated principal or raises."""
+        raise NotImplementedError
+
+
+class FilePasswordAuthenticator(PasswordAuthenticator):
+    """htpasswd-style user:bcrypt-or-sha256 file (reference:
+    password-authenticators' file-based authenticator).  Lines are
+    `user:{scheme}hash`; supported schemes: {plain} (tests only) and
+    {sha256} of salt$hexdigest."""
+
+    def __init__(self, path: str):
+        self.creds = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or ":" not in line:
+                    continue
+                user, spec = line.split(":", 1)
+                self.creds[user] = spec
+
+    @staticmethod
+    def hash_password(password: str, salt: str = "") -> str:
+        import hashlib
+        import secrets
+
+        salt = salt or secrets.token_hex(8)  # per-user random salt
+        d = hashlib.sha256((salt + "$" + password).encode()).hexdigest()
+        return "{sha256}" + salt + "$" + d
+
+    def authenticate(self, user: str, password: str) -> str:
+        import hashlib
+        import hmac as _hmac
+
+        spec = self.creds.get(user)
+        if spec is None:
+            raise AuthenticationError(f"unknown user '{user}'")
+        if spec.startswith("{plain}"):
+            ok = _hmac.compare_digest(spec[len("{plain}"):], password)
+        elif spec.startswith("{sha256}"):
+            salt, _, digest = spec[len("{sha256}"):].partition("$")
+            d = hashlib.sha256((salt + "$" + password).encode()).hexdigest()
+            ok = _hmac.compare_digest(digest, d)
+        else:
+            raise AuthenticationError("unsupported credential scheme")
+        if not ok:
+            raise AuthenticationError("invalid credentials")
+        return user

@@ -48,9 +48,14 @@ class PrestoTpuServer:
     thread pool so the HTTP loop never blocks on execution."""
 
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
-                 max_concurrent: int = 4, resource_groups=None):
+                 max_concurrent: int = 4, resource_groups=None,
+                 authenticator=None):
         self.session = session
         self.resource_groups = resource_groups  # ResourceGroupManager | None
+        # security.PasswordAuthenticator | None — when set, every /v1
+        # request must carry HTTP Basic credentials (reference:
+        # password authenticators wired through http-server.authentication)
+        self.authenticator = authenticator
         self.jobs: Dict[str, _QueryJob] = {}
         self.jobs_lock = threading.Lock()
         self.node_id = f"node_{uuid.uuid4().hex[:8]}"
@@ -238,7 +243,42 @@ def _make_handler(server: PrestoTpuServer):
             self.end_headers()
             self.wfile.write(body)
 
+        def _authenticate(self) -> bool:
+            """HTTP Basic against the configured PasswordAuthenticator;
+            True == proceed.  401 + WWW-Authenticate on failure."""
+            if server.authenticator is None:
+                return True
+            import base64 as _b64
+
+            from presto_tpu.security import AuthenticationError
+
+            hdr = self.headers.get("Authorization", "")
+            if hdr.startswith("Basic "):
+                try:
+                    user, _, pw = _b64.b64decode(
+                        hdr[6:]).decode("utf-8").partition(":")
+                    server.authenticator.authenticate(user, pw)
+                    return True
+                except (AuthenticationError, ValueError):
+                    pass
+            # drain any request body so the keep-alive connection is not
+            # left mid-stream (the client's retry-with-credentials would
+            # otherwise parse garbage), then close it
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            if n:
+                self.rfile.read(n)
+            self.close_connection = True
+            self.send_response(401)
+            self.send_header("WWW-Authenticate",
+                             'Basic realm="presto_tpu"')
+            self.send_header("Content-Length", "0")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            return False
+
         def do_POST(self):
+            if not self._authenticate():
+                return
             if self.path != "/v1/statement":
                 return self._json({"error": "not found"}, 404)
             if server.shutting_down.is_set():
@@ -251,6 +291,8 @@ def _make_handler(server: PrestoTpuServer):
             self._json(server.results_payload(job, 0))
 
         def do_GET(self):
+            if not self._authenticate():
+                return
             parts = [p for p in self.path.split("/") if p]
             if parts[:2] == ["v1", "statement"] and len(parts) == 4:
                 job = server.jobs.get(parts[2])
@@ -314,6 +356,8 @@ def _make_handler(server: PrestoTpuServer):
             return self._json({"error": "not found"}, 404)
 
         def do_DELETE(self):
+            if not self._authenticate():
+                return
             parts = [p for p in self.path.split("/") if p]
             if parts[:2] == ["v1", "statement"] and len(parts) >= 3:
                 job = server.jobs.get(parts[2])

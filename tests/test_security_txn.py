@@ -301,3 +301,72 @@ def test_file_audit_log(tpch_catalog_tiny, tmp_path):
     assert ("query_completed", "FAILED") in events
     done = [r for r in lines if r.get("state") == "FINISHED"]
     assert done[0]["output_rows"] == 1 and done[0]["user"] == "user"
+
+
+def test_password_authenticator_unit(tmp_path):
+    from presto_tpu.security import (AuthenticationError,
+                                     FilePasswordAuthenticator)
+
+    path = tmp_path / "passwd"
+    path.write_text(
+        "alice:" + FilePasswordAuthenticator.hash_password("s3cret") + "\n"
+        "bob:{plain}pw\n")
+    auth = FilePasswordAuthenticator(str(path))
+    assert auth.authenticate("alice", "s3cret") == "alice"
+    assert auth.authenticate("bob", "pw") == "bob"
+    for user, pw in [("alice", "wrong"), ("nobody", "x")]:
+        try:
+            auth.authenticate(user, pw)
+            assert False
+        except AuthenticationError:
+            pass
+
+
+def test_server_basic_auth(tpch_catalog_tiny, tmp_path):
+    """HTTP Basic over the protocol (reference: password authenticators
+    behind http-server.authentication.type=PASSWORD)."""
+    import base64
+    import json
+    import urllib.error
+    import urllib.request
+
+    import presto_tpu
+    from presto_tpu.security import FilePasswordAuthenticator
+    from presto_tpu.server.protocol import PrestoTpuServer
+
+    path = tmp_path / "passwd"
+    path.write_text(
+        "alice:" + FilePasswordAuthenticator.hash_password("pw") + "\n")
+    s = presto_tpu.connect(tpch_catalog_tiny)
+    srv = PrestoTpuServer(
+        s, authenticator=FilePasswordAuthenticator(str(path))).start()
+    try:
+        url = f"{srv.uri}/v1/statement"
+        req = urllib.request.Request(
+            url, data=b"SELECT 1", method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 401"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+            assert "Basic" in e.headers.get("WWW-Authenticate", "")
+        tok = base64.b64encode(b"alice:pw").decode()
+        req = urllib.request.Request(
+            url, data=b"SELECT 1", method="POST",
+            headers={"Authorization": f"Basic {tok}"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            payload = json.loads(r.read())
+        assert payload["stats"]["state"] in (
+            "QUEUED", "RUNNING", "FINISHED")
+        # wrong password also rejected
+        bad = base64.b64encode(b"alice:nope").decode()
+        req = urllib.request.Request(
+            url, data=b"SELECT 1", method="POST",
+            headers={"Authorization": f"Basic {bad}"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 401"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+    finally:
+        srv.stop()
