@@ -179,6 +179,9 @@ class Catalog:
         # per-instance copy: a connector attaching a new qualifier (e.g.
         # sqlite) must not change name resolution in OTHER catalogs
         self.known_qualifiers = set(self.KNOWN_QUALIFIERS)
+        # prefixes CLAIMED by a connector: a qualified miss under them is
+        # an error, never a fallback to a same-named internal table
+        self.claimed_prefixes: set = set()
 
     def register(self, table: ConnectorTable) -> None:
         self.tables[table.name.lower()] = table
@@ -207,6 +210,8 @@ class Catalog:
         parts = name.lower().split(".")
         if len(parts) < 2:
             return None
+        if parts[0] in self.claimed_prefixes:
+            return None  # connector-owned namespace: exact matches only
         import re as _re
 
         if all(p in self.known_qualifiers

@@ -20,11 +20,12 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu.catalog import Catalog, ConnectorTable
 
-# longest/most-specific first: the scan is substring-based, so SMALLINT
-# must match before INT, POINT must not match INT at all, etc.
+import re as _re
+
+# longest/most-specific first (the scan is substring-based, so SMALLINT
+# must match before the generic integer rule)
 _AFFINITY = [
     ("SMALLINT", T.INTEGER), ("TINYINT", T.INTEGER),
-    ("BIGINT", T.BIGINT), ("INTEGER", T.BIGINT), ("INT ", T.BIGINT),
     ("DOUBLE", T.DOUBLE), ("FLOAT", T.DOUBLE), ("REAL", T.DOUBLE),
     ("NUMERIC", T.DOUBLE), ("DECIMAL", T.DOUBLE),
     ("VARCHAR", T.VARCHAR), ("CHAR", T.VARCHAR), ("TEXT", T.VARCHAR),
@@ -33,14 +34,18 @@ _AFFINITY = [
     ("DATETIME", T.VARCHAR), ("DATE", T.VARCHAR),
 ]
 
+# SQLite integer affinity: any *INT* word — INT, INT8, INT(11), BIGINT,
+# MEDIUMINT — but not POINT (the 'INT' must not follow a letter)
+_INT_RE = _re.compile(r"(^|[^A-Z])(TINY|SMALL|MEDIUM|BIG)?INT(EGER)?\d*\b")
+
 
 def _map_type(decl: str) -> T.Type:
-    d = (decl or "").upper().strip()
+    d = _re.sub(r"\(.*\)", "", (decl or "").upper()).strip()
     for key, t in _AFFINITY:
-        if key == "INT " and d in ("INT",):  # bare INT (no trailing space)
-            return t
         if key in d:
             return t
+    if _INT_RE.search(d):
+        return T.BIGINT
     return T.VARCHAR  # SQLite's dynamic typing default
 
 
@@ -67,9 +72,14 @@ class SqliteTable(ConnectorTable):
 
     def splits(self, n_splits: int) -> List[Tuple[int, int]]:
         """Rowid ranges (reference: JdbcSplitManager; JDBC connectors
-        usually produce one split, we do better when rowids are dense)."""
-        row = self._conn().execute(
-            f"SELECT min(rowid), max(rowid) FROM {self._quoted}").fetchone()
+        usually produce one split, we do better when rowids are dense).
+        WITHOUT ROWID tables fall back to one full-scan split."""
+        try:
+            row = self._conn().execute(
+                f"SELECT min(rowid), max(rowid) FROM "
+                f"{self._quoted}").fetchone()
+        except sqlite3.OperationalError:
+            return [(-1, -1)]  # sentinel: full scan (see read)
         if row is None or row[0] is None:
             return []
         lo, hi = int(row[0]), int(row[1]) + 1
@@ -84,7 +94,7 @@ class SqliteTable(ConnectorTable):
         sel = ", ".join(f'"{c}"' for c in cols)  # projection pushdown
         sql = f"SELECT {sel} FROM {self._quoted}"
         args: tuple = ()
-        if split is not None:
+        if split is not None and split[0] >= 0:
             sql += " WHERE rowid >= ? AND rowid < ?"
             args = (split[0], split[1])
         rows = self._conn().execute(sql, args).fetchall()
@@ -145,7 +155,9 @@ def attach_sqlite(catalog: Catalog, path: str,
     registered = []
     for name in names:
         info = conn.execute(f'PRAGMA table_info("{name}")').fetchall()
-        schema = {r[1]: _map_type(r[2]) for r in info}
+        # the engine's parser lowercases identifiers; SQLite resolves
+        # quoted lowercase names case-insensitively, so read() still works
+        schema = {r[1].lower(): _map_type(r[2]) for r in info}
         t = SqliteTable(connect, name.lower(), schema, f'"{name}"')
         qualified = f"{catalog_name}.{name.lower()}"
         catalog.tables[qualified] = t  # one table object, both names
@@ -155,4 +167,7 @@ def attach_sqlite(catalog: Catalog, path: str,
         registered.append(qualified)
     catalog.version += 1
     catalog.known_qualifiers.add(catalog_name)  # this catalog only
+    # qualified misses under this prefix must error, not fall back to a
+    # same-named internal table
+    catalog.claimed_prefixes.add(catalog_name)
     return registered

@@ -1,0 +1,321 @@
+"""Iterative rule framework: Pattern + Rule + Memo + IterativeOptimizer.
+
+Reference parity: sql/planner/iterative/{IterativeOptimizer, Memo, Rule}
+driven by the presto-matching Pattern DSL (presto-matching/.../matching/).
+The reference runs 87 rules to fixpoint over a Memo whose groups replace
+node children; this is the same machinery at the scale the engine needs:
+groups, group references, fixpoint iteration with a budget, and a small
+set of always-safe normalization rules.  The heavyweight passes
+(predicate pushdown/join reassembly, column pruning, exchange planning)
+remain whole-plan passes, as PlanOptimizers.java also keeps its legacy
+passes alongside the iterative ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from presto_tpu.plan import ir
+from presto_tpu.plan import nodes as P
+
+
+# ---------------------------------------------------------------------------
+# pattern DSL (presto-matching analog)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Pattern:
+    """Match a node by type + predicates + (optionally) source patterns.
+    Source patterns look through GroupRefs, like the reference's
+    `Patterns.source().matching(...)` with Lookup.resolve."""
+
+    node_type: type
+    predicates: List[Callable] = field(default_factory=list)
+    source_patterns: List[Optional["Pattern"]] = field(default_factory=list)
+
+    def matching(self, pred: Callable) -> "Pattern":
+        return Pattern(self.node_type, self.predicates + [pred],
+                       self.source_patterns)
+
+    def with_source(self, *pats: Optional["Pattern"]) -> "Pattern":
+        return Pattern(self.node_type, self.predicates, list(pats))
+
+    def matches(self, node, lookup) -> bool:
+        if not isinstance(node, self.node_type):
+            return False
+        if any(not p(node) for p in self.predicates):
+            return False
+        if self.source_patterns:
+            srcs = node.sources
+            if len(srcs) < len(self.source_patterns):
+                return False
+            for pat, src in zip(self.source_patterns, srcs):
+                if pat is None:
+                    continue
+                if not pat.matches(lookup(src), lookup):
+                    return False
+        return True
+
+
+def pattern(node_type: type) -> Pattern:
+    return Pattern(node_type)
+
+
+class Rule:
+    """Subclass with `pattern` and `apply(node, ctx)` returning a
+    replacement node or None (reference: iterative/Rule.java)."""
+
+    pattern: Pattern = Pattern(P.PlanNode)
+
+    def apply(self, node, ctx: "RuleContext"):
+        raise NotImplementedError
+
+
+@dataclass
+class RuleContext:
+    memo: "Memo"
+
+    def resolve(self, node):
+        """Look through a GroupRef to the group's current node
+        (reference: Lookup.resolve)."""
+        return self.memo.resolve(node)
+
+
+# ---------------------------------------------------------------------------
+# memo (reference: iterative/Memo.java)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GroupRef(P.PlanNode):
+    """Placeholder child pointing at a memo group."""
+
+    memo: "Memo"
+    gid: int
+
+    def outputs(self):
+        return self.memo.node(self.gid).outputs()
+
+    @property
+    def sources(self):
+        return []
+
+    def __repr__(self):
+        return f"GroupRef({self.gid})"
+
+
+class Memo:
+    """Plan stored as groups; children of every stored node are
+    GroupRefs.  `replace` rewires a group to a new representative
+    (equivalence is by construction: rules only produce semantically
+    equal plans)."""
+
+    def __init__(self, root: P.PlanNode):
+        self._nodes: Dict[int, P.PlanNode] = {}
+        self._ids = itertools.count()
+        self.root_gid = self._insert(root)
+
+    # -- structure ----------------------------------------------------
+    def _insert(self, node: P.PlanNode) -> int:
+        gid = next(self._ids)
+        self._nodes[gid] = self._with_group_children(node)
+        return gid
+
+    def _with_group_children(self, node: P.PlanNode) -> P.PlanNode:
+        if isinstance(node, GroupRef):
+            return node
+        changed = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, GroupRef):
+                continue
+            if isinstance(v, P.PlanNode):
+                changed[f.name] = GroupRef(self, self._insert(v))
+            elif isinstance(v, list) and v and \
+                    all(isinstance(x, P.PlanNode) for x in v):
+                changed[f.name] = [
+                    x if isinstance(x, GroupRef)
+                    else GroupRef(self, self._insert(x)) for x in v]
+        return dataclasses.replace(node, **changed) if changed else node
+
+    def node(self, gid: int) -> P.PlanNode:
+        return self._nodes[gid]
+
+    def resolve(self, node: P.PlanNode) -> P.PlanNode:
+        while isinstance(node, GroupRef):
+            node = self._nodes[node.gid]
+        return node
+
+    def group_ids(self) -> List[int]:
+        """Reachable groups, children before parents."""
+        out: List[int] = []
+        seen = set()
+
+        def visit(gid):
+            if gid in seen:
+                return
+            seen.add(gid)
+            for f in dataclasses.fields(self._nodes[gid]):
+                v = getattr(self._nodes[gid], f.name)
+                for x in (v if isinstance(v, list) else [v]):
+                    if isinstance(x, GroupRef):
+                        visit(x.gid)
+            out.append(gid)
+
+        visit(self.root_gid)
+        return out
+
+    def replace(self, gid: int, node: P.PlanNode) -> None:
+        self._nodes[gid] = self._with_group_children(node)
+
+    def extract(self, gid: Optional[int] = None) -> P.PlanNode:
+        """Materialize the plan back out of the memo."""
+        node = self._nodes[self.root_gid if gid is None else gid]
+        changed = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, GroupRef):
+                changed[f.name] = self.extract(v.gid)
+            elif isinstance(v, list) and v and \
+                    any(isinstance(x, GroupRef) for x in v):
+                changed[f.name] = [self.extract(x.gid)
+                                   if isinstance(x, GroupRef) else x
+                                   for x in v]
+        return dataclasses.replace(node, **changed) if changed else node
+
+
+class IterativeOptimizer:
+    """Run rules over memo groups until no rule fires (reference:
+    iterative/IterativeOptimizer.exploreGroup), bounded by a budget so a
+    bad rule can't loop forever."""
+
+    def __init__(self, rules: List[Rule], max_applications: int = 10_000):
+        self.rules = rules
+        self.max_applications = max_applications
+
+    def optimize(self, root: P.PlanNode) -> P.PlanNode:
+        memo = Memo(root)
+        ctx = RuleContext(memo)
+        budget = self.max_applications
+        progress = True
+        while progress and budget > 0:
+            progress = False
+            for gid in memo.group_ids():
+                node = memo.node(gid)
+                for rule in self.rules:
+                    if not rule.pattern.matches(node, memo.resolve):
+                        continue
+                    out = rule.apply(node, ctx)
+                    if out is not None and out is not node:
+                        memo.replace(gid, out)
+                        progress = True
+                        budget -= 1
+                        break  # re-match this group next sweep
+        return memo.extract()
+
+
+# ---------------------------------------------------------------------------
+# normalization rules (always-safe subset of the reference's 87)
+# ---------------------------------------------------------------------------
+
+
+class MergeFilters(Rule):
+    """Filter(Filter(x)) -> Filter(x, a AND b)
+    (reference: rule/MergeFilters.java)."""
+
+    pattern = pattern(P.Filter).with_source(pattern(P.Filter))
+
+    def apply(self, node: P.Filter, ctx):
+        child = ctx.resolve(node.source)
+        combined = ir.combine_conjuncts(
+            ir.conjuncts(child.predicate) + ir.conjuncts(node.predicate))
+        return P.Filter(child.source, combined)
+
+
+class RemoveTrivialFilter(Rule):
+    """Filter(TRUE) -> source (reference: RemoveTrivialFilters)."""
+
+    pattern = pattern(P.Filter).matching(
+        lambda n: isinstance(n.predicate, ir.Lit)
+        and n.predicate.value is True)
+
+    def apply(self, node: P.Filter, ctx):
+        return ctx.resolve(node.source)
+
+
+class MergeLimits(Rule):
+    """Limit(a, Limit(b, x)) -> Limit(min(a,b), x)
+    (reference: rule/MergeLimits.java)."""
+
+    pattern = pattern(P.Limit).with_source(pattern(P.Limit))
+
+    def apply(self, node: P.Limit, ctx):
+        child = ctx.resolve(node.source)
+        return P.Limit(child.source, min(node.count, child.count))
+
+
+class MergeLimitWithSort(Rule):
+    """Limit(k, Sort(x)) -> TopN(k, x)
+    (reference: rule/MergeLimitWithSort.java — the TopN rewrite)."""
+
+    pattern = pattern(P.Limit).with_source(pattern(P.Sort))
+
+    def apply(self, node: P.Limit, ctx):
+        child = ctx.resolve(node.source)
+        return P.TopN(child.source, child.keys, node.count)
+
+
+class PushLimitThroughProject(Rule):
+    """Limit(Project(x)) -> Project(Limit(x))
+    (reference: rule/PushLimitThroughProject.java)."""
+
+    pattern = pattern(P.Limit).with_source(pattern(P.Project))
+
+    def apply(self, node: P.Limit, ctx):
+        child = ctx.resolve(node.source)
+        return P.Project(P.Limit(child.source, node.count),
+                         dict(child.assignments))
+
+
+class InlineIdentityProject(Rule):
+    """Project that re-emits exactly its input symbols -> source
+    (reference: RemoveRedundantIdentityProjections)."""
+
+    pattern = pattern(P.Project)
+
+    def apply(self, node: P.Project, ctx):
+        child = ctx.resolve(node.source)
+        child_outs = [s for s, _ in child.outputs()]
+        if list(node.assignments) != child_outs:
+            return None
+        for s, e in node.assignments.items():
+            if not (isinstance(e, ir.Ref) and e.name == s):
+                return None
+        return child
+
+
+class MergeAdjacentProjects(Rule):
+    """Project(Project(x)) -> one Project with inlined expressions when
+    the inner assignments are pure Refs (reference: InlineProjections)."""
+
+    pattern = pattern(P.Project).with_source(pattern(P.Project))
+
+    def apply(self, node: P.Project, ctx):
+        child = ctx.resolve(node.source)
+        if not all(isinstance(e, ir.Ref) for e in child.assignments.values()):
+            return None
+        mapping = dict(child.assignments)
+        new_assigns = {s: ir.substitute(e, mapping)
+                       for s, e in node.assignments.items()}
+        return P.Project(child.source, new_assigns)
+
+
+DEFAULT_RULES: List[Rule] = [
+    MergeFilters(), RemoveTrivialFilter(), MergeLimits(),
+    MergeLimitWithSort(), PushLimitThroughProject(),
+    InlineIdentityProject(), MergeAdjacentProjects(),
+]

@@ -80,6 +80,10 @@ def annotate_static_hints(plan: P.QueryPlan, session) -> None:
 def _optimize_node(node: P.PlanNode, session) -> P.PlanNode:
     node = _rewrite(node, session)
     node = prune_columns(node, set(n for n, _ in node.outputs()))
+    if session.properties.get("iterative_optimizer_enabled", True):
+        from presto_tpu.plan.iterative import DEFAULT_RULES, IterativeOptimizer
+
+        node = IterativeOptimizer(DEFAULT_RULES).optimize(node)
     return node
 
 

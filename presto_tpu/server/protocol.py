@@ -261,12 +261,17 @@ def _make_handler(server: PrestoTpuServer):
                     return True
                 except (AuthenticationError, ValueError):
                     pass
-            # drain any request body so the keep-alive connection is not
-            # left mid-stream (the client's retry-with-credentials would
-            # otherwise parse garbage), then close it
+            # drain a BOUNDED amount of request body so small keep-alive
+            # requests can retry cleanly; oversized unauthenticated bodies
+            # are not buffered (pre-auth memory safety) — the connection
+            # closes instead
             n = int(self.headers.get("Content-Length", 0) or 0)
-            if n:
-                self.rfile.read(n)
+            drained = 0
+            while drained < min(n, 1 << 20):
+                chunk = self.rfile.read(min(65536, n - drained))
+                if not chunk:
+                    break
+                drained += len(chunk)
             self.close_connection = True
             self.send_response(401)
             self.send_header("WWW-Authenticate",

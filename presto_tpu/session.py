@@ -53,6 +53,7 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "memory_pool_bytes": 16 << 30,  # per-process pool (MemoryPool capacity)
     "spill_enabled": True,
     "spill_encryption": False,  # AES-256-CTR at rest (AesSpillCipher)
+    "iterative_optimizer_enabled": True,  # Memo/Rule fixpoint pass
     "spill_path": "",  # "" = <tmp>/presto_tpu_spill
     "spill_partition_count": 8,  # Grace hash fan-out (GenericPartitioningSpiller)
     "max_spill_bytes": 64 << 30,

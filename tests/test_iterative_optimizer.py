@@ -1,0 +1,98 @@
+"""Iterative rule framework: Pattern/Rule/Memo fixpoint
+(reference: sql/planner/iterative/ + presto-matching)."""
+
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.plan import ir
+from presto_tpu.plan import nodes as P
+from presto_tpu.plan.iterative import (DEFAULT_RULES, IterativeOptimizer,
+                                       Memo, MergeFilters, MergeLimits,
+                                       pattern)
+
+
+def _scan():
+    return P.TableScan("t", {"a": "a", "b": "b"},
+                       {"a": T.BIGINT, "b": T.BIGINT})
+
+
+def _ref(s):
+    return ir.Ref(s, T.BIGINT)
+
+
+def test_memo_roundtrip():
+    plan = P.Limit(P.Filter(_scan(), ir.Lit(True, T.BOOLEAN)), 5)
+    memo = Memo(plan)
+    out = memo.extract()
+    assert isinstance(out, P.Limit) and out.count == 5
+    assert isinstance(out.source, P.Filter)
+    assert isinstance(out.source.source, P.TableScan)
+
+
+def test_merge_filters_and_limits():
+    f1 = ir.Call("gt", (_ref("a"), ir.Lit(1, T.BIGINT)), T.BOOLEAN)
+    f2 = ir.Call("lt", (_ref("b"), ir.Lit(9, T.BIGINT)), T.BOOLEAN)
+    plan = P.Limit(P.Limit(P.Filter(P.Filter(_scan(), f1), f2), 10), 3)
+    out = IterativeOptimizer([MergeFilters(), MergeLimits()]).optimize(plan)
+    assert isinstance(out, P.Limit) and out.count == 3
+    flt = out.source
+    assert isinstance(flt, P.Filter)
+    assert len(ir.conjuncts(flt.predicate)) == 2
+    assert isinstance(flt.source, P.TableScan)
+
+
+def test_limit_sort_fuses_to_topn():
+    plan = P.Limit(P.Sort(_scan(), [("a", True, None)]), 7)
+    out = IterativeOptimizer(DEFAULT_RULES).optimize(plan)
+    assert isinstance(out, P.TopN) and out.count == 7
+    assert out.keys == [("a", True, None)]
+
+
+def test_identity_project_removed_and_projects_merged():
+    scan = _scan()
+    ident = P.Project(scan, {"a": _ref("a"), "b": _ref("b")})
+    renaming = P.Project(ident, {"x": _ref("a")})
+    outer = P.Project(renaming, {"y": ir.Call("add", (_ref("x"),
+                                                      ir.Lit(1, T.BIGINT)),
+                                              T.BIGINT)})
+    out = IterativeOptimizer(DEFAULT_RULES).optimize(outer)
+    # identity removed, rename inlined: one Project straight over the scan
+    assert isinstance(out, P.Project)
+    assert list(out.assignments) == ["y"]
+    assert isinstance(out.source, P.TableScan)
+    inner = out.assignments["y"]
+    assert isinstance(inner, ir.Call) and inner.args[0].name == "a"
+
+
+def test_pattern_dsl():
+    p = pattern(P.Limit).matching(lambda n: n.count < 10) \
+        .with_source(pattern(P.Sort))
+    plan = P.Limit(P.Sort(_scan(), [("a", True, None)]), 5)
+    assert p.matches(plan, lambda n: n)
+    assert not p.matches(P.Limit(_scan(), 5), lambda n: n)
+    assert not p.matches(P.Limit(P.Sort(_scan(), []), 50), lambda n: n)
+
+
+def test_fixpoint_budget_terminates():
+    from presto_tpu.plan.iterative import Rule
+
+    class Bad(Rule):
+        pattern = pattern(P.Limit)
+
+        def apply(self, node, ctx):
+            return P.Limit(node.source, node.count)  # always "new"
+
+    plan = P.Limit(_scan(), 5)
+    out = IterativeOptimizer([Bad()], max_applications=25).optimize(plan)
+    assert isinstance(out, P.Limit)  # terminated by budget, not hang
+
+
+def test_end_to_end_queries_unchanged(tpch_catalog_tiny):
+    s = presto_tpu.connect(tpch_catalog_tiny)
+    q = ("SELECT n_name FROM (SELECT n_name, n_regionkey FROM nation "
+         "ORDER BY n_name LIMIT 20) t WHERE n_regionkey >= 0 LIMIT 5")
+    with_rules = s.sql(q).rows
+    s.set("iterative_optimizer_enabled", False)
+    without = s.sql(q).rows
+    assert with_rules == without and len(with_rules) == 5
