@@ -1516,8 +1516,9 @@ class Executor:
             raise StaticFallback(
                 f"static expansion too large: {n} x fanout {bound}")
         counts = jnp.where(left.sel, counts, 0)
-        lidx = jnp.repeat(jnp.arange(n), bound, total_repeat_length=total)
-        k = jnp.tile(jnp.arange(bound), n)
+        lidx = jnp.repeat(jnp.arange(n, dtype=jnp.int32), bound,
+                          total_repeat_length=total)
+        k = jnp.tile(jnp.arange(bound, dtype=jnp.int32), n)
         slot_live = k < jnp.minimum(counts, bound)[lidx]
         rpos = jnp.clip(lb[lidx] + k, 0, max(order.shape[0] - 1, 0))
         ridx = order[rpos]
@@ -1649,7 +1650,10 @@ class Executor:
         return self._limit(self.exec_node(node.source), node.count)
 
     def _limit(self, b: Batch, n: int) -> Batch:
-        rank = jnp.cumsum(b.sel.astype(jnp.int64))
+        # int32 rank: capacity < 2^31, and i64 cumsum runs emulated on TPU;
+        # clamp the count host-side so a giant LIMIT cannot wrap int32
+        n = min(int(n), b.capacity)
+        rank = jnp.cumsum(b.sel.astype(jnp.int32))
         return b.with_sel(b.sel & (rank <= n))
 
     # ---- set ops ------------------------------------------------------

@@ -33,6 +33,15 @@ from presto_tpu.exec.colval import translate_codes
 
 I64_MIN = np.iinfo(np.int64).min
 I64_MAX = np.iinfo(np.int64).max
+I32_MAX = np.iinfo(np.int32).max
+
+
+def key_sentinel(key) -> int:
+    """Masked-row sentinel for a packed key array: the dtype's max
+    (narrow int32 keys avoid the TPU's emulated 64-bit integer ops —
+    the hardware has no native int64, so every i64 compare/sort/gather
+    runs as u32-pair fusions, measured ~8s of TPC-H Q18's runtime)."""
+    return I32_MAX if key.dtype == jnp.int32 else I64_MAX
 
 
 # ---------------------------------------------------------------------------
@@ -41,11 +50,13 @@ I64_MAX = np.iinfo(np.int64).max
 
 
 def pack_keys(cols: List[Column], sel, extra_cols: Optional[List[Column]] = None):
-    """Pack key columns into a single int64 key per row. Masked-out rows get
-    sentinel I64_MAX (sorts last, never matches). NULL in any key column
-    gets its own code (SQL GROUP BY treats NULLs as one group).
+    """Pack key columns into a single integer key per row — int32 when
+    the packed widths fit 30 bits (native on TPU), else int64.  Masked-out
+    rows get the dtype's max as sentinel (sorts last, never matches; see
+    key_sentinel). NULL in any key column gets its own code (SQL GROUP BY
+    treats NULLs as one group).
 
-    Returns (key: i64[n], layout) where layout allows packing another
+    Returns (key: i32[n]|i64[n], layout) where layout allows packing another
     column set with the same strides (for join build/probe sides pass
     `extra_cols` so both sides share ranges).
     """
@@ -80,16 +91,18 @@ def pack_keys(cols: List[Column], sel, extra_cols: Optional[List[Column]] = None
         layout.append((lo_h, stride, width))
         stride <<= width
     key = _apply_layout(cols, layout)
-    key = jnp.where(sel, key, I64_MAX)
+    key = jnp.where(sel, key, key_sentinel(key))
     return key, layout
 
 
 def _apply_layout(cols: List[Column], layout) -> jnp.ndarray:
+    total_bits = sum(w for _, _, w in layout)
+    kt = jnp.int32 if total_bits <= 30 else jnp.int64  # native i32 wins
     key = None
     for c, (lo, stride, width) in zip(cols, layout):
         d = _orderable_int(c)
         code = jnp.where(_valid_arr(c), d - lo + 1, 0)  # 0 = null code
-        contrib = code.astype(jnp.int64) * stride
+        contrib = code.astype(kt) * kt(stride)
         key = contrib if key is None else key + contrib
     return key
 
@@ -98,7 +111,7 @@ def pack_with_layout(cols: List[Column], sel, layout) -> jnp.ndarray:
     if layout is None:
         return _hash_keys(cols, sel)
     key = _apply_layout(cols, layout)
-    return jnp.where(sel, key, I64_MAX)
+    return jnp.where(sel, key, key_sentinel(key))
 
 
 _POW2 = None  # lazily-built exact power-of-two table (host constants)
@@ -269,13 +282,13 @@ def group_ids_static(key: jnp.ndarray, cap: int):
     overflow) — overflow True means cap was too small (caller re-runs in
     dynamic mode; the guard is checked once per query, not per op)."""
     n = key.shape[0]
-    order = jnp.argsort(key)
+    order = jnp.argsort(key).astype(jnp.int32)  # n < 2^31 always
     skey = key[order]
     newgrp = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
-    live_sorted = skey != I64_MAX
+    live_sorted = skey != key_sentinel(key)
     newgrp = newgrp & live_sorted
     n_groups = jnp.sum(newgrp)
-    gid_sorted = jnp.cumsum(newgrp) - 1
+    gid_sorted = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
     gid_sorted = jnp.where(live_sorted & (gid_sorted < cap), gid_sorted, cap)
     # inverse permutation via argsort+gather: a 6M-row permutation
     # SCATTER serializes on TPU (~7x slower than this sort+gather)
@@ -291,12 +304,12 @@ def group_ids(key: jnp.ndarray, sel) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
     representative row index per group [n_groups], n_groups).
     Masked rows get gid = n_groups (callers drop them via segment bounds)."""
     n = key.shape[0]
-    order = jnp.argsort(key)  # masked rows (I64_MAX) sort last
+    order = jnp.argsort(key).astype(jnp.int32)  # masked rows sort last
     skey = key[order]
     newgrp = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
-    live_sorted = skey != I64_MAX
+    live_sorted = skey != key_sentinel(key)
     newgrp = newgrp & live_sorted
-    gid_sorted = jnp.cumsum(newgrp) - 1
+    gid_sorted = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
     n_groups = int(jnp.sum(newgrp))
     gid_sorted = jnp.where(live_sorted, gid_sorted, n_groups)
     gid = gid_sorted[jnp.argsort(order)]  # see group_ids_static
@@ -435,28 +448,28 @@ def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray):
     scatter, ~3x faster end-to-end on the join-heavy TPC-H queries."""
     nb = build_key.shape[0]
     npr = probe_key.shape[0]
-    order = jnp.argsort(build_key)
+    order = jnp.argsort(build_key).astype(jnp.int32)
     n = nb + npr
     allk = jnp.concatenate([build_key, probe_key])
     flag = jnp.concatenate([jnp.zeros((nb,), jnp.int32),
                             jnp.ones((npr,), jnp.int32)])
     sk, sf, sidx = jax.lax.sort(
         (allk, flag, jnp.arange(n, dtype=jnp.int32)), num_keys=2)
-    is_build = (sf == 0).astype(jnp.int64)
+    is_build = (sf == 0).astype(jnp.int32)
     before = jnp.cumsum(is_build) - is_build  # builds strictly before pos
     # first position of each equal-key run via a running maximum
-    pos = jnp.arange(n)
+    pos = jnp.arange(n, dtype=jnp.int32)
     newrun = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
-    run_start = jax.lax.cummax(jnp.where(newrun, pos, -1))
+    run_start = jax.lax.cummax(jnp.where(newrun, pos, jnp.int32(-1)))
     # builds sort before probes within a run, so at a probe's position:
     #   lb = builds before its run (key <  probe key)
     #   ub = builds before itself  (key <= probe key)
     lb_at = before[jnp.clip(run_start, 0, n - 1)]
-    inv = jnp.argsort(sidx)  # gather-based inverse permutation
+    inv = jnp.argsort(sidx).astype(jnp.int32)  # gather-based inverse perm
     lb = lb_at[inv][nb:]
     ub = before[inv][nb:]
     # sentinel keys (masked build rows) must not match masked probe rows
-    live = probe_key != I64_MAX
+    live = probe_key != key_sentinel(probe_key)
     lb = jnp.where(live, lb, 0)
     ub = jnp.where(live, ub, 0)
     return order, lb, ub
