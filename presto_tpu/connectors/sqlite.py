@@ -39,6 +39,13 @@ _AFFINITY = [
 _INT_RE = _re.compile(r"(^|[^A-Z])(TINY|SMALL|MEDIUM|BIG)?INT(EGER)?\d*\b")
 
 
+def _qident(name: str) -> str:
+    """Quote an identifier for SQLite, escaping embedded double quotes —
+    hostile table/column names in an attached file must not break out of
+    the quoted context."""
+    return '"' + name.replace('"', '""') + '"'
+
+
 def _map_type(decl: str) -> T.Type:
     d = _re.sub(r"\(.*\)", "", (decl or "").upper()).strip()
     for key, t in _AFFINITY:
@@ -91,7 +98,7 @@ class SqliteTable(ConnectorTable):
     def read(self, columns: Optional[List[str]] = None,
              split: Optional[Tuple[int, int]] = None) -> Dict[str, np.ndarray]:
         cols = columns if columns is not None else list(self.schema)
-        sel = ", ".join(f'"{c}"' for c in cols)  # projection pushdown
+        sel = ", ".join(_qident(c) for c in cols)  # projection pushdown
         sql = f"SELECT {sel} FROM {self._quoted}"
         args: tuple = ()
         if split is not None and split[0] >= 0:
@@ -123,7 +130,7 @@ class SqliteTable(ConnectorTable):
         from presto_tpu.plan.stats import ColStats
 
         t = self.schema[column]
-        q = f'"{column}"'
+        q = _qident(column)
         if t.is_string:
             (ndv,) = self._conn().execute(
                 f"SELECT count(DISTINCT {q}) FROM {self._quoted}").fetchone()
@@ -154,11 +161,12 @@ def attach_sqlite(catalog: Catalog, path: str,
         "AND name NOT LIKE 'sqlite_%' ORDER BY name")]
     registered = []
     for name in names:
-        info = conn.execute(f'PRAGMA table_info("{name}")').fetchall()
+        info = conn.execute(
+            f"PRAGMA table_info({_qident(name)})").fetchall()
         # the engine's parser lowercases identifiers; SQLite resolves
         # quoted lowercase names case-insensitively, so read() still works
         schema = {r[1].lower(): _map_type(r[2]) for r in info}
-        t = SqliteTable(connect, name.lower(), schema, f'"{name}"')
+        t = SqliteTable(connect, name.lower(), schema, _qident(name))
         qualified = f"{catalog_name}.{name.lower()}"
         catalog.tables[qualified] = t  # one table object, both names
         t._catalog = catalog

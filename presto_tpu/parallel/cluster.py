@@ -30,8 +30,13 @@ data plane is XLA).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import hmac
+import ipaddress
 import json
+import os
 import pickle
+import secrets as _pysecrets
 import threading
 import time
 import urllib.request
@@ -42,6 +47,81 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from presto_tpu.native import serde as pserde
+
+
+# ---------------------------------------------------------------------------
+# control-plane authentication
+#
+# Task payloads are pickled plan fragments, i.e. executing a task is
+# executing code — so every worker endpoint requires a shared-secret HMAC
+# (reference ships JSON fragments + relies on network security; we must be
+# stricter because of pickle).  The secret is distributed via the
+# PRESTO_TPU_CLUSTER_SECRET env var (inherited by worker processes) or
+# set_cluster_secret().  Binding a non-loopback host without a secret is
+# refused outright.
+# ---------------------------------------------------------------------------
+
+AUTH_HEADER = "X-PrestoTPU-Auth"
+_SECRET_ENV = "PRESTO_TPU_CLUSTER_SECRET"
+_process_secret: Optional[bytes] = None
+
+
+def set_cluster_secret(secret) -> None:
+    """Set this process's cluster shared secret (str or bytes)."""
+    global _process_secret
+    _process_secret = (secret.encode() if isinstance(secret, str)
+                       else secret)
+
+
+def cluster_secret() -> Optional[bytes]:
+    if _process_secret is not None:
+        return _process_secret
+    s = os.environ.get(_SECRET_ENV)
+    return s.encode() if s else None
+
+
+_AUTH_MAX_SKEW = 300.0  # seconds a signed request stays valid
+
+
+def _sign(secret: bytes, method: str, path: str, body: bytes,
+          ts: Optional[str] = None) -> str:
+    """Header value `ts:mac` — the timestamp is signed, giving captured
+    requests a bounded replay window even over plaintext DCN."""
+    ts = ts if ts is not None else str(int(time.time()))
+    mac = hmac.new(secret, digestmod=hashlib.sha256)
+    mac.update(method.encode())
+    mac.update(b"\n")
+    mac.update(path.encode())
+    mac.update(b"\n")
+    mac.update(ts.encode())
+    mac.update(b"\n")
+    mac.update(body or b"")
+    return ts + ":" + mac.hexdigest()
+
+
+def _verify_auth(secret: bytes, header: str, method: str, path: str,
+                 body: bytes) -> bool:
+    ts, _, _ = header.partition(":")
+    try:
+        skew = abs(time.time() - int(ts))
+    except ValueError:
+        return False
+    if skew > _AUTH_MAX_SKEW:
+        return False
+    want = _sign(secret, method, path, body, ts=ts)
+    return hmac.compare_digest(header.encode("utf-8", "replace"),
+                               want.encode())
+
+
+def _is_loopback(host: str) -> bool:
+    if host == "":
+        return False  # '' binds INADDR_ANY — every interface
+    if host == "localhost":
+        return True
+    try:
+        return ipaddress.ip_address(host).is_loopback
+    except ValueError:
+        return False  # hostname — assume routable, require a secret
 
 
 # ---------------------------------------------------------------------------
@@ -275,6 +355,13 @@ class TaskSpec:
 def _http(url: str, data: Optional[bytes] = None, method: str = "GET",
           timeout: float = 60.0) -> bytes:
     req = urllib.request.Request(url, data=data, method=method)
+    secret = cluster_secret()
+    if secret is not None:
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)  # sign the full request target (path?query)
+        path = parts.path + ("?" + parts.query if parts.query else "")
+        req.add_header(AUTH_HEADER, _sign(secret, method, path, data or b""))
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return r.read()
 
@@ -461,12 +548,19 @@ class WorkerServer:
     result buffers (reference: SqlTaskManager + TaskResource)."""
 
     def __init__(self, catalog_spec: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, secret: Optional[bytes] = None):
         import presto_tpu
 
+        self.secret = secret if secret is not None else cluster_secret()
+        if self.secret is None and not _is_loopback(host):
+            raise ValueError(
+                f"refusing to bind non-loopback host {host!r} without a "
+                f"cluster secret: task payloads are executable; set "
+                f"{_SECRET_ENV} or pass secret=")
         self.session = presto_tpu.connect(make_catalog(catalog_spec))
         self.tasks: Dict[str, dict] = {}
         self.lock = threading.Lock()
+        self.exec_lock = threading.Lock()
         handler = _make_worker_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -495,10 +589,19 @@ class WorkerServer:
 
         def run():
             try:
-                for k, v in spec.properties.items():
-                    if k in self.session.properties:
-                        self.session.properties[k] = v
-                buffers = _ClusterExecutor(self.session, spec).run()
+                # one task at a time per worker; session properties are
+                # snapshotted/restored so overlapping coordinators can't
+                # leak settings into each other's tasks
+                with self.exec_lock:
+                    snapshot = dict(self.session.properties)
+                    try:
+                        for k, v in spec.properties.items():
+                            if k in self.session.properties:
+                                self.session.properties[k] = v
+                        buffers = _ClusterExecutor(self.session, spec).run()
+                    finally:
+                        self.session.properties.clear()
+                        self.session.properties.update(snapshot)
                 with self.lock:
                     task["buffers"] = buffers
                     task["state"] = "FINISHED"
@@ -528,10 +631,21 @@ def _make_worker_handler(server: WorkerServer):
             self.end_headers()
             self.wfile.write(body)
 
+        def _authorized(self, body: bytes = b"") -> bool:
+            if server.secret is None:
+                return True  # loopback-only dev mode (enforced at bind)
+            got = self.headers.get(AUTH_HEADER, "")
+            return _verify_auth(server.secret, got, self.command,
+                                self.path, body)
+
         def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            if not self._authorized(body):
+                self._send(401, b"{}", "application/json")
+                return
             if self.path == "/v1/task":
-                n = int(self.headers.get("Content-Length", 0))
-                spec = pickle.loads(self.rfile.read(n))
+                spec = pickle.loads(body)
                 server.submit(spec)
                 self._send(200, json.dumps(
                     {"taskId": spec.task_id}).encode(), "application/json")
@@ -542,6 +656,9 @@ def _make_worker_handler(server: WorkerServer):
                 self._send(404, b"{}")
 
         def do_GET(self):
+            if not self._authorized():
+                self._send(401, b"{}", "application/json")
+                return
             parts = self.path.strip("/").split("/")
             if self.path == "/v1/info":
                 self._send(200, json.dumps(
@@ -574,6 +691,9 @@ def _make_worker_handler(server: WorkerServer):
             self._send(404, b"{}")
 
         def do_DELETE(self):
+            if not self._authorized():
+                self._send(401, b"{}", "application/json")
+                return
             parts = self.path.strip("/").split("/")
             if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                 with server.lock:
@@ -800,6 +920,10 @@ def launch_local_cluster(session, catalog_spec: str, nworkers: int = 2,
     import subprocess
     import sys
 
+    if cluster_secret() is None:
+        set_cluster_secret(_pysecrets.token_hex(32))
+    env = dict(os.environ)
+    env[_SECRET_ENV] = cluster_secret().decode()
     procs = []
     urls = []
     for _ in range(nworkers):
@@ -807,7 +931,7 @@ def launch_local_cluster(session, catalog_spec: str, nworkers: int = 2,
             [sys.executable, "-m", "presto_tpu.parallel.cluster",
              "--catalog", catalog_spec],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True)
+            text=True, env=env)
         procs.append(p)
     import select
 

@@ -134,10 +134,14 @@ class PasswordAuthenticator:
 
 
 class FilePasswordAuthenticator(PasswordAuthenticator):
-    """htpasswd-style user:bcrypt-or-sha256 file (reference:
-    password-authenticators' file-based authenticator).  Lines are
-    `user:{scheme}hash`; supported schemes: {plain} (tests only) and
-    {sha256} of salt$hexdigest."""
+    """htpasswd-style credential file (reference: the
+    password-authenticators plugin's file-based authenticator).  Lines are
+    `user:{scheme}hash`; supported schemes: {pbkdf2} (default for new
+    hashes: pbkdf2_hmac-sha256, iterations$salt$hexdigest), {sha256}
+    (legacy single-round salt$hexdigest — accepted but weak), and {plain}
+    (tests only)."""
+
+    PBKDF2_ITERATIONS = 120_000
 
     def __init__(self, path: str):
         self.creds = {}
@@ -149,14 +153,16 @@ class FilePasswordAuthenticator(PasswordAuthenticator):
                 user, spec = line.split(":", 1)
                 self.creds[user] = spec
 
-    @staticmethod
-    def hash_password(password: str, salt: str = "") -> str:
+    @classmethod
+    def hash_password(cls, password: str, salt: str = "") -> str:
         import hashlib
         import secrets
 
-        salt = salt or secrets.token_hex(8)  # per-user random salt
-        d = hashlib.sha256((salt + "$" + password).encode()).hexdigest()
-        return "{sha256}" + salt + "$" + d
+        salt = salt or secrets.token_hex(16)  # per-user random salt
+        d = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), salt.encode(),
+            cls.PBKDF2_ITERATIONS).hex()
+        return "{pbkdf2}" + f"{cls.PBKDF2_ITERATIONS}${salt}${d}"
 
     def authenticate(self, user: str, password: str) -> str:
         import hashlib
@@ -167,6 +173,15 @@ class FilePasswordAuthenticator(PasswordAuthenticator):
             raise AuthenticationError(f"unknown user '{user}'")
         if spec.startswith("{plain}"):
             ok = _hmac.compare_digest(spec[len("{plain}"):], password)
+        elif spec.startswith("{pbkdf2}"):
+            try:
+                iters, salt, digest = spec[len("{pbkdf2}"):].split("$", 2)
+                d = hashlib.pbkdf2_hmac(
+                    "sha256", password.encode(), salt.encode(),
+                    int(iters)).hex()
+            except (ValueError, OverflowError):
+                raise AuthenticationError("malformed pbkdf2 credential")
+            ok = _hmac.compare_digest(digest, d)
         elif spec.startswith("{sha256}"):
             salt, _, digest = spec[len("{sha256}"):].partition("$")
             d = hashlib.sha256((salt + "$" + password).encode()).hexdigest()

@@ -140,3 +140,40 @@ def test_cluster_worker_failure_reported(cluster):
 
     st = CM._http(f"{url}/v1/task/t_bad_fragment/status")
     assert _json.loads(st)["state"] == "FAILED"
+
+
+def test_cluster_auth_rejects_unsigned_requests(cluster):
+    """Worker endpoints require the shared-secret HMAC: an unsigned POST
+    /v1/task (or GET) must get 401, not execute the pickled payload."""
+    import pickle
+    import urllib.error
+    import urllib.request
+
+    import presto_tpu.parallel.cluster as CM
+
+    assert CM.cluster_secret() is not None  # launch generated one
+    url = cluster[1].workers[0]
+    spec = CM.TaskSpec(
+        task_id="t_unsigned", fragment=pickle.dumps("payload"),
+        out_symbols=[], nworkers=1, windex=0, inputs=[])
+    req = urllib.request.Request(
+        f"{url}/v1/task", data=pickle.dumps(spec), method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10.0)
+    assert ei.value.code == 401
+    # a wrong secret must also fail
+    req2 = urllib.request.Request(
+        f"{url}/v1/task/t_unsigned/status", method="GET")
+    req2.add_header(CM.AUTH_HEADER, "0" * 64)
+    with pytest.raises(urllib.error.HTTPError) as ei2:
+        urllib.request.urlopen(req2, timeout=10.0)
+    assert ei2.value.code == 401
+
+
+def test_worker_refuses_public_bind_without_secret(monkeypatch):
+    import presto_tpu.parallel.cluster as CM
+
+    monkeypatch.delenv(CM._SECRET_ENV, raising=False)
+    monkeypatch.setattr(CM, "_process_secret", None)
+    with pytest.raises(ValueError, match="non-loopback"):
+        CM.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache", host="0.0.0.0")
