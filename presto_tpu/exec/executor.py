@@ -394,6 +394,12 @@ def _compact_batch(out: Batch, bound: int) -> Batch:
     return Batch(cols, jnp.arange(bound) < count)
 
 
+# results larger than this skip pack_fetch in favor of to_numpy's
+# selective fetch (pull sel, gather survivors) — matches batch.py's
+# _COMPACT_THRESHOLD reasoning
+_PACK_FETCH_MAX = 262_144
+
+
 def run_compiled(session, text: str, stmt) -> QueryResult:
     """Compiled execution: the WHOLE plan traces into one jitted XLA
     program over the scan batches (the reference compiles expressions to
@@ -425,6 +431,7 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
         _collect_tablescans(plan.root, scan_nodes)
 
         bound = _static_root_bound(plan.root)
+        meta_box: list = []  # static pack layout, captured at trace time
 
         def fn(batches):
             ex = Executor(session, static=True,
@@ -437,24 +444,39 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
                 guard = jnp.any(jnp.stack([jnp.asarray(g) for g in ex.guards]))
             else:
                 guard = jnp.asarray(False)
-            return out, guard
+            meta_box.clear()
+            if out.capacity > _PACK_FETCH_MAX:
+                # unbounded root over a scan-sized capacity: keep the
+                # Batch so to_numpy's selective fetch (pull sel, gather
+                # survivors) can avoid shipping the full columns
+                meta_box.append(None)
+                return out, guard
+            # one flat buffer -> ONE host fetch (see kernels.pack_fetch)
+            buf, meta = K.pack_fetch(out, guard)
+            meta_box.append(meta)
+            return buf
 
         jitted = jax.jit(fn)
         f32 = bool(session.properties.get("float32_compute", False))
         batches = [scan_batch(session.catalog.get(n.table), n, f32)
                    for n in scan_nodes]
-        out_batch, guard = jitted(batches)  # traces; may raise StaticFallback
-        cache[key] = (plan, jitted, scan_nodes)  # cache only after success
+        buf = jitted(batches)  # traces; may raise StaticFallback
+        meta = meta_box[0]
+        cache[key] = (plan, jitted, scan_nodes, meta)  # cache only after success
     else:
-        plan, jitted, scan_nodes = entry
+        plan, jitted, scan_nodes, meta = entry
         f32 = bool(session.properties.get("float32_compute", False))
         batches = [scan_batch(session.catalog.get(n.table), n, f32)
                    for n in scan_nodes]
-        out_batch, guard = jitted(batches)
-    # materialize pulls the guard in the SAME device fetch as the result —
-    # a separate bool(guard) costs a full tunnel round trip per query
+        buf = jitted(batches)
     ex = Executor(session)
-    result, guard_h = ex.materialize(plan, out_batch, extra=guard)
+    if meta is None:  # sparse/unbounded result: selective to_numpy fetch
+        out_batch, guard = buf
+        result, guard_h = ex.materialize(plan, out_batch, extra=guard)
+    else:
+        # single device fetch: result columns + guard ride one buffer
+        datas, sel, guard_h = K.unpack_fetch(jax.device_get(buf), meta)
+        result = ex.materialize_host(plan, meta, datas, sel)
     if bool(guard_h):
         # static assumption violated; data is static so it will trip again —
         # remember to go straight to dynamic next time (no retrace loop)
@@ -602,11 +624,32 @@ class Executor:
                     extra=None):
         """Batch -> QueryResult; `extra` (e.g. a guard scalar) rides the
         same device fetch, saving a tunnel round trip."""
-        out = plan.root
         if extra is not None:
             arrays, sel, extra_h = to_numpy(batch, extra)
         else:
             arrays, sel = to_numpy(batch)
+        result = self._format_result(plan, arrays, sel)
+        return (result, extra_h) if extra is not None else result
+
+    def materialize_host(self, plan: P.QueryPlan, meta: dict,
+                         datas: Dict[str, tuple], sel) -> QueryResult:
+        """Materialize from an unpack_fetch result (host numpy arrays):
+        dictionary/decimal decode, then row formatting."""
+        arrays = {}
+        for name, _dtype_s, _words, _has_valid, typ, dic in meta["cols"]:
+            data, valid = datas[name]
+            if dic is not None:
+                codes = np.clip(data, 0, len(dic) - 1)
+                data = dic.values[codes]
+            elif typ.is_decimal:
+                data = data.astype(np.float64) / (10 ** typ.decimal_scale)
+            if valid is not None:
+                data = np.ma.masked_array(data, mask=~valid)
+            arrays[name] = data
+        return self._format_result(plan, arrays, sel)
+
+    def _format_result(self, plan: P.QueryPlan, arrays, sel) -> QueryResult:
+        out = plan.root
         cols = []
         rows_data = []
         out_types = dict(out.source.outputs())
@@ -625,8 +668,7 @@ class Executor:
                     v = v.item()
                 row.append(v)
             rows.append(tuple(row))
-        result = QueryResult(cols, rows)
-        return (result, extra_h) if extra is not None else result
+        return QueryResult(cols, rows)
 
     def evaluate(self, plan: P.QueryPlan) -> Batch:
         # evaluate scalar subplans first (dependency order is registration order)
@@ -982,12 +1024,11 @@ class Executor:
         key, _ = K.pack_keys(key_cols, b.sel)
         gid, rep_rows, n_groups = K.group_ids(key, b.sel)
         out_cols: Dict[str, Column] = {}
-        for k in group_keys:
+        raw, _ = K.take_columns({k: b.columns[k] for k in group_keys},
+                                rep_rows)
+        for k, (data, valid) in raw.items():
             c = b.columns[k]
-            out_cols[k] = Column(
-                c.data[rep_rows],
-                None if c.valid is None else c.valid[rep_rows],
-                c.type, c.dictionary)
+            out_cols[k] = Column(data, valid, c.type, c.dictionary)
         fused = self._fused_sum_aggs(b, aggs, gid, n_groups)
         for sym, a in aggs.items():
             out_cols[sym] = fused.get(sym) or self._agg_column(b, a, gid, n_groups)
@@ -996,6 +1037,10 @@ class Executor:
             out_cols = {k: Column(c.data[:0], None if c.valid is None else c.valid[:0],
                                   c.type, c.dictionary) for k, c in out_cols.items()}
         return Batch(out_cols, sel)
+
+    # layouts this small use the packed key AS the group id (no sort at
+    # all); key columns are reconstructed from slot arithmetic
+    _DIRECT_GID_BITS = 12
 
     def _aggregate_static(self, b: Batch, group_keys, key_cols, aggs, node) -> Batch:
         cap = getattr(node, "capacity_hint", None) if node is not None else None
@@ -1007,13 +1052,50 @@ class Executor:
         key = K.pack_with_layout(key_cols, b.sel, layout)  # None -> hash, sync-free
         if layout is not None:
             self.guards.append(K.layout_range_guard(key_cols, b.sel, layout))
+            total_bits = sum(w for _, _, w in layout)
+            if total_bits <= self._DIRECT_GID_BITS and all(
+                    not jnp.issubdtype(c.data.dtype, jnp.floating)
+                    for c in key_cols):
+                return self._aggregate_direct(
+                    b, group_keys, key_cols, aggs, key, layout, total_bits)
         gid, rep_rows, exists, overflow = K.group_ids_static(key, cap)
         self.guards.append(overflow)
         out_cols: Dict[str, Column] = {}
-        for k in group_keys:
+        raw, _ = K.take_columns({k: b.columns[k] for k in group_keys},
+                                rep_rows)
+        for k, (data, valid) in raw.items():
             c = b.columns[k]
-            valid = None if c.valid is None else (c.valid[rep_rows] & exists)
-            out_cols[k] = Column(c.data[rep_rows], valid, c.type, c.dictionary)
+            out_cols[k] = Column(
+                data, None if valid is None else (valid & exists),
+                c.type, c.dictionary)
+        fused = self._fused_sum_aggs(b, aggs, gid, cap)
+        for sym, a in aggs.items():
+            out_cols[sym] = fused.get(sym) or self._agg_column(b, a, gid, cap)
+        return Batch(out_cols, exists)
+
+    def _aggregate_direct(self, b: Batch, group_keys, key_cols, aggs,
+                          key, layout, total_bits: int) -> Batch:
+        """Sort-free grouping for small static layouts: the packed key IS
+        the group id (a dense slot in [0, 2^total_bits)), and the key
+        columns come back from slot arithmetic instead of representative-
+        row gathers.  TPC-H Q1's whole grouping collapses to one
+        elementwise pass + the fused segmented reduction (reference
+        analog: BigintGroupByHash's direct small-range fast path,
+        operator/BigintGroupByHash.java)."""
+        cap = 1 << total_bits
+        # masked rows carry key_sentinel (huge) — clip sends them to the
+        # dead slot `cap`, which every segment kernel already ignores
+        gid = jnp.clip(key, 0, cap).astype(jnp.int32)
+        counts = K.segment_sum(
+            jnp.where(b.sel, 1.0, 0.0).astype(jnp.float32), gid, cap)
+        exists = counts > 0.5
+        slots = jnp.arange(cap, dtype=jnp.int64)
+        out_cols: Dict[str, Column] = {}
+        for k, c, (lo, stride, width) in zip(group_keys, key_cols, layout):
+            code = (slots // stride) & ((1 << width) - 1)
+            data = (code - 1 + lo).astype(c.data.dtype)
+            valid = None if c.valid is None else ((code != 0) & exists)
+            out_cols[k] = Column(data, valid, c.type, c.dictionary)
         fused = self._fused_sum_aggs(b, aggs, gid, cap)
         for sym, a in aggs.items():
             out_cols[sym] = fused.get(sym) or self._agg_column(b, a, gid, cap)
@@ -1121,21 +1203,27 @@ class Executor:
         if a.filter is not None:
             mask = mask & eval_predicate(a.filter, b, self.ctx)
         if a.fn in ("count",) and not a.args:
-            cnt = K.segment_sum(mask.astype(jnp.int64), gid, n_groups)
-            return Column(cnt, None, T.BIGINT)
+            # i32 accumulate: an i64 scatter-add runs as u32-pair
+            # emulation on TPU (~10x slower, measured); per-group row
+            # counts within one batch always fit i32
+            cnt = K.segment_sum(mask.astype(jnp.int32), gid, n_groups)
+            return Column(cnt.astype(jnp.int64), None, T.BIGINT)
         if a.fn == "count_if":
             v = eval_expr(a.args[0], b, self.ctx)
             m = mask & jnp.asarray(v.data)
             if v.valid is not None:
                 m = m & v.valid
-            return Column(K.segment_sum(m.astype(jnp.int64), gid, n_groups), None, T.BIGINT)
+            return Column(K.segment_sum(m.astype(jnp.int32), gid,
+                                        n_groups).astype(jnp.int64),
+                          None, T.BIGINT)
         if a.fn in ("merge_count", "merge_avg") or a.fn.startswith(
                 ("merge_stddev", "merge_var")):
             return self._merge_agg_column(b, a, gid, n_groups, mask)
         v = eval_expr(a.args[0], b, self.ctx)
         col = to_column(v, b.capacity)
         valid = mask if col.valid is None else (mask & col.valid)
-        cnt = K.segment_sum(valid.astype(jnp.int64), gid, n_groups)
+        cnt = K.segment_sum(valid.astype(jnp.int32), gid,
+                            n_groups).astype(jnp.int64)  # i32: see count
         nonempty = cnt > 0
         if a.fn == "count":
             return Column(cnt, None, T.BIGINT)
@@ -1362,7 +1450,7 @@ class Executor:
             valid = mask if c.valid is None else (mask & c.valid)
             x = jnp.where(valid, c.data, jnp.asarray(zero, c.data.dtype))
             return K.segment_sum(x, gid, n_groups), K.segment_sum(
-                valid.astype(jnp.int64), gid, n_groups)
+                valid.astype(jnp.int32), gid, n_groups).astype(jnp.int64)
 
         if a.fn == "merge_count":
             s, _ = summed(a.args[0], 0)
@@ -1489,9 +1577,11 @@ class Executor:
                 fb = Batch(merged, left.sel)
                 fmask = eval_predicate(node.filter, fb, self.ctx)
                 found = found & fmask
-                rbatch = K.gather_batch(right, ridx, idx_valid=found)
-                merged = dict(left.columns)
-                merged.update(rbatch.columns)
+                # data is independent of the match mask — only the
+                # validity tightens, so refresh masks without re-gathering
+                for name, c in rbatch.columns.items():
+                    v = found if c.valid is None else (c.valid & found)
+                    merged[name] = Column(c.data, v, c.type, c.dictionary)
             if jt == "SEMI":
                 return left.with_sel(left.sel & found)
             if jt == "ANTI":
@@ -1519,8 +1609,11 @@ class Executor:
         lidx = jnp.repeat(jnp.arange(n, dtype=jnp.int32), bound,
                           total_repeat_length=total)
         k = jnp.tile(jnp.arange(bound, dtype=jnp.int32), n)
-        slot_live = k < jnp.minimum(counts, bound)[lidx]
-        rpos = jnp.clip(lb[lidx] + k, 0, max(order.shape[0] - 1, 0))
+        cnt_l, lb_l = K.take_rows(
+            [jnp.minimum(counts, bound).astype(jnp.int32),
+             lb.astype(jnp.int32)], lidx)
+        slot_live = k < cnt_l
+        rpos = jnp.clip(lb_l + k, 0, max(order.shape[0] - 1, 0))
         ridx = order[rpos]
         lbatch = K.gather_batch(left, lidx)
         rbatch = K.gather_batch(right, ridx, idx_valid=slot_live)

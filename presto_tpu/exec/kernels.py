@@ -303,23 +303,31 @@ def nonzero_i32(mask: jnp.ndarray, size: int, fill: int) -> jnp.ndarray:
                      jnp.int32(fill))
 
 
+def unpermute(order: jnp.ndarray, *payloads):
+    """Carry payloads back to original row order: payload[i] moves to
+    position order[i].  One co-sort keyed on the permutation replaces
+    `payload[argsort(order)]` — on TPU an extra full-size GATHER costs
+    ~43ms per 6M rows (measured, Q1 xplane) while sort payload operands
+    ride along nearly free (8 payloads sort at 1-payload cost)."""
+    out = jax.lax.sort((order,) + payloads, num_keys=1)[1:]
+    return out[0] if len(out) == 1 else out
+
+
 def group_ids_static(key: jnp.ndarray, cap: int):
     """Static-shape grouping: same sort-based scheme as group_ids but with
     a fixed group capacity.  Returns (gid, rep_rows[cap], exists[cap],
     overflow) — overflow True means cap was too small (caller re-runs in
     dynamic mode; the guard is checked once per query, not per op)."""
     n = key.shape[0]
-    order = jnp.argsort(key).astype(jnp.int32)  # n < 2^31 always
-    skey = key[order]
+    skey, order = jax.lax.sort(
+        (key, jnp.arange(n, dtype=jnp.int32)), num_keys=1)
     newgrp = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
     live_sorted = skey != key_sentinel(key)
     newgrp = newgrp & live_sorted
     n_groups = jnp.sum(newgrp)
     gid_sorted = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
     gid_sorted = jnp.where(live_sorted & (gid_sorted < cap), gid_sorted, cap)
-    # inverse permutation via argsort+gather: a 6M-row permutation
-    # SCATTER serializes on TPU (~7x slower than this sort+gather)
-    gid = gid_sorted[jnp.argsort(order)]
+    gid = unpermute(order, gid_sorted)
     rep_pos = nonzero_i32(newgrp, cap, 0)
     rep_rows = order[rep_pos]
     exists = jnp.arange(cap) < n_groups
@@ -331,22 +339,24 @@ def group_ids(key: jnp.ndarray, sel) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
     representative row index per group [n_groups], n_groups).
     Masked rows get gid = n_groups (callers drop them via segment bounds)."""
     n = key.shape[0]
-    order = jnp.argsort(key).astype(jnp.int32)  # masked rows sort last
-    skey = key[order]
+    skey, order = jax.lax.sort(  # masked rows sort last
+        (key, jnp.arange(n, dtype=jnp.int32)), num_keys=1)
     newgrp = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
     live_sorted = skey != key_sentinel(key)
     newgrp = newgrp & live_sorted
     gid_sorted = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
     n_groups = int(jnp.sum(newgrp))
     gid_sorted = jnp.where(live_sorted, gid_sorted, n_groups)
-    gid = gid_sorted[jnp.argsort(order)]  # see group_ids_static
+    gid = unpermute(order, gid_sorted)
     # representative row per group = first sorted occurrence
     rep_sorted_pos = nonzero_i32(newgrp, max(n_groups, 1), 0)
     rep_rows = order[rep_sorted_pos][:n_groups] if n_groups else jnp.zeros((0,), order.dtype)
     return gid, rep_rows, n_groups
 
 
-_MATMUL_GROUPS = 128  # few-group segment sums go through the MXU instead
+_MATMUL_GROUPS = 4096  # few-group segment sums go through the MXU instead
+# (einsum against a fused one-hot costs ~7ms at 6M rows x 1024 groups,
+# measured, vs ~48ms per column for the TPU scatter-add lowering)
 
 
 def segment_sum(x, gid, n_groups):
@@ -492,9 +502,11 @@ def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray):
     #   lb = builds before its run (key <  probe key)
     #   ub = builds before itself  (key <= probe key)
     lb_at = before[jnp.clip(run_start, 0, n - 1)]
-    inv = jnp.argsort(sidx).astype(jnp.int32)  # gather-based inverse perm
-    lb = lb_at[inv][nb:]
-    ub = before[inv][nb:]
+    # co-sort keyed on the permutation carries lb/ub home without the
+    # two full-size inverse-perm gathers (see unpermute)
+    lb_all, ub_all = unpermute(sidx, lb_at, before)
+    lb = lb_all[nb:]
+    ub = ub_all[nb:]
     # sentinel keys (masked build rows) must not match masked probe rows
     live = probe_key != key_sentinel(probe_key)
     lb = jnp.where(live, lb, 0)
@@ -502,21 +514,193 @@ def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray):
     return order, lb, ub
 
 
+def take_rows(arrays: List[jnp.ndarray], idx: jnp.ndarray) -> List[jnp.ndarray]:
+    """Gather idx rows from every array, packing columns into one u32
+    matrix so ONE gather moves them all.  TPU gathers pay a fixed
+    per-index cost (~45ms per 6M f32 rows, measured) that amortizes
+    across the row width: gathering a (6M,8) matrix costs ~1/7th of 8
+    separate column gathers.  All 4-byte types bitcast to u32; bools
+    widen; i64 splits into two u32 words; f64 stays separate (the TPU
+    X64 rewriter cannot lower f64 bitcasts)."""
+    words: List[jnp.ndarray] = []    # u32 columns going into the pack
+    spec: List = [None] * len(arrays)  # how to rebuild each output
+    out: List = [None] * len(arrays)
+    for i, a in enumerate(arrays):
+        dt = a.dtype
+        if dt == jnp.bool_:
+            spec[i] = ("bool", len(words))
+            words.append(a.astype(jnp.uint32))
+        elif jnp.issubdtype(dt, jnp.floating) and dt.itemsize == 8:
+            spec[i] = ("direct", None)
+        elif dt.itemsize == 8:
+            spec[i] = ("i64", len(words))
+            m = jnp.asarray(0xFFFFFFFF, dt)  # dtype-matched (u64 vs i64)
+            words.append((a & m).astype(jnp.uint32))
+            words.append(((a >> 32) & m).astype(jnp.uint32))
+        elif dt.itemsize == 4:
+            spec[i] = ("cast", len(words))
+            words.append(jax.lax.bitcast_convert_type(a, jnp.uint32))
+        else:
+            spec[i] = ("widen", len(words))
+            words.append(jax.lax.bitcast_convert_type(
+                a.astype(jnp.int32), jnp.uint32))
+    if len(words) >= 3 and idx.shape[0] >= 65536:
+        packed = jnp.stack(words, axis=1)[idx]
+        col = lambda k: packed[:, k]
+    else:
+        taken = [w[idx] for w in words]
+        col = lambda k: taken[k]
+    for i, a in enumerate(arrays):
+        kind, k = spec[i]
+        dt = a.dtype
+        if kind == "direct":
+            out[i] = a[idx]
+        elif kind == "bool":
+            out[i] = col(k) != 0
+        elif kind == "i64":
+            lo = col(k).astype(jnp.int64)
+            hi = jax.lax.bitcast_convert_type(col(k + 1),
+                                              jnp.int32).astype(jnp.int64)
+            out[i] = ((hi << 32) | lo).astype(dt)
+        elif kind == "cast":
+            out[i] = jax.lax.bitcast_convert_type(col(k), dt)
+        else:  # widen
+            out[i] = jax.lax.bitcast_convert_type(
+                col(k), jnp.int32).astype(dt)
+    return out
+
+
+def take_columns(columns: Dict[str, Column], idx: jnp.ndarray,
+                 extra: Optional[List[jnp.ndarray]] = None):
+    """Gather idx rows of (data, valid) for every column in one packed
+    take_rows pass.  Returns ({name: (data, valid)}, [extra results]).
+    `extra` arrays ride the same pack."""
+    arrays = list(extra or [])
+    n_extra = len(arrays)
+    for c in columns.values():
+        arrays.append(c.data)
+        if c.valid is not None:
+            arrays.append(c.valid)
+    taken = take_rows(arrays, idx)
+    out = {}
+    i = n_extra
+    for name, c in columns.items():
+        data = taken[i]
+        i += 1
+        valid = None
+        if c.valid is not None:
+            valid = taken[i]
+            i += 1
+        out[name] = (data, valid)
+    return out, taken[:n_extra]
+
+
+def _take_batch(batch: Batch, safe: jnp.ndarray):
+    """Gather rows of all of a batch's arrays (data+valid+sel) at safe
+    (pre-clipped) indices with dtype-packed gathers."""
+    raw, (sel,) = take_columns(batch.columns, safe, extra=[batch.sel])
+    cols = {name: (data, valid, batch.columns[name].type,
+                   batch.columns[name].dictionary)
+            for name, (data, valid) in raw.items()}
+    return cols, sel
+
+
 def gather_batch(batch: Batch, idx: jnp.ndarray, idx_valid=None) -> Batch:
     """Gather rows of all columns at idx (clipped); optionally mask."""
     n = batch.capacity
     safe = jnp.clip(idx, 0, max(n - 1, 0))
+    raw, sel = _take_batch(batch, safe)
     cols = {}
-    for name, c in batch.columns.items():
-        data = c.data[safe]
-        valid = None if c.valid is None else c.valid[safe]
+    for name, (data, valid, typ, dic) in raw.items():
         if idx_valid is not None:
             valid = idx_valid if valid is None else (valid & idx_valid)
-        cols[name] = Column(data, valid, c.type, c.dictionary)
-    sel = batch.sel[safe]
+        cols[name] = Column(data, valid, typ, dic)
     if idx_valid is not None:
         sel = sel & idx_valid
     return Batch(cols, sel)
+
+
+def pack_fetch(batch: Batch, guard) -> Tuple[jnp.ndarray, dict]:
+    """Flatten a result batch (+ guard scalar) into ONE uint32 buffer so
+    the host pulls a single array: on tunneled TPU backends every array
+    in a fetched pytree adds ~4ms and the first costs a ~70ms round trip
+    (measured), so a 12-column result fetched column-wise pays ~2x the
+    packed fetch.  Returns (buffer, meta); unpack_fetch inverts on host.
+    Must be called under trace (jit) — meta is static."""
+    n = batch.capacity
+    parts = [jnp.asarray(batch.sel).astype(jnp.uint32)]
+    side = []  # f64 columns ride as separate pytree leaves (one RPC still)
+    cols_meta = []
+    for name, c in batch.columns.items():
+        d = c.data
+        if jnp.issubdtype(d.dtype, jnp.floating) and d.dtype.itemsize == 8:
+            # the TPU X64 rewriter cannot lower any f64 bitcast, so f64
+            # can't enter the u32 buffer; a separate leaf costs ~4ms on
+            # the tunnel vs ~70ms for a separate fetch
+            side.append(d)
+            w, words = None, 0
+        elif d.dtype == jnp.bool_:
+            w, words = d.astype(jnp.uint32), 1
+        elif d.dtype.itemsize == 8:
+            # i64 -> 2x32 via shifts/masks (64->32 bitcast unsupported)
+            lo = (d & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+            hi = ((d >> 32) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+            w = jnp.stack([lo, hi], axis=1).reshape(-1)
+            words = 2
+        elif d.dtype.itemsize == 4:
+            w, words = jax.lax.bitcast_convert_type(d, jnp.uint32), 1
+        else:  # narrow ints: widen (host casts back)
+            w = jax.lax.bitcast_convert_type(d.astype(jnp.int32), jnp.uint32)
+            words = 1
+        if w is not None:
+            parts.append(w)
+        if c.valid is not None:
+            parts.append(c.valid.astype(jnp.uint32))
+        cols_meta.append((name, str(d.dtype), words, c.valid is not None,
+                          c.type, c.dictionary))
+    parts.append(jnp.asarray(guard).astype(jnp.uint32).reshape(1))
+    meta = {"n": n, "cols": cols_meta}
+    return (jnp.concatenate(parts), side), meta
+
+
+def unpack_fetch(fetched, meta: dict):
+    """Host-side inverse of pack_fetch: returns ({name: (data, valid)},
+    sel, guard) as numpy arrays."""
+    buf, side = fetched
+    n = meta["n"]
+    buf = np.asarray(buf)
+    side = [np.asarray(a) for a in side]
+    si = 0
+    sel = buf[:n] != 0
+    off = n
+    datas = {}
+    for name, dtype_s, words, has_valid, _typ, _dic in meta["cols"]:
+        dt = np.dtype(dtype_s)
+        if words == 0:  # f64 side leaf
+            data = side[si]
+            si += 1
+        else:
+            raw = buf[off:off + n * words]
+            off += n * words
+            if dt == np.bool_:
+                data = raw != 0
+            elif words == 2:
+                lo = raw.reshape(n, 2)[:, 0].astype(np.uint64)
+                hi = raw.reshape(n, 2)[:, 1].astype(np.uint64)
+                data = (lo | (hi << np.uint64(32))).view(np.int64) \
+                    if dt == np.int64 else \
+                    (lo | (hi << np.uint64(32))).astype(dt)
+            elif dt.itemsize == 4:
+                data = raw.view(dt)
+            else:
+                data = raw.view(np.int32).astype(dt)
+        valid = None
+        if has_valid:
+            valid = buf[off:off + n] != 0
+            off += n
+        datas[name] = (data, valid)
+    guard = bool(buf[off]) if off < len(buf) else False
+    return datas, sel, guard
 
 
 def compact(batch: Batch) -> Batch:
@@ -526,10 +710,9 @@ def compact(batch: Batch) -> Batch:
     idx = nonzero_i32(batch.sel, max(n_live, 1), 0)
     if n_live == 0:
         idx = idx[:0]
-    cols = {}
-    for name, c in batch.columns.items():
-        cols[name] = Column(c.data[idx], None if c.valid is None else c.valid[idx],
-                            c.type, c.dictionary)
+    raw, _ = _take_batch(batch, idx)
+    cols = {name: Column(data, valid, typ, dic)
+            for name, (data, valid, typ, dic) in raw.items()}
     return Batch(cols, jnp.ones((n_live,), bool))
 
 
@@ -601,25 +784,23 @@ def sort_perm(batch: Batch, keys: List[Tuple[Column, bool, Optional[bool]]]):
     reference (NULLS LAST for ASC, NULLS FIRST for DESC —
     presto-parser SortItem.NullOrdering defaults)."""
     n = batch.capacity
-    perm = jnp.arange(n)
-    # stable sorts applied last-key-first
-    for col, asc, nulls_first in reversed(keys):
-        d = _orderable_int(col)[perm]
-        valid = _valid_arr(col)[perm]
-        if nulls_first is None:
-            nf = not asc
-        else:
-            nf = nulls_first
+    # ONE multi-operand lexicographic lax.sort: masked-rows-last is the
+    # primary key, then the sort keys in priority order, then a position
+    # tiebreak for stability.  Extra sort-key operands are nearly free on
+    # TPU, while the per-key argsort+gather chain this replaces paid a
+    # full-size gather per key (~43ms per 6M rows each, measured).
+    operands = [(~jnp.asarray(batch.sel)).astype(jnp.int32)]
+    for col, asc, nulls_first in keys:
+        d = _orderable_int(col)
+        valid = _valid_arr(col)
+        nf = (not asc) if nulls_first is None else nulls_first
         if not asc:
             d = -d
         null_sent = I64_MIN if nf else I64_MAX - 1
-        d = jnp.where(valid, d, null_sent)
-        order = jnp.argsort(d, stable=True)
-        perm = perm[order]
-    # push masked rows to the end (stable)
-    live = batch.sel[perm]
-    order = jnp.argsort(~live, stable=True)
-    return perm[order]
+        operands.append(jnp.where(valid, d, null_sent))
+    operands.append(jnp.arange(n, dtype=jnp.int32))
+    out = jax.lax.sort(tuple(operands), num_keys=len(operands))
+    return out[-1]
 
 
 # ---------------------------------------------------------------------------
