@@ -1,15 +1,22 @@
 """Benchmark driver entry point.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Metric: TPC-H rows/sec/chip across Q1/Q3/Q6 (round-1 set; Q9/Q18 join as
-the distributed path matures), measured on the real device with 1 prewarm +
-3 timed runs (methodology trimmed from the reference's benchto 2+6,
+Metric: TPC-H rows/sec/chip across the bench query set, measured on the
+real device with 1 prewarm + BENCH_RUNS timed runs (methodology trimmed
+from the reference's benchto 2+6 runs,
 presto-benchto-benchmarks/.../tpch.yaml).
 
-vs_baseline: wall-clock speedup vs the same queries on the sqlite oracle
-(the stand-in for "stock Java operators on the same worker" until a Presto
-JVM baseline is measurable in-image; BASELINE.md north star is >=5x)."""
+Baselines (VERDICT r1 asked for an honest one):
+- vs_baseline / vs_numpy: wall-clock speedup vs hand-tuned vectorized
+  numpy pipelines for the same queries over the same arrays
+  (bench_baselines.py) — a DuckDB-class single-core columnar yardstick.
+- vs_sqlite: the old oracle ratio (single-threaded row store; flattering,
+  kept for continuity with BENCH_r01).
+
+Extra keys: per_query_ms (warm best per query), sf, note.
+Env knobs: BENCH_SF, BENCH_QUERIES, BENCH_RUNS, BENCH_F32.
+"""
 
 import json
 import os
@@ -19,7 +26,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 SF = float(os.environ.get("BENCH_SF", "1.0"))
-QUERY_IDS = [int(x) for x in os.environ.get("BENCH_QUERIES", "1,3,6").split(",")]
+QUERY_IDS = [int(x) for x in os.environ.get("BENCH_QUERIES", "1,3,6,18").split(",")]
 RUNS = int(os.environ.get("BENCH_RUNS", "3"))
 
 
@@ -40,10 +47,9 @@ def main():
     if os.environ.get("BENCH_F32", "1") != "0":
         session.set("float32_compute", True)
 
-    # warm generation + device upload + compile caches
     engine_times = {}
     for qid in QUERY_IDS:
-        session.sql(QUERIES[qid])  # prewarm
+        session.sql(QUERIES[qid])  # prewarm (gen + upload + compile)
         best = float("inf")
         for _ in range(RUNS):
             t0 = time.perf_counter()
@@ -55,17 +61,61 @@ def main():
     # rows processed: dominated by lineitem scans per query
     rows_per_sec = lineitem_rows * len(QUERY_IDS) / total_engine
 
-    vs = baseline_speedup(engine_times)
+    vs_numpy = numpy_speedup(cat, engine_times)
+    vs_sqlite = sqlite_speedup(engine_times)
 
     print(json.dumps({
         "metric": f"tpch_sf{SF:g}_q{'_'.join(map(str, QUERY_IDS))}_rows_per_sec_per_chip",
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec/chip",
-        "vs_baseline": vs,
+        "vs_baseline": vs_numpy if vs_numpy is not None else vs_sqlite,
+        "vs_numpy": vs_numpy,
+        "vs_sqlite": vs_sqlite,
+        "per_query_ms": {str(q): round(t * 1000, 1)
+                         for q, t in engine_times.items()},
+        "sf": SF,
+        "note": ("vs_numpy = tuned vectorized numpy single-core; "
+                 "vs_sqlite = row-store oracle (flattering); "
+                 "warm times include ~100ms tunnel RTT per query"
+                 + ("" if vs_numpy is not None
+                    else "; NUMPY BASELINE FAILED - vs_baseline fell "
+                         "back to sqlite")),
     }))
 
 
-def baseline_speedup(engine_times):
+def numpy_speedup(cat, engine_times):
+    """Tuned numpy pipelines over the same in-memory arrays (honest
+    CPU-core baseline; see bench_baselines.py)."""
+    try:
+        from bench_baselines import NUMPY_QUERIES
+
+        tables = {t: cat.get(t) for t in ("lineitem", "orders", "customer")}
+        total = 0.0
+        covered = 0.0
+        for qid in engine_times:
+            fn = NUMPY_QUERIES.get(qid)
+            if fn is None:
+                continue
+            fn(tables)  # warm (column reads cache)
+            best = float("inf")
+            for _ in range(RUNS):  # same run count as the engine
+                t0 = time.perf_counter()
+                fn(tables)
+                best = min(best, time.perf_counter() - t0)
+            total += best
+            covered += engine_times[qid]
+        if covered == 0.0:
+            return None
+        return round(total / covered, 2)
+    except Exception as e:
+        # vs_baseline must not silently degrade to the flattering sqlite
+        # ratio — make the failure visible
+        print(f"bench: numpy baseline FAILED ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return None
+
+
+def sqlite_speedup(engine_times):
     try:
         from tests.sqlite_oracle import build_sqlite, to_sqlite
         from tests.tpch_queries import QUERIES

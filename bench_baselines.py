@@ -1,0 +1,129 @@
+"""Hand-tuned vectorized numpy implementations of the bench TPC-H
+queries — the honest single-core CPU baseline.
+
+VERDICT round 1 called out that the sqlite oracle flatters the engine
+(sqlite is a single-threaded row store).  These are the strongest
+straight-line numpy pipelines we can write for the same queries over the
+same generated arrays (hash-free: searchsorted joins, bincount
+aggregations) — closer to what a tuned columnar CPU engine (DuckDB-class
+per-core) does for these shapes.  Reference for the role:
+presto-benchmark/src/main/java/com/facebook/presto/benchmark/HandTpchQuery1.java
+(hand-built operator pipelines as the perf yardstick).
+"""
+
+import numpy as np
+
+from presto_tpu.connectors.tpch import _days
+
+
+def _col(table, name):
+    a = table.read([name])[name]
+    return np.asarray(a)
+
+
+def q1(tables):
+    li = tables["lineitem"]
+    ship = _col(li, "l_shipdate")
+    m = ship <= _days("1998-09-02")
+    rf = _col(li, "l_returnflag")[m]
+    ls = _col(li, "l_linestatus")[m]
+    qty = _col(li, "l_quantity")[m]
+    px = _col(li, "l_extendedprice")[m]
+    disc = _col(li, "l_discount")[m]
+    tax = _col(li, "l_tax")[m]
+    # group codes: returnflag/linestatus are low-cardinality strings
+    rf_codes, rf_inv = np.unique(rf, return_inverse=True)
+    ls_codes, ls_inv = np.unique(ls, return_inverse=True)
+    gid = rf_inv * len(ls_codes) + ls_inv
+    n = len(rf_codes) * len(ls_codes)
+    disc_px = px * (1.0 - disc)
+    out = []
+    sums = {
+        "qty": np.bincount(gid, qty, n),
+        "base": np.bincount(gid, px, n),
+        "disc": np.bincount(gid, disc_px, n),
+        "charge": np.bincount(gid, disc_px * (1.0 + tax), n),
+        "count": np.bincount(gid, minlength=n),
+        "disc_sum": np.bincount(gid, disc, n),
+    }
+    for g in np.flatnonzero(sums["count"]):
+        out.append((rf_codes[g // len(ls_codes)], ls_codes[g % len(ls_codes)],
+                    sums["qty"][g], sums["base"][g], sums["disc"][g],
+                    sums["charge"][g]))
+    return out
+
+
+def q6(tables):
+    li = tables["lineitem"]
+    ship = _col(li, "l_shipdate")
+    disc = _col(li, "l_discount")
+    qty = _col(li, "l_quantity")
+    m = ((ship >= _days("1994-01-01")) & (ship < _days("1995-01-01"))
+         & (disc >= 0.05) & (disc <= 0.07) & (qty < 24))
+    return float(np.sum(_col(li, "l_extendedprice")[m] * disc[m]))
+
+
+def q3(tables):
+    cu, od, li = tables["customer"], tables["orders"], tables["lineitem"]
+    seg = _col(cu, "c_mktsegment")
+    bkeys = np.sort(_col(cu, "c_custkey")[seg == "BUILDING"])
+    o_date = _col(od, "o_orderdate")
+    om = o_date < _days("1995-03-15")
+    o_ck = _col(od, "o_custkey")[om]
+    pos = np.clip(np.searchsorted(bkeys, o_ck), 0, max(len(bkeys) - 1, 0))
+    om2 = (bkeys[pos] == o_ck) if len(bkeys) else np.zeros(len(o_ck), bool)
+    o_key = _col(od, "o_orderkey")[om][om2]
+    o_dt = o_date[om][om2]
+    o_pri = _col(od, "o_shippriority")[om][om2]
+    o_order = np.argsort(o_key)
+    o_key_s = o_key[o_order]
+    ship = _col(li, "l_shipdate")
+    lm = ship > _days("1995-03-15")
+    l_ok = _col(li, "l_orderkey")[lm]
+    rev = (_col(li, "l_extendedprice")[lm]
+           * (1.0 - _col(li, "l_discount")[lm]))
+    p = np.clip(np.searchsorted(o_key_s, l_ok), 0,
+                max(len(o_key_s) - 1, 0))
+    hit = (o_key_s[p] == l_ok) if len(o_key_s) \
+        else np.zeros(len(l_ok), bool)
+    l_ok = l_ok[hit]
+    rev = rev[hit]
+    p = p[hit]
+    # group by matched order row (o_orderkey unique per order)
+    uniq, inv = np.unique(p, return_inverse=True)
+    rsum = np.bincount(inv, rev, len(uniq))
+    k = min(10, len(uniq))
+    # top 10 by revenue desc, date asc
+    dt = o_dt[o_order][uniq]
+    order = np.lexsort((dt, -rsum))[:k]
+    rows = [(int(o_key_s[uniq[i]]), float(rsum[i]),
+             int(dt[i]), int(o_pri[o_order][uniq[i]])) for i in order]
+    return rows
+
+
+def q18(tables):
+    cu, od, li = tables["customer"], tables["orders"], tables["lineitem"]
+    l_ok = _col(li, "l_orderkey")
+    qty = _col(li, "l_quantity")
+    # dense bincount over orderkey (keys are bounded by 4*orders)
+    hi = int(l_ok.max()) + 1 if len(l_ok) else 1
+    qsum = np.bincount(l_ok, qty, hi)
+    big = np.flatnonzero(qsum > 300.0)
+    o_key = _col(od, "o_orderkey")
+    om = np.isin(o_key, big)
+    o_key = o_key[om]
+    o_ck = _col(od, "o_custkey")[om]
+    o_dt = _col(od, "o_orderdate")[om]
+    o_tp = _col(od, "o_totalprice")[om]
+    c_key = _col(cu, "c_custkey")
+    c_order = np.argsort(c_key)
+    cpos = np.clip(np.searchsorted(c_key[c_order], o_ck), 0,
+                   max(len(c_key) - 1, 0))
+    cname = _col(cu, "c_name")[c_order][cpos]
+    tq = qsum[o_key]
+    order = np.lexsort((o_dt, -o_tp))[:100]
+    return [(cname[i], int(o_ck[i]), int(o_key[i]), int(o_dt[i]),
+             float(o_tp[i]), float(tq[i])) for i in order]
+
+
+NUMPY_QUERIES = {1: q1, 3: q3, 6: q6, 18: q18}

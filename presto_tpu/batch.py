@@ -225,17 +225,22 @@ def to_numpy(batch: Batch, extra=None):
     return (out, sel) if extra is None else (out, sel, extra_h)
 
 
+def decode_host_column(data, valid, typ, dictionary) -> np.ndarray:
+    """Decode one pulled column on host: dictionary lookup, decimal
+    rescale, NULL masking.  Shared by every result-materialization path
+    (to_numpy and the compiled packed fetch)."""
+    data = np.asarray(data)
+    if dictionary is not None:
+        codes = np.clip(data, 0, len(dictionary) - 1)
+        data = dictionary.values[codes]
+    elif typ.is_decimal:
+        data = data.astype(np.float64) / (10 ** typ.decimal_scale)
+    if valid is not None:
+        data = np.ma.masked_array(data, mask=~np.asarray(valid))
+    return data
+
+
 def _decode_pulled(batch: Batch, datas) -> Dict[str, np.ndarray]:
-    out = {}
-    for name, col in batch.columns.items():
-        data, valid = datas[name]
-        data = np.asarray(data)
-        if col.dictionary is not None:
-            codes = np.clip(data, 0, len(col.dictionary) - 1)
-            data = col.dictionary.values[codes]
-        elif col.type.is_decimal:
-            data = data.astype(np.float64) / (10 ** col.type.decimal_scale)
-        if valid is not None:
-            data = np.ma.masked_array(data, mask=~np.asarray(valid))
-        out[name] = data
-    return out
+    return {name: decode_host_column(datas[name][0], datas[name][1],
+                                     col.type, col.dictionary)
+            for name, col in batch.columns.items()}

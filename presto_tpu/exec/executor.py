@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu import types as T
-from presto_tpu.batch import Batch, Column, batch_from_numpy, to_numpy
+from presto_tpu.batch import (Batch, Column, batch_from_numpy,
+                              decode_host_column, to_numpy)
 from presto_tpu.exec import kernels as K
 from presto_tpu.exec.colval import ColVal
 from presto_tpu.exec.compiler import EvalContext, eval_expr, eval_predicate, to_column
@@ -638,14 +639,7 @@ class Executor:
         arrays = {}
         for name, _dtype_s, _words, _has_valid, typ, dic in meta["cols"]:
             data, valid = datas[name]
-            if dic is not None:
-                codes = np.clip(data, 0, len(dic) - 1)
-                data = dic.values[codes]
-            elif typ.is_decimal:
-                data = data.astype(np.float64) / (10 ** typ.decimal_scale)
-            if valid is not None:
-                data = np.ma.masked_array(data, mask=~valid)
-            arrays[name] = data
+            arrays[name] = decode_host_column(data, valid, typ, dic)
         return self._format_result(plan, arrays, sel)
 
     def _format_result(self, plan: P.QueryPlan, arrays, sel) -> QueryResult:
