@@ -139,16 +139,54 @@ class TpchTable(ConnectorTable):
     def splits(self, n_splits):
         return tpch_gen.split_ranges(self.name, self.sf, n_splits)
 
+    def pushdown_like(self, column: str, pattern: str):
+        """Connector LIKE pushdown: returns a BOOLEAN virtual column
+        name evaluable at scan (generator word draws), or None."""
+        return tpch_gen.like_pushdown_virtual(self.name, column, pattern)
+
     def read(self, columns=None, split=None):
         cols = columns if columns is not None else list(self.schema)
+        virtual = [c for c in cols if "$contains$" in c]
+        cols = [c for c in cols if "$contains$" not in c]
         data = self._full_table()
         if split is not None:
             a, b = split
             if self.name == "lineitem":
                 lo, hi = tpch_gen.lineitem_offsets(a, b)
-                return {c: data[c][lo:hi] for c in cols}
-            return {c: data[c][a:b] for c in cols}
-        return {c: data[c] for c in cols}
+                out = {c: data[c][lo:hi] for c in cols}
+            else:
+                out = {c: data[c][a:b] for c in cols}
+        else:
+            out = {c: data[c] for c in cols}
+        for v in virtual:
+            word = v.rsplit("$", 1)[1]
+            a, b = split if split is not None else (0, self.row_count())
+            out[v] = tpch_gen.part_name_contains(a, b - a, word)
+        return out
+
+    def device_columns(self, columns, f32=False):
+        """Generate columns directly on device (no host round trip) when
+        the device generator covers them; returns None otherwise and the
+        caller falls back to read().  See connectors/tpch_device.py."""
+        from presto_tpu.connectors import tpch_device as D
+
+        if not all(D.is_device_generable(self.name, c) for c in columns):
+            return None
+        import jax
+
+        key = (tuple(sorted(columns)), f32)
+        cache = getattr(self, "_device_gen_jit", None)
+        if cache is None:
+            cache = self._device_gen_jit = {}
+        fn = cache.get(key)
+        if fn is None:
+            cols = list(key[0])
+
+            def gen():
+                return D.generate_device(self.name, self.sf, cols, f32=f32)
+
+            fn = cache[key] = jax.jit(gen)
+        return fn()
 
     def _full_table(self):
         if not hasattr(self, "_data"):

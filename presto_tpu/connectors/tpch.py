@@ -473,6 +473,51 @@ def generate(table: str, sf: float = 1.0, row0: int = 0, row1: int | None = None
     return _GENERATORS[table](sf, row0, row1)
 
 
+def like_pushdown_virtual(table: str, column: str, pattern: str):
+    """Virtual-column name for a connector-evaluable LIKE predicate, or
+    None.  `p_name LIKE '%word%'` is decidable from the generator's word
+    DRAWS without materializing any string (reference analog: TupleDomain
+    predicate pushdown into the connector, PickTableLayout): p_name is 5
+    vocabulary words joined by spaces, so a single-word substring match
+    (where no other vocabulary word contains it) holds iff some draw
+    picked that word."""
+    if table != "part" or column != "p_name":
+        return None
+    if len(pattern) < 3 or not (pattern.startswith("%")
+                                and pattern.endswith("%")):
+        return None
+    word = pattern[1:-1]
+    if "%" in word or "_" in word or " " in word:
+        return None
+    containing = [c for c in COLORS if word in c]
+    if containing != [word]:
+        return None  # ambiguous: substring of another vocabulary word
+    return f"p_name$contains${word}"
+
+
+def part_name_contains(row0: int, n: int, word: str) -> np.ndarray:
+    """Host evaluation of the p_name LIKE '%word%' virtual column."""
+    idx = np.floor(_raw("part", "name", row0, n, 5) * len(COLORS)).astype(
+        np.int64)
+    return (idx == COLORS.index(word)).any(axis=1)
+
+
+def chunk_grid(sf: float, chunk_orders: int):
+    """Order-row chunk grid + lineitem offsets for chunked execution:
+    returns (order_edges[n+1], line_offsets[n+1]).  Buckets are
+    order-row ranges, so every orderkey's lineitems live in exactly one
+    chunk (the connector-bucketing property grouped execution needs)."""
+    n_orders = int(_TABLE_ROWS["orders"] * sf)
+    edges = list(range(0, n_orders, chunk_orders)) + [n_orders]
+    if edges[-2] == edges[-1]:
+        edges.pop()
+    line_offsets = [0]
+    for a, b in zip(edges[:-1], edges[1:]):
+        counts = _lines_per_order(np.arange(a, b, dtype=np.int64))
+        line_offsets.append(line_offsets[-1] + int(np.sum(counts)))
+    return edges, line_offsets
+
+
 def split_ranges(table: str, sf: float, n_splits: int) -> list[tuple[int, int]]:
     """Even row-range splits (order-ranges for lineitem)."""
     total = int(_TABLE_ROWS["orders"] * sf) if table == "lineitem" else row_count(table, sf)

@@ -164,6 +164,26 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
                 jax.errors.TracerArrayConversionError):
             pass  # single-device paths below
     mode = session.properties.get("execution_mode", "auto")
+    if mode in ("auto", "compiled", "chunked"):
+        # grouped/chunked execution when a scanned table exceeds the HBM
+        # residency threshold (reference: grouped execution, Lifespan)
+        from presto_tpu.exec import chunked as CH
+
+        needs_chunks = False
+        if mode == "chunked" or CH.catalog_may_need_chunks(session):
+            try:
+                plan_probe = plan_statement(session, stmt)
+                needs_chunks = CH.chunk_plan_needed(session, plan_probe)
+            except Exception:
+                needs_chunks = False
+        if needs_chunks or mode == "chunked":
+            try:
+                with mon.phase("execute"):
+                    mon.stats.execution_mode = "chunked"
+                    return CH.run_chunked(session, stmt, text)
+            except CH.Unchunkable:
+                if mode == "chunked":
+                    raise
     if mode in ("auto", "compiled"):
         try:
             with mon.phase("execute"):
@@ -1836,22 +1856,34 @@ def scan_batch(table, node: P.TableScan, f32: bool = False) -> Batch:
             f32cache = table._device_cols_f32 = {}
 
     def cache_for(colname):
-        if f32 and table.schema[colname].name == "DOUBLE":
+        # virtual pushdown columns are not in the schema (BOOLEAN)
+        t = table.schema.get(colname)
+        if f32 and t is not None and t.name == "DOUBLE":
             return f32cache
         return base
 
     needed = list(dict.fromkeys(node.assignments.values()))
     missing = [c for c in needed if c not in cache_for(c)]
     if missing:
-        from presto_tpu.batch import column_from_numpy
+        dev = None
+        if hasattr(table, "device_columns"):
+            # generator connectors produce columns ON DEVICE (one jitted
+            # program, no host materialization or H2D upload)
+            dev = table.device_columns(missing, f32=f32)
+        if dev is not None:
+            for c in missing:
+                cache_for(c)[c] = dev[c]
+        else:
+            from presto_tpu.batch import column_from_numpy
 
-        data = table.read(missing)
-        for c in missing:
-            col = column_from_numpy(data[c], table.schema[c])
-            if f32 and table.schema[c].name == "DOUBLE":
-                col = Column(col.data.astype(jnp.float32), col.valid,
-                             col.type, col.dictionary)
-            cache_for(c)[c] = col
+            data = table.read(missing)
+            for c in missing:
+                t = table.schema.get(c, T.BOOLEAN)  # virtual: BOOLEAN
+                col = column_from_numpy(data[c], t)
+                if f32 and t.name == "DOUBLE":
+                    col = Column(col.data.astype(jnp.float32), col.valid,
+                                 col.type, col.dictionary)
+                cache_for(c)[c] = col
     cols = {}
     n = None
     for sym, col in node.assignments.items():

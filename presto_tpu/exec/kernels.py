@@ -329,7 +329,10 @@ def group_ids_static(key: jnp.ndarray, cap: int):
     gid_sorted = jnp.where(live_sorted & (gid_sorted < cap), gid_sorted, cap)
     gid = unpermute(order, gid_sorted)
     rep_pos = nonzero_i32(newgrp, cap, 0)
-    rep_rows = order[rep_pos]
+    if n == 0:  # empty input (e.g. zero-row exchange buffer)
+        rep_rows = jnp.zeros((cap,), jnp.int32)
+    else:
+        rep_rows = order[rep_pos]
     exists = jnp.arange(cap) < n_groups
     return gid, rep_rows, exists, n_groups > cap
 
@@ -522,6 +525,11 @@ def take_rows(arrays: List[jnp.ndarray], idx: jnp.ndarray) -> List[jnp.ndarray]:
     separate column gathers.  All 4-byte types bitcast to u32; bools
     widen; i64 splits into two u32 words; f64 stays separate (the TPU
     X64 rewriter cannot lower f64 bitcasts)."""
+    if arrays and arrays[0].shape[0] == 0 and idx.shape[0] > 0:
+        # gathering from an EMPTY source (e.g. a zero-row exchange
+        # buffer): every index is dead and the caller masks the result —
+        # type-correct zeros avoid an out-of-range XLA gather
+        return [jnp.zeros((idx.shape[0],), a.dtype) for a in arrays]
     words: List[jnp.ndarray] = []    # u32 columns going into the pack
     spec: List = [None] * len(arrays)  # how to rebuild each output
     out: List = [None] * len(arrays)

@@ -1,4 +1,4 @@
-"""Window function execution.
+"""Window function execution — fully on-device (jnp), jit-compatible.
 
 Reference parity: operator/WindowOperator.java + the 21 window function
 implementations in operator/window/ (RowNumberFunction, RankFunction,
@@ -6,19 +6,30 @@ NthValueFunction, LagFunction, ...; framing in WindowPartition.java).
 The reference sorts each partition with PagesIndex and walks frames row
 by row; here the whole batch is sorted once by (partition, order) keys
 and every function is computed as a vectorized prefix/segment scan over
-the sorted column — the TPU-friendly formulation (no per-row loop).
+the sorted columns — the TPU-friendly formulation (no per-row loop,
+no host round trips), so windowed queries compile into the same XLA
+program as the rest of the fragment and distribute by hash-partitioning
+on the partition keys (sql/planner/optimizations/AddExchanges.java
+inserts the same partitioned exchange for WindowNode).
 
-Framing: ROWS/RANGE with UNBOUNDED/CURRENT/k-offset bounds.  Sum-like
+Framing: ROWS/RANGE with UNBOUNDED/CURRENT/k-offset bounds.  Frame
+SHAPE is decided at plan time (the spec is static), so the
+prefix-vs-suffix-vs-sliding strategy never branches on data.  Sum-like
 aggregates use prefix-sum differences over per-row [frame_start,
-frame_end] index vectors; min/max use segmented Hillis-Steele scans
-(supported when a running scan can answer the frame, which covers the
-default frame, whole-partition frames, and suffix frames).
+frame_end] index vectors; min/max use segmented Hillis-Steele scans or
+a sparse-table (doubling) range query for bounded ROWS frames.
+
+Masked (sel=False) rows sort last and form their own partition runs via
+a leading liveness sort/partition key, so static mode needs no
+compaction: dead rows produce garbage outputs that stay masked.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu import types as T
@@ -33,52 +44,51 @@ class WindowError(Exception):
 
 
 def execute_window(ex, node: P.Window) -> Batch:
-    from presto_tpu.exec.executor import StaticFallback
-
-    if ex.static:
-        raise StaticFallback("window functions run in dynamic mode")
     b = ex.exec_node(node.source)
-    b = K.compact(b)
-    # sort by (partition keys ASC, order keys as specified); stable
+    if not ex.static:
+        b = K.compact(b)
+    n = b.capacity
+    live_col = Column(jnp.asarray(b.sel), None, T.BOOLEAN)
+    # sort by (liveness, partition keys ASC, order keys as specified);
+    # sort_perm already puts masked rows last, and the liveness flag as a
+    # partition key fences them into their own (garbage, masked) runs
     keys = [(b.columns[s], True, None) for s in node.partition_by]
     keys += [(b.columns[s], asc, nf) for s, asc, nf in node.order_by]
-    if keys:
+    if keys or (ex.static and n):
+        # static mode must sort even for OVER (): interleaved masked
+        # rows would otherwise split the single partition into
+        # per-liveness runs (sort_perm orders masked rows last)
         perm = K.sort_perm(b, keys)
         b = K.gather_batch(b, perm)
-    n = b.capacity
+        live_col = Column(jnp.asarray(b.sel), None, T.BOOLEAN)
     cols = dict(b.columns)
     if n == 0:
         for sym, call in node.functions.items():
-            dt = np.dtype(object) if call.type.is_string else call.type.numpy_dtype()
-            cols[sym] = Column(np.zeros(0, dt), None, call.type, None)
+            dt = (np.dtype(np.int32) if call.type.is_string
+                  else call.type.numpy_dtype())
+            cols[sym] = Column(jnp.zeros(0, dt), None, call.type, None)
         return Batch(cols, b.sel)
 
-    part_cols = [b.columns[s] for s in node.partition_by]
+    part_cols = [live_col] + [b.columns[s] for s in node.partition_by]
     order_cols = [b.columns[s] for s, _, _ in node.order_by]
-    ctx = _FrameContext(n, part_cols, order_cols, node.order_by and True or False,
+    ctx = _FrameContext(n, part_cols, order_cols, bool(node.order_by),
                         node.frame)
     for sym, call in node.functions.items():
         cols[sym] = _compute(ctx, b, call)
-    return Batch(cols, np.ones(n, dtype=bool))
+    return Batch(cols, b.sel)
 
 
-def _col_host(c: Column) -> Tuple[np.ndarray, Optional[np.ndarray]]:
-    d = np.asarray(c.data)
-    v = None if c.valid is None else np.asarray(c.valid)
-    return d, v
-
-
-def _adjacent_change(cols: List[Column], n: int) -> np.ndarray:
+def _adjacent_change(cols: List[Column], n: int) -> jnp.ndarray:
     """new[i] = row i differs from row i-1 on any column (nulls equal)."""
-    new = np.zeros(n, dtype=bool)
-    new[0] = True
+    new = jnp.zeros(n, dtype=bool).at[0].set(True)
     for c in cols:
-        d, v = _col_host(c)
+        d = jnp.asarray(c.data)
         diff = d[1:] != d[:-1]
+        v = c.valid
         if v is not None:
             both_null = ~v[1:] & ~v[:-1]
-            diff = np.where(both_null, False, diff | (v[1:] != v[:-1]))
-        new[1:] |= diff
+            diff = jnp.where(both_null, False, diff | (v[1:] != v[:-1]))
+        new = new.at[1:].set(new[1:] | diff)
     return new
 
 
@@ -88,63 +98,80 @@ class _FrameContext:
 
     def __init__(self, n, part_cols, order_cols, has_order, frame):
         self.n = n
-        ar = np.arange(n)
+        ar = jnp.arange(n)
         self.ar = ar
-        self.part_new = (_adjacent_change(part_cols, n) if part_cols
-                         else _first_only(n))
+        self.part_new = _adjacent_change(part_cols, n)
         # no ORDER BY: every partition row is a peer of every other
-        self.peer_new = self.part_new | (
-            _adjacent_change(order_cols, n) if order_cols else False)
-        self.part_id = np.cumsum(self.part_new) - 1
-        self.part_start = np.maximum.accumulate(np.where(self.part_new, ar, 0))
-        sizes = np.bincount(self.part_id)
-        self.part_size = sizes[self.part_id]
-        self.part_end = self.part_start + self.part_size - 1
-        self.peer_start = np.maximum.accumulate(np.where(self.peer_new, ar, 0))
-        nxt = np.append(self.peer_new[1:], True)
-        self.peer_end = np.minimum.accumulate(
-            np.where(nxt, ar, n)[::-1])[::-1]
+        if order_cols:
+            self.peer_new = self.part_new | _adjacent_change(order_cols, n)
+        else:
+            self.peer_new = self.part_new
+        self.part_id = jnp.cumsum(self.part_new.astype(jnp.int32)) - 1
+        self.part_start = jax.lax.cummax(
+            jnp.where(self.part_new, ar, 0))
+        nxt_part = jnp.concatenate(
+            [self.part_new[1:], jnp.ones(1, bool)])
+        self.part_end = jnp.flip(jax.lax.cummin(
+            jnp.flip(jnp.where(nxt_part, ar, n))))
+        self.part_size = self.part_end - self.part_start + 1
+        self.peer_start = jax.lax.cummax(
+            jnp.where(self.peer_new, ar, 0))
+        nxt = jnp.concatenate([self.peer_new[1:], jnp.ones(1, bool)])
+        self.peer_end = jnp.flip(jax.lax.cummin(
+            jnp.flip(jnp.where(nxt, ar, n))))
         self.rn = ar - self.part_start + 1
         self.has_order = has_order
         self.frame = frame
 
     def frame_bounds(self):
-        """Per-row [fs, fe] row-index bounds (inclusive); empty if fs>fe."""
+        """(fs, fe, shape) — per-row inclusive bounds plus the STATIC
+        frame shape tag: 'prefix' (fs==part_start), 'suffix'
+        (fe==part_end), 'whole', 'single', 'sliding:<maxw>'."""
         if self.frame is None:
             if self.has_order:
-                ftype, start, end = "RANGE", "UNBOUNDED PRECEDING", "CURRENT ROW"
+                ftype, start, end = ("RANGE", "UNBOUNDED PRECEDING",
+                                     "CURRENT ROW")
             else:
                 ftype, start, end = ("ROWS", "UNBOUNDED PRECEDING",
                                      "UNBOUNDED FOLLOWING")
         else:
             ftype, start, end = self.frame
-        fs = self._bound(ftype, start, is_start=True)
-        fe = self._bound(ftype, end, is_start=False)
-        fs = np.maximum(fs, self.part_start)
-        fe = np.minimum(fe, self.part_end)
-        return fs, fe
+        fs, s_off = self._bound(ftype, start, is_start=True)
+        fe, e_off = self._bound(ftype, end, is_start=False)
+        fs = jnp.maximum(fs, self.part_start)
+        fe = jnp.minimum(fe, self.part_end)
+        if start == "UNBOUNDED PRECEDING" and end == "UNBOUNDED FOLLOWING":
+            shape = "whole"
+        elif start == "UNBOUNDED PRECEDING":
+            shape = "prefix"
+        elif end == "UNBOUNDED FOLLOWING":
+            shape = "suffix"
+        elif ftype == "ROWS" and start == end == "CURRENT ROW":
+            shape = "single"
+        elif ftype == "RANGE" and start == end == "CURRENT ROW":
+            shape = "peer"  # the whole peer group (width is data-dependent)
+        else:
+            maxw = (s_off or 0) + (e_off or 0) + 1
+            shape = f"sliding:{maxw}"
+        return fs, fe, shape
 
     def _bound(self, ftype, spec, is_start):
+        """Returns (index vector, static offset magnitude or None)."""
         ar = self.ar
         if spec == "UNBOUNDED PRECEDING":
-            return self.part_start
+            return self.part_start, None
         if spec == "UNBOUNDED FOLLOWING":
-            return self.part_end
+            return self.part_end, None
         if spec == "CURRENT ROW":
             if ftype == "ROWS":
-                return ar
-            return self.peer_start if is_start else self.peer_end
+                return ar, 0
+            return (self.peer_start, None) if is_start \
+                else (self.peer_end, None)
         k_str, direction = spec.split()
         k = int(k_str)
         if ftype != "ROWS":
             raise WindowError("RANGE with offset frame bounds not supported")
-        return ar - k if direction == "PRECEDING" else ar + k
-
-
-def _first_only(n):
-    a = np.zeros(n, dtype=bool)
-    a[0] = True
-    return a
+        return (ar - k if direction == "PRECEDING" else ar + k), k
 
 
 # ---------------------------------------------------------------------------
@@ -158,16 +185,16 @@ def _compute(ctx: _FrameContext, b: Batch, call: ir.AggCall) -> Column:
     if fn == "rank":
         return _int_col(ctx.peer_start - ctx.part_start + 1, call.type)
     if fn == "dense_rank":
-        dr = np.cumsum(ctx.peer_new)
+        dr = jnp.cumsum(ctx.peer_new.astype(jnp.int64))
         return _int_col(dr - dr[ctx.part_start] + 1, call.type)
     if fn == "percent_rank":
         rank = ctx.peer_start - ctx.part_start + 1
-        denom = np.maximum(ctx.part_size - 1, 1)
-        out = np.where(ctx.part_size > 1, (rank - 1) / denom, 0.0)
-        return Column(out.astype(np.float64), None, call.type, None)
+        denom = jnp.maximum(ctx.part_size - 1, 1)
+        out = jnp.where(ctx.part_size > 1, (rank - 1) / denom, 0.0)
+        return Column(out.astype(jnp.float64), None, call.type, None)
     if fn == "cume_dist":
         out = (ctx.peer_end - ctx.part_start + 1) / ctx.part_size
-        return Column(out.astype(np.float64), None, call.type, None)
+        return Column(out.astype(jnp.float64), None, call.type, None)
     if fn == "ntile":
         k = _lit_int(call.args[0], "ntile bucket count")
         if k < 1:
@@ -181,7 +208,7 @@ def _compute(ctx: _FrameContext, b: Batch, call: ir.AggCall) -> Column:
 
 
 def _int_col(a, t):
-    return Column(a.astype(np.int64), None, t, None)
+    return Column(a.astype(jnp.int64), None, t, None)
 
 
 def _lit_int(e: ir.RowExpr, what: str) -> int:
@@ -195,9 +222,10 @@ def _ntile(ctx, k):
     size = ctx.part_size // k
     rem = ctx.part_size % k
     thresh = rem * (size + 1)
-    big = np.where(size > 0, rn0 // np.maximum(size + 1, 1), rn0)
-    small = rem + np.where(size > 0, (rn0 - thresh) // np.maximum(size, 1), 0)
-    return np.where(rn0 < thresh, big, small) + 1
+    big = jnp.where(size > 0, rn0 // jnp.maximum(size + 1, 1), rn0)
+    small = rem + jnp.where(size > 0,
+                            (rn0 - thresh) // jnp.maximum(size, 1), 0)
+    return jnp.where(rn0 < thresh, big, small) + 1
 
 
 def _arg_column(b: Batch, e: ir.RowExpr) -> Column:
@@ -206,27 +234,25 @@ def _arg_column(b: Batch, e: ir.RowExpr) -> Column:
     if isinstance(e, ir.Lit):
         n = b.capacity
         if e.type.is_string:
-            d = np.full(n, e.value, dtype=object)
-        else:
-            d = np.full(n, e.value if e.value is not None else 0,
-                        dtype=e.type.numpy_dtype())
-        v = None if e.value is not None else np.zeros(n, dtype=bool)
+            raise WindowError("string literal window argument")
+        d = jnp.full(n, e.value if e.value is not None else 0,
+                     dtype=e.type.numpy_dtype())
+        v = None if e.value is not None else jnp.zeros(n, dtype=bool)
         return Column(d, v, e.type, None)
     raise WindowError("window argument must be a column or literal")
 
 
-def _gather_col(c: Column, idx: np.ndarray, in_frame: np.ndarray) -> Column:
-    d, v = _col_host(c)
-    safe = np.clip(idx, 0, len(d) - 1)
+def _gather_col(c: Column, idx, in_frame) -> Column:
+    d = jnp.asarray(c.data)
+    safe = jnp.clip(idx, 0, d.shape[0] - 1)
     out = d[safe]
-    valid = in_frame.copy()
-    if v is not None:
-        valid &= v[safe]
+    valid = in_frame
+    if c.valid is not None:
+        valid = valid & c.valid[safe]
     if c.type.is_string and c.dictionary is None:
-        out = np.where(valid, out, "")
-    else:
-        out = np.where(valid, out, np.zeros_like(out))
-    return Column(out, valid if not valid.all() else None, c.type, c.dictionary)
+        raise WindowError("non-dictionary string window values")
+    out = jnp.where(valid, out, jnp.zeros((), out.dtype))
+    return Column(out, valid, c.type, c.dictionary)
 
 
 def _lag_lead(ctx, b, call):
@@ -241,23 +267,24 @@ def _lag_lead(ctx, b, call):
     out = _gather_col(src, idx, in_part)
     if len(call.args) > 2:  # default value fills out-of-partition slots
         dflt = _arg_column(b, call.args[2])
-        dd, dv = _col_host(dflt)
-        d, v = _col_host(out)
-        use_d = ~in_part
-        d = np.where(use_d, dd, d)
-        valid = np.where(use_d,
-                         dv if dv is not None else np.ones(ctx.n, bool),
-                         v if v is not None else np.ones(ctx.n, bool))
-        same_dict = (out.dictionary is dflt.dictionary)
+        same_dict = out.dictionary is dflt.dictionary
         if out.type.is_string and not same_dict:
-            raise WindowError("lag/lead string default requires matching encoding")
-        out = Column(d, None if valid.all() else valid, out.type, out.dictionary)
+            raise WindowError(
+                "lag/lead string default requires matching encoding")
+        use_d = ~in_part
+        d = jnp.where(use_d, dflt.data, out.data)
+        ones = jnp.ones(ctx.n, bool)
+        valid = jnp.where(
+            use_d,
+            dflt.valid if dflt.valid is not None else ones,
+            out.valid if out.valid is not None else ones)
+        out = Column(d, valid, out.type, out.dictionary)
     return out
 
 
 def _value_fn(ctx, b, call):
     src = _arg_column(b, call.args[0])
-    fs, fe = ctx.frame_bounds()
+    fs, fe, _shape = ctx.frame_bounds()
     nonempty = fs <= fe
     if call.fn == "first_value":
         idx = fs
@@ -276,25 +303,27 @@ def _value_fn(ctx, b, call):
 # aggregates over frames
 # ---------------------------------------------------------------------------
 
-def _prefix_at(csum: np.ndarray, idx: np.ndarray) -> np.ndarray:
+def _prefix_at(csum, idx):
     """Sum of x[0..idx] using inclusive prefix csum; idx may be -1."""
-    return np.where(idx >= 0, csum[np.clip(idx, 0, len(csum) - 1)], 0)
+    return jnp.where(idx >= 0,
+                     csum[jnp.clip(idx, 0, csum.shape[0] - 1)], 0)
 
 
 def _frame_aggregate(ctx, b, call):
     fn = call.fn
-    fs, fe = ctx.frame_bounds()
+    fs, fe, shape = ctx.frame_bounds()
     nonempty = fs <= fe
     if fn == "count" and not call.args:
-        cnt = np.where(nonempty, fe - fs + 1, 0)
+        cnt = jnp.where(nonempty, fe - fs + 1, 0)
         return _int_col(cnt, call.type)
 
     src = _arg_column(b, call.args[0]) if call.args else None
-    d, v = _col_host(src)
-    notnull = v if v is not None else np.ones(ctx.n, dtype=bool)
-    cs = np.cumsum(notnull.astype(np.int64))
+    d = jnp.asarray(src.data)
+    notnull = src.valid if src.valid is not None \
+        else jnp.ones(ctx.n, dtype=bool)
+    cs = jnp.cumsum(notnull.astype(jnp.int64))
     cnt = _prefix_at(cs, fe) - _prefix_at(cs, fs - 1)
-    cnt = np.where(nonempty, cnt, 0)
+    cnt = jnp.where(nonempty, cnt, 0)
     if fn == "count":
         return _int_col(cnt, call.type)
 
@@ -302,121 +331,123 @@ def _frame_aggregate(ctx, b, call):
               "variance", "var_samp", "var_pop"):
         if src.type.is_string:
             raise WindowError(f"{fn} over strings")
-        x = np.where(notnull, d, 0).astype(np.float64)
-        s = np.cumsum(x)
+        acc = jnp.float32 if d.dtype == jnp.float32 else jnp.float64
+        x = jnp.where(notnull, d, jnp.zeros((), d.dtype)).astype(acc)
+        s = jnp.cumsum(x)
         tot = _prefix_at(s, fe) - _prefix_at(s, fs - 1)
         valid = nonempty & (cnt > 0)
         if fn == "sum":
             if call.type.is_integer or call.type.name == "DECIMAL":
-                si = np.cumsum(np.where(notnull, d, 0).astype(np.int64))
+                si = jnp.cumsum(jnp.where(
+                    notnull, d, jnp.zeros((), d.dtype)).astype(jnp.int64))
                 tot = _prefix_at(si, fe) - _prefix_at(si, fs - 1)
-            return Column(tot, None if valid.all() else valid, call.type, None)
-        mean = tot / np.maximum(cnt, 1)
+            return Column(tot, valid, call.type, None)
+        mean = tot / jnp.maximum(cnt, 1)
         if fn == "avg":
-            return Column(mean, None if valid.all() else valid, call.type, None)
-        s2 = np.cumsum(x * x)
+            return Column(mean.astype(jnp.float64), valid, call.type, None)
+        s2 = jnp.cumsum(x * x)
         tot2 = _prefix_at(s2, fe) - _prefix_at(s2, fs - 1)
-        m2 = tot2 - tot * tot / np.maximum(cnt, 1)
+        m2 = tot2 - tot * tot / jnp.maximum(cnt, 1)
         if fn in ("stddev", "stddev_samp", "variance", "var_samp"):
-            denom = np.maximum(cnt - 1, 1)
+            denom = jnp.maximum(cnt - 1, 1)
             valid = valid & (cnt > 1)
         else:
-            denom = np.maximum(cnt, 1)
-        var = np.maximum(m2 / denom, 0.0)
-        out = np.sqrt(var) if fn.startswith("stddev") else var
-        return Column(out, None if valid.all() else valid, call.type, None)
+            denom = jnp.maximum(cnt, 1)
+        var = jnp.maximum(m2 / denom, 0.0)
+        out = jnp.sqrt(var) if fn.startswith("stddev") else var
+        return Column(out.astype(jnp.float64), valid, call.type, None)
 
     if fn in ("min", "max"):
-        return _minmax(ctx, src, d, notnull, fs, fe, nonempty & (cnt > 0), call)
+        return _minmax(ctx, src, d, notnull, fs, fe, shape,
+                       nonempty & (cnt > 0), call)
     raise WindowError(f"window aggregate {fn} not supported")
 
 
 def _segmented_scan(vals, seg_new, op, identity):
     """Hillis-Steele segmented inclusive scan — log2(n) vectorized passes."""
-    n = len(vals)
-    res = vals.copy()
-    flag = seg_new.copy()
+    n = vals.shape[0]
+    res = vals
+    flag = seg_new
     shift = 1
     while shift < n:
-        prev = np.concatenate([np.full(shift, identity, dtype=res.dtype),
-                               res[:-shift]])
-        prev_flag = np.concatenate([np.ones(shift, dtype=bool), flag[:-shift]])
-        res = np.where(flag, res, op(res, prev))
+        prev = jnp.concatenate([
+            jnp.full(shift, identity, dtype=res.dtype), res[:-shift]])
+        prev_flag = jnp.concatenate([
+            jnp.ones(shift, dtype=bool), flag[:-shift]])
+        res = jnp.where(flag, res, op(res, prev))
         flag = flag | prev_flag
         shift <<= 1
     return res
 
 
-def _minmax(ctx, src, d, notnull, fs, fe, valid, call):
-    op = np.minimum if call.fn == "min" else np.maximum
+def _minmax(ctx, src, d, notnull, fs, fe, shape, valid, call):
+    op = jnp.minimum if call.fn == "min" else jnp.maximum
     if src.type.is_string and src.dictionary is None:
-        # order on raw strings: factorize to ranks, min/max over ranks
-        uniq, codes = np.unique(d.astype(str), return_inverse=True)
-        work = codes.astype(np.int64)
-        decode = lambda r: uniq[np.clip(r, 0, len(uniq) - 1)]
-        ident = np.iinfo(np.int64).max if call.fn == "min" else np.iinfo(np.int64).min
-    elif src.dictionary is not None:
-        # dictionary codes are sorted-unique in encode_strings -> order-preserving
-        work = np.asarray(d, dtype=np.int64)
-        decode = lambda r: r  # keep codes; dictionary travels with the column
-        ident = np.iinfo(np.int64).max if call.fn == "min" else np.iinfo(np.int64).min
+        raise WindowError("min/max over non-dictionary strings")
+    if src.dictionary is not None:
+        # dictionary codes are sorted-unique -> order-preserving
+        work = d.astype(jnp.int64)
+        ident = (np.iinfo(np.int64).max if call.fn == "min"
+                 else np.iinfo(np.int64).min)
+    elif jnp.issubdtype(d.dtype, jnp.floating):
+        work = d.astype(jnp.float64)
+        ident = np.inf if call.fn == "min" else -np.inf
     else:
-        work = d.astype(np.float64) if d.dtype.kind == "f" else d.astype(np.int64)
-        if d.dtype.kind == "f":
-            ident = np.inf if call.fn == "min" else -np.inf
-        else:
-            ident = np.iinfo(np.int64).max if call.fn == "min" else np.iinfo(np.int64).min
-        decode = lambda r: r
-    work = np.where(notnull, work, ident)
+        work = d.astype(jnp.int64)
+        ident = (np.iinfo(np.int64).max if call.fn == "min"
+                 else np.iinfo(np.int64).min)
+    work = jnp.where(notnull, work, ident)
 
-    ar = ctx.ar
-    run_fwd = _segmented_scan(work, ctx.part_new, op, ident)
-    run_bwd = _segmented_scan(work[::-1], np.append(ctx.part_new[1:], True)[::-1],
-                              op, ident)[::-1]
-    # answerable cases: fs == part_start (prefix scan at fe), or
-    # fe == part_end (suffix scan at fs), or single-row frames
-    if np.array_equal(fs, ctx.part_start):
-        raw = run_fwd[np.clip(fe, 0, ctx.n - 1)]
-    elif np.array_equal(fe, ctx.part_end):
-        raw = run_bwd[np.clip(fs, 0, ctx.n - 1)]
-    elif np.array_equal(fs, fe):
-        raw = work[np.clip(fs, 0, ctx.n - 1)]
-    else:
-        raw = _minmax_sliding(work, fs, fe, op, ident)
-    # validity = frame contains a non-null value (passed in as `valid`);
-    # a sentinel comparison would misreport legitimate extreme values
-    out = decode(raw)
-    if src.type.is_string and src.dictionary is None:
-        out = np.where(valid, out, "")
-        out = out.astype(object)
-    else:
-        out = np.where(valid, out, np.zeros_like(out))
-    return Column(out, None if valid.all() else valid, call.type,
+    n = ctx.n
+    # the frame SHAPE is static (from the spec), so strategy selection
+    # never branches on data
+    if shape == "prefix" or shape == "whole":
+        run_fwd = _segmented_scan(work, ctx.part_new, op, ident)
+        raw = run_fwd[jnp.clip(fe, 0, n - 1)]
+    elif shape == "peer":
+        # frame == the peer group: forward scan over PEER segments,
+        # evaluated at each row's peer_end (== fe)
+        run_fwd = _segmented_scan(work, ctx.peer_new, op, ident)
+        raw = run_fwd[jnp.clip(fe, 0, n - 1)]
+    elif shape == "suffix":
+        nxt = jnp.concatenate([ctx.part_new[1:], jnp.ones(1, bool)])
+        run_bwd = jnp.flip(_segmented_scan(
+            jnp.flip(work), jnp.flip(nxt), op, ident))
+        raw = run_bwd[jnp.clip(fs, 0, n - 1)]
+    elif shape == "single":
+        raw = work[jnp.clip(fs, 0, n - 1)]
+    else:  # sliding:<maxw>
+        maxw = int(shape.split(":")[1])
+        raw = _minmax_sliding(work, fs, fe, op, ident, maxw)
+    out = jnp.where(valid, raw, jnp.zeros((), raw.dtype))
+    if src.dictionary is not None:
+        out = out.astype(d.dtype)
+    return Column(out, valid, call.type,
                   src.dictionary if src.dictionary is not None else None)
 
 
-def _minmax_sliding(work, fs, fe, op, ident):
+def _minmax_sliding(work, fs, fe, op, ident, max_w):
     """Bounded ROWS frames: sparse-table (doubling) range min/max —
-    O(n log n) precompute, O(1) per row."""
-    n = len(work)
+    O(n log n) precompute, O(1) per row.  max_w is static (from the
+    frame spec's offsets)."""
+    n = work.shape[0]
     width = fe - fs + 1
-    max_w = int(np.max(np.maximum(width, 1)))
     levels = [work]
     span = 1
-    while span < max_w:
+    while span < max(max_w, 1):
         cur = levels[-1]
-        nxt = op(cur, np.concatenate([cur[span:], np.full(span, ident, cur.dtype)]))
+        nxt = op(cur, jnp.concatenate(
+            [cur[span:], jnp.full(span, ident, cur.dtype)]))
         levels.append(nxt)
         span <<= 1
-    k = np.maximum(width, 1)
-    lev = np.floor(np.log2(k)).astype(np.int64)
-    span_arr = (1 << lev)
-    out = np.full(n, ident, dtype=work.dtype)
+    k = jnp.maximum(width, 1)
+    lev = jnp.floor(jnp.log2(k.astype(jnp.float64))).astype(jnp.int64)
+    span_arr = 1 << lev
+    out = jnp.full(n, ident, dtype=work.dtype)
     for li, table in enumerate(levels):
         m = lev == li
-        if not m.any():
-            continue
-        a = table[np.clip(fs[m], 0, n - 1)]
-        second = np.clip(fe[m] - span_arr[m] + 1, 0, n - 1)
-        out[m] = op(a, table[second])
+        a = table[jnp.clip(fs, 0, n - 1)]
+        second = jnp.clip(fe - span_arr + 1, 0, n - 1)
+        cand = op(a, table[second])
+        out = jnp.where(m, cand, out)
     return out

@@ -197,7 +197,10 @@ def sharded_scan(table, node: P.TableScan, mesh, ndev: int) -> Batch:
 
         data = table.read(missing)
         for c in missing:
-            col = column_from_numpy(data[c], table.schema[c])
+            from presto_tpu import types as T
+
+            # virtual pushdown predicate columns are schema-less BOOLEANs
+            col = column_from_numpy(data[c], table.schema.get(c, T.BOOLEAN))
             arr = np.asarray(col.data)
             pad = np.zeros((npad - n_rows,), dtype=arr.dtype)
             arr = np.concatenate([arr, pad])

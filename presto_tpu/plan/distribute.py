@@ -39,12 +39,21 @@ class Undistributable(Exception):
     single-device path instead."""
 
 
-def distribute(plan: P.QueryPlan, session, ndev: int) -> P.QueryPlan:
+def distribute(plan: P.QueryPlan, session, ndev: int,
+               bucketed=None) -> P.QueryPlan:
     """Rewrite an optimized single-device plan into a distributed one.
     Subplans (uncorrelated scalars) stay single-device — they are evaluated
     host-side before the superstep, like the reference's pre-requisite
-    stages feeding a gather exchange."""
-    d = Distributer(session, ndev)
+    stages feeding a gather exchange.
+
+    `bucketed` ({table: bucket column}) switches the planner into
+    chunked/grouped-execution mode (reference: connector bucketing +
+    grouped execution, BucketNodeMap + Lifespan): scans of bucketed
+    tables are hashed on the bucket column (all rows of one bucket land
+    in one chunk — range-bucketing colocates equi-joins the same way
+    hash-bucketing does), every other scan is replicated (resident whole
+    in HBM, visible to every chunk)."""
+    d = Distributer(session, ndev, bucketed=bucketed)
     # subplans run in the SAME trace (not host-side) so float reduction
     # order — and therefore sums compared against the main plan, e.g.
     # TPC-H Q15's total_revenue = (select max(...)) — is bit-identical
@@ -70,9 +79,10 @@ _MERGEABLE = {"count", "count_if", "sum", "min", "max", "avg",
 
 
 class Distributer:
-    def __init__(self, session, ndev: int):
+    def __init__(self, session, ndev: int, bucketed=None):
         self.session = session
         self.ndev = ndev
+        self.bucketed = bucketed or {}  # table -> bucket column (chunk mode)
         self.broadcast_rows = int(session.properties.get(
             "broadcast_join_threshold_rows", 1_000_000))
         self.dist_sort_threshold = int(session.properties.get(
@@ -93,6 +103,14 @@ class Distributer:
         return m(node)
 
     def _visit_tablescan(self, node: P.TableScan):
+        if self.bucketed:
+            bcol = self.bucketed.get(node.table)
+            if bcol is None:
+                return node, REPLICATED  # resident table: whole per chunk
+            syms = [s for s, c in node.assignments.items() if c == bcol]
+            if syms:
+                return node, Dist("hashed", (syms[0],))
+            return node, ANY
         return node, ANY
 
     def _visit_values(self, node: P.Values):
@@ -350,6 +368,21 @@ class Distributer:
 
     def _visit_window(self, node: P.Window):
         src, dist = self.visit(node.source)
+        if node.partition_by:
+            # hash-partitioned window execution: all rows of a window
+            # partition land on one shard, local sorted-scan windows per
+            # shard (reference: WindowOperator + AddExchanges inserting a
+            # partitioned exchange on the partition keys)
+            if dist.kind == "replicated" or (
+                    dist.kind == "hashed"
+                    and set(dist.keys) <= set(node.partition_by)):
+                node.source = src
+                out = dist if dist.kind == "replicated" \
+                    else Dist("hashed", dist.keys)
+                return node, out
+            node.source = P.Exchange(src, "repartition",
+                                     list(node.partition_by))
+            return node, Dist("hashed", tuple(node.partition_by))
         node.source = self._to_replicated(src, dist)
         return node, REPLICATED
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Set, Tuple
 
+from presto_tpu import types as T
 from presto_tpu.plan import ir
 from presto_tpu.plan import nodes as P
 
@@ -84,6 +85,62 @@ def _optimize_node(node: P.PlanNode, session) -> P.PlanNode:
         from presto_tpu.plan.iterative import DEFAULT_RULES, IterativeOptimizer
 
         node = IterativeOptimizer(DEFAULT_RULES).optimize(node)
+    node = _pushdown_connector_predicates(node, session)
+    # re-prune: a pushed-down predicate leaves its original string column
+    # unreferenced in the scan — dropping it is the whole point (the
+    # column never materializes)
+    node = prune_columns(node, set(n for n, _ in node.outputs()))
+    return node
+
+
+def _pushdown_connector_predicates(node: P.PlanNode, session) -> P.PlanNode:
+    """Rewrite connector-evaluable predicates into virtual scan columns
+    (reference: predicate pushdown into the connector via TupleDomain /
+    PickTableLayout + ConnectorMetadata).  A conjunct like
+    `p_name LIKE '%green%'` over a generator connector becomes a BOOLEAN
+    column the connector computes natively on device — the string column
+    itself never materializes."""
+    catalog = getattr(session, "catalog", None)
+    if catalog is None:
+        return node
+    for attr in ("source", "left", "right"):
+        if hasattr(node, attr):
+            setattr(node, attr, _pushdown_connector_predicates(
+                getattr(node, attr), session))
+    if isinstance(node, P.Union):
+        node.sources_ = [_pushdown_connector_predicates(s, session)
+                         for s in node.sources_]
+    if not (isinstance(node, P.Filter)
+            and isinstance(node.source, P.TableScan)):
+        return node
+    scan = node.source
+    try:
+        table = catalog.get(scan.table)
+    except KeyError:
+        return node
+    hook = getattr(table, "pushdown_like", None)
+    if hook is None:
+        return node
+    conjs = list(ir.conjuncts(node.predicate))
+    changed = False
+    for i, c in enumerate(conjs):
+        if not (isinstance(c, ir.Call) and c.fn == "like"
+                and len(c.args) == 2 and isinstance(c.args[0], ir.Ref)
+                and isinstance(c.args[1], ir.Lit)):
+            continue
+        col = scan.assignments.get(c.args[0].name)
+        if col is None:
+            continue
+        vcol = hook(col, str(c.args[1].value))
+        if vcol is None:
+            continue
+        vsym = f"{c.args[0].name}$pushed{i}"
+        scan.assignments[vsym] = vcol
+        scan.types[vsym] = T.BOOLEAN
+        conjs[i] = ir.Ref(vsym, T.BOOLEAN)
+        changed = True
+    if changed:
+        return P.Filter(scan, ir.combine_conjuncts(conjs))
     return node
 
 

@@ -116,16 +116,58 @@ def test_window_filter_rejected(session):
                     "FROM orders")
 
 
-def test_distributed_window_failure_memoized(tpch_catalog_tiny):
-    """A query the distributed path cannot trace must be memoized as
-    DYNAMIC so re-runs skip the failed distribution attempt."""
+def test_distributed_window_executes(tpch_catalog_tiny):
+    """Windows distribute now: partitioned windows repartition on the
+    partition keys; global-order windows gather.  Either way the
+    distributed result must match single-device."""
     import presto_tpu
 
     s = presto_tpu.connect(tpch_catalog_tiny)
+    ref = presto_tpu.connect(tpch_catalog_tiny)
     s.set("distributed", True)
-    sql = ("SELECT o_orderkey, row_number() OVER (ORDER BY o_orderkey) rn "
-           "FROM orders ORDER BY o_orderkey LIMIT 5")
-    r1 = s.sql(sql)
-    assert any(v == "DYNAMIC" for v in getattr(s, "_dist_cache", {}).values())
-    r2 = s.sql(sql)
-    assert r1.rows == r2.rows
+    for sql in [
+        ("SELECT o_orderkey, row_number() OVER (ORDER BY o_orderkey) rn "
+         "FROM orders ORDER BY o_orderkey LIMIT 5"),
+        ("SELECT o_custkey, o_orderkey, "
+         "rank() OVER (PARTITION BY o_custkey ORDER BY o_totalprice) rk "
+         "FROM orders ORDER BY o_custkey, o_orderkey LIMIT 20"),
+        ("SELECT o_custkey, sum(o_totalprice) "
+         "OVER (PARTITION BY o_custkey ORDER BY o_orderdate) s "
+         "FROM orders ORDER BY o_custkey, s LIMIT 20"),
+    ]:
+        def rnd(rows):
+            # prefix-sum order differs per shard -> f64 jitter in sums
+            return [tuple(round(v, 2) if isinstance(v, float) else v
+                          for v in r) for r in rows]
+
+        assert rnd(s.sql(sql).rows) == rnd(ref.sql(sql).rows), sql
+
+
+def test_partitioned_window_distributes_without_gather(tpch_catalog_tiny):
+    """The plan for a partitioned window contains a repartition exchange
+    on the partition keys, not a gather of the whole input."""
+    import presto_tpu
+    from presto_tpu.plan import nodes as P
+    from presto_tpu.plan.distribute import distribute
+    from presto_tpu.exec.executor import plan_statement
+    from presto_tpu.sql.parser import parse
+
+    s = presto_tpu.connect(tpch_catalog_tiny)
+    stmt = parse("SELECT o_custkey, row_number() OVER "
+                 "(PARTITION BY o_custkey ORDER BY o_orderdate) rn "
+                 "FROM orders")
+    plan = plan_statement(s, stmt)
+    dplan = distribute(plan, s, ndev=4)
+
+    found = []
+
+    def walk(n):
+        if isinstance(n, P.Window):
+            found.append(n.source)
+        for attr in ("source", "left", "right"):
+            if hasattr(n, attr):
+                walk(getattr(n, attr))
+
+    walk(dplan.root)
+    assert found and isinstance(found[0], P.Exchange)
+    assert found[0].kind == "repartition"
