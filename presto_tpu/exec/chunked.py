@@ -93,14 +93,15 @@ def chunk_plan_needed(session, plan) -> bool:
     return False
 
 
-def run_chunked(session, stmt, text: str):
+def run_chunked(session, stmt, text: str, plan=None):
     """Plan + execute a chunked query; returns a QueryResult."""
     from presto_tpu.exec.executor import Executor, plan_statement
     from presto_tpu.parallel.cluster import cut_fragments
     from presto_tpu.plan.distribute import Undistributable, distribute
     from presto_tpu.connectors import tpch as H
 
-    plan = plan_statement(session, stmt)
+    if plan is None:
+        plan = plan_statement(session, stmt)
     if plan.subplans:
         raise Unchunkable("scalar subplans not supported in chunked mode")
 
@@ -232,14 +233,22 @@ class _FragmentRunner:
 
         ex = Executor(self.session, static=True, scan_inputs=scan_inputs)
         out = ex.exec_node(frag.root)
-        # shrink inside the compiled program when the fragment root has a
-        # static bound (partial topN/limit): the eager compact outside
-        # would otherwise walk a chunk-capacity-sized batch at peak HBM
+        # shrink inside the compiled program: the eager compact outside
+        # would otherwise walk a chunk-capacity-sized batch at peak HBM.
+        # A fragment root with a static bound (partial topN/limit)
+        # compacts to it; otherwise compact to the per-chunk order count
+        # (exchange outputs are reductions of the chunk — aggregates on
+        # the bucket key, selective filters) with an overflow GUARD so a
+        # miss falls back instead of silently truncating.
         bound = _static_root_bound(frag.root)
+        guards = list(ex.guards)
+        if bound is None and out.sel.shape[0] > 4 * self.cap_orders:
+            bound = self.cap_orders
+            guards.append(jnp.sum(out.sel) > bound)
         if bound is not None and out.sel.shape[0] > 4 * bound:
             out = _compact_batch(out, bound)
-        if ex.guards:
-            guard = jnp.any(jnp.stack([jnp.asarray(g) for g in ex.guards]))
+        if guards:
+            guard = jnp.any(jnp.stack([jnp.asarray(g) for g in guards]))
         else:
             guard = jnp.asarray(False)
         return out, guard

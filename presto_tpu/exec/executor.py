@@ -170,6 +170,7 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
         from presto_tpu.exec import chunked as CH
 
         needs_chunks = False
+        plan_probe = None
         if mode == "chunked" or CH.catalog_may_need_chunks(session):
             try:
                 plan_probe = plan_statement(session, stmt)
@@ -180,8 +181,10 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
             try:
                 with mon.phase("execute"):
                     mon.stats.execution_mode = "chunked"
-                    return CH.run_chunked(session, stmt, text)
-            except CH.Unchunkable:
+                    return CH.run_chunked(session, stmt, text,
+                                          plan=plan_probe)
+            except (CH.Unchunkable, jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError):
                 if mode == "chunked":
                     raise
     if mode in ("auto", "compiled"):
@@ -1242,7 +1245,7 @@ class Executor:
         if a.fn == "count":
             return Column(cnt, None, T.BIGINT)
         if a.fn == "approx_distinct":
-            h = K._hash_keys([col], valid).astype(jnp.uint64)
+            h = K.hll_hash64(col)  # value hash: matches distributed merge
             est = K.hll_registers_and_estimate(h, valid, gid, n_groups)
             return Column(est, None, T.BIGINT)
         if a.fn == "checksum":
@@ -1525,6 +1528,8 @@ class Executor:
         jt = node.join_type
         if jt == "CROSS":
             return self._cross_join(left, right, node)
+        if jt == "FULL":
+            return self._full_join(left, right, node)
         lkeys = [left.columns[lk] for lk, _ in node.criteria]
         rkeys = [right.columns[rk] for _, rk in node.criteria]
         lkeys, rkeys = _unify_key_dictionaries(lkeys, rkeys)
@@ -1717,6 +1722,33 @@ class Executor:
             return out
         raise ExecutionError(f"join type {jt} not implemented")
 
+    def _full_join(self, left: Batch, right: Batch, node: P.Join) -> Batch:
+        """FULL = LEFT(l,r) ++ (rows of r with no match, left side typed
+        NULL).  The anti pass mirrors probe/build (reference:
+        LookupOuterOperator emitting unmatched build rows after probes
+        finish).  Static-shape friendly: output capacity is the LEFT
+        expansion plus right's capacity, no host syncs added."""
+        lnode = P.Join(node.left, node.right, "LEFT", node.criteria,
+                       node.filter)
+        for attr in ("build_unique", "fanout_bound", "key_stats"):
+            if hasattr(node, attr):
+                setattr(lnode, attr, getattr(node, attr))
+        left_part = self._join_batches(left, right, lnode)
+        anode = P.Join(node.right, node.left, "ANTI",
+                       [(rk, lk) for lk, rk in node.criteria], node.filter)
+        right_anti = self._join_batches(right, left, anode)
+        null_left = {}
+        for name, c in left.columns.items():
+            cap = right_anti.capacity
+            null_left[name] = Column(
+                jnp.zeros((cap,), c.data.dtype),
+                jnp.zeros((cap,), bool), c.type, c.dictionary)
+        # column order must match left_part's (left cols, then right cols)
+        ro_cols = dict(null_left)
+        ro_cols.update(right_anti.columns)
+        right_only = Batch(ro_cols, right_anti.sel)
+        return K.concat_batches([left_part, right_only])
+
     def _cross_join(self, left: Batch, right: Batch, node: P.Join) -> Batch:
         if not self.static:  # compaction needs a host sync
             left = K.compact(left)
@@ -1765,10 +1797,9 @@ class Executor:
 
     # ---- set ops ------------------------------------------------------
     def _exec_unnest(self, node: P.Unnest) -> Batch:
-        """Lateral explode (reference: UnnestOperator).  Host-side ragged
-        work — dynamic mode only; the compiled path falls back."""
+        """Lateral explode (reference: UnnestOperator)."""
         if self.static:
-            raise StaticFallback("UNNEST is dynamic-mode only")
+            return self._unnest_static(node)
         b = self.exec_node(node.source)
         v = eval_expr(node.array_expr, b, self.ctx)
         col = to_column(v, b.capacity)
@@ -1806,6 +1837,83 @@ class Executor:
                 jnp.asarray(k + 1, jnp.int64), None, T.BIGINT)
         return Batch(cols, jnp.ones((max(total, 0),), bool) if total else
                      jnp.zeros((0,), bool))
+
+    def _unnest_static(self, node: P.Unnest) -> Batch:
+        """Static-shape UNNEST: ARRAY columns are int32 codes into a
+        host tuple dictionary, which is a TRACE-TIME constant — so the
+        ragged expansion precomputes, per dictionary entry, a padded
+        (dict_size, maxlen) element matrix + lengths host-side, and the
+        traced program is two gathers with a slot-liveness mask.  The
+        fanout bound is maxlen (static, from the dictionary), the
+        LazyBlock-style analog of UnnestOperator's per-page expansion."""
+        b = self.exec_node(node.source)
+        v = eval_expr(node.array_expr, b, self.ctx)
+        col = to_column(v, b.capacity)
+        if col.dictionary is None:
+            raise StaticFallback("UNNEST over a non-dictionary array")
+        dvals = col.dictionary.values
+        lens_h = np.asarray([len(t) for t in dvals], dtype=np.int32)
+        maxlen = int(lens_h.max()) if len(lens_h) else 0
+        n = b.capacity
+        total = n * max(maxlen, 1)
+        if total > 50_000_000:
+            raise StaticFallback(
+                f"static UNNEST expansion too large: {n} x {maxlen}")
+        elem_t = node.elem_type
+        dsize = max(len(dvals), 1)
+        mat_valid = np.zeros((dsize, max(maxlen, 1)), dtype=bool)
+        if elem_t.is_string or elem_t.name in ("ARRAY", "MAP", "ROW",
+                                               "JSON"):
+            uniq = {e for t in dvals for e in t if e is not None}
+            # string element dictionaries keep the lex==code-order
+            # invariant; nested tuples use repr order (not compared)
+            flat = sorted(uniq) if elem_t.is_string else sorted(uniq,
+                                                                key=repr)
+            edict_vals = np.empty(len(flat), dtype=object)
+            edict_vals[:] = flat
+            index = {e: i for i, e in enumerate(flat)}
+            mat = np.zeros((dsize, max(maxlen, 1)), dtype=np.int32)
+            for di, t in enumerate(dvals):
+                for k_, e in enumerate(t):
+                    if e is not None:
+                        mat[di, k_] = index[e]
+                        mat_valid[di, k_] = True
+            from presto_tpu.batch import Dictionary as _Dict
+
+            edict = _Dict(edict_vals)
+        else:
+            mat = np.zeros((dsize, max(maxlen, 1)), dtype=elem_t.numpy_dtype())
+            for di, t in enumerate(dvals):
+                for k_, e in enumerate(t):
+                    if e is not None:
+                        mat[di, k_] = e
+                        mat_valid[di, k_] = True
+            edict = None
+        codes = jnp.clip(jnp.asarray(col.data), 0, dsize - 1)
+        live = b.sel if col.valid is None else (b.sel & col.valid)
+        if maxlen == 0:
+            out = K.gather_batch(b, jnp.zeros((0,), jnp.int32))
+            cols = dict(out.columns)
+            cols[node.out_sym] = Column(
+                jnp.zeros((0,), mat.dtype), None, elem_t, edict)
+            if node.ordinality_sym:
+                cols[node.ordinality_sym] = Column(
+                    jnp.zeros((0,), jnp.int64), None, T.BIGINT)
+            return Batch(cols, jnp.zeros((0,), bool))
+        lidx = jnp.repeat(jnp.arange(n, dtype=jnp.int32), maxlen,
+                          total_repeat_length=n * maxlen)
+        k = jnp.tile(jnp.arange(maxlen, dtype=jnp.int32), n)
+        code_l = codes[lidx]
+        slot_live = live[lidx] & (k < jnp.asarray(lens_h)[code_l])
+        elem_data = jnp.asarray(mat)[code_l, k]
+        elem_valid = slot_live & jnp.asarray(mat_valid)[code_l, k]
+        out = K.gather_batch(b, lidx, idx_valid=slot_live)
+        cols = dict(out.columns)
+        cols[node.out_sym] = Column(elem_data, elem_valid, elem_t, edict)
+        if node.ordinality_sym:
+            cols[node.ordinality_sym] = Column(
+                (k + 1).astype(jnp.int64), None, T.BIGINT)
+        return Batch(cols, out.sel)
 
     def _exec_union(self, node: P.Union) -> Batch:
         parts = []

@@ -420,6 +420,40 @@ def segment_max(x, gid, n_groups):
 # ---------------------------------------------------------------------------
 
 
+def hll_hash64(col: Column) -> jnp.ndarray:
+    """Process-independent 64-bit value hash for approx_distinct: string
+    (dictionary) columns hash their VALUES via xxh64 host-side per
+    dictionary entry (cached on the Dictionary), so shards/workers with
+    different code assignments agree; numeric columns splitmix their
+    orderable ints.  Single-device and distributed paths share this, so
+    their HLL registers — and estimates — match exactly while both use
+    m=1024 registers (hll_registers_and_estimate shrinks m above ~8k
+    groups to bound the register matrix; past that point the two paths
+    are independent — both valid — approximations)."""
+    d = jnp.asarray(col.data)
+    dic = col.dictionary
+    if dic is not None and not hasattr(dic.values, "prefix"):
+        hv = getattr(dic, "_value_hashes", None)
+        if hv is None:
+            from presto_tpu import native
+
+            hv = np.asarray(
+                [native.xxh64(str(v).encode("utf-8", "surrogatepass"))
+                 for v in dic.values.tolist()], dtype=np.uint64)
+            try:
+                dic._value_hashes = hv
+            except AttributeError:
+                pass
+        safe = jnp.clip(d, 0, max(len(dic) - 1, 0))
+        return jnp.asarray(hv)[safe]
+    # numeric / FormatDictionary (code<->value bijection): splitmix the value
+    x = _orderable_int(col).astype(jnp.uint64)
+    z = x + jnp.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return z ^ (z >> jnp.uint64(31))
+
+
 def hll_registers_and_estimate(h: jnp.ndarray, valid: jnp.ndarray,
                                gid: jnp.ndarray, n_groups: int,
                                m: int = 1024) -> jnp.ndarray:

@@ -1310,6 +1310,45 @@ def _bitwise2(fn):
                                 all_valid(*args), T.BIGINT))
 
 
+# HLL building blocks for distributed approx_distinct: per-row register
+# index and rank (rho) from the shared value hash (kernels.hll_hash64) —
+# the partial/final split rewrites approx_distinct into standard
+# max/sum/count aggregates over these (plan/distribute.py; reference:
+# ApproximateCountDistinctAggregation's partial HLL state merge).
+HLL_M = 1024
+HLL_LOG2M = 10
+
+
+def _hll_col(cv):
+    from presto_tpu.batch import Column as _Col
+    from presto_tpu.exec import kernels as _K
+
+    col = _Col(jnp.asarray(cv.data), cv.valid if cv.valid is not None
+               and hasattr(cv.valid, "shape") else None, cv.type,
+               cv.dictionary)
+    return _K.hll_hash64(col)
+
+
+register("$hll_reg")((
+    lambda args: T.BIGINT if len(args) == 1 else None,
+    lambda args: ColVal((_hll_col(args[0])
+                         & jnp.uint64(HLL_M - 1)).astype(jnp.int64),
+                        args[0].valid, T.BIGINT)))
+
+
+def _hll_rho_emit(args):
+    h = _hll_col(args[0])
+    w = ((h >> jnp.uint64(HLL_LOG2M))
+         & jnp.uint64(0xFFFFFFFF)).astype(jnp.float64)
+    rho = jnp.where(w > 0,
+                    32.0 - jnp.floor(jnp.log2(jnp.maximum(w, 1.0))), 33.0)
+    return ColVal(rho, args[0].valid, T.DOUBLE)
+
+
+register("$hll_rho")((
+    lambda args: T.DOUBLE if len(args) == 1 else None, _hll_rho_emit))
+
+
 register("bitwise_and")(_bitwise2(jnp.bitwise_and))
 register("bitwise_or")(_bitwise2(jnp.bitwise_or))
 register("bitwise_xor")(_bitwise2(jnp.bitwise_xor))

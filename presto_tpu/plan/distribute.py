@@ -138,6 +138,13 @@ class Distributer:
 
     # ---- aggregation --------------------------------------------------
     def _visit_aggregate(self, node: P.Aggregate):
+        if node.aggs and all(a.fn == "approx_distinct" and not a.distinct
+                             for a in node.aggs.values()):
+            # HLL partial/final merge (reference:
+            # ApproximateCountDistinctAggregation merging airlift HLL
+            # state): rewrite into standard mergeable aggregates over
+            # per-row (register, rho) columns, then distribute THAT
+            return self.visit(self._rewrite_approx_distinct(node))
         src, dist = self.visit(node.source)
         node.source = src
         if dist.kind == "replicated":
@@ -162,6 +169,107 @@ class Distributer:
                 f"global aggregate with non-mergeable fns "
                 f"{[a.fn for a in node.aggs.values()]}")
         return self._split_partial_final(node, src)
+
+    def _rewrite_approx_distinct(self, node: P.Aggregate) -> P.PlanNode:
+        """approx_distinct(x) GROUP BY K becomes (m = 1024 registers):
+
+            Agg(K, est-inputs) over Agg(K + [reg], M := max(rho)) over
+            Project(reg := $hll_reg(x), rho := $hll_rho(x))
+
+        followed by a Project computing the bias-corrected HLL estimate
+        with small-range linear counting — every aggregate in the tree
+        is mergeable, so the existing partial/final machinery
+        distributes it."""
+        from presto_tpu.functions.scalar import HLL_M as m
+
+        src = node.source
+        src_types = dict(src.outputs())
+        keys = list(node.group_keys)
+        proj = {k: ir.Ref(k, src_types[k]) for k in keys}
+        inner_aggs = {}
+        per_sym = {}
+        for sym, a in node.aggs.items():
+            reg_s = self.fresh(sym + "_reg")
+            rho_s = self.fresh(sym + "_rho")
+            arg = a.args[0]
+            proj[reg_s] = ir.Call("$hll_reg", (arg,), T.BIGINT)
+            proj[rho_s] = ir.Call("$hll_rho", (arg,), T.DOUBLE)
+            M_s = self.fresh(sym + "_M")
+            inner_aggs[M_s] = ir.AggCall("max", (ir.Ref(rho_s, T.DOUBLE),),
+                                         T.DOUBLE, False, a.filter)
+            per_sym[sym] = (reg_s, M_s)
+        # one shared register column keyes the inner grouping; with
+        # several approx_distincts we need one inner agg per register
+        # column, so keep it simple: one rewrite handles ONE register
+        # grouping — multiple aggs share x's register column only if the
+        # args match; otherwise group by all reg columns (registers of
+        # different args are independent, the cross product is bounded
+        # by m^k which is fine for the typical k=1)
+        reg_cols = list(dict.fromkeys(r for r, _ in per_sym.values()))
+        inner = P.Aggregate(P.Project(src, proj), keys + reg_cols,
+                            inner_aggs, "SINGLE")
+        mid_types = dict(inner.outputs())
+        mid = {k: ir.Ref(k, mid_types[k]) for k in keys}
+        outer_aggs = {}
+        est_inputs = {}
+        for sym, (reg_s, M_s) in per_sym.items():
+            Mref = ir.Ref(M_s, T.DOUBLE)
+            pw_s = self.fresh(sym + "_pw")
+            z_s = self.fresh(sym + "_z")
+            mid[pw_s] = ir.Call("power", (ir.Lit(2.0, T.DOUBLE),
+                                          ir.Call("neg", (Mref,), T.DOUBLE)), T.DOUBLE)
+            mid[z_s] = ir.Call("gt", (Mref, ir.Lit(0.0, T.DOUBLE)),
+                               T.BOOLEAN)
+            s_s = self.fresh(sym + "_s")
+            c_s = self.fresh(sym + "_c")
+            nz_s = self.fresh(sym + "_nz")
+            outer_aggs[s_s] = ir.AggCall("sum", (ir.Ref(pw_s, T.DOUBLE),),
+                                         T.DOUBLE)
+            outer_aggs[c_s] = ir.AggCall("count", (ir.Ref(pw_s, T.DOUBLE),),
+                                         T.BIGINT)
+            outer_aggs[nz_s] = ir.AggCall("count_if",
+                                          (ir.Ref(z_s, T.BOOLEAN),),
+                                          T.BIGINT)
+            est_inputs[sym] = (s_s, c_s, nz_s)
+        outer = P.Aggregate(P.Project(inner, mid), keys, outer_aggs,
+                            "SINGLE")
+        outer.capacity_hint = getattr(node, "capacity_hint", None)
+        outer.key_stats = getattr(node, "key_stats", {})
+        out_types = dict(outer.outputs())
+        final_proj = {k: ir.Ref(k, out_types[k]) for k in keys}
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        for sym, (s_s, c_s, nz_s) in est_inputs.items():
+            S = ir.Ref(s_s, T.DOUBLE)
+            C = ir.Ref(c_s, T.BIGINT)
+            NZ = ir.Ref(nz_s, T.BIGINT)
+
+            def D(fn, *args):
+                return ir.Call(fn, tuple(args), T.DOUBLE)
+
+            # empty registers contribute 2^0 each: denom = S + (m - C)
+            denom = D("add", S, D("sub", ir.Lit(float(m), T.DOUBLE),
+                                  ir.CastExpr(C, T.DOUBLE)))
+            E = D("div", ir.Lit(alpha * m * m, T.DOUBLE), denom)
+            zeros = D("sub", ir.Lit(float(m), T.DOUBLE),
+                      ir.CastExpr(NZ, T.DOUBLE))
+            linear = D("mul", ir.Lit(float(m), T.DOUBLE),
+                       D("ln", D("div", ir.Lit(float(m), T.DOUBLE),
+                                 ir.Call("greatest",
+                                         (zeros, ir.Lit(1.0, T.DOUBLE)),
+                                         T.DOUBLE))))
+            cond = ir.Call(
+                "and", (ir.Call("le", (E, ir.Lit(2.5 * m, T.DOUBLE)),
+                                T.BOOLEAN),
+                        ir.Call("gt", (zeros, ir.Lit(0.0, T.DOUBLE)),
+                                T.BOOLEAN)), T.BOOLEAN)
+            est = ir.Call("if", (cond, linear, E), T.DOUBLE)
+            # all-NULL / fully-filtered groups: S is NULL -> the whole
+            # expression is NULL; the single-device kernel returns 0
+            final_proj[sym] = ir.Call(
+                "coalesce",
+                (ir.CastExpr(ir.Call("round", (est,), T.DOUBLE), T.BIGINT),
+                 ir.Lit(0, T.BIGINT)), T.BIGINT)
+        return P.Project(outer, final_proj)
 
     def _split_partial_final(self, node: P.Aggregate, src: P.PlanNode):
         """partial agg per shard -> gather -> final merge (the reference's
@@ -255,9 +363,27 @@ class Distributer:
         if ldist.kind == "replicated" and rdist.kind == "replicated":
             return node, REPLICATED
 
+        if jt in ("RIGHT", "FULL") and node.criteria:
+            # partitioned outer joins (reference: LookupOuterOperator +
+            # AddExchanges): hash-repartition BOTH sides on the join keys
+            # so matched pairs AND unmatched rows of either side are
+            # decidable shard-locally.  Broadcast is never legal here —
+            # a replicated side would emit its unmatched rows once per
+            # shard.
+            lkeys0 = [lk for lk, _ in node.criteria]
+            rkeys0 = [rk for _, rk in node.criteria]
+            colocated0 = (ldist.kind == "hashed" and rdist.kind == "hashed"
+                          and len(ldist.keys) == len(rdist.keys)
+                          and list(ldist.keys) == lkeys0[:len(ldist.keys)]
+                          and list(rdist.keys) == rkeys0[:len(rdist.keys)])
+            if not colocated0:
+                node.left = P.Exchange(left, "repartition", lkeys0)
+                node.right = P.Exchange(right, "repartition", rkeys0)
+            # output is NOT hashed on the keys: NULL-extended rows land
+            # on shards by the OTHER side's hash, so the NULL key group
+            # is scattered — downstream consumers must re-exchange
+            return node, ANY
         if jt in ("RIGHT", "FULL"):
-            # executed as a mirrored probe; correctness needs both sides
-            # whole — gather (rare in practice; distributed FULL later)
             node.left = self._to_replicated(left, ldist)
             node.right = self._to_replicated(right, rdist)
             return node, REPLICATED
@@ -365,6 +491,14 @@ class Distributer:
         if node.distinct:
             raise Undistributable("UNION DISTINCT")  # planner lowers it to agg
         return node, ANY
+
+    def _visit_unnest(self, node):
+        # row-local expansion: each row explodes on its own shard, so the
+        # source distribution passes through (hashed keys survive since
+        # source columns are preserved in the output)
+        src, dist = self.visit(node.source)
+        node.source = src
+        return node, dist
 
     def _visit_window(self, node: P.Window):
         src, dist = self.visit(node.source)

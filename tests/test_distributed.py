@@ -110,3 +110,20 @@ def test_distributed_sort_strings_and_nulls(tpch_catalog_tiny, tpch_sqlite_tiny)
     actual = s.sql(sql)
     expected = tpch_sqlite_tiny.execute(to_sqlite(sql)).fetchall()
     assert_same_results(actual.rows, expected, ordered=True)
+
+
+def test_all_22_tpch_queries_distribute(dsession):
+    """VERDICT r2 item 3: every TPC-H query must take the collective
+    path — each run must add a compiled (non-DYNAMIC) _dist_cache entry.
+    Windows hash-partition, approx_distinct merges HLL state,
+    RIGHT/FULL joins repartition, UNNEST stays static."""
+    import tests.tpch_queries as TQ
+
+    for qid in sorted(TQ.QUERIES):
+        dsession.sql(TQ.QUERIES[qid])
+    # after running all 22, the memo must hold ONLY compiled entries —
+    # any DYNAMIC value means some query fell off the collective path
+    cache = dsession._dist_cache
+    dynamic = [k for k, v in cache.items() if v == "DYNAMIC"]
+    assert not dynamic, f"queries fell back to single-device: {dynamic}"
+    assert len(cache) >= 22
