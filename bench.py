@@ -63,6 +63,7 @@ def main():
 
     vs_numpy = numpy_speedup(cat, engine_times)
     vs_sqlite = sqlite_speedup(engine_times)
+    scale = scale_configs(session_factory=lambda sf: _scale_session(sf))
 
     print(json.dumps({
         "metric": f"tpch_sf{SF:g}_q{'_'.join(map(str, QUERY_IDS))}_rows_per_sec_per_chip",
@@ -74,13 +75,65 @@ def main():
         "per_query_ms": {str(q): round(t * 1000, 1)
                          for q, t in engine_times.items()},
         "sf": SF,
+        "scale_configs": scale,
         "note": ("vs_numpy = tuned vectorized numpy single-core; "
                  "vs_sqlite = row-store oracle (flattering); "
-                 "warm times include ~100ms tunnel RTT per query"
+                 "warm times include ~100ms tunnel RTT per query; "
+                 "scale_configs = BASELINE SF10/SF100 wall-clock on one "
+                 "chip (device-side generation + chunked execution)"
                  + ("" if vs_numpy is not None
                     else "; NUMPY BASELINE FAILED - vs_baseline fell "
                          "back to sqlite")),
     }))
+
+
+def _scale_session(sf):
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+
+    s = presto_tpu.connect(tpch_catalog(sf, cache_dir=None))
+    if os.environ.get("BENCH_F32", "1") != "0":
+        s.set("float32_compute", True)
+    return s
+
+
+def scale_configs(session_factory):
+    """BASELINE configs above SF1: per-query cold+warm wall seconds.
+    SF10 runs whole-table on device generation; SF100 streams through
+    chunked (grouped) execution.  BENCH_SCALE=0 skips (the SF100 compile
+    alone is ~minutes)."""
+    if os.environ.get("BENCH_SCALE", "1") == "0":
+        return None
+    from tests.tpch_queries import QUERIES
+
+    configs = [("sf10_q3", 10.0, 3), ("sf100_q18", 100.0, 18)]
+    if os.environ.get("BENCH_SF100_Q9", "0") == "1":
+        configs.append(("sf100_q9", 100.0, 9))
+    out = {}
+    for name, sf, qid in configs:
+        try:
+            s = session_factory(sf)
+            t0 = time.perf_counter()
+            r = s.sql(QUERIES[qid])
+            cold = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            s.sql(QUERIES[qid])
+            warm = time.perf_counter() - t0
+            out[name] = {"cold_s": round(cold, 1), "warm_s": round(warm, 1),
+                         "rows": len(r.rows)}
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+        finally:
+            # catalog<->table reference cycles would otherwise keep the
+            # previous config's device columns resident into the next one
+            import gc
+
+            try:
+                del s, r
+            except NameError:
+                pass
+            gc.collect()
+    return out
 
 
 def numpy_speedup(cat, engine_times):

