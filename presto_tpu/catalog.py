@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -189,6 +190,13 @@ class TpchTable(ConnectorTable):
         return fn()
 
     def _full_table(self):
+        # per-table lock: streaming cluster tasks run concurrently and
+        # must not generate/unpickle the same table more than once
+        lock = self.__dict__.setdefault("_mat_lock", threading.Lock())
+        with lock:
+            return self._full_table_locked()
+
+    def _full_table_locked(self):
         if not hasattr(self, "_data"):
             path = None
             if self.cache_dir:
@@ -309,6 +317,11 @@ class TpcdsTable(ConnectorTable):
         return {c: data[c] for c in cols}
 
     def _full_table(self):
+        lock = self.__dict__.setdefault("_mat_lock", threading.Lock())
+        with lock:
+            return self._full_table_locked()
+
+    def _full_table_locked(self):
         if not hasattr(self, "_data"):
             path = None
             if self.cache_dir:

@@ -16,15 +16,22 @@ shuffle over DCN instead of a collective over ICI:
     repartition -> hash-bucketed worker->worker page pull (P1)
     broadcast   -> every consumer pulls every producer's buffer (P2)
     gather      -> coordinator pulls all buffers (P5)
-    range       -> gathered + downstream runs single-node (dist-sort merge)
+    range       -> sample-sort bucket exchange: consumer shard i owns
+                   key range i (P11 distributed sort over DCN)
 
 The wire format is the native PTPG page serde (native/serde.py — LZ4 +
 xxh64, the PagesSerde role), with validity vectors and dictionary-decoded
-strings packed alongside data columns.  Scheduling is bulk-synchronous:
-a fragment's tasks start only after all producer fragments finished, so
-consumers never wait on pages (the reference streams instead — its
-ExchangeClient long-polls; acceptable trade for a control plane whose
-data plane is XLA).
+strings packed alongside data columns.
+
+Scheduling is ALL-AT-ONCE with streaming pages (reference:
+AllAtOnceExecutionPolicy + ExchangeClient long-polls,
+operator/ExchangeClient.java:69): every fragment's tasks are submitted
+up front with pre-assigned upstream locations; leaf tasks publish a page
+per split chunk as produced, and consumers pull pages with sequence
+tokens + acks (at-least-once delivery with client dedup,
+server/TaskResource.java:244-307) — stages overlap, P7 pipelining.
+Worker failure mid-query drops the dead worker from the pool and
+re-executes the query on the survivors.
 """
 
 from __future__ import annotations
@@ -39,6 +46,7 @@ import pickle
 import secrets as _pysecrets
 import threading
 import time
+import urllib.error
 import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -290,7 +298,9 @@ def cut_fragments(root) -> List[Fragment]:
 
         def rewrite(n):
             if isinstance(n, P.Exchange):
-                pf = build(n.source, n.kind, list(n.keys))
+                # range exchanges carry (sym, asc, nulls_first) sort keys
+                okeys = list(getattr(n, "sort_keys", None) or n.keys)
+                pf = build(n.source, n.kind, okeys)
                 eid = eid_counter[0]
                 eid_counter[0] += 1
                 inputs.append(ExchangeInput(eid, n.kind, list(n.keys), pf))
@@ -317,10 +327,12 @@ def cut_fragments(root) -> List[Fragment]:
         new_root = rewrite(node)
         fid = len(fragments)
         # a fragment runs on all workers if it scans base tables or
-        # consumes worker-partitioned data; gathered/range inputs mean the
-        # data is collected in one place -> single-node execution
+        # consumes worker-partitioned data (incl. range buckets: shard i
+        # sorts key-range i locally — real distributed sort over DCN);
+        # gathered inputs mean the data is collected in one place ->
+        # single-node execution
         on_workers = has_scan[0] or any(
-            i.kind in ("repartition", "broadcast", "scatter")
+            i.kind in ("repartition", "broadcast", "scatter", "range")
             for i in inputs)
         fragments.append(Fragment(fid, new_root, inputs, has_scan[0],
                                   on_workers, out_kind, out_keys))
@@ -366,28 +378,94 @@ def _http(url: str, data: Optional[bytes] = None, method: str = "GET",
         return r.read()
 
 
-def pull_buffer(url: str, task_id: str, bucket: int,
-                timeout: float = 120.0) -> bytes:
-    """GET with retry until the producer task finishes (reference:
-    HttpPageBufferClient's poll loop; token/ack collapsed because BSP
-    ordering makes delivery exactly-once here)."""
+class UpstreamFailed(Exception):
+    """Producer task failed or its worker became unreachable."""
+
+
+def pull_pages(url: str, task_id: str, bucket: int,
+               timeout: float = 600.0, ack: bool = True,
+               max_pages: Optional[int] = None) -> List[bytes]:
+    """Streaming page pull with sequence tokens + acks (reference:
+    HttpPageBufferClient GET /v1/task/{id}/results/{buffer}/{token} +
+    .../acknowledge, server/TaskResource.java:244-307).  Pages are
+    published as the producer finishes each split chunk, so consumers
+    overlap with production (P7 pipelining); the token makes delivery
+    at-least-once with client dedup, and the ack releases server memory."""
     deadline = time.time() + timeout
+    pages: List[bytes] = []
+    token = 0
     while True:
         try:
-            return _http(f"{url}/v1/task/{task_id}/results/{bucket}")
-        except Exception:
-            if time.time() > deadline:
+            req = urllib.request.Request(
+                f"{url}/v1/task/{task_id}/results/{bucket}/{token}")
+            secret = cluster_secret()
+            if secret is not None:
+                from urllib.parse import urlsplit
+
+                path = urlsplit(req.full_url).path
+                req.add_header(AUTH_HEADER,
+                               _sign(secret, "GET", path, b""))
+            with urllib.request.urlopen(req, timeout=30.0) as r:
+                status = r.status
+                body = r.read()
+                complete = r.headers.get("X-Complete") == "1"
+            if status == 200:
+                pages.append(body)
+                token += 1
+                if max_pages is not None and len(pages) >= max_pages:
+                    return pages
+                if ack:  # only exclusive readers may release pages
+                    try:  # frees producer-side memory; best effort
+                        _http(f"{url}/v1/task/{task_id}/results/{bucket}/"
+                              f"{token}/ack", timeout=5.0)
+                    except Exception:
+                        pass
+                if complete:
+                    return pages
+                continue
+            if status == 204:  # producer complete, no more pages
+                return pages
+        except urllib.error.HTTPError as e:
+            if e.code == 503:  # not produced yet — poll
+                pass
+            elif e.code == 500:
+                raise UpstreamFailed(
+                    f"task {task_id} on {url} failed: "
+                    f"{e.read()[:300]!r}")
+            else:
                 raise
-            time.sleep(0.05)
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            # transient connection trouble is absorbed by the poll loop;
+            # a failed health probe means the worker is really gone
+            try:
+                _http(f"{url}/v1/info", timeout=3.0)
+            except Exception:
+                raise UpstreamFailed(f"worker {url} unreachable: {e}")
+        if time.time() > deadline:
+            raise TimeoutError(f"pages from {task_id}@{url} timed out")
+        time.sleep(0.05)
 
 
 class _ClusterExecutor:
     """Runs one fragment over this process's table splits + pulled
-    exchange inputs, partitions the output."""
+    exchange inputs, partitions the output.
 
-    def __init__(self, session, spec: TaskSpec):
+    Leaf fragments STREAM: the task executes split-chunk supersteps and
+    publishes each chunk's partitioned output as a page the moment it is
+    ready, so downstream tasks (already scheduled, all-at-once) overlap
+    with production — P7 pipeline parallelism over DCN (reference:
+    PartitionedOutputOperator filling OutputBuffer pages while consumers'
+    ExchangeClients stream them)."""
+
+    # target pages per task: enough to overlap, few enough to amortize
+    PAGES_PER_TASK = 4
+
+    def __init__(self, session, spec: TaskSpec, publish=None,
+                 task_state=None):
         self.session = session
         self.spec = spec
+        self.publish = publish or (lambda bucket, page: None)
+        self.task_state = task_state or {}
 
     def _exchange_batches(self):
         from presto_tpu.batch import Batch, column_from_numpy
@@ -395,19 +473,23 @@ class _ClusterExecutor:
 
         inputs = {}
         for inp in self.spec.inputs:
-            if inp["kind"] == "repartition":
+            if inp["kind"] in ("repartition", "range"):
+                # range: consumer shard w owns key range w (sample sort)
                 bucket, ups = self.spec.windex, inp["upstreams"]
             elif inp["kind"] == "scatter":
                 # producers hold identical replicated copies, round-robin
                 # sliced into buckets; one producer is the source of truth
                 bucket, ups = self.spec.windex, inp["upstreams"][:1]
-            else:  # gather / broadcast / range
+            else:  # gather / broadcast
                 bucket, ups = 0, inp["upstreams"]
             parts = []
+            # broadcast buckets have MANY readers: acking would release
+            # pages other consumers still need
+            exclusive = inp["kind"] != "broadcast"
             for (url, tid) in ups:
-                buf = pull_buffer(url, tid, bucket)
-                if buf:
-                    parts.append(unpack_columns(buf))
+                for buf in pull_pages(url, tid, bucket, ack=exclusive):
+                    if buf:
+                        parts.append(unpack_columns(buf))
             merged: Dict[str, tuple] = {}
             types = inp["types"]
             for name in types:
@@ -439,7 +521,30 @@ class _ClusterExecutor:
                 cols, jnp.ones((n,), dtype=bool))
         return inputs
 
-    def run(self) -> Dict[int, bytes]:
+    def _scan_tables(self, root):
+        from presto_tpu.plan import nodes as P
+
+        out = []
+
+        def walk(n):
+            if isinstance(n, P.TableScan) \
+                    and not n.table.startswith("__exch_"):
+                out.append(n.table)
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                if isinstance(v, P.PlanNode):
+                    walk(v)
+                elif isinstance(v, list):
+                    for x in v:
+                        if isinstance(x, P.PlanNode):
+                            walk(x)
+        walk(root)
+        return list(dict.fromkeys(out))
+
+    def _exec_once(self, root, exch, split_subset):
+        """One superstep: execute the fragment with the given split
+        subset per table (None = this worker's full share); returns host
+        columns {sym: (data, valid)}."""
         from presto_tpu.batch import Batch, column_from_numpy
         from presto_tpu.exec.compiler import EvalContext
         from presto_tpu.exec.executor import Executor
@@ -447,8 +552,6 @@ class _ClusterExecutor:
         import jax
         import jax.numpy as jnp
 
-        root = pickle.loads(self.spec.fragment)
-        exch = self._exchange_batches()
         spec = self.spec
 
         class FragmentExecutor(Executor):
@@ -460,9 +563,13 @@ class _ClusterExecutor:
                             for s, c in node.assignments.items()}
                     return Batch(cols, b.sel)
                 table = ex_self.session.catalog.get(node.table)
-                ranges = table.splits(spec.nworkers)
-                mine = [r for i, r in enumerate(ranges)
-                        if i % spec.nworkers == spec.windex]
+                if split_subset is not None \
+                        and node.table in split_subset:
+                    mine = split_subset[node.table]
+                else:
+                    ranges = table.splits(spec.nworkers)
+                    mine = [r for i, r in enumerate(ranges)
+                            if i % spec.nworkers == spec.windex]
                 needed = list(dict.fromkeys(node.assignments.values()))
                 datas = [table.read(needed, split=r) for r in mine]
                 cols = {}
@@ -498,8 +605,11 @@ class _ClusterExecutor:
                     np.clip(data, 0, max(len(c.dictionary.values) - 1, 0))]
             valid = None if valid is None else np.asarray(valid)[live]
             cols[sym] = (data, valid)
+        return cols
 
-        buffers: Dict[int, bytes] = {}
+    def _publish_cols(self, cols):
+        """Partition one superstep's output and publish a page per
+        destination bucket."""
         nb = self.spec.out_buckets
         if self.spec.out_kind == "repartition" and nb > 1:
             bucket = hash_partition(cols, self.spec.out_keys, nb)
@@ -507,17 +617,72 @@ class _ClusterExecutor:
                 idx = np.flatnonzero(bucket == b)
                 sub = {k: (d[idx], None if v is None else v[idx])
                        for k, (d, v) in cols.items()}
-                buffers[b] = pack_columns(sub)
+                self.publish(b, pack_columns(sub))
         elif self.spec.out_kind == "scatter" and nb > 1:
             # replicated -> sharded: disjoint round-robin slices (the ICI
             # "masked to one shard" semantics re-established over DCN)
             for b in range(nb):
                 sub = {k: (d[b::nb], None if v is None else v[b::nb])
                        for k, (d, v) in cols.items()}
-                buffers[b] = pack_columns(sub)
-        else:  # gather / broadcast / range: one buffer everyone reads
-            buffers[0] = pack_columns(cols)
-        return buffers
+                self.publish(b, pack_columns(sub))
+        else:  # gather / broadcast: one bucket everyone reads
+            self.publish(0, pack_columns(cols))
+
+    def _publish_range(self, cols):
+        """Sample-sort range partitioning (P11 over DCN): publish a key
+        sample on the side channel (bucket = out_buckets), wait for the
+        coordinator's global boundaries, then bucket rows so consumer
+        shard i holds exactly key-range i.  Equal keys share a bucket
+        (side='left' on exact boundary values), so secondary sort keys
+        never interleave across buckets."""
+        nb = self.spec.out_buckets
+        key_sym, asc, nulls_first = self.spec.out_keys[0]
+        data, valid = cols[key_sym]
+        live = np.ones(len(data), dtype=bool) if valid is None else valid
+        sample_vals = data[live][:: max(1, int(np.sum(live)) // 256)][:256]
+        self.publish(nb, pickle.dumps(sample_vals, protocol=4))
+        if not self.task_state.get("range_event", threading.Event()) \
+                .wait(timeout=300.0):
+            raise TimeoutError("range boundaries never arrived")
+        boundaries = self.task_state["range_boundaries"]
+        if len(boundaries):
+            pos = np.searchsorted(boundaries, data, side="left")
+            if not asc:
+                pos = (len(boundaries) - pos)
+        else:
+            pos = np.zeros(len(data), dtype=np.int64)
+        pos = np.clip(pos, 0, nb - 1)
+        nf = (not asc) if nulls_first is None else nulls_first
+        if valid is not None:
+            pos = np.where(valid, pos, 0 if nf else nb - 1)
+        for b in range(nb):
+            idx = np.flatnonzero(pos == b)
+            sub = {k: (d[idx], None if v is None else v[idx])
+                   for k, (d, v) in cols.items()}
+            self.publish(b, pack_columns(sub))
+
+    def run(self) -> None:
+        root = pickle.loads(self.spec.fragment)
+        exch = self._exchange_batches()
+        scan_tables = self._scan_tables(root)
+
+        if self.spec.out_kind == "range":
+            self._publish_range(self._exec_once(root, exch, None))
+            return
+        if len(scan_tables) == 1 and self.spec.nworkers >= 1:
+            # leaf fragment: stream split-chunk supersteps as pages
+            table = self.session.catalog.get(scan_tables[0])
+            ranges = table.splits(self.spec.nworkers * self.PAGES_PER_TASK)
+            mine = [r for i, r in enumerate(ranges)
+                    if i % self.spec.nworkers == self.spec.windex]
+            groups = [mine[i::self.PAGES_PER_TASK]
+                      for i in range(self.PAGES_PER_TASK)]
+            groups = [g for g in groups if g] or [[]]
+            for g in groups:
+                cols = self._exec_once(root, exch, {scan_tables[0]: g})
+                self._publish_cols(cols)
+            return
+        self._publish_cols(self._exec_once(root, exch, None))
 
 
 # ---------------------------------------------------------------------------
@@ -584,26 +749,35 @@ class WorkerServer:
 
     def submit(self, spec: TaskSpec):
         with self.lock:
-            task = {"state": "RUNNING", "error": None, "buffers": {}}
+            # pages: bucket -> list of page bytes (None = acked/pruned);
+            # complete flips when the producer will publish no more
+            task = {"state": "RUNNING", "error": None,
+                    "pages": {}, "complete": False,
+                    "range_boundaries": None,
+                    "range_event": threading.Event()}
             self.tasks[spec.task_id] = task
+
+        def publish(bucket: int, page: bytes):
+            with self.lock:
+                task["pages"].setdefault(bucket, []).append(page)
 
         def run():
             try:
-                # one task at a time per worker; session properties are
-                # snapshotted/restored so overlapping coordinators can't
-                # leak settings into each other's tasks
-                with self.exec_lock:
-                    snapshot = dict(self.session.properties)
-                    try:
-                        for k, v in spec.properties.items():
-                            if k in self.session.properties:
-                                self.session.properties[k] = v
-                        buffers = _ClusterExecutor(self.session, spec).run()
-                    finally:
-                        self.session.properties.clear()
-                        self.session.properties.update(snapshot)
+                # tasks run CONCURRENTLY (producers stream to consumers
+                # on the same worker), so each task executes against a
+                # shallow session clone with its own properties dict —
+                # no shared mutation between overlapping queries
+                import copy
+
+                task_session = copy.copy(self.session)
+                task_session.properties = dict(self.session.properties)
+                for k, v in spec.properties.items():
+                    if k in task_session.properties:
+                        task_session.properties[k] = v
+                _ClusterExecutor(task_session, spec, publish=publish,
+                                 task_state=task).run()
                 with self.lock:
-                    task["buffers"] = buffers
+                    task["complete"] = True
                     task["state"] = "FINISHED"
             except BaseException as e:  # noqa: BLE001 — reported to coordinator
                 import traceback
@@ -612,6 +786,7 @@ class WorkerServer:
                     task["error"] = (f"{type(e).__name__}: {e}\n"
                                      + traceback.format_exc(limit=8))
                     task["state"] = "FAILED"
+                    task["complete"] = True
 
         threading.Thread(target=run, daemon=True).start()
 
@@ -649,6 +824,18 @@ def _make_worker_handler(server: WorkerServer):
                 server.submit(spec)
                 self._send(200, json.dumps(
                     {"taskId": spec.task_id}).encode(), "application/json")
+            elif self.path.startswith("/v1/task/") \
+                    and self.path.endswith("/range"):
+                # range boundaries for sample-sort partitioning
+                tid = self.path.split("/")[3]
+                with server.lock:
+                    task = server.tasks.get(tid)
+                if task is None:
+                    self._send(404, b"{}")
+                    return
+                task["range_boundaries"] = pickle.loads(body)
+                task["range_event"].set()
+                self._send(200, b"{}", "application/json")
             elif self.path == "/v1/shutdown":
                 self._send(200, b"{}", "application/json")
                 threading.Thread(target=server.stop, daemon=True).start()
@@ -678,15 +865,58 @@ def _make_worker_handler(server: WorkerServer):
                          "error": task["error"]}).encode(),
                         "application/json")
                     return
-                if parts[3] == "results" and len(parts) == 5:
-                    if task["state"] == "FAILED":
-                        self._send(500, (task["error"] or "").encode())
-                        return
-                    if task["state"] != "FINISHED":
-                        self._send(503, b"")  # not ready — consumer retries
-                        return
+                # /v1/task/{tid}/results/{bucket}/{token}[/ack]
+                if parts[3] == "results" and len(parts) >= 6:
                     bucket = int(parts[4])
-                    self._send(200, task["buffers"].get(bucket, b""))
+                    token = int(parts[5])
+                    if len(parts) == 7 and parts[6] == "ack":
+                        with server.lock:
+                            pages = task["pages"].get(bucket, [])
+                            for i in range(min(token, len(pages))):
+                                pages[i] = None  # release acked pages
+                        self._send(200, b"{}", "application/json")
+                        return
+                    # snapshot under the lock, SEND outside it — a slow
+                    # consumer must not stall every other request on
+                    # this worker (multi-MB page writes take a while)
+                    kind, page, last, err = "wait", None, False, b""
+                    with server.lock:
+                        if task["state"] == "FAILED":
+                            kind = "failed"
+                            err = (task["error"] or "").encode()
+                        else:
+                            pages = task["pages"].get(bucket, [])
+                            complete = task["complete"]
+                            if token < len(pages):
+                                page = pages[token]
+                                if page is None:
+                                    # acked page re-requested (consumer
+                                    # restarted): at-least-once means a
+                                    # task retry is needed; report as
+                                    # failure so the coordinator re-runs
+                                    kind = "released"
+                                else:
+                                    kind = "page"
+                                    last = complete \
+                                        and token + 1 >= len(pages)
+                            elif complete:
+                                kind = "done"
+                    if kind == "failed":
+                        self._send(500, err)
+                    elif kind == "released":
+                        self._send(500, b"page already released")
+                    elif kind == "page":
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Content-Length", str(len(page)))
+                        self.send_header("X-Complete", "1" if last else "0")
+                        self.end_headers()
+                        self.wfile.write(page)
+                    elif kind == "done":
+                        self._send(204, b"")  # no more pages
+                    else:
+                        self._send(503, b"")  # not produced yet — poll
                     return
             self._send(404, b"{}")
 
@@ -725,11 +955,33 @@ class ClusterSession:
 
         stmt = parse(text)
         plan = plan_statement(self.session, stmt)
-        try:
-            return self._run_distributed(plan)
-        except (Undistributable, NotImplementedError):
-            # plan shape the cluster can't place — single-node fallback
-            return self.session.sql(text)
+        attempts = 1 + int(self.session.properties.get(
+            "cluster_query_retries", 1))
+        for attempt in range(attempts):
+            try:
+                return self._run_distributed(plan)
+            except (Undistributable, NotImplementedError):
+                # plan shape the cluster can't place — single-node fallback
+                return self.session.sql(text)
+            except (UpstreamFailed, RuntimeError, TimeoutError,
+                    ConnectionError, OSError):
+                # worker failure mid-query: drop dead workers and re-run
+                # on the survivors (reference: fast-fail + re-execution;
+                # recoverable grouped execution covers finer grains)
+                survivors = []
+                for url in self.workers:
+                    try:
+                        _http(f"{url}/v1/info", timeout=3.0)
+                        survivors.append(url)
+                    except Exception:
+                        pass
+                if not survivors or attempt == attempts - 1 \
+                        or len(survivors) == len(self.workers):
+                    # same pool => deterministic failure; re-running
+                    # would fail identically
+                    raise
+                self.workers = survivors
+        raise RuntimeError("unreachable")
 
     def _eval_subplan(self, sub, scalar_results) -> tuple:
         """Uncorrelated scalar subplan -> (value, valid), distributed the
@@ -836,8 +1088,20 @@ class ClusterSession:
 
     def _run_fragments(self, fragments, scalar_results, run_on_of,
                        consumer_of, placements, all_tasks):
+        """All-at-once scheduling (reference: AllAtOnceExecutionPolicy):
+        every fragment's tasks are submitted up front with pre-assigned
+        upstream locations; workers stream pages between themselves while
+        the coordinator runs the final fragment, which blocks inside its
+        own page pulls until the pipeline drains."""
         nfr = len(fragments)
-        coordinator_result = None
+        # pre-assign every placement so consumers know their upstreams
+        # at submission time (streaming needs no producer-finished
+        # barrier; the page protocol carries readiness)
+        for frag in fragments:
+            run_on = run_on_of[frag.fid]
+            placements[frag.fid] = [
+                (url, f"t_{uuid.uuid4().hex[:12]}") for url in run_on]
+        coordinator_spec = None
         for frag in fragments:
             out_symbols = [s for s, _ in frag.root.outputs()]
             inputs = []
@@ -849,17 +1113,16 @@ class ClusterSession:
                     "upstreams": placements[inp.producer],
                 })
             run_on = run_on_of[frag.fid]
-            is_final = frag.fid == nfr - 1
-            if frag.out_kind in ("repartition", "scatter"):
+            if frag.out_kind in ("repartition", "scatter", "range"):
                 out_buckets = len(run_on_of.get(
                     consumer_of.get(frag.fid, -1), [None]))
             else:
                 out_buckets = 1
             payload_root = pickle.dumps(frag.root, protocol=4)
             tasks: List[Tuple[str, str]] = []
-            for w, url in enumerate(run_on):
+            for w, (url, tid) in enumerate(placements[frag.fid]):
                 spec = TaskSpec(
-                    task_id=f"t_{uuid.uuid4().hex[:12]}",
+                    task_id=tid,
                     fragment=payload_root,
                     out_symbols=out_symbols,
                     nworkers=len(run_on), windex=w, inputs=inputs,
@@ -871,19 +1134,70 @@ class ClusterSession:
                             "float32_compute", False)},
                 )
                 if url is None:  # final fragment: run on the coordinator
-                    buffers = _ClusterExecutor(self.session, spec).run()
-                    coordinator_result = unpack_columns(buffers[0])
+                    coordinator_spec = spec
                 else:
                     _http(f"{url}/v1/task", pickle.dumps(spec, protocol=4),
                           method="POST")
-                    tasks.append((url, spec.task_id))
+                    tasks.append((url, tid))
             if tasks:
                 all_tasks.extend(tasks)
-                self._wait(tasks)
-                placements[frag.fid] = tasks
-        return coordinator_result
+            if frag.out_kind == "range" and tasks:
+                self._coordinate_range(frag, tasks, out_buckets)
+        # the final fragment executes here, pulling pages (and thereby
+        # blocking) until upstream production drains
+        pages: Dict[int, List[bytes]] = {}
+        _ClusterExecutor(self.session, coordinator_spec,
+                         publish=lambda b, p: pages.setdefault(
+                             b, []).append(p)).run()
+        merged = [unpack_columns(p) for p in pages.get(0, [])]
+        # single final page expected (gather output); concat defensively
+        if len(merged) == 1:
+            return merged[0]
+        out: Dict[str, tuple] = {}
+        for part in merged:
+            for k, (d, v) in part.items():
+                if k in out:
+                    pd, pv = out[k]
+                    d = np.concatenate([pd, d])
+                    v = None if (pv is None and v is None) else \
+                        np.concatenate([
+                            pv if pv is not None
+                            else np.ones(len(pd), bool),
+                            v if v is not None
+                            else np.ones(len(d) - len(pd), bool)])
+                out[k] = (d, v)
+        return out
+
+    def _coordinate_range(self, frag, tasks, out_buckets):
+        """Pull key samples from every range producer, compute global
+        bucket boundaries, post them back (reference: the sampling stage
+        of distributed sort, admin/dist-sort.rst)."""
+        _sym, asc, _nf = frag.out_keys[0]
+        samples = []
+        for url, tid in tasks:
+            # exactly one sample page per producer; the producer is
+            # blocked awaiting boundaries, so never wait for "complete"
+            for page in pull_pages(url, tid, out_buckets, max_pages=1):
+                vals = pickle.loads(page)
+                if len(vals):
+                    samples.append(np.asarray(vals))
+        if samples:
+            allv = np.concatenate(samples)
+            allv = np.sort(allv)
+            k = out_buckets
+            edges = [allv[int(len(allv) * i / k)]
+                     for i in range(1, k)] if len(allv) else []
+            boundaries = np.asarray(edges)
+        else:
+            boundaries = np.asarray([])
+        payload = pickle.dumps(boundaries, protocol=4)
+        for url, tid in tasks:
+            _http(f"{url}/v1/task/{tid}/range", payload, method="POST")
 
     def _wait(self, tasks: List[Tuple[str, str]], timeout: float = 600.0):
+        """Status-poll specific tasks to completion.  The streaming
+        scheduler no longer needs a barrier; kept for direct task-status
+        waits (tests, ad-hoc operations)."""
         deadline = time.time() + timeout
         for url, tid in tasks:
             while True:

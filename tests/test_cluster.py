@@ -177,3 +177,68 @@ def test_worker_refuses_public_bind_without_secret(monkeypatch):
     monkeypatch.setattr(CM, "_process_secret", None)
     with pytest.raises(ValueError, match="non-loopback"):
         CM.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache", host="0.0.0.0")
+
+
+def test_worker_killed_mid_query_retries(tpch_catalog_tiny):
+    """VERDICT r2 item 5: kill a worker mid-query; the coordinator drops
+    the dead worker and re-executes on survivors."""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    cs = C.launch_local_cluster(
+        session, "tpch:0.01:/tmp/presto_tpu_cache", nworkers=3)
+    try:
+        q = ("SELECT o_orderpriority, count(*) c FROM orders "
+             "GROUP BY o_orderpriority ORDER BY 1")
+        want = session.sql(q).rows
+        assert cs.sql(q).rows == want  # warm the pipeline
+        # kill one worker process outright
+        victim = cs._procs[0]
+        victim.kill()
+        victim.wait(timeout=10)
+        assert norm(cs.sql(q).rows) == norm(want)
+        assert len(cs.workers) == 2  # dead worker dropped from the pool
+    finally:
+        cs.close()
+
+
+def test_cluster_distributed_sort_uses_range_buckets(cluster):
+    """Range exchange partitions by sampled key ranges across workers
+    (no gather-to-one-node); ordered concat of bucket outputs is the
+    global order."""
+    session, cs = cluster
+    # force the range path (default threshold skips it at tiny SF)
+    old = session.properties.get("distributed_sort_threshold_rows")
+    session.properties["distributed_sort_threshold_rows"] = 100
+    try:
+        q = ("SELECT c_custkey, c_acctbal FROM customer "
+             "ORDER BY c_acctbal DESC, c_custkey")
+        # norm(): XLA jit rewrites x/100 as reciprocal-multiply (fast
+        # math), so compiled single-node floats differ 1ulp from the
+        # workers' eager division
+        assert norm(cs.sql(q).rows) == norm(session.sql(q).rows)
+        q2 = ("SELECT c_name, c_custkey FROM customer "
+              "ORDER BY c_name LIMIT 50")
+        assert cs.sql(q2).rows == session.sql(q2).rows
+        # prove the distributed plan really contains a range exchange
+        from presto_tpu.exec.executor import plan_statement
+        from presto_tpu.plan import nodes as P
+        from presto_tpu.plan.distribute import distribute
+        from presto_tpu.sql.parser import parse
+
+        dplan = distribute(plan_statement(session, parse(q)), session,
+                           ndev=len(cs.workers))
+        kinds = []
+
+        def walk(n):
+            if isinstance(n, P.Exchange):
+                kinds.append(n.kind)
+            for attr in ("source", "left", "right"):
+                if hasattr(n, attr):
+                    walk(getattr(n, attr))
+
+        walk(dplan.root)
+        assert "range" in kinds, kinds
+    finally:
+        if old is None:
+            session.properties.pop("distributed_sort_threshold_rows", None)
+        else:
+            session.properties["distributed_sort_threshold_rows"] = old
