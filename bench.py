@@ -14,8 +14,12 @@ Baselines (VERDICT r1 asked for an honest one):
 - vs_sqlite: the old oracle ratio (single-threaded row store; flattering,
   kept for continuity with BENCH_r01).
 
-Extra keys: per_query_ms (warm best per query), sf, note.
-Env knobs: BENCH_SF, BENCH_QUERIES, BENCH_RUNS, BENCH_F32.
+Extra keys: per_query_ms (warm best per query), sf, note, scale_configs
+(last-known SF10/SF100 results from BENCH_SCALE_PROGRESS.json — the line
+prints BEFORE the slow scale configs re-run, so the caller always
+captures a number even under a process timeout).
+Env knobs: BENCH_SF, BENCH_QUERIES, BENCH_RUNS, BENCH_F32, BENCH_SCALE,
+BENCH_SF1_TESTS, BENCH_TIME_BUDGET.
 """
 
 import json
@@ -64,39 +68,78 @@ def main():
     vs_numpy = numpy_speedup(cat, engine_times)
     vs_sqlite = sqlite_speedup(engine_times)
 
-    def emit(scale):
-        print(json.dumps({
-            "metric": f"tpch_sf{SF:g}_q{'_'.join(map(str, QUERY_IDS))}_rows_per_sec_per_chip",
-            "value": round(rows_per_sec, 1),
-            "unit": "rows/sec/chip",
-            "vs_baseline": vs_numpy if vs_numpy is not None else vs_sqlite,
-            "vs_numpy": vs_numpy,
-            "vs_sqlite": vs_sqlite,
-            "per_query_ms": {str(q): round(t * 1000, 1)
-                             for q, t in engine_times.items()},
-            "sf": SF,
-            "scale_configs": scale,
-            "note": ("vs_numpy = tuned vectorized numpy single-core; "
-                     "vs_sqlite = row-store oracle (flattering); "
-                     "warm times include ~100ms tunnel RTT per query; "
-                     "scale_configs = BASELINE SF10/SF100 wall-clock on "
-                     "one chip (device-side generation + chunked "
-                     "execution); SF100 Q9 via BENCH_SF100_Q9=1"
-                     + ("" if vs_numpy is not None
-                        else "; NUMPY BASELINE FAILED - vs_baseline fell "
-                             "back to sqlite")), }, ), flush=True)
+    # ONE line on stdout, emitted IMMEDIATELY after the SF1 measurements
+    # (round-2 lesson: the scale configs below can outlive the caller's
+    # process timeout; holding the line until after them lost the whole
+    # round's perf record).  scale_configs in the line are the last-known
+    # results from the committed side file (BENCH_SCALE_PROGRESS.json),
+    # refreshed after the line is printed.
+    print(json.dumps({
+        "metric": f"tpch_sf{SF:g}_q{'_'.join(map(str, QUERY_IDS))}_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": vs_numpy if vs_numpy is not None else vs_sqlite,
+        "vs_numpy": vs_numpy,
+        "vs_sqlite": vs_sqlite,
+        "per_query_ms": {str(q): round(t * 1000, 1)
+                         for q, t in engine_times.items()},
+        "sf": SF,
+        "scale_configs": {k: v for k, v in (load_scale_progress() or {}).items()
+                          if k != "sf1_test_tier"} or None,
+        "sf1_tests": (load_scale_progress() or {}).get("sf1_test_tier"),
+        "note": ("vs_numpy = tuned vectorized numpy single-core; "
+                 "vs_sqlite = row-store oracle (flattering); "
+                 "warm times include ~100ms tunnel RTT per query; "
+                 "scale_configs = BASELINE SF10/SF100 wall-clock on "
+                 "one chip (device-side generation + chunked "
+                 "execution), last-known results refreshed after this "
+                 "line prints (each entry carries asof)"
+                 + ("" if vs_numpy is not None
+                    else "; NUMPY BASELINE FAILED - vs_baseline fell "
+                         "back to sqlite")), }, ), flush=True)
 
-    # ONE line on stdout (the documented contract).  The SF10/SF100
-    # configs take tens of minutes (one ~35min XLA compile at SF100), so
-    # they run under a wall budget and stream partial results to a side
-    # file (BENCH_SCALE_PROGRESS.json) as crash evidence for the case
-    # where the caller times the whole run out.
-    scale_enabled = os.environ.get("BENCH_SCALE", "1") != "0"
-    scale = None
-    if scale_enabled:
-        scale = scale_configs(
-            session_factory=lambda sf: _scale_session(sf))
-    emit(scale)
+    # Post-emit phases (best-effort; the record above is already out):
+    # 1. SF1 correctness tier (spill/guards at non-toy scale — the
+    #    reference runs TestDistributedSpilledQueries in its standard
+    #    suite); 2. refresh the scale-config side file.
+    if os.environ.get("BENCH_SF1_TESTS", "1") != "0":
+        run_sf1_tier()
+    if os.environ.get("BENCH_SCALE", "1") != "0":
+        scale_configs(session_factory=lambda sf: _scale_session(sf))
+
+
+SCALE_PROGRESS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_SCALE_PROGRESS.json")
+
+
+def load_scale_progress():
+    try:
+        with open(SCALE_PROGRESS_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def run_sf1_tier():
+    """SF1 scale-test tier as part of the default bench run, so spill and
+    capacity-guard paths at non-toy scale cannot regress silently."""
+    import subprocess
+
+    env = dict(os.environ, PRESTO_TPU_SCALE_TESTS="1")
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_scale_sf1.py", "-q"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+    out = load_scale_progress() or {}
+    out["sf1_test_tier"] = {"rc": rc, "asof": _today()}
+    try:
+        with open(SCALE_PROGRESS_PATH, "w") as f:
+            json.dump(out, f)
+    except OSError:
+        pass
+
+
+def _today():
+    return time.strftime("%Y-%m-%d")
 
 
 def _scale_session(sf):
@@ -110,8 +153,21 @@ def _scale_session(sf):
 
 
 # rough cold wall-clock per scale config (compile-dominated), used to
-# skip configs the remaining budget cannot fit
+# skip configs the remaining budget cannot fit.  With a populated
+# persistent XLA cache (presto_tpu/__init__.py) "cold" is a cache load,
+# not a compile, so the gates drop accordingly.
 _SCALE_ESTIMATES_S = {"sf10_q3": 420, "sf100_q18": 2700, "sf100_q9": 2700}
+_SCALE_ESTIMATES_CACHED_S = {"sf10_q3": 180, "sf100_q18": 600, "sf100_q9": 600}
+
+
+def _scale_estimates():
+    cache = os.environ.get("PRESTO_TPU_XLA_CACHE", "/tmp/presto_tpu_xla_cache")
+    try:
+        if cache != "0" and os.listdir(cache):
+            return _SCALE_ESTIMATES_CACHED_S
+    except OSError:
+        pass
+    return _SCALE_ESTIMATES_S
 
 
 def scale_configs(session_factory):
@@ -119,28 +175,35 @@ def scale_configs(session_factory):
     SF10 runs whole-table on device generation; SF100 streams through
     chunked (grouped) execution.  Runs under BENCH_TIME_BUDGET wall
     seconds (default 5400) — configs that cannot fit are recorded as
-    skipped.  Partial results stream to BENCH_SCALE_PROGRESS.json."""
+    skipped.  Results merge into BENCH_SCALE_PROGRESS.json (committed;
+    the emitted bench line reports its last-known contents), stalest
+    entry refreshed first so a tight budget rotates rather than
+    starves."""
     from tests.tpch_queries import QUERIES
 
     budget = float(os.environ.get("BENCH_TIME_BUDGET", "5400"))
     t_start = time.perf_counter()
-    configs = [("sf10_q3", 10.0, 3), ("sf100_q18", 100.0, 18)]
-    if os.environ.get("BENCH_SF100_Q9", "0") == "1":
-        configs.append(("sf100_q9", 100.0, 9))
-    out = {}
+    configs = [("sf10_q3", 10.0, 3), ("sf100_q18", 100.0, 18),
+               ("sf100_q9", 100.0, 9)]
+    out = load_scale_progress() or {}
+    # stalest first: refresh the entry whose record is oldest
+    configs.sort(key=lambda c: (out.get(c[0]) or {}).get("asof", ""))
 
     def checkpoint():
         try:
-            with open("BENCH_SCALE_PROGRESS.json", "w") as f:
+            with open(SCALE_PROGRESS_PATH, "w") as f:
                 json.dump(out, f)
         except OSError:
             pass
 
+    estimates = _scale_estimates()
     for name, sf, qid in configs:
         remaining = budget - (time.perf_counter() - t_start)
-        if remaining < _SCALE_ESTIMATES_S.get(name, 600):
-            out[name] = {"skipped": f"time budget ({remaining:.0f}s left)"}
-            checkpoint()
+        if remaining < estimates.get(name, 600):
+            if name not in out:
+                out[name] = {"skipped":
+                             f"time budget ({remaining:.0f}s left)"}
+                checkpoint()
             continue
         try:
             s = session_factory(sf)
@@ -151,9 +214,10 @@ def scale_configs(session_factory):
             s.sql(QUERIES[qid])
             warm = time.perf_counter() - t0
             out[name] = {"cold_s": round(cold, 1), "warm_s": round(warm, 1),
-                         "rows": len(r.rows)}
+                         "rows": len(r.rows), "asof": _today()}
         except Exception as e:
-            out[name] = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+            out[name] = {"error": f"{type(e).__name__}: {str(e)[:120]}",
+                         "asof": _today()}
         finally:
             checkpoint()
             # catalog<->table reference cycles would otherwise keep the

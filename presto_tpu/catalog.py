@@ -235,7 +235,15 @@ class Catalog:
         self.version += 1
 
     def drop(self, name: str, if_exists: bool = False) -> bool:
-        t = self.tables.pop(name.lower(), None)
+        n = name.lower()
+        if n not in self.tables and "." in n:
+            # qualified name over a flat registration: resolve the same
+            # way get() does, or DROP memory.default.t would delete the
+            # table's data and then fail to unregister it
+            flat = self._flat_name(n)
+            if flat is not None and flat in self.tables:
+                n = flat
+        t = self.tables.pop(n, None)
         if t is None:
             if if_exists:
                 return False

@@ -155,6 +155,14 @@ class Parser:
             return t.value.lower()
         self.err("expected identifier")
 
+    def dotted_name(self) -> str:
+        """catalog.schema.table target names in DDL/DML (reference:
+        qualifiedName in SqlBase.g4 used by CREATE/DROP/INSERT/DELETE)."""
+        name = self.ident()
+        while self.accept_op("."):
+            name += "." + self.ident()
+        return name
+
     # ---- statements -------------------------------------------------
     def parse_statement(self) -> ast.Statement:
         stmt = self._statement()
@@ -191,7 +199,7 @@ class Parser:
                 self.expect_kw("NOT")
                 self.expect_kw("EXISTS")
                 if_not_exists = True
-            name = self.ident()
+            name = self.dotted_name()
             if self.accept_op("("):  # CREATE TABLE t (col type, ...)
                 columns = []
                 while True:
@@ -214,10 +222,10 @@ class Parser:
             if self.accept_kw("IF"):
                 self.expect_kw("EXISTS")
                 if_exists = True
-            return ast.DropTable(self.ident(), if_exists)
+            return ast.DropTable(self.dotted_name(), if_exists)
         if self.accept_kw("DELETE"):
             self.expect_kw("FROM")
-            name = self.ident()
+            name = self.dotted_name()
             where = None
             if self.accept_kw("WHERE"):
                 where = self.expr()
@@ -257,7 +265,7 @@ class Parser:
             return ast.TransactionStatement("ROLLBACK")
         if self.accept_kw("INSERT"):
             self.expect_kw("INTO")
-            name = self.ident()
+            name = self.dotted_name()
             cols = None
             if self.accept_op("("):
                 cols = [self.ident()]
@@ -267,9 +275,7 @@ class Parser:
             return ast.InsertInto(name, cols, self.parse_query())
         if self.at_kw("SET") and self.peek(1).kind == "kw" and self.peek(1).value == "SESSION":
             self.next(), self.next()
-            name = self.ident()
-            while self.accept_op("."):
-                name += "." + self.ident()
+            name = self.dotted_name()
             self.expect_op("=")
             v = self.next()
             value = v.value
@@ -617,9 +623,7 @@ class Parser:
                 if col_aliases and hasattr(rel, "column_aliases"):
                     rel.column_aliases = col_aliases
             return rel
-        name = self.ident()
-        while self.accept_op("."):  # catalog.schema.table — full dotted name
-            name += "." + self.ident()
+        name = self.dotted_name()  # catalog.schema.table — full dotted name
         alias, col_aliases = self._alias()
         return ast.Table(name, alias, col_aliases)
 
