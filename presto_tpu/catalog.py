@@ -50,6 +50,15 @@ class ConnectorTable:
     def max_rows_per_key(self) -> Dict[tuple, int]:
         return {}
 
+    # ---- bucketing SPI (reference: Connector.getNodePartitioningProvider,
+    # presto-spi/.../spi/connector/Connector.java:74 + BucketNodeMap;
+    # here the metadata that lets grouped/chunked execution stream this
+    # table bucket-by-bucket, exec/chunked.py) ----
+    def bucketing(self):
+        """ChunkFamily this table belongs to, or None if it cannot
+        stream chunk-wise."""
+        return None
+
     def _invalidate(self) -> None:
         """Drop cached device columns + bump the catalog version after a
         write (compiled-plan caches key on catalog version)."""
@@ -125,6 +134,11 @@ class TpchTable(ConnectorTable):
 
     def row_count(self) -> int:
         return tpch_gen.row_count(self.name, self.sf)
+
+    def bucketing(self):
+        from presto_tpu.connectors.tpch_device import chunk_family
+
+        return chunk_family(self.name, self.sf)
 
     def column_stats(self, column: str):
         from presto_tpu.plan.stats import ColStats

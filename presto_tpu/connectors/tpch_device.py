@@ -540,3 +540,106 @@ def is_device_generable(table: str, col: str) -> bool:
     if col in DEVICE_COLUMNS.get(table, set()):
         return True
     return table == "part" and col.startswith("p_name$contains$")
+
+
+# ---------------------------------------------------------------------------
+# connector bucketing SPI (chunk family): how lineitem/orders stream
+# chunk-wise through grouped execution.  Reference: connector bucketing
+# (ConnectorNodePartitioningProvider, Connector.java:74, BucketNodeMap)
+# + grouped execution (StageExecutionDescriptor.java:24-27,
+# Lifespan.java:26-38).  TPU-native adaptation: a bucket is an
+# order-row RANGE (range-bucketing colocates orderkey equi-joins the
+# same way hash-bucketing does), and the "page source" for a bucket is
+# device-side generation inside the consuming XLA program.
+# ---------------------------------------------------------------------------
+
+
+DEFAULT_CHUNK_ORDERS = 2_000_000
+
+
+class TpchChunkGrid:
+    """One chunk plan: order-row edges + lineitem offsets, static pad
+    capacities, and the in-trace scan builder."""
+
+    def __init__(self, sf: float, order_edges, line_offsets):
+        self.sf = sf
+        self.order_edges = order_edges
+        self.line_offsets = line_offsets
+        self.nchunks = len(order_edges) - 1
+        self.cap_orders = max(b - a for a, b in zip(order_edges[:-1],
+                                                    order_edges[1:]))
+        self.cap_lines = max(b - a for a, b in zip(line_offsets[:-1],
+                                                   line_offsets[1:]))
+
+    def capacity(self, table: str) -> int:
+        return self.cap_lines if table == "lineitem" else self.cap_orders
+
+    def exchange_bound(self) -> int:
+        """Default per-chunk compact bound for exchange outputs (chunk
+        outputs are reductions of the chunk — aggregates on the bucket
+        key, selective filters)."""
+        return self.cap_orders
+
+    def chunk_args(self, i: int):
+        """Traced scalars for chunk i — a fixed pytree so ONE jitted
+        program serves every chunk."""
+        o0 = self.order_edges[i]
+        o1 = self.order_edges[i + 1]
+        return (jnp.asarray(o0, jnp.int64),
+                jnp.asarray(self.line_offsets[i], jnp.int64),
+                jnp.asarray(o1 - o0, jnp.int32),
+                jnp.asarray(self.line_offsets[i + 1]
+                            - self.line_offsets[i], jnp.int32))
+
+    def build_scan(self, table: str, cols: List[str], args, f32: bool):
+        """(raw {col: Column}, sel) for one chunk of `table`, inside the
+        traced program."""
+        o0, line0, n_ord, n_line = args
+        if table == "lineitem":
+            raw = generate_device(
+                "lineitem", self.sf, cols, row0=o0, f32=f32,
+                pad=self.cap_lines, n_orders=self.cap_orders,
+                line_row0=line0)
+            sel = jnp.arange(self.cap_lines) < n_line
+        elif table == "orders":
+            raw = generate_device("orders", self.sf, cols, row0=o0,
+                                  f32=f32, pad=self.cap_orders)
+            sel = jnp.arange(self.cap_orders) < n_ord
+        else:
+            raise KeyError(f"{table} is not in the tpch chunk family")
+        return raw, sel
+
+
+class TpchChunkFamily:
+    """lineitem+orders co-bucketed on orderkey (reference:
+    TpchNodePartitioningProvider buckets both on orderkey so the Q18
+    join is colocated, presto-tpch/.../TpchNodePartitioningProvider)."""
+
+    name = "tpch-orders"
+    BUCKET_COLUMNS = {"lineitem": "l_orderkey", "orders": "o_orderkey"}
+
+    def __init__(self, sf: float):
+        self.sf = sf
+
+    def tables(self):
+        return set(self.BUCKET_COLUMNS)
+
+    def bucket_column(self, table: str) -> str:
+        return self.BUCKET_COLUMNS[table]
+
+    def device_columns(self, table: str):
+        return DEVICE_COLUMNS.get(table, set())
+
+    def make_grid(self, session) -> TpchChunkGrid:
+        chunk_orders = int(session.properties.get(
+            "chunk_orders", DEFAULT_CHUNK_ORDERS))
+        edges, line_offsets = H.chunk_grid(self.sf, chunk_orders)
+        return TpchChunkGrid(self.sf, edges, line_offsets)
+
+
+def chunk_family(table: str, sf: float):
+    """Bucketing metadata for `table`, or None (the connector SPI hook
+    TpchTable.bucketing delegates to)."""
+    if table in TpchChunkFamily.BUCKET_COLUMNS:
+        return TpchChunkFamily(sf)
+    return None

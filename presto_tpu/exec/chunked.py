@@ -9,21 +9,31 @@ StageExecutionDescriptor, BucketNodeMap), plus the partial->final
 aggregation split and partial topN of AddExchanges.  TPU-native
 adaptation:
 
+- WHICH tables can stream, on WHICH bucket column, and HOW a bucket's
+  rows are produced on device is connector metadata — the ChunkFamily
+  SPI (`ConnectorTable.bucketing()`, the analog of
+  ConnectorNodePartitioningProvider, spi/connector/Connector.java:74):
+  a family is a set of co-bucketed tables (tpch lineitem+orders on
+  orderkey; tpcds store_sales+store_returns on ticket_number,
+  catalog_sales+catalog_returns on order_number) with a chunk grid and
+  an in-trace device scan builder;
 - the distributed planner (plan/distribute.py) plans chunks as shards
   over a VIRTUAL TIME AXIS: bucketed scans are `hashed` on the bucket
-  column (range-bucketing colocates orderkey equi-joins exactly like
+  column (range-bucketing colocates equi-joins exactly like
   hash-bucketing), resident tables are `replicated` (whole in HBM,
   visible to every chunk);
 - the plan is cut at Exchange nodes (parallel/cluster.cut_fragments,
   the PlanFragmenter analog); an exchange between a chunk-looped
   fragment and its consumer is an ON-DEVICE concat buffer — partial
   states are tiny after per-chunk aggregation/topN, so "shuffle"
-  degenerates to concatenation on one chip;
+  degenerates to concatenation on one chip; a query may chunk-loop
+  SEVERAL families (q64 streams the store channel and the catalog
+  channel through separate loops whose buffered outputs join);
 - each chunk-looped fragment compiles ONCE: chunk shapes are padded to
   a static capacity and the chunk start offsets enter as traced
   scalars; scan batches are GENERATED ON DEVICE inside the same
-  compiled program (connectors/tpch_device.py), so a 600M-row scan
-  never exists anywhere — not in host RAM, not in HBM.
+  compiled program (connectors/tpch_device.py, tpcds_device.py), so a
+  600M-row scan never exists anywhere — not in host RAM, not in HBM.
 """
 
 from __future__ import annotations
@@ -45,8 +55,6 @@ class Unchunkable(Exception):
     back to whole-table execution."""
 
 
-# default chunk size in ORDERS rows (~4x lineitems per chunk)
-DEFAULT_CHUNK_ORDERS = 2_000_000
 # scans above this row count stream chunk-wise instead of residing whole
 DEFAULT_STREAM_THRESHOLD = 120_000_000
 
@@ -64,22 +72,29 @@ def _collect_scans(node, out):
                     _collect_scans(x, out)
 
 
+def _threshold(session) -> int:
+    return int(session.properties.get(
+        "chunked_rows_threshold", DEFAULT_STREAM_THRESHOLD))
+
+
+def _bucketing(table) -> Optional[object]:
+    fn = getattr(table, "bucketing", None)
+    return fn() if fn is not None else None
+
+
 def catalog_may_need_chunks(session) -> bool:
     """Cheap pre-check (no planning): any bucketed big table at all?"""
-    threshold = int(session.properties.get(
-        "chunked_rows_threshold", DEFAULT_STREAM_THRESHOLD))
-    for name in ("lineitem", "orders"):
-        if name in session.catalog:
-            t = session.catalog.get(name)
-            if hasattr(t, "sf") and t.row_count() > threshold:
-                return True
+    threshold = _threshold(session)
+    for t in session.catalog.tables.values():
+        if _bucketing(t) is not None and t.row_count() > threshold:
+            return True
     return False
 
 
 def chunk_plan_needed(session, plan) -> bool:
-    """True when some scanned table is too big to reside in HBM whole."""
-    threshold = int(session.properties.get(
-        "chunked_rows_threshold", DEFAULT_STREAM_THRESHOLD))
+    """True when some scanned bucketed table is too big to reside in
+    HBM whole."""
+    threshold = _threshold(session)
     scans: List[P.TableScan] = []
     _collect_scans(plan.root, scans)
     for n in scans:
@@ -87,10 +102,32 @@ def chunk_plan_needed(session, plan) -> bool:
             t = session.catalog.get(n.table)
         except KeyError:
             return False
-        if n.table in ("lineitem", "orders") and hasattr(t, "sf") \
-                and t.row_count() > threshold:
+        if _bucketing(t) is not None and t.row_count() > threshold:
             return True
     return False
+
+
+def _plan_streaming(session, scans) -> Dict[str, object]:
+    """{table: family} for every plan table whose chunk family has at
+    least one member over the streaming threshold (family members
+    stream TOGETHER — their colocated bucketing is what keeps the
+    family's equi-joins chunk-local)."""
+    threshold = _threshold(session)
+    by_family: Dict[str, list] = {}
+    for tname in {n.table for n in scans}:
+        try:
+            t = session.catalog.get(tname)
+        except KeyError:
+            continue
+        fam = _bucketing(t)
+        if fam is not None:
+            by_family.setdefault(fam.name, []).append((tname, t, fam))
+    streamed: Dict[str, object] = {}
+    for members in by_family.values():
+        if any(t.row_count() > threshold for _, t, _f in members):
+            for tname, _t, fam in members:
+                streamed[tname] = fam
+    return streamed
 
 
 def run_chunked(session, stmt, text: str, plan=None):
@@ -102,7 +139,6 @@ def run_chunked(session, stmt, text: str, plan=None):
     from presto_tpu.exec.executor import Executor, plan_statement
     from presto_tpu.parallel.cluster import cut_fragments
     from presto_tpu.plan.distribute import Undistributable, distribute
-    from presto_tpu.connectors import tpch as H
 
     cache = getattr(session, "_chunked_cache", None)
     if cache is None:
@@ -122,35 +158,26 @@ def run_chunked(session, stmt, text: str, plan=None):
 
     scans: List[P.TableScan] = []
     _collect_scans(plan.root, scans)
-    tables = {n.table for n in scans}
-    streamed = {t for t in tables if t in ("lineitem", "orders")}
-    if not streamed & {"lineitem", "orders"}:
+    streamed = _plan_streaming(session, scans)
+    if not streamed:
         raise Unchunkable("no bucketed big table in plan")
-    from presto_tpu.connectors import tpch_device as D
 
     for n in scans:
-        if n.table in streamed:
+        fam = streamed.get(n.table)
+        if fam is not None:
             missing = set(n.assignments.values()) \
-                - D.DEVICE_COLUMNS.get(n.table, set())
+                - fam.device_columns(n.table)
             if missing:
                 raise Unchunkable(
                     f"{n.table} columns not device-generable: {missing}")
-    sf = session.catalog.get(next(iter(streamed))).sf
 
-    chunk_orders = int(session.properties.get(
-        "chunk_orders", DEFAULT_CHUNK_ORDERS))
-    order_edges, line_offsets = H.chunk_grid(sf, chunk_orders)
-    nchunks = len(order_edges) - 1
-    cap_orders = max(b - a for a, b in zip(order_edges[:-1],
-                                           order_edges[1:]))
-    cap_lines = max(b - a for a, b in zip(line_offsets[:-1],
-                                          line_offsets[1:]))
-
-    bucketed = {}
-    if "lineitem" in streamed:
-        bucketed["lineitem"] = "l_orderkey"
-    if "orders" in streamed:
-        bucketed["orders"] = "o_orderkey"
+    grids = {}
+    for fam in streamed.values():
+        if fam.name not in grids:
+            grids[fam.name] = fam.make_grid(session)
+    table_family = {t: fam.name for t, fam in streamed.items()}
+    bucketed = {t: fam.bucket_column(t) for t, fam in streamed.items()}
+    nchunks = max(g.nchunks for g in grids.values())
     try:
         dplan = distribute(plan, session, ndev=nchunks, bucketed=bucketed)
     except Undistributable as e:
@@ -159,25 +186,24 @@ def run_chunked(session, stmt, text: str, plan=None):
     frags = cut_fragments(dplan.root)
     f32 = bool(session.properties.get("float32_compute", False))
 
-    runner = _FragmentRunner(session, f32, sf, order_edges, line_offsets,
-                             cap_orders, cap_lines, {})
+    runner = _FragmentRunner(session, f32, table_family, grids, {})
     consumer_eid = {}  # producer fid -> eid of the exchange it feeds
     for f in frags:
         for inp in f.inputs:
             consumer_eid[inp.producer] = inp.eid
-    result = _execute_prepared(session, dplan, frags, runner, bucketed,
+    result = _execute_prepared(session, dplan, frags, runner, table_family,
                                consumer_eid)
-    cache[key] = (dplan, frags, runner, bucketed, consumer_eid)
+    cache[key] = (dplan, frags, runner, table_family, consumer_eid)
     return result
 
 
-def _execute_prepared(session, dplan, frags, runner, bucketed,
+def _execute_prepared(session, dplan, frags, runner, table_family,
                       consumer_eid):
     from presto_tpu.exec.executor import Executor, StaticFallback
 
     runner.buffers.clear()
     try:
-        final_batch = _run_fragments(session, frags, runner, bucketed,
+        final_batch = _run_fragments(session, frags, runner, table_family,
                                      consumer_eid)
         ex = Executor(session)
         return ex.materialize(dplan, final_batch)
@@ -185,14 +211,14 @@ def _execute_prepared(session, dplan, frags, runner, bucketed,
         runner.buffers.clear()  # don't pin HBM between runs
 
 
-def _run_fragments(session, frags, runner, bucketed, consumer_eid):
+def _run_fragments(session, frags, runner, table_family, consumer_eid):
     from presto_tpu.exec.executor import StaticFallback
 
     final_batch = None
     for frag in frags:
         fscans: List[P.TableScan] = []
         _collect_scans(frag.root, fscans)
-        chunked = any(s.table in bucketed for s in fscans)
+        chunked = any(s.table in table_family for s in fscans)
         try:
             out = runner.run_chunk_loop(frag, fscans) if chunked \
                 else runner.run_once(frag, fscans)
@@ -209,24 +235,24 @@ def _run_fragments(session, frags, runner, bucketed, consumer_eid):
 
 
 class _FragmentRunner:
-    def __init__(self, session, f32, sf, order_edges, line_offsets,
-                 cap_orders, cap_lines, buffers):
+    def __init__(self, session, f32, table_family: Dict[str, str],
+                 grids: Dict[str, object], buffers):
         self.session = session
         self.f32 = f32
-        self.sf = sf
-        self.order_edges = order_edges
-        self.line_offsets = line_offsets
-        self.cap_orders = cap_orders
-        self.cap_lines = cap_lines
+        self.table_family = table_family  # table -> family name
+        self.grids = grids                # family name -> ChunkGrid
         self.buffers = buffers
+        # run-once fragments consume concatenated exchange buffers; their
+        # compact fallback bound follows the largest family's per-chunk
+        # reduction bound
+        self.default_bound = max(g.exchange_bound() for g in grids.values())
         self._jit = {}  # fragment fid -> (jitted fn, ids, chunk_nodes)
 
     # ---- fragment execution ------------------------------------------
-    def _scan_builder(self, node: P.TableScan, chunk_args):
+    def _scan_builder(self, node: P.TableScan, chunk_args, grid):
         """Returns a Batch for one scan node inside the traced program.
-        chunk_args = (o0, line0, n_ord_live, n_line_live) traced scalars,
-        or None for run-once fragments."""
-        from presto_tpu.connectors import tpch_device as D
+        chunk_args = the grid's traced scalars, or None for run-once
+        fragments."""
         from presto_tpu.exec.executor import scan_batch
 
         if node.table.startswith("__exch_"):
@@ -239,30 +265,20 @@ class _FragmentRunner:
                 cols[sym] = Column(c.data, c.valid, node.types[sym],
                                    c.dictionary)
             return Batch(cols, b.sel)
-        table = self.session.catalog.get(node.table)
-        if chunk_args is not None and node.table in ("lineitem", "orders"):
-            o0, line0, n_ord, n_line = chunk_args
+        if chunk_args is not None and node.table in self.table_family:
             cols = list(dict.fromkeys(node.assignments.values()))
-            if node.table == "lineitem":
-                raw = D.generate_device(
-                    "lineitem", self.sf, cols, row0=o0, f32=self.f32,
-                    pad=self.cap_lines, n_orders=self.cap_orders,
-                    line_row0=line0)
-                sel = jnp.arange(self.cap_lines) < n_line
-            else:
-                raw = D.generate_device(
-                    "orders", self.sf, cols, row0=o0, f32=self.f32,
-                    pad=self.cap_orders)
-                sel = jnp.arange(self.cap_orders) < n_ord
+            raw, sel = grid.build_scan(node.table, cols, chunk_args,
+                                       self.f32)
             cols_out = {}
             for sym, src in node.assignments.items():
                 c = raw[src]
                 cols_out[sym] = Column(c.data, c.valid, node.types[sym],
                                        c.dictionary)
             return Batch(cols_out, sel)
+        table = self.session.catalog.get(node.table)
         return scan_batch(table, node, self.f32)
 
-    def _execute(self, frag, scan_inputs):
+    def _execute(self, frag, scan_inputs, bound_cap):
         from presto_tpu.exec.executor import (Executor, _compact_batch,
                                               _static_root_bound)
 
@@ -271,14 +287,15 @@ class _FragmentRunner:
         # shrink inside the compiled program: the eager compact outside
         # would otherwise walk a chunk-capacity-sized batch at peak HBM.
         # A fragment root with a static bound (partial topN/limit)
-        # compacts to it; otherwise compact to the per-chunk order count
-        # (exchange outputs are reductions of the chunk — aggregates on
-        # the bucket key, selective filters) with an overflow GUARD so a
-        # miss falls back instead of silently truncating.
+        # compacts to it; otherwise compact to the family's per-chunk
+        # reduction bound (exchange outputs are reductions of the chunk
+        # — aggregates on the bucket key, selective filters) with an
+        # overflow GUARD so a miss falls back instead of silently
+        # truncating.
         bound = _static_root_bound(frag.root)
         guards = list(ex.guards)
-        if bound is None and out.sel.shape[0] > 4 * self.cap_orders:
-            bound = self.cap_orders
+        if bound is None and out.sel.shape[0] > 4 * bound_cap:
+            bound = bound_cap
             guards.append(jnp.sum(out.sel) > bound)
         if bound is not None and out.sel.shape[0] > 4 * bound:
             out = _compact_batch(out, bound)
@@ -294,12 +311,20 @@ class _FragmentRunner:
         resident = {}
         chunk_nodes = []
         for n in fscans:
-            if chunked and n.table in ("lineitem", "orders") \
+            if chunked and n.table in self.table_family \
                     and not n.table.startswith("__exch_"):
                 chunk_nodes.append(n)
             else:
-                resident[id(n)] = self._scan_builder(n, None)
+                resident[id(n)] = self._scan_builder(n, None, None)
         return resident, chunk_nodes
+
+    def _fragment_grid(self, chunk_nodes):
+        fams = {self.table_family[n.table] for n in chunk_nodes}
+        if len(fams) != 1:
+            # distribute() cuts exchanges between differently-bucketed
+            # sides, so a mixed-family fragment means a planning hole
+            raise Unchunkable(f"fragment mixes chunk families: {fams}")
+        return self.grids[fams.pop()]
 
     def run_once(self, frag, fscans) -> Batch:
         resident, _ = self._split_scans(fscans, chunked=False)
@@ -308,7 +333,8 @@ class _FragmentRunner:
             ids = list(resident)
 
             def fn(batches):
-                return self._execute(frag, dict(zip(ids, batches)))
+                return self._execute(frag, dict(zip(ids, batches)),
+                                     self.default_bound)
 
             cached = self._jit[frag.fid] = (jax.jit(fn), ids, None)
         jitted, ids, _ = cached
@@ -319,6 +345,7 @@ class _FragmentRunner:
 
     def run_chunk_loop(self, frag, fscans) -> Batch:
         resident, chunk_nodes = self._split_scans(fscans, chunked=True)
+        grid = self._fragment_grid(chunk_nodes)
         cached = self._jit.get(frag.fid)
         if cached is None:
             ids = list(resident)
@@ -327,8 +354,9 @@ class _FragmentRunner:
             def fn(batches, args):
                 scan_inputs = dict(zip(ids, batches))
                 for n in nodes:
-                    scan_inputs[id(n)] = self._scan_builder(n, args)
-                return self._execute(frag, scan_inputs)
+                    scan_inputs[id(n)] = self._scan_builder(n, args, grid)
+                return self._execute(frag, scan_inputs,
+                                     grid.exchange_bound())
 
             cached = self._jit[frag.fid] = (jax.jit(fn), ids, nodes)
         jitted, ids, _ = cached
@@ -338,15 +366,8 @@ class _FragmentRunner:
         buffered = 0
         budget = int(self.session.properties.get(
             "chunk_buffer_max_rows", 64_000_000))
-        for i in range(len(self.order_edges) - 1):
-            o0 = self.order_edges[i]
-            o1 = self.order_edges[i + 1]
-            args = (jnp.asarray(o0, jnp.int64),
-                    jnp.asarray(self.line_offsets[i], jnp.int64),
-                    jnp.asarray(o1 - o0, jnp.int32),
-                    jnp.asarray(self.line_offsets[i + 1]
-                                - self.line_offsets[i], jnp.int32))
-            out, guard = jitted(res_list, args)
+        for i in range(grid.nchunks):
+            out, guard = jitted(res_list, grid.chunk_args(i))
             guards.append(guard)
             part = K.compact(out)  # host-syncs the live count
             parts.append(part)
