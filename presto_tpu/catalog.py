@@ -327,6 +327,22 @@ class TpcdsTable(ConnectorTable):
     def row_count(self) -> int:
         return self._gen.row_count(self.name, self.sf)
 
+    def bucketing(self):
+        from presto_tpu.connectors.tpcds_device import chunk_family
+
+        return chunk_family(self.name, self.sf)
+
+    def column_stats(self, column: str):
+        from presto_tpu.plan.stats import ColStats
+
+        return self._gen.column_stats(self.name, column, self.sf, ColStats)
+
+    def unique_keys(self):
+        return self._gen.UNIQUE_KEYS.get(self.name, [])
+
+    def max_rows_per_key(self):
+        return self._gen.MAX_ROWS_PER_KEY.get(self.name, {})
+
     def splits(self, n_splits):
         return self._gen.split_ranges(self.name, self.sf, n_splits)
 
@@ -349,7 +365,9 @@ class TpcdsTable(ConnectorTable):
             if self.cache_dir:
                 os.makedirs(self.cache_dir, exist_ok=True)
                 path = os.path.join(self.cache_dir,
-                                    f"tpcds_{self.name}_sf{self.sf}.pkl")
+                                    # v2: money values moved to explicit
+                                    # rint/reciprocal rounding (tpcds._round)
+                                    f"tpcds_{self.name}_sf{self.sf}_v2.pkl")
             if path and os.path.exists(path):
                 with open(path, "rb") as f:
                     self._data = pickle.load(f)

@@ -35,6 +35,16 @@ from presto_tpu.connectors.tpch import _colkey, _splitmix64
 # ---------------------------------------------------------------------------
 
 
+def _round(x, decimals=2):
+    """np.round with explicit scale / rint / reciprocal-multiply.
+    XLA rewrites division by a constant into multiplication by its
+    reciprocal under jit; the device fact generator (tpcds_device.py)
+    therefore multiplies by 0.01, and the host must do the SAME or the
+    two diverge by 1 ULP per money value (np.round divides)."""
+    s = 10.0 ** decimals
+    return np.rint(x * s) * (1.0 / s)
+
+
 def _raw_at(table, col, rows, k=1):
     """(len(rows), k) uniform doubles in [0,1) for explicit row indices —
     the strided-access generalization the returns tables need to read
@@ -61,11 +71,11 @@ def _u(table, col, row0, n, lo, hi, dtype=np.int64):
 
 
 def _money_at(table, col, rows, lo_cents, hi_cents):
-    return _u_at(table, col, rows, lo_cents, hi_cents) / 100.0
+    return _u_at(table, col, rows, lo_cents, hi_cents) * 0.01
 
 
 def _money(table, col, row0, n, lo_cents, hi_cents):
-    return _u(table, col, row0, n, lo_cents, hi_cents) / 100.0
+    return _u(table, col, row0, n, lo_cents, hi_cents) * 0.01
 
 
 def _pick_at(table, col, rows, choices):
@@ -555,7 +565,7 @@ def _gen_item(sf, row0, row1):
                                   np.int32),
         "i_item_desc": _pick("item", "desc", row0, n, COLORS),
         "i_current_price": price,
-        "i_wholesale_cost": np.round(price * 0.6, 2),
+        "i_wholesale_cost": _round(price * 0.6, 2),
         "i_brand_id": brand_id.astype(np.int32),
         "i_brand": brand,
         "i_class_id": class_id.astype(np.int32),
@@ -698,7 +708,7 @@ def _gen_promotion(sf, row0, row1):
         "p_start_date_sk": start,
         "p_end_date_sk": start + _u("promotion", "len", row0, n, 10, 60),
         "p_item_sk": _u("promotion", "item", row0, n, 1, n_item),
-        "p_cost": np.round(1000.0 * _u("promotion", "cost", row0, n, 1, 1000), 2),
+        "p_cost": _round(1000.0 * _u("promotion", "cost", row0, n, 1, 1000), 2),
         "p_response_target": np.ones(n, np.int32),
         "p_promo_name": _pick("promotion", "name", row0, n,
                               ["anti", "bar", "ese", "ought", "able", "pri",
@@ -755,7 +765,7 @@ def _gen_store(sf, row0, row1):
                                5).astype(object),
         "s_country": np.full(n, "United States", dtype=object),
         "s_gmt_offset": _u("store", "gmt", row0, n, -10, -5).astype(np.float64),
-        "s_tax_precentage": np.round(_u("store", "tax", row0, n, 0, 11) / 100.0, 2),
+        "s_tax_precentage": _round(_u("store", "tax", row0, n, 0, 11) / 100.0, 2),
     }
 
 
@@ -838,17 +848,17 @@ def _store_sales_cols(sf, rows):
     wholesale = _money_at(t, "wholesale", rows, 100, 10_000)
     markup = _raw_at(t, "markup", rows)[:, 0] * 1.0  # 0..100% markup
     discount = _raw_at(t, "discount", rows)[:, 0]    # 0..100% discount
-    list_price = np.round(wholesale * (1.0 + markup), 2)
-    sales_price = np.round(list_price * (1.0 - discount), 2)
+    list_price = _round(wholesale * (1.0 + markup), 2)
+    sales_price = _round(list_price * (1.0 - discount), 2)
     qf = qty.astype(np.float64)
-    ext_list = np.round(list_price * qf, 2)
-    ext_sales = np.round(sales_price * qf, 2)
-    ext_wholesale = np.round(wholesale * qf, 2)
-    ext_discount = np.round(ext_list - ext_sales, 2)
-    coupon = np.round(ext_sales * (_raw_at(t, "coupon", rows)[:, 0] < 0.2)
+    ext_list = _round(list_price * qf, 2)
+    ext_sales = _round(sales_price * qf, 2)
+    ext_wholesale = _round(wholesale * qf, 2)
+    ext_discount = _round(ext_list - ext_sales, 2)
+    coupon = _round(ext_sales * (_raw_at(t, "coupon", rows)[:, 0] < 0.2)
                       * _raw_at(t, "coupamt", rows)[:, 0] * 0.5, 2)
-    net_paid = np.round(ext_sales - coupon, 2)
-    tax = np.round(net_paid * 0.08, 2)
+    net_paid = _round(ext_sales - coupon, 2)
+    tax = _round(net_paid * 0.08, 2)
     return {
         "ss_sold_date_sk": sold_date,
         "ss_sold_time_sk": _u_at(t, "time", rows, 28800, 75600),
@@ -871,8 +881,8 @@ def _store_sales_cols(sf, rows):
         "ss_ext_tax": tax,
         "ss_coupon_amt": coupon,
         "ss_net_paid": net_paid,
-        "ss_net_paid_inc_tax": np.round(net_paid + tax, 2),
-        "ss_net_profit": np.round(net_paid - ext_wholesale, 2),
+        "ss_net_paid_inc_tax": _round(net_paid + tax, 2),
+        "ss_net_profit": _round(net_paid - ext_wholesale, 2),
     }
 
 
@@ -887,14 +897,14 @@ def _gen_store_returns(sf, row0, row1):
     ss = _store_sales_cols(sf, parent)
     ret_qty = np.minimum(
         _u_at(t, "qty", j, 1, 100, np.int32), ss["ss_quantity"])
-    amt = np.round(ss["ss_sales_price"] * ret_qty, 2)
-    tax = np.round(amt * 0.08, 2)
+    amt = _round(ss["ss_sales_price"] * ret_qty, 2)
+    tax = _round(amt * 0.08, 2)
     fee = _money_at(t, "fee", j, 50, 10_000)
     ship = _money_at(t, "ship", j, 0, 10_000)
     frac = _raw_at(t, "cashfrac", j)[:, 0]
-    cash = np.round(amt * frac, 2)
-    charge = np.round((amt - cash) * _raw_at(t, "chargefrac", j)[:, 0], 2)
-    credit = np.round(amt - cash - charge, 2)
+    cash = _round(amt * frac, 2)
+    charge = _round((amt - cash) * _raw_at(t, "chargefrac", j)[:, 0], 2)
+    credit = _round(amt - cash - charge, 2)
     return {
         "sr_returned_date_sk": ss["ss_sold_date_sk"]
             + _u_at(t, "lag", j, 1, 60),
@@ -910,13 +920,13 @@ def _gen_store_returns(sf, row0, row1):
         "sr_return_quantity": ret_qty,
         "sr_return_amt": amt,
         "sr_return_tax": tax,
-        "sr_return_amt_inc_tax": np.round(amt + tax, 2),
+        "sr_return_amt_inc_tax": _round(amt + tax, 2),
         "sr_fee": fee,
         "sr_return_ship_cost": ship,
         "sr_refunded_cash": cash,
         "sr_reversed_charge": charge,
         "sr_store_credit": credit,
-        "sr_net_loss": np.round(fee + ship + tax, 2),
+        "sr_net_loss": _round(fee + ship + tax, 2),
     }
 
 
@@ -1016,28 +1026,28 @@ def _sales_money_cols(t, sf, rows):
     wholesale = _money_at(t, "wholesale", rows, 100, 10_000)
     markup = _raw_at(t, "markup", rows)[:, 0]
     discount = _raw_at(t, "discount", rows)[:, 0]
-    list_price = np.round(wholesale * (1.0 + markup), 2)
-    sales_price = np.round(list_price * (1.0 - discount), 2)
+    list_price = _round(wholesale * (1.0 + markup), 2)
+    sales_price = _round(list_price * (1.0 - discount), 2)
     qf = qty.astype(np.float64)
-    ext_list = np.round(list_price * qf, 2)
-    ext_sales = np.round(sales_price * qf, 2)
-    ext_wholesale = np.round(wholesale * qf, 2)
-    coupon = np.round(ext_sales * (_raw_at(t, "coupon", rows)[:, 0] < 0.2)
+    ext_list = _round(list_price * qf, 2)
+    ext_sales = _round(sales_price * qf, 2)
+    ext_wholesale = _round(wholesale * qf, 2)
+    coupon = _round(ext_sales * (_raw_at(t, "coupon", rows)[:, 0] < 0.2)
                       * _raw_at(t, "coupamt", rows)[:, 0] * 0.5, 2)
     ship_cost = _money_at(t, "shipc", rows, 0, 5_000) * qf
-    net_paid = np.round(ext_sales - coupon, 2)
-    tax = np.round(net_paid * 0.08, 2)
+    net_paid = _round(ext_sales - coupon, 2)
+    tax = _round(net_paid * 0.08, 2)
     return {
         "quantity": qty, "wholesale_cost": wholesale,
         "list_price": list_price, "sales_price": sales_price,
-        "ext_discount_amt": np.round(ext_list - ext_sales, 2),
+        "ext_discount_amt": _round(ext_list - ext_sales, 2),
         "ext_sales_price": ext_sales, "ext_wholesale_cost": ext_wholesale,
         "ext_list_price": ext_list, "ext_tax": tax, "coupon_amt": coupon,
-        "ext_ship_cost": np.round(ship_cost, 2), "net_paid": net_paid,
-        "net_paid_inc_tax": np.round(net_paid + tax, 2),
-        "net_paid_inc_ship": np.round(net_paid + ship_cost, 2),
-        "net_paid_inc_ship_tax": np.round(net_paid + ship_cost + tax, 2),
-        "net_profit": np.round(net_paid - ext_wholesale, 2),
+        "ext_ship_cost": _round(ship_cost, 2), "net_paid": net_paid,
+        "net_paid_inc_tax": _round(net_paid + tax, 2),
+        "net_paid_inc_ship": _round(net_paid + ship_cost, 2),
+        "net_paid_inc_ship_tax": _round(net_paid + ship_cost + tax, 2),
+        "net_profit": _round(net_paid - ext_wholesale, 2),
     }
 
 
@@ -1045,20 +1055,20 @@ def _returns_money_cols(t, rows_j, sales_price, sale_qty):
     """Channel-shared returns math (returned quantity, amounts, fee,
     shipping, cash/charge/credit split)."""
     ret_qty = np.minimum(_u_at(t, "qty", rows_j, 1, 100, np.int32), sale_qty)
-    amt = np.round(sales_price * ret_qty, 2)
-    tax = np.round(amt * 0.08, 2)
+    amt = _round(sales_price * ret_qty, 2)
+    tax = _round(amt * 0.08, 2)
     fee = _money_at(t, "fee", rows_j, 50, 10_000)
     ship = _money_at(t, "ship", rows_j, 0, 10_000)
     frac = _raw_at(t, "cashfrac", rows_j)[:, 0]
-    cash = np.round(amt * frac, 2)
-    charge = np.round((amt - cash) * _raw_at(t, "chargefrac", rows_j)[:, 0], 2)
-    credit = np.round(amt - cash - charge, 2)
+    cash = _round(amt * frac, 2)
+    charge = _round((amt - cash) * _raw_at(t, "chargefrac", rows_j)[:, 0], 2)
+    credit = _round(amt - cash - charge, 2)
     return {
         "return_quantity": ret_qty, "return_amt": amt, "return_tax": tax,
-        "return_amt_inc_tax": np.round(amt + tax, 2), "fee": fee,
+        "return_amt_inc_tax": _round(amt + tax, 2), "fee": fee,
         "return_ship_cost": ship, "refunded_cash": cash,
         "reversed_charge": charge, "credit": credit,
-        "net_loss": np.round(fee + ship + tax, 2),
+        "net_loss": _round(fee + ship + tax, 2),
     }
 
 
@@ -1320,6 +1330,175 @@ _GENERATORS = {
     "time_dim": _gen_time_dim,
     "inventory": _gen_inventory,
 }
+
+
+# ---------------------------------------------------------------------------
+# statistics (arithmetic, no scanning) — reference: presto-tpcds
+# TpcdsMetadata.getTableStatistics; derivable from the generator
+# formulas.  Feeds the CBO (plan/stats.py) AND the static-shape bounds
+# of compiled/chunked execution (join fanout, agg capacities).
+# ---------------------------------------------------------------------------
+
+PRIMARY_KEYS = {
+    "date_dim": "d_date_sk", "item": "i_item_sk",
+    "customer": "c_customer_sk", "customer_address": "ca_address_sk",
+    "customer_demographics": "cd_demo_sk",
+    "household_demographics": "hd_demo_sk",
+    "income_band": "ib_income_band_sk", "promotion": "p_promo_sk",
+    "store": "s_store_sk", "reason": "r_reason_sk",
+    "ship_mode": "sm_ship_mode_sk", "warehouse": "w_warehouse_sk",
+    "web_site": "web_site_sk", "web_page": "wp_web_page_sk",
+    "call_center": "cc_call_center_sk",
+    "catalog_page": "cp_catalog_page_sk", "time_dim": "t_time_sk",
+}
+
+# returns are unique on the ticket/order alone: parent sales rows are
+# every RETURN_EVERY-th row and RETURN_EVERY (10) exceeds the rows per
+# ticket (3) / order (4), so no two returns share a parent unit
+UNIQUE_KEYS = {
+    **{t: [(k,)] for t, k in PRIMARY_KEYS.items()},
+    "store_returns": [("sr_ticket_number",),
+                      ("sr_item_sk", "sr_ticket_number")],
+    "catalog_returns": [("cr_order_number",),
+                        ("cr_item_sk", "cr_order_number")],
+    "web_returns": [("wr_order_number",),
+                    ("wr_item_sk", "wr_order_number")],
+    "inventory": [("inv_date_sk", "inv_item_sk", "inv_warehouse_sk")],
+}
+
+# max rows sharing one value of the key set (join fanout upper bounds)
+MAX_ROWS_PER_KEY = {
+    "store_sales": {("ss_ticket_number",): ITEMS_PER_TICKET,
+                    ("ss_item_sk", "ss_ticket_number"): ITEMS_PER_TICKET},
+    "catalog_sales": {("cs_order_number",): ITEMS_PER_ORDER,
+                      ("cs_item_sk", "cs_order_number"): ITEMS_PER_ORDER},
+    "web_sales": {("ws_order_number",): ITEMS_PER_ORDER,
+                  ("ws_item_sk", "ws_order_number"): ITEMS_PER_ORDER},
+}
+
+
+def _fk_targets(sf: float):
+    """FK column suffix -> (lo, hi) of the referenced key range."""
+    return {
+        "_date_sk": (JULIAN_OF_START, JULIAN_OF_START + DATE_DIM_ROWS - 1),
+        "_time_sk": (0, 86_399),
+        "_item_sk": (1, row_count("item", sf)),
+        "_customer_sk": (1, row_count("customer", sf)),
+        "_cdemo_sk": (1, row_count("customer_demographics", sf)),
+        "_hdemo_sk": (1, _FIXED_ROWS["household_demographics"]),
+        "_addr_sk": (1, row_count("customer_address", sf)),
+        "_store_sk": (1, row_count("store", sf)),
+        "_promo_sk": (1, row_count("promotion", sf)),
+        "_warehouse_sk": (1, row_count("warehouse", sf)),
+        "_call_center_sk": (1, 6),
+        "_catalog_page_sk": (1, 11_718),
+        "_ship_mode_sk": (1, _FIXED_ROWS["ship_mode"]),
+        "_reason_sk": (1, _FIXED_ROWS["reason"]),
+        "_income_band_sk": (1, _FIXED_ROWS["income_band"]),
+        "_web_page_sk": (1, row_count("web_page", sf)),
+        "_web_site_sk": (1, row_count("web_site", sf)),
+    }
+
+
+def column_stats(table: str, column: str, sf: float, ColStats):
+    """(min, max, ndv) per column from the generator formulas — exact
+    bounds, approximate ndv."""
+    rows = row_count(table, sf)
+    if column == "d_date_sk":
+        return ColStats(min=float(JULIAN_OF_START),
+                        max=float(JULIAN_OF_START + rows - 1), ndv=rows)
+    if column == "t_time_sk":
+        return ColStats(min=0.0, max=float(rows - 1), ndv=rows)
+    if column == PRIMARY_KEYS.get(table):  # k = row + 1
+        return ColStats(min=1.0, max=float(rows), ndv=rows)
+    # fact-table unit numbers
+    if column in ("ss_ticket_number",):
+        n = row_count("store_sales", sf) // ITEMS_PER_TICKET + 1
+        return ColStats(min=1.0, max=float(n), ndv=n)
+    if column in ("cs_order_number", "cr_order_number"):
+        n = row_count("catalog_sales", sf) // ITEMS_PER_ORDER + 1
+        return ColStats(min=1.0, max=float(n), ndv=n)
+    if column in ("ws_order_number", "wr_order_number"):
+        n = row_count("web_sales", sf) // ITEMS_PER_ORDER + 1
+        return ColStats(min=1.0, max=float(n), ndv=n)
+    if column == "sr_ticket_number":
+        n = row_count("store_sales", sf) // ITEMS_PER_TICKET + 1
+        return ColStats(min=1.0, max=float(n), ndv=min(rows, n))
+    # sold/returned/ship dates on fact tables: the 5-year sales window
+    if column.endswith("sold_date_sk") or column.endswith(
+            "returned_date_sk") or column.endswith("ship_date_sk"):
+        # ship/returned lag up to 90/60 days past the sold window: the
+        # +150 widening must cover ndv too (group capacities sized from
+        # ndv must never undershoot)
+        return ColStats(min=float(SALES_DATE_LO),
+                        max=float(SALES_DATE_HI + 150),
+                        ndv=SALES_DATE_HI + 150 - SALES_DATE_LO + 1)
+    if column.endswith("sold_time_sk") or column.endswith(
+            "return_time_sk") or column.endswith("returned_time_sk"):
+        return ColStats(min=28800.0, max=75600.0, ndv=46801)
+    # FK columns by suffix
+    for suffix, (lo, hi) in _fk_targets(sf).items():
+        if column.endswith(suffix):
+            return ColStats(min=float(lo), max=float(hi),
+                            ndv=min(rows, hi - lo + 1))
+    # date_dim derived columns queries filter on constantly
+    D = {
+        "d_year": (1900, 2099, 200), "d_moy": (1, 12, 12),
+        "d_dom": (1, 31, 31), "d_qoy": (1, 4, 4), "d_dow": (0, 6, 7),
+        "d_month_seq": (0, 2399, 2400), "d_week_seq": (1, 10436, 10436),
+        "d_quarter_seq": (0, 799, 800),
+        "d_date": (-25567, 47481, DATE_DIM_ROWS),
+        "i_manager_id": (1, 100, 100), "i_manufact_id": (1, 1000, 1000),
+        "i_brand_id": (1_001_000,
+                       len(CATEGORIES) * 1_000_000 + len(CLASSES) * 1000
+                       + 999, len(CATEGORIES) * len(CLASSES) * 1000),
+        "i_class_id": (1, len(CLASSES), len(CLASSES)),
+        "i_category_id": (1, len(CATEGORIES), len(CATEGORIES)),
+        "i_current_price": (0.09, 999.99, 99_991),
+        "cd_purchase_estimate": (500, 10000, 20),
+        "cd_dep_count": (0, 6, 7), "cd_dep_employed_count": (0, 6, 7),
+        "cd_dep_college_count": (0, 6, 7),
+        "hd_dep_count": (0, 9, 10), "hd_vehicle_count": (0, 5, 6),
+        "ib_lower_bound": (0, 190001, 20),
+        "ib_upper_bound": (10000, 200000, 20),
+        "c_birth_day": (1, 28, 28), "c_birth_month": (1, 12, 12),
+        "c_birth_year": (1924, 1992, 69),
+        "ca_gmt_offset": (-10, -5, 6),
+        "inv_quantity_on_hand": (0, 1000, 1001),
+    }
+    if column in D:
+        lo, hi, ndv = D[column]
+        return ColStats(min=float(lo), max=float(hi), ndv=ndv)
+    # quantities / money on fact tables: exact generator ranges.
+    # ext_* amounts are unit price x quantity (<=100), so their bounds
+    # and ndvs are ~100x the unit-price rules — match the ext_ prefix
+    # FIRST or group capacities sized from ndv undershoot by 100x
+    if column.endswith("_quantity"):
+        return ColStats(min=0.0 if "return" in column else 1.0,
+                        max=100.0, ndv=101)
+    if column == "i_wholesale_cost":  # price * 0.6, price <= 999.99
+        return ColStats(min=0.05, max=600.0, ndv=60_000)
+    if "_ext_" in column or column.endswith("_paid") \
+            or "_paid_inc" in column or column.endswith("_profit") \
+            or column.endswith("_coupon_amt"):
+        # worst case list_price(200) x qty(100), plus ship (<=5000/unit
+        # x qty via ext_ship_cost) and tax on the _inc_ variants; profit
+        # can go negative.  Bounds here must never undershoot (they feed
+        # range selectivity AND static range-narrowing)
+        lo = -20_000.0 if "profit" in column or "discount" in column \
+            else 0.0
+        return ColStats(min=lo, max=27_000.0, ndv=2_000_000)
+    if column.endswith("wholesale_cost"):
+        return ColStats(min=1.0, max=100.0, ndv=9901)
+    if column.endswith("list_price") and table != "item":
+        return ColStats(min=1.0, max=200.0, ndv=19901)
+    if column.endswith("sales_price") and table != "item":
+        return ColStats(min=0.0, max=200.0, ndv=20001)
+    typ = SCHEMAS[table].get(column)
+    if typ is not None and typ.name == "VARCHAR":
+        # string ndvs: enum picks are tiny, ids/names scale with rows
+        return ColStats(ndv=min(rows, 100_000))
+    return ColStats()
 
 
 def generate(table: str, sf: float = 1.0, row0: int = 0,

@@ -220,11 +220,27 @@ def _run_fragments(session, frags, runner, table_family, consumer_eid):
         _collect_scans(frag.root, fscans)
         chunked = any(s.table in table_family for s in fscans)
         try:
-            out = runner.run_chunk_loop(frag, fscans) if chunked \
-                else runner.run_once(frag, fscans)
+            if chunked:
+                out = runner.run_chunk_loop(frag, fscans)
+            elif frag.fid in runner.dynamic_fids:
+                out = runner.run_once_dynamic(frag, fscans)
+            else:
+                try:
+                    out = runner.run_once(frag, fscans)
+                except (StaticFallback, Unchunkable):
+                    # a run-once fragment (resident scans / buffered
+                    # exchange inputs, e.g. q64's cross_sales self-join
+                    # whose fanout has no static bound, or a fragment
+                    # whose runtime guard tripped) executes ONCE on
+                    # already-reduced data — the dynamic executor with
+                    # host syncs is fine there, only chunk LOOPS must
+                    # stay sync-free.  Memoized so warm runs skip the
+                    # doomed trace.
+                    runner.dynamic_fids.add(frag.fid)
+                    out = runner.run_once_dynamic(frag, fscans)
         except StaticFallback as e:
-            # plan shape the static executor can't bound (e.g. unbounded
-            # join fanout): let the caller fall back to whole-table paths
+            # a chunk-loop shape the static executor can't bound: let
+            # the caller fall back to whole-table paths
             raise Unchunkable(f"static fallback: {e}")
         eid = consumer_eid.get(frag.fid)
         if eid is None:  # no consumer: the root fragment's result
@@ -247,6 +263,7 @@ class _FragmentRunner:
         # reduction bound
         self.default_bound = max(g.exchange_bound() for g in grids.values())
         self._jit = {}  # fragment fid -> (jitted fn, ids, chunk_nodes)
+        self.dynamic_fids = set()  # run-once fids that fell back dynamic
 
     # ---- fragment execution ------------------------------------------
     def _scan_builder(self, node: P.TableScan, chunk_args, grid):
@@ -342,6 +359,16 @@ class _FragmentRunner:
         if bool(guard):
             raise Unchunkable("static guard tripped in resident fragment")
         return out
+
+    def run_once_dynamic(self, frag, fscans) -> Batch:
+        """Eager (non-jit) dynamic execution of a run-once fragment —
+        per-op device dispatch with host syncs, like the whole-table
+        executor."""
+        from presto_tpu.exec.executor import Executor
+
+        resident, _ = self._split_scans(fscans, chunked=False)
+        ex = Executor(self.session, scan_inputs=resident)
+        return ex.exec_node(frag.root)
 
     def run_chunk_loop(self, frag, fscans) -> Batch:
         resident, chunk_nodes = self._split_scans(fscans, chunked=True)

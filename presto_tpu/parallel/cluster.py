@@ -322,7 +322,18 @@ def cut_fragments(root) -> List[Fragment]:
                     nv = [rewrite(x) for x in v]
                     if any(a is not b for a, b in zip(nv, v)):
                         changed[f.name] = nv
-            return dataclasses.replace(n, **changed) if changed else n
+            if not changed:
+                return n
+            nn = dataclasses.replace(n, **changed)
+            # carry the optimizer's static-shape hints (build_unique,
+            # fanout_bound, key_stats, capacity_hint — instance attrs,
+            # not dataclass fields; plan/optimizer.annotate_static_hints
+            # runs BEFORE fragmentation and must survive it)
+            fields = {f.name for f in dataclasses.fields(n)}
+            for k, v in n.__dict__.items():
+                if k not in fields and k not in nn.__dict__:
+                    setattr(nn, k, v)
+            return nn
 
         new_root = rewrite(node)
         fid = len(fragments)

@@ -85,6 +85,15 @@ class Distributer:
         self.bucketed = bucketed or {}  # table -> bucket column (chunk mode)
         self.broadcast_rows = int(session.properties.get(
             "broadcast_join_threshold_rows", 1_000_000))
+        if self.bucketed:
+            # chunk mode: a "broadcast" build side is ONE resident
+            # on-chip buffer shared by the sequential chunk loop, not a
+            # per-shard copy — the economic threshold is HBM headroom,
+            # not replication cost (q64's cs_ui at SF100 is ~1.8M rows
+            # and must stay resident or the repartition path buffers
+            # the 10x bigger store join output instead)
+            self.broadcast_rows = int(session.properties.get(
+                "chunk_broadcast_rows", 8_000_000))
         self.dist_sort_threshold = int(session.properties.get(
             "distributed_sort_threshold_rows", 100_000))
         self.partial_agg_groups = int(session.properties.get(
@@ -120,6 +129,24 @@ class Distributer:
     def _keys_subset(self, keys, of) -> bool:
         reps = {self._find(k) for k in of}
         return all(self._find(k) in reps for k in keys)
+
+    def _colocated(self, ldist, rdist, criteria) -> bool:
+        """Both sides hashed on keys that some pairing of the equi-join
+        criteria makes equal — regardless of criteria ORDER (hashed(K)
+        colocates any join whose criteria CONTAIN K=K': q64 writes
+        `ss_item_sk = sr_item_sk AND ss_ticket_number = sr_ticket_number`
+        and both sides are bucketed on the ticket, the second
+        criterion).  Reference: AddExchanges' partitioning-properties
+        satisfaction is set-based the same way."""
+        if not (ldist.kind == "hashed" and rdist.kind == "hashed"
+                and len(ldist.keys) == len(rdist.keys)):
+            return False
+        pair = {}
+        for lk, rk in criteria:
+            pair.setdefault(self._find(lk), self._find(rk))
+        want = [pair.get(self._find(lk)) for lk in ldist.keys]
+        return (None not in want
+                and want == [self._find(rk) for rk in rdist.keys])
 
     # ------------------------------------------------------------------
     def visit(self, node: P.PlanNode) -> Tuple[P.PlanNode, Dist]:
@@ -400,13 +427,7 @@ class Distributer:
             # shard.
             lkeys0 = [lk for lk, _ in node.criteria]
             rkeys0 = [rk for _, rk in node.criteria]
-            colocated0 = (ldist.kind == "hashed" and rdist.kind == "hashed"
-                          and len(ldist.keys) == len(rdist.keys)
-                          and self._same_keys(ldist.keys,
-                                              lkeys0[:len(ldist.keys)])
-                          and self._same_keys(rdist.keys,
-                                              rkeys0[:len(rdist.keys)]))
-            if not colocated0:
+            if not self._colocated(ldist, rdist, node.criteria):
                 node.left = P.Exchange(left, "repartition", lkeys0)
                 node.right = P.Exchange(right, "repartition", rkeys0)
             # output is NOT hashed on the keys: NULL-extended rows land
@@ -444,11 +465,7 @@ class Distributer:
         broadcast_ok = (rdist.kind == "replicated"
                         or (build_rows is not None
                             and build_rows <= self.broadcast_rows))
-        colocated = (ldist.kind == "hashed" and rdist.kind == "hashed"
-                     and len(ldist.keys) == len(rdist.keys)
-                     and self._same_keys(ldist.keys, lkeys[: len(ldist.keys)])
-                     and self._same_keys(rdist.keys, rkeys[: len(rdist.keys)]))
-        if colocated:
+        if self._colocated(ldist, rdist, node.criteria):
             out_dist = Dist("hashed", ldist.keys)
             return node, out_dist
         if broadcast_ok and node.distribution != "PARTITIONED":

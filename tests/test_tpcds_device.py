@@ -1,0 +1,93 @@
+"""Device-side TPC-DS fact generation must match the host generator
+column-for-column (same splitmix64 counters; see
+presto_tpu/connectors/tpcds_device.py), and the chunk grids must
+partition sales AND returns rows exactly."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import tpcds as DS
+from presto_tpu.connectors import tpcds_device as D
+
+SF = 0.02
+
+
+@pytest.mark.parametrize("table", sorted(D.DEVICE_COLUMNS))
+def test_device_matches_host(table):
+    cols = sorted(D.DEVICE_COLUMNS[table])
+    n = DS.row_count(table, SF)
+    host = DS.generate(table, SF, 0, n)
+    dev = D.generate_device(table, SF, cols, 0, n)
+    for c in cols:
+        got = np.asarray(dev[c].data)
+        want = np.asarray(host[c])
+        assert got.shape == want.shape, (c, got.shape, want.shape)
+        if np.issubdtype(want.dtype, np.floating):
+            np.testing.assert_allclose(got, want, rtol=0, atol=0,
+                                       err_msg=c)
+        else:
+            assert (got == want).all(), (c, got[:5], want[:5])
+
+
+def test_traced_row0_padding():
+    """Chunk-mode generation: traced start + static pad serves every
+    chunk; live rows match the host."""
+    import jax
+    import jax.numpy as jnp
+
+    cols = ["ss_item_sk", "ss_ticket_number", "ss_ext_list_price"]
+    pad = 1000
+
+    @jax.jit
+    def gen(row0):
+        raw = D.generate_device("store_sales", SF, cols, row0, pad)
+        return {c: raw[c].data for c in cols}
+
+    for row0, live in ((0, 1000), (2_997, 1000), (57_000, 404)):
+        out = gen(jnp.asarray(row0, jnp.int64))
+        host = DS.generate("store_sales", SF, row0, row0 + live)
+        for c in cols:
+            got = np.asarray(out[c])[:live]
+            want = np.asarray(host[c])
+            np.testing.assert_array_equal(got, want, err_msg=c)
+
+
+@pytest.mark.parametrize("fam_table", ["store_sales", "catalog_sales"])
+def test_chunk_grid_partitions_exactly(fam_table):
+    """Edges align to ticket/order units; sales and returns ranges
+    partition their tables; every return's bucket value falls inside
+    its chunk's sales bucket range (the colocation property)."""
+    fam = D.chunk_family(fam_table, SF)
+
+    class S:
+        properties = {"chunk_fact_rows": 10_000}
+
+    grid = fam.make_grid(S())
+    total_s = DS.row_count(fam.sales, SF)
+    total_r = DS.row_count(fam.returns, SF)
+    assert grid.edges[0] == 0 and grid.edges[-1] == total_s
+    assert grid.ret_edges[0] == 0 and grid.ret_edges[-1] == total_r
+    assert all(a < b for a, b in zip(grid.edges[:-1], grid.edges[1:]))
+    assert all(e % fam.unit == 0 for e in grid.edges[1:-1])
+    bcol_s = fam.bucket_column(fam.sales)
+    bcol_r = fam.bucket_column(fam.returns)
+    for i in range(grid.nchunks):
+        a, b = grid.edges[i], grid.edges[i + 1]
+        ra, rb = grid.ret_edges[i], grid.ret_edges[i + 1]
+        if ra == rb:
+            continue
+        s = DS.generate(fam.sales, SF, a, b)
+        r = DS.generate(fam.returns, SF, ra, rb)
+        s_buckets = set(np.unique(s[bcol_s]).tolist())
+        r_buckets = set(np.unique(r[bcol_r]).tolist())
+        assert r_buckets <= s_buckets, (i, sorted(r_buckets - s_buckets)[:5])
+
+
+def test_bucketing_spi_wired():
+    from presto_tpu.catalog import tpcds_catalog
+
+    cat = tpcds_catalog(SF)
+    assert cat.get("store_sales").bucketing().name == "tpcds-store"
+    assert cat.get("store_returns").bucketing().name == "tpcds-store"
+    assert cat.get("catalog_sales").bucketing().name == "tpcds-catalog"
+    assert cat.get("item").bucketing() is None
