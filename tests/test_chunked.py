@@ -61,3 +61,45 @@ def test_like_pushdown_into_scan(sessions):
     chunked, _ = sessions
     text = chunked.sql("EXPLAIN " + QUERIES[9]).rows[0][0]
     assert "p_name$contains$green" in text
+
+
+def test_chunked_mesh_composition(sessions):
+    """Chunk loop x device mesh: each superstep runs 4 bucket-aligned
+    micro-chunks under shard_map on the virtual CPU mesh (VERDICT r2
+    item 5 — HBM-exceeding queries must not be single-chip by
+    construction).  Results must match the single-device chunk loop."""
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+
+    meshed = presto_tpu.connect(
+        tpch_catalog(SF, cache_dir="/tmp/presto_tpu_cache"))
+    meshed.properties["chunked_rows_threshold"] = 50_000
+    meshed.properties["chunk_orders"] = 5_000  # ~15 micro-chunks
+    meshed.properties["chunk_mesh_devices"] = 4
+    _, whole = sessions
+    for qid in (1, 3, 18):
+        got = meshed.sql(QUERIES[qid])
+        want = whole.sql(QUERIES[qid])
+        assert norm(got.rows) == norm(want.rows), qid
+
+
+def test_chunked_mesh_actually_chunkloops(sessions):
+    from presto_tpu.exec import chunked as CH
+    from presto_tpu.exec.executor import plan_statement
+    from presto_tpu.sql.parser import parse
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+
+    meshed = presto_tpu.connect(
+        tpch_catalog(SF, cache_dir="/tmp/presto_tpu_cache"))
+    meshed.properties["chunked_rows_threshold"] = 50_000
+    meshed.properties["chunk_orders"] = 5_000
+    meshed.properties["chunk_mesh_devices"] = 4
+    stmt = parse(QUERIES[3])
+    plan = plan_statement(meshed, stmt)
+    assert CH.chunk_plan_needed(meshed, plan)
+    r = CH.run_chunked(meshed, stmt, QUERIES[3])
+    assert len(r.rows) == 10
+    runner = next(iter(meshed._chunked_cache.values()))[2]
+    assert any(isinstance(k, tuple) and k and k[0] == "mesh"
+               for k in runner._jit), "mesh superstep path not taken"
