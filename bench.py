@@ -193,8 +193,9 @@ def scale_configs(session_factory):
 
     budget = float(os.environ.get("BENCH_TIME_BUDGET", "5400"))
     t_start = time.perf_counter()
-    configs = [("sf10_q3", 10.0, 3), ("sf100_q18", 100.0, 18),
-               ("sf100_q9", 100.0, 9), ("sf100_q64", 100.0, 64)]
+    configs = [("sf10_q3", 10.0, 3, "tpch"), ("sf100_q18", 100.0, 18, "tpch"),
+               ("sf100_q9", 100.0, 9, "tpch"),
+               ("sf100_q64", 100.0, 64, "tpcds")]
     out = load_scale_progress() or {}
     # stalest first: refresh the entry whose record is oldest
     configs.sort(key=lambda c: (out.get(c[0]) or {}).get("asof", ""))
@@ -208,9 +209,8 @@ def scale_configs(session_factory):
 
     from tests.tpcds_queries import QUERIES as DS_QUERIES
 
-    for name, sf, qid in configs:
-        tpcds = name.endswith("_q64")
-        q = (DS_QUERIES if tpcds else QUERIES)[qid]
+    for name, sf, qid, family in configs:
+        q = (DS_QUERIES if family == "tpcds" else QUERIES)[qid]
         remaining = budget - (time.perf_counter() - t_start)
         if remaining < _scale_estimate(name, out):
             if name not in out:
@@ -219,7 +219,7 @@ def scale_configs(session_factory):
                 checkpoint()
             continue
         try:
-            s = session_factory(sf, "tpcds" if tpcds else "tpch")
+            s = session_factory(sf, family)
             t0 = time.perf_counter()
             r = s.sql(q)
             cold = time.perf_counter() - t0

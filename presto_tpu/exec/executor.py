@@ -1569,6 +1569,11 @@ class Executor:
         order, lb, ub = K.build_probe(rkey, lkey)
         counts = ub - lb
 
+        if jt == "MARK":  # filter-free by construction (planner)
+            merged = dict(left.columns)
+            merged[node.mark] = Column(counts > 0, None, T.BOOLEAN, None)
+            return Batch(merged, left.sel)
+
         if jt in ("SEMI", "ANTI") and node.filter is None:
             found = counts > 0
             sel = left.sel & (found if jt == "SEMI" else ~found)

@@ -744,8 +744,31 @@ def _emit_concat(args):
         elif lo is not None:
             r = _host_string_transform(nxt, lambda v: lo + v)
             out = ColVal(r.data, all_valid(out, nxt), T.VARCHAR, r.dictionary)
+        elif out.dictionary is not None and nxt.dictionary is not None \
+                and len(out.dictionary) * len(nxt.dictionary) <= (1 << 20):
+            # dictionary x dictionary concat: the result dictionary is
+            # the value cross product (|A| x |B| host strings — q84's
+            # last_name || ', ' || first_name is ~60x64), codes combine
+            # row-major, then re-sort to keep the code-order ==
+            # lexicographic-order invariant
+            av = out.dictionary.values.astype(str)
+            bv = nxt.dictionary.values.astype(str)
+            prod = np.char.add(av[:, None], bv[None, :]).astype(
+                object).ravel()
+            nb = len(bv)
+            codes = ColVal(
+                jnp.clip(out.data, 0, len(av) - 1) * nb
+                + jnp.clip(nxt.data, 0, nb - 1),
+                all_valid(out, nxt), T.VARCHAR)
+            out = normalize_dictionary(prod, codes)
+        elif out.dictionary is not None and nxt.dictionary is not None:
+            raise NotImplementedError(
+                "concat of string columns whose dictionary product "
+                f"({len(out.dictionary)} x {len(nxt.dictionary)}) "
+                "exceeds the materialization cap")
         else:
-            raise NotImplementedError("concat of two non-literal string columns")
+            raise NotImplementedError(
+                "concat of non-dictionary string columns")
     return out
 
 

@@ -102,17 +102,25 @@ class Aggregate(PlanNode):
 class Join(PlanNode):
     """INNER/LEFT/RIGHT/FULL/CROSS equi-join (+ residual filter), or
     SEMI/ANTI (left row kept iff [no] right match passes the filter —
-    reference: SemiJoinNode, with the filtered-EXISTS generalization)."""
+    reference: SemiJoinNode, with the filtered-EXISTS generalization),
+    or MARK (every left row kept, match-ness exposed as a BOOLEAN
+    column `mark` — reference: SemiJoinNode's semiJoinOutput symbol,
+    what EXISTS compiles to when it is NOT a top-level conjunct)."""
 
     left: PlanNode
     right: PlanNode
-    join_type: str  # INNER LEFT RIGHT FULL CROSS SEMI ANTI
+    join_type: str  # INNER LEFT RIGHT FULL CROSS SEMI ANTI MARK
     criteria: List[Tuple[str, str]] = field(default_factory=list)  # (lsym, rsym)
     filter: Optional[RowExpr] = None
     # execution hints filled by the optimizer
     distribution: str = "AUTOMATIC"  # PARTITIONED | BROADCAST | AUTOMATIC
+    mark: Optional[str] = None  # MARK only: output symbol for match-ness
 
     def outputs(self):
+        if self.join_type == "MARK":
+            from presto_tpu import types as _T
+
+            return self.left.outputs() + [(self.mark, _T.BOOLEAN)]
         if self.join_type in ("SEMI", "ANTI"):
             return self.left.outputs()
         lout = self.left.outputs()

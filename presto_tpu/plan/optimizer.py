@@ -228,7 +228,10 @@ def push_filter(node: P.PlanNode, conjs: List[ir.RowExpr], session) -> P.PlanNod
         return out
     if isinstance(node, P.Join) and node.join_type in ("CROSS", "INNER"):
         return _reassemble_join(node, conjs, session)
-    if isinstance(node, P.Join) and node.join_type in ("SEMI", "ANTI", "LEFT"):
+    if isinstance(node, P.Join) and node.join_type in ("SEMI", "ANTI",
+                                                       "LEFT", "MARK"):
+        # left rows pass through 1:1 (MARK adds only its bool column),
+        # so left-only conjuncts commute with the join
         lsyms = {s for s, _ in node.left.outputs()}
         pushable = [c for c in conjs if c.refs() <= lsyms]
         kept = [c for c in conjs if not (c.refs() <= lsyms)]
@@ -441,7 +444,7 @@ def prune_columns(node: P.PlanNode, required: Set[str]) -> P.PlanNode:
         left = prune_columns(node.left, need_l)
         right = prune_columns(node.right, need_r)
         return P.Join(left, right, node.join_type, node.criteria, node.filter,
-                      node.distribution)
+                      node.distribution, node.mark)
     if isinstance(node, (P.Sort, P.TopN)):
         need = required | {k for k, _, _ in node.keys}
         src = prune_columns(node.source, need)

@@ -89,6 +89,7 @@ class Planner:
         # id(ast.ScalarSubquery) -> decorrelated column Ref (see
         # _try_subquery_conjunct's general correlated form)
         self._scalar_sub_overrides: Dict[int, ir.RowExpr] = {}
+        self._mark_overrides: Dict[int, str] = {}  # Exists/In -> mark sym
 
     # ------------------------------------------------------------------
     def plan_statement(self, stmt: ast.Statement) -> P.QueryPlan:
@@ -173,7 +174,16 @@ class Planner:
         def null_out(expr, excluded):
             """Replace references to rolled-up keys with NULL literals
             inside arbitrary select expressions (e.g. the lochierarchy
-            CASE of TPC-DS q86 referencing a rolled-up column)."""
+            CASE of TPC-DS q86 referencing a rolled-up column), and
+            resolve grouping(e1..en) to its per-branch literal bitmask
+            (reference: GroupingOperationRewriter — grouping() is a
+            constant once the grouping set is fixed)."""
+            if isinstance(expr, ast.FunctionCall) \
+                    and expr.name.lower() == "grouping":
+                bits = 0
+                for a in expr.args:
+                    bits = bits * 2 + (1 if _ast_key(a) in excluded else 0)
+                return ast.Literal(bits)
             if isinstance(expr, ast.Expr) and _ast_key(expr) in excluded:
                 return ast.Literal(None)
             if isinstance(expr, ast.FunctionCall) \
@@ -206,7 +216,9 @@ class Planner:
                 if k in all_keys and k not in in_set:
                     items.append(ast.SelectItem(ast.Literal(None),
                                                 name_of(item)))
-                elif k not in all_keys and excluded:
+                elif k not in all_keys:
+                    # null_out with an empty exclusion set still resolves
+                    # grouping() (all bits 0 in the finest branch)
                     items.append(ast.SelectItem(
                         null_out(item.expr, excluded), name_of(item)))
                 else:
@@ -349,9 +361,16 @@ class Planner:
                 sym = s
             keys.append((sym, si.ascending, si.nulls_first))
         if extra_assignments:
-            if not isinstance(node, P.Project):
-                raise SemanticError("cannot add sort keys to non-projection")
-            node = P.Project(node.source, {**node.assignments, **extra_assignments})
+            if isinstance(node, P.Project):
+                node = P.Project(node.source,
+                                 {**node.assignments, **extra_assignments})
+            else:
+                # non-projection source (e.g. the UNION of grouping-set
+                # branches under a computed ORDER BY key, q36/q70):
+                # wrap in an identity projection carrying the sort keys
+                assigns = {f.symbol: ir.Ref(f.symbol, f.type)
+                           for f in scope.fields}
+                node = P.Project(node, {**assigns, **extra_assignments})
         if limit is not None:
             node = P.TopN(node, keys, limit)
         else:
@@ -583,6 +602,14 @@ class Planner:
                     inner = ast.BinaryOp(opmap.get(inner.op, inner.op), lhs, rhs)
                 return self._plan_scalar_compare(node, scope, inner.op, lhs,
                                                  rhs.query, agg_map, group_map), True
+        # EXISTS/IN under a boolean combination (q10/q35's
+        # `EXISTS(...) OR EXISTS(...)`): plan each subquery as a MARK
+        # join adding a boolean match column, then evaluate the
+        # original expression over the marks (reference: SemiJoinNode's
+        # semiJoinOutput consumed by a FilterNode)
+        marked = self._try_mark_joins(node, scope, conj, agg_map, group_map)
+        if marked is not None:
+            return marked, True
         # general form: ONE correlated scalar subquery anywhere in the
         # conjunct (e.g. `price > 1.2 * (SELECT avg(...) WHERE corr)`) —
         # decorrelate to a joined column, substitute, analyze as usual
@@ -602,6 +629,55 @@ class Planner:
                 return P.Filter(new_node, rex), True
         return node, False
 
+    def _try_mark_joins(self, node, scope, conj, agg_map, group_map):
+        """Plan a conjunct whose boolean expression CONTAINS subquery
+        predicates (not as top-level conjuncts): each EXISTS/IN becomes
+        a MARK join; the expression then filters on the mark columns.
+        Returns the new plan node, or None if the shape doesn't apply."""
+        subqs: List[ast.Expr] = []
+        _collect_subquery_preds(conj, subqs)
+        if not subqs:
+            return None
+        planned = []
+        try:
+            for sq in subqs:
+                mark = self.symbols.new("mark")
+                if isinstance(sq, ast.Exists):
+                    spec = sq.query.body
+                    if not isinstance(spec, ast.QuerySpec) or spec.group_by \
+                            or spec.having:
+                        return None
+                    inner_node, inner_scope = self.plan_relation(spec.from_,
+                                                                 None)
+                    node = self._correlated_semi_join(
+                        node, scope, inner_node, inner_scope, spec.where,
+                        negated=False, mark=mark)
+                else:  # InSubquery
+                    val = self.analyze(sq.value, scope, agg_map=agg_map,
+                                       group_map=group_map)
+                    inner_node, inner_scope, _ = self.plan_query(sq.query,
+                                                                 scope)
+                    if len(inner_scope.fields) != 1:
+                        return None
+                    lsym = self._as_symbol(val, "inval")
+                    if not isinstance(val, ir.Ref):
+                        node = self._attach_key(node, val)
+                    node = P.Join(node, inner_node, "MARK",
+                                  [(lsym, inner_scope.fields[0].symbol)],
+                                  mark=mark)
+                # negation is applied where the expression references the
+                # mark (analyze's Exists/InSubquery override)
+                planned.append((id(sq), mark))
+                self._mark_overrides[id(sq)] = mark
+            rex = self.analyze(conj, scope, agg_map=agg_map,
+                               group_map=group_map)
+        except SemanticError:
+            return None
+        finally:
+            for k, _m in planned:
+                self._mark_overrides.pop(k, None)
+        return P.Filter(node, rex)
+
     def _plan_exists(self, node, scope, sub: ast.Query, negated: bool):
         if not isinstance(sub.body, ast.QuerySpec) or sub.body.group_by or sub.body.having:
             raise SemanticError("EXISTS subquery too complex")
@@ -612,7 +688,8 @@ class Planner:
 
     def _correlated_semi_join(self, node, scope, inner_node, inner_scope,
                               where: Optional[ast.Expr], negated: bool,
-                              extra_criteria: Optional[list] = None):
+                              extra_criteria: Optional[list] = None,
+                              mark: Optional[str] = None):
         inner_syms = {f.symbol for f in inner_scope.fields}
         joint = Scope(inner_scope.fields, parent=scope)
         criteria: List[Tuple[str, str]] = list(extra_criteria or [])
@@ -639,6 +716,12 @@ class Planner:
             inner_node = P.Filter(inner_node, ir.combine_conjuncts(inner_only))
         if not criteria and residual:
             raise SemanticError("unsupported correlated predicate (no equality)")
+        if mark is not None:
+            if residual:
+                # the MARK executor path is filter-free; residual
+                # correlation falls back to the caller's error path
+                raise SemanticError("MARK join with residual predicate")
+            return P.Join(node, inner_node, "MARK", criteria, mark=mark)
         jt = "ANTI" if negated else "SEMI"
         return P.Join(node, inner_node, jt, criteria, ir.combine_conjuncts(residual))
 
@@ -1044,6 +1127,12 @@ class Planner:
             self.subplans[pid] = sub_node
             return ir.ScalarSub(pid, sub_scope.fields[0].type)
         if isinstance(e, (ast.Exists, ast.InSubquery)):
+            mark = self._mark_overrides.get(id(e))
+            if mark is not None:
+                ref = ir.Ref(mark, T.BOOLEAN)
+                if getattr(e, "negated", False):
+                    return ir.Call("not", (ref,), T.BOOLEAN)
+                return ref
             raise SemanticError(
                 f"{type(e).__name__} only supported as a top-level WHERE/HAVING conjunct")
         raise SemanticError(f"unsupported expression {type(e).__name__}")
@@ -1201,6 +1290,20 @@ def _collect_scalar_subqueries(e: ast.Expr, out: list) -> None:
         if isinstance(child, (ast.Query, ast.QuerySpec)):
             continue
         _collect_scalar_subqueries(child, out)
+
+
+def _collect_subquery_preds(e: ast.Expr, out: list) -> None:
+    """EXISTS/IN-subquery predicate nodes inside a boolean expression
+    (without descending into the subqueries themselves)."""
+    if isinstance(e, (ast.Exists, ast.InSubquery)):
+        out.append(e)
+        return
+    if isinstance(e, ast.ScalarSubquery):
+        return
+    for child in e.children():
+        if isinstance(child, (ast.Query, ast.QuerySpec)):
+            continue
+        _collect_subquery_preds(child, out)
 
 
 def _ast_conjuncts(e: Optional[ast.Expr]) -> List[ast.Expr]:

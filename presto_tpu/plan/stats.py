@@ -122,6 +122,12 @@ def _derive(node, catalog, memo) -> NodeStats:
         if node.join_type in ("SEMI", "ANTI"):
             est = ls.est_rows * (0.5 if node.join_type == "SEMI" else 0.5)
             return NodeStats(ls.rows, ls.cols, ls.unique, ls.fanout, est)
+        if node.join_type == "MARK":
+            # every left row survives, one extra boolean column
+            cols = dict(ls.cols)
+            cols[node.mark] = ColStats(ndv=2)
+            return NodeStats(ls.rows, cols, ls.unique, ls.fanout,
+                             ls.est_rows)
         cols = {**ls.cols, **rs.cols}
         rkeys = frozenset(rk for _, rk in node.criteria)
         build_unique = any(u <= rkeys for u in rs.unique)

@@ -23,6 +23,28 @@ def build_sqlite(sf: float = 0.01, generator=None) -> sqlite3.Connection:
     if key in _CONNS:
         return _CONNS[key]
     conn = sqlite3.connect(":memory:")
+
+    class _Stddev:
+        """Welford sample stddev (sqlite has no stddev built in)."""
+
+        def __init__(self):
+            self.n, self.mean, self.m2 = 0, 0.0, 0.0
+
+        def step(self, v):
+            if v is None:
+                return
+            self.n += 1
+            d = v - self.mean
+            self.mean += d / self.n
+            self.m2 += d * (v - self.mean)
+
+        def finalize(self):
+            if self.n < 2:
+                return None
+            return math.sqrt(self.m2 / (self.n - 1))
+
+    conn.create_aggregate("stddev_samp", 1, _Stddev)
+    conn.create_aggregate("stddev", 1, _Stddev)
     for table, schema in gen.SCHEMAS.items():
         data = gen.generate(table, sf)
         cols = list(schema)
@@ -73,7 +95,8 @@ def normalize(rows: Iterable[tuple]) -> list:
     return out
 
 
-def assert_same_results(actual_rows, expected_rows, ordered: bool = False, rel_tol=1e-6):
+def assert_same_results(actual_rows, expected_rows, ordered: bool = False,
+                        rel_tol=1e-6, abs_tol=1e-4):
     a = normalize(actual_rows)
     e = normalize(expected_rows)
     if not ordered:
@@ -87,7 +110,8 @@ def assert_same_results(actual_rows, expected_rows, ordered: bool = False, rel_t
                 if va is None or ve is None:
                     assert va is None and ve is None, f"row {i} col {j}: {va} != {ve}"
                     continue
-                assert math.isclose(float(va), float(ve), rel_tol=rel_tol, abs_tol=1e-4), (
+                assert math.isclose(float(va), float(ve), rel_tol=rel_tol,
+                                    abs_tol=abs_tol), (
                     f"row {i} col {j}: {va} != {ve}\nactual={ra}\nexpected={re_}"
                 )
             else:
