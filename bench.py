@@ -155,6 +155,12 @@ def _scale_session(sf, family="tpch"):
     else:
         cat = tpch_catalog(sf, cache_dir=None)
     s = presto_tpu.connect(cat)
+    if family == "tpcds":
+        # q64's 18-join chunk fragment: 6M-row chunks + the per-chunk
+        # syncing loop keep peak HBM under the 16G chip (12M pipelined
+        # chunks ResourceExhausted on v5e)
+        s.properties["chunk_fact_rows"] = 6_000_000
+        s.properties["chunk_pipeline"] = False
     if os.environ.get("BENCH_F32", "1") != "0":
         s.set("float32_compute", True)
     return s

@@ -156,6 +156,23 @@ def column_from_numpy(data: np.ndarray, typ: Type, valid: Optional[np.ndarray] =
     dictionary = None
     if typ.is_string and data.dtype.kind in ("U", "S", "O"):
         data, dictionary = encode_strings(data)
+    if typ.is_decimal and typ.is_long_decimal:
+        from presto_tpu.exec import dec128 as D128
+
+        if data.ndim == 2 and data.dtype.kind == "i":
+            pass  # already limbs
+        else:
+            import decimal as _d
+
+            s = typ.decimal_scale
+            with _d.localcontext() as ctx:
+                ctx.prec = 80  # default 28 can't hold 38-digit values
+                ints = [int(_d.Decimal(str(v)).scaleb(s).quantize(
+                    _d.Decimal(1), rounding=_d.ROUND_HALF_UP))
+                    for v in data]
+            data = D128.from_host_ints(ints)
+        v = None if valid is None else jnp.asarray(valid, dtype=bool)
+        return Column(jnp.asarray(data), v, typ, None)
     if typ.is_decimal and data.dtype.kind == "f":
         # host floats (e.g. a decoded decimal column re-ingested via
         # CTAS/INSERT) carry the unscaled value; rescale, don't truncate
@@ -233,6 +250,23 @@ def decode_host_column(data, valid, typ, dictionary) -> np.ndarray:
     if dictionary is not None:
         codes = np.clip(data, 0, len(dictionary) - 1)
         data = dictionary.values[codes]
+    elif typ.is_decimal and typ.is_long_decimal and data.ndim == 2:
+        # two-limb Int128: decode to exact python Decimals (reference:
+        # Int128ArrayBlock -> SqlDecimal)
+        from decimal import Decimal
+
+        from presto_tpu.exec import dec128 as D128
+
+        ints = D128.to_host_ints(data)  # signed (hi limb is signed)
+        s = typ.decimal_scale
+        out = np.empty(len(ints), dtype=object)
+        import decimal as _d
+
+        with _d.localcontext() as ctx:
+            ctx.prec = 80  # scaleb ROUNDS to context precision (28!)
+            for i, v in enumerate(ints):
+                out[i] = Decimal(v).scaleb(-s)
+        data = out
     elif typ.is_decimal:
         data = data.astype(np.float64) / (10 ** typ.decimal_scale)
     if valid is not None:

@@ -37,6 +37,18 @@ def eval_expr(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> ColVal:
     if isinstance(expr, ir.ScalarSub):
         v, valid = ctx.scalar_results[expr.plan_id]
         if isinstance(valid, (bool, type(None))):  # host-evaluated subplan
+            if expr.type.is_decimal and valid \
+                    and not hasattr(v, "shape"):
+                # _single_value decodes decimals to SCALED host values
+                # (Decimal for long, float for short); decimal ColVals
+                # carry UNSCALED integers
+                import decimal as _d
+
+                s = expr.type.decimal_scale
+                with _d.localcontext() as ctx2:
+                    ctx2.prec = 80
+                    v = int(_d.Decimal(str(v)).scaleb(s).quantize(
+                        _d.Decimal(1), rounding=_d.ROUND_HALF_EVEN))
             return ColVal(v, None if valid else False, expr.type)
         return ColVal(v, valid, expr.type)  # traced 0-d value (distributed)
     if isinstance(expr, ir.CastExpr):
@@ -68,6 +80,12 @@ def to_column(v: ColVal, capacity: int):
 
     data = v.data
     if not hasattr(data, "shape") or getattr(data, "ndim", 0) == 0:
+        if v.type.is_decimal and v.type.is_long_decimal:
+            from presto_tpu.exec import dec128 as D128
+
+            limbs = jnp.asarray(D128.from_host_int(int(data)))
+            data = jnp.broadcast_to(limbs, (capacity, 2))
+            return Column(data, _expand_valid(v.valid, capacity), v.type)
         if isinstance(data, str):
             # string literal column: single-entry dictionary
             import numpy as np

@@ -1,0 +1,327 @@
+"""Two-limb Int128 decimal arithmetic on device.
+
+Reference parity: long decimals (precision 19..38) are Int128 values in
+the reference — `spi/type/UnscaledDecimal128Arithmetic.java` (add/
+multiply/compare/rescale over two 64-bit limbs) stored in
+`spi/block/Int128ArrayBlock.java` (two longs per position).  TPU-native
+adaptation: a long-decimal column is an int64 array of shape (n, 2) —
+[..., 0] = signed high limb, [..., 1] = low limb (the unsigned low 64
+bits, stored in int64 with wrapping semantics).  value = hi * 2^64 +
+u64(lo), two's complement.  All ops are elementwise integer vector math
+(VPU-friendly); 64x64->128 products split operands into 32-bit halves;
+exact segmented SUM splits the 128-bit value into four unsigned 32-bit
+lanes whose int64 segment sums cannot overflow for any n < 2^31, then
+recombines with carry propagation — so a SUM over an entire SF100
+column is bit-exact, where the reference pays a per-row Int128 add
+(UnscaledDecimal128Arithmetic.addWithOverflow).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+HI = 0
+LO = 1
+
+_M32 = (1 << 32) - 1
+_SIGNBIT = -(1 << 63)  # int64 min: xor-bias turns unsigned order into signed
+
+
+def _u(x):
+    return x.astype(jnp.uint64)
+
+
+def _i(x):
+    return x.astype(jnp.int64)
+
+
+def from_int64(x: jnp.ndarray) -> jnp.ndarray:
+    """Sign-extend int64 unscaled values to (n, 2) limbs."""
+    x = jnp.asarray(x, jnp.int64)
+    hi = x >> 63  # arithmetic shift: 0 or -1
+    return jnp.stack([hi, x], axis=-1)
+
+
+def from_host_int(v: int) -> np.ndarray:
+    """One python int (|v| < 2^127) to host [hi, lo] limbs."""
+    m = v & ((1 << 128) - 1)  # two's complement mod 2^128
+    lo = m & ((1 << 64) - 1)
+    hi = m >> 64
+    if hi >= 1 << 63:
+        hi -= 1 << 64
+    if lo >= 1 << 63:
+        lo -= 1 << 64  # int64 wrap of the unsigned low limb
+    return np.asarray([hi, lo], dtype=np.int64)
+
+
+def from_host_ints(vals) -> np.ndarray:
+    return np.stack([from_host_int(int(v)) for v in vals]) \
+        if len(vals) else np.zeros((0, 2), np.int64)
+
+
+def to_host_ints(limbs: np.ndarray) -> list:
+    """(n, 2) int64 limbs -> python ints."""
+    limbs = np.asarray(limbs)
+    out = []
+    for hi, lo in limbs.reshape(-1, 2):
+        v = (int(hi) << 64) + (int(lo) & ((1 << 64) - 1))
+        out.append(v)
+    return out
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    lo = _i(_u(a[..., LO]) + _u(b[..., LO]))
+    # unsigned overflow iff result < either addend
+    carry = (_u(lo) < _u(a[..., LO])).astype(jnp.int64)
+    hi = a[..., HI] + b[..., HI] + carry
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    lo = _i(~_u(a[..., LO]) + jnp.uint64(1))
+    carry = (lo == 0).astype(jnp.int64)
+    hi = ~a[..., HI] + carry
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return add(a, neg(b))
+
+
+def lt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return (a[..., HI] < b[..., HI]) | (
+        (a[..., HI] == b[..., HI])
+        & (_u(a[..., LO]) < _u(b[..., LO])))
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return (a[..., HI] == b[..., HI]) & (a[..., LO] == b[..., LO])
+
+
+def mul_int64(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Exact int64 x int64 -> (n, 2) limbs (the overflow-free product
+    the reference computes in UnscaledDecimal128Arithmetic.multiply).
+    Signed via unsigned mulhi + sign corrections."""
+    x = jnp.asarray(x, jnp.int64)
+    y = jnp.asarray(y, jnp.int64)
+    ux, uy = _u(x), _u(y)
+    xl = ux & jnp.uint64(_M32)
+    xh = ux >> jnp.uint64(32)
+    yl = uy & jnp.uint64(_M32)
+    yh = uy >> jnp.uint64(32)
+    ll = xl * yl
+    lh = xl * yh
+    hl = xh * yl
+    hh = xh * yh
+    mid = (ll >> jnp.uint64(32)) + (lh & jnp.uint64(_M32)) \
+        + (hl & jnp.uint64(_M32))
+    lo = _i((ll & jnp.uint64(_M32)) | (mid << jnp.uint64(32)))
+    uhi = hh + (lh >> jnp.uint64(32)) + (hl >> jnp.uint64(32)) \
+        + (mid >> jnp.uint64(32))
+    # unsigned -> signed mulhi: subtract (x<0)*y and (y<0)*x
+    hi = _i(uhi) - jnp.where(x < 0, y, 0) - jnp.where(y < 0, x, 0)
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def mul_small(a: jnp.ndarray, c: int) -> jnp.ndarray:
+    """128-bit x small positive int (< 2^31), wrapping mod 2^128."""
+    assert 0 <= c < (1 << 31)
+    cu = jnp.uint64(c)
+    lo_l = (_u(a[..., LO]) & jnp.uint64(_M32)) * cu
+    lo_h = (_u(a[..., LO]) >> jnp.uint64(32)) * cu + (lo_l >> jnp.uint64(32))
+    lo = _i((lo_l & jnp.uint64(_M32)) | (lo_h << jnp.uint64(32)))
+    carry = _i(lo_h >> jnp.uint64(32))
+    hi = a[..., HI] * c + carry
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def scale_up(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a * 10^k (k >= 0) via repeated small multiplies (10^9 < 2^31)."""
+    while k > 0:
+        step = min(k, 9)
+        a = mul_small(a, 10 ** step)
+        k -= step
+    return a
+
+
+def _divmod_small_nonneg(a: jnp.ndarray, c: int):
+    """(a // c, a % c) for NON-NEGATIVE a and 0 < c < 2^31, via 32-bit
+    long division over the four limbs (remainder < c keeps every
+    intermediate inside int64)."""
+    l3 = (_u(a[..., HI]) >> jnp.uint64(32)).astype(jnp.int64)
+    l2 = (_u(a[..., HI]) & jnp.uint64(_M32)).astype(jnp.int64)
+    l1 = (_u(a[..., LO]) >> jnp.uint64(32)).astype(jnp.int64)
+    l0 = (_u(a[..., LO]) & jnp.uint64(_M32)).astype(jnp.int64)
+    r = jnp.zeros_like(l3)
+    qs = []
+    for limb in (l3, l2, l1, l0):
+        cur = (r << 32) | limb
+        qs.append(cur // c)
+        r = cur % c
+    q3, q2, q1, q0 = qs
+    hi = _i((_u(q3) << jnp.uint64(32)) | _u(q2))
+    lo = _i((_u(q1) << jnp.uint64(32)) | _u(q0))
+    return jnp.stack([hi, lo], axis=-1), r
+
+
+def scale_down_round(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a / 10^k rounded half away from zero (Presto decimal rounding,
+    UnscaledDecimal128Arithmetic.rescale)."""
+    if k <= 0:
+        return scale_up(a, -k)
+    sign_neg = a[..., HI] < 0
+    mag = jnp.where(sign_neg[..., None], neg(a), a)
+    total_rem = None
+    total_div = 1
+    rem_f = None
+    while k > 0:
+        step = min(k, 9)
+        c = 10 ** step
+        mag, r = _divmod_small_nonneg(mag, c)
+        # exact combined remainder while it fits int64 (k <= 18); the
+        # f64 shadow carries the (rare) deeper shifts approximately
+        if total_rem is None:
+            total_rem = r
+            rem_f = r.astype(jnp.float64)
+        else:
+            total_rem = r * total_div + total_rem \
+                if total_div * c <= 10 ** 18 else total_rem
+            rem_f = r.astype(jnp.float64) * float(total_div) + rem_f
+        total_div *= c
+        k -= step
+    if total_div <= 10 ** 18:
+        round_up = (2 * total_rem >= total_div)
+    else:
+        round_up = (rem_f >= float(total_div) / 2.0)
+    mag = jnp.where(round_up[..., None],
+                    add(mag, from_int64(jnp.ones_like(mag[..., HI]))), mag)
+    return jnp.where(sign_neg[..., None], neg(mag), mag)
+
+
+def floor_divmod_pow10(a: jnp.ndarray, k: int):
+    """(a // 10^k, a mod 10^k) with FLOOR semantics (remainder in
+    [0, 10^k) for any sign) — exact, never overflows."""
+    assert 0 <= k <= 18
+    sign_neg = a[..., HI] < 0
+    mag = jnp.where(sign_neg[..., None], neg(a), a)
+    q = mag
+    rem = jnp.zeros_like(a[..., HI])
+    mult = 1
+    kk = k
+    while kk > 0:
+        step = min(kk, 9)
+        c = 10 ** step
+        q, r = _divmod_small_nonneg(q, c)
+        rem = rem + r * mult
+        mult *= c
+        kk -= step
+    c_total = 10 ** k
+    # negative a: floor division rounds away from zero when rem > 0
+    q_neg = neg(q)
+    adj = sign_neg & (rem > 0)
+    q_final = jnp.where(sign_neg[..., None],
+                        jnp.where(adj[..., None],
+                                  sub(q_neg, from_int64(
+                                      jnp.ones_like(rem))), q_neg),
+                        q)
+    r_final = jnp.where(adj, c_total - rem, jnp.where(sign_neg, 0, rem))
+    return q_final, r_final
+
+
+def cmp_scaled(a: jnp.ndarray, sa: int, b: jnp.ndarray, sb: int):
+    """(lt, eq) between a at scale sa and b at scale sb — exact for the
+    full 38-digit range (scaling the larger-scale side DOWN with a
+    floor remainder instead of scaling the smaller up, which would wrap
+    past 2^128; reference: UnscaledDecimal128Arithmetic.compare)."""
+    if sa == sb:
+        return lt(a, b), eq(a, b)
+    if sa > sb:
+        l, e = cmp_scaled(b, sb, a, sa)
+        return ~l & ~e, e
+    # sb > sa: b = bq * 10^k + br; a*10^k <=> b reduces to (a, 0) vs
+    # (bq, br) lexicographically
+    bq, br = floor_divmod_pow10(b, sb - sa)
+    less = lt(a, bq) | (eq(a, bq) & (br > 0))
+    equal = eq(a, bq) & (br == 0)
+    return less, equal
+
+
+_FITS38_LIMIT = None
+
+
+def exceeds_38_digits(a: jnp.ndarray) -> jnp.ndarray:
+    """|a| >= 10^38 (the reference's DECIMAL overflow boundary,
+    UnscaledDecimal128Arithmetic.exceedsOrEqualTenToThirtyEight)."""
+    global _FITS38_LIMIT
+    if _FITS38_LIMIT is None:
+        _FITS38_LIMIT = from_host_int(10 ** 38), from_host_int(-(10 ** 38))
+    hi_pos, hi_neg = _FITS38_LIMIT
+    pos = jnp.asarray(hi_pos)
+    neg_l = jnp.asarray(hi_neg)
+    return ~lt(a, pos) | lt(a, neg_l)
+
+
+def to_float64(a: jnp.ndarray) -> jnp.ndarray:
+    hi = a[..., HI].astype(jnp.float64)
+    lo = _u(a[..., LO]).astype(jnp.float64)
+    return hi * (2.0 ** 64) + lo
+
+
+def sort_operands(a: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(primary, secondary) int64 sort keys whose lexicographic order is
+    the signed 128-bit order: signed hi, then lo xor-biased so its
+    unsigned order sorts as int64."""
+    return a[..., HI], a[..., LO] ^ jnp.int64(_SIGNBIT)
+
+
+def segment_sum128(a: jnp.ndarray, valid, gid: jnp.ndarray,
+                   n_groups: int) -> jnp.ndarray:
+    """Exact segmented sum of (n, 2)-limb values: four unsigned 32-bit
+    lanes segment-summed as int64 (lane sums < 2^63 for n < 2^31), then
+    carry-recombined — mod-2^128 exact for any sign mix."""
+    from presto_tpu.exec import kernels as K
+
+    if valid is not None:
+        a = jnp.where(jnp.asarray(valid)[..., None], a,
+                      jnp.zeros_like(a))
+    lanes = [
+        (_u(a[..., LO]) & jnp.uint64(_M32)).astype(jnp.int64),
+        (_u(a[..., LO]) >> jnp.uint64(32)).astype(jnp.int64),
+        (_u(a[..., HI]) & jnp.uint64(_M32)).astype(jnp.int64),
+        (_u(a[..., HI]) >> jnp.uint64(32)).astype(jnp.int64),
+    ]
+    sums = [K.segment_sum(l, gid, n_groups).astype(jnp.int64)
+            for l in lanes]
+    c0 = _u(sums[0])
+    r0 = c0 & jnp.uint64(_M32)
+    c1 = _u(sums[1]) + (c0 >> jnp.uint64(32))
+    r1 = c1 & jnp.uint64(_M32)
+    c2 = _u(sums[2]) + (c1 >> jnp.uint64(32))
+    r2 = c2 & jnp.uint64(_M32)
+    c3 = _u(sums[3]) + (c2 >> jnp.uint64(32))
+    r3 = c3 & jnp.uint64(_M32)  # overflow past 2^128 wraps (mod arith)
+    lo = _i(r0 | (r1 << jnp.uint64(32)))
+    hi = _i(r2 | (r3 << jnp.uint64(32)))
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def segment_minmax128(a: jnp.ndarray, valid, gid: jnp.ndarray,
+                      n_groups: int, is_min: bool) -> jnp.ndarray:
+    """Exact segmented min/max: two-pass lexicographic (extremize the
+    high limb, then the biased low limb among rows matching it)."""
+    from presto_tpu.exec import kernels as K
+
+    f = K.segment_min if is_min else K.segment_max
+    # sentinels must dominate the FULL int64 range (biased low limbs
+    # span all of it; high limbs reach ~5.4e18 at 38 digits)
+    sent = jnp.int64((1 << 63) - 1 if is_min else -(1 << 63))
+    hi = a[..., HI]
+    lo_b = a[..., LO] ^ jnp.int64(_SIGNBIT)
+    v = jnp.ones_like(hi, bool) if valid is None else jnp.asarray(valid)
+    hi_m = f(jnp.where(v, hi, sent), gid, n_groups)
+    on_best = v & (hi == hi_m[gid])
+    lo_m = f(jnp.where(on_best, lo_b, sent), gid, n_groups)
+    return jnp.stack([hi_m, lo_m ^ jnp.int64(_SIGNBIT)], axis=-1)

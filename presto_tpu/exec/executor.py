@@ -429,6 +429,23 @@ def _compact_batch(out: Batch, bound: int) -> Batch:
 _PACK_FETCH_MAX = 262_144
 
 
+def _plan_has_long_decimal(node) -> bool:
+    import dataclasses as _dc
+
+    for _s, t in node.outputs():
+        if getattr(t, "is_decimal", False) and t.is_long_decimal:
+            return True
+    for f in _dc.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, P.PlanNode) and _plan_has_long_decimal(v):
+            return True
+        if isinstance(v, list) and any(
+                isinstance(x, P.PlanNode) and _plan_has_long_decimal(x)
+                for x in v):
+            return True
+    return False
+
+
 def run_compiled(session, text: str, stmt) -> QueryResult:
     """Compiled execution: the WHOLE plan traces into one jitted XLA
     program over the scan batches (the reference compiles expressions to
@@ -451,6 +468,11 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
         return Executor(session).run(plan)
     if entry is None:
         plan = plan_statement(session, stmt)
+        if _plan_has_long_decimal(plan.root):
+            # two-limb Int128 columns don't pack through the compiled
+            # fetch plane yet; the dynamic executor carries them exactly
+            cache[key] = "DYNAMIC"
+            return Executor(session).run(plan)
         # uncorrelated scalar subqueries: evaluate eagerly (tiny), bake in;
         # populate ctx as we go — later subplans may reference earlier ones
         ex0 = Executor(session)
@@ -1398,18 +1420,42 @@ class Executor:
             s = K.segment_sum(jnp.log(jnp.maximum(x, 1e-300)), gid, n_groups)
             return Column(jnp.exp(s / jnp.maximum(cnt, 1)), nonempty, T.DOUBLE)
         if a.fn == "sum":
+            if a.type.is_decimal and a.type.is_long_decimal:
+                # exact Int128 accumulation (reference:
+                # DecimalSumAggregation over UnscaledDecimal128Arithmetic)
+                from presto_tpu.exec import dec128 as D128
+
+                limbs = jnp.asarray(col.data) \
+                    if getattr(col.data, "ndim", 1) == 2 \
+                    else D128.from_int64(jnp.asarray(col.data))
+                s = D128.segment_sum128(limbs, valid, gid, n_groups)
+                return Column(s, nonempty, a.type)
             x = jnp.where(valid, col.data, jnp.zeros_like(col.data))
             s = K.segment_sum(x, gid, n_groups)
             if a.type.is_integer:
                 s = s.astype(jnp.int64)
             return Column(s.astype(a.type.numpy_dtype()), nonempty, a.type)
         if a.fn == "avg":
+            if getattr(col.data, "ndim", 1) == 2:  # long decimal limbs
+                from presto_tpu.exec import dec128 as D128
+
+                f = D128.to_float64(jnp.asarray(col.data)) \
+                    / (10 ** col.type.decimal_scale)
+                x = jnp.where(valid, f, 0.0)
+                s = K.segment_sum(x, gid, n_groups)
+                return Column(s / jnp.maximum(cnt, 1), nonempty, T.DOUBLE)
             x = jnp.where(valid, col.data.astype(jnp.float64), 0.0)
             if col.type.is_decimal:
                 x = x / (10 ** col.type.decimal_scale)
             s = K.segment_sum(x, gid, n_groups)
             return Column(s / jnp.maximum(cnt, 1), nonempty, T.DOUBLE)
         if a.fn in ("min", "max"):
+            if getattr(col.data, "ndim", 1) == 2:  # long decimal limbs
+                from presto_tpu.exec import dec128 as D128
+
+                r = D128.segment_minmax128(jnp.asarray(col.data), valid,
+                                           gid, n_groups, a.fn == "min")
+                return Column(r, nonempty, a.type)
             if jnp.issubdtype(col.data.dtype, jnp.floating):
                 ext = jnp.inf if a.fn == "min" else -jnp.inf
             elif col.data.dtype == jnp.bool_:

@@ -36,7 +36,10 @@ def _numeric_sum_type(t: T.Type) -> T.Type:
     if t.is_integer:
         return T.BIGINT
     if t.is_decimal:
-        return t
+        # Presto: sum(DECIMAL(p,s)) -> DECIMAL(38,s) — the accumulator
+        # is Int128 (two-limb), so whole-column sums cannot wrap
+        # (reference: DecimalSumAggregation)
+        return T.decimal(38, t.decimal_scale)
     return T.DOUBLE
 
 

@@ -223,8 +223,11 @@ def _resolve_arith(name):
             return T.DATE
         if a.is_numeric and b.is_numeric:
             ct = T.common_super_type(a, b)
-            if name == "div" and ct is not None and ct.is_decimal:
-                return T.DOUBLE  # keep decimal division simple: promote
+            if ct is not None and ct.is_decimal:
+                if name == "div":
+                    return T.DOUBLE  # decimal division promotes
+                if name in ("add", "sub", "mul"):
+                    return _decimal_result_type(name, a, b)
             return ct
         return None
 
@@ -250,6 +253,8 @@ def _emit_arith(name):
                 b = _decimal_to_double(b)
                 out_t = T.DOUBLE
             else:
+                if name in ("add", "sub", "mul"):
+                    out_t = _decimal_result_type(name, a.type, b.type)
                 return _emit_decimal_arith(name, a, b, out_t, valid)
         x, y = a.data, b.data
         if name == "add":
@@ -325,7 +330,109 @@ def _dec_scale(t: T.Type) -> int:
     return t.decimal_scale if t.is_decimal else 0
 
 
+def _f64_to_u64_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """f64 in [0, 2^64) -> the int64 whose unsigned value is round(x)."""
+    return jnp.where(x >= 2.0 ** 63, x - 2.0 ** 64, x).astype(jnp.int64)
+
+
+def _check_dec38(r, what: str) -> None:
+    """Raise on |value| >= 10^38 (the reference raises DECIMAL overflow,
+    DecimalOperators) when the data is host-inspectable; traced values
+    skip the check (long decimals run the dynamic executor, so data is
+    concrete in practice)."""
+    from presto_tpu.exec import dec128 as D128
+
+    if isinstance(r, jax.core.Tracer):
+        return
+    bad = D128.exceeds_38_digits(r)
+    if bool(jnp.any(bad)):
+        raise ValueError(f"DECIMAL overflow: {what} exceeds 38 digits")
+
+
+def _decimal_result_type(name: str, at: T.Type, bt: T.Type) -> T.Type:
+    """Presto decimal result typing (DecimalOperators.{ADD,MULTIPLY}):
+    integers coerce as decimal(18,0); precision growth past 18 switches
+    to two-limb Int128 storage."""
+    da = at if at.is_decimal else T.decimal(18, 0)
+    db = bt if bt.is_decimal else T.decimal(18, 0)
+    return T.decimal_add_type(da, db) if name in ("add", "sub") \
+        else T.decimal_mul_type(da, db)
+
+
+def _lift128(v: ColVal):
+    """A decimal/integer operand as (n, 2) limbs (or (2,) for a scalar),
+    at its own scale."""
+    from presto_tpu.exec import dec128 as D128
+
+    if v.type.is_decimal and v.type.is_long_decimal:
+        if v.is_scalar and not hasattr(v.data, "shape"):
+            return jnp.asarray(D128.from_host_int(int(v.data)))
+        return jnp.asarray(v.data)
+    if v.is_scalar and not hasattr(v.data, "shape"):
+        return jnp.asarray(D128.from_host_int(int(v.data)))
+    return D128.from_int64(jnp.asarray(v.data))
+
+
+def _emit_decimal_arith_long(name, a, b, out_t, valid):
+    """Two-limb Int128 path (reference:
+    UnscaledDecimal128Arithmetic.{add,subtract,multiply})."""
+    from presto_tpu.exec import dec128 as D128
+
+    sa, sb = _dec_scale(a.type), _dec_scale(b.type)
+    so = out_t.decimal_scale
+    if a.is_scalar and b.is_scalar and not isinstance(
+            a.data, jax.core.Tracer) and not isinstance(
+            b.data, jax.core.Tracer):
+        # literal folding: exact host integer arithmetic (covers python
+        # ints AND concrete 0-d device scalars)
+        x, y = int(a.data), int(b.data)
+        if name == "add":
+            r = x * 10 ** (so - sa) + y * 10 ** (so - sb)
+        elif name == "sub":
+            r = x * 10 ** (so - sa) - y * 10 ** (so - sb)
+        elif name == "mul":
+            r = x * y  # scales add to so
+        else:
+            raise NotImplementedError(f"long decimal {name}")
+        return ColVal(r, valid, out_t)
+    if name in ("add", "sub"):
+        x = D128.scale_up(_lift128(a), so - sa)
+        y = D128.scale_up(_lift128(b), so - sb)
+        r = D128.add(x, y) if name == "add" else D128.sub(x, y)
+        _check_dec38(r, "decimal " + name)
+        return ColVal(r, valid, out_t)
+    if name == "mul":
+        # sa + sb == so by construction (decimal_mul_type)
+        a_long = a.type.is_decimal and a.type.is_long_decimal
+        b_long = b.type.is_decimal and b.type.is_long_decimal
+        if not a_long and not b_long:
+            x = jnp.asarray(a.data, jnp.int64) if not a.is_scalar \
+                else jnp.int64(a.data)
+            y = jnp.asarray(b.data, jnp.int64) if not b.is_scalar \
+                else jnp.int64(b.data)
+            return ColVal(D128.mul_int64(x, y), valid, out_t)
+        # long x small-int scalar (e.g. sum * 2): exact via mul_small
+        for big, small in ((a, b), (b, a)):
+            bt_long = big.type.is_decimal and big.type.is_long_decimal
+            if bt_long and small.is_scalar \
+                    and not hasattr(small.data, "shape"):
+                c = int(small.data)
+                if abs(c) < (1 << 31):
+                    r = D128.mul_small(_lift128(big), abs(c))
+                    if c < 0:
+                        r = D128.neg(r)
+                    return ColVal(r, valid, out_t)
+        raise NotImplementedError(
+            "long-decimal x long-decimal multiply (128x128) is not "
+            "supported; cast one side down or to DOUBLE")
+    raise NotImplementedError(f"long decimal {name}")
+
+
 def _emit_decimal_arith(name, a: ColVal, b: ColVal, out_t: T.Type, valid):
+    if out_t.is_long_decimal or \
+            (a.type.is_decimal and a.type.is_long_decimal) or \
+            (b.type.is_decimal and b.type.is_long_decimal):
+        return _emit_decimal_arith_long(name, a, b, out_t, valid)
     sa, sb = _dec_scale(a.type), _dec_scale(b.type)
     so = out_t.decimal_scale
     x = jnp.asarray(a.data).astype(jnp.int64) if not a.is_scalar else jnp.int64(a.data)
@@ -360,10 +467,21 @@ def _emit_decimal_arith(name, a: ColVal, b: ColVal, out_t: T.Type, valid):
 for _n in ("add", "sub", "mul", "div", "mod"):
     register(_n)((_resolve_arith(_n), _emit_arith(_n)))
 
+def _emit_neg(args):
+    v = args[0]
+    if v.type.is_decimal and v.type.is_long_decimal:
+        from presto_tpu.exec import dec128 as D128
+
+        if v.is_scalar and not hasattr(v.data, "shape"):
+            return ColVal(-int(v.data), v.valid, v.type)
+        return ColVal(D128.neg(jnp.asarray(v.data)), v.valid, v.type)
+    return ColVal(-jnp.asarray(v.data) if hasattr(v.data, "shape")
+                  else -v.data, v.valid, v.type)
+
+
 register("neg")((
     lambda args: args[0] if len(args) == 1 and args[0].is_numeric else None,
-    lambda args: ColVal(-jnp.asarray(args[0].data) if hasattr(args[0].data, "shape")
-                        else -args[0].data, args[0].valid, args[0].type),
+    _emit_neg,
 ))
 
 
@@ -385,6 +503,29 @@ def _emit_cmp(name):
         if a.type.is_string or b.type.is_string:
             return _string_compare(name, a, b)
         valid = all_valid(a, b)
+        a_long = a.type.is_decimal and a.type.is_long_decimal
+        b_long = b.type.is_decimal and b.type.is_long_decimal
+        if a_long or b_long:
+            from presto_tpu.exec import dec128 as D128
+
+            if a.type.is_floating or b.type.is_floating:
+                def flat(v, lng):
+                    if not lng:
+                        return _decimal_to_double(v).data
+                    s = v.type.decimal_scale
+                    return D128.to_float64(_lift128(v)) / (10 ** s)
+                return ColVal(_PYOPS[name](flat(a, a_long), flat(b, b_long)),
+                              valid, T.BOOLEAN)
+            x, y = _lift128(a), _lift128(b)
+            sx, sy = _dec_scale(a.type), _dec_scale(b.type)
+            less, equal = D128.cmp_scaled(x, sx, y, sy)
+            r = {"eq": lambda: equal,
+                 "ne": lambda: ~equal,
+                 "lt": lambda: less,
+                 "le": lambda: less | equal,
+                 "gt": lambda: ~(less | equal),
+                 "ge": lambda: ~less}[name]()
+            return ColVal(r, valid, T.BOOLEAN)
         return ColVal(_PYOPS[name](jnp.asarray(a.data) if not a.is_scalar else a.data,
                                    b.data), valid, T.BOOLEAN)
 
@@ -959,8 +1100,133 @@ register("least")((_resolve_coalesce, _emit_fold(jnp.minimum)))
 
 
 def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool) -> ColVal:
+    from presto_tpu.exec import dec128 as D128
+
     frm = v.type
+    if frm.is_decimal and frm.is_long_decimal:
+        s = frm.decimal_scale
+        if v.is_scalar and not hasattr(v.data, "shape"):
+            # python-int long scalar: fold host-side, exactly
+            import decimal as _d
+            from decimal import ROUND_HALF_UP, Decimal
+
+            _hp = _d.Context(prec=80)
+            d = _hp.create_decimal(int(v.data)).scaleb(-s, context=_hp)
+            if to.is_decimal:
+                with _d.localcontext() as ctx:
+                    ctx.prec = 80
+                    unscaled = int(d.scaleb(to.decimal_scale).quantize(
+                        Decimal(1), rounding=ROUND_HALF_UP))
+                limit = (1 << 63) if not to.is_long_decimal else 10 ** 38
+                if abs(unscaled) >= limit:
+                    if safe:
+                        return ColVal(0, False, to)
+                    raise ValueError(
+                        f"DECIMAL overflow: CAST to {to} (reference "
+                        "raises on rescale overflow, "
+                        "UnscaledDecimal128Arithmetic.rescale)")
+                return ColVal(unscaled, v.valid, to)
+            if to.is_floating:
+                return ColVal(float(d), v.valid, to)
+            if to.is_integer:
+                return ColVal(int(d.quantize(
+                    Decimal(1), rounding=ROUND_HALF_UP,
+                    context=_hp)), v.valid, to)
+            if to.is_string:
+                return ColVal(str(d), v.valid, to)
+            raise NotImplementedError(f"CAST {frm} -> {to}")
+        a = _lift128(v)
+        if to.is_decimal and to.is_long_decimal:
+            r = D128.scale_up(a, to.decimal_scale - s) \
+                if to.decimal_scale >= s \
+                else D128.scale_down_round(a, s - to.decimal_scale)
+            if not safe:
+                _check_dec38(r, f"CAST {frm} -> {to}")
+            return ColVal(r, v.valid, to)
+        if to.is_decimal:  # long -> short: rescale, must fit int64
+            r = D128.scale_down_round(a, s - to.decimal_scale) \
+                if s >= to.decimal_scale \
+                else D128.scale_up(a, to.decimal_scale - s)
+            fits = r[..., D128.HI] == (r[..., D128.LO] >> 63)
+            short = r[..., D128.LO]
+            valid = v.valid
+            if safe:
+                valid = fits if valid is None else (jnp.asarray(valid)
+                                                    & fits)
+            elif not isinstance(fits, jax.core.Tracer):
+                live = fits if v.valid is None \
+                    else fits | ~jnp.asarray(v.valid)
+                if not bool(jnp.all(live)):
+                    raise ValueError(
+                        f"DECIMAL overflow: CAST {frm} -> {to} value "
+                        "does not fit a short decimal")
+            return ColVal(short, valid, to)
+        if to.is_floating:
+            r = D128.to_float64(a) / (10 ** s)
+            return ColVal(r.astype(to.numpy_dtype()), v.valid, to)
+        if to.is_integer:
+            r = D128.scale_down_round(a, s)
+            return ColVal(r[..., D128.LO].astype(to.numpy_dtype()),
+                          v.valid, to)
+        if to.is_string:
+            if isinstance(a, jax.core.Tracer):
+                raise NotImplementedError(
+                    "CAST(long decimal AS VARCHAR) inside a compiled "
+                    "fragment")
+            from decimal import Decimal
+
+            ints = D128.to_host_ints(np.asarray(a))  # signed
+            vals = np.empty(len(ints), dtype=object)
+            import decimal as _d
+
+            with _d.localcontext() as ctx:
+                ctx.prec = 80  # scaleb rounds to context precision
+                for i, u in enumerate(ints):
+                    vals[i] = str(Decimal(u).scaleb(-s))
+            codes = ColVal(jnp.arange(len(ints), dtype=jnp.int32),
+                           v.valid, to)
+            return normalize_dictionary(vals, codes)
+        raise NotImplementedError(f"CAST {frm} -> {to}")
     x = jnp.asarray(v.data)
+    if to.is_decimal and to.is_long_decimal:
+        s = to.decimal_scale
+        if (frm.is_decimal or frm.is_integer) and v.is_scalar \
+                and not isinstance(v.data, jax.core.Tracer):
+            import decimal as _d
+
+            s0 = frm.decimal_scale if frm.is_decimal else 0
+            with _d.localcontext() as ctx:
+                ctx.prec = 80
+                unscaled = int(_d.Decimal(int(v.data)).scaleb(s - s0)
+                               .quantize(_d.Decimal(1),
+                                         rounding=_d.ROUND_HALF_UP))
+            return ColVal(unscaled, v.valid, to)
+        if frm.is_decimal:
+            a = D128.from_int64(x.astype(jnp.int64))
+            r = D128.scale_up(a, s - frm.decimal_scale) \
+                if s >= frm.decimal_scale \
+                else D128.scale_down_round(a, frm.decimal_scale - s)
+            return ColVal(r, v.valid, to)
+        if frm.is_integer:
+            return ColVal(D128.scale_up(D128.from_int64(
+                x.astype(jnp.int64)), s), v.valid, to)
+        if frm.is_floating:
+            if v.is_scalar and not isinstance(v.data, jax.core.Tracer):
+                # concrete scalar: exact host fold keeps it a python int
+                # (so downstream literal arithmetic stays exact)
+                from decimal import ROUND_HALF_UP, Decimal
+
+                unscaled = int(Decimal(float(v.data)).scaleb(s).quantize(
+                    Decimal(1), rounding=ROUND_HALF_UP))
+                return ColVal(unscaled, v.valid, to)
+            scaled = x.astype(jnp.float64) * (10 ** s)
+            r = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
+            hi = jnp.floor(r / (2.0 ** 64))
+            lo_f = r - hi * (2.0 ** 64)
+            lo = _f64_to_u64_bits(lo_f)
+            return ColVal(jnp.stack(
+                [hi.astype(jnp.int64), lo], axis=-1), v.valid, to)
+        raise NotImplementedError(f"CAST {frm} -> {to}")
     if to.is_decimal:
         s = to.decimal_scale
         if frm.is_decimal:
@@ -1189,6 +1455,28 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
             return _emit_date_from_str([v])
         # parse numerics via dictionary LUT; None == parse failure (kept
         # distinct from a genuine float('NaN') parse)
+        def parse_dec128(x):
+            """Exact unscaled Int128 from a decimal string (reference:
+            Decimals.parse for long decimals)."""
+            import decimal as _d
+
+            try:
+                with _d.localcontext() as ctx:
+                    ctx.prec = 80  # default 28 can't quantize 38 digits
+                    d = _d.Decimal(x)
+                    unscaled = int(d.scaleb(to.decimal_scale).quantize(
+                        _d.Decimal(1), rounding=_d.ROUND_HALF_UP))
+            except _d.InvalidOperation:
+                if safe:
+                    return None
+                raise ValueError(f"cannot CAST '{x}' to {to}")
+            if abs(unscaled) >= 10 ** to.decimal_precision:
+                if safe:
+                    return None
+                raise ValueError(
+                    f"DECIMAL overflow: '{x}' exceeds {to}")
+            return unscaled
+
         def parse(x):
             try:
                 f = float(x)
@@ -1199,8 +1487,9 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
             if to.is_decimal and \
                     abs(f) * (10 ** to.decimal_scale) \
                     >= T.DECIMAL_UNSCALED_LIMIT:
-                # int64 unscaled storage limit (~19 digits); raise rather
-                # than silently wrapping (long-decimal Int128 boundary)
+                # int64 unscaled storage limit (~19 digits): short
+                # decimals reject; DECIMAL(p>18) takes the exact
+                # two-limb path (parse_dec128)
                 if safe:
                     return None
                 raise ValueError(
@@ -1208,6 +1497,12 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
             return f
         lit = _as_string_literal(v)
         if lit is not None:
+            if to.is_decimal and to.is_long_decimal:
+                unscaled = parse_dec128(lit)
+                if unscaled is None:
+                    return emit_cast(ColVal(False, False, T.UNKNOWN),
+                                     to, safe)
+                return ColVal(unscaled, v.valid, to)  # long scalar: py int
             val = parse(lit)
             if val is None:  # safe-parse failure -> typed NULL
                 return emit_cast(ColVal(False, False, T.UNKNOWN), to, safe)
@@ -1220,6 +1515,26 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
                 return _emit_cast_decimal(
                     ColVal(val, v.valid, T.DOUBLE), to, safe)
             return ColVal(val, v.valid, to)  # 'NaN' parses to a real NaN
+        if to.is_decimal and to.is_long_decimal:
+            from presto_tpu.exec import dec128 as D128
+
+            bad_np = np.zeros(len(v.dictionary), dtype=bool)
+            ints = []
+            for i, x in enumerate(v.dictionary.values):
+                r = parse_dec128(x)
+                if r is None:
+                    bad_np[i] = True
+                    r = 0
+                ints.append(r)
+            lut = jnp.asarray(D128.from_host_ints(ints))
+            data = lut[jnp.clip(v.data, 0, len(v.dictionary) - 1)]
+            valid = v.valid
+            if bad_np.any():
+                bad = jnp.asarray(bad_np)[
+                    jnp.clip(v.data, 0, len(v.dictionary) - 1)]
+                valid = (~bad) if valid is None \
+                    else (jnp.asarray(valid) & ~bad)
+            return ColVal(data, valid, to)
         bad_np = np.zeros(len(v.dictionary), dtype=bool)
         lut_vals = []
         for i, x in enumerate(v.dictionary.values):

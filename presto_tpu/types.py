@@ -82,6 +82,12 @@ class Type:
         assert self.is_decimal
         return self.params[0] if self.params else 18
 
+    @property
+    def is_long_decimal(self) -> bool:
+        """Precision 19..38: two-limb Int128 storage (reference:
+        Decimals.MAX_SHORT_PRECISION boundary, Int128ArrayBlock)."""
+        return self.is_decimal and self.decimal_precision > 18
+
     # ---- physical representation ------------------------------------
     def numpy_dtype(self) -> np.dtype:
         return np.dtype(_PHYSICAL[self.name])
@@ -104,14 +110,32 @@ UNKNOWN = Type("UNKNOWN")  # the NULL literal's type
 
 
 def decimal(precision: int, scale: int) -> Type:
-    """DECIMAL(p<=38, s).  Declared precisions up to the reference's
-    Int128 limit are accepted; the unscaled value is stored as int64, so
-    actual magnitudes are bounded by ~9.2e18 (19 significant digits) —
-    ingest/arithmetic beyond that raises rather than silently wrapping
-    (reference: spi/type/DecimalType long decimals over Int128)."""
+    """DECIMAL(p,s).  p <= 18 ("short"): unscaled int64.  p in 19..38
+    ("long"): two int64 limbs per value, shape (n, 2) — exact Int128
+    semantics through arithmetic, comparison, sort and SUM/MIN/MAX
+    aggregation (reference: spi/type/DecimalType,
+    UnscaledDecimal128Arithmetic, Int128ArrayBlock; device kernels in
+    exec/dec128.py)."""
     if precision > 38:
         raise ValueError(f"DECIMAL precision {precision} exceeds 38")
     return Type("DECIMAL", (precision, scale))
+
+
+def decimal_add_type(a: "Type", b: "Type") -> "Type":
+    """Presto result type of decimal +/- (DecimalOperators.ADD)."""
+    s = max(a.decimal_scale, b.decimal_scale)
+    p = min(38, max(a.decimal_precision - a.decimal_scale,
+                    b.decimal_precision - b.decimal_scale) + s + 1)
+    return decimal(p, s)
+
+
+def decimal_mul_type(a: "Type", b: "Type") -> "Type":
+    """Presto result type of decimal * (DecimalOperators.MULTIPLY)."""
+    s = a.decimal_scale + b.decimal_scale
+    p = min(38, a.decimal_precision + b.decimal_precision)
+    if s > 38:
+        raise ValueError("DECIMAL multiply scale exceeds 38")
+    return decimal(p, s)
 
 
 def varchar(length: Optional[int] = None) -> Type:

@@ -1,0 +1,211 @@
+"""Long decimals (precision 19..38): two-limb Int128 semantics, exact
+end to end (reference: spi/type/UnscaledDecimal128Arithmetic.java,
+Int128ArrayBlock.java; device kernels exec/dec128.py).
+
+Exactness oracle: python Decimal/int arithmetic over the same values —
+sqlite stores decimals as f64, which cannot express these."""
+
+import random
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu.catalog import Catalog
+
+
+@pytest.fixture(scope="module")
+def s():
+    return presto_tpu.connect(Catalog())
+
+
+def one(session, sql):
+    rows = session.sql(sql).rows
+    assert len(rows) == 1 and len(rows[0]) == 1, rows
+    return rows[0][0]
+
+
+def test_literal_arithmetic_exact(s):
+    big = "123456789012345678901234.50"
+    r = one(s, f"SELECT CAST('{big}' AS DECIMAL(38,2)) "
+               f"+ CAST('0.44' AS DECIMAL(38,2))")
+    assert r == Decimal("123456789012345678901234.94")
+    r = one(s, f"SELECT CAST('{big}' AS DECIMAL(38,2)) "
+               f"- CAST('0.51' AS DECIMAL(38,2))")
+    assert r == Decimal("123456789012345678901233.99")
+    r = one(s, f"SELECT -CAST('{big}' AS DECIMAL(38,2))")
+    assert r == Decimal("-123456789012345678901234.50")
+
+
+def test_short_mul_produces_exact_long(s):
+    # (18,2) x (18,2) -> (36,4): the product exceeds int64 and must be
+    # the bit-exact Int128 value
+    a, b = Decimal("4000000000.12"), Decimal("4000000001.34")
+    r = one(s, f"SELECT CAST('{a}' AS DECIMAL(18,2)) "
+               f"* CAST('{b}' AS DECIMAL(18,2))")
+    assert r == a * b
+    # negative operand
+    r = one(s, f"SELECT CAST('-{a}' AS DECIMAL(18,2)) "
+               f"* CAST('{b}' AS DECIMAL(18,2))")
+    assert r == -a * b
+
+
+def test_long_compare_and_where(s):
+    big = "99999999999999999999.99"  # > int64 unscaled
+    r = one(s, f"SELECT CAST('{big}' AS DECIMAL(38,2)) "
+               f"> CAST('99999999999999999999.98' AS DECIMAL(38,2))")
+    assert r is True
+    r = one(s, f"SELECT CAST('{big}' AS DECIMAL(38,2)) "
+               f"= CAST('{big}' AS DECIMAL(38,2))")
+    assert r is True
+
+
+def test_cast_round_trips(s):
+    big = "12345678901234567890.123456"
+    assert one(s, f"SELECT CAST(CAST('{big}' AS DECIMAL(38,6)) "
+                  "AS VARCHAR)") == big
+    # long -> short rescale with half-away rounding
+    assert one(s, "SELECT CAST(CAST('123.455' AS DECIMAL(38,3)) "
+                  "AS DECIMAL(10,2))") == pytest.approx(123.46)
+    # long -> double
+    assert one(s, f"SELECT CAST(CAST('{big}' AS DECIMAL(38,6)) "
+                  "AS DOUBLE)") == pytest.approx(float(Decimal(big)))
+    # overflow guard still rejects > 38 digits
+    assert one(s, "SELECT TRY_CAST('1" + "0" * 38
+                  + "' AS DECIMAL(38,0))") is None
+
+
+def _fixture_catalog(n=20_000, seed=7):
+    rng = random.Random(seed)
+    vals = [Decimal(rng.randint(-10 ** 24, 10 ** 24)) / 100
+            for _ in range(n)]
+    grp = [rng.randrange(5) for _ in range(n)]
+    cat = Catalog()
+    from presto_tpu import types as T
+
+    cat.register_memory(
+        "t", {"g": T.BIGINT, "v": T.decimal(38, 2)},
+        {"g": np.asarray(grp, np.int64),
+         "v": np.asarray([str(v) for v in vals], dtype=object)})
+    return cat, vals, grp
+
+
+def test_sum_min_max_exact_over_table():
+    """Whole-column and per-group SUM/MIN/MAX of 20k 26-digit values —
+    bit-exact vs python Decimal (an f64 accumulator is ~1e10 off at
+    this magnitude)."""
+    cat, vals, grp = _fixture_catalog()
+    s = presto_tpu.connect(cat)
+    r = s.sql("SELECT sum(v), min(v), max(v) FROM t").rows[0]
+    assert r[0] == sum(vals)
+    assert r[1] == min(vals)
+    assert r[2] == max(vals)
+    rows = s.sql("SELECT g, sum(v), min(v), max(v) FROM t GROUP BY g "
+                 "ORDER BY g").rows
+    for g, sm, mn, mx in rows:
+        sub = [v for v, gg in zip(vals, grp) if gg == g]
+        assert sm == sum(sub) and mn == min(sub) and mx == max(sub), g
+
+
+def test_order_by_long_exact():
+    cat, vals, _ = _fixture_catalog(n=3000)
+    s = presto_tpu.connect(cat)
+    rows = s.sql("SELECT v FROM t ORDER BY v LIMIT 50").rows
+    assert [r[0] for r in rows] == sorted(vals)[:50]
+    rows = s.sql("SELECT v FROM t ORDER BY v DESC LIMIT 50").rows
+    assert [r[0] for r in rows] == sorted(vals, reverse=True)[:50]
+
+
+def test_tpch_q1_exact_decimal_semantics(tpch_catalog_tiny):
+    """TPC-H Q1's aggregate pipeline with exact-decimal semantics at
+    precision > 19: sums of (12,2)x(18,2)-> long products match python
+    Decimal exactly (VERDICT r2 item 6's done-bar)."""
+    s = presto_tpu.connect(tpch_catalog_tiny)
+    s.sql("""
+        CREATE TABLE memory.l AS
+        SELECT l_returnflag AS rf, l_linestatus AS ls,
+               CAST(CAST(l_quantity AS VARCHAR) AS DECIMAL(12,2)) AS qty,
+               CAST(CAST(l_extendedprice AS VARCHAR) AS DECIMAL(12,2))
+                   AS price,
+               CAST(CAST(l_discount AS VARCHAR) AS DECIMAL(12,2)) AS disc
+        FROM lineitem""")
+    got = s.sql("""
+        SELECT rf, ls, sum(qty) AS sq, sum(price) AS sp,
+               sum(price * (CAST('1.00' AS DECIMAL(12,2)) - disc)) AS sd
+        FROM memory.l GROUP BY rf, ls ORDER BY rf, ls""").rows
+    # python Decimal oracle over the same host data
+    raw = s.sql("SELECT rf, ls, qty, price, disc FROM memory.l").rows
+    agg = {}
+    for rf, ls, qty, price, disc in raw:
+        k = (rf, ls)
+        a = agg.setdefault(k, [Decimal(0), Decimal(0), Decimal(0)])
+        qty = Decimal(str(qty)).quantize(Decimal("0.01"))
+        price = Decimal(str(price)).quantize(Decimal("0.01"))
+        disc = Decimal(str(disc)).quantize(Decimal("0.01"))
+        a[0] += qty
+        a[1] += price
+        a[2] += price * (Decimal("1.00") - disc)
+    want = [(rf, ls, *agg[(rf, ls)]) for rf, ls in sorted(agg)]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[1] == w[1]
+        for i in (2, 3, 4):
+            assert Decimal(str(g[i])) == w[i], (g, w)
+
+
+def test_scalar_subquery_long_decimal(s):
+    # review regression: _single_value decodes to a SCALED Decimal; the
+    # ScalarSub consumer must re-derive the unscaled integer
+    r = one(s, "SELECT (SELECT CAST('12345.67' AS DECIMAL(38,2)))")
+    assert r == Decimal("12345.67")
+    r = s.sql("SELECT 1 WHERE CAST('12345.67' AS DECIMAL(38,2)) = "
+              "(SELECT CAST('12345.67' AS DECIMAL(38,2)))").rows
+    assert r == [(1,)]
+
+
+def test_long_to_short_cast_overflow_raises(s):
+    with pytest.raises(Exception):
+        s.sql("SELECT CAST(CAST('99999999999999999999.00' AS "
+              "DECIMAL(38,2)) AS DECIMAL(10,2))")
+    assert one(s, "SELECT TRY_CAST(CAST('99999999999999999999.00' AS "
+                  "DECIMAL(38,2)) AS DECIMAL(10,2))") is None
+
+
+def test_extreme_scale_compare_exact(s):
+    # review regression: cross-scale comparison must not silently wrap
+    # mod 2^128.  A 34-digit value coerced to scale 3 still fits 38
+    # digits -> exact compare; the full-38-digit case overflows the
+    # coercion target (38,1) and must RAISE like the reference
+    # (UnscaledDecimal128Arithmetic.rescale overflow), never misanswer.
+    big34 = "9" * 34
+    r = s.sql(f"SELECT 1 WHERE CAST('{big34}' AS DECIMAL(38,0)) > "
+              "CAST('0.555' AS DECIMAL(38,3))").rows
+    assert r == [(1,)]
+    r = s.sql(f"SELECT 1 WHERE CAST('-{big34}' AS DECIMAL(38,0)) < "
+              "CAST('0.555' AS DECIMAL(38,3))").rows
+    assert r == [(1,)]
+    big38 = "9" * 38
+    with pytest.raises(Exception):
+        s.sql(f"SELECT CAST('{big38}' AS DECIMAL(38,0)) > "
+              "CAST('0.5' AS DECIMAL(38,1))")
+
+
+def test_cast_respects_declared_precision(s):
+    with pytest.raises(Exception):
+        s.sql("SELECT CAST('999999999999999999999' AS DECIMAL(19,0))")
+    assert one(s, "SELECT TRY_CAST('999999999999999999999' "
+                  "AS DECIMAL(19,0))") is None
+
+
+def test_ingest_38_digit_strings():
+    from presto_tpu import types as T
+
+    cat = Catalog()
+    vals = ["1234567890123456789012345678.90",
+            "-9999999999999999999999999999999999.99"]
+    cat.register_memory("big", {"v": T.decimal(38, 2)},
+                        {"v": np.asarray(vals, dtype=object)})
+    sess = presto_tpu.connect(cat)
+    rows = sess.sql("SELECT v FROM big ORDER BY v").rows
+    assert rows == [(Decimal(vals[1]),), (Decimal(vals[0]),)]

@@ -382,19 +382,23 @@ def test_json_distinct_type(tpch_catalog_tiny):
 
 
 def test_wide_decimal_declarations(tpch_catalog_tiny):
-    """DECIMAL up to precision 38 declared; int64 unscaled storage with
-    overflow errors past ~19 significant digits (the Int128 boundary is
-    rejected, never silently wrapped)."""
+    """DECIMAL(p>18) is two-limb Int128 (exec/dec128.py): values past 19
+    significant digits are EXACT, not rejected; only the 38-digit
+    boundary errors (reference: UnscaledDecimal128Arithmetic limits).
+    Full exactness coverage: tests/test_decimal128.py."""
+    from decimal import Decimal
+
     import presto_tpu as pt
 
     s = pt.connect(tpch_catalog_tiny)
     assert s.sql("SELECT CAST('12345678901234.56' AS DECIMAL(38,2)) "
                  "+ CAST('0.44' AS DECIMAL(38,2))").rows \
-        == [(12345678901235.0,)]
+        == [(Decimal("12345678901235.00"),)]
     assert s.sql(
-        "SELECT TRY_CAST('123456789012345678901234.5' AS DECIMAL(38,2))"
-    ).rows == [(None,)]
-    with pytest.raises(Exception):
-        s.sql("SELECT CAST('123456789012345678901234.5' AS DECIMAL(38,2))")
-    with pytest.raises(Exception):
-        s.sql("SELECT CAST(4e9 AS DECIMAL(38,2)) * CAST(4e9 AS DECIMAL(38,2))")
+        "SELECT CAST('123456789012345678901234.5' AS DECIMAL(38,2))"
+    ).rows == [(Decimal("123456789012345678901234.50"),)]
+    assert s.sql(
+        "SELECT CAST(4e9 AS DECIMAL(38,2)) * CAST(4e9 AS DECIMAL(38,2))"
+    ).rows == [(Decimal(4_000_000_000) * Decimal(4_000_000_000),)]
+    assert s.sql("SELECT TRY_CAST('1" + "0" * 38 + "' AS DECIMAL(38,0))"
+                 ).rows == [(None,)]
