@@ -66,6 +66,14 @@ def distribute(plan: P.QueryPlan, session, ndev: int,
     root, dist = d.visit(plan.root.source)
     if dist.kind != "replicated":
         root = P.Exchange(root, "gather")
+    # post-exchange iterative rules (the reference runs e.g.
+    # PushPartialAggregationThroughExchange AFTER AddExchanges,
+    # PlanOptimizers.java:230-424)
+    from presto_tpu.plan.iterative import (
+        IterativeOptimizer, PushPartialAggregationThroughExchange)
+
+    root = IterativeOptimizer(
+        [PushPartialAggregationThroughExchange(session)]).optimize(root)
     out = P.Output(root, plan.root.names, plan.root.symbols)
     return P.QueryPlan(out, subplans)
 
@@ -79,7 +87,7 @@ _MERGEABLE = {"count", "count_if", "sum", "min", "max", "avg",
 
 
 class Distributer:
-    def __init__(self, session, ndev: int, bucketed=None):
+    def __init__(self, session, ndev: int = 1, bucketed=None):
         self.session = session
         self.ndev = ndev
         self.bucketed = bucketed or {}  # table -> bucket column (chunk mode)
@@ -326,14 +334,35 @@ class Distributer:
                  ir.Lit(0, T.BIGINT)), T.BIGINT)
         return P.Project(outer, final_proj)
 
+    def decompose_aggs(self, aggs):
+        """(partial_aggs, final_aggs) for a mergeable aggregate map, or
+        (None, None) when some aggregate has no partial/final
+        decomposition (shared by _split_partial_final and the
+        PushPartialAggregationThroughExchange rule)."""
+        try:
+            return self._decompose_aggs(aggs)
+        except Undistributable:
+            return None, None
+
     def _split_partial_final(self, node: P.Aggregate, src: P.PlanNode):
         """partial agg per shard -> gather -> final merge (the reference's
         PARTIAL/FINAL AggregationNode pair around a repartition,
         AddExchanges.java:239; here the combine is a gather because the
         partial output is tiny — <= partial_aggregation_max_groups rows)."""
+        partial_aggs, final_aggs = self._decompose_aggs(node.aggs)
+        partial = P.Aggregate(src, list(node.group_keys), partial_aggs, "PARTIAL")
+        partial.capacity_hint = getattr(node, "capacity_hint", None)
+        partial.key_stats = getattr(node, "key_stats", {})
+        gathered = P.Exchange(partial, "gather")
+        final = P.Aggregate(gathered, list(node.group_keys), final_aggs, "FINAL")
+        final.capacity_hint = getattr(node, "capacity_hint", None)
+        final.key_stats = getattr(node, "key_stats", {})
+        return final, REPLICATED
+
+    def _decompose_aggs(self, aggs):
         partial_aggs = {}
         final_aggs = {}
-        for sym, a in node.aggs.items():
+        for sym, a in aggs.items():
             fn = a.fn
             if fn in ("count", "count_if"):
                 p = self.fresh(sym)
@@ -399,14 +428,7 @@ class Distributer:
                      ir.Ref(pc, T.BIGINT)), T.DOUBLE)
             else:
                 raise Undistributable(f"aggregate {fn}")
-        partial = P.Aggregate(src, list(node.group_keys), partial_aggs, "PARTIAL")
-        partial.capacity_hint = getattr(node, "capacity_hint", None)
-        partial.key_stats = getattr(node, "key_stats", {})
-        gathered = P.Exchange(partial, "gather")
-        final = P.Aggregate(gathered, list(node.group_keys), final_aggs, "FINAL")
-        final.capacity_hint = getattr(node, "capacity_hint", None)
-        final.key_stats = getattr(node, "key_stats", {})
-        return final, REPLICATED
+        return partial_aggs, final_aggs
 
     # ---- joins --------------------------------------------------------
     def _visit_join(self, node: P.Join):

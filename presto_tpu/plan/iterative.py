@@ -108,6 +108,17 @@ class GroupRef(P.PlanNode):
         return f"GroupRef({self.gid})"
 
 
+def _carry_attrs(src: P.PlanNode, dst: P.PlanNode) -> P.PlanNode:
+    """Preserve optimizer hint instance-attrs (capacity_hint, key_stats,
+    build_unique, fanout_bound — not dataclass fields) across
+    dataclasses.replace round-trips through the memo."""
+    fields = {f.name for f in dataclasses.fields(src)}
+    for k, v in src.__dict__.items():
+        if k not in fields and k not in dst.__dict__:
+            setattr(dst, k, v)
+    return dst
+
+
 class Memo:
     """Plan stored as groups; children of every stored node are
     GroupRefs.  `replace` rewires a group to a new representative
@@ -140,7 +151,8 @@ class Memo:
                 changed[f.name] = [
                     x if isinstance(x, GroupRef)
                     else GroupRef(self, self._insert(x)) for x in v]
-        return dataclasses.replace(node, **changed) if changed else node
+        return _carry_attrs(node, dataclasses.replace(node, **changed)) \
+            if changed else node
 
     def node(self, gid: int) -> P.PlanNode:
         return self._nodes[gid]
@@ -174,7 +186,13 @@ class Memo:
 
     def extract(self, gid: Optional[int] = None) -> P.PlanNode:
         """Materialize the plan back out of the memo."""
-        node = self._nodes[self.root_gid if gid is None else gid]
+        return self.extract_node(
+            self._nodes[self.root_gid if gid is None else gid])
+
+    def extract_node(self, node: P.PlanNode) -> P.PlanNode:
+        """Materialize a node whose children may be GroupRefs."""
+        if isinstance(node, GroupRef):
+            return self.extract(node.gid)
         changed = {}
         for f in dataclasses.fields(node):
             v = getattr(node, f.name)
@@ -185,7 +203,8 @@ class Memo:
                 changed[f.name] = [self.extract(x.gid)
                                    if isinstance(x, GroupRef) else x
                                    for x in v]
-        return dataclasses.replace(node, **changed) if changed else node
+        return _carry_attrs(node, dataclasses.replace(node, **changed)) \
+            if changed else node
 
 
 class IterativeOptimizer:
@@ -319,3 +338,174 @@ DEFAULT_RULES: List[Rule] = [
     MergeLimitWithSort(), PushLimitThroughProject(),
     InlineIdentityProject(), MergeAdjacentProjects(),
 ]
+
+
+# ---------------------------------------------------------------------------
+# cost-based rules (reference: rule/ReorderJoins.java — the CBO join
+# enumeration INSIDE the iterative framework, replacing the greedy
+# whole-plan pass for bounded join sets)
+# ---------------------------------------------------------------------------
+
+
+class ReorderJoins(Rule):
+    """Memoized cost-based join reordering: flatten a tree of INNER
+    equi-joins (through GroupRefs), run a Selinger-style DP over
+    connected subsets costing each alternative with the stats engine
+    (plan/stats.py — the CostCalculator role), and keep the cheapest
+    tree.  Bounded to `max_reorder_joins` relations like the
+    reference's JoinEnumerator (ReorderJoins.java limits to 9);
+    larger sets keep the greedy order from the reassembly pass."""
+
+    def __init__(self, session):
+        self.session = session
+        self.max_rels = int(session.properties.get("max_reorder_joins", 8))
+        self.pattern = pattern(P.Join).matching(
+            lambda n: n.join_type == "INNER" and n.criteria
+            and not n.reordered and n.filter is None)
+
+    def _flatten(self, node, ctx, sources, criteria):
+        node = ctx.resolve(node)
+        if isinstance(node, P.Join) and node.join_type == "INNER" \
+                and node.criteria and node.filter is None:
+            self._flatten(node.left, ctx, sources, criteria)
+            self._flatten(node.right, ctx, sources, criteria)
+            criteria.extend(node.criteria)
+            return
+        sources.append(ctx.memo.extract_node(node))
+
+    def apply(self, node: P.Join, ctx):
+        from presto_tpu.plan import stats as S
+
+        catalog = getattr(self.session, "catalog", None)
+        if catalog is None:
+            return None
+        sources: List[P.PlanNode] = []
+        criteria: List[tuple] = []
+        self._flatten(node, ctx, sources, criteria)
+        n = len(sources)
+        if n < 3 or n > self.max_rels:
+            return self._mark(node)
+        sym_of = {}  # symbol -> relation index
+        for i, s in enumerate(sources):
+            for sym, _t in s.outputs():
+                sym_of[sym] = i
+        edges = []  # (i, j, lsym@i, rsym@j)
+        for lk, rk in criteria:
+            i, j = sym_of.get(lk), sym_of.get(rk)
+            if i is None or j is None or i == j:
+                return self._mark(node)
+            edges.append((i, j, lk, rk))
+
+        def stats_of(tree):
+            try:
+                return S.derive(tree, catalog)
+            except Exception:
+                return None
+
+        # DP over connected subsets: best[mask] = (cost, tree)
+        best: Dict[int, tuple] = {}
+        for i, s in enumerate(sources):
+            st = stats_of(s)
+            if st is None:
+                return self._mark(node)
+            best[1 << i] = (0.0, s)
+        full = (1 << n) - 1
+        for mask in range(3, full + 1):
+            if mask & (mask - 1) == 0:
+                continue
+            cand = None
+            sub = (mask - 1) & mask
+            while sub:
+                rest = mask ^ sub
+                if sub < rest:  # each split once
+                    sub = (sub - 1) & mask
+                    continue
+                bl, br = best.get(sub), best.get(rest)
+                if bl and br:
+                    crit = [(lk, rk) for (i, j, lk, rk) in edges
+                            if (sub >> i) & 1 and (rest >> j) & 1]
+                    crit += [(rk, lk) for (i, j, lk, rk) in edges
+                             if (rest >> i) & 1 and (sub >> j) & 1]
+                    if crit:
+                        tree = P.Join(bl[1], br[1], "INNER", crit,
+                                      reordered=True)
+                        st = stats_of(tree)
+                        if st is not None:
+                            cost = bl[0] + br[0] + st.est_rows
+                            if cand is None or cost < cand[0]:
+                                cand = (cost, tree)
+                sub = (sub - 1) & mask
+            if cand is not None:
+                best[mask] = cand
+        if full not in best:
+            return self._mark(node)
+        cost, tree = best[full]
+        cur_cost = self._tree_cost(node, ctx, catalog)
+        if cur_cost is not None and cost >= cur_cost:
+            return self._mark(node)
+        return tree
+
+    def _tree_cost(self, node, ctx, catalog):
+        from presto_tpu.plan import stats as S
+
+        node = ctx.resolve(node)
+        if not (isinstance(node, P.Join) and node.join_type == "INNER"
+                and node.criteria and node.filter is None):
+            return 0.0
+        try:
+            st = S.derive(ctx.memo.extract_node(node), catalog)
+        except Exception:
+            return None
+        lc = self._tree_cost(node.left, ctx, catalog)
+        rc = self._tree_cost(node.right, ctx, catalog)
+        if lc is None or rc is None:
+            return None
+        return lc + rc + st.est_rows
+
+    @staticmethod
+    def _mark(node):
+        return dataclasses.replace(node, reordered=True)
+
+
+class PushPartialAggregationThroughExchange(Rule):
+    """Aggregate(SINGLE, Exchange(repartition, keys == group keys)) ->
+    FinalAgg(Exchange(repartition, PartialAgg(src))) when every
+    aggregate decomposes into a partial/final pair and the stats say
+    shards hold duplicate keys (reference:
+    rule/PushPartialAggregationThroughExchange.java, run after
+    AddExchanges; here run by distribute() on the distributed plan)."""
+
+    def __init__(self, session):
+        self.session = session
+        self.pattern = pattern(P.Aggregate).matching(
+            lambda n: n.step == "SINGLE" and n.group_keys)
+
+    def apply(self, node: P.Aggregate, ctx):
+        from presto_tpu.plan.distribute import Distributer, _MERGEABLE
+
+        if not bool(self.session.properties.get(
+                "push_partial_aggregation_through_exchange", True)):
+            return None
+        ex = ctx.resolve(node.source)
+        if not (isinstance(ex, P.Exchange) and ex.kind == "repartition"
+                and list(ex.keys) == list(node.group_keys)):
+            return None
+        if any(a.distinct or a.fn not in _MERGEABLE
+               for a in node.aggs.values()):
+            return None
+        src = ex.source
+        d = Distributer(self.session)
+        partial_aggs, final_aggs = d.decompose_aggs(node.aggs)
+        if partial_aggs is None:
+            return None
+        partial = P.Aggregate(ctx.memo.extract_node(ctx.resolve(src)),
+                              list(node.group_keys), partial_aggs,
+                              "PARTIAL")
+        partial.capacity_hint = getattr(node, "capacity_hint", None)
+        partial.key_stats = getattr(node, "key_stats", {})
+        new_ex = P.Exchange(partial, "repartition", list(ex.keys))
+        final = P.Aggregate(new_ex, list(node.group_keys), final_aggs,
+                            "FINAL")
+        final.capacity_hint = getattr(node, "capacity_hint", None)
+        final.key_stats = getattr(node, "key_stats", {})
+        return final

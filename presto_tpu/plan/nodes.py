@@ -115,6 +115,7 @@ class Join(PlanNode):
     # execution hints filled by the optimizer
     distribution: str = "AUTOMATIC"  # PARTITIONED | BROADCAST | AUTOMATIC
     mark: Optional[str] = None  # MARK only: output symbol for match-ness
+    reordered: bool = False  # ReorderJoins already explored this tree
 
     def outputs(self):
         if self.join_type == "MARK":

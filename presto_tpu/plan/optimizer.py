@@ -82,9 +82,16 @@ def _optimize_node(node: P.PlanNode, session) -> P.PlanNode:
     node = _rewrite(node, session)
     node = prune_columns(node, set(n for n, _ in node.outputs()))
     if session.properties.get("iterative_optimizer_enabled", True):
-        from presto_tpu.plan.iterative import DEFAULT_RULES, IterativeOptimizer
+        from presto_tpu.plan.iterative import (DEFAULT_RULES,
+                                               IterativeOptimizer,
+                                               ReorderJoins)
 
-        node = IterativeOptimizer(DEFAULT_RULES).optimize(node)
+        rules = list(DEFAULT_RULES)
+        if session.properties.get("reorder_joins", True):
+            # cost-based join enumeration inside the memo (reference:
+            # rule/ReorderJoins.java replacing the greedy order)
+            rules.append(ReorderJoins(session))
+        node = IterativeOptimizer(rules).optimize(node)
     node = _pushdown_connector_predicates(node, session)
     # re-prune: a pushed-down predicate leaves its original string column
     # unreferenced in the scan — dropping it is the whole point (the

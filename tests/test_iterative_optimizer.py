@@ -96,3 +96,94 @@ def test_end_to_end_queries_unchanged(tpch_catalog_tiny):
     s.set("iterative_optimizer_enabled", False)
     without = s.sql(q).rows
     assert with_rules == without and len(with_rules) == 5
+
+
+def test_reorder_joins_cost_based(tpch_catalog_tiny):
+    """ReorderJoins (memoized CBO enumeration, reference
+    rule/ReorderJoins.java): a deliberately bad syntactic order —
+    lineitem x orders first, selective filtered nation last — must be
+    rewritten so the cheap selective side joins early."""
+    import presto_tpu
+    from presto_tpu.plan.iterative import IterativeOptimizer, ReorderJoins
+
+    s = presto_tpu.connect(tpch_catalog_tiny)
+    li = P.TableScan("lineitem", {"l_suppkey": "l_suppkey",
+                                  "l_orderkey": "l_orderkey"},
+                     {"l_suppkey": T.BIGINT, "l_orderkey": T.BIGINT})
+    o = P.TableScan("orders", {"o_orderkey": "o_orderkey"},
+                    {"o_orderkey": T.BIGINT})
+    su = P.TableScan("supplier", {"s_suppkey": "s_suppkey",
+                                  "s_nationkey": "s_nationkey"},
+                     {"s_suppkey": T.BIGINT, "s_nationkey": T.BIGINT})
+    filt = P.Filter(su, ir.Call("lt", (ir.Ref("s_nationkey", T.BIGINT),
+                                       ir.Lit(2, T.BIGINT)), T.BOOLEAN))
+    bad = P.Join(P.Join(li, o, "INNER", [("l_orderkey", "o_orderkey")]),
+                 filt, "INNER", [("l_suppkey", "s_suppkey")])
+    out = IterativeOptimizer([ReorderJoins(s)]).optimize(bad)
+    assert isinstance(out, P.Join) and out.reordered
+
+    def leaves_in_order(n, acc):
+        if isinstance(n, P.Join):
+            leaves_in_order(n.left, acc)
+            leaves_in_order(n.right, acc)
+        elif isinstance(n, P.Filter):
+            leaves_in_order(n.source, acc)
+        else:
+            acc.append(n.table)
+        return acc
+
+    order = leaves_in_order(out, [])
+    # the selective supplier side must not be last anymore: the DP joins
+    # lineitem with (filtered) supplier before the orders blow-up
+    assert order.index("supplier") < order.index("orders"), order
+
+
+def test_push_partial_aggregation_through_exchange(tpch_catalog_tiny):
+    """PushPartialAggregationThroughExchange (reference rule of the
+    same name, run post-AddExchanges): a big-ndv GROUP BY that takes
+    the repartition path must become PARTIAL -> repartition -> FINAL,
+    and distributed results must still match single-device."""
+    import presto_tpu
+    from presto_tpu.plan.distribute import distribute
+    from presto_tpu.exec.executor import plan_statement
+    from presto_tpu.sql.parser import parse
+
+    s = presto_tpu.connect(tpch_catalog_tiny)
+    s.properties["partial_aggregation_max_groups"] = 4  # force repartition
+    sql = ("SELECT o_custkey, count(*) AS c, sum(o_totalprice) AS t "
+           "FROM orders GROUP BY o_custkey")
+    plan = plan_statement(s, parse(sql))
+    dplan = distribute(plan, s, ndev=4)
+
+    found = []
+
+    def walk(n):
+        if isinstance(n, P.Aggregate):
+            found.append(n.step)
+        for src in n.sources:
+            walk(src)
+
+    walk(dplan.root)
+    assert "PARTIAL" in found and "FINAL" in found, found
+    # and the exchange sits BETWEEN them
+    def has_shape(n):
+        if isinstance(n, P.Aggregate) and n.step == "FINAL":
+            ex = n.source
+            if isinstance(ex, P.Exchange) and ex.kind == "repartition":
+                return isinstance(ex.source, P.Aggregate) \
+                    and ex.source.step == "PARTIAL"
+        return any(has_shape(src) for src in n.sources)
+
+    assert has_shape(dplan.root), "partial not pushed through exchange"
+
+    # execution equivalence on the virtual mesh
+    s2 = presto_tpu.connect(tpch_catalog_tiny)
+    s2.properties["partial_aggregation_max_groups"] = 4
+    s2.set("distributed", True)
+    s2.set("mesh_devices", 4)
+    got = sorted(s2.sql(sql).rows)
+    want = sorted(presto_tpu.connect(tpch_catalog_tiny).sql(sql).rows)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[1] == w[1]
+        assert abs(g[2] - w[2]) < 1e-6 * max(1.0, abs(w[2]))
