@@ -495,6 +495,11 @@ class _SalesChunkGrid:
         # returns matches <= the chunk's return count x small fanout)
         return self.cap_sales // 2
 
+    def bucket_ndv(self) -> int:
+        # edges land on unit (ticket/order) boundaries, so a chunk
+        # holds at most cap_sales/unit distinct bucket values
+        return max(self.cap_sales // max(self.unit, 1), 1)
+
     def chunk_args(self, i: int):
         return (jnp.asarray(self.edges[i], jnp.int64),
                 jnp.asarray(self.edges[i + 1] - self.edges[i], jnp.int32),

@@ -580,6 +580,12 @@ class TpchChunkGrid:
         key, selective filters)."""
         return self.cap_orders
 
+    def bucket_ndv(self) -> int:
+        """Distinct bucket (orderkey) values in any one chunk — lets the
+        chunked runner bound a per-chunk GROUP BY bucket_key output at
+        order grain instead of lineitem grain."""
+        return self.cap_orders
+
     def chunk_args(self, i: int):
         """Traced scalars for chunk i — a fixed pytree so ONE jitted
         program serves every chunk."""

@@ -57,15 +57,9 @@ def annotate_static_hints(plan: P.QueryPlan, session) -> None:
                 node.build_unique = any(u <= rkeys for u in rs.unique)
                 best = S._best_fanout_key(rs, rkeys)
                 node.fanout_bound = rs.fanout.get(best) if best else None
-                if node.fanout_bound is None and len(node.criteria) == 1:
-                    # speculative bound from ndv: ~4x the average fanout.
-                    # Safe because the compiled path guards actual counts
-                    # and re-runs dynamically on overflow.
-                    cs = rs.cols.get(node.criteria[0][1])
-                    if cs is not None and cs.ndv:
-                        import math
-
-                        node.fanout_bound = max(4, math.ceil(rs.rows / cs.ndv) * 4)
+                if node.fanout_bound is None:
+                    node.fanout_bound = \
+                        S.speculative_fanout_bound(rs, node.criteria)
                 node.key_stats = {}
                 for lk, rk in node.criteria:
                     node.key_stats[lk] = ls.cols.get(lk)

@@ -45,13 +45,18 @@ class NodeStats:
 
 def derive(node: P.PlanNode, catalog, memo=None) -> NodeStats:
     """Bottom-up stats derivation (reference: ComposableStatsCalculator
-    visiting per-node rules)."""
+    visiting per-node rules).  The memo stores (node, stats) and checks
+    identity on lookup: entries hold a strong ref so a memo that
+    outlives temporaries (e.g. the ReorderJoins DP deriving stats for
+    rejected candidate trees) can never serve stale stats through a
+    recycled id()."""
     if memo is None:
         memo = {}
-    if id(node) in memo:
-        return memo[id(node)]
+    hit = memo.get(id(node))
+    if hit is not None and hit[0] is node:
+        return hit[1]
     s = _derive(node, catalog, memo)
-    memo[id(node)] = s
+    memo[id(node)] = (node, s)
     return s
 
 
@@ -141,11 +146,12 @@ def _derive(node, catalog, memo) -> NodeStats:
             rows = ls.rows
             unique = list(ls.unique)
             fanout = dict(ls.fanout)
-        elif bound is not None:
-            rows = ls.rows * bound
-            unique, fanout = [], {}
         else:
-            rows = ls.rows * 4  # heuristic expansion guess (eager fallback)
+            if bound is None:
+                # a plain small constant here UNDERSHOOTS (rows is a
+                # bound the planner must be able to trust)
+                bound = speculative_fanout_bound(rs, node.criteria)
+            rows = ls.rows * (bound if bound is not None else 4)
             unique, fanout = [], {}
         if node.join_type in ("LEFT", "FULL"):
             est = max(est, ls.est_rows)  # outer side survives
@@ -295,6 +301,23 @@ def join_cardinality(ls: NodeStats, rs: NodeStats, criteria) -> float:
             denom = max(ls.est_rows, rs.est_rows, 1.0) * EQ_UNKNOWN
         est /= max(denom, 1.0)
     return max(est, 1.0)
+
+
+def speculative_fanout_bound(rs: NodeStats, criteria) -> Optional[int]:
+    """Build-side fanout bound from ndv when no connector bound exists:
+    ~4x the average rows-per-key, min over every criterion key (a
+    composite-key match is at most any single key's fanout).  The ONE
+    definition shared by the stats join rule, annotate_static_hints and
+    the ReorderJoins cost model — the executor guards the actual counts
+    and re-runs dynamically on overflow, so 4x average is safe to
+    speculate."""
+    bound = None
+    for _lk, rk in criteria:
+        cs = rs.cols.get(rk)
+        if cs is not None and cs.ndv:
+            b = max(4, math.ceil(rs.rows / cs.ndv) * 4)
+            bound = b if bound is None else min(bound, b)
+    return bound
 
 
 def _best_fanout_key(stats: NodeStats, keys: FrozenSet[str]):

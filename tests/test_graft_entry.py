@@ -1,0 +1,29 @@
+"""The driver's own checks, run in the default suite (round-3 VERDICT
+item 10: dryrun/bench failures must be impossible to ship silently —
+the suite goes red whenever the driver's checks would).
+
+The driver compile-checks entry() single-chip and runs
+dryrun_multichip(N) on an N-virtual-device CPU mesh; both live in
+__graft_entry__.py.  conftest.py already pins an 8-device CPU mesh.
+"""
+
+import os
+import sys
+
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as G
+
+    fn, args = G.entry()
+    out = jax.jit(fn)(*args)
+    assert out[0].shape[0] == 8  # 8 Q1 groups
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as G
+
+    G.dryrun_multichip(8)
