@@ -269,9 +269,24 @@ class Catalog:
                         data: Dict[str, np.ndarray]) -> None:
         self.register(MemoryTable(name, schema, data))
 
+    def register_parquet(self, name: str, path: str) -> None:
+        """A .parquet file (or directory of them) as a table
+        (reference: hive external tables over parquet files)."""
+        from presto_tpu.connectors.parquet import ParquetTable
+
+        self.register(ParquetTable(name, path))
+
+    def register_orc(self, name: str, path: str) -> None:
+        """A .orc file (or directory of them) as a table (reference:
+        hive external tables over ORC, presto-orc readers)."""
+        from presto_tpu.connectors.orc import OrcTable
+
+        self.register(OrcTable(name, path))
+
     #: catalog/schema qualifiers accepted for flat registrations; a bogus
     #: prefix must NOT silently resolve to the bare table
     KNOWN_QUALIFIERS = {"tpch", "tpcds", "memory", "localfile", "blackhole",
+                        "parquet", "orc",
                         "presto_tpu", "default", "system"}
 
     def _flat_name(self, name: str) -> Optional[str]:

@@ -112,15 +112,82 @@ class LocalFileTable(ConnectorTable):
         return kept, total
 
     # ---- write path (reference: ConnectorPageSinkProvider) -----------
+    #: rows per writer page; appends above one page scale writers (P4)
+    WRITER_PAGE_ROWS = 262_144
+    #: writers scale up while backlog > this many pages per active
+    #: writer (reference: ScaledWriterScheduler.java scales tasks while
+    #: buffered bytes outpace the running writers)
+    SCALE_UP_BACKLOG = 2
+    MAX_WRITERS = 4
+
     def append(self, arrays: Dict[str, np.ndarray]) -> int:
         n = len(next(iter(arrays.values()))) if arrays else 0
         if n == 0:
             return 0
-        idx = len(self._shards())
-        path = os.path.join(self.dir, f"shard_{idx:06d}.ptsh")
-        write_shard(path, {c: arrays[c] for c in self.schema}, self.schema)
+        pages = -(-n // self.WRITER_PAGE_ROWS)
+        if pages <= 1:
+            idx = len(self._shards())
+            path = os.path.join(self.dir, f"shard_{idx:06d}.ptsh")
+            write_shard(path, {c: arrays[c] for c in self.schema},
+                        self.schema)
+            self.last_writers_used = 1
+            self._invalidate()
+            return n
+        self._scaled_append(arrays, n, pages)
         self._invalidate()
         return n
+
+    def _scaled_append(self, arrays, n: int, pages: int) -> None:
+        """P4 scaled-writer redistribution, local adaptation (reference:
+        execution/scheduler/ScaledWriterScheduler.java — writer tasks
+        start at one and scale up while the produced-page backlog
+        outpaces the active writers).  Here the writers are shard-writer
+        threads; each page becomes one shard file, so the readers'
+        split/stripe machinery parallelizes the read back."""
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue()
+        base = len(self._shards())
+        for p in range(pages):
+            lo = p * self.WRITER_PAGE_ROWS
+            hi = min(n, lo + self.WRITER_PAGE_ROWS)
+            q.put((base + p, lo, hi))
+        errors: List[BaseException] = []
+
+        def writer():
+            while True:
+                try:
+                    idx, lo, hi = q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    path = os.path.join(self.dir,
+                                        f"shard_{idx:06d}.ptsh")
+                    write_shard(path, {c: arrays[c][lo:hi]
+                                       for c in self.schema}, self.schema)
+                except BaseException as e:  # surfaced to the caller
+                    errors.append(e)
+                finally:
+                    q.task_done()
+
+        threads = [threading.Thread(target=writer, daemon=True)]
+        threads[0].start()
+        # scale-up loop: add a writer while the backlog stays above
+        # SCALE_UP_BACKLOG pages per active writer
+        while not q.empty() and len(threads) < self.MAX_WRITERS:
+            if q.qsize() > self.SCALE_UP_BACKLOG * len(threads):
+                t = threading.Thread(target=writer, daemon=True)
+                t.start()
+                threads.append(t)
+            else:
+                break
+        q.join()
+        for t in threads:
+            t.join(timeout=60.0)
+        self.last_writers_used = len(threads)
+        if errors:
+            raise errors[0]
 
     def delete_where(self, keep_mask: np.ndarray) -> int:
         """Rewrite shards keeping only masked rows (reference: Raptor

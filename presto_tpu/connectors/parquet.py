@@ -1,0 +1,137 @@
+"""Parquet connector: tables over .parquet files on local disk.
+
+Reference parity: presto-hive's ParquetPageSourceFactory +
+presto-parquet readers (the Raptor-style "directory of files is a
+table" model the localfile connector already uses).  The decoder/encoder
+live in storage/parquet.py — in-engine, no external parquet library;
+splits map to row groups so the scan path parallelizes like the
+reference's Parquet stripes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.catalog import ConnectorTable
+from presto_tpu.storage.parquet import ParquetFile, write_parquet
+
+
+class ParquetTable(ConnectorTable):
+    """A .parquet file, or a directory of them with one schema."""
+
+    def __init__(self, name: str, path: str,
+                 schema: Optional[Dict[str, T.Type]] = None):
+        self.path = path
+        if schema is None:
+            files = self._files()
+            if not files:
+                raise FileNotFoundError(f"no parquet files under {path}")
+            f0 = ParquetFile(files[0])
+            schema = {c.name: c.sql_type() for c in f0.columns}
+        elif not os.path.isdir(path):
+            os.makedirs(path, exist_ok=True)
+        super().__init__(name, schema)
+
+    # -- layout --------------------------------------------------------
+    def _files(self) -> List[str]:
+        if os.path.isfile(self.path):
+            return [self.path]
+        if not os.path.isdir(self.path):
+            return []
+        return sorted(
+            os.path.join(self.path, p) for p in os.listdir(self.path)
+            if p.endswith(".parquet"))
+
+    def _readers(self) -> List[ParquetFile]:
+        paths = tuple(self._files())
+        cached = getattr(self, "_reader_cache", None)
+        if cached is None or cached[0] != paths:
+            self._reader_cache = (paths, [ParquetFile(p) for p in paths])
+        return self._reader_cache[1]
+
+    def _invalidate(self):
+        self._reader_cache = None
+        super()._invalidate()  # device-column cache + catalog version
+
+    # -- metadata ------------------------------------------------------
+    def row_count(self) -> int:
+        return sum(f.num_rows for f in self._readers())
+
+    def splits(self, n_splits: int) -> List[Tuple[int, int]]:
+        # row-group boundaries are the natural split grain (reference:
+        # ParquetPageSourceFactory planning one split per row group)
+        edges = [0]
+        for f in self._readers():
+            for rg in f.row_groups:
+                edges.append(edges[-1] + rg[3])  # RowGroup.num_rows
+        if len(edges) <= 1:
+            return []
+        targets = np.linspace(0, edges[-1], n_splits + 1)
+        # snap to row-group boundaries, keeping splits non-empty
+        snapped = sorted({min(edges, key=lambda e: abs(e - t))
+                          for t in targets})
+        if snapped[0] != 0:
+            snapped.insert(0, 0)
+        if snapped[-1] != edges[-1]:
+            snapped.append(edges[-1])
+        return [(a, b) for a, b in zip(snapped[:-1], snapped[1:]) if a < b]
+
+    # -- read path -----------------------------------------------------
+    def read(self, columns=None, split=None) -> Dict[str, np.ndarray]:
+        cols = columns if columns is not None else list(self.schema)
+        a, b = split if split is not None else (0, self.row_count())
+        parts: Dict[str, list] = {c: [] for c in cols}
+        base = 0
+        for f in self._readers():
+            bycol = {c.name: c for c in f.columns}
+            for gi, rg in enumerate(f.row_groups):
+                n = rg[3]
+                lo, hi = max(base, a), min(base + n, b)
+                if lo < hi:
+                    s0, s1 = lo - base, hi - base
+                    for c in cols:
+                        vals, valid, _t = f.read_column(gi, bycol[c])
+                        seg = vals[s0:s1]
+                        if valid is not None:
+                            seg = np.ma.masked_array(
+                                seg, mask=~valid[s0:s1])
+                        parts[c].append(seg)
+                base += n
+        out = {}
+        for c in cols:
+            ps = parts[c]
+            if not ps:
+                t = self.schema[c]
+                out[c] = np.empty(0, object if t.is_string
+                                  else t.numpy_dtype())
+            elif any(isinstance(p, np.ma.MaskedArray) for p in ps):
+                out[c] = np.ma.concatenate(ps)
+            else:
+                out[c] = np.concatenate(ps)
+        return out
+
+    # -- write path (reference: the hive connector's parquet sink) ----
+    def append(self, arrays: Dict[str, np.ndarray]) -> int:
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        if n == 0:
+            return 0
+        if os.path.isfile(self.path):
+            raise ValueError(
+                "single-file parquet table is read-only; register a "
+                "directory to INSERT")
+        os.makedirs(self.path, exist_ok=True)
+        idx = len(self._files())
+        out = os.path.join(self.path, f"part_{idx:06d}.parquet")
+        write_parquet(out, {c: arrays[c] for c in self.schema},
+                      self.schema)
+        self._invalidate()
+        return n
+
+    def drop_data(self) -> None:
+        if os.path.isdir(self.path):
+            for p in self._files():
+                os.remove(p)

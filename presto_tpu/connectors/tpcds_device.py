@@ -37,9 +37,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 import jax.numpy as jnp
-import numpy as np
 
-from presto_tpu import types as T
 from presto_tpu.batch import Column
 from presto_tpu.connectors import tpcds as DS
 from presto_tpu.connectors.tpch_device import _mix

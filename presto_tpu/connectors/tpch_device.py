@@ -32,7 +32,6 @@ from __future__ import annotations
 import itertools
 from typing import Dict, List, Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -43,7 +43,6 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from presto_tpu.batch import Batch, Column
 from presto_tpu.exec import kernels as K
@@ -578,8 +577,15 @@ class _FragmentRunner:
         cap = 1 << max(16, (4 * max(n0, 1)).bit_length())
         cap = min(cap, out0.sel.shape[0])
         if n0 + cap * (grid.nchunks - 1) > budget:
-            # fixed-cap buffering would blow HBM; per-chunk exact
-            # compaction (with its incremental budget bail-out) instead
+            # fixed-cap buffering of every chunk would blow HBM: fold
+            # chunks into a bounded on-device accumulator instead —
+            # still pipelined, peak HBM ~ chunk working set + cap + A
+            # (round-3 VERDICT item 4; the per-chunk syncing loop
+            # remains the fallback when the accumulator can't apply)
+            r = self._chunk_loop_accumulate(frag, jitted, res_list, grid,
+                                            budget, cap, out0, g0, ov0)
+            if r is not None:
+                return r
             return self._chunk_loop_syncing(
                 jitted, res_list, grid, budget,
                 prefix=[part0], guards=[g0], overflows=[ov0], start=1)
@@ -654,6 +660,96 @@ class _FragmentRunner:
             cached = self._jit[key] = (jax.jit(sharded), ids)
         jitted, ids = cached
         return jitted, ids, _MeshGridView(grid, mesh_n)
+
+    def _chunk_loop_accumulate(self, frag, jitted, res_list, grid,
+                               budget, cap, out0, g0, ov0):
+        """Pipelined chunk loop with a BOUNDED on-device accumulator:
+        each chunk's output compacts to a fixed `cap` and scatters into
+        one A-row buffer at a running offset — no per-chunk host sync,
+        no cap x nchunks buffering.  A grows geometrically (re-running
+        the loop) until the live total fits or the budget is hit.
+        Returns None when the shape can't accumulate (per-chunk
+        dictionaries differ) so the caller falls back."""
+        from presto_tpu.exec.executor import _compact_batch
+
+        ckey = ("compact", frag.fid, cap)
+        cjit = self._jit.get(ckey)
+        if cjit is None:
+            def cfn(b):
+                return _compact_batch(b, cap), jnp.sum(b.sel)
+
+            cjit = self._jit[ckey] = jax.jit(cfn)
+        part0, cnt0 = cjit(out0)
+        dicts0 = {name: c.dictionary for name, c in part0.columns.items()}
+
+        A = max(4 * cap, 1 << 20)
+        while True:
+            A = min(A, budget)
+            fkey = ("fold", frag.fid, cap, A)
+            fjit = self._jit.get(fkey)
+            if fjit is None:
+                A_ = A
+
+                def fold(acc, n, part):
+                    live = part.sel
+                    pos = n + jnp.cumsum(live.astype(jnp.int32)) - 1
+                    # overflowing rows land in the dump slot A (caught
+                    # by the final count check, then A grows)
+                    idx = jnp.where(live & (pos < A_), pos,
+                                    A_).astype(jnp.int32)
+                    cols = {}
+                    for name, c in part.columns.items():
+                        a = acc.columns[name]
+                        data = a.data.at[idx].set(c.data)
+                        cv = c.valid if c.valid is not None else \
+                            jnp.ones((c.data.shape[0],), bool)
+                        valid = a.valid.at[idx].set(cv)
+                        cols[name] = Column(data, valid, c.type,
+                                            c.dictionary)
+                    n2 = n + jnp.sum(live, dtype=jnp.int32)
+                    return Batch(cols, acc.sel), n2
+
+                fjit = self._jit[fkey] = jax.jit(
+                    fold, donate_argnums=(0, 1))
+
+            def empty_acc():
+                cols = {}
+                for name, c in part0.columns.items():
+                    shape = (A + 1,) + tuple(c.data.shape[1:])
+                    cols[name] = Column(
+                        jnp.zeros(shape, c.data.dtype),
+                        jnp.zeros((A + 1,), bool), c.type, c.dictionary)
+                return Batch(cols, jnp.zeros((A + 1,), bool))
+
+            acc, n = fjit(empty_acc(), jnp.int32(0), part0)
+            guards = [g0]
+            overflows = [ov0]
+            cap_over = []  # a later chunk outgrew chunk-0's calibration
+            for i in range(1, grid.nchunks):
+                out, guard, ov = jitted(res_list, grid.chunk_args(i))
+                part, cnt = cjit(out)
+                if any(part.columns[name].dictionary is not d
+                       for name, d in dicts0.items()):
+                    return None  # unstable dictionaries: caller falls back
+                guards.append(guard)
+                overflows.append(ov)
+                cap_over.append(cnt > cap)
+                acc, n = fjit(acc, n, part)
+            n_host = int(n)
+            if cap_over and bool(jnp.any(jnp.stack(cap_over))):
+                return None  # recalibrate via the exact syncing loop
+            if bool(jnp.any(jnp.stack(overflows))):
+                raise _CompactOverflow
+            if bool(jnp.any(jnp.stack(guards))):
+                raise Unchunkable("static guard tripped in chunk loop")
+            if n_host <= A:
+                sel = jnp.arange(A + 1) < n_host
+                out_cols = {name: c for name, c in acc.columns.items()}
+                return Batch(out_cols, sel)
+            if A >= budget:
+                raise Unchunkable(
+                    f"accumulator exceeds budget ({n_host} rows)")
+            A *= 4  # grown accumulator, re-run the loop
 
     def _chunk_loop_syncing(self, jitted, res_list, grid, budget,
                             prefix=None, guards=None, overflows=None,

@@ -12,7 +12,6 @@ from typing import Dict
 
 import jax.numpy as jnp
 
-from presto_tpu import types as T
 from presto_tpu.batch import Batch
 from presto_tpu.exec.colval import ColVal, LambdaVal
 from presto_tpu.functions import scalar as scalar_fns
@@ -20,10 +19,15 @@ from presto_tpu.plan import ir
 
 
 class EvalContext:
-    """Carries scalar-subquery results (python scalars) into evaluation."""
+    """Carries scalar-subquery results (python scalars) into evaluation,
+    plus (in compiled mode) the executor's runtime-guard list so
+    expression-level overflow checks can abort the compiled program to
+    the dynamic path, which raises properly."""
 
-    def __init__(self, scalar_results: Dict[int, tuple] | None = None):
+    def __init__(self, scalar_results: Dict[int, tuple] | None = None,
+                 guards: list | None = None):
         self.scalar_results = scalar_results or {}  # plan_id -> (value, valid)
+        self.guards = guards  # Executor.guards in static mode, else None
 
 
 def eval_expr(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> ColVal:
@@ -52,7 +56,8 @@ def eval_expr(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> ColVal:
             return ColVal(v, None if valid else False, expr.type)
         return ColVal(v, valid, expr.type)  # traced 0-d value (distributed)
     if isinstance(expr, ir.CastExpr):
-        return scalar_fns.emit_cast(eval_expr(expr.arg, batch, ctx), expr.type, expr.safe)
+        return scalar_fns.emit_cast(eval_expr(expr.arg, batch, ctx), expr.type, expr.safe,
+                                    guards=ctx.guards)
     if isinstance(expr, ir.Call):
         args = [LambdaVal(a.params, a.param_types, a.body, ctx, a.type)
                 if isinstance(a, ir.LambdaExpr)
@@ -86,13 +91,15 @@ def to_column(v: ColVal, capacity: int):
             limbs = jnp.asarray(D128.from_host_int(int(data)))
             data = jnp.broadcast_to(limbs, (capacity, 2))
             return Column(data, _expand_valid(v.valid, capacity), v.type)
-        if isinstance(data, str):
-            # string literal column: single-entry dictionary
+        if isinstance(data, (str, bytes)):
+            # string/varbinary literal column: single-entry dictionary
             import numpy as np
 
             from presto_tpu.batch import Dictionary
 
-            d = Dictionary(np.asarray([data], dtype=object))
+            vals = np.empty(1, dtype=object)
+            vals[0] = data
+            d = Dictionary(vals)
             data = jnp.zeros((capacity,), dtype=jnp.int32)
             valid = _expand_valid(v.valid, capacity)
             return Column(data, valid, v.type, d)

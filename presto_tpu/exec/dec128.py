@@ -174,28 +174,19 @@ def scale_down_round(a: jnp.ndarray, k: int) -> jnp.ndarray:
         return scale_up(a, -k)
     sign_neg = a[..., HI] < 0
     mag = jnp.where(sign_neg[..., None], neg(a), a)
-    total_rem = None
-    total_div = 1
-    rem_f = None
+    # the step chain discards least-significant digits first, so with
+    # rem = r_last*prev_div + rem_prev and rem_prev < prev_div,
+    # 2*rem >= total_div  <=>  2*r_last >= c_last: half-away rounding
+    # needs ONLY the final step's remainder — exact at every k, no
+    # wide-remainder arithmetic required
+    r = jnp.zeros_like(a[..., HI])
+    c = 1
     while k > 0:
         step = min(k, 9)
         c = 10 ** step
         mag, r = _divmod_small_nonneg(mag, c)
-        # exact combined remainder while it fits int64 (k <= 18); the
-        # f64 shadow carries the (rare) deeper shifts approximately
-        if total_rem is None:
-            total_rem = r
-            rem_f = r.astype(jnp.float64)
-        else:
-            total_rem = r * total_div + total_rem \
-                if total_div * c <= 10 ** 18 else total_rem
-            rem_f = r.astype(jnp.float64) * float(total_div) + rem_f
-        total_div *= c
         k -= step
-    if total_div <= 10 ** 18:
-        round_up = (2 * total_rem >= total_div)
-    else:
-        round_up = (rem_f >= float(total_div) / 2.0)
+    round_up = 2 * r >= c
     mag = jnp.where(round_up[..., None],
                     add(mag, from_int64(jnp.ones_like(mag[..., HI]))), mag)
     return jnp.where(sign_neg[..., None], neg(mag), mag)

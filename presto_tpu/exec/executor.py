@@ -24,9 +24,7 @@ from presto_tpu import types as T
 from presto_tpu.batch import (Batch, Column, batch_from_numpy,
                               decode_host_column, to_numpy)
 from presto_tpu.exec import kernels as K
-from presto_tpu.exec.colval import ColVal
 from presto_tpu.exec.compiler import EvalContext, eval_expr, eval_predicate, to_column
-from presto_tpu.functions import scalar as scalar_fns
 from presto_tpu.plan import ir
 from presto_tpu.plan import nodes as P
 from presto_tpu.plan.optimizer import optimize
@@ -298,6 +296,22 @@ def _create_table(session, name, schema, properties, arrays):
                                                 "presto_tpu_tables")),
             name)
         t = LocalFileTable(name, directory, schema)
+        session.catalog.register(t)
+        if arrays is not None:
+            t.append(arrays)
+        return
+    if connector == "parquet":
+        import tempfile
+
+        from presto_tpu.connectors.parquet import ParquetTable
+
+        directory = properties.get("path") or properties.get(
+            "directory") or os.path.join(
+            session.properties.get("localfile_root",
+                                   os.path.join(tempfile.gettempdir(),
+                                                "presto_tpu_tables")),
+            name)
+        t = ParquetTable(name, directory, schema)
         session.catalog.register(t)
         if arrays is not None:
             t.append(arrays)
@@ -636,13 +650,22 @@ def explain_query(session, text: str, analyze: bool = False) -> str:
 
 
 class Executor:
+    # index joins assume whole-table natural-order build batches; sharded
+    # executors (DistExecutor, cluster FragmentExecutor) re-split scans
+    # and must turn this off (the layout guard would catch it anyway, at
+    # the cost of a spurious whole-query dynamic fallback)
+    allow_index_join = True
+
     def __init__(self, session, static: bool = False, scan_inputs=None,
                  monitor=None, mem=None):
         self.session = session
-        self.ctx = EvalContext()
         self.static = static  # compiled mode: no host syncs, static shapes
         self.scan_inputs = scan_inputs  # {node id: Batch} traced jit args
         self.guards = []  # traced bools: True => static assumption violated
+        # static mode: expression-level overflow checks (decimal casts)
+        # append to the SAME guard list, so a violation aborts the
+        # compiled program to the dynamic path, which raises properly
+        self.ctx = EvalContext(guards=self.guards if static else None)
         self.monitor = monitor  # QueryMonitor collecting per-node stats
         # memory accounting: only for monitored (top-level) executions —
         # helper executors (subplan eval, CTAS materialization) must not
@@ -663,6 +686,8 @@ class Executor:
 
     # ------------------------------------------------------------------
     def run(self, plan: P.QueryPlan) -> QueryResult:
+        if self.monitor is not None:
+            self.monitor.plan = plan  # rendered at finish (UI plan pane)
         try:
             batch = self.evaluate(plan)
             return self.materialize(plan, batch)
@@ -1506,6 +1531,149 @@ class Executor:
                 x = x * x
             s = K.segment_sum(jnp.where(valid, x, 0.0), gid, n_groups)
             return Column(s, nonempty, T.DOUBLE)
+        if a.fn in ("corr", "covar_samp", "covar_pop", "regr_slope",
+                    "regr_intercept"):
+            # bivariate family from co-moment segment sums (reference:
+            # operator/aggregation/{Corr,Covar,Regr}*Aggregation over
+            # CovarianceState: n, meanX, meanY, c2 — same moments,
+            # vectorized).  Presto argument order is (y, x).
+            yv = to_column(eval_expr(a.args[1], b, self.ctx), b.capacity)
+            both = valid if yv.valid is None else (valid & yv.valid)
+
+            def f64(c):
+                d = jnp.asarray(c.data).astype(jnp.float64)
+                return d / (10 ** c.type.decimal_scale) \
+                    if c.type.is_decimal else d
+
+            y = jnp.where(both, f64(col), 0.0)
+            x = jnp.where(both, f64(yv), 0.0)
+            n = K.segment_sum(both.astype(jnp.int32), gid,
+                              n_groups).astype(jnp.float64)
+            sx = K.segment_sum(x, gid, n_groups)
+            sy = K.segment_sum(y, gid, n_groups)
+            sxy = K.segment_sum(x * y, gid, n_groups)
+            sxx = K.segment_sum(x * x, gid, n_groups)
+            syy = K.segment_sum(y * y, gid, n_groups)
+            n1 = jnp.maximum(n, 1.0)
+            covp = sxy / n1 - (sx / n1) * (sy / n1)
+            varx = jnp.maximum(sxx / n1 - (sx / n1) ** 2, 0.0)
+            vary = jnp.maximum(syy / n1 - (sy / n1) ** 2, 0.0)
+            if a.fn == "covar_pop":
+                return Column(covp, n > 0, T.DOUBLE)
+            if a.fn == "covar_samp":
+                r = covp * n / jnp.maximum(n - 1.0, 1.0)
+                return Column(r, n > 1, T.DOUBLE)
+            if a.fn == "corr":
+                denom = jnp.sqrt(varx * vary)
+                r = covp / jnp.maximum(denom, 1e-300)
+                return Column(r, (n > 1) & (denom > 0), T.DOUBLE)
+            slope = covp / jnp.maximum(varx, 1e-300)
+            if a.fn == "regr_slope":
+                return Column(slope, (n > 1) & (varx > 0), T.DOUBLE)
+            icept = sy / n1 - slope * (sx / n1)
+            return Column(icept, (n > 1) & (varx > 0), T.DOUBLE)
+        if a.fn in ("skewness", "kurtosis"):
+            # central moments from raw power sums (reference:
+            # CentralMomentsAggregation over CentralMomentsState)
+            x = jnp.where(valid, col.data.astype(jnp.float64), 0.0)
+            n = jnp.maximum(cnt, 1).astype(jnp.float64)
+            s1 = K.segment_sum(x, gid, n_groups)
+            s2 = K.segment_sum(x * x, gid, n_groups)
+            s3 = K.segment_sum(x ** 3, gid, n_groups)
+            mu = s1 / n
+            m2 = jnp.maximum(s2 - n * mu * mu, 0.0)
+            if a.fn == "skewness":
+                m3 = s3 - 3 * mu * s2 + 2 * n * mu ** 3
+                sd2 = m2 / jnp.maximum(n - 1.0, 1.0)
+                r = n / jnp.maximum((n - 1) * (n - 2), 1.0) \
+                    * m3 / jnp.maximum(sd2 ** 1.5, 1e-300)
+                return Column(r, (cnt > 2) & (m2 > 0), T.DOUBLE)
+            s4 = K.segment_sum(x ** 4, gid, n_groups)
+            m4 = s4 - 4 * mu * s3 + 6 * mu * mu * s2 - 3 * n * mu ** 4
+            sd2 = m2 / jnp.maximum(n - 1.0, 1.0)
+            d = jnp.maximum((n - 1) * (n - 2) * (n - 3), 1.0)
+            r = n * (n + 1) / d * m4 / jnp.maximum(sd2 * sd2, 1e-300) \
+                - 3.0 * (n - 1) ** 2 / jnp.maximum((n - 2) * (n - 3), 1.0)
+            return Column(r, (cnt > 3) & (m2 > 0), T.DOUBLE)
+        if a.fn == "entropy":
+            # entropy of empirical distribution from count weights
+            # (reference: EntropyAggregation): log2(S) - sum(c*log2 c)/S
+            c = jnp.where(valid, col.data.astype(jnp.float64), 0.0)
+            c = jnp.maximum(c, 0.0)
+            s = K.segment_sum(c, gid, n_groups)
+            clogc = K.segment_sum(
+                jnp.where(c > 0, c * jnp.log2(jnp.maximum(c, 1e-300)), 0.0),
+                gid, n_groups)
+            r = jnp.where(s > 0,
+                          jnp.log2(jnp.maximum(s, 1e-300)) - clogc
+                          / jnp.maximum(s, 1e-300), 0.0)
+            return Column(r, nonempty, T.DOUBLE)
+        if a.fn in ("bitwise_and_agg", "bitwise_or_agg"):
+            # per-bit segment min/max over an (n, 64) bit plane — ONE
+            # segment op (reference: BitwiseAndAggregation/
+            # BitwiseOrAggregation's running long)
+            xi = jnp.asarray(col.data).astype(jnp.int64)
+            shifts = jnp.arange(64, dtype=jnp.int64)
+            bits = ((xi[:, None] >> shifts[None, :]) & 1).astype(jnp.int32)
+            if a.fn == "bitwise_and_agg":
+                bits = jnp.where(valid[:, None], bits, 1)
+                red = K.segment_min(bits, gid, n_groups)
+            else:
+                bits = jnp.where(valid[:, None], bits, 0)
+                red = K.segment_max(bits, gid, n_groups)
+            r = jnp.sum(red.astype(jnp.int64) << shifts[None, :], axis=1)
+            return Column(r, nonempty, T.BIGINT)
+        if a.fn in ("histogram", "numeric_histogram", "map_union"):
+            # ragged MAP output, host-side like map_agg (reference:
+            # Histogram / NumericHistogramAggregation / MapUnionAggregation)
+            if self.static:
+                raise StaticFallback(f"{a.fn} is dynamic-mode only")
+            gidh = np.asarray(gid)
+            vh = np.asarray(valid)
+            data = np.asarray(col.data)
+            if col.dictionary is not None:
+                data = col.dictionary.values[
+                    np.clip(data, 0, len(col.dictionary) - 1)]
+            if a.fn == "numeric_histogram":
+                nb_v = eval_expr(a.args[0], b, self.ctx)
+                nb = int(nb_v.data if getattr(nb_v.data, "ndim", 0) == 0
+                         else np.asarray(nb_v.data)[0])
+                vcol = to_column(eval_expr(a.args[1], b, self.ctx),
+                                 b.capacity)
+                vvh = mask if vcol.valid is None else \
+                    np.asarray(mask & vcol.valid)
+                vdata = np.asarray(vcol.data).astype(np.float64)
+                if vcol.type.is_decimal:
+                    vdata = vdata / (10 ** vcol.type.decimal_scale)
+                tuples = np.empty(n_groups, dtype=object)
+                for g in range(n_groups):
+                    vals = np.sort(vdata[(gidh == g) & vvh])
+                    if not len(vals):
+                        tuples[g] = ()
+                        continue
+                    bins = np.array_split(vals, max(min(nb, len(vals)), 1))
+                    tuples[g] = tuple(sorted(
+                        (float(np.mean(bin_)), float(len(bin_)))
+                        for bin_ in bins if len(bin_)))
+                return _tuples_to_dict_column(tuples, nonempty, a.type)
+            groups = [dict() for _ in range(n_groups)]
+            for row in np.flatnonzero(vh):
+                g = int(gidh[row])
+                if not (0 <= g < n_groups):
+                    continue
+                v = data[row]
+                v = v.item() if hasattr(v, "item") else v
+                if isinstance(v, np.str_):
+                    v = str(v)
+                if a.fn == "histogram":
+                    groups[g][v] = groups[g].get(v, 0) + 1
+                else:  # map_union: v is a map value (tuple of pairs)
+                    for k, mv in v:
+                        groups[g].setdefault(k, mv)
+            tuples = np.empty(n_groups, dtype=object)
+            tuples[:] = [tuple(sorted(g.items(), key=lambda p: repr(p[0])))
+                         for g in groups]
+            return _tuples_to_dict_column(tuples, nonempty, a.type)
         raise ExecutionError(f"aggregate {a.fn} not implemented")
 
     def _merge_agg_column(self, b: Batch, a: ir.AggCall, gid, n_groups,
@@ -1582,6 +1750,32 @@ class Executor:
             return self._cross_join(left, right, node)
         if jt == "FULL":
             return self._full_join(left, right, node)
+        if right.capacity == 0 and jt in ("INNER", "LEFT", "SEMI",
+                                          "ANTI", "MARK"):
+            # zero-capacity build (e.g. an empty side an outer join must
+            # preserve): no row matches, and gathers into zero-length
+            # arrays are not representable — emit the no-match result
+            # shape directly
+            if jt == "SEMI":
+                return left.with_sel(jnp.zeros_like(left.sel))
+            if jt == "ANTI":
+                return left
+            merged = dict(left.columns)
+            if jt == "MARK":
+                # x IN (empty) is FALSE, never NULL, for every probe
+                merged[node.mark] = Column(
+                    jnp.zeros((left.capacity,), bool), None, T.BOOLEAN,
+                    None)
+                return Batch(merged, left.sel)
+            never = jnp.zeros((left.capacity,), bool)
+            for name, t in node.right.outputs():
+                c = right.columns[name]
+                shape = (left.capacity,) + tuple(c.data.shape[1:])
+                merged[name] = Column(jnp.zeros(shape, c.data.dtype),
+                                      never, t, c.dictionary)
+            if jt == "INNER":
+                return Batch(merged, never)
+            return Batch(merged, left.sel)  # LEFT: all rows, NULL right
         lkeys = [left.columns[lk] for lk, _ in node.criteria]
         rkeys = [right.columns[rk] for _, rk in node.criteria]
         lkeys, rkeys = _unify_key_dictionaries(lkeys, rkeys)
@@ -1595,7 +1789,40 @@ class Executor:
         for c in rkeys:
             if c.valid is not None:
                 rsel = rsel & c.valid
-        if self.static:
+        # P10 index join: dense unique build key -> the probe is ONE
+        # gather at position key - key_min, no sorts at all (hint from
+        # plan/optimizer._index_lookup_info).  The identity layout
+        # (row i holds key min+i) only holds when the build batch is the
+        # WHOLE table in natural order — sharded executors re-split
+        # scans (allow_index_join=False there), and a build-side layout
+        # verification catches everything else: a guard in static mode,
+        # a host check (fall back to the sort join) in dynamic mode.
+        il = getattr(node, "index_lookup", None)
+        use_index = (il is not None and self.allow_index_join
+                     and len(lkeys) == 1
+                     and right.capacity == il["rows"]
+                     and lkeys[0].dictionary is None
+                     and rkeys[0].dictionary is None
+                     and getattr(lkeys[0].data, "ndim", 1) == 1)
+        index_ridx = None
+        if use_index:
+            kmin, nrows = il["min"], il["rows"]
+            rk_arr = jnp.asarray(rkeys[0].data).astype(jnp.int64)
+            expect = kmin + jnp.arange(nrows, dtype=jnp.int64)
+            layout_ok = ~jnp.any(rsel & (rk_arr != expect))
+            if self.static:
+                self.guards.append(~layout_ok)
+            elif not bool(layout_ok):
+                use_index = False
+        if use_index:
+            lk = jnp.asarray(lkeys[0].data).astype(jnp.int64)
+            pos = jnp.clip(lk - kmin, 0, nrows - 1).astype(jnp.int32)
+            in_range = (lk >= kmin) & (lk < kmin + nrows)
+            rkd = jnp.asarray(rkeys[0].data)[pos].astype(jnp.int64)
+            found_idx = lsel & in_range & rsel[pos] & (rkd == lk)
+            counts = found_idx.astype(jnp.int32)
+            index_ridx = pos
+        elif self.static:
             # compile-time layout from stats/dictionaries (shared ranges
             # across both sides); unknown ranges -> sync-free 64-bit hash
             key_stats = getattr(node, "key_stats", {})
@@ -1612,12 +1839,36 @@ class Executor:
         else:
             rkey, layout = K.pack_keys(rkeys, rsel, extra_cols=lkeys)
             lkey = K.pack_with_layout(lkeys, lsel, layout)
-        order, lb, ub = K.build_probe(rkey, lkey)
-        counts = ub - lb
+        if index_ridx is None:
+            order, lb, ub = K.build_probe(rkey, lkey)
+            counts = ub - lb
 
         if jt == "MARK":  # filter-free by construction (planner)
+            # Presto semiJoinOutput NULL semantics: TRUE on match;
+            # without a match the mark is NULL (not FALSE) when the
+            # probe key is NULL or the build side contains any NULL —
+            # `x NOT IN (sub)` must then filter the row, not keep it
+            # (reference: SemiJoinNode / MarkDistinct null handling)
             merged = dict(left.columns)
-            merged[node.mark] = Column(counts > 0, None, T.BOOLEAN, None)
+            found = counts > 0
+            lvalid = None
+            for c in lkeys:
+                if c.valid is not None:
+                    v_ = jnp.asarray(c.valid)
+                    lvalid = v_ if lvalid is None else (lvalid & v_)
+            rnull = None
+            for c in rkeys:
+                if c.valid is not None:
+                    has = jnp.any(right.sel & ~jnp.asarray(c.valid))
+                    rnull = has if rnull is None else (rnull | has)
+            if lvalid is None and rnull is None:
+                mvalid = None  # keys can't be NULL: mark is 2-valued
+            else:
+                ok = jnp.ones_like(found) if lvalid is None else lvalid
+                if rnull is not None:
+                    ok = ok & ~rnull
+                mvalid = found | ok
+            merged[node.mark] = Column(found, mvalid, T.BOOLEAN, None)
             return Batch(merged, left.sel)
 
         if jt in ("SEMI", "ANTI") and node.filter is None:
@@ -1625,7 +1876,10 @@ class Executor:
             sel = left.sel & (found if jt == "SEMI" else ~found)
             return left.with_sel(sel)
 
-        if self.static:
+        if index_ridx is not None:
+            max_matches = 1  # dense unique build: at most one match,
+            # no guard and (in dynamic mode) no max-count host sync
+        elif self.static:
             if getattr(node, "build_unique", False):
                 max_matches = 1
                 if counts.shape[0]:
@@ -1644,8 +1898,11 @@ class Executor:
 
         if max_matches <= 1 and jt in ("INNER", "LEFT", "SEMI", "ANTI"):
             found = counts > 0
-            match_pos = jnp.clip(lb, 0, max(order.shape[0] - 1, 0))
-            ridx = order[match_pos]
+            if index_ridx is not None:
+                ridx = index_ridx
+            else:
+                match_pos = jnp.clip(lb, 0, max(order.shape[0] - 1, 0))
+                ridx = order[match_pos]
             rbatch = K.gather_batch(right, ridx, idx_valid=found)
             merged = dict(left.columns)
             merged.update(rbatch.columns)

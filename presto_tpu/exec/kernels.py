@@ -27,7 +27,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from presto_tpu import types as T
 from presto_tpu.batch import Batch, Column, Dictionary
 from presto_tpu.exec.colval import translate_codes
 
@@ -845,7 +844,11 @@ def sort_perm(batch: Batch, keys: List[Tuple[Column, bool, Optional[bool]]]):
                 else jnp.ones(col.data.shape[0], bool)
             for d in D128.sort_operands(jnp.asarray(col.data)):
                 if not asc:
-                    d = jnp.where(d == I64_MIN, I64_MAX, -d)
+                    # bitwise NOT is an exact order-reversing bijection
+                    # on int64 (negation maps both I64_MIN and
+                    # I64_MIN+1 to I64_MAX: low-limb ties would
+                    # misorder DESC)
+                    d = ~d
                 operands.append(jnp.where(v1, d, null_sent))
             continue
         d = _orderable_int(col)

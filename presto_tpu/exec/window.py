@@ -26,7 +26,7 @@ compaction: dead rows produce garbage outputs that stay masked.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List
 
 import jax
 import jax.numpy as jnp

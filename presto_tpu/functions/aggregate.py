@@ -11,9 +11,8 @@ AggregationNode.Step) works on the intermediate columns declared here.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List
 
-import jax.numpy as jnp
 
 from presto_tpu import types as T
 
@@ -63,8 +62,31 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         return T.DOUBLE
     if name in ("bool_and", "bool_or", "every"):
         return T.BOOLEAN
-    if name in ("corr", "covar_samp", "covar_pop"):
+    if name in ("corr", "covar_samp", "covar_pop", "regr_slope",
+                "regr_intercept"):
         return T.DOUBLE
+    if name in ("skewness", "kurtosis"):
+        if not arg_types[0].is_numeric:
+            raise TypeError(f"{name} over {arg_types[0]}")
+        return T.DOUBLE
+    if name == "entropy":
+        if not arg_types[0].is_numeric:
+            raise TypeError(f"entropy over {arg_types[0]}")
+        return T.DOUBLE
+    if name in ("bitwise_and_agg", "bitwise_or_agg"):
+        if not arg_types[0].is_integer:
+            raise TypeError(f"{name} over {arg_types[0]}")
+        return T.BIGINT
+    if name == "histogram":
+        return T.map_of(arg_types[0], T.BIGINT)
+    if name == "numeric_histogram":
+        if len(arg_types) != 2:
+            raise TypeError("numeric_histogram takes (buckets, value)")
+        return T.map_of(T.DOUBLE, T.DOUBLE)
+    if name == "map_union":
+        if arg_types[0].name != "MAP":
+            raise TypeError("map_union takes a MAP argument")
+        return arg_types[0]
     if name == "approx_percentile":
         if len(arg_types) != 2:
             raise TypeError("approx_percentile takes (value, percentile)")
@@ -109,6 +131,9 @@ AGG_NAMES = {
     "covar_pop", "approx_percentile", "checksum", "min_by", "max_by",
     "geometric_mean", "array_agg", "map_agg", "multimap_agg",
     "approx_set", "merge", "qdigest_agg",
+    "regr_slope", "regr_intercept", "skewness", "kurtosis", "entropy",
+    "bitwise_and_agg", "bitwise_or_agg", "histogram", "numeric_histogram",
+    "map_union",
 }
 
 

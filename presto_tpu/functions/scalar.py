@@ -15,7 +15,7 @@ explicitly.
 from __future__ import annotations
 
 import re
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +26,6 @@ from presto_tpu.batch import Dictionary
 from presto_tpu.exec.colval import (
     ColVal,
     all_valid,
-    and_valid,
     normalize_dictionary,
     translate_codes,
 )
@@ -1099,7 +1098,8 @@ register("least")((_resolve_coalesce, _emit_fold(jnp.minimum)))
 # ---- cast -----------------------------------------------------------------
 
 
-def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool) -> ColVal:
+def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool,
+                       guards=None) -> ColVal:
     from presto_tpu.exec import dec128 as D128
 
     frm = v.type
@@ -1129,9 +1129,15 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool) -> ColVal:
             if to.is_floating:
                 return ColVal(float(d), v.valid, to)
             if to.is_integer:
-                return ColVal(int(d.quantize(
-                    Decimal(1), rounding=ROUND_HALF_UP,
-                    context=_hp)), v.valid, to)
+                iv = int(d.quantize(Decimal(1), rounding=ROUND_HALF_UP,
+                                    context=_hp))
+                if not -(1 << 63) <= iv < (1 << 63):
+                    if safe:
+                        return ColVal(0, False, to)
+                    raise ValueError(
+                        f"DECIMAL overflow: CAST {frm} -> {to} value "
+                        "does not fit an integer")
+                return ColVal(iv, v.valid, to)
             if to.is_string:
                 return ColVal(str(d), v.valid, to)
             raise NotImplementedError(f"CAST {frm} -> {to}")
@@ -1153,10 +1159,16 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool) -> ColVal:
             if safe:
                 valid = fits if valid is None else (jnp.asarray(valid)
                                                     & fits)
-            elif not isinstance(fits, jax.core.Tracer):
+            else:
                 live = fits if v.valid is None \
                     else fits | ~jnp.asarray(v.valid)
-                if not bool(jnp.all(live)):
+                if isinstance(fits, jax.core.Tracer):
+                    # compiled mode cannot raise at trace time: a guard
+                    # aborts the compiled program to the dynamic path,
+                    # which re-evaluates eagerly and raises properly
+                    if guards is not None:
+                        guards.append(~jnp.all(live))
+                elif not bool(jnp.all(live)):
                     raise ValueError(
                         f"DECIMAL overflow: CAST {frm} -> {to} value "
                         "does not fit a short decimal")
@@ -1166,8 +1178,25 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool) -> ColVal:
             return ColVal(r.astype(to.numpy_dtype()), v.valid, to)
         if to.is_integer:
             r = D128.scale_down_round(a, s)
+            # rounded magnitude may exceed int64: taking the low limb
+            # alone would silently wrap (reference raises on overflow)
+            fits = r[..., D128.HI] == (r[..., D128.LO] >> 63)
+            valid = v.valid
+            if safe:
+                valid = fits if valid is None else (jnp.asarray(valid)
+                                                    & fits)
+            else:
+                live = fits if v.valid is None \
+                    else fits | ~jnp.asarray(v.valid)
+                if isinstance(fits, jax.core.Tracer):
+                    if guards is not None:  # see the short-decimal arm
+                        guards.append(~jnp.all(live))
+                elif not bool(jnp.all(live)):
+                    raise ValueError(
+                        f"DECIMAL overflow: CAST {frm} -> {to} value "
+                        "does not fit an integer")
             return ColVal(r[..., D128.LO].astype(to.numpy_dtype()),
-                          v.valid, to)
+                          valid, to)
         if to.is_string:
             if isinstance(a, jax.core.Tracer):
                 raise NotImplementedError(
@@ -1364,7 +1393,8 @@ def _render_varchar(x, frm: T.Type) -> str:
     raise NotImplementedError(f"CAST {frm} -> VARCHAR")
 
 
-def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
+def emit_cast(v: ColVal, to: T.Type, safe: bool = False,
+              guards=None) -> ColVal:
     frm = v.type
     if frm == to:
         return v
@@ -1553,7 +1583,7 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False) -> ColVal:
             valid = (~bad) if valid is None else (jnp.asarray(valid) & ~bad)
         return emit_cast(ColVal(data, valid, T.DOUBLE), to, safe)
     if to.is_decimal or frm.is_decimal:
-        return _emit_cast_decimal(v, to, safe)
+        return _emit_cast_decimal(v, to, safe, guards=guards)
     if frm == T.UNKNOWN:
         # typed NULL
         return ColVal(jnp.zeros(jnp.asarray(v.data).shape, _np_dtype(to))
@@ -2993,3 +3023,7 @@ def _register_sketch_fns():
 
 
 _register_sketch_fns()
+
+# round-4 breadth: the extended batch registers on import (kept in its
+# own module to keep this file navigable)
+from presto_tpu.functions import scalar_ext as _scalar_ext  # noqa: E402,F401

@@ -1,0 +1,1177 @@
+"""Extended scalar function batch (round-4 breadth push).
+
+Reference parity: the long tail of presto-main/.../operator/scalar/ —
+MathFunctions' trig/probability surface, StringFunctions' distance
+family, re2j RegexpFunctions, VarbinaryFunctions + HmacFunctions,
+UrlFunctions, DateTimeFunctions' Joda field/format surface and the
+Teradata compatibility shims (to_char/to_date).  Same conventions as
+scalar.py: dictionary-encoded strings transform on host over UNIQUE
+dictionary values (never per row), numeric kernels are jnp elementwise,
+strict null propagation unless noted.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import datetime as _dt
+import hashlib
+import hmac as _hmac
+import math
+import re
+import struct
+import unicodedata
+import urllib.parse as _url
+import zlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Dictionary
+from presto_tpu.exec.colval import ColVal, all_valid, normalize_dictionary
+from presto_tpu.functions.scalar import (
+    _as_string_literal,
+    _host_string_pred,
+    _str_transform,
+    _tuple_dict_normalize,
+    civil_from_days,
+    days_from_civil,
+    register,
+)
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _f64(v):
+    return jnp.asarray(v.data).astype(jnp.float64)
+
+
+def _math1d(name, fn):
+    """1-arg numeric -> DOUBLE."""
+    return (lambda args: T.DOUBLE if args[0].is_numeric else None,
+            lambda args: ColVal(fn(_f64(args[0])), args[0].valid, T.DOUBLE))
+
+
+def _mathNd(name, n, fn, valid_fn=None):
+    """n-arg numeric -> DOUBLE elementwise."""
+
+    def resolve(args):
+        if len(args) == n and all(a.is_numeric for a in args):
+            return T.DOUBLE
+        return None
+
+    def emit(args):
+        xs = [_f64(a) for a in args]
+        out = fn(*xs)
+        valid = all_valid(*args)
+        if valid_fn is not None:
+            ok = valid_fn(*xs)
+            valid = ok if valid is None else (valid & ok)
+        return ColVal(out, valid, T.DOUBLE)
+
+    return resolve, emit
+
+
+def _pred1d(name, fn):
+    """1-arg floating -> BOOLEAN."""
+    return (lambda args: T.BOOLEAN if args[0].is_numeric else None,
+            lambda args: ColVal(fn(_f64(args[0])), args[0].valid, T.BOOLEAN))
+
+
+def _const(name, value, typ=T.DOUBLE):
+    return (lambda args: typ if not args else None,
+            lambda args: ColVal(value, None, typ))
+
+
+def _obj_dict_normalize(values: np.ndarray, codes: ColVal,
+                        out_type: T.Type) -> ColVal:
+    """normalize_dictionary for non-str dictionary values (bytes,
+    tuples): sorted-unique by natural order, codes remapped."""
+    uniq = sorted(set(values.tolist()))
+    code_map = {v: i for i, v in enumerate(uniq)}
+    inverse = np.fromiter((code_map[v] for v in values.tolist()),
+                          np.int32, len(values))
+    lut = jnp.asarray(inverse)
+    new_codes = lut[jnp.clip(codes.data, 0, max(len(values) - 1, 0))]
+    u = np.empty(len(uniq), dtype=object)
+    u[:] = uniq
+    return ColVal(new_codes, codes.valid, out_type, Dictionary(u))
+
+
+def _host_transform_typed(col: ColVal, fn, out_type: T.Type) -> ColVal:
+    """Dictionary transform whose outputs are bytes/objects (VARBINARY)
+    or strings, normalized appropriately."""
+    vals = np.empty(len(col.dictionary), dtype=object)
+    vals[:] = [fn(v) for v in col.dictionary.values]
+    cv = ColVal(col.data, col.valid, out_type)
+    if out_type.name == "VARBINARY":
+        return _obj_dict_normalize(vals, cv, out_type)
+    return normalize_dictionary(vals, cv)
+
+
+def _str_fn(name, fn, out_type=T.VARCHAR, in_name=None):
+    """1-string-arg function over dictionary values; scalars fold."""
+
+    def resolve(args):
+        if len(args) != 1 or not args[0].is_string:
+            return None
+        if in_name is not None and args[0].name != in_name:
+            return None
+        return out_type
+
+    def emit(args):
+        col = args[0]
+        lit = col.data if col.is_scalar and isinstance(
+            col.data, (str, bytes)) else None
+        if lit is not None:
+            return ColVal(fn(lit), col.valid, out_type)
+        if out_type.is_string:
+            return _host_transform_typed(col, fn, out_type)
+        if out_type == T.BOOLEAN:
+            return _host_string_pred(col, fn)
+        lut = jnp.asarray(np.asarray(
+            [fn(v) for v in col.dictionary.values],
+            dtype=out_type.numpy_dtype()))
+        data = lut[jnp.clip(col.data, 0, len(col.dictionary) - 1)]
+        return ColVal(data, col.valid, out_type)
+
+    return resolve, emit
+
+
+def _str2_fn(name, fn, out_type):
+    """2-string-arg function: literal x literal, column x literal,
+    literal x column, and dictionary x dictionary via the value cross
+    product (bounded)."""
+
+    def resolve(args):
+        if len(args) == 2 and all(a.is_string for a in args):
+            return out_type
+        return None
+
+    def emit(args):
+        a, b = args
+        la = a.data if a.is_scalar and isinstance(a.data, (str, bytes)) \
+            else None
+        lb = b.data if b.is_scalar and isinstance(b.data, (str, bytes)) \
+            else None
+        valid = all_valid(a, b)
+        if la is not None and lb is not None:
+            return ColVal(fn(la, lb), valid, out_type)
+
+        def via_lut(col, f1):
+            vals = [f1(v) for v in col.dictionary.values]
+            if out_type.is_string:
+                o = np.empty(len(vals), dtype=object)
+                o[:] = vals
+                r = _obj_dict_normalize(o, ColVal(col.data, valid,
+                                                  out_type), out_type) \
+                    if out_type.name == "VARBINARY" else \
+                    normalize_dictionary(o, ColVal(col.data, valid,
+                                                   out_type))
+                return r
+            lut = jnp.asarray(np.asarray(vals,
+                                         dtype=out_type.numpy_dtype()))
+            d = lut[jnp.clip(col.data, 0, len(col.dictionary) - 1)]
+            return ColVal(d, valid, out_type)
+
+        if lb is not None:
+            return via_lut(a, lambda v: fn(v, lb))
+        if la is not None:
+            return via_lut(b, lambda v: fn(la, v))
+        if a.dictionary is not None and b.dictionary is not None \
+                and len(a.dictionary) * len(b.dictionary) <= (1 << 18):
+            av = a.dictionary.values
+            bv = b.dictionary.values
+            nb = len(bv)
+            vals = [fn(x, y) for x in av for y in bv]
+            codes = jnp.clip(a.data, 0, len(av) - 1) * nb \
+                + jnp.clip(b.data, 0, nb - 1)
+            cv = ColVal(codes, valid, out_type)
+            if out_type.is_string:
+                o = np.empty(len(vals), dtype=object)
+                o[:] = vals
+                return _obj_dict_normalize(o, cv, out_type) \
+                    if out_type.name == "VARBINARY" else \
+                    normalize_dictionary(o, cv)
+            lut = jnp.asarray(np.asarray(vals,
+                                         dtype=out_type.numpy_dtype()))
+            return ColVal(lut[codes], valid, out_type)
+        raise NotImplementedError(
+            f"{name} over non-dictionary string columns")
+
+    return resolve, emit
+
+
+# ---------------------------------------------------------------------------
+# math: trig/hyperbolic/conversions
+# ---------------------------------------------------------------------------
+
+register("sin")(_math1d("sin", jnp.sin))
+register("cos")(_math1d("cos", jnp.cos))
+register("tan")(_math1d("tan", jnp.tan))
+register("asin")(_math1d("asin", jnp.arcsin))
+register("acos")(_math1d("acos", jnp.arccos))
+register("atan")(_math1d("atan", jnp.arctan))
+register("sinh")(_math1d("sinh", jnp.sinh))
+register("cosh")(_math1d("cosh", jnp.cosh))
+register("tanh")(_math1d("tanh", jnp.tanh))
+register("cbrt")(_math1d("cbrt", jnp.cbrt))
+register("degrees")(_math1d("degrees", jnp.degrees))
+register("radians")(_math1d("radians", jnp.radians))
+register("log2")(_math1d("log2", jnp.log2))
+register("is_nan")(_pred1d("is_nan", jnp.isnan))
+register("is_finite")(_pred1d("is_finite", jnp.isfinite))
+register("is_infinite")(_pred1d("is_infinite", jnp.isinf))
+register("infinity")(_const("infinity", float("inf")))
+register("nan")(_const("nan", float("nan")))
+
+
+def _resolve_mod(args):
+    if len(args) == 2 and all(a.is_numeric for a in args):
+        if all(a.is_integer for a in args):
+            return T.common_super_type(*args)
+        return T.DOUBLE
+    return None
+
+
+def _emit_mod(args):
+    a, b = args
+    t = _resolve_mod([a.type, b.type])
+    x = jnp.asarray(a.data)
+    y = jnp.asarray(b.data)
+    if t.is_integer:
+        r = (x - jnp.trunc(
+            x.astype(jnp.float64) / jnp.where(y == 0, 1, y)
+        ).astype(x.dtype) * y).astype(t.numpy_dtype())
+        # fmod sign semantics on ints without float rounding at scale:
+        r = x % jnp.where(y == 0, 1, y)
+        r = jnp.where((r != 0) & ((r < 0) != (x < 0)), r - y, r)
+        valid = all_valid(a, b)
+        ok = y != 0
+        valid = ok if valid is None else (valid & ok)
+        return ColVal(r.astype(t.numpy_dtype()), valid, t)
+    r = jnp.fmod(x.astype(jnp.float64), y.astype(jnp.float64))
+    return ColVal(r, all_valid(a, b), T.DOUBLE)
+
+
+register("mod")((_resolve_mod, _emit_mod))
+
+
+def _bit_count_emit(args):
+    x = jnp.asarray(args[0].data).astype(jnp.int64)
+    bits = 64 if len(args) < 2 else int(np.asarray(args[1].data))
+    if bits < 64:
+        x = x & ((1 << bits) - 1)
+        # sign bit of the narrowed width counts as set for negatives
+    cnt = jnp.sum(((x[..., None] >> jnp.arange(64, dtype=jnp.int64)) & 1),
+                  axis=-1)
+    return ColVal(cnt.astype(jnp.int64), args[0].valid, T.BIGINT)
+
+
+register("bit_count")((
+    lambda args: T.BIGINT if args and args[0].is_integer else None,
+    _bit_count_emit))
+register("bitwise_logical_shift_right")((
+    lambda args: T.BIGINT if len(args) == 2 else None,
+    lambda args: ColVal(
+        jnp.asarray(
+            (np.uint64 if False else jnp.asarray(args[0].data)
+             .astype(jnp.uint64)) >> jnp.asarray(args[1].data)
+            .astype(jnp.uint64)).astype(jnp.int64),
+        all_valid(*args), T.BIGINT)))
+register("bitwise_arithmetic_shift_right")((
+    lambda args: T.BIGINT if len(args) == 2 else None,
+    lambda args: ColVal(
+        jnp.asarray(args[0].data).astype(jnp.int64)
+        >> jnp.asarray(args[1].data).astype(jnp.int64),
+        all_valid(*args), T.BIGINT)))
+
+
+# probability CDFs (reference: operator/scalar/MathFunctions.java's
+# *_cdf / inverse_*_cdf family) — closed forms + jax.scipy specials
+from jax.scipy import special as _sp  # noqa: E402
+
+register("normal_cdf")(_mathNd(
+    "normal_cdf", 3,
+    lambda mean, sd, v: 0.5 * (1.0 + _sp.erf((v - mean)
+                                             / (sd * math.sqrt(2.0)))),
+    valid_fn=lambda mean, sd, v: sd > 0))
+register("inverse_normal_cdf")(_mathNd(
+    "inverse_normal_cdf", 3,
+    lambda mean, sd, p: mean + sd * math.sqrt(2.0) * _sp.erfinv(2 * p - 1),
+    valid_fn=lambda mean, sd, p: (sd > 0) & (p > 0) & (p < 1)))
+register("cauchy_cdf")(_mathNd(
+    "cauchy_cdf", 3,
+    lambda med, sc, v: jnp.arctan((v - med) / sc) / jnp.pi + 0.5,
+    valid_fn=lambda med, sc, v: sc > 0))
+register("inverse_cauchy_cdf")(_mathNd(
+    "inverse_cauchy_cdf", 3,
+    lambda med, sc, p: med + sc * jnp.tan(jnp.pi * (p - 0.5)),
+    valid_fn=lambda med, sc, p: (sc > 0) & (p > 0) & (p < 1)))
+register("laplace_cdf")(_mathNd(
+    "laplace_cdf", 3,
+    lambda mean, sc, v: jnp.where(
+        v < mean, 0.5 * jnp.exp((v - mean) / sc),
+        1.0 - 0.5 * jnp.exp(-(v - mean) / sc)),
+    valid_fn=lambda mean, sc, v: sc > 0))
+register("logistic_cdf")(_mathNd(
+    "logistic_cdf", 3,
+    lambda mean, sc, v: 1.0 / (1.0 + jnp.exp(-(v - mean) / sc)),
+    valid_fn=lambda mean, sc, v: sc > 0))
+register("weibull_cdf")(_mathNd(
+    "weibull_cdf", 3,
+    lambda a, b, v: 1.0 - jnp.exp(-jnp.power(jnp.maximum(v, 0.0) / b, a)),
+    valid_fn=lambda a, b, v: (a > 0) & (b > 0)))
+register("poisson_cdf")(_mathNd(
+    "poisson_cdf", 2,
+    lambda lam, k: _sp.gammaincc(jnp.floor(k) + 1.0, lam),
+    valid_fn=lambda lam, k: (lam > 0) & (k >= 0)))
+register("chi_squared_cdf")(_mathNd(
+    "chi_squared_cdf", 2,
+    lambda df, v: _sp.gammainc(df / 2.0, v / 2.0),
+    valid_fn=lambda df, v: (df > 0) & (v >= 0)))
+register("gamma_cdf")(_mathNd(
+    "gamma_cdf", 3,
+    lambda shape, scale, v: _sp.gammainc(shape, v / scale),
+    valid_fn=lambda shape, scale, v: (shape > 0) & (scale > 0) & (v >= 0)))
+register("beta_cdf")(_mathNd(
+    "beta_cdf", 3,
+    lambda a, b, v: _sp.betainc(a, b, jnp.clip(v, 0.0, 1.0)),
+    valid_fn=lambda a, b, v: (a > 0) & (b > 0) & (v >= 0) & (v <= 1)))
+register("binomial_cdf")(_mathNd(
+    "binomial_cdf", 3,
+    lambda n, p, s: jnp.where(
+        s >= n, 1.0, jnp.where(
+            s < 0, 0.0,
+            _sp.betainc(jnp.maximum(n - jnp.floor(s), 1.0),
+                        jnp.floor(s) + 1.0, 1.0 - p))),
+    valid_fn=lambda n, p, s: (n > 0) & (p >= 0) & (p <= 1)))
+register("f_cdf")(_mathNd(
+    "f_cdf", 3,
+    lambda d1, d2, v: _sp.betainc(d1 / 2, d2 / 2,
+                                  d1 * v / (d1 * v + d2)),
+    valid_fn=lambda d1, d2, v: (d1 > 0) & (d2 > 0) & (v >= 0)))
+register("wilson_interval_lower")(_mathNd(
+    "wilson_interval_lower", 3,
+    lambda s, n, z: (s + z * z / 2 - z * jnp.sqrt(
+        jnp.maximum(s * (n - s) / n + z * z / 4, 0.0))) / (n + z * z),
+    valid_fn=lambda s, n, z: (n > 0) & (s >= 0) & (s <= n) & (z > 0)))
+register("wilson_interval_upper")(_mathNd(
+    "wilson_interval_upper", 3,
+    lambda s, n, z: (s + z * z / 2 + z * jnp.sqrt(
+        jnp.maximum(s * (n - s) / n + z * z / 4, 0.0))) / (n + z * z),
+    valid_fn=lambda s, n, z: (n > 0) & (s >= 0) & (s <= n) & (z > 0)))
+
+
+def _from_base(v, radix):
+    return int(str(v).strip(), int(radix))
+
+
+def _to_base(x, radix):
+    x = int(x)
+    radix = int(radix)
+    if x == 0:
+        return "0"
+    digs = "0123456789abcdefghijklmnopqrstuvwxyz"
+    neg = x < 0
+    x = abs(x)
+    out = []
+    while x:
+        out.append(digs[x % radix])
+        x //= radix
+    return ("-" if neg else "") + "".join(reversed(out))
+
+
+register("from_base")((_str_transform("from_base", _from_base, T.BIGINT)))
+
+
+def _emit_to_base(args):
+    x = args[0]
+    radix = int(np.asarray(args[1].data))
+    data = np.asarray(x.data)
+    if data.ndim == 0:
+        return ColVal(_to_base(int(data), radix), x.valid, T.VARCHAR)
+    uniq, inv = np.unique(data, return_inverse=True)
+    vals = np.asarray([_to_base(int(u), radix) for u in uniq],
+                      dtype=object)
+    return normalize_dictionary(
+        vals, ColVal(jnp.asarray(inv.astype(np.int32)), x.valid,
+                     T.VARCHAR))
+
+
+register("to_base")((
+    lambda args: T.VARCHAR if len(args) == 2 and args[0].is_integer
+    else None, _emit_to_base))
+
+
+# ---------------------------------------------------------------------------
+# string distance / shaping
+# ---------------------------------------------------------------------------
+
+
+def _levenshtein(a, b):
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[-1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def _hamming(a, b):
+    if len(a) != len(b):
+        raise ValueError("hamming_distance: equal lengths required")
+    return sum(x != y for x, y in zip(a, b))
+
+
+register("levenshtein_distance")(
+    _str2_fn("levenshtein_distance", _levenshtein, T.BIGINT))
+register("hamming_distance")(
+    _str2_fn("hamming_distance", _hamming, T.BIGINT))
+register("jaccard_index")(_str2_fn(
+    "jaccard_index",
+    lambda a, b: (len(set(a) & set(b)) / len(set(a) | set(b)))
+    if (a or b) else 1.0, T.DOUBLE))
+
+
+def _translate(v, frm, to):
+    table = {}
+    for i, c in enumerate(str(frm)):
+        table[ord(c)] = str(to)[i] if i < len(str(to)) else None
+    return v.translate(table)
+
+
+register("translate")((_str_transform("translate", _translate)))
+register("normalize")((_str_transform(
+    "normalize", lambda v, form="NFC": unicodedata.normalize(
+        str(form), v))))
+register("soundex")((_str_transform("soundex", lambda v: _soundex(v))))
+
+
+def _soundex(v):
+    if not v:
+        return ""
+    v = v.upper()
+    codes = {"B": "1", "F": "1", "P": "1", "V": "1",
+             "C": "2", "G": "2", "J": "2", "K": "2", "Q": "2", "S": "2",
+             "X": "2", "Z": "2", "D": "3", "T": "3", "L": "4",
+             "M": "5", "N": "5", "R": "6"}
+    out = [v[0]]
+    last = codes.get(v[0], "")
+    for c in v[1:]:
+        code = codes.get(c, "")
+        if code and code != last:
+            out.append(code)
+        if c not in "HW":
+            last = code
+    return ("".join(out) + "000")[:4]
+
+
+register("from_utf8")((_str_fn(
+    "from_utf8", lambda v: (v if isinstance(v, bytes) else
+                            str(v).encode()).decode("utf-8", "replace"),
+    T.VARCHAR)))
+register("to_utf8")((_str_fn(
+    "to_utf8", lambda v: v.encode() if isinstance(v, str) else bytes(v),
+    T.VARBINARY)))
+
+
+# ---------------------------------------------------------------------------
+# regexp long tail (re2j RegexpFunctions)
+# ---------------------------------------------------------------------------
+
+
+def _rx(pattern):
+    return re.compile(str(pattern))
+
+
+def _regexp_count(v, pat):
+    return len(_rx(pat).findall(v))
+
+
+def _regexp_position(v, pat, start=1):
+    m = _rx(pat).search(v, int(start) - 1)
+    return -1 if m is None else m.start() + 1
+
+
+register("regexp_count")((_str_transform(
+    "regexp_count", _regexp_count, T.BIGINT)))
+register("regexp_position")((_str_transform(
+    "regexp_position", _regexp_position, T.BIGINT)))
+
+
+def _emit_regexp_array(fn_name, per_value):
+    def resolve(args):
+        if args and args[0].is_string:
+            return T.array_of(T.VARCHAR)
+        return None
+
+    def emit(args):
+        col = args[0]
+        extra = [np.asarray(a.data).item() if hasattr(a.data, "shape")
+                 and getattr(a.data, "ndim", 0) == 0 else a.data
+                 for a in args[1:]]
+        out_t = T.array_of(T.VARCHAR)
+        lit = _as_string_literal(col)
+        if lit is not None:
+            vals = np.empty(1, dtype=object)
+            vals[0] = tuple(per_value(lit, *extra))
+            return _tuple_dict_normalize(
+                vals, ColVal(jnp.asarray(0, jnp.int32), col.valid, out_t),
+                out_t)
+        vals = np.empty(len(col.dictionary), dtype=object)
+        vals[:] = [tuple(per_value(v, *extra))
+                   for v in col.dictionary.values]
+        return _tuple_dict_normalize(
+            vals, ColVal(col.data, col.valid, out_t), out_t)
+
+    return resolve, emit
+
+
+register("regexp_extract_all")(_emit_regexp_array(
+    "regexp_extract_all",
+    lambda v, pat, group=0: [m.group(int(group))
+                             for m in _rx(pat).finditer(v)]))
+register("regexp_split")(_emit_regexp_array(
+    "regexp_split", lambda v, pat: _rx(pat).split(v)))
+
+
+# ---------------------------------------------------------------------------
+# binary / codec / hashing (VarbinaryFunctions + HmacFunctions)
+# ---------------------------------------------------------------------------
+
+
+def _as_bytes(v):
+    return v if isinstance(v, bytes) else str(v).encode()
+
+
+def _bin_fn(name, fn, out_type=T.VARBINARY):
+    return _str_fn(name, lambda v: fn(_as_bytes(v)), out_type)
+
+
+register("to_hex")(_bin_fn("to_hex",
+                           lambda b: b.hex().upper(), T.VARCHAR))
+register("from_hex")(_str_fn(
+    "from_hex", lambda v: binascii.unhexlify(
+        v if isinstance(v, str) else v.decode()), T.VARBINARY))
+register("to_base64")(_bin_fn(
+    "to_base64", lambda b: base64.b64encode(b).decode(), T.VARCHAR))
+register("from_base64")(_str_fn(
+    "from_base64", lambda v: base64.b64decode(_as_bytes(v) + b"=="),
+    T.VARBINARY))
+register("to_base64url")(_bin_fn(
+    "to_base64url", lambda b: base64.urlsafe_b64encode(b).decode(),
+    T.VARCHAR))
+register("from_base64url")(_str_fn(
+    "from_base64url",
+    lambda v: base64.urlsafe_b64decode(_as_bytes(v) + b"=="),
+    T.VARBINARY))
+register("md5")(_bin_fn("md5", lambda b: hashlib.md5(b).digest()))
+register("sha1")(_bin_fn("sha1", lambda b: hashlib.sha1(b).digest()))
+register("sha256")(_bin_fn("sha256", lambda b: hashlib.sha256(b).digest()))
+register("sha512")(_bin_fn("sha512", lambda b: hashlib.sha512(b).digest()))
+register("crc32")(_bin_fn("crc32", lambda b: zlib.crc32(b) & 0xFFFFFFFF,
+                          T.BIGINT))
+
+
+def _xxh64(data: bytes, seed: int = 0) -> int:
+    """Pure-python xxHash64 (public domain algorithm)."""
+    P1, P2, P3 = 0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9
+    P4, P5 = 0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5
+    M = (1 << 64) - 1
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    n = len(data)
+    if n >= 32:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed & M
+        v4 = (seed - P1) & M
+        i = 0
+        while i <= n - 32:
+            for k, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 8 * k:i + 8 * k + 8],
+                                      "little")
+                v = (v + lane * P2) & M
+                v = rotl(v, 31)
+                v = (v * P1) & M
+                if k == 0:
+                    v1 = v
+                elif k == 1:
+                    v2 = v
+                elif k == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 32
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+        for v in (v1, v2, v3, v4):
+            v = (v * P2) & M
+            v = rotl(v, 31)
+            v = (v * P1) & M
+            h = ((h ^ v) * P1 + P4) & M
+    else:
+        h = (seed + P5) & M
+        i = 0
+    h = (h + n) & M
+    while i <= n - 8:
+        lane = int.from_bytes(data[i:i + 8], "little")
+        h ^= rotl((lane * P2) & M, 31) * P1 & M
+        h = (rotl(h, 27) * P1 + P4) & M
+        i += 8
+    if i <= n - 4:
+        h ^= (int.from_bytes(data[i:i + 4], "little") * P1) & M
+        h = (rotl(h, 23) * P2 + P3) & M
+        i += 4
+    while i < n:
+        h ^= (data[i] * P5) & M
+        h = (rotl(h, 11) * P1) & M
+        i += 1
+    h ^= h >> 33
+    h = (h * P2) & M
+    h ^= h >> 29
+    h = (h * P3) & M
+    h ^= h >> 32
+    return h
+
+
+def _signed64(u):
+    return u - (1 << 64) if u >= (1 << 63) else u
+
+
+register("xxhash64")(_bin_fn(
+    "xxhash64", lambda b: struct.pack(">q", _signed64(_xxh64(b)))))
+for _alg in ("md5", "sha1", "sha256", "sha512"):
+    register(f"hmac_{_alg}")(_str2_fn(
+        f"hmac_{_alg}",
+        (lambda alg: lambda v, key: _hmac.new(
+            _as_bytes(key), _as_bytes(v), alg).digest())(_alg),
+        T.VARBINARY))
+
+
+def _emit_int_to_bin(fmt, in_float=False):
+    def emit(args):
+        x = args[0]
+        data = np.asarray(x.data)
+        conv = (lambda u: struct.pack(fmt, u))
+        if data.ndim == 0:
+            return ColVal(conv(data.item()), x.valid, T.VARBINARY)
+        uniq, inv = np.unique(data, return_inverse=True)
+        vals = np.empty(len(uniq), dtype=object)
+        vals[:] = [conv(u.item()) for u in uniq]
+        return _obj_dict_normalize(
+            vals, ColVal(jnp.asarray(inv.astype(np.int32)), x.valid,
+                         T.VARBINARY), T.VARBINARY)
+
+    return emit
+
+
+register("to_big_endian_64")((
+    lambda args: T.VARBINARY if args and args[0].is_integer else None,
+    _emit_int_to_bin(">q")))
+register("to_big_endian_32")((
+    lambda args: T.VARBINARY if args and args[0].is_integer else None,
+    _emit_int_to_bin(">i")))
+register("to_ieee754_64")((
+    lambda args: T.VARBINARY if args and args[0].is_numeric else None,
+    _emit_int_to_bin(">d")))
+register("to_ieee754_32")((
+    lambda args: T.VARBINARY if args and args[0].is_numeric else None,
+    _emit_int_to_bin(">f")))
+register("from_big_endian_64")(_str_fn(
+    "from_big_endian_64",
+    lambda v: struct.unpack(">q", _as_bytes(v))[0], T.BIGINT,
+    in_name="VARBINARY"))
+register("from_big_endian_32")(_str_fn(
+    "from_big_endian_32",
+    lambda v: struct.unpack(">i", _as_bytes(v))[0], T.INTEGER,
+    in_name="VARBINARY"))
+register("from_ieee754_64")(_str_fn(
+    "from_ieee754_64",
+    lambda v: struct.unpack(">d", _as_bytes(v))[0], T.DOUBLE,
+    in_name="VARBINARY"))
+register("from_ieee754_32")(_str_fn(
+    "from_ieee754_32",
+    lambda v: struct.unpack(">f", _as_bytes(v))[0], T.REAL,
+    in_name="VARBINARY"))
+
+
+# ---------------------------------------------------------------------------
+# URL functions (operator/scalar/UrlFunctions.java)
+# ---------------------------------------------------------------------------
+
+
+def _url_part(part):
+    def fn(v):
+        u = _url.urlparse(v)
+        if part == "protocol":
+            return u.scheme
+        if part == "host":
+            return u.hostname or ""
+        if part == "path":
+            return u.path
+        if part == "query":
+            return u.query
+        if part == "fragment":
+            return u.fragment
+        raise KeyError(part)
+
+    return fn
+
+
+for _p in ("protocol", "host", "path", "query", "fragment"):
+    register(f"url_extract_{_p}")((_str_transform(
+        f"url_extract_{_p}", _url_part(_p))))
+register("url_extract_port")((_str_transform(
+    "url_extract_port",
+    lambda v: _url.urlparse(v).port or -1, T.BIGINT)))
+register("url_extract_parameter")((_str_transform(
+    "url_extract_parameter",
+    lambda v, name: (_url.parse_qs(_url.urlparse(v).query)
+                     .get(str(name), [""])[0]))))
+register("url_encode")((_str_transform(
+    "url_encode", lambda v: _url.quote_plus(v))))
+register("url_decode")((_str_transform(
+    "url_decode", lambda v: _url.unquote_plus(v))))
+
+
+# ---------------------------------------------------------------------------
+# datetime Joda surface (DateTimeFunctions.java)
+# ---------------------------------------------------------------------------
+
+
+def _ts_micros(v):
+    """TIMESTAMP int64 micros; DATE widens to midnight micros."""
+    d = jnp.asarray(v.data)
+    if v.type.name == "DATE":
+        return d.astype(jnp.int64) * 86_400_000_000
+    return d.astype(jnp.int64)
+
+
+def _time_field(name, fn):
+    return (lambda args: T.BIGINT if args and args[0].is_temporal
+            else None,
+            lambda args: ColVal(fn(_ts_micros(args[0])).astype(jnp.int64),
+                                args[0].valid, T.BIGINT))
+
+
+register("hour")(_time_field(
+    "hour", lambda us: (us // 3_600_000_000) % 24))
+register("minute")(_time_field(
+    "minute", lambda us: (us // 60_000_000) % 60))
+register("second")(_time_field(
+    "second", lambda us: (us // 1_000_000) % 60))
+register("millisecond")(_time_field(
+    "millisecond", lambda us: (us // 1_000) % 1000))
+register("timezone_hour")(_time_field(
+    "timezone_hour", lambda us: jnp.zeros_like(us)))  # engine is UTC
+register("timezone_minute")(_time_field(
+    "timezone_minute", lambda us: jnp.zeros_like(us)))
+
+
+def _days_of(v):
+    d = jnp.asarray(v.data)
+    if v.type.name == "TIMESTAMP":
+        return jnp.floor_divide(d, 86_400_000_000).astype(jnp.int64)
+    return d.astype(jnp.int64)
+
+
+def _date_field(name, fn):
+    return (lambda args: T.BIGINT if args and args[0].is_temporal
+            else None,
+            lambda args: ColVal(fn(_days_of(args[0])).astype(jnp.int64),
+                                args[0].valid, T.BIGINT))
+
+
+register("day_of_week")(_date_field(
+    "day_of_week", lambda days: ((days + 3) % 7) + 1))  # ISO Mon=1
+register("day_of_month")(_date_field(
+    "day_of_month", lambda days: civil_from_days(days)[2]))
+register("day_of_year")(_date_field(
+    "day_of_year",
+    lambda days: days - days_from_civil(civil_from_days(days)[0],
+                                        jnp.asarray(1),
+                                        jnp.asarray(1)) + 1))
+
+
+def _iso_week_year(days):
+    """ISO-8601 week number and week-year (Joda weekOfWeekyear /
+    weekyear)."""
+    dow = (days + 3) % 7  # 0 = Monday
+    thursday = days - dow + 3
+    y, _m, _d = civil_from_days(thursday)
+    jan1 = days_from_civil(y, jnp.asarray(1), jnp.asarray(1))
+    week = (thursday - jan1) // 7 + 1
+    return week, y
+
+
+register("week_of_year")(_date_field(
+    "week_of_year", lambda days: _iso_week_year(days)[0]))
+register("year_of_week")(_date_field(
+    "year_of_week", lambda days: _iso_week_year(days)[1]))
+register("yow")(_date_field(
+    "yow", lambda days: _iso_week_year(days)[1]))
+
+
+_MYSQL_FMT = {
+    "%Y": "%Y", "%y": "%y", "%m": "%m", "%c": "%-m", "%d": "%d",
+    "%e": "%-d", "%H": "%H", "%k": "%-H", "%i": "%M", "%s": "%S",
+    "%f": "%f", "%p": "%p", "%h": "%I", "%I": "%I", "%j": "%j",
+    "%a": "%a", "%W": "%A", "%M": "%B", "%b": "%b", "%T": "%H:%M:%S",
+    "%%": "%%",
+}
+
+
+def _mysql_to_strftime(fmt):
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "%" and i + 1 < len(fmt):
+            tok = fmt[i:i + 2]
+            out.append(_MYSQL_FMT.get(tok, tok[1]))
+            i += 2
+        else:
+            out.append(fmt[i])
+            i += 1
+    return "".join(out)
+
+
+_JODA_FMT = [
+    ("yyyy", "%Y"), ("yy", "%y"), ("MMMM", "%B"), ("MMM", "%b"),
+    ("MM", "%m"), ("M", "%-m"), ("dd", "%d"), ("d", "%-d"),
+    ("HH", "%H"), ("H", "%-H"), ("hh", "%I"), ("h", "%-I"),
+    ("mm", "%M"), ("m", "%-M"), ("ss", "%S"), ("s", "%-S"),
+    ("SSS", "%f"), ("a", "%p"), ("EEEE", "%A"), ("EEE", "%a"),
+    ("DDD", "%j"), ("ZZ", "+00:00"), ("Z", "+0000"),
+]
+
+
+def _joda_to_strftime(fmt):
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "'":
+            j = fmt.find("'", i + 1)
+            if j == i + 1:
+                out.append("'")
+                i += 2
+                continue
+            out.append(fmt[i + 1:j if j > 0 else len(fmt)])
+            i = (j if j > 0 else len(fmt)) + 1
+            continue
+        for tok, rep in _JODA_FMT:
+            if fmt.startswith(tok, i):
+                out.append(rep)
+                i += len(tok)
+                break
+        else:
+            out.append(fmt[i])
+            i += 1
+    return "".join(out)
+
+
+def _dt_of_micros(us):
+    return _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(us))
+
+
+def _strftime_portable(dtv, fmt):
+    # %-m style (no zero pad) is glibc-specific; expand manually
+    def sub(m):
+        c = m.group(1)
+        v = {"m": dtv.month, "d": dtv.day, "H": dtv.hour,
+             "I": (dtv.hour % 12) or 12, "M": dtv.minute,
+             "S": dtv.second}[c]
+        return str(v)
+
+    fmt = re.sub(r"%-([mdHIMS])", sub, fmt)
+    return dtv.strftime(fmt)
+
+
+def _emit_temporal_format(to_strftime):
+    def emit(args):
+        v = args[0]
+        fmt = to_strftime(str(np.asarray(args[1].data)
+                              if not isinstance(args[1].data, str)
+                              else args[1].data))
+        data = np.asarray(v.data)
+        us = data.astype(np.int64) * (86_400_000_000
+                                      if v.type.name == "DATE" else 1)
+        if us.ndim == 0:
+            return ColVal(_strftime_portable(_dt_of_micros(us), fmt),
+                          v.valid, T.VARCHAR)
+        uniq, inv = np.unique(us, return_inverse=True)
+        vals = np.asarray([_strftime_portable(_dt_of_micros(u), fmt)
+                           for u in uniq], dtype=object)
+        return normalize_dictionary(
+            vals, ColVal(jnp.asarray(inv.astype(np.int32)), v.valid,
+                         T.VARCHAR))
+
+    return emit
+
+
+register("date_format")((
+    lambda args: T.VARCHAR if len(args) == 2 and args[0].is_temporal
+    else None, _emit_temporal_format(_mysql_to_strftime)))
+register("format_datetime")((
+    lambda args: T.VARCHAR if len(args) == 2 and args[0].is_temporal
+    else None, _emit_temporal_format(_joda_to_strftime)))
+
+
+def _parse_to_micros(v, fmt):
+    d = _dt.datetime.strptime(str(v).strip(), fmt)
+    return int((d - _dt.datetime(1970, 1, 1)).total_seconds() * 1e6)
+
+
+register("date_parse")((_str_transform(
+    "date_parse",
+    lambda v, fmt: _parse_to_micros(v, _mysql_to_strftime(str(fmt))),
+    T.TIMESTAMP)))
+register("parse_datetime")((_str_transform(
+    "parse_datetime",
+    lambda v, fmt: _parse_to_micros(
+        v, _joda_to_strftime(str(fmt)).replace("+00:00", "%z")
+        .replace("+0000", "%z")),
+    T.TIMESTAMP)))
+register("from_iso8601_date")((_str_transform(
+    "from_iso8601_date",
+    lambda v: (_dt.date.fromisoformat(str(v))
+               - _dt.date(1970, 1, 1)).days, T.DATE)))
+register("from_iso8601_timestamp")((_str_transform(
+    "from_iso8601_timestamp",
+    lambda v: int((_dt.datetime.fromisoformat(
+        str(v).replace("Z", "+00:00")).replace(tzinfo=None)
+        - _dt.datetime(1970, 1, 1)).total_seconds() * 1e6),
+    T.TIMESTAMP)))
+
+
+def _emit_to_iso8601(args):
+    v = args[0]
+    data = np.asarray(v.data)
+    if v.type.name == "DATE":
+        conv = lambda x: (_dt.date(1970, 1, 1)
+                          + _dt.timedelta(days=int(x))).isoformat()
+    else:
+        conv = lambda x: _dt_of_micros(x).isoformat() + "Z"
+    if data.ndim == 0:
+        return ColVal(conv(data.item()), v.valid, T.VARCHAR)
+    uniq, inv = np.unique(data, return_inverse=True)
+    vals = np.asarray([conv(u) for u in uniq], dtype=object)
+    return normalize_dictionary(
+        vals, ColVal(jnp.asarray(inv.astype(np.int32)), v.valid,
+                     T.VARCHAR))
+
+
+register("to_iso8601")((
+    lambda args: T.VARCHAR if args and args[0].is_temporal else None,
+    _emit_to_iso8601))
+register("to_char")((
+    lambda args: T.VARCHAR if len(args) == 2 and args[0].is_temporal
+    else None, _emit_temporal_format(_joda_to_strftime)))
+register("to_date")((_str_transform(
+    "to_date",
+    lambda v, fmt: _parse_to_micros(v, _joda_to_strftime(str(fmt)))
+    // 86_400_000_000, T.DATE)))
+register("to_timestamp")((_str_transform(
+    "to_timestamp",
+    lambda v, fmt: _parse_to_micros(v, _joda_to_strftime(str(fmt))),
+    T.TIMESTAMP)))
+
+
+def _now_emit(args):
+    import time as _time
+
+    return ColVal(int(_time.time() * 1e6), None, T.TIMESTAMP)
+
+
+register("now")((lambda args: T.TIMESTAMP if not args else None,
+                 _now_emit))
+register("current_timestamp")((
+    lambda args: T.TIMESTAMP if not args else None, _now_emit))
+register("localtimestamp")((
+    lambda args: T.TIMESTAMP if not args else None, _now_emit))
+register("current_date")((
+    lambda args: T.DATE if not args else None,
+    lambda args: ColVal(
+        (_dt.date.today() - _dt.date(1970, 1, 1)).days, None, T.DATE)))
+register("current_timezone")((
+    lambda args: T.VARCHAR if not args else None,
+    lambda args: ColVal("UTC", None, T.VARCHAR)))
+
+
+def _parse_duration(v):
+    m = re.fullmatch(r"\s*([\d.]+)\s*(ns|us|ms|s|m|h|d)\s*", str(v))
+    if not m:
+        raise ValueError(f"invalid duration: {v}")
+    mult = {"ns": 1e-3, "us": 1.0, "ms": 1e3, "s": 1e6, "m": 6e7,
+            "h": 3.6e9, "d": 8.64e10}[m.group(2)]
+    return int(float(m.group(1)) * mult)
+
+
+register("parse_duration")((_str_transform(
+    "parse_duration", _parse_duration, T.INTERVAL_DAY_TIME)))
+register("to_milliseconds")((
+    lambda args: T.BIGINT if args
+    and args[0].name == "INTERVAL_DAY_TIME" else None,
+    lambda args: ColVal(jnp.asarray(args[0].data).astype(jnp.int64)
+                        // 1000, args[0].valid, T.BIGINT)))
+
+
+# ---------------------------------------------------------------------------
+# JSON long tail
+# ---------------------------------------------------------------------------
+
+
+def _json_array_get(v, idx):
+    import json as _json
+
+    try:
+        arr = _json.loads(v)
+        if not isinstance(arr, list):
+            return None
+        i = int(idx)
+        if i < 0:
+            i += len(arr)
+        if not 0 <= i < len(arr):
+            return None
+        e = arr[i]
+        return _json.dumps(e) if isinstance(e, (dict, list)) \
+            else (_json.dumps(e) if not isinstance(e, str) else e)
+    except ValueError:
+        return None
+
+
+def _json_array_contains(v, needle):
+    import json as _json
+
+    try:
+        arr = _json.loads(v)
+        return isinstance(arr, list) and needle in arr
+    except ValueError:
+        return False
+
+
+register("json_array_get")((_str_transform(
+    "json_array_get", _json_array_get, T.JSON)))
+
+
+def _emit_json_array_contains(args):
+    col, needle = args
+    nv = needle.data
+    if hasattr(nv, "item") and getattr(nv, "ndim", 0) == 0:
+        nv = nv.item()
+    if needle.type == T.BOOLEAN:
+        nv = bool(nv)
+    elif needle.type.is_integer:
+        nv = int(nv)
+    elif needle.type.is_floating:
+        nv = float(nv)
+    lit = _as_string_literal(col)
+    if lit is not None:
+        return ColVal(_json_array_contains(lit, nv), col.valid, T.BOOLEAN)
+    return _host_string_pred(col, lambda v: _json_array_contains(v, nv))
+
+
+register("json_array_contains")((
+    lambda args: T.BOOLEAN if len(args) == 2 and args[0].is_string
+    else None, _emit_json_array_contains))
+
+
+# ---------------------------------------------------------------------------
+# arrays long tail
+# ---------------------------------------------------------------------------
+
+
+def _array_transform(name, fn, resolve_out):
+    """Host transform over array-dictionary tuples."""
+
+    def resolve(args):
+        if args and args[0].name == "ARRAY":
+            return resolve_out(args[0])
+        return None
+
+    def emit(args):
+        col = args[0]
+        out_t = resolve_out(col.type)
+        vals = np.empty(len(col.dictionary), dtype=object)
+        vals[:] = [fn(t) for t in col.dictionary.values]
+        cv = ColVal(col.data, col.valid, out_t)
+        if out_t.name == "ARRAY":
+            return _tuple_dict_normalize(vals, cv, out_t)
+        if out_t == T.BOOLEAN:
+            lut = jnp.asarray(np.asarray([bool(x) for x in vals]))
+            return ColVal(lut[jnp.clip(col.data, 0,
+                                       len(col.dictionary) - 1)],
+                          col.valid, T.BOOLEAN)
+        lut_np = np.asarray([0 if x is None else x for x in vals],
+                            dtype=out_t.numpy_dtype())
+        miss = np.asarray([x is None for x in vals])
+        idx = jnp.clip(col.data, 0, len(col.dictionary) - 1)
+        data = jnp.asarray(lut_np)[idx]
+        mvalid = ~jnp.asarray(miss)[idx]
+        valid = mvalid if col.valid is None else (col.valid & mvalid)
+        return ColVal(data, valid, out_t)
+
+    return resolve, emit
+
+
+register("array_sum")(_array_transform(
+    "array_sum",
+    lambda t: sum(x for x in t if x is not None and
+                  isinstance(x, (int, float))),
+    lambda at: T.DOUBLE if at.params[0].is_floating else T.BIGINT))
+register("array_average")(_array_transform(
+    "array_average",
+    lambda t: (float(np.mean([x for x in t if x is not None]))
+               if any(x is not None for x in t) else None),
+    lambda at: T.DOUBLE))
+register("array_duplicates")(_array_transform(
+    "array_duplicates",
+    lambda t: tuple(sorted({x for x in t if t.count(x) > 1},
+                           key=repr)),
+    lambda at: at))
+register("array_has_duplicates")(_array_transform(
+    "array_has_duplicates",
+    lambda t: len(set(t)) != len(t),
+    lambda at: T.BOOLEAN))
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+def _emit_typeof(args):
+    return ColVal(str(args[0].type), None, T.VARCHAR)
+
+
+register("typeof")((lambda args: T.VARCHAR if len(args) == 1 else None,
+                    _emit_typeof))
+
+
+def _emit_concat_ws(args):
+    from presto_tpu.functions.scalar import _emit_concat
+
+    sep = args[0]
+    s = _as_string_literal(sep)
+    if s is None:
+        raise NotImplementedError("concat_ws with non-constant separator")
+    parts = []
+    for i, a in enumerate(args[1:]):
+        if i:
+            parts.append(ColVal(s, None, T.VARCHAR))
+        parts.append(a)
+    return _emit_concat(parts)
+
+
+register("concat_ws")((
+    lambda args: T.VARCHAR if len(args) >= 2
+    and all(a.is_string for a in args) else None, _emit_concat_ws))

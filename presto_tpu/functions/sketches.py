@@ -27,7 +27,6 @@ from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
 
-from presto_tpu import native
 
 HLL_M = 1024  # ~3.25% standard error (1.04/sqrt(m))
 QDIGEST_K = 200  # centroid budget (t-digest-like accuracy in the tails)

@@ -16,7 +16,7 @@ import dataclasses
 import itertools
 import time
 from contextlib import contextmanager
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional
 
 _query_ids = itertools.count(1)
 
@@ -52,6 +52,10 @@ class QueryStats:
     recovered_buckets: int = 0  # grouped-execution buckets loaded from ckpt
     # id(plan node) -> NodeStats; populated in dynamic mode
     node_stats: Dict[int, NodeStats] = dataclasses.field(default_factory=dict)
+    # rendered plan (annotated with per-node stats when collected) for
+    # the web UI's plan pane (reference: webapp plan.jsx consuming
+    # /v1/query/{id}?pretty)
+    plan_text: str = ""
 
     @property
     def total_ns(self) -> int:
@@ -113,6 +117,18 @@ class QueryMonitor:
 
         self.stats.state = "FINISHED"
         self.stats.end_time = time.time()
+        plan = getattr(self, "plan", None)
+        if plan is not None and not self.stats.plan_text:
+            try:
+                if self.stats.node_stats:
+                    self.stats.plan_text = annotated_plan(
+                        plan.root, plan.subplans, self.stats)
+                else:
+                    from presto_tpu.plan.nodes import plan_tree_str
+
+                    self.stats.plan_text = plan_tree_str(plan.root)
+            except Exception:
+                pass  # the plan pane is best-effort
         if not self.rows_preset:
             try:
                 self.stats.output_rows = len(result)

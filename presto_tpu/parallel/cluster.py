@@ -373,6 +373,15 @@ class TaskSpec:
     out_buckets: int = 1
     scalar_results: Dict[int, tuple] = dataclasses.field(default_factory=dict)
     properties: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # durable exchange (P12, reference: ExchangeNode.java:60
+    # REMOTE_MATERIALIZED): published pages ALSO persist under
+    # durable_dir/durable_key/a{attempt}/ — past acks, past task DELETE —
+    # until the query ends; a retry replays completed tasks from disk
+    # instead of re-executing them
+    durable_dir: Optional[str] = None
+    durable_key: Optional[str] = None  # f{fid}_w{windex}, attempt-stable
+    attempt: int = 0
+    replay: bool = False  # serve the durable pages; do not execute
 
 
 def _http(url: str, data: Optional[bytes] = None, method: str = "GET",
@@ -566,6 +575,10 @@ class _ClusterExecutor:
         spec = self.spec
 
         class FragmentExecutor(Executor):
+            # split-subset scans are not whole tables: the index join's
+            # natural-order layout assumption does not hold here
+            allow_index_join = False
+
             def _exec_tablescan(ex_self, node: P.TableScan) -> Batch:
                 if node.table in exch:
                     b = exch[node.table]
@@ -735,6 +748,11 @@ class WorkerServer:
                 f"{_SECRET_ENV} or pass secret=")
         self.session = presto_tpu.connect(make_catalog(catalog_spec))
         self.tasks: Dict[str, dict] = {}
+        # per-worker work accounting (served via /v1/info): `executed`
+        # counts fragment executions, `replayed` counts durable-page
+        # replays — the per-bucket-retry test's evidence that survivors
+        # re-execute ONLY the victim's work
+        self.counters = {"executed": 0, "replayed": 0}
         self.lock = threading.Lock()
         self.exec_lock = threading.Lock()
         handler = _make_worker_handler(self)
@@ -768,11 +786,64 @@ class WorkerServer:
                     "range_event": threading.Event()}
             self.tasks[spec.task_id] = task
 
+        key_dir = None
+        if getattr(spec, "durable_dir", None) and \
+                getattr(spec, "durable_key", None):
+            key_dir = os.path.join(spec.durable_dir, spec.durable_key)
+        attempt_dir = os.path.join(key_dir, f"a{spec.attempt}") \
+            if key_dir else None
+
         def publish(bucket: int, page: bytes):
             with self.lock:
                 task["pages"].setdefault(bucket, []).append(page)
+                seq = len(task["pages"][bucket]) - 1
+            if attempt_dir is not None:
+                # durable copy survives acks and task DELETE; tmp+rename
+                # so a torn write never reads as a page
+                bdir = os.path.join(attempt_dir, f"b{bucket}")
+                os.makedirs(bdir, exist_ok=True)
+                tmp = os.path.join(bdir, f".tmp{seq}")
+                with open(tmp, "wb") as f:
+                    f.write(page)
+                os.replace(tmp, os.path.join(bdir, f"{seq:06d}.page"))
+
+        def replay_dir():
+            """A prior attempt's completed durable output, or None."""
+            if key_dir is None or not os.path.isdir(key_dir):
+                return None
+            for a in sorted(os.listdir(key_dir)):
+                d = os.path.join(key_dir, a)
+                if os.path.exists(os.path.join(d, "_DONE")):
+                    return d
+            return None
 
         def run():
+            src = replay_dir() if getattr(spec, "replay", False) else None
+            if src is not None:
+                try:
+                    for b in sorted(os.listdir(src)):
+                        if not b.startswith("b"):
+                            continue
+                        bdir = os.path.join(src, b)
+                        for pf in sorted(os.listdir(bdir)):
+                            if pf.endswith(".page"):
+                                with open(os.path.join(bdir, pf),
+                                          "rb") as f:
+                                    page = f.read()
+                                with self.lock:
+                                    task["pages"].setdefault(
+                                        int(b[1:]), []).append(page)
+                    with self.lock:
+                        task["complete"] = True
+                        task["state"] = "FINISHED"
+                        self.counters["replayed"] += 1
+                    return
+                except OSError as e:
+                    with self.lock:
+                        task["error"] = f"replay failed: {e}"
+                        task["state"] = "FAILED"
+                        task["complete"] = True
+                    return
             try:
                 # tasks run CONCURRENTLY (producers stream to consumers
                 # on the same worker), so each task executes against a
@@ -787,9 +858,15 @@ class WorkerServer:
                         task_session.properties[k] = v
                 _ClusterExecutor(task_session, spec, publish=publish,
                                  task_state=task).run()
+                if attempt_dir is not None:
+                    os.makedirs(attempt_dir, exist_ok=True)
+                    with open(os.path.join(attempt_dir, "_DONE"),
+                              "wb"):
+                        pass  # marker AFTER every page is on disk
                 with self.lock:
                     task["complete"] = True
                     task["state"] = "FINISHED"
+                    self.counters["executed"] += 1
             except BaseException as e:  # noqa: BLE001 — reported to coordinator
                 import traceback
 
@@ -859,9 +936,12 @@ def _make_worker_handler(server: WorkerServer):
                 return
             parts = self.path.strip("/").split("/")
             if self.path == "/v1/info":
+                with server.lock:
+                    counters = dict(server.counters)
                 self._send(200, json.dumps(
                     {"nodeId": f"worker:{server.port}",
-                     "state": "active"}).encode(), "application/json")
+                     "state": "active",
+                     "counters": counters}).encode(), "application/json")
                 return
             if len(parts) >= 4 and parts[:2] == ["v1", "task"]:
                 tid = parts[2]
@@ -960,6 +1040,8 @@ class ClusterSession:
         self.workers = list(worker_urls)
 
     def sql(self, text: str):
+        import shutil
+
         from presto_tpu.exec.executor import plan_statement
         from presto_tpu.plan.distribute import Undistributable
         from presto_tpu.sql.parser import parse
@@ -968,31 +1050,55 @@ class ClusterSession:
         plan = plan_statement(self.session, stmt)
         attempts = 1 + int(self.session.properties.get(
             "cluster_query_retries", 1))
-        for attempt in range(attempts):
-            try:
-                return self._run_distributed(plan)
-            except (Undistributable, NotImplementedError):
-                # plan shape the cluster can't place — single-node fallback
-                return self.session.sql(text)
-            except (UpstreamFailed, RuntimeError, TimeoutError,
-                    ConnectionError, OSError):
-                # worker failure mid-query: drop dead workers and re-run
-                # on the survivors (reference: fast-fail + re-execution;
-                # recoverable grouped execution covers finer grains)
-                survivors = []
-                for url in self.workers:
-                    try:
-                        _http(f"{url}/v1/info", timeout=3.0)
-                        survivors.append(url)
-                    except Exception:
-                        pass
-                if not survivors or attempt == attempts - 1 \
-                        or len(survivors) == len(self.workers):
-                    # same pool => deterministic failure; re-running
-                    # would fail identically
-                    raise
-                self.workers = survivors
-        raise RuntimeError("unreachable")
+        # durable exchange (P12): pages persist on (shared) disk for the
+        # query's lifetime so a retry replays completed tasks instead of
+        # re-executing them (reference: REMOTE_MATERIALIZED exchanges +
+        # per-lifespan rescheduling, StageExecutionId.java:28-45)
+        ddir = None
+        if bool(self.session.properties.get(
+                "recoverable_grouped_execution", False)):
+            base = str(self.session.properties.get("spill_path", "")) or \
+                os.path.join("/tmp", "presto_tpu_spill")
+            ddir = os.path.join(base, "exchange", uuid.uuid4().hex[:16])
+        # the query's task layout: slot i runs splits i of len(layout).
+        # A retry keeps the LAYOUT (so bucket counts and splits stay
+        # consistent with pages already durably produced) and remaps the
+        # dead workers' slots onto survivors.
+        layout = list(self.workers)
+        try:
+            for attempt in range(attempts):
+                try:
+                    return self._run_distributed(plan, layout, ddir,
+                                                 attempt)
+                except (Undistributable, NotImplementedError):
+                    # plan shape the cluster can't place — single-node
+                    # fallback
+                    return self.session.sql(text)
+                except (UpstreamFailed, RuntimeError, TimeoutError,
+                        ConnectionError, OSError):
+                    # worker failure mid-query: remap the dead slots and
+                    # re-run; completed tasks replay from the durable
+                    # store when enabled
+                    survivors = []
+                    for url in self.workers:
+                        try:
+                            _http(f"{url}/v1/info", timeout=3.0)
+                            survivors.append(url)
+                        except Exception:
+                            pass
+                    if not survivors or attempt == attempts - 1 \
+                            or set(survivors) >= set(layout):
+                        # same pool => deterministic failure; re-running
+                        # would fail identically
+                        raise
+                    layout = [u if u in survivors
+                              else survivors[i % len(survivors)]
+                              for i, u in enumerate(layout)]
+                    self.workers = survivors
+            raise RuntimeError("unreachable")
+        finally:
+            if ddir is not None:
+                shutil.rmtree(ddir, ignore_errors=True)
 
     def _eval_subplan(self, sub, scalar_results) -> tuple:
         """Uncorrelated scalar subplan -> (value, valid), distributed the
@@ -1023,18 +1129,26 @@ class ClusterSession:
             ex.ctx.scalar_results.update(scalar_results)
             return _single_value(ex.exec_node(sub))
 
-    def _run_distributed(self, plan):
+    def _run_distributed(self, plan, layout=None, ddir=None, attempt=0):
         from presto_tpu.plan import nodes as P
         from presto_tpu.plan.distribute import distribute
         from presto_tpu.session import QueryResult
 
-        nw = len(self.workers)
+        import copy
+
+        layout = layout if layout is not None else list(self.workers)
+        nw = len(layout)
         scalar_results: Dict[int, tuple] = {}
         for pid, sub in sorted(plan.subplans.items()):
-            scalar_results[pid] = self._eval_subplan(sub, scalar_results)
-        dplan = distribute(P.QueryPlan(plan.root, {}), self.session, nw)
+            # deepcopy: distribute() rewrites nodes in place, and a
+            # retry re-distributes the same logical plan
+            scalar_results[pid] = self._eval_subplan(
+                copy.deepcopy(sub), scalar_results)
+        dplan = distribute(P.QueryPlan(copy.deepcopy(plan.root), {}),
+                           self.session, nw)
         fragments = cut_fragments(dplan.root)
-        coordinator_result = self._schedule(fragments, scalar_results)
+        coordinator_result = self._schedule(fragments, scalar_results,
+                                            layout, ddir, attempt)
 
         # shape the final columns like Session.sql
         out = dplan.root
@@ -1058,10 +1172,12 @@ class ClusterSession:
         return QueryResult(list(zip(names, types)), rows)
 
     def _schedule(self, fragments: List[Fragment],
-                  scalar_results: Dict[int, tuple]):
+                  scalar_results: Dict[int, tuple], layout=None,
+                  ddir=None, attempt=0):
         """Run fragments as BSP supersteps; returns the final fragment's
         unpacked columns (reference: SqlQueryScheduler's stage loop with
         an AllAtOnce-per-level policy)."""
+        layout = layout if layout is not None else list(self.workers)
         nfr = len(fragments)
         # placement is a pure function of the fragment, so consumers'
         # bucket counts are known before producers run
@@ -1070,12 +1186,12 @@ class ClusterSession:
             if frag.fid == nfr - 1:
                 run_on_of[frag.fid] = [None]  # coordinator-local output
             elif frag.on_workers:
-                run_on_of[frag.fid] = list(self.workers)
+                run_on_of[frag.fid] = list(layout)
             else:
                 # single-node intermediate (e.g. the merge stage of a
                 # distributed sort) runs on worker 0, which can serve its
                 # buffers over HTTP — the coordinator cannot
-                run_on_of[frag.fid] = [self.workers[0]]
+                run_on_of[frag.fid] = [layout[0]]
         consumer_of = {inp.producer: frag.fid
                        for frag in fragments for inp in frag.inputs}
 
@@ -1085,7 +1201,7 @@ class ClusterSession:
         try:
             coordinator_result = self._run_fragments(
                 fragments, scalar_results, run_on_of, consumer_of,
-                placements, all_tasks)
+                placements, all_tasks, ddir=ddir, attempt=attempt)
         finally:
             # free worker-side shuffle buffers (reference: DELETE
             # /v1/task/{id} when the downstream is done with the data)
@@ -1098,7 +1214,8 @@ class ClusterSession:
         return coordinator_result
 
     def _run_fragments(self, fragments, scalar_results, run_on_of,
-                       consumer_of, placements, all_tasks):
+                       consumer_of, placements, all_tasks, ddir=None,
+                       attempt=0):
         """All-at-once scheduling (reference: AllAtOnceExecutionPolicy):
         every fragment's tasks are submitted up front with pre-assigned
         upstream locations; workers stream pages between themselves while
@@ -1132,6 +1249,17 @@ class ClusterSession:
             payload_root = pickle.dumps(frag.root, protocol=4)
             tasks: List[Tuple[str, str]] = []
             for w, (url, tid) in enumerate(placements[frag.fid]):
+                dkey = f"f{frag.fid}_w{w}" if ddir is not None else None
+                # a completed durable output from a prior attempt means
+                # this slot REPLAYS from disk — only the victim's lost
+                # work re-executes (per-bucket retry, P12)
+                replay = False
+                if dkey is not None and attempt > 0:
+                    kd = os.path.join(ddir, dkey)
+                    if os.path.isdir(kd):
+                        replay = any(
+                            os.path.exists(os.path.join(kd, a, "_DONE"))
+                            for a in os.listdir(kd))
                 spec = TaskSpec(
                     task_id=tid,
                     fragment=payload_root,
@@ -1143,6 +1271,8 @@ class ClusterSession:
                     properties={
                         "float32_compute": self.session.properties.get(
                             "float32_compute", False)},
+                    durable_dir=ddir, durable_key=dkey,
+                    attempt=attempt, replay=replay,
                 )
                 if url is None:  # final fragment: run on the coordinator
                     coordinator_spec = spec

@@ -37,6 +37,9 @@ class DistExecutor(Executor):
     """Per-shard executor: inherits the whole static (compiled-mode)
     operator repertoire and adds Exchange lowering."""
 
+    # per-shard scan slices break the index join's whole-table layout
+    allow_index_join = False
+
     def __init__(self, session, ndev: int, scan_inputs):
         super().__init__(session, static=True, scan_inputs=scan_inputs)
         self.ndev = ndev

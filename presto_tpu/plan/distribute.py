@@ -17,7 +17,7 @@ Distribution lattice per node:
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 from presto_tpu.plan import ir
 from presto_tpu.plan import nodes as P

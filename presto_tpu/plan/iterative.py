@@ -336,10 +336,354 @@ class MergeAdjacentProjects(Rule):
         return P.Project(child.source, new_assigns)
 
 
+# ---------------------------------------------------------------------------
+# constant-folding / empty-relation rules (reference: rule/
+# EvaluateZeroLimit, RemoveTrivialFilters' FALSE arm, the
+# Evaluate*Over{EmptyRelation} family)
+# ---------------------------------------------------------------------------
+
+
+def _empty_values(node: P.PlanNode) -> P.Values:
+    outs = node.outputs()
+    return P.Values([s for s, _ in outs], [t for _, t in outs], [])
+
+
+def _is_empty_pattern() -> Pattern:
+    return pattern(P.Values).matching(lambda n: not n.rows)
+
+
+class EvaluateZeroLimit(Rule):
+    """Limit(0, x) -> empty Values (rule/EvaluateZeroLimit.java)."""
+
+    pattern = pattern(P.Limit).matching(lambda n: n.count == 0)
+
+    def apply(self, node: P.Limit, ctx):
+        return _empty_values(node)
+
+
+class EvaluateZeroTopN(Rule):
+    """TopN(0, x) -> empty Values (part of the reference's zero-limit
+    family)."""
+
+    pattern = pattern(P.TopN).matching(lambda n: n.count == 0)
+
+    def apply(self, node: P.TopN, ctx):
+        return _empty_values(node)
+
+
+class RemoveFalseFilter(Rule):
+    """Filter(FALSE | NULL) -> empty Values (RemoveTrivialFilters)."""
+
+    pattern = pattern(P.Filter).matching(
+        lambda n: isinstance(n.predicate, ir.Lit)
+        and (n.predicate.value is False or n.predicate.value is None))
+
+    def apply(self, node: P.Filter, ctx):
+        return _empty_values(node)
+
+
+class FoldValuesLimit(Rule):
+    """Limit(k, Values) -> Values[:k] (constant fold)."""
+
+    pattern = pattern(P.Limit).with_source(pattern(P.Values))
+
+    def apply(self, node: P.Limit, ctx):
+        child = ctx.resolve(node.source)
+        if len(child.rows) <= node.count:
+            return child
+        return P.Values(child.symbols, child.types_,
+                        child.rows[:node.count])
+
+
+class PropagateEmptySource(Rule):
+    """Row-wise / order-wise nodes over an empty relation are empty
+    (reference: the EvaluateXOverEmptyRelation rule family)."""
+
+    pattern = Pattern((P.Filter, P.Project, P.Sort, P.TopN, P.Limit,
+                       P.Window, P.Unnest)).with_source(_is_empty_pattern())
+
+    def apply(self, node, ctx):
+        return _empty_values(node)
+
+
+class EvaluateEmptyAggregate(Rule):
+    """Grouped aggregate over an empty relation -> no groups, empty
+    (global aggregates still emit their single row and are excluded)."""
+
+    pattern = pattern(P.Aggregate).matching(
+        lambda n: bool(n.group_keys)).with_source(_is_empty_pattern())
+
+    def apply(self, node: P.Aggregate, ctx):
+        return _empty_values(node)
+
+
+class EliminateEmptyJoin(Rule):
+    """Joins with a statically-empty side fold away (reference:
+    rule/RemoveRedundant*Join*): INNER/CROSS/SEMI with either-empty
+    probe or relevant side -> empty; ANTI with empty build -> probe
+    passthrough; MARK with empty build -> probe + mark := FALSE."""
+
+    pattern = pattern(P.Join)
+
+    def apply(self, node: P.Join, ctx):
+        from presto_tpu import types as T
+
+        left = ctx.resolve(node.left)
+        right = ctx.resolve(node.right)
+        lempty = isinstance(left, P.Values) and not left.rows
+        rempty = isinstance(right, P.Values) and not right.rows
+        if not lempty and not rempty:
+            return None
+        jt = node.join_type
+        if lempty:
+            # RIGHT/FULL null-extend the RIGHT side's rows even with an
+            # empty probe; folding them would drop rows
+            if jt in ("INNER", "CROSS", "SEMI", "ANTI", "MARK", "LEFT"):
+                return _empty_values(node)
+            return None
+        if jt in ("INNER", "CROSS", "SEMI"):
+            return _empty_values(node)
+        if jt == "ANTI":  # nothing to reject: left passes through
+            return ctx.memo.extract_node(left)
+        if jt == "MARK":  # no build rows: every mark is FALSE
+            assigns = {s: ir.Ref(s, t) for s, t in left.outputs()}
+            assigns[node.mark] = ir.Lit(False, T.BOOLEAN)
+            return P.Project(ctx.memo.extract_node(left), assigns)
+        return None  # LEFT/RIGHT/FULL need null-extension; leave as-is
+
+
+class PruneEmptyUnionBranches(Rule):
+    """UNION ALL drops statically-empty branches; all-empty -> empty,
+    one branch -> remapping Project (reference: set-operation pruning
+    rules)."""
+
+    pattern = pattern(P.Union).matching(lambda n: not n.distinct)
+
+    def apply(self, node: P.Union, ctx):
+        kept = [(src, m) for src, m in zip(node.sources_, node.mappings)
+                if not (isinstance(ctx.resolve(src), P.Values)
+                        and not ctx.resolve(src).rows)]
+        if len(kept) == len(node.sources_):
+            return None
+        if not kept:
+            return _empty_values(node)
+        types = dict(node.outputs())
+        if len(kept) == 1:
+            src, m = kept[0]
+            return P.Project(ctx.memo.extract_node(ctx.resolve(src)),
+                             {s: ir.Ref(m[s], types[s])
+                              for s in node.symbols})
+        return P.Union([ctx.memo.extract_node(ctx.resolve(s))
+                        for s, _ in kept],
+                       list(node.symbols), [m for _, m in kept], False)
+
+
+# ---------------------------------------------------------------------------
+# pushdown rules (reference: rule/PushLimitThrough*, PushTopNThrough*,
+# the post-AddExchanges Filter pushes)
+# ---------------------------------------------------------------------------
+
+
+class MergeLimitWithTopN(Rule):
+    """Limit(k, TopN(n, x)) -> TopN(min(k, n), x)
+    (rule/MergeLimitWithTopN.java)."""
+
+    pattern = pattern(P.Limit).with_source(pattern(P.TopN))
+
+    def apply(self, node: P.Limit, ctx):
+        child = ctx.resolve(node.source)
+        return P.TopN(child.source, list(child.keys),
+                      min(node.count, child.count))
+
+
+class PushLimitThroughUnion(Rule):
+    """Limit(k, Union ALL) -> Limit(k, Union(Limit(k, s)...)): each
+    branch needs at most k rows (rule/PushLimitThroughUnion.java)."""
+
+    pattern = pattern(P.Limit).with_source(
+        pattern(P.Union).matching(lambda n: not n.distinct))
+
+    def apply(self, node: P.Limit, ctx):
+        child = ctx.resolve(node.source)
+        k = node.count
+        srcs = [ctx.resolve(s) for s in child.sources_]
+        if all(isinstance(s, P.Limit) and s.count <= k for s in srcs):
+            return None  # already pushed
+        new_srcs = [s if isinstance(ctx.resolve(s), P.Limit)
+                    and ctx.resolve(s).count <= k else P.Limit(s, k)
+                    for s in child.sources_]
+        return P.Limit(P.Union(new_srcs, list(child.symbols),
+                               [dict(m) for m in child.mappings], False), k)
+
+
+class PushLimitThroughOuterJoin(Rule):
+    """Limit(k, LEFT join) -> Limit(k, join(Limit(k, probe), build)):
+    a LEFT join emits at least one row per probe row, so k output rows
+    need at most k probe rows (rule/PushLimitThroughOuterJoin.java)."""
+
+    pattern = pattern(P.Limit).with_source(
+        pattern(P.Join).matching(lambda n: n.join_type == "LEFT"))
+
+    def apply(self, node: P.Limit, ctx):
+        child = ctx.resolve(node.source)
+        probe = ctx.resolve(child.left)
+        if isinstance(probe, P.Limit) and probe.count <= node.count:
+            return None  # already pushed
+        new_join = dataclasses.replace(child,
+                                       left=P.Limit(child.left, node.count))
+        _carry_attrs(child, new_join)
+        return P.Limit(new_join, node.count)
+
+
+class PushLimitThroughMarkJoin(Rule):
+    """Limit(k, MARK join) -> same push as the outer-join rule: MARK
+    emits exactly one row per probe row (reference:
+    PushLimitThroughSemiJoin operating on SemiJoinNode)."""
+
+    pattern = pattern(P.Limit).with_source(
+        pattern(P.Join).matching(lambda n: n.join_type == "MARK"))
+
+    def apply(self, node: P.Limit, ctx):
+        child = ctx.resolve(node.source)
+        probe = ctx.resolve(child.left)
+        if isinstance(probe, P.Limit) and probe.count <= node.count:
+            return None
+        new_join = dataclasses.replace(child,
+                                       left=P.Limit(child.left, node.count))
+        _carry_attrs(child, new_join)
+        return P.Limit(new_join, node.count)
+
+
+class PushTopNThroughProject(Rule):
+    """TopN(Project(x)) -> Project(TopN(x)) when every sort key maps
+    through an identity Ref — the projection then computes on at most
+    N rows (rule/PushTopNThroughProject.java)."""
+
+    pattern = pattern(P.TopN).with_source(pattern(P.Project))
+
+    def apply(self, node: P.TopN, ctx):
+        child = ctx.resolve(node.source)
+        new_keys = []
+        for sym, asc, nf in node.keys:
+            e = child.assignments.get(sym)
+            if not isinstance(e, ir.Ref):
+                return None
+            new_keys.append((e.name, asc, nf))
+        return P.Project(P.TopN(child.source, new_keys, node.count),
+                         dict(child.assignments))
+
+
+class PushFilterThroughProject(Rule):
+    """Filter(Project(x)) -> Project(Filter(x)) with the predicate
+    rewritten through the assignments (reference: the
+    PushDownFilterThroughProject shape inside PredicatePushDown)."""
+
+    pattern = pattern(P.Filter).with_source(pattern(P.Project))
+
+    def apply(self, node: P.Filter, ctx):
+        child = ctx.resolve(node.source)
+        refs = node.predicate.refs()
+        if not refs <= set(child.assignments):
+            return None
+        rewritten = ir.substitute(node.predicate, dict(child.assignments))
+        return P.Project(P.Filter(child.source, rewritten),
+                         dict(child.assignments))
+
+
+class PushFilterThroughUnion(Rule):
+    """Filter(Union) -> Union(Filter(s)...) with per-branch symbol
+    remapping (reference: PredicatePushDown's union arm)."""
+
+    pattern = pattern(P.Filter).with_source(pattern(P.Union))
+
+    def apply(self, node: P.Filter, ctx):
+        child = ctx.resolve(node.source)
+        types = dict(node.outputs())
+        if not node.predicate.refs() <= set(child.symbols):
+            return None
+        new_srcs = []
+        for src, m in zip(child.sources_, child.mappings):
+            sub = {s: ir.Ref(m[s], types[s]) for s in child.symbols}
+            new_srcs.append(P.Filter(src, ir.substitute(node.predicate,
+                                                        sub)))
+        return P.Union(new_srcs, list(child.symbols),
+                       [dict(m) for m in child.mappings], child.distinct)
+
+
+class SimplifyCountOverConstant(Rule):
+    """count(<non-null literal>) -> count(*)
+    (rule/SimplifyCountOverConstant.java)."""
+
+    pattern = pattern(P.Aggregate)
+
+    def apply(self, node: P.Aggregate, ctx):
+        changed = {}
+        for sym, a in node.aggs.items():
+            if a.fn == "count" and not a.distinct and len(a.args) == 1 \
+                    and isinstance(a.args[0], ir.Lit) \
+                    and a.args[0].value is not None:
+                changed[sym] = dataclasses.replace(a, args=())
+        if not changed:
+            return None
+        aggs = dict(node.aggs)
+        aggs.update(changed)
+        out = P.Aggregate(node.source, list(node.group_keys), aggs,
+                          node.step)
+        return _carry_attrs(node, out)
+
+
+class MergeUnions(Rule):
+    """Union(Union ALL(a, b), c) -> Union(a, b, c): compose mappings
+    through the inner ALL union (reference: MergeUnion /
+    SetOperationMerge)."""
+
+    pattern = pattern(P.Union)
+
+    def apply(self, node: P.Union, ctx):
+        new_srcs, new_maps = [], []
+        changed = False
+        for src, m in zip(node.sources_, node.mappings):
+            inner = ctx.resolve(src)
+            if isinstance(inner, P.Union) and not inner.distinct:
+                for isrc, im in zip(inner.sources_, inner.mappings):
+                    new_srcs.append(isrc)
+                    new_maps.append({s: im[m[s]] for s in node.symbols})
+                changed = True
+            else:
+                new_srcs.append(src)
+                new_maps.append(dict(m))
+        if not changed:
+            return None
+        return P.Union([ctx.memo.extract_node(ctx.resolve(s))
+                        for s in new_srcs],
+                       list(node.symbols), new_maps, node.distinct)
+
+
+class RemoveRedundantSortOverValues(Rule):
+    """Sort / TopN(n>=1) over a <=1-row relation is a no-op
+    (reference: the RemoveRedundantSort rule on maxCardinality<=1)."""
+
+    pattern = Pattern((P.Sort, P.TopN)).with_source(
+        pattern(P.Values).matching(lambda n: len(n.rows) <= 1))
+
+    def apply(self, node, ctx):
+        if isinstance(node, P.TopN) and node.count < 1:
+            return None  # zero-TopN folds via EvaluateZeroTopN
+        return ctx.memo.extract_node(ctx.resolve(node.source))
+
+
 DEFAULT_RULES: List[Rule] = [
     MergeFilters(), RemoveTrivialFilter(), MergeLimits(),
     MergeLimitWithSort(), PushLimitThroughProject(),
     InlineIdentityProject(), MergeAdjacentProjects(),
+    EvaluateZeroLimit(), EvaluateZeroTopN(), RemoveFalseFilter(),
+    FoldValuesLimit(), PropagateEmptySource(), EvaluateEmptyAggregate(),
+    EliminateEmptyJoin(), PruneEmptyUnionBranches(),
+    MergeLimitWithTopN(), PushLimitThroughUnion(),
+    PushLimitThroughOuterJoin(), PushLimitThroughMarkJoin(),
+    PushTopNThroughProject(), PushFilterThroughProject(),
+    PushFilterThroughUnion(), SimplifyCountOverConstant(),
+    MergeUnions(), RemoveRedundantSortOverValues(),
 ]
 
 

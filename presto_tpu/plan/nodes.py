@@ -304,7 +304,8 @@ def plan_tree_str(node: PlanNode, indent: int = 0, annotate=None) -> str:
                   + "{" + ", ".join(f"{s} := {a}" for s, a in node.aggs.items()) + "}")
     elif isinstance(node, Join):
         detail = f" {node.join_type} {node.criteria}" + (
-            f" filter=[{node.filter}]" if node.filter is not None else "")
+            f" filter=[{node.filter}]" if node.filter is not None else "") + (
+            " INDEX" if getattr(node, "index_lookup", None) else "")
     elif isinstance(node, (Sort, TopN)):
         detail = f" {node.keys}" + (
             f" limit={node.count}" if isinstance(node, TopN) else "")

@@ -15,7 +15,7 @@ rules).  Round-1 set, the ones correctness/feasibility actually require:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set, Tuple
+from typing import List, Set
 
 from presto_tpu import types as T
 from presto_tpu.plan import ir
@@ -64,12 +64,74 @@ def annotate_static_hints(plan: P.QueryPlan, session) -> None:
                 for lk, rk in node.criteria:
                     node.key_stats[lk] = ls.cols.get(lk)
                     node.key_stats[rk] = rs.cols.get(rk)
+                node.index_lookup = _index_lookup_info(node, catalog)
         except Exception:
             pass  # hints are optional; executor falls back to dynamic mode
 
     annotate(plan.root)
     for sub in plan.subplans.values():
         annotate(sub)
+
+
+def _index_lookup_info(node: P.Join, catalog):
+    """P10 index joins, TPU-native: when the build (right) side is a
+    resident table whose single join key is a DENSE unique integer key
+    (surrogate keys: tpch nation/part/customer, tpcds date_dim/item...),
+    the probe lowers to ONE gather — position = key - key_min — instead
+    of the three sorts of build_probe.  Reference:
+    sql/planner/optimizations/IndexJoinOptimizer.java planning
+    IndexJoinNode probes against a connector index (operator/index/
+    IndexLoader); here the "index" is the identity layout of a dense
+    surrogate key, the natural connector index on TPU.
+
+    Returns {"min", "rows"} or None.  Sound preconditions: the build
+    subtree is Filter/Project-over-TableScan ONLY (row positions reach
+    the join unchanged — filters mask sel, never compact), the key is an
+    identity Ref of the scan's dense unique column, and the executor
+    additionally verifies gathered key == probe key in-trace, so stale
+    stats degrade to no-match on rows a sort join would also not match.
+    """
+    if len(node.criteria) != 1:
+        return None
+    if node.join_type not in ("INNER", "LEFT", "SEMI", "ANTI", "MARK"):
+        return None
+    if node.filter is not None and node.join_type not in ("INNER", "LEFT"):
+        return None  # filtered SEMI/ANTI take the expanding path
+    sym = node.criteria[0][1]
+    cur = node.right
+    while True:
+        if isinstance(cur, P.Filter):
+            cur = cur.source
+        elif isinstance(cur, P.Project):
+            e = cur.assignments.get(sym)
+            if not isinstance(e, ir.Ref):
+                return None
+            sym = e.name
+            cur = cur.source
+        else:
+            break
+    if not isinstance(cur, P.TableScan):
+        return None
+    col = cur.assignments.get(sym)
+    if col is None:
+        return None
+    try:
+        t = catalog.get(cur.table)
+    except KeyError:
+        return None
+    if not hasattr(t, "unique_keys") or (col,) not in \
+            [tuple(k) for k in t.unique_keys()]:
+        return None
+    typ = cur.types.get(sym)
+    if typ is None or not typ.is_integer:
+        return None
+    cs = t.column_stats(col) if hasattr(t, "column_stats") else None
+    rows = t.row_count()
+    if cs is None or cs.min is None or cs.max is None or not cs.ndv:
+        return None
+    if cs.ndv != rows or int(cs.max) - int(cs.min) + 1 != rows or rows == 0:
+        return None
+    return {"min": int(cs.min), "rows": int(rows)}
 
 
 def _optimize_node(node: P.PlanNode, session) -> P.PlanNode:

@@ -463,6 +463,12 @@ class Planner:
             vals = []
             for j, e in enumerate(row):
                 rex = self.analyze(e, Scope([]))
+                # fold CAST(NULL AS t) — the idiomatic way to type a
+                # NULL column in VALUES (reference VALUES accepts
+                # arbitrary constant expressions)
+                if isinstance(rex, ir.CastExpr) and \
+                        isinstance(rex.arg, ir.Lit) and rex.arg.value is None:
+                    rex = ir.Lit(None, rex.type)
                 if not isinstance(rex, ir.Lit):
                     raise SemanticError("VALUES requires literals")
                 vals.append(rex.value)
@@ -553,7 +559,10 @@ class Planner:
         if isinstance(e, ir.Ref):
             return e.name
         s = self.symbols.new(hint)
-        e._planned_symbol = s  # type: ignore
+        # RowExprs are frozen dataclasses: attach the planning-only
+        # symbol without tripping __setattr__ (a literal or computed
+        # join key lands here, e.g. ON l.x = u.k after `1 AS x` inlines)
+        object.__setattr__(e, "_planned_symbol", s)
         return s
 
     def _attach_key(self, node: P.PlanNode, e: ir.RowExpr) -> P.PlanNode:
@@ -735,8 +744,19 @@ class Planner:
         lsym = self._as_symbol(val, "inval")
         if not isinstance(val, ir.Ref):
             node = self._attach_key(node, val)
-        jt = "ANTI" if negated else "SEMI"
-        return P.Join(node, inner_node, jt, [(lsym, inner_sym)])
+        if negated:
+            # null-aware NOT IN: with no match the predicate is NULL
+            # (row filtered) when x is NULL or the build side contains
+            # NULLs.  A plain ANTI join has EXISTS semantics and keeps
+            # exactly those rows; the MARK join's 3-valued mark carries
+            # the distinction (reference: SemiJoinNode semiJoinOutput
+            # consumed by FilterNode(NOT mark))
+            mark = self.symbols.new("mark")
+            j = P.Join(node, inner_node, "MARK", [(lsym, inner_sym)],
+                       mark=mark)
+            return P.Filter(j, ir.Call("not", (ir.Ref(mark, T.BOOLEAN),),
+                                       T.BOOLEAN))
+        return P.Join(node, inner_node, "SEMI", [(lsym, inner_sym)])
 
     def _plan_scalar_compare(self, node, scope, op: str, lhs: ast.Expr,
                              sub: ast.Query, agg_map, group_map):

@@ -215,8 +215,46 @@ class PrestoTpuServer:
                 "elapsedTimeMillis": int(st.total_ns / 1e6),
                 "outputRows": st.output_rows, "error": st.error,
                 "peakMemoryBytes": st.peak_memory_bytes,
+                "createTime": st.create_time, "endTime": st.end_time,
             })
         return out
+
+    def query_detail_payload(self, st) -> dict:
+        """Query-detail view for the web UI's plan/stage/timeline panes
+        (reference: webapp query.jsx + plan.jsx + stage.jsx consuming
+        /v1/query/{id})."""
+        plan_text = st.plan_text
+        if not plan_text:
+            # plans are pure functions of (sql, catalog): render on
+            # demand for queries that ran through the fused paths
+            try:
+                from presto_tpu.exec.executor import explain_text
+                from presto_tpu.sql import ast as _ast
+                from presto_tpu.sql.parser import parse as _parse
+
+                stmt = _parse(st.sql)
+                if isinstance(stmt, _ast.QueryStatement):
+                    plan_text = explain_text(self.session, stmt)
+            except Exception:
+                plan_text = ""
+        nodes = []
+        for ns in st.node_stats.values():
+            nodes.append({"kind": ns.node_kind, "rowsOut": ns.rows_out,
+                          "wallMillis": round(ns.wall_ns / 1e6, 2),
+                          "invocations": ns.invocations})
+        nodes.sort(key=lambda n: -n["wallMillis"])
+        return {
+            "queryId": st.query_id, "query": st.sql,
+            "state": st.state, "error": st.error,
+            "executionMode": st.execution_mode,
+            "createTime": st.create_time, "endTime": st.end_time,
+            "phaseMillis": {k: v / 1e6 for k, v in st.phase_ns.items()},
+            "outputRows": st.output_rows,
+            "peakMemoryBytes": st.peak_memory_bytes,
+            "spilledBytes": st.spilled_bytes,
+            "planText": plan_text,
+            "nodes": nodes,
+        }
 
     def info_payload(self) -> dict:
         return {
@@ -317,12 +355,7 @@ def _make_handler(server: PrestoTpuServer):
             if parts[:2] == ["v1", "query"] and len(parts) == 3:
                 for st in server.session.history_snapshot():
                     if st.query_id == parts[2]:
-                        return self._json({
-                            "queryId": st.query_id, "query": st.sql,
-                            "state": st.state, "error": st.error,
-                            "phaseMillis": {k: v / 1e6
-                                            for k, v in st.phase_ns.items()},
-                            "outputRows": st.output_rows})
+                        return self._json(server.query_detail_payload(st))
                 return self._json({"error": "unknown query"}, 404)
             if parts == ["v1", "info"]:
                 return self._json(server.info_payload())

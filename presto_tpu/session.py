@@ -13,7 +13,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
-import numpy as np
 
 DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # Reference: SystemSessionProperties.java:56 (81 typed properties).
@@ -55,6 +54,7 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "spill_encryption": False,  # AES-256-CTR at rest (AesSpillCipher)
     "iterative_optimizer_enabled": True,  # Memo/Rule fixpoint pass
     "spill_path": "",  # "" = <tmp>/presto_tpu_spill
+    "localfile_root": "",  # "" = <tmp>/presto_tpu_tables (file connectors)
     "spill_partition_count": 8,  # Grace hash fan-out (GenericPartitioningSpiller)
     "max_spill_bytes": 64 << 30,
     # force grouped execution above this input row count regardless of the

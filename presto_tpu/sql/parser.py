@@ -11,7 +11,7 @@ CREATE TABLE AS, INSERT, SET SESSION.
 from __future__ import annotations
 
 import re
-from typing import List, Optional
+from typing import List
 
 from presto_tpu.sql import ast
 

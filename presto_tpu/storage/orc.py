@@ -1,0 +1,597 @@
+"""ORC read path — an in-engine decoder, no external ORC library.
+
+Reference parity: presto-orc/ (OrcReader, StripeReader, the stream
+readers under stream/ and reader/ — the reference's single biggest
+connector-side codebase at ~54k LoC).  TPU-native adaptation mirrors
+storage/parquet.py: column chunks decode straight into whole numpy
+arrays for one fused XLA consumer, so the reader keeps ORC's layout
+smarts (stripes, RLE families, dictionary encodings) and drops the
+per-batch streaming scaffolding.
+
+Scope: the ORC v1 (0.12) core — protobuf-decoded postscript/footer/
+stripe footers, ZLIB/SNAPPY/ZSTD/LZ4/NONE block compression, byte RLE,
+boolean RLE, integer RLE v1 + all four RLE v2 sub-encodings (short
+repeat / direct / delta / patched base), PRESENT streams, and the
+BOOLEAN/BYTE/SHORT/INT/LONG/FLOAT/DOUBLE/STRING (direct + dictionary)/
+BINARY/DATE/TIMESTAMP/DECIMAL column types over flat schemas.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.storage.parquet import snappy_decompress
+
+MAGIC = b"ORC"
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire-format reader (ORC metadata is proto, not thrift)
+# ---------------------------------------------------------------------------
+
+
+class _Proto:
+    def __init__(self, buf: bytes):
+        self.b = buf
+        self.i = 0
+
+    def varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            v = self.b[self.i]
+            self.i += 1
+            out |= (v & 0x7F) << shift
+            if not v & 0x80:
+                return out
+            shift += 7
+
+    def read_message(self) -> Dict[int, list]:
+        """Message -> {field_number: [values...]} (repeated fields keep
+        every occurrence; submessages stay as raw bytes for the caller
+        to parse with the right shape)."""
+        out: Dict[int, list] = {}
+        n = len(self.b)
+        while self.i < n:
+            key = self.varint()
+            fnum = key >> 3
+            wt = key & 7
+            if wt == 0:
+                v = self.varint()
+            elif wt == 1:
+                v = struct.unpack_from("<q", self.b, self.i)[0]
+                self.i += 8
+            elif wt == 2:
+                ln = self.varint()
+                v = self.b[self.i:self.i + ln]
+                self.i += ln
+            elif wt == 5:
+                v = struct.unpack_from("<i", self.b, self.i)[0]
+                self.i += 4
+            else:
+                raise NotImplementedError(f"proto wire type {wt}")
+            out.setdefault(fnum, []).append(v)
+        return out
+
+
+def _msg(buf: bytes) -> Dict[int, list]:
+    return _Proto(buf).read_message()
+
+
+def _packed_varints(buf: bytes) -> List[int]:
+    p = _Proto(buf)
+    out = []
+    while p.i < len(buf):
+        out.append(p.varint())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# compression framing + codecs
+# ---------------------------------------------------------------------------
+
+
+def _lz4_block_decompress(data: bytes, max_out: int) -> bytes:
+    """LZ4 block format (no frame), pure python."""
+    out = bytearray()
+    i = 0
+    n = len(data)
+    while i < n:
+        token = data[i]
+        i += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                v = data[i]
+                i += 1
+                lit += v
+                if v != 255:
+                    break
+        out += data[i:i + lit]
+        i += lit
+        if i >= n:
+            break
+        off = int.from_bytes(data[i:i + 2], "little")
+        i += 2
+        ml = token & 0xF
+        if ml == 15:
+            while True:
+                v = data[i]
+                i += 1
+                ml += v
+                if v != 255:
+                    break
+        ml += 4
+        if off >= ml:
+            start = len(out) - off
+            out += out[start:start + ml]
+        else:
+            for _ in range(ml):
+                out.append(out[-off])
+    return bytes(out)
+
+
+def _decompress_stream(codec: int, data: bytes, block_size: int) -> bytes:
+    """ORC chunked compression: 3-byte little-endian header per chunk,
+    LSB = isOriginal (uncompressed)."""
+    if codec == 0:  # NONE
+        return data
+    out = bytearray()
+    i = 0
+    while i + 3 <= len(data):
+        hdr = int.from_bytes(data[i:i + 3], "little")
+        i += 3
+        orig = hdr & 1
+        ln = hdr >> 1
+        chunk = data[i:i + ln]
+        i += ln
+        if orig:
+            out += chunk
+        elif codec == 1:  # ZLIB (raw deflate)
+            out += zlib.decompress(chunk, wbits=-15)
+        elif codec == 2:  # SNAPPY
+            out += snappy_decompress(chunk)
+        elif codec == 4:  # LZ4
+            out += _lz4_block_decompress(chunk, block_size)
+        elif codec == 5:  # ZSTD
+            import zstandard
+
+            out += zstandard.ZstdDecompressor().decompress(
+                chunk, max_output_size=block_size)
+        else:
+            raise NotImplementedError(f"orc compression kind {codec}")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# RLE decoders (reference: stream/LongInputStreamV1/V2, ByteInputStream,
+# BooleanInputStream)
+# ---------------------------------------------------------------------------
+
+
+def _byte_rle(data: bytes, count: int) -> np.ndarray:
+    out = np.empty(count, np.uint8)
+    o = 0
+    i = 0
+    while o < count and i < len(data):
+        h = data[i]
+        i += 1
+        if h < 128:  # run of h+3 copies
+            run = h + 3
+            out[o:o + run] = data[i]
+            i += 1
+            o += run
+        else:  # 256-h literals
+            lit = 256 - h
+            out[o:o + lit] = np.frombuffer(data[i:i + lit], np.uint8)
+            i += lit
+            o += lit
+    return out[:count]
+
+
+def _bool_rle(data: bytes, count: int) -> np.ndarray:
+    nbytes = (count + 7) // 8
+    by = _byte_rle(data, nbytes)
+    bits = np.unpackbits(by, bitorder="big")
+    return bits[:count].astype(bool)
+
+
+def _zigzag_np(v: np.ndarray) -> np.ndarray:
+    u = v.astype(np.uint64)
+    return ((u >> np.uint64(1)).astype(np.int64)
+            ^ -(v & 1).astype(np.int64))
+
+
+class _IntRle:
+    """Integer RLE, both versions (reference: LongInputStreamV1/V2)."""
+
+    def __init__(self, data: bytes, signed: bool, v2: bool):
+        self.b = data
+        self.i = 0
+        self.signed = signed
+        self.v2 = v2
+
+    def _varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            v = self.b[self.i]
+            self.i += 1
+            out |= (v & 0x7F) << shift
+            if not v & 0x80:
+                return out
+            shift += 7
+
+    def _svarint(self) -> int:
+        v = self._varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read(self, count: int) -> np.ndarray:
+        out = np.empty(count, np.int64)
+        o = 0
+        while o < count:
+            if self.v2:
+                o = self._read_v2(out, o, count)
+            else:
+                o = self._read_v1(out, o, count)
+        return out
+
+    # -- v1 -----------------------------------------------------------
+    def _read_v1(self, out, o, count) -> int:
+        h = self.b[self.i]
+        self.i += 1
+        if h < 128:  # run: h+3 values, delta byte, base varint
+            run = h + 3
+            delta = struct.unpack_from("b", self.b, self.i)[0]
+            self.i += 1
+            base = self._svarint() if self.signed else self._varint()
+            take = min(run, count - o)
+            out[o:o + take] = base + delta * np.arange(take)
+            return o + take
+        lit = 256 - h
+        for k in range(min(lit, count - o)):
+            out[o + k] = self._svarint() if self.signed else self._varint()
+        return o + min(lit, count - o)
+
+    # -- v2 -----------------------------------------------------------
+    _W = [1, 2, 4, 8, 16, 24, 32, 40, 48, 56, 64]  # 5-bit width table
+    _WIDTH = [0, 0, 1, 2, 4, 8, 16, 24, 32, 40, 48, 52, 56, 60, 62, 64]
+
+    @classmethod
+    def _decode_width(cls, enc: int) -> int:
+        """The 5-bit encoded bit width (Table in the ORC spec)."""
+        if enc <= 23:
+            return enc + 1
+        return {24: 26, 25: 28, 26: 30, 27: 32, 28: 40,
+                29: 48, 30: 56, 31: 64}[enc]
+
+    def _bits(self, n_vals: int, width: int) -> np.ndarray:
+        nbytes = (n_vals * width + 7) // 8
+        chunk = np.frombuffer(self.b[self.i:self.i + nbytes], np.uint8)
+        self.i += nbytes
+        if width == 0:
+            return np.zeros(n_vals, np.int64)
+        bits = np.unpackbits(chunk, bitorder="big")
+        need = n_vals * width
+        bits = bits[:need].reshape(n_vals, width)
+        weights = (1 << np.arange(width - 1, -1, -1, dtype=np.uint64))
+        return (bits.astype(np.uint64) @ weights).astype(np.int64)
+
+    def _read_v2(self, out, o, count) -> int:
+        h = self.b[self.i]
+        kind = h >> 6
+        if kind == 0:  # SHORT_REPEAT
+            width = ((h >> 3) & 0x7) + 1
+            run = (h & 0x7) + 3
+            self.i += 1
+            v = int.from_bytes(self.b[self.i:self.i + width], "big")
+            self.i += width
+            if self.signed:
+                v = (v >> 1) ^ -(v & 1)
+            take = min(run, count - o)
+            out[o:o + take] = v
+            return o + take
+        if kind == 1:  # DIRECT
+            width = self._decode_width((h >> 1) & 0x1F)
+            n = (((h & 1) << 8) | self.b[self.i + 1]) + 1
+            self.i += 2
+            vals = self._bits(n, width)
+            if self.signed:
+                vals = _zigzag_np(vals)
+            take = min(n, count - o)
+            out[o:o + take] = vals[:take]
+            return o + take
+        if kind == 3:  # DELTA
+            width_enc = (h >> 1) & 0x1F
+            width = 0 if width_enc == 0 else self._decode_width(width_enc)
+            n = (((h & 1) << 8) | self.b[self.i + 1]) + 1
+            self.i += 2
+            base = self._svarint() if self.signed else self._varint()
+            delta0 = self._svarint()
+            vals = np.empty(n, np.int64)
+            vals[0] = base
+            if n > 1:
+                vals[1] = base + delta0
+            if n > 2:
+                if width:
+                    deltas = self._bits(n - 2, width)
+                else:
+                    deltas = np.full(n - 2, abs(delta0), np.int64)
+                sign = 1 if delta0 >= 0 else -1
+                if width:
+                    deltas = deltas * sign
+                    vals[2:] = vals[1] + np.cumsum(deltas)
+                else:
+                    vals[2:] = vals[1] + sign * np.cumsum(deltas)
+            take = min(n, count - o)
+            out[o:o + take] = vals[:take]
+            return o + take
+        # kind == 2: PATCHED_BASE
+        width = self._decode_width((h >> 1) & 0x1F)
+        n = (((h & 1) << 8) | self.b[self.i + 1]) + 1
+        h3 = self.b[self.i + 2]
+        h4 = self.b[self.i + 3]
+        self.i += 4
+        bw = (h3 >> 5) + 1  # base value width, BYTES
+        pw_enc = h3 & 0x1F
+        pw = self._decode_width(pw_enc)  # patch width, bits
+        pgw = (h4 >> 5) + 1  # patch GAP width, BITS (1..8)
+        pll = h4 & 0x1F  # patch list length
+        base_raw = int.from_bytes(self.b[self.i:self.i + bw], "big")
+        self.i += bw
+        msb = 1 << (bw * 8 - 1)
+        base = -(base_raw & (msb - 1)) if base_raw & msb else base_raw
+        vals = self._bits(n, width)
+        # patch entries pack at the closest "fixed bits" width covering
+        # gap width + patch width (getClosestFixedBits); gap-filler
+        # entries (value 0) extend gaps past 255
+        # getClosestFixedBits: 1..24, then 26/28/30/32/40/48/56/64
+        need = pgw + pw
+        if need <= 24:
+            cw = need
+        else:
+            cw = next(w for w in (26, 28, 30, 32, 40, 48, 56, 64)
+                      if w >= need)
+        patches = self._bits(pll, cw)
+        gaps = (patches >> pw) & ((1 << pgw) - 1)
+        pvals = patches & ((1 << pw) - 1)
+        pos = 0
+        for k in range(pll):
+            pos += int(gaps[k])
+            v = int(pvals[k])
+            if v != 0 and pos < n:
+                vals[pos] |= v << width
+        vals = vals + base
+        take = min(n, count - o)
+        out[o:o + take] = vals[:take]
+        return o + take
+
+
+# ---------------------------------------------------------------------------
+# file reader
+# ---------------------------------------------------------------------------
+
+# proto field ids (orc_proto.proto)
+_PS_FOOTER_LEN, _PS_COMPRESSION, _PS_BLOCK = 1, 2, 3
+_FTR_STRIPES, _FTR_TYPES, _FTR_NROWS = 3, 4, 6
+_STR_OFFSET, _STR_INDEX_LEN, _STR_DATA_LEN, _STR_FOOTER_LEN, _STR_NROWS = \
+    1, 2, 3, 4, 5
+
+_KIND = {0: "boolean", 1: "byte", 2: "short", 3: "int", 4: "long",
+         5: "float", 6: "double", 7: "string", 8: "binary",
+         9: "timestamp", 10: "list", 11: "map", 12: "struct",
+         13: "union", 14: "decimal", 15: "date", 16: "varchar",
+         17: "char"}
+
+
+class OrcColumn:
+    def __init__(self, cid: int, kind: str, name: str,
+                 precision: int = 0, scale: int = 0):
+        self.cid = cid
+        self.kind = kind
+        self.name = name
+        self.precision = precision
+        self.scale = scale
+
+    def sql_type(self) -> T.Type:
+        k = self.kind
+        if k == "boolean":
+            return T.BOOLEAN
+        if k in ("byte", "short"):
+            return T.SMALLINT
+        if k == "int":
+            return T.INTEGER
+        if k == "long":
+            return T.BIGINT
+        if k == "float":
+            return T.REAL
+        if k == "double":
+            return T.DOUBLE
+        if k in ("string", "varchar", "char"):
+            return T.VARCHAR
+        if k == "binary":
+            return T.VARBINARY
+        if k == "date":
+            return T.DATE
+        if k == "timestamp":
+            return T.TIMESTAMP
+        if k == "decimal":
+            return T.decimal(self.precision or 38, self.scale)
+        raise NotImplementedError(f"orc type {k}")
+
+
+class OrcFile:
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            tail_len = min(size, 16 * 1024)
+            f.seek(size - tail_len)
+            tail = f.read(tail_len)
+        ps_len = tail[-1]
+        ps = _msg(tail[-1 - ps_len:-1])
+        self.codec = ps.get(_PS_COMPRESSION, [0])[0]
+        self.block_size = ps.get(_PS_BLOCK, [262144])[0]
+        footer_len = ps[_PS_FOOTER_LEN][0]
+        footer_raw = tail[-1 - ps_len - footer_len:-1 - ps_len]
+        footer = _msg(_decompress_stream(self.codec, footer_raw,
+                                         self.block_size))
+        self.num_rows = footer.get(_FTR_NROWS, [0])[0]
+        types = [_msg(t) for t in footer.get(_FTR_TYPES, [])]
+        root = types[0]
+        if _KIND[root.get(1, [12])[0]] != "struct":
+            raise NotImplementedError("non-struct ORC root")
+        subtypes = root.get(2, [])
+        if isinstance(subtypes and subtypes[0], bytes):
+            # packed repeated uint32
+            subtypes = [v for b in subtypes for v in _packed_varints(b)]
+        names = [n.decode() for n in root.get(3, [])]
+        self.columns: List[OrcColumn] = []
+        for cid, name in zip(subtypes, names):
+            tmsg = types[cid]
+            kind = _KIND[tmsg.get(1, [0])[0]]
+            if kind in ("list", "map", "struct", "union"):
+                raise NotImplementedError("nested ORC schemas")
+            self.columns.append(OrcColumn(
+                cid, kind, name,
+                precision=tmsg.get(5, [0])[0], scale=tmsg.get(6, [0])[0]))
+        self.stripes = [_msg(s) for s in footer.get(_FTR_STRIPES, [])]
+
+    # -- stripe decode -------------------------------------------------
+    def _stripe_streams(self, st) -> Tuple[dict, dict]:
+        """({(column, kind): bytes}, {column: (encoding, dict_size)})."""
+        offset = st[_STR_OFFSET][0]
+        index_len = st.get(_STR_INDEX_LEN, [0])[0]
+        data_len = st.get(_STR_DATA_LEN, [0])[0]
+        footer_len = st.get(_STR_FOOTER_LEN, [0])[0]
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            blob = f.read(index_len + data_len + footer_len)
+        sf = _msg(_decompress_stream(
+            self.codec, blob[index_len + data_len:], self.block_size))
+        streams = [_msg(s) for s in sf.get(1, [])]
+        encodings = [_msg(e) for e in sf.get(2, [])]
+        out = {}
+        pos = 0
+        for s in streams:
+            kind = s.get(1, [0])[0]
+            col = s.get(2, [0])[0]
+            ln = s.get(3, [0])[0]
+            # indexes precede data; both counted from stripe start
+            out[(col, kind)] = (pos, ln)
+            pos += ln
+        enc = {cid: (e.get(1, [0])[0], e.get(2, [0])[0])
+               for cid, e in enumerate(encodings)}
+        raw = {k: blob[p:p + ln] for k, (p, ln) in out.items()}
+        return raw, enc
+
+    def _stream(self, raw, col, kind) -> bytes:
+        data = raw.get((col, kind))
+        if data is None:
+            return b""
+        return _decompress_stream(self.codec, data, self.block_size)
+
+    def read_column(self, stripe_index: int, col: OrcColumn
+                    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        st = self.stripes[stripe_index]
+        n = st[_STR_NROWS][0]
+        raw, encs = self._stripe_streams(st)
+        enc_kind, dict_size = encs.get(col.cid, (0, 0))
+        # ColumnEncoding.Kind: DIRECT=0 DICTIONARY=1 DIRECT_V2=2
+        # DICTIONARY_V2=3
+        v2 = enc_kind in (2, 3)
+        present_b = self._stream(raw, col.cid, 0)
+        present = _bool_rle(present_b, n) if present_b else None
+        n_vals = int(present.sum()) if present is not None else n
+        data = self._stream(raw, col.cid, 1)
+        k = col.kind
+
+        if k == "boolean":
+            vals = _bool_rle(data, n_vals)
+        elif k == "byte":
+            vals = _byte_rle(data, n_vals).astype(np.int8).astype(np.int64)
+        elif k in ("short", "int", "long", "date"):
+            vals = _IntRle(data, signed=True, v2=v2).read(n_vals)
+        elif k == "float":
+            vals = np.frombuffer(data[:4 * n_vals], "<f4").copy()
+        elif k == "double":
+            vals = np.frombuffer(data[:8 * n_vals], "<f8").copy()
+        elif k in ("string", "varchar", "char", "binary"):
+            length_b = self._stream(raw, col.cid, 2)
+            if enc_kind in (1, 3):  # DICTIONARY / DICTIONARY_V2
+                dict_b = self._stream(raw, col.cid, 3)
+                lens = _IntRle(length_b, False, v2).read(dict_size)
+                dvals = np.empty(dict_size, object)
+                o = 0
+                for i2 in range(dict_size):
+                    ln = int(lens[i2])
+                    dvals[i2] = dict_b[o:o + ln]
+                    o += ln
+                codes = _IntRle(data, False, v2).read(n_vals)
+                vals = dvals[np.clip(codes, 0,
+                                     max(dict_size - 1, 0))]
+            else:
+                lens = _IntRle(length_b, False, v2).read(n_vals)
+                vals = np.empty(n_vals, object)
+                o = 0
+                for i2 in range(n_vals):
+                    ln = int(lens[i2])
+                    vals[i2] = data[o:o + ln]
+                    o += ln
+        elif k == "timestamp":
+            secs = _IntRle(data, True, v2).read(n_vals)
+            nanos_b = self._stream(raw, col.cid, 2)  # SECONDARY
+            nraw = _IntRle(nanos_b, False, v2).read(n_vals)
+            zeros = nraw & 0x7
+            nanos = nraw >> 3
+            mult = np.where(zeros > 0, 10 ** (zeros + 1), 1)
+            nanos = nanos * mult
+            base = 1420070400  # 2015-01-01 00:00:00 UTC, the ORC epoch
+            vals = (secs + base) * 1_000_000 + nanos // 1000
+        elif k == "decimal":
+            # unbounded zigzag varint mantissa + scale RLE (SECONDARY)
+            p = _Proto(data)
+            ints = []
+            for _ in range(n_vals):
+                v = p.varint()
+                ints.append((v >> 1) ^ -(v & 1))
+            vals = np.asarray(ints, np.int64)
+        else:
+            raise NotImplementedError(f"orc column kind {k}")
+
+        # scatter through the present mask
+        if present is not None:
+            full = np.empty(n, object) if isinstance(
+                vals.dtype, object.__class__) or vals.dtype == object \
+                else np.zeros(n, vals.dtype)
+            full[present] = vals
+            return self._convert(col, full, present)
+        return self._convert(col, vals, None)
+
+    def _convert(self, col, vals, valid):
+        t = col.sql_type()
+        if t.name in ("VARCHAR",):
+            out = np.empty(len(vals), object)
+            for i, v in enumerate(vals):
+                out[i] = v.decode("utf-8", "replace") \
+                    if isinstance(v, bytes) else ("" if v is None else v)
+            if col.kind == "char":
+                pass  # ORC stores padded values already
+            return out, valid, t
+        if t.name == "VARBINARY":
+            out = np.empty(len(vals), object)
+            for i, v in enumerate(vals):
+                out[i] = v if isinstance(v, bytes) else b""
+            return out, valid, t
+        if t.is_decimal:
+            return np.asarray(vals).astype(np.int64), valid, t
+        arr = np.asarray(vals)
+        if arr.dtype == object:
+            arr = np.asarray([0 if v is None else v for v in vals])
+        return arr.astype(t.numpy_dtype()), valid, t

@@ -1,0 +1,855 @@
+"""Parquet read path — an in-engine decoder, no external parquet library.
+
+Reference parity: presto-parquet/ (ParquetReader, PageReader, the
+column readers under reader/) + presto-hive's ParquetPageSourceFactory.
+TPU-native adaptation: the engine's columns are whole numpy arrays, so
+each column chunk decodes straight into one contiguous array (strings
+into object arrays that the Batch layer dictionary-encodes) — there is
+no per-1024-row block streaming because the consumer is a fused XLA
+program, not a per-page operator pipeline.
+
+Scope (the flat-schema core the reference's readers spend most of their
+code on): PLAIN / PLAIN_DICTIONARY / RLE_DICTIONARY encodings, the
+RLE+bit-packed hybrid for definition levels and dictionary indices,
+data pages v1 + v2, dictionary pages, UNCOMPRESSED/SNAPPY/GZIP/ZSTD
+codecs (snappy block format decompressed in-repo), BOOLEAN/INT32/INT64/
+FLOAT/DOUBLE/BYTE_ARRAY/FIXED_LEN_BYTE_ARRAY physical types with the
+UTF8/DATE/TIMESTAMP/DECIMAL converted types, optional fields
+(max definition level 1).  Nested schemas (repeated groups) are out of
+scope, like the early reference reader.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+
+MAGIC = b"PAR1"
+
+
+# ---------------------------------------------------------------------------
+# thrift compact protocol (the only wire format parquet metadata uses)
+# ---------------------------------------------------------------------------
+
+
+class _Thrift:
+    """Minimal thrift compact-protocol reader returning dicts keyed by
+    field id (parquet.thrift assigns stable ids; names live in the spec)."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.b = buf
+        self.i = pos
+
+    def _u8(self) -> int:
+        v = self.b[self.i]
+        self.i += 1
+        return v
+
+    def varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            v = self._u8()
+            out |= (v & 0x7F) << shift
+            if not v & 0x80:
+                return out
+            shift += 7
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read_binary(self) -> bytes:
+        n = self.varint()
+        out = self.b[self.i:self.i + n]
+        self.i += n
+        return out
+
+    def skip(self, ftype: int) -> None:
+        self.read_value(ftype)
+
+    def read_value(self, ftype: int):
+        if ftype in (1, 2):  # BOOLEAN_TRUE / BOOLEAN_FALSE
+            return ftype == 1
+        if ftype == 3:  # BYTE
+            v = struct.unpack_from("b", self.b, self.i)[0]
+            self.i += 1
+            return v
+        if ftype in (4, 5, 6):  # I16 / I32 / I64
+            return self.zigzag()
+        if ftype == 7:  # DOUBLE
+            v = struct.unpack_from("<d", self.b, self.i)[0]
+            self.i += 8
+            return v
+        if ftype == 8:  # BINARY / STRING
+            return self.read_binary()
+        if ftype in (9, 10):  # LIST / SET
+            return self.read_list()
+        if ftype == 12:  # STRUCT
+            return self.read_struct()
+        if ftype == 11:  # MAP
+            hdr = self._u8()
+            if hdr == 0:
+                return {}
+            n = hdr  # size as varint already? compact: size varint then kv byte
+            raise NotImplementedError("thrift map in parquet metadata")
+        raise NotImplementedError(f"thrift compact type {ftype}")
+
+    def read_list(self):
+        hdr = self._u8()
+        size = hdr >> 4
+        etype = hdr & 0x0F
+        if size == 15:
+            size = self.varint()
+        return [self.read_value(etype) for _ in range(size)]
+
+    def read_struct(self) -> Dict[int, object]:
+        out: Dict[int, object] = {}
+        fid = 0
+        while True:
+            hdr = self._u8()
+            if hdr == 0:  # STOP
+                return out
+            delta = hdr >> 4
+            ftype = hdr & 0x0F
+            if delta == 0:
+                fid = self.zigzag()
+            else:
+                fid += delta
+            out[fid] = self.read_value(ftype)
+
+
+# ---------------------------------------------------------------------------
+# snappy block-format decompression (no python-snappy in the image)
+# ---------------------------------------------------------------------------
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    """Raw snappy block format (the framing parquet uses none of):
+    varint uncompressed length, then literal/copy tagged elements."""
+    i = 0
+    n = 0
+    shift = 0
+    while True:
+        v = data[i]
+        i += 1
+        n |= (v & 0x7F) << shift
+        if not v & 0x80:
+            break
+        shift += 7
+    out = bytearray(n)
+    o = 0
+    while i < len(data):
+        tag = data[i]
+        i += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            ln = (tag >> 2) + 1
+            if ln > 60:
+                nbytes = ln - 60
+                ln = int.from_bytes(data[i:i + nbytes], "little") + 1
+                i += nbytes
+            out[o:o + ln] = data[i:i + ln]
+            i += ln
+            o += ln
+            continue
+        if kind == 1:  # copy, 1-byte offset
+            ln = ((tag >> 2) & 0x7) + 4
+            off = ((tag >> 5) << 8) | data[i]
+            i += 1
+        elif kind == 2:  # copy, 2-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[i:i + 2], "little")
+            i += 2
+        else:  # copy, 4-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[i:i + 4], "little")
+            i += 4
+        # overlapping copies are the RLE mechanism: byte-at-a-time when
+        # the window is shorter than the run
+        if off >= ln:
+            out[o:o + ln] = out[o - off:o - off + ln]
+            o += ln
+        else:
+            for _ in range(ln):
+                out[o] = out[o - off]
+                o += 1
+    return bytes(out[:o])
+
+
+def _decompress(codec: int, data: bytes, uncompressed_size: int) -> bytes:
+    if codec == 0:  # UNCOMPRESSED
+        return data
+    if codec == 1:  # SNAPPY
+        return snappy_decompress(data)
+    if codec == 2:  # GZIP
+        return gzip.decompress(data)
+    if codec == 6:  # ZSTD
+        import zstandard
+
+        return zstandard.ZstdDecompressor().decompress(
+            data, max_output_size=uncompressed_size)
+    raise NotImplementedError(f"parquet codec {codec}")
+
+
+# ---------------------------------------------------------------------------
+# RLE / bit-packed hybrid (levels + dictionary indices)
+# ---------------------------------------------------------------------------
+
+
+def _rle_bp_decode(data: bytes, bit_width: int, count: int) -> np.ndarray:
+    """Parquet's RLE/bit-packing hybrid (format/Encodings.md; reference:
+    parquet-column's RunLengthBitPackingHybridDecoder)."""
+    out = np.empty(count, np.int64)
+    o = 0
+    i = 0
+    if bit_width == 0:
+        out[:] = 0
+        return out
+    byte_w = (bit_width + 7) // 8
+    while o < count and i < len(data):
+        # varint header
+        hdr = 0
+        shift = 0
+        while True:
+            v = data[i]
+            i += 1
+            hdr |= (v & 0x7F) << shift
+            if not v & 0x80:
+                break
+            shift += 7
+        if hdr & 1:  # bit-packed run: (hdr >> 1) groups of 8 values
+            n_groups = hdr >> 1
+            n_vals = n_groups * 8
+            n_bytes = n_groups * bit_width
+            chunk = np.frombuffer(data[i:i + n_bytes], np.uint8)
+            i += n_bytes
+            bits = np.unpackbits(chunk, bitorder="little")
+            vals = bits.reshape(-1, bit_width)
+            weights = (1 << np.arange(bit_width, dtype=np.int64))
+            decoded = vals @ weights
+            take = min(n_vals, count - o)
+            out[o:o + take] = decoded[:take]
+            o += take
+        else:  # RLE run
+            run = hdr >> 1
+            v = int.from_bytes(data[i:i + byte_w], "little")
+            i += byte_w
+            take = min(run, count - o)
+            out[o:o + take] = v
+            o += take
+    return out
+
+
+def _delta_binary_decode(data: bytes, count: int
+                         ) -> Tuple[np.ndarray, int]:
+    """DELTA_BINARY_PACKED (format/Encodings.md; v2 integer pages):
+    header = block_size, miniblocks/block, total_count, first_value;
+    blocks = min_delta + per-miniblock bit widths + bit-packed deltas.
+    Returns (values, bytes_consumed)."""
+    t = _Thrift(data)
+    block_size = t.varint()
+    n_mini = t.varint()
+    total = t.varint()
+    first = t.zigzag()
+    out = np.empty(max(total, 1), np.int64)
+    out[0] = first
+    filled = 1
+    per_mini = block_size // max(n_mini, 1)
+    while filled < total:
+        min_delta = t.zigzag()
+        widths = [t._u8() for _ in range(n_mini)]
+        for w in widths:
+            if filled >= total:
+                # trailing miniblock bytes are still present in the
+                # stream and must be consumed
+                t.i += (w * per_mini + 7) // 8
+                continue
+            nbytes = (w * per_mini + 7) // 8
+            chunk = np.frombuffer(t.b[t.i:t.i + nbytes], np.uint8)
+            t.i += nbytes
+            if w == 0:
+                deltas = np.zeros(per_mini, np.int64)
+            else:
+                bits = np.unpackbits(chunk, bitorder="little")
+                usable = (len(bits) // w) * w
+                vals = bits[:usable].reshape(-1, w)
+                weights = (1 << np.arange(w, dtype=np.int64))
+                deltas = (vals @ weights)[:per_mini]
+            take = min(per_mini, total - filled)
+            d = deltas[:take] + min_delta
+            out[filled:filled + take] = out[filled - 1] + np.cumsum(d)
+            filled += take
+    return out[:total], t.i
+
+
+# ---------------------------------------------------------------------------
+# value decoding
+# ---------------------------------------------------------------------------
+
+_PLAIN_NP = {1: np.int32, 2: np.int64, 4: np.float32, 5: np.float64}
+
+
+def _plain_decode(ptype: int, data: bytes, count: int, type_length: int):
+    if ptype == 0:  # BOOLEAN: bit-packed LSB-first
+        bits = np.unpackbits(np.frombuffer(data, np.uint8),
+                             bitorder="little")
+        return bits[:count].astype(bool), len(data)
+    if ptype in _PLAIN_NP:
+        dt = np.dtype(_PLAIN_NP[ptype]).newbyteorder("<")
+        nb = dt.itemsize * count
+        return np.frombuffer(data[:nb], dt).copy(), nb
+    if ptype == 6:  # BYTE_ARRAY: u32 length prefix per value
+        out = np.empty(count, object)
+        i = 0
+        for k in range(count):
+            n = int.from_bytes(data[i:i + 4], "little")
+            i += 4
+            out[k] = data[i:i + n]
+            i += n
+        return out, i
+    if ptype == 7:  # FIXED_LEN_BYTE_ARRAY
+        out = np.empty(count, object)
+        i = 0
+        for k in range(count):
+            out[k] = data[i:i + type_length]
+            i += type_length
+        return out, i
+    if ptype == 3:  # INT96 (legacy impala timestamps)
+        raw = np.frombuffer(data[:12 * count], np.uint8).reshape(-1, 12)
+        nanos = raw[:, :8].copy().view("<u8").reshape(-1).astype(np.int64)
+        jdays = raw[:, 8:].copy().view("<u4").reshape(-1).astype(np.int64)
+        micros = (jdays - 2440588) * 86_400_000_000 + nanos // 1000
+        return micros, 12 * count
+    raise NotImplementedError(f"parquet physical type {ptype}")
+
+
+# ---------------------------------------------------------------------------
+# file reader
+# ---------------------------------------------------------------------------
+
+
+class ParquetColumn:
+    def __init__(self, name, ptype, type_length, optional, converted,
+                 scale, precision, logical):
+        self.name = name
+        self.ptype = ptype
+        self.type_length = type_length
+        self.optional = optional
+        self.converted = converted
+        self.scale = scale
+        self.precision = precision
+        self.logical = logical  # LogicalType struct (field-id dict)
+
+    def sql_type(self) -> T.Type:
+        """Parquet (physical, converted/logical) -> engine type
+        (reference: ParquetTypeUtils.getPrestoType)."""
+        c = self.converted
+        lt = self.logical or {}
+        if self.ptype == 0:
+            return T.BOOLEAN
+        if self.ptype == 1:  # INT32
+            if c == 6:  # DATE
+                return T.DATE
+            if c == 5 and self.precision:  # DECIMAL
+                return T.decimal(self.precision, self.scale)
+            return T.INTEGER
+        if self.ptype == 2:  # INT64
+            if c in (9, 10) or 8 in lt:  # TIMESTAMP_MILLIS/MICROS
+                return T.TIMESTAMP
+            if c == 5 and self.precision:
+                return T.decimal(self.precision, self.scale)
+            return T.BIGINT
+        if self.ptype == 3:
+            return T.TIMESTAMP
+        if self.ptype == 4:
+            return T.REAL
+        if self.ptype == 5:
+            return T.DOUBLE
+        if self.ptype in (6, 7):
+            if c == 0 or 1 in lt:  # UTF8 / StringType
+                return T.VARCHAR
+            if c == 5 and self.precision:
+                return T.decimal(self.precision, self.scale)
+            return T.VARBINARY
+        raise NotImplementedError(f"parquet type {self.ptype}")
+
+
+class ParquetFile:
+    """One .parquet file: schema + row groups, column-chunk decoding."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            f.seek(0, io.SEEK_END)
+            size = f.tell()
+            f.seek(size - 8)
+            meta_len = int.from_bytes(f.read(4), "little")
+            assert f.read(4) == MAGIC, "not a parquet file"
+            f.seek(size - 8 - meta_len)
+            meta_buf = f.read(meta_len)
+        md = _Thrift(meta_buf).read_struct()
+        # FileMetaData: 2=schema, 3=num_rows, 4=row_groups
+        self.num_rows = md.get(3, 0)
+        self.columns = self._parse_schema(md[2])
+        self.row_groups = md.get(4, [])
+
+    def _parse_schema(self, elements) -> List[ParquetColumn]:
+        # SchemaElement: 1=type, 2=type_length, 3=repetition_type,
+        # 4=name, 5=num_children, 6=converted_type, 7=scale,
+        # 8=precision, 10=logicalType
+        root = elements[0]
+        if root.get(5, 0) != len(elements) - 1:
+            # nested groups present: accept only the flat prefix
+            flat = []
+            i = 1
+            while i < len(elements):
+                el = elements[i]
+                if el.get(5):  # group node: skip its subtree
+                    raise NotImplementedError(
+                        "nested parquet schemas are not supported")
+                flat.append(el)
+                i += 1
+            elements = [root] + flat
+        out = []
+        for el in elements[1:]:
+            rep = el.get(3, 0)  # 0=required 1=optional 2=repeated
+            if rep == 2:
+                raise NotImplementedError("repeated parquet fields")
+            out.append(ParquetColumn(
+                name=el[4].decode(), ptype=el.get(1, 0),
+                type_length=el.get(2, 0), optional=rep == 1,
+                converted=el.get(6, -1), scale=el.get(7, 0),
+                precision=el.get(8, 0), logical=el.get(10)))
+        return out
+
+    # -- column chunk decode ------------------------------------------
+    def read_column(self, rg_index: int, col: ParquetColumn
+                    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """(values, validity) for one column chunk (reference:
+        reader/PageReader + the Plain/Dictionary column readers)."""
+        rg = self.row_groups[rg_index]
+        # RowGroup: 1=columns, 2=total_byte_size, 3=num_rows
+        chunk = None
+        for cc in rg[1]:
+            meta = cc[3]  # ColumnMetaData
+            path = [p.decode() for p in meta[3]]
+            if path == [col.name]:
+                chunk = meta
+                break
+        if chunk is None:
+            raise KeyError(f"column {col.name} not in row group")
+        codec = chunk.get(4, 0)
+        num_values = chunk[5]
+        data_off = chunk[9]
+        dict_off = chunk.get(11)
+        start = min(data_off, dict_off) if dict_off else data_off
+        total = chunk[7]  # total_compressed_size
+        with open(self.path, "rb") as f:
+            f.seek(start)
+            buf = f.read(total)
+
+        values = np.empty(num_values, object) \
+            if col.ptype in (6, 7) else np.empty(num_values, np.float64)
+        defined = np.ones(num_values, bool)
+        dictionary = None
+        filled = 0
+        typed_parts: List[np.ndarray] = []
+        i = 0
+        while filled < num_values:
+            th = _Thrift(buf, i)
+            ph = th.read_struct()
+            i = th.i
+            # PageHeader: 1=type, 2=uncompressed, 3=compressed,
+            # 5=data_page_header, 7=dictionary_page_header, 8=v2
+            ptype_pg = ph[1]
+            comp = ph[3]
+            raw = buf[i:i + comp]
+            i += comp
+            if ptype_pg == 2:  # DICTIONARY_PAGE
+                page = _decompress(codec, raw, ph[2])
+                dph = ph[7]  # 1=num_values, 2=encoding
+                dictionary, _ = _plain_decode(col.ptype, page, dph[1],
+                                              col.type_length)
+                continue
+            if ptype_pg == 0:  # DATA_PAGE v1
+                page = _decompress(codec, raw, ph[2])
+                dp = ph[5]  # 1=num_values, 2=encoding, 3=def_enc, 4=rep_enc
+                n = dp[1]
+                enc = dp[2]
+                pos = 0
+                if col.optional:
+                    ln = int.from_bytes(page[pos:pos + 4], "little")
+                    pos += 4
+                    levels = _rle_bp_decode(page[pos:pos + ln], 1, n)
+                    pos += ln
+                    present = levels.astype(bool)
+                else:
+                    present = np.ones(n, bool)
+            elif ptype_pg == 3:  # DATA_PAGE_V2
+                dp = ph[8]
+                # 1=num_values, 2=num_nulls, 3=num_rows, 4=encoding,
+                # 5=def_len, 6=rep_len, 7=is_compressed
+                n = dp[1]
+                enc = dp[4]
+                dlen = dp.get(5, 0)
+                rlen = dp.get(6, 0)
+                lev = raw[:dlen + rlen]
+                body = raw[dlen + rlen:]
+                if dp.get(7, True):
+                    body = _decompress(codec, body,
+                                       ph[2] - dlen - rlen)
+                if col.optional and dlen:
+                    levels = _rle_bp_decode(lev[rlen:rlen + dlen], 1, n)
+                    present = levels.astype(bool)
+                else:
+                    present = np.ones(n, bool)
+                page = body
+                pos = 0
+            else:
+                continue  # index pages etc.
+
+            n_present = int(present.sum())
+            if enc == 0:  # PLAIN
+                vals, _used = _plain_decode(col.ptype, page[pos:],
+                                            n_present, col.type_length)
+            elif enc in (2, 8):  # PLAIN_DICTIONARY / RLE_DICTIONARY
+                bw = page[pos]
+                pos += 1
+                idx = _rle_bp_decode(page[pos:], bw, n_present)
+                if dictionary is None:
+                    raise ValueError("dictionary page missing")
+                vals = dictionary[np.clip(idx, 0,
+                                          max(len(dictionary) - 1, 0))]
+            elif enc == 3:  # RLE (v2 boolean values; u32 length prefix)
+                ln = int.from_bytes(page[pos:pos + 4], "little")
+                pos += 4
+                vals = _rle_bp_decode(page[pos:pos + ln], 1,
+                                      n_present).astype(bool)
+            elif enc == 5:  # DELTA_BINARY_PACKED (v2 ints)
+                vals, _used = _delta_binary_decode(page[pos:], n_present)
+                if col.ptype == 1:
+                    vals = vals.astype(np.int32)
+            elif enc == 6:  # DELTA_LENGTH_BYTE_ARRAY (v2 strings)
+                lens, used = _delta_binary_decode(page[pos:], n_present)
+                body = page[pos + used:]
+                vals = np.empty(n_present, object)
+                o = 0
+                for k in range(n_present):
+                    ln = int(lens[k])
+                    vals[k] = bytes(body[o:o + ln])
+                    o += ln
+            elif enc == 7:  # DELTA_BYTE_ARRAY (prefix + suffix deltas)
+                pref, used1 = _delta_binary_decode(page[pos:], n_present)
+                sufl, used2 = _delta_binary_decode(
+                    page[pos + used1:], n_present)
+                body = page[pos + used1 + used2:]
+                vals = np.empty(n_present, object)
+                o = 0
+                prev = b""
+                for k in range(n_present):
+                    ln = int(sufl[k])
+                    prev = prev[:int(pref[k])] + bytes(body[o:o + ln])
+                    o += ln
+                    vals[k] = prev
+            else:
+                raise NotImplementedError(f"parquet encoding {enc}")
+            page_vals = np.empty(
+                n, object if col.ptype in (6, 7) else vals.dtype)
+            page_vals[present] = vals
+            typed_parts.append(page_vals)
+            defined[filled:filled + n] = present
+            filled += n
+
+        allv = np.concatenate(typed_parts) if typed_parts else \
+            np.empty(0, object)
+        valid = defined if col.optional and not defined.all() else None
+        return self._convert(col, allv, valid)
+
+    def _convert(self, col: ParquetColumn, vals: np.ndarray,
+                 valid: Optional[np.ndarray]):
+        """Physical values -> the engine's physical representation."""
+        t = col.sql_type()
+        fill0 = valid is not None
+        if t.name == "VARCHAR":
+            out = np.empty(len(vals), object)
+            for k, v in enumerate(vals):
+                out[k] = v.decode("utf-8", "replace") \
+                    if isinstance(v, bytes) else ("" if v is None else v)
+            return out, valid, t
+        if t.name == "VARBINARY":
+            out = np.empty(len(vals), object)
+            for k, v in enumerate(vals):
+                out[k] = v if isinstance(v, bytes) else b""
+            return out, valid, t
+        if t.is_decimal and col.ptype in (6, 7):
+            out = np.empty(len(vals), np.int64)
+            for k, v in enumerate(vals):
+                out[k] = int.from_bytes(v, "big", signed=True) \
+                    if isinstance(v, bytes) and len(v) else 0
+            return out, valid, t
+        if t.name == "TIMESTAMP" and col.ptype == 2:
+            arr = np.where(valid, vals, 0) if fill0 else vals
+            arr = arr.astype(np.int64)
+            if col.converted == 9 or _ts_unit_is_millis(col.logical):
+                arr = arr * 1000  # millis -> engine micros
+            return arr, valid, t
+        dt = t.numpy_dtype()
+        arr = np.where(valid, vals, 0) if fill0 else vals
+        return np.asarray(arr).astype(dt), valid, t
+
+
+def _ts_unit_is_millis(logical) -> bool:
+    # LogicalType: 8=TIMESTAMP{1=isAdjustedToUTC, 2=unit{1=MILLIS,...}}
+    try:
+        unit = logical[8][2]
+        return 1 in unit
+    except (KeyError, TypeError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# writer (reference: presto-parquet writer/ — ParquetWriter,
+# PrimitiveColumnWriter; PLAIN encoding, v1 data pages, one row group)
+# ---------------------------------------------------------------------------
+
+
+class _TWrite:
+    """Minimal thrift compact-protocol writer."""
+
+    def __init__(self):
+        self.out = bytearray()
+        self._fid = [0]
+
+    def varint(self, v: int) -> None:
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                self.out.append(b | 0x80)
+            else:
+                self.out.append(b)
+                return
+
+    def zigzag(self, v: int) -> None:
+        self.varint((v << 1) ^ (v >> 63) if v < 0 else v << 1)
+
+    def field(self, fid: int, ftype: int) -> None:
+        delta = fid - self._fid[-1]
+        if 0 < delta <= 15:
+            self.out.append((delta << 4) | ftype)
+        else:
+            self.out.append(ftype)
+            self.zigzag(fid)
+        self._fid[-1] = fid
+
+    def i32(self, fid: int, v: int) -> None:
+        self.field(fid, 5)
+        self.zigzag(v)
+
+    def i64(self, fid: int, v: int) -> None:
+        self.field(fid, 6)
+        self.zigzag(v)
+
+    def binary(self, fid: int, v: bytes) -> None:
+        self.field(fid, 8)
+        self.varint(len(v))
+        self.out += v
+
+    def begin_struct(self, fid: int) -> None:
+        self.field(fid, 12)
+        self._fid.append(0)
+
+    def end_struct(self) -> None:
+        self.out.append(0)
+        self._fid.pop()
+
+    def begin_list(self, fid: int, etype: int, size: int) -> None:
+        self.field(fid, 9)
+        if size < 15:
+            self.out.append((size << 4) | etype)
+        else:
+            self.out.append(0xF0 | etype)
+            self.varint(size)
+
+
+def _rle_encode_levels(levels: np.ndarray) -> bytes:
+    """Definition levels (bit width 1) as one RLE-run-per-change —
+    tiny and always valid."""
+    out = bytearray()
+    i = 0
+    n = len(levels)
+    while i < n:
+        v = int(levels[i])
+        j = i
+        while j < n and levels[j] == v:
+            j += 1
+        run = j - i
+        hdr = run << 1  # RLE run
+        while True:
+            b = hdr & 0x7F
+            hdr >>= 7
+            if hdr:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        out.append(v)
+        i = j
+    return bytes(out)
+
+
+def _parquet_physical(t: T.Type):
+    """engine type -> (physical type id, converted type id or -1)."""
+    if t.name == "BOOLEAN":
+        return 0, -1
+    if t.name in ("TINYINT", "SMALLINT", "INTEGER"):
+        return 1, -1
+    if t.name == "DATE":
+        return 1, 6
+    if t.name == "BIGINT" or (t.is_decimal and not t.is_long_decimal):
+        return 2, 5 if t.is_decimal else -1
+    if t.name == "TIMESTAMP":
+        return 2, 10  # TIMESTAMP_MICROS
+    if t.name == "REAL":
+        return 4, -1
+    if t.name == "DOUBLE":
+        return 5, -1
+    if t.name == "VARBINARY":
+        return 6, -1
+    if t.is_string:
+        return 6, 0  # BYTE_ARRAY + UTF8
+    raise NotImplementedError(f"parquet write of {t}")
+
+
+def _plain_encode(ptype: int, vals, t: T.Type) -> bytes:
+    if ptype == 0:
+        return np.packbits(np.asarray(vals, bool),
+                           bitorder="little").tobytes()
+    if ptype == 1:
+        return np.asarray(vals).astype("<i4").tobytes()
+    if ptype == 2:
+        return np.asarray(vals).astype("<i8").tobytes()
+    if ptype == 4:
+        return np.asarray(vals).astype("<f4").tobytes()
+    if ptype == 5:
+        return np.asarray(vals).astype("<f8").tobytes()
+    out = bytearray()
+    for v in vals:
+        b = v.encode() if isinstance(v, str) else \
+            (bytes(v) if v is not None else b"")
+        out += len(b).to_bytes(4, "little")
+        out += b
+    return bytes(out)
+
+
+def write_parquet(path: str, arrays: Dict[str, np.ndarray],
+                  schema: Dict[str, T.Type]) -> int:
+    """Write one row group of PLAIN-encoded v1 pages (uncompressed).
+    Readable by this module AND by any conformant reader — the tests
+    cross-check with an independent implementation."""
+    cols = list(schema)
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    body = io.BytesIO()
+    body.write(MAGIC)
+    chunk_meta = []
+    for c in cols:
+        t = schema[c]
+        a = arrays[c]
+        if isinstance(a, np.ma.MaskedArray):
+            valid = ~np.ma.getmaskarray(a)
+            a = a.filled("" if t.is_string else 0)
+        else:
+            valid = None
+        ptype, conv = _parquet_physical(t)
+        optional = valid is not None
+        if optional:
+            levels = valid.astype(np.int64)
+            lev = _rle_encode_levels(levels)
+            lev_block = len(lev).to_bytes(4, "little") + lev
+            vals = np.asarray(a)[valid]
+        else:
+            lev_block = b""
+            vals = np.asarray(a)
+        payload = lev_block + _plain_encode(ptype, vals, t)
+        ph = _TWrite()
+        ph.i32(1, 0)  # type = DATA_PAGE
+        ph.i32(2, len(payload))  # uncompressed
+        ph.i32(3, len(payload))  # compressed (none)
+        ph.begin_struct(5)  # data_page_header
+        ph.i32(1, n)
+        ph.i32(2, 0)  # PLAIN
+        ph.i32(3, 3)  # def levels: RLE
+        ph.i32(4, 3)  # rep levels: RLE
+        ph.end_struct()
+        ph.out.append(0)  # end PageHeader struct
+        off = body.tell()
+        body.write(bytes(ph.out))
+        body.write(payload)
+        total = body.tell() - off
+        chunk_meta.append((c, ptype, conv, off, total, optional, t))
+
+    # FileMetaData
+    md = _TWrite()
+    md.i32(1, 1)  # version
+    # schema list: root + columns
+    md.begin_list(2, 12, len(cols) + 1)
+    root = _TWrite()
+    root.binary(4, b"schema")
+    root.i32(5, len(cols))
+    root.out.append(0)
+    md.out += root.out
+    for c, ptype, conv, _off, _tot, optional, t in chunk_meta:
+        el = _TWrite()
+        el.i32(1, ptype)
+        el.i32(3, 1 if optional else 0)  # repetition
+        el.binary(4, c.encode())
+        if conv >= 0:
+            el.i32(6, conv)
+        if t.is_decimal:
+            el.i32(7, t.decimal_scale)
+            el.i32(8, t.decimal_precision)
+        el.out.append(0)
+        md.out += el.out
+    md.i64(3, n)  # num_rows
+    md.begin_list(4, 12, 1)  # one row group
+    rg = _TWrite()
+    rg.begin_list(1, 12, len(cols))
+    total_bytes = 0
+    for c, ptype, conv, off, tot, optional, t in chunk_meta:
+        cc = _TWrite()
+        cc.i64(2, off)  # file_offset
+        cc.begin_struct(3)  # ColumnMetaData
+        cc.i32(1, ptype)
+        cc.begin_list(2, 5, 1)
+        cc.zigzag(0)  # encodings: [PLAIN]
+        cc.begin_list(3, 8, 1)
+        cc.varint(len(c.encode()))
+        cc.out += c.encode()
+        cc.i32(4, 0)  # codec: UNCOMPRESSED
+        cc.i64(5, n)  # num_values
+        cc.i64(6, tot)  # total_uncompressed_size
+        cc.i64(7, tot)  # total_compressed_size
+        cc.i64(9, off)  # data_page_offset
+        cc.end_struct()
+        cc.out.append(0)  # end ColumnChunk
+        rg.out += cc.out
+        total_bytes += tot
+    rg.i64(2, total_bytes)
+    rg.i64(3, n)
+    rg.out.append(0)  # end RowGroup
+    md.out += rg.out
+    md.out.append(0)  # end FileMetaData
+    meta = bytes(md.out)
+    body.write(meta)
+    body.write(len(meta).to_bytes(4, "little"))
+    body.write(MAGIC)
+    with open(path, "wb") as f:
+        f.write(body.getvalue())
+    return n

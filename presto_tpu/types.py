@@ -60,9 +60,11 @@ class Type:
 
     @property
     def is_string(self) -> bool:
-        # JSON is a distinct logical type (spi/type/JsonType) but shares
-        # the dictionary-encoded physical form and string compute paths
-        return self.name in ("VARCHAR", "CHAR", "JSON")
+        # JSON/VARBINARY are distinct logical types (spi/type/JsonType,
+        # VarbinaryType) but share the dictionary-encoded physical form
+        # and string compute paths (VARBINARY dictionary values are
+        # python bytes)
+        return self.name in ("VARCHAR", "CHAR", "JSON", "VARBINARY")
 
     @property
     def is_temporal(self) -> bool:
@@ -106,6 +108,7 @@ TIMESTAMP = Type("TIMESTAMP")
 INTERVAL_DAY_TIME = Type("INTERVAL_DAY_TIME")
 INTERVAL_YEAR_MONTH = Type("INTERVAL_YEAR_MONTH")
 JSON = Type("JSON")
+VARBINARY = Type("VARBINARY")
 UNKNOWN = Type("UNKNOWN")  # the NULL literal's type
 
 
@@ -197,6 +200,7 @@ _PHYSICAL = {
     "VARCHAR": np.int32,  # dictionary code
     "CHAR": np.int32,  # dictionary code
     "JSON": np.int32,  # dictionary code
+    "VARBINARY": np.int32,  # dictionary code over bytes values
     "DATE": np.int32,
     "TIMESTAMP": np.int64,
     "INTERVAL_DAY_TIME": np.int64,
@@ -275,6 +279,7 @@ def parse_type(text: str) -> Type:
         "TIMESTAMP": TIMESTAMP,
         "DECIMAL": decimal(18, 0),
         "JSON": JSON,
+        "VARBINARY": VARBINARY,
         "HLL": HLL,
         "HYPERLOGLOG": HLL,
         "QDIGEST": qdigest_of(DOUBLE),

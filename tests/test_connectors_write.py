@@ -196,3 +196,31 @@ def test_shard_zone_map_pruning(tmp_path):
     assert (full["k"] == k).all()
     assert (full["v"] == v).all()
     assert (full["s"] == s).all()
+
+
+def test_scaled_writer_scales_with_backlog(tmp_path):
+    """P4 scaled-writer redistribution (reference:
+    execution/scheduler/ScaledWriterScheduler.java): a small append uses
+    ONE writer; a large append scales writer threads with the page
+    backlog, bounded by MAX_WRITERS, and every page lands as a shard the
+    read path reassembles exactly."""
+    import numpy as np
+
+    from presto_tpu import types as T
+    from presto_tpu.connectors.localfile import LocalFileTable
+
+    t = LocalFileTable("w", str(tmp_path / "w"),
+                       {"a": T.BIGINT, "b": T.DOUBLE})
+    small = {"a": np.arange(1000), "b": np.arange(1000) * 0.5}
+    assert t.append(small) == 1000
+    assert t.last_writers_used == 1
+
+    n = LocalFileTable.WRITER_PAGE_ROWS * 6 + 17
+    big = {"a": np.arange(n, dtype=np.int64),
+           "b": np.arange(n, dtype=np.float64)}
+    assert t.append(big) == n
+    assert 2 <= t.last_writers_used <= LocalFileTable.MAX_WRITERS
+    assert t.row_count() == 1000 + n
+    back = t.read(["a"])["a"]
+    assert back[:1000].tolist() == small["a"].tolist()
+    assert (back[1000:] == big["a"]).all()

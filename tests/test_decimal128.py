@@ -209,3 +209,51 @@ def test_ingest_38_digit_strings():
     sess = presto_tpu.connect(cat)
     rows = sess.sql("SELECT v FROM big ORDER BY v").rows
     assert rows == [(Decimal(vals[1]),), (Decimal(vals[0]),)]
+
+
+def test_deep_rescale_rounding_exact(s):
+    # round-4 ADVICE: scale_down_round used an approximate f64 remainder
+    # for k > 18, so half-away rounding could err on large rescales.
+    # The chain's LAST remainder decides exactly; probe right at the
+    # half boundary 25 digits down, where f64 cannot represent the tie.
+    lo = "4" + "9" * 24          # .4999... -> round DOWN
+    hi_ = "5" + "0" * 23 + "1"   # .5000..1 -> round UP
+    tie = "5" + "0" * 24         # exactly half -> round UP (away from 0)
+    for frac, want in [(lo, 7), (hi_, 8), (tie, 8)]:
+        got = one(s, f"SELECT CAST(CAST('7.{frac}' AS DECIMAL(38,25)) "
+                      "AS DECIMAL(38,0))")
+        assert got == Decimal(want), (frac, got)
+        got = one(s, f"SELECT CAST(CAST('-7.{frac}' AS DECIMAL(38,25)) "
+                      "AS DECIMAL(38,0))")
+        assert got == Decimal(-want), (frac, got)
+
+
+def test_long_decimal_to_bigint_overflow(s):
+    # round-4 ADVICE: CAST(long decimal AS BIGINT) silently wrapped when
+    # the rounded magnitude exceeded int64; reference raises
+    big = "99999999999999999999"  # 20 digits > int64 range
+    with pytest.raises(Exception):
+        s.sql(f"SELECT CAST(CAST('{big}.00' AS DECIMAL(38,2)) AS BIGINT)")
+    assert one(s, f"SELECT TRY_CAST(CAST('{big}.00' AS DECIMAL(38,2)) "
+                  "AS BIGINT)") is None
+    # in-range values still cast with rounding
+    assert one(s, "SELECT CAST(CAST('41.50' AS DECIMAL(38,2)) "
+                  "AS BIGINT)") == 42
+
+
+def test_desc_sort_low_limb_tie():
+    # round-4 ADVICE: DESC negation mapped both I64_MIN and I64_MIN+1 of
+    # the biased low limb to I64_MAX — values differing only in low limb
+    # 0 vs 1 under one high limb tied.  2^64*k, 2^64*k + 1 hit exactly
+    # that pair after the sign-bias.
+    from presto_tpu import types as T
+
+    k = 3 << 64
+    vals = [k, k + 1, k - 1]
+    strs = [str(v) for v in vals]
+    cat = Catalog()
+    cat.register_memory("t", {"v": T.decimal(38, 0)},
+                        {"v": np.asarray(strs, dtype=object)})
+    sess = presto_tpu.connect(cat)
+    rows = [r[0] for r in sess.sql("SELECT v FROM t ORDER BY v DESC").rows]
+    assert rows == [Decimal(k + 1), Decimal(k), Decimal(k - 1)]

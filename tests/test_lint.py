@@ -1,0 +1,18 @@
+"""Static-analysis gate (round-4; reference: the error-prone +
+checkstyle + modernizer stack in the root pom).  tools/lint.py is the
+in-repo checker; the suite is red whenever it finds anything."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_lint_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+         os.path.join(ROOT, "presto_tpu"),
+         os.path.join(ROOT, "tools")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, f"lint findings:\n{r.stdout}"

@@ -1,0 +1,139 @@
+"""ORC read path (storage/orc.py + connectors/orc.py) validated against
+an INDEPENDENT implementation: pyarrow.orc writes every file our
+decoder reads — all codecs, RLEv2 sub-encodings, dictionary strings,
+present streams, multiple stripes.
+
+Reference parity target: presto-orc/ readers via the hive connector's
+OrcPageSourceFactory."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.orc as po
+import pytest
+
+import presto_tpu
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.orc import OrcTable
+from presto_tpu.storage.orc import OrcFile, _IntRle
+
+
+@pytest.fixture()
+def rich_table():
+    rng = np.random.default_rng(7)
+    n = 6000
+    return pa.table({
+        "i32": pa.array(rng.integers(-1000, 1000, n), pa.int32()),
+        "i64": pa.array(rng.integers(-10**12, 10**12, n), pa.int64()),
+        "f32": pa.array(rng.normal(size=n).astype(np.float32)),
+        "f64": pa.array(rng.normal(size=n)),
+        "s": pa.array([f"val{int(x)}" for x in rng.integers(0, 60, n)]),
+        "b": pa.array(rng.integers(0, 2, n).astype(bool)),
+        "opt": pa.array([None if x % 5 == 0 else int(x)
+                         for x in range(n)], pa.int64()),
+        "d": pa.array(rng.integers(0, 20000, n).astype(np.int32),
+                      pa.date32()),
+        "mono": pa.array(np.cumsum(rng.integers(0, 3, n)), pa.int64()),
+    })
+
+
+def _assert_matches(path, table):
+    ours = OrcFile(path)
+    want = table.to_pydict()
+    assert ours.num_rows == table.num_rows
+    by_name = {c.name: c for c in ours.columns}
+    for name in table.column_names:
+        col = by_name[name]
+        got, ok = [], []
+        for si in range(len(ours.stripes)):
+            vals, valid, _t = ours.read_column(si, col)
+            got.extend(vals.tolist())
+            ok.extend(valid.tolist() if valid is not None
+                      else [True] * len(vals))
+        for g, o, e in zip(got, ok, want[name]):
+            if e is None:
+                assert not o, (name, g)
+                continue
+            assert o, (name, e)
+            if hasattr(e, "toordinal"):
+                e = e.toordinal() - 719163
+            if isinstance(e, float):
+                assert g == pytest.approx(e, rel=1e-6)
+            else:
+                assert g == e, (name, g, e)
+
+
+@pytest.mark.parametrize("codec", ["uncompressed", "zlib", "snappy",
+                                   "zstd", "lz4"])
+def test_read_pyarrow_orc_all_codecs(tmp_path, rich_table, codec):
+    p = str(tmp_path / f"t_{codec}.orc")
+    po.write_table(rich_table, p, compression=codec)
+    _assert_matches(p, rich_table)
+
+
+def test_multiple_stripes(tmp_path, rich_table):
+    p = str(tmp_path / "stripes.orc")
+    po.write_table(rich_table, p, stripe_size=16384, batch_size=1000)
+    f = OrcFile(p)
+    assert len(f.stripes) > 1  # the per-stripe path is really exercised
+    _assert_matches(p, rich_table)
+
+
+def test_rlev2_subencodings_roundtrip(tmp_path):
+    """Data shaped to force each RLE v2 sub-encoding: constant runs
+    (short repeat), random (direct), monotonic (delta), and skewed
+    outliers (patched base)."""
+    n = 2000
+    rng = np.random.default_rng(3)
+    base = rng.integers(0, 100, n)
+    base[::97] = 10**9  # outliers -> patched base candidates
+    tbl = pa.table({
+        "const": pa.array(np.full(n, 42), pa.int64()),
+        "rand": pa.array(rng.integers(-10**9, 10**9, n), pa.int64()),
+        "mono": pa.array(np.arange(n) * 3 + 7, pa.int64()),
+        "skew": pa.array(base, pa.int64()),
+    })
+    p = str(tmp_path / "rle2.orc")
+    po.write_table(tbl, p, compression="uncompressed")
+    _assert_matches(p, tbl)
+
+
+def test_orc_connector_sql(tmp_path, rich_table):
+    p = str(tmp_path / "t.orc")
+    po.write_table(rich_table, p, compression="zstd")
+    cat = Catalog()
+    cat.register(OrcTable("orc_t", p))
+    s = presto_tpu.connect(cat)
+    want = rich_table.to_pydict()
+    assert s.sql("SELECT count(*) FROM orc_t").rows[0][0] \
+        == rich_table.num_rows
+    total = s.sql("SELECT sum(i64), count(opt) FROM orc_t").rows[0]
+    assert total[0] == sum(want["i64"])
+    assert total[1] == sum(1 for v in want["opt"] if v is not None)
+    top = s.sql("SELECT s, count(*) c FROM orc_t GROUP BY s "
+                "ORDER BY c DESC, s LIMIT 3").rows
+    import collections
+
+    cnt = collections.Counter(want["s"])
+    expect = sorted(cnt.items(), key=lambda kv: (-kv[1], kv[0]))[:3]
+    assert [(r[0], r[1]) for r in top] == expect
+
+
+def test_orc_splits_align_to_stripes(tmp_path, rich_table):
+    p = str(tmp_path / "t.orc")
+    po.write_table(rich_table, p, stripe_size=16384, batch_size=1000)
+    t = OrcTable("t", p)
+    splits = t.splits(4)
+    assert sum(b - a for a, b in splits) == rich_table.num_rows
+    got = np.concatenate([t.read(["i64"], sp)["i64"] for sp in splits])
+    assert got.tolist() == rich_table.to_pydict()["i64"]
+
+
+def test_int_rle_v1():
+    # v1 run: header=2 (5 values), delta=1, base=100 (varint 100)
+    data = bytes([2, 1, 100])
+    vals = _IntRle(data, signed=False, v2=False).read(5)
+    assert vals.tolist() == [100, 101, 102, 103, 104]
+    # v1 literals: header=0xFE (2 literals), zigzag varints 1, -1
+    data = bytes([0xFE, 2, 1])
+    vals = _IntRle(data, signed=True, v2=False).read(2)
+    assert vals.tolist() == [1, -1]

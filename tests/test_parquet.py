@@ -1,0 +1,206 @@
+"""Parquet read/write path (storage/parquet.py + connectors/parquet.py)
+validated against an INDEPENDENT implementation: pyarrow writes the
+files our decoder reads (every codec/encoding combination), and pyarrow
+reads back the files our encoder writes.
+
+Reference parity targets: presto-parquet readers + writer, the hive
+connector's parquet page source."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.catalog import Catalog
+from presto_tpu.storage.parquet import (ParquetFile, snappy_decompress,
+                                        write_parquet)
+
+
+@pytest.fixture()
+def rich_table():
+    rng = np.random.default_rng(5)
+    n = 5000
+    return pa.table({
+        "i32": pa.array(rng.integers(-100, 100, n), pa.int32()),
+        "i64": pa.array(rng.integers(-10**12, 10**12, n), pa.int64()),
+        "f32": pa.array(rng.normal(size=n).astype(np.float32)),
+        "f64": pa.array(rng.normal(size=n)),
+        "b": pa.array(rng.integers(0, 2, n).astype(bool)),
+        "s": pa.array([f"v{int(x)}" for x in rng.integers(0, 50, n)]),
+        "d": pa.array(rng.integers(0, 20000, n).astype(np.int32),
+                      pa.date32()),
+        "opt": pa.array([None if x % 7 == 0 else int(x)
+                         for x in range(n)], pa.int64()),
+    })
+
+
+def _assert_matches(path, table):
+    ours = ParquetFile(path)
+    want = table.to_pydict()
+    assert ours.num_rows == table.num_rows
+    by_name = {c.name: c for c in ours.columns}
+    for name in table.column_names:
+        col = by_name[name]
+        allv = []
+        allok = []
+        for gi in range(len(ours.row_groups)):
+            vals, valid, _t = ours.read_column(gi, col)
+            allv.extend(vals.tolist())
+            allok.extend(valid.tolist() if valid is not None
+                         else [True] * len(vals))
+        for got, ok, exp in zip(allv, allok, want[name]):
+            if exp is None:
+                assert not ok, (name, got, exp)
+            else:
+                assert ok, (name, exp)
+                if isinstance(exp, float):
+                    assert got == pytest.approx(exp, rel=1e-6)
+                elif hasattr(exp, "toordinal"):  # date32 -> engine days
+                    assert got == exp.toordinal() - 719163
+                else:
+                    assert got == exp, (name, got, exp)
+
+
+@pytest.mark.parametrize("codec", ["none", "snappy", "gzip", "zstd"])
+@pytest.mark.parametrize("dictionary", [True, False])
+def test_read_pyarrow_files(tmp_path, rich_table, codec, dictionary):
+    p = str(tmp_path / f"t_{codec}_{dictionary}.parquet")
+    pq.write_table(rich_table, p, compression=codec,
+                   use_dictionary=dictionary, row_group_size=1500)
+    _assert_matches(p, rich_table)
+
+
+def test_read_data_page_v2(tmp_path, rich_table):
+    p = str(tmp_path / "v2.parquet")
+    pq.write_table(rich_table, p, compression="zstd",
+                   data_page_version="2.0", row_group_size=2000)
+    _assert_matches(p, rich_table)
+
+
+def test_snappy_decompress_roundtrip():
+    # snappy golden vectors: literals + every copy-tag width via a
+    # repetitive buffer that compresses with overlapping copies
+    try:
+        import pyarrow as _pa
+
+        comp = _pa.compress(b"ab" * 400 + b"unique-tail", codec="snappy",
+                            asbytes=True)
+        assert snappy_decompress(comp) == b"ab" * 400 + b"unique-tail"
+    except (ImportError, AttributeError):
+        pytest.skip("no snappy compressor available to test against")
+
+
+def test_our_writer_read_by_pyarrow(tmp_path):
+    p = str(tmp_path / "ours.parquet")
+    arrays = {
+        "a": np.arange(100, dtype=np.int64),
+        "s": np.asarray([f"s{i % 9}" for i in range(100)], dtype=object),
+        "f": np.ma.masked_array(np.arange(100) * 0.5,
+                                np.arange(100) % 5 == 0),
+        "flag": np.arange(100) % 3 == 0,
+    }
+    schema = {"a": T.BIGINT, "s": T.VARCHAR, "f": T.DOUBLE,
+              "flag": T.BOOLEAN}
+    write_parquet(p, arrays, schema)
+    t = pq.read_table(p)  # the independent reader
+    assert t.column("a").to_pylist() == list(range(100))
+    assert t.column("s").to_pylist() == [f"s{i % 9}" for i in range(100)]
+    got_f = t.column("f").to_pylist()
+    for i, v in enumerate(got_f):
+        if i % 5 == 0:
+            assert v is None
+        else:
+            assert v == i * 0.5
+    assert t.column("flag").to_pylist() == [i % 3 == 0
+                                            for i in range(100)]
+
+
+def test_parquet_connector_sql(tmp_path, rich_table):
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(rich_table, p, compression="zstd", row_group_size=1000)
+    cat = Catalog()
+    cat.register_parquet("pq_t", p)
+    s = presto_tpu.connect(cat)
+    want = rich_table.to_pydict()
+    n = s.sql("SELECT count(*) FROM pq_t").rows[0][0]
+    assert n == rich_table.num_rows
+    total = s.sql("SELECT sum(i64), count(opt) FROM pq_t").rows[0]
+    assert total[0] == sum(want["i64"])
+    assert total[1] == sum(1 for v in want["opt"] if v is not None)
+    top = s.sql("SELECT s, count(*) c FROM pq_t GROUP BY s "
+                "ORDER BY c DESC, s LIMIT 3").rows
+    import collections
+
+    cnt = collections.Counter(want["s"])
+    expect = sorted(cnt.items(), key=lambda kv: (-kv[1], kv[0]))[:3]
+    assert [(r[0], r[1]) for r in top] == expect
+
+
+def test_parquet_ctas_and_insert(tmp_path):
+    cat = Catalog()
+    s = presto_tpu.connect(cat)
+    s.set("localfile_root", str(tmp_path))
+    s.sql("CREATE TABLE pt WITH (connector = 'parquet') AS "
+          "SELECT a, a * 2 AS b FROM (VALUES (1), (2), (3)) t(a)")
+    assert s.sql("SELECT sum(b) FROM pt").rows == [(12,)]
+    s.sql("INSERT INTO pt SELECT a, a * 2 FROM (VALUES (10)) t(a)")
+    assert s.sql("SELECT count(*), sum(b) FROM pt").rows == [(4, 32)]
+    # files readable by the independent implementation
+    files = [f for f in os.listdir(tmp_path / "pt")
+             if f.endswith(".parquet")]
+    assert len(files) == 2
+    back = pq.read_table(str(tmp_path / "pt"))
+    assert sorted(back.column("a").to_pylist()) == [1, 2, 3, 10]
+
+
+def test_parquet_splits_align_to_row_groups(tmp_path, rich_table):
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(rich_table, p, row_group_size=1000)
+    from presto_tpu.connectors.parquet import ParquetTable
+
+    t = ParquetTable("t", p)
+    splits = t.splits(4)
+    assert sum(b - a for a, b in splits) == rich_table.num_rows
+    for a, b in splits:
+        assert a % 1000 == 0  # snapped to row-group boundaries
+    # split reads reassemble exactly
+    got = np.concatenate([t.read(["i64"], sp)["i64"] for sp in splits])
+    assert got.tolist() == rich_table.to_pydict()["i64"]
+
+
+def test_read_data_page_v2_no_dictionary(tmp_path, rich_table):
+    """v2 pages without dictionaries use the DELTA encodings
+    (DELTA_BINARY_PACKED ints, DELTA_BYTE_ARRAY strings)."""
+    p = str(tmp_path / "v2nd.parquet")
+    pq.write_table(rich_table, p, use_dictionary=False,
+                   data_page_version="2.0", row_group_size=2000)
+    _assert_matches(p, rich_table)
+
+
+def test_read_forced_delta_encodings(tmp_path):
+    """DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY / DELTA_BYTE_ARRAY
+    forced explicitly (pyarrow only emits them on request)."""
+    n = 4000
+    rng = np.random.default_rng(9)
+    tbl = pa.table({
+        "i": pa.array(rng.integers(-10**9, 10**9, n), pa.int64()),
+        "j": pa.array(np.cumsum(rng.integers(0, 5, n)), pa.int32()),
+        "s": pa.array([f"prefix_{i // 10}_{i}" for i in range(n)]),
+    })
+    for enc in ("DELTA_BINARY_PACKED", "DELTA_LENGTH_BYTE_ARRAY",
+                "DELTA_BYTE_ARRAY"):
+        p = str(tmp_path / f"{enc}.parquet")
+        col_enc = {"i": "DELTA_BINARY_PACKED",
+                   "j": "DELTA_BINARY_PACKED", "s": enc} \
+            if enc != "DELTA_BINARY_PACKED" else enc
+        try:
+            pq.write_table(tbl, p, use_dictionary=False,
+                           column_encoding=col_enc,
+                           data_page_version="2.0")
+        except Exception:
+            continue  # encoding not writable by this pyarrow build
+        _assert_matches(p, tbl)

@@ -212,3 +212,55 @@ def test_web_ui_served(server):
         assert r.headers["Content-Type"].startswith("text/html")
         html = r.read().decode()
     assert "presto_tpu" in html and "/v1/statement" in html
+
+
+def test_query_detail_plan_and_timeline(server):
+    """Round-4 UI depth (reference: webapp query.jsx/plan.jsx/
+    timeline.jsx): /v1/query/{id} serves the plan pane, phase
+    breakdown and wall-clock span; /v1/query rows carry the timeline
+    fields."""
+    import json
+    import urllib.request
+
+    client = StatementClient(
+        server.uri, "SELECT n_name, count(*) c FROM customer, nation "
+                    "WHERE c_nationkey = n_nationkey GROUP BY n_name "
+                    "ORDER BY c DESC LIMIT 3")
+    assert len(list(client.rows())) == 3
+    hist = json.loads(urllib.request.urlopen(
+        f"{server.uri}/v1/query").read())
+    q = [x for x in hist if "n_nationkey" in (x.get("query") or "")][-1]
+    assert q["createTime"] > 0 and q["endTime"] >= q["createTime"]
+    detail = json.loads(urllib.request.urlopen(
+        f"{server.uri}/v1/query/{q['queryId']}").read())
+    assert detail["state"] == "FINISHED"
+    assert "Join" in detail["planText"]  # the plan pane has a real plan
+    assert "phaseMillis" in detail and detail["phaseMillis"]
+    assert detail["executionMode"]
+
+
+def test_query_detail_node_stats_dynamic(server):
+    """Per-node stats populate the detail view for dynamic runs
+    (fused modes run as one XLA program by design)."""
+    import json
+    import urllib.request
+
+    server.session.set("collect_node_stats", True)
+    server.session.set("execution_mode", "dynamic")
+    try:
+        client = StatementClient(
+            server.uri, "SELECT r_name, count(*) FROM region, nation "
+                        "WHERE r_regionkey = n_regionkey GROUP BY r_name")
+        assert len(list(client.rows())) == 5
+        hist = json.loads(urllib.request.urlopen(
+            f"{server.uri}/v1/query").read())
+        q = [x for x in hist
+             if "r_regionkey" in (x.get("query") or "")][-1]
+        detail = json.loads(urllib.request.urlopen(
+            f"{server.uri}/v1/query/{q['queryId']}").read())
+        kinds = {n["kind"] for n in detail["nodes"]}
+        assert "Join" in kinds and "Aggregate" in kinds
+        assert all(n["wallMillis"] >= 0 for n in detail["nodes"])
+    finally:
+        server.session.set("collect_node_stats", False)
+        server.session.set("execution_mode", "auto")

@@ -1,0 +1,78 @@
+"""Second-oracle verifier for the TPC-DS queries whose sqlite oracle is
+BUILDER-REWRITTEN SQL (tests/tpcds_queries.py SQLITE_OVERRIDES — e.g. the
+hand-expanded ROLLUP unions) plus q89's widened-tolerance case (round-3
+VERDICT item 6: a rewrite bug could mask an engine bug when only one
+oracle exists).
+
+Reference analog: presto-verifier runs each query against two independent
+clusters and compares row checksums (presto-verifier/.../checksum/).
+Here the two "clusters" are the engine's independent execution paths —
+per-op dynamic dispatch vs the whole-fragment compiled executor vs the
+8-virtual-device distributed mesh — which share the planner but nothing
+below it.  The rewritten sqlite text plays no part, so agreement is an
+independent second opinion on exactly the queries the rewrites cover.
+"""
+
+import pytest
+
+import presto_tpu
+from presto_tpu.catalog import tpcds_catalog
+from tests.tpcds_queries import QUERIES, SQLITE_OVERRIDES
+
+SF = 0.01
+VERIFY_QIDS = sorted(SQLITE_OVERRIDES) + [89]
+
+
+def _norm_rows(rows):
+    """Order-insensitive normalized rows: floats rounded to absorb
+    summation-order ULP noise between executors."""
+    out = []
+    for r in rows:
+        out.append(tuple(round(v, 4) if isinstance(v, float) else v
+                         for v in r))
+    return sorted(out, key=repr)
+
+
+def _checksum(rows):
+    import hashlib
+
+    h = hashlib.sha256()
+    for r in _norm_rows(rows):
+        h.update(repr(r).encode())
+    return h.hexdigest()
+
+
+@pytest.fixture(scope="module")
+def sessions():
+    cat = tpcds_catalog(SF, cache_dir="/tmp/presto_tpu_cache")
+    dyn = presto_tpu.connect(cat)
+    dyn.set("execution_mode", "dynamic")
+    comp = presto_tpu.connect(cat)
+    comp.set("execution_mode", "auto")
+    dist = presto_tpu.connect(cat)
+    dist.set("distributed", True)
+    return dyn, comp, dist
+
+
+# the distributed leg recompiles an 8-device mesh program per query
+# (~minutes each on the CPU test mesh); a rotating sample keeps suite
+# wall-clock bounded while every query still gets the dynamic/compiled
+# cross-check
+DIST_QIDS = VERIFY_QIDS[::5]
+
+
+@pytest.mark.parametrize("qid", VERIFY_QIDS)
+def test_override_query_checksum_across_executors(sessions, qid):
+    dyn, comp, dist = sessions
+    sql = QUERIES[qid]
+    rows_dyn = dyn.sql(sql).rows
+    assert rows_dyn, f"q{qid}: empty result verifies nothing"
+    cs_dyn = _checksum(rows_dyn)
+    cs_comp = _checksum(comp.sql(sql).rows)
+    assert cs_dyn == cs_comp, f"q{qid}: dynamic vs compiled disagree"
+    if qid in DIST_QIDS:
+        # distributed mesh: falls back identically when a shape cannot
+        # distribute, which still exercises an independent code path
+        cs_dist = _checksum(dist.sql(sql).rows)
+        assert cs_dyn == cs_dist, \
+            f"q{qid}: dynamic vs distributed disagree"

@@ -1,0 +1,137 @@
+"""In-repo static-analysis gate (round-4; reference: the build-time
+error-prone + checkstyle + modernizer stack wired into the root pom —
+src/checkstyle/checkstyle.xml).  No third-party linters ship in this
+environment, so the gate is a small AST checker covering the
+error-prone-class mistakes that bite this codebase:
+
+- syntax (compileall)
+- unused imports (module scope; `# noqa` opt-out per line)
+- bare `except:` (swallows KeyboardInterrupt/SystemExit)
+- mutable default arguments
+- `== None` / `!= None` comparisons
+- re-defined top-level functions/classes in one module
+
+Run: python tools/lint.py [paths...]   (exit 1 on findings)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+
+def _noqa_lines(src: str):
+    return {i + 1 for i, line in enumerate(src.splitlines())
+            if "# noqa" in line}
+
+
+def check_file(path: str):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
+    noqa = _noqa_lines(src)
+    problems = []
+
+    # ---- imports: collect bindings and usages -----------------------
+    imports = {}  # name -> (lineno, display)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imports[name] = (node.lineno, a.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # compiler directive, not a binding
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                name = a.asname or a.name
+                imports[name] = (node.lineno, f"{node.module}.{a.name}")
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            v = node
+            while isinstance(v, ast.Attribute):
+                v = v.value
+            if isinstance(v, ast.Name):
+                used.add(v.id)
+    # names re-exported via __all__ count as used
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" \
+                        and isinstance(node.value, (ast.List, ast.Tuple)):
+                    for e in node.value.elts:
+                        if isinstance(e, ast.Constant) \
+                                and isinstance(e.value, str):
+                            used.add(e.value)
+    for name, (lineno, disp) in imports.items():
+        if name not in used and lineno not in noqa:
+            problems.append((path, lineno, f"unused import: {disp}"))
+
+    # ---- bare except / mutable defaults / == None -------------------
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None \
+                and node.lineno not in noqa:
+            problems.append((path, node.lineno,
+                             "bare `except:` (catches SystemExit)"))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) \
+                    + [x for x in node.args.kw_defaults if x is not None]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) \
+                        and d.lineno not in noqa:
+                    problems.append(
+                        (path, d.lineno,
+                         f"mutable default argument in {node.name}()"))
+        if isinstance(node, ast.Compare) and node.lineno not in noqa:
+            for op, cmp_ in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) \
+                        and isinstance(cmp_, ast.Constant) \
+                        and cmp_.value is None:
+                    problems.append((path, node.lineno,
+                                     "use `is None`, not `== None`"))
+
+    # ---- duplicate top-level defs -----------------------------------
+    seen = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.name in seen and node.lineno not in noqa:
+                problems.append(
+                    (path, node.lineno,
+                     f"redefinition of {node.name} "
+                     f"(first at line {seen[node.name]})"))
+            seen[node.name] = node.lineno
+    return problems
+
+
+def lint(paths):
+    problems = []
+    for root in paths:
+        if os.path.isfile(root):
+            problems += check_file(root)
+            continue
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in files:
+                if fn.endswith(".py"):
+                    problems += check_file(os.path.join(dirpath, fn))
+    return problems
+
+
+def main(argv=None):
+    paths = (argv or sys.argv[1:]) or ["presto_tpu"]
+    problems = lint(paths)
+    for path, lineno, msg in sorted(problems):
+        print(f"{path}:{lineno}: {msg}")
+    print(f"{len(problems)} finding(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
