@@ -62,9 +62,7 @@ class ConnectorTable:
     def _invalidate(self) -> None:
         """Drop cached device columns + bump the catalog version after a
         write (compiled-plan caches key on catalog version)."""
-        for attr in ("_device_cols", "_device_cols_f32"):
-            if hasattr(self, attr):
-                delattr(self, attr)
+        _drop_device_cache(self)
         cat = getattr(self, "_catalog", None)
         if cat is not None:
             cat.version += 1
@@ -227,6 +225,31 @@ class TpchTable(ConnectorTable):
         return self._data
 
 
+#: every live catalog, for bulk cache release (the test suite frees
+#: device-column caches between modules to bound one-process memory)
+import weakref
+
+_live_catalogs: "weakref.WeakSet[Catalog]" = weakref.WeakSet()
+
+
+def _drop_device_cache(table) -> None:
+    """The ONE device-column-cache drop (used by writes via
+    ConnectorTable._invalidate and by release_device_caches); instance
+    attrs only — some tables expose _device_cols as a property."""
+    for attr in ("_device_cols", "_device_cols_f32"):
+        if attr in getattr(table, "__dict__", {}):
+            delattr(table, attr)
+
+
+def release_device_caches() -> None:
+    """Drop cached device columns on every live catalog's tables (they
+    re-upload lazily).  Host memory otherwise accumulates one copy per
+    (catalog, sf) across a long test session."""
+    for cat in list(_live_catalogs):
+        for t in cat.tables.values():
+            _drop_device_cache(t)
+
+
 class Catalog:
     """Named schemas of tables (reference: MetadataManager + StaticCatalogStore).
     `version` bumps on registration so compiled-plan caches invalidate;
@@ -236,6 +259,7 @@ class Catalog:
     def __init__(self):
         self.tables: Dict[str, ConnectorTable] = {}
         self.version = 0
+        _live_catalogs.add(self)
         # per-instance copy: a connector attaching a new qualifier (e.g.
         # sqlite) must not change name resolution in OTHER catalogs
         self.known_qualifiers = set(self.KNOWN_QUALIFIERS)

@@ -1,0 +1,529 @@
+"""Geospatial functions (planar) — the presto-geospatial core, TPU-first.
+
+Reference parity: presto-geospatial's GeoFunctions (ST_Point,
+ST_GeometryFromText, ST_Contains, ST_Distance, ST_Area, ST_X/Y, ...)
+over Esri geometry objects.  TPU-native adaptation: the hot analytics
+shape is "millions of device-resident points against a handful of
+geometries" (geofencing), so POINT columns live ON DEVICE as (n, 2)
+float64 arrays and containment/distance lower to vectorized jnp math
+(ray casting / segment distance over broadcast polygon edges — no
+per-row host calls).  Non-point geometries (POLYGON, LINESTRING,
+MULTIPOINT) are WKT-parsed host tuples behind the usual dictionary
+encoding, like ARRAY values.
+
+A spatial join is a CROSS join + ST_Contains/ST_Distance filter through
+the existing join machinery (the reference's SpatialJoinNode builds an
+R-tree; with a bounded number of build geometries the vectorized
+all-pairs check IS the TPU-shaped plan).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.exec.colval import ColVal, all_valid
+from presto_tpu.functions.scalar import (
+    _as_string_literal,
+    _str_transform,
+    _tuple_dict_normalize,
+    register,
+)
+
+GEOMETRY = T.Type("GEOMETRY")  # dictionary-encoded parsed geometry
+POINTS = T.Type("GEOMETRY", ("point",))  # device (n, 2) f64 columns
+T._PHYSICAL.setdefault("GEOMETRY", np.int32)
+
+
+# ---------------------------------------------------------------------------
+# WKT parse/format (host; geometries are few and dictionary-encoded)
+# ---------------------------------------------------------------------------
+
+
+def parse_wkt(text: str):
+    """WKT -> ('point', (x, y)) | ('linestring', ((x,y),...)) |
+    ('polygon', (ring, ...)) | ('multipoint', ((x,y),...))."""
+    s = text.strip()
+    m = re.match(r"(?i)^(point|linestring|polygon|multipoint)\s*", s)
+    if not m:
+        raise ValueError(f"unsupported WKT: {text[:40]}")
+    kind = m.group(1).lower()
+    body = s[m.end():].strip()
+    if body.upper() == "EMPTY":
+        return (kind, ())
+
+    def coords(seg: str):
+        out = []
+        for pair in seg.split(","):
+            xy = pair.split()
+            out.append((float(xy[0]), float(xy[1])))
+        return tuple(out)
+
+    inner = body.strip()
+    assert inner.startswith("(") and inner.endswith(")")
+    inner = inner[1:-1]
+    if kind == "point":
+        return ("point", coords(inner)[0])
+    if kind in ("linestring", "multipoint"):
+        inner = inner.replace("(", "").replace(")", "")
+        return (kind, coords(inner))
+    rings = re.findall(r"\(([^()]*)\)", inner)
+    return ("polygon", tuple(coords(r) for r in rings))
+
+
+def to_wkt(g) -> str:
+    kind, data = g
+    if not data:
+        return f"{kind.upper()} EMPTY"
+    if kind == "point":
+        return f"POINT ({_num(data[0])} {_num(data[1])})"
+    if kind in ("linestring", "multipoint"):
+        return (kind.upper() + " ("
+                + ", ".join(f"{_num(x)} {_num(y)}" for x, y in data) + ")")
+    return ("POLYGON ("
+            + ", ".join("(" + ", ".join(f"{_num(x)} {_num(y)}"
+                                        for x, y in ring) + ")"
+                        for ring in data) + ")")
+
+
+def _num(v: float) -> str:
+    return f"{int(v)}" if float(v).is_integer() else f"{v}"
+
+
+def _ring_contains(ring, px, py):
+    """Vectorized ray casting: ring = host tuple of (x, y); px/py device
+    arrays.  Boundary-inclusive within float tolerance."""
+    n = len(ring)
+    inside = jnp.zeros(px.shape, bool)
+    for i in range(n):
+        x1, y1 = ring[i]
+        x2, y2 = ring[(i + 1) % n]
+        crosses = ((y1 > py) != (y2 > py))
+        xint = (x2 - x1) * (py - y1) / ((y2 - y1) or 1e-300) + x1
+        inside = inside ^ (crosses & (px < xint))
+    return inside
+
+
+def _seg_distance(ax, ay, bx, by, px, py):
+    """Distance from device points to host segment AB (vectorized)."""
+    dx, dy = bx - ax, by - ay
+    L2 = dx * dx + dy * dy
+    t = jnp.clip(((px - ax) * dx + (py - ay) * dy) / (L2 or 1e-300),
+                 0.0, 1.0)
+    cx, cy = ax + t * dx, ay + t * dy
+    return jnp.sqrt((px - cx) ** 2 + (py - cy) ** 2)
+
+
+def _poly_contains_points(g, px, py):
+    kind, data = g
+    if kind == "polygon":
+        if not data:
+            return jnp.zeros(px.shape, bool)
+        inside = _ring_contains(data[0], px, py)
+        for hole in data[1:]:
+            inside = inside & ~_ring_contains(hole, px, py)
+        return inside
+    if kind == "point":
+        return (px == data[0]) & (py == data[1])
+    if kind == "multipoint":
+        hit = jnp.zeros(px.shape, bool)
+        for x, y in data:
+            hit = hit | ((px == x) & (py == y))
+        return hit
+    raise NotImplementedError(f"ST_Contains over {kind}")
+
+
+def _geom_distance_points(g, px, py):
+    kind, data = g
+    if kind == "point":
+        return jnp.sqrt((px - data[0]) ** 2 + (py - data[1]) ** 2)
+    if kind == "multipoint":
+        d = None
+        for x, y in data:
+            dd = jnp.sqrt((px - x) ** 2 + (py - y) ** 2)
+            d = dd if d is None else jnp.minimum(d, dd)
+        return d
+    segs = []
+    if kind == "linestring":
+        segs = list(zip(data[:-1], data[1:]))
+    elif kind == "polygon":
+        for ring in data:  # hole boundaries count too (point in a hole
+            # is OUTSIDE: its nearest boundary may be the hole ring)
+            segs += [(ring[i], ring[(i + 1) % len(ring)])
+                     for i in range(len(ring))]
+    d = None
+    for (ax, ay), (bx, by) in segs:
+        dd = _seg_distance(ax, ay, bx, by, px, py)
+        d = dd if d is None else jnp.minimum(d, dd)
+    if kind == "polygon":  # interior points are at distance 0
+        d = jnp.where(_poly_contains_points(g, px, py), 0.0, d)
+    return d
+
+
+def _geom_segments(g):
+    """Host segment list of a geometry's boundary (all rings)."""
+    kind, data = g
+    if kind == "linestring":
+        return list(zip(data[:-1], data[1:]))
+    if kind == "polygon":
+        out = []
+        for ring in data:
+            out += [(ring[i], ring[(i + 1) % len(ring)])
+                    for i in range(len(ring))]
+        return out
+    return []
+
+
+def _segments_intersect(s1, s2) -> bool:
+    """Proper/improper 2D segment intersection (orientation tests)."""
+    (ax, ay), (bx, by) = s1
+    (cx, cy), (dx, dy) = s2
+
+    def orient(px, py, qx, qy, rx, ry):
+        v = (qx - px) * (ry - py) - (qy - py) * (rx - px)
+        return 0 if abs(v) < 1e-12 else (1 if v > 0 else -1)
+
+    o1 = orient(ax, ay, bx, by, cx, cy)
+    o2 = orient(ax, ay, bx, by, dx, dy)
+    o3 = orient(cx, cy, dx, dy, ax, ay)
+    o4 = orient(cx, cy, dx, dy, bx, by)
+    if o1 != o2 and o3 != o4:
+        return True
+
+    def on(px, py, qx, qy, rx, ry):  # r collinear-on pq
+        return (min(px, qx) - 1e-12 <= rx <= max(px, qx) + 1e-12
+                and min(py, qy) - 1e-12 <= ry <= max(py, qy) + 1e-12)
+
+    if o1 == 0 and on(ax, ay, bx, by, cx, cy):
+        return True
+    if o2 == 0 and on(ax, ay, bx, by, dx, dy):
+        return True
+    if o3 == 0 and on(cx, cy, dx, dy, ax, ay):
+        return True
+    return o4 == 0 and on(cx, cy, dx, dy, bx, by)
+
+
+def _boundaries_cross(ga, gb) -> bool:
+    return any(_segments_intersect(s1, s2)
+               for s1 in _geom_segments(ga) for s2 in _geom_segments(gb))
+
+
+def _shoelace(ring) -> float:
+    s = 0.0
+    n = len(ring)
+    for i in range(n):
+        x1, y1 = ring[i]
+        x2, y2 = ring[(i + 1) % n]
+        s += x1 * y2 - x2 * y1
+    return abs(s) / 2.0
+
+
+def _bbox(g):
+    kind, data = g
+    pts = [data] if kind == "point" else \
+        (data[0] if kind == "polygon" else data)
+    xs = [p[0] for p in pts]
+    ys = [p[1] for p in pts]
+    return min(xs), min(ys), max(xs), max(ys)
+
+
+# ---------------------------------------------------------------------------
+# value plumbing
+# ---------------------------------------------------------------------------
+
+
+def _geom_of(v: ColVal):
+    """Host geometry for a scalar/literal geometry ColVal."""
+    if v.type.is_string:
+        lit = _as_string_literal(v)
+        if lit is not None:
+            return parse_wkt(lit)
+    if v.type.name == "GEOMETRY" and v.dictionary is not None \
+            and getattr(v.data, "ndim", 1) == 0:
+        return v.dictionary.values[int(v.data)]
+    if v.type.name == "GEOMETRY" and v.is_scalar \
+            and isinstance(v.data, tuple):
+        return v.data
+    if v.type == POINTS and getattr(v.data, "ndim", 0) == 1:
+        # scalar ST_Point(x, y): a single device pair routes through
+        # the host-geometry paths
+        return ("point", (float(v.data[0]), float(v.data[1])))
+    return None
+
+
+def _points_of(v: ColVal):
+    """(px, py) device arrays for a POINTS ColVal, else None."""
+    if v.type == POINTS and getattr(v.data, "ndim", 0) == 2:
+        return v.data[:, 0], v.data[:, 1]
+    return None
+
+
+def _geoms_apply(col: ColVal, fn, out_type):
+    """Host map over a dictionary-encoded GEOMETRY column."""
+    vals = [fn(g) for g in col.dictionary.values]
+    if out_type.name == "GEOMETRY" or out_type.is_string:
+        out = np.empty(len(vals), dtype=object)
+        out[:] = vals
+        return _tuple_dict_normalize(out, ColVal(col.data, col.valid,
+                                                 out_type), out_type)
+    lut = jnp.asarray(np.asarray(vals, dtype=out_type.numpy_dtype()))
+    data = lut[jnp.clip(col.data, 0, len(col.dictionary) - 1)]
+    return ColVal(data, col.valid, out_type)
+
+
+# ---------------------------------------------------------------------------
+# registrations
+# ---------------------------------------------------------------------------
+
+register("st_point")((
+    lambda args: POINTS if len(args) == 2
+    and all(a.is_numeric for a in args) else None,
+    lambda args: ColVal(
+        jnp.stack(jnp.broadcast_arrays(
+            jnp.asarray(args[0].data).astype(jnp.float64),
+            jnp.asarray(args[1].data).astype(jnp.float64)), axis=-1),
+        all_valid(*args), POINTS)))
+
+register("st_geometryfromtext")((_str_transform(
+    "st_geometryfromtext", parse_wkt, GEOMETRY)))
+
+
+def _emit_astext(args):
+    v = args[0]
+    g0 = _geom_of(v)
+    if g0 is not None:  # scalar point / literal geometry
+        return ColVal(to_wkt(g0), v.valid, T.VARCHAR)
+    if v.type == POINTS:
+        # device points render host-side; dynamic mode only
+        pts = np.asarray(v.data)
+        vals = np.empty(len(pts), dtype=object)
+        vals[:] = [to_wkt(("point", (float(x), float(y))))
+                   for x, y in pts]
+        from presto_tpu.exec.colval import normalize_dictionary
+
+        return normalize_dictionary(
+            vals, ColVal(jnp.arange(len(pts), dtype=jnp.int32), v.valid,
+                         T.VARCHAR))
+    return _geoms_apply(v, to_wkt, T.VARCHAR)
+
+
+register("st_astext")((
+    lambda args: T.VARCHAR if len(args) == 1
+    and args[0].name == "GEOMETRY" else None, _emit_astext))
+
+
+def _xy_emit(idx):
+    def emit(args):
+        v = args[0]
+        p = _points_of(v)
+        if p is not None:
+            return ColVal(p[idx], v.valid, T.DOUBLE)
+        g = _geom_of(v)
+        if g is not None and g[0] == "point":
+            return ColVal(float(g[1][idx]), v.valid, T.DOUBLE)
+        return _geoms_apply(
+            args[0], lambda g2: float(g2[1][idx])
+            if g2[0] == "point" else float("nan"), T.DOUBLE)
+
+    return emit
+
+
+register("st_x")((lambda args: T.DOUBLE if args
+                  and args[0].name == "GEOMETRY" else None, _xy_emit(0)))
+register("st_y")((lambda args: T.DOUBLE if args
+                  and args[0].name == "GEOMETRY" else None, _xy_emit(1)))
+
+
+def _resolve_geom_pair(out):
+    def resolve(args):
+        if len(args) == 2 and all(
+                a.name == "GEOMETRY" or a.is_string for a in args):
+            return out
+        return None
+
+    return resolve
+
+
+def _emit_contains(args):
+    g = _geom_of(args[0])
+    p = _points_of(args[1])
+    if g is not None and p is not None:
+        # the TPU-shaped path: constant geometry, device point column
+        return ColVal(_poly_contains_points(g, *p),
+                      all_valid(*args), T.BOOLEAN)
+    g2 = _geom_of(args[1])
+    if g is not None and g2 is not None:
+        if g2[0] == "point":
+            px = jnp.asarray([g2[1][0]])
+            py = jnp.asarray([g2[1][1]])
+            return ColVal(bool(_poly_contains_points(g, px, py)[0]),
+                          all_valid(*args), T.BOOLEAN)
+        if g2[0] in ("multipoint", "linestring", "polygon"):
+            pts = g2[1] if g2[0] != "polygon" else g2[1][0]
+            px = jnp.asarray([q[0] for q in pts])
+            py = jnp.asarray([q[1] for q in pts])
+            inside = bool(jnp.all(_poly_contains_points(g, px, py)))
+            # vertex containment alone is wrong for non-convex
+            # containers: the contained shape must also never cross
+            # the container's boundary
+            ok = inside and not _boundaries_cross(g, g2)
+            return ColVal(ok, all_valid(*args), T.BOOLEAN)
+    raise NotImplementedError(
+        "ST_Contains needs a constant geometry on the left")
+
+
+register("st_contains")((_resolve_geom_pair(T.BOOLEAN), _emit_contains))
+register("st_within")((
+    _resolve_geom_pair(T.BOOLEAN),
+    lambda args: _emit_contains([args[1], args[0]])))
+
+
+def _emit_distance(args):
+    a, b = args
+    pa_, pb = _points_of(a), _points_of(b)
+    if pa_ is not None and pb is not None:
+        d = jnp.sqrt((pa_[0] - pb[0]) ** 2 + (pa_[1] - pb[1]) ** 2)
+        return ColVal(d, all_valid(a, b), T.DOUBLE)
+    for pts, other in ((pa_, b), (pb, a)):
+        if pts is not None:
+            g = _geom_of(other)
+            if g is None:
+                break
+            return ColVal(_geom_distance_points(g, *pts),
+                          all_valid(a, b), T.DOUBLE)
+    ga, gb = _geom_of(a), _geom_of(b)
+    if ga is not None and gb is not None and gb[0] == "point":
+        px = jnp.asarray([gb[1][0]])
+        py = jnp.asarray([gb[1][1]])
+        return ColVal(float(_geom_distance_points(ga, px, py)[0]),
+                      all_valid(a, b), T.DOUBLE)
+    if ga is not None and gb is not None and ga[0] == "point":
+        return _emit_distance([b, a])
+    raise NotImplementedError("ST_Distance geometry pair")
+
+
+register("st_distance")((_resolve_geom_pair(T.DOUBLE), _emit_distance))
+
+
+def _emit_intersects(args):
+    # bbox prefilter + containment/distance exact checks for the
+    # supported kinds (reference: ST_Intersects via Esri relate)
+    g = _geom_of(args[0])
+    p = _points_of(args[1])
+    if g is not None and p is not None:
+        return _emit_contains(args)
+    ga, gb = _geom_of(args[0]), _geom_of(args[1])
+    if ga is not None and gb is not None:
+        ax0, ay0, ax1, ay1 = _bbox(ga)
+        bx0, by0, bx1, by1 = _bbox(gb)
+        if ax1 < bx0 or bx1 < ax0 or ay1 < by0 or by1 < ay0:
+            return ColVal(False, all_valid(*args), T.BOOLEAN)
+        if gb[0] == "point":
+            return _emit_contains(args)
+        if ga[0] == "point":
+            return _emit_contains([args[1], args[0]])
+        # polygon/linestring pair: boundaries crossing, or one shape's
+        # vertex inside the other (covers containment without crossing)
+        hit = _boundaries_cross(ga, gb)
+        if not hit and ga[0] == "polygon":
+            pts = gb[1] if gb[0] != "polygon" else gb[1][0]
+            px = jnp.asarray([q[0] for q in pts])
+            py = jnp.asarray([q[1] for q in pts])
+            hit = bool(jnp.any(_poly_contains_points(ga, px, py)))
+        if not hit and gb[0] == "polygon":
+            qts = ga[1] if ga[0] != "polygon" else ga[1][0]
+            qx = jnp.asarray([q[0] for q in qts])
+            qy = jnp.asarray([q[1] for q in qts])
+            hit = bool(jnp.any(_poly_contains_points(gb, qx, qy)))
+        return ColVal(hit, all_valid(*args), T.BOOLEAN)
+    raise NotImplementedError("ST_Intersects geometry pair")
+
+
+register("st_intersects")((_resolve_geom_pair(T.BOOLEAN),
+                           _emit_intersects))
+
+
+def _area(g) -> float:
+    if g[0] != "polygon" or not g[1]:
+        return 0.0
+    a = _shoelace(g[1][0])
+    for hole in g[1][1:]:
+        a -= _shoelace(hole)
+    return a
+
+
+def _envelope(g):
+    x0, y0, x1, y1 = _bbox(g)
+    return ("polygon", (((x0, y0), (x1, y0), (x1, y1), (x0, y1),
+                         (x0, y0)),))
+
+
+def _geom1(name, fn, out_type):
+    def emit(args):
+        g = _geom_of(args[0])
+        if g is not None:
+            r = fn(g)
+            if out_type.name == "GEOMETRY":
+                return ColVal(r, args[0].valid, GEOMETRY)
+            return ColVal(r, args[0].valid, out_type)
+        return _geoms_apply(args[0], fn, out_type)
+
+    return (lambda args: out_type if len(args) == 1
+            and args[0].name == "GEOMETRY" else None, emit)
+
+
+register("st_area")(_geom1("st_area", _area, T.DOUBLE))
+register("st_envelope")(_geom1("st_envelope", _envelope, GEOMETRY))
+def _centroid(g):
+    kind, data = g
+    if kind == "point":
+        return ("point", data)
+    if kind == "multipoint":
+        return ("point", (float(np.mean([p[0] for p in data])),
+                          float(np.mean([p[1] for p in data]))))
+    if kind == "linestring":
+        # length-weighted segment midpoints (GeoFunctions semantics)
+        tx = ty = tl = 0.0
+        for (x1, y1), (x2, y2) in zip(data[:-1], data[1:]):
+            ln = math.dist((x1, y1), (x2, y2))
+            tx += (x1 + x2) / 2 * ln
+            ty += (y1 + y2) / 2 * ln
+            tl += ln
+        if tl == 0:
+            return ("point", data[0])
+        return ("point", (tx / tl, ty / tl))
+    # polygon: signed-area-weighted centroid over rings (holes
+    # subtract via opposite winding of the shoelace terms)
+    ax = ay = asum = 0.0
+    for ri, ring in enumerate(data):
+        sx = sy = s = 0.0
+        for i in range(len(ring)):
+            x1, y1 = ring[i]
+            x2, y2 = ring[(i + 1) % len(ring)]
+            cross = x1 * y2 - x2 * y1
+            sx += (x1 + x2) * cross
+            sy += (y1 + y2) * cross
+            s += cross
+        sign = 1.0 if ri == 0 else -1.0
+        ax += sign * abs(s) * (sx / (3.0 * s) if s else 0.0)
+        ay += sign * abs(s) * (sy / (3.0 * s) if s else 0.0)
+        asum += sign * abs(s)
+    if asum == 0:
+        return ("point", data[0][0])
+    return ("point", (ax / asum, ay / asum))
+
+
+register("st_centroid")(_geom1("st_centroid", _centroid, GEOMETRY))
+register("st_npoints")(_geom1(
+    "st_npoints",
+    lambda g: sum(len(r) for r in g[1]) if g[0] == "polygon"
+    else (1 if g[0] == "point" else len(g[1])), T.BIGINT))
+register("st_length")(_geom1(
+    "st_length",
+    lambda g: float(sum(
+        math.dist(a, b) for a, b in zip(g[1][:-1], g[1][1:])))
+    if g[0] == "linestring" else 0.0, T.DOUBLE))

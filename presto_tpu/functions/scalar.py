@@ -3024,6 +3024,7 @@ def _register_sketch_fns():
 
 _register_sketch_fns()
 
-# round-4 breadth: the extended batch registers on import (kept in its
-# own module to keep this file navigable)
+# round-4 breadth: the extended batches register on import (kept in
+# their own modules to keep this file navigable)
 from presto_tpu.functions import scalar_ext as _scalar_ext  # noqa: E402,F401
+from presto_tpu.functions import geospatial as _geospatial  # noqa: E402,F401

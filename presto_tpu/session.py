@@ -52,7 +52,9 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "memory_pool_bytes": 16 << 30,  # per-process pool (MemoryPool capacity)
     "spill_enabled": True,
     "spill_encryption": False,  # AES-256-CTR at rest (AesSpillCipher)
-    "iterative_optimizer_enabled": True,  # Memo/Rule fixpoint pass
+    "iterative_optimizer_enabled": True,
+    "reorder_joins": True,  # Selinger-DP ReorderJoins in the Memo
+    "max_reorder_joins": 8,  # Memo/Rule fixpoint pass
     "spill_path": "",  # "" = <tmp>/presto_tpu_spill
     "localfile_root": "",  # "" = <tmp>/presto_tpu_tables (file connectors)
     "spill_partition_count": 8,  # Grace hash fan-out (GenericPartitioningSpiller)

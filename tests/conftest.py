@@ -31,3 +31,21 @@ def tpch_sqlite_tiny():
     from tests.sqlite_oracle import build_sqlite
 
     return build_sqlite(sf=0.01)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_suite_memory():
+    """One-process full-suite runs accumulate XLA executables and
+    device-column caches per module until the host OOMs (observed at
+    ~119GB around the late tpcds modules).  Releasing both between
+    modules bounds RSS; later modules recompile/re-upload lazily."""
+    yield
+    import gc
+
+    import jax as _jax
+
+    from presto_tpu.catalog import release_device_caches
+
+    release_device_caches()
+    _jax.clear_caches()
+    gc.collect()

@@ -1,0 +1,164 @@
+"""Geospatial functions (presto-geospatial's GeoFunctions core),
+differentially tested against python/shapely-free references computed
+in the test.  The hot path — constant geometry against device-resident
+point columns — is checked over a table, not just literals."""
+
+import math
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.catalog import Catalog, MemoryTable
+
+
+@pytest.fixture(scope="module")
+def s():
+    rng = np.random.default_rng(4)
+    n = 2000
+    cat = Catalog()
+    cat.register(MemoryTable(
+        "pts", {"x": T.DOUBLE, "y": T.DOUBLE},
+        {"x": rng.uniform(-2, 2, n), "y": rng.uniform(-2, 2, n)}))
+    return presto_tpu.connect(cat)
+
+
+def one(s, sql):
+    return s.sql(sql).rows[0][0]
+
+
+def test_point_accessors_and_wkt(s):
+    assert one(s, "SELECT ST_X(ST_Point(3.5, -1))") == 3.5
+    assert one(s, "SELECT ST_Y(ST_Point(3.5, -1))") == -1.0
+    assert one(s, "SELECT ST_AsText(ST_Point(2, 4))") == "POINT (2 4)"
+    assert one(s, "SELECT ST_AsText(ST_GeometryFromText("
+                  "'POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))'))") \
+        == "POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))"
+
+
+def test_contains_device_points(s):
+    """The TPU-shaped path: unit-square containment over a 2000-row
+    device point column, checked against numpy."""
+    t = s.catalog.get("pts")
+    want = int(((np.abs(t.data["x"]) <= 1) & (np.abs(t.data["y"]) <= 1)
+                & (t.data["x"] > -1) & (t.data["x"] < 1)
+                & (t.data["y"] > -1) & (t.data["y"] < 1)).sum())
+    got = one(s, "SELECT count(*) FROM pts WHERE ST_Contains("
+                 "ST_GeometryFromText("
+                 "'POLYGON ((-1 -1, 1 -1, 1 1, -1 1, -1 -1))'), "
+                 "ST_Point(x, y))")
+    assert abs(got - want) <= 2  # boundary rows are tolerance-sensitive
+
+
+def test_contains_with_hole(s):
+    wkt = ("POLYGON ((-2 -2, 2 -2, 2 2, -2 2, -2 -2), "
+           "(-1 -1, 1 -1, 1 1, -1 1, -1 -1))")
+    assert one(s, f"SELECT ST_Contains(ST_GeometryFromText('{wkt}'), "
+                  "ST_Point(1.5, 0))") is True
+    assert one(s, f"SELECT ST_Contains(ST_GeometryFromText('{wkt}'), "
+                  "ST_Point(0, 0))") is False
+
+
+def test_distance(s):
+    assert one(s, "SELECT ST_Distance(ST_Point(0, 0), "
+                  "ST_Point(3, 4))") == 5.0
+    d = one(s, "SELECT ST_Distance(ST_GeometryFromText("
+               "'LINESTRING (0 0, 10 0)'), ST_Point(5, 2))")
+    assert d == pytest.approx(2.0)
+    d = one(s, "SELECT ST_Distance(ST_GeometryFromText("
+               "'POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))'), "
+               "ST_Point(1, 1))")
+    assert d == 0.0  # interior
+    # device point column distances vs numpy
+    t = s.catalog.get("pts")
+    want = float(np.sqrt(t.data["x"] ** 2 + t.data["y"] ** 2).sum())
+    got = one(s, "SELECT sum(ST_Distance(ST_Point(x, y), "
+                 "ST_Point(0, 0))) FROM pts")
+    assert got == pytest.approx(want, rel=1e-9)
+
+
+def test_area_centroid_envelope_npoints(s):
+    poly = "'POLYGON ((0 0, 4 0, 4 3, 0 3, 0 0))'"
+    assert one(s, f"SELECT ST_Area(ST_GeometryFromText({poly}))") == 12.0
+    assert one(s, "SELECT ST_AsText(ST_Envelope(ST_GeometryFromText("
+                  "'LINESTRING (0 1, 5 0, 3 4)')))") \
+        == "POLYGON ((0 0, 5 0, 5 4, 0 4, 0 0))"
+    assert one(s, f"SELECT ST_NPoints(ST_GeometryFromText({poly}))") == 5
+    assert one(s, "SELECT ST_Length(ST_GeometryFromText("
+                  "'LINESTRING (0 0, 3 4, 3 10)'))") \
+        == pytest.approx(5 + 6)
+
+
+def test_intersects_and_within(s):
+    a = "'POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))'"
+    b = "'POLYGON ((1 1, 3 1, 3 3, 1 3, 1 1))'"
+    c = "'POLYGON ((5 5, 6 5, 6 6, 5 6, 5 5))'"
+    assert one(s, f"SELECT ST_Intersects(ST_GeometryFromText({a}), "
+                  f"ST_GeometryFromText({b}))") is True
+    assert one(s, f"SELECT ST_Intersects(ST_GeometryFromText({a}), "
+                  f"ST_GeometryFromText({c}))") is False
+    assert one(s, f"SELECT ST_Within(ST_Point(1, 1), "
+                  f"ST_GeometryFromText({a}))") is True
+
+
+def test_spatial_join_shape(s):
+    """Spatial join = CROSS + ST_Contains filter through the ordinary
+    join machinery (SpatialJoinNode role)."""
+    got = s.sql(
+        "SELECT g.name, count(*) c FROM pts, (VALUES "
+        "('ne'), ('sw')) g(name) "
+        "WHERE (g.name = 'ne' AND ST_Contains(ST_GeometryFromText("
+        "'POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))'), ST_Point(x, y))) "
+        "OR (g.name = 'sw' AND ST_Contains(ST_GeometryFromText("
+        "'POLYGON ((-2 -2, 0 -2, 0 0, -2 0, -2 -2))'), ST_Point(x, y)))"
+        " GROUP BY g.name ORDER BY g.name").rows
+    t = s.catalog.get("pts")
+    ne = int(((t.data["x"] > 0) & (t.data["x"] < 2)
+              & (t.data["y"] > 0) & (t.data["y"] < 2)).sum())
+    sw = int(((t.data["x"] > -2) & (t.data["x"] < 0)
+              & (t.data["y"] > -2) & (t.data["y"] < 0)).sum())
+    got_d = dict((r[0], r[1]) for r in got)
+    assert abs(got_d.get("ne", 0) - ne) <= 2
+    assert abs(got_d.get("sw", 0) - sw) <= 2
+
+
+def test_intersects_crossing_rectangles(s):
+    """Review regression: cross-overlapping rectangles intersect even
+    though no vertex of either lies inside the other."""
+    a = "'POLYGON ((-5 -1, 5 -1, 5 1, -5 1, -5 -1))'"
+    b = "'POLYGON ((-1 -5, 1 -5, 1 5, -1 5, -1 -5))'"
+    assert one(s, f"SELECT ST_Intersects(ST_GeometryFromText({a}), "
+                  f"ST_GeometryFromText({b}))") is True
+
+
+def test_distance_into_hole(s):
+    wkt = ("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), "
+           "(4 4, 6 4, 6 6, 4 6, 4 4))")
+    d = one(s, f"SELECT ST_Distance(ST_GeometryFromText('{wkt}'), "
+               "ST_Point(5, 5))")
+    assert d == pytest.approx(1.0)  # nearest boundary is the hole ring
+
+
+def test_centroid_area_weighted(s):
+    got = one(s, "SELECT ST_AsText(ST_Centroid(ST_GeometryFromText("
+                 "'POLYGON ((0 0, 4 0, 4 3, 0 3, 0 0))')))")
+    assert got == "POINT (2 1.5)"
+    got = one(s, "SELECT ST_AsText(ST_Centroid(ST_GeometryFromText("
+                 "'LINESTRING (0 0, 10 0)')))")
+    assert got == "POINT (5 0)"
+
+
+def test_contains_nonconvex_container(s):
+    u = ("POLYGON ((0 0, 6 0, 6 5, 4 5, 4 2, 2 2, 2 5, 0 5, 0 0))")
+    # both endpoints inside the U's arms, segment crosses the notch
+    assert one(s, f"SELECT ST_Contains(ST_GeometryFromText('{u}'), "
+                  "ST_GeometryFromText('LINESTRING (1 4, 5 4)'))") is False
+    assert one(s, f"SELECT ST_Contains(ST_GeometryFromText('{u}'), "
+                  "ST_GeometryFromText('LINESTRING (1 1, 5 1)'))") is True
+
+
+def test_npoints_counts_all_rings(s):
+    wkt = ("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), "
+           "(4 4, 6 4, 6 6, 4 6, 4 4))")
+    assert one(s, f"SELECT ST_NPoints(ST_GeometryFromText('{wkt}'))") == 10

@@ -81,10 +81,11 @@ def test_tpcds_query_vs_sqlite(ds_session, ds_sqlite, qid):
     oracle_sql = SQLITE_OVERRIDES.get(qid, sql)
     oracle_rows = ds_sqlite.execute(to_sqlite(oracle_sql)).fetchall()
     ordered = "ORDER BY" in sql.upper()
-    # q89's windowed avg lands exactly on a .00005 rounding boundary;
-    # engine-vs-sqlite summation-order noise rounds it to opposite
-    # sides, leaving 1e-4 + ULP — widen ONLY that query's tolerance
-    abs_tol = 2e-4 if qid == 89 else 1e-4
+    # q89/q47's windowed avgs land exactly on a .00005 rounding
+    # boundary; engine-vs-sqlite summation-order noise (join order
+    # changes reduction order) rounds them to opposite sides, leaving
+    # 1e-4 + ULP — widen ONLY those queries' tolerance
+    abs_tol = 2e-4 if qid in (47, 89) else 1e-4
     assert_same_results(engine_rows, oracle_rows, ordered=False,
                         abs_tol=abs_tol)
     # ties reorder legally (34..79); 65/89 order by float expressions
