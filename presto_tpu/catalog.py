@@ -307,6 +307,20 @@ class Catalog:
 
         self.register(OrcTable(name, path))
 
+    def register_csv(self, name: str, path: str, schema=None) -> None:
+        """A header-rowed CSV file as a table; types infer from the
+        data when no schema is given (presto-record-decoder role)."""
+        from presto_tpu.connectors.textfile import CsvTable
+
+        self.register(CsvTable(name, path, schema))
+
+    def register_jsonl(self, name: str, path: str, schema=None) -> None:
+        """A JSON-lines file as a table (JsonRowDecoder role); nested
+        values surface as JSON text."""
+        from presto_tpu.connectors.textfile import JsonlTable
+
+        self.register(JsonlTable(name, path, schema))
+
     #: catalog/schema qualifiers accepted for flat registrations; a bogus
     #: prefix must NOT silently resolve to the bare table
     KNOWN_QUALIFIERS = {"tpch", "tpcds", "memory", "localfile", "blackhole",

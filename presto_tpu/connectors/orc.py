@@ -21,13 +21,22 @@ _STR_NROWS = 5
 class OrcTable(ConnectorTable):
     """A .orc file, or a directory of them with one schema."""
 
-    def __init__(self, name: str, path: str):
+    supports_null_append = True  # null channel in the format
+
+    def __init__(self, name: str, path: str, schema=None):
         self.path = path
         files = self._files()
-        if not files:
-            raise FileNotFoundError(f"no orc files under {path}")
-        f0 = OrcFile(files[0])
-        schema = {c.name: c.sql_type() for c in f0.columns}
+        if schema is None:
+            if not files:
+                raise FileNotFoundError(f"no orc files under {path}")
+            f0 = OrcFile(files[0])
+            schema = {c.name: c.sql_type() for c in f0.columns}
+        else:
+            if files:  # see ParquetTable: no silent stale-part absorb
+                raise ValueError(
+                    f"target directory {path} already contains orc "
+                    "files; register it read-only or choose a new path")
+            os.makedirs(path, exist_ok=True)
         super().__init__(name, schema)
 
     def _files(self) -> List[str]:
@@ -45,6 +54,31 @@ class OrcTable(ConnectorTable):
         if cached is None or cached[0] != paths:
             self._orc_cache = (paths, [OrcFile(p) for p in paths])
         return self._orc_cache[1]
+
+    # -- write path (reference: presto-orc OrcWriter behind the hive
+    # sink) --------------------------------------------------------
+    def append(self, arrays) -> int:
+        from presto_tpu.storage.orc import write_orc
+
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        if n == 0:
+            return 0
+        if os.path.isfile(self.path):
+            raise ValueError(
+                "single-file orc table is read-only; register a "
+                "directory to INSERT")
+        os.makedirs(self.path, exist_ok=True)
+        idx = len(self._files())
+        write_orc(os.path.join(self.path, f"part_{idx:06d}.orc"),
+                  {c: arrays[c] for c in self.schema}, self.schema)
+        self._orc_cache = None
+        self._invalidate()
+        return n
+
+    def drop_data(self) -> None:
+        if os.path.isdir(self.path):
+            for p in self._files():
+                os.remove(p)
 
     def row_count(self) -> int:
         return sum(f.num_rows for f in self._readers())

@@ -23,6 +23,8 @@ from presto_tpu.storage.parquet import ParquetFile, write_parquet
 class ParquetTable(ConnectorTable):
     """A .parquet file, or a directory of them with one schema."""
 
+    supports_null_append = True  # null channel in the format
+
     def __init__(self, name: str, path: str,
                  schema: Optional[Dict[str, T.Type]] = None):
         self.path = path
@@ -32,7 +34,13 @@ class ParquetTable(ConnectorTable):
                 raise FileNotFoundError(f"no parquet files under {path}")
             f0 = ParquetFile(files[0])
             schema = {c.name: c.sql_type() for c in f0.columns}
-        elif not os.path.isdir(path):
+        else:
+            # a FRESH table (CTAS) must not silently absorb another
+            # table-lifetime's part files sitting in the directory
+            if self._files():
+                raise ValueError(
+                    f"target directory {path} already contains parquet "
+                    "files; register it read-only or choose a new path")
             os.makedirs(path, exist_ok=True)
         super().__init__(name, schema)
 

@@ -1,0 +1,169 @@
+"""Text-format tables: CSV and JSON-lines files on local disk.
+
+Reference parity: presto-record-decoder (the JSON/CSV row decoders
+Kafka/Redis/local-file sources share) + the hive connector's text
+formats.  Decoding happens once at first scan into typed numpy columns
+(nulls as masked arrays); from there the engine's columnar path takes
+over — there is no per-row decode at query time, which is the
+TPU-friendly restating of the reference's streaming decoders.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.catalog import ConnectorTable
+
+
+def _coerce(values: List[object], t: T.Type,
+            empty_is_null: bool = True) -> np.ndarray:
+    """Python values (None = null) -> typed column (masked when any
+    null).  empty_is_null is the CSV convention; JSON keeps "" a real
+    VARCHAR value."""
+    mask = np.asarray([v is None or (empty_is_null and v == "")
+                       for v in values], bool)
+    if t.is_string:
+        arr = np.empty(len(values), object)
+        arr[:] = ["" if m else str(v) for v, m in zip(values, mask)]
+    elif t.name == "BOOLEAN":
+        arr = np.asarray([False if m else str(v).lower()
+                          in ("true", "1", "t") for v, m in
+                          zip(values, mask)])
+    elif t.name == "DATE":
+        import datetime as _dt
+
+        arr = np.asarray([0 if m else
+                          (_dt.date.fromisoformat(str(v))
+                           - _dt.date(1970, 1, 1)).days
+                          for v, m in zip(values, mask)], np.int32)
+    elif t.is_integer:
+        arr = np.asarray([0 if m else int(float(v))
+                          for v, m in zip(values, mask)],
+                         t.numpy_dtype())
+    else:
+        arr = np.asarray([0.0 if m else float(v)
+                          for v, m in zip(values, mask)],
+                         t.numpy_dtype())
+    if mask.any():
+        return np.ma.masked_array(arr, mask)
+    return arr
+
+
+def _infer_type(samples: List[object]) -> T.Type:
+    """BIGINT < DOUBLE < BOOLEAN < VARCHAR by what every sample parses
+    as (the record-decoder's schema-less default)."""
+    seen = [s for s in samples if s is not None and s != ""]
+    if not seen:
+        return T.VARCHAR
+    if all(isinstance(s, bool) for s in seen):
+        return T.BOOLEAN
+
+    def ok(fn):
+        try:
+            for s in seen:
+                fn(s)
+            return True
+        except (TypeError, ValueError):
+            return False
+
+    if all(not isinstance(s, float) for s in seen) and ok(int):
+        return T.BIGINT
+    if ok(float):
+        return T.DOUBLE
+    if all(str(s).lower() in ("true", "false") for s in seen):
+        return T.BOOLEAN
+    return T.VARCHAR
+
+
+class _DecodedTextTable(ConnectorTable):
+    """Shared base: subclasses decode file -> {col: python values}."""
+
+    EMPTY_IS_NULL = True  # CSV convention; JSONL overrides
+
+    def __init__(self, name: str, path: str,
+                 schema: Optional[Dict[str, T.Type]] = None):
+        self.path = path
+        raw = self._decode(path)
+        inferred = schema is None
+        if inferred:
+            schema = {c: _infer_type(vals[:200])
+                      for c, vals in raw.items()}
+        self._data = {}
+        for c, t in schema.items():
+            try:
+                self._data[c] = _coerce(raw[c], t, self.EMPTY_IS_NULL)
+            except (TypeError, ValueError) as e:
+                if not inferred:
+                    raise ValueError(
+                        f"column {c!r} does not parse as {t}: {e}"
+                    ) from e
+                # inference sampled a numeric-looking prefix; a later
+                # value disagreed — fall back to VARCHAR
+                schema[c] = T.VARCHAR
+                self._data[c] = _coerce(raw[c], T.VARCHAR,
+                                        self.EMPTY_IS_NULL)
+        self._rows = len(next(iter(self._data.values()))) if self._data \
+            else 0
+        super().__init__(name, schema)
+
+    def row_count(self) -> int:
+        return self._rows
+
+    def splits(self, n_splits: int) -> List[Tuple[int, int]]:
+        edges = np.linspace(0, self._rows, n_splits + 1).astype(int)
+        return [(int(a), int(b)) for a, b in zip(edges[:-1], edges[1:])
+                if a < b]
+
+    def read(self, columns=None, split=None) -> Dict[str, np.ndarray]:
+        cols = columns if columns is not None else list(self.schema)
+        a, b = split if split is not None else (0, self._rows)
+        return {c: self._data[c][a:b] for c in cols}
+
+
+class CsvTable(_DecodedTextTable):
+    """CSV with a header row (reference: CsvRowDecoder + hive text)."""
+
+    def _decode(self, path: str) -> Dict[str, List[object]]:
+        with open(path, newline="", encoding="utf-8") as f:
+            rd = csv.reader(f)
+            header = next(rd, [])
+            cols: Dict[str, List[object]] = {h: [] for h in header}
+            for row in rd:
+                for h, v in zip(header, row):
+                    cols[h].append(v if v != "" else None)
+                for h in header[len(row):]:  # ragged short rows
+                    cols[h].append(None)
+        return cols
+
+
+class JsonlTable(_DecodedTextTable):
+    """JSON-lines: one object per line, columns = union of keys
+    (reference: JsonRowDecoder)."""
+
+    EMPTY_IS_NULL = False  # "" is a real JSON string value
+
+    def _decode(self, path: str) -> Dict[str, List[object]]:
+        rows = []
+        keys: List[str] = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                for k in obj:
+                    if k not in keys:
+                        keys.append(k)
+                rows.append(obj)
+        return {k: [self._scalar(r.get(k)) for r in rows] for k in keys}
+
+    @staticmethod
+    def _scalar(v):
+        if isinstance(v, (dict, list)):
+            return json.dumps(v)  # nested values surface as JSON text
+        return v

@@ -259,7 +259,9 @@ def _create_table(session, name, schema, properties, arrays):
     properties (reference: StaticCatalogStore catalogs + per-connector
     getPageSinkProvider; default is the memory connector)."""
     connector = str(properties.get("connector", "memory")).lower()
-    if arrays is not None:
+    if arrays is not None and connector not in ("parquet", "orc"):
+        # parquet/orc sinks carry nulls natively (definition levels /
+        # PRESENT streams); the memory/shard sinks store raw arrays
         clean = {}
         for c, a in arrays.items():
             if isinstance(a, np.ma.MaskedArray):
@@ -285,33 +287,23 @@ def _create_table(session, name, schema, properties, arrays):
         if arrays is not None:
             t.append(arrays)
         return
-    if connector == "localfile":
+    if connector in ("localfile", "parquet", "orc"):
         import tempfile
 
-        from presto_tpu.connectors.localfile import LocalFileTable
-
-        directory = properties.get("directory") or os.path.join(
-            session.properties.get("localfile_root",
-                                   os.path.join(tempfile.gettempdir(),
-                                                "presto_tpu_tables")),
-            name)
-        t = LocalFileTable(name, directory, schema)
-        session.catalog.register(t)
-        if arrays is not None:
-            t.append(arrays)
-        return
-    if connector == "parquet":
-        import tempfile
-
-        from presto_tpu.connectors.parquet import ParquetTable
-
+        if connector == "localfile":
+            from presto_tpu.connectors.localfile import \
+                LocalFileTable as cls
+        elif connector == "parquet":
+            from presto_tpu.connectors.parquet import ParquetTable as cls
+        else:
+            from presto_tpu.connectors.orc import OrcTable as cls
         directory = properties.get("path") or properties.get(
             "directory") or os.path.join(
             session.properties.get("localfile_root",
                                    os.path.join(tempfile.gettempdir(),
                                                 "presto_tpu_tables")),
             name)
-        t = ParquetTable(name, directory, schema)
+        t = cls(name, directory, schema)
         session.catalog.register(t)
         if arrays is not None:
             t.append(arrays)
@@ -348,14 +340,18 @@ def _insert_into(session, stmt: ast.InsertInto) -> int:
         want = table.schema[tgt]
         a = arrays[src]
         if isinstance(a, np.ma.MaskedArray):
-            if a.mask is not np.ma.nomask and np.any(a.mask):
+            if getattr(table, "supports_null_append", False):
+                pass  # the sink writes a null channel (parquet/orc)
+            elif a.mask is not np.ma.nomask and np.any(a.mask):
                 # the memory/shard sinks store raw arrays (no validity
                 # mask); silently writing fill values would corrupt NULLs
                 raise ExecutionError(
                     f"INSERT of NULL values into column '{tgt}' is not "
                     "supported by this connector")
-            a = a.data
-        a = np.asarray(a)
+            else:
+                a = a.data
+        if not isinstance(a, np.ma.MaskedArray):
+            a = np.asarray(a)
         have = types.get(src, want)
         if have != want and not T.can_coerce(have, want) \
                 and not (have.is_numeric and want.is_numeric):

@@ -18,6 +18,7 @@ BINARY/DATE/TIMESTAMP/DECIMAL column types over flat schemas.
 
 from __future__ import annotations
 
+import io
 import struct
 import zlib
 from typing import Dict, List, Optional, Tuple
@@ -546,7 +547,7 @@ class OrcFile:
                     o += ln
         elif k == "timestamp":
             secs = _IntRle(data, True, v2).read(n_vals)
-            nanos_b = self._stream(raw, col.cid, 2)  # SECONDARY
+            nanos_b = self._stream(raw, col.cid, 5)  # SECONDARY
             nraw = _IntRle(nanos_b, False, v2).read(n_vals)
             zeros = nraw & 0x7
             nanos = nraw >> 3
@@ -595,3 +596,223 @@ class OrcFile:
         if arr.dtype == object:
             arr = np.asarray([0 if v is None else v for v in vals])
         return arr.astype(t.numpy_dtype()), valid, t
+
+
+# ---------------------------------------------------------------------------
+# writer (reference: presto-orc OrcWriter/StripeWriter + the column
+# writers under writer/ — here: one stripe, DIRECT (RLE v1) encodings,
+# NONE compression; readable by any conformant implementation)
+# ---------------------------------------------------------------------------
+
+
+class _PWrite:
+    """Minimal protobuf wire-format writer."""
+
+    def __init__(self):
+        self.out = bytearray()
+
+    def varint(self, v: int) -> None:
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                self.out.append(b | 0x80)
+            else:
+                self.out.append(b)
+                return
+
+    def field_varint(self, fnum: int, v: int) -> None:
+        self.varint((fnum << 3) | 0)
+        self.varint(v)
+
+    def field_bytes(self, fnum: int, data: bytes) -> None:
+        self.varint((fnum << 3) | 2)
+        self.varint(len(data))
+        self.out += data
+
+    def field_msg(self, fnum: int, msg: "_PWrite") -> None:
+        self.field_bytes(fnum, bytes(msg.out))
+
+
+def _rle_v1_write(vals, signed: bool) -> bytes:
+    """Integer RLE v1: runs of >=3 equal values, else literal groups."""
+    out = bytearray()
+
+    def varint(v: int):
+        if signed:
+            v = (v << 1) ^ (v >> 63) if v < 0 else v << 1
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return
+
+    i = 0
+    n = len(vals)
+    while i < n:
+        j = i
+        while j + 1 < n and vals[j + 1] == vals[i] and j - i < 129:
+            j += 1
+        run = j - i + 1
+        if run >= 3:
+            out.append(run - 3)
+            out.append(0)  # delta
+            varint(int(vals[i]))
+            i = j + 1
+            continue
+        k = i
+        while k < n and k - i < 128:
+            if k + 2 < n and vals[k] == vals[k + 1] == vals[k + 2]:
+                break
+            k += 1
+        lit = k - i
+        out.append(256 - lit)
+        for m in range(i, k):
+            varint(int(vals[m]))
+        i = k
+    return bytes(out)
+
+
+def _byte_rle_write(data: bytes) -> bytes:
+    """Byte RLE (PRESENT/boolean byte stream): literal groups only —
+    always valid, simple."""
+    out = bytearray()
+    i = 0
+    while i < len(data):
+        chunk = data[i:i + 128]
+        out.append(256 - len(chunk))
+        out += chunk
+        i += len(chunk)
+    return bytes(out)
+
+
+def _bool_rle_write(bits: np.ndarray) -> bytes:
+    by = np.packbits(bits.astype(bool), bitorder="big").tobytes()
+    return _byte_rle_write(by)
+
+
+_ORC_KIND = {"BOOLEAN": 0, "SMALLINT": 2, "INTEGER": 3, "BIGINT": 4,
+             "REAL": 5, "DOUBLE": 6, "VARCHAR": 7, "CHAR": 7,
+             "JSON": 7, "VARBINARY": 8, "TIMESTAMP": 9, "DATE": 15,
+             "TINYINT": 1}
+
+
+def write_orc(path: str, arrays: Dict[str, np.ndarray],
+              schema: Dict[str, T.Type]) -> int:
+    """One-stripe ORC v0.12 file, DIRECT encodings, no compression."""
+    cols = list(schema)
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    streams = []  # (column id, kind, bytes)
+    for ci, c in enumerate(cols, start=1):
+        t = schema[c]
+        a = arrays[c]
+        if isinstance(a, np.ma.MaskedArray):
+            valid = ~np.ma.getmaskarray(a)
+            a = a.filled("" if t.is_string else 0)
+            streams.append((ci, 0, _bool_rle_write(valid)))
+            live = np.asarray(a)[valid]
+        else:
+            valid = None
+            live = np.asarray(a)
+        kind = _ORC_KIND.get(t.name)
+        if kind is None:
+            raise NotImplementedError(f"orc write of {t}")
+        if kind == 0:  # boolean bits
+            streams.append((ci, 1, _bool_rle_write(live.astype(bool))))
+        elif kind in (1,):  # tinyint: byte rle
+            streams.append((ci, 1, _byte_rle_write(
+                live.astype(np.int8).tobytes())))
+        elif kind in (2, 3, 4, 15):  # ints / date: signed RLE v1
+            streams.append((ci, 1, _rle_v1_write(
+                live.astype(np.int64), signed=True)))
+        elif kind == 5:
+            streams.append((ci, 1, live.astype("<f4").tobytes()))
+        elif kind == 6:
+            streams.append((ci, 1, live.astype("<f8").tobytes()))
+        elif kind in (7, 8):  # string/binary: DATA + LENGTH
+            bs = [v.encode() if isinstance(v, str) else
+                  (bytes(v) if v is not None else b"") for v in live]
+            streams.append((ci, 1, b"".join(bs)))
+            streams.append((ci, 2, _rle_v1_write(
+                np.asarray([len(b) for b in bs], np.int64),
+                signed=False)))
+        elif kind == 9:  # timestamp: seconds from 2015 + nanos
+            micros = live.astype(np.int64)
+            secs = micros // 1_000_000 - 1420070400
+            nanos = (micros % 1_000_000) * 1000
+            streams.append((ci, 1, _rle_v1_write(secs, signed=True)))
+            # SECONDARY (kind 5): nanos << 3, no trailing-zero packing
+            streams.append((ci, 5, _rle_v1_write(
+                nanos.astype(np.int64) << 3, signed=False)))
+
+    body = io.BytesIO()
+    body.write(MAGIC)
+    data_start = body.tell()
+    offsets = []
+    for _ci, _k, blob in streams:
+        offsets.append(body.tell())
+        body.write(blob)
+    data_len = body.tell() - data_start
+
+    # stripe footer
+    sf = _PWrite()
+    for ci, k, blob in streams:
+        st = _PWrite()
+        st.field_varint(1, k)
+        st.field_varint(2, ci)
+        st.field_varint(3, len(blob))
+        sf.field_msg(1, st)
+    for _ in range(len(cols) + 1):  # root + columns: DIRECT encoding
+        enc = _PWrite()
+        enc.field_varint(1, 0)
+        sf.field_msg(2, enc)
+    sf_bytes = bytes(sf.out)
+    sf_off = body.tell()
+    body.write(sf_bytes)
+
+    # footer
+    ftr = _PWrite()
+    ftr.field_varint(1, 3)  # headerLength (magic)
+    ftr.field_varint(2, body.tell())  # contentLength
+    stripe = _PWrite()
+    stripe.field_varint(1, data_start)  # offset
+    stripe.field_varint(2, 0)  # indexLength
+    stripe.field_varint(3, data_len)
+    stripe.field_varint(4, len(sf_bytes))
+    stripe.field_varint(5, n)
+    ftr.field_msg(3, stripe)
+    root = _PWrite()
+    root.field_varint(1, 12)  # STRUCT
+    for ci in range(1, len(cols) + 1):
+        root.field_varint(2, ci)  # subtypes (non-packed repeated)
+    for c in cols:
+        root.field_bytes(3, c.encode())
+    ftr.field_msg(4, root)
+    for c in cols:
+        el = _PWrite()
+        el.field_varint(1, _ORC_KIND[schema[c].name])
+        ftr.field_msg(4, el)
+    ftr.field_varint(6, n)  # numberOfRows
+    ftr.field_varint(8, 10000)  # rowIndexStride
+    ftr_bytes = bytes(ftr.out)
+    body.write(ftr_bytes)
+
+    ps = _PWrite()
+    ps.field_varint(1, len(ftr_bytes))
+    ps.field_varint(2, 0)  # compression NONE
+    ps.field_varint(3, 262144)
+    # version: repeated uint32 [0, 12] (non-packed)
+    ps.field_varint(4, 0)
+    ps.field_varint(4, 12)
+    ps.field_varint(5, 0)  # metadataLength
+    ps.field_varint(6, 6)  # writerVersion
+    ps.field_bytes(8, b"ORC")  # magic
+    ps_bytes = bytes(ps.out)
+    body.write(ps_bytes)
+    body.write(bytes([len(ps_bytes)]))
+    with open(path, "wb") as f:
+        f.write(body.getvalue())
+    return n

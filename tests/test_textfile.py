@@ -1,0 +1,100 @@
+"""CSV / JSON-lines tables (presto-record-decoder role)."""
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.catalog import Catalog
+
+
+def test_csv_infer_and_query(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("id,name,score,flag\n"
+                 "1,alice,9.5,true\n"
+                 "2,bob,,false\n"
+                 "3,,7.25,true\n")
+    cat = Catalog()
+    cat.register_csv("t", str(p))
+    s = presto_tpu.connect(cat)
+    t = cat.get("t")
+    assert t.schema["id"] == T.BIGINT
+    assert t.schema["score"] == T.DOUBLE
+    assert t.schema["flag"] == T.BOOLEAN
+    assert s.sql("SELECT count(*), count(score), count(name) "
+                 "FROM t").rows == [(3, 2, 2)]
+    assert s.sql("SELECT id FROM t WHERE flag ORDER BY id").rows \
+        == [(1,), (3,)]
+    assert s.sql("SELECT sum(score) FROM t").rows[0][0] \
+        == pytest.approx(16.75)
+
+
+def test_csv_explicit_schema_and_dates(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("d,v\n2026-01-01,10\n2026-07-31,20\n")
+    cat = Catalog()
+    cat.register_csv("d", str(p), {"d": T.DATE, "v": T.BIGINT})
+    s = presto_tpu.connect(cat)
+    assert s.sql("SELECT sum(v) FROM d WHERE d >= DATE '2026-02-01'"
+                 ).rows == [(20,)]
+
+
+def test_jsonl_union_of_keys_and_nested(tmp_path):
+    p = tmp_path / "e.jsonl"
+    p.write_text('{"a": 1, "b": "x"}\n'
+                 '{"a": 2, "c": 2.5, "nested": {"k": [1, 2]}}\n'
+                 '{"a": 3, "b": "y", "c": 4.5}\n')
+    cat = Catalog()
+    cat.register_jsonl("e", str(p))
+    s = presto_tpu.connect(cat)
+    assert s.sql("SELECT sum(a), count(b), sum(c) FROM e").rows \
+        == [(6, 2, 7.0)]
+    # nested values surface as JSON text, usable with json functions
+    r = s.sql("SELECT json_extract_scalar(nested, '$.k[1]') FROM e "
+              "WHERE nested IS NOT NULL").rows
+    assert r == [("2",)]
+
+
+def test_csv_joins_with_other_connectors(tmp_path):
+    p = tmp_path / "dim.csv"
+    p.write_text("k,label\n1,one\n2,two\n")
+    cat = Catalog()
+    cat.register_csv("dim", str(p))
+    cat.register_memory("f", {"k": T.BIGINT, "v": T.BIGINT},
+                        {"k": np.array([1, 2, 2]),
+                         "v": np.array([10, 20, 30])})
+    s = presto_tpu.connect(cat)
+    assert s.sql("SELECT label, sum(v) FROM f, dim WHERE f.k = dim.k "
+                 "GROUP BY label ORDER BY label").rows \
+        == [("one", 10), ("two", 50)]
+
+
+def test_jsonl_empty_string_is_not_null(tmp_path):
+    """Review regression: "" is a real JSON string, not NULL."""
+    p = tmp_path / "s.jsonl"
+    p.write_text('{"s": ""}\n{"s": null}\n{"s": "x"}\n')
+    cat = Catalog()
+    cat.register_jsonl("t", str(p))
+    s = presto_tpu.connect(cat)
+    assert s.sql("SELECT count(*), count(s) FROM t").rows == [(3, 2)]
+    assert s.sql("SELECT count(*) FROM t WHERE s = ''").rows == [(1,)]
+
+
+def test_csv_inference_falls_back_on_late_strings(tmp_path):
+    """Review regression: a non-numeric value past the inference sample
+    window downgrades the column to VARCHAR instead of crashing."""
+    rows = "\n".join(str(i) for i in range(300))
+    p = tmp_path / "late.csv"
+    p.write_text("a\n" + rows + "\noops\n")
+    cat = Catalog()
+    cat.register_csv("t", str(p))
+    s = presto_tpu.connect(cat)
+    assert cat.get("t").schema["a"] == T.VARCHAR
+    assert s.sql("SELECT count(*) FROM t").rows == [(301,)]
+
+
+def test_csv_explicit_schema_mismatch_is_informative(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("a\nx\n")
+    with pytest.raises(ValueError, match="column 'a'"):
+        Catalog().register_csv("t", str(p), {"a": T.BIGINT})
