@@ -1619,6 +1619,39 @@ class Executor:
                 red = K.segment_max(bits, gid, n_groups)
             r = jnp.sum(red.astype(jnp.int64) << shifts[None, :], axis=1)
             return Column(r, nonempty, T.BIGINT)
+        if a.fn in ("learn_classifier", "learn_regressor"):
+            # host-side training inside the aggregate (reference:
+            # presto-ml LearnAggregations over libsvm; here numpy
+            # logistic regression / ridge LSQ — see functions/ml.py)
+            if self.static:
+                raise StaticFallback(f"{a.fn} is dynamic-mode only")
+            from presto_tpu.functions import ml as ML
+
+            fv = eval_expr(a.args[1], b, self.ctx)
+            feats = np.asarray(fv.data)
+            labels = np.asarray(col.data)
+            if col.dictionary is not None:
+                labels = col.dictionary.values[
+                    np.clip(labels, 0, len(col.dictionary) - 1)]
+            elif col.type.is_decimal:
+                labels = labels.astype(np.float64) \
+                    / (10 ** col.type.decimal_scale)
+            gidh = np.asarray(gid)
+            vh = np.asarray(valid)
+            if fv.valid is not None:  # rows with NULL features skip
+                vh = vh & np.asarray(fv.valid)
+            blobs = np.empty(n_groups, dtype=object)
+            for g in range(n_groups):
+                m = (gidh == g) & vh
+                if not m.any():
+                    blobs[g] = b""
+                    continue
+                if a.fn == "learn_classifier":
+                    blobs[g] = ML.train_classifier(labels[m], feats[m])
+                else:
+                    blobs[g] = ML.train_regressor(
+                        labels[m].astype(np.float64), feats[m])
+            return _tuples_to_dict_column(blobs, nonempty, a.type)
         if a.fn in ("histogram", "numeric_histogram", "map_union"):
             # ragged MAP output, host-side like map_agg (reference:
             # Histogram / NumericHistogramAggregation / MapUnionAggregation)

@@ -87,6 +87,19 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         if arg_types[0].name != "MAP":
             raise TypeError("map_union takes a MAP argument")
         return arg_types[0]
+    if name in ("learn_classifier", "learn_regressor"):
+        if len(arg_types) != 2 or arg_types[1].name != "FEATURES":
+            raise TypeError(f"{name} takes (label, features(...))")
+        lt = arg_types[0]
+        if name == "learn_regressor" and not lt.is_numeric:
+            raise TypeError(f"learn_regressor label must be numeric, "
+                            f"got {lt}")
+        if name == "learn_classifier" and not (
+                lt.is_numeric or lt.is_string
+                or lt.name in ("BOOLEAN", "DATE")):
+            raise TypeError(f"learn_classifier label type {lt} "
+                            "is not supported")
+        return T.VARBINARY  # serialized model (presto-ml Model role)
     if name == "approx_percentile":
         if len(arg_types) != 2:
             raise TypeError("approx_percentile takes (value, percentile)")
@@ -133,7 +146,7 @@ AGG_NAMES = {
     "approx_set", "merge", "qdigest_agg",
     "regr_slope", "regr_intercept", "skewness", "kurtosis", "entropy",
     "bitwise_and_agg", "bitwise_or_agg", "histogram", "numeric_histogram",
-    "map_union",
+    "map_union", "learn_classifier", "learn_regressor",
 }
 
 

@@ -1,0 +1,172 @@
+"""ML functions (the presto-ml module role).
+
+Reference parity: presto-ml's learn_classifier / learn_regressor /
+classify / regress / features over libsvm models.  TPU-native
+adaptation: models train host-side inside the aggregate (like the
+sketch aggregates) — logistic regression and ridge least-squares on
+numpy instead of libsvm — and serialize to a VARBINARY blob; `classify`
+and `regress` apply the model VECTORIZED on device over the feature
+matrix (one jnp matmul per call, which is the TPU-shaped inference
+path the reference's per-row libsvm calls cannot take).
+
+`features(x1, x2, ...)` builds a device (n, k) float64 matrix carried
+as a typed column (like geospatial's point columns).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.exec.colval import ColVal, all_valid
+from presto_tpu.functions.scalar import register
+
+FEATURES = T.Type("FEATURES")  # device (n, k) f64 matrix
+T._PHYSICAL.setdefault("FEATURES", np.int32)
+
+MODEL = T.VARBINARY  # serialized model blob
+
+
+def _feat_f64(a: ColVal):
+    d = jnp.asarray(a.data).astype(jnp.float64)
+    if a.type.is_decimal:  # decimal data is the UNSCALED integer
+        d = d / (10 ** a.type.decimal_scale)
+    return d
+
+
+register("features")((
+    lambda args: FEATURES if args and all(a.is_numeric for a in args)
+    else None,
+    lambda args: ColVal(
+        jnp.stack(jnp.broadcast_arrays(*[_feat_f64(a) for a in args]),
+                  axis=-1),
+        all_valid(*args), FEATURES)))
+
+
+# ---------------------------------------------------------------------------
+# model blobs
+# ---------------------------------------------------------------------------
+
+
+def _pack_model(kind: str, weights: np.ndarray, bias,
+                classes=None) -> bytes:
+    return json.dumps({
+        "kind": kind,
+        "w": np.asarray(weights, np.float64).tolist(),
+        "b": (np.asarray(bias, np.float64).tolist()
+              if hasattr(bias, "__len__") else float(bias)),
+        "classes": None if classes is None else list(classes),
+    }).encode()
+
+
+def _unpack_model(blob) -> dict:
+    if isinstance(blob, str):
+        blob = blob.encode()
+    return json.loads(bytes(blob).decode())
+
+
+def train_classifier(labels: np.ndarray, feats: np.ndarray,
+                     iters: int = 300, lr: float = 0.5) -> bytes:
+    """Multinomial logistic regression by full-batch gradient descent
+    (the LibSvmClassifier role; classes = the distinct labels)."""
+    classes, y = np.unique(labels, return_inverse=True)
+    n, k = feats.shape
+    c = len(classes)
+    mu = feats.mean(axis=0)
+    sd = feats.std(axis=0)
+    sd[sd == 0] = 1.0
+    x = (feats - mu) / sd
+    w = np.zeros((k, c))
+    b = np.zeros(c)
+    onehot = np.eye(c)[y]
+    for _ in range(iters):
+        z = x @ w + b
+        z -= z.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        g = (p - onehot) / n
+        w -= lr * (x.T @ g + 1e-4 * w)
+        b -= lr * g.sum(axis=0)
+    # fold standardization into the weights: z = ((f-mu)/sd)w + b
+    w_raw = w / sd[:, None]
+    b_raw = b - mu @ w_raw
+    return _pack_model("classifier", w_raw, b_raw,
+                       [v.item() if hasattr(v, "item") else v
+                        for v in classes])
+
+
+def train_regressor(labels: np.ndarray, feats: np.ndarray) -> bytes:
+    """Ridge least squares (the LibSvmRegressor role)."""
+    n, k = feats.shape
+    xb = np.hstack([feats, np.ones((n, 1))])
+    ident = np.eye(k + 1) * 1e-8
+    ident[-1, -1] = 0.0
+    coef = np.linalg.solve(xb.T @ xb + ident, xb.T @ labels)
+    return _pack_model("regressor", coef[:-1], coef[-1])
+
+
+# ---------------------------------------------------------------------------
+# inference scalars (vectorized on device)
+# ---------------------------------------------------------------------------
+
+
+def _model_of(v: ColVal):
+    if getattr(v.data, "ndim", None) == 0 and v.dictionary is not None:
+        return _unpack_model(v.dictionary.values[int(v.data)])
+    if isinstance(v.data, (bytes, str)):
+        return _unpack_model(v.data)
+    if v.dictionary is not None and getattr(v.data, "ndim", 0) == 1:
+        # model arrived as a per-row column (the canonical CROSS JOIN
+        # form); one distinct model applies to the whole column
+        if len(v.dictionary) == 1:
+            return _unpack_model(v.dictionary.values[0])
+        import numpy as _np
+
+        codes = _np.unique(_np.asarray(v.data))
+        if len(codes) == 1:
+            return _unpack_model(v.dictionary.values[int(codes[0])])
+        raise NotImplementedError(
+            "classify/regress with multiple distinct models in one "
+            "column")
+    return None
+
+
+def _emit_apply(kind):
+    def emit(args):
+        feats, model = args
+        m = _model_of(model)
+        if m is None or m.get("kind") != kind:
+            raise ValueError(f"{kind} model expected")
+        x = jnp.asarray(feats.data)
+        if x.ndim == 1:
+            x = x[None, :]
+        w = jnp.asarray(np.asarray(m["w"], np.float64))
+        b = jnp.asarray(np.asarray(m["b"], np.float64))
+        z = x @ w + b  # ONE matmul for the whole column (MXU-shaped)
+        if kind == "regressor":
+            out = z if z.ndim == 1 else z.reshape(x.shape[0])
+            return ColVal(out, all_valid(*args), T.DOUBLE)
+        idx = jnp.argmax(z, axis=-1)
+        classes = m["classes"]
+        # type-stable: labels always come back as VARCHAR (the
+        # reference's classify is varchar-typed too)
+        from presto_tpu.exec.colval import normalize_dictionary
+
+        vals = np.empty(len(classes), object)
+        vals[:] = [str(c) for c in classes]
+        return normalize_dictionary(
+            vals, ColVal(idx.astype(jnp.int32), all_valid(*args),
+                         T.VARCHAR))
+
+    return emit
+
+
+register("classify")((
+    lambda args: T.VARCHAR if len(args) == 2 else None,
+    _emit_apply("classifier")))
+register("regress")((
+    lambda args: T.DOUBLE if len(args) == 2 else None,
+    _emit_apply("regressor")))

@@ -3028,3 +3028,4 @@ _register_sketch_fns()
 # their own modules to keep this file navigable)
 from presto_tpu.functions import scalar_ext as _scalar_ext  # noqa: E402,F401
 from presto_tpu.functions import geospatial as _geospatial  # noqa: E402,F401
+from presto_tpu.functions import ml as _ml  # noqa: E402,F401
