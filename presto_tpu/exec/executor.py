@@ -1896,7 +1896,13 @@ class Executor:
                 ok = jnp.ones_like(found) if lvalid is None else lvalid
                 if rnull is not None:
                     ok = ok & ~rnull
-                mvalid = found | ok
+                # An empty subquery makes the mark definitively FALSE no
+                # matter what the probe key is: `NULL NOT IN (empty)` is
+                # TRUE, so the mark must be valid-FALSE, not NULL.  Use
+                # right.sel (all build rows), not rsel — a build of only
+                # NULL keys is NOT empty and must keep the NULL mark.
+                build_nonempty = jnp.any(right.sel)
+                mvalid = found | ok | ~build_nonempty
             merged[node.mark] = Column(found, mvalid, T.BOOLEAN, None)
             return Batch(merged, left.sel)
 

@@ -1131,7 +1131,8 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool,
             if to.is_integer:
                 iv = int(d.quantize(Decimal(1), rounding=ROUND_HALF_UP,
                                     context=_hp))
-                if not -(1 << 63) <= iv < (1 << 63):
+                _tmin, _tmax = to.integer_bounds()
+                if not _tmin <= iv <= _tmax:
                     if safe:
                         return ColVal(0, False, to)
                     raise ValueError(
@@ -1178,9 +1179,14 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool,
             return ColVal(r.astype(to.numpy_dtype()), v.valid, to)
         if to.is_integer:
             r = D128.scale_down_round(a, s)
-            # rounded magnitude may exceed int64: taking the low limb
-            # alone would silently wrap (reference raises on overflow)
-            fits = r[..., D128.HI] == (r[..., D128.LO] >> 63)
+            # rounded magnitude may exceed the TARGET integer type:
+            # taking the low limb alone (or astype to a narrower int)
+            # would silently wrap (reference raises on overflow)
+            lo_limb = r[..., D128.LO]
+            fits = r[..., D128.HI] == (lo_limb >> 63)
+            tmin, tmax = to.integer_bounds()
+            if to.name != "BIGINT":
+                fits = fits & (lo_limb >= tmin) & (lo_limb <= tmax)
             valid = v.valid
             if safe:
                 valid = fits if valid is None else (jnp.asarray(valid)
@@ -1195,7 +1201,7 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool,
                     raise ValueError(
                         f"DECIMAL overflow: CAST {frm} -> {to} value "
                         "does not fit an integer")
-            return ColVal(r[..., D128.LO].astype(to.numpy_dtype()),
+            return ColVal(lo_limb.astype(to.numpy_dtype()),
                           valid, to)
         if to.is_string:
             if isinstance(a, jax.core.Tracer):
@@ -1282,8 +1288,28 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool,
         r = x.astype(jnp.float64) / (10 ** s)
         return ColVal(r.astype(to.numpy_dtype()), v.valid, to)
     if to.is_integer:
-        r = jnp.sign(x) * (jnp.abs(x.astype(jnp.int64)) // (10 ** s))
-        return ColVal(r.astype(to.numpy_dtype()), v.valid, to)
+        # HALF_UP rounding (reference DecimalCasts.shortDecimalToBigint
+        # rounds, it does not truncate) + target-dtype overflow check —
+        # astype alone would silently wrap e.g. 3000000000.5 -> INTEGER
+        half = (10 ** s) // 2
+        r = jnp.sign(x.astype(jnp.int64)) * (
+            (jnp.abs(x.astype(jnp.int64)) + half) // (10 ** s))
+        tmin, tmax = to.integer_bounds()
+        fits = (r >= tmin) & (r <= tmax)
+        valid = v.valid
+        if safe:
+            valid = fits if valid is None else (jnp.asarray(valid) & fits)
+        else:
+            live = fits if v.valid is None \
+                else fits | ~jnp.asarray(v.valid)
+            if isinstance(fits, jax.core.Tracer):
+                if guards is not None:  # see the long-decimal arm
+                    guards.append(~jnp.all(live))
+            elif not bool(jnp.all(live)):
+                raise ValueError(
+                    f"DECIMAL overflow: CAST {frm} -> {to} value "
+                    "does not fit the target integer type")
+        return ColVal(r.astype(to.numpy_dtype()), valid, to)
     raise NotImplementedError(f"CAST {frm} -> {to}")
 
 

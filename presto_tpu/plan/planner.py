@@ -1291,6 +1291,29 @@ def _literal_to_ir(e: ast.Literal) -> ir.Lit:
         us = int((np.datetime64(e.value) - np.datetime64("1970-01-01T00:00:00"))
                  / np.timedelta64(1, "us"))
         return ir.Lit(us, T.TIMESTAMP)
+    if e.type_hint == "decimal":
+        # DECIMAL 'x.y' typed literal: precision/scale from the text
+        # (reference DecimalParseResult / Decimals.parse)
+        from decimal import Decimal, InvalidOperation
+
+        import decimal as _dec
+
+        try:
+            d = Decimal(str(e.value).strip())
+        except InvalidOperation:
+            raise SemanticError(f"invalid DECIMAL literal {e.value!r}")
+        if not d.is_finite():  # Decimal('NaN')/'Infinity' parse fine
+            raise SemanticError(f"invalid DECIMAL literal {e.value!r}")
+        exp = d.as_tuple().exponent
+        scale = max(0, -exp)
+        with _dec.localcontext() as ctx:
+            ctx.prec = 80  # default 28 would round >28-digit literals
+            unscaled = int(d.scaleb(scale))
+        precision = max(len(str(abs(unscaled))), scale, 1)
+        if precision > 38:
+            raise SemanticError(
+                f"DECIMAL literal {e.value!r} exceeds precision 38")
+        return ir.Lit(unscaled, T.decimal(precision, scale))
     if isinstance(e.value, bool):
         return ir.Lit(e.value, T.BOOLEAN)
     if isinstance(e.value, int):

@@ -805,6 +805,11 @@ class Parser:
         if self.at_kw("TIMESTAMP") and self.peek(1).kind == "string":
             self.next()
             return ast.Literal(self.next().value, type_hint="timestamp")
+        if (self.peek().kind == "ident"
+                and str(self.peek().value).upper() == "DECIMAL"
+                and self.peek(1).kind == "string"):
+            self.next()  # DECIMAL is not reserved, so it lexes as ident
+            return ast.Literal(self.next().value, type_hint="decimal")
         if self.accept_kw("INTERVAL"):
             sign = -1 if self.accept_op("-") else 1
             v = self.next()

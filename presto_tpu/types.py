@@ -94,6 +94,15 @@ class Type:
     def numpy_dtype(self) -> np.dtype:
         return np.dtype(_PHYSICAL[self.name])
 
+    def integer_bounds(self):
+        """LOGICAL (min, max) for integer types.  Distinct from the
+        physical dtype: TINYINT/SMALLINT are stored as int32 lanes, but
+        CAST overflow semantics follow the SQL type (reference raises
+        out-of-range, e.g. IntegerOperators.saturatedFloorCastToSmallint)."""
+        bits = {"TINYINT": 8, "SMALLINT": 16, "INTEGER": 32, "BIGINT": 64}[
+            self.name]
+        return -(1 << (bits - 1)), (1 << (bits - 1)) - 1
+
 
 BOOLEAN = Type("BOOLEAN")
 TINYINT = Type("TINYINT")

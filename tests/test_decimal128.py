@@ -257,3 +257,40 @@ def test_desc_sort_low_limb_tie():
     sess = presto_tpu.connect(cat)
     rows = [r[0] for r in sess.sql("SELECT v FROM t ORDER BY v DESC").rows]
     assert rows == [Decimal(k + 1), Decimal(k), Decimal(k - 1)]
+
+
+def test_decimal_typed_literal():
+    import presto_tpu
+    from presto_tpu.catalog import Catalog
+    s = presto_tpu.connect(Catalog())
+    assert s.sql("SELECT DECIMAL '1.5' + DECIMAL '2.25'").rows == [(3.75,)]
+    from decimal import Decimal
+    assert s.sql("SELECT DECIMAL '99999999999999999999.5' * 2").rows[0][0] \
+        == Decimal("199999999999999999999.0")
+
+
+def test_decimal_to_int_cast_overflow_and_rounding():
+    # round-5 ADVICE: narrow-int casts must range-check the LOGICAL type
+    # (TINYINT/SMALLINT store in int32 lanes) and round HALF_UP
+    import pytest
+
+    import presto_tpu
+    from presto_tpu.catalog import Catalog
+    s = presto_tpu.connect(Catalog())
+    assert s.sql("SELECT CAST(DECIMAL '2.5' AS BIGINT)").rows == [(3,)]
+    assert s.sql("SELECT CAST(DECIMAL '-2.5' AS BIGINT)").rows == [(-3,)]
+    assert s.sql("SELECT CAST(DECIMAL '3000000000.5' AS BIGINT)").rows \
+        == [(3000000001,)]
+    for q in ["SELECT CAST(DECIMAL '3000000000.5' AS INTEGER)",
+              "SELECT CAST(DECIMAL '40000.5' AS SMALLINT)",
+              "SELECT CAST(DECIMAL '200.0' AS TINYINT)",
+              "SELECT CAST(DECIMAL '99999999999999999999999999999.0'"
+              " AS BIGINT)"]:
+        with pytest.raises(ValueError):
+            s.sql(q)
+    assert s.sql("SELECT TRY_CAST(DECIMAL '3000000000.5' AS INTEGER)").rows \
+        == [(None,)]
+    # column (non-scalar) path
+    r = s.sql("SELECT CAST(CAST(x AS DECIMAL(10,1)) AS INTEGER) "
+              "FROM (VALUES (2.5),(1.4)) t(x)")
+    assert r.rows == [(3,), (1,)]

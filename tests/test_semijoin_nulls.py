@@ -73,3 +73,22 @@ def test_empty_side_outer_joins():
                 "(SELECT 5 AS y FROM (VALUES (0)) w(z) LIMIT 0) r "
                 "ON u.k = r.y ORDER BY k").rows
     assert got == [(1,), (2,)]
+
+
+def test_not_in_runtime_empty_build_keeps_all_rows():
+    # round-5 ADVICE: a build side that is empty only at RUNTIME (rows
+    # exist, all filtered) must behave like the statically-empty case:
+    # `x NOT IN (empty)` is TRUE even for NULL x.
+    s = _s()
+    r = s.sql(f"SELECT a {BASE} WHERE a NOT IN "
+              f"(SELECT b FROM {U_NULL} u(b) WHERE b > 100)")
+    assert sorted(x[0] is None and -1 or x[0] for x in r.rows) == [-1, 1, 2]
+
+
+def test_not_in_build_all_null_keys_still_filters():
+    # a build of ONLY null keys is NOT empty: the IN-list is {NULL},
+    # so every NOT IN is NULL -> filtered.
+    s = _s()
+    r = s.sql(f"SELECT a {BASE} WHERE a NOT IN "
+              "(SELECT b FROM (VALUES (CAST(NULL AS BIGINT))) u(b))")
+    assert r.rows == []
