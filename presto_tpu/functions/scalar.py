@@ -1098,6 +1098,22 @@ register("least")((_resolve_coalesce, _emit_fold(jnp.minimum)))
 # ---- cast -----------------------------------------------------------------
 
 
+def _overflow_checked_valid(fits, v: ColVal, safe: bool, guards, msg: str):
+    """Shared CAST-overflow plumbing: under TRY_CAST the failing rows go
+    NULL; otherwise raise eagerly, or (at trace time) append a guard that
+    aborts the compiled program to the dynamic path, which re-evaluates
+    eagerly and raises properly.  Returns the result validity mask."""
+    if safe:
+        return fits if v.valid is None else (jnp.asarray(v.valid) & fits)
+    live = fits if v.valid is None else fits | ~jnp.asarray(v.valid)
+    if isinstance(fits, jax.core.Tracer):
+        if guards is not None:
+            guards.append(~jnp.all(live))
+    elif not bool(jnp.all(live)):
+        raise ValueError(msg)
+    return v.valid
+
+
 def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool,
                        guards=None) -> ColVal:
     from presto_tpu.exec import dec128 as D128
@@ -1156,23 +1172,10 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool,
                 else D128.scale_up(a, to.decimal_scale - s)
             fits = r[..., D128.HI] == (r[..., D128.LO] >> 63)
             short = r[..., D128.LO]
-            valid = v.valid
-            if safe:
-                valid = fits if valid is None else (jnp.asarray(valid)
-                                                    & fits)
-            else:
-                live = fits if v.valid is None \
-                    else fits | ~jnp.asarray(v.valid)
-                if isinstance(fits, jax.core.Tracer):
-                    # compiled mode cannot raise at trace time: a guard
-                    # aborts the compiled program to the dynamic path,
-                    # which re-evaluates eagerly and raises properly
-                    if guards is not None:
-                        guards.append(~jnp.all(live))
-                elif not bool(jnp.all(live)):
-                    raise ValueError(
-                        f"DECIMAL overflow: CAST {frm} -> {to} value "
-                        "does not fit a short decimal")
+            valid = _overflow_checked_valid(
+                fits, v, safe, guards,
+                f"DECIMAL overflow: CAST {frm} -> {to} value "
+                "does not fit a short decimal")
             return ColVal(short, valid, to)
         if to.is_floating:
             r = D128.to_float64(a) / (10 ** s)
@@ -1187,20 +1190,10 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool,
             tmin, tmax = to.integer_bounds()
             if to.name != "BIGINT":
                 fits = fits & (lo_limb >= tmin) & (lo_limb <= tmax)
-            valid = v.valid
-            if safe:
-                valid = fits if valid is None else (jnp.asarray(valid)
-                                                    & fits)
-            else:
-                live = fits if v.valid is None \
-                    else fits | ~jnp.asarray(v.valid)
-                if isinstance(fits, jax.core.Tracer):
-                    if guards is not None:  # see the short-decimal arm
-                        guards.append(~jnp.all(live))
-                elif not bool(jnp.all(live)):
-                    raise ValueError(
-                        f"DECIMAL overflow: CAST {frm} -> {to} value "
-                        "does not fit an integer")
+            valid = _overflow_checked_valid(
+                fits, v, safe, guards,
+                f"DECIMAL overflow: CAST {frm} -> {to} value "
+                "does not fit an integer")
             return ColVal(lo_limb.astype(to.numpy_dtype()),
                           valid, to)
         if to.is_string:
@@ -1296,19 +1289,10 @@ def _emit_cast_decimal(v: ColVal, to: T.Type, safe: bool,
             (jnp.abs(x.astype(jnp.int64)) + half) // (10 ** s))
         tmin, tmax = to.integer_bounds()
         fits = (r >= tmin) & (r <= tmax)
-        valid = v.valid
-        if safe:
-            valid = fits if valid is None else (jnp.asarray(valid) & fits)
-        else:
-            live = fits if v.valid is None \
-                else fits | ~jnp.asarray(v.valid)
-            if isinstance(fits, jax.core.Tracer):
-                if guards is not None:  # see the long-decimal arm
-                    guards.append(~jnp.all(live))
-            elif not bool(jnp.all(live)):
-                raise ValueError(
-                    f"DECIMAL overflow: CAST {frm} -> {to} value "
-                    "does not fit the target integer type")
+        valid = _overflow_checked_valid(
+            fits, v, safe, guards,
+            f"DECIMAL overflow: CAST {frm} -> {to} value "
+            "does not fit the target integer type")
         return ColVal(r.astype(to.numpy_dtype()), valid, to)
     raise NotImplementedError(f"CAST {frm} -> {to}")
 
