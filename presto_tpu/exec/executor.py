@@ -55,6 +55,9 @@ def execute_query(session, text: str) -> QueryResult:
     from presto_tpu.observe.stats import QueryMonitor
 
     mon = QueryMonitor.begin(session, text)
+    from presto_tpu import session_ctx
+
+    session_ctx.activate(session)  # zone + query-stable now()
     try:
         with mon.phase("parse"):
             stmt = parse(text)

@@ -1,0 +1,453 @@
+"""TIME / TIMESTAMP WITH TIME ZONE function surface.
+
+Reference: presto-main/.../operator/scalar/DateTimeFunctions.java
+(at_timezone, with_timezone, zone-aware extract/date_trunc/date_add/
+date_format, timezone_hour/minute), spi/type/TimestampWithTimeZoneType,
+TimeWithTimeZoneType.
+
+Design (see types.Type.tz): the zone rides the column TYPE, the device
+lane is pure UTC int64 micros.  Zone-dependent functions LOCALIZE the
+lane (one searchsorted over the zone's transition table, tzdb.ZoneRules)
+into a plain-TIMESTAMP wall clock, reuse the existing zone-less
+emitters, and — when the result is temporal — convert back.  That keeps
+every civil-field algorithm (civil_from_days etc.) in exactly one place
+and makes the TZ surface a thin adapter instead of a parallel
+implementation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import session_ctx
+from presto_tpu import types as T
+from presto_tpu.exec.colval import ColVal
+from presto_tpu.functions import tzdb
+from presto_tpu.functions.scalar import (
+    REGISTRY,
+    _as_string_literal,
+    all_valid,
+    register,
+)
+
+US_PER_DAY = 86_400_000_000
+
+
+def _zone_of(v: ColVal) -> tzdb.ZoneRules:
+    return tzdb.rules(v.type.tz or "UTC")
+
+
+def _localize(v: ColVal) -> ColVal:
+    """TIMESTAMP_TZ -> zone-less TIMESTAMP carrying the zone's wall
+    clock (device conversion); anything else passes through."""
+    if v.type.name != "TIMESTAMP_TZ":
+        return v
+    r = _zone_of(v)
+    data = v.data if not hasattr(v.data, "shape") and v.is_scalar \
+        else jnp.asarray(v.data)
+    if v.is_scalar and not hasattr(v.data, "shape"):
+        return ColVal(r.utc_to_local_scalar(int(v.data)), v.valid,
+                      T.TIMESTAMP)
+    return ColVal(r.utc_to_local(data.astype(jnp.int64)), v.valid,
+                  T.TIMESTAMP)
+
+
+def _delocalize(v: ColVal, zone: str) -> ColVal:
+    """Zone-less wall-clock TIMESTAMP -> TIMESTAMP_TZ in `zone`."""
+    r = tzdb.rules(zone)
+    if v.is_scalar and not hasattr(v.data, "shape"):
+        return ColVal(r.local_to_utc_scalar(int(v.data)), v.valid,
+                      T.timestamp_tz(zone))
+    return ColVal(r.local_to_utc(jnp.asarray(v.data).astype(jnp.int64)),
+                  v.valid, T.timestamp_tz(zone))
+
+
+def _zone_arg(v: ColVal) -> str:
+    z = _as_string_literal(v)
+    if z is None:
+        raise NotImplementedError("time zone argument must be a literal")
+    if not tzdb.is_valid_zone(z):
+        raise ValueError(f"unknown time zone: {z!r}")
+    return z
+
+
+# ---- at_timezone / with_timezone -----------------------------------------
+
+
+def _emit_at_timezone(args):
+    v, zone = args[0], _zone_arg(args[1])
+    if v.type.name == "TIMESTAMP":  # coerce via the session zone first
+        v = _delocalize(v, session_ctx.current_zone())
+    if v.type.name != "TIMESTAMP_TZ":
+        raise NotImplementedError(f"at_timezone({v.type})")
+    # same instant, new display zone: the lane is already UTC
+    return ColVal(v.data, v.valid, T.timestamp_tz(zone), v.dictionary)
+
+
+register("at_timezone")((
+    lambda args: (T.timestamp_tz() if len(args) == 2
+                  and args[0].name in ("TIMESTAMP", "TIMESTAMP_TZ")
+                  and args[1].is_string else None),
+    _emit_at_timezone))
+
+
+def _emit_with_timezone(args):
+    v, zone = args[0], _zone_arg(args[1])
+    if v.type.name != "TIMESTAMP":
+        raise NotImplementedError(f"with_timezone({v.type})")
+    return _delocalize(v, zone)
+
+
+register("with_timezone")((
+    lambda args: (T.timestamp_tz() if len(args) == 2
+                  and args[0].name == "TIMESTAMP"
+                  and args[1].is_string else None),
+    _emit_with_timezone))
+
+
+# ---- session-dependent constants ------------------------------------------
+# (reference: now()/current_timestamp return TIMESTAMP WITH TIME ZONE at
+# the session zone and are stable across the query —
+# session.getStartTime())
+
+
+def _now_tz_emit(args):
+    return ColVal(session_ctx.query_start_us(), None,
+                  T.timestamp_tz(session_ctx.current_zone()))
+
+
+register("now")((lambda args: T.timestamp_tz() if not args else None,
+                 _now_tz_emit))
+register("current_timestamp")((
+    lambda args: T.timestamp_tz() if not args else None, _now_tz_emit))
+register("localtimestamp")((
+    lambda args: T.TIMESTAMP if not args else None,
+    lambda args: _localize(_now_tz_emit(args))))
+register("current_date")((
+    lambda args: T.DATE if not args else None,
+    lambda args: ColVal(
+        int(_localize(_now_tz_emit(args)).data) // US_PER_DAY, None,
+        T.DATE)))
+register("current_timezone")((
+    lambda args: T.VARCHAR if not args else None,
+    lambda args: ColVal(session_ctx.current_zone(), None, T.VARCHAR)))
+register("current_user")((
+    lambda args: T.VARCHAR if not args else None,
+    lambda args: ColVal(session_ctx.current_user(), None, T.VARCHAR)))
+register("localtime")((
+    lambda args: T.TIME if not args else None,
+    lambda args: ColVal(
+        int(_localize(_now_tz_emit(args)).data) % US_PER_DAY, None,
+        T.TIME)))
+
+
+def _current_time_emit(args):
+    zone = session_ctx.current_zone()
+    utc = session_ctx.query_start_us()
+    off_us = tzdb.rules(zone).offset_at_utc_scalar(utc)
+    return ColVal((utc + off_us) % US_PER_DAY, None,
+                  T.time_tz(off_us // 60_000_000))
+
+
+register("current_time")((
+    lambda args: T.time_tz() if not args else None, _current_time_emit))
+
+
+# ---- unix time ------------------------------------------------------------
+
+register("to_unixtime")((
+    lambda args: (T.DOUBLE if args
+                  and args[0].name in ("TIMESTAMP", "TIMESTAMP_TZ")
+                  else None),
+    lambda args: ColVal(jnp.asarray(args[0].data).astype(jnp.float64) / 1e6,
+                        args[0].valid, T.DOUBLE)))
+
+_prev_from_unixtime = REGISTRY["from_unixtime"]
+
+
+def _emit_from_unixtime(args):
+    if len(args) == 1:
+        return _prev_from_unixtime.emit(args)
+    us = (jnp.asarray(args[0].data).astype(jnp.float64)
+          * 1e6).astype(jnp.int64)
+    if len(args) == 2:  # (unixtime, zone-string)
+        return ColVal(us, args[0].valid,
+                      T.timestamp_tz(_zone_arg(args[1])))
+    # (unixtime, hours, minutes) fixed offset: total = hours*60+minutes
+    # (reference DateTimeFunctions.fromUnixTime(double, long, long))
+    total = int(np.asarray(args[1].data)) * 60 + int(np.asarray(args[2].data))
+    sign = "-" if total < 0 else "+"
+    return ColVal(us, args[0].valid,
+                  T.timestamp_tz(
+                      f"{sign}{abs(total) // 60:02d}:{abs(total) % 60:02d}"))
+
+
+register("from_unixtime")((
+    lambda args: (T.TIMESTAMP if len(args) == 1 and args[0].is_numeric
+                  else T.timestamp_tz()
+                  if (len(args) == 2 and args[0].is_numeric
+                      and args[1].is_string)
+                  or (len(args) == 3 and all(a.is_numeric for a in args))
+                  else None),
+    _emit_from_unixtime))
+
+
+# ---- timezone_hour / timezone_minute --------------------------------------
+
+
+def _tz_offset_us(v: ColVal):
+    r = _zone_of(v)
+    if v.is_scalar and not hasattr(v.data, "shape"):
+        return jnp.asarray(r.offset_at_utc_scalar(int(v.data)), jnp.int64)
+    data = jnp.asarray(v.data).astype(jnp.int64)
+    return r.utc_to_local(data) - data
+
+
+def _tz_field(divisor, mod):
+    def emit(args):
+        v = args[0]
+        if v.type.name == "TIME_TZ":
+            off_min = int(v.type.tz or 0)
+            off = jnp.full(jnp.asarray(v.data).shape, off_min * 60_000_000,
+                           jnp.int64) if hasattr(v.data, "shape") \
+                else jnp.asarray(off_min * 60_000_000, jnp.int64)
+        elif v.type.name == "TIMESTAMP_TZ":
+            off = _tz_offset_us(v)
+        else:
+            off = jnp.zeros_like(jnp.asarray(v.data), jnp.int64)
+        sign = jnp.sign(off)
+        r = sign * ((jnp.abs(off) // divisor) % mod)
+        return ColVal(r.astype(jnp.int64), v.valid, T.BIGINT)
+
+    return emit
+
+
+register("timezone_hour")((
+    lambda args: T.BIGINT if args and args[0].name in
+    ("TIMESTAMP", "TIMESTAMP_TZ", "TIME_TZ") else None,
+    _tz_field(3_600_000_000, 24)))
+register("timezone_minute")((
+    lambda args: T.BIGINT if args and args[0].name in
+    ("TIMESTAMP", "TIMESTAMP_TZ", "TIME_TZ") else None,
+    _tz_field(60_000_000, 60)))
+
+
+# ---- localizing adapters over the zone-less emitters ----------------------
+# Every civil-field function keeps its single zone-less implementation;
+# the adapter converts a TIMESTAMP_TZ argument to its wall clock first
+# (and TIME/TIME_TZ to micros where the original expects TIMESTAMP).
+
+
+def _wrap_localize_arg(name, arg_idx=0, relocalize_result=False):
+    prev = REGISTRY.get(name)
+    if prev is None:
+        return
+    prev_resolve, prev_emit = prev.resolve, prev.emit
+
+    def resolve(args):
+        mapped = [T.TIMESTAMP if a.name == "TIMESTAMP_TZ"
+                  and i == arg_idx else a for i, a in enumerate(args)]
+        r = prev_resolve(mapped)
+        if r is None:
+            return None
+        if relocalize_result and len(args) > arg_idx \
+                and args[arg_idx].name == "TIMESTAMP_TZ" \
+                and r.name == "TIMESTAMP":
+            return args[arg_idx]
+        return r
+
+    def emit(args):
+        src = args[arg_idx] if arg_idx < len(args) else None
+        if src is not None and src.type.name == "TIMESTAMP_TZ":
+            largs = list(args)
+            largs[arg_idx] = _localize(src)
+            out = prev_emit(largs)
+            if relocalize_result and out.type.name == "TIMESTAMP":
+                return _delocalize(out, src.type.tz or "UTC")
+            return out
+        return prev_emit(args)
+
+    REGISTRY[name].resolve = resolve
+    REGISTRY[name].emit = emit
+
+
+for _n in ("extract_year", "extract_month", "extract_day",
+           "extract_quarter", "extract_dow", "extract_doy",
+           "extract_week", "year", "month", "day", "quarter",
+           "day_of_week", "day_of_month", "day_of_year", "week_of_year",
+           "year_of_week", "yow", "date_format", "format_datetime",
+           "to_iso8601", "to_char", "date"):
+    _wrap_localize_arg(_n, 0)
+for _n in ("hour", "minute", "second", "millisecond"):
+    _wrap_localize_arg(_n, 0)
+_wrap_localize_arg("date_trunc", 1, relocalize_result=True)
+_wrap_localize_arg("date_add", 2, relocalize_result=True)
+for _i in (1, 2):
+    _wrap_localize_arg("date_diff", _i)
+
+
+# ---- TIME field access ----------------------------------------------------
+# hour/minute/second/millisecond over TIME / TIME_TZ: the lane is
+# already local micros-since-midnight, so the field math is direct.
+
+
+def _extend_time_fields():
+    for name, div, mod in (("hour", 3_600_000_000, 24),
+                           ("minute", 60_000_000, 60),
+                           ("second", 1_000_000, 60),
+                           ("millisecond", 1_000, 1000)):
+        prev = REGISTRY[name]
+        prev_resolve, prev_emit = prev.resolve, prev.emit
+
+        def resolve(args, _pr=prev_resolve):
+            if args and args[0].name in ("TIME", "TIME_TZ"):
+                return T.BIGINT
+            return _pr(args)
+
+        def emit(args, _pe=prev_emit, _div=div, _mod=mod):
+            v = args[0]
+            if v.type.name in ("TIME", "TIME_TZ"):
+                us = jnp.asarray(v.data).astype(jnp.int64)
+                return ColVal(((us // _div) % _mod).astype(jnp.int64),
+                              v.valid, T.BIGINT)
+            return _pe(args)
+
+        prev.resolve = resolve
+        prev.emit = emit
+
+
+_extend_time_fields()
+
+
+# ---- casts ---------------------------------------------------------------
+# (reference: DateTimeOperators / the *CastTo* operators on
+# TimestampWithTimeZoneType, TimeType, TimeWithTimeZoneType)
+
+
+def _session_zone_of(t: T.Type) -> str:
+    return t.tz or session_ctx.current_zone()
+
+
+def emit_cast_tz(v: ColVal, to: T.Type, safe: bool):
+    """Cast arms for the TZ family.  Returns None for combinations the
+    generic emit_cast path already handles (rendering to VARCHAR)."""
+    frm = v.type
+    if to.is_string:
+        return None  # _cast_to_varchar renders via _render_varchar
+    if frm.name == "TIMESTAMP_TZ":
+        if to.name == "TIMESTAMP_TZ":
+            # zone-less target (bare CAST .. AS TIMESTAMP WITH TIME
+            # ZONE) is the identity — keep the VALUE's zone; only an
+            # explicit target zone retags (same instant either way)
+            return ColVal(v.data, v.valid,
+                          frm if to.tz is None else to, v.dictionary)
+        if to.name == "TIMESTAMP":
+            return _localize(v)
+        if to.name == "DATE":
+            loc = _localize(v)
+            return ColVal(
+                jnp.floor_divide(jnp.asarray(loc.data), US_PER_DAY)
+                .astype(jnp.int32), v.valid, T.DATE)
+        if to.name == "TIME":
+            loc = _localize(v)
+            return ColVal(jnp.mod(jnp.asarray(loc.data), US_PER_DAY)
+                          .astype(jnp.int64), v.valid, T.TIME)
+        return None
+    if to.name == "TIMESTAMP_TZ":
+        zone = _session_zone_of(to)
+        if frm.name == "TIMESTAMP":
+            return _delocalize(v, zone)
+        if frm.name == "DATE":
+            wall = ColVal(jnp.asarray(v.data).astype(jnp.int64)
+                          * US_PER_DAY if hasattr(v.data, "shape")
+                          or not v.is_scalar
+                          else int(v.data) * US_PER_DAY, v.valid,
+                          T.TIMESTAMP)
+            return _delocalize(wall, zone)
+        if frm.is_string:
+            return _parse_tstz_strings(v, zone, safe)
+        return None
+    if frm.name == "TIME":
+        if to.name == "TIME_TZ":
+            off = int(to.tz) if to.tz is not None else \
+                tzdb.rules(session_ctx.current_zone()).offset_at_utc_scalar(
+                    session_ctx.query_start_us()) // 60_000_000
+            return ColVal(v.data, v.valid, T.time_tz(off), v.dictionary)
+        return None
+    if frm.name == "TIME_TZ" and to.name == "TIME":
+        return ColVal(v.data, v.valid, T.TIME, v.dictionary)
+    if to.name == "TIME" and frm.is_string:
+        return _parse_time_strings(v, safe)
+    return None
+
+
+def _host_parse_lut(v: ColVal, parse_one, out_type: T.Type, safe: bool,
+                    dtype=np.int64):
+    """Parse every dictionary entry host-side into an int lane LUT."""
+    from presto_tpu.functions.scalar import _lit_to_dict_colval
+
+    if isinstance(v.data, str):
+        v = _lit_to_dict_colval(v)
+    vals = v.dictionary.values
+    lut = np.zeros(max(len(vals), 1), dtype=dtype)
+    bad = np.zeros(max(len(vals), 1), dtype=bool)
+    for i, s in enumerate(vals):
+        try:
+            lut[i] = parse_one(str(s))
+        except (ValueError, KeyError):
+            if not safe:
+                raise ValueError(f"cannot CAST {s!r} to {out_type}")
+            bad[i] = True
+    codes = jnp.clip(v.data, 0, len(lut) - 1)
+    data = jnp.asarray(lut)[codes]
+    valid = v.valid
+    if bad.any():
+        ok = ~jnp.asarray(bad)[codes]
+        valid = ok if valid is None else (jnp.asarray(valid) & ok)
+    return ColVal(data, valid, out_type)
+
+
+def _parse_tstz_strings(v: ColVal, default_zone: str, safe: bool):
+    """VARCHAR -> TIMESTAMP WITH TIME ZONE.  A zone suffix in the text
+    wins; otherwise the cast-target/session zone interprets the wall
+    clock.  Mixed-zone inputs collapse to the FIRST zone seen (single
+    zone per column — same instant, display zone approximated)."""
+    import re as _re
+
+    zone_seen = [None]
+
+    def parse_one(s):
+        m = _re.match(
+            r"^(\d{4}-\d{2}-\d{2})"
+            r"(?:[ T](\d{2}:\d{2}(?::\d{2}(?:\.\d{1,6})?)?))?"
+            r"(?:\s+(\S.*))?$", s.strip())
+        if m is None:
+            raise ValueError(s)
+        civil = m.group(1) + ("T" + m.group(2) if m.group(2) else "")
+        local_us = int((np.datetime64(civil)
+                        - np.datetime64("1970-01-01T00:00:00"))
+                       / np.timedelta64(1, "us"))
+        zone = m.group(3) or default_zone
+        if zone_seen[0] is None:
+            zone_seen[0] = zone
+        return tzdb.rules(zone).local_to_utc_scalar(local_us)
+
+    out = _host_parse_lut(v, parse_one, T.timestamp_tz(default_zone), safe)
+    return ColVal(out.data, out.valid,
+                  T.timestamp_tz(zone_seen[0] or default_zone))
+
+
+def _parse_time_strings(v: ColVal, safe: bool):
+    import re as _re
+
+    def parse_one(s):
+        m = _re.match(r"^(\d{2}):(\d{2})(?::(\d{2})(?:\.(\d{1,6}))?)?$",
+                      s.strip())
+        if m is None:
+            raise ValueError(s)
+        frac = (m.group(4) or "").ljust(6, "0")
+        return ((int(m.group(1)) * 3600 + int(m.group(2)) * 60
+                 + int(m.group(3) or 0)) * 1_000_000 + int(frac or 0))
+
+    return _host_parse_lut(v, parse_one, T.TIME, safe)

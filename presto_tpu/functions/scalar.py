@@ -213,13 +213,16 @@ def _resolve_arith(name):
             return None
         a, b = args
         # date/timestamp +- interval
-        if name in ("add", "sub") and a.name == "DATE":
-            if b.name == "INTERVAL_DAY_TIME":
-                return T.DATE
-            if b.name == "INTERVAL_YEAR_MONTH":
-                return T.DATE
-        if name == "add" and a.name == "INTERVAL_DAY_TIME" and b.name == "DATE":
-            return T.DATE
+        if name in ("add", "sub") \
+                and a.name in ("DATE", "TIMESTAMP", "TIMESTAMP_TZ", "TIME") \
+                and b.name in ("INTERVAL_DAY_TIME", "INTERVAL_YEAR_MONTH"):
+            if a.name == "TIME" and b.name == "INTERVAL_YEAR_MONTH":
+                return None
+            return a
+        if name == "add" and a.name in ("INTERVAL_DAY_TIME",
+                                        "INTERVAL_YEAR_MONTH") \
+                and b.name in ("DATE", "TIMESTAMP", "TIMESTAMP_TZ"):
+            return b
         if a.is_numeric and b.is_numeric:
             ct = T.common_super_type(a, b)
             if ct is not None and ct.is_decimal:
@@ -237,14 +240,44 @@ def _emit_arith(name):
     def emit(args):
         a, b = args
         valid = all_valid(a, b)
-        if a.type.name == "DATE" and b.type.name == "INTERVAL_DAY_TIME":
-            delta = b.data if name == "add" else -b.data
-            return ColVal((jnp.asarray(a.data) + delta).astype(jnp.int32), valid, T.DATE)
-        if a.type.name == "DATE" and b.type.name == "INTERVAL_YEAR_MONTH":
+        if a.type.name in ("INTERVAL_DAY_TIME", "INTERVAL_YEAR_MONTH") \
+                and b.type.name in ("DATE", "TIMESTAMP", "TIMESTAMP_TZ"):
+            a, b = b, a  # interval + temporal commutes (add only)
+        if b.type.name == "INTERVAL_DAY_TIME" and a.type.name in (
+                "DATE", "TIMESTAMP", "TIMESTAMP_TZ", "TIME"):
+            delta = b.data if name == "add" else -b.data  # micros
+            if a.type.name == "DATE":
+                # whole result days (reference: joda plus + toDate)
+                us = jnp.asarray(a.data).astype(jnp.int64) \
+                    * 86_400_000_000 + delta
+                return ColVal(jnp.floor_divide(us, 86_400_000_000)
+                              .astype(jnp.int32), valid, T.DATE)
+            if a.type.name == "TIME":
+                r = jnp.mod(jnp.asarray(a.data) + delta, 86_400_000_000)
+                return ColVal(r.astype(jnp.int64), valid, T.TIME)
+            # TIMESTAMP wall / TIMESTAMP_TZ instant: plain micros add
+            return ColVal((jnp.asarray(a.data) + delta)
+                          .astype(jnp.int64), valid, a.type)
+        if b.type.name == "INTERVAL_YEAR_MONTH" and a.type.name in (
+                "DATE", "TIMESTAMP", "TIMESTAMP_TZ"):
             months = b.data if name == "add" else -b.data
-            return ColVal(add_months(a.data, months), valid, T.DATE)
-        if a.type.name == "INTERVAL_DAY_TIME":
-            return ColVal((jnp.asarray(b.data) + a.data).astype(jnp.int32), valid, T.DATE)
+            if a.type.name == "DATE":
+                return ColVal(add_months(a.data, months), valid, T.DATE)
+            from presto_tpu.functions import datetime_tz as _dtz
+
+            src = a
+            if a.type.name == "TIMESTAMP_TZ":  # civil math on wall clock
+                src = _dtz._localize(a)
+            us = jnp.asarray(src.data).astype(jnp.int64)
+            days = jnp.floor_divide(us, 86_400_000_000)
+            rem = us - days * 86_400_000_000
+            out = add_months(days, months).astype(jnp.int64) \
+                * 86_400_000_000 + rem
+            r = ColVal(out, valid, T.TIMESTAMP)
+            if a.type.name == "TIMESTAMP_TZ":
+                r = _dtz._delocalize(r, a.type.tz or "UTC")
+                return ColVal(r.data, valid, a.type)
+            return r
         out_t = T.common_super_type(a.type, b.type)
         if out_t is not None and out_t.is_decimal:
             if name == "div":
@@ -1400,6 +1433,25 @@ def _render_varchar(x, frm: T.Type) -> str:
     if frm.name == "TIMESTAMP":  # int64 microseconds since epoch
         t = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(x))
         return t.strftime("%Y-%m-%d %H:%M:%S.%f")[:-3]
+    if frm.name == "TIMESTAMP_TZ":  # UTC micros; zone in the type
+        from presto_tpu import session_ctx
+        from presto_tpu.functions import tzdb
+
+        zone = frm.tz or session_ctx.current_zone()
+        local = tzdb.rules(zone).utc_to_local_scalar(int(x))
+        t = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=local)
+        return t.strftime("%Y-%m-%d %H:%M:%S.%f")[:-3] + " " + zone
+    if frm.name == "TIME":  # micros since midnight
+        us = int(x)
+        return (_dt.datetime(1970, 1, 1)
+                + _dt.timedelta(microseconds=us)).strftime("%H:%M:%S.%f")[:-3]
+    if frm.name == "TIME_TZ":
+        us = int(x)
+        off = int(frm.tz or 0)
+        body = (_dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=us)
+                ).strftime("%H:%M:%S.%f")[:-3]
+        sign = "-" if off < 0 else "+"
+        return f"{body}{sign}{abs(off) // 60:02d}:{abs(off) % 60:02d}"
     raise NotImplementedError(f"CAST {frm} -> VARCHAR")
 
 
@@ -1408,6 +1460,13 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False,
     frm = v.type
     if frm == to:
         return v
+    if frm.name in ("TIMESTAMP_TZ", "TIME", "TIME_TZ") \
+            or to.name in ("TIMESTAMP_TZ", "TIME", "TIME_TZ"):
+        from presto_tpu.functions import datetime_tz as _dtz
+
+        r = _dtz.emit_cast_tz(v, to, safe)
+        if r is not None:
+            return r  # None: fall through (e.g. ->VARCHAR render below)
     if frm.is_string and to.is_string:
         if to.name == "JSON" and frm.name != "JSON":
             # reference JsonType cast: the varchar becomes a JSON *string
@@ -1493,6 +1552,18 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False,
     if frm.is_string and not to.is_string:
         if to.name == "DATE":
             return _emit_date_from_str([v])
+        if to.name == "TIMESTAMP":
+            def _ts_parse(s):
+                t = str(s).strip()
+                if " " in t and "T" not in t:
+                    t = t.replace(" ", "T", 1)
+                return int((np.datetime64(t)
+                            - np.datetime64("1970-01-01T00:00:00"))
+                           / np.timedelta64(1, "us"))
+
+            from presto_tpu.functions.datetime_tz import _host_parse_lut
+
+            return _host_parse_lut(v, _ts_parse, T.TIMESTAMP, safe)
         # parse numerics via dictionary LUT; None == parse failure (kept
         # distinct from a genuine float('NaN') parse)
         def parse_dec128(x):
@@ -1592,6 +1663,14 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False,
                 jnp.clip(v.data, 0, len(v.dictionary) - 1)]
             valid = (~bad) if valid is None else (jnp.asarray(valid) & ~bad)
         return emit_cast(ColVal(data, valid, T.DOUBLE), to, safe)
+    if frm.name == "DATE" and to.name == "TIMESTAMP":
+        d = (jnp.asarray(v.data).astype(jnp.int64) if not v.is_scalar
+             or hasattr(v.data, "shape") else int(v.data))
+        return ColVal(d * 86_400_000_000, v.valid, T.TIMESTAMP)
+    if frm.name == "TIMESTAMP" and to.name == "DATE":
+        d = jnp.floor_divide(jnp.asarray(v.data).astype(jnp.int64),
+                             86_400_000_000)
+        return ColVal(d.astype(jnp.int32), v.valid, T.DATE)
     if to.is_decimal or frm.is_decimal:
         return _emit_cast_decimal(v, to, safe, guards=guards)
     if frm == T.UNKNOWN:
@@ -3037,5 +3116,6 @@ _register_sketch_fns()
 # round-4 breadth: the extended batches register on import (kept in
 # their own modules to keep this file navigable)
 from presto_tpu.functions import scalar_ext as _scalar_ext  # noqa: E402,F401
+from presto_tpu.functions import datetime_tz as _datetime_tz  # noqa: E402,F401
 from presto_tpu.functions import geospatial as _geospatial  # noqa: E402,F401
 from presto_tpu.functions import ml as _ml  # noqa: E402,F401

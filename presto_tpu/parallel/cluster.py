@@ -54,6 +54,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from presto_tpu import session_ctx as _sctx
 from presto_tpu.native import serde as pserde
 
 
@@ -856,6 +857,13 @@ class WorkerServer:
                 for k, v in spec.properties.items():
                     if k in task_session.properties:
                         task_session.properties[k] = v
+                from presto_tpu import session_ctx
+
+                # zone-dependent expressions and now() must agree with
+                # the coordinator's stamped context
+                session_ctx.activate_raw(
+                    str(task_session.properties.get("time_zone", "UTC")),
+                    spec.properties.get("query_start_us"))
                 _ClusterExecutor(task_session, spec, publish=publish,
                                  task_state=task).run()
                 if attempt_dir is not None:
@@ -1270,7 +1278,12 @@ class ClusterSession:
                     scalar_results=scalar_results,
                     properties={
                         "float32_compute": self.session.properties.get(
-                            "float32_compute", False)},
+                            "float32_compute", False),
+                        "time_zone": self.session.properties.get(
+                            "time_zone", "UTC"),
+                        # now()/current_date must be query-stable across
+                        # the mesh (session_ctx contract)
+                        "query_start_us": _sctx.query_start_us()},
                     durable_dir=ddir, durable_key=dkey,
                     attempt=attempt, replay=replay,
                 )

@@ -1012,8 +1012,14 @@ class Planner:
         if isinstance(e, ast.Literal):
             return _literal_to_ir(e)
         if isinstance(e, ast.IntervalLiteral):
-            if e.unit in ("DAY", "WEEK"):
-                return ir.Lit(e.value * (7 if e.unit == "WEEK" else 1), T.INTERVAL_DAY_TIME)
+            # INTERVAL DAY TO SECOND carries MICROSECONDS (reference
+            # stores millis, spi/type/IntervalDayTimeType; micros match
+            # the TIMESTAMP lane)
+            us = {"DAY": 86_400_000_000, "WEEK": 7 * 86_400_000_000,
+                  "HOUR": 3_600_000_000, "MINUTE": 60_000_000,
+                  "SECOND": 1_000_000}.get(e.unit)
+            if us is not None:
+                return ir.Lit(e.value * us, T.INTERVAL_DAY_TIME)
             if e.unit in ("MONTH", "YEAR"):
                 return ir.Lit(e.value * (12 if e.unit == "YEAR" else 1), T.INTERVAL_YEAR_MONTH)
             raise SemanticError(f"unsupported interval unit {e.unit}")
@@ -1268,6 +1274,24 @@ class Planner:
     def _coerce_pair(self, l: ir.RowExpr, r: ir.RowExpr):
         if l.type == r.type:
             return l, r
+        # TIMESTAMP_TZ vs plain temporal: lift the plain side onto the
+        # instant lane via the session zone (reference coerces
+        # TIMESTAMP -> TIMESTAMP WITH TIME ZONE the same way) — must
+        # run BEFORE the keep-native branch below or the UTC lane would
+        # compare raw against a wall-clock/days lane
+        if {l.type.name, r.type.name} <= {"TIMESTAMP_TZ", "TIMESTAMP",
+                                          "DATE"} \
+                and "TIMESTAMP_TZ" in (l.type.name, r.type.name) \
+                and l.type.name != r.type.name:
+            tz = T.timestamp_tz()
+            return (l if l.type.name == "TIMESTAMP_TZ"
+                    else self._coerce(l, tz),
+                    r if r.type.name == "TIMESTAMP_TZ"
+                    else self._coerce(r, tz))
+        if {l.type.name, r.type.name} == {"TIME", "TIME_TZ"}:
+            tz = T.time_tz()
+            return (l if l.type.name == "TIME_TZ" else self._coerce(l, tz),
+                    r if r.type.name == "TIME_TZ" else self._coerce(r, tz))
         # temporal/interval arithmetic keeps native types
         if l.type.name in ("DATE", "TIMESTAMP", "INTERVAL_DAY_TIME", "INTERVAL_YEAR_MONTH") or \
            r.type.name in ("DATE", "TIMESTAMP", "INTERVAL_DAY_TIME", "INTERVAL_YEAR_MONTH"):
@@ -1288,9 +1312,52 @@ def _literal_to_ir(e: ast.Literal) -> ir.Lit:
                    / np.timedelta64(1, "D"))
         return ir.Lit(days, T.DATE)
     if e.type_hint == "timestamp":
-        us = int((np.datetime64(e.value) - np.datetime64("1970-01-01T00:00:00"))
-                 / np.timedelta64(1, "us"))
-        return ir.Lit(us, T.TIMESTAMP)
+        text = str(e.value).strip()
+        import re as _re
+
+        m = _re.match(
+            r"^(\d{4}-\d{2}-\d{2})"
+            r"(?:[ T](\d{2}:\d{2}(?::\d{2}(?:\.\d{1,6})?)?))?"
+            r"(?:\s+(\S.*))?$", text)
+        if m is None:
+            raise SemanticError(f"invalid TIMESTAMP literal {text!r}")
+        civil = m.group(1) + ("T" + m.group(2) if m.group(2) else "")
+        local_us = int((np.datetime64(civil)
+                        - np.datetime64("1970-01-01T00:00:00"))
+                       / np.timedelta64(1, "us"))
+        zone = m.group(3)
+        if zone is None:
+            return ir.Lit(local_us, T.TIMESTAMP)
+        # `TIMESTAMP '2020-01-01 00:00:00 America/New_York'` -> WITH
+        # TIME ZONE, wall clock resolved via the zone's rules (DST
+        # ambiguity picks the earlier offset, like java.time)
+        from presto_tpu.functions import tzdb
+
+        try:
+            r = tzdb.rules(zone)
+        except ValueError:
+            raise SemanticError(
+                f"invalid TIMESTAMP literal {text!r}: unknown zone")
+        return ir.Lit(r.local_to_utc_scalar(local_us), T.timestamp_tz(zone))
+    if e.type_hint == "time":
+        text = str(e.value).strip()
+        import re as _re
+
+        m = _re.match(
+            r"^(\d{2}):(\d{2})(?::(\d{2})(?:\.(\d{1,6}))?)?"
+            r"(?:\s*([+-]\d{2}:?\d{2}))?$", text)
+        if m is None:
+            raise SemanticError(f"invalid TIME literal {text!r}")
+        frac = (m.group(4) or "").ljust(6, "0")
+        us = ((int(m.group(1)) * 3600 + int(m.group(2)) * 60
+               + int(m.group(3) or 0)) * 1_000_000 + int(frac or 0))
+        if m.group(5) is None:
+            return ir.Lit(us, T.TIME)
+        off = m.group(5).replace(":", "")
+        mins = int(off[1:3]) * 60 + int(off[3:5])
+        if off[0] == "-":
+            mins = -mins
+        return ir.Lit(us, T.time_tz(mins))
     if e.type_hint == "decimal":
         # DECIMAL 'x.y' typed literal: precision/scale from the text
         # (reference DecimalParseResult / Decimals.parse)

@@ -52,6 +52,9 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "memory_pool_bytes": 16 << 30,  # per-process pool (MemoryPool capacity)
     "spill_enabled": True,
     "spill_encryption": False,  # AES-256-CTR at rest (AesSpillCipher)
+    # session time zone for the WITH TIME ZONE surface (reference:
+    # Session.getTimeZoneKey / SystemSessionProperties)
+    "time_zone": "UTC",
     "iterative_optimizer_enabled": True,
     "reorder_joins": True,  # Selinger-DP ReorderJoins in the Memo
     "max_reorder_joins": 8,  # Memo/Rule fixpoint pass

@@ -685,6 +685,23 @@ class Parser:
             return ast.UnaryOp("NOT", self._not_expr())
         return self._predicate()
 
+    def _at_time_zone(self, left):
+        # postfix `AT TIME ZONE 'zone'` (AT/ZONE are unreserved idents,
+        # TIME lexes as a keyword) -> at_timezone(expr, zone)
+        while (self.peek().kind == "ident"
+               and str(self.peek().value).upper() == "AT"
+               and self.peek(1).kind == "kw" and self.peek(1).value == "TIME"
+               and self.peek(2).kind == "ident"
+               and str(self.peek(2).value).upper() == "ZONE"):
+            self.i += 3
+            # the zone operand is a primary (string literal / column),
+            # NOT an additive — `x AT TIME ZONE 'z' + INTERVAL ...`
+            # must apply + to the converted value (reference grammar:
+            # timeZoneSpecifier is a string or interval literal)
+            zone = self._unary()
+            left = ast.FunctionCall("at_timezone", [left, zone])
+        return left
+
     def _predicate(self):
         left = self._additive()
         while True:
@@ -753,10 +770,10 @@ class Parser:
                 return left
 
     def _multiplicative(self):
-        left = self._unary()
+        left = self._at_time_zone(self._unary())
         while self.at_op("*", "/", "%"):
             op = self.next().value
-            left = ast.BinaryOp(op, left, self._unary())
+            left = ast.BinaryOp(op, left, self._at_time_zone(self._unary()))
         return left
 
     def _unary(self):
@@ -805,6 +822,9 @@ class Parser:
         if self.at_kw("TIMESTAMP") and self.peek(1).kind == "string":
             self.next()
             return ast.Literal(self.next().value, type_hint="timestamp")
+        if self.at_kw("TIME") and self.peek(1).kind == "string":
+            self.next()
+            return ast.Literal(self.next().value, type_hint="time")
         if (self.peek().kind == "ident"
                 and str(self.peek().value).upper() == "DECIMAL"
                 and self.peek(1).kind == "string"):
@@ -890,6 +910,11 @@ class Parser:
             name = self.ident()
             if self.at_op("("):
                 return self._function_call(name)
+            if name in ("current_date", "current_timestamp", "current_time",
+                        "localtime", "localtimestamp", "current_user") \
+                    and not self.at_op("."):
+                # SQL-spec niladic functions take no parentheses
+                return ast.FunctionCall(name, [])
             parts = [name]
             while self.at_op(".") and self.peek(1).kind in ("ident", "kw"):
                 self.next()
@@ -928,6 +953,16 @@ class Parser:
         tn = str(name.value)
         if tn.upper() == "DOUBLE" and self.peek().kind == "ident" and self.peek().value == "precision":
             self.next()
+        if tn.upper() in ("TIMESTAMP", "TIME") and self.at_kw("WITH"):
+            # TIMESTAMP/TIME WITH TIME ZONE (TIME is a kw, ZONE an ident)
+            save = self.i
+            self.next()
+            if self.accept_kw("TIME") and self.peek().kind == "ident" \
+                    and str(self.peek().value).upper() == "ZONE":
+                self.next()
+                tn += " WITH TIME ZONE"
+            else:
+                self.i = save
         if self.accept_op("("):
             # capture the balanced-paren argument list verbatim so nested
             # types (MAP(VARCHAR, ARRAY(BIGINT)), ROW(x BIGINT, ...)) pass

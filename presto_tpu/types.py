@@ -37,6 +37,10 @@ class Type:
     params: tuple = ()
 
     def __str__(self) -> str:
+        if self.name == "TIMESTAMP_TZ":
+            return "TIMESTAMP WITH TIME ZONE"
+        if self.name == "TIME_TZ":
+            return "TIME WITH TIME ZONE"
         if self.params:
             return f"{self.name}({','.join(str(p) for p in self.params)})"
         return self.name
@@ -68,7 +72,20 @@ class Type:
 
     @property
     def is_temporal(self) -> bool:
-        return self.name in ("DATE", "TIMESTAMP")
+        return self.name in ("DATE", "TIMESTAMP", "TIMESTAMP_TZ")
+
+    @property
+    def tz(self) -> Optional[str]:
+        """Zone name for TIMESTAMP_TZ / offset-minutes for TIME_TZ.
+
+        TPU-native departure from the reference: the reference packs a
+        12-bit zone key into every VALUE (spi/type/
+        TimestampWithTimeZoneType + DateTimeEncoding.packDateTimeWithZone);
+        here the zone rides the column TYPE and the device lane stays
+        pure UTC int64 micros, so compare/join/sort/group need no unpack."""
+        if self.name in ("TIMESTAMP_TZ", "TIME_TZ") and self.params:
+            return self.params[0]
+        return None
 
     @property
     def is_orderable(self) -> bool:
@@ -119,6 +136,27 @@ INTERVAL_YEAR_MONTH = Type("INTERVAL_YEAR_MONTH")
 JSON = Type("JSON")
 VARBINARY = Type("VARBINARY")
 UNKNOWN = Type("UNKNOWN")  # the NULL literal's type
+TIME = Type("TIME")  # int64 microseconds since midnight (zone-less)
+
+
+def timestamp_tz(zone: Optional[str] = None) -> Type:
+    """TIMESTAMP WITH TIME ZONE in `zone` (reference:
+    spi/type/TimestampWithTimeZoneType).  Lane: UTC int64 micros; the
+    zone is column metadata — see Type.tz.  zone=None (e.g. a CAST
+    target written without a zone) means "the session zone", resolved
+    when the cast/function emits."""
+    return Type("TIMESTAMP_TZ", () if zone is None else (zone,))
+
+
+def time_tz(offset_minutes: Optional[int] = None) -> Type:
+    """TIME WITH TIME ZONE at a fixed UTC offset (reference:
+    spi/type/TimeWithTimeZoneType; named zones degenerate to their
+    offset for TIME, as in the reference's packed offset encoding).
+    Lane: int64 micros since midnight LOCAL to the offset.
+    offset_minutes=None (a zone-less CAST target) means "the session
+    zone's offset", resolved when the cast emits."""
+    return Type("TIME_TZ", () if offset_minutes is None
+                else (int(offset_minutes),))
 
 
 def decimal(precision: int, scale: int) -> Type:
@@ -212,6 +250,9 @@ _PHYSICAL = {
     "VARBINARY": np.int32,  # dictionary code over bytes values
     "DATE": np.int32,
     "TIMESTAMP": np.int64,
+    "TIMESTAMP_TZ": np.int64,  # UTC micros; zone in the type (Type.tz)
+    "TIME": np.int64,  # micros since midnight
+    "TIME_TZ": np.int64,  # micros since midnight at the type's offset
     "INTERVAL_DAY_TIME": np.int64,
     "INTERVAL_YEAR_MONTH": np.int64,
     "UNKNOWN": np.bool_,
@@ -286,6 +327,9 @@ def parse_type(text: str) -> Type:
         "STRING": VARCHAR,
         "DATE": DATE,
         "TIMESTAMP": TIMESTAMP,
+        "TIMESTAMP WITH TIME ZONE": timestamp_tz(),
+        "TIME": TIME,
+        "TIME WITH TIME ZONE": time_tz(),
         "DECIMAL": decimal(18, 0),
         "JSON": JSON,
         "VARBINARY": VARBINARY,
@@ -372,6 +416,18 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         return VARCHAR
     if {a.name, b.name} == {"DATE", "TIMESTAMP"}:
         return TIMESTAMP
+    if a.name == "TIMESTAMP_TZ" and b.name == "TIMESTAMP_TZ":
+        # same instant lane; zones differ only as display metadata —
+        # keep the left zone (the reference keeps per-value zones; a
+        # documented single-zone-per-column simplification)
+        return a
+    if "TIMESTAMP_TZ" in (a.name, b.name) \
+            and {a.name, b.name} <= {"TIMESTAMP_TZ", "TIMESTAMP", "DATE"}:
+        return a if a.name == "TIMESTAMP_TZ" else b
+    if a.name == "TIME_TZ" and b.name == "TIME_TZ":
+        return a
+    if {a.name, b.name} == {"TIME", "TIME_TZ"}:
+        return a if a.name == "TIME_TZ" else b
     if a.name == "DATE" and b.name == "INTERVAL_DAY_TIME":
         return DATE
     return None

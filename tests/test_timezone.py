@@ -1,0 +1,254 @@
+"""TIME / TIMESTAMP WITH TIME ZONE semantics, differentially checked
+against Python's zoneinfo (independent IANA-rules oracle) across zones
+with DST transitions, half-hour offsets, and a date-line jump.
+
+Reference: presto-spi/.../spi/type/TimestampWithTimeZoneType.java,
+presto-main/.../operator/scalar/DateTimeFunctions.java (at_timezone,
+with_timezone, zone-aware extract/date_trunc), TestDateTimeFunctions.
+"""
+
+import datetime as dt
+from zoneinfo import ZoneInfo
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu.catalog import Catalog
+
+ZONES = ["America/New_York", "Europe/Berlin", "Asia/Kolkata",
+         "Australia/Lord_Howe"]
+
+# wall-clock probe instants: plain, just-before/after the 2024 US + EU
+# DST transitions, and a leap-day
+PROBES = ["2024-01-15 12:00:00", "2024-03-10 01:59:59",
+          "2024-03-10 03:00:00", "2024-03-31 03:00:00",
+          "2024-11-03 00:30:00", "2024-10-27 03:00:00",
+          "2024-02-29 23:59:59", "2024-07-04 00:00:00"]
+
+
+def _s(tz="UTC"):
+    s = presto_tpu.connect(Catalog())
+    s.set("time_zone", tz)
+    return s
+
+
+def _epoch_us(d: dt.datetime) -> int:
+    return int(d.timestamp() * 1_000_000)
+
+
+@pytest.mark.parametrize("zone", ZONES)
+def test_tstz_literal_matches_zoneinfo(zone):
+    s = _s()
+    zi = ZoneInfo(zone)
+    for probe in PROBES:
+        naive = dt.datetime.strptime(probe, "%Y-%m-%d %H:%M:%S")
+        expect = _epoch_us(naive.replace(tzinfo=zi))
+        got = s.sql(f"SELECT TIMESTAMP '{probe} {zone}'").rows[0][0]
+        assert got == expect, (zone, probe)
+
+
+@pytest.mark.parametrize("zone", ZONES)
+def test_extract_fields_match_zoneinfo(zone):
+    s = _s()
+    zi = ZoneInfo(zone)
+    for probe in ["2024-03-10 06:59:59", "2024-03-10 07:00:01",
+                  "2024-12-31 23:30:00", "2024-06-15 04:15:30"]:
+        utc = dt.datetime.strptime(probe, "%Y-%m-%d %H:%M:%S").replace(
+            tzinfo=dt.timezone.utc)
+        local = utc.astimezone(zi)
+        lit = f"TIMESTAMP '{probe} UTC' AT TIME ZONE '{zone}'"
+        row = s.sql(
+            f"SELECT year({lit}), month({lit}), day({lit}), hour({lit}), "
+            f"minute({lit}), second({lit})").rows[0]
+        assert row == (local.year, local.month, local.day, local.hour,
+                       local.minute, local.second), (zone, probe)
+
+
+@pytest.mark.parametrize("zone", ZONES)
+def test_date_trunc_day_matches_zoneinfo(zone):
+    s = _s()
+    zi = ZoneInfo(zone)
+    for probe in ["2024-03-10 06:59:59", "2024-11-03 05:30:00",
+                  "2024-06-15 23:15:30"]:
+        utc = dt.datetime.strptime(probe, "%Y-%m-%d %H:%M:%S").replace(
+            tzinfo=dt.timezone.utc)
+        local = utc.astimezone(zi)
+        midnight = local.replace(hour=0, minute=0, second=0, microsecond=0)
+        got = s.sql(
+            f"SELECT date_trunc('day', TIMESTAMP '{probe} UTC'"
+            f" AT TIME ZONE '{zone}')").rows[0][0]
+        assert got == _epoch_us(midnight), (zone, probe)
+
+
+def test_at_timezone_preserves_instant():
+    s = _s()
+    r = s.sql(
+        "SELECT to_unixtime(TIMESTAMP '2024-06-01 12:00:00 UTC'), "
+        "to_unixtime(TIMESTAMP '2024-06-01 12:00:00 UTC'"
+        " AT TIME ZONE 'Asia/Kolkata')").rows[0]
+    assert r[0] == r[1]
+
+
+def test_with_timezone_dst_gap_and_overlap():
+    s = _s()
+    # 2024-03-10 02:30 does not exist in New York (gap) -> the offset
+    # AFTER the gap (EDT), matching joda convertLocalToUTC non-strict
+    # (the reference's path) and java.time's gap rule
+    got = s.sql("SELECT to_unixtime(with_timezone("
+                "TIMESTAMP '2024-03-10 02:30:00', 'America/New_York'))"
+                ).rows[0][0]
+    edt_gap = dt.datetime(2024, 3, 10, 2, 30,
+                          tzinfo=dt.timezone(dt.timedelta(hours=-4)))
+    assert got == edt_gap.timestamp()
+    # 2024-11-03 01:30 happens twice -> earlier offset (EDT)
+    got = s.sql("SELECT to_unixtime(with_timezone("
+                "TIMESTAMP '2024-11-03 01:30:00', 'America/New_York'))"
+                ).rows[0][0]
+    edt = dt.datetime(2024, 11, 3, 1, 30,
+                      tzinfo=dt.timezone(dt.timedelta(hours=-4)))
+    assert got == edt.timestamp()
+
+
+def test_session_zone_drives_casts_and_current_timezone():
+    s = _s("America/New_York")
+    assert s.sql("SELECT current_timezone()").rows == [("America/New_York",)]
+    # TIMESTAMP -> TSTZ interprets the wall clock in the session zone
+    got = s.sql("SELECT to_unixtime(CAST(TIMESTAMP '2024-06-01 12:00:00'"
+                " AS TIMESTAMP WITH TIME ZONE))").rows[0][0]
+    expect = dt.datetime(2024, 6, 1, 12, 0,
+                         tzinfo=ZoneInfo("America/New_York")).timestamp()
+    assert got == expect
+    # SET SESSION switches the zone
+    s.sql("SET SESSION time_zone = 'Asia/Kolkata'")
+    assert s.sql("SELECT current_timezone()").rows == [("Asia/Kolkata",)]
+
+
+def test_timezone_hour_minute():
+    s = _s()
+    r = s.sql("SELECT timezone_hour(TIMESTAMP '2024-06-01 12:00:00 "
+              "Australia/Lord_Howe'), timezone_minute(TIMESTAMP "
+              "'2024-06-01 12:00:00 Australia/Lord_Howe')").rows[0]
+    assert r == (10, 30)  # LHST = +10:30 (winter)
+    r = s.sql("SELECT timezone_hour(TIMESTAMP '2024-01-01 12:00:00 "
+              "Australia/Lord_Howe')").rows[0]
+    assert r == (11,)  # LHDT = +11 (half-hour DST)
+
+
+def test_tstz_render_and_parse_roundtrip():
+    s = _s()
+    txt = s.sql("SELECT CAST(TIMESTAMP '2024-06-01 10:30:00.250 "
+                "Europe/Berlin' AS VARCHAR)").rows[0][0]
+    assert txt == "2024-06-01 10:30:00.250 Europe/Berlin"
+    back = s.sql(f"SELECT to_unixtime(CAST('{txt}' AS "
+                 "TIMESTAMP WITH TIME ZONE))").rows[0][0]
+    expect = dt.datetime(2024, 6, 1, 10, 30, 0, 250000,
+                         tzinfo=ZoneInfo("Europe/Berlin")).timestamp()
+    assert back == expect
+
+
+def test_time_type_fields_and_render():
+    s = _s()
+    assert s.sql("SELECT CAST(TIME '09:05:07.123' AS VARCHAR)").rows \
+        == [("09:05:07.123",)]
+    assert s.sql("SELECT hour(TIME '09:05:07'), minute(TIME '09:05:07'), "
+                 "second(TIME '09:05:07')").rows == [(9, 5, 7)]
+    assert s.sql("SELECT CAST('23:59:59' AS TIME)").rows \
+        == [((23 * 3600 + 59 * 60 + 59) * 1_000_000,)]
+    # TIME WITH TIME ZONE literal with explicit offset
+    assert s.sql("SELECT CAST(TIME '10:00:00 +05:30' AS VARCHAR)").rows \
+        == [("10:00:00.000+05:30",)]
+
+
+def test_tstz_column_group_order_join():
+    """Column-path (not scalar-folded) semantics: grouping and ordering
+    run on the UTC instant lane."""
+    s = _s()
+    r = s.sql(
+        "SELECT t.z, count(*) FROM (VALUES "
+        "(TIMESTAMP '2024-06-01 12:00:00 UTC'), "
+        "(TIMESTAMP '2024-06-01 08:00:00 America/New_York'), "  # same instant
+        "(TIMESTAMP '2024-06-01 13:00:00 UTC')) t(z) "
+        "GROUP BY t.z ORDER BY t.z")
+    assert [row[1] for row in r.rows] == [2, 1]
+
+
+def test_interval_arithmetic_micros():
+    s = _s()
+    assert s.sql("SELECT CAST(TIMESTAMP '2020-01-01 10:00:00' + "
+                 "INTERVAL '3' HOUR AS VARCHAR)").rows \
+        == [("2020-01-01 13:00:00.000",)]
+    assert s.sql("SELECT DATE '1998-12-01' - INTERVAL '90' DAY").rows \
+        == [(10471,)]
+    # instant arithmetic across spring-forward (reference
+    # DateTimeOperators adds fixed millis)
+    assert s.sql("SELECT CAST(TIMESTAMP '2020-03-08 01:30:00 "
+                 "America/New_York' + INTERVAL '1' HOUR AS VARCHAR)").rows \
+        == [("2020-03-08 03:30:00.000 America/New_York",)]
+
+
+def test_now_family_consistency():
+    s = _s("Asia/Kolkata")
+    r = s.sql("SELECT to_unixtime(now()), "
+              "CAST(CAST(localtimestamp AS VARCHAR) AS TIMESTAMP), "
+              "current_date").rows[0]
+    now_utc = dt.datetime.now(dt.timezone.utc)
+    assert abs(r[0] - now_utc.timestamp()) < 120
+    local = now_utc.astimezone(ZoneInfo("Asia/Kolkata"))
+    wall_us = r[1]
+    assert abs(wall_us / 1e6
+               - local.replace(tzinfo=dt.timezone.utc).timestamp()) < 120
+    assert r[2] == (local.date() - dt.date(1970, 1, 1)).days
+
+
+def test_cast_date_timestamp_scaling():
+    # CAST(DATE AS TIMESTAMP) must scale days->micros (was a silent
+    # dtype retag before round 5)
+    s = _s()
+    assert s.sql("SELECT CAST(CAST(DATE '2020-02-29' AS TIMESTAMP)"
+                 " AS VARCHAR)").rows == [("2020-02-29 00:00:00.000",)]
+    assert s.sql("SELECT CAST(CAST(TIMESTAMP '2020-02-29 13:00:00'"
+                 " AS DATE) AS VARCHAR)").rows == [("2020-02-29",)]
+
+
+def test_mixed_tstz_plain_comparison_coerces_via_session_zone():
+    s = _s("America/New_York")
+    assert s.sql("SELECT TIMESTAMP '2020-06-01 12:00:00 America/New_York'"
+                 " = TIMESTAMP '2020-06-01 12:00:00'").rows == [(True,)]
+    assert s.sql("SELECT DATE '2020-06-02' > "
+                 "TIMESTAMP '2020-06-01 22:00:00 America/New_York'").rows \
+        == [(True,)]
+
+
+def test_time_to_time_tz_cast_uses_session_offset():
+    s = _s("Asia/Tokyo")
+    assert s.sql("SELECT CAST(CAST(TIME '12:00:00' AS TIME WITH TIME "
+                 "ZONE) AS VARCHAR)").rows == [("12:00:00.000+09:00",)]
+
+
+def test_bare_tstz_cast_is_identity():
+    s = _s()
+    assert s.sql("SELECT hour(CAST(TIMESTAMP '2020-06-01 12:00:00 "
+                 "America/New_York' AS TIMESTAMP WITH TIME ZONE))").rows \
+        == [(12,)]
+
+
+def test_at_time_zone_precedence_binds_before_additive():
+    s = _s()
+    assert s.sql("SELECT CAST(TIMESTAMP '2020-06-01 12:00:00 UTC' AT "
+                 "TIME ZONE 'America/New_York' + INTERVAL '1' HOUR "
+                 "AS VARCHAR)").rows \
+        == [("2020-06-01 09:00:00.000 America/New_York",)]
+
+
+def test_from_unixtime_mixed_sign_offset():
+    # total minutes = hours*60 + minutes (reference
+    # DateTimeFunctions.fromUnixTime(double, long, long))
+    s = _s()
+    assert s.sql("SELECT CAST(from_unixtime(0, -5, 30) AS VARCHAR)").rows \
+        == [("1969-12-31 19:30:00.000 -04:30",)]
+
+
+def test_current_user_niladic():
+    s = _s()
+    assert s.sql("SELECT current_user").rows == [("user",)]
