@@ -23,6 +23,10 @@ from presto_tpu.storage.shard import Domain, ShardReader, write_shard
 class LocalFileTable(ConnectorTable):
     """A directory of shard files + a schema.json sidecar."""
 
+    # zone maps in the PTSH stripes serve the engine's TupleDomain
+    # pushdown (plan/domains.py -> read(domains=...))
+    supports_domain_pushdown = True
+
     def __init__(self, name: str, directory: str,
                  schema: Optional[Dict[str, T.Type]] = None):
         self.dir = directory

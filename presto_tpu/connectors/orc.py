@@ -70,7 +70,8 @@ class OrcTable(ConnectorTable):
         os.makedirs(self.path, exist_ok=True)
         idx = len(self._files())
         write_orc(os.path.join(self.path, f"part_{idx:06d}.orc"),
-                  {c: arrays[c] for c in self.schema}, self.schema)
+                  {c: arrays[c] for c in self.schema}, self.schema,
+                  stripe_rows=getattr(self, "stripe_rows", 0))
         self._orc_cache = None
         self._invalidate()
         return n
@@ -101,17 +102,33 @@ class OrcTable(ConnectorTable):
             snapped.append(edges[-1])
         return [(a, b) for a, b in zip(snapped[:-1], snapped[1:]) if a < b]
 
-    def read(self, columns=None, split=None) -> Dict[str, np.ndarray]:
+    supports_domain_pushdown = True
+
+    def read(self, columns=None, split=None,
+             domains=None) -> Dict[str, np.ndarray]:
+        """`domains` prunes whole stripes via the Metadata-section
+        ColumnStatistics before any stream decodes (reference:
+        OrcSelectiveRecordReader / OrcPredicate stripe pruning)."""
         cols = columns if columns is not None else list(self.schema)
         a, b = split if split is not None else (0, self.row_count())
         parts: Dict[str, list] = {c: [] for c in cols}
+        counters = {"groups_total": 0, "groups_read": 0,
+                    "bytes_total": 0, "bytes_read": 0}
         base = 0
         for f in self._readers():
             bycol = {c.name: c for c in f.columns}
             for si, st in enumerate(f.stripes):
                 n = st[_STR_NROWS][0]
+                nbytes = st.get(3, [0])[0]  # dataLength
                 lo, hi = max(base, a), min(base + n, b)
                 if lo < hi:
+                    counters["groups_total"] += 1
+                    counters["bytes_total"] += nbytes
+                    if not self._stripe_matches(f, si, bycol, domains):
+                        base += n
+                        continue
+                    counters["groups_read"] += 1
+                    counters["bytes_read"] += nbytes
                     s0, s1 = lo - base, hi - base
                     for c in cols:
                         vals, valid, _t = f.read_column(si, bycol[c])
@@ -121,6 +138,7 @@ class OrcTable(ConnectorTable):
                                 seg, mask=~valid[s0:s1])
                         parts[c].append(seg)
                 base += n
+        self.last_scan_counters = counters
         out = {}
         for c in cols:
             ps = parts[c]
@@ -133,3 +151,18 @@ class OrcTable(ConnectorTable):
             else:
                 out[c] = np.concatenate(ps)
         return out
+
+    @staticmethod
+    def _stripe_matches(f: OrcFile, si: int, bycol, domains) -> bool:
+        if not domains:
+            return True
+        for col, dom in domains.items():
+            oc = bycol.get(col)
+            if oc is None:
+                continue
+            st = f.stripe_col_stats(si, oc)
+            if st is None:
+                continue  # no stats -> cannot prune
+            if not dom.overlaps(st[0], st[1]):
+                return False
+        return True

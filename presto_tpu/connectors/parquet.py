@@ -88,11 +88,21 @@ class ParquetTable(ConnectorTable):
             snapped.append(edges[-1])
         return [(a, b) for a, b in zip(snapped[:-1], snapped[1:]) if a < b]
 
+    supports_domain_pushdown = True
+
     # -- read path -----------------------------------------------------
-    def read(self, columns=None, split=None) -> Dict[str, np.ndarray]:
+    def read(self, columns=None, split=None,
+             domains=None) -> Dict[str, np.ndarray]:
+        """`domains` ({column: storage.shard.Domain}) prunes whole row
+        groups via footer statistics before any page decodes — the
+        selective-read path (reference: OrcSelectiveRecordReader /
+        TupleDomainParquetPredicate).  Pruning is advisory: surviving
+        groups still carry non-matching rows for the Filter above."""
         cols = columns if columns is not None else list(self.schema)
         a, b = split if split is not None else (0, self.row_count())
         parts: Dict[str, list] = {c: [] for c in cols}
+        counters = {"groups_total": 0, "groups_read": 0,
+                    "bytes_total": 0, "bytes_read": 0}
         base = 0
         for f in self._readers():
             bycol = {c.name: c for c in f.columns}
@@ -100,6 +110,13 @@ class ParquetTable(ConnectorTable):
                 n = rg[3]
                 lo, hi = max(base, a), min(base + n, b)
                 if lo < hi:
+                    counters["groups_total"] += 1
+                    counters["bytes_total"] += f.rg_byte_size(gi)
+                    if not self._rg_matches(f, gi, bycol, domains):
+                        base += n
+                        continue
+                    counters["groups_read"] += 1
+                    counters["bytes_read"] += f.rg_byte_size(gi)
                     s0, s1 = lo - base, hi - base
                     for c in cols:
                         vals, valid, _t = f.read_column(gi, bycol[c])
@@ -109,6 +126,7 @@ class ParquetTable(ConnectorTable):
                                 seg, mask=~valid[s0:s1])
                         parts[c].append(seg)
                 base += n
+        self.last_scan_counters = counters
         out = {}
         for c in cols:
             ps = parts[c]
@@ -121,6 +139,21 @@ class ParquetTable(ConnectorTable):
             else:
                 out[c] = np.concatenate(ps)
         return out
+
+    @staticmethod
+    def _rg_matches(f: ParquetFile, gi: int, bycol, domains) -> bool:
+        if not domains:
+            return True
+        for col, dom in domains.items():
+            pc = bycol.get(col)
+            if pc is None:
+                continue
+            st = f.rg_stats(gi, pc)
+            if st is None:
+                continue  # no stats -> cannot prune
+            if not dom.overlaps(st[0], st[1]):
+                return False
+        return True
 
     # -- write path (reference: the hive connector's parquet sink) ----
     def append(self, arrays: Dict[str, np.ndarray]) -> int:
@@ -135,7 +168,8 @@ class ParquetTable(ConnectorTable):
         idx = len(self._files())
         out = os.path.join(self.path, f"part_{idx:06d}.parquet")
         write_parquet(out, {c: arrays[c] for c in self.schema},
-                      self.schema)
+                      self.schema,
+                      row_group_rows=getattr(self, "row_group_rows", 0))
         self._invalidate()
         return n
 

@@ -1356,7 +1356,7 @@ class Executor:
             tuples = np.empty(n_groups, dtype=object)
             tuples[:] = [tuple(g) for g in groups]
             return _tuples_to_dict_column(tuples, nonempty, a.type)
-        if a.fn in ("approx_set", "merge", "qdigest_agg"):
+        if a.fn in ("approx_set", "merge", "qdigest_agg", "tdigest_agg"):
             # serializable sketch build/merge: host-side per group like
             # array_agg (reference: ApproximateSetAggregation /
             # MergeHyperLogLogAggregation / QuantileDigestAggregation);
@@ -1374,20 +1374,48 @@ class Executor:
                     np.clip(data, 0, len(col.dictionary) - 1)]
             elif col.type.is_decimal:
                 data = data.astype(np.float64) / (10 ** col.type.decimal_scale)
+            wdata = None
+            if a.fn == "tdigest_agg" and len(a.args) >= 2:
+                wcol = to_column(eval_expr(a.args[1], b, self.ctx),
+                                 b.capacity)
+                wdata = np.asarray(wcol.data, np.float64)
+                if wdata.ndim == 0:
+                    wdata = np.full(b.capacity, float(wdata))
             groups: list = [[] for _ in range(n_groups)]
+            wgroups: list = [[] for _ in range(n_groups)]
             for row in np.flatnonzero(vh):
                 g = int(gidh[row])
                 if 0 <= g < n_groups:
                     v = data[row]
                     groups[g].append(v.item() if hasattr(v, "item") else v)
+                    if wdata is not None:
+                        wgroups[g].append(float(wdata[row]))
             blobs = np.empty(n_groups, dtype=object)
             if a.fn == "approx_set":
                 blobs[:] = [SK.hll_from_values(g) for g in groups]
             elif a.fn == "qdigest_agg":
                 blobs[:] = [SK.qdigest_from_values(g) for g in groups]
+            elif a.fn == "tdigest_agg":
+                from presto_tpu.functions import tdigest as TD
+
+                compression = TD.DEFAULT_COMPRESSION
+                if len(a.args) >= 3:  # constant compression argument
+                    cv = np.asarray(eval_expr(a.args[2], b, self.ctx).data)
+                    if cv.ndim > 0:
+                        raise NotImplementedError(
+                            "tdigest_agg compression must be a constant")
+                    compression = float(cv)
+                blobs[:] = [TD.tdigest_from_values(
+                    g, weights=wg if wdata is not None else None,
+                    compression=compression)
+                    for g, wg in zip(groups, wgroups)]
             else:  # merge over serialized sketches
-                if a.type.name == "HLL":
+                if a.type.name in ("HLL", "P4HLL"):
                     blobs[:] = [SK.hll_merge(g) for g in groups]
+                elif a.type.name == "TDIGEST":
+                    from presto_tpu.functions import tdigest as TD
+
+                    blobs[:] = [TD.tdigest_merge(g) for g in groups]
                 else:
                     blobs[:] = [SK.qdigest_merge(g) for g in groups]
             return _tuples_to_dict_column(blobs, nonempty, a.type)
@@ -2323,6 +2351,26 @@ def scan_batch(table, node: P.TableScan, f32: bool = False) -> Batch:
         return base
 
     needed = list(dict.fromkeys(node.assignments.values()))
+    domains = getattr(node, "scan_domains", None)
+    if domains and getattr(table, "supports_domain_pushdown", False):
+        # selective scan: the reader prunes stripes/row groups on the
+        # pushed-down domains, so the result is QUERY-specific — it
+        # bypasses the per-table device cache entirely (all needed
+        # columns in ONE read call keeps row alignment)
+        from presto_tpu.batch import column_from_numpy
+
+        data = table.read(needed, domains=domains)
+        cols = {}
+        n = 0
+        for sym, src in node.assignments.items():
+            t = node.types[sym]
+            col = column_from_numpy(data[src], t)
+            if f32 and t.name == "DOUBLE":
+                col = Column(col.data.astype(jnp.float32), col.valid,
+                             col.type, col.dictionary)
+            cols[sym] = Column(col.data, col.valid, t, col.dictionary)
+            n = col.data.shape[0]
+        return Batch(cols, jnp.ones((n,), bool))
     missing = [c for c in needed if c not in cache_for(c)]
     if missing:
         dev = None

@@ -119,13 +119,24 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
     if name == "approx_set":
         return T.HLL
     if name == "merge":
-        if arg_types[0].name not in ("HLL", "QDIGEST"):
-            raise TypeError("merge() takes an HLL or QDIGEST argument")
+        if arg_types[0].name not in ("HLL", "P4HLL", "QDIGEST", "TDIGEST"):
+            raise TypeError(
+                "merge() takes an HLL, P4HLL, QDIGEST or TDIGEST argument")
         return arg_types[0]
     if name == "qdigest_agg":
         if not arg_types[0].is_numeric:
             raise TypeError(f"qdigest_agg over {arg_types[0]}")
         return T.qdigest_of(arg_types[0])
+    if name == "tdigest_agg":
+        # (value[, weight[, compression]]) — reference:
+        # TDigestAggregationFunction
+        if not arg_types or not arg_types[0].is_numeric:
+            raise TypeError(f"tdigest_agg over {arg_types or 'no args'}")
+        if len(arg_types) > 3 or any(not t.is_numeric
+                                     for t in arg_types[1:]):
+            raise TypeError("tdigest_agg takes (value[, weight"
+                            "[, compression]])")
+        return T.tdigest_of(T.DOUBLE)
     if name == "map_agg":
         if len(arg_types) != 2:
             raise TypeError("map_agg takes (key, value)")
@@ -143,7 +154,7 @@ AGG_NAMES = {
     "bool_and", "bool_or", "every", "approx_distinct", "corr", "covar_samp",
     "covar_pop", "approx_percentile", "checksum", "min_by", "max_by",
     "geometric_mean", "array_agg", "map_agg", "multimap_agg",
-    "approx_set", "merge", "qdigest_agg",
+    "approx_set", "merge", "qdigest_agg", "tdigest_agg",
     "regr_slope", "regr_intercept", "skewness", "kurtosis", "entropy",
     "bitwise_and_agg", "bitwise_or_agg", "histogram", "numeric_histogram",
     "map_union", "learn_classifier", "learn_regressor",

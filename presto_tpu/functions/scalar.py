@@ -1489,9 +1489,19 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False,
             return _host_string_transform(src, unwrap, T.VARCHAR)
         # VARCHAR <-> CHAR: same physical form, re-tag only
         return ColVal(v.data, v.valid, to, v.dictionary)
-    if frm.name in ("HLL", "QDIGEST") and to.is_string:
-        # export: serialized sketch -> base64 text (the role of casting
-        # HyperLogLog to varbinary in the reference)
+    _SKETCHES = ("HLL", "P4HLL", "QDIGEST", "TDIGEST")
+    if frm.name in ("HLL", "P4HLL") and to.name in ("HLL", "P4HLL"):
+        # dense-format re-tag (reference: HyperLogLog <-> P4HyperLogLog
+        # casts; this engine's HLL blobs are always dense)
+        return ColVal(v.data, v.valid, to, v.dictionary)
+    if frm.name in _SKETCHES and to.name == "VARBINARY":
+        # RAW serialized sketch bytes (reference: CAST(hll AS varbinary)
+        # returns the airlift-serialized form verbatim)
+        return ColVal(v.data, v.valid, T.VARBINARY, v.dictionary)
+    if frm.name == "VARBINARY" and to.name in _SKETCHES:
+        return ColVal(v.data, v.valid, to, v.dictionary)
+    if frm.name in _SKETCHES and to.is_string:
+        # export: serialized sketch -> base64 text
         import base64 as _b64
 
         vals = v.dictionary.values if v.dictionary is not None \
@@ -1500,7 +1510,7 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False,
                          or [""], dtype=object)
         codes = jnp.clip(v.data, 0, max(len(obj) - 1, 0))
         return normalize_dictionary(obj, ColVal(codes, v.valid, T.VARCHAR))
-    if frm.is_string and to.name in ("HLL", "QDIGEST"):
+    if frm.is_string and to.name in _SKETCHES:
         import base64 as _b64
         import binascii
 
@@ -2521,6 +2531,12 @@ def _dict_lut_result(vals: list, col: ColVal, rt: T.Type) -> ColVal:
         for i, v in enumerate(vals):
             obj[i] = tuple(v) if v is not None else ()
         return _tuple_dict_normalize(obj, ColVal(codes, valid, rt), rt)
+    if rt.name in ("HLL", "P4HLL", "QDIGEST", "TDIGEST"):
+        # serialized-sketch results: dictionary over the byte blobs
+        obj = np.empty(ne, dtype=object)
+        for i, v in enumerate(vals):
+            obj[i] = v if v is not None else b""
+        return ColVal(codes, valid, rt, Dictionary(obj))
     if rt.is_string:
         obj = np.asarray(["" if v is None else str(v) for v in vals],
                          dtype=object)
@@ -3037,6 +3053,15 @@ def _sketch_dict_fn(name, fn, rt_fn, type_names):
 
     def emit(args):
         col = args[0]
+        if col.dictionary is None and isinstance(col.data,
+                                                 (bytes, bytearray)):
+            # scalar blob (e.g. from_base64 result cast to a sketch):
+            # lift into the 1-entry dictionary form the LUT path expects
+            d = np.empty(1, dtype=object)
+            d[0] = bytes(col.data)
+            col = ColVal(jnp.asarray(0, jnp.int32), col.valid, col.type,
+                         Dictionary(d))
+            args = [col] + list(args[1:])
         extra = []
         for a in args[1:]:
             if hasattr(a.data, "shape") and getattr(a.data, "ndim", 0) > 0:
@@ -3067,19 +3092,19 @@ def _register_sketch_fns():
     prev_card = REGISTRY["cardinality"]
 
     def card_resolve(args):
-        if args and args[0].name in ("HLL", "QDIGEST"):
+        if args and args[0].name in ("HLL", "P4HLL", "QDIGEST"):
             return T.BIGINT
         return prev_card.resolve(args)
 
     def card_emit(args):
-        if args[0].type.name in ("HLL", "QDIGEST"):
+        if args[0].type.name in ("HLL", "P4HLL", "QDIGEST"):
             def card(blob):
-                if args[0].type.name == "HLL":
+                if args[0].type.name in ("HLL", "P4HLL"):
                     return SK.hll_cardinality(blob)
                 return int(SK._qd_parse(blob)[1])
 
             return _sketch_dict_fn("cardinality", card, lambda a: T.BIGINT,
-                                   ("HLL", "QDIGEST"))[1](args)
+                                   ("HLL", "P4HLL", "QDIGEST"))[1](args)
         return prev_card.emit(args)
 
     register("cardinality")((card_resolve, card_emit))
@@ -3090,25 +3115,75 @@ def _register_sketch_fns():
                             Dictionary(np.asarray([SK.hll_empty()],
                                                   dtype=object)))))
 
-    register("value_at_quantile")((_sketch_dict_fn(
-        "value_at_quantile",
-        lambda blob, q: SK.qdigest_value_at_quantile(blob, float(q)),
-        lambda a: T.DOUBLE if a[0].params and a[0].params[0].is_floating
-        else (a[0].params[0] if a[0].params else T.DOUBLE),
-        ("QDIGEST",))))
+    from presto_tpu.functions import tdigest as TD
 
-    register("values_at_quantiles")((_sketch_dict_fn(
-        "values_at_quantiles",
-        lambda blob, qs: tuple(SK.qdigest_value_at_quantile(blob, float(q))
-                               for q in qs),
-        lambda a: T.array_of(T.DOUBLE),
-        ("QDIGEST",))))
+    def _vaq(tname):
+        def one(blob, q):
+            if tname == "TDIGEST":
+                return TD.tdigest_value_at_quantile(blob, float(q))
+            return SK.qdigest_value_at_quantile(blob, float(q))
 
-    register("quantile_at_value")((_sketch_dict_fn(
-        "quantile_at_value",
-        lambda blob, v: SK.qdigest_quantile_at_value(blob, float(v)),
-        lambda a: T.DOUBLE,
-        ("QDIGEST",))))
+        return one
+
+    def _vaq_dispatch(args):
+        return _sketch_dict_fn(
+            "value_at_quantile", _vaq(args[0].type.name),
+            lambda a: T.DOUBLE if a[0].params
+            and a[0].params[0].is_floating
+            else (a[0].params[0] if a[0].params else T.DOUBLE),
+            ("QDIGEST", "TDIGEST"))[1](args)
+
+    register("value_at_quantile")((
+        lambda args: (T.DOUBLE if args
+                      and args[0].name in ("QDIGEST", "TDIGEST")
+                      and (not args[0].params
+                           or args[0].params[0].is_floating)
+                      else args[0].params[0]
+                      if args and args[0].name in ("QDIGEST", "TDIGEST")
+                      else None),
+        _vaq_dispatch))
+
+    register("values_at_quantiles")((
+        lambda args: (T.array_of(T.DOUBLE) if args
+                      and args[0].name in ("QDIGEST", "TDIGEST")
+                      else None),
+        lambda args: _sketch_dict_fn(
+            "values_at_quantiles",
+            lambda blob, qs, _f=_vaq(args[0].type.name): tuple(
+                _f(blob, q) for q in qs),
+            lambda a: T.array_of(T.DOUBLE),
+            ("QDIGEST", "TDIGEST"))[1](args)))
+
+    register("quantile_at_value")((
+        lambda args: (T.DOUBLE if args
+                      and args[0].name in ("QDIGEST", "TDIGEST")
+                      else None),
+        lambda args: _sketch_dict_fn(
+            "quantile_at_value",
+            (lambda blob, v: TD.tdigest_quantile_at_value(blob, float(v)))
+            if args[0].type.name == "TDIGEST"
+            else (lambda blob, v: SK.qdigest_quantile_at_value(
+                blob, float(v))),
+            lambda a: T.DOUBLE,
+            ("QDIGEST", "TDIGEST"))[1](args)))
+
+    register("scale_tdigest")((_sketch_dict_fn(
+        "scale_tdigest",
+        lambda blob, f: TD.tdigest_scale(blob, float(f)),
+        lambda a: a[0],
+        ("TDIGEST",))))
+
+    register("destructure_tdigest")((_sketch_dict_fn(
+        "destructure_tdigest",
+        lambda blob: tuple(
+            (tuple(p) if isinstance(p, list) else p)
+            for p in TD.tdigest_destructure(blob)),
+        lambda a: T.row_of([("means", T.array_of(T.DOUBLE)),
+                            ("weights", T.array_of(T.DOUBLE)),
+                            ("compression", T.DOUBLE),
+                            ("min", T.DOUBLE), ("max", T.DOUBLE),
+                            ("sum", T.DOUBLE)]),
+        ("TDIGEST",))))
 
 
 _register_sketch_fns()

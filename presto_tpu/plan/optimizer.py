@@ -181,6 +181,20 @@ def _pushdown_connector_predicates(node: P.PlanNode, session) -> P.PlanNode:
         table = catalog.get(scan.table)
     except KeyError:
         return node
+    if getattr(table, "supports_domain_pushdown", False):
+        # TupleDomain-style stats pruning: attach per-column domains to
+        # the scan for the reader to prune stripes/row groups (advisory
+        # — the Filter stays; reference: PickTableLayout pushing the
+        # TupleDomain into the connector's table layout)
+        from presto_tpu.plan.domains import (
+            domains_from_conjuncts,
+            domains_pickle_safe,
+        )
+
+        doms = domains_from_conjuncts(
+            ir.conjuncts(node.predicate), scan.assignments)
+        if doms:
+            scan.scan_domains = domains_pickle_safe(doms)
     hook = getattr(table, "pushdown_like", None)
     if hook is None:
         return node
@@ -463,7 +477,12 @@ def prune_columns(node: P.PlanNode, required: Set[str]) -> P.PlanNode:
         if not keep:  # keep at least one column for row counting
             first = next(iter(node.assignments))
             keep = {first: node.assignments[first]}
-        return P.TableScan(node.table, keep, {s: node.types[s] for s in keep})
+        out = P.TableScan(node.table, keep,
+                          {s: node.types[s] for s in keep})
+        for extra in ("scan_domains", "index_lookup", "build_unique"):
+            if hasattr(node, extra):  # dynamic pushdown annotations
+                setattr(out, extra, getattr(node, extra))
+        return out
     if isinstance(node, P.Values):
         return node
     if isinstance(node, P.Filter):

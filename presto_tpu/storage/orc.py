@@ -378,7 +378,7 @@ class _IntRle:
 # ---------------------------------------------------------------------------
 
 # proto field ids (orc_proto.proto)
-_PS_FOOTER_LEN, _PS_COMPRESSION, _PS_BLOCK = 1, 2, 3
+_PS_FOOTER_LEN, _PS_COMPRESSION, _PS_BLOCK, _PS_META_LEN = 1, 2, 3, 5
 _FTR_STRIPES, _FTR_TYPES, _FTR_NROWS = 3, 4, 6
 _STR_OFFSET, _STR_INDEX_LEN, _STR_DATA_LEN, _STR_FOOTER_LEN, _STR_NROWS = \
     1, 2, 3, 4, 5
@@ -440,6 +440,15 @@ class OrcFile:
         self.codec = ps.get(_PS_COMPRESSION, [0])[0]
         self.block_size = ps.get(_PS_BLOCK, [262144])[0]
         footer_len = ps[_PS_FOOTER_LEN][0]
+        meta_len0 = ps.get(_PS_META_LEN, [0])[0]
+        need = min(1 + ps_len + footer_len + meta_len0, size)
+        if need > len(tail):
+            # many-stripe file: the Metadata section outgrew the probe
+            # read — fetch the real tail (the 16 KB guess covers the
+            # common case, like the reference's expectedFooterSize)
+            with open(path, "rb") as f:
+                f.seek(size - need)
+                tail = f.read(need)
         footer_raw = tail[-1 - ps_len - footer_len:-1 - ps_len]
         footer = _msg(_decompress_stream(self.codec, footer_raw,
                                          self.block_size))
@@ -463,6 +472,61 @@ class OrcFile:
                 cid, kind, name,
                 precision=tmsg.get(5, [0])[0], scale=tmsg.get(6, [0])[0]))
         self.stripes = [_msg(s) for s in footer.get(_FTR_STRIPES, [])]
+        # Metadata section (per-stripe ColumnStatistics; reference:
+        # metadata/Metadata.java feeding OrcPredicate stripe pruning)
+        meta_len = ps.get(_PS_META_LEN, [0])[0]
+        self.stripe_stats: List[Optional[list]] = []
+        if meta_len:
+            meta_raw = tail[-1 - ps_len - footer_len - meta_len:
+                            -1 - ps_len - footer_len]
+            try:
+                metadata = _msg(_decompress_stream(
+                    self.codec, meta_raw, self.block_size))
+                self.stripe_stats = [
+                    _msg(ss).get(1, []) for ss in metadata.get(1, [])]
+            except Exception:
+                self.stripe_stats = []  # stats are advisory only
+
+    def stripe_col_stats(self, stripe_index: int, col: "OrcColumn"):
+        """(min, max) in SQL space for one column of one stripe, or
+        None.  Column ids index the flat type list; entry 0 is the root
+        struct."""
+        if stripe_index >= len(self.stripe_stats):
+            return None
+        entries = self.stripe_stats[stripe_index]
+        if col.cid >= len(entries):
+            return None
+        cs = _msg(entries[col.cid])
+
+        def zz(v):
+            return (v >> 1) ^ -(v & 1)
+
+        if 2 in cs:  # IntegerStatistics
+            sub = _msg(cs[2][0])
+            if 1 in sub and 2 in sub:
+                return zz(sub[1][0]), zz(sub[2][0])
+        if 3 in cs:  # DoubleStatistics (fixed64 doubles)
+            sub = _Proto(cs[3][0]).read_message()
+            if 1 in sub and 2 in sub:
+                mn = struct.unpack("<d", struct.pack("<q", sub[1][0]))[0]
+                mx = struct.unpack("<d", struct.pack("<q", sub[2][0]))[0]
+                return mn, mx
+        if 4 in cs:  # StringStatistics
+            sub = _msg(cs[4][0])
+            if 1 in sub and 2 in sub:
+                try:
+                    return sub[1][0].decode(), sub[2][0].decode()
+                except UnicodeDecodeError:
+                    return None
+        if 7 in cs:  # DateStatistics (sint32 days)
+            sub = _msg(cs[7][0])
+            if 1 in sub and 2 in sub:
+                return zz(sub[1][0]), zz(sub[2][0])
+        if 9 in cs:  # TimestampStatistics (sint64 MILLIS -> micros)
+            sub = _msg(cs[9][0])
+            if 1 in sub and 2 in sub:
+                return zz(sub[1][0]) * 1000, zz(sub[2][0]) * 1000 + 999
+        return None
 
     # -- stripe decode -------------------------------------------------
     def _stripe_streams(self, st) -> Tuple[dict, dict]:
@@ -633,6 +697,15 @@ class _PWrite:
     def field_msg(self, fnum: int, msg: "_PWrite") -> None:
         self.field_bytes(fnum, bytes(msg.out))
 
+    def field_zigzag(self, fnum: int, v: int) -> None:
+        """sint32/sint64 field (zigzag varint)."""
+        self.varint(fnum << 3)
+        self.varint((v << 1) ^ (v >> 63) if v < 0 else v << 1)
+
+    def field_double(self, fnum: int, v: float) -> None:
+        self.varint((fnum << 3) | 1)
+        self.out += struct.pack("<d", v)
+
 
 def _rle_v1_write(vals, signed: bool) -> bytes:
     """Integer RLE v1: runs of >=3 equal values, else literal groups."""
@@ -700,90 +773,158 @@ _ORC_KIND = {"BOOLEAN": 0, "SMALLINT": 2, "INTEGER": 3, "BIGINT": 4,
              "TINYINT": 1}
 
 
+def _column_stats_msg(t, live, n_nulls) -> "_PWrite":
+    """ColumnStatistics proto for one column of one stripe (reference:
+    presto-orc .../metadata/statistics/*Statistics + OrcWriter's
+    StripeStatistics) — the zone map select_stripes-style pruning reads."""
+    cs = _PWrite()
+    cs.field_varint(1, int(len(live)))  # numberOfValues (non-null)
+    kind = _ORC_KIND.get(t.name)
+    if len(live):
+        if kind in (1, 2, 3, 4):  # IntegerStatistics (sint64 zigzag)
+            sub = _PWrite()
+            sub.field_zigzag(1, int(np.min(live)))
+            sub.field_zigzag(2, int(np.max(live)))
+            cs.field_msg(2, sub)
+        elif kind in (5, 6):  # DoubleStatistics
+            a = np.asarray(live, np.float64)
+            a = a[~np.isnan(a)]
+            if len(a):
+                sub = _PWrite()
+                sub.field_double(1, float(a.min()))
+                sub.field_double(2, float(a.max()))
+                cs.field_msg(3, sub)
+        elif kind == 7:  # StringStatistics
+            vals = [v if isinstance(v, str) else str(v) for v in live]
+            sub = _PWrite()
+            sub.field_bytes(1, min(vals).encode())
+            sub.field_bytes(2, max(vals).encode())
+            cs.field_msg(4, sub)
+        elif kind == 15:  # DateStatistics (sint32 days)
+            sub = _PWrite()
+            sub.field_zigzag(1, int(np.min(live)))
+            sub.field_zigzag(2, int(np.max(live)))
+            cs.field_msg(7, sub)
+        elif kind == 9:  # TimestampStatistics (sint64 MILLIS)
+            us = np.asarray(live, np.int64)
+            sub = _PWrite()
+            sub.field_zigzag(1, int(us.min() // 1000))
+            sub.field_zigzag(2, int(us.max() // 1000))
+            cs.field_msg(9, sub)
+    if n_nulls:
+        cs.field_varint(10, 1)  # hasNull
+    return cs
+
+
 def write_orc(path: str, arrays: Dict[str, np.ndarray],
-              schema: Dict[str, T.Type]) -> int:
-    """One-stripe ORC v0.12 file, DIRECT encodings, no compression."""
+              schema: Dict[str, T.Type], stripe_rows: int = 0) -> int:
+    """ORC v0.12 file, DIRECT encodings, no compression; stripe_rows > 0
+    splits rows into multiple stripes, each with ColumnStatistics in the
+    Metadata section (the stats-pruning grain)."""
     cols = list(schema)
     n = len(next(iter(arrays.values()))) if arrays else 0
-    streams = []  # (column id, kind, bytes)
-    for ci, c in enumerate(cols, start=1):
-        t = schema[c]
-        a = arrays[c]
-        if isinstance(a, np.ma.MaskedArray):
-            valid = ~np.ma.getmaskarray(a)
-            a = a.filled("" if t.is_string else 0)
-            streams.append((ci, 0, _bool_rle_write(valid)))
-            live = np.asarray(a)[valid]
-        else:
-            valid = None
-            live = np.asarray(a)
-        kind = _ORC_KIND.get(t.name)
-        if kind is None:
-            raise NotImplementedError(f"orc write of {t}")
-        if kind == 0:  # boolean bits
-            streams.append((ci, 1, _bool_rle_write(live.astype(bool))))
-        elif kind in (1,):  # tinyint: byte rle
-            streams.append((ci, 1, _byte_rle_write(
-                live.astype(np.int8).tobytes())))
-        elif kind in (2, 3, 4, 15):  # ints / date: signed RLE v1
-            streams.append((ci, 1, _rle_v1_write(
-                live.astype(np.int64), signed=True)))
-        elif kind == 5:
-            streams.append((ci, 1, live.astype("<f4").tobytes()))
-        elif kind == 6:
-            streams.append((ci, 1, live.astype("<f8").tobytes()))
-        elif kind in (7, 8):  # string/binary: DATA + LENGTH
-            bs = [v.encode() if isinstance(v, str) else
-                  (bytes(v) if v is not None else b"") for v in live]
-            streams.append((ci, 1, b"".join(bs)))
-            streams.append((ci, 2, _rle_v1_write(
-                np.asarray([len(b) for b in bs], np.int64),
-                signed=False)))
-        elif kind == 9:  # timestamp: seconds from 2015 + nanos
-            micros = live.astype(np.int64)
-            secs = micros // 1_000_000 - 1420070400
-            nanos = (micros % 1_000_000) * 1000
-            streams.append((ci, 1, _rle_v1_write(secs, signed=True)))
-            # SECONDARY (kind 5): nanos << 3, no trailing-zero packing
-            streams.append((ci, 5, _rle_v1_write(
-                nanos.astype(np.int64) << 3, signed=False)))
+    grp = stripe_rows if stripe_rows > 0 else max(n, 1)
+    bounds = [(s, min(s + grp, n)) for s in range(0, max(n, 1), grp)]
 
     body = io.BytesIO()
     body.write(MAGIC)
-    data_start = body.tell()
-    offsets = []
-    for _ci, _k, blob in streams:
-        offsets.append(body.tell())
-        body.write(blob)
-    data_len = body.tell() - data_start
+    stripe_infos = []  # (offset, data_len, footer_len, rows)
+    stripe_stats = []  # per stripe: [ColumnStatistics _PWrite] col order
+    for g0, g1 in bounds:
+        streams = []  # (column id, kind, bytes)
+        col_stats = []
+        for ci, c in enumerate(cols, start=1):
+            t = schema[c]
+            a = arrays[c][g0:g1]
+            if isinstance(a, np.ma.MaskedArray):
+                valid = ~np.ma.getmaskarray(a)
+                a = a.filled("" if t.is_string else 0)
+                streams.append((ci, 0, _bool_rle_write(valid)))
+                live = np.asarray(a)[valid]
+                nulls = int((~valid).sum())
+            else:
+                valid = None
+                live = np.asarray(a)
+                nulls = 0
+            col_stats.append(_column_stats_msg(t, live, nulls))
+            kind = _ORC_KIND.get(t.name)
+            if kind is None:
+                raise NotImplementedError(f"orc write of {t}")
+            if kind == 0:  # boolean bits
+                streams.append((ci, 1, _bool_rle_write(live.astype(bool))))
+            elif kind in (1,):  # tinyint: byte rle
+                streams.append((ci, 1, _byte_rle_write(
+                    live.astype(np.int8).tobytes())))
+            elif kind in (2, 3, 4, 15):  # ints / date: signed RLE v1
+                streams.append((ci, 1, _rle_v1_write(
+                    live.astype(np.int64), signed=True)))
+            elif kind == 5:
+                streams.append((ci, 1, live.astype("<f4").tobytes()))
+            elif kind == 6:
+                streams.append((ci, 1, live.astype("<f8").tobytes()))
+            elif kind in (7, 8):  # string/binary: DATA + LENGTH
+                bs = [v.encode() if isinstance(v, str) else
+                      (bytes(v) if v is not None else b"") for v in live]
+                streams.append((ci, 1, b"".join(bs)))
+                streams.append((ci, 2, _rle_v1_write(
+                    np.asarray([len(b) for b in bs], np.int64),
+                    signed=False)))
+            elif kind == 9:  # timestamp: seconds from 2015 + nanos
+                micros = live.astype(np.int64)
+                secs = micros // 1_000_000 - 1420070400
+                nanos = (micros % 1_000_000) * 1000
+                streams.append((ci, 1, _rle_v1_write(secs, signed=True)))
+                # SECONDARY (kind 5): nanos << 3, no trailing-zero packing
+                streams.append((ci, 5, _rle_v1_write(
+                    nanos.astype(np.int64) << 3, signed=False)))
+        stripe_stats.append(col_stats)
 
-    # stripe footer
-    sf = _PWrite()
-    for ci, k, blob in streams:
-        st = _PWrite()
-        st.field_varint(1, k)
-        st.field_varint(2, ci)
-        st.field_varint(3, len(blob))
-        sf.field_msg(1, st)
-    for _ in range(len(cols) + 1):  # root + columns: DIRECT encoding
-        enc = _PWrite()
-        enc.field_varint(1, 0)
-        sf.field_msg(2, enc)
-    sf_bytes = bytes(sf.out)
-    sf_off = body.tell()
-    body.write(sf_bytes)
+        data_start = body.tell()
+        for _ci, _k, blob in streams:
+            body.write(blob)
+        data_len = body.tell() - data_start
+
+        sf = _PWrite()
+        for ci, k, blob in streams:
+            st = _PWrite()
+            st.field_varint(1, k)
+            st.field_varint(2, ci)
+            st.field_varint(3, len(blob))
+            sf.field_msg(1, st)
+        for _ in range(len(cols) + 1):  # root + columns: DIRECT encoding
+            enc = _PWrite()
+            enc.field_varint(1, 0)
+            sf.field_msg(2, enc)
+        sf_bytes = bytes(sf.out)
+        body.write(sf_bytes)
+        stripe_infos.append((data_start, data_len, len(sf_bytes), g1 - g0))
+
+    # Metadata section: one StripeStatistics per stripe (root column
+    # first, then data columns — reference metadata/Metadata.java)
+    meta = _PWrite()
+    for (_o, _d, _f, rows), col_stats in zip(stripe_infos, stripe_stats):
+        ss = _PWrite()
+        root_cs = _PWrite()
+        root_cs.field_varint(1, rows)  # root struct: every row counts
+        ss.field_msg(1, root_cs)
+        for cs in col_stats:
+            ss.field_msg(1, cs)
+        meta.field_msg(1, ss)
+    meta_bytes = bytes(meta.out)
+    body.write(meta_bytes)
 
     # footer
     ftr = _PWrite()
     ftr.field_varint(1, 3)  # headerLength (magic)
     ftr.field_varint(2, body.tell())  # contentLength
-    stripe = _PWrite()
-    stripe.field_varint(1, data_start)  # offset
-    stripe.field_varint(2, 0)  # indexLength
-    stripe.field_varint(3, data_len)
-    stripe.field_varint(4, len(sf_bytes))
-    stripe.field_varint(5, n)
-    ftr.field_msg(3, stripe)
+    for off, dlen, sflen, rows in stripe_infos:
+        stripe = _PWrite()
+        stripe.field_varint(1, off)  # offset
+        stripe.field_varint(2, 0)  # indexLength
+        stripe.field_varint(3, dlen)
+        stripe.field_varint(4, sflen)
+        stripe.field_varint(5, rows)
+        ftr.field_msg(3, stripe)
     root = _PWrite()
     root.field_varint(1, 12)  # STRUCT
     for ci in range(1, len(cols) + 1):
@@ -807,7 +948,7 @@ def write_orc(path: str, arrays: Dict[str, np.ndarray],
     # version: repeated uint32 [0, 12] (non-packed)
     ps.field_varint(4, 0)
     ps.field_varint(4, 12)
-    ps.field_varint(5, 0)  # metadataLength
+    ps.field_varint(5, len(meta_bytes))  # metadataLength
     ps.field_varint(6, 6)  # writerVersion
     ps.field_bytes(8, b"ORC")  # magic
     ps_bytes = bytes(ps.out)

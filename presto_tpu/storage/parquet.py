@@ -429,6 +429,36 @@ class ParquetFile:
                 precision=el.get(8, 0), logical=el.get(10)))
         return out
 
+    def rg_stats(self, rg_index: int, col: ParquetColumn):
+        """(min, max, null_count) for one column chunk from the footer
+        Statistics, or None when absent/undecodable (reference:
+        TupleDomainParquetPredicate reading ColumnChunkMetaData stats).
+        Written by both this module's writer and any conformant one."""
+        rg = self.row_groups[rg_index]
+        for cc in rg[1]:
+            meta = cc[3]
+            if [p.decode() for p in meta[3]] == [col.name]:
+                st = meta.get(12)
+                if not isinstance(st, dict):
+                    return None
+                mn_raw = st.get(6, st.get(2))  # min_value, else legacy
+                mx_raw = st.get(5, st.get(1))
+                nulls = st.get(3, 0)
+                if mn_raw is None or mx_raw is None:
+                    return None
+                mn = _stat_decode(mn_raw, col.ptype, col)
+                mx = _stat_decode(mx_raw, col.ptype, col)
+                if mn is None or mx is None:
+                    return None
+                return mn, mx, nulls
+        return None
+
+    def rg_byte_size(self, rg_index: int) -> int:
+        rg = self.row_groups[rg_index]
+        if 2 in rg:  # total_byte_size (avoid the O(ncols) fallback sum)
+            return rg[2]
+        return sum(cc[3].get(7, 0) for cc in rg[1])
+
     # -- column chunk decode ------------------------------------------
     def read_column(self, rg_index: int, col: ParquetColumn
                     ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
@@ -749,51 +779,105 @@ def _plain_encode(ptype: int, vals, t: T.Type) -> bytes:
     return bytes(out)
 
 
+def _stat_bytes(ptype: int, vals, t: T.Type):
+    """(min_value, max_value) plain-encoded for the Statistics struct,
+    or None when the column has no non-null values / an unordered
+    physical type."""
+    if len(vals) == 0 or ptype == 0:
+        return None
+    if ptype in (1, 2, 4, 5):
+        a = np.asarray(vals)
+        if a.dtype.kind == "f":
+            a = a[~np.isnan(a)]  # NaN must not poison the zone map
+            if len(a) == 0:
+                return None
+        lo, hi = a.min(), a.max()
+        fmt = {1: "<i4", 2: "<i8", 4: "<f4", 5: "<f8"}[ptype]
+        return (np.asarray(lo).astype(fmt).tobytes(),
+                np.asarray(hi).astype(fmt).tobytes())
+    if ptype == 6 and t.is_string and t.name != "VARBINARY":
+        enc = [v.encode() if isinstance(v, str) else bytes(v)
+               for v in vals]
+        return (min(enc), max(enc))
+    return None
+
+
+def _stat_decode(raw: bytes, ptype: int, col: "ParquetColumn"):
+    """Plain-encoded Statistics value -> SQL-space python scalar (days
+    for DATE, micros for TIMESTAMP — the same space the planner's
+    literals live in)."""
+    try:
+        if ptype == 1:
+            return int(np.frombuffer(raw[:4], "<i4")[0])
+        if ptype == 2:
+            return int(np.frombuffer(raw[:8], "<i8")[0])
+        if ptype == 4:
+            return float(np.frombuffer(raw[:4], "<f4")[0])
+        if ptype == 5:
+            return float(np.frombuffer(raw[:8], "<f8")[0])
+        if ptype == 6 and col.converted == 0:  # UTF8
+            return raw.decode("utf-8")
+    except (ValueError, UnicodeDecodeError):
+        return None
+    return None
+
+
 def write_parquet(path: str, arrays: Dict[str, np.ndarray],
-                  schema: Dict[str, T.Type]) -> int:
-    """Write one row group of PLAIN-encoded v1 pages (uncompressed).
-    Readable by this module AND by any conformant reader — the tests
-    cross-check with an independent implementation."""
+                  schema: Dict[str, T.Type],
+                  row_group_rows: int = 0) -> int:
+    """Write PLAIN-encoded v1 pages (uncompressed) with footer
+    Statistics per column chunk.  row_group_rows > 0 splits the rows
+    into multiple row groups — the pruning grain of the selective read
+    path.  Readable by this module AND by any conformant reader — the
+    tests cross-check with an independent implementation."""
     cols = list(schema)
     n = len(next(iter(arrays.values()))) if arrays else 0
+    grp = row_group_rows if row_group_rows > 0 else max(n, 1)
+    bounds = [(s, min(s + grp, n)) for s in range(0, max(n, 1), grp)]
     body = io.BytesIO()
     body.write(MAGIC)
-    chunk_meta = []
-    for c in cols:
-        t = schema[c]
-        a = arrays[c]
-        if isinstance(a, np.ma.MaskedArray):
-            valid = ~np.ma.getmaskarray(a)
-            a = a.filled("" if t.is_string else 0)
-        else:
-            valid = None
-        ptype, conv = _parquet_physical(t)
-        optional = valid is not None
-        if optional:
-            levels = valid.astype(np.int64)
-            lev = _rle_encode_levels(levels)
-            lev_block = len(lev).to_bytes(4, "little") + lev
-            vals = np.asarray(a)[valid]
-        else:
-            lev_block = b""
-            vals = np.asarray(a)
-        payload = lev_block + _plain_encode(ptype, vals, t)
-        ph = _TWrite()
-        ph.i32(1, 0)  # type = DATA_PAGE
-        ph.i32(2, len(payload))  # uncompressed
-        ph.i32(3, len(payload))  # compressed (none)
-        ph.begin_struct(5)  # data_page_header
-        ph.i32(1, n)
-        ph.i32(2, 0)  # PLAIN
-        ph.i32(3, 3)  # def levels: RLE
-        ph.i32(4, 3)  # rep levels: RLE
-        ph.end_struct()
-        ph.out.append(0)  # end PageHeader struct
-        off = body.tell()
-        body.write(bytes(ph.out))
-        body.write(payload)
-        total = body.tell() - off
-        chunk_meta.append((c, ptype, conv, off, total, optional, t))
+    groups = []  # [(rows, [(c, ptype, conv, off, tot, optional, t, stat, nulls)])]
+    for g0, g1 in bounds:
+        chunk_meta = []
+        for c in cols:
+            t = schema[c]
+            a = arrays[c][g0:g1]
+            if isinstance(a, np.ma.MaskedArray):
+                valid = ~np.ma.getmaskarray(a)
+                a = a.filled("" if t.is_string else 0)
+            else:
+                valid = None
+            ptype, conv = _parquet_physical(t)
+            optional = valid is not None
+            if optional:
+                levels = valid.astype(np.int64)
+                lev = _rle_encode_levels(levels)
+                lev_block = len(lev).to_bytes(4, "little") + lev
+                vals = np.asarray(a)[valid]
+            else:
+                lev_block = b""
+                vals = np.asarray(a)
+            payload = lev_block + _plain_encode(ptype, vals, t)
+            nulls = 0 if valid is None else int((~valid).sum())
+            stat = _stat_bytes(ptype, vals, t)
+            ph = _TWrite()
+            ph.i32(1, 0)  # type = DATA_PAGE
+            ph.i32(2, len(payload))  # uncompressed
+            ph.i32(3, len(payload))  # compressed (none)
+            ph.begin_struct(5)  # data_page_header
+            ph.i32(1, g1 - g0)
+            ph.i32(2, 0)  # PLAIN
+            ph.i32(3, 3)  # def levels: RLE
+            ph.i32(4, 3)  # rep levels: RLE
+            ph.end_struct()
+            ph.out.append(0)  # end PageHeader struct
+            off = body.tell()
+            body.write(bytes(ph.out))
+            body.write(payload)
+            total = body.tell() - off
+            chunk_meta.append((c, ptype, conv, off, total, optional, t,
+                               stat, nulls))
+        groups.append((g1 - g0, chunk_meta))
 
     # FileMetaData
     md = _TWrite()
@@ -805,7 +889,7 @@ def write_parquet(path: str, arrays: Dict[str, np.ndarray],
     root.i32(5, len(cols))
     root.out.append(0)
     md.out += root.out
-    for c, ptype, conv, _off, _tot, optional, t in chunk_meta:
+    for c, ptype, conv, _off, _tot, optional, t, _st, _nu in groups[0][1]:
         el = _TWrite()
         el.i32(1, ptype)
         el.i32(3, 1 if optional else 0)  # repetition
@@ -818,33 +902,54 @@ def write_parquet(path: str, arrays: Dict[str, np.ndarray],
         el.out.append(0)
         md.out += el.out
     md.i64(3, n)  # num_rows
-    md.begin_list(4, 12, 1)  # one row group
-    rg = _TWrite()
-    rg.begin_list(1, 12, len(cols))
-    total_bytes = 0
-    for c, ptype, conv, off, tot, optional, t in chunk_meta:
-        cc = _TWrite()
-        cc.i64(2, off)  # file_offset
-        cc.begin_struct(3)  # ColumnMetaData
-        cc.i32(1, ptype)
-        cc.begin_list(2, 5, 1)
-        cc.zigzag(0)  # encodings: [PLAIN]
-        cc.begin_list(3, 8, 1)
-        cc.varint(len(c.encode()))
-        cc.out += c.encode()
-        cc.i32(4, 0)  # codec: UNCOMPRESSED
-        cc.i64(5, n)  # num_values
-        cc.i64(6, tot)  # total_uncompressed_size
-        cc.i64(7, tot)  # total_compressed_size
-        cc.i64(9, off)  # data_page_offset
-        cc.end_struct()
-        cc.out.append(0)  # end ColumnChunk
-        rg.out += cc.out
-        total_bytes += tot
-    rg.i64(2, total_bytes)
-    rg.i64(3, n)
-    rg.out.append(0)  # end RowGroup
-    md.out += rg.out
+    md.begin_list(4, 12, len(groups))
+    for g_rows, chunk_meta in groups:
+        rg = _TWrite()
+        rg.begin_list(1, 12, len(cols))
+        total_bytes = 0
+        for c, ptype, conv, off, tot, optional, t, stat, nulls in chunk_meta:
+            cc = _TWrite()
+            cc.i64(2, off)  # file_offset
+            cc.begin_struct(3)  # ColumnMetaData
+            cc.i32(1, ptype)
+            cc.begin_list(2, 5, 1)
+            cc.zigzag(0)  # encodings: [PLAIN]
+            cc.begin_list(3, 8, 1)
+            cc.varint(len(c.encode()))
+            cc.out += c.encode()
+            cc.i32(4, 0)  # codec: UNCOMPRESSED
+            cc.i64(5, g_rows)  # num_values
+            cc.i64(6, tot)  # total_uncompressed_size
+            cc.i64(7, tot)  # total_compressed_size
+            cc.i64(9, off)  # data_page_offset
+            if stat is not None or nulls:
+                # Statistics (field 12): 3=null_count, 5=max_value,
+                # 6=min_value — the zone map the selective read path
+                # prunes on (reference: OrcSelectiveRecordReader /
+                # parquet TupleDomainParquetPredicate)
+                cc.begin_struct(12)
+                cc.i64(3, nulls)
+                if stat is not None:
+                    cc.binary(5, stat[1])
+                    cc.binary(6, stat[0])
+                cc.end_struct()
+            cc.end_struct()
+            cc.out.append(0)  # end ColumnChunk
+            rg.out += cc.out
+            total_bytes += tot
+        rg.i64(2, total_bytes)
+        rg.i64(3, g_rows)
+        rg.out.append(0)  # end RowGroup
+        md.out += rg.out
+    # column_orders (field 7): TYPE_ORDER for every column — readers
+    # ignore min_value/max_value statistics unless this is present
+    md.begin_list(7, 12, len(cols))
+    for _ in cols:
+        co = _TWrite()
+        co.begin_struct(1)  # ColumnOrder.TYPE_ORDER
+        co.end_struct()
+        co.out.append(0)  # end ColumnOrder union
+        md.out += co.out
     md.out.append(0)  # end FileMetaData
     meta = bytes(md.out)
     body.write(meta)

@@ -260,14 +260,26 @@ _PHYSICAL = {
     "MAP": np.int32,  # dictionary code over unique pair-tuples
     "ROW": np.int32,  # dictionary code over unique field-tuples
     "HLL": np.int32,  # dictionary code over serialized sketch bytes
+    "P4HLL": np.int32,  # dictionary code over serialized sketch bytes
     "QDIGEST": np.int32,  # dictionary code over serialized sketch bytes
+    "TDIGEST": np.int32,  # dictionary code over serialized sketch bytes
 }
 
 HLL = Type("HLL")
+# Dense-format HyperLogLog (reference: spi/type/P4HyperLogLogType —
+# the fixed-register airlift P4 layout; this engine's HLL blobs are
+# always dense, so the two types share the physical form and casts
+# between them are re-tags)
+P4HLL = Type("P4HLL")
 
 
 def qdigest_of(elem: Type) -> Type:
     return Type("QDIGEST", (elem,))
+
+
+def tdigest_of(elem: Type) -> Type:
+    """reference: TDigestParametricType (tdigest(double))."""
+    return Type("TDIGEST", (elem,))
 
 
 def parse_type(text: str) -> Type:
@@ -283,6 +295,8 @@ def parse_type(text: str) -> Type:
             inner = inner[:-1]
         if base == "QDIGEST":
             return qdigest_of(parse_type(inner))
+        if base == "TDIGEST":
+            return tdigest_of(parse_type(inner))
         if base in ("ARRAY", "MAP", "ROW"):
             parts = _split_type_args(inner)
             if base == "ARRAY":
@@ -336,6 +350,8 @@ def parse_type(text: str) -> Type:
         "HLL": HLL,
         "HYPERLOGLOG": HLL,
         "QDIGEST": qdigest_of(DOUBLE),
+        "TDIGEST": tdigest_of(DOUBLE),
+        "P4HYPERLOGLOG": P4HLL,
     }
     if t in aliases:
         return aliases[t]
