@@ -73,7 +73,9 @@ class MemoryTable(ConnectorTable):
 
     def __init__(self, name, schema, data: Dict[str, np.ndarray]):
         super().__init__(name, schema)
-        self.data = {k: np.asarray(v) for k, v in data.items()}
+        # np.asarray would silently STRIP a null mask
+        self.data = {k: (v if isinstance(v, np.ma.MaskedArray)
+                         else np.asarray(v)) for k, v in data.items()}
         self._rows = len(next(iter(self.data.values()))) if self.data else 0
 
     def column_stats(self, column: str):
@@ -105,10 +107,17 @@ class MemoryTable(ConnectorTable):
         n = len(next(iter(arrays.values()))) if arrays else 0
         if n == 0:
             return 0
+        def keep_mask(v):
+            return v if isinstance(v, np.ma.MaskedArray) else np.asarray(v)
+
         if self._rows == 0:
-            self.data = {c: np.asarray(arrays[c]) for c in self.schema}
+            self.data = {c: keep_mask(arrays[c]) for c in self.schema}
         else:
-            self.data = {c: np.concatenate([self.data[c], np.asarray(arrays[c])])
+            cat = np.ma.concatenate \
+                if any(isinstance(x, np.ma.MaskedArray)
+                       for x in (*self.data.values(), *arrays.values())) \
+                else np.concatenate
+            self.data = {c: cat([self.data[c], keep_mask(arrays[c])])
                          for c in self.schema}
         self._rows += n
         self._invalidate()

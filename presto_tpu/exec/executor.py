@@ -1784,6 +1784,89 @@ class Executor:
         return Batch(out_cols, jnp.ones((1,), bool))
 
     # ---- joins -------------------------------------------------------
+    def _exec_spatialjoin(self, node) -> Batch:
+        """Grid-indexed spatial inner join (reference:
+        SpatialJoinOperator over PagesRTreeIndex; see P.SpatialJoin for
+        the TPU-native redesign).  Dynamic-mode only: the match count is
+        data-dependent."""
+        if self.static:
+            raise StaticFallback("spatial join is dynamic-mode only")
+        from presto_tpu.functions import geospatial as GEO
+
+        left = self.exec_node(node.left)
+        right = self.exec_node(node.right)
+        lrows = np.flatnonzero(np.asarray(left.sel))
+        rrows = np.flatnonzero(np.asarray(right.sel))
+
+        def coords(batch, rows, sym):
+            c = batch.columns[sym]
+            v = np.asarray(c.data, np.float64)[rows]
+            if c.valid is not None:
+                v = np.where(np.asarray(c.valid)[rows], v, np.nan)
+            return v
+
+        px = coords(left, lrows, node.probe_x)
+        py = coords(left, lrows, node.probe_y)
+        # NULL coordinates (NaN after masking) match nothing — drop them
+        # BEFORE the grid, where a NaN would poison the cell math
+        pkeep = np.isfinite(px) & np.isfinite(py)
+        lrows, px, py = lrows[pkeep], px[pkeep], py[pkeep]
+        if node.kind == "contains":
+            gc = right.columns[node.build_geom]
+            if gc.dictionary is None:
+                raise ExecutionError("spatial join build side must be a "
+                                     "geometry/varchar column")
+            if gc.valid is not None:  # NULL geometry matches nothing
+                rrows = rrows[np.asarray(gc.valid)[rrows]]
+            codes = np.clip(np.asarray(gc.data)[rrows], 0,
+                            len(gc.dictionary) - 1)
+            entries = gc.dictionary.values
+            # parse + index per DISTINCT referenced entry (a
+            # low-cardinality geometry column must not replicate its
+            # edge arrays per row, and unreferenced dictionary entries
+            # must not poison the join)
+            uniq, inv = np.unique(codes, return_inverse=True)
+            geoms = []
+            for c in uniq:
+                g = entries[int(c)]
+                g = g if isinstance(g, tuple) else GEO.parse_wkt(str(g))
+                if g[0] not in ("polygon",):
+                    raise ExecutionError(
+                        f"spatial join build over {g[0]} geometries is "
+                        "not supported (polygons only)")
+                geoms.append(g)
+            li, gi = GEO.grid_contains_join(px, py, geoms)
+            # expand geometry matches back to build ROWS sharing the code
+            order = np.argsort(inv, kind="stable")
+            starts = np.searchsorted(inv[order], np.arange(len(uniq)))
+            ends = np.searchsorted(inv[order], np.arange(len(uniq)),
+                                   side="right")
+            counts = ends[gi] - starts[gi]
+            li = np.repeat(li, counts)
+            flat = (np.arange(int(counts.sum()), dtype=np.int64)
+                    - np.repeat(np.concatenate(
+                        [[0], np.cumsum(counts)[:-1]]) if len(counts)
+                        else np.empty(0, np.int64), counts)
+                    + np.repeat(starts[gi], counts))
+            ri = order[flat]
+        else:
+            bx = coords(right, rrows, node.build_x)
+            by = coords(right, rrows, node.build_y)
+            bkeep = np.isfinite(bx) & np.isfinite(by)
+            rrows, bx, by = rrows[bkeep], bx[bkeep], by[bkeep]
+            li, ri = GEO.grid_distance_join(px, py, bx, by, node.radius,
+                                            node.strict)
+        lgat = jnp.asarray(lrows[li]) if len(li) else jnp.zeros(0, jnp.int32)
+        rgat = jnp.asarray(rrows[ri]) if len(ri) else jnp.zeros(0, jnp.int32)
+        lb = K.gather_batch(left, lgat)
+        rb = K.gather_batch(right, rgat)
+        merged = dict(lb.columns)
+        merged.update(rb.columns)
+        out = Batch(merged, jnp.ones((len(li),), bool))
+        if node.filter is not None:
+            out = Batch(merged, eval_predicate(node.filter, out, self.ctx))
+        return out
+
     def _exec_join(self, node: P.Join) -> Batch:
         from presto_tpu.memory.context import batch_bytes
 

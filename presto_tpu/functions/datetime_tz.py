@@ -27,7 +27,6 @@ from presto_tpu.functions import tzdb
 from presto_tpu.functions.scalar import (
     REGISTRY,
     _as_string_literal,
-    all_valid,
     register,
 )
 

@@ -99,6 +99,35 @@ class Aggregate(PlanNode):
 
 
 @dataclass
+class SpatialJoin(PlanNode):
+    """Grid-indexed spatial inner join (reference: SpatialJoinOperator +
+    PagesRTreeIndex).  TPU-native redesign: instead of a pointer-chasing
+    R-tree, the build side bins into a uniform grid sized so each
+    geometry bbox spans O(1) cells; probes hash to their cell, candidate
+    pairs expand vectorized, and the exact predicate (even-odd ray cast
+    / distance) evaluates on device over padded edge arrays."""
+
+    left: PlanNode  # probe side: point coordinates
+    right: PlanNode  # build side: geometries (or points for distance)
+    kind: str  # "contains" | "distance"
+    probe_x: str = ""
+    probe_y: str = ""
+    build_geom: str = ""  # contains: right WKT/GEOMETRY symbol
+    build_x: str = ""  # distance: right point coords
+    build_y: str = ""
+    radius: float = 0.0  # distance joins: st_distance(..) <= radius
+    strict: bool = False  # True: < radius, False: <= radius
+    filter: Optional[RowExpr] = None  # residual conjuncts
+
+    def outputs(self):
+        return list(self.left.outputs()) + list(self.right.outputs())
+
+    @property
+    def sources(self):
+        return [self.left, self.right]
+
+
+@dataclass
 class Join(PlanNode):
     """INNER/LEFT/RIGHT/FULL/CROSS equi-join (+ residual filter), or
     SEMI/ANTI (left row kept iff [no] right match passes the filter —
@@ -306,6 +335,15 @@ def plan_tree_str(node: PlanNode, indent: int = 0, annotate=None) -> str:
         detail = f" {node.join_type} {node.criteria}" + (
             f" filter=[{node.filter}]" if node.filter is not None else "") + (
             " INDEX" if getattr(node, "index_lookup", None) else "")
+    elif isinstance(node, SpatialJoin):
+        pred = (f"ST_Contains({node.build_geom}, "
+                f"point({node.probe_x}, {node.probe_y}))"
+                if node.kind == "contains" else
+                f"ST_Distance(({node.probe_x}, {node.probe_y}), "
+                f"({node.build_x}, {node.build_y})) "
+                f"{'<' if node.strict else '<='} {node.radius}")
+        detail = f" GRID-INDEXED [{pred}]" + (
+            f" filter=[{node.filter}]" if node.filter is not None else "")
     elif isinstance(node, (Sort, TopN)):
         detail = f" {node.keys}" + (
             f" limit={node.count}" if isinstance(node, TopN) else "")

@@ -149,6 +149,7 @@ def _optimize_node(node: P.PlanNode, session) -> P.PlanNode:
             rules.append(ReorderJoins(session))
         node = IterativeOptimizer(rules).optimize(node)
     node = _pushdown_connector_predicates(node, session)
+    node = _extract_spatial_joins(node)
     # re-prune: a pushed-down predicate leaves its original string column
     # unreferenced in the scan — dropping it is the whole point (the
     # column never materializes)
@@ -527,6 +528,25 @@ def prune_columns(node: P.PlanNode, required: Set[str]) -> P.PlanNode:
         right = prune_columns(node.right, need_r)
         return P.Join(left, right, node.join_type, node.criteria, node.filter,
                       node.distribution, node.mark)
+    if isinstance(node, P.SpatialJoin):
+        lsyms = {s for s, _ in node.left.outputs()}
+        rsyms = {s for s, _ in node.right.outputs()}
+        need_l = {node.probe_x, node.probe_y} & lsyms
+        need_r = ({node.build_geom, node.build_x, node.build_y}
+                  - {""}) & rsyms
+        extra = set(required)
+        if node.filter is not None:
+            extra |= node.filter.refs()
+        for r in extra:
+            (need_l if r in lsyms else need_r if r in rsyms
+             else set()).add(r)
+        import dataclasses as _dc
+
+        # fresh node, like every sibling branch (in-place child swaps
+        # would narrow plans shared with a retained pre-prune tree)
+        return _dc.replace(node,
+                           left=prune_columns(node.left, need_l),
+                           right=prune_columns(node.right, need_r))
     if isinstance(node, (P.Sort, P.TopN)):
         need = required | {k for k, _, _ in node.keys}
         src = prune_columns(node.source, need)
@@ -554,4 +574,109 @@ def prune_columns(node: P.PlanNode, required: Set[str]) -> P.PlanNode:
     if isinstance(node, P.Output):
         return P.Output(prune_columns(node.source, set(node.symbols)),
                         node.names, node.symbols)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# spatial join extraction (reference: ExtractSpatialJoins +
+# SpatialJoinOperator/PagesRTreeIndex in presto-main; here the runtime
+# index is a uniform grid — see P.SpatialJoin)
+# ---------------------------------------------------------------------------
+
+
+def _point_refs(e):
+    """st_point(Ref x, Ref y) -> (x, y) symbol names, else None."""
+    if isinstance(e, ir.Call) and e.fn == "st_point" \
+            and len(e.args) == 2 \
+            and all(isinstance(a, ir.Ref) for a in e.args):
+        return e.args[0].name, e.args[1].name
+    return None
+
+
+def _match_spatial_conjunct(c, lsyms, rsyms):
+    """One conjunct -> SpatialJoin fields, or None.  Shapes:
+    st_contains(g, p) / st_within(p, g) with g a Ref and p an
+    st_point over Refs; st_distance(p1, p2) < lit / <= lit."""
+    if not isinstance(c, ir.Call):
+        return None
+    if c.fn in ("st_contains", "st_within", "st_intersects") \
+            and len(c.args) == 2:
+        # a point probe makes st_intersects == st_contains (interior
+        # test; boundary points follow the same ray-cast tolerance)
+        if c.fn == "st_intersects" and _point_refs(c.args[0]) is not None:
+            g, p = c.args[1], c.args[0]
+        elif c.fn == "st_within":
+            g, p = c.args[1], c.args[0]
+        else:
+            g, p = c.args
+        if isinstance(g, ir.Call) and g.fn == "st_geometryfromtext" \
+                and len(g.args) == 1 and isinstance(g.args[0], ir.Ref):
+            g = g.args[0]  # WKT column: the executor parses per entry
+        pt = _point_refs(p)
+        if not isinstance(g, ir.Ref) or pt is None:
+            return None
+        if g.name in rsyms and pt[0] in lsyms and pt[1] in lsyms:
+            return {"kind": "contains", "probe_x": pt[0],
+                    "probe_y": pt[1], "build_geom": g.name}
+        if g.name in lsyms and pt[0] in rsyms and pt[1] in rsyms:
+            return {"kind": "contains", "probe_x": pt[0],
+                    "probe_y": pt[1], "build_geom": g.name,
+                    "swap": True}
+        return None
+    if c.fn in ("lt", "le") and len(c.args) == 2 \
+            and isinstance(c.args[0], ir.Call) \
+            and c.args[0].fn == "st_distance" \
+            and isinstance(c.args[1], ir.Lit) \
+            and isinstance(c.args[1].value, (int, float)):
+        p1 = _point_refs(c.args[0].args[0])
+        p2 = _point_refs(c.args[0].args[1])
+        if p1 is None or p2 is None:
+            return None
+        r = float(c.args[1].value)
+        for probe, build, swap in ((p1, p2, False), (p2, p1, True)):
+            if probe[0] in lsyms and probe[1] in lsyms \
+                    and build[0] in rsyms and build[1] in rsyms:
+                return {"kind": "distance", "probe_x": probe[0],
+                        "probe_y": probe[1], "build_x": build[0],
+                        "build_y": build[1], "radius": r,
+                        "strict": c.fn == "lt", "swap": swap}
+    return None
+
+
+def _extract_spatial_joins(node: P.PlanNode) -> P.PlanNode:
+    for attr in ("source", "left", "right"):
+        if hasattr(node, attr):
+            setattr(node, attr, _extract_spatial_joins(getattr(node, attr)))
+    if isinstance(node, P.Union):
+        node.sources_ = [_extract_spatial_joins(s) for s in node.sources_]
+    # pattern A: Filter over a filter-free CROSS join
+    # pattern B: the CROSS join carries the predicate itself
+    filt_node = None
+    join = node
+    if isinstance(node, P.Filter) and isinstance(node.source, P.Join):
+        filt_node, join = node, node.source
+    if not (isinstance(join, P.Join) and join.join_type == "CROSS"
+            and not join.criteria):
+        return node
+    pred = filt_node.predicate if filt_node is not None else join.filter
+    if filt_node is not None and join.filter is not None:
+        pred = ir.combine_conjuncts(
+            list(ir.conjuncts(pred)) + list(ir.conjuncts(join.filter)))
+    if pred is None:
+        return node
+    lsyms = {s for s, _ in join.left.outputs()}
+    rsyms = {s for s, _ in join.right.outputs()}
+    conjs = list(ir.conjuncts(pred))
+    for i, c in enumerate(conjs):
+        m = _match_spatial_conjunct(c, lsyms, rsyms)
+        if m is None:
+            continue
+        swap = m.pop("swap", False)
+        left, right = (join.right, join.left) if swap \
+            else (join.left, join.right)
+        rest = conjs[:i] + conjs[i + 1:]
+        sj = P.SpatialJoin(left=left, right=right,
+                           filter=ir.combine_conjuncts(rest)
+                           if rest else None, **m)
+        return sj
     return node
