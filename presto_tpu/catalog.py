@@ -113,11 +113,15 @@ class MemoryTable(ConnectorTable):
         if self._rows == 0:
             self.data = {c: keep_mask(arrays[c]) for c in self.schema}
         else:
-            cat = np.ma.concatenate \
-                if any(isinstance(x, np.ma.MaskedArray)
-                       for x in (*self.data.values(), *arrays.values())) \
-                else np.concatenate
-            self.data = {c: cat([self.data[c], keep_mask(arrays[c])])
+            def cat(old, new):
+                # masked concat ONLY for columns that carry a mask —
+                # null-free columns must stay plain ndarrays
+                if isinstance(old, np.ma.MaskedArray) \
+                        or isinstance(new, np.ma.MaskedArray):
+                    return np.ma.concatenate([old, new])
+                return np.concatenate([old, new])
+
+            self.data = {c: cat(self.data[c], keep_mask(arrays[c]))
                          for c in self.schema}
         self._rows += n
         self._invalidate()

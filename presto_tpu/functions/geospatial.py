@@ -556,7 +556,6 @@ def grid_contains_join(px, py, geoms):
     m = len(geoms)
     if n == 0 or m == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64)
-    # bboxes + padded edge arrays
     boxes = np.empty((m, 4), np.float64)
     edge_lists = []
     for j, g in enumerate(geoms):
@@ -577,30 +576,50 @@ def grid_contains_join(px, py, geoms):
                 x2, y2 = r[(i + 1) % k]
                 segs.append((x1, y1, x2, y2))
         edge_lists.append(segs)
-    emax = max(max(len(s) for s in edge_lists), 1)
-    E = np.full((m, emax, 4), np.nan)  # NaN edges never cross
-    for j, segs in enumerate(edge_lists):
-        if segs:
-            E[j, :len(segs)] = segs
 
     lidx, ridx = _grid_candidates(px, py, boxes)
     if len(lidx) == 0:
         return lidx, ridx
-    # exact even-odd ray cast on device: (C, emax) elementwise
+    # exact even-odd ray cast on device, BUCKETED by edge count so one
+    # high-vertex polygon does not inflate the padding for everyone
+    # (pow2 classes keep the compiled-shape count logarithmic)
+    nedges = np.asarray([len(s) for s in edge_lists], np.int64)
+    pair_edges = nedges[ridx]
+    classes = np.maximum(
+        1 << np.ceil(np.log2(np.maximum(pair_edges, 1))).astype(np.int64),
+        4)
+    out_l, out_r = [], []
+    for cls in np.unique(classes):
+        sel = np.flatnonzero(classes == cls)
+        sl, sr = lidx[sel], ridx[sel]
+        uniq_g, inv_g = np.unique(sr, return_inverse=True)
+        E = np.full((len(uniq_g), int(cls), 4), np.nan)  # NaN never crosses
+        for gi, j in enumerate(uniq_g):
+            segs = edge_lists[j]
+            if segs:
+                E[gi, :len(segs)] = segs
+        hit = _raycast_pairs(px[sl], py[sl], E, inv_g)
+        out_l.append(sl[hit])
+        out_r.append(sr[hit])
+    return np.concatenate(out_l), np.concatenate(out_r)
+
+
+def _raycast_pairs(cx, cy, E, gsel):
+    """Even-odd ray parity for candidate pairs: cx/cy host points (C,),
+    E (G, emax, 4) padded edges, gsel (C,) geometry index per pair."""
     import jax.numpy as jnp
 
-    ex1 = jnp.asarray(E[:, :, 0])[ridx]
-    ey1 = jnp.asarray(E[:, :, 1])[ridx]
-    ex2 = jnp.asarray(E[:, :, 2])[ridx]
-    ey2 = jnp.asarray(E[:, :, 3])[ridx]
-    cx = jnp.asarray(px)[lidx][:, None]
-    cy = jnp.asarray(py)[lidx][:, None]
-    crosses = (ey1 > cy) != (ey2 > cy)
+    ex1 = jnp.asarray(E[:, :, 0])[gsel]
+    ey1 = jnp.asarray(E[:, :, 1])[gsel]
+    ex2 = jnp.asarray(E[:, :, 2])[gsel]
+    ey2 = jnp.asarray(E[:, :, 3])[gsel]
+    pcx = jnp.asarray(cx)[:, None]
+    pcy = jnp.asarray(cy)[:, None]
+    crosses = (ey1 > pcy) != (ey2 > pcy)
     denom = jnp.where(ey2 == ey1, 1e-300, ey2 - ey1)
-    xint = (ex2 - ex1) * (cy - ey1) / denom + ex1
-    parity = jnp.sum(crosses & (cx < xint), axis=1) % 2 == 1
-    hit = np.asarray(parity)
-    return lidx[hit], ridx[hit]
+    xint = (ex2 - ex1) * (pcy - ey1) / denom + ex1
+    parity = jnp.sum(crosses & (pcx < xint), axis=1) % 2 == 1
+    return np.asarray(parity)
 
 
 def grid_distance_join(px, py, bx, by, radius, strict=False):

@@ -42,7 +42,6 @@ import hmac
 import ipaddress
 import json
 import os
-import pickle
 import secrets as _pysecrets
 import threading
 import time
@@ -55,16 +54,18 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from presto_tpu import session_ctx as _sctx
+from presto_tpu.plan import serde as plan_serde
 from presto_tpu.native import serde as pserde
 
 
 # ---------------------------------------------------------------------------
 # control-plane authentication
 #
-# Task payloads are pickled plan fragments, i.e. executing a task is
-# executing code — so every worker endpoint requires a shared-secret HMAC
-# (reference ships JSON fragments + relies on network security; we must be
-# stricter because of pickle).  The secret is distributed via the
+# Task payloads are tagged-JSON plan fragments (plan/serde.py, the
+# reference's Jackson-encoded PlanFragment role) — the decoder builds
+# only whitelisted plan dataclasses, never arbitrary code.  Every worker
+# endpoint still requires a shared-secret HMAC (defense in depth +
+# admission control).  The secret is distributed via the
 # PRESTO_TPU_CLUSTER_SECRET env var (inherited by worker processes) or
 # set_cluster_secret().  Binding a non-loopback host without a secret is
 # refused outright.
@@ -141,8 +142,8 @@ def _is_loopback(host: str) -> bool:
 def pack_columns(cols: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]
                  ) -> bytes:
     """Columns with optional validity -> one PTPG frame.  Object (string /
-    container) columns are dictionary-packed: int32 codes + a pickled
-    value list (strings use a compact utf-8 blob)."""
+    container) columns are dictionary-packed: int32 codes + a tagged-
+    JSON value list (strings use a compact utf-8 blob)."""
     flat: Dict[str, np.ndarray] = {}
     for name, (data, valid) in cols.items():
         data = np.asarray(data)
@@ -160,13 +161,13 @@ def pack_columns(cols: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]
                 flat[name + "\x00sdict"] = np.frombuffer(
                     blob, dtype=np.uint8).copy() if blob else np.empty(
                     0, dtype=np.uint8)
-            else:  # tuples (ARRAY/MAP/ROW entries) or mixed: pickle
+            else:  # tuples (ARRAY/MAP/ROW entries) or mixed: tagged JSON
                 uniq = sorted(set(vals.tolist()), key=repr)
                 cmap = {v: i for i, v in enumerate(uniq)}
                 flat[name + "\x00pcodes"] = np.fromiter(
                     (cmap[v] for v in vals.tolist()), np.int32, len(vals))
                 flat[name + "\x00pdict"] = np.frombuffer(
-                    pickle.dumps(uniq, protocol=4), dtype=np.uint8).copy()
+                    plan_serde.dumps(uniq), dtype=np.uint8).copy()
         else:
             flat[name + "\x00data"] = data
         if valid is not None:
@@ -198,7 +199,7 @@ def unpack_columns(buf: bytes
         if isinstance(v, dict):
             codes = v["codes"]
             if "pblob" in v:
-                uniq_list = pickle.loads(v["pblob"].tobytes())
+                uniq_list = plan_serde.loads(v["pblob"].tobytes())
             else:
                 blob = v["sblob"].tobytes()
                 offs = v["offs"]
@@ -362,7 +363,7 @@ def cut_fragments(root) -> List[Fragment]:
 @dataclasses.dataclass
 class TaskSpec:
     task_id: str
-    fragment: bytes  # pickled plan root
+    fragment: bytes  # tagged-JSON plan root (plan/serde.py)
     out_symbols: List[str]
     nworkers: int
     windex: int  # this worker's index (coordinator: 0)
@@ -383,6 +384,9 @@ class TaskSpec:
     durable_key: Optional[str] = None  # f{fid}_w{windex}, attempt-stable
     attempt: int = 0
     replay: bool = False  # serve the durable pages; do not execute
+
+
+plan_serde.register_class(TaskSpec)
 
 
 def _http(url: str, data: Optional[bytes] = None, method: str = "GET",
@@ -665,7 +669,7 @@ class _ClusterExecutor:
         data, valid = cols[key_sym]
         live = np.ones(len(data), dtype=bool) if valid is None else valid
         sample_vals = data[live][:: max(1, int(np.sum(live)) // 256)][:256]
-        self.publish(nb, pickle.dumps(sample_vals, protocol=4))
+        self.publish(nb, plan_serde.dumps(sample_vals.tolist()))
         if not self.task_state.get("range_event", threading.Event()) \
                 .wait(timeout=300.0):
             raise TimeoutError("range boundaries never arrived")
@@ -687,7 +691,7 @@ class _ClusterExecutor:
             self.publish(b, pack_columns(sub))
 
     def run(self) -> None:
-        root = pickle.loads(self.spec.fragment)
+        root = plan_serde.loads(self.spec.fragment)
         exch = self._exchange_batches()
         scan_tables = self._scan_tables(root)
 
@@ -916,7 +920,15 @@ def _make_worker_handler(server: WorkerServer):
                 self._send(401, b"{}", "application/json")
                 return
             if self.path == "/v1/task":
-                spec = pickle.loads(body)
+                try:
+                    spec = plan_serde.loads(body)
+                    if not isinstance(spec, TaskSpec):
+                        raise ValueError("body is not a TaskSpec")
+                except (ValueError, TypeError, KeyError) as e:
+                    self._send(400, json.dumps(
+                        {"error": f"bad task payload: {e}"}).encode(),
+                        "application/json")
+                    return
                 server.submit(spec)
                 self._send(200, json.dumps(
                     {"taskId": spec.task_id}).encode(), "application/json")
@@ -929,7 +941,8 @@ def _make_worker_handler(server: WorkerServer):
                 if task is None:
                     self._send(404, b"{}")
                     return
-                task["range_boundaries"] = pickle.loads(body)
+                task["range_boundaries"] = np.asarray(
+                    plan_serde.loads(body))
                 task["range_event"].set()
                 self._send(200, b"{}", "application/json")
             elif self.path == "/v1/shutdown":
@@ -1254,7 +1267,7 @@ class ClusterSession:
                     consumer_of.get(frag.fid, -1), [None]))
             else:
                 out_buckets = 1
-            payload_root = pickle.dumps(frag.root, protocol=4)
+            payload_root = plan_serde.dumps(frag.root)
             tasks: List[Tuple[str, str]] = []
             for w, (url, tid) in enumerate(placements[frag.fid]):
                 dkey = f"f{frag.fid}_w{w}" if ddir is not None else None
@@ -1290,7 +1303,7 @@ class ClusterSession:
                 if url is None:  # final fragment: run on the coordinator
                     coordinator_spec = spec
                 else:
-                    _http(f"{url}/v1/task", pickle.dumps(spec, protocol=4),
+                    _http(f"{url}/v1/task", plan_serde.dumps(spec),
                           method="POST")
                     tasks.append((url, tid))
             if tasks:
@@ -1332,7 +1345,7 @@ class ClusterSession:
             # exactly one sample page per producer; the producer is
             # blocked awaiting boundaries, so never wait for "complete"
             for page in pull_pages(url, tid, out_buckets, max_pages=1):
-                vals = pickle.loads(page)
+                vals = plan_serde.loads(page)
                 if len(vals):
                     samples.append(np.asarray(vals))
         if samples:
@@ -1344,7 +1357,7 @@ class ClusterSession:
             boundaries = np.asarray(edges)
         else:
             boundaries = np.asarray([])
-        payload = pickle.dumps(boundaries, protocol=4)
+        payload = plan_serde.dumps(boundaries.tolist())
         for url, tid in tasks:
             _http(f"{url}/v1/task/{tid}/range", payload, method="POST")
 

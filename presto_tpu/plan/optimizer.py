@@ -633,13 +633,15 @@ def _match_spatial_conjunct(c, lsyms, rsyms):
         if p1 is None or p2 is None:
             return None
         r = float(c.args[1].value)
-        for probe, build, swap in ((p1, p2, False), (p2, p1, True)):
+        # either argument order: the PROBE is whichever point reads the
+        # left child's symbols, so the join sides never swap here
+        for probe, build in ((p1, p2), (p2, p1)):
             if probe[0] in lsyms and probe[1] in lsyms \
                     and build[0] in rsyms and build[1] in rsyms:
                 return {"kind": "distance", "probe_x": probe[0],
                         "probe_y": probe[1], "build_x": build[0],
                         "build_y": build[1], "radius": r,
-                        "strict": c.fn == "lt", "swap": swap}
+                        "strict": c.fn == "lt"}
     return None
 
 

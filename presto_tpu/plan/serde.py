@@ -1,0 +1,155 @@
+"""Plan-fragment and value serialization for the cluster wire.
+
+Reference: the reference ships plan fragments between coordinator and
+workers as JSON via Jackson (server/remotetask/HttpRemoteTask.java:591,
+PlanFragment's @JsonCreator constructors) — executing a task never
+involves deserializing arbitrary code.  This module gives the engine the
+same property: a tagged JSON encoding whose decoder instantiates ONLY
+whitelisted plan/IR dataclasses, replacing the pickled fragments the
+round-4 review flagged (pickle.loads of network bytes == remote code
+execution gated only by the HMAC secret).
+
+Encoding:
+  scalars      -> native JSON (int/float/str/bool/None)
+  bytes        -> {"$b": base64}
+  Decimal      -> {"$d": str}
+  tuple        -> {"$t": [...]}
+  set/frozenset-> {"$s"/"$fs": [...]}
+  dict         -> {"$m": [[k, v], ...]}  (keys keep their types)
+  nan/inf      -> {"$f": "nan"|"inf"|"-inf"}
+  dataclass    -> {"$n": "ClassName", "f": {attr: value, ...}}
+                  (the full __dict__, so optimizer annotations like
+                  scan_domains / index_lookup / key_stats survive)
+
+Decoding uses cls.__new__ + __dict__.update — no constructors run, no
+callables are ever encoded, unknown class names are an error.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import math
+from decimal import Decimal
+
+import numpy as np
+
+
+def _registry():
+    from presto_tpu import types as T
+    from presto_tpu.plan import ir
+    from presto_tpu.plan import nodes as P
+    from presto_tpu.plan import stats as S
+    from presto_tpu.storage.shard import Domain
+
+    classes = [T.Type, S.ColStats, Domain,
+               ir.Ref, ir.Lit, ir.Call, ir.CastExpr, ir.ScalarSub,
+               ir.LambdaExpr, ir.AggCall]
+    for name in dir(P):
+        obj = getattr(P, name)
+        if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+            classes.append(obj)
+    return {c.__name__: c for c in classes}
+
+
+_REGISTRY = None
+
+
+def _classes():
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = _registry()
+    return _REGISTRY
+
+
+def register_class(cls) -> None:
+    """Whitelist an additional dataclass (e.g. the cluster TaskSpec)."""
+    assert dataclasses.is_dataclass(cls)
+    _classes()[cls.__name__] = cls
+
+
+def encode(v):
+    if v is None or isinstance(v, (bool, str)):
+        return v
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        v = float(v)
+    if isinstance(v, int):
+        return v
+    if isinstance(v, float):
+        if math.isnan(v):
+            return {"$f": "nan"}
+        if math.isinf(v):
+            return {"$f": "inf" if v > 0 else "-inf"}
+        return v
+    if isinstance(v, (bytes, bytearray, np.void)):
+        return {"$b": base64.b64encode(bytes(v)).decode("ascii")}
+    if isinstance(v, Decimal):
+        return {"$d": str(v)}
+    if isinstance(v, tuple):
+        return {"$t": [encode(x) for x in v]}
+    if isinstance(v, list):
+        return [encode(x) for x in v]
+    if isinstance(v, frozenset):
+        return {"$fs": [encode(x) for x in sorted(v, key=repr)]}
+    if isinstance(v, set):
+        return {"$s": [encode(x) for x in sorted(v, key=repr)]}
+    if isinstance(v, dict):
+        return {"$m": [[encode(k), encode(x)] for k, x in v.items()]}
+    if isinstance(v, np.ndarray):  # e.g. Values rows ingested from numpy
+        return {"$t": [encode(x) for x in v.tolist()]}
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        name = type(v).__name__
+        if name not in _classes():
+            raise TypeError(f"cannot serialize plan object {name}")
+        return {"$n": name,
+                "f": {k: encode(x) for k, x in vars(v).items()}}
+    if isinstance(v, np.generic):
+        return encode(v.item())
+    raise TypeError(f"cannot serialize {type(v).__name__} on the wire")
+
+
+def decode(j):
+    if j is None or isinstance(j, (bool, int, float, str)):
+        return j
+    if isinstance(j, list):
+        return [decode(x) for x in j]
+    if isinstance(j, dict):
+        if "$f" in j:
+            return {"nan": math.nan, "inf": math.inf,
+                    "-inf": -math.inf}[j["$f"]]
+        if "$b" in j:
+            return base64.b64decode(j["$b"])
+        if "$d" in j:
+            return Decimal(j["$d"])
+        if "$t" in j:
+            return tuple(decode(x) for x in j["$t"])
+        if "$s" in j:
+            return set(decode(x) for x in j["$s"])
+        if "$fs" in j:
+            return frozenset(decode(x) for x in j["$fs"])
+        if "$m" in j:
+            return {decode(k): decode(x) for k, x in j["$m"]}
+        if "$n" in j:
+            cls = _classes().get(j["$n"])
+            if cls is None:
+                raise ValueError(f"unknown plan class {j['$n']!r}")
+            fields = j.get("f")
+            if not isinstance(fields, dict):  # hostile/malformed body
+                raise ValueError(f"bad fields for {j['$n']!r}")
+            obj = cls.__new__(cls)
+            obj.__dict__.update(
+                {k: decode(x) for k, x in fields.items()})
+            return obj
+    raise ValueError(f"bad wire value {type(j).__name__}")
+
+
+def dumps(obj) -> bytes:
+    return json.dumps(encode(obj), separators=(",", ":"),
+                      allow_nan=False).encode("utf-8")
+
+
+def loads(buf: bytes):
+    return decode(json.loads(buf.decode("utf-8")))

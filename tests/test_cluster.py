@@ -121,19 +121,25 @@ def test_cluster_worker_failure_reported(cluster):
     # coordinator-side planning error
     with pytest.raises(Exception):
         cs.sql("SELECT nonexistent_col FROM lineitem")
-    # genuine WORKER-side failure: a task whose fragment can't unpickle /
+    # genuine WORKER-side failure: a task whose fragment can't decode /
     # execute must surface as FAILED -> RuntimeError at the coordinator
-    import pickle
-
     import presto_tpu.parallel.cluster as CM
+    from presto_tpu.plan import serde as plan_serde
 
     spec = CM.TaskSpec(
-        task_id="t_bad_fragment", fragment=pickle.dumps("not a plan"),
+        task_id="t_bad_fragment", fragment=plan_serde.dumps("not a plan"),
         out_symbols=[], nworkers=1, windex=0, inputs=[])
     url = cs.workers[0]
-    CM._http(f"{url}/v1/task", pickle.dumps(spec), method="POST")
+    CM._http(f"{url}/v1/task", plan_serde.dumps(spec), method="POST")
     with pytest.raises(RuntimeError, match="failed"):
         cs._wait([(url, "t_bad_fragment")], timeout=30.0)
+    # a NON-whitelisted payload is rejected up front (400), never run —
+    # the property replacing pickle was about (round-4 weakness 7)
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError):
+        CM._http(f"{url}/v1/task",
+                 b'{"$n": "QueryMonitor", "f": {}}', method="POST")
     # buffers are cleaned up after successful queries (DELETE issued)
     cs.sql("SELECT count(*) FROM nation")
     import json as _json
