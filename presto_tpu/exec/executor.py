@@ -749,6 +749,21 @@ class Executor:
 
     # ------------------------------------------------------------------
     def exec_node(self, node: P.PlanNode) -> Batch:
+        if getattr(node, "shared_subtree", False):
+            # plan DAGs (transitive semi-join inference shares the
+            # filter subquery between both join sides): run once
+            cache = getattr(self, "_shared_results", None)
+            if cache is None:
+                cache = self._shared_results = {}
+            hit = cache.get(id(node))
+            if hit is not None and hit[0] is node:
+                return hit[1]
+            b = self._exec_node_inner(node)
+            cache[id(node)] = (node, b)
+            return b
+        return self._exec_node_inner(node)
+
+    def _exec_node_inner(self, node: P.PlanNode) -> Batch:
         method = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
@@ -1116,6 +1131,20 @@ class Executor:
         if cap is None:
             cap = b.capacity
         cap = min(cap, b.capacity) or 1
+        # Guarded pre-aggregation compaction: after selective joins the
+        # live set is often orders of magnitude below the mask-not-
+        # compact capacity, and every grouping pass (sorts, segment
+        # reductions, representative gathers) scales with CAPACITY.
+        # Compact to an estimate-derived power-of-two bound (top_k path,
+        # ~10ms) under a guard that aborts to dynamic if the estimate
+        # lied.  Q3-class join->group queries drop ~3x wall-clock.
+        est = getattr(node, "input_est_hint", None) if node is not None \
+            else None
+        b2 = self._maybe_compact_static(b, est)
+        if b2 is not b:
+            b = b2
+            key_cols = [b.columns[k] for k in group_keys]
+            cap = min(cap, b.capacity)
         key_stats = getattr(node, "key_stats", {}) if node is not None else {}
         layout = K.static_layout(key_cols, [key_stats.get(k) for k in group_keys])
         key = K.pack_with_layout(key_cols, b.sel, layout)  # None -> hash, sync-free
@@ -1867,11 +1896,30 @@ class Executor:
             out = Batch(merged, eval_predicate(node.filter, out, self.ctx))
         return out
 
+    def _maybe_compact_static(self, b: Batch, est) -> Batch:
+        """Guarded estimate-driven compaction (see _aggregate_static):
+        dropping masked rows is always semantically safe; the guard
+        covers the estimate being wrong."""
+        if not self.static or est is None or b.capacity < (1 << 19):
+            return b
+        bound = 1 << max(int(np.ceil(np.log2(max(est, 1) * 2))), 14)
+        if bound > min(b.capacity // 4, 1 << 20):
+            return b
+        self.guards.append(jnp.sum(b.sel.astype(jnp.int32)) > bound)
+        return _compact_batch(b, bound)
+
     def _exec_join(self, node: P.Join) -> Batch:
         from presto_tpu.memory.context import batch_bytes
 
         left = self.exec_node(node.left)
         right = self.exec_node(node.right)
+        left = self._maybe_compact_static(
+            left, getattr(node, "left_est_hint", None))
+        if getattr(node, "index_lookup", None) is None:
+            # index joins need the build side's whole-table natural
+            # order — never compact it
+            right = self._maybe_compact_static(
+                right, getattr(node, "right_est_hint", None))
         if node.join_type == "RIGHT":
             # RIGHT = mirrored LEFT with output order left-cols-first
             node = P.Join(node.right, node.left, "LEFT",
@@ -2245,8 +2293,22 @@ class Executor:
         return K.gather_batch(b, perm)
 
     def _exec_topn(self, node: P.TopN) -> Batch:
-        b = self._exec_sort(P.Sort(node.source, node.keys))
-        return self._limit(b, node.count)
+        """TopN = key-only sort + k-row gather (reference: TopNOperator's
+        bounded heap).  The previous full-sort-then-mask shape paid a
+        full-capacity gather of EVERY output column to keep k rows —
+        ~half of Q3's single-chip wall time at 6M capacity."""
+        b = self.exec_node(node.source)
+        k = min(int(node.count), b.capacity)
+        keys = [(b.columns[s], asc, nf) for s, asc, nf in node.keys]
+        perm = K.sort_perm(b, keys)  # masked rows sort last
+        if k == b.capacity:  # LIMIT >= capacity: plain sort
+            return K.gather_batch(b, perm)
+        idx = perm[:k]
+        out = K.gather_batch(b, idx)
+        live_total = jnp.sum(jnp.asarray(b.sel).astype(jnp.int32)) \
+            if b.capacity else jnp.int32(0)
+        sel = jnp.arange(k, dtype=jnp.int32) < live_total
+        return Batch(out.columns, out.sel & sel)
 
     def _exec_limit(self, node: P.Limit) -> Batch:
         return self._limit(self.exec_node(node.source), node.count)

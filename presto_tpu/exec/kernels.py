@@ -832,16 +832,21 @@ def sort_perm(batch: Batch, keys: List[Tuple[Column, bool, Optional[bool]]]):
     # full-size gather per key (~43ms per 6M rows each, measured).
     operands = [(~jnp.asarray(batch.sel)).astype(jnp.int32)]
     for col, asc, nulls_first in keys:
-        valid = _valid_arr(col)
+        valid = col.valid if col.valid is not None else \
+            jnp.ones(col.data.shape[0], bool)  # 1-D even for limb pairs
         nf = (not asc) if nulls_first is None else nulls_first
-        null_sent = I64_MIN if nf else I64_MAX - 1
+        # a dedicated null-flag operand per key instead of in-band
+        # sentinels: sentinel values can collide with real data at the
+        # dtype extremes (int32 MIN under DESC negation), and extra
+        # lexicographic operands are nearly free on TPU
+        if col.valid is not None:
+            operands.append(jnp.where(valid, jnp.int32(0 if not nf else 1),
+                                      jnp.int32(1 if not nf else 0)))
         if getattr(col.data, "ndim", 1) == 2:
             # long decimal (Int128 limbs): two lexicographic operands
             # (reference: Int128ArrayBlock comparison is hi-then-lo)
             from presto_tpu.exec import dec128 as D128
 
-            v1 = col.valid if col.valid is not None \
-                else jnp.ones(col.data.shape[0], bool)
             for d in D128.sort_operands(jnp.asarray(col.data)):
                 if not asc:
                     # bitwise NOT is an exact order-reversing bijection
@@ -849,15 +854,39 @@ def sort_perm(batch: Batch, keys: List[Tuple[Column, bool, Optional[bool]]]):
                     # I64_MIN+1 to I64_MAX: low-limb ties would
                     # misorder DESC)
                     d = ~d
-                operands.append(jnp.where(v1, d, null_sent))
+                operands.append(jnp.where(valid, d, 0))
             continue
-        d = _orderable_int(col)
+        d = _sort_operand_native(col)
         if not asc:
-            d = -d
-        operands.append(jnp.where(valid, d, null_sent))
+            d = ~d  # order-reversing bijection; negation wraps the min
+        operands.append(jnp.where(valid, d, jnp.zeros((), d.dtype)))
     operands.append(jnp.arange(n, dtype=jnp.int32))
     out = jax.lax.sort(tuple(operands), num_keys=len(operands))
     return out[-1]
+
+
+def _sort_operand_native(col: Column) -> jnp.ndarray:
+    """Orderable integer in the NARROWEST dtype that preserves order:
+    int32 stays int32 and float32 maps onto int32 with ONE bitcast —
+    i64 sort operands run u32-pair emulated on TPU (~1.5x), so keeping
+    Q3-class sort keys (f32 revenue, i32 dates) in i32 roughly halves
+    the multi-operand sort cost."""
+    d = col.data
+    if d.dtype == jnp.bool_:
+        return d.astype(jnp.int32)
+    if d.dtype == jnp.float32 and jax.default_backend() == "tpu":
+        b = jax.lax.bitcast_convert_type(d, jnp.int32)
+        key = jnp.where(b < 0, (~b) + jnp.int32(-(1 << 31)), b)
+        key = jnp.where(d == 0, 0, key)  # +-0 compare equal in SQL
+        # NaN sorts largest (Presto order) REGARDLESS of its sign bit —
+        # a negative-bit NaN (0xFFC.., preserved verbatim from file
+        # data) would otherwise land below -inf
+        return jnp.where(jnp.isnan(d), jnp.int32((1 << 31) - 8), key)
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        return _orderable_int(col)
+    if d.dtype in (jnp.int32, jnp.int16, jnp.int8):
+        return d.astype(jnp.int32)
+    return d.astype(jnp.int64)
 
 
 # ---------------------------------------------------------------------------

@@ -50,9 +50,16 @@ def annotate_static_hints(plan: P.QueryPlan, session) -> None:
                 src = S.derive(node.source, catalog, memo)
                 node.capacity_hint = S.capacity_for_groups(node, src)
                 node.key_stats = {k: src.cols.get(k) for k in node.group_keys}
+                # selectivity ESTIMATE of the input (not the sound upper
+                # bound): drives the guarded pre-aggregation compaction
+                # in the static executor
+                node.input_est_hint = int(src.est_rows)
             elif isinstance(node, P.Join) and node.join_type not in ("CROSS",):
                 ls = S.derive(node.left, catalog, memo)
                 rs = S.derive(node.right, catalog, memo)
+                # estimate hints for guarded join-input compaction
+                node.left_est_hint = int(ls.est_rows)
+                node.right_est_hint = int(rs.est_rows)
                 rkeys = frozenset(rk for _, rk in node.criteria)
                 node.build_unique = any(u <= rkeys for u in rs.unique)
                 best = S._best_fanout_key(rs, rkeys)
@@ -154,6 +161,9 @@ def _optimize_node(node: P.PlanNode, session) -> P.PlanNode:
     # unreferenced in the scan — dropping it is the whole point (the
     # column never materializes)
     node = prune_columns(node, set(n for n, _ in node.outputs()))
+    # AFTER pruning: the inferred semi join shares its subquery subtree
+    # with the original (a DAG prune_columns would split back into two)
+    node = infer_transitive_semijoins(node)
     return node
 
 
@@ -681,4 +691,49 @@ def _extract_spatial_joins(node: P.PlanNode) -> P.PlanNode:
                            filter=ir.combine_conjuncts(rest)
                            if rest else None, **m)
         return sj
+    return node
+
+
+# ---------------------------------------------------------------------------
+# transitive semi-join inference (reference: PredicatePushDown's
+# equality inference deriving `l.k IN S` from `l.k = r.k AND r.k IN S`;
+# also the static analog of dynamic filtering)
+# ---------------------------------------------------------------------------
+
+
+def infer_transitive_semijoins(node: P.PlanNode) -> P.PlanNode:
+    """INNER join whose build side is SEMI-filtered on the join key gets
+    the same SEMI filter on the probe side, sharing the filter subquery
+    SUBTREE (the executor memoizes shared nodes, so it runs once).  On
+    the mask-not-compact executor this is the difference between probing
+    6M rows and probing the handful the subquery admits (TPC-H Q18)."""
+    for attr in ("source", "left", "right"):
+        if hasattr(node, attr):
+            setattr(node, attr, infer_transitive_semijoins(
+                getattr(node, attr)))
+    if isinstance(node, P.Union):
+        node.sources_ = [infer_transitive_semijoins(s)
+                         for s in node.sources_]
+    if not (isinstance(node, P.Join) and node.join_type == "SEMI"
+            and len(node.criteria) == 1 and node.filter is None
+            and isinstance(node.left, P.Join)
+            and node.left.join_type == "INNER" and node.left.criteria):
+        return node
+    k, sk = node.criteria[0]
+    j = node.left
+    for lk, rk in j.criteria:
+        if k not in (lk, rk):
+            continue
+        sub = node.right  # SHARED subtree, not a copy
+        setattr(sub, "shared_subtree", True)
+        # recurse: each pushed SEMI may sit over another inner join in a
+        # chain, so the filter keeps descending toward the scans
+        lsemi = infer_transitive_semijoins(
+            P.Join(j.left, sub, "SEMI", [(lk, sk)], None))
+        rsemi = infer_transitive_semijoins(
+            P.Join(j.right, sub, "SEMI", [(rk, sk)], None))
+        # both inner-join inputs filter on the (equal) key, so the top
+        # SEMI is subsumed and the expensive sides compact early
+        return P.Join(lsemi, rsemi, "INNER", j.criteria, j.filter,
+                      j.distribution, j.mark)
     return node

@@ -91,6 +91,20 @@ def _derive(node, catalog, memo) -> NodeStats:
     if isinstance(node, P.Filter):
         s = d(node.source)
         sel, cols = filter_selectivity(s, node.predicate)
+        src = node.source
+        while isinstance(src, P.Project):
+            src = src.source
+        if isinstance(src, P.Aggregate) and src.group_keys \
+                and _refs_agg_output(node.predicate, src):
+            # HAVING-style comparison against an aggregate output:
+            # range selectivity is unknowable from column stats, and
+            # such filters are characteristically sharp (Q18's
+            # sum(l_quantity) > 300 keeps ~0.4% of groups).  The
+            # reference uses an unknown-filter coefficient here too
+            # (FilterStatsCalculator.UNKNOWN_FILTER_COEFFICIENT);
+            # downstream consumers of est guard against underestimates
+            # (pre-aggregation compaction aborts to dynamic).
+            sel = min(sel, 0.05)
         est = max(1.0, s.est_rows * sel)
         return NodeStats(s.rows, cols, s.unique, s.fanout, est)
     if isinstance(node, P.Project):
@@ -125,7 +139,24 @@ def _derive(node, catalog, memo) -> NodeStats:
     if isinstance(node, P.Join):
         ls, rs = d(node.left), d(node.right)
         if node.join_type in ("SEMI", "ANTI"):
-            est = ls.est_rows * (0.5 if node.join_type == "SEMI" else 0.5)
+            # matching fraction ~= |distinct build keys| / ndv(probe key)
+            # (containment assumption, reference SemiJoinStatsCalculator);
+            # 0.5 when ndv is unknown
+            frac = 0.5
+            if node.criteria:
+                lk, rk = node.criteria[0]
+                lcs = ls.cols.get(lk)
+                rcs = rs.cols.get(rk)
+                # DISTINCT build keys, not build rows (duplicates do not
+                # admit more probe rows)
+                build_keys = rs.est_rows
+                if rcs and rcs.ndv:
+                    build_keys = min(build_keys, float(rcs.ndv))
+                if lcs and lcs.ndv:
+                    frac = min(1.0, build_keys / max(float(lcs.ndv), 1.0))
+            if node.join_type == "ANTI":
+                frac = 1.0 - frac
+            est = max(ls.est_rows * frac, 1.0)
             return NodeStats(ls.rows, ls.cols, ls.unique, ls.fanout, est)
         if node.join_type == "MARK":
             # every left row survives, one extra boolean column
@@ -206,6 +237,12 @@ def _lit_value(e) -> Optional[float]:
     if isinstance(e, ir.Lit) and isinstance(e.value, (int, float, bool)):
         return float(e.value)
     return None
+
+
+def _refs_agg_output(pred, agg) -> bool:
+    """Does the predicate reference any AGGREGATE symbol (vs group key)?"""
+    agg_syms = set(agg.aggs)
+    return bool(pred.refs() & agg_syms)
 
 
 def filter_selectivity(src: NodeStats, pred: ir.RowExpr
