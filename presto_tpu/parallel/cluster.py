@@ -757,7 +757,8 @@ class WorkerServer:
         # counts fragment executions, `replayed` counts durable-page
         # replays — the per-bucket-retry test's evidence that survivors
         # re-execute ONLY the victim's work
-        self.counters = {"executed": 0, "replayed": 0}
+        self.counters = {"executed": 0, "replayed": 0,
+                         "buffered_bytes": 0, "peak_buffered_bytes": 0}
         self.lock = threading.Lock()
         self.exec_lock = threading.Lock()
         handler = _make_worker_handler(self)
@@ -802,6 +803,10 @@ class WorkerServer:
             with self.lock:
                 task["pages"].setdefault(bucket, []).append(page)
                 seq = len(task["pages"][bucket]) - 1
+                self.counters["buffered_bytes"] += len(page)
+                self.counters["peak_buffered_bytes"] = max(
+                    self.counters["peak_buffered_bytes"],
+                    self.counters["buffered_bytes"])
             if attempt_dir is not None:
                 # durable copy survives acks and task DELETE; tmp+rename
                 # so a torn write never reads as a page
@@ -838,6 +843,12 @@ class WorkerServer:
                                 with self.lock:
                                     task["pages"].setdefault(
                                         int(b[1:]), []).append(page)
+                                    self.counters["buffered_bytes"] += \
+                                        len(page)
+                                    self.counters["peak_buffered_bytes"] = \
+                                        max(self.counters[
+                                            "peak_buffered_bytes"],
+                                            self.counters["buffered_bytes"])
                     with self.lock:
                         task["complete"] = True
                         task["state"] = "FINISHED"
@@ -956,8 +967,11 @@ def _make_worker_handler(server: WorkerServer):
                 self._send(401, b"{}", "application/json")
                 return
             parts = self.path.strip("/").split("/")
-            if self.path == "/v1/info":
+            if self.path.startswith("/v1/info"):
                 with server.lock:
+                    if "reset_peak" in self.path:
+                        server.counters["peak_buffered_bytes"] = \
+                            max(server.counters["buffered_bytes"], 0)
                     counters = dict(server.counters)
                 self._send(200, json.dumps(
                     {"nodeId": f"worker:{server.port}",
@@ -985,6 +999,9 @@ def _make_worker_handler(server: WorkerServer):
                         with server.lock:
                             pages = task["pages"].get(bucket, [])
                             for i in range(min(token, len(pages))):
+                                if pages[i] is not None:
+                                    server.counters["buffered_bytes"] -= \
+                                        len(pages[i])
                                 pages[i] = None  # release acked pages
                         self._send(200, b"{}", "application/json")
                         return
@@ -1039,7 +1056,11 @@ def _make_worker_handler(server: WorkerServer):
             parts = self.path.strip("/").split("/")
             if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                 with server.lock:
-                    server.tasks.pop(parts[2], None)
+                    gone = server.tasks.pop(parts[2], None)
+                    if gone:
+                        server.counters["buffered_bytes"] -= sum(
+                            len(p) for ps in gone["pages"].values()
+                            for p in ps if p is not None)
                 self._send(200, b"{}", "application/json")
             else:
                 self._send(404, b"{}")
@@ -1237,11 +1258,14 @@ class ClusterSession:
     def _run_fragments(self, fragments, scalar_results, run_on_of,
                        consumer_of, placements, all_tasks, ddir=None,
                        attempt=0):
-        """All-at-once scheduling (reference: AllAtOnceExecutionPolicy):
-        every fragment's tasks are submitted up front with pre-assigned
-        upstream locations; workers stream pages between themselves while
-        the coordinator runs the final fragment, which blocks inside its
-        own page pulls until the pipeline drains."""
+        """Fragment scheduling.  Default: all-at-once with streaming
+        pages (reference: AllAtOnceExecutionPolicy) — every task is
+        submitted up front and workers stream pages between themselves.
+        With the `phased_execution` session property (reference:
+        PhasedExecutionSchedule): fragments are grouped into phases so
+        that a join's BUILD-side producers complete before its
+        PROBE-side producers start, bounding worker memory — probe
+        pages never pile up behind an unfinished build."""
         nfr = len(fragments)
         # pre-assign every placement so consumers know their upstreams
         # at submission time (streaming needs no producer-finished
@@ -1251,65 +1275,87 @@ class ClusterSession:
             placements[frag.fid] = [
                 (url, f"t_{uuid.uuid4().hex[:12]}") for url in run_on]
         coordinator_spec = None
-        for frag in fragments:
-            out_symbols = [s for s, _ in frag.root.outputs()]
-            inputs = []
-            for inp in frag.inputs:
-                prod = fragments[inp.producer]
-                inputs.append({
-                    "eid": inp.eid, "kind": inp.kind,
-                    "types": dict(prod.root.outputs()),
-                    "upstreams": placements[inp.producer],
-                })
-            run_on = run_on_of[frag.fid]
-            if frag.out_kind in ("repartition", "scatter", "range"):
-                out_buckets = len(run_on_of.get(
-                    consumer_of.get(frag.fid, -1), [None]))
-            else:
-                out_buckets = 1
-            payload_root = plan_serde.dumps(frag.root)
-            tasks: List[Tuple[str, str]] = []
-            for w, (url, tid) in enumerate(placements[frag.fid]):
-                dkey = f"f{frag.fid}_w{w}" if ddir is not None else None
-                # a completed durable output from a prior attempt means
-                # this slot REPLAYS from disk — only the victim's lost
-                # work re-executes (per-bucket retry, P12)
-                replay = False
-                if dkey is not None and attempt > 0:
-                    kd = os.path.join(ddir, dkey)
-                    if os.path.isdir(kd):
-                        replay = any(
-                            os.path.exists(os.path.join(kd, a, "_DONE"))
-                            for a in os.listdir(kd))
-                spec = TaskSpec(
-                    task_id=tid,
-                    fragment=payload_root,
-                    out_symbols=out_symbols,
-                    nworkers=len(run_on), windex=w, inputs=inputs,
-                    out_kind=frag.out_kind, out_keys=frag.out_keys,
-                    out_buckets=out_buckets,
-                    scalar_results=scalar_results,
-                    properties={
-                        "float32_compute": self.session.properties.get(
-                            "float32_compute", False),
-                        "time_zone": self.session.properties.get(
-                            "time_zone", "UTC"),
-                        # now()/current_date must be query-stable across
-                        # the mesh (session_ctx contract)
-                        "query_start_us": _sctx.query_start_us()},
-                    durable_dir=ddir, durable_key=dkey,
-                    attempt=attempt, replay=replay,
-                )
-                if url is None:  # final fragment: run on the coordinator
-                    coordinator_spec = spec
+        phased = bool(self.session.properties.get(
+            "phased_execution", False))
+        phases = _fragment_phases(fragments) if phased else \
+            {f.fid: 0 for f in fragments}
+        self.schedule_trace = []  # [(fid, phase, submit_time)]
+        prev_wave_tasks: List[Tuple[str, str]] = []
+        for phase in sorted(set(phases.values())):
+            if phased and prev_wave_tasks:
+                # barrier: earlier phases (build sides) finish first
+                self._wait(prev_wave_tasks)
+                states = []
+                for url, tid in prev_wave_tasks:
+                    st = json.loads(_http(f"{url}/v1/task/{tid}/status"))
+                    states.append(st.get("state"))
+                self.schedule_trace.append(
+                    ("barrier", phase, tuple(states)))
+            prev_wave_tasks = []
+            for frag in fragments:
+                if phases[frag.fid] != phase:
+                    continue
+                out_symbols = [s for s, _ in frag.root.outputs()]
+                inputs = []
+                for inp in frag.inputs:
+                    prod = fragments[inp.producer]
+                    inputs.append({
+                        "eid": inp.eid, "kind": inp.kind,
+                        "types": dict(prod.root.outputs()),
+                        "upstreams": placements[inp.producer],
+                    })
+                run_on = run_on_of[frag.fid]
+                if frag.out_kind in ("repartition", "scatter", "range"):
+                    out_buckets = len(run_on_of.get(
+                        consumer_of.get(frag.fid, -1), [None]))
                 else:
-                    _http(f"{url}/v1/task", plan_serde.dumps(spec),
-                          method="POST")
-                    tasks.append((url, tid))
-            if tasks:
-                all_tasks.extend(tasks)
-            if frag.out_kind == "range" and tasks:
-                self._coordinate_range(frag, tasks, out_buckets)
+                    out_buckets = 1
+                payload_root = plan_serde.dumps(frag.root)
+                tasks: List[Tuple[str, str]] = []
+                for w, (url, tid) in enumerate(placements[frag.fid]):
+                    dkey = f"f{frag.fid}_w{w}" if ddir is not None else None
+                    # a completed durable output from a prior attempt means
+                    # this slot REPLAYS from disk — only the victim's lost
+                    # work re-executes (per-bucket retry, P12)
+                    replay = False
+                    if dkey is not None and attempt > 0:
+                        kd = os.path.join(ddir, dkey)
+                        if os.path.isdir(kd):
+                            replay = any(
+                                os.path.exists(os.path.join(kd, a, "_DONE"))
+                                for a in os.listdir(kd))
+                    spec = TaskSpec(
+                        task_id=tid,
+                        fragment=payload_root,
+                        out_symbols=out_symbols,
+                        nworkers=len(run_on), windex=w, inputs=inputs,
+                        out_kind=frag.out_kind, out_keys=frag.out_keys,
+                        out_buckets=out_buckets,
+                        scalar_results=scalar_results,
+                        properties={
+                            "float32_compute": self.session.properties.get(
+                                "float32_compute", False),
+                            "time_zone": self.session.properties.get(
+                                "time_zone", "UTC"),
+                            # now()/current_date must be query-stable across
+                            # the mesh (session_ctx contract)
+                            "query_start_us": _sctx.query_start_us()},
+                        durable_dir=ddir, durable_key=dkey,
+                        attempt=attempt, replay=replay,
+                    )
+                    if url is None:  # final fragment: run on the coordinator
+                        coordinator_spec = spec
+                    else:
+                        _http(f"{url}/v1/task", plan_serde.dumps(spec),
+                              method="POST")
+                        tasks.append((url, tid))
+                self.schedule_trace.append(
+                    (frag.fid, phases[frag.fid], time.time()))
+                if tasks:
+                    all_tasks.extend(tasks)
+                    prev_wave_tasks.extend(tasks)
+                if frag.out_kind == "range" and tasks:
+                    self._coordinate_range(frag, tasks, out_buckets)
         # the final fragment executes here, pulling pages (and thereby
         # blocking) until upstream production drains
         pages: Dict[int, List[bytes]] = {}
@@ -1362,9 +1408,9 @@ class ClusterSession:
             _http(f"{url}/v1/task/{tid}/range", payload, method="POST")
 
     def _wait(self, tasks: List[Tuple[str, str]], timeout: float = 600.0):
-        """Status-poll specific tasks to completion.  The streaming
-        scheduler no longer needs a barrier; kept for direct task-status
-        waits (tests, ad-hoc operations)."""
+        """Status-poll specific tasks to completion.  THE load-bearing
+        phase barrier for phased_execution (_run_fragments waits here
+        between waves); also used for range coordination and tests."""
         deadline = time.time() + timeout
         for url, tid in tasks:
             while True:
@@ -1473,3 +1519,65 @@ def main(argv=None):
 
 if __name__ == "__main__":
     main()
+
+
+def _classify_exchange_inputs(root):
+    """Walk a fragment plan: exchange-scan eids under any join's BUILD
+    (right) subtree vs elsewhere (probe/pass-through)."""
+    build: set = set()
+    probe: set = set()
+
+    def walk(node, under_build):
+        from presto_tpu.plan import nodes as P
+
+        if isinstance(node, P.TableScan) and \
+                node.table.startswith("__exch_"):
+            eid = int(node.table[len("__exch_"):])
+            (build if under_build else probe).add(eid)
+            return
+        if isinstance(node, P.Join):
+            walk(node.left, under_build)
+            walk(node.right, True)
+            return
+        for s in getattr(node, "sources", []):
+            walk(s, under_build)
+
+    walk(root, False)
+    return build, probe - build
+
+
+def _fragment_phases(fragments) -> Dict[int, int]:
+    """Phase numbers per fragment id (reference:
+    PhasedExecutionSchedule.extractPhases): for every consumer, the
+    producers feeding a join's build side get a STRICTLY earlier phase
+    than those feeding its probe side; a consumer starts no earlier
+    than its latest producer."""
+    phase = {f.fid: 0 for f in fragments}
+    strict = []  # (must-finish-first fid, later fid)
+    for frag in fragments:
+        build_eids, probe_eids = _classify_exchange_inputs(frag.root)
+        prod = {inp.eid: inp.producer for inp in frag.inputs}
+        for be in build_eids:
+            if be not in prod:
+                continue
+            # build producers strictly precede probe-side producers...
+            for pe in probe_eids:
+                if pe in prod and prod[be] != prod[pe]:
+                    strict.append((prod[be], prod[pe]))
+            # ...and the consuming fragment itself when its probe side
+            # is a local scan (the consumer IS the probe stage)
+            strict.append((prod[be], frag.fid))
+    for _ in range(len(fragments) + 1):
+        changed = False
+        for a, b in strict:
+            if phase[b] < phase[a] + 1:
+                phase[b] = phase[a] + 1
+                changed = True
+        for frag in fragments:
+            for inp in frag.inputs:
+                if phase[frag.fid] < phase[inp.producer]:
+                    phase[frag.fid] = phase[inp.producer]
+                    changed = True
+        if not changed:
+            break
+    return phase

@@ -55,6 +55,11 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # session time zone for the WITH TIME ZONE surface (reference:
     # Session.getTimeZoneKey / SystemSessionProperties)
     "time_zone": "UTC",
+    # cluster scheduling policy (reference: PhasedExecutionSchedule vs
+    # AllAtOnceExecutionPolicy, execution-policy session property):
+    # phased gates probe-side stage startup on build-side completion,
+    # bounding worker buffer memory on deep join DAGs
+    "phased_execution": False,
     "iterative_optimizer_enabled": True,
     "reorder_joins": True,  # Selinger-DP ReorderJoins in the Memo
     "max_reorder_joins": 8,  # Memo/Rule fixpoint pass

@@ -344,3 +344,54 @@ def test_durable_retry_after_worker_death(tpch_catalog_tiny):
         assert len(cs.workers) == 2
     finally:
         cs.close()
+
+
+def test_phased_execution_build_before_probe(cluster):
+    """PhasedExecutionSchedule analog: with phased_execution on, a
+    join's build-side producer stages complete before its probe-side
+    producers are submitted, results stay identical, and worker buffer
+    peaks never exceed the all-at-once run (reference:
+    execution/scheduler/PhasedExecutionSchedule.java)."""
+    session, cs = cluster
+    q = ("SELECT c.c_mktsegment, count(*) c FROM customer c, orders o, "
+         "lineitem l WHERE c.c_custkey = o.o_custkey "
+         "AND o.o_orderkey = l.l_orderkey "
+         "GROUP BY c.c_mktsegment ORDER BY 1")
+    want = norm(session.sql(q).rows)
+
+    import json
+
+    def reset_and_peak(reset=False):
+        total = 0
+        for url in cs.workers:
+            path = "/v1/info?reset_peak=1" if reset else "/v1/info"
+            info = json.loads(C._http(f"{url}{path}"))
+            total = max(total, info["counters"]["peak_buffered_bytes"])
+        return total
+
+    reset_and_peak(reset=True)
+    assert norm(cs.sql(q).rows) == want  # all-at-once baseline
+    allatonce_peak = reset_and_peak()
+
+    session.set("phased_execution", True)
+    try:
+        reset_and_peak(reset=True)
+        got = cs.sql(q)
+        assert norm(got.rows) == want
+        phased_peak = reset_and_peak()
+        # the policy's whole point: probe pages never pile up behind an
+        # unfinished build, so buffering never exceeds all-at-once
+        assert phased_peak <= allatonce_peak, (phased_peak,
+                                               allatonce_peak)
+        trace = getattr(cs, "schedule_trace", [])
+        phases = sorted({p for e in trace
+                         if e[0] != "barrier" for p in [e[1]]})
+        assert len(phases) >= 2, f"no phasing happened: {trace}"
+        # each barrier recorded the PREVIOUS wave's states at the next
+        # wave's submission: all FINISHED == build ran before probe
+        barriers = [e for e in trace if e[0] == "barrier"]
+        assert barriers, trace
+        for _tag, _phase, states in barriers:
+            assert states and all(s == "FINISHED" for s in states), trace
+    finally:
+        session.set("phased_execution", False)
