@@ -137,7 +137,7 @@ def _plan_streaming(session, scans) -> Dict[str, object]:
     return streamed
 
 
-def run_chunked(session, stmt, text: str, plan=None):
+def run_chunked(session, stmt, text: str):
     """Plan + execute a chunked query; returns a QueryResult.  The
     prepared execution (distributed plan, fragments, jitted per-chunk
     programs) memoizes per session so warm runs skip planning AND
@@ -158,8 +158,16 @@ def run_chunked(session, stmt, text: str, plan=None):
     if prepared is not None:
         return _execute_prepared(session, *prepared)
 
-    if plan is None:
+    # ALWAYS re-plan (the executor's probe plan used inference ON):
+    # chunked mode needs transitive semi-join inference OFF (see
+    # plan/optimizer._optimize_node — the inferred probe-side semi can
+    # never compact at chunk capacities and costs a join per chunk)
+    prev_tsi = session.properties.get("transitive_semijoin_inference", True)
+    session.properties["transitive_semijoin_inference"] = False
+    try:
         plan = plan_statement(session, stmt)
+    finally:
+        session.properties["transitive_semijoin_inference"] = prev_tsi
     if plan.subplans:
         raise Unchunkable("scalar subplans not supported in chunked mode")
 

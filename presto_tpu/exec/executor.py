@@ -187,8 +187,7 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
             try:
                 with mon.phase("execute"):
                     mon.stats.execution_mode = "chunked"
-                    return CH.run_chunked(session, stmt, text,
-                                          plan=plan_probe)
+                    return CH.run_chunked(session, stmt, text)
             except (CH.Unchunkable, jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError):
                 if mode == "chunked":

@@ -162,8 +162,12 @@ def _optimize_node(node: P.PlanNode, session) -> P.PlanNode:
     # column never materializes)
     node = prune_columns(node, set(n for n, _ in node.outputs()))
     # AFTER pruning: the inferred semi join shares its subquery subtree
-    # with the original (a DAG prune_columns would split back into two)
-    node = infer_transitive_semijoins(node)
+    # with the original (a DAG prune_columns would split back into two).
+    # Chunked execution plans with this OFF: per-chunk capacities dwarf
+    # whole-table estimates, so the extra probe-side semi never enables
+    # compaction there and is pure added work per chunk program.
+    if session.properties.get("transitive_semijoin_inference", True):
+        node = infer_transitive_semijoins(node)
     return node
 
 

@@ -60,6 +60,10 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # phased gates probe-side stage startup on build-side completion,
     # bounding worker buffer memory on deep join DAGs
     "phased_execution": False,
+    # transitive semi-join pushdown (plan/optimizer); chunked planning
+    # turns it off — the inferred probe-side semi never compacts at
+    # chunk capacities
+    "transitive_semijoin_inference": True,
     "iterative_optimizer_enabled": True,
     "reorder_joins": True,  # Selinger-DP ReorderJoins in the Memo
     "max_reorder_joins": 8,  # Memo/Rule fixpoint pass

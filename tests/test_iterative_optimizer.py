@@ -366,3 +366,109 @@ def test_empty_left_outer_joins_not_folded():
     for jt in ("INNER", "LEFT", "SEMI"):
         out = _opt(P.Join(_empty(), right, jt, [("a", "x")]))
         assert isinstance(out, P.Values) and not out.rows, jt
+
+
+# ---- round-5 rule breadth (VERDICT item 9) ---------------------------
+
+
+def _gt(sym, v):
+    return ir.Call("gt", (_ref(sym), ir.Lit(v, T.BIGINT)), T.BOOLEAN)
+
+
+def test_push_filter_through_aggregation_on_keys():
+    agg = P.Aggregate(_scan(), ["a"],
+                      {"c": ir.AggCall("count", (), T.BIGINT)})
+    plan = P.Filter(agg, ir.combine_conjuncts([_gt("a", 3), _gt("c", 1)]))
+    out = _opt(plan)
+    # the key conjunct went below the aggregate; the HAVING stays above
+    assert isinstance(out, P.Filter)
+    assert ir.conjuncts(out.predicate)[0].refs() == {"c"}
+    assert isinstance(out.source, P.Aggregate)
+    assert isinstance(out.source.source, P.Filter)
+    assert ir.conjuncts(out.source.source.predicate)[0].refs() == {"a"}
+
+
+def test_push_filter_through_sort_and_merge_sorts():
+    plan = P.Filter(P.Sort(P.Sort(_scan(), [("b", True, None)]),
+                           [("a", True, None)]), _gt("a", 1))
+    out = _opt(plan)
+    assert isinstance(out, P.Sort) and out.keys[0][0] == "a"
+    assert isinstance(out.source, P.Filter)
+    assert isinstance(out.source.source, P.TableScan)  # inner sort gone
+
+
+def test_push_filter_through_semi_and_mark_join():
+    build = P.TableScan("s", {"k": "k"}, {"k": T.BIGINT})
+    semi = P.Join(_scan(), build, "SEMI", [("a", "k")])
+    out = _opt(P.Filter(semi, _gt("b", 7)))
+    assert isinstance(out, P.Join) and out.join_type == "SEMI"
+    assert isinstance(out.left, P.Filter)
+
+    mark = P.Join(_scan(), build, "MARK", [("a", "k")], mark="m")
+    mixed = ir.combine_conjuncts([_gt("b", 7), ir.Ref("m", T.BOOLEAN)])
+    out2 = _opt(P.Filter(mark, mixed))
+    assert isinstance(out2, P.Filter)  # the mark conjunct stays above
+    assert out2.predicate.refs() == {"m"}
+    assert isinstance(out2.source, P.Join)
+    assert isinstance(out2.source.left, P.Filter)
+
+
+def test_push_filter_through_left_join_probe_side():
+    right = P.TableScan("r", {"k": "k", "v": "v"},
+                        {"k": T.BIGINT, "v": T.BIGINT})
+    join = P.Join(_scan(), right, "LEFT", [("a", "k")])
+    mixed = ir.combine_conjuncts([_gt("b", 2), _gt("v", 5)])
+    out = _opt(P.Filter(join, mixed))
+    assert isinstance(out, P.Filter)  # build-side conjunct stays above
+    assert out.predicate.refs() == {"v"}
+    assert isinstance(out.source.left, P.Filter)
+    assert out.source.left.predicate.refs() == {"b"}
+
+
+def test_push_topn_through_outer_join_and_union():
+    right = P.TableScan("r", {"k": "k"}, {"k": T.BIGINT})
+    join = P.Join(_scan(), right, "LEFT", [("a", "k")])
+    out = _opt(P.TopN(join, [("b", True, None)], 5))
+    assert isinstance(out, P.TopN)
+    assert isinstance(out.source.left, P.TopN)
+    assert out.source.left.count == 5
+
+    u = P.Union([_scan(), _scan()], ["a"],
+                [{"a": "a"}, {"a": "a"}])
+    out2 = _opt(P.TopN(u, [("a", True, None)], 3))
+    assert isinstance(out2, P.TopN)
+    assert all(isinstance(s, P.TopN) and s.count == 3
+               for s in out2.source.sources_)
+
+
+def test_remove_redundant_distinct_over_aggregate():
+    inner = P.Aggregate(_scan(), ["a"],
+                        {"s": ir.AggCall("sum", (_ref("b"),), T.BIGINT)})
+    distinct = P.Aggregate(inner, ["a", "s"], {})
+    out = _opt(distinct)
+    # uniqueness on 'a' makes the outer DISTINCT a projection
+    assert not (isinstance(out, P.Aggregate) and not out.aggs)
+
+
+def test_limit_over_scalar_aggregate_removed():
+    agg = P.Aggregate(_scan(), [],
+                      {"c": ir.AggCall("count", (), T.BIGINT)})
+    out = _opt(P.Limit(agg, 10))
+    assert isinstance(out, P.Aggregate)
+
+
+def test_fold_constant_comparisons():
+    t = ir.Call("gt", (ir.Lit(5, T.BIGINT), ir.Lit(3, T.BIGINT)),
+                T.BOOLEAN)
+    plan = P.Filter(_scan(), ir.combine_conjuncts([t, _gt("a", 1)]))
+    out = _opt(plan)
+    assert isinstance(out, P.Filter)
+    assert out.predicate.refs() == {"a"}  # TRUE conjunct folded away
+    f = ir.Call("lt", (ir.Lit(5, T.BIGINT), ir.Lit(3, T.BIGINT)),
+                T.BOOLEAN)
+    out2 = _opt(P.Filter(_scan(), f))
+    # FALSE conjunct -> empty plan (RemoveFalseFilter/Propagate chain)
+    assert isinstance(out2, (P.Values, P.Filter, P.TableScan))
+    if isinstance(out2, P.Filter):
+        assert isinstance(out2.predicate, ir.Lit) \
+            and out2.predicate.value is False
