@@ -1,0 +1,648 @@
+"""Hive-shaped connector: partitioned warehouse tables behind a remote
+metastore.
+
+Reference parity: presto-hive — HiveMetadata (schema from the
+metastore), HivePartitionManager.getPartitions (partition pruning from
+the TupleDomain BEFORE any file IO), HiveSplitManager (one split unit
+per partition's files), HivePageSourceProvider dispatching per storage
+format, and HiveMetadata.finishInsert + SemiTransactionalHiveMetastore
+(INSERT writes files into partition directories and registers new
+partitions).  The metastore lives behind HTTP (server/metastore.py) the
+way the reference's lives behind thrift — every metadata operation is a
+real network round trip.
+
+TPU-first restating: a partition prunes to a boolean decision on the
+host (no device work at all), surviving partitions decode columnar and
+concatenate into the engine's device batch, and partition-key columns
+materialize as constant arrays — the scan feeds the same fixed-shape
+Batch every other connector produces.
+"""
+
+from __future__ import annotations
+
+import csv
+import datetime as _dt
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.catalog import Catalog, ConnectorTable
+from presto_tpu.server.metastore import (MetastoreClient, MetastoreError,
+                                         parse_partition_path,
+                                         partition_path)
+
+_EPOCH = _dt.date(1970, 1, 1)
+
+#: types usable as partition keys (reference: HiveUtil.checkPartitionKey
+#: supports the primitive types; same trim here)
+_PARTITION_TYPES = ("VARCHAR", "BIGINT", "INTEGER", "SMALLINT", "TINYINT",
+                    "DOUBLE", "BOOLEAN", "DATE")
+
+
+def _render_partition_value(v, t: T.Type) -> Optional[str]:
+    """Engine value -> directory-name string (None stays None = NULL)."""
+    if v is None:
+        return None
+    if t.name == "DATE":
+        return (_EPOCH + _dt.timedelta(days=int(v))).isoformat()
+    if t.name == "BOOLEAN":
+        return "true" if v else "false"
+    if t.is_string:
+        return str(v)
+    if t.is_integer:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _parse_partition_value(s: Optional[str], t: T.Type):
+    """Directory-name string -> engine literal-space value (DATE = days,
+    matching plan/domains.py literal space for pruning comparisons)."""
+    if s is None:
+        return None
+    if t.name == "DATE":
+        return (_dt.date.fromisoformat(s) - _EPOCH).days
+    if t.name == "BOOLEAN":
+        return s == "true"
+    if t.is_string:
+        return s
+    if t.is_integer:
+        return int(s)
+    return float(s)
+
+
+class HivePartition:
+    """One resolved partition (reference: HivePartition + Partition)."""
+
+    __slots__ = ("name", "values", "location", "num_rows")
+
+    def __init__(self, name: str, values: list, location: str,
+                 num_rows: Optional[int]):
+        self.name = name
+        self.values = values  # literal-space, aligned w/ partition cols
+        self.location = location
+        self.num_rows = num_rows
+
+
+class HiveContext:
+    """One attached metastore (client + warehouse root for new tables)."""
+
+    def __init__(self, client: MetastoreClient, warehouse: str):
+        self.client = client
+        self.warehouse = warehouse
+
+
+class HiveTable(ConnectorTable):
+    """One warehouse table.  Schema = data columns then partition
+    columns (the hive layout: partition keys are directory names, not
+    file contents)."""
+
+    supports_domain_pushdown = True
+
+    def __init__(self, name: str, ctx: HiveContext, db: str, table: str):
+        self.ctx = ctx
+        self.db = db
+        self.table = table
+        doc = ctx.client.get_table(db, table)
+        self.format = doc["format"]
+        self.location = doc["location"]
+        self.data_schema = {c: T.parse_type(t) for c, t in doc["columns"]}
+        self.partition_schema = {c: T.parse_type(t)
+                                 for c, t in doc["partition_columns"]}
+        for c, t in self.partition_schema.items():
+            if t.name not in _PARTITION_TYPES:
+                raise ValueError(f"partition column '{c}' has "
+                                 f"unsupported type {t}")
+        super().__init__(name, {**self.data_schema, **self.partition_schema})
+        self._part_cache: Optional[Tuple[int, List[HivePartition]]] = None
+        self._reader_cache: Dict[str, ConnectorTable] = {}
+
+    # nulls survive INSERT when the file format carries a null channel
+    @property
+    def supports_null_append(self) -> bool:
+        return self.format in ("parquet", "orc")
+
+    # ---- partition metadata (every call = metastore round trip or a
+    # sequence-validated cache hit, HivePartitionManager's shape) ------
+    def _partitions(self) -> List[HivePartition]:
+        seq = self.ctx.client.sequence()
+        if self._part_cache is not None and self._part_cache[0] == seq:
+            return self._part_cache[1]
+        raw, seq2 = self.ctx.client.partitions(self.db, self.table)
+        ptypes = list(self.partition_schema.values())
+        parts = []
+        for p in raw:
+            vals = [_parse_partition_value(v, t)
+                    for v, t in zip(p["values"], ptypes)]
+            nr = p.get("parameters", {}).get("numRows")
+            loc = p["location"]
+            if not os.path.isabs(loc):
+                loc = os.path.join(self.location, loc)
+            parts.append(HivePartition(p["name"], vals, loc,
+                                       int(nr) if nr is not None else None))
+        if not self.partition_schema:
+            # unpartitioned: the table location is the single "partition"
+            parts = [HivePartition("", [], self.location, None)]
+        self._part_cache = (seq2 if seq2 >= 0 else seq, parts)
+        return parts
+
+    def _invalidate(self):
+        self._part_cache = None
+        self._reader_cache = {}
+        super()._invalidate()
+
+    # ---- per-partition file access -----------------------------------
+    def _reader(self, location: str) -> Optional[ConnectorTable]:
+        """Format reader over one partition directory (reference:
+        HivePageSourceProvider dispatch on the partition's storage
+        format).  None when the partition has no data files yet."""
+        r = self._reader_cache.get(location)
+        if r is not None:
+            return r
+        if self.format == "parquet":
+            from presto_tpu.connectors.parquet import ParquetTable
+
+            if not any(p.endswith(".parquet")
+                       for p in _listdir(location)):
+                return None
+            r = ParquetTable(self.table, location)
+        elif self.format == "orc":
+            from presto_tpu.connectors.orc import OrcTable
+
+            if not any(p.endswith(".orc") for p in _listdir(location)):
+                return None
+            r = OrcTable(self.table, location)
+        else:  # csv
+            files = [p for p in _listdir(location) if p.endswith(".csv")]
+            if not files:
+                return None
+            r = _CsvPartition(self.table,
+                              [os.path.join(location, p) for p in files],
+                              self.data_schema)
+        self._reader_cache[location] = r
+        return r
+
+    def _partition_rows(self, part: HivePartition) -> int:
+        if part.num_rows is not None:
+            return part.num_rows
+        r = self._reader(part.location)
+        return 0 if r is None else r.row_count()
+
+    # ---- metadata SPI ------------------------------------------------
+    def row_count(self) -> int:
+        return sum(self._partition_rows(p) for p in self._partitions())
+
+    def splits(self, n_splits: int) -> List[Tuple[int, int]]:
+        """Partition boundaries are the split grain (reference:
+        HiveSplitManager produces splits per partition's files)."""
+        edges = [0]
+        for p in self._partitions():
+            n = self._partition_rows(p)
+            if n:
+                edges.append(edges[-1] + n)
+        if len(edges) <= 1:
+            return []
+        if len(edges) - 1 > n_splits:
+            keep = np.linspace(0, len(edges) - 1, n_splits + 1).astype(int)
+            edges = [edges[i] for i in sorted(set(keep.tolist()))]
+        return [(a, b) for a, b in zip(edges[:-1], edges[1:]) if a < b]
+
+    def column_stats(self, column: str):
+        from presto_tpu.plan.stats import ColStats
+
+        if column in self.partition_schema:
+            vals = [p.values[list(self.partition_schema).index(column)]
+                    for p in self._partitions()]
+            vals = [v for v in vals if v is not None]
+            if not vals:
+                return ColStats(ndv=0)
+            if self.partition_schema[column].is_string:
+                return ColStats(ndv=len(set(vals)))
+            return ColStats(min=float(min(vals)), max=float(max(vals)),
+                            ndv=len(set(vals)))
+        return None
+
+    # ---- read path ---------------------------------------------------
+    def read(self, columns=None, split=None,
+             domains=None) -> Dict[str, np.ndarray]:
+        """Partition pruning happens FIRST, on metadata alone (the
+        reference's HivePartitionManager.getPartitions over the
+        TupleDomain); only surviving partitions open files, where the
+        format reader applies the remaining data-column domains at
+        stripe/row-group granularity."""
+        cols = columns if columns is not None else list(self.schema)
+        pcols = list(self.partition_schema)
+        data_cols = [c for c in cols if c not in self.partition_schema]
+        data_domains = {c: d for c, d in (domains or {}).items()
+                        if c not in self.partition_schema} or None
+        counters = {"partitions_total": 0, "partitions_read": 0,
+                    "groups_total": 0, "groups_read": 0,
+                    "bytes_total": 0, "bytes_read": 0}
+        a, b = split if split is not None else (0, None)
+        parts_out: Dict[str, list] = {c: [] for c in cols}
+        base = 0
+        for part in self._partitions():
+            n = self._partition_rows(part)
+            if n == 0:
+                continue
+            counters["partitions_total"] += 1
+            hi = base + n
+            lo_r, hi_r = max(base, a), (hi if b is None else min(hi, b))
+            base = hi
+            if lo_r >= hi_r:
+                continue
+            if not self._partition_matches(part, domains, pcols):
+                continue
+            counters["partitions_read"] += 1
+            r = self._reader(part.location)
+            if r is None:
+                continue
+            sub = (lo_r - (hi - n), hi_r - (hi - n))
+            if data_cols:
+                if getattr(r, "supports_domain_pushdown", False):
+                    data = r.read(data_cols, split=sub,
+                                  domains=data_domains)
+                    for k in ("groups_total", "groups_read",
+                              "bytes_total", "bytes_read"):
+                        counters[k] += r.last_scan_counters.get(k, 0)
+                else:
+                    data = r.read(data_cols, split=sub)
+                got = len(next(iter(data.values())))
+            else:
+                data = {}
+                got = sub[1] - sub[0]
+            for c in data_cols:
+                parts_out[c].append(self._coerce_decl(data[c],
+                                                      self.data_schema[c]))
+            for c in cols:
+                if c in self.partition_schema:
+                    v = part.values[pcols.index(c)]
+                    parts_out[c].append(_constant_column(
+                        v, self.partition_schema[c], got))
+        self.last_scan_counters = counters
+        out = {}
+        for c in cols:
+            ps = parts_out[c]
+            if not ps:
+                t = self.schema[c]
+                out[c] = np.empty(0, object if t.is_string
+                                  else t.numpy_dtype())
+            elif any(isinstance(p, np.ma.MaskedArray) for p in ps):
+                out[c] = np.ma.concatenate(ps)
+            else:
+                out[c] = np.concatenate(ps)
+        return out
+
+    @staticmethod
+    def _coerce_decl(a: np.ndarray, t: T.Type) -> np.ndarray:
+        """File dtype -> declared dtype (a CSV partition infers BIGINT
+        where the table declares INTEGER, etc.)."""
+        if t.is_string or a.dtype == object:
+            return a
+        want = t.numpy_dtype()
+        if a.dtype == want:
+            return a
+        if isinstance(a, np.ma.MaskedArray):
+            return np.ma.masked_array(a.data.astype(want), a.mask)
+        return a.astype(want)
+
+    def _partition_matches(self, part: HivePartition, domains,
+                           pcols: List[str]) -> bool:
+        if not domains:
+            return True
+        for c, dom in domains.items():
+            if c not in self.partition_schema:
+                continue
+            v = part.values[pcols.index(c)]
+            if v is None:
+                # a NULL partition key matches no range/point domain
+                # (comparisons with NULL are never TRUE)
+                return False
+            if dom.values is not None:
+                if v not in dom.values:
+                    return False
+            else:
+                if dom.lo is not None and v < dom.lo:
+                    return False
+                if dom.hi is not None and v > dom.hi:
+                    return False
+        return True
+
+    # ---- write path (reference: HiveMetadata.finishInsert +
+    # HiveWriterFactory one writer per partition) ----------------------
+    def append(self, arrays: Dict[str, np.ndarray]) -> int:
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        if n == 0:
+            return 0
+        pcols = list(self.partition_schema)
+        ptypes = list(self.partition_schema.values())
+        # pre-insert row counts, BEFORE any file lands: a reader built
+        # after the write would see the new file and double-count (and a
+        # sync'd partition without numRows must count its files, not 0)
+        prev_rows = {p.name: self._partition_rows(p)
+                     for p in self._partitions()}
+        new_parts = []
+        for key, sel in _group_by_partition(arrays, pcols, n).items():
+            strs = [_render_partition_value(v, t)
+                    for v, t in zip(key, ptypes)]
+            rel = partition_path(pcols, strs) if pcols else ""
+            pdir = os.path.join(self.location, rel) if rel \
+                else self.location
+            os.makedirs(pdir, exist_ok=True)
+            rows = {c: arrays[c][sel] for c in self.data_schema}
+            self._write_file(pdir, rows)
+            new_parts.append({"values": strs, "location": rel,
+                              "parameters": {"numRows":
+                                             prev_rows.get(rel, 0)
+                                             + len(sel)}})
+        if pcols:
+            self.ctx.client.add_partitions(self.db, self.table, new_parts)
+        else:
+            self.ctx.client.update_parameters(
+                self.db, self.table,
+                {"numRows": prev_rows.get("", 0) + n})
+        self._invalidate()
+        return n
+
+    def _write_file(self, pdir: str, rows: Dict[str, np.ndarray]) -> None:
+        # unique writer id, not len(listdir): concurrent writers sharing
+        # the metastore must never clobber each other's part files
+        # (reference: HiveWriterFactory's per-writer UUID file names)
+        import uuid
+
+        stem = f"part_{uuid.uuid4().hex[:16]}"
+        if self.format == "parquet":
+            from presto_tpu.storage.parquet import write_parquet
+
+            write_parquet(os.path.join(pdir, stem + ".parquet"),
+                          rows, self.data_schema)
+        elif self.format == "orc":
+            from presto_tpu.storage.orc import write_orc
+
+            write_orc(os.path.join(pdir, stem + ".orc"),
+                      rows, self.data_schema)
+        else:
+            _write_csv(os.path.join(pdir, stem + ".csv"),
+                       rows, self.data_schema)
+
+    def drop_data(self) -> None:
+        """DROP TABLE: metastore entry first, THEN data files — if the
+        metastore is unreachable the data survives intact (the
+        reference's HiveMetadata.dropTable commits metadata before the
+        recursive delete)."""
+        import shutil
+
+        try:
+            self.ctx.client.drop_table(self.db, self.table)
+        except MetastoreError as e:
+            if e.status != 404:  # already gone is fine
+                raise
+        if os.path.isdir(self.location):
+            shutil.rmtree(self.location, ignore_errors=True)
+
+    # ---- partition repair (reference: the hive procedure
+    # system.sync_partition_metadata / MSCK REPAIR) --------------------
+    def sync_partition_metadata(self) -> List[str]:
+        """Register partition directories found on disk but missing
+        from the metastore.  Returns the added partition names."""
+        pcols = list(self.partition_schema)
+        if not pcols:
+            return []
+        known = {p.name for p in self._partitions()}
+        found = []
+
+        def walk(d: str, depth: int, rel: str):
+            if depth == len(pcols):
+                if rel not in known and _listdir(
+                        os.path.join(self.location, rel)):
+                    found.append(rel)
+                return
+            for e in _listdir(d):
+                if e.startswith(f"{pcols[depth]}="):
+                    walk(os.path.join(d, e), depth + 1,
+                         f"{rel}/{e}" if rel else e)
+
+        walk(self.location, 0, "")
+        if found:
+            self.ctx.client.add_partitions(
+                self.db, self.table,
+                [{"values": parse_partition_path(rel), "location": rel,
+                  "parameters": {}} for rel in found])
+            self._invalidate()
+        return sorted(found)
+
+
+def _group_by_partition(arrays: Dict[str, np.ndarray], pcols: List[str],
+                        n: int) -> Dict[tuple, np.ndarray]:
+    """{partition-value tuple: row indices}, vectorized — factorize each
+    partition column (code 0 = NULL), pair codes into one key, one
+    np.unique over the combined key.  A per-row Python loop here would
+    dominate large partitioned INSERT/CTAS."""
+    if not pcols:
+        return {(): np.arange(n)}
+    codes, uniques = [], []
+    for c in pcols:
+        a = arrays[c]
+        mask = np.ma.getmaskarray(a) if isinstance(a, np.ma.MaskedArray) \
+            else np.zeros(n, bool)
+        data = np.ma.getdata(a)
+        if mask.any():
+            # masked slots may hold unorderable fill (None in object
+            # arrays); give them a sortable placeholder — code 0 wins
+            data = data.copy()
+            data[mask] = "" if data.dtype == object else data.dtype.type(0)
+        u, inv = np.unique(data, return_inverse=True)
+        inv = inv.astype(np.int64) + 1
+        inv[mask] = 0
+        codes.append(inv)
+        uniques.append(u)
+    combined = codes[0]
+    for code, u in zip(codes[1:], uniques[1:]):
+        combined = combined * (len(u) + 1) + code
+    _, first, inv = np.unique(combined, return_index=True,
+                              return_inverse=True)
+    order = np.argsort(inv, kind="stable")
+    bounds = np.searchsorted(inv[order], np.arange(len(first) + 1))
+    out: Dict[tuple, np.ndarray] = {}
+    for g, i0 in enumerate(first):
+        key = []
+        for code, u in zip(codes, uniques):
+            if code[i0] == 0:
+                key.append(None)
+            else:
+                v = u[code[i0] - 1]
+                key.append(v.item() if isinstance(v, np.generic) else v)
+        out[tuple(key)] = order[bounds[g]:bounds[g + 1]]
+    return out
+
+
+def _listdir(d: str) -> List[str]:
+    try:
+        return sorted(os.listdir(d))
+    except FileNotFoundError:
+        return []
+
+
+def _constant_column(v, t: T.Type, n: int) -> np.ndarray:
+    """A partition-key value as an n-row column."""
+    if v is None:
+        base = np.zeros(n, object if t.is_string else t.numpy_dtype())
+        return np.ma.masked_array(base, mask=np.ones(n, bool))
+    if t.is_string:
+        a = np.empty(n, object)
+        a[:] = str(v)
+        return a
+    return np.full(n, v, t.numpy_dtype())
+
+
+# ---------------------------------------------------------------------
+# CSV partition files (hive text format)
+# ---------------------------------------------------------------------
+
+class _CsvPartition(ConnectorTable):
+    """Headerless CSV files in one partition directory, decoded against
+    the table schema (hive's text SerDe is schema-on-read; headers live
+    in the metastore, not the file)."""
+
+    def __init__(self, name: str, files: List[str],
+                 schema: Dict[str, T.Type]):
+        super().__init__(name, schema)
+        self.files = files
+        self._cache: Optional[Dict[str, np.ndarray]] = None
+
+    def _data(self) -> Dict[str, np.ndarray]:
+        if self._cache is None:
+            from presto_tpu.connectors.textfile import _coerce
+
+            cols: Dict[str, list] = {c: [] for c in self.schema}
+            names = list(self.schema)
+            for path in self.files:
+                with open(path, newline="", encoding="utf-8") as f:
+                    for row in csv.reader(f):
+                        for c, v in zip(names, row):
+                            cols[c].append(v if v != "" else None)
+                        for c in names[len(row):]:
+                            cols[c].append(None)
+            self._cache = {c: _coerce(cols[c], t)
+                           for c, t in self.schema.items()}
+        return self._cache
+
+    def row_count(self) -> int:
+        return len(next(iter(self._data().values()))) if self.schema else 0
+
+    def read(self, columns=None, split=None):
+        cols = columns if columns is not None else list(self.schema)
+        data = self._data()
+        a, b = split if split is not None else (0, self.row_count())
+        return {c: data[c][a:b] for c in cols}
+
+
+def _write_csv(path: str, rows: Dict[str, np.ndarray],
+               schema: Dict[str, T.Type]) -> None:
+    n = len(next(iter(rows.values()))) if rows else 0
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        cols = list(schema)
+        for i in range(n):
+            rec = []
+            for c in cols:
+                a = rows[c]
+                if isinstance(a, np.ma.MaskedArray) and \
+                        np.ma.getmaskarray(a)[i]:
+                    rec.append("")
+                    continue
+                v = a[i]
+                t = schema[c]
+                if t.name == "DATE":
+                    v = (_EPOCH + _dt.timedelta(days=int(v))).isoformat()
+                elif isinstance(v, np.generic):
+                    v = v.item()
+                rec.append(v)
+            w.writerow(rec)
+
+
+# ---------------------------------------------------------------------
+# catalog attachment + DDL entry points
+# ---------------------------------------------------------------------
+
+def attach_hive(catalog: Catalog, metastore_uri: str,
+                catalog_name: str = "hive",
+                warehouse: Optional[str] = None,
+                secret: Optional[str] = None) -> List[str]:
+    """Discover and register every table the metastore knows
+    (reference: HiveMetadata.listTables driving the catalog).  Tables
+    register qualified `<catalog>.<db>.<table>`; CREATE TABLE under the
+    claimed prefix routes to this connector."""
+    client = MetastoreClient(metastore_uri, secret=secret)
+    ctx = HiveContext(client, warehouse or "")
+    registered = []
+    for db in client.databases():
+        for tbl in client.tables(db):
+            qualified = f"{catalog_name}.{db}.{tbl}"
+            t = HiveTable(qualified, ctx, db, tbl)
+            catalog.tables[qualified] = t
+            t._catalog = catalog
+            registered.append(qualified)
+    catalog.version += 1
+    catalog.known_qualifiers.add(catalog_name)
+    catalog.claimed_prefixes.add(catalog_name)
+    if not hasattr(catalog, "hive_contexts"):
+        catalog.hive_contexts = {}
+    catalog.hive_contexts[catalog_name] = ctx
+    return registered
+
+
+def create_hive_table(catalog: Catalog, name: str,
+                      schema: Dict[str, T.Type],
+                      properties: dict) -> HiveTable:
+    """CREATE TABLE <prefix>.<db>.<t> (...) WITH (format='parquet',
+    partitioned_by='dt,region') — reference: HiveMetadata.createTable
+    (partition columns must be declared and are moved to the end, the
+    hive rule; partitioned_by is comma-separated)."""
+    parts = name.lower().split(".")
+    ctxs = getattr(catalog, "hive_contexts", {})
+    if not parts or parts[0] not in ctxs:
+        raise ValueError(f"no hive catalog attached for '{name}'")
+    ctx = ctxs[parts[0]]
+    if len(parts) == 3:
+        db, tbl = parts[1], parts[2]
+    elif len(parts) == 2:
+        db, tbl = "default", parts[1]
+    else:
+        raise ValueError(f"hive table name must be "
+                         f"<catalog>.<db>.<table>: '{name}'")
+    fmt = str(properties.get("format", "parquet")).lower()
+    pby = properties.get("partitioned_by", "")
+    pcols = [c.strip().lower() for c in str(pby).split(",") if c.strip()]
+    unknown = [c for c in pcols if c not in schema]
+    if unknown:
+        raise ValueError(f"partitioned_by columns not declared: {unknown}")
+    data_cols = [(c, str(t)) for c, t in schema.items() if c not in pcols]
+    part_cols = [(c, str(schema[c])) for c in pcols]
+    if not data_cols:
+        raise ValueError("hive table needs at least one data column")
+    location = properties.get("location") or properties.get("path")
+    if not location:
+        if not ctx.warehouse:
+            raise ValueError("hive catalog has no warehouse root; pass "
+                             "WITH (location = '...')")
+        location = os.path.join(ctx.warehouse, db, tbl)
+    os.makedirs(location, exist_ok=True)
+    if db not in ctx.client.databases():
+        ctx.client.create_database(db)
+    ctx.client.create_table(db, tbl, {
+        "columns": data_cols, "partition_columns": part_cols,
+        "format": fmt, "location": os.path.abspath(location),
+        "parameters": {}})
+    qualified = f"{parts[0]}.{db}.{tbl}"
+    t = HiveTable(qualified, ctx, db, tbl)
+    catalog.tables[qualified] = t
+    t._catalog = catalog
+    catalog.version += 1
+    return t
+
+
+def is_hive_name(catalog: Catalog, name: str) -> bool:
+    parts = name.lower().split(".")
+    return bool(parts) and parts[0] in getattr(catalog, "hive_contexts", {})

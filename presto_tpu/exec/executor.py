@@ -261,6 +261,25 @@ def _create_table(session, name, schema, properties, arrays):
     properties (reference: StaticCatalogStore catalogs + per-connector
     getPageSinkProvider; default is the memory connector)."""
     connector = str(properties.get("connector", "memory")).lower()
+    from presto_tpu.connectors.hive import create_hive_table, is_hive_name
+
+    if connector == "hive" or is_hive_name(session.catalog, name):
+        # a name under an attached hive catalog's prefix routes to the
+        # hive connector (reference: the catalog name selects the
+        # connector in MetadataManager.createTable)
+        t = create_hive_table(session.catalog, name, schema, properties)
+        if arrays is not None:
+            if not t.supports_null_append:
+                # same guard as INSERT: the csv sink's "" NULL encoding
+                # would silently conflate NULL with empty VARCHAR
+                for c, a in arrays.items():
+                    if isinstance(a, np.ma.MaskedArray) \
+                            and a.mask is not np.ma.nomask and np.any(a.mask):
+                        raise ExecutionError(
+                            f"CTAS with NULL values in column '{c}' is "
+                            "not supported by this storage format")
+            t.append({c: arrays[c] for c in t.schema})
+        return
     if arrays is not None and connector not in ("parquet", "orc"):
         # parquet/orc sinks carry nulls natively (definition levels /
         # PRESENT streams); the memory/shard sinks store raw arrays
