@@ -152,8 +152,11 @@ def run_chunked(session, stmt, text: str):
         cache = session._chunked_cache = {}
     # raw text key: whitespace normalization would merge queries that
     # differ only inside string literals
+    from presto_tpu.exec.executor import _volatile_nonce
+
     key = (text, getattr(session.catalog, "version", 0),
-           tuple(sorted((k, repr(v)) for k, v in session.properties.items())))
+           tuple(sorted((k, repr(v)) for k, v in session.properties.items())),
+           _volatile_nonce(text))
     prepared = cache.get(key)
     if prepared is not None:
         return _execute_prepared(session, *prepared)

@@ -31,6 +31,11 @@ class EvalContext:
 
 
 def eval_expr(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> ColVal:
+    from presto_tpu import session_ctx
+
+    # per-row volatile emitters (random()) need a row count that the
+    # argument ColVals cannot provide
+    session_ctx.set_batch_capacity(batch.capacity)
     if isinstance(expr, ir.Ref):
         c = batch.columns[expr.name]
         return ColVal(c.data, c.valid, c.type, c.dictionary)

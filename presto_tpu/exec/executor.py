@@ -174,7 +174,8 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
         plan_probe = None
         warm_key = (text, getattr(session.catalog, "version", 0),
                     tuple(sorted((k, repr(v))
-                                 for k, v in session.properties.items())))
+                                 for k, v in session.properties.items())),
+                    _volatile_nonce(text))
         if warm_key in getattr(session, "_chunked_cache", {}):
             needs_chunks = True  # memo hit: skip the planning probe
         elif mode == "chunked" or CH.catalog_may_need_chunks(session):
@@ -477,6 +478,29 @@ def _plan_has_long_decimal(node) -> bool:
     return False
 
 
+import re as _re
+
+#: functions whose value must differ between executions of the SAME query
+#: text (reference: FunctionMetadata deterministic=false / the session
+#: start instant).  A cached compiled program bakes their values in at
+#: trace time, so volatile queries key the program caches per query.
+_VOLATILE_RE = _re.compile(
+    r"\b(?:now|random|rand|uuid|shuffle)\s*\("
+    r"|\bcurrent_(?:date|time|timestamp)\b|\blocaltime(?:stamp)?\b",
+    _re.IGNORECASE)
+
+
+def _volatile_nonce(text: str) -> int:
+    """0 for deterministic queries (cache shared across executions);
+    the per-query sequence number otherwise (every execution retraces,
+    so now()/random() are fresh — matching per-query semantics)."""
+    if _VOLATILE_RE.search(text) is None:
+        return 0
+    from presto_tpu import session_ctx
+
+    return session_ctx.query_seq()
+
+
 def run_compiled(session, text: str, stmt) -> QueryResult:
     """Compiled execution: the WHOLE plan traces into one jitted XLA
     program over the scan batches (the reference compiles expressions to
@@ -492,7 +516,8 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
     # raw text key (whitespace normalization would merge queries that
     # differ only inside string literals)
     key = (text, getattr(session.catalog, "version", 0),
-           tuple(sorted((k, repr(v)) for k, v in session.properties.items())))
+           tuple(sorted((k, repr(v)) for k, v in session.properties.items())),
+           _volatile_nonce(text))
     entry = cache.get(key)
     if entry == "DYNAMIC":  # static assumptions known-violated for this query
         plan = plan_statement(session, stmt)

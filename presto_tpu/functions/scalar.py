@@ -3191,6 +3191,7 @@ _register_sketch_fns()
 # round-4 breadth: the extended batches register on import (kept in
 # their own modules to keep this file navigable)
 from presto_tpu.functions import scalar_ext as _scalar_ext  # noqa: E402,F401
+from presto_tpu.functions import scalar_ext2 as _scalar_ext2  # noqa: E402,F401
 from presto_tpu.functions import datetime_tz as _datetime_tz  # noqa: E402,F401
 from presto_tpu.functions import geospatial as _geospatial  # noqa: E402,F401
 from presto_tpu.functions import ml as _ml  # noqa: E402,F401

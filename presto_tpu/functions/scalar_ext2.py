@@ -1,0 +1,983 @@
+"""Scalar function batch 3 (round-5 breadth push).
+
+Reference parity: the remaining presto-main/.../operator/scalar/ surface
+that rounds 2-4 skipped — MathFunctions' inverse-CDF family and
+cosine_similarity, the volatile functions (MathFunctions.random,
+UuidFunction, ArrayShuffleFunction) whose non-determinism the engine
+models with a per-query cache nonce (exec/executor._volatile_nonce),
+StringFunctions.splitToMap/splitToMultimap/strrpos, WordStemFunction,
+KeySamplingPercentFunction, ColorFunctions (color/rgb/render/bar — the
+COLOR type trims to BIGINT codes here), the array long tail
+(ArrayFrequency/CumSum/Normalize/SortDesc, CombinationsFunction,
+NgramsFunction, ZipFunction), and the map long tail (MapZipWith,
+MultimapFromEntries, MapSubset, RemoveNullValues, MapNormalize, the
+keys/values-match family).
+
+Conventions follow scalar.py: dictionary values transform on host per
+UNIQUE entry, numeric kernels are jnp elementwise, strict NULL
+propagation unless the reference says otherwise.
+"""
+
+from __future__ import annotations
+
+import math
+import os as _os
+import uuid as _uuid
+
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy import special as _sp
+
+from presto_tpu import session_ctx, types as T
+from presto_tpu.batch import Dictionary
+from presto_tpu.exec.colval import ColVal, all_valid
+from presto_tpu.functions.scalar import (
+    _arr_entries,
+    _array_transform,
+    _check_lambda,
+    _colval_from_pylist,
+    _dict_lut_result,
+    _fn_ret,
+    _is_array,
+    _is_function,
+    _is_map,
+    _map_sort,
+    _map_value_fn,
+    _pair_codes,
+    _pylist_from_colval,
+    _tuple_dict_normalize,
+    register,
+)
+from presto_tpu.functions.scalar_ext import _mathNd
+
+# ---------------------------------------------------------------------------
+# volatile functions (reference: FunctionMetadata deterministic=false;
+# the compiled-program caches key volatile queries per execution)
+# ---------------------------------------------------------------------------
+
+
+def _fresh_rng() -> np.random.Generator:
+    return np.random.default_rng(int.from_bytes(_os.urandom(8), "little"))
+
+
+def _rows() -> int:
+    cap = session_ctx.batch_capacity()
+    return int(cap) if cap else 1
+
+
+def _resolve_random(args):
+    if not args:
+        return T.DOUBLE
+    if len(args) == 1 and args[0].is_integer:
+        return args[0]
+    return None
+
+
+def _emit_random(args):
+    """random() -> [0,1) DOUBLE per row; random(n) -> [0,n) integer
+    (reference: MathFunctions.random).  Values are drawn on host at
+    trace time — per-query freshness comes from the volatile cache
+    nonce, per-row freshness from drawing batch_capacity values."""
+    n = _rows()
+    rng = _fresh_rng()
+    if not args:
+        vals = rng.random(n)
+        data = jnp.asarray(vals) if n > 1 else jnp.asarray(vals[0])
+        return ColVal(data, None, T.DOUBLE)
+    bound = args[0]
+    b = bound.data
+    if hasattr(b, "shape") and getattr(b, "ndim", 0) > 0:
+        raise NotImplementedError("random(n) needs a constant bound")
+    b = int(b.item() if hasattr(b, "item") else b)
+    if b <= 0:
+        raise ValueError("bound must be positive")
+    vals = rng.integers(0, b, size=n)
+    data = jnp.asarray(vals.astype(bound.type.numpy_dtype()))
+    if n == 1:
+        data = data[0]
+    return ColVal(data, bound.valid, bound.type)
+
+
+register("random")((_resolve_random, _emit_random))
+register("rand")((_resolve_random, _emit_random))
+
+
+def _emit_uuid(args):
+    n = _rows()
+    if n > 200_000:
+        raise NotImplementedError(
+            "uuid() over very large batches is not supported")
+    vals = np.empty(n, dtype=object)
+    vals[:] = [str(_uuid.uuid4()) for _ in range(n)]
+    codes = jnp.arange(n, dtype=jnp.int32) if n > 1 \
+        else jnp.asarray(0, jnp.int32)
+    return ColVal(codes, None, T.VARCHAR, Dictionary(vals))
+
+
+register("uuid")((lambda args: T.VARCHAR if not args else None, _emit_uuid))
+
+
+def _emit_shuffle(args):
+    rng = _fresh_rng()
+
+    def fn(v):
+        out = list(v)
+        rng.shuffle(out)
+        return tuple(out)
+
+    return _array_transform("shuffle", fn)[1](args)
+
+
+register("shuffle")((
+    lambda args: args[0] if len(args) == 1 and _is_array(args[0]) else None,
+    _emit_shuffle))
+
+
+# ---------------------------------------------------------------------------
+# inverse CDFs (reference: MathFunctions.inverse*Cdf).  Closed forms
+# where they exist; elsewhere vectorized bracket-doubling + bisection on
+# the same jax.scipy.special CDFs the forward functions use — fixed
+# iteration counts keep the whole solve one fused XLA region.
+# ---------------------------------------------------------------------------
+
+
+def _bisect(cdf, p, lo, hi, iters=56):
+    lo = jnp.broadcast_to(jnp.asarray(lo, jnp.float64), p.shape)
+    hi = jnp.broadcast_to(jnp.asarray(hi, jnp.float64), p.shape)
+    for _ in range(iters):
+        mid = 0.5 * (lo + hi)
+        below = cdf(mid) < p
+        lo = jnp.where(below, mid, lo)
+        hi = jnp.where(below, hi, mid)
+    return 0.5 * (lo + hi)
+
+
+def _grow_hi(cdf, p, start=1.0, doublings=36):
+    hi = jnp.full(p.shape, start, jnp.float64)
+    for _ in range(doublings):
+        hi = jnp.where(cdf(hi) < p, hi * 2.0, hi)
+    return hi
+
+
+def _guard_p(p, v):
+    return jnp.where((p >= 0.0) & (p <= 1.0), v, jnp.nan)
+
+
+def _inv_beta(a, b, p):
+    return _guard_p(p, _bisect(lambda v: _sp.betainc(a, b, v), p, 0.0, 1.0))
+
+
+def _inv_chi2(df, p):
+    hi = _grow_hi(lambda v: _sp.gammainc(df / 2.0, v / 2.0), p)
+    return _guard_p(p, _bisect(
+        lambda v: _sp.gammainc(df / 2.0, v / 2.0), p, 0.0, hi))
+
+
+def _inv_gamma(shape, scale, p):
+    hi = _grow_hi(lambda v: _sp.gammainc(shape, v / scale), p)
+    return _guard_p(p, _bisect(
+        lambda v: _sp.gammainc(shape, v / scale), p, 0.0, hi))
+
+
+def _inv_f(d1, d2, p):
+    def cdf(v):
+        return _sp.betainc(d1 / 2, d2 / 2,
+                           jnp.clip(d1 * v / (d1 * v + d2), 0.0, 1.0))
+
+    hi = _grow_hi(cdf, p)
+    return _guard_p(p, _bisect(cdf, p, 0.0, hi))
+
+
+register("inverse_beta_cdf")(_mathNd("inverse_beta_cdf", 3, _inv_beta))
+register("inverse_chi_squared_cdf")(_mathNd(
+    "inverse_chi_squared_cdf", 2, _inv_chi2))
+register("inverse_gamma_cdf")(_mathNd("inverse_gamma_cdf", 3, _inv_gamma))
+register("inverse_f_cdf")(_mathNd("inverse_f_cdf", 3, _inv_f))
+register("inverse_laplace_cdf")(_mathNd(
+    "inverse_laplace_cdf", 3,
+    lambda mean, scale, p: _guard_p(p, jnp.where(
+        p < 0.5, mean + scale * jnp.log(2.0 * p),
+        mean - scale * jnp.log(2.0 - 2.0 * p)))))
+register("inverse_logistic_cdf")(_mathNd(
+    "inverse_logistic_cdf", 3,
+    lambda mean, scale, p: _guard_p(
+        p, mean + scale * jnp.log(p / (1.0 - p)))))
+register("inverse_weibull_cdf")(_mathNd(
+    "inverse_weibull_cdf", 3,
+    lambda a, b, p: _guard_p(
+        p, b * jnp.power(-jnp.log1p(-p), 1.0 / a))))
+
+
+def _disc_inverse(cdf_at, p, hi0):
+    """Smallest integer k with CDF(k) >= p (discrete inverses)."""
+    lo = jnp.zeros(p.shape, jnp.float64)
+    hi = jnp.broadcast_to(jnp.asarray(hi0, jnp.float64), p.shape)
+    for _ in range(40):
+        mid = jnp.floor(0.5 * (lo + hi))
+        below = cdf_at(mid) < p
+        lo = jnp.where(below, mid + 1.0, lo)
+        hi = jnp.where(below, hi, mid)
+    return lo
+
+
+def _inv_poisson(lam, p):
+    hi = lam + 12.0 * jnp.sqrt(lam) + 64.0
+    k = _disc_inverse(lambda m: _sp.gammaincc(m + 1.0, lam), p, hi)
+    return _guard_p(p, k)
+
+
+def _inv_binomial(n, sp_, p):
+    def cdf(m):
+        return jnp.where(
+            m >= n, 1.0,
+            1.0 - _sp.betainc(jnp.maximum(m + 1.0, 1e-30),
+                              jnp.maximum(n - m, 1e-30), sp_))
+
+    return _guard_p(p, _disc_inverse(cdf, p, n))
+
+
+register("inverse_poisson_cdf")(_mathNd(
+    "inverse_poisson_cdf", 2, _inv_poisson))
+register("inverse_binomial_cdf")(_mathNd(
+    "inverse_binomial_cdf", 3, _inv_binomial))
+
+
+# ---------------------------------------------------------------------------
+# cosine_similarity over sparse MAP(VARCHAR, DOUBLE) vectors
+# (reference: MathFunctions.cosineSimilarity)
+# ---------------------------------------------------------------------------
+
+
+def _pairwise_dict_fn(name, fn, rt):
+    """2-dictionary-column function evaluated per unique value pair."""
+
+    def emit(args):
+        a, b = args
+        uniq, inv, scalar, _n = _pair_codes(args)
+        av, bv = _arr_entries(a), _arr_entries(b)
+        outs = []
+        for ca, cb in uniq:
+            if int(ca) < 0 or int(cb) < 0:
+                outs.append(None)
+                continue
+            try:
+                outs.append(fn(av[int(ca)] if int(ca) < len(av) else (),
+                               bv[int(cb)] if int(cb) < len(bv) else ()))
+            except (ValueError, TypeError, ZeroDivisionError):
+                outs.append(None)
+        codes = jnp.asarray(int(inv[0]), jnp.int32) if scalar \
+            else jnp.asarray(inv.astype(np.int32))
+        return _dict_lut_result(outs, ColVal(codes, all_valid(a, b), rt), rt)
+
+    return emit
+
+
+def _cosine(m1, m2):
+    d1, d2 = dict(m1), dict(m2)
+    n1 = math.sqrt(sum(v * v for v in d1.values()))
+    n2 = math.sqrt(sum(v * v for v in d2.values()))
+    if n1 == 0.0 or n2 == 0.0:
+        return None
+    dot = sum(v * d2.get(k, 0.0) for k, v in d1.items())
+    return dot / (n1 * n2)
+
+
+register("cosine_similarity")((
+    lambda args: T.DOUBLE if len(args) == 2 and all(_is_map(a) for a in args)
+    else None,
+    _pairwise_dict_fn("cosine_similarity", _cosine, T.DOUBLE)))
+
+
+# ---------------------------------------------------------------------------
+# string long tail
+# ---------------------------------------------------------------------------
+
+
+def _strrpos(s, sub, instance=1):
+    """1-based position of the instance'th occurrence from the END
+    (reference: StringFunctions.stringReversePosition)."""
+    inst = int(instance)
+    if inst <= 0:
+        raise ValueError("strrpos instance must be positive")
+    if not sub:
+        return 0
+    pos, found = len(s), 0
+    while found < inst:
+        pos = s.rfind(sub, 0, pos)
+        if pos < 0:
+            return 0
+        found += 1
+    return pos + 1
+
+
+def _str_fn(name, fn, rt, nargs=(1, 2, 3)):
+    """String-first function with constant extra args over dictionary
+    values (the _array_transform convention, string flavor)."""
+
+    def resolve(args):
+        return rt if args and args[0].is_string \
+            and len(args) in (nargs if isinstance(nargs, tuple) else (nargs,)) \
+            else None
+
+    def emit(args):
+        col = args[0]
+        extra = []
+        for a in args[1:]:
+            v = a.data
+            if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0:
+                raise NotImplementedError(
+                    f"{name} with non-constant arguments")
+            if a.dictionary is not None:
+                v = a.dictionary.values[int(v)]
+            elif hasattr(v, "item"):
+                v = v.item()
+            extra.append(v)
+        if col.dictionary is None and isinstance(col.data, (str, bytes)):
+            # string literal: fold through a single-entry dictionary
+            vals = np.empty(1, dtype=object)
+            vals[0] = col.data
+            col = ColVal(jnp.asarray(0, jnp.int32), col.valid, col.type,
+                         Dictionary(vals))
+        vals = col.dictionary.values if col.dictionary is not None \
+            else np.empty(0, object)
+        outs = []
+        for v in vals:
+            try:
+                outs.append(fn(str(v), *extra))
+            except (ValueError, TypeError, IndexError):
+                outs.append(None)
+        return _dict_lut_result(outs, ColVal(col.data, col.valid, rt), rt)
+
+    return resolve, emit
+
+
+register("strrpos")(_str_fn("strrpos", _strrpos, T.BIGINT, (2, 3)))
+
+
+def _split_to_map(s, entry_d, kv_d):
+    out = {}
+    if s:
+        for part in s.split(entry_d):
+            k, sep, v = part.partition(kv_d)
+            if not sep:
+                raise ValueError(f"key-value delimiter missing in {part!r}")
+            if k in out:
+                raise ValueError(f"duplicate key {k!r} in split_to_map")
+            out[k] = v
+    return _map_sort(out.items())
+
+
+def _split_to_multimap(s, entry_d, kv_d):
+    out: dict = {}
+    if s:
+        for part in s.split(entry_d):
+            k, sep, v = part.partition(kv_d)
+            if not sep:
+                raise ValueError(f"key-value delimiter missing in {part!r}")
+            out.setdefault(k, []).append(v)
+    return _map_sort((k, tuple(v)) for k, v in out.items())
+
+
+register("split_to_map")(_str_fn(
+    "split_to_map", _split_to_map, T.map_of(T.VARCHAR, T.VARCHAR), 3))
+register("split_to_multimap")(_str_fn(
+    "split_to_multimap", _split_to_multimap,
+    T.map_of(T.VARCHAR, T.array_of(T.VARCHAR)), 3))
+
+
+def _fnv64(b: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for c in b:
+        h ^= c
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _ksp(s):
+    # the engine's xxhash64 scalar lives as a jnp kernel; host-side here
+    # a 64-bit FNV-1a stands in (same bucketing contract: deterministic,
+    # uniform; documented deviation from the reference's xxHash64)
+    return (_fnv64(str(s).encode("utf-8")) % 100) / 100.0
+
+
+register("key_sampling_percent")(_str_fn(
+    "key_sampling_percent", _ksp, T.DOUBLE, 1))
+
+
+# ---- word_stem: Porter stemmer (reference: WordStemFunction over
+# lucene's snowball English stemmer; the classic Porter algorithm) ----
+
+_VOWELS = "aeiou"
+
+
+def _is_cons(w, i):
+    c = w[i]
+    if c in _VOWELS:
+        return False
+    if c == "y":
+        return i == 0 or not _is_cons(w, i - 1)
+    return True
+
+
+def _measure(w):
+    m, i, n = 0, 0, len(w)
+    while i < n and _is_cons(w, i):
+        i += 1
+    while i < n:
+        while i < n and not _is_cons(w, i):
+            i += 1
+        if i >= n:
+            break
+        m += 1
+        while i < n and _is_cons(w, i):
+            i += 1
+    return m
+
+
+def _has_vowel(w):
+    return any(not _is_cons(w, i) for i in range(len(w)))
+
+
+def _ends_cvc(w):
+    if len(w) < 3:
+        return False
+    if not (_is_cons(w, -3 + len(w)) and not _is_cons(w, len(w) - 2)
+            and _is_cons(w, len(w) - 1)):
+        return False
+    return w[-1] not in "wxy"
+
+
+def _porter(word: str) -> str:
+    w = word.lower()
+    if len(w) <= 2:
+        return w
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif not w.endswith("ss") and w.endswith("s"):
+        w = w[:-1]
+    # step 1b
+    flag = False
+    if w.endswith("eed"):
+        if _measure(w[:-3]) > 0:
+            w = w[:-1]
+    elif w.endswith("ed") and _has_vowel(w[:-2]):
+        w, flag = w[:-2], True
+    elif w.endswith("ing") and _has_vowel(w[:-3]):
+        w, flag = w[:-3], True
+    if flag:
+        if w.endswith(("at", "bl", "iz")):
+            w += "e"
+        elif len(w) >= 2 and w[-1] == w[-2] and _is_cons(w, len(w) - 1) \
+                and w[-1] not in "lsz":
+            w = w[:-1]
+        elif _measure(w) == 1 and _ends_cvc(w):
+            w += "e"
+    # step 1c
+    if w.endswith("y") and _has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+    # step 2
+    for suf, rep in (("ational", "ate"), ("tional", "tion"), ("enci", "ence"),
+                     ("anci", "ance"), ("izer", "ize"), ("abli", "able"),
+                     ("alli", "al"), ("entli", "ent"), ("eli", "e"),
+                     ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+                     ("ator", "ate"), ("alism", "al"), ("iveness", "ive"),
+                     ("fulness", "ful"), ("ousness", "ous"), ("aliti", "al"),
+                     ("iviti", "ive"), ("biliti", "ble")):
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 0:
+                w = w[:-len(suf)] + rep
+            break
+    # step 3
+    for suf, rep in (("icate", "ic"), ("ative", ""), ("alize", "al"),
+                     ("iciti", "ic"), ("ical", "ic"), ("ful", ""),
+                     ("ness", "")):
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 0:
+                w = w[:-len(suf)] + rep
+            break
+    # step 4
+    for suf in ("al", "ance", "ence", "er", "ic", "able", "ible", "ant",
+                "ement", "ment", "ent", "ou", "ism", "ate", "iti", "ous",
+                "ive", "ize"):
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 1:
+                w = w[:-len(suf)]
+            break
+    else:
+        if w.endswith("ion") and len(w) > 3 and w[-4] in "st" \
+                and _measure(w[:-3]) > 1:
+            w = w[:-3]
+    # step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        m = _measure(stem)
+        if m > 1 or (m == 1 and not _ends_cvc(stem)):
+            w = stem
+    # step 5b
+    if len(w) >= 2 and w.endswith("ll") and _measure(w) > 1:
+        w = w[:-1]
+    return w
+
+
+def _word_stem(s, lang="en"):
+    if lang != "en":
+        raise ValueError(f"unsupported stemmer language: {lang}")
+    return _porter(s)
+
+
+register("word_stem")(_str_fn("word_stem", _word_stem, T.VARCHAR, (1, 2)))
+
+
+# ---------------------------------------------------------------------------
+# color functions (reference: operator/scalar/ColorFunctions.java; the
+# COLOR type trims to a BIGINT code — negative = ANSI system color,
+# else packed 24-bit rgb)
+# ---------------------------------------------------------------------------
+
+_ANSI_COLORS = {"black": 1, "red": 2, "green": 3, "yellow": 4, "blue": 5,
+                "magenta": 6, "cyan": 7, "white": 8}
+
+
+def _parse_color(s):
+    s = str(s).strip().lower()
+    if s.startswith("#") and len(s) == 4:
+        r, g, b = (int(c, 16) * 17 for c in s[1:])
+        return (r << 16) | (g << 8) | b
+    if s in _ANSI_COLORS:
+        return -_ANSI_COLORS[s]
+    raise ValueError(f"invalid color: {s!r}")
+
+
+register("color")(_str_fn("color", _parse_color, T.BIGINT, 1))
+
+
+def _rgb(r, g, b):
+    for v in (r, g, b):
+        if not 0 <= v <= 255:
+            raise ValueError("rgb component out of [0,255]")
+    return (int(r) << 16) | (int(g) << 8) | int(b)
+
+
+register("rgb")((
+    lambda args: T.BIGINT if len(args) == 3
+    and all(a.is_integer for a in args) else None,
+    lambda args: _int3_host("rgb", _rgb, args)))
+
+
+def _int3_host(name, fn, args):
+    datas = []
+    for a in args:
+        v = a.data
+        if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0:
+            raise NotImplementedError(f"{name} over column values")
+        datas.append(int(v.item() if hasattr(v, "item") else v))
+    return ColVal(jnp.asarray(fn(*datas), jnp.int64), all_valid(*args),
+                  T.BIGINT)
+
+
+def _ansi_for(code: int) -> str:
+    if code < 0:
+        return f"\x1b[3{-code - 1}m"
+    r, g, b = (code >> 16) & 255, (code >> 8) & 255, code & 255
+    n = 16 + 36 * (r * 6 // 256) + 6 * (g * 6 // 256) + (b * 6 // 256)
+    return f"\x1b[38;5;{n}m"
+
+
+def _resolve_render(args):
+    if len(args) == 1 and args[0].name == "BOOLEAN":
+        return T.VARCHAR
+    if len(args) == 2 and args[1].is_integer:
+        return T.VARCHAR
+    return None
+
+
+def _emit_render(args):
+    if len(args) == 1:  # render(boolean) -> colored check mark / cross
+        b = args[0]
+        vals = np.asarray(["\x1b[31m✘\x1b[0m", "\x1b[32m✔\x1b[0m"],
+                          dtype=object)
+        codes = jnp.asarray(b.data, jnp.int32)
+        return ColVal(codes, b.valid, T.VARCHAR, Dictionary(vals))
+    v, c = args
+    code = c.data
+    if hasattr(code, "shape") and getattr(code, "ndim", 0) > 0:
+        raise NotImplementedError("render with a non-constant color")
+    prefix = _ansi_for(int(code.item() if hasattr(code, "item") else code))
+    if v.type.is_string:
+        if v.dictionary is None and isinstance(v.data, (str, bytes)):
+            d = np.empty(1, dtype=object)
+            d[0] = v.data
+            v = ColVal(jnp.asarray(0, jnp.int32), v.valid, v.type,
+                       Dictionary(d))
+        vals = v.dictionary.values if v.dictionary is not None \
+            else np.empty(0, object)
+        outs = [f"{prefix}{s}\x1b[0m" for s in vals]
+        return _dict_lut_result(outs, ColVal(v.data, all_valid(v, c),
+                                             T.VARCHAR), T.VARCHAR)
+    raise NotImplementedError("render over non-string values")
+
+
+register("render")((_resolve_render, _emit_render))
+
+
+def _bar(x, width, low=-(_ANSI_COLORS["red"]), high=-(_ANSI_COLORS["green"])):
+    x = min(max(float(x), 0.0), 1.0)
+    width = int(width)
+    if width < 0:
+        raise ValueError("bar width must be >= 0")
+    n = int(round(x * width))
+    out = []
+    for i in range(n):
+        frac = i / max(n - 1, 1)
+        if int(low) < 0 and int(high) < 0:
+            code = int(low) if frac < 0.5 else int(high)
+        else:
+            lr, lg, lb = (int(low) >> 16) & 255, (int(low) >> 8) & 255, \
+                int(low) & 255
+            hr, hg, hb = (int(high) >> 16) & 255, (int(high) >> 8) & 255, \
+                int(high) & 255
+            code = _rgb(int(lr + (hr - lr) * frac),
+                        int(lg + (hg - lg) * frac),
+                        int(lb + (hb - lb) * frac))
+        out.append(_ansi_for(code) + "█")
+    return "".join(out) + "\x1b[0m" + " " * (width - n)
+
+
+def _resolve_bar(args):
+    return T.VARCHAR if len(args) in (2, 4) and args[0].is_numeric else None
+
+
+def _emit_bar(args):
+    datas = []
+    for a in args:
+        v = a.data
+        if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0:
+            raise NotImplementedError("bar over column values")
+        datas.append(v.item() if hasattr(v, "item") else v)
+    s = _bar(*datas)
+    vals = np.empty(1, dtype=object)
+    vals[0] = s
+    return ColVal(jnp.asarray(0, jnp.int32), all_valid(*args), T.VARCHAR,
+                  Dictionary(vals))
+
+
+register("bar")((_resolve_bar, _emit_bar))
+
+
+# ---------------------------------------------------------------------------
+# array long tail
+# ---------------------------------------------------------------------------
+
+
+def _freq(v):
+    out: dict = {}
+    for e in v:
+        if e is not None:
+            out[e] = out.get(e, 0) + 1
+    return _map_sort(out.items())
+
+
+def _emit_array_frequency(args):
+    rt = T.map_of(args[0].type.params[0], T.BIGINT)
+    vals = [_freq(tuple(v)) for v in _arr_entries(args[0])]
+    return _dict_lut_result(vals, ColVal(args[0].data, args[0].valid, rt),
+                            rt)
+
+
+register("array_frequency")((
+    lambda args: T.map_of(args[0].params[0], T.BIGINT)
+    if len(args) == 1 and _is_array(args[0]) else None,
+    _emit_array_frequency))
+
+
+def _cum_sum(v):
+    out, acc, dead = [], 0, False
+    for e in v:
+        if e is None or dead:
+            out.append(None)
+            dead = True  # reference: elements after a NULL are NULL
+        else:
+            acc += e
+            out.append(acc)
+    return tuple(out)
+
+
+register("array_cum_sum")((
+    lambda args: args[0] if len(args) == 1 and _is_array(args[0])
+    and args[0].params[0].is_numeric else None,
+    _array_transform("array_cum_sum", _cum_sum)[1]))
+
+
+def _normalize_arr(v, p):
+    p = float(p)
+    if p < 0:
+        raise ValueError("array_normalize requires p >= 0")
+    if any(e is None for e in v):
+        return None
+    if p == 0:
+        return tuple(v)
+    norm = sum(abs(e) ** p for e in v) ** (1.0 / p)
+    if norm == 0:
+        return tuple(v)
+    return tuple(e / norm for e in v)
+
+
+register("array_normalize")((
+    lambda args: args[0] if len(args) == 2 and _is_array(args[0])
+    and args[0].params[0].is_floating else None,
+    _array_transform("array_normalize", _normalize_arr)[1]))
+
+register("array_sort_desc")((_array_transform(
+    "array_sort_desc",
+    lambda v: tuple(sorted((e for e in v if e is not None), reverse=True))
+    + tuple(None for e in v if e is None))))
+
+
+def _combinations(v, n):
+    import itertools as _it
+
+    n = int(n)
+    if n < 0 or n > 5:
+        raise ValueError("combinations n must be in [0, 5]")
+    return tuple(tuple(c) for c in _it.combinations(v, n))
+
+
+register("combinations")((
+    lambda args: T.array_of(args[0]) if len(args) == 2
+    and _is_array(args[0]) else None,
+    _array_transform("combinations", _combinations)[1]))
+
+
+def _ngrams(v, n):
+    n = int(n)
+    if n <= 0:
+        raise ValueError("ngrams n must be positive")
+    if n >= len(v):
+        return (tuple(v),)
+    return tuple(tuple(v[i:i + n]) for i in range(len(v) - n + 1))
+
+
+register("ngrams")((
+    lambda args: T.array_of(args[0]) if len(args) == 2
+    and _is_array(args[0]) else None,
+    _array_transform("ngrams", _ngrams)[1]))
+
+
+def _resolve_zip(args):
+    if len(args) < 2 or not all(_is_array(a) for a in args):
+        return None
+    return T.array_of(T.row_of([(None, a.params[0]) for a in args]))
+
+
+def _emit_zip(args):
+    rt = _resolve_zip([a.type for a in args])
+    uniq, inv, scalar, _n = _pair_codes(args)
+    entr = [_arr_entries(a) for a in args]
+    outs = np.empty(max(len(uniq), 1), dtype=object)
+    outs[:] = [()] * len(outs)
+    for i, combo in enumerate(uniq):
+        if any(int(c) < 0 for c in combo):
+            continue
+        tups = [entr[j][int(c)] if int(c) < len(entr[j]) else ()
+                for j, c in enumerate(combo)]
+        L = max((len(t) for t in tups), default=0)
+        outs[i] = tuple(
+            tuple(t[k] if k < len(t) else None for t in tups)
+            for k in range(L))  # reference: zip pads shorter arrays w/ NULL
+    codes = jnp.asarray(int(inv[0]), jnp.int32) if scalar \
+        else jnp.asarray(inv.astype(np.int32))
+    return _tuple_dict_normalize(outs, ColVal(codes, all_valid(*args), rt),
+                                 rt)
+
+
+register("zip")((_resolve_zip, _emit_zip))
+
+
+# ---------------------------------------------------------------------------
+# map long tail
+# ---------------------------------------------------------------------------
+
+register("map_remove_null_values")((_map_value_fn(
+    "map_remove_null_values",
+    lambda t: tuple((k, v) for k, v in t if v is not None),
+    lambda a: a[0])))
+
+register("map_normalize")((_map_value_fn(
+    "map_normalize",
+    lambda t: (lambda s: tuple(
+        (k, (v / s if v is not None else None)) for k, v in t))
+    (sum(v for _, v in t if v is not None)),
+    lambda a: a[0] if a[0].params[1].is_floating else None)))
+
+
+def _map_subset_fn(t, keys):
+    want = set(keys)
+    return tuple((k, v) for k, v in t if k in want)
+
+
+def _emit_map_subset(args):
+    m, ks = args
+    rt = m.type
+    uniq, inv, scalar, _n = _pair_codes(args)
+    mv, kv = _arr_entries(m), _arr_entries(ks)
+    outs = np.empty(max(len(uniq), 1), dtype=object)
+    outs[:] = [()] * len(outs)
+    for i, (cm, ck) in enumerate(uniq):
+        if int(cm) < 0 or int(ck) < 0:
+            continue
+        outs[i] = _map_subset_fn(
+            mv[int(cm)] if int(cm) < len(mv) else (),
+            kv[int(ck)] if int(ck) < len(kv) else ())
+    codes = jnp.asarray(int(inv[0]), jnp.int32) if scalar \
+        else jnp.asarray(inv.astype(np.int32))
+    return _tuple_dict_normalize(outs, ColVal(codes, all_valid(m, ks), rt),
+                                 rt)
+
+
+register("map_subset")((
+    lambda args: args[0] if len(args) == 2 and _is_map(args[0])
+    and _is_array(args[1]) else None,
+    _emit_map_subset))
+
+
+def _resolve_multimap_from_entries(args):
+    a = args[0] if args else None
+    if a is None or not _is_array(a) or a.params[0].name != "ROW":
+        return None
+    fields = a.params[0].params
+    return T.map_of(fields[0][1], T.array_of(fields[1][1]))
+
+
+def _mm_from_entries(v):
+    out: dict = {}
+    for pair in v:
+        if pair is None:
+            raise ValueError("map entry cannot be null")
+        k, val = pair
+        if k is None:
+            raise ValueError("map key cannot be null")
+        out.setdefault(k, []).append(val)
+    return _map_sort((k, tuple(vs)) for k, vs in out.items())
+
+
+def _safe_mm(v):
+    try:
+        return _mm_from_entries(v)
+    except (ValueError, TypeError):
+        return None
+
+
+def _emit_multimap_from_entries(args):
+    rt = _resolve_multimap_from_entries([args[0].type])
+    vals = [_safe_mm(tuple(v)) for v in _arr_entries(args[0])]
+    return _dict_lut_result(vals, ColVal(args[0].data, args[0].valid, rt),
+                            rt)
+
+
+register("multimap_from_entries")((
+    _resolve_multimap_from_entries, _emit_multimap_from_entries))
+
+
+def _emit_map_zip_with(args):
+    m1, m2, lam = args
+    _check_lambda(lam, "map_zip_with")
+    rt = T.map_of(m1.type.params[0], lam.ret_type)
+    uniq, inv, scalar, _n = _pair_codes([m1, m2])
+    e1, e2 = _arr_entries(m1), _arr_entries(m2)
+    # flatten the unioned key space of every combo for ONE lambda apply
+    combo_keys, flat_k, flat_v1, flat_v2 = [], [], [], []
+    for ca, cb in uniq:
+        if int(ca) < 0 or int(cb) < 0:
+            combo_keys.append(None)
+            continue
+        d1 = dict(e1[int(ca)]) if int(ca) < len(e1) else {}
+        d2 = dict(e2[int(cb)]) if int(cb) < len(e2) else {}
+        keys = sorted(set(d1) | set(d2), key=repr)
+        combo_keys.append(keys)
+        for k in keys:
+            flat_k.append(k)
+            flat_v1.append(d1.get(k))
+            flat_v2.append(d2.get(k))
+    if flat_k:
+        kc = _colval_from_pylist(flat_k, lam.param_types[0])
+        v1c = _colval_from_pylist(flat_v1, lam.param_types[1])
+        v2c = _colval_from_pylist(flat_v2, lam.param_types[2])
+        res = _pylist_from_colval(
+            lam.apply({lam.params[0]: kc, lam.params[1]: v1c,
+                       lam.params[2]: v2c}), len(flat_k))
+    else:
+        res = []
+    outs = np.empty(max(len(uniq), 1), dtype=object)
+    outs[:] = [()] * len(outs)
+    off = 0
+    for i, keys in enumerate(combo_keys):
+        if keys is None:
+            continue
+        window = res[off:off + len(keys)]
+        off += len(keys)
+        outs[i] = _map_sort(zip(keys, window))
+    codes = jnp.asarray(int(inv[0]), jnp.int32) if scalar \
+        else jnp.asarray(inv.astype(np.int32))
+    return _tuple_dict_normalize(
+        outs, ColVal(codes, all_valid(m1, m2), rt), rt)
+
+
+register("map_zip_with")((
+    lambda args: T.map_of(args[0].params[0], _fn_ret(args[2]))
+    if len(args) == 3 and _is_map(args[0]) and _is_map(args[1])
+    and _is_function(args[2]) else None,
+    _emit_map_zip_with))
+
+
+def _emit_keys_values_match(name, which, quantifier):
+    def emit(args):
+        col, lam = args
+        _check_lambda(lam, name)
+        entries = _arr_entries(col)
+        lens = [len(t) for t in entries]
+        flat = [(k if which == "keys" else v)
+                for t in entries for k, v in t]
+        if flat:
+            ptype = lam.param_types[0]
+            res = _pylist_from_colval(
+                lam.apply({lam.params[0]:
+                           _colval_from_pylist(flat, ptype)}), len(flat))
+        else:
+            res = []
+        outs = []
+        off = 0
+        for L in lens:
+            window = [bool(r) if r is not None else None
+                      for r in res[off:off + L]]
+            off += L
+            if quantifier == "all":
+                v = (False if any(r is False for r in window)
+                     else (None if any(r is None for r in window) else True))
+            elif quantifier == "any":
+                v = (True if any(r is True for r in window)
+                     else (None if any(r is None for r in window)
+                           else False))
+            else:  # none
+                v = (False if any(r is True for r in window)
+                     else (None if any(r is None for r in window) else True))
+            outs.append(v)
+        return _dict_lut_result(outs, ColVal(col.data, col.valid,
+                                             T.BOOLEAN), T.BOOLEAN)
+
+    return emit
+
+
+for _nm, _which, _q in (("all_keys_match", "keys", "all"),
+                        ("any_keys_match", "keys", "any"),
+                        ("no_keys_match", "keys", "none"),
+                        ("any_values_match", "values", "any"),
+                        ("no_values_match", "values", "none")):
+    register(_nm)((
+        (lambda args: T.BOOLEAN if len(args) == 2 and _is_map(args[0])
+         and _is_function(args[1]) else None),
+        _emit_keys_values_match(_nm, _which, _q)))
+
+

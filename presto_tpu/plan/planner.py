@@ -1213,6 +1213,30 @@ class Planner:
                                                              T.UNKNOWN):
                 raise SemanticError(f"{name} lambda must return BOOLEAN")
             return self._call(name, [m, le])
+        if name in ("all_keys_match", "any_keys_match", "no_keys_match",
+                    "any_values_match", "no_values_match"):
+            if len(e.args) != 2:
+                raise SemanticError(f"{name}(map, lambda) expected")
+            m = a(e.args[0])
+            if m.type.name != "MAP":
+                raise SemanticError(f"{name} expects a MAP argument")
+            kt, vt = m.type.params
+            le = lam(e.args[1], (kt if "keys" in name else vt,))
+            if le.body.type not in (T.BOOLEAN, T.UNKNOWN):
+                raise SemanticError(f"{name} lambda must return BOOLEAN")
+            return self._call(name, [m, le])
+        if name == "map_zip_with":
+            if len(e.args) != 3:
+                raise SemanticError(
+                    "map_zip_with(map, map, lambda) expected")
+            m1, m2 = a(e.args[0]), a(e.args[1])
+            if m1.type.name != "MAP" or m2.type.name != "MAP":
+                raise SemanticError("map_zip_with expects two MAP arguments")
+            kt = T.common_super_type(m1.type.params[0], m2.type.params[0])
+            if kt is None:
+                raise SemanticError("map_zip_with key types are incompatible")
+            le = lam(e.args[2], (kt, m1.type.params[1], m2.type.params[1]))
+            return self._call(name, [m1, m2, le])
         if name == "zip_with":
             if len(e.args) != 3:
                 raise SemanticError("zip_with(array, array, lambda) expected")

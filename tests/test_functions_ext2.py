@@ -1,0 +1,292 @@
+"""Round-5 scalar batch: volatile functions, inverse CDFs, color
+functions, string/array/map long tail.
+
+Reference: presto-main/.../operator/scalar/ — MathFunctions (random,
+inverse*Cdf, cosineSimilarity), UuidFunction, ColorFunctions,
+StringFunctions (splitToMap/splitToMultimap/strrpos), WordStemFunction,
+KeySamplingPercentFunction, ArrayFunctions + MapFunctions long tail,
+and the volatile-query cache semantics (a cached compiled program must
+not freeze now()/random() — exec/executor._volatile_nonce).
+"""
+
+import math
+import time
+
+import pytest
+
+import presto_tpu
+from presto_tpu.catalog import Catalog
+
+
+@pytest.fixture(scope="module")
+def s():
+    return presto_tpu.connect(Catalog())
+
+
+def one(s, sql):
+    rows = s.sql(sql).rows
+    assert len(rows) == 1
+    return rows[0][0] if len(rows[0]) == 1 else rows[0]
+
+
+# ---------------------------------------------------------------------
+# volatile functions + cache-freshness semantics
+# ---------------------------------------------------------------------
+
+def test_now_is_fresh_across_executions_of_same_text(s):
+    """Regression: the compiled-plan cache used to bake the first
+    execution's instant into the program, so a re-run of the SAME query
+    text returned a stale now()."""
+    a = one(s, "SELECT now()")
+    time.sleep(0.01)
+    b = one(s, "SELECT now()")
+    assert a != b
+
+
+def test_random_per_row_and_per_execution(s):
+    q = "SELECT random() FROM (VALUES (1),(2),(3),(4)) AS t(x)"
+    r1 = [v[0] for v in s.sql(q).rows]
+    assert len(set(r1)) == 4  # per-row, not one value broadcast
+    assert all(0.0 <= v < 1.0 for v in r1)
+    r2 = [v[0] for v in s.sql(q).rows]
+    assert r1 != r2  # per-execution fresh despite identical text
+
+
+def test_random_bounded(s):
+    vals = [one(s, "SELECT random(10)") for _ in range(8)]
+    assert all(0 <= v < 10 for v in vals)
+    # result type follows the bound's type (reference: random(n) is
+    # typed per overload)
+    assert one(s, "SELECT typeof(random(10))").lower() in (
+        "integer", "bigint")
+
+
+def test_rand_alias(s):
+    assert 0.0 <= one(s, "SELECT rand()") < 1.0
+
+
+def test_uuid_shape_and_uniqueness(s):
+    rows = s.sql("SELECT uuid() FROM (VALUES (1),(2),(3)) AS t(x)").rows
+    vals = [r[0] for r in rows]
+    assert len(set(vals)) == 3
+    for v in vals:
+        assert len(v) == 36 and v.count("-") == 4
+
+
+def test_shuffle_is_a_permutation(s):
+    v = one(s, "SELECT shuffle(ARRAY[1,2,3,4,5,6,7,8])")
+    assert sorted(v) == [1, 2, 3, 4, 5, 6, 7, 8]
+
+
+# ---------------------------------------------------------------------
+# inverse CDFs — round-trip against the engine's own forward CDFs plus
+# externally-known constants
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("fwd,inv,args,p", [
+    ("beta_cdf(2.0, 5.0, {v})", "inverse_beta_cdf(2.0, 5.0, {p})", (), 0.3),
+    ("chi_squared_cdf(3.0, {v})", "inverse_chi_squared_cdf(3.0, {p})",
+     (), 0.95),
+    ("gamma_cdf(2.0, 2.0, {v})", "inverse_gamma_cdf(2.0, 2.0, {p})",
+     (), 0.5),
+    ("f_cdf(5.0, 2.0, {v})", "inverse_f_cdf(5.0, 2.0, {p})", (), 0.7),
+    ("laplace_cdf(1.0, 2.0, {v})", "inverse_laplace_cdf(1.0, 2.0, {p})",
+     (), 0.25),
+    ("logistic_cdf(0.0, 1.0, {v})", "inverse_logistic_cdf(0.0, 1.0, {p})",
+     (), 0.75),
+    ("weibull_cdf(1.5, 1.0, {v})", "inverse_weibull_cdf(1.5, 1.0, {p})",
+     (), 0.5),
+])
+def test_inverse_cdf_round_trip(s, fwd, inv, args, p):
+    v = one(s, f"SELECT {inv.format(p=p)}")
+    back = one(s, f"SELECT {fwd.format(v=v)}")
+    assert back == pytest.approx(p, abs=1e-6)
+
+
+def test_inverse_cdf_known_values(s):
+    # chi^2(df=3) 95th percentile = 7.8147 (standard table value)
+    assert one(s, "SELECT inverse_chi_squared_cdf(3.0, 0.95)") == \
+        pytest.approx(7.8147, abs=1e-3)
+    # logistic closed form: mu + s*ln(p/(1-p))
+    assert one(s, "SELECT inverse_logistic_cdf(0.0, 1.0, 0.75)") == \
+        pytest.approx(math.log(3.0), abs=1e-9)
+    assert one(s, "SELECT inverse_laplace_cdf(0.0, 1.0, 0.25)") == \
+        pytest.approx(-math.log(2.0), abs=1e-9)
+
+
+def test_inverse_discrete_cdfs(s):
+    assert one(s, "SELECT inverse_poisson_cdf(3.0, 0.5)") == 3
+    assert one(s, "SELECT inverse_binomial_cdf(20, 0.5, 0.5)") == 10
+    # smallest k with CDF >= p, CDF(k) must reach p and CDF(k-1) must not
+    k = one(s, "SELECT inverse_poisson_cdf(10.0, 0.9)")
+    hi = one(s, f"SELECT poisson_cdf(10.0, {k})")
+    lo = one(s, f"SELECT poisson_cdf(10.0, {k - 1})")
+    assert lo < 0.9 <= hi
+
+
+def test_inverse_cdf_out_of_range_p_is_null(s):
+    assert s.sql("SELECT inverse_beta_cdf(2.0, 5.0, 1.5)").rows[0][0] \
+        is None or math.isnan(
+            s.sql("SELECT inverse_beta_cdf(2.0, 5.0, 1.5)").rows[0][0])
+
+
+def test_cosine_similarity(s):
+    assert one(
+        s, "SELECT cosine_similarity(MAP(ARRAY['a','b'], ARRAY[1.0,2.0]),"
+        " MAP(ARRAY['a','b'], ARRAY[2.0,4.0]))") == pytest.approx(1.0)
+    assert one(
+        s, "SELECT cosine_similarity(MAP(ARRAY['a'], ARRAY[1.0]),"
+        " MAP(ARRAY['b'], ARRAY[1.0]))") == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------
+# string long tail
+# ---------------------------------------------------------------------
+
+def test_strrpos(s):
+    assert one(s, "SELECT strrpos('abcabc', 'b')") == 5
+    assert one(s, "SELECT strrpos('abcabc', 'b', 2)") == 2
+    assert one(s, "SELECT strrpos('abc', 'z')") == 0
+
+
+def test_split_to_map(s):
+    assert one(s, "SELECT split_to_map('a=1,b=2', ',', '=')") == \
+        (("a", "1"), ("b", "2"))
+    assert one(s, "SELECT split_to_multimap('a=1,a=2,b=3', ',', '=')") == \
+        (("a", ("1", "2")), ("b", ("3",)))
+    # duplicate keys are an error for the map form -> NULL entry here
+    assert s.sql("SELECT split_to_map('a=1,a=2', ',', '=')").rows[0][0] \
+        is None
+
+
+def test_word_stem_porter(s):
+    cases = {"running": "run", "ponies": "poni", "caresses": "caress",
+             "relational": "relat", "hopeful": "hope", "sky": "sky"}
+    for w, st in cases.items():
+        assert one(s, f"SELECT word_stem('{w}')") == st
+    # over a column (dictionary path)
+    rows = s.sql("SELECT word_stem(x) FROM "
+                 "(VALUES ('flies'),('denied')) AS t(x)").rows
+    assert [r[0] for r in rows] == ["fli", "deni"]
+
+
+def test_key_sampling_percent(s):
+    v = one(s, "SELECT key_sampling_percent('some_key')")
+    assert 0.0 <= v < 1.0
+    assert v == one(s, "SELECT key_sampling_percent('some_key')")
+
+
+# ---------------------------------------------------------------------
+# color functions
+# ---------------------------------------------------------------------
+
+def test_color_codes(s):
+    assert one(s, "SELECT color('red')") == -2
+    assert one(s, "SELECT color('#f00')") == 0xFF0000
+    assert one(s, "SELECT rgb(16, 32, 48)") == (16 << 16) | (32 << 8) | 48
+
+
+def test_render_and_bar(s):
+    assert one(s, "SELECT render('hi', color('red'))") == \
+        "\x1b[31mhi\x1b[0m"
+    assert one(s, "SELECT render(true)") == "\x1b[32m✔\x1b[0m"
+    assert one(s, "SELECT render(false)") == "\x1b[31m✘\x1b[0m"
+    b = one(s, "SELECT bar(0.5, 10)")
+    assert b.count("█") == 5 and b.endswith("\x1b[0m" + " " * 5)
+
+
+# ---------------------------------------------------------------------
+# array long tail
+# ---------------------------------------------------------------------
+
+def test_array_frequency(s):
+    assert one(s, "SELECT array_frequency(ARRAY[1,1,2,NULL])") == \
+        ((1, 2), (2, 1))
+
+
+def test_array_cum_sum(s):
+    assert one(s, "SELECT array_cum_sum(ARRAY[1,2,3])") == (1, 3, 6)
+    assert one(s, "SELECT array_cum_sum(ARRAY[1.5, 2.5])") == (1.5, 4.0)
+    assert one(s, "SELECT array_cum_sum(ARRAY[1, NULL, 2])") == \
+        (1, None, None)
+
+
+def test_array_normalize(s):
+    assert one(s, "SELECT array_normalize(ARRAY[3.0, 4.0], 2)") == \
+        pytest.approx((0.6, 0.8))
+    assert one(s, "SELECT array_normalize(ARRAY[0.0, 0.0], 2)") == \
+        (0.0, 0.0)
+
+
+def test_array_sort_desc(s):
+    assert one(s, "SELECT array_sort_desc(ARRAY[1,3,2])") == (3, 2, 1)
+    assert one(s, "SELECT array_sort_desc(ARRAY[1, NULL, 2])") == \
+        (2, 1, None)
+
+
+def test_combinations_and_ngrams(s):
+    assert one(s, "SELECT combinations(ARRAY[1,2,3], 2)") == \
+        ((1, 2), (1, 3), (2, 3))
+    assert one(s, "SELECT ngrams(ARRAY['a','b','c'], 2)") == \
+        (("a", "b"), ("b", "c"))
+    assert one(s, "SELECT ngrams(ARRAY['a'], 3)") == (("a",),)
+
+
+def test_zip_pads_with_null(s):
+    assert one(s, "SELECT zip(ARRAY[1,2], ARRAY['a','b','c'])") == \
+        ((1, "a"), (2, "b"), (None, "c"))
+
+
+# ---------------------------------------------------------------------
+# map long tail
+# ---------------------------------------------------------------------
+
+def test_map_remove_null_values(s):
+    assert one(s, "SELECT map_remove_null_values("
+               "MAP(ARRAY['a','b'], ARRAY[1, NULL]))") == (("a", 1),)
+
+
+def test_map_normalize(s):
+    assert one(s, "SELECT map_normalize("
+               "MAP(ARRAY['a','b'], ARRAY[1.0, 3.0]))") == \
+        (("a", 0.25), ("b", 0.75))
+
+
+def test_map_subset(s):
+    assert one(s, "SELECT map_subset(MAP(ARRAY['a','b'], ARRAY[1,2]), "
+               "ARRAY['a','c'])") == (("a", 1),)
+
+
+def test_multimap_from_entries(s):
+    assert one(s, "SELECT multimap_from_entries("
+               "ARRAY[ROW('a',1), ROW('a',2), ROW('b',3)])") == \
+        (("a", (1, 2)), ("b", (3,)))
+
+
+def test_map_zip_with(s):
+    assert one(s, "SELECT map_zip_with("
+               "MAP(ARRAY['a','b'], ARRAY[1,2]), "
+               "MAP(ARRAY['b','c'], ARRAY[10,20]), "
+               "(k, v1, v2) -> coalesce(v1,0) + coalesce(v2,0))") == \
+        (("a", 1), ("b", 12), ("c", 20))
+
+
+def test_keys_values_match_family(s):
+    assert one(s, "SELECT all_keys_match(MAP(ARRAY['a','ab'], "
+               "ARRAY[1,2]), k -> length(k) >= 1)") is True
+    assert one(s, "SELECT any_keys_match(MAP(ARRAY['a'], ARRAY[1]), "
+               "k -> k = 'z')") is False
+    assert one(s, "SELECT no_keys_match(MAP(ARRAY['a'], ARRAY[1]), "
+               "k -> k = 'z')") is True
+    assert one(s, "SELECT any_values_match(MAP(ARRAY['a','b'], "
+               "ARRAY[1,2]), v -> v > 1)") is True
+    assert one(s, "SELECT no_values_match(MAP(ARRAY['a'], ARRAY[1]), "
+               "v -> v > 5)") is True
+
+
+def test_match_family_null_three_valued(s):
+    # no TRUE, one NULL -> NULL (the reference's three-valued quantifier)
+    assert s.sql("SELECT any_values_match(MAP(ARRAY['a','b'], "
+                 "ARRAY[1, NULL]), v -> v > 5)").rows[0][0] is None
+    assert s.sql("SELECT all_keys_match(MAP(ARRAY['a'], ARRAY[1]), "
+                 "k -> k > 'z')").rows[0][0] is False
