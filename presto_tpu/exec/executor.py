@@ -1386,7 +1386,7 @@ class Executor:
             vals, ok = K.group_percentile(x, valid, gid, n_groups, p)
             return Column(vals.astype(col.data.dtype), ok, a.type,
                           col.dictionary)
-        if a.fn in ("min_by", "max_by"):
+        if a.fn in ("min_by", "max_by") and len(a.args) == 2:
             yv = to_column(eval_expr(a.args[1], b, self.ctx), b.capacity)
             # rank by KEY validity only: the winning row's value may be
             # NULL and must be returned as NULL (Presto MinMaxByNState)
@@ -1539,6 +1539,13 @@ class Executor:
                                           key=lambda p: repr(p[0])))
                              for g in groups]
             return _tuples_to_dict_column(tuples, nonempty, a.type)
+        if a.fn in ("set_agg", "set_union", "map_union_sum",
+                    "approx_most_frequent", "reduce_agg") \
+                or (a.fn in ("min_by", "max_by") and len(a.args) == 3):
+            if self.static:
+                raise StaticFallback(f"{a.fn} is dynamic-mode only")
+            return self._agg_column_host(b, a, gid, n_groups, col, valid,
+                                         nonempty)
         if a.fn == "geometric_mean":
             x = jnp.where(valid, col.data.astype(jnp.float64), 1.0)
             s = K.segment_sum(jnp.log(jnp.maximum(x, 1e-300)), gid, n_groups)
@@ -1807,6 +1814,165 @@ class Executor:
                          for g in groups]
             return _tuples_to_dict_column(tuples, nonempty, a.type)
         raise ExecutionError(f"aggregate {a.fn} not implemented")
+
+    def _agg_column_host(self, b: Batch, a: ir.AggCall, gid, n_groups,
+                         col, valid, nonempty) -> Column:
+        """Host-side ragged aggregates added in round 5 (reference:
+        SetAggregationFunction / SetUnionFunction / MapUnionSumAggregation
+        / ApproximateMostFrequent / MinMaxByNAggregationFunction /
+        ReduceAggregationFunction) — same dynamic-mode host-build shape
+        as array_agg/map_agg above."""
+
+        def decode(c):
+            d = np.asarray(c.data)
+            if c.dictionary is not None:
+                d = c.dictionary.values[np.clip(d, 0, len(c.dictionary) - 1)]
+            return d
+
+        gidh = np.asarray(gid)
+        vh = np.asarray(valid)
+        data = decode(col)
+
+        def host(v):
+            v = v.item() if hasattr(v, "item") else v
+            return str(v) if isinstance(v, np.str_) else v
+
+        if a.fn == "set_agg":
+            groups = [dict() for _ in range(n_groups)]  # ordered distinct
+            for row in np.flatnonzero(vh):
+                g = int(gidh[row])
+                if 0 <= g < n_groups:
+                    groups[g].setdefault(host(data[row]))
+            tuples = np.empty(n_groups, dtype=object)
+            tuples[:] = [tuple(g) for g in groups]
+            return _tuples_to_dict_column(tuples, nonempty, a.type)
+        if a.fn == "set_union":
+            groups = [dict() for _ in range(n_groups)]
+            for row in np.flatnonzero(vh):
+                g = int(gidh[row])
+                if 0 <= g < n_groups:
+                    for e in data[row]:
+                        groups[g].setdefault(e)
+            tuples = np.empty(n_groups, dtype=object)
+            tuples[:] = [tuple(g) for g in groups]
+            return _tuples_to_dict_column(tuples, nonempty, a.type)
+        if a.fn == "map_union_sum":
+            groups = [dict() for _ in range(n_groups)]
+            for row in np.flatnonzero(vh):
+                g = int(gidh[row])
+                if 0 <= g < n_groups:
+                    for k, mv in data[row]:
+                        if mv is None:
+                            groups[g].setdefault(k, None)
+                        else:
+                            cur = groups[g].get(k)
+                            groups[g][k] = mv if cur is None else cur + mv
+            tuples = np.empty(n_groups, dtype=object)
+            tuples[:] = [tuple(sorted(g.items(), key=lambda p: repr(p[0])))
+                         for g in groups]
+            return _tuples_to_dict_column(tuples, nonempty, a.type)
+        if a.fn == "approx_most_frequent":
+            # exact counting + top-K truncation: a superset of the
+            # reference's stream-summary guarantee at this scale
+            bk = np.asarray(eval_expr(a.args[0], b, self.ctx).data)
+            buckets = int(bk if bk.ndim == 0 else bk.flat[0])
+            vcol = to_column(eval_expr(a.args[1], b, self.ctx), b.capacity)
+            vdata = decode(vcol)
+            vvalid = np.asarray(b.sel if vcol.valid is None
+                                else (b.sel & vcol.valid))
+            counts = [dict() for _ in range(n_groups)]
+            for row in np.flatnonzero(vvalid):
+                g = int(gidh[row])
+                if 0 <= g < n_groups:
+                    k = host(vdata[row])
+                    counts[g][k] = counts[g].get(k, 0) + 1
+            tuples = np.empty(n_groups, dtype=object)
+            tuples[:] = [
+                tuple(sorted(
+                    sorted(g.items(), key=lambda p: (-p[1], repr(p[0])))
+                    [:buckets], key=lambda p: repr(p[0])))
+                for g in counts]
+            ok = jnp.asarray(
+                np.asarray([len(g) > 0 for g in counts], bool))
+            return _tuples_to_dict_column(tuples, ok, a.type)
+        if a.fn in ("min_by", "max_by"):  # 3-arg: top-n by key
+            ycol = to_column(eval_expr(a.args[1], b, self.ctx), b.capacity)
+            ydata = decode(ycol)
+            yvalid = vh if ycol.valid is None else (vh & np.asarray(
+                ycol.valid))
+            nv = np.asarray(eval_expr(a.args[2], b, self.ctx).data)
+            topn = int(nv if nv.ndim == 0 else nv.flat[0])
+            xvalid = np.ones(b.capacity, bool) if col.valid is None \
+                else np.asarray(col.valid)
+            rows_by_g = [[] for _ in range(n_groups)]
+            for row in np.flatnonzero(np.asarray(b.sel) & yvalid):
+                g = int(gidh[row])
+                if 0 <= g < n_groups:
+                    rows_by_g[g].append(row)
+            tuples = np.empty(n_groups, dtype=object)
+            out = []
+            for g_rows in rows_by_g:
+                g_rows.sort(key=lambda r: host(ydata[r]),
+                            reverse=(a.fn == "max_by"))
+                out.append(tuple(
+                    host(data[r]) if xvalid[r] else None
+                    for r in g_rows[:topn]))
+            tuples[:] = out
+            return _tuples_to_dict_column(tuples, nonempty, a.type)
+        # reduce_agg: vectorized input apply + per-level tree combine
+        from presto_tpu.exec.colval import LambdaVal
+
+        _value_ref, init_ref, in_lam, comb_lam = a.args
+        in_l = LambdaVal(in_lam.params, in_lam.param_types, in_lam.body,
+                         self.ctx, in_lam.type)
+        comb_l = LambdaVal(comb_lam.params, comb_lam.param_types,
+                           comb_lam.body, self.ctx, comb_lam.type)
+        from presto_tpu.functions.scalar import (_colval_from_pylist,
+                                                 _pylist_from_colval)
+
+        init_v = eval_expr(init_ref, b, self.ctx)
+        init_host = _pylist_from_colval(init_v, 1)[0]
+        st = a.type
+        rows = np.flatnonzero(vh)
+        vals = [host(data[r]) for r in rows]
+        if vals:
+            states = _pylist_from_colval(
+                in_l.apply({
+                    in_lam.params[0]: _colval_from_pylist(
+                        [init_host] * len(vals), st),
+                    in_lam.params[1]: _colval_from_pylist(
+                        vals, col.type)}), len(vals))
+        else:
+            states = []
+        per_group: list = [[] for _ in range(n_groups)]
+        for r, s in zip(rows, states):
+            g = int(gidh[r])
+            if 0 <= g < n_groups:
+                per_group[g].append(s)
+        # tree combine: one vectorized lambda apply per level
+        while any(len(g) > 1 for g in per_group):
+            lefts, rights, slots = [], [], []
+            for gi, g in enumerate(per_group):
+                nxt = []
+                i = 0
+                while i + 1 < len(g):
+                    slots.append((gi, len(nxt)))
+                    lefts.append(g[i])
+                    rights.append(g[i + 1])
+                    nxt.append(None)  # placeholder
+                    i += 2
+                if i < len(g):
+                    nxt.append(g[i])
+                per_group[gi] = nxt
+            combined = _pylist_from_colval(
+                comb_l.apply({
+                    comb_lam.params[0]: _colval_from_pylist(lefts, st),
+                    comb_lam.params[1]: _colval_from_pylist(rights, st)}),
+                len(lefts))
+            for (gi, si), val in zip(slots, combined):
+                per_group[gi][si] = val
+        results = [g[0] if g else None for g in per_group]
+        return to_column(_colval_from_pylist(results, st), n_groups)
 
     def _merge_agg_column(self, b: Batch, a: ir.AggCall, gid, n_groups,
                           mask) -> Column:

@@ -109,8 +109,14 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
     if name == "checksum":
         return T.BIGINT
     if name in ("min_by", "max_by"):
+        if len(arg_types) == 3:
+            # n-variant: the n smallest/largest keys' values as an array
+            # (reference: MinMaxByNAggregationFunction)
+            if not arg_types[2].is_integer:
+                raise TypeError(f"{name}(value, key, n): n must be integer")
+            return T.array_of(arg_types[0])
         if len(arg_types) != 2:
-            raise TypeError(f"{name} takes (value, key)")
+            raise TypeError(f"{name} takes (value, key[, n])")
         return arg_types[0]
     if name == "geometric_mean":
         return T.DOUBLE
@@ -141,6 +147,29 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         if len(arg_types) != 2:
             raise TypeError("map_agg takes (key, value)")
         return T.map_of(arg_types[0], arg_types[1])
+    if name == "set_agg":
+        # distinct values as an array (reference: SetAggregationFunction)
+        return T.array_of(arg_types[0])
+    if name == "set_union":
+        if arg_types[0].name != "ARRAY":
+            raise TypeError("set_union takes an ARRAY argument")
+        return arg_types[0]
+    if name == "map_union_sum":
+        if arg_types[0].name != "MAP" \
+                or not arg_types[0].params[1].is_numeric:
+            raise TypeError("map_union_sum takes a MAP(K, numeric)")
+        return arg_types[0]
+    if name == "approx_most_frequent":
+        if len(arg_types) != 3:
+            raise TypeError(
+                "approx_most_frequent takes (buckets, value, capacity)")
+        return T.map_of(arg_types[1], T.BIGINT)
+    if name == "reduce_agg":
+        # (value, init_state, input_lambda, combine_lambda) -> state
+        if len(arg_types) < 2:
+            raise TypeError(
+                "reduce_agg takes (value, state, input_fn, combine_fn)")
+        return arg_types[1]
     if name == "multimap_agg":
         if len(arg_types) != 2:
             raise TypeError("multimap_agg takes (key, value)")
@@ -158,6 +187,8 @@ AGG_NAMES = {
     "regr_slope", "regr_intercept", "skewness", "kurtosis", "entropy",
     "bitwise_and_agg", "bitwise_or_agg", "histogram", "numeric_histogram",
     "map_union", "learn_classifier", "learn_regressor",
+    "set_agg", "set_union", "map_union_sum", "approx_most_frequent",
+    "reduce_agg",
 }
 
 

@@ -971,7 +971,59 @@ class Planner:
                                        f.name if f else None, sym, rex.type))
         aggs: Dict[str, ir.AggCall] = {}
         agg_map: Dict[int, Tuple[str, T.Type]] = {}
+        def _agg_lambda(l, ptypes, name):
+            """Type a lambda aggregate argument (reduce_agg) against the
+            enclosing scope — same shape as the scalar-HOF `lam` helper."""
+            if not isinstance(l, ast.Lambda):
+                raise SemanticError(f"{name} expects a lambda argument")
+            if len(l.params) != len(ptypes):
+                raise SemanticError(
+                    f"{name} lambda must take {len(ptypes)} argument(s)")
+            syms = [self.symbols.new(f"lam_{p}") for p in l.params]
+            inner = Scope([Field_(None, p, sy, t) for p, sy, t
+                           in zip(l.params, syms, ptypes)], parent=scope)
+            body = self.analyze(l.body, inner)
+            return ir.LambdaExpr(tuple(syms), tuple(ptypes), body,
+                                 T.function_type(body.type))
+
         for fc, _ in agg_calls:
+            if fc.name.lower() == "reduce_agg":
+                # reduce_agg(value, init, (s,v)->s, (s,s)->s) — the
+                # lambdas ride the AggCall unevaluated (reference:
+                # ReduceAggregationFunction)
+                if len(fc.args) != 4:
+                    raise SemanticError(
+                        "reduce_agg(input, init, input_fn, combine_fn) "
+                        "expected")
+                arg_refs = []
+                for a in fc.args[:2]:
+                    ae = self.analyze(a, scope)
+                    if isinstance(ae, ir.Ref):
+                        arg_refs.append(ae)
+                    else:
+                        s2 = self.symbols.new("aggarg")
+                        pre_assigns[s2] = ae
+                        arg_refs.append(ir.Ref(s2, ae.type))
+                st = arg_refs[1].type
+                in_lam = _agg_lambda(fc.args[2], (st, arg_refs[0].type),
+                                     "reduce_agg")
+                if in_lam.body.type != st:
+                    in_lam = ir.LambdaExpr(
+                        in_lam.params, in_lam.param_types,
+                        ir.CastExpr(in_lam.body, st), T.function_type(st))
+                comb_lam = _agg_lambda(fc.args[3], (st, st), "reduce_agg")
+                if comb_lam.body.type != st:
+                    comb_lam = ir.LambdaExpr(
+                        comb_lam.params, comb_lam.param_types,
+                        ir.CastExpr(comb_lam.body, st),
+                        T.function_type(st))
+                s = self.symbols.new(fc.name)
+                aggs[s] = ir.AggCall(
+                    "reduce_agg",
+                    (arg_refs[0], arg_refs[1], in_lam, comb_lam), st,
+                    fc.distinct, None)
+                agg_map[id(fc)] = (s, st)
+                continue
             arg_refs = []
             for a in fc.args:
                 ae = self.analyze(a, scope)

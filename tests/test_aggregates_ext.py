@@ -1,0 +1,93 @@
+"""Round-5 aggregate batch: set_agg/set_union, map_union_sum,
+approx_most_frequent, min_by/max_by(x, y, n), reduce_agg.
+
+Reference: presto-main/.../operator/aggregation/ —
+SetAggregationFunction, SetUnionFunction, MapUnionSumAggregation,
+ApproximateMostFrequent, MinMaxByNAggregationFunction,
+ReduceAggregationFunction.
+"""
+
+import pytest
+
+import presto_tpu
+from presto_tpu.catalog import Catalog
+
+
+@pytest.fixture(scope="module")
+def s():
+    return presto_tpu.connect(Catalog())
+
+
+def one(s, sql):
+    rows = s.sql(sql).rows
+    assert len(rows) == 1
+    return rows[0][0]
+
+
+def test_set_agg_dedups(s):
+    assert one(s, "SELECT set_agg(x) FROM "
+               "(VALUES (1),(2),(1),(3),(2)) AS t(x)") == (1, 2, 3)
+
+
+def test_set_agg_grouped(s):
+    rows = s.sql("SELECT g, set_agg(x) FROM (VALUES (1,'a'),(1,'b'),"
+                 "(1,'a'),(2,'c')) AS t(g,x) GROUP BY g ORDER BY g").rows
+    assert rows == [(1, ("a", "b")), (2, ("c",))]
+
+
+def test_set_union(s):
+    assert one(s, "SELECT set_union(a) FROM (SELECT ARRAY[1,2] AS a "
+               "UNION ALL SELECT ARRAY[2,3])") == (1, 2, 3)
+
+
+def test_map_union_sum(s):
+    assert one(s, "SELECT map_union_sum(m) FROM "
+               "(SELECT MAP(ARRAY['a','b'], ARRAY[1,2]) AS m UNION ALL "
+               "SELECT MAP(ARRAY['b','c'], ARRAY[10,20]))") == \
+        (("a", 1), ("b", 12), ("c", 20))
+
+
+def test_approx_most_frequent(s):
+    assert one(s, "SELECT approx_most_frequent(2, x, 10) FROM (VALUES "
+               "('a'),('b'),('a'),('c'),('a'),('b')) AS t(x)") == \
+        (("a", 3), ("b", 2))
+
+
+def test_min_max_by_n(s):
+    assert one(s, "SELECT min_by(x, y, 2) FROM (VALUES ('a',3),('b',1),"
+               "('c',2)) AS t(x,y)") == ("b", "c")
+    assert one(s, "SELECT max_by(x, y, 2) FROM (VALUES ('a',3),('b',1),"
+               "('c',2)) AS t(x,y)") == ("a", "c")
+    # n larger than the group: whole group, ordered
+    assert one(s, "SELECT max_by(x, y, 9) FROM (VALUES ('a',1),('b',2))"
+               " AS t(x,y)") == ("b", "a")
+
+
+def test_min_max_by_2arg_still_scalar(s):
+    assert one(s, "SELECT min_by(x, y) FROM (VALUES ('a',3),('b',1))"
+               " AS t(x,y)") == "b"
+
+
+def test_reduce_agg_sum(s):
+    assert one(s, "SELECT reduce_agg(x, 0, (s, v) -> s + v, "
+               "(a, b) -> a + b) FROM (VALUES (1),(2),(3),(4)) "
+               "AS t(x)") == 10
+
+
+def test_reduce_agg_grouped_product(s):
+    rows = s.sql("SELECT g, reduce_agg(x, 1, (s, v) -> s * v, "
+                 "(a, b) -> a * b) FROM (VALUES (1,2),(1,3),(2,5)) "
+                 "AS t(g,x) GROUP BY g ORDER BY g").rows
+    assert rows == [(1, 6), (2, 5)]
+
+
+def test_reduce_agg_double_state(s):
+    # state widens via the cast the analyzer inserts on the lambda body
+    assert one(s, "SELECT reduce_agg(x, 0.0, (s, v) -> s + v * v, "
+               "(a, b) -> a + b) FROM (VALUES (1),(2),(3)) AS t(x)") == \
+        pytest.approx(14.0)
+
+
+def test_empty_groups_are_null(s):
+    assert one(s, "SELECT set_agg(x) FROM (VALUES "
+               "(CAST(NULL AS INTEGER))) AS t(x)") is None
