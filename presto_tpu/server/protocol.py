@@ -124,6 +124,7 @@ class PrestoTpuServer:
             with self.jobs_lock:
                 self.active_queries -= 1
             return
+        t0 = time.monotonic()
         with self._sema:
             try:
                 if job.cancel.is_set():
@@ -160,7 +161,10 @@ class PrestoTpuServer:
                 job.state = "FAILED"
             finally:
                 if group is not None:
-                    rgm.release(group)
+                    # charge the query's elapsed time as CPU usage for
+                    # the group's soft/hard CPU limits (reference:
+                    # per-query cpuUsageMillis charged on completion)
+                    rgm.release(group, cpu_s=time.monotonic() - t0)
                 job.done.set()
                 with self.jobs_lock:
                     self.active_queries -= 1

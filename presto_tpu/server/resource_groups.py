@@ -1,19 +1,42 @@
-"""Resource groups: admission control for concurrent queries.
+"""Resource groups: hierarchical scheduling + admission control.
 
 Reference parity: execution/resourceGroups/InternalResourceGroup(+Manager)
 and the file-backed config in presto-resource-group-managers — a tree of
-groups with concurrency/queue limits, selectors mapping (user, source) to
-a group, and fair scheduling of queued queries.  Trimmed to the engine's
-process model: admission happens at submit time (the protocol server or
-the embedded session), release at completion; weighted subgroup
-scheduling collapses to FIFO-fair per group.
+groups with concurrency/queue limits, per-group scheduling policies
+(FAIR / WEIGHTED / WEIGHTED_FAIR / QUERY_PRIORITY), CPU limits with
+quota regeneration, selectors mapping (user, source) to a group, and
+dispatch of queued queries when capacity frees.
+
+Deviations, documented: WEIGHTED picks deterministically by stride
+(min served/weight) instead of the reference's stochastic
+proportional draw — same long-run shares, reproducible tests; CPU
+usage is charged at release (per-query), not sampled mid-flight.
 """
 
 from __future__ import annotations
 
+import itertools
 import re
 import threading
+import time
+from collections import deque
 from typing import Dict, List, Optional
+
+POLICIES = ("fair", "weighted", "weighted_fair", "query_priority")
+
+
+class _Ticket:
+    """One queued admission request (reference: the queued-query state
+    inside InternalResourceGroup)."""
+
+    __slots__ = ("group", "priority", "seq", "granted", "event")
+
+    def __init__(self, group: "ResourceGroup", priority: int, seq: int):
+        self.group = group
+        self.priority = priority
+        self.seq = seq
+        self.granted = False
+        self.event = threading.Event()
 
 
 class ResourceGroup:
@@ -28,9 +51,22 @@ class ResourceGroup:
         self.parent = parent
         self.children: Dict[str, ResourceGroup] = {}
         self.running = 0
-        self.queued = 0
+        self.queued = 0  # includes descendants (reference semantics)
         self.total_admitted = 0
         self.total_rejected = 0
+        # scheduling (applies to choosing among THIS group's children)
+        self.scheduling_policy = "fair"
+        self.scheduling_weight = 1
+        self._served = 0  # stride counter for the WEIGHTED policy
+        # CPU governance (reference: softCpuLimit/hardCpuLimit +
+        # cpuQuotaGenerationMillisPerSecond)
+        self.soft_cpu_limit_s: Optional[float] = None
+        self.hard_cpu_limit_s: Optional[float] = None
+        self.cpu_quota_generation_per_s: float = 1.0
+        self.cpu_usage_s = 0.0
+        self._last_regen: Optional[float] = None
+        # leaf admission queue
+        self._queue: deque = deque()
 
     @property
     def full_name(self) -> str:
@@ -38,10 +74,42 @@ class ResourceGroup:
             return self.name
         return f"{self.parent.full_name}.{self.name}"
 
-    def can_run(self) -> bool:
+    # ---- CPU quota ---------------------------------------------------
+    def _regenerate(self, now: float) -> None:
+        if self._last_regen is None:
+            self._last_regen = now
+            return
+        dt = max(0.0, now - self._last_regen)
+        self._last_regen = now
+        if self.cpu_usage_s > 0.0:
+            self.cpu_usage_s = max(
+                0.0, self.cpu_usage_s - dt * self.cpu_quota_generation_per_s)
+
+    def _cpu_blocked(self, now: float) -> bool:
+        self._regenerate(now)
+        return self.hard_cpu_limit_s is not None \
+            and self.cpu_usage_s > self.hard_cpu_limit_s
+
+    def _effective_weight(self, now: float) -> float:
+        """Soft CPU limit halves the group's share until quota
+        regenerates (reference: weight reduction past softCpuLimit)."""
+        self._regenerate(now)
+        w = float(max(self.scheduling_weight, 1))
+        if self.soft_cpu_limit_s is not None \
+                and self.cpu_usage_s > self.soft_cpu_limit_s:
+            w /= 2.0
+        return w
+
+    # ---- capacity ----------------------------------------------------
+    def _can_run_here(self, now: float) -> bool:
+        return self.running < self.hard_concurrency_limit \
+            and not self._cpu_blocked(now)
+
+    def can_run(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
         g: Optional[ResourceGroup] = self
         while g is not None:
-            if g.running >= g.hard_concurrency_limit:
+            if not g._can_run_here(now):
                 return False
             g = g.parent
         return True
@@ -52,25 +120,83 @@ class ResourceGroup:
             fn(g)
             g = g.parent
 
+    # ---- queue introspection ----------------------------------------
+    def _head_ticket(self, now: float) -> Optional[_Ticket]:
+        """Best dispatchable ticket under this subtree, chosen by THIS
+        group's scheduling policy at each internal node (reference:
+        InternalResourceGroup.internalStartNext)."""
+        if not self._can_run_here(now):
+            return None
+        local = None
+        if self._queue:
+            if self.scheduling_policy == "query_priority":
+                local = min(self._queue,
+                            key=lambda t: (-t.priority, t.seq))
+            else:
+                local = self._queue[0]
+        best_child: Optional[_Ticket] = None
+        candidates = []
+        for c in self.children.values():
+            t = c._head_ticket(now)
+            if t is not None:
+                candidates.append((c, t))
+        if candidates:
+            pol = self.scheduling_policy
+            if pol == "weighted":
+                c, best_child = min(
+                    candidates,
+                    key=lambda ct: (ct[0]._served
+                                    / ct[0]._effective_weight(now),
+                                    ct[1].seq))
+            elif pol == "weighted_fair":
+                c, best_child = min(
+                    candidates,
+                    key=lambda ct: (ct[0].running
+                                    / ct[0]._effective_weight(now),
+                                    ct[1].seq))
+            elif pol == "query_priority":
+                c, best_child = min(candidates,
+                                    key=lambda ct: (-ct[1].priority,
+                                                    ct[1].seq))
+            else:  # fair: global arrival order
+                c, best_child = min(candidates, key=lambda ct: ct[1].seq)
+        if local is not None and best_child is not None:
+            if self.scheduling_policy == "query_priority":
+                return local if (-local.priority, local.seq) <= \
+                    (-best_child.priority, best_child.seq) else best_child
+            return local if local.seq <= best_child.seq else best_child
+        return local or best_child
+
 
 class QueryRejected(Exception):
-    """Queue full (reference: QUERY_QUEUE_FULL error)."""
+    """Queue full or admission timeout (reference: QUERY_QUEUE_FULL)."""
 
 
 class ResourceGroupManager:
-    """Selector-driven admission (reference: InternalResourceGroupManager
-    + StaticSelector).  `acquire` blocks while the group is saturated
-    (the QUEUED state), raises QueryRejected past max_queued."""
+    """Selector-driven admission with policy-based dispatch (reference:
+    InternalResourceGroupManager + StaticSelector).  `acquire` blocks
+    while the group is saturated (the QUEUED state), raises
+    QueryRejected past max_queued or on timeout; `release` charges CPU
+    usage and dispatches the next eligible queued queries."""
 
-    def __init__(self):
+    def __init__(self, now_fn=time.monotonic):
         self.root = ResourceGroup("global")
         self._lock = threading.Lock()
-        self._wakeup = threading.Condition(self._lock)
+        self._seq = itertools.count()
+        self._now = now_fn  # injectable clock (CPU-quota tests)
         self.selectors: List[tuple] = []  # (user_re, source_re, group)
 
     # ---- configuration ----------------------------------------------
     def add_group(self, path: str, hard_concurrency_limit: int = 100,
-                  max_queued: int = 1000) -> ResourceGroup:
+                  max_queued: int = 1000,
+                  scheduling_policy: str = "fair",
+                  scheduling_weight: int = 1,
+                  soft_cpu_limit_s: Optional[float] = None,
+                  hard_cpu_limit_s: Optional[float] = None,
+                  cpu_quota_generation_per_s: float = 1.0) -> ResourceGroup:
+        if scheduling_policy not in POLICIES:
+            raise ValueError(f"unknown scheduling policy "
+                             f"'{scheduling_policy}' (one of {POLICIES})")
         parts = path.split(".")
         assert parts[0] == "global", "group paths are rooted at 'global'"
         g = self.root
@@ -80,6 +206,11 @@ class ResourceGroupManager:
             g = g.children[p]
         g.hard_concurrency_limit = hard_concurrency_limit
         g.max_queued = max_queued
+        g.scheduling_policy = scheduling_policy
+        g.scheduling_weight = scheduling_weight
+        g.soft_cpu_limit_s = soft_cpu_limit_s
+        g.hard_cpu_limit_s = hard_cpu_limit_s
+        g.cpu_quota_generation_per_s = cpu_quota_generation_per_s
         return g
 
     def add_selector(self, group_path: str, user: Optional[str] = None,
@@ -92,12 +223,20 @@ class ResourceGroupManager:
     def load_config(self, config: dict) -> None:
         """File-config shape (reference: resource-groups.json):
         {"groups": [{"name": "global.etl", "hardConcurrencyLimit": 2,
-                     "maxQueued": 5}],
+                     "maxQueued": 5, "schedulingPolicy": "weighted_fair",
+                     "schedulingWeight": 3, "softCpuLimit": "2s",
+                     "hardCpuLimit": "5s"}],
          "selectors": [{"user": "etl.*", "group": "global.etl"}]}"""
         for g in config.get("groups", []):
-            self.add_group(g["name"],
-                           g.get("hardConcurrencyLimit", 100),
-                           g.get("maxQueued", 1000))
+            self.add_group(
+                g["name"],
+                g.get("hardConcurrencyLimit", 100),
+                g.get("maxQueued", 1000),
+                str(g.get("schedulingPolicy", "fair")).lower(),
+                g.get("schedulingWeight", 1),
+                _parse_duration_s(g.get("softCpuLimit")),
+                _parse_duration_s(g.get("hardCpuLimit")),
+                g.get("cpuQuotaGenerationPerSecond", 1.0))
         for s in config.get("selectors", []):
             self.add_selector(s["group"], s.get("user"), s.get("source"))
 
@@ -118,34 +257,72 @@ class ResourceGroupManager:
         return g
 
     def acquire(self, user: str = "", source: str = "",
-                timeout: float = 60.0) -> ResourceGroup:
+                priority: int = 0,
+                timeout: Optional[float] = 60.0) -> ResourceGroup:
         group = self.select_group(user, source)
         with self._lock:
-            if not group.can_run():
-                if group.queued >= group.max_queued:
-                    group.total_rejected += 1
-                    raise QueryRejected(
-                        f"Too many queued queries for '{group.full_name}'")
-                group.queued += 1
-                try:
-                    deadline = threading.TIMEOUT_MAX if timeout is None \
-                        else timeout
-                    ok = self._wakeup.wait_for(group.can_run, timeout=deadline)
-                    if not ok:
-                        group.total_rejected += 1
-                        raise QueryRejected(
-                            f"Query queue timeout in '{group.full_name}'")
-                finally:
-                    group.queued -= 1
-            group._for_ancestors(lambda g: setattr(g, "running", g.running + 1))
-            group.total_admitted += 1
-            return group
+            now = self._now()
+            if not group._queue and group.can_run(now):
+                self._start(group)
+                return group
+            if group.queued >= group.max_queued:
+                group.total_rejected += 1
+                raise QueryRejected(
+                    f"Too many queued queries for '{group.full_name}'")
+            t = _Ticket(group, priority, next(self._seq))
+            group._queue.append(t)
+            group._for_ancestors(
+                lambda g: setattr(g, "queued", g.queued + 1))
+        t.event.wait(timeout=timeout)
+        with self._lock:
+            if t.granted:
+                # covers the grant-at-timeout-boundary race: a granted
+                # slot is never abandoned (it would leak `running`)
+                return group
+            try:
+                group._queue.remove(t)
+            except ValueError:
+                pass
+            group._for_ancestors(
+                lambda g: setattr(g, "queued", max(0, g.queued - 1)))
+            group.total_rejected += 1
+        raise QueryRejected(
+            f"Query queue timeout in '{group.full_name}'")
 
-    def release(self, group: ResourceGroup) -> None:
+    def _start(self, group: ResourceGroup) -> None:
+        group._for_ancestors(
+            lambda g: setattr(g, "running", g.running + 1))
+        group.total_admitted += 1
+        group._served += 1
+
+    def release(self, group: ResourceGroup, cpu_s: float = 0.0) -> None:
+        """Finish a query: free the slot, charge its CPU time up the
+        tree (reference: InternalResourceGroup.updateGroupsAndProcess-
+        QueuedQueries charging cpuUsageMillis), dispatch queued work."""
         with self._lock:
             group._for_ancestors(
                 lambda g: setattr(g, "running", max(0, g.running - 1)))
-            self._wakeup.notify_all()
+            if cpu_s:
+                group._for_ancestors(
+                    lambda g: setattr(g, "cpu_usage_s",
+                                      g.cpu_usage_s + cpu_s))
+            self._dispatch()
+
+    def _dispatch(self) -> None:
+        """Grant as many queued tickets as capacity allows, choosing the
+        next ticket by walking the tree under each node's policy."""
+        now = self._now()
+        while True:
+            t = self.root._head_ticket(now)
+            if t is None:
+                return
+            g = t.group
+            g._queue.remove(t)
+            g._for_ancestors(
+                lambda a: setattr(a, "queued", max(0, a.queued - 1)))
+            self._start(g)
+            t.granted = True
+            t.event.set()
 
     def info(self) -> list:
         """Flat group stats (reference: /v1/resourceGroupState)."""
@@ -156,6 +333,9 @@ class ResourceGroupManager:
                         "queued": g.queued,
                         "hardConcurrencyLimit": g.hard_concurrency_limit,
                         "maxQueued": g.max_queued,
+                        "schedulingPolicy": g.scheduling_policy,
+                        "schedulingWeight": g.scheduling_weight,
+                        "cpuUsageSeconds": round(g.cpu_usage_s, 6),
                         "totalAdmitted": g.total_admitted,
                         "totalRejected": g.total_rejected})
             for c in g.children.values():
@@ -163,3 +343,17 @@ class ResourceGroupManager:
 
         walk(self.root)
         return out
+
+
+def _parse_duration_s(v) -> Optional[float]:
+    """'5s' / '100ms' / '2m' / bare number (seconds) -> seconds."""
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = re.fullmatch(r"\s*([\d.]+)\s*(ms|s|m|h)?\s*", str(v))
+    if not m:
+        raise ValueError(f"bad duration: {v!r}")
+    n = float(m.group(1))
+    return n * {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0,
+                None: 1.0}[m.group(2)]
