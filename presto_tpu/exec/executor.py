@@ -109,6 +109,51 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
         t = session.catalog.get(stmt.table)
         rows = [(c, str(ty)) for c, ty in t.schema.items()]
         return QueryResult([("Column", T.VARCHAR), ("Type", T.VARCHAR)], rows)
+    if isinstance(stmt, ast.ShowFunctions):
+        from presto_tpu.functions import aggregate as _agg
+        from presto_tpu.functions import scalar as _sc
+
+        rows = sorted(
+            [(n, "scalar") for n in _sc.REGISTRY
+             if not n.startswith("$")]
+            + [(n, "aggregate") for n in _agg.AGG_NAMES]
+            + [(n, "window") for n in _agg.WINDOW_ONLY])
+        return QueryResult(
+            [("Function", T.VARCHAR), ("Type", T.VARCHAR)], rows)
+    if isinstance(stmt, ast.ShowSession):
+        rows = sorted((k, str(v)) for k, v in session.properties.items())
+        return QueryResult(
+            [("Name", T.VARCHAR), ("Value", T.VARCHAR)], rows)
+    if isinstance(stmt, ast.ShowCatalogs):
+        rows = sorted((q,) for q in session.catalog.known_qualifiers)
+        return QueryResult([("Catalog", T.VARCHAR)], rows)
+    if isinstance(stmt, ast.ShowSchemas):
+        schemas = {"default"}
+        for name in session.catalog.tables:
+            parts = name.split(".")
+            if len(parts) >= 2:
+                schemas.add(parts[-2])
+        return QueryResult([("Schema", T.VARCHAR)],
+                           sorted((s,) for s in schemas))
+    if isinstance(stmt, ast.ShowStats):
+        # reference: ShowStatsRewrite — per-column connector statistics
+        # plus the table row-count summary row
+        t = session.catalog.get(stmt.table)
+        rows = []
+        for c in t.schema:
+            st = t.column_stats(c)
+            rows.append((c,
+                         float(st.ndv) if st is not None
+                         and st.ndv is not None else None,
+                         st.min if st is not None else None,
+                         st.max if st is not None else None,
+                         None))
+        rows.append((None, None, None, None, float(t.row_count())))
+        return QueryResult(
+            [("column_name", T.VARCHAR),
+             ("distinct_values_count", T.DOUBLE),
+             ("low_value", T.DOUBLE), ("high_value", T.DOUBLE),
+             ("row_count", T.DOUBLE)], rows)
     if isinstance(stmt, ast.Explain):
         if stmt.analyze:
             text_plan = explain_analyze_text(session, stmt.statement, mon)
@@ -486,7 +531,8 @@ import re as _re
 #: trace time, so volatile queries key the program caches per query.
 _VOLATILE_RE = _re.compile(
     r"\b(?:now|random|rand|uuid|shuffle)\s*\("
-    r"|\bcurrent_(?:date|time|timestamp)\b|\blocaltime(?:stamp)?\b",
+    r"|\bcurrent_(?:date|time|timestamp)\b|\blocaltime(?:stamp)?\b"
+    r"|\btablesample\b",  # lowers to a random() filter
     _re.IGNORECASE)
 
 

@@ -904,6 +904,176 @@ class MergeSorts(Rule):
         return P.Sort(child.source, node.keys)
 
 
+class PushProjectionThroughUnion(Rule):
+    """Project(Union ALL) -> Union(per-branch Projects): expressions
+    evaluate once per branch at branch width (reference:
+    rule/PushProjectionThroughUnion.java)."""
+
+    pattern = pattern(P.Project).with_source(pattern(P.Union).matching(
+        lambda n: not n.distinct))
+
+    def apply(self, node: P.Project, ctx):
+        child = ctx.resolve(node.source)
+        # identity projects die via InlineIdentityProject; pushing them
+        # would churn the memo without progress
+        if all(isinstance(e, ir.Ref) and e.name == s
+               for s, e in node.assignments.items()):
+            return None
+        new_sources, new_mappings = [], []
+        for src, mapping in zip(child.sources_, child.mappings):
+            types = ctx.resolve(src).output_types()
+            ref_map = {u: ir.Ref(m, types[m]) for u, m in mapping.items()}
+            assigns = {s: ir.substitute(e, ref_map)
+                       for s, e in node.assignments.items()}
+            new_sources.append(P.Project(src, assigns))
+            new_mappings.append({s: s for s in node.assignments})
+        new_union = dataclasses.replace(
+            child, sources_=new_sources,
+            symbols=list(node.assignments), mappings=new_mappings)
+        return _carry_attrs(child, new_union)
+
+
+class SingleDistinctAggregationToGroupBy(Rule):
+    """All aggregates DISTINCT over one shared argument list -> dedup
+    with an inner GROUP BY, then aggregate plainly (reference:
+    rule/SingleDistinctAggregationToGroupBy.java).  The rewrite turns
+    per-group distinct tracking into the engine's sort-based grouping,
+    which is the fast path on device."""
+
+    pattern = pattern(P.Aggregate).matching(
+        lambda n: n.aggs and n.step == "SINGLE"
+        and all(a.distinct for a in n.aggs.values()))
+
+    def apply(self, node: P.Aggregate, ctx):
+        calls = list(node.aggs.values())
+        if any(a.filter is not None or not a.args
+               or any(not isinstance(r, ir.Ref) for r in a.args)
+               for a in calls):
+            return None
+        if any(a.fn not in ("count", "sum", "avg", "min", "max")
+               for a in calls):
+            return None
+        arg_lists = {tuple(r.name for r in a.args) for a in calls}
+        if len(arg_lists) != 1:
+            return None
+        arg_syms = next(iter(arg_lists))
+        inner_keys = list(node.group_keys) + [
+            s for s in arg_syms if s not in node.group_keys]
+        inner = P.Aggregate(node.source, inner_keys, {}, "SINGLE")
+        new_aggs = {sym: dataclasses.replace(a, distinct=False)
+                    for sym, a in node.aggs.items()}
+        out = dataclasses.replace(node, source=inner, aggs=new_aggs)
+        return _carry_attrs(node, out)
+
+
+class PushAggregationThroughOuterJoin(Rule):
+    """Aggregate over a LEFT equi-join where every aggregate input
+    comes from the build side: pre-aggregate the build side per join
+    key, join the (much smaller) partials, and merge above (reference:
+    rule/PushAggregationThroughOuterJoin.java; the merge-above shape
+    keeps the rewrite correct for duplicate probe keys, where the
+    reference instead requires distinct probe rows).
+
+    count merges as sum(coalesce(partial, 0)) — an unmatched probe row
+    contributes 0, exactly the count over its null-extended row."""
+
+    pattern = pattern(P.Aggregate).matching(
+        lambda n: n.group_keys and n.aggs and n.step == "SINGLE"
+    ).with_source(pattern(P.Join).matching(
+        lambda n: n.join_type == "LEFT" and not n.filter
+        and len(n.criteria) == 1))
+
+    MERGEABLE = {"count": "sum", "sum": "sum", "min": "min", "max": "max"}
+
+    def apply(self, node: P.Aggregate, ctx):
+        join = ctx.resolve(node.source)
+        build = ctx.resolve(join.right)
+        if isinstance(build, P.Aggregate):
+            return None  # already pushed
+        lk, rk = join.criteria[0]
+        probe_syms = {s for s, _ in ctx.resolve(join.left).outputs()}
+        build_syms = {s for s, _ in build.outputs()}
+        if not all(k in probe_syms for k in node.group_keys):
+            return None
+        calls = list(node.aggs.items())
+        if any(a.distinct or a.filter is not None or not a.args
+               or a.fn not in self.MERGEABLE
+               or not all(isinstance(r, ir.Ref)
+                          and r.name in build_syms for r in a.args)
+               for _s, a in calls):
+            return None
+        # build-side partials, grouped by the join key
+        partial_aggs = {}
+        partial_sym = {}
+        for s, a in calls:
+            ps = f"{s}$part"
+            partial_sym[s] = ps
+            partial_aggs[ps] = ir.AggCall(a.fn, a.args,
+                                          a.type, False, None)
+        inner = P.Aggregate(join.right, [rk], partial_aggs, "SINGLE")
+        new_join = dataclasses.replace(join, right=inner)
+        _carry_attrs(join, new_join)
+        # coalesce count partials to 0 for null-extended probe rows
+        types = dict(new_join.outputs())
+        assigns = {k: ir.Ref(k, types[k]) for k in node.group_keys}
+        for s, a in calls:
+            ps = partial_sym[s]
+            ref = ir.Ref(ps, a.type)
+            if a.fn == "count":
+                assigns[ps] = ir.Call(
+                    "coalesce", (ref, ir.Lit(0, a.type)), a.type)
+            else:
+                assigns[ps] = ref
+        proj = P.Project(new_join, assigns)
+        merged = {s: ir.AggCall(self.MERGEABLE[a.fn],
+                                (ir.Ref(partial_sym[s], a.type),),
+                                a.type, False, None)
+                  for s, a in calls}
+        out = dataclasses.replace(node, source=proj, aggs=merged)
+        return _carry_attrs(node, out)
+
+
+class PushFilterThroughWindow(Rule):
+    """Filter conjuncts over ONLY the partition keys move below a
+    Window: they drop whole partitions, never rows within one, so
+    every window value is unchanged (reference:
+    rule/PushdownFilterIntoWindow.java's partition-key case)."""
+
+    pattern = pattern(P.Filter).with_source(pattern(P.Window))
+
+    def apply(self, node: P.Filter, ctx):
+        child = ctx.resolve(node.source)
+        keys = set(child.partition_by)
+        if not keys:
+            return None
+        below, keep = [], []
+        for c in ir.conjuncts(node.predicate):
+            (below if c.refs() <= keys else keep).append(c)
+        if not below:
+            return None
+        new_win = dataclasses.replace(
+            child, source=P.Filter(child.source,
+                                   ir.combine_conjuncts(below)))
+        _carry_attrs(child, new_win)
+        if keep:
+            return P.Filter(new_win, ir.combine_conjuncts(keep))
+        return new_win
+
+
+class RemoveSortOverScalar(Rule):
+    """Sort over a global Aggregate (exactly one row) is a no-op
+    (reference: RemoveRedundantSort's cardinality reasoning)."""
+
+    pattern = pattern(P.Sort)
+
+    def apply(self, node: P.Sort, ctx):
+        child = ctx.resolve(node.source)
+        if isinstance(child, P.Aggregate) and not child.group_keys \
+                and child.step == "SINGLE":
+            return child
+        return None
+
+
 DEFAULT_RULES: List[Rule] = [
     MergeFilters(), RemoveTrivialFilter(), MergeLimits(),
     MergeLimitWithSort(), PushLimitThroughProject(),
@@ -922,6 +1092,10 @@ DEFAULT_RULES: List[Rule] = [
     PushTopNThroughUnion(), RemoveRedundantDistinct(),
     RemoveLimitOverScalarAggregate(), FoldConstantComparisons(),
     MergeSorts(),
+    # round-5 batch 2
+    PushProjectionThroughUnion(), SingleDistinctAggregationToGroupBy(),
+    PushAggregationThroughOuterJoin(), PushFilterThroughWindow(),
+    RemoveSortOverScalar(),
 ]
 
 

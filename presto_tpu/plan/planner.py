@@ -454,7 +454,18 @@ class Planner:
             assignments[s] = col
             types[s] = typ
             fields.append(Field_(q, nm, s, typ))
-        return P.TableScan(name, assignments, types), Scope(fields)
+        node = P.TableScan(name, assignments, types)
+        if getattr(rel, "sample", None):
+            # TABLESAMPLE BERNOULLI(p): keep each row with probability
+            # p% (reference: SampleNode; SYSTEM trims to the same
+            # row-level bernoulli — this engine has no split-local
+            # storage granularity worth sampling by)
+            _method, pct = rel.sample
+            pred = ir.Call(
+                "lt", (ir.Call("random", (), T.DOUBLE),
+                       ir.Lit(pct / 100.0, T.DOUBLE)), T.BOOLEAN)
+            node = P.Filter(node, pred)
+        return node, Scope(fields)
 
     def _plan_values(self, rel: ast.ValuesRelation):
         rows = []

@@ -177,6 +177,9 @@ class Table(Relation):
     name: str
     alias: Optional[str] = None
     column_aliases: Optional[List[str]] = None
+    # TABLESAMPLE: ("BERNOULLI" | "SYSTEM", percentage) — reference:
+    # SqlBase.g4 sampledRelation
+    sample: Optional[tuple] = None
 
 
 @dataclass
@@ -281,6 +284,31 @@ class ShowTables(Statement):
 
 @dataclass
 class ShowColumns(Statement):
+    table: str
+
+
+@dataclass
+class ShowFunctions(Statement):
+    pass
+
+
+@dataclass
+class ShowSession(Statement):
+    pass
+
+
+@dataclass
+class ShowCatalogs(Statement):
+    pass
+
+
+@dataclass
+class ShowSchemas(Statement):
+    pass
+
+
+@dataclass
+class ShowStats(Statement):
     table: str
 
 

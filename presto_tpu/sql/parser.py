@@ -190,8 +190,23 @@ class Parser:
                 return ast.ShowTables()
             if self.accept_kw("COLUMNS"):
                 self.expect_kw("FROM")
-                return ast.ShowColumns(self.ident())
-            self.err("expected TABLES or COLUMNS")
+                return ast.ShowColumns(self.dotted_name())
+            if self._accept_word("FUNCTIONS"):
+                return ast.ShowFunctions()
+            if self.accept_kw("SESSION"):
+                return ast.ShowSession()
+            if self._accept_word("CATALOGS"):
+                return ast.ShowCatalogs()
+            if self._accept_word("SCHEMAS"):
+                return ast.ShowSchemas()
+            if self._accept_word("STATS"):
+                self.expect_kw("FOR")
+                return ast.ShowStats(self.dotted_name())
+            self.err("expected TABLES, COLUMNS, FUNCTIONS, SESSION, "
+                     "CATALOGS, SCHEMAS or STATS")
+        if self._accept_word("DESCRIBE") or self.accept_kw("DESC"):
+            # DESCRIBE t == SHOW COLUMNS FROM t (reference: SqlBase.g4)
+            return ast.ShowColumns(self.dotted_name())
         if self.accept_kw("CREATE"):
             self.expect_kw("TABLE")
             if_not_exists = False
@@ -542,7 +557,10 @@ class Parser:
         alias = None
         if self.accept_kw("AS"):
             alias = self.ident()
-        elif self.peek().kind == "ident":
+        elif self.peek().kind == "ident" \
+                and str(self.peek().value).upper() != "TABLESAMPLE":
+            # TABLESAMPLE is a sample clause, never an implicit alias
+            # (reference: SqlBase.g4 reserves it)
             alias = self.next().value
         return ast.SelectItem(e, alias)
 
@@ -625,7 +643,33 @@ class Parser:
             return rel
         name = self.dotted_name()  # catalog.schema.table — full dotted name
         alias, col_aliases = self._alias()
-        return ast.Table(name, alias, col_aliases)
+        t = ast.Table(name, alias, col_aliases)
+        if self._accept_word("TABLESAMPLE"):
+            # reference: SqlBase.g4 sampledRelation — alias precedes the
+            # sample clause; accept one after too when none came before
+            method = str(self.ident()).upper()
+            if method not in ("BERNOULLI", "SYSTEM"):
+                self.err("expected BERNOULLI or SYSTEM")
+            self.expect_op("(")
+            tok = self.next()
+            if tok.kind != "number":
+                self.err("expected a sample percentage")
+            self.expect_op(")")
+            t.sample = (method, float(tok.value))
+            if t.alias is None:
+                t.alias, t.column_aliases = self._alias()
+        return t
+
+    def _accept_word(self, word: str) -> bool:
+        """Match a non-reserved word (parsed as an identifier) without
+        growing the KEYWORDS set — SHOW FUNCTIONS must not reserve
+        'functions' as a column name."""
+        tok = self.peek()
+        if tok.kind == "ident" and str(tok.value).upper() == word:
+            self.next()
+            return True
+        return False
+
 
     def _values_row(self):
         if self.accept_op("("):
@@ -641,7 +685,10 @@ class Parser:
         col_aliases = None
         if self.accept_kw("AS"):
             alias = self.ident()
-        elif self.peek().kind == "ident":
+        elif self.peek().kind == "ident" \
+                and str(self.peek().value).upper() != "TABLESAMPLE":
+            # TABLESAMPLE is a sample clause, never an implicit alias
+            # (reference: SqlBase.g4 reserves it)
             alias = self.next().value
         if alias and self.at_op("(") and self._looks_like_column_aliases():
             self.next()

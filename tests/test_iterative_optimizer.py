@@ -472,3 +472,88 @@ def test_fold_constant_comparisons():
     if isinstance(out2, P.Filter):
         assert isinstance(out2.predicate, ir.Lit) \
             and out2.predicate.value is False
+
+
+# ---- round-5 rule batch 2 --------------------------------------------
+
+
+def test_push_projection_through_union():
+    u = P.Union([_scan(), _scan()], ["a"], [{"a": "a"}, {"a": "b"}])
+    proj = P.Project(u, {"x": ir.Call("add", (_ref("a"),
+                                              ir.Lit(1, T.BIGINT)),
+                                      T.BIGINT)})
+    out = _opt(proj)
+    assert isinstance(out, P.Union)
+    assert out.symbols == ["x"]
+    for s, m in zip(out.sources_, out.mappings):
+        assert isinstance(s, P.Project) and "x" in s.assignments
+        assert m == {"x": "x"}
+    # second branch's expression rewrote a -> b
+    assert out.sources_[1].assignments["x"].refs() == {"b"}
+
+
+def test_single_distinct_aggregation_to_group_by():
+    agg = P.Aggregate(_scan(), ["a"],
+                      {"c": ir.AggCall("count", (_ref("b"),), T.BIGINT,
+                                       distinct=True)})
+    out = _opt(agg)
+    assert isinstance(out, P.Aggregate)
+    assert not any(a.distinct for a in out.aggs.values())
+    inner = out.source
+    while isinstance(inner, P.Project):
+        inner = inner.source
+    assert isinstance(inner, P.Aggregate)
+    assert set(inner.group_keys) == {"a", "b"} and not inner.aggs
+
+
+def test_single_distinct_not_applied_to_mixed():
+    agg = P.Aggregate(_scan(), ["a"],
+                      {"c": ir.AggCall("count", (_ref("b"),), T.BIGINT,
+                                       distinct=True),
+                       "s": ir.AggCall("sum", (_ref("a"),), T.BIGINT)})
+    out = _opt(agg)
+    # mixed distinct/plain must stay as-is
+    assert any(a.distinct for a in out.aggs.values())
+
+
+def test_push_aggregation_through_left_join():
+    probe = _scan()
+    build = P.TableScan("u", {"k": "k", "v": "v"},
+                        {"k": T.BIGINT, "v": T.BIGINT})
+    join = P.Join(probe, build, "LEFT", [("a", "k")])
+    agg = P.Aggregate(join, ["a"],
+                      {"c": ir.AggCall("count", (_ref("v"),), T.BIGINT),
+                       "m": ir.AggCall("max", (_ref("v"),), T.BIGINT)})
+    out = _opt(agg)
+    assert isinstance(out, P.Aggregate)
+    assert {a.fn for a in out.aggs.values()} == {"sum", "max"}
+    # the build side of the join below is now pre-aggregated by k
+    node = out.source
+    while isinstance(node, P.Project):
+        node = node.source
+    assert isinstance(node, P.Join)
+    right = node.right
+    while isinstance(right, P.Project):
+        right = right.source
+    assert isinstance(right, P.Aggregate) and right.group_keys == ["k"]
+
+
+def test_push_filter_through_window():
+    win = P.Window(_scan(), ["a"], [("b", True, None)],
+                   {"rn": ir.AggCall("row_number", (), T.BIGINT)})
+    plan = P.Filter(win, ir.combine_conjuncts(
+        [_gt("a", 5), _gt("rn", 1)]))
+    out = _opt(plan)
+    # partition-key conjunct below the window, rn conjunct above
+    assert isinstance(out, P.Filter) and out.predicate.refs() == {"rn"}
+    w = out.source
+    assert isinstance(w, P.Window)
+    assert isinstance(w.source, P.Filter)
+    assert w.source.predicate.refs() == {"a"}
+
+
+def test_sort_over_scalar_aggregate_removed():
+    agg = P.Aggregate(_scan(), [],
+                      {"c": ir.AggCall("count", (), T.BIGINT)})
+    out = _opt(P.Sort(agg, [("c", True, None)]))
+    assert isinstance(out, P.Aggregate)

@@ -1,0 +1,85 @@
+"""SHOW FUNCTIONS/SESSION/CATALOGS/SCHEMAS/STATS, DESCRIBE, and
+TABLESAMPLE.
+
+Reference: presto-main ShowQueriesRewrite + ShowStatsRewrite
+(SHOW ... rewritten over metadata), SqlBase.g4 sampledRelation +
+SampleNode.
+"""
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.catalog import Catalog, MemoryTable
+
+
+@pytest.fixture(scope="module")
+def s():
+    cat = Catalog()
+    cat.register(MemoryTable(
+        "t", {"a": T.BIGINT, "b": T.VARCHAR},
+        {"a": np.arange(1000, dtype=np.int64),
+         "b": np.asarray([f"s{i % 7}" for i in range(1000)], object)}))
+    return presto_tpu.connect(cat)
+
+
+def test_show_functions(s):
+    rows = s.sql("SHOW FUNCTIONS").rows
+    byname = dict(rows)
+    assert byname["abs"] == "scalar"
+    assert byname["sum"] == "aggregate"
+    assert byname["row_number"] == "window"
+    assert len(rows) > 350
+
+
+def test_show_session(s):
+    rows = dict(s.sql("SHOW SESSION").rows)
+    assert "execution_mode" in rows or len(rows) > 5
+
+
+def test_show_catalogs_and_schemas(s):
+    cats = [r[0] for r in s.sql("SHOW CATALOGS").rows]
+    assert "memory" in cats
+    schemas = [r[0] for r in s.sql("SHOW SCHEMAS").rows]
+    assert "default" in schemas
+
+
+def test_describe(s):
+    rows = s.sql("DESCRIBE t").rows
+    assert rows == s.sql("DESC t").rows == \
+        s.sql("SHOW COLUMNS FROM t").rows
+    assert ("a", "BIGINT") in rows
+
+
+def test_show_stats(s):
+    rows = s.sql("SHOW STATS FOR t").rows
+    bycol = {r[0]: r for r in rows}
+    assert bycol["a"][1] == 1000.0  # ndv
+    assert bycol["a"][2] == 0.0 and bycol["a"][3] == 999.0
+    assert bycol[None][4] == 1000.0  # row_count summary row
+
+
+def test_tablesample_bernoulli(s):
+    n = s.sql("SELECT count(*) FROM t TABLESAMPLE BERNOULLI (30)"
+              ).rows[0][0]
+    assert 150 < n < 450  # ~300 expected, loose bounds
+    # 100% keeps everything, 0% nothing
+    assert s.sql("SELECT count(*) FROM t TABLESAMPLE BERNOULLI (100)"
+                 ).rows == [(1000,)]
+    assert s.sql("SELECT count(*) FROM t TABLESAMPLE BERNOULLI (0)"
+                 ).rows == [(0,)]
+
+
+def test_tablesample_fresh_across_runs(s):
+    q = "SELECT sum(a) FROM t TABLESAMPLE BERNOULLI (50)"
+    assert s.sql(q).rows != s.sql(q).rows  # volatile: no stale cache
+
+
+def test_tablesample_with_alias_and_predicate(s):
+    n = s.sql("SELECT count(*) FROM t TABLESAMPLE SYSTEM (100) x "
+              "WHERE x.a >= 500").rows[0][0]
+    assert n == 500
+    n2 = s.sql("SELECT count(*) FROM t AS x TABLESAMPLE BERNOULLI (100)"
+               ).rows[0][0]
+    assert n2 == 1000
