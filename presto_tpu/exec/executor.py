@@ -157,9 +157,39 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
     if isinstance(stmt, ast.Explain):
         if stmt.analyze:
             text_plan = explain_analyze_text(session, stmt.statement, mon)
+        elif stmt.type_ == "VALIDATE":
+            # reference: ExplainType.VALIDATE — analysis only
+            plan_statement(session, stmt.statement)
+            return QueryResult([("Valid", T.BOOLEAN)], [(True,)])
+        elif stmt.type_ == "DISTRIBUTED":
+            text_plan = explain_distributed_text(session, stmt.statement)
         else:
             text_plan = explain_text(session, stmt.statement)
         return QueryResult([("Query Plan", T.VARCHAR)], [(text_plan,)])
+    if isinstance(stmt, ast.DescribeInput):
+        # reference: DescribeInputRewrite — parameter positions; types
+        # are unresolved without binding, reported as 'unknown'
+        prepared = getattr(session, "prepared_statements", {}).get(stmt.name)
+        if prepared is None:
+            raise ExecutionError(f"prepared statement '{stmt.name}' not found")
+        rows = [(i, "unknown")
+                for i in range(_count_placeholders(prepared))]
+        return QueryResult([("Position", T.BIGINT), ("Type", T.VARCHAR)],
+                           rows)
+    if isinstance(stmt, ast.DescribeOutput):
+        # reference: DescribeOutputRewrite — plan with parameters bound
+        # to NULL, report output names and types
+        prepared = getattr(session, "prepared_statements", {}).get(stmt.name)
+        if prepared is None:
+            raise ExecutionError(f"prepared statement '{stmt.name}' not found")
+        null_params = [ast.Literal(None)] * _count_placeholders(prepared)
+        bound = _substitute_parameters(prepared, null_params)
+        plan = plan_statement(session, parse(bound))
+        types = dict(plan.root.source.outputs())
+        rows = [(n, str(types.get(s, T.VARCHAR)).lower())
+                for n, s in zip(plan.root.names, plan.root.symbols)]
+        return QueryResult(
+            [("Column Name", T.VARCHAR), ("Type", T.VARCHAR)], rows)
     if isinstance(stmt, ast.CreateTableAs):
         session.access_control.check_can_create_table(session.user, stmt.name)
         if stmt.name in session.catalog:
@@ -698,6 +728,43 @@ def explain_text(session, stmt) -> str:
         lines.append(f"\nSubplan {pid}:")
         lines.append(P.plan_tree_str(sub, 1, annotate=ann))
     return "\n".join(lines)
+
+
+def _count_placeholders(sql: str) -> int:
+    n = 0
+    in_str = False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+        elif ch == "?" and not in_str:
+            n += 1
+    return n
+
+
+def explain_distributed_text(session, stmt) -> str:
+    """EXPLAIN (TYPE DISTRIBUTED): fragment the optimized plan the way
+    the cluster scheduler would and print each fragment (reference:
+    PlanPrinter.textDistributedPlan over SubPlan fragments)."""
+    from presto_tpu.parallel.cluster import cut_fragments
+    from presto_tpu.plan.distribute import Undistributable, distribute
+
+    plan = plan_statement(session, stmt)
+    ndev = int(session.properties.get("explain_ndev", 8))
+    try:
+        dplan = distribute(plan, session, ndev)
+    except Undistributable as e:
+        return (f"single fragment (undistributable: {e})\n\n"
+                + explain_text(session, stmt))
+    lines = []
+    for f in cut_fragments(dplan.root):
+        lines.append(f"Fragment {f.fid}:")
+        lines.append(P.plan_tree_str(f.root, 1))
+        lines.append("")
+    for pid, sub in sorted(dplan.subplans.items()):
+        lines.append(f"Subplan {pid}:")
+        lines.append(P.plan_tree_str(sub, 1))
+        lines.append("")
+    return "\n".join(lines).rstrip()
 
 
 def explain_analyze_text(session, stmt, mon) -> str:

@@ -275,6 +275,19 @@ class QueryStatement(Statement):
 class Explain(Statement):
     statement: Statement
     analyze: bool = False
+    # EXPLAIN (TYPE LOGICAL | DISTRIBUTED | VALIDATE) — reference:
+    # SqlBase.g4 explainOption / ExplainType
+    type_: str = "LOGICAL"
+
+
+@dataclass
+class DescribeInput(Statement):
+    name: str
+
+
+@dataclass
+class DescribeOutput(Statement):
+    name: str
 
 
 @dataclass

@@ -174,17 +174,25 @@ class Parser:
     def _statement(self) -> ast.Statement:
         if self.accept_kw("EXPLAIN"):
             analyze = False
-            if self.accept_op("("):  # EXPLAIN (TYPE ...) — accept and ignore options
-                depth = 1
-                while depth:
-                    t = self.next()
-                    if t.kind == "op" and t.value == "(":
-                        depth += 1
-                    elif t.kind == "op" and t.value == ")":
-                        depth -= 1
+            etype = "LOGICAL"
+            if self.accept_op("("):  # (TYPE ..., FORMAT ...) options
+                while True:
+                    if self._accept_word("TYPE"):
+                        etype = str(self.ident()).upper()
+                        if etype not in ("LOGICAL", "DISTRIBUTED",
+                                         "VALIDATE", "IO"):
+                            self.err(f"unknown EXPLAIN type {etype}")
+                    elif self._accept_word("FORMAT"):
+                        self.ident()  # TEXT only; accepted and ignored
+                    else:
+                        self.err("expected TYPE or FORMAT")
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
             if self.accept_kw("ANALYZE"):
                 analyze = True
-            return ast.Explain(self._statement(), analyze=analyze)
+            return ast.Explain(self._statement(), analyze=analyze,
+                               type_=etype)
         if self.accept_kw("SHOW"):
             if self.accept_kw("TABLES"):
                 return ast.ShowTables()
@@ -205,7 +213,12 @@ class Parser:
             self.err("expected TABLES, COLUMNS, FUNCTIONS, SESSION, "
                      "CATALOGS, SCHEMAS or STATS")
         if self._accept_word("DESCRIBE") or self.accept_kw("DESC"):
-            # DESCRIBE t == SHOW COLUMNS FROM t (reference: SqlBase.g4)
+            # DESCRIBE INPUT/OUTPUT <prepared>; DESCRIBE t == SHOW
+            # COLUMNS FROM t (reference: SqlBase.g4)
+            if self._accept_word("INPUT"):
+                return ast.DescribeInput(self.ident())
+            if self._accept_word("OUTPUT"):
+                return ast.DescribeOutput(self.ident())
             return ast.ShowColumns(self.dotted_name())
         if self.accept_kw("CREATE"):
             self.expect_kw("TABLE")

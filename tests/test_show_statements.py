@@ -83,3 +83,22 @@ def test_tablesample_with_alias_and_predicate(s):
     n2 = s.sql("SELECT count(*) FROM t AS x TABLESAMPLE BERNOULLI (100)"
                ).rows[0][0]
     assert n2 == 1000
+
+
+def test_explain_types(s):
+    assert s.sql("EXPLAIN (TYPE VALIDATE) SELECT a FROM t").rows == \
+        [(True,)]
+    txt = s.sql("EXPLAIN (TYPE DISTRIBUTED) "
+                "SELECT b, count(*) FROM t GROUP BY b").rows[0][0]
+    assert "Fragment" in txt
+    assert "PARTIAL" in txt and "FINAL" in txt  # split aggregation
+    with pytest.raises(Exception):
+        s.sql("EXPLAIN (TYPE VALIDATE) SELECT nope FROM t")
+
+
+def test_describe_input_output(s):
+    s.sql("PREPARE pq FROM SELECT a, b FROM t WHERE a > ? AND b = ?")
+    assert s.sql("DESCRIBE INPUT pq").rows == [(0, "unknown"),
+                                               (1, "unknown")]
+    out = s.sql("DESCRIBE OUTPUT pq").rows
+    assert out == [("a", "bigint"), ("b", "varchar")]
