@@ -1653,7 +1653,8 @@ class Executor:
                              for g in groups]
             return _tuples_to_dict_column(tuples, nonempty, a.type)
         if a.fn in ("set_agg", "set_union", "map_union_sum",
-                    "approx_most_frequent", "reduce_agg") \
+                    "approx_most_frequent", "reduce_agg",
+                    "evaluate_classifier_predictions") \
                 or (a.fn in ("min_by", "max_by") and len(a.args) == 3):
             if self.static:
                 raise StaticFallback(f"{a.fn} is dynamic-mode only")
@@ -2032,6 +2033,40 @@ class Executor:
                     for r in g_rows[:topn]))
             tuples[:] = out
             return _tuples_to_dict_column(tuples, nonempty, a.type)
+        if a.fn == "evaluate_classifier_predictions":
+            # accuracy + per-label precision/recall summary (reference:
+            # presto-ml EvaluateClassifierPredictionsAggregation)
+            pcol = to_column(eval_expr(a.args[1], b, self.ctx), b.capacity)
+            pdata = decode(pcol)
+            pvh = vh if pcol.valid is None else (vh & np.asarray(pcol.valid))
+            texts = np.empty(n_groups, dtype=object)
+            stats = [([], []) for _ in range(n_groups)]
+            for row in np.flatnonzero(pvh):
+                g = int(gidh[row])
+                if 0 <= g < n_groups:
+                    stats[g][0].append(host(data[row]))
+                    stats[g][1].append(host(pdata[row]))
+            for g, (truth, pred) in enumerate(stats):
+                n = len(truth)
+                if n == 0:
+                    texts[g] = ""
+                    continue
+                correct = sum(1 for t, p in zip(truth, pred) if t == p)
+                lines = [f"Accuracy: {correct}/{n} "
+                         f"({100.0 * correct / n:.2f}%)"]
+                for lab in sorted({*truth, *pred}, key=repr):
+                    tp = sum(1 for t, p in zip(truth, pred)
+                             if t == p == lab)
+                    pp = sum(1 for p in pred if p == lab)
+                    ap = sum(1 for t in truth if t == lab)
+                    if pp:
+                        lines.append(f"Precision({lab}): {tp}/{pp} "
+                                     f"({100.0 * tp / pp:.2f}%)")
+                    if ap:
+                        lines.append(f"Recall({lab}): {tp}/{ap} "
+                                     f"({100.0 * tp / ap:.2f}%)")
+                texts[g] = "\n".join(lines)
+            return _tuples_to_dict_column(texts, nonempty, a.type)
         # reduce_agg: vectorized input apply + per-level tree combine
         from presto_tpu.exec.colval import LambdaVal
 

@@ -87,6 +87,13 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         if arg_types[0].name != "MAP":
             raise TypeError("map_union takes a MAP argument")
         return arg_types[0]
+    if name == "evaluate_classifier_predictions":
+        # (truth, prediction) -> summary text (reference: presto-ml
+        # EvaluateClassifierPredictionsAggregation)
+        if len(arg_types) != 2:
+            raise TypeError(
+                "evaluate_classifier_predictions takes (truth, prediction)")
+        return T.VARCHAR
     if name in ("learn_classifier", "learn_regressor"):
         if len(arg_types) != 2 or arg_types[1].name != "FEATURES":
             raise TypeError(f"{name} takes (label, features(...))")
@@ -188,7 +195,7 @@ AGG_NAMES = {
     "bitwise_and_agg", "bitwise_or_agg", "histogram", "numeric_histogram",
     "map_union", "learn_classifier", "learn_regressor",
     "set_agg", "set_union", "map_union_sum", "approx_most_frequent",
-    "reduce_agg",
+    "reduce_agg", "evaluate_classifier_predictions",
 }
 
 

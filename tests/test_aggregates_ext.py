@@ -91,3 +91,11 @@ def test_reduce_agg_double_state(s):
 def test_empty_groups_are_null(s):
     assert one(s, "SELECT set_agg(x) FROM (VALUES "
                "(CAST(NULL AS INTEGER))) AS t(x)") is None
+
+
+def test_evaluate_classifier_predictions(s):
+    r = one(s, "SELECT evaluate_classifier_predictions(t, p) FROM "
+            "(VALUES ('a','a'),('a','b'),('b','b'),('b','b')) AS x(t,p)")
+    assert r.splitlines()[0] == "Accuracy: 3/4 (75.00%)"
+    assert "Precision(b): 2/3 (66.67%)" in r
+    assert "Recall(a): 1/2 (50.00%)" in r
