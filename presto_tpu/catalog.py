@@ -47,6 +47,16 @@ class ConnectorTable:
     def unique_keys(self) -> List[tuple]:
         return []
 
+    def key_layout(self, column: str):
+        """Invertible layout of a unique integer key column, or None.
+        Returns (base, block_keys, block_rows): row i holds key
+        base + (i // block_rows) * block_keys + (i % block_rows).
+        Dense surrogate keys are (min, 1, 1); dbgen's sparse orderkey
+        (8 keys per 32-key block) is (1, 32, 8).  Index joins use this
+        to turn the probe into one gather (P10), with an in-trace
+        layout verification guarding staleness."""
+        return None
+
     def max_rows_per_key(self) -> Dict[tuple, int]:
         return {}
 
@@ -158,6 +168,11 @@ class TpchTable(ConnectorTable):
 
     def unique_keys(self):
         return tpch_gen.UNIQUE_KEYS.get(self.name, [])
+
+    def key_layout(self, column: str):
+        if self.name == "orders" and column == "o_orderkey":
+            return (1, 32, 8)  # dbgen sparse orderkey: 8 per 32 block
+        return None
 
     def max_rows_per_key(self):
         return tpch_gen.MAX_ROWS_PER_KEY.get(self.name, {})

@@ -2352,11 +2352,25 @@ class Executor:
                      and lkeys[0].dictionary is None
                      and rkeys[0].dictionary is None
                      and getattr(lkeys[0].data, "ndim", 1) == 1)
+        if use_index and (il.get("block_keys", 1),
+                          il.get("block_rows", 1)) != (1, 1):
+            # strided layouts: the gather runs at PROBE capacity and the
+            # output stays there (no est-bound compaction like the sort
+            # join's) — only a win when the probe is not much wider than
+            # the build (measured: SF1 Q3 6M-probe/1.5M-build LOSES
+            # ~150ms vs the compacted sort join)
+            use_index = lkeys[0].data.shape[0] <= 2 * il["rows"]
         index_ridx = None
         if use_index:
             kmin, nrows = il["min"], il["rows"]
+            bk = il.get("block_keys", 1)
+            br = il.get("block_rows", 1)
             rk_arr = jnp.asarray(rkeys[0].data).astype(jnp.int64)
-            expect = kmin + jnp.arange(nrows, dtype=jnp.int64)
+            ar = jnp.arange(nrows, dtype=jnp.int64)
+            # row i holds key kmin + (i // br) * bk + i % br — dense
+            # layouts are the bk == br == 1 case (identity)
+            expect = kmin + (ar // br) * bk + ar % br \
+                if (bk, br) != (1, 1) else kmin + ar
             layout_ok = ~jnp.any(rsel & (rk_arr != expect))
             if self.static:
                 self.guards.append(~layout_ok)
@@ -2364,8 +2378,15 @@ class Executor:
                 use_index = False
         if use_index:
             lk = jnp.asarray(lkeys[0].data).astype(jnp.int64)
-            pos = jnp.clip(lk - kmin, 0, nrows - 1).astype(jnp.int32)
-            in_range = (lk >= kmin) & (lk < kmin + nrows)
+            off = lk - kmin
+            if (bk, br) != (1, 1):
+                pos_raw = (off // bk) * br + off % bk
+                in_slot = (off % bk) < br  # keys between blocks miss
+            else:
+                pos_raw = off
+                in_slot = jnp.ones_like(off, bool)
+            pos = jnp.clip(pos_raw, 0, nrows - 1).astype(jnp.int32)
+            in_range = (off >= 0) & (pos_raw < nrows) & in_slot
             rkd = jnp.asarray(rkeys[0].data)[pos].astype(jnp.int64)
             found_idx = lsel & in_range & rsel[pos] & (rkd == lk)
             counts = found_idx.astype(jnp.int32)
