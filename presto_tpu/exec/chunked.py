@@ -39,6 +39,8 @@ adaptation:
 from __future__ import annotations
 
 import dataclasses
+import sys
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -615,9 +617,20 @@ class _FragmentRunner:
         guards = [g0]
         overflows = [ov0]
         counts = []
+        profile = bool(self.session.properties.get("chunk_profile",
+                                                   False))
         for i in range(1, grid.nchunks):
+            t0 = time.perf_counter() if profile else 0.0
             out, guard, ov = jitted(res_list, grid.chunk_args(i))
             part, cnt = cjit(out)  # async: no host sync in this loop
+            if profile:
+                # per-chunk wall time, device-synced (diagnostics only —
+                # syncing defeats the pipeline; keep the property off in
+                # production runs)
+                jax.block_until_ready(part)
+                print(f"chunk_profile: chunk {i} "
+                      f"{(time.perf_counter() - t0) * 1e3:.0f}ms",
+                      file=sys.stderr)
             guards.append(guard)
             overflows.append(ov)
             counts.append(cnt)
@@ -736,9 +749,17 @@ class _FragmentRunner:
             guards = [g0]
             overflows = [ov0]
             cap_over = []  # a later chunk outgrew chunk-0's calibration
+            profile = bool(self.session.properties.get("chunk_profile",
+                                                       False))
             for i in range(1, grid.nchunks):
+                t0 = time.perf_counter() if profile else 0.0
                 out, guard, ov = jitted(res_list, grid.chunk_args(i))
                 part, cnt = cjit(out)
+                if profile:  # diagnostics only: syncing kills pipelining
+                    jax.block_until_ready(part)
+                    print(f"chunk_profile: chunk {i} "
+                          f"{(time.perf_counter() - t0) * 1e3:.0f}ms",
+                          file=sys.stderr)
                 if any(part.columns[name].dictionary is not d
                        for name, d in dicts0.items()):
                     return None  # unstable dictionaries: caller falls back
