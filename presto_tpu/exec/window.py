@@ -255,10 +255,33 @@ def _gather_col(c: Column, idx, in_frame) -> Column:
     return Column(out, valid, c.type, c.dictionary)
 
 
+def _nn_machinery(ctx, src):
+    """(inclusive nn-count, exclusive nn-count) over the window-sorted
+    rows — the vectorized basis for IGNORE NULLS: the m-th non-null's
+    index is searchsorted(cnt, m) (reference: the value functions'
+    nullTreatment in operator/window/)."""
+    valid = src.valid if src.valid is not None \
+        else jnp.ones(ctx.n, dtype=bool)
+    cnt = jnp.cumsum(valid.astype(jnp.int32))
+    return cnt, cnt - valid.astype(jnp.int32), valid
+
+
 def _lag_lead(ctx, b, call):
     off = _lit_int(call.args[1], "offset") if len(call.args) > 1 else 1
     src = _arg_column(b, call.args[0])
-    if call.fn == "lag":
+    if getattr(call, "ignore_nulls", False):
+        cnt, cnt0, _valid = _nn_machinery(ctx, src)
+        if call.fn == "lag":
+            # the off-th non-null strictly before this row
+            m = cnt0 - off + 1
+            in_part = m >= cnt0[ctx.part_start] + 1
+        else:
+            # the off-th non-null strictly after this row
+            m = cnt + off
+            in_part = m <= cnt[ctx.part_end]
+        m = jnp.maximum(m, 1)
+        idx = jnp.searchsorted(cnt, m).astype(jnp.int32)
+    elif call.fn == "lag":
         idx = ctx.ar - off
         in_part = idx >= ctx.part_start
     else:
@@ -286,6 +309,20 @@ def _value_fn(ctx, b, call):
     src = _arg_column(b, call.args[0])
     fs, fe, _shape = ctx.frame_bounds()
     nonempty = fs <= fe
+    if getattr(call, "ignore_nulls", False):
+        cnt, cnt0, _valid = _nn_machinery(ctx, src)
+        if call.fn == "first_value":
+            m = cnt0[fs] + 1  # first non-null at/after frame start
+        elif call.fn == "last_value":
+            m = cnt[fe]  # last non-null at/before frame end
+        else:
+            k = _lit_int(call.args[1], "nth_value offset")
+            if k < 1:
+                raise WindowError("nth_value offset must be positive")
+            m = cnt0[fs] + k
+        nonempty = nonempty & (m >= cnt0[fs] + 1) & (m <= cnt[fe])
+        idx = jnp.searchsorted(cnt, jnp.maximum(m, 1)).astype(jnp.int32)
+        return _gather_col(src, idx, nonempty)
     if call.fn == "first_value":
         idx = fs
     elif call.fn == "last_value":

@@ -129,6 +129,9 @@ class AggCall:
     type: Type
     distinct: bool = False
     filter: Optional[RowExpr] = None
+    # window value functions only (lag/lead/first/last/nth_value):
+    # IGNORE NULLS (reference: sql/tree/FunctionCall nullTreatment)
+    ignore_nulls: bool = False
 
     def __str__(self):
         d = "DISTINCT " if self.distinct else ""

@@ -957,8 +957,17 @@ class Planner:
                     rt = agg_fns.resolve_window(fc.name, [a.type for a in args])
                 except KeyError as e:
                     raise SemanticError(str(e.args[0])) from None
+                ign = fc.null_treatment == "IGNORE"
+                if ign and fc.name.lower() not in (
+                        "lag", "lead", "first_value", "last_value",
+                        "nth_value"):
+                    raise SemanticError(
+                        "IGNORE NULLS applies only to the window value "
+                        "functions (lag/lead/first_value/last_value/"
+                        "nth_value)")
                 s = self.symbols.new(fc.name)
-                fns[s] = ir.AggCall(fc.name.lower(), args, rt, fc.distinct, None)
+                fns[s] = ir.AggCall(fc.name.lower(), args, rt, fc.distinct,
+                                    None, ignore_nulls=ign)
                 win_map[id(fc)] = (s, rt)
             node = P.Window(node, list(part), list(order), fns, frame)
         return node, win_map
@@ -1180,6 +1189,9 @@ class Planner:
         if isinstance(e, ast.FunctionCall):
             if agg_fns.is_aggregate(e.name) and e.window is None:
                 raise SemanticError(f"aggregate {e.name} not allowed here")
+            if e.null_treatment is not None and e.window is None:
+                raise SemanticError(
+                    "IGNORE/RESPECT NULLS requires an OVER clause")
             if any(isinstance(x, ast.Lambda) for x in e.args):
                 return self._analyze_lambda_call(e, scope, agg_map, group_map)
             if e.name == "$dereference":

@@ -140,6 +140,8 @@ class FunctionCall(Expr):
     distinct: bool = False
     filter: Optional[Expr] = None
     window: Optional["WindowSpec"] = None
+    # "IGNORE" | "RESPECT" | None (reference: nullTreatment)
+    null_treatment: Optional[str] = None
 
 
 @dataclass

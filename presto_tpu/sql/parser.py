@@ -1066,10 +1066,18 @@ class Parser:
             self.expect_kw("WHERE")
             filt = self.expr()
             self.expect_op(")")
+        nt = None
+        if self._accept_word("IGNORE"):
+            self.expect_kw("NULLS")
+            nt = "IGNORE"
+        elif self._accept_word("RESPECT"):
+            self.expect_kw("NULLS")
+            nt = "RESPECT"
         window = None
         if self.accept_kw("OVER"):
             window = self._window_spec()
-        return ast.FunctionCall(name.lower(), args, distinct, filt, window)
+        return ast.FunctionCall(name.lower(), args, distinct, filt, window,
+                                nt)
 
     def _lambda_or_expr(self) -> ast.Expr:
         """Function argument: `x -> body`, `(x, y) -> body`, or an expression

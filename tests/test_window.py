@@ -171,3 +171,52 @@ def test_partitioned_window_distributes_without_gather(tpch_catalog_tiny):
     walk(dplan.root)
     assert found and isinstance(found[0], P.Exchange)
     assert found[0].kind == "repartition"
+
+
+# ---- IGNORE NULLS (round 5; reference: nullTreatment on the window
+# value functions) ------------------------------------------------------
+
+NULLS_BASE = ("(VALUES (1,1,10),(1,2,NULL),(1,3,30),(1,4,NULL),(1,5,50),"
+              "(2,1,NULL),(2,2,7)) AS t(g,i,v)")
+
+
+def test_lag_lead_ignore_nulls(session):
+    r = session.sql(
+        f"SELECT lag(v) IGNORE NULLS OVER (PARTITION BY g ORDER BY i) "
+        f"FROM {NULLS_BASE} ORDER BY g, i").rows
+    assert [x[0] for x in r] == [None, 10, 10, 30, 30, None, None]
+    r = session.sql(
+        f"SELECT lead(v, 2) IGNORE NULLS OVER "
+        f"(PARTITION BY g ORDER BY i) FROM {NULLS_BASE} "
+        f"ORDER BY g, i").rows
+    assert [x[0] for x in r] == [50, 50, None, None, None, None, None]
+
+
+def test_value_fns_ignore_nulls(session):
+    r = session.sql(
+        f"SELECT first_value(v) IGNORE NULLS OVER "
+        f"(PARTITION BY g ORDER BY i) FROM {NULLS_BASE} "
+        f"ORDER BY g, i").rows
+    assert [x[0] for x in r] == [10, 10, 10, 10, 10, None, 7]
+    r = session.sql(
+        f"SELECT nth_value(v, 2) IGNORE NULLS OVER (PARTITION BY g "
+        f"ORDER BY i ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED "
+        f"FOLLOWING) FROM {NULLS_BASE} ORDER BY g, i").rows
+    assert [x[0] for x in r] == [30, 30, 30, 30, 30, None, None]
+
+
+def test_respect_nulls_is_default(session):
+    q1 = (f"SELECT lag(v) RESPECT NULLS OVER (PARTITION BY g ORDER "
+          f"BY i) FROM {NULLS_BASE} ORDER BY g, i")
+    q2 = (f"SELECT lag(v) OVER (PARTITION BY g ORDER BY i) "
+          f"FROM {NULLS_BASE} ORDER BY g, i")
+    assert session.sql(q1).rows == session.sql(q2).rows
+
+
+def test_ignore_nulls_requires_window(session):
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="OVER"):
+        session.sql("SELECT abs(-1) IGNORE NULLS")
+    with _pytest.raises(Exception, match="value functions"):
+        session.sql(f"SELECT sum(v) IGNORE NULLS OVER () FROM {NULLS_BASE}")
