@@ -2325,7 +2325,9 @@ register("array_position")((_array_transform(
 register("array_distinct")((_array_transform(
     "array_distinct", lambda v: tuple(dict.fromkeys(v)))))
 register("array_sort")((_array_transform(
-    "array_sort", lambda v: tuple(sorted(v)))))
+    "array_sort",  # NULLs last (reference: ArraySortFunction)
+    lambda v: tuple(sorted(e for e in v if e is not None))
+    + tuple(e for e in v if e is None))))
 register("array_join")((
     lambda args: T.VARCHAR if _is_array(args[0]) else None,
     _array_transform("array_join",

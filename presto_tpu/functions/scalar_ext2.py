@@ -970,6 +970,207 @@ def _emit_keys_values_match(name, which, quantifier):
     return emit
 
 
+# ---------------------------------------------------------------------------
+# comparator / lambda overloads of existing functions, and the data-size
+# parser (reference: ArraySortComparatorFunction,
+# JoniRegexpReplaceLambdaFunction, DataSizeFunctions)
+# ---------------------------------------------------------------------------
+
+from presto_tpu.functions.scalar import REGISTRY as _REG  # noqa: E402
+
+
+def _wrap_array_sort():
+    old = _REG["array_sort"]
+
+    def resolve(args):
+        if len(args) == 2 and _is_array(args[0]) \
+                and _is_function(args[1]):
+            return args[0]
+        return old.resolve(args)
+
+    def emit(args):
+        from presto_tpu.exec.colval import LambdaVal
+
+        if len(args) == 2 and isinstance(args[1], LambdaVal):
+            return _emit_array_sort_cmp(args)
+        return old.emit(args)
+
+    register("array_sort")((resolve, emit))
+
+
+def _emit_array_sort_cmp(args):
+    """array_sort(a, (x, y) -> cmp): all intra-array pairs evaluate in
+    ONE vectorized lambda apply, then a host sort consults the
+    precomputed comparisons (reference: ArraySortComparatorFunction)."""
+    import functools
+
+    col, lam = args
+    _check_lambda(lam, "array_sort")
+    entries = _arr_entries(col)
+    xs, ys, owners = [], [], []
+    for ei, t in enumerate(entries):
+        for i in range(len(t)):
+            for j in range(i + 1, len(t)):
+                xs.append(t[i])
+                ys.append(t[j])
+                owners.append((ei, i, j))
+    if xs:
+        et = lam.param_types[0]
+        res = _pylist_from_colval(
+            lam.apply({lam.params[0]: _colval_from_pylist(xs, et),
+                       lam.params[1]: _colval_from_pylist(ys, et)}),
+            len(xs))
+    else:
+        res = []
+    cmps: dict = {}
+    for (ei, i, j), r in zip(owners, res):
+        cmps[(ei, i, j)] = 0 if r is None else int(r)
+    outs = []
+    for ei, t in enumerate(entries):
+        def cmp(i, j, _ei=ei):
+            if i == j:
+                return 0
+            if i < j:
+                return cmps.get((_ei, i, j), 0)
+            return -cmps.get((_ei, j, i), 0)
+
+        order = sorted(range(len(t)), key=functools.cmp_to_key(cmp))
+        outs.append(tuple(t[i] for i in order))
+    return _dict_lut_result(outs, ColVal(col.data, col.valid, col.type),
+                            col.type)
+
+
+_wrap_array_sort()
+
+
+def _wrap_regexp_replace():
+    import re as _re
+
+    old = _REG["regexp_replace"]
+
+    def resolve(args):
+        if len(args) == 3 and args[0].is_string \
+                and _is_function(args[2]):
+            return T.VARCHAR
+        return old.resolve(args)
+
+    def emit(args):
+        from presto_tpu.exec.colval import LambdaVal
+
+        if len(args) == 3 and isinstance(args[2], LambdaVal):
+            return _emit_regexp_replace_lambda(args, _re)
+        return old.emit(args)
+
+    register("regexp_replace")((resolve, emit))
+
+
+def _emit_regexp_replace_lambda(args, _re):
+    """regexp_replace(s, p, groups -> r): every match's capturing-group
+    array across every distinct string feeds ONE vectorized lambda
+    apply; NULL lambda results drop the match (reference:
+    JoniRegexpReplaceLambdaFunction)."""
+    col, pat, lam = args
+    _check_lambda(lam, "regexp_replace")
+    p = pat.data
+    if pat.dictionary is not None:
+        p = pat.dictionary.values[int(p)]
+    rx = _re.compile(str(p))
+    if col.dictionary is None and isinstance(col.data, (str, bytes)):
+        vals_in = [str(col.data)]
+        codes = jnp.asarray(0, jnp.int32)
+    else:
+        vals_in = [str(v) for v in _arr_entries_str(col)]
+        codes = col.data
+    per_string = []  # list of (spans, n_matches)
+    flat_groups = []
+    for s in vals_in:
+        ms = list(rx.finditer(s))
+        per_string.append(ms)
+        for m in ms:
+            flat_groups.append(tuple(m.groups()))
+    if flat_groups:
+        res = _pylist_from_colval(
+            lam.apply({lam.params[0]: _colval_from_pylist(
+                flat_groups, T.array_of(T.VARCHAR))}), len(flat_groups))
+    else:
+        res = []
+    outs = []
+    off = 0
+    for s, ms in zip(vals_in, per_string):
+        parts, last = [], 0
+        for m in ms:
+            parts.append(s[last:m.start()])
+            r = res[off]
+            off += 1
+            if r is not None:
+                parts.append(str(r))
+            last = m.end()
+        parts.append(s[last:])
+        outs.append("".join(parts))
+    return _dict_lut_result(outs, ColVal(codes, col.valid, T.VARCHAR),
+                            T.VARCHAR)
+
+
+def _arr_entries_str(col):
+    return col.dictionary.values if col.dictionary is not None else []
+
+
+_wrap_regexp_replace()
+
+
+_DATA_SIZE_UNITS = {"B": 1, "kB": 1 << 10, "MB": 1 << 20, "GB": 1 << 30,
+                    "TB": 1 << 40, "PB": 1 << 50, "EB": 1 << 60}
+
+
+def _parse_data_size(s):
+    import re as _re
+
+    m = _re.fullmatch(r"\s*([\d.]+)\s*([A-Za-z]+)\s*", str(s))
+    if not m or m.group(2) not in _DATA_SIZE_UNITS:
+        raise ValueError(f"invalid data size: {s!r}")
+    v = int(float(m.group(1)) * _DATA_SIZE_UNITS[m.group(2)])
+    # reference returns DECIMAL(38,0); BIGINT covers sizes to 8EB —
+    # documented trim
+    return v
+
+
+register("parse_presto_data_size")(_str_fn(
+    "parse_presto_data_size", _parse_data_size, T.BIGINT, 1))
+
+
+def _fix_array_sort_nulls_and_join():
+    """array_sort puts NULLs LAST (reference: ArraySortFunction);
+    array_join gains the 3-arg null-replacement form."""
+    old_join = _REG["array_join"]
+
+    def _join(v, d, nr=None):
+        parts = []
+        for e in v:
+            if e is None:
+                if nr is not None:
+                    parts.append(str(nr))
+            else:
+                parts.append(_fmt_join(e))
+        return str(d).join(parts)
+
+    def resolve(args):
+        return T.VARCHAR if args and _is_array(args[0]) \
+            and len(args) in (2, 3) else None
+
+    register("array_join")((
+        resolve, _array_transform("array_join", _join, T.VARCHAR)[1]))
+    _ = old_join  # superseded registration
+
+
+def _fmt_join(e):
+    if isinstance(e, bool):
+        return "true" if e else "false"
+    return str(e)
+
+
+_fix_array_sort_nulls_and_join()
+
+
 for _nm, _which, _q in (("all_keys_match", "keys", "all"),
                         ("any_keys_match", "keys", "any"),
                         ("no_keys_match", "keys", "none"),

@@ -1300,6 +1300,16 @@ class Planner:
             if le.body.type not in (T.BOOLEAN, T.UNKNOWN):
                 raise SemanticError(f"{name} lambda must return BOOLEAN")
             return self._call(name, [m, le])
+        if name == "array_sort" and len(e.args) == 2:
+            arr = a(e.args[0])
+            et = elem_of(arr)
+            le = lam(e.args[1], (et, et))
+            return self._call(name, [arr, le])
+        if name == "regexp_replace" and len(e.args) == 3 \
+                and isinstance(e.args[2], ast.Lambda):
+            s_, p_ = a(e.args[0]), a(e.args[1])
+            le = lam(e.args[2], (T.array_of(T.VARCHAR),))
+            return self._call(name, [s_, p_, le])
         if name == "map_zip_with":
             if len(e.args) != 3:
                 raise SemanticError(

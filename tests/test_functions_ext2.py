@@ -290,3 +290,35 @@ def test_match_family_null_three_valued(s):
                  "ARRAY[1, NULL]), v -> v > 5)").rows[0][0] is None
     assert s.sql("SELECT all_keys_match(MAP(ARRAY['a'], ARRAY[1]), "
                  "k -> k > 'z')").rows[0][0] is False
+
+
+# ---- comparator/lambda overloads + data size (second batch) ----------
+
+def test_array_sort_nulls_last(s):
+    assert one(s, "SELECT array_sort(ARRAY[3, 1, NULL, 2])") == \
+        (1, 2, 3, None)
+
+
+def test_array_sort_comparator(s):
+    assert one(s, "SELECT array_sort(ARRAY[3, 2, 5, 1, 2], "
+               "(x, y) -> y - x)") == (5, 3, 2, 2, 1)
+    assert one(s, "SELECT array_sort(ARRAY['a', 'ccc', 'bb'], (x, y) -> "
+               "IF(length(x) < length(y), -1, "
+               "IF(length(x) > length(y), 1, 0)))") == ("a", "bb", "ccc")
+
+
+def test_regexp_replace_lambda(s):
+    assert one(s, "SELECT regexp_replace('new york', '(\\w)(\\w*)', "
+               "x -> upper(x[1]) || lower(x[2]))") == "New York"
+
+
+def test_parse_presto_data_size(s):
+    assert one(s, "SELECT parse_presto_data_size('1kB')") == 1024
+    assert one(s, "SELECT parse_presto_data_size('2.5GB')") == \
+        int(2.5 * (1 << 30))
+
+
+def test_array_join_null_replacement(s):
+    assert one(s, "SELECT array_join(ARRAY[1, NULL, 2], ',')") == "1,2"
+    assert one(s, "SELECT array_join(ARRAY[1, NULL, 2], ',', 'N/A')") == \
+        "1,N/A,2"
