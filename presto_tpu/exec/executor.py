@@ -2346,31 +2346,51 @@ class Executor:
         # verification catches everything else: a guard in static mode,
         # a host check (fall back to the sort join) in dynamic mode.
         il = getattr(node, "index_lookup", None)
+        bk = il.get("block_keys", 1) if il else 1
+        br = il.get("block_rows", 1) if il else 1
+        strided = (bk, br) != (1, 1)
+        full_build = il is not None and right.capacity == il["rows"]
         use_index = (il is not None and self.allow_index_join
                      and len(lkeys) == 1
-                     and right.capacity == il["rows"]
+                     # strided layouts also run over CHUNK-sized builds:
+                     # bucket-aligned chunks are contiguous row ranges,
+                     # so the layout holds with a chunk-local base taken
+                     # from the build data itself (traced)
+                     and (full_build or strided)
                      and lkeys[0].dictionary is None
                      and rkeys[0].dictionary is None
                      and getattr(lkeys[0].data, "ndim", 1) == 1)
-        if use_index and (il.get("block_keys", 1),
-                          il.get("block_rows", 1)) != (1, 1):
-            # strided layouts: the gather runs at PROBE capacity and the
-            # output stays there (no est-bound compaction like the sort
-            # join's) — only a win when the probe is not much wider than
-            # the build (measured: SF1 Q3 6M-probe/1.5M-build LOSES
-            # ~150ms vs the compacted sort join)
-            use_index = lkeys[0].data.shape[0] <= 2 * il["rows"]
+        if use_index and strided:
+            # strided builds: the index gather runs at PROBE capacity
+            # and the output stays there, while the sort join's output
+            # materializes at its est-driven bound — which wins big
+            # whenever upstream filters/semi-joins leave the build
+            # sparse (measured: SF1 Q3 6M/1.5M loses ~150ms; SF100 Q18
+            # chunks with a highly selective semi-join upstream lose
+            # ~7%).  Gate to probes not much wider than the build.
+            use_index = lkeys[0].data.shape[0] <= 2 * right.capacity
         index_ridx = None
+        if il is not None and os.environ.get("PRESTO_TPU_DEBUG_INDEX"):
+            import sys as _sys
+
+            print(f"index-join debug: {node.criteria} use_index="
+                  f"{use_index} full_build={full_build} strided={strided} "
+                  f"rcap={right.capacity} lcap={lkeys[0].data.shape if hasattr(lkeys[0].data, 'shape') else '?'}",
+                  file=_sys.stderr, flush=True)
         if use_index:
-            kmin, nrows = il["min"], il["rows"]
-            bk = il.get("block_keys", 1)
-            br = il.get("block_rows", 1)
+            nrows = right.capacity
             rk_arr = jnp.asarray(rkeys[0].data).astype(jnp.int64)
             ar = jnp.arange(nrows, dtype=jnp.int64)
-            # row i holds key kmin + (i // br) * bk + i % br — dense
+            # row i holds key base + (i // br) * bk + i % br — dense
             # layouts are the bk == br == 1 case (identity)
-            expect = kmin + (ar // br) * bk + ar % br \
-                if (bk, br) != (1, 1) else kmin + ar
+            if full_build:
+                base = jnp.asarray(il["min"], jnp.int64)
+            else:
+                # chunk-local base from the data; the verification
+                # below proves the whole layout against it in-trace
+                base = rk_arr[0]
+            expect = base + (ar // br) * bk + ar % br \
+                if strided else base + ar
             layout_ok = ~jnp.any(rsel & (rk_arr != expect))
             if self.static:
                 self.guards.append(~layout_ok)
@@ -2378,8 +2398,8 @@ class Executor:
                 use_index = False
         if use_index:
             lk = jnp.asarray(lkeys[0].data).astype(jnp.int64)
-            off = lk - kmin
-            if (bk, br) != (1, 1):
+            off = lk - base
+            if strided:
                 pos_raw = (off // bk) * br + off % bk
                 in_slot = (off % bk) < br  # keys between blocks miss
             else:
