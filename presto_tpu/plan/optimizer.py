@@ -28,7 +28,82 @@ def optimize(plan: P.QueryPlan, session) -> P.QueryPlan:
     new_root = _optimize_node(root, session)
     out = P.QueryPlan(new_root, subplans)
     annotate_static_hints(out, session)
+    if session.properties.get("prune_fd_group_keys", False):
+        # OFF by default: measured on chip (SF1 Q3 517->607ms, Q18
+        # 647->687ms), each arbitrary() representative costs a
+        # full-capacity reduction pass that outweighs the narrower
+        # grouping sort in this executor.  The rewrite itself is
+        # correct and tested; revisit if representatives ever ride the
+        # grouping sort directly.
+        # Needs build_unique from the annotation pass; re-annotate after
+        # the rewrite so aggregate capacity hints match the new keys
+        changed = _prune_fd_group_keys(out.root, set())
+        for sub in out.subplans.values():
+            changed |= _prune_fd_group_keys(sub, set())
+        if changed:
+            annotate_static_hints(out, session)
     return out
+
+
+def _prune_fd_group_keys(node: P.PlanNode, seen: set) -> bool:
+    """Group keys functionally determined through a unique-build join
+    collapse to arbitrary() aggregates: grouping by (l_orderkey,
+    o_orderdate, o_shippriority) over lineitem JOIN orders-unique-on-
+    orderkey sorts ONE key instead of three and gathers representatives
+    at the group bound (reference: the unique-constraint-driven
+    grouping-key pruning in newer optimizers; correctness is the FD
+    through AggregationNode semantics — within a group of the join key
+    the unique build row, and so every build column, is constant;
+    LEFT-join groups are uniformly matched or uniformly null-extended).
+    Mutates Aggregates in place; returns whether anything changed."""
+    if id(node) in seen:
+        return False
+    seen.add(id(node))
+    changed = False
+    for s in node.sources:
+        changed |= _prune_fd_group_keys(s, seen)
+    if not isinstance(node, P.Aggregate) or node.step != "SINGLE" \
+            or len(node.group_keys) < 2:
+        return changed
+    # walk identity projections down to the join, tracking renames
+    maps = []
+    cur = node.source
+    while isinstance(cur, P.Project):
+        maps.append({s: (e.name if isinstance(e, ir.Ref) else None)
+                     for s, e in cur.assignments.items()})
+        cur = cur.source
+    if not isinstance(cur, P.Join) \
+            or cur.join_type not in ("INNER", "LEFT") \
+            or len(cur.criteria) != 1 or cur.filter is not None \
+            or not getattr(cur, "build_unique", False):
+        return changed
+    lk, rk = cur.criteria[0]
+    build_syms = {s for s, _ in cur.right.outputs()}
+
+    def base(sym):
+        s = sym
+        for m in maps:
+            s = m.get(s)
+            if s is None:
+                return None
+        return s
+
+    keys_base = {k: base(k) for k in node.group_keys}
+    anchors = [k for k, b in keys_base.items()
+               if b == lk or (cur.join_type == "INNER" and b == rk)]
+    if not anchors:
+        return changed
+    anchor = anchors[0]
+    fd = [k for k in node.group_keys
+          if k != anchor and keys_base.get(k) in build_syms]
+    if not fd:
+        return changed
+    types = dict(node.source.outputs())
+    node.group_keys = [k for k in node.group_keys if k not in fd]
+    for k in fd:
+        node.aggs[k] = ir.AggCall("arbitrary", (ir.Ref(k, types[k]),),
+                                  types[k])
+    return True
 
 
 def annotate_static_hints(plan: P.QueryPlan, session) -> None:

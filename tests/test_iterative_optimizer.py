@@ -557,3 +557,44 @@ def test_sort_over_scalar_aggregate_removed():
                       {"c": ir.AggCall("count", (), T.BIGINT)})
     out = _opt(P.Sort(agg, [("c", True, None)]))
     assert isinstance(out, P.Aggregate)
+
+
+def test_fd_group_key_pruning():
+    """Group keys functionally determined through a unique-build join
+    become arbitrary() aggregates (optimizer._prune_fd_group_keys)."""
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+
+    s = presto_tpu.connect(tpch_catalog(0.01, "/tmp/presto_tpu_cache"))
+    s.properties["prune_fd_group_keys"] = True  # opt-in (see optimizer)
+    txt = s.sql(
+        "EXPLAIN SELECT l_orderkey, o_orderdate, sum(l_quantity) "
+        "FROM lineitem, orders WHERE l_orderkey = o_orderkey "
+        "GROUP BY l_orderkey, o_orderdate").rows[0][0]
+    agg = next(l for l in txt.splitlines() if "Aggregate" in l)
+    assert "arbitrary" in agg
+    assert agg.count("keys=['l_orderkey") == 1
+    # correctness vs the unpruned plan
+    q = ("SELECT l_orderkey, o_orderdate, sum(l_quantity) "
+         "FROM lineitem, orders WHERE l_orderkey = o_orderkey "
+         "GROUP BY l_orderkey, o_orderdate ORDER BY 1 LIMIT 50")
+    a = s.sql(q).rows
+    s.properties["prune_fd_group_keys"] = False
+    b = s.sql(q).rows
+    assert a == b
+
+
+def test_fd_pruning_keeps_probe_side_keys():
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+
+    s = presto_tpu.connect(tpch_catalog(0.01, "/tmp/presto_tpu_cache"))
+    s.properties["prune_fd_group_keys"] = True  # opt-in (see optimizer)
+    # l_linestatus is probe-side: NOT functionally determined, stays a key
+    txt = s.sql(
+        "EXPLAIN SELECT l_orderkey, l_linestatus, o_orderdate, count(*) "
+        "FROM lineitem, orders WHERE l_orderkey = o_orderkey "
+        "GROUP BY l_orderkey, l_linestatus, o_orderdate").rows[0][0]
+    agg = next(l for l in txt.splitlines() if "Aggregate" in l)
+    assert "l_linestatus" in agg.split("{")[0]  # still a grouping key
+    assert "arbitrary(o_orderdate" in agg
