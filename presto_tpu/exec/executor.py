@@ -966,8 +966,17 @@ class Executor:
         valids = {}
         types = {}
         n = len(node.rows)
+        collection_cols = {}
         for j, (sym, t) in enumerate(zip(node.symbols, node.types_)):
             vals = [r[j] for r in node.rows]
+            if t.name in ("ARRAY", "MAP", "ROW"):
+                # collection literals (folded ARRAY[..]/MAP(..) ctors):
+                # dictionary-encode the tuple values like any column
+                from presto_tpu.functions.scalar import _colval_from_pylist
+
+                collection_cols[sym] = to_column(
+                    _colval_from_pylist(vals, t), n)
+                continue
             mask = np.asarray([v is not None for v in vals])
             if t.is_string:
                 arr = np.asarray([v if v is not None else "" for v in vals], dtype=object)
@@ -978,7 +987,13 @@ class Executor:
             types[sym] = t
             if not mask.all():
                 valids[sym] = mask
-        return batch_from_numpy(arrays, types, valids or None)
+        b = batch_from_numpy(arrays, types, valids or None) if arrays \
+            else Batch({}, jnp.ones((n,), bool))
+        if collection_cols:
+            cols = dict(b.columns)
+            cols.update(collection_cols)
+            b = Batch(cols, b.sel)
+        return b
 
     # ---- row-wise ----------------------------------------------------
     def _exec_filter(self, node: P.Filter) -> Batch:

@@ -481,7 +481,14 @@ class Planner:
                         isinstance(rex.arg, ir.Lit) and rex.arg.value is None:
                     rex = ir.Lit(None, rex.type)
                 if not isinstance(rex, ir.Lit):
-                    raise SemanticError("VALUES requires literals")
+                    # constant expressions (ARRAY[..] / MAP(..) ctors,
+                    # arithmetic over literals) fold at plan time —
+                    # the reference's VALUES accepts any constant expr
+                    folded = _fold_constant_expr(rex)
+                    if folded is None:
+                        raise SemanticError(
+                            "VALUES requires constant expressions")
+                    rex = folded
                 vals.append(rex.value)
                 if j >= len(col_types):
                     col_types.append(rex.type)
@@ -1409,6 +1416,28 @@ class Planner:
         if ct is None:
             return l, r
         return self._coerce(l, ct), self._coerce(r, ct)
+
+
+def _fold_constant_expr(rex: ir.RowExpr):
+    """Evaluate a ref-free scalar expression at plan time to a typed
+    literal (VALUES with ARRAY/MAP constructors; reference: VALUES rows
+    are arbitrary constant expressions evaluated by the analyzer).
+    Returns None when the expression isn't foldable."""
+    if rex.refs():
+        return None
+    try:
+        import jax.numpy as jnp
+
+        from presto_tpu.batch import Batch
+        from presto_tpu.exec.compiler import EvalContext, eval_expr
+        from presto_tpu.functions.scalar import _pylist_from_colval
+
+        cv = eval_expr(rex, Batch({}, jnp.ones((1,), bool)),
+                       EvalContext())
+        v = _pylist_from_colval(cv, 1)[0]
+        return ir.Lit(v, cv.type if cv.type is not None else rex.type)
+    except Exception:
+        return None
 
 
 def _literal_to_ir(e: ast.Literal) -> ir.Lit:

@@ -430,6 +430,14 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         return hi
     if a.is_string and b.is_string:
         return VARCHAR
+    if a.name == b.name == "ARRAY":
+        et = common_super_type(a.params[0], b.params[0])
+        return array_of(et) if et is not None else None
+    if a.name == b.name == "MAP":
+        kt = common_super_type(a.params[0], b.params[0])
+        vt = common_super_type(a.params[1], b.params[1])
+        return map_of(kt, vt) if kt is not None and vt is not None \
+            else None
     if {a.name, b.name} == {"DATE", "TIMESTAMP"}:
         return TIMESTAMP
     if a.name == "TIMESTAMP_TZ" and b.name == "TIMESTAMP_TZ":

@@ -196,3 +196,20 @@ def test_array_set_functions(session):
         "SELECT ARRAY[1,2] || ARRAY[3]").rows == [((1, 2, 3),)]
     assert session.sql(
         "SELECT ARRAY[ARRAY[1,2], ARRAY[3]]").rows == [(((1, 2), (3,)),)]
+
+
+def test_values_with_collection_constants(session):
+    """VALUES accepts constant expressions, not just literals
+    (reference: VALUES rows are arbitrary constant expressions)."""
+    r = session.sql("SELECT set_union(a) FROM (VALUES (ARRAY[1,2]), "
+                    "(ARRAY[2,3])) AS t(a)").rows
+    assert r == [((1, 2, 3),)]
+    r = session.sql("SELECT cardinality(a) FROM (VALUES (ARRAY[1,2,3]),"
+                    " (ARRAY[])) AS t(a) ORDER BY 1").rows
+    assert r == [(0,), (3,)]
+    r = session.sql("SELECT x FROM (VALUES (1+1), (2*3)) AS t(x) "
+                    "ORDER BY x").rows
+    assert r == [(2,), (6,)]
+    r = session.sql("SELECT m['a'] FROM (VALUES (MAP(ARRAY['a'], "
+                    "ARRAY[7]))) AS t(m)").rows
+    assert r == [(7,)]
