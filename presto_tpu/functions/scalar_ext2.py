@@ -971,6 +971,94 @@ def _emit_keys_values_match(name, which, quantifier):
 
 
 # ---------------------------------------------------------------------------
+# ARRAY/ROW ordering comparisons (reference: ArrayLessThanOperator +
+# RowComparisonOperator family).  The dictionary CODES are canonical-
+# repr-ordered, not semantically ordered, so </<=/>/>= over collection
+# columns must compare the VALUES pairwise (python tuple comparison is
+# exactly elementwise-lexicographic with prefix ordering); a NULL
+# element makes the comparison NULL (the reference throws).
+# ---------------------------------------------------------------------------
+
+
+def _is_orderable_collection(t) -> bool:
+    return t is not None and t.name in ("ARRAY", "ROW")
+
+
+def _wrap_collection_cmp(name, pyop):
+    from presto_tpu.functions.scalar import REGISTRY as _R
+
+    old = _R[name]
+
+    def resolve(args):
+        if len(args) == 2 and all(_is_orderable_collection(a)
+                                  for a in args):
+            return T.BOOLEAN
+        return old.resolve(args)
+
+    def fn(x, y):
+        return pyop(tuple(x), tuple(y))
+
+    pair_emit = _pairwise_dict_fn(name, fn, T.BOOLEAN)
+
+    def emit(args):
+        if len(args) == 2 and all(
+                _is_orderable_collection(a.type) for a in args):
+            return pair_emit(args)
+        return old.emit(args)
+
+    register(name)((resolve, emit))
+
+
+for _cmp_name, _op in (("lt", lambda x, y: x < y),
+                       ("le", lambda x, y: x <= y),
+                       ("gt", lambda x, y: x > y),
+                       ("ge", lambda x, y: x >= y)):
+    _wrap_collection_cmp(_cmp_name, _op)
+
+
+# ---------------------------------------------------------------------------
+# IS [NOT] DISTINCT FROM (reference: the distinct_from operator family —
+# null-safe comparison that never returns NULL)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_distinct_from(args):
+    if len(args) != 2:
+        return None
+    from presto_tpu.functions.scalar import REGISTRY as _R
+
+    return T.BOOLEAN if _R["eq"].resolve(args) is not None \
+        or T.UNKNOWN in (args[0], args[1]) else None
+
+
+def _emit_distinct_from(args):
+    from presto_tpu.functions.scalar import REGISTRY as _R
+
+    a, b = args
+
+    def validity(c):
+        if c.valid is None:
+            return jnp.asarray(True)
+        return jnp.asarray(c.valid)
+
+    av, bv = validity(a), validity(b)
+    if a.type == T.UNKNOWN or b.type == T.UNKNOWN:
+        # a literal NULL operand: distinct iff the other side is
+        # non-null (both-null is NOT distinct)
+        return ColVal(av | bv, None, T.BOOLEAN)
+    eqv = _R["eq"].emit([a, b])
+    eq_data = jnp.asarray(eqv.data)
+    one_null = av ^ bv
+    both_valid = av & bv
+    out = one_null | (both_valid & ~eq_data)
+    return ColVal(out, None, T.BOOLEAN)
+
+
+register("is_distinct_from")((_resolve_distinct_from,
+                              _emit_distinct_from))
+
+
+# ---------------------------------------------------------------------------
 # comparator / lambda overloads of existing functions, and the data-size
 # parser (reference: ArraySortComparatorFunction,
 # JoniRegexpReplaceLambdaFunction, DataSizeFunctions)

@@ -811,6 +811,15 @@ class Parser:
                 break
             if self.accept_kw("IS"):
                 neg = self.accept_kw("NOT")
+                if self.accept_kw("DISTINCT"):
+                    # IS [NOT] DISTINCT FROM: null-safe comparison
+                    # (reference: SqlBase.g4 DISTINCT FROM predicate)
+                    self.expect_kw("FROM")
+                    rhs = self._additive()
+                    call = ast.FunctionCall("is_distinct_from",
+                                            [left, rhs])
+                    left = ast.UnaryOp("NOT", call) if neg else call
+                    continue
                 self.expect_kw("NULL")
                 left = ast.IsNull(left, neg)
                 continue

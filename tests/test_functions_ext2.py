@@ -322,3 +322,29 @@ def test_array_join_null_replacement(s):
     assert one(s, "SELECT array_join(ARRAY[1, NULL, 2], ',')") == "1,2"
     assert one(s, "SELECT array_join(ARRAY[1, NULL, 2], ',', 'N/A')") == \
         "1,N/A,2"
+
+
+# ---- collection ordering + IS DISTINCT FROM --------------------------
+
+def test_array_row_ordering_is_lexicographic(s):
+    """Regression: </<=/>/>= over ARRAY/ROW used to compare dictionary
+    CODES (canonical-repr order), so ARRAY[2] < ARRAY[10] was false."""
+    assert one(s, "SELECT ARRAY[1,2] < ARRAY[1,3]") is True
+    assert one(s, "SELECT ARRAY[2] < ARRAY[10]") is True
+    assert one(s, "SELECT ARRAY[1,2] > ARRAY[1]") is True  # prefix
+    assert one(s, "SELECT ROW(1,'a') < ROW(2,'a')") is True
+    assert one(s, "SELECT ROW(1,'b') >= ROW(1,'a')") is True
+
+
+def test_is_distinct_from(s):
+    assert one(s, "SELECT 1 IS DISTINCT FROM NULL") is True
+    assert one(s, "SELECT NULL IS NOT DISTINCT FROM NULL") is True
+    assert one(s, "SELECT 1 IS DISTINCT FROM 1") is False
+    assert one(s, "SELECT 'a' IS NOT DISTINCT FROM 'a'") is True
+    rows = s.sql("SELECT x IS DISTINCT FROM y FROM (VALUES (1, 1), "
+                 "(1, NULL), (CAST(NULL AS INTEGER), NULL)) "
+                 "AS t(x, y)").rows
+    assert [r[0] for r in rows] == [False, True, False]
+    # never NULL, usable directly in WHERE
+    assert one(s, "SELECT count(*) FROM (VALUES (1),(2)) t(x) "
+               "WHERE x IS DISTINCT FROM 1") == 1
