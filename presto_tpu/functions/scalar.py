@@ -2170,12 +2170,47 @@ def _elem_type(t: T.Type) -> T.Type:
     return t.params[0] if t.params else T.UNKNOWN
 
 
+def _tuple_cmp(a, b) -> int:
+    """Total order over dictionary tuples: elementwise-lexicographic
+    with prefix ordering (python tuple semantics), NULL elements last,
+    nested tuples recursive, incomparable types by repr.  Code order ==
+    semantic order makes ORDER BY / min / max / </<= over ARRAY and ROW
+    columns correct straight from the codes (reference:
+    ArrayLessThanOperator ordering)."""
+    for x, y in zip(a, b):
+        if x is None and y is None:
+            continue
+        if x is None:
+            return 1
+        if y is None:
+            return -1
+        if isinstance(x, tuple) and isinstance(y, tuple):
+            c = _tuple_cmp(x, y)
+            if c:
+                return c
+            continue
+        try:
+            if x < y:
+                return -1
+            if y < x:
+                return 1
+        except TypeError:  # heterogenous slots: deterministic fallback
+            rx, ry = repr(x), repr(y)
+            if rx != ry:
+                return -1 if rx < ry else 1
+    return (len(a) > len(b)) - (len(a) < len(b))
+
+
 def _tuple_dict_normalize(values: np.ndarray, codes: ColVal,
                           out_type: T.Type) -> ColVal:
-    """normalize_dictionary for tuple dictionaries; repr-keyed sort is
-    deterministic even with NULL (None) elements mixed into tuples
-    (array code order is never compared semantically)."""
-    uniq = sorted(set(values.tolist()), key=repr)
+    """normalize_dictionary for tuple dictionaries, canonical order =
+    SEMANTIC order (see _tuple_cmp)."""
+    import functools as _ft
+
+    # repr pre-sort makes cmp-equal-but-distinct entries (1 vs 1.0)
+    # deterministic across processes (string hashes are randomized)
+    uniq = sorted(sorted(set(values.tolist()), key=repr),
+                  key=_ft.cmp_to_key(_tuple_cmp))
     code_map = {v: i for i, v in enumerate(uniq)}
     inverse = np.fromiter((code_map[v] for v in values.tolist()),
                           np.int32, len(values))

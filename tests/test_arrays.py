@@ -213,3 +213,15 @@ def test_values_with_collection_constants(session):
     r = session.sql("SELECT m['a'] FROM (VALUES (MAP(ARRAY['a'], "
                     "ARRAY[7]))) AS t(m)").rows
     assert r == [(7,)]
+
+
+def test_collection_order_by_and_min_max_semantic(session):
+    """Regression: dictionary canonical order was repr-based, so
+    ORDER BY / min / max over ARRAY columns followed string order
+    (ARRAY[10] sorted before ARRAY[2])."""
+    r = session.sql("SELECT a FROM (VALUES (ARRAY[2]), (ARRAY[10]), "
+                    "(ARRAY[1,5])) AS t(a) ORDER BY a").rows
+    assert [x[0] for x in r] == [(1, 5), (2,), (10,)]
+    r = session.sql("SELECT max(a), min(a) FROM (VALUES (ARRAY[2]), "
+                    "(ARRAY[10])) AS t(a)").rows
+    assert r == [((10,), (2,))]
