@@ -1508,6 +1508,16 @@ class Executor:
             s = K.segment_sum(jnp.where(valid, h, 0), gid, n_groups)
             return Column(s, nonempty, T.BIGINT)
         if a.fn == "approx_percentile":
+            if a.type.name == "ARRAY" or len(a.args) >= 3:
+                # array-of-percentiles / weighted forms: host-side
+                # (reference: Approximate*PercentileArrayAggregations +
+                # the weighted overloads)
+                if self.static:
+                    raise StaticFallback(
+                        "array/weighted approx_percentile is "
+                        "dynamic-mode only")
+                return self._approx_percentile_host(b, a, gid, n_groups,
+                                                    col, valid, nonempty)
             pv = eval_expr(a.args[1], b, self.ctx)
             p = pv.data if getattr(pv.data, "ndim", 0) == 0 else pv.data[0]
             x = col.data
@@ -1667,6 +1677,11 @@ class Executor:
                                           key=lambda p: repr(p[0])))
                              for g in groups]
             return _tuples_to_dict_column(tuples, nonempty, a.type)
+        if a.fn.startswith("classification_"):
+            if self.static:
+                raise StaticFallback(f"{a.fn} is dynamic-mode only")
+            return self._classification_host(b, a, gid, n_groups,
+                                             nonempty)
         if a.fn in ("set_agg", "set_union", "map_union_sum",
                     "approx_most_frequent", "reduce_agg",
                     "evaluate_classifier_predictions") \
@@ -1696,6 +1711,15 @@ class Executor:
                 s = s.astype(jnp.int64)
             return Column(s.astype(a.type.numpy_dtype()), nonempty, a.type)
         if a.fn == "avg":
+            if a.type.name.startswith("INTERVAL"):
+                # interval average stays an interval: truncating integer
+                # division of the micros/months sum (reference:
+                # IntervalDayToSecondAverageAggregation)
+                x = jnp.where(valid, col.data, jnp.zeros_like(col.data))
+                s = K.segment_sum(x, gid, n_groups).astype(jnp.int64)
+                d = jnp.maximum(cnt, 1)
+                r = jnp.sign(s) * (jnp.abs(s) // d)
+                return Column(r, nonempty, a.type)
             if getattr(col.data, "ndim", 1) == 2:  # long decimal limbs
                 from presto_tpu.exec import dec128 as D128
 
@@ -2136,6 +2160,126 @@ class Executor:
                 per_group[gi][si] = val
         results = [g[0] if g else None for g in per_group]
         return to_column(_colval_from_pylist(results, st), n_groups)
+
+    def _approx_percentile_host(self, b: Batch, a: ir.AggCall, gid,
+                                n_groups, col, valid, nonempty) -> Column:
+        """Array-of-percentiles and weighted approx_percentile: exact
+        host computation per group over (value, cumulative weight)
+        (reference: Approximate*PercentileArrayAggregations and the
+        weighted overloads; exact beats approximate at these sizes)."""
+        has_weight = len(a.args) >= 3
+        pv = eval_expr(a.args[2 if has_weight else 1], b, self.ctx)
+        if pv.dictionary is not None:  # ARRAY of percentiles
+            ps = list(pv.dictionary.values[int(np.asarray(pv.data).flat[0])])
+            array_out = True
+        else:
+            p0 = np.asarray(pv.data)
+            ps = [float(p0 if p0.ndim == 0 else p0.flat[0])]
+            array_out = False
+        data = np.asarray(col.data, np.float64)
+        if col.type.is_decimal:
+            data = data / (10 ** col.type.decimal_scale)
+        wts = np.ones(b.capacity)
+        if has_weight:
+            wcol = to_column(eval_expr(a.args[1], b, self.ctx), b.capacity)
+            wts = np.asarray(wcol.data, np.float64)
+            if wts.ndim == 0:
+                wts = np.full(b.capacity, float(wts))
+        gidh = np.asarray(gid)
+        vh = np.asarray(valid)
+        outs = np.empty(n_groups, dtype=object)
+        scalar_vals = np.zeros(n_groups)
+        for g in range(n_groups):
+            m = (gidh == g) & vh & (wts > 0)
+            if not m.any():
+                outs[g] = None
+                continue
+            v = data[m]
+            w = wts[m]
+            o = np.argsort(v, kind="stable")
+            v, w = v[o], w[o]
+            cw = np.cumsum(w)
+            qs = []
+            for p in ps:
+                # first value whose cumulative weight reaches p * total
+                i = int(np.searchsorted(cw, float(p) * cw[-1],
+                                        side="left"))
+                qs.append(float(v[min(i, len(v) - 1)]))
+            outs[g] = tuple(qs)
+            scalar_vals[g] = qs[0]
+        if array_out:
+            et = a.type.params[0]
+            if et.is_integer:
+                outs_t = np.empty(n_groups, dtype=object)
+                outs_t[:] = [None if t is None
+                             else tuple(int(x) for x in t) for t in outs]
+                outs = outs_t
+            ok = jnp.asarray(np.asarray(
+                [t is not None for t in outs], bool)) & nonempty
+            tuples = np.empty(n_groups, dtype=object)
+            tuples[:] = [t if t is not None else () for t in outs]
+            return _tuples_to_dict_column(tuples, ok, a.type)
+        vals = scalar_vals
+        if a.type.is_integer:
+            vals = np.rint(vals)
+        ok = jnp.asarray(np.asarray([t is not None for t in outs], bool))
+        return Column(jnp.asarray(vals.astype(a.type.numpy_dtype())),
+                      ok & nonempty, a.type)
+
+    def _classification_host(self, b: Batch, a: ir.AggCall, gid,
+                             n_groups, nonempty) -> Column:
+        """classification_{miss_rate, fall_out, precision, recall,
+        thresholds}(buckets, truth, prediction[, weight]) ->
+        ARRAY(DOUBLE) at thresholds i/buckets (reference:
+        PrecisionRecallAggregation family; prediction >= threshold
+        counts as a positive call)."""
+        bk = np.asarray(eval_expr(a.args[0], b, self.ctx).data)
+        buckets = int(bk if bk.ndim == 0 else bk.flat[0])
+        if buckets < 2:
+            raise ExecutionError(f"{a.fn}: buckets must be >= 2")
+        tcol = to_column(eval_expr(a.args[1], b, self.ctx), b.capacity)
+        pcol = to_column(eval_expr(a.args[2], b, self.ctx), b.capacity)
+        truth = np.asarray(tcol.data, bool)
+        pred = np.asarray(pcol.data, np.float64)
+        wts = np.ones(b.capacity)
+        if len(a.args) > 3:
+            wcol = to_column(eval_expr(a.args[3], b, self.ctx), b.capacity)
+            wts = np.asarray(wcol.data, np.float64)
+        vh = np.asarray(b.sel)
+        for c in (tcol, pcol):
+            if c.valid is not None:
+                vh = vh & np.asarray(c.valid)
+        if np.any(vh & ((pred < 0) | (pred > 1))):
+            raise ExecutionError(
+                f"{a.fn}: predictions must be in [0, 1]")
+        gidh = np.asarray(gid)
+        th = np.arange(buckets) / buckets
+        tuples = np.empty(n_groups, dtype=object)
+        for g in range(n_groups):
+            m = (gidh == g) & vh
+            if not m.any():
+                tuples[g] = ()
+                continue
+            t, p, w = truth[m], pred[m], wts[m]
+            pos = p[:, None] >= th[None, :]  # (rows, buckets)
+            tp = (w[:, None] * (pos & t[:, None])).sum(0)
+            fp = (w[:, None] * (pos & ~t[:, None])).sum(0)
+            fn_ = (w[:, None] * (~pos & t[:, None])).sum(0)
+            tn = (w[:, None] * (~pos & ~t[:, None])).sum(0)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                if a.fn == "classification_thresholds":
+                    out = th
+                elif a.fn == "classification_precision":
+                    out = tp / (tp + fp)
+                elif a.fn == "classification_recall":
+                    out = tp / (tp + fn_)
+                elif a.fn == "classification_miss_rate":
+                    out = fn_ / (tp + fn_)
+                else:  # fall_out
+                    out = fp / (fp + tn)
+            tuples[g] = tuple(None if np.isnan(x) else float(x)
+                              for x in np.broadcast_to(out, th.shape))
+        return _tuples_to_dict_column(tuples, nonempty, a.type)
 
     def _merge_agg_column(self, b: Batch, a: ir.AggCall, gid, n_groups,
                           mask) -> Column:

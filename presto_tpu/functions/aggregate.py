@@ -49,10 +49,18 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
     if name == "approx_distinct":
         return T.BIGINT
     if name == "sum":
+        if arg_types[0].name in ("INTERVAL_DAY_TIME",
+                                 "INTERVAL_YEAR_MONTH"):
+            # reference: IntervalDayToSecondSumAggregation
+            return arg_types[0]
         if not arg_types[0].is_numeric:
             raise TypeError(f"sum over {arg_types[0]}")
         return _numeric_sum_type(arg_types[0])
     if name == "avg":
+        if arg_types[0].name in ("INTERVAL_DAY_TIME",
+                                 "INTERVAL_YEAR_MONTH"):
+            # reference: IntervalDayToSecondAverageAggregation
+            return arg_types[0]
         if not arg_types[0].is_numeric:
             raise TypeError(f"avg over {arg_types[0]}")
         return T.DOUBLE
@@ -87,6 +95,20 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         if arg_types[0].name != "MAP":
             raise TypeError("map_union takes a MAP argument")
         return arg_types[0]
+    if name in ("classification_miss_rate", "classification_fall_out",
+                "classification_precision", "classification_recall",
+                "classification_thresholds"):
+        # (buckets, truth_bool, prediction_prob[, weight]) ->
+        # ARRAY(DOUBLE) at thresholds i/buckets (reference:
+        # Classification*Aggregation / PrecisionRecallAggregation)
+        if len(arg_types) not in (3, 4) \
+                or not arg_types[0].is_integer \
+                or arg_types[1].name != "BOOLEAN" \
+                or not arg_types[2].is_numeric:
+            raise TypeError(
+                f"{name} takes (buckets, truth boolean, prediction"
+                "[, weight])")
+        return T.array_of(T.DOUBLE)
     if name == "evaluate_classifier_predictions":
         # (truth, prediction) -> summary text (reference: presto-ml
         # EvaluateClassifierPredictionsAggregation)
@@ -108,11 +130,21 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
                             "is not supported")
         return T.VARBINARY  # serialized model (presto-ml Model role)
     if name == "approx_percentile":
-        if len(arg_types) != 2:
-            raise TypeError("approx_percentile takes (value, percentile)")
-        if not arg_types[0].is_numeric:
-            raise TypeError(f"approx_percentile over {arg_types[0]}")
-        return arg_types[0]
+        # (value, p) / (value, ARRAY[p..]) / (value, weight, p[, acc])
+        # — reference: Approximate*PercentileAggregations (+Array forms)
+        if not arg_types or not arg_types[0].is_numeric:
+            raise TypeError(
+                f"approx_percentile over {arg_types or 'no args'}")
+        if len(arg_types) == 2:
+            if arg_types[1].name == "ARRAY":
+                return T.array_of(arg_types[0])
+            return arg_types[0]
+        if len(arg_types) in (3, 4):
+            if arg_types[2].name == "ARRAY":
+                return T.array_of(arg_types[0])
+            return arg_types[0]
+        raise TypeError("approx_percentile takes (value[, weight], "
+                        "percentile[, accuracy])")
     if name == "checksum":
         return T.BIGINT
     if name in ("min_by", "max_by"):
@@ -196,6 +228,9 @@ AGG_NAMES = {
     "map_union", "learn_classifier", "learn_regressor",
     "set_agg", "set_union", "map_union_sum", "approx_most_frequent",
     "reduce_agg", "evaluate_classifier_predictions",
+    "classification_miss_rate", "classification_fall_out",
+    "classification_precision", "classification_recall",
+    "classification_thresholds",
 }
 
 

@@ -99,3 +99,49 @@ def test_evaluate_classifier_predictions(s):
     assert r.splitlines()[0] == "Accuracy: 3/4 (75.00%)"
     assert "Precision(b): 2/3 (66.67%)" in r
     assert "Recall(a): 1/2 (50.00%)" in r
+
+
+def test_approx_percentile_array_form(s):
+    assert one(s, "SELECT approx_percentile(x, ARRAY[0.25, 0.5, 0.75]) "
+               "FROM (VALUES (1),(2),(3),(4)) AS t(x)") == (1, 2, 3)
+    rows = s.sql("SELECT g, approx_percentile(x, ARRAY[0.5]) FROM "
+                 "(VALUES (1,1),(1,9),(2,5)) AS t(g,x) GROUP BY g "
+                 "ORDER BY g").rows
+    assert rows == [(1, (1,)), (2, (5,))]
+
+
+def test_approx_percentile_weighted(s):
+    # weight 10 on the value 3 pulls the median to 3
+    assert one(s, "SELECT approx_percentile(x, w, 0.5) FROM "
+               "(VALUES (1,1),(2,1),(3,10)) AS t(x,w)") == 3
+    assert one(s, "SELECT approx_percentile(x, w, ARRAY[0.5, 0.9]) "
+               "FROM (VALUES (1.0,1),(2.0,1),(3.0,10)) AS t(x,w)") == \
+        (3.0, 3.0)
+
+
+def test_interval_sum_avg(s):
+    r = s.sql("SELECT sum(d), avg(d) FROM (VALUES (INTERVAL '1' DAY), "
+              "(INTERVAL '2' DAY)) AS t(d)").rows
+    assert r == [(3 * 86400 * 1_000_000, 3 * 86400 * 1_000_000 // 2)]
+
+
+def test_classification_metrics(s):
+    base = ("(VALUES (true, 0.9), (false, 0.6), (true, 0.3), "
+            "(false, 0.1)) AS x(t, p)")
+    assert one(s, f"SELECT classification_thresholds(4, t, p) "
+               f"FROM {base}") == (0.0, 0.25, 0.5, 0.75)
+    # at threshold 0.5: called positive = {0.9, 0.6}; TP=1 FP=1
+    prec = one(s, f"SELECT classification_precision(4, t, p) FROM {base}")
+    assert prec[2] == pytest.approx(0.5)
+    rec = one(s, f"SELECT classification_recall(4, t, p) FROM {base}")
+    assert rec[2] == pytest.approx(0.5)
+    miss = one(s, f"SELECT classification_miss_rate(4, t, p) FROM {base}")
+    assert miss[2] == pytest.approx(0.5)
+    fo = one(s, f"SELECT classification_fall_out(4, t, p) FROM {base}")
+    assert fo[2] == pytest.approx(0.5)
+
+
+def test_classification_rejects_bad_predictions(s):
+    with pytest.raises(Exception, match="0, 1"):
+        s.sql("SELECT classification_precision(2, t, p) FROM "
+              "(VALUES (true, 1.5)) AS x(t, p)")
