@@ -15,11 +15,13 @@ Baselines (VERDICT r1 asked for an honest one):
   kept for continuity with BENCH_r01).
 
 Extra keys: per_query_ms (warm best per query), sf, note, scale_configs
-(last-known SF10/SF100 results from BENCH_SCALE_PROGRESS.json — the line
-prints BEFORE the slow scale configs re-run, so the caller always
-captures a number even under a process timeout).
-Env knobs: BENCH_SF, BENCH_QUERIES, BENCH_RUNS, BENCH_F32, BENCH_SCALE,
-BENCH_SF1_TESTS, BENCH_TIME_BUDGET.
+(ALWAYS the committed records from BENCH_SCALE_PROGRESS.json; a default
+run never re-measures them — re-measuring is BENCH_SCALE=1 opt-in and
+runs after the line prints, under a budget sized to finish before the
+driver's 3600s kill).
+Env knobs: BENCH_SF, BENCH_QUERIES, BENCH_RUNS, BENCH_F32,
+BENCH_SCALE (=1 re-measures scale configs post-emit), BENCH_SF1_TESTS,
+BENCH_TIME_BUDGET, BENCH_TOTAL_BUDGET (default 3300s).
 """
 
 import json
@@ -34,13 +36,15 @@ QUERY_IDS = [int(x) for x in os.environ.get("BENCH_QUERIES", "1,3,6,18").split("
 RUNS = int(os.environ.get("BENCH_RUNS", "3"))
 
 # Whole-PROCESS wall-clock budget.  Four rounds of rc=124 proved the
-# driver kills the process before it exits on its own (round-2 lost the
-# emitted line entirely to a flaky kill).  Everything after the emitted
-# JSON line is best-effort and must leave the process time to exit
-# cleanly: phases are gated on _remaining(), and a SIGALRM backstop
-# exits 0 if a single config overruns its estimate mid-flight.
+# driver kills the process at 3600s before it exits on its own — the
+# old 3600s in-process budget (and a SIGALRM set at remaining+60) could
+# only ever fire AFTER the external kill.  The budget now sits 300s
+# under the driver's limit and the backstop fires exactly at the
+# budget, so the process always reaches its own clean exit first.
+# Everything after the emitted JSON line is best-effort and gated on
+# _remaining().
 _T0 = time.perf_counter()
-TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET", "3600"))
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET", "3300"))
 
 
 def _remaining():
@@ -58,7 +62,10 @@ def _install_deadline_backstop():
 
     try:
         signal.signal(signal.SIGALRM, _bail)
-        signal.alarm(max(int(_remaining()) + 60, 1))
+        # at the budget, NOT beyond it: the old +60s grace pushed the
+        # backstop past the driver's own kill, which is how four rounds
+        # of rc=124 shipped
+        signal.alarm(max(int(_remaining()), 1))
     except (ValueError, OSError, AttributeError):
         pass  # non-main thread / platform without SIGALRM
 
@@ -101,9 +108,9 @@ def main():
     # ONE line on stdout, emitted IMMEDIATELY after the SF1 measurements
     # (round-2 lesson: the scale configs below can outlive the caller's
     # process timeout; holding the line until after them lost the whole
-    # round's perf record).  scale_configs in the line are the last-known
-    # results from the committed side file (BENCH_SCALE_PROGRESS.json),
-    # refreshed after the line is printed.
+    # round's perf record).  scale_configs in the line are the committed
+    # records from BENCH_SCALE_PROGRESS.json; re-measuring them is
+    # BENCH_SCALE=1 opt-in, after the line prints.
     print(json.dumps({
         "metric": f"tpch_sf{SF:g}_q{'_'.join(map(str, QUERY_IDS))}_rows_per_sec_per_chip",
         "value": round(rows_per_sec, 1),
@@ -123,19 +130,20 @@ def main():
                  "warm times include ~100ms tunnel RTT per query; "
                  "scale_configs = BASELINE SF10/SF100 wall-clock on "
                  "one chip (device-side generation + chunked "
-                 "execution), last-known results refreshed after this "
-                 "line prints (each entry carries asof)"
+                 "execution), committed records (each entry carries "
+                 "asof; BENCH_SCALE=1 re-measures post-emit)"
                  + ("" if vs_numpy is not None
                     else "; NUMPY BASELINE FAILED - vs_baseline fell "
                          "back to sqlite")), }, ), flush=True)
 
     # Post-emit phases (best-effort; the record above is already out).
-    # Scale configs run FIRST — BASELINE configs 3/5 have repeatedly
-    # been starved by the process timeout when anything ran before
-    # them (round-3 VERDICT item 3); the SF1 correctness tier
-    # (spill/guards at non-toy scale) takes whatever budget remains.
+    # scale_configs in the emitted line always come from the COMMITTED
+    # progress file; re-MEASURING them is opt-in (BENCH_SCALE=1) because
+    # the re-measure phase is what overran the driver's timeout four
+    # rounds running — a default bench run now does SF1 + the SF1 test
+    # tier and exits 0 well inside the external limit.
     _install_deadline_backstop()
-    if os.environ.get("BENCH_SCALE", "1") != "0":
+    if os.environ.get("BENCH_SCALE", "0") == "1":
         scale_configs(session_factory=_scale_session)
     if os.environ.get("BENCH_SF1_TESTS", "1") != "0" and _remaining() > 600:
         run_sf1_tier()
@@ -145,11 +153,22 @@ SCALE_PROGRESS_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_SCALE_PROGRESS.json")
 
 
+# warm per-query times on the tunneled chip include ~100ms of pure
+# round trip; the gate models that floor explicitly so RTT-dominated
+# queries (Q1/Q6) are held to the floor, not to 1.2x of a number that
+# is mostly network
+GATE_RTT_FLOOR_MS = 100.0
+GATE_RATIO = 1.2
+
+
 def perf_gate(engine_times):
     """Per-query regression gate vs committed reference warm times
-    (tests/perf_reference.json): >1.5x on any query is a FAIL, reported
-    in the emitted line so a regressed round is visibly red (round-3
-    VERDICT item 1).  Only meaningful on the real chip at SF1."""
+    (tests/perf_reference.json): FAIL when any query exceeds
+    RTT_floor + 1.2x its reference COMPUTE time (ref - floor), reported
+    in the emitted line so a regressed round is visibly red.  The old
+    1.5x-of-total gate let a 40% Q1 regression "pass" (round-5 VERDICT
+    weak #3) because 1.5x of an RTT-dominated reference hides ~70ms of
+    real compute regression.  Only meaningful on the real chip at SF1."""
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "tests", "perf_reference.json")) as f:
@@ -165,8 +184,14 @@ def perf_gate(engine_times):
     bad = {}
     for qid, t in engine_times.items():
         r = ref.get(str(qid))
-        if r is not None and t * 1000 > 1.5 * r:
-            bad[str(qid)] = f"{t * 1000:.0f}ms > 1.5x ref {r:.0f}ms"
+        if r is None:
+            continue
+        limit = GATE_RTT_FLOOR_MS + GATE_RATIO * max(r - GATE_RTT_FLOOR_MS,
+                                                     0.0)
+        if t * 1000 > limit:
+            bad[str(qid)] = (f"{t * 1000:.0f}ms > limit {limit:.0f}ms "
+                             f"(ref {r:.0f}ms, {GATE_RATIO}x over "
+                             f"{GATE_RTT_FLOOR_MS:.0f}ms RTT floor)")
     return ("FAIL: " + "; ".join(f"q{k} {v}" for k, v in bad.items())) \
         if bad else "pass"
 

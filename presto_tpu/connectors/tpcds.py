@@ -11,10 +11,13 @@ returns reference their parent sale's item/ticket/customer/prices); NOT
 bit-identical to dsdgen — correctness testing is differential against
 sqlite over identical generated data.
 
-Covered tables (15): the dimensions + store/catalog sales channels —
-everything needed by the star-schema query class incl. q64.  Not yet
-generated: web_* channel, inventory, time_dim, call_center,
-catalog_page.
+Covered tables: ALL 24 of the TPC-DS schema — every dimension
+(date_dim, time_dim, item, customer, customer_address,
+customer_demographics, household_demographics, income_band, promotion,
+reason, ship_mode, store, warehouse, web_site, web_page, call_center,
+catalog_page) and every fact channel (store_sales/store_returns,
+catalog_sales/catalog_returns, web_sales/web_returns, inventory),
+enough for the full 99-query differential corpus.
 
 Row counts at SF1 follow the spec (store_sales 2,880,404; catalog_sales
 1,441,548; returns ~10% of sales).  Fixed-size dimensions

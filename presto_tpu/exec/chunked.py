@@ -271,6 +271,19 @@ def _run_fragments(session, frags, runner, table_family, consumer_eid):
     return final_batch
 
 
+def _root_order_insensitive(root) -> bool:
+    """May this fragment's OUTPUT rows arrive in any order?  True for a
+    partial-aggregate root: its consumer is the FINAL aggregate, which
+    re-groups whatever order the buffered partials arrive in.  (Join
+    subtrees below an in-fragment aggregate are covered by the
+    executor's walk independent of this root flag.)"""
+    node = root
+    while type(node).__name__ in ("Output", "Project", "Filter"):
+        node = node.source
+    return type(node).__name__ == "Aggregate" \
+        and getattr(node, "step", "SINGLE") == "PARTIAL"
+
+
 class _MeshGridView:
     """Presents a base chunk grid as a grid of SUPERSTEPS: superstep i
     covers micro-chunks [i*n, (i+1)*n), one per mesh device, with args
@@ -443,6 +456,15 @@ class _FragmentRunner:
                                               _static_root_bound)
 
         ex = Executor(self.session, static=True, scan_inputs=scan_inputs)
+        # sort-order materialization hint (gather.py): a chunk
+        # fragment's OUTPUT rows are compacted, buffered, and consumed
+        # by the next fragment's aggregate/TopN/join — all of which
+        # re-sort or re-group, so a partial-aggregate root's row order
+        # is free and the joins below it may materialize in
+        # sorted-gather order.  A projection-rooted fragment (rows
+        # surface as-is) stays conservative.
+        ex.mark_order_insensitive(frag.root,
+                                  _root_order_insensitive(frag.root))
         out = ex.exec_node(frag.root)
         # shrink inside the compiled program: the eager compact outside
         # would otherwise walk a chunk-capacity-sized batch at peak HBM.
@@ -656,7 +678,10 @@ class _FragmentRunner:
         across nodes (execution/scheduler/group/LifespanScheduler.java).
         Returns (superstep callable, grid view whose "chunks" are
         supersteps)."""
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:  # moved to core in newer jax; 0.4.x path:
+            from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as PS
 
         from presto_tpu.parallel.mesh import AXIS, make_mesh

@@ -23,6 +23,7 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu.batch import (Batch, Column, batch_from_numpy,
                               decode_host_column, to_numpy)
+from presto_tpu.exec import gather as GA
 from presto_tpu.exec import kernels as K
 from presto_tpu.exec.compiler import EvalContext, eval_expr, eval_predicate, to_column
 from presto_tpu.plan import ir
@@ -523,10 +524,14 @@ def _compact_batch(out: Batch, bound: int) -> Batch:
     top = jax.lax.top_k(score, bound)[0]
     idx = jnp.clip(cap - top, 0, cap - 1)
     count = jnp.sum(out.sel)
-    cols = {n: Column(c.data[idx],
-                      None if c.valid is None else c.valid[idx],
-                      c.type, c.dictionary)
-            for n, c in out.columns.items()}
+    # idx is nondecreasing by construction (descending top_k scores →
+    # ascending positions, dead-slot tail clips to cap-1), so the
+    # materialization is one presorted packed gather — the staged tier
+    # streams it through VMEM windows at chunk-compaction sizes
+    raw, _ = K.take_columns(out.columns, idx, presorted=True)
+    cols = {n: Column(data, valid, out.columns[n].type,
+                      out.columns[n].dictionary)
+            for n, (data, valid) in raw.items()}
     return Batch(cols, jnp.arange(bound) < count)
 
 
@@ -838,6 +843,58 @@ class Executor:
                 monitor.stats.query_id, pool,
                 int(session.properties.get("query_max_memory_bytes", 4 << 30)))
         self.mem = mem
+
+    # aggregates whose VALUE depends on input row order (beyond float
+    # rounding): reordering their input would change results, not just
+    # permute them
+    _ORDER_SENSITIVE_AGGS = frozenset({
+        "array_agg", "map_agg", "multimap_agg", "arbitrary", "any_value"})
+
+    def mark_order_insensitive(self, root: P.PlanNode, root_flag: bool):
+        """Precompute which plan nodes may emit their output in ANY row
+        order — the hint behind sort-order materialization (gather.py):
+        a join below an aggregation can leave its rows in sorted-gather
+        order and skip the inverse permutation, because grouping sorts
+        by key anyway and semi-join membership is a set question.
+
+        `root_flag` says whether the ROOT's own output order is free
+        (chunked partial fragments feeding a final aggregate/TopN: yes;
+        a whole query's result rows: no).  The walk ANDs over every
+        path to a node, so a shared DAG subtree feeding one
+        order-sensitive consumer stays unmarked."""
+        flags: Dict[int, bool] = {}
+
+        def walk(node, flag):
+            prev = flags.get(id(node))
+            flags[id(node)] = flag if prev is None else (prev and flag)
+            t = type(node).__name__
+            if t == "Aggregate":
+                walk(node.source, not any(
+                    a.fn in self._ORDER_SENSITIVE_AGGS
+                    for a in node.aggs.values()))
+            elif t in ("Filter", "Project", "Output"):
+                # row-wise: input permutation = same output permutation
+                walk(node.source, flag)
+            elif t == "Join":
+                walk(node.left, flag)
+                # SEMI/ANTI/MARK consume the build side as a SET
+                walk(node.right, True if node.join_type in
+                     ("SEMI", "ANTI", "MARK") else flag)
+            elif t == "Union":
+                for s in node.sources_:
+                    walk(s, flag)
+            else:
+                # Sort/TopN/Limit/Window/Unnest/...: input order shows
+                # through (tie-breaking, first-n, frames) — conservative
+                for s in getattr(node, "sources", []):
+                    walk(s, False)
+
+        walk(root, root_flag)
+        self._oi_ids = {i for i, f in flags.items() if f}
+
+    def _order_ok(self, node) -> bool:
+        oi = getattr(self, "_oi_ids", None)
+        return oi is not None and id(node) in oi
 
     # ------------------------------------------------------------------
     def run(self, plan: P.QueryPlan) -> QueryResult:
@@ -2698,12 +2755,27 @@ class Executor:
         k = jnp.tile(jnp.arange(bound, dtype=jnp.int32), n)
         cnt_l, lb_l = K.take_rows(
             [jnp.minimum(counts, bound).astype(jnp.int32),
-             lb.astype(jnp.int32)], lidx)
+             lb.astype(jnp.int32)], lidx, presorted=True)
         slot_live = k < cnt_l
         rpos = jnp.clip(lb_l + k, 0, max(order.shape[0] - 1, 0))
         ridx = order[rpos]
-        lbatch = K.gather_batch(left, lidx)
-        rbatch = K.gather_batch(right, ridx, idx_valid=slot_live)
+        if self._order_ok(node) and GA.sort_order_worthwhile(
+                total, K.batch_word_width(right) - K.batch_word_width(left)):
+            # sort-order materialization: every consumer up the tree is
+            # order-insensitive, so the join output simply STAYS in
+            # build-index order — the wide right side gathers
+            # sequentially and nobody pays the way back.  The slot
+            # arithmetic (k, slot_live) and the probe indices ride the
+            # one planning sort.
+            ridx, (lidx, k, slot_live) = K.sort_order_plan(
+                ridx, lidx, k, slot_live)
+            lbatch = K.gather_batch(left, lidx)
+            rbatch = K.gather_batch(right, ridx, idx_valid=slot_live,
+                                    presorted=True)
+        else:
+            # lidx is repeat(arange): nondecreasing by construction
+            lbatch = K.gather_batch(left, lidx, presorted=True)
+            rbatch = K.gather_batch(right, ridx, idx_valid=slot_live)
         merged = dict(lbatch.columns)
         merged.update(rbatch.columns)
         out = Batch(merged, lbatch.sel & slot_live)
@@ -2753,8 +2825,18 @@ class Executor:
         has_match = counts[lidx] > 0
         rpos = jnp.clip(lb[lidx] + k, 0, max(order.shape[0] - 1, 0))
         ridx = order[rpos]
-        lbatch = K.gather_batch(left, lidx)
-        rbatch = K.gather_batch(right, ridx, idx_valid=has_match)
+        if self._order_ok(node) and GA.sort_order_worthwhile(
+                total, K.batch_word_width(right) - K.batch_word_width(left)):
+            # sort-order materialization (see _expanding_join_static)
+            ridx, (lidx, k, has_match) = K.sort_order_plan(
+                ridx, lidx, k, has_match)
+            rbatch = K.gather_batch(right, ridx, idx_valid=has_match,
+                                    presorted=True)
+            lbatch = K.gather_batch(left, lidx)
+        else:
+            rbatch = K.gather_batch(right, ridx, idx_valid=has_match)
+            # lidx is repeat(arange): nondecreasing by construction
+            lbatch = K.gather_batch(left, lidx, presorted=True)
         merged = dict(lbatch.columns)
         merged.update(rbatch.columns)
         sel = lbatch.sel

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.batch import Batch, Column, Dictionary
+from presto_tpu.exec import gather as G
 from presto_tpu.exec.colval import translate_codes
 
 I64_MIN = np.iinfo(np.int64).min
@@ -550,14 +551,55 @@ def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray):
     return order, lb, ub
 
 
-def take_rows(arrays: List[jnp.ndarray], idx: jnp.ndarray) -> List[jnp.ndarray]:
+def sort_order_plan(idx: jnp.ndarray, *aligned):
+    """Pre-permute a gather's request-aligned operands into ASCENDING
+    index order — the sort-order materialization primitive (reference
+    role: PagesIndex.getSortedPages).  Returns (sorted_idx,
+    [aligned...]) permuted by ONE lax.sort; callers then gather with
+    presorted=True and simply leave the batch in sorted order, skipping
+    the inverse permutation entirely.  Only valid when every downstream
+    consumer is order-insensitive (aggregation, semi-join membership) —
+    the executor's order-insensitivity walk decides that."""
+    ii = jnp.asarray(idx).astype(jnp.int32)
+    ops = [ii]
+    bools = []
+    for a in aligned:
+        a = jnp.asarray(a)
+        bools.append(a.dtype == jnp.bool_)
+        ops.append(a.astype(jnp.int32) if a.dtype == jnp.bool_ else a)
+    out = jax.lax.sort(tuple(ops), num_keys=1)
+    rest = [o.astype(jnp.bool_) if b else o
+            for o, b in zip(out[1:], bools)]
+    return out[0], rest
+
+
+def batch_word_width(batch: Batch) -> int:
+    """u32 words one gathered row of this batch costs (the take_rows
+    pack width): sizes the sort-order-materialization side choice."""
+    w = 0
+    for c in batch.columns.values():
+        w += 2 if c.data.dtype.itemsize == 8 else 1
+        if c.valid is not None:
+            w += 1
+    return w
+
+
+def take_rows(arrays: List[jnp.ndarray], idx: jnp.ndarray,
+              presorted: bool = False) -> List[jnp.ndarray]:
     """Gather idx rows from every array, packing columns into one u32
     matrix so ONE gather moves them all.  TPU gathers pay a fixed
     per-index cost (~45ms per 6M f32 rows, measured) that amortizes
     across the row width: gathering a (6M,8) matrix costs ~1/7th of 8
     separate column gathers.  All 4-byte types bitcast to u32; bools
     widen; i64 splits into two u32 words; f64 stays separate (the TPU
-    X64 rewriter cannot lower f64 bitcasts)."""
+    X64 rewriter cannot lower f64 bitcasts).
+
+    Large gathers route through the gather-aware tier (exec/gather.py):
+    indices are sorted, rows are staged through VMEM-windowed
+    sequential reads (Pallas block-gather), and results ride ONE
+    co-sort back to request order.  `presorted=True` asserts idx is
+    already nondecreasing (ascending expansions, sort_order_plan
+    output): the staging then skips both the sort and the way home."""
     if arrays and arrays[0].shape[0] == 0 and idx.shape[0] > 0:
         # gathering from an EMPTY source (e.g. a zero-row exchange
         # buffer): every index is dead and the caller masks the result —
@@ -585,6 +627,12 @@ def take_rows(arrays: List[jnp.ndarray], idx: jnp.ndarray) -> List[jnp.ndarray]:
             spec[i] = ("widen", len(words))
             words.append(jax.lax.bitcast_convert_type(
                 a.astype(jnp.int32), jnp.uint32))
+    n_src = arrays[0].shape[0] if arrays else 0
+    route = G.gather_route(n_src, idx.shape[0], len(words), presorted)
+    if route == "staged" and all(w.ndim == 1 for w in words):
+        # 2-D words (Int128 limb columns) keep the flat path — the u32
+        # matrix pack is rank-1-per-word on both routes
+        return _take_rows_staged(arrays, idx, words, spec, presorted)
     # pack from TWO words up: the gather's per-index cost amortizes
     # across row width (measured: two separate 8M 1-col gathers 140ms
     # vs one (8M,2) packed gather 35-50ms on chip), so a single i64
@@ -595,11 +643,47 @@ def take_rows(arrays: List[jnp.ndarray], idx: jnp.ndarray) -> List[jnp.ndarray]:
     else:
         taken = [w[idx] for w in words]
         col = lambda k: taken[k]
+    return _rebuild_taken(arrays, idx, spec, col, out)
+
+
+def _take_rows_staged(arrays, idx, words, spec, presorted):
+    """Sorted-index staging: ascending gather through exec/gather's
+    VMEM-windowed kernel, then (for request-order callers) ONE co-sort
+    keyed on the saved positions carries every word — and the f64
+    side columns — home together.  Payload operands ride a lax.sort
+    nearly free; the inverse-permutation GATHER this replaces paid the
+    full ~45ns/index random cost a second time."""
+    out: List = [None] * len(arrays)
+    ii = jnp.asarray(idx).astype(jnp.int32)
+    if presorted:
+        sidx, spos = ii, None
+    else:
+        n = ii.shape[0]
+        sidx, spos = jax.lax.sort(
+            (ii, jnp.arange(n, dtype=jnp.int32)), num_keys=1)
+    mat = jnp.stack(words, axis=1)
+    rows = G.staged_gather(mat, sidx)
+    cols = [rows[:, k] for k in range(len(words))]
+    directs = {i: arrays[i][sidx] for i, a in enumerate(arrays)
+               if spec[i][0] == "direct"}
+    if spos is not None:
+        home = unpermute(spos, *(cols + list(directs.values())))
+        cols = list(home[:len(cols)])
+        directs = dict(zip(directs, home[len(cols):]))
+    col = lambda k: cols[k]
+    for i, a in enumerate(arrays):
+        if spec[i][0] == "direct":
+            out[i] = directs[i]
+    return _rebuild_taken(arrays, idx, spec, col, out, skip_direct=True)
+
+
+def _rebuild_taken(arrays, idx, spec, col, out, skip_direct=False):
     for i, a in enumerate(arrays):
         kind, k = spec[i]
         dt = a.dtype
         if kind == "direct":
-            out[i] = a[idx]
+            if not skip_direct:
+                out[i] = a[idx]
         elif kind == "bool":
             out[i] = col(k) != 0
         elif kind == "i64":
@@ -616,7 +700,8 @@ def take_rows(arrays: List[jnp.ndarray], idx: jnp.ndarray) -> List[jnp.ndarray]:
 
 
 def take_columns(columns: Dict[str, Column], idx: jnp.ndarray,
-                 extra: Optional[List[jnp.ndarray]] = None):
+                 extra: Optional[List[jnp.ndarray]] = None,
+                 presorted: bool = False):
     """Gather idx rows of (data, valid) for every column in one packed
     take_rows pass.  Returns ({name: (data, valid)}, [extra results]).
     `extra` arrays ride the same pack."""
@@ -626,7 +711,7 @@ def take_columns(columns: Dict[str, Column], idx: jnp.ndarray,
         arrays.append(c.data)
         if c.valid is not None:
             arrays.append(c.valid)
-    taken = take_rows(arrays, idx)
+    taken = take_rows(arrays, idx, presorted=presorted)
     out = {}
     i = n_extra
     for name, c in columns.items():
@@ -640,21 +725,27 @@ def take_columns(columns: Dict[str, Column], idx: jnp.ndarray,
     return out, taken[:n_extra]
 
 
-def _take_batch(batch: Batch, safe: jnp.ndarray):
+def _take_batch(batch: Batch, safe: jnp.ndarray, presorted: bool = False):
     """Gather rows of all of a batch's arrays (data+valid+sel) at safe
     (pre-clipped) indices with dtype-packed gathers."""
-    raw, (sel,) = take_columns(batch.columns, safe, extra=[batch.sel])
+    raw, (sel,) = take_columns(batch.columns, safe, extra=[batch.sel],
+                               presorted=presorted)
     cols = {name: (data, valid, batch.columns[name].type,
                    batch.columns[name].dictionary)
             for name, (data, valid) in raw.items()}
     return cols, sel
 
 
-def gather_batch(batch: Batch, idx: jnp.ndarray, idx_valid=None) -> Batch:
-    """Gather rows of all columns at idx (clipped); optionally mask."""
+def gather_batch(batch: Batch, idx: jnp.ndarray, idx_valid=None,
+                 presorted: bool = False) -> Batch:
+    """Gather rows of all columns at idx (clipped); optionally mask.
+    presorted=True asserts idx is nondecreasing (ascending expansions,
+    sort_order_plan output) so large gathers stage sequentially without
+    paying the way back to request order — idx_valid, if given, must
+    already be in the same (sorted) order."""
     n = batch.capacity
     safe = jnp.clip(idx, 0, max(n - 1, 0))
-    raw, sel = _take_batch(batch, safe)
+    raw, sel = _take_batch(batch, safe, presorted=presorted)
     cols = {}
     for name, (data, valid, typ, dic) in raw.items():
         if idx_valid is not None:

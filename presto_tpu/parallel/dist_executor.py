@@ -23,7 +23,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as PS
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # moved to core in newer jax; 0.4.x path:
+    from jax.experimental.shard_map import shard_map
 
 from presto_tpu.batch import Batch, Column
 from presto_tpu.exec.executor import Executor
@@ -148,8 +152,12 @@ def _build_and_run(session, stmt, cache, key, ndev):
         g = jax.lax.psum(g.astype(jnp.int32), AXIS) > 0
         return out, g
 
-    sharded = shard_map(fn, mesh=mesh, in_specs=(PS(AXIS),),
-                        out_specs=PS(), check_vma=False)
+    try:
+        sharded = shard_map(fn, mesh=mesh, in_specs=(PS(AXIS),),
+                            out_specs=PS(), check_vma=False)
+    except TypeError:  # pre-0.5 jax spells the kwarg check_rep
+        sharded = shard_map(fn, mesh=mesh, in_specs=(PS(AXIS),),
+                            out_specs=PS(), check_rep=False)
     jitted = jax.jit(sharded)
     entry = (dplan, jitted, scan_nodes, mesh)
     # trace/compile before caching so failures propagate to the caller

@@ -17,6 +17,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: tier-2 tests excluded from the tier-1 `-m 'not slow'` run")
+
+
 @pytest.fixture(scope="session")
 def tpch_catalog_tiny():
     from presto_tpu.catalog import tpch_catalog

@@ -24,7 +24,11 @@ def test_mesh_has_8_devices():
     assert len(jax.devices()) == 8
 
 
-@pytest.mark.parametrize("qid", sorted(QUERIES))
+# q21's mesh program alone costs ~40s of compile on the 1-core CI box;
+# test_all_22_tpch_queries_distribute still covers it in tier 1
+@pytest.mark.parametrize("qid", [
+    pytest.param(q, marks=pytest.mark.slow) if q == 21 else q
+    for q in sorted(QUERIES)])
 def test_tpch_query_distributed(qid, dsession, tpch_sqlite_tiny):
     sql = QUERIES[qid]
     actual = dsession.sql(sql)

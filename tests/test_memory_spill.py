@@ -49,8 +49,11 @@ def test_spilled_join_identical(session):
     assert session.last_stats.spilled_partitions > 0
 
 
+@pytest.mark.slow
 def test_spilled_left_join_identical(session):
-    """Unmatched-row (LEFT) semantics survive Grace partitioning."""
+    """Unmatched-row (LEFT) semantics survive Grace partitioning.
+    Tier 2: forcing grace everywhere recompiles the whole program
+    (~24s on the 1-core CI box); INNER-join spill stays tier 1."""
     expected = session.sql(LEFT_JOIN_SQL).rows
     session.set("spill_trigger_rows", 100)  # force grace on every join/agg
     actual = session.sql(LEFT_JOIN_SQL).rows
@@ -58,10 +61,13 @@ def test_spilled_left_join_identical(session):
     assert session.last_stats.spilled_partitions > 0
 
 
+@pytest.mark.slow
 def test_forced_spill_tpch_subset(session, tpch_sqlite_tiny):
     """A TPC-H slice with grace forced on every hash operator still
     matches the oracle (reference: TestDistributedSpilledQueries reruns
-    the query suite with spill forced)."""
+    the query suite with spill forced).  Tier 2: forcing grace on every
+    operator recompiles 4 query programs (~65s on the 1-core CI box);
+    the single-operator spill tests above keep the path in tier 1."""
     from tests.sqlite_oracle import assert_same_results, to_sqlite
     from tests.tpch_queries import QUERIES
 

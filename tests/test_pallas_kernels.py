@@ -7,6 +7,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from presto_tpu.exec import gather as G
 from presto_tpu.exec import kernels as K
 
 
@@ -118,3 +119,276 @@ def test_fused_agg_in_query(tpch_catalog_tiny):
         assert a[0] == b[0] and a[1] == b[1]
         assert abs(a[2] - b[2]) < 1e-6 * abs(b[2])
         assert abs(a[3] - b[3]) < 1e-9 * abs(b[3])
+
+
+# ---------------------------------------------------------------------------
+# gather-aware tier (exec/gather.py): blocked Pallas gather + sort-order
+# staging must be BYTE-IDENTICAL to the flat packed gather
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tiny_gather(monkeypatch):
+    """Shrink the routing/window constants so the staged tier (and the
+    Pallas block-gather inside it) engages at test sizes; 'force'
+    opts in to staging off-TPU (auto mode is TPU-only)."""
+    monkeypatch.setenv("PRESTO_TPU_GATHER", "force")
+    monkeypatch.setattr(G, "_STAGED_MIN_INDICES", 1)
+    monkeypatch.setattr(G, "_IB", 64)
+    monkeypatch.setattr(G, "_MAX_WINDOW", 512)
+    yield
+
+
+def _dtype_arrays(n, rng):
+    """One array per engine dtype class take_rows packs differently."""
+    return [
+        jnp.asarray(rng.integers(-(1 << 30), 1 << 30, n).astype(np.int32)),
+        jnp.asarray(rng.random(n).astype(np.float32)),
+        jnp.asarray(rng.integers(-(1 << 60), 1 << 60, n)),      # i64 pair
+        jnp.asarray(rng.random(n)),                             # f64 direct
+        jnp.asarray(rng.integers(0, 2, n).astype(bool)),
+        jnp.asarray(rng.integers(-100, 100, n).astype(np.int16)),
+    ]
+
+
+def test_staged_take_rows_matches_flat(tiny_gather, monkeypatch):
+    rng = np.random.default_rng(7)
+    n, m = 5000, 4096
+    arrays = _dtype_arrays(n, rng)
+    idx = jnp.asarray(rng.integers(0, n, m).astype(np.int32))
+    assert G.gather_route(n, m, 8) == "staged"
+    staged = K.take_rows(arrays, idx)
+    monkeypatch.setenv("PRESTO_TPU_GATHER", "flat")
+    flat = K.take_rows(arrays, idx)
+    for a, b in zip(flat, staged):
+        assert a.dtype == b.dtype
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_staged_take_rows_presorted(tiny_gather, monkeypatch):
+    rng = np.random.default_rng(8)
+    n, m = 3000, 2048
+    arrays = _dtype_arrays(n, rng)
+    sidx = jnp.asarray(np.sort(rng.integers(0, n, m)).astype(np.int32))
+    staged = K.take_rows(arrays, sidx, presorted=True)
+    monkeypatch.setenv("PRESTO_TPU_GATHER", "flat")
+    flat = K.take_rows(arrays, sidx)
+    for a, b in zip(flat, staged):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_staged_gather_skew_falls_back_covered(tiny_gather):
+    """Index blocks whose span exceeds the window must take the
+    lax.cond fallback and still return exact rows."""
+    rng = np.random.default_rng(9)
+    n, m = 8192, 1024
+    src = jnp.asarray(rng.integers(0, 1 << 32, (n, 3)).astype(np.uint32))
+    # maximally skewed: indices alternate across the whole range
+    skew = np.sort(np.concatenate([
+        np.zeros(m // 2, np.int32), np.full(m - m // 2, n - 1, np.int32)]))
+    # interleave so single blocks span the full source
+    skew[::2], skew[1::2] = 0, n - 1
+    skew = np.sort(skew)  # staged_gather requires ascending
+    out = G.staged_gather(src, jnp.asarray(skew))
+    assert np.array_equal(np.asarray(out), np.asarray(src)[skew])
+
+
+def test_staged_gather_dense_uses_windows(tiny_gather):
+    """Dense ascending indices satisfy coverage (windows engage) and
+    the result is exact."""
+    rng = np.random.default_rng(10)
+    n, m = 4096, 4096
+    src = jnp.asarray(rng.integers(0, 1 << 32, (n, 2)).astype(np.uint32))
+    sidx = jnp.asarray(np.sort(rng.integers(0, n, m)).astype(np.int32))
+    W = G.window_rows(n, m)
+    assert W is not None
+    out = G.staged_gather(src, sidx)
+    assert np.array_equal(np.asarray(out), np.asarray(src)[np.asarray(sidx)])
+
+
+def test_gather_batch_staged_oob_and_validity(tiny_gather, monkeypatch):
+    """gather_batch clips out-of-range indices and ANDs idx_valid the
+    same way on both routes, across validity masks."""
+    from presto_tpu import types as T
+    from presto_tpu.batch import Batch, Column
+
+    rng = np.random.default_rng(11)
+    n, m = 2000, 2048
+    cols = {
+        "a": Column(jnp.asarray(rng.integers(0, 99, n).astype(np.int32)),
+                    jnp.asarray(rng.integers(0, 2, n).astype(bool)),
+                    T.INTEGER, None),
+        "b": Column(jnp.asarray(rng.random(n)), None, T.DOUBLE, None),
+    }
+    b = Batch(cols, jnp.asarray(rng.integers(0, 2, n).astype(bool)))
+    idx = jnp.asarray(rng.integers(-50, n + 50, m).astype(np.int32))
+    iv = jnp.asarray(rng.integers(0, 2, m).astype(bool))
+    staged = K.gather_batch(b, idx, idx_valid=iv)
+    monkeypatch.setenv("PRESTO_TPU_GATHER", "flat")
+    flat = K.gather_batch(b, idx, idx_valid=iv)
+    assert np.array_equal(np.asarray(staged.sel), np.asarray(flat.sel))
+    for name in cols:
+        sc, fc = staged.columns[name], flat.columns[name]
+        assert np.array_equal(np.asarray(sc.data), np.asarray(fc.data))
+        if fc.valid is not None:
+            assert np.array_equal(np.asarray(sc.valid), np.asarray(fc.valid))
+
+
+def test_staged_gather_empty_inputs(tiny_gather):
+    src = jnp.zeros((0, 2), jnp.uint32)
+    out = G.staged_gather(jnp.zeros((16, 2), jnp.uint32),
+                          jnp.zeros((0,), jnp.int32))
+    assert out.shape == (0, 2)
+    # empty SOURCE goes through take_rows' zero-fill early return
+    zero = K.take_rows([jnp.zeros((0,), jnp.int32)],
+                       jnp.asarray([0, 0], dtype=jnp.int32))
+    assert zero[0].shape == (2,)
+
+
+def test_sort_order_plan_keeps_alignment():
+    rng = np.random.default_rng(12)
+    m = 5000
+    idx = jnp.asarray(rng.integers(0, 1000, m).astype(np.int32))
+    a = jnp.asarray(rng.integers(0, 7, m))
+    flag = jnp.asarray(rng.integers(0, 2, m).astype(bool))
+    sidx, (a2, f2) = K.sort_order_plan(idx, a, flag)
+    assert (np.diff(np.asarray(sidx)) >= 0).all()
+    assert f2.dtype == jnp.bool_
+    before = sorted(zip(np.asarray(idx).tolist(), np.asarray(a).tolist(),
+                        np.asarray(flag).tolist()))
+    after = sorted(zip(np.asarray(sidx).tolist(), np.asarray(a2).tolist(),
+                       np.asarray(f2).tolist()))
+    assert before == after
+
+
+# ---- routing heuristics (size/width crossover) ----------------------------
+
+
+def test_gather_route_crossovers(monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_GATHER", "force")
+    M = G._STAGED_MIN_INDICES
+    # large + wide: staged, both orders
+    assert G.gather_route(1 << 23, M, 4) == "staged"
+    assert G.gather_route(1 << 23, M, 4, presorted=True) == "staged"
+    # below the index threshold: flat
+    assert G.gather_route(1 << 23, M - 1, 8) == "flat"
+    # narrow request-order gathers can't amortize the co-sort home...
+    assert G.gather_route(1 << 23, M, 1) == "flat"
+    # ...but presorted ones skip it, so width 1 still stages
+    assert G.gather_route(1 << 23, M, 1, presorted=True) == "staged"
+    # degenerate sources
+    assert G.gather_route(0, M, 4) == "flat"
+    assert G.gather_route(1 << 23, M, 0) == "flat"
+
+
+def test_gather_route_env_off(monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_GATHER", "flat")
+    assert G.gather_route(1 << 23, 1 << 22, 8) == "flat"
+    assert not G.sort_order_worthwhile(1 << 22, 4)
+
+
+def test_gather_route_auto_is_tpu_only(monkeypatch):
+    """Auto mode must NOT stage off-TPU: the interpret-mode Pallas
+    grid at production index counts unrolls into an XLA CPU program
+    that effectively never finishes compiling (tpcds q37 regression)."""
+    monkeypatch.delenv("PRESTO_TPU_GATHER", raising=False)
+    assert jax.default_backend() != "tpu"
+    assert G.gather_route(1 << 23, 1 << 22, 8) == "flat"
+    assert G.gather_route(1 << 23, 1 << 22, 8, presorted=True) == "flat"
+    assert not G.sort_order_worthwhile(1 << 22, 4)
+
+
+def test_window_rows_density():
+    IB = G._IB
+    # dense (m == n): the 2x slack window
+    assert G.window_rows(1 << 23, 1 << 23) == 2 * IB
+    # 2:1 density doubles the window (2x slack x 2 rows/index)
+    assert G.window_rows(1 << 23, 1 << 22) == 4 * IB
+    # too sparse for any window: staging falls back to the plain
+    # ascending gather
+    assert G.window_rows(1 << 23, 1 << 18) is None
+    assert G.window_rows(0, 1 << 20) is None
+
+
+def test_sort_order_worthwhile_gate(monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_GATHER", "force")
+    M = G._STAGED_MIN_INDICES
+    assert G.sort_order_worthwhile(M, 3)
+    assert not G.sort_order_worthwhile(M - 1, 3)  # too small
+    assert not G.sort_order_worthwhile(M, 0)      # build not wider
+    assert not G.sort_order_worthwhile(M, -2)
+
+
+def test_batch_word_width():
+    from presto_tpu import types as T
+    from presto_tpu.batch import Batch, Column
+
+    n = 8
+    b = Batch({
+        "i": Column(jnp.zeros((n,), jnp.int32), None, T.INTEGER, None),
+        "l": Column(jnp.zeros((n,), jnp.int64),
+                    jnp.ones((n,), bool), T.BIGINT, None),
+        "d": Column(jnp.zeros((n,), jnp.float64), None, T.DOUBLE, None),
+    }, jnp.ones((n,), bool))
+    # i32=1, i64+valid=3, f64=2
+    assert K.batch_word_width(b) == 6
+
+
+def test_expanding_join_sort_order_materialization(tiny_gather):
+    """One-to-many join whose build side is WIDER than the probe, under
+    an order-insensitive consumer: the executor pre-permutes the
+    expansion into build-index order (sort_order_plan) and gathers the
+    wide side presorted.  The output row SET must equal the flat
+    path's; the row ORDER may differ — that is the point."""
+    from presto_tpu import types as T
+    from presto_tpu.batch import Batch, Column
+    from presto_tpu.exec.executor import Executor
+    from presto_tpu.plan import nodes as P
+
+    rng = np.random.default_rng(13)
+    nl, nr = 1500, 2000
+    lkeys = rng.integers(0, 500, nl).astype(np.int64)
+    rkeys = rng.integers(0, 500, nr).astype(np.int64)
+    left = Batch({"x": Column(jnp.asarray(lkeys), None, T.BIGINT, None)},
+                 jnp.ones((nl,), bool))
+    right = Batch({
+        "y": Column(jnp.asarray(rkeys), None, T.BIGINT, None),
+        "p": Column(jnp.asarray(rng.random(nr)), None, T.DOUBLE, None),
+        "q": Column(jnp.asarray(rng.integers(0, 9, nr)),
+                    jnp.asarray(rng.integers(0, 2, nr).astype(bool)),
+                    T.BIGINT, None),
+        "r": Column(jnp.asarray(rng.integers(0, 7, nr).astype(np.int32)),
+                    None, T.INTEGER, None),
+    }, jnp.ones((nr,), bool))
+    node = P.Join(P.Values(), P.Values(), "INNER", [("x", "y")])
+
+    def run(mark):
+        ex = Executor.__new__(Executor)
+        ex.static = False
+        ex.guards = []
+        ex.monitor = None
+        ex.mem = None
+        from presto_tpu.exec.executor import EvalContext
+
+        ex.ctx = EvalContext()
+        if mark:
+            ex._oi_ids = {id(node)}
+        out = ex._join_batches(left, right, node)
+        sel = np.asarray(out.sel)
+        rows = []
+        for i in np.flatnonzero(sel):
+            row = []
+            for name in ("x", "y", "p", "q", "r"):
+                c = out.columns[name]
+                v = None if (c.valid is not None
+                             and not bool(np.asarray(c.valid)[i])) \
+                    else np.asarray(c.data)[i].item()
+                row.append(v)
+            rows.append(tuple(row))
+        return sorted(rows, key=repr)
+
+    assert G.sort_order_worthwhile(1, K.batch_word_width(right)
+                                   - K.batch_word_width(left))
+    marked = run(mark=True)
+    flat = run(mark=False)
+    assert marked == flat and len(marked) > 0

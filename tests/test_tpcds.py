@@ -71,7 +71,18 @@ def test_date_dim_calendar():
     assert (np.diff(dd["d_date_sk"]) == 1).all()
 
 
-@pytest.mark.parametrize("qid", sorted(QUERIES))
+# compile-heavy queries (multi-CTE monsters, inventory rollups: >4s
+# each on the 1-core CI box, ~210s together) run in tier 2; tier 1
+# keeps the other ~80 queries plus q64's star-join class so the
+# differential corpus still gates every operator family within the
+# tier-1 wall-clock budget
+_SLOW_QIDS = {2, 4, 8, 14, 16, 21, 24, 31, 37, 39, 47, 48, 54, 57, 59,
+              75, 78, 82}
+
+
+@pytest.mark.parametrize("qid", [
+    pytest.param(q, marks=pytest.mark.slow) if q in _SLOW_QIDS else q
+    for q in sorted(QUERIES)])
 def test_tpcds_query_vs_sqlite(ds_session, ds_sqlite, qid):
     from tests.tpcds_queries import SQLITE_OVERRIDES
 

@@ -61,7 +61,13 @@ def sessions():
 DIST_QIDS = VERIFY_QIDS[::5]
 
 
-@pytest.mark.parametrize("qid", VERIFY_QIDS)
+# q14's distributed leg alone compiles ~10 minutes of 8-device mesh
+# program on the 1-core CI box (q67's ~30s); their dynamic/compiled
+# legs are covered by test_tpcds.py and q87 keeps the verifier's mesh
+# leg exercised in tier 1
+@pytest.mark.parametrize("qid", [
+    pytest.param(q, marks=pytest.mark.slow) if q in (14, 67) else q
+    for q in VERIFY_QIDS])
 def test_override_query_checksum_across_executors(sessions, qid):
     dyn, comp, dist = sessions
     sql = QUERIES[qid]

@@ -92,6 +92,72 @@ def main():
     t = per_iter(timed(sort_loop64, base64_))
     out["sort_i64_mrows_s"] = round(n / t / 1e6, 1)
 
+    # --- gather family: random vs blocked vs sort-order ---------------
+    # Pins the routing constants in exec/gather.py (the crossover where
+    # sorted staging beats the flat packed gather, and where the Pallas
+    # VMEM-window kernel beats the plain ascending gather).  Swept over
+    # index count x row width; each cell is ns/index so the table reads
+    # directly against the ~45ns/random-index constant from the round-5
+    # profile.
+    from presto_tpu.exec import gather as GG
+
+    nsrc = 1 << 23  # 8M source rows, the SF100 chunk shape
+    gout = {}
+    for width in (1, 2, 4, 8):
+        src = jnp.asarray(
+            rng.integers(0, 1 << 32, (nsrc, width)).astype(np.uint32))
+        for mexp in (20, 22, 23):
+            m = 1 << mexp
+            ridx = jnp.asarray(rng.integers(0, nsrc, m).astype(np.int32))
+            sidx = jnp.sort(ridx)
+
+            @jax.jit
+            def rand_loop(src, ridx):
+                def body(i, s):
+                    return src[(ridx + s) % nsrc][0, 0].astype(jnp.int32)
+                return lax.fori_loop(0, K, body, jnp.int32(0))
+
+            @jax.jit
+            def sorted_loop(src, sidx):
+                def body(i, s):
+                    return src[jnp.clip(sidx + s, 0, nsrc - 1)][0, 0] \
+                        .astype(jnp.int32)
+                return lax.fori_loop(0, K, body, jnp.int32(0))
+
+            @jax.jit
+            def blocked_loop(src, sidx):
+                def body(i, s):
+                    out = GG.staged_gather(
+                        src, jnp.clip(sidx + s, 0, nsrc - 1))
+                    return out[0, 0].astype(jnp.int32)
+                return lax.fori_loop(0, K, body, jnp.int32(0))
+
+            cell = {}
+            cell["random_ns_per_idx"] = round(
+                per_iter(timed(rand_loop, src, ridx)) / m * 1e9, 2)
+            cell["sorted_ns_per_idx"] = round(
+                per_iter(timed(sorted_loop, src, sidx)) / m * 1e9, 2)
+            cell["blocked_ns_per_idx"] = round(
+                per_iter(timed(blocked_loop, src, sidx)) / m * 1e9, 2)
+            gout[f"w{width}_m{m >> 20}M"] = cell
+    out["gather"] = gout
+
+    # sort-order materialization overhead: the planning sort + the
+    # co-sort home, i.e. what request-order staging adds over presorted
+    m = 1 << 23
+    ridx = jnp.asarray(rng.integers(0, nsrc, m).astype(np.int32))
+
+    @jax.jit
+    def plan_loop(ridx):
+        def body(i, s):
+            sidx, pos = lax.sort(
+                (ridx ^ s, jnp.arange(m, dtype=jnp.int32)), num_keys=1)
+            return sidx[0] + pos[0]
+        return lax.fori_loop(0, K, body, jnp.int32(0))
+
+    out["gather_plan_sort_ms"] = round(
+        per_iter(timed(plan_loop, ridx)) * 1000, 1)
+
     # --- build_probe at TPC-H Q3 shape: 6M probe, 1.5M build ----------
     npr, nb = 6_000_000, 1_500_000
     probe = jnp.asarray(rng.integers(0, nb, npr).astype(np.int32))
