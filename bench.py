@@ -104,6 +104,7 @@ def main():
     vs_numpy = numpy_speedup(cat, engine_times)
     vs_sqlite = sqlite_speedup(engine_times)
     gate = perf_gate(engine_times)
+    recovery_ms = recovery_bench()
 
     # ONE line on stdout, emitted IMMEDIATELY after the SF1 measurements
     # (round-2 lesson: the scale configs below can outlive the caller's
@@ -121,6 +122,7 @@ def main():
         "per_query_ms": {str(q): round(t * 1000, 1)
                          for q, t in engine_times.items()},
         "perf_gate": gate,
+        "recovery_ms": recovery_ms,
         "sf": SF,
         "scale_configs": {k: v for k, v in (load_scale_progress() or {}).items()
                           if k != "sf1_test_tier"} or None,
@@ -194,6 +196,49 @@ def perf_gate(engine_times):
                              f"{GATE_RTT_FLOOR_MS:.0f}ms RTT floor)")
     return ("FAIL: " + "; ".join(f"q{k} {v}" for k, v in bad.items())) \
         if bad else "pass"
+
+
+def recovery_bench():
+    """Robustness cost metric (docs/ROBUSTNESS.md): wall-clock ms from
+    an injected worker crash (fault-plan scripted, in-process cluster at
+    tiny SF) to query completion on the survivors — the bench trajectory
+    tracks recovery latency alongside raw query latency.  BENCH_RECOVERY=0
+    skips it; any failure reports None rather than failing the bench."""
+    if os.environ.get("BENCH_RECOVERY", "1") == "0":
+        return None
+    try:
+        import presto_tpu
+        from presto_tpu.catalog import tpch_catalog
+        from presto_tpu.parallel import cluster as C
+        from presto_tpu.parallel import faults as F
+
+        session = presto_tpu.connect(
+            tpch_catalog(0.01, cache_dir="/tmp/presto_tpu_cache"))
+        # hard per-query budget: this runs BEFORE the bench line is
+        # emitted, so it must fail fast rather than ever hang the bench
+        session.properties["cluster_query_deadline_s"] = 60.0
+        workers = [C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                                  faults=F.FaultPlan([])).start()
+                   for _ in range(2)]
+        cs = C.ClusterSession(session, [w.url for w in workers])
+        try:
+            q = "SELECT count(*) c, sum(o_totalprice) s FROM orders"
+            cs.sql(q)  # prewarm: compile + page-path caches
+            plan = F.FaultPlan.parse("exec:EXEC:*:1:crash")
+            workers[1].faults = plan
+            cs.sql(q)  # crash fires mid-wave; survivors finish the query
+            if not plan.fired:
+                return None
+            done = time.monotonic()
+            return round((done - plan.fired[0][0]) * 1000, 1)
+        finally:
+            for w in workers:
+                if not w.crashed:
+                    w.stop()
+    except Exception as e:
+        print(f"bench: recovery bench FAILED ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return None
 
 
 def load_scale_progress():

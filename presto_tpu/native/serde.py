@@ -101,6 +101,20 @@ def read_stream(f) -> Dict[str, np.ndarray]:
         out.update(deserialize_columns(frame))
 
 
+def frame_ok(buf: bytes) -> bool:
+    """Cheap integrity check for one PTPG frame (magic + xxh64) without
+    decompressing — the HTTP page pull verifies each page on receipt so
+    a truncated/corrupt transfer is re-requested by token instead of
+    poisoning the consumer (at-least-once delivery)."""
+    if len(buf) < 24 or buf[:4] != MAGIC:
+        return False
+    body, (csum,) = buf[:-8], struct.unpack("<Q", buf[-8:])
+    flags = body[5]
+    if flags & FLAG_CHECKSUM:
+        return native.xxh64(body) == csum
+    return True
+
+
 def deserialize_columns(buf: bytes) -> Dict[str, np.ndarray]:
     if len(buf) < 24 or buf[:4] != MAGIC:
         raise ValueError("not a PTPG frame")

@@ -29,6 +29,19 @@ class QueryCompletedEvent:
     error: Optional[str] = None
 
 
+@dataclasses.dataclass
+class RecoveryEvent:
+    """One failure-recovery action in cluster mode (retry, hedge,
+    quarantine, cancellation) — emitted by parallel/retry.RunContext as
+    it bumps the matching QueryStats.recovery counter.  `kind` matches
+    the counter key (docs/ROBUSTNESS.md lists the schema); `detail`
+    carries action-specific context (worker url, task id, delay)."""
+
+    query_id: str
+    kind: str
+    detail: Optional[dict] = None
+
+
 class EventListener:
     """Subclass and override; register via Session.add_event_listener."""
 
@@ -36,6 +49,9 @@ class EventListener:
         pass
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
+        pass
+
+    def recovery(self, event: RecoveryEvent) -> None:
         pass
 
 

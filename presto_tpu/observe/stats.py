@@ -50,6 +50,11 @@ class QueryStats:
     spilled_bytes: int = 0
     spilled_partitions: int = 0
     recovered_buckets: int = 0  # grouped-execution buckets loaded from ckpt
+    # cluster-mode recovery counters (parallel/retry.RunContext.count):
+    # http_retries, pages_retried, workers_quarantined, workers_readmitted,
+    # hedges_launched, hedges_won, task_cancels, query_retries,
+    # deadline_expired — see docs/ROBUSTNESS.md for the schema
+    recovery: Dict[str, int] = dataclasses.field(default_factory=dict)
     # id(plan node) -> NodeStats; populated in dynamic mode
     node_stats: Dict[int, NodeStats] = dataclasses.field(default_factory=dict)
     # rendered plan (annotated with per-node stats when collected) for

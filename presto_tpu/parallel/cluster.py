@@ -30,8 +30,14 @@ up front with pre-assigned upstream locations; leaf tasks publish a page
 per split chunk as produced, and consumers pull pages with sequence
 tokens + acks (at-least-once delivery with client dedup,
 server/TaskResource.java:244-307) — stages overlap, P7 pipelining.
-Worker failure mid-query drops the dead worker from the pool and
-re-executes the query on the survivors.
+Failure handling (docs/ROBUSTNESS.md): every RPC goes through one
+signed choke point (`_http`) with retry/backoff and a per-query
+Deadline from parallel/retry.py; worker health is a circuit breaker
+(consecutive-failure trip, probation re-admission) instead of one-shot
+probes; stragglers are hedged onto healthy survivors with first-
+FINISHED-wins dedup by sequence token; worker failure mid-query remaps
+the dead slots onto survivors and re-executes.  All of it is
+deterministically testable through parallel/faults.py.
 """
 
 from __future__ import annotations
@@ -48,12 +54,16 @@ import time
 import urllib.error
 import urllib.request
 import uuid
+from http.client import HTTPException
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
 
 import numpy as np
 
 from presto_tpu import session_ctx as _sctx
+from presto_tpu.parallel import faults as F
+from presto_tpu.parallel import retry as R
 from presto_tpu.plan import serde as plan_serde
 from presto_tpu.native import serde as pserde
 
@@ -389,86 +399,211 @@ class TaskSpec:
 plan_serde.register_class(TaskSpec)
 
 
-def _http(url: str, data: Optional[bytes] = None, method: str = "GET",
-          timeout: float = 60.0) -> bytes:
-    req = urllib.request.Request(url, data=data, method=method)
+def _signed_request(method: str, url: str,
+                    body: Optional[bytes] = None) -> urllib.request.Request:
+    """THE request builder: every outbound control/data-plane request is
+    constructed (and HMAC-signed over the full request target) here."""
+    req = urllib.request.Request(url, data=body, method=method)
     secret = cluster_secret()
     if secret is not None:
-        from urllib.parse import urlsplit
-
         parts = urlsplit(url)  # sign the full request target (path?query)
         path = parts.path + ("?" + parts.query if parts.query else "")
-        req.add_header(AUTH_HEADER, _sign(secret, method, path, data or b""))
+        req.add_header(AUTH_HEADER, _sign(secret, method, path, body or b""))
+    return req
+
+
+def _http(url: str, data: Optional[bytes] = None, method: str = "GET",
+          timeout: Optional[float] = None,
+          ctx: Optional[R.RunContext] = None) -> bytes:
+    """One signed request (single attempt).  The per-call timeout is
+    capped by the query Deadline on the ambient RunContext, so every RPC
+    a query makes derives from one query-level budget."""
+    ctx = ctx if ctx is not None else R.current()
+    timeout = ctx.deadline.cap(
+        R.RPC_TIMEOUT_S if timeout is None else timeout)
+    rule = F.apply_client(method, urlsplit(url).path)  # may raise/delay
+    req = _signed_request(method, url, data)
     with urllib.request.urlopen(req, timeout=timeout) as r:
-        return r.read()
+        body = r.read()
+    if rule is not None and rule.action == "partial":
+        body = F.corrupt_page(body)
+    return body
+
+
+def _transient(e: BaseException) -> bool:
+    """Retryable at the RPC layer: connection trouble and 5xx — never
+    4xx (auth / bad payload are deterministic)."""
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code in (500, 502, 503)
+    return isinstance(e, (urllib.error.URLError, ConnectionError,
+                          TimeoutError, HTTPException, OSError))
+
+
+def _http_retry(url: str, data: Optional[bytes] = None,
+                method: str = "GET", timeout: Optional[float] = None,
+                ctx: Optional[R.RunContext] = None) -> bytes:
+    """Idempotent RPC with policy-driven backoff (task submit / status /
+    range / delete — the worker endpoints are all safely re-playable:
+    submit overwrites, delete is idempotent, reads are pure)."""
+    ctx = ctx if ctx is not None else R.current()
+
+    def on_retry(attempt, e, delay):
+        ctx.count("http_retries", url=url, error=type(e).__name__)
+
+    return ctx.policy.call(
+        lambda: _http(url, data, method, timeout, ctx),
+        retryable=_transient, deadline=ctx.deadline, on_retry=on_retry)
 
 
 class UpstreamFailed(Exception):
     """Producer task failed or its worker became unreachable."""
 
 
+def _task_state(url: str, task_id: str,
+                ctx: Optional[R.RunContext] = None) -> Optional[str]:
+    """Best-effort status peek (used to tell a transient 500 from a
+    genuinely FAILED task); None when the worker can't answer."""
+    try:
+        st = json.loads(_http(f"{url}/v1/task/{task_id}/status",
+                              timeout=R.PROBE_TIMEOUT_S, ctx=ctx))
+        return st.get("state")
+    except R.DeadlineExceeded:
+        raise
+    except Exception:  # noqa: BLE001 — probe failures are expected here
+        return None
+
+
+def _probe(url: str, ctx: Optional[R.RunContext] = None) -> None:
+    _http(f"{url}/v1/info", timeout=R.PROBE_TIMEOUT_S, ctx=ctx)
+
+
+def _get_page(url: str, task_id: str, bucket: int, token: int,
+              ctx: R.RunContext) -> Tuple[int, bytes, bool]:
+    """One results GET -> (status, body, X-Complete).  Goes around _http
+    because the caller needs the status/header, but hits the same fault
+    choke point and signs the same way."""
+    path = f"/v1/task/{task_id}/results/{bucket}/{token}"
+    F.apply_client("GET", path)
+    req = _signed_request("GET", url + path)
+    with urllib.request.urlopen(
+            req, timeout=ctx.deadline.cap(R.PAGE_TIMEOUT_S)) as r:
+        status = r.status
+        body = r.read()
+        complete = r.headers.get("X-Complete") == "1"
+    if status == 200 and body:
+        # the PAGE pseudo-method counts DELIVERED pages only, so a
+        # partial-transfer rule's nth is deterministic (503 polls and
+        # empty bodies don't consume it)
+        prule = F.client_plan().match("client", "PAGE", path)
+        if prule is not None and prule.action == "partial":
+            body = F.corrupt_page(body)
+    return status, body, complete
+
+
 def pull_pages(url: str, task_id: str, bucket: int,
-               timeout: float = 600.0, ack: bool = True,
-               max_pages: Optional[int] = None) -> List[bytes]:
+               timeout: Optional[float] = None, ack: bool = True,
+               max_pages: Optional[int] = None,
+               ctx: Optional[R.RunContext] = None,
+               slot: Optional[list] = None) -> List[bytes]:
     """Streaming page pull with sequence tokens + acks (reference:
     HttpPageBufferClient GET /v1/task/{id}/results/{buffer}/{token} +
     .../acknowledge, server/TaskResource.java:244-307).  Pages are
     published as the producer finishes each split chunk, so consumers
     overlap with production (P7 pipelining); the token makes delivery
-    at-least-once with client dedup, and the ack releases server memory."""
-    deadline = time.time() + timeout
+    at-least-once with client dedup, and the ack releases server memory.
+
+    Robustness: each page is checksum-verified on receipt (a corrupt /
+    truncated body is re-requested by token); transient 500s and
+    connection trouble are absorbed by seeded backoff under the retry
+    policy's attempt budget; worker death is decided by the circuit
+    breaker, not a one-shot probe.  When `slot` (a mutable [url,
+    task_id] pair) is given, the target is re-read each iteration, so a
+    straggler hedge can transparently fail the pull over to the winning
+    replica — attempts execute deterministically, so page K is
+    identical across replicas and the token sequence stays valid."""
+    ctx = ctx if ctx is not None else R.current()
+    local = R.Deadline(R.PULL_TIMEOUT_S if timeout is None else timeout)
+    backoff = ctx.policy.backoff()
     pages: List[bytes] = []
     token = 0
+    errors_500 = 0
     while True:
+        if slot is not None:
+            url, task_id = slot[0], slot[1]
         try:
-            req = urllib.request.Request(
-                f"{url}/v1/task/{task_id}/results/{bucket}/{token}")
-            secret = cluster_secret()
-            if secret is not None:
-                from urllib.parse import urlsplit
-
-                path = urlsplit(req.full_url).path
-                req.add_header(AUTH_HEADER,
-                               _sign(secret, "GET", path, b""))
-            with urllib.request.urlopen(req, timeout=30.0) as r:
-                status = r.status
-                body = r.read()
-                complete = r.headers.get("X-Complete") == "1"
+            status, body, complete = _get_page(url, task_id, bucket,
+                                               token, ctx)
+            if status == 204:  # producer complete, no more pages
+                return pages
             if status == 200:
+                # integrity check for PTPG-framed pages (range-sample
+                # pages are tagged JSON and pass through): a corrupt /
+                # truncated transfer is re-requested by token
+                if body[:4] == pserde.MAGIC and not pserde.frame_ok(body):
+                    ctx.count("pages_retried", url=url, token=token)
+                    backoff.sleep(local)
+                    continue
                 pages.append(body)
                 token += 1
+                errors_500 = 0
+                backoff.reset()
                 if max_pages is not None and len(pages) >= max_pages:
                     return pages
                 if ack:  # only exclusive readers may release pages
                     try:  # frees producer-side memory; best effort
                         _http(f"{url}/v1/task/{task_id}/results/{bucket}/"
-                              f"{token}/ack", timeout=5.0)
+                              f"{token}/ack", timeout=R.ACK_TIMEOUT_S,
+                              ctx=ctx)
+                    except R.DeadlineExceeded:
+                        raise
                     except Exception:
                         pass
                 if complete:
                     return pages
                 continue
-            if status == 204:  # producer complete, no more pages
-                return pages
+        except R.DeadlineExceeded:
+            raise
         except urllib.error.HTTPError as e:
             if e.code == 503:  # not produced yet — poll
                 pass
+            elif e.code == 404 and slot is not None:
+                # slot read raced a hedge swap (url/tid repointed
+                # between the two reads) — re-read and poll again
+                pass
             elif e.code == 500:
-                raise UpstreamFailed(
-                    f"task {task_id} on {url} failed: "
-                    f"{e.read()[:300]!r}")
+                detail = e.read()[:300]
+                if b"page already released" in detail:
+                    # at-least-once bookkeeping says a task retry is the
+                    # only fix — no point retrying the request
+                    raise UpstreamFailed(
+                        f"task {task_id} on {url} failed: {detail!r}")
+                # transient (flaky server / injected fault) vs genuine
+                # task failure: the status endpoint knows
+                if _task_state(url, task_id, ctx) == "FAILED":
+                    raise UpstreamFailed(
+                        f"task {task_id} on {url} failed: {detail!r}")
+                errors_500 += 1
+                if errors_500 >= ctx.policy.max_attempts:
+                    raise UpstreamFailed(
+                        f"task {task_id} on {url}: {errors_500} "
+                        f"consecutive 500s: {detail!r}")
+                ctx.count("http_retries", url=url, code=500)
             else:
                 raise
-        except (urllib.error.URLError, ConnectionError, OSError) as e:
+        except (urllib.error.URLError, ConnectionError, HTTPException,
+                OSError) as e:
             # transient connection trouble is absorbed by the poll loop;
-            # a failed health probe means the worker is really gone
-            try:
-                _http(f"{url}/v1/info", timeout=3.0)
-            except Exception:
+            # the circuit breaker decides when the worker is really gone
+            # (consecutive probe failures trip it — no one-shot verdicts)
+            if not ctx.health.probe(url, lambda u: _probe(u, ctx)) \
+                    and ctx.health.state(url) != "closed":
+                ctx.count("workers_quarantined", url=url)
                 raise UpstreamFailed(f"worker {url} unreachable: {e}")
-        if time.time() > deadline:
+            ctx.count("http_retries", url=url, error=type(e).__name__)
+        ctx.deadline.check(f"pages from {task_id}@{url}")
+        if local.expired():
             raise TimeoutError(f"pages from {task_id}@{url} timed out")
-        time.sleep(0.05)
+        backoff.sleep(local)
 
 
 class _ClusterExecutor:
@@ -511,8 +646,13 @@ class _ClusterExecutor:
             # broadcast buckets have MANY readers: acking would release
             # pages other consumers still need
             exclusive = inp["kind"] != "broadcast"
-            for (url, tid) in ups:
-                for buf in pull_pages(url, tid, bucket, ack=exclusive):
+            for up in ups:
+                # coordinator-side upstreams are mutable [url, tid]
+                # slots shared with the hedge monitor, so the pull
+                # follows a hedge winner mid-stream; worker-side specs
+                # carry deserialized copies that never mutate
+                for buf in pull_pages(up[0], up[1], bucket, ack=exclusive,
+                                      slot=up):
                     if buf:
                         parts.append(unpack_columns(buf))
             merged: Dict[str, tuple] = {}
@@ -671,7 +811,7 @@ class _ClusterExecutor:
         sample_vals = data[live][:: max(1, int(np.sum(live)) // 256)][:256]
         self.publish(nb, plan_serde.dumps(sample_vals.tolist()))
         if not self.task_state.get("range_event", threading.Event()) \
-                .wait(timeout=300.0):
+                .wait(timeout=R.RANGE_TIMEOUT_S):
             raise TimeoutError("range boundaries never arrived")
         boundaries = self.task_state["range_boundaries"]
         if len(boundaries):
@@ -742,9 +882,14 @@ class WorkerServer:
     result buffers (reference: SqlTaskManager + TaskResource)."""
 
     def __init__(self, catalog_spec: str, host: str = "127.0.0.1",
-                 port: int = 0, secret: Optional[bytes] = None):
+                 port: int = 0, secret: Optional[bytes] = None,
+                 faults: Optional["F.FaultPlan"] = None):
         import presto_tpu
 
+        # scripted failures for THIS worker (tests pass a plan per
+        # server; subprocess workers inherit PRESTO_TPU_FAULTS)
+        self.faults = faults if faults is not None else F.FaultPlan.from_env()
+        self.crashed = False
         self.secret = secret if secret is not None else cluster_secret()
         if self.secret is None and not _is_loopback(host):
             raise ValueError(
@@ -781,6 +926,16 @@ class WorkerServer:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+
+    def simulate_crash(self):
+        """The `crash` fault action: a subprocess worker dies for real;
+        an in-process worker (chaos tests) stops serving, so every later
+        request observes connection-refused — the same failure the
+        coordinator sees when an OS process is killed."""
+        self.crashed = True
+        if os.environ.get("PRESTO_TPU_WORKER_PROC") == "1":
+            os._exit(1)
+        threading.Thread(target=self.stop, daemon=True).start()
 
     def submit(self, spec: TaskSpec):
         with self.lock:
@@ -861,6 +1016,9 @@ class WorkerServer:
                         task["complete"] = True
                     return
             try:
+                # scripted exec faults: delay (straggler), fail (task
+                # FAILED), crash (worker dies mid-wave)
+                F.apply_exec(self.faults, spec.task_id, self)
                 # tasks run CONCURRENTLY (producers stream to consumers
                 # on the same worker), so each task executes against a
                 # shallow session clone with its own properties dict —
@@ -879,8 +1037,14 @@ class WorkerServer:
                 session_ctx.activate_raw(
                     str(task_session.properties.get("time_zone", "UTC")),
                     spec.properties.get("query_start_us"))
-                _ClusterExecutor(task_session, spec, publish=publish,
-                                 task_state=task).run()
+                # the worker inherits the coordinator's remaining query
+                # budget: every upstream pull this task makes derives
+                # its timeout from the same query-level deadline
+                wctx = R.RunContext(
+                    deadline=R.Deadline(spec.properties.get("deadline_s")))
+                with R.activate(wctx):
+                    _ClusterExecutor(task_session, spec, publish=publish,
+                                     task_state=task).run()
                 if attempt_dir is not None:
                     os.makedirs(attempt_dir, exist_ok=True)
                     with open(os.path.join(attempt_dir, "_DONE"),
@@ -924,9 +1088,21 @@ def _make_worker_handler(server: WorkerServer):
             return _verify_auth(server.secret, got, self.command,
                                 self.path, body)
 
+        def _fault_gate(self) -> bool:
+            """Scripted server-side faults (parallel/faults.py); True
+            when the fault consumed the request."""
+            if server.crashed:  # a "crashed" worker answers nothing
+                F._abort_connection(self)
+                return True
+            rule = server.faults.match("server", self.command, self.path)
+            return rule is not None \
+                and not F.apply_server(rule, self, server)
+
         def do_POST(self):
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n)
+            if self._fault_gate():
+                return
             if not self._authorized(body):
                 self._send(401, b"{}", "application/json")
                 return
@@ -963,6 +1139,8 @@ def _make_worker_handler(server: WorkerServer):
                 self._send(404, b"{}")
 
         def do_GET(self):
+            if self._fault_gate():
+                return
             if not self._authorized():
                 self._send(401, b"{}", "application/json")
                 return
@@ -1035,6 +1213,8 @@ def _make_worker_handler(server: WorkerServer):
                     elif kind == "released":
                         self._send(500, b"page already released")
                     elif kind == "page":
+                        if getattr(self, "_fault_partial", False):
+                            page = F.corrupt_page(page)
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "application/octet-stream")
@@ -1050,6 +1230,8 @@ def _make_worker_handler(server: WorkerServer):
             self._send(404, b"{}")
 
         def do_DELETE(self):
+            if self._fault_gate():
+                return
             if not self._authorized():
                 self._send(401, b"{}", "application/json")
                 return
@@ -1073,6 +1255,115 @@ def _make_worker_handler(server: WorkerServer):
 # ---------------------------------------------------------------------------
 
 
+class _HedgeMonitor(threading.Thread):
+    """Straggler mitigation: watches the coordinator-consumed wave's
+    tasks; once a quantile of the wave has FINISHED, any task still
+    running past max(q*factor, q+min_s) is speculatively re-submitted to
+    a healthy survivor.  First FINISHED attempt wins — the mutable
+    placement slot is repointed in place, and because fragment execution
+    is deterministic, both attempts publish the identical page sequence,
+    so the consumer's token counter carries straight over (the dedup the
+    at-least-once protocol already provides).  Best-effort: any monitor
+    error leaves the query exactly as unhedged execution."""
+
+    def __init__(self, cs: "ClusterSession", watch, all_tasks, ctx):
+        super().__init__(daemon=True, name="hedge-monitor")
+        self.cs = cs
+        self.all_tasks = all_tasks
+        self.ctx = ctx
+        props = cs.session.properties
+        self.quantile = float(props.get("cluster_hedge_quantile", 0.5))
+        self.factor = float(props.get("cluster_hedge_factor", 3.0))
+        self.min_s = float(props.get("cluster_hedge_min_s", 0.25))
+        self.t0 = time.monotonic()
+        self.waves: Dict[int, list] = {}
+        for slot, fid in watch:
+            self.waves.setdefault(fid, []).append(
+                {"slot": slot, "done": None, "hedge": None})
+        self._halt = threading.Event()
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=R.ACK_TIMEOUT_S)
+
+    def _state(self, url: str, tid: str) -> Optional[str]:
+        return _task_state(url, tid, self.ctx)
+
+    def run(self):
+        backoff = self.ctx.policy.backoff()
+        try:
+            while not self._halt.is_set():
+                pending = sum(self._scan(entries)
+                              for entries in self.waves.values())
+                if pending == 0 or self.ctx.deadline.expired():
+                    return
+                backoff.sleep(self.ctx.deadline)
+        except Exception:  # noqa: BLE001 — hedging is strictly best-effort
+            pass
+
+    def _scan(self, entries) -> int:
+        now = time.monotonic()
+        pending = 0
+        for e in entries:
+            if e["done"] is not None:
+                continue
+            url, tid = e["slot"][0], e["slot"][1]
+            if self._state(url, tid) == "FINISHED":
+                e["done"] = now
+                if e["hedge"] is not None:  # original won: reap the hedge
+                    self.all_tasks.append(tuple(e["hedge"]))
+                continue
+            if e["hedge"] is not None \
+                    and self._state(*e["hedge"]) == "FINISHED":
+                # hedge won: keep the loser reachable for cleanup, then
+                # repoint the slot atomically (single slice-assign) so
+                # in-flight pulls fail over mid-stream
+                self.all_tasks.append((url, tid))
+                e["slot"][:] = e["hedge"]
+                e["done"] = now
+                self.ctx.count("hedges_won", task=tid,
+                               winner=e["hedge"][1])
+                continue
+            pending += 1
+        if pending == 0:
+            return 0
+        n = len(entries)
+        done_times = sorted(e["done"] - self.t0 for e in entries
+                            if e["done"] is not None)
+        need = max(int(np.ceil(self.quantile * n)), 1)
+        if len(done_times) < need:
+            return pending
+        q = done_times[need - 1]
+        threshold = max(q * self.factor, q + self.min_s)
+        for e in entries:
+            if e["done"] is None and e["hedge"] is None \
+                    and now - self.t0 > threshold:
+                self._launch(e)
+        return pending
+
+    def _launch(self, e) -> None:
+        url0, tid0 = e["slot"][0], e["slot"][1]
+        spec, fid = self.cs._task_specs.get(tid0, (None, None))
+        if spec is None:
+            return
+        targets = [u for u in self.cs.workers
+                   if u != url0 and self.cs.health.allow(u)]
+        if not targets:
+            return
+        # deterministic survivor pick: stable under a fixed layout
+        target = targets[(fid + spec.windex) % len(targets)]
+        hspec = dataclasses.replace(spec, task_id=tid0 + "_h",
+                                    replay=False)
+        try:
+            _http_retry(f"{target}/v1/task", plan_serde.dumps(hspec),
+                        method="POST", ctx=self.ctx)
+        except Exception:  # noqa: BLE001 — failed hedge changes nothing
+            return
+        e["hedge"] = [target, hspec.task_id]
+        self.all_tasks.append((target, hspec.task_id))
+        self.ctx.count("hedges_launched", task=tid0, target=target)
+
+
 class ClusterSession:
     """Coordinator: plans on the local session, schedules fragments over
     the worker set, returns results like Session.sql."""
@@ -1080,14 +1371,63 @@ class ClusterSession:
     def __init__(self, session, worker_urls: List[str]):
         self.session = session
         self.workers = list(worker_urls)
+        # circuit breaker shared across this session's queries: trips on
+        # consecutive failures, re-admits through probation (reference:
+        # failureDetector/HeartbeatFailureDetector)
+        self.health = R.HealthBoard(
+            trip_after=int(self.session.properties.get(
+                "cluster_health_trip_after", 3)),
+            probation_s=float(self.session.properties.get(
+                "cluster_health_probation_s", 5.0)))
+        self._benched: List[str] = []  # quarantined, awaiting probation
+
+    def _query_ctx(self, query_id: str = "") -> R.RunContext:
+        """Per-query RunContext: ONE deadline budget every RPC timeout
+        derives from (`cluster_query_deadline_s` session property, else
+        PRESTO_TPU_QUERY_DEADLINE), the seeded retry policy, and this
+        session's health board."""
+        dl = self.session.properties.get("cluster_query_deadline_s")
+        deadline = R.Deadline(float(dl)) if dl is not None else \
+            R.Deadline(R.query_deadline_from_env())
+        return R.RunContext(
+            deadline=deadline, policy=R.RetryPolicy.from_env(),
+            health=self.health,
+            listeners=self.session.event_listeners, query_id=query_id)
+
+    def _refresh_pool(self, ctx: R.RunContext) -> None:
+        """Probation re-admission: a quarantined worker whose circuit
+        allows a probe (probation elapsed) and answers it rejoins the
+        pool — flapping workers come back instead of staying dropped."""
+        for url in list(self._benched):
+            if self.health.probe(url, lambda u: _probe(u, ctx)):
+                self._benched.remove(url)
+                self.workers.append(url)
+                ctx.count("workers_readmitted", url=url)
 
     def sql(self, text: str):
+        from presto_tpu.observe.stats import QueryMonitor
+
+        mon = QueryMonitor.begin(self.session, text)
+        mon.stats.execution_mode = "distributed"
+        ctx = self._query_ctx(mon.stats.query_id)
+        mon.stats.recovery = ctx.recovery  # live view, not a copy
+        with R.activate(ctx):
+            try:
+                result = self._sql_attempts(text, ctx)
+            except BaseException as e:
+                mon.fail(e)
+                raise
+        mon.finish(result.rows)
+        return result
+
+    def _sql_attempts(self, text: str, ctx: R.RunContext):
         import shutil
 
         from presto_tpu.exec.executor import plan_statement
         from presto_tpu.plan.distribute import Undistributable
         from presto_tpu.sql.parser import parse
 
+        self._refresh_pool(ctx)
         stmt = parse(text)
         plan = plan_statement(self.session, stmt)
         attempts = 1 + int(self.session.properties.get(
@@ -1116,18 +1456,25 @@ class ClusterSession:
                     # plan shape the cluster can't place — single-node
                     # fallback
                     return self.session.sql(text)
+                except R.DeadlineExceeded:
+                    # the deadline is a query-level budget: never retry
+                    # past it (_schedule already cancelled all tasks)
+                    ctx.count("deadline_expired")
+                    raise
                 except (UpstreamFailed, RuntimeError, TimeoutError,
                         ConnectionError, OSError):
                     # worker failure mid-query: remap the dead slots and
                     # re-run; completed tasks replay from the durable
-                    # store when enabled
+                    # store when enabled.  Survivorship is the circuit
+                    # breaker's call, not a one-shot probe's.
                     survivors = []
                     for url in self.workers:
-                        try:
-                            _http(f"{url}/v1/info", timeout=3.0)
+                        if self.health.probe(url,
+                                             lambda u: _probe(u, ctx)):
                             survivors.append(url)
-                        except Exception:
-                            pass
+                        elif url not in self._benched:
+                            self._benched.append(url)
+                            ctx.count("workers_quarantined", url=url)
                     if not survivors or attempt == attempts - 1 \
                             or set(survivors) >= set(layout):
                         # same pool => deterministic failure; re-running
@@ -1137,6 +1484,7 @@ class ClusterSession:
                               else survivors[i % len(survivors)]
                               for i, u in enumerate(layout)]
                     self.workers = survivors
+                    ctx.count("query_retries", survivors=len(survivors))
             raise RuntimeError("unreachable")
         finally:
             if ddir is not None:
@@ -1237,20 +1585,35 @@ class ClusterSession:
         consumer_of = {inp.producer: frag.fid
                        for frag in fragments for inp in frag.inputs}
 
-        placements: Dict[int, List[Tuple[str, str]]] = {}
+        placements: Dict[int, List[list]] = {}
         all_tasks: List[Tuple[str, str]] = []
         coordinator_result = None
+        ctx = R.current()
         try:
             coordinator_result = self._run_fragments(
                 fragments, scalar_results, run_on_of, consumer_of,
                 placements, all_tasks, ddir=ddir, attempt=attempt)
         finally:
-            # free worker-side shuffle buffers (reference: DELETE
-            # /v1/task/{id} when the downstream is done with the data)
+            hedge = getattr(self, "_hedge", None)
+            if hedge is not None:
+                hedge.stop()
+                self._hedge = None
+            # free worker-side shuffle buffers; on abort / deadline
+            # expiry this is also the cancellation path — every live
+            # task observes DELETE so workers never run orphaned work
+            # (reference: DELETE /v1/task/{id}, SqlTaskManager cancel)
+            aborted = coordinator_result is None
+            # cancellation must outlive the query deadline: DELETEs run
+            # under a fresh never-expiring context so an aborted query
+            # still reaps every worker task within ACK_TIMEOUT_S each
+            reap_ctx = R.RunContext(deadline=R.Deadline.never(),
+                                    policy=ctx.policy, health=ctx.health)
             for url, tid in all_tasks:
                 try:
                     _http(f"{url}/v1/task/{tid}", method="DELETE",
-                          timeout=5.0)
+                          timeout=R.ACK_TIMEOUT_S, ctx=reap_ctx)
+                    if aborted:
+                        ctx.count("task_cancels", url=url, task=tid)
                 except Exception:
                     pass
         return coordinator_result
@@ -1267,14 +1630,19 @@ class ClusterSession:
         PROBE-side producers start, bounding worker memory — probe
         pages never pile up behind an unfinished build."""
         nfr = len(fragments)
+        ctx = R.current()
         # pre-assign every placement so consumers know their upstreams
         # at submission time (streaming needs no producer-finished
-        # barrier; the page protocol carries readiness)
+        # barrier; the page protocol carries readiness).  Slots are
+        # MUTABLE [url, task_id] pairs shared with the hedge monitor:
+        # when a hedge wins, the slot is repointed in place and every
+        # coordinator-side pull follows it (pull_pages slot= contract).
         for frag in fragments:
             run_on = run_on_of[frag.fid]
             placements[frag.fid] = [
-                (url, f"t_{uuid.uuid4().hex[:12]}") for url in run_on]
+                [url, f"t_{uuid.uuid4().hex[:12]}"] for url in run_on]
         coordinator_spec = None
+        self._task_specs: Dict[str, tuple] = {}  # tid -> (spec, fid)
         phased = bool(self.session.properties.get(
             "phased_execution", False))
         phases = _fragment_phases(fragments) if phased else \
@@ -1311,7 +1679,9 @@ class ClusterSession:
                 else:
                     out_buckets = 1
                 payload_root = plan_serde.dumps(frag.root)
-                tasks: List[Tuple[str, str]] = []
+                tasks: List[list] = []
+                rem = ctx.deadline.remaining()
+                deadline_s = None if rem == float("inf") else max(rem, 0.0)
                 for w, (url, tid) in enumerate(placements[frag.fid]):
                     dkey = f"f{frag.fid}_w{w}" if ddir is not None else None
                     # a completed durable output from a prior attempt means
@@ -1339,16 +1709,19 @@ class ClusterSession:
                                 "time_zone", "UTC"),
                             # now()/current_date must be query-stable across
                             # the mesh (session_ctx contract)
-                            "query_start_us": _sctx.query_start_us()},
+                            "query_start_us": _sctx.query_start_us(),
+                            # workers inherit the remaining query budget
+                            "deadline_s": deadline_s},
                         durable_dir=ddir, durable_key=dkey,
                         attempt=attempt, replay=replay,
                     )
                     if url is None:  # final fragment: run on the coordinator
                         coordinator_spec = spec
                     else:
-                        _http(f"{url}/v1/task", plan_serde.dumps(spec),
-                              method="POST")
-                        tasks.append((url, tid))
+                        _http_retry(f"{url}/v1/task", plan_serde.dumps(spec),
+                                    method="POST")
+                        self._task_specs[tid] = (spec, frag.fid)
+                        tasks.append(placements[frag.fid][w])
                 self.schedule_trace.append(
                     (frag.fid, phases[frag.fid], time.time()))
                 if tasks:
@@ -1356,6 +1729,27 @@ class ClusterSession:
                     prev_wave_tasks.extend(tasks)
                 if frag.out_kind == "range" and tasks:
                     self._coordinate_range(frag, tasks, out_buckets)
+        # straggler hedging (reference: task-level speculative execution;
+        # SURVEY.md hard-part: stragglers): watch the fragments whose
+        # pages the COORDINATOR pulls (their upstream slots live in this
+        # process, so a winner swap is visible mid-pull; worker-side
+        # consumers hold serialized placements a swap can't reach) and
+        # speculatively re-run late tasks on a healthy survivor — first
+        # FINISHED wins, dedup by the page token sequence, which is
+        # identical across attempts because execution is deterministic
+        if bool(self.session.properties.get("cluster_hedging", True)) \
+                and len(self.workers) > 1:
+            hedged_fids = [
+                f.fid for f in fragments
+                if f.fid != nfr - 1 and f.out_kind != "range"
+                and consumer_of.get(f.fid) == nfr - 1
+                and len(placements[f.fid]) > 1]
+            watch = [(slot, placements_fid)
+                     for placements_fid in hedged_fids
+                     for slot in placements[placements_fid]]
+            if watch:
+                self._hedge = _HedgeMonitor(self, watch, all_tasks, ctx)
+                self._hedge.start()
         # the final fragment executes here, pulling pages (and thereby
         # blocking) until upstream production drains
         pages: Dict[int, List[bytes]] = {}
@@ -1405,41 +1799,51 @@ class ClusterSession:
             boundaries = np.asarray([])
         payload = plan_serde.dumps(boundaries.tolist())
         for url, tid in tasks:
-            _http(f"{url}/v1/task/{tid}/range", payload, method="POST")
+            _http_retry(f"{url}/v1/task/{tid}/range", payload,
+                        method="POST")
 
-    def _wait(self, tasks: List[Tuple[str, str]], timeout: float = 600.0):
+    def _wait(self, tasks, timeout: Optional[float] = None,
+              ctx: Optional[R.RunContext] = None):
         """Status-poll specific tasks to completion.  THE load-bearing
         phase barrier for phased_execution (_run_fragments waits here
-        between waves); also used for range coordination and tests."""
-        deadline = time.time() + timeout
-        for url, tid in tasks:
+        between waves); also used for range coordination and tests.
+        `tasks` holds (url, tid) pairs or mutable slots — the target is
+        re-read each poll, so a hedge winner satisfies the barrier."""
+        ctx = ctx if ctx is not None else R.current()
+        local = R.Deadline(R.WAIT_TIMEOUT_S if timeout is None else timeout)
+        for slot in tasks:
+            backoff = ctx.policy.backoff()
             while True:
-                st = json.loads(_http(f"{url}/v1/task/{tid}/status"))
+                url, tid = slot[0], slot[1]
+                st = json.loads(_http_retry(
+                    f"{url}/v1/task/{tid}/status", ctx=ctx))
                 if st["state"] == "FINISHED":
                     break
                 if st["state"] == "FAILED":
                     raise RuntimeError(
                         f"task {tid} on {url} failed: {st['error']}")
-                if time.time() > deadline:
+                ctx.deadline.check(f"task {tid} on {url}")
+                if local.expired():
                     raise TimeoutError(f"task {tid} on {url} timed out")
-                time.sleep(0.05)
+                backoff.sleep(local)
 
     def close(self):
-        for url in self.workers:
+        for url in self.workers + self._benched:
             try:
                 _http(f"{url}/v1/shutdown", b"{}", method="POST",
-                      timeout=5.0)
+                      timeout=R.ACK_TIMEOUT_S)
             except Exception:
                 pass
         for p in getattr(self, "_procs", []):
             try:
-                p.wait(timeout=10.0)
+                p.wait(timeout=R.SHUTDOWN_TIMEOUT_S)
             except Exception:
                 p.kill()
 
 
 def launch_local_cluster(session, catalog_spec: str, nworkers: int = 2,
-                         timeout: float = 120.0) -> "ClusterSession":
+                         timeout: Optional[float] = None
+                         ) -> "ClusterSession":
     """Spawn worker OS processes on this host and return a ClusterSession
     driving them (the in-process DistributedQueryRunner analog, but with
     REAL process isolation — each worker is its own interpreter + XLA
@@ -1447,10 +1851,12 @@ def launch_local_cluster(session, catalog_spec: str, nworkers: int = 2,
     import subprocess
     import sys
 
+    timeout = R.STARTUP_TIMEOUT_S if timeout is None else timeout
     if cluster_secret() is None:
         set_cluster_secret(_pysecrets.token_hex(32))
     env = dict(os.environ)
     env[_SECRET_ENV] = cluster_secret().decode()
+    env["PRESTO_TPU_WORKER_PROC"] = "1"  # crash faults really _exit
     procs = []
     urls = []
     for _ in range(nworkers):
@@ -1508,6 +1914,7 @@ def main(argv=None):
                     help="jax platform for this worker (default cpu: "
                          "worker processes must not contend for the TPU)")
     args = ap.parse_args(argv)
+    os.environ["PRESTO_TPU_WORKER_PROC"] = "1"  # crash faults really exit
     if args.platform != "default":
         import jax
 

@@ -1,0 +1,260 @@
+"""Deterministic, seeded fault-injection harness for the cluster layer.
+
+Every recovery path in the engine — backoff on a transient 500, circuit
+breaking a dead worker, straggler hedging, deadline cancellation — used
+to be testable only by killing worker subprocesses and hoping the timing
+worked out.  This module scripts failures instead: a `FaultPlan` is a
+list of `FaultRule`s installed at the engine's three choke points, and
+fires at the Nth matching request, so the same plan reproduces the same
+failure sequence every run ("Design Trade-offs for a Robust Dynamic
+Hybrid Hash Join", PAPERS.md: robustness mechanisms must be first-class
+and MEASURABLE).
+
+Choke points:
+
+- `client` — `cluster._http` / `cluster.pull_pages` (every coordinator
+  and worker-side outbound request): the fault fires before/after the
+  real request (`delay`, `http500`, `reset`, `drop`, `partial`).
+- `server` — the worker HTTP handler, before routing (`delay`,
+  `http500`, `reset`, `drop`, `crash`; `partial` corrupts the page body
+  of a results response).
+- `exec` — `WorkerServer.submit`'s task thread, before the fragment
+  runs (`delay` = straggler, `fail` = task FAILED, `crash` = the worker
+  dies mid-wave).
+
+Grammar (env `PRESTO_TPU_FAULTS`, inherited by worker subprocesses, or
+programmatic via `FaultPlan(...)` / `install(...)`):
+
+    rule[;rule...]          rule = where:method:path:nth:action[:arg]
+
+    where  = client | server | exec
+    method = GET | POST | DELETE | EXEC | PAGE | * (any); PAGE is the
+             client-side delivered-page pseudo-method — its nth counts
+             200-with-body results responses, so a `partial` rule
+             corrupts exactly the nth delivered page
+    path   = substring match on the request path ('' or * = any;
+             for exec the path is the task id)
+    nth    = fire on the nth match, 1-based; append '+' to keep firing
+             on every later match too (e.g. '3+')
+    action = delay | http500 | reset | drop | partial | fail | crash
+    arg    = seconds for delay, probability for any action via 'p0.5'
+             suffix is NOT supported in the compact form — use JSON
+
+A JSON list of rule objects is also accepted (keys = FaultRule fields),
+e.g. '[{"where":"server","method":"GET","path":"/results/","nth":2,
+"action":"http500","p":0.5}]'.  Probabilistic rules draw from the
+plan's seeded rng, so a fixed seed reproduces the exact firing pattern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import random
+import threading
+import time
+import urllib.error
+from typing import List, Optional
+
+from presto_tpu.parallel import retry as R
+
+_FAULTS_ENV = "PRESTO_TPU_FAULTS"
+_ACTIONS = ("delay", "http500", "reset", "drop", "partial", "fail", "crash")
+
+
+@dataclasses.dataclass
+class FaultRule:
+    where: str = "client"      # client | server | exec
+    method: str = "*"          # GET | POST | DELETE | EXEC | *
+    path: str = ""             # substring of the request path ('' = any)
+    nth: int = 1               # fire on the nth match (1-based)
+    count: int = 1             # consecutive firings (0 = every match on)
+    action: str = "http500"
+    arg: float = 0.0           # delay seconds
+    p: float = 1.0             # firing probability (seeded rng)
+
+    def matches(self, where: str, method: str, path: str) -> bool:
+        if self.where != where:
+            return False
+        if self.method not in ("*", "", method):
+            return False
+        return self.path in ("", "*") or self.path in path
+
+
+class FaultPlan:
+    """A scripted failure sequence: rules + per-rule match counters + a
+    seeded rng.  Thread-safe; `fired` logs every injection as
+    (monotonic_ts, where, method, path, action) for assertions and the
+    bench's recovery_ms measurement."""
+
+    def __init__(self, rules: Optional[List[FaultRule]] = None,
+                 seed: int = 0):
+        self.rules = list(rules or [])
+        self.rng = random.Random(seed)
+        self._matched = [0] * len(self.rules)
+        self.fired: List[tuple] = []
+        self._lock = threading.Lock()
+
+    def match(self, where: str, method: str, path: str
+              ) -> Optional[FaultRule]:
+        """Record one request against the plan; return the rule to apply
+        (first rule wins) or None."""
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if not rule.matches(where, method, path):
+                    continue
+                self._matched[i] += 1
+                c = self._matched[i]
+                armed = c >= rule.nth if rule.count == 0 else \
+                    rule.nth <= c < rule.nth + rule.count
+                if not armed:
+                    continue
+                if rule.p < 1.0 and self.rng.random() >= rule.p:
+                    continue
+                self.fired.append((time.monotonic(), where, method,
+                                   path, rule.action))
+                return rule
+        return None
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        text = (text or "").strip()
+        if not text:
+            return cls([], seed)
+        if text.startswith("["):
+            rules = [FaultRule(**obj) for obj in json.loads(text)]
+            return cls(rules, seed)
+        rules = []
+        for part in text.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            f = part.split(":")
+            if len(f) < 5:
+                raise ValueError(f"bad fault rule {part!r} (need "
+                                 "where:method:path:nth:action[:arg])")
+            nth, count = f[3], 1
+            if nth.endswith("+"):
+                nth, count = nth[:-1], 0
+            action = f[4]
+            if action not in _ACTIONS:
+                raise ValueError(f"unknown fault action {action!r}")
+            rules.append(FaultRule(
+                where=f[0], method=f[1].upper() or "*", path=f[2],
+                nth=int(nth), count=count, action=action,
+                arg=float(f[5]) if len(f) > 5 else 0.0))
+        return cls(rules, seed)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        seed = int(R._env_f("PRESTO_TPU_FAULT_SEED", 0))
+        return cls.parse(os.environ.get(_FAULTS_ENV, ""), seed)
+
+
+_EMPTY = FaultPlan([])
+_client_plan: Optional[FaultPlan] = None
+_client_from_env = False
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Install (or with None, remove) this process's client-side plan."""
+    global _client_plan, _client_from_env
+    _client_plan = plan
+    _client_from_env = False
+
+
+def client_plan() -> FaultPlan:
+    global _client_plan, _client_from_env
+    if _client_plan is None and not _client_from_env:
+        _client_plan = FaultPlan.from_env() \
+            if os.environ.get(_FAULTS_ENV) else _EMPTY
+        _client_from_env = True
+    return _client_plan if _client_plan is not None else _EMPTY
+
+
+def apply_client(method: str, path: str) -> Optional[FaultRule]:
+    """Client choke point (called from cluster._http before the request
+    goes out).  Raises / delays per the matched rule; returns the rule
+    when the CALLER must apply it to the response (partial)."""
+    rule = client_plan().match("client", method, path)
+    if rule is None:
+        return None
+    if rule.action == "delay":
+        R._sleep(rule.arg)
+        return None
+    if rule.action == "http500":
+        raise urllib.error.HTTPError(
+            path, 500, "injected fault", None, io.BytesIO(b"injected fault"))
+    if rule.action == "reset":
+        raise ConnectionResetError("injected fault: connection reset")
+    if rule.action == "drop":
+        raise urllib.error.URLError(TimeoutError("injected fault: drop"))
+    return rule  # partial: caller truncates the response body
+
+
+def corrupt_page(body: bytes) -> bytes:
+    """The `partial` action: keep the length, destroy the tail — the
+    PTPG checksum catches it downstream and the pull re-requests the
+    token (at-least-once delivery doing its job)."""
+    if len(body) < 2:
+        return body
+    half = len(body) // 2
+    return body[:half] + b"\x00" * (len(body) - half)
+
+
+def apply_server(rule: FaultRule, handler, server) -> bool:
+    """Server choke point (worker handler, before routing).  Returns
+    True when the handler should continue normally (delay / partial —
+    partial is applied at response time via `server._fault_partial`),
+    False when the fault consumed the request."""
+    if rule.action == "delay":
+        R._sleep(rule.arg)
+        return True
+    if rule.action == "partial":
+        handler._fault_partial = True
+        return True
+    if rule.action == "http500":
+        handler._send(500, b"injected fault")
+        return False
+    if rule.action in ("reset", "drop"):
+        _abort_connection(handler)
+        return False
+    if rule.action == "crash":
+        server.simulate_crash()
+        _abort_connection(handler)
+        return False
+    return True
+
+
+def _abort_connection(handler) -> None:
+    """Close the socket without a response: the client observes a reset
+    / remote-disconnect, exactly like a worker dying mid-request."""
+    import socket
+
+    handler.close_connection = True
+    try:
+        handler.connection.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        handler.connection.close()
+    except OSError:
+        pass
+
+
+def apply_exec(plan: FaultPlan, task_id: str, server) -> None:
+    """Exec choke point: called on the worker's task thread before the
+    fragment runs.  delay = straggler; fail = task FAILED (reported to
+    the coordinator); crash = the worker dies mid-wave."""
+    rule = plan.match("exec", "EXEC", task_id)
+    if rule is None:
+        return
+    if rule.action == "delay":
+        R._sleep(rule.arg)
+    elif rule.action == "fail":
+        raise RuntimeError("injected fault: task failure")
+    elif rule.action == "crash":
+        server.simulate_crash()
+        raise RuntimeError("injected fault: worker crash")

@@ -1,0 +1,334 @@
+"""Unified retry / deadline / backoff layer for the distributed engine.
+
+Reference parity: the failure-handling spine of the reference coordinator
+— `failureDetector/HeartbeatFailureDetector.java` (consecutive-failure
+trip + probation re-admission), the exponential backoff of
+`operator/HttpPageBufferClient.java` (getFailuresCount-scaled delay),
+and the per-query execution deadline of `QueryStateMachine`.  DrJAX
+(PAPERS.md) motivates keeping this control plane OUTSIDE the traced JAX
+program: retries, hedges, and deadline checks live here in host Python,
+so recovery never retraces or recompiles anything.
+
+Design rules enforced by tests/test_lint.py:
+
+- This module is the ONLY place in `presto_tpu/parallel/` allowed to
+  call `time.sleep` or carry a hard-coded timeout.  Everything in
+  `cluster.py` / `faults.py` routes waits through `_sleep`, poll loops
+  through `Backoff`, and RPC timeouts through the `*_TIMEOUT_S`
+  constants below (each env-overridable).
+- Every timeout is capped by the per-query `Deadline` carried on the
+  thread-local `RunContext`, so one query-level budget
+  (`PRESTO_TPU_QUERY_DEADLINE` / the `cluster_query_deadline_s` session
+  property) bounds every RPC the query ever makes.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+# the single sleep choke point for the parallel package (fault injection
+# and tests can monkeypatch it; lint forbids time.sleep elsewhere)
+_sleep = time.sleep
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# ---------------------------------------------------------------------------
+# RPC timeout budget (seconds).  These are DEFAULT per-call caps; the
+# query Deadline always caps them further.
+# ---------------------------------------------------------------------------
+
+RPC_TIMEOUT_S = _env_f("PRESTO_TPU_RPC_TIMEOUT", 60.0)       # generic RPC
+PAGE_TIMEOUT_S = _env_f("PRESTO_TPU_PAGE_TIMEOUT", 30.0)     # one page GET
+PULL_TIMEOUT_S = _env_f("PRESTO_TPU_PULL_TIMEOUT", 600.0)    # whole pull
+WAIT_TIMEOUT_S = _env_f("PRESTO_TPU_WAIT_TIMEOUT", 600.0)    # task wait
+ACK_TIMEOUT_S = _env_f("PRESTO_TPU_ACK_TIMEOUT", 5.0)        # acks/deletes
+PROBE_TIMEOUT_S = _env_f("PRESTO_TPU_PROBE_TIMEOUT", 3.0)    # health probe
+RANGE_TIMEOUT_S = _env_f("PRESTO_TPU_RANGE_TIMEOUT", 300.0)  # boundaries
+SHUTDOWN_TIMEOUT_S = _env_f("PRESTO_TPU_SHUTDOWN_TIMEOUT", 10.0)
+STARTUP_TIMEOUT_S = _env_f("PRESTO_TPU_STARTUP_TIMEOUT", 120.0)
+
+_DEADLINE_ENV = "PRESTO_TPU_QUERY_DEADLINE"
+
+
+class DeadlineExceeded(TimeoutError):
+    """The per-query deadline expired.  Subclasses TimeoutError so legacy
+    handlers see a timeout, but the coordinator treats it as TERMINAL:
+    never retried, always followed by task cancellation."""
+
+
+class Deadline:
+    """Monotonic-clock deadline; `None` seconds = never expires."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, seconds: Optional[float] = None):
+        self.at = None if seconds is None else time.monotonic() + seconds
+
+    @classmethod
+    def never(cls) -> "Deadline":
+        return cls(None)
+
+    def remaining(self) -> float:
+        return math.inf if self.at is None else self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "query") -> None:
+        if self.expired():
+            raise DeadlineExceeded(f"{what}: query deadline exceeded")
+
+    def cap(self, timeout: float) -> float:
+        """Largest per-call timeout that still respects the deadline.
+        Raises the moment the budget is gone, so no RPC is even issued
+        past the deadline."""
+        rem = self.remaining()
+        if rem <= 0.0:
+            raise DeadlineExceeded("query deadline exceeded")
+        return min(timeout, rem)
+
+
+class RetryPolicy:
+    """Exponential backoff with decorrelated jitter (seeded, so a fixed
+    seed reproduces the exact delay sequence) + an attempt budget."""
+
+    def __init__(self, max_attempts: int = 5, base_s: float = 0.02,
+                 cap_s: float = 2.0, seed: Optional[int] = None,
+                 poll_base_s: float = 0.01, poll_cap_s: float = 0.25):
+        self.max_attempts = max(int(max_attempts), 1)
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.poll_base_s = poll_base_s
+        self.poll_cap_s = poll_cap_s
+        self.rng = random.Random(seed)
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        seed = os.environ.get("PRESTO_TPU_RETRY_SEED")
+        return cls(
+            max_attempts=int(_env_f("PRESTO_TPU_RETRY_ATTEMPTS", 5)),
+            base_s=_env_f("PRESTO_TPU_RETRY_BASE", 0.02),
+            cap_s=_env_f("PRESTO_TPU_RETRY_CAP", 2.0),
+            seed=int(seed) if seed is not None else None)
+
+    def next_delay(self, prev: float) -> float:
+        """AWS-style decorrelated jitter: sleep in [base, 3*prev], capped."""
+        return min(self.cap_s, self.rng.uniform(self.base_s,
+                                                max(prev * 3, self.base_s)))
+
+    def call(self, fn: Callable, retryable: Callable[[BaseException], bool],
+             deadline: Optional[Deadline] = None,
+             on_retry: Optional[Callable] = None):
+        """Run `fn`, retrying retryable failures under the attempt budget
+        and the deadline.  `on_retry(attempt, exc, delay)` fires before
+        each backoff sleep."""
+        delay = self.base_s
+        for attempt in range(self.max_attempts):
+            try:
+                return fn()
+            except DeadlineExceeded:
+                raise  # terminal by definition
+            except Exception as e:  # noqa: BLE001 — filtered by retryable()
+                if attempt >= self.max_attempts - 1 or not retryable(e):
+                    raise
+                delay = self.next_delay(delay)
+                d = delay
+                if deadline is not None:
+                    rem = deadline.remaining()
+                    if rem <= 0.0:
+                        raise DeadlineExceeded(
+                            "query deadline exceeded during retry") from e
+                    d = min(d, rem)
+                if on_retry is not None:
+                    on_retry(attempt, e, d)
+                _sleep(d)
+        raise RuntimeError("unreachable")
+
+    def backoff(self) -> "Backoff":
+        return Backoff(self)
+
+
+class Backoff:
+    """Poll-loop backoff: starts near-instant, grows toward a cap with
+    jitter from the policy's seeded rng, resets on progress.  Replaces
+    the fixed `time.sleep(0.05)` poll sprinkled through the old cluster
+    layer."""
+
+    def __init__(self, policy: RetryPolicy):
+        self.policy = policy
+        self.cur = policy.poll_base_s
+
+    def reset(self) -> None:
+        self.cur = self.policy.poll_base_s
+
+    def sleep(self, deadline: Optional[Deadline] = None) -> None:
+        d = self.cur
+        if deadline is not None:
+            rem = deadline.remaining()
+            if rem <= 0.0:
+                return  # caller's next deadline check raises
+            d = min(d, rem)
+        _sleep(d)
+        grow = 1.5 + self.policy.rng.random() * 0.5  # 1.5x..2x
+        self.cur = min(self.policy.poll_cap_s, self.cur * grow)
+
+
+# ---------------------------------------------------------------------------
+# health scoreboard: circuit breaker per worker (reference:
+# HeartbeatFailureDetector's consecutive-failure stats + probation)
+# ---------------------------------------------------------------------------
+
+_CLOSED, _OPEN, _PROBATION = "closed", "open", "probation"
+
+
+class HealthBoard:
+    """Per-URL circuit breaker.  `trip_after` consecutive probe/RPC
+    failures open the circuit (worker quarantined); after `probation_s`
+    a single probe is re-admitted — success closes the circuit, failure
+    re-opens it.  Replaces one-shot `/v1/info` probes, so a flapping
+    worker is neither permanently dropped nor hammered."""
+
+    def __init__(self, trip_after: int = 3, probation_s: float = 5.0,
+                 clock=time.monotonic):
+        self.trip_after = max(int(trip_after), 1)
+        self.probation_s = probation_s
+        self.clock = clock
+        self._st: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def _entry(self, url: str) -> dict:
+        return self._st.setdefault(
+            url, {"fails": 0, "state": _CLOSED, "opened": 0.0})
+
+    def record_ok(self, url: str) -> None:
+        with self._lock:
+            e = self._entry(url)
+            e["fails"] = 0
+            e["state"] = _CLOSED
+
+    def record_fail(self, url: str) -> bool:
+        """Returns True when THIS failure trips the breaker open."""
+        with self._lock:
+            e = self._entry(url)
+            e["fails"] += 1
+            if e["state"] == _PROBATION or (
+                    e["state"] == _CLOSED and e["fails"] >= self.trip_after):
+                e["state"] = _OPEN
+                e["opened"] = self.clock()
+                return True
+            return False
+
+    def state(self, url: str) -> str:
+        with self._lock:
+            return self._entry(url)["state"]
+
+    def allow(self, url: str) -> bool:
+        """May we talk to this worker?  Open circuits admit one probe
+        after the probation interval (flipping to half-open)."""
+        with self._lock:
+            e = self._entry(url)
+            if e["state"] == _OPEN:
+                if self.clock() - e["opened"] >= self.probation_s:
+                    e["state"] = _PROBATION
+                    return True
+                return False
+            return True
+
+    def probe(self, url: str, probe_fn: Callable[[str], None]) -> bool:
+        """One health probe (respecting the breaker); updates the board.
+        `probe_fn(url)` raises on failure."""
+        if not self.allow(url):
+            return False
+        try:
+            probe_fn(url)
+        except Exception:  # noqa: BLE001 — any probe failure counts
+            self.record_fail(url)
+            return False
+        self.record_ok(url)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# per-query run context: deadline + policy + health + recovery counters,
+# carried on a thread-local so the whole call tree under one query shares
+# one budget without threading a parameter through every signature
+# ---------------------------------------------------------------------------
+
+
+class RunContext:
+    def __init__(self, deadline: Optional[Deadline] = None,
+                 policy: Optional[RetryPolicy] = None,
+                 health: Optional[HealthBoard] = None,
+                 listeners=None, query_id: str = ""):
+        self.deadline = deadline if deadline is not None else \
+            Deadline(query_deadline_from_env())
+        self.policy = policy or RetryPolicy.from_env()
+        self.health = health or HealthBoard()
+        self.listeners = listeners or []
+        self.query_id = query_id
+        self.recovery: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def count(self, key: str, n: int = 1, **detail) -> None:
+        """Bump a recovery counter and fan a RecoveryEvent out to the
+        session's event listeners (coordinator side only)."""
+        with self._lock:
+            self.recovery[key] = self.recovery.get(key, 0) + n
+        if self.listeners:
+            from presto_tpu.observe.events import RecoveryEvent, dispatch
+
+            dispatch(self.listeners, "recovery",
+                     RecoveryEvent(self.query_id, key, detail or None))
+
+
+def query_deadline_from_env() -> Optional[float]:
+    s = os.environ.get(_DEADLINE_ENV)
+    if not s:
+        return None
+    try:
+        return float(s)
+    except ValueError:
+        return None
+
+
+_tls = threading.local()
+_default_ctx: Optional[RunContext] = None
+_default_lock = threading.Lock()
+
+
+def current() -> RunContext:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        return ctx
+    global _default_ctx
+    with _default_lock:
+        if _default_ctx is None:
+            _default_ctx = RunContext()
+        return _default_ctx
+
+
+class activate:
+    """Context manager binding a RunContext to this thread."""
+
+    def __init__(self, ctx: RunContext):
+        self.ctx = ctx
+        self.prev = None
+
+    def __enter__(self) -> RunContext:
+        self.prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc) -> None:
+        _tls.ctx = self.prev

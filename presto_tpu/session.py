@@ -60,6 +60,17 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # phased gates probe-side stage startup on build-side completion,
     # bounding worker buffer memory on deep join DAGs
     "phased_execution": False,
+    # cluster robustness knobs (parallel/retry.py, docs/ROBUSTNESS.md):
+    # one query-level deadline every RPC timeout derives from (None =
+    # unbounded; env PRESTO_TPU_QUERY_DEADLINE overrides the default),
+    # the straggler-hedging policy, and the health circuit breaker
+    "cluster_query_deadline_s": None,
+    "cluster_hedging": True,
+    "cluster_hedge_quantile": 0.5,  # hedge when this wave share FINISHED
+    "cluster_hedge_factor": 3.0,    # ... and a task exceeds q*factor
+    "cluster_hedge_min_s": 0.25,    # ... with at least this headroom
+    "cluster_health_trip_after": 3,   # consecutive failures to quarantine
+    "cluster_health_probation_s": 5.0,  # re-probe a quarantined worker
     # transitive semi-join pushdown (plan/optimizer); chunked planning
     # turns it off — the inferred probe-side semi never compacts at
     # chunk capacities

@@ -1,0 +1,274 @@
+"""Chaos smoke: deterministic fault-injected recovery paths (ISSUE 2).
+
+Every scenario runs against an IN-PROCESS cluster (no subprocess kills)
+with a scripted FaultPlan, so each recovery path — backoff absorbing a
+transient 500, circuit-breaking a crashed worker, straggler hedging,
+deadline cancellation — is a fast, reproducible unit test asserted via
+QueryStats.recovery counters.  The harness is seeded: a fixed seed
+reproduces the exact firing pattern and backoff delays."""
+
+import pytest
+
+import presto_tpu
+from presto_tpu.observe.events import EventListener
+from presto_tpu.parallel import cluster as C
+from presto_tpu.parallel import faults as F
+from presto_tpu.parallel import retry as R
+
+
+def norm(rows):
+    return [tuple(round(x, 4) if isinstance(x, float) else x for x in r)
+            for r in rows]
+
+
+# ---- deterministic primitives -----------------------------------------
+
+
+def test_fault_plan_grammar_compact_and_json():
+    p = F.FaultPlan.parse(
+        "server:GET:/results/:2:http500;exec:EXEC:*:1:delay:2.5;"
+        "client:*:/v1/task:3+:reset")
+    assert [r.action for r in p.rules] == ["http500", "delay", "reset"]
+    assert p.rules[1].arg == 2.5
+    assert p.rules[2].count == 0  # '3+' = every match from the 3rd on
+    pj = F.FaultPlan.parse(
+        '[{"where":"server","path":"/results/","nth":2,"action":"drop"}]')
+    assert pj.rules[0].where == "server" and pj.rules[0].nth == 2
+    with pytest.raises(ValueError):
+        F.FaultPlan.parse("server:GET:/x:1:frobnicate")
+
+
+def test_fault_plan_nth_matching_is_deterministic():
+    p = F.FaultPlan.parse("server:GET:/results/:2:http500")
+    assert p.match("server", "GET", "/v1/task/t/results/0/0") is None
+    assert p.match("server", "GET", "/v1/task/t/results/0/0") is not None
+    assert p.match("server", "GET", "/v1/task/t/results/0/0") is None
+    assert p.match("server", "GET", "/v1/status") is None  # path filter
+    assert len(p.fired) == 1
+
+
+def test_fault_plan_probability_seeded():
+    mk = lambda seed: F.FaultPlan(  # noqa: E731
+        [F.FaultRule(where="client", nth=1, count=0, p=0.5)], seed=seed)
+    fires = lambda plan: [  # noqa: E731
+        plan.match("client", "GET", "/x") is not None for _ in range(32)]
+    a, b = mk(7), mk(7)
+    assert fires(a) == fires(b)  # same seed -> identical firing pattern
+    assert any(fires(mk(8))) and 0 < sum(fires(mk(9))) < 32
+
+
+def test_retry_policy_decorrelated_jitter_deterministic():
+    a = R.RetryPolicy(seed=3)
+    b = R.RetryPolicy(seed=3)
+    da = [a.next_delay(d) for d in (0.02, 0.1, 0.5, 2.0)]
+    db = [b.next_delay(d) for d in (0.02, 0.1, 0.5, 2.0)]
+    assert da == db
+    assert all(x <= a.cap_s for x in da)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    assert R.RetryPolicy(seed=1, base_s=0.001, cap_s=0.002).call(
+        flaky, retryable=lambda e: True) == "ok"
+    assert len(calls) == 3
+    with pytest.raises(ValueError):
+        R.RetryPolicy(seed=1).call(
+            lambda: (_ for _ in ()).throw(ValueError("x")),
+            retryable=lambda e: isinstance(e, ConnectionError))
+
+
+def test_deadline_caps_and_expires():
+    d = R.Deadline(60.0)
+    assert 0 < d.cap(5.0) <= 5.0
+    assert not d.expired()
+    e = R.Deadline(-1.0)
+    assert e.expired()
+    with pytest.raises(R.DeadlineExceeded):
+        e.cap(5.0)
+    with pytest.raises(TimeoutError):  # DeadlineExceeded IS a timeout
+        e.check("x")
+    assert R.Deadline.never().cap(7.0) == 7.0
+
+
+def test_health_board_trip_and_probation():
+    clock = [0.0]
+    hb = R.HealthBoard(trip_after=3, probation_s=5.0,
+                       clock=lambda: clock[0])
+    u = "http://w1"
+    assert hb.record_fail(u) is False
+    assert hb.record_fail(u) is False
+    assert hb.record_fail(u) is True  # third consecutive failure trips
+    assert hb.state(u) == "open" and not hb.allow(u)
+    clock[0] = 6.0  # probation elapsed: one probe re-admitted
+    assert hb.allow(u) and hb.state(u) == "probation"
+    assert hb.record_fail(u) is True  # probation failure re-opens
+    assert not hb.allow(u)
+    clock[0] = 12.0
+    assert hb.allow(u)
+    hb.record_ok(u)
+    assert hb.state(u) == "closed" and hb.allow(u)
+
+
+# ---- fault-injected in-process cluster (the chaos smoke) --------------
+
+
+QUERY = ("SELECT o_orderpriority, count(*) c FROM orders "
+         "GROUP BY o_orderpriority ORDER BY 1")
+
+
+@pytest.fixture(scope="module")
+def chaos(tpch_catalog_tiny):
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    workers = [C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                              faults=F.FaultPlan([])).start()
+               for _ in range(2)]
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    want = norm(session.sql(QUERY).rows)
+    assert norm(cs.sql(QUERY).rows) == want  # prewarm (compile + caches)
+    yield session, cs, workers, want
+    F.install(None)
+    for w in workers:
+        if not w.crashed:
+            w.stop()
+
+
+def _reset(session, cs, workers):
+    for w in workers:
+        w.faults = F.FaultPlan([])
+    F.install(None)
+    session.properties["cluster_query_deadline_s"] = None
+
+
+def test_transient_500_absorbed_by_backoff(chaos):
+    """Acceptance: a scripted one-shot 500 on the results endpoint is
+    absorbed by retry/backoff — ZERO query-level retries."""
+    session, cs, workers, want = chaos
+    seen = []
+
+    class Tap(EventListener):
+        def recovery(self, event):
+            seen.append(event.kind)
+
+    session.event_listeners.append(Tap())
+    try:
+        workers[0].faults = F.FaultPlan.parse("server:GET:/results/:1:http500")
+        assert norm(cs.sql(QUERY).rows) == want
+        rec = session.last_stats.recovery
+        assert rec.get("http_retries", 0) >= 1, rec
+        assert "query_retries" not in rec, rec
+        assert len(workers[0].faults.fired) == 1  # fired exactly once
+        assert "http_retries" in seen  # RecoveryEvent reached listeners
+    finally:
+        session.event_listeners.pop()
+        _reset(session, cs, workers)
+
+
+def test_partial_page_reverified_and_repulled(chaos):
+    """A corrupted (truncated) page transfer fails the PTPG checksum on
+    receipt and is re-requested by sequence token — at-least-once
+    delivery, not a poisoned consumer."""
+    session, cs, workers, want = chaos
+    # PAGE = the client-side delivered-page pseudo-method: nth counts
+    # real page bodies, so the corruption is deterministic
+    F.install(F.FaultPlan.parse("client:PAGE:/results/:1:partial"))
+    try:
+        assert norm(cs.sql(QUERY).rows) == want
+        rec = session.last_stats.recovery
+        assert rec.get("pages_retried", 0) >= 1, rec
+        assert "query_retries" not in rec, rec
+    finally:
+        _reset(session, cs, workers)
+
+
+def test_connection_reset_absorbed_while_worker_healthy(chaos):
+    """A scripted connection reset is absorbed by the poll loop: the
+    circuit breaker probes the worker, finds it healthy, and the pull
+    continues — no quarantine, no query retry."""
+    session, cs, workers, want = chaos
+    F.install(F.FaultPlan.parse("client:GET:/results/:1:reset"))
+    try:
+        assert norm(cs.sql(QUERY).rows) == want
+        rec = session.last_stats.recovery
+        assert rec.get("http_retries", 0) >= 1, rec
+        assert "workers_quarantined" not in rec, rec
+    finally:
+        _reset(session, cs, workers)
+
+
+def test_straggler_hedged_duplicate_wins(chaos):
+    """Acceptance: a scripted exec delay makes one leaf task a
+    straggler; the hedge monitor re-runs it on the healthy survivor and
+    the duplicate's FINISHED wins (dedup by the page-token sequence)."""
+    session, cs, workers, want = chaos
+    workers[1].faults = F.FaultPlan.parse("exec:EXEC:*:1:delay:8.0")
+    try:
+        assert norm(cs.sql(QUERY).rows) == want
+        rec = session.last_stats.recovery
+        assert rec.get("hedges_launched", 0) >= 1, rec
+        assert rec.get("hedges_won", 0) >= 1, rec
+        assert "query_retries" not in rec, rec
+    finally:
+        _reset(session, cs, workers)
+
+
+def test_deadline_expiry_cancels_all_tasks(chaos):
+    """Acceptance: when the query-level deadline expires, the
+    coordinator aborts and every live worker task observes DELETE —
+    asserted synchronously (the reap runs before sql() raises), so no
+    sleep-based polling."""
+    session, cs, workers, want = chaos
+    for w in workers:
+        w.faults = F.FaultPlan.parse("exec:EXEC:*:1:delay:30.0")
+    session.set("cluster_query_deadline_s", 1.5)
+    try:
+        with pytest.raises(TimeoutError):
+            cs.sql(QUERY)
+        rec = session.last_stats.recovery
+        assert rec.get("deadline_expired", 0) == 1, rec
+        assert rec.get("task_cancels", 0) >= 2, rec
+        for w in workers:  # DELETE observed: no orphaned task state
+            assert not w.tasks, list(w.tasks)
+        assert session.last_stats.state == "FAILED"
+    finally:
+        _reset(session, cs, workers)
+
+
+def test_worker_crash_mid_wave_remaps_to_survivors(tpch_catalog_tiny):
+    """Acceptance: a scripted worker crash mid-wave trips the circuit
+    breaker; the retry remaps the dead slots onto survivors and the
+    query succeeds — the crashed worker lands in quarantine, not in an
+    endless probe loop."""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    workers = [C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                              faults=F.FaultPlan([])).start()
+               for _ in range(2)]
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    try:
+        want = norm(session.sql(QUERY).rows)
+        assert norm(cs.sql(QUERY).rows) == want  # prewarm
+        workers[1].faults = F.FaultPlan.parse("exec:EXEC:*:1:crash")
+        assert norm(cs.sql(QUERY).rows) == want
+        rec = session.last_stats.recovery
+        assert rec.get("query_retries", 0) == 1, rec
+        assert rec.get("workers_quarantined", 0) >= 1, rec
+        assert cs.workers == [workers[0].url]
+        assert workers[1].url in cs._benched
+        assert workers[1].crashed
+    finally:
+        for w in workers:
+            if not w.crashed:
+                w.stop()
+
+
+def test_env_fault_plan_roundtrip(monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_FAULTS",
+                       "server:GET:/results/:3:drop;exec:EXEC:*:1:fail")
+    p = F.FaultPlan.from_env()
+    assert [r.action for r in p.rules] == ["drop", "fail"]
+    w = object.__new__(C.WorkerServer)  # no bind: just the env pickup
+    w.faults = F.FaultPlan.from_env()
+    assert len(w.faults.rules) == 2

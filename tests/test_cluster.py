@@ -176,6 +176,36 @@ def test_cluster_auth_rejects_unsigned_requests(cluster):
     assert ei2.value.code == 401
 
 
+def test_upstream_500_once_then_recovers_is_not_a_failure(tpch_catalog_tiny):
+    """UpstreamFailed semantics under RetryPolicy: a worker that 500s
+    exactly once on its results endpoint then recovers must NOT fail the
+    query — the backoff absorbs it with zero query-level retries, and
+    UpstreamFailed stays reserved for genuinely FAILED tasks (scripted
+    via the fault plan, so the sequence is fully deterministic)."""
+    from presto_tpu.parallel import faults as F
+
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    workers = [C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                              faults=F.FaultPlan([])).start()
+               for _ in range(2)]
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    try:
+        q = "SELECT count(*) c, sum(o_totalprice) s FROM orders"
+        want = norm(session.sql(q).rows)
+        assert norm(cs.sql(q).rows) == want  # prewarm
+        workers[0].faults = F.FaultPlan.parse(
+            "server:GET:/results/:1:http500")
+        assert norm(cs.sql(q).rows) == want
+        rec = session.last_stats.recovery
+        assert rec.get("http_retries", 0) >= 1, rec
+        assert "query_retries" not in rec, rec  # absorbed below query level
+        assert len(workers[0].faults.fired) == 1
+        assert len(cs.workers) == 2  # nobody got dropped for one flake
+    finally:
+        for w in workers:
+            w.stop()
+
+
 def test_worker_refuses_public_bind_without_secret(monkeypatch):
     import presto_tpu.parallel.cluster as CM
 

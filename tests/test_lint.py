@@ -16,3 +16,41 @@ def test_lint_clean():
          os.path.join(ROOT, "tools")],
         capture_output=True, text=True)
     assert r.returncode == 0, f"lint findings:\n{r.stdout}"
+
+
+def test_no_raw_sleeps_or_timeouts_in_parallel():
+    """Robustness gate (ISSUE 2): presto_tpu/parallel/retry.py is the
+    ONLY module in the parallel package allowed to call `time.sleep` or
+    hard-code a timeout.  Everything else must route waits through
+    retry.Backoff / retry._sleep and derive per-call timeouts from the
+    retry.*_TIMEOUT_S constants (each capped by the query Deadline), so
+    one query-level budget governs every RPC.  This test forbids NEW
+    call sites from creeping back in."""
+    import ast
+
+    pdir = os.path.join(ROOT, "presto_tpu", "parallel")
+    bad = []
+    for fn in sorted(os.listdir(pdir)):
+        if not fn.endswith(".py") or fn == "retry.py":
+            continue
+        path = os.path.join(pdir, fn)
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "sleep" \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id == "time":
+                bad.append(f"{fn}:{node.lineno}: bare time.sleep() — "
+                           "use retry.Backoff / retry._sleep")
+            for kw in node.keywords:
+                if kw.arg == "timeout" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, (int, float)):
+                    bad.append(
+                        f"{fn}:{kw.value.lineno}: hard-coded "
+                        f"timeout={kw.value.value!r} — use a "
+                        "retry.*_TIMEOUT_S constant")
+    assert not bad, "\n".join(bad)

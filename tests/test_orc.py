@@ -65,6 +65,8 @@ def _assert_matches(path, table):
 @pytest.mark.parametrize("codec", ["uncompressed", "zlib", "snappy",
                                    "zstd", "lz4"])
 def test_read_pyarrow_orc_all_codecs(tmp_path, rich_table, codec):
+    if codec == "zstd":
+        pytest.importorskip("zstandard")  # optional codec dep -> skip
     p = str(tmp_path / f"t_{codec}.orc")
     po.write_table(rich_table, p, compression=codec)
     _assert_matches(p, rich_table)
@@ -98,6 +100,7 @@ def test_rlev2_subencodings_roundtrip(tmp_path):
 
 
 def test_orc_connector_sql(tmp_path, rich_table):
+    pytest.importorskip("zstandard")  # file written with zstd below
     p = str(tmp_path / "t.orc")
     po.write_table(rich_table, p, compression="zstd")
     cat = Catalog()

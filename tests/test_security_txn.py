@@ -239,6 +239,7 @@ def test_explicit_set_outranks_property_rules():
 def test_spill_encryption_roundtrip(tmp_path):
     """AES-256-CTR spill files (reference: AesSpillCipher) decrypt only
     through the in-memory cipher."""
+    pytest.importorskip("cryptography")  # optional crypto dep -> skip
     import numpy as np
 
     from presto_tpu import types as T
@@ -266,6 +267,7 @@ def test_spill_encryption_roundtrip(tmp_path):
 
 
 def test_spill_encryption_via_query(tpch_catalog_tiny, tmp_path):
+    pytest.importorskip("cryptography")  # optional crypto dep -> skip
     import presto_tpu as pt
 
     s = pt.connect(tpch_catalog_tiny)
