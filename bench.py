@@ -88,8 +88,14 @@ def main():
         session.set("float32_compute", True)
 
     engine_times = {}
+    sort_econ = {}
     for qid in QUERY_IDS:
-        session.sql(QUERIES[qid])  # prewarm (gen + upload + compile)
+        r = session.sql(QUERIES[qid])  # prewarm (gen + upload + compile)
+        if r.stats is not None:  # round-8 sort economics per query
+            sort_econ[str(qid)] = {
+                "taken": r.stats.sorts_taken,
+                "elided": r.stats.sorts_elided,
+                "memo_hits": r.stats.sort_memo_hits}
         best = float("inf")
         for _ in range(RUNS):
             t0 = time.perf_counter()
@@ -123,6 +129,7 @@ def main():
                          for q, t in engine_times.items()},
         "perf_gate": gate,
         "recovery_ms": recovery_ms,
+        "sort_economics": sort_econ or None,
         "sf": SF,
         "scale_configs": {k: v for k, v in (load_scale_progress() or {}).items()
                           if k != "sf1_test_tier"} or None,

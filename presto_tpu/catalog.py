@@ -60,6 +60,15 @@ class ConnectorTable:
     def max_rows_per_key(self) -> Dict[tuple, int]:
         return {}
 
+    def ordering(self) -> List[Tuple[str, bool]]:
+        """Declared physical row ordering: [(column, ascending), ...] —
+        rows are emitted lexicographically nondecreasing on this column
+        prefix (reference: ConnectorMetadata table layout
+        LocalProperties).  A CLAIM consumed behind runtime monotonicity
+        guards (plan/properties.py), so a wrong declaration costs the
+        elided sort back, never correctness.  Empty = unordered."""
+        return []
+
     # ---- bucketing SPI (reference: Connector.getNodePartitioningProvider,
     # presto-spi/.../spi/connector/Connector.java:74 + BucketNodeMap;
     # here the metadata that lets grouped/chunked execution stream this
@@ -176,6 +185,13 @@ class TpchTable(ConnectorTable):
 
     def max_rows_per_key(self):
         return tpch_gen.MAX_ROWS_PER_KEY.get(self.name, {})
+
+    def ordering(self):
+        # generator emits every table in primary-key order (validated
+        # against generated data in tests/test_ordering_properties.py);
+        # split/chunk scans preserve it — ranges are contiguous,
+        # ascending, and concatenated in index order
+        return tpch_gen.ORDERINGS.get(self.name, [])
 
     def splits(self, n_splits):
         return tpch_gen.split_ranges(self.name, self.sf, n_splits)
@@ -321,12 +337,16 @@ class Catalog:
                         data: Dict[str, np.ndarray]) -> None:
         self.register(MemoryTable(name, schema, data))
 
-    def register_parquet(self, name: str, path: str) -> None:
+    def register_parquet(self, name: str, path: str,
+                         ordering=None) -> None:
         """A .parquet file (or directory of them) as a table
-        (reference: hive external tables over parquet files)."""
+        (reference: hive external tables over parquet files).
+        `ordering`: optional [(column, ascending), ...] physical sort
+        declaration (hive SORTED BY analog) — exploited by ordering-
+        aware execution behind runtime guards."""
         from presto_tpu.connectors.parquet import ParquetTable
 
-        self.register(ParquetTable(name, path))
+        self.register(ParquetTable(name, path, ordering=ordering))
 
     def register_orc(self, name: str, path: str) -> None:
         """A .orc file (or directory of them) as a table (reference:
@@ -423,6 +443,9 @@ class TpcdsTable(ConnectorTable):
 
     def max_rows_per_key(self):
         return self._gen.MAX_ROWS_PER_KEY.get(self.name, {})
+
+    def ordering(self):
+        return self._gen.ORDERINGS.get(self.name, [])
 
     def splits(self, n_splits):
         return self._gen.split_ranges(self.name, self.sf, n_splits)

@@ -26,8 +26,15 @@ class ParquetTable(ConnectorTable):
     supports_null_append = True  # null channel in the format
 
     def __init__(self, name: str, path: str,
-                 schema: Optional[Dict[str, T.Type]] = None):
+                 schema: Optional[Dict[str, T.Type]] = None,
+                 ordering=None):
         self.path = path
+        # declared physical sort order (hive SORTED BY analog): the
+        # files are claimed written lexicographically nondecreasing on
+        # these (column, ascending) pairs — consumed behind runtime
+        # monotonicity guards, so a false declaration costs the elided
+        # sort back, never correctness
+        self._ordering = [(c, bool(a)) for c, a in (ordering or [])]
         if schema is None:
             files = self._files()
             if not files:
@@ -43,6 +50,9 @@ class ParquetTable(ConnectorTable):
                     "files; register it read-only or choose a new path")
             os.makedirs(path, exist_ok=True)
         super().__init__(name, schema)
+
+    def ordering(self):
+        return list(self._ordering)
 
     # -- layout --------------------------------------------------------
     def _files(self) -> List[str]:

@@ -1369,6 +1369,24 @@ UNIQUE_KEYS = {
     "inventory": [("inv_date_sk", "inv_item_sk", "inv_warehouse_sk")],
 }
 
+# physical row ordering the generator emits (ordering-properties SPI,
+# plan/properties.py): dimensions in surrogate-key order; sales in
+# ticket/order-number order (unit = row // items-per-unit + 1); returns
+# inherit their parent sale's unit, sampled every RETURN_EVERY rows in
+# row order.  Validated against generated data in
+# tests/test_ordering_properties.py; consumed behind monotonicity
+# guards.
+ORDERINGS = {
+    **{t: [(k, True)] for t, k in PRIMARY_KEYS.items()},
+    "store_sales": [("ss_ticket_number", True)],
+    "store_returns": [("sr_ticket_number", True)],
+    "catalog_sales": [("cs_order_number", True)],
+    "catalog_returns": [("cr_order_number", True)],
+    "web_sales": [("ws_order_number", True)],
+    "web_returns": [("wr_order_number", True)],
+    "inventory": [("inv_date_sk", True), ("inv_item_sk", True)],
+}
+
 # max rows sharing one value of the key set (join fanout upper bounds)
 MAX_ROWS_PER_KEY = {
     "store_sales": {("ss_ticket_number",): ITEMS_PER_TICKET,

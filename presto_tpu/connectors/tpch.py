@@ -552,6 +552,23 @@ UNIQUE_KEYS = {
     "lineitem": [("l_orderkey", "l_linenumber")],
 }
 
+# physical row ordering the generator emits (ordering-properties SPI,
+# plan/properties.py): every table comes out in primary-key order —
+# dbgen writes entity files in key order and the counter-based
+# generator indexes rows the same way.  (partsupp's ps_suppkey is a
+# slot formula, NOT sorted within a part, so only ps_partkey is
+# declared.)  Consumed behind runtime monotonicity guards.
+ORDERINGS = {
+    "region": [("r_regionkey", True)],
+    "nation": [("n_nationkey", True)],
+    "part": [("p_partkey", True)],
+    "supplier": [("s_suppkey", True)],
+    "partsupp": [("ps_partkey", True)],
+    "customer": [("c_custkey", True)],
+    "orders": [("o_orderkey", True)],
+    "lineitem": [("l_orderkey", True), ("l_linenumber", True)],
+}
+
 # max rows sharing one value of the key set (join fanout upper bounds)
 MAX_ROWS_PER_KEY = {
     "lineitem": {("l_orderkey",): 7, ("l_orderkey", "l_linenumber"): 1},

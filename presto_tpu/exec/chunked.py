@@ -139,7 +139,7 @@ def _plan_streaming(session, scans) -> Dict[str, object]:
     return streamed
 
 
-def run_chunked(session, stmt, text: str):
+def run_chunked(session, stmt, text: str, mon=None):
     """Plan + execute a chunked query; returns a QueryResult.  The
     prepared execution (distributed plan, fragments, jitted per-chunk
     programs) memoizes per session so warm runs skip planning AND
@@ -161,7 +161,7 @@ def run_chunked(session, stmt, text: str):
            _volatile_nonce(text))
     prepared = cache.get(key)
     if prepared is not None:
-        return _execute_prepared(session, *prepared)
+        return _execute_prepared(session, *prepared, mon=mon)
 
     # ALWAYS re-plan (the executor's probe plan used inference ON):
     # chunked mode needs transitive semi-join inference OFF (see
@@ -213,14 +213,15 @@ def run_chunked(session, stmt, text: str):
         for inp in f.inputs:
             consumer_eid[inp.producer] = inp.eid
     result = _execute_prepared(session, dplan, frags, runner, table_family,
-                               consumer_eid)
+                               consumer_eid, mon=mon)
     cache[key] = (dplan, frags, runner, table_family, consumer_eid)
     return result
 
 
 def _execute_prepared(session, dplan, frags, runner, table_family,
-                      consumer_eid):
-    from presto_tpu.exec.executor import Executor, StaticFallback
+                      consumer_eid, mon=None):
+    from presto_tpu.exec.executor import (Executor, StaticFallback,
+                                          _merge_sort_stats)
 
     runner.buffers.clear()
     try:
@@ -229,6 +230,10 @@ def _execute_prepared(session, dplan, frags, runner, table_family,
         ex = Executor(session)
         return ex.materialize(dplan, final_batch)
     finally:
+        if mon is not None:
+            # trace-time routing decisions of the per-chunk programs
+            # (warm runs replay the same totals, not re-accumulate)
+            _merge_sort_stats(mon.stats, runner.sort_stats)
         runner.buffers.clear()  # don't pin HBM between runs
 
 
@@ -401,6 +406,8 @@ class _FragmentRunner:
         self.dynamic_fids = set()  # run-once fids that fell back dynamic
         self.bound_mult: Dict[object, int] = {}  # fid -> compact growth
         self._bound_cache: Dict[object, int] = {}  # fid -> stats bound
+        # trace-time sort-economics counters across fragment programs
+        self.sort_stats: Dict[str, int] = {}
 
     # ---- fragment execution ------------------------------------------
     def _scan_builder(self, node: P.TableScan, chunk_args, grid):
@@ -455,7 +462,8 @@ class _FragmentRunner:
         from presto_tpu.exec.executor import (Executor, _compact_batch,
                                               _static_root_bound)
 
-        ex = Executor(self.session, static=True, scan_inputs=scan_inputs)
+        ex = Executor(self.session, static=True, scan_inputs=scan_inputs,
+                      sort_stats=self.sort_stats)
         # sort-order materialization hint (gather.py): a chunk
         # fragment's OUTPUT rows are compacted, buffered, and consumed
         # by the next fragment's aggregate/TopN/join — all of which

@@ -44,6 +44,13 @@ class ExecutionError(Exception):
     pass
 
 
+def _merge_sort_stats(stats, counts: dict) -> None:
+    """Fold an executor's sort-economics counters into QueryStats."""
+    for k in ("sorts_taken", "sorts_elided", "sort_memo_hits",
+              "ordering_guard_trips"):
+        setattr(stats, k, getattr(stats, k, 0) + int(counts.get(k, 0)))
+
+
 class StaticFallback(Exception):
     """Raised when a plan shape can't be made static (missing stats /
     unbounded join fanout); auto mode falls back to eager execution."""
@@ -264,7 +271,7 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
             try:
                 with mon.phase("execute"):
                     mon.stats.execution_mode = "chunked"
-                    return CH.run_chunked(session, stmt, text)
+                    return CH.run_chunked(session, stmt, text, mon=mon)
             except (CH.Unchunkable, jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError):
                 if mode == "chunked":
@@ -273,7 +280,7 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
         try:
             with mon.phase("execute"):
                 mon.stats.execution_mode = "compiled"
-                return run_compiled(session, text, stmt)
+                return run_compiled(session, text, stmt, mon=mon)
         except (StaticFallback, jax.errors.ConcretizationTypeError,
                 jax.errors.TracerArrayConversionError) as e:
             if mode == "compiled":
@@ -582,7 +589,7 @@ def _volatile_nonce(text: str) -> int:
     return session_ctx.query_seq()
 
 
-def run_compiled(session, text: str, stmt) -> QueryResult:
+def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
     """Compiled execution: the WHOLE plan traces into one jitted XLA
     program over the scan batches (the reference compiles expressions to
     bytecode per operator, sql/gen/; we compile the entire fragment DAG —
@@ -602,17 +609,18 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
     entry = cache.get(key)
     if entry == "DYNAMIC":  # static assumptions known-violated for this query
         plan = plan_statement(session, stmt)
-        return Executor(session).run(plan)
+        return Executor(session, monitor=mon).run(plan)
     if entry is None:
         plan = plan_statement(session, stmt)
         if _plan_has_long_decimal(plan.root):
             # two-limb Int128 columns don't pack through the compiled
             # fetch plane yet; the dynamic executor carries them exactly
             cache[key] = "DYNAMIC"
-            return Executor(session).run(plan)
+            return Executor(session, monitor=mon).run(plan)
         # uncorrelated scalar subqueries: evaluate eagerly (tiny), bake in;
         # populate ctx as we go — later subplans may reference earlier ones
-        ex0 = Executor(session)
+        sort_counts = {}  # trace-time sort routing decisions
+        ex0 = Executor(session, sort_stats=sort_counts)
         scalar_results = ex0.ctx.scalar_results
         for pid, sub in sorted(plan.subplans.items()):
             scalar_results[pid] = _single_value(ex0.exec_node(sub))
@@ -624,7 +632,8 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
 
         def fn(batches):
             ex = Executor(session, static=True,
-                          scan_inputs={id(n): b for n, b in zip(scan_nodes, batches)})
+                          scan_inputs={id(n): b for n, b in zip(scan_nodes, batches)},
+                          sort_stats=sort_counts)
             ex.ctx.scalar_results = scalar_results
             out = ex.exec_node(plan.root)
             if bound is not None and out.sel.shape[0] > 4 * bound:
@@ -651,13 +660,17 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
                    for n in scan_nodes]
         buf = jitted(batches)  # traces; may raise StaticFallback
         meta = meta_box[0]
-        cache[key] = (plan, jitted, scan_nodes, meta)  # cache only after success
+        # cache only after success; sort_counts are the program's
+        # trace-time routing decisions, replayed into stats per run
+        cache[key] = (plan, jitted, scan_nodes, meta, dict(sort_counts))
     else:
-        plan, jitted, scan_nodes, meta = entry
+        plan, jitted, scan_nodes, meta, sort_counts = entry
         f32 = bool(session.properties.get("float32_compute", False))
         batches = [scan_batch(session.catalog.get(n.table), n, f32)
                    for n in scan_nodes]
         buf = jitted(batches)
+    if mon is not None:
+        _merge_sort_stats(mon.stats, sort_counts)
     ex = Executor(session)
     if meta is None:  # sparse/unbounded result: selective to_numpy fetch
         out_batch, guard = buf
@@ -667,11 +680,12 @@ def run_compiled(session, text: str, stmt) -> QueryResult:
         datas, sel, guard_h = K.unpack_fetch(jax.device_get(buf), meta)
         result = ex.materialize_host(plan, meta, datas, sel)
     if bool(guard_h):
-        # static assumption violated; data is static so it will trip again —
+        # static assumption violated (incl. a tripped ordering-claim
+        # monotonicity guard); data is static so it will trip again —
         # remember to go straight to dynamic next time (no retrace loop)
         cache[key] = "DYNAMIC"
         plan2 = plan_statement(session, stmt)
-        return Executor(session).run(plan2)
+        return Executor(session, monitor=mon).run(plan2)
     return result
 
 
@@ -817,11 +831,27 @@ class Executor:
     allow_index_join = True
 
     def __init__(self, session, static: bool = False, scan_inputs=None,
-                 monitor=None, mem=None):
+                 monitor=None, mem=None, sort_stats=None):
         self.session = session
         self.static = static  # compiled mode: no host syncs, static shapes
         self.scan_inputs = scan_inputs  # {node id: Batch} traced jit args
         self.guards = []  # traced bools: True => static assumption violated
+        # ordering-aware execution state (plan/properties.py):
+        # - sort economics counters (flow into QueryStats)
+        # - the per-trace sort-permutation memo: key fingerprint ->
+        #   (refs, (skey, order)) so a key sorted once in a fragment is
+        #   never sorted again (refs hold the fingerprinted arrays
+        #   alive, so a recycled id() can never alias a dead entry)
+        # - the runtime CERTAIN-ordering channel: id(Batch) -> (batch,
+        #   keys) for orderings this executor constructed itself
+        #   (grouped output with an exact pack layout, sort output) —
+        #   the only claims Sort/TopN elision may trust without a guard
+        self.sort_stats = sort_stats if sort_stats is not None else {
+            "sorts_taken": 0, "sorts_elided": 0, "sort_memo_hits": 0,
+            "ordering_guard_trips": 0}
+        self._sort_memo: Dict[tuple, tuple] = {}
+        self._perm_memo: Dict[tuple, tuple] = {}
+        self._batch_order: Dict[int, tuple] = {}
         # static mode: expression-level overflow checks (decimal casts)
         # append to the SAME guard list, so a violation aborts the
         # compiled program to the dynamic path, which raises properly
@@ -869,9 +899,14 @@ class Executor:
             flags[id(node)] = flag if prev is None else (prev and flag)
             t = type(node).__name__
             if t == "Aggregate":
+                # an ordering-exploiting aggregate (presorted grouping
+                # hint) WANTS its input order: sort-order-materializing
+                # joins below it would scramble the claimed ordering and
+                # trade the elided grouping sort for a guard trip
                 walk(node.source, not any(
                     a.fn in self._ORDER_SENSITIVE_AGGS
-                    for a in node.aggs.values()))
+                    for a in node.aggs.values())
+                    and getattr(node, "ordering_hint", None) is None)
             elif t in ("Filter", "Project", "Output"):
                 # row-wise: input permutation = same output permutation
                 walk(node.source, flag)
@@ -896,6 +931,123 @@ class Executor:
         oi = getattr(self, "_oi_ids", None)
         return oi is not None and id(node) in oi
 
+    # ---- ordering-aware execution plumbing ---------------------------
+    def _count(self, key: str, n: int = 1) -> None:
+        self.sort_stats[key] = self.sort_stats.get(key, 0) + n
+
+    def _ordering_enabled(self) -> bool:
+        return bool(self.session.properties.get(
+            "ordering_aware_execution", True))
+
+    def _key_fp(self, cols, sel, layout):
+        """(fingerprint, refs) identifying a packed key by the IDENTITY
+        of its source arrays + pack layout — the sort-permutation memo
+        key.  refs must be stored with the memo entry so the
+        fingerprinted objects stay alive (id() reuse would otherwise
+        alias entries).  None fp => not fingerprintable (2-D limbs)."""
+        parts = []
+        refs = [sel]
+        for c in cols:
+            d = c.data
+            if getattr(d, "ndim", 1) != 1:
+                return None, ()
+            parts.append((id(d),
+                          None if c.valid is None else id(c.valid)))
+            refs.append(d)
+            if c.valid is not None:
+                refs.append(c.valid)
+        lay = None if layout is None else tuple(tuple(x) for x in layout)
+        return (tuple(parts), id(sel), lay), tuple(refs)
+
+    def _memo_pair(self, key, fp, refs):
+        """(skey, order) for a packed key, through the memo: the second
+        and later group-bys/joins on the same key ride the cached
+        permutation instead of re-sorting."""
+        if not self._ordering_enabled():
+            fp = None  # kill switch disables the memo too
+        entry = self._sort_memo.get(fp) if fp is not None else None
+        if entry is not None:
+            self._count("sort_memo_hits")
+            self._count("sorts_elided")
+            return entry[1]
+        self._count("sorts_taken")
+        pair = K.sort_pair(key)
+        if fp is not None:
+            self._sort_memo[fp] = (refs, pair)
+        return pair
+
+    def _note_order(self, batch: Batch, keys, tail_ok: bool = True) -> None:
+        """Record a CERTAIN output ordering this executor constructed
+        (sorted over live rows on `keys`: tuple of (symbol, asc)).
+        tail_ok: masked rows are confined to a suffix, so the FULL
+        array (sentinels included) is nondecreasing once packed — what
+        a presorted join build needs; live-row order alone (tail_ok
+        False after a filter) still satisfies Sort/TopN elision."""
+        if keys:
+            self._batch_order[id(batch)] = (batch, tuple(keys), tail_ok)
+
+    def _copy_order(self, src: Batch, dst: Batch, tail_ok=None) -> None:
+        e = self._batch_order.get(id(src))
+        if e is not None and e[0] is src:
+            self._note_order(dst, e[1],
+                             e[2] if tail_ok is None else (e[2] and tail_ok))
+
+    def _order_satisfies(self, b: Batch, want) -> bool:
+        """Does the runtime-certain ordering of `b` satisfy the
+        requested sort keys?  `want`: list of (sym, asc, nulls_first).
+        Requires the request to be a prefix of the known ordering and,
+        because packed orderings place the NULL group first while SQL
+        defaults differ, null-free key columns (valid is None)."""
+        e = self._batch_order.get(id(b))
+        if e is None or e[0] is not b:
+            return False
+        have = e[1]
+        if len(want) > len(have):
+            return False
+        for (sym, asc, _nf), (hsym, hasc) in zip(want, have):
+            if sym != hsym or bool(asc) != bool(hasc):
+                return False
+            col = b.columns.get(sym)
+            if col is None or col.valid is not None:
+                return False
+        return True
+
+    def _build_order_certain(self, node, right: Batch, rkeys) -> bool:
+        """Runtime-certain presorted build: this executor constructed
+        `right` sorted on the join key with masked rows in a suffix
+        (e.g. a grouped output joined on its leading group key)."""
+        if len(node.criteria) != 1 or rkeys[0].valid is not None:
+            return False
+        e = self._batch_order.get(id(right))
+        if e is None or e[0] is not right or not e[2]:
+            return False
+        keys = e[1]
+        rk = node.criteria[0][1]
+        return bool(keys) and keys[0] == (rk, True)
+
+    def _build_presorted(self, node, right: Batch, rkeys) -> bool:
+        if len(node.criteria) != 1:
+            return False
+        return bool(getattr(node, "build_ordering_hint", False)) \
+            or self._build_order_certain(node, right, rkeys)
+
+    @staticmethod
+    def _agg_pack_order(node, group_keys):
+        """Key pack order: a presorted-input hint rotates the sorted
+        key run to the front (most significant — kernels pack
+        first-key-major), so the packed key is monotone whenever the
+        claim + the remaining keys' functional dependence hold; the
+        guard verifies both at once."""
+        order = getattr(node, "ordering_pack_order", None) \
+            if node is not None else None
+        if order is not None and sorted(order) == sorted(group_keys):
+            return list(order)
+        hint = getattr(node, "ordering_hint", None) if node is not None \
+            else None
+        if hint is not None and hint in group_keys:
+            return [hint] + [k for k in group_keys if k != hint]
+        return list(group_keys)
+
     # ------------------------------------------------------------------
     def run(self, plan: P.QueryPlan) -> QueryResult:
         if self.monitor is not None:
@@ -904,6 +1056,8 @@ class Executor:
             batch = self.evaluate(plan)
             return self.materialize(plan, batch)
         finally:
+            if self.monitor is not None:
+                _merge_sort_stats(self.monitor.stats, self.sort_stats)
             if self.mem is not None:
                 if self.monitor is not None:
                     self.monitor.stats.peak_memory_bytes = self.mem.peak
@@ -1056,7 +1210,10 @@ class Executor:
     def _exec_filter(self, node: P.Filter) -> Batch:
         b = self.exec_node(node.source)
         mask = eval_predicate(node.predicate, b, self.ctx)
-        return b.with_sel(b.sel & mask)
+        out = b.with_sel(b.sel & mask)
+        # masking never moves rows, but it punches interior holes
+        self._copy_order(b, out, tail_ok=False)
+        return out
 
     def _exec_project(self, node: P.Project) -> Batch:
         b = self.exec_node(node.source)
@@ -1064,7 +1221,22 @@ class Executor:
         for sym, e in node.assignments.items():
             v = eval_expr(e, b, self.ctx)
             cols[sym] = to_column(v, b.capacity)
-        return Batch(cols, b.sel)
+        out = Batch(cols, b.sel)
+        src_order = self._batch_order.get(id(b))
+        if src_order is not None and src_order[0] is b:
+            # row-wise: certain orderings survive under identity (Ref)
+            # renames up to the first non-Ref key
+            renames = {}
+            for sym, e in node.assignments.items():
+                if isinstance(e, ir.Ref):
+                    renames.setdefault(e.name, sym)
+            mapped = []
+            for sym, asc in src_order[1]:
+                if sym not in renames:
+                    break
+                mapped.append((renames[sym], asc))
+            self._note_order(out, tuple(mapped), tail_ok=src_order[2])
+        return out
 
     # ---- aggregation -------------------------------------------------
     def _exec_aggregate(self, node: P.Aggregate) -> Batch:
@@ -1333,8 +1505,33 @@ class Executor:
         key_cols = [b.columns[k] for k in group_keys]
         if self.static:
             return self._aggregate_static(b, group_keys, key_cols, aggs, node)
-        key, _ = K.pack_keys(key_cols, b.sel)
-        gid, rep_rows, n_groups = K.group_ids(key, b.sel)
+        pack_order = self._agg_pack_order(node, group_keys)
+        pack_cols = [b.columns[k] for k in pack_order]
+        key, layout = K.pack_keys(pack_cols, b.sel)
+        gid = rep_rows = n_groups = None
+        if layout is not None and self._ordering_enabled() \
+                and getattr(node, "ordering_hint", None) == pack_order[0]:
+            # presorted grouping: run-boundary scan, no sort, no
+            # unpermute.  Dynamic mode host-checks the monotonicity
+            # guard (one fetch shared with the group count) and falls
+            # back to the sort path when the ordering claim lied.
+            g2, newgrp, ng_t, guard = K.group_ids_presorted(key, b.sel)
+            guard_h, ng = jax.device_get((guard, ng_t))
+            if not bool(guard_h):
+                n_groups = int(ng)
+                gid = g2
+                rep_rows = K.nonzero_i32(
+                    newgrp, max(n_groups, 1), 0)[:n_groups] \
+                    if n_groups else jnp.zeros((0,), jnp.int32)
+                self._count("sorts_elided", 2)
+            else:
+                self._count("ordering_guard_trips")
+        if gid is None:
+            fp, refs = self._key_fp(pack_cols, b.sel, layout)
+            pair = self._memo_pair(key, fp, refs)
+            self._count("sorts_taken")  # the unpermute co-sort
+            gid, rep_rows, n_groups = K.group_ids(key, b.sel,
+                                                  sorted_pair=pair)
         out_cols: Dict[str, Column] = {}
         raw, _ = K.take_columns({k: b.columns[k] for k in group_keys},
                                 rep_rows)
@@ -1348,7 +1545,14 @@ class Executor:
         if n_groups == 0:
             out_cols = {k: Column(c.data[:0], None if c.valid is None else c.valid[:0],
                                   c.type, c.dictionary) for k, c in out_cols.items()}
-        return Batch(out_cols, sel)
+        out = Batch(out_cols, sel)
+        if layout is not None:
+            # exact packing: group rows emitted ascending on the packed
+            # key = lexicographic on pack_order (certain by construction
+            # — both the sorted and the run-scan path number groups in
+            # ascending key order)
+            self._note_order(out, tuple((k, True) for k in pack_order))
+        return out
 
     # layouts this small use the packed key AS the group id (no sort at
     # all); key columns are reconstructed from slot arithmetic
@@ -1370,21 +1574,43 @@ class Executor:
             else None
         b2 = self._maybe_compact_static(b, est)
         if b2 is not b:
+            # order-preserving compaction (ascending top_k indices):
+            # presorted-input claims survive it
             b = b2
             key_cols = [b.columns[k] for k in group_keys]
             cap = min(cap, b.capacity)
         key_stats = getattr(node, "key_stats", {}) if node is not None else {}
-        layout = K.static_layout(key_cols, [key_stats.get(k) for k in group_keys])
-        key = K.pack_with_layout(key_cols, b.sel, layout)  # None -> hash, sync-free
+        pack_order = self._agg_pack_order(node, group_keys)
+        pack_cols = [b.columns[k] for k in pack_order]
+        layout = K.static_layout(pack_cols, [key_stats.get(k) for k in pack_order])
+        key = K.pack_with_layout(pack_cols, b.sel, layout)  # None -> hash, sync-free
         if layout is not None:
-            self.guards.append(K.layout_range_guard(key_cols, b.sel, layout))
+            self.guards.append(K.layout_range_guard(pack_cols, b.sel, layout))
             total_bits = sum(w for _, _, w in layout)
             if total_bits <= self._DIRECT_GID_BITS and all(
                     not jnp.issubdtype(c.data.dtype, jnp.floating)
-                    for c in key_cols):
+                    for c in pack_cols):
                 return self._aggregate_direct(
-                    b, group_keys, key_cols, aggs, key, layout, total_bits)
-        gid, rep_rows, exists, overflow = K.group_ids_static(key, cap)
+                    b, pack_order, pack_cols, aggs, key, layout, total_bits)
+        if layout is not None and self._ordering_enabled() \
+                and getattr(node, "ordering_hint", None) == pack_order[0] \
+                and getattr(node, "ordering_hint_safe", False):
+            # presorted grouping, compiled mode: the traced monotonicity
+            # guard rides the existing static-guard channel — a wrong
+            # ordering claim re-runs the query on the dynamic path.
+            # SAFE hints only (remaining keys provably constant within
+            # leading runs): a static trip costs the whole program,
+            # where the dynamic path's host check costs one fetch
+            gid, rep_rows, exists, overflow, guard = \
+                K.group_ids_presorted_static(key, cap)
+            self.guards.append(guard)
+            self._count("sorts_elided", 2)
+        else:
+            fp, refs = self._key_fp(pack_cols, b.sel, layout)
+            pair = self._memo_pair(key, fp, refs)
+            self._count("sorts_taken")  # the unpermute co-sort
+            gid, rep_rows, exists, overflow = K.group_ids_static(
+                key, cap, sorted_pair=pair)
         self.guards.append(overflow)
         out_cols: Dict[str, Column] = {}
         raw, _ = K.take_columns({k: b.columns[k] for k in group_keys},
@@ -1397,7 +1623,12 @@ class Executor:
         fused = self._fused_sum_aggs(b, aggs, gid, cap)
         for sym, a in aggs.items():
             out_cols[sym] = fused.get(sym) or self._agg_column(b, a, gid, cap)
-        return Batch(out_cols, exists)
+        out = Batch(out_cols, exists)
+        if layout is not None:
+            # live prefix ascending on the packed key; dead slots carry
+            # sentinels, so downstream full-array monotone guards hold
+            self._note_order(out, tuple((k, True) for k in pack_order))
+        return out
 
     def _aggregate_direct(self, b: Batch, group_keys, key_cols, aggs,
                           key, layout, total_bits: int) -> Batch:
@@ -1425,7 +1656,12 @@ class Executor:
         fused = self._fused_sum_aggs(b, aggs, gid, cap)
         for sym, a in aggs.items():
             out_cols[sym] = fused.get(sym) or self._agg_column(b, a, gid, cap)
-        return Batch(out_cols, exists)
+        out = Batch(out_cols, exists)
+        # slot order IS packed-key order (live slots ascending), but
+        # EMPTY slots sit interspersed: not tail-masked
+        self._note_order(out, tuple((k, True) for k in group_keys),
+                         tail_ok=False)
+        return out
 
     def _fused_sum_aggs(self, b: Batch, aggs: Dict[str, ir.AggCall],
                         gid, n_groups: int) -> Dict[str, Column]:
@@ -2479,7 +2715,13 @@ class Executor:
         if bound > min(b.capacity // 4, 1 << 20):
             return b
         self.guards.append(jnp.sum(b.sel.astype(jnp.int32)) > bound)
-        return _compact_batch(b, bound)
+        out = _compact_batch(b, bound)
+        e = self._batch_order.get(id(b))
+        if e is not None and e[0] is b:
+            # compaction keeps live rows in order AND moves them to a
+            # prefix: certainty upgrades to tail-masked
+            self._note_order(out, e[1], tail_ok=True)
+        return out
 
     def _exec_join(self, node: P.Join) -> Batch:
         from presto_tpu.memory.context import batch_bytes
@@ -2506,7 +2748,11 @@ class Executor:
             holder = [left, right]
             del left, right  # holder owns the refs; grace path frees them
             return self._join_grouped(holder, node)
-        return self._join_batches(left, right, node)
+        out = self._join_batches(left, right, node)
+        if node.join_type in ("SEMI", "ANTI", "MARK"):
+            # probe masked in place: row positions untouched
+            self._copy_order(left, out)
+        return out
 
     def _join_batches(self, left: Batch, right: Batch, node: P.Join) -> Batch:
         jt = node.join_type
@@ -2645,7 +2891,37 @@ class Executor:
             rkey, layout = K.pack_keys(rkeys, rsel, extra_cols=lkeys)
             lkey = K.pack_with_layout(lkeys, lsel, layout)
         if index_ridx is None:
-            order, lb, ub = K.build_probe(rkey, lkey)
+            build_order = None
+            if layout is not None and self._ordering_enabled() \
+                    and self._build_presorted(node, right, rkeys):
+                # presorted build: the packed build key is fully
+                # nondecreasing (sorted input, masked rows — sentinels —
+                # confined to a suffix, e.g. a static aggregate's exists
+                # tail), so the build argsort is the identity.  Certain
+                # (runtime-channel) claims skip the dynamic host check;
+                # static mode guards every claim — a reasoning bug
+                # becomes a dynamic fallback, never wrong matches.
+                certain = self._build_order_certain(node, right, rkeys)
+                if self.static:
+                    self.guards.append(K.monotone_guard(rkey))
+                    build_order = jnp.arange(rkey.shape[0],
+                                             dtype=jnp.int32)
+                    self._count("sorts_elided")
+                elif certain or not bool(K.monotone_guard(rkey)):
+                    build_order = jnp.arange(rkey.shape[0],
+                                             dtype=jnp.int32)
+                    self._count("sorts_elided")
+                else:
+                    self._count("ordering_guard_trips")
+            if build_order is None:
+                # fingerprint over the COMPONENTS of rsel (base sel +
+                # key validities, already in fp) so the two probes of a
+                # shared build subtree hash alike
+                fp, refs = self._key_fp(rkeys, right.sel, layout)
+                build_order = self._memo_pair(rkey, fp, refs)[1]
+            order, lb, ub = K.build_probe(rkey, lkey,
+                                          build_order=build_order)
+            self._count("sorts_taken", 2)  # composite sort + co-sort home
             counts = ub - lb
 
         if jt == "MARK":  # filter-free by construction (planner)
@@ -2925,11 +3201,39 @@ class Executor:
         return out
 
     # ---- sort / limit -------------------------------------------------
+    def _sort_perm(self, b: Batch, key_spec) -> jnp.ndarray:
+        """sort_perm through the permutation memo: an identical sort of
+        the same batch (same key columns, same sel, same directions)
+        replays the cached permutation."""
+        keys = [(b.columns[s], asc, nf) for s, asc, nf in key_spec]
+        fp, refs = self._key_fp([c for c, _, _ in keys], b.sel,
+                                [("sort", s, bool(asc), nf)
+                                 for s, asc, nf in key_spec])
+        if not self._ordering_enabled():
+            fp = None
+        entry = self._perm_memo.get(fp) if fp is not None else None
+        if entry is not None:
+            self._count("sort_memo_hits")
+            self._count("sorts_elided")
+            return entry[1]
+        self._count("sorts_taken")
+        perm = K.sort_perm(b, keys)
+        if fp is not None:
+            self._perm_memo[fp] = (refs, perm)
+        return perm
+
     def _exec_sort(self, node: P.Sort) -> Batch:
         b = self.exec_node(node.source)
-        keys = [(b.columns[s], asc, nf) for s, asc, nf in node.keys]
-        perm = K.sort_perm(b, keys)
-        return K.gather_batch(b, perm)
+        if self._ordering_enabled() and self._order_satisfies(b, node.keys):
+            # input provably sorted (runtime-certain channel: grouped /
+            # sorted output upstream): the Sort node is a no-op — live
+            # rows already surface in order, masked rows stay hidden
+            self._count("sorts_elided")
+            return b
+        perm = self._sort_perm(b, node.keys)
+        out = K.gather_batch(b, perm)
+        self._note_order(out, tuple((s, asc) for s, asc, _nf in node.keys))
+        return out
 
     def _exec_topn(self, node: P.TopN) -> Batch:
         """TopN = key-only sort + k-row gather (reference: TopNOperator's
@@ -2937,20 +3241,33 @@ class Executor:
         full-capacity gather of EVERY output column to keep k rows —
         ~half of Q3's single-chip wall time at 6M capacity."""
         b = self.exec_node(node.source)
+        if self._ordering_enabled() and self._order_satisfies(b, node.keys):
+            # already ordered: TopN degenerates to LIMIT (rank mask)
+            self._count("sorts_elided")
+            out = self._limit(b, node.count)
+            self._copy_order(b, out)
+            return out
         k = min(int(node.count), b.capacity)
-        keys = [(b.columns[s], asc, nf) for s, asc, nf in node.keys]
-        perm = K.sort_perm(b, keys)  # masked rows sort last
+        perm = self._sort_perm(b, node.keys)  # masked rows sort last
+        sorted_keys = tuple((s, asc) for s, asc, _nf in node.keys)
         if k == b.capacity:  # LIMIT >= capacity: plain sort
-            return K.gather_batch(b, perm)
+            out = K.gather_batch(b, perm)
+            self._note_order(out, sorted_keys)
+            return out
         idx = perm[:k]
         out = K.gather_batch(b, idx)
         live_total = jnp.sum(jnp.asarray(b.sel).astype(jnp.int32)) \
             if b.capacity else jnp.int32(0)
         sel = jnp.arange(k, dtype=jnp.int32) < live_total
-        return Batch(out.columns, out.sel & sel)
+        out = Batch(out.columns, out.sel & sel)
+        self._note_order(out, sorted_keys)
+        return out
 
     def _exec_limit(self, node: P.Limit) -> Batch:
-        return self._limit(self.exec_node(node.source), node.count)
+        b = self.exec_node(node.source)
+        out = self._limit(b, node.count)
+        self._copy_order(b, out)  # rank mask: rows never move
+        return out
 
     def _limit(self, b: Batch, n: int) -> Batch:
         # int32 rank: capacity < 2^31, and i64 cumsum runs emulated on TPU;
@@ -3182,7 +3499,15 @@ def scan_batch(table, node: P.TableScan, f32: bool = False) -> Batch:
         c = cache_for(col)[col]
         cols[sym] = Column(c.data, c.valid, node.types[sym], c.dictionary)
         n = c.data.shape[0]
-    return Batch(cols, jnp.ones((n or 0,), bool))
+    # ONE shared all-live sel per (table, capacity): scans of the same
+    # table hand out identical (data, sel) array objects, which is what
+    # lets the executor's sort-permutation memo fingerprint two scans of
+    # the same key column as the same sort
+    sel_key = ("__sel__", n or 0)
+    sel = base.get(sel_key)
+    if sel is None:
+        sel = base[sel_key] = jnp.ones((n or 0,), bool)
+    return Batch(cols, sel)
 
 
 def _merge_range(a, b):

@@ -84,15 +84,25 @@ def pack_keys(cols: List[Column], sel, extra_cols: Optional[List[Column]] = None
     if total_bits > 62:
         return _hash_keys(cols, sel), None
 
-    layout = []
-    stride = 1
-    for lo_h, card in parts:
-        width = int(np.ceil(np.log2(max(card, 2))))
-        layout.append((lo_h, stride, width))
-        stride <<= width
-    key = _apply_layout(cols, layout)
+    key = _apply_layout(cols, (layout := _assign_strides(parts)))
     key = jnp.where(sel, key, key_sentinel(key))
     return key, layout
+
+
+def _assign_strides(parts) -> list:
+    """(lo, card) per column -> (lo, stride, width) with the FIRST column
+    most significant: ascending packed-key order == lexicographic order
+    of the columns as listed.  This is what makes grouped output sorted
+    on its group keys (the ordering-properties framework's producer
+    side) at zero cost — stride assignment order is free."""
+    widths = [int(np.ceil(np.log2(max(card, 2)))) for _, card in parts]
+    layout = []
+    stride = 1
+    for (lo_h, _card), width in zip(reversed(parts), reversed(widths)):
+        layout.append((lo_h, stride, width))
+        stride <<= width
+    layout.reverse()
+    return layout
 
 
 def _apply_layout(cols: List[Column], layout) -> jnp.ndarray:
@@ -254,13 +264,7 @@ def static_layout(cols: List[Column], stats_list) -> Optional[list]:
     total_bits = sum(int(np.ceil(np.log2(max(card, 2)))) for _, card in parts)
     if total_bits > 62:
         return None
-    layout = []
-    stride = 1
-    for lo, card in parts:
-        width = int(np.ceil(np.log2(max(card, 2))))
-        layout.append((lo, stride, width))
-        stride <<= width
-    return layout
+    return _assign_strides(parts)
 
 
 def layout_range_guard(cols: List[Column], sel, layout) -> jnp.ndarray:
@@ -313,14 +317,94 @@ def unpermute(order: jnp.ndarray, *payloads):
     return out[0] if len(out) == 1 else out
 
 
-def group_ids_static(key: jnp.ndarray, cap: int):
+def sort_pair(key: jnp.ndarray):
+    """(sorted key, permutation) — THE routed entry point for key sorts,
+    so the executor's sort-permutation memo can cache and replay the
+    permutation for every later grouping/join on the same key."""
+    n = key.shape[0]
+    return jax.lax.sort((key, jnp.arange(n, dtype=jnp.int32)), num_keys=1)
+
+
+def monotone_guard(key: jnp.ndarray) -> jnp.ndarray:
+    """True if `key` is NOT nondecreasing end to end (the traced
+    ordering-claim verifier for presorted JOIN builds, where sentinels
+    must already sit in a suffix — same pattern as layout_range_guard:
+    a tripped guard sends the compiled program to the dynamic path)."""
+    if key.shape[0] < 2:
+        return jnp.zeros((), bool)
+    return jnp.any(key[1:] < key[:-1])
+
+
+def _live_runs(key: jnp.ndarray):
+    """Run-boundary scan over a key whose LIVE subsequence is claimed
+    nondecreasing (masked rows carry key_sentinel and may be anywhere).
+    Returns (live, newgrp, guard): newgrp marks each live row starting a
+    new key run; guard is True when the claim is violated.  The
+    previous-live-key at row i is the running max of live keys before i
+    — exact under the claim, and any violation (a live key below that
+    max) trips the guard, so a wrong claim can never mis-group."""
+    n = key.shape[0]
+    live = key != key_sentinel(key)
+    if n == 0:
+        z = jnp.zeros((0,), bool)
+        return z, z, jnp.zeros((), bool)
+    # packed keys are nonnegative (codes >= 0 per field), so -1 is a
+    # safe "no previous live row" floor
+    floor = jnp.where(live, key, jnp.full((), -1, key.dtype))
+    prev = jnp.concatenate([jnp.full((1,), -1, key.dtype),
+                            jax.lax.cummax(floor)[:-1]])
+    guard = jnp.any(live & (key < prev))
+    newgrp = live & (key != prev)
+    return live, newgrp, guard
+
+
+def group_ids_presorted(key: jnp.ndarray, sel):
+    """Sort-free grouping for a key already nondecreasing over its live
+    rows (scan order from an ordering-declaring connector, or a
+    prior grouped output): ONE run-boundary scan replaces the grouping
+    sort AND the unpermute co-sort.  Returns (gid, newgrp, n_groups_t,
+    guard) with gid semantics identical to group_ids — groups numbered
+    in ascending key order; representatives are the first row of each
+    run, recoverable as nonzero_i32(newgrp, ...) once the caller has
+    host-synced n_groups_t (together with the guard, in ONE fetch).
+    guard True => the ordering claim lied and the results are garbage;
+    callers MUST fall back to group_ids."""
+    live, newgrp, guard = _live_runs(key)
+    n = key.shape[0]
+    n_groups_t = jnp.sum(newgrp.astype(jnp.int32))
+    gid = jnp.cumsum(newgrp.astype(jnp.int32)) - 1 if n else \
+        jnp.zeros((0,), jnp.int32)
+    gid = jnp.where(live, gid, n_groups_t)
+    return gid, newgrp, n_groups_t, guard
+
+
+def group_ids_presorted_static(key: jnp.ndarray, cap: int):
+    """Static-capacity twin of group_ids_presorted: returns (gid,
+    rep_rows[cap], exists[cap], overflow, guard) matching the
+    group_ids_static contract, with guard riding the executor's existing
+    static-guard channel (trip => whole-query dynamic fallback)."""
+    live, newgrp, guard = _live_runs(key)
+    n = key.shape[0]
+    n_groups = jnp.sum(newgrp.astype(jnp.int32))
+    if n == 0:
+        gid = jnp.zeros((0,), jnp.int32)
+        rep_rows = jnp.zeros((cap,), jnp.int32)
+    else:
+        gid = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
+        gid = jnp.where(live & (gid < cap), gid, cap)
+        rep_rows = nonzero_i32(newgrp, cap, 0)
+    exists = jnp.arange(cap) < n_groups
+    return gid, rep_rows, exists, n_groups > cap, guard
+
+
+def group_ids_static(key: jnp.ndarray, cap: int, sorted_pair=None):
     """Static-shape grouping: same sort-based scheme as group_ids but with
     a fixed group capacity.  Returns (gid, rep_rows[cap], exists[cap],
     overflow) — overflow True means cap was too small (caller re-runs in
-    dynamic mode; the guard is checked once per query, not per op)."""
+    dynamic mode; the guard is checked once per query, not per op).
+    `sorted_pair` replays a memoized (skey, order) for this exact key."""
     n = key.shape[0]
-    skey, order = jax.lax.sort(
-        (key, jnp.arange(n, dtype=jnp.int32)), num_keys=1)
+    skey, order = sorted_pair if sorted_pair is not None else sort_pair(key)
     newgrp = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
     live_sorted = skey != key_sentinel(key)
     newgrp = newgrp & live_sorted
@@ -337,13 +421,15 @@ def group_ids_static(key: jnp.ndarray, cap: int):
     return gid, rep_rows, exists, n_groups > cap
 
 
-def group_ids(key: jnp.ndarray, sel) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+def group_ids(key: jnp.ndarray, sel,
+              sorted_pair=None) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
     """Sort-based grouping. Returns (gid[n] in [0, n_groups) for live rows,
     representative row index per group [n_groups], n_groups).
-    Masked rows get gid = n_groups (callers drop them via segment bounds)."""
+    Masked rows get gid = n_groups (callers drop them via segment bounds).
+    `sorted_pair` replays a memoized (skey, order) for this exact key."""
     n = key.shape[0]
-    skey, order = jax.lax.sort(  # masked rows sort last
-        (key, jnp.arange(n, dtype=jnp.int32)), num_keys=1)
+    skey, order = sorted_pair if sorted_pair is not None \
+        else sort_pair(key)  # masked rows sort last
     newgrp = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
     live_sorted = skey != key_sentinel(key)
     newgrp = newgrp & live_sorted
@@ -510,7 +596,8 @@ def group_percentile(x: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
     return vals, cnt > 0
 
 
-def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray):
+def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray,
+                build_order=None):
     """Sort build side; position every probe key among the build keys.
     Returns (order, lb, ub): build_key[order] sorted; matches for probe row
     i are order[lb[i]:ub[i]].
@@ -519,10 +606,17 @@ def build_probe(build_key: jnp.ndarray, probe_key: jnp.ndarray):
     searchsorted(method='sort') calls: each of those hides a full-size
     permutation SCATTER, which serializes on TPU (~600ms per 7M rows,
     measured) — the scan+gather formulation costs three sorts and no
-    scatter, ~3x faster end-to-end on the join-heavy TPC-H queries."""
+    scatter, ~3x faster end-to-end on the join-heavy TPC-H queries.
+
+    `build_order` elides the build argsort (1 of the 3 sorts): a
+    memoized permutation of this exact key, or an identity arange when
+    the build side is already fully nondecreasing (sentinels in a
+    suffix — callers verify via monotone_guard; equal-key order within
+    a run is free, matches are consumed as a set)."""
     nb = build_key.shape[0]
     npr = probe_key.shape[0]
-    order = jnp.argsort(build_key).astype(jnp.int32)
+    order = build_order if build_order is not None \
+        else sort_pair(build_key)[1]
     n = nb + npr
     allk = jnp.concatenate([build_key, probe_key])
     flag = jnp.concatenate([jnp.zeros((nb,), jnp.int32),
@@ -982,6 +1076,24 @@ def _sort_operand_native(col: Column) -> jnp.ndarray:
     if d.dtype in (jnp.int32, jnp.int16, jnp.int8):
         return d.astype(jnp.int32)
     return d.astype(jnp.int64)
+
+
+def argsort_stable(key: jnp.ndarray) -> jnp.ndarray:
+    """Stable argsort (equal keys keep input order) — routed entry point
+    for the exchange layer's destination-bucket ordering."""
+    return jnp.argsort(key, stable=True)
+
+
+def lexsort_pair(minor: jnp.ndarray, major: jnp.ndarray) -> jnp.ndarray:
+    """Permutation sorting by (major, then minor) — routed entry point
+    (jnp.lexsort order convention: last key is primary)."""
+    return jnp.lexsort((minor, major))
+
+
+def sort_values(x: jnp.ndarray) -> jnp.ndarray:
+    """Ascending value sort — routed entry point for splitter sampling
+    in the range exchange."""
+    return jnp.sort(x)
 
 
 # ---------------------------------------------------------------------------

@@ -50,6 +50,18 @@ class QueryStats:
     spilled_bytes: int = 0
     spilled_partitions: int = 0
     recovered_buckets: int = 0  # grouped-execution buckets loaded from ckpt
+    # sort economics (ordering-aware execution, plan/properties.py):
+    # sorts the executor routed (taken) vs avoided (elided: presorted
+    # kernel variants, memo replays, satisfied ORDER BYs), memo replays
+    # specifically, and ordering-claim guard trips (each one a
+    # fell-back-to-the-sort-path event — correctness kept, sort paid).
+    # Compiled/chunked modes count TRACE-TIME routing decisions (the
+    # program runs the same ops every call); dynamic mode counts per
+    # execution.
+    sorts_taken: int = 0
+    sorts_elided: int = 0
+    sort_memo_hits: int = 0
+    ordering_guard_trips: int = 0
     # cluster-mode recovery counters (parallel/retry.RunContext.count):
     # http_retries, pages_retried, workers_quarantined, workers_readmitted,
     # hedges_launched, hedges_won, task_cancels, query_retries,

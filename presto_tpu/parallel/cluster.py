@@ -148,6 +148,30 @@ def _is_loopback(host: str) -> bool:
 # wire helpers: (data, valid) column pairs <-> PTPG frames
 # ---------------------------------------------------------------------------
 
+# page encodings a producer DECLARES at publish time and the server
+# echoes back as the X-Page-Encoding header.  Integrity verification on
+# receipt is gated on this declaration — NOT on sniffing the PTPG magic,
+# which silently waved through corrupt non-PTPG (JSON range-sample)
+# pages and corrupt PTPG pages whose first bytes were damaged.
+PAGE_ENC_PTPG = "ptpg"   # native frame: verified via pserde.frame_ok
+PAGE_ENC_JSON = "json"   # tagged JSON (range samples): must parse
+PAGE_ENC_HEADER = "X-Page-Encoding"
+
+
+def _page_ok(body: bytes, enc: str) -> bool:
+    """Receipt-time integrity check by DECLARED encoding; an empty
+    declaration (pre-encoding producer) falls back to the magic sniff
+    for compatibility."""
+    if enc == PAGE_ENC_PTPG:
+        return pserde.frame_ok(body)
+    if enc == PAGE_ENC_JSON:
+        try:
+            json.loads(body.decode("utf-8"))
+            return True
+        except (UnicodeDecodeError, ValueError):
+            return False
+    return body[:4] != pserde.MAGIC or pserde.frame_ok(body)
+
 
 def pack_columns(cols: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]
                  ) -> bytes:
@@ -478,10 +502,10 @@ def _probe(url: str, ctx: Optional[R.RunContext] = None) -> None:
 
 
 def _get_page(url: str, task_id: str, bucket: int, token: int,
-              ctx: R.RunContext) -> Tuple[int, bytes, bool]:
-    """One results GET -> (status, body, X-Complete).  Goes around _http
-    because the caller needs the status/header, but hits the same fault
-    choke point and signs the same way."""
+              ctx: R.RunContext) -> Tuple[int, bytes, bool, str]:
+    """One results GET -> (status, body, X-Complete, declared encoding).
+    Goes around _http because the caller needs the status/headers, but
+    hits the same fault choke point and signs the same way."""
     path = f"/v1/task/{task_id}/results/{bucket}/{token}"
     F.apply_client("GET", path)
     req = _signed_request("GET", url + path)
@@ -490,6 +514,7 @@ def _get_page(url: str, task_id: str, bucket: int, token: int,
         status = r.status
         body = r.read()
         complete = r.headers.get("X-Complete") == "1"
+        enc = r.headers.get(PAGE_ENC_HEADER, "")
     if status == 200 and body:
         # the PAGE pseudo-method counts DELIVERED pages only, so a
         # partial-transfer rule's nth is deterministic (503 polls and
@@ -497,7 +522,7 @@ def _get_page(url: str, task_id: str, bucket: int, token: int,
         prule = F.client_plan().match("client", "PAGE", path)
         if prule is not None and prule.action == "partial":
             body = F.corrupt_page(body)
-    return status, body, complete
+    return status, body, complete, enc
 
 
 def pull_pages(url: str, task_id: str, bucket: int,
@@ -531,15 +556,18 @@ def pull_pages(url: str, task_id: str, bucket: int,
         if slot is not None:
             url, task_id = slot[0], slot[1]
         try:
-            status, body, complete = _get_page(url, task_id, bucket,
-                                               token, ctx)
+            status, body, complete, enc = _get_page(url, task_id, bucket,
+                                                    token, ctx)
             if status == 204:  # producer complete, no more pages
                 return pages
             if status == 200:
-                # integrity check for PTPG-framed pages (range-sample
-                # pages are tagged JSON and pass through): a corrupt /
-                # truncated transfer is re-requested by token
-                if body[:4] == pserde.MAGIC and not pserde.frame_ok(body):
+                # integrity check gated on the DECLARED page encoding
+                # (X-Page-Encoding): PTPG frames verify magic+xxh64,
+                # JSON (range-sample) pages must parse — a corrupt /
+                # truncated transfer of EITHER kind is re-requested by
+                # token instead of sniffing the magic and waving
+                # non-PTPG bodies through unverified
+                if not _page_ok(body, enc):
                     ctx.count("pages_retried", url=url, token=token)
                     backoff.sleep(local)
                     continue
@@ -624,7 +652,11 @@ class _ClusterExecutor:
                  task_state=None):
         self.session = session
         self.spec = spec
-        self.publish = publish or (lambda bucket, page: None)
+        # publish(bucket, page, enc=...): the producer DECLARES each
+        # page's encoding so receipt-time verification never has to
+        # sniff bytes (see _page_ok)
+        self.publish = publish or (lambda bucket, page, enc=PAGE_ENC_PTPG:
+                                   None)
         self.task_state = task_state or {}
 
     def _exchange_batches(self):
@@ -809,7 +841,8 @@ class _ClusterExecutor:
         data, valid = cols[key_sym]
         live = np.ones(len(data), dtype=bool) if valid is None else valid
         sample_vals = data[live][:: max(1, int(np.sum(live)) // 256)][:256]
-        self.publish(nb, plan_serde.dumps(sample_vals.tolist()))
+        self.publish(nb, plan_serde.dumps(sample_vals.tolist()),
+                     enc=PAGE_ENC_JSON)
         if not self.task_state.get("range_event", threading.Event()) \
                 .wait(timeout=R.RANGE_TIMEOUT_S):
             raise TimeoutError("range boundaries never arrived")
@@ -954,9 +987,9 @@ class WorkerServer:
         attempt_dir = os.path.join(key_dir, f"a{spec.attempt}") \
             if key_dir else None
 
-        def publish(bucket: int, page: bytes):
+        def publish(bucket: int, page: bytes, enc: str = PAGE_ENC_PTPG):
             with self.lock:
-                task["pages"].setdefault(bucket, []).append(page)
+                task["pages"].setdefault(bucket, []).append((page, enc))
                 seq = len(task["pages"][bucket]) - 1
                 self.counters["buffered_bytes"] += len(page)
                 self.counters["peak_buffered_bytes"] = max(
@@ -964,13 +997,15 @@ class WorkerServer:
                     self.counters["buffered_bytes"])
             if attempt_dir is not None:
                 # durable copy survives acks and task DELETE; tmp+rename
-                # so a torn write never reads as a page
+                # so a torn write never reads as a page; the declared
+                # encoding rides in the file name
                 bdir = os.path.join(attempt_dir, f"b{bucket}")
                 os.makedirs(bdir, exist_ok=True)
                 tmp = os.path.join(bdir, f".tmp{seq}")
                 with open(tmp, "wb") as f:
                     f.write(page)
-                os.replace(tmp, os.path.join(bdir, f"{seq:06d}.page"))
+                os.replace(tmp,
+                           os.path.join(bdir, f"{seq:06d}.{enc}.page"))
 
         def replay_dir():
             """A prior attempt's completed durable output, or None."""
@@ -995,9 +1030,12 @@ class WorkerServer:
                                 with open(os.path.join(bdir, pf),
                                           "rb") as f:
                                     page = f.read()
+                                parts = pf.split(".")
+                                enc = parts[1] if len(parts) == 3 \
+                                    else PAGE_ENC_PTPG
                                 with self.lock:
                                     task["pages"].setdefault(
-                                        int(b[1:]), []).append(page)
+                                        int(b[1:]), []).append((page, enc))
                                     self.counters["buffered_bytes"] += \
                                         len(page)
                                     self.counters["peak_buffered_bytes"] = \
@@ -1179,7 +1217,7 @@ def _make_worker_handler(server: WorkerServer):
                             for i in range(min(token, len(pages))):
                                 if pages[i] is not None:
                                     server.counters["buffered_bytes"] -= \
-                                        len(pages[i])
+                                        len(pages[i][0])
                                 pages[i] = None  # release acked pages
                         self._send(200, b"{}", "application/json")
                         return
@@ -1187,6 +1225,7 @@ def _make_worker_handler(server: WorkerServer):
                     # consumer must not stall every other request on
                     # this worker (multi-MB page writes take a while)
                     kind, page, last, err = "wait", None, False, b""
+                    enc = PAGE_ENC_PTPG
                     with server.lock:
                         if task["state"] == "FAILED":
                             kind = "failed"
@@ -1195,7 +1234,9 @@ def _make_worker_handler(server: WorkerServer):
                             pages = task["pages"].get(bucket, [])
                             complete = task["complete"]
                             if token < len(pages):
-                                page = pages[token]
+                                entry = pages[token]
+                                page, enc = entry if entry is not None \
+                                    else (None, PAGE_ENC_PTPG)
                                 if page is None:
                                     # acked page re-requested (consumer
                                     # restarted): at-least-once means a
@@ -1220,6 +1261,7 @@ def _make_worker_handler(server: WorkerServer):
                                          "application/octet-stream")
                         self.send_header("Content-Length", str(len(page)))
                         self.send_header("X-Complete", "1" if last else "0")
+                        self.send_header(PAGE_ENC_HEADER, enc)
                         self.end_headers()
                         self.wfile.write(page)
                     elif kind == "done":
@@ -1241,7 +1283,7 @@ def _make_worker_handler(server: WorkerServer):
                     gone = server.tasks.pop(parts[2], None)
                     if gone:
                         server.counters["buffered_bytes"] -= sum(
-                            len(p) for ps in gone["pages"].values()
+                            len(p[0]) for ps in gone["pages"].values()
                             for p in ps if p is not None)
                 self._send(200, b"{}", "application/json")
             else:
@@ -1754,8 +1796,8 @@ class ClusterSession:
         # blocking) until upstream production drains
         pages: Dict[int, List[bytes]] = {}
         _ClusterExecutor(self.session, coordinator_spec,
-                         publish=lambda b, p: pages.setdefault(
-                             b, []).append(p)).run()
+                         publish=lambda b, p, enc=PAGE_ENC_PTPG:
+                         pages.setdefault(b, []).append(p)).run()
         merged = [unpack_columns(p) for p in pages.get(0, [])]
         # single final page expected (gather output); concat defensively
         if len(merged) == 1:

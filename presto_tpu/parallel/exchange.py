@@ -105,9 +105,9 @@ def _exchange_by_dest(b: Batch, dest: jnp.ndarray, ndev: int, axis: str,
     c_cap = max(int(np.ceil(slack * n / ndev)), 1)
     dest = jnp.where(b.sel, dest, ndev)  # dead rows sort last
     if order_key is None:
-        order = jnp.argsort(dest, stable=True)
+        order = K.argsort_stable(dest)
     else:
-        order = jnp.lexsort((order_key, dest))
+        order = K.lexsort_pair(order_key, dest)
     sdest = dest[order]
     # position of each row within its destination bucket
     first = jnp.searchsorted(sdest, jnp.arange(ndev + 1, dtype=sdest.dtype))
@@ -179,10 +179,10 @@ def range_partition_batch(b: Batch, sort_keys, ndev: int, axis: str,
     n = b.capacity
     # evenly-spaced sample of the locally-sorted keys (dead rows last)
     big = jnp.iinfo(jnp.int64).max
-    local_sorted = jnp.sort(jnp.where(b.sel, key, big))
+    local_sorted = K.sort_values(jnp.where(b.sel, key, big))
     pos = jnp.linspace(0, n - 1, samples_per_shard).astype(jnp.int32)
     sample = local_sorted[pos]
-    all_samples = jnp.sort(jax.lax.all_gather(sample, axis, tiled=True))
+    all_samples = K.sort_values(jax.lax.all_gather(sample, axis, tiled=True))
     total = ndev * samples_per_shard
     cut = (jnp.arange(1, ndev) * total) // ndev
     splitters = all_samples[cut]

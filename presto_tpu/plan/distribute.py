@@ -450,8 +450,17 @@ class Distributer:
             lkeys0 = [lk for lk, _ in node.criteria]
             rkeys0 = [rk for _, rk in node.criteria]
             if not self._colocated(ldist, rdist, node.criteria):
-                node.left = P.Exchange(left, "repartition", lkeys0)
-                node.right = P.Exchange(right, "repartition", rkeys0)
+                # a replicated side must be scattered before the
+                # repartition or every shard contributes a duplicate
+                # copy of each row to the exchange (same rule as the
+                # INNER repartition path below; exposed by q51's FULL
+                # join over a gathered CTE)
+                lsrc = P.Exchange(left, "scatter") \
+                    if ldist.kind == "replicated" else left
+                rsrc = P.Exchange(right, "scatter") \
+                    if rdist.kind == "replicated" else right
+                node.left = P.Exchange(lsrc, "repartition", lkeys0)
+                node.right = P.Exchange(rsrc, "repartition", rkeys0)
             # output is NOT hashed on the keys: NULL-extended rows land
             # on shards by the OTHER side's hash, so the NULL key group
             # is scattered — downstream consumers must re-exchange

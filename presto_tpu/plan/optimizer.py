@@ -42,6 +42,13 @@ def optimize(plan: P.QueryPlan, session) -> P.QueryPlan:
             changed |= _prune_fd_group_keys(sub, set())
         if changed:
             annotate_static_hints(out, session)
+    if session.properties.get("ordering_aware_execution", True):
+        # ordering-properties hints (plan/properties.py): advisory,
+        # guard-verified at every exploitation site.  Runs LAST so the
+        # hints see the final key lists (fd-pruning may drop keys).
+        from presto_tpu.plan import properties as OP
+
+        OP.annotate(out, session)
     return out
 
 

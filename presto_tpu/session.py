@@ -36,6 +36,11 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "fault_injection_fail_after_buckets": 0,
     # fuse sum-shaped aggregates into one Pallas pass (kernels.fused_group_sums)
     "pallas_fused_agg": True,
+    # ordering-aware execution (plan/properties.py): exploit connector-
+    # declared / operator-derived sort orders via presorted kernel
+    # variants, the sort-permutation memo, and ORDER BY elision — all
+    # behind runtime monotonicity guards.  Kill switch for A/B runs.
+    "ordering_aware_execution": True,
     # execute DOUBLE expressions in float32 on device (cross-block
     # aggregate merges stay f64).  Default off: exact f64 semantics.  On
     # TPU, f64 is software-emulated (~10-20x per op), so benchmarks turn

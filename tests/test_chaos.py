@@ -184,6 +184,30 @@ def test_partial_page_reverified_and_repulled(chaos):
         _reset(session, cs, workers)
 
 
+def test_corrupt_json_range_sample_page_reverified(chaos):
+    """ISSUE-3 satellite: page verification is gated on the DECLARED
+    page encoding (X-Page-Encoding), not the PTPG magic sniff — a
+    corrupted JSON range-sample page (which has no magic and used to
+    pass through unverified, poisoning the splitter computation) now
+    fails the parse check on receipt and is re-requested by token."""
+    session, cs, workers, want = chaos
+    q = ("SELECT c_custkey, c_acctbal FROM customer "
+         "ORDER BY c_acctbal DESC, c_custkey")
+    session.properties["distributed_sort_threshold_rows"] = 100
+    # bucket 2 (= out_buckets with 2 workers) is the range side channel
+    # carrying the JSON key sample; corrupt the first delivered copy
+    F.install(F.FaultPlan.parse("client:PAGE:/results/2/:1:partial"))
+    try:
+        want_sorted = norm(session.sql(q).rows)
+        assert norm(cs.sql(q).rows) == want_sorted
+        rec = session.last_stats.recovery
+        assert rec.get("pages_retried", 0) >= 1, rec
+        assert "query_retries" not in rec, rec
+    finally:
+        session.properties.pop("distributed_sort_threshold_rows", None)
+        _reset(session, cs, workers)
+
+
 def test_connection_reset_absorbed_while_worker_healthy(chaos):
     """A scripted connection reset is absorbed by the poll loop: the
     circuit breaker probes the worker, finds it healthy, and the pull

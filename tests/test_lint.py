@@ -18,6 +18,51 @@ def test_lint_clean():
     assert r.returncode == 0, f"lint findings:\n{r.stdout}"
 
 
+def test_no_raw_device_sorts_outside_kernels():
+    """Ordering-aware execution gate (ISSUE 3): every DEVICE sort must
+    go through the routed entry points in exec/kernels.py (sort_pair /
+    group_ids* / build_probe / sort_perm / argsort_stable / ...) or the
+    staging sorts in exec/gather.py — those are the sites the
+    executor's sort-permutation memo and the sorts_taken/sorts_elided
+    accounting can see.  A raw jax.lax.sort / jnp.sort / jnp.argsort /
+    jnp.lexsort anywhere else is an unrouted, unaccounted sort.  Host
+    numpy sorts (np.sort over already-fetched data) are fine."""
+    import ast
+
+    ALLOWED = {os.path.join("exec", "kernels.py"),
+               os.path.join("exec", "gather.py")}
+    # device-array namespaces as imported across the engine
+    DEVICE_NS = {"jnp", "lax"}
+    FORBIDDEN_ATTRS = {"sort", "argsort", "lexsort", "sort_key_val"}
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg)
+            if rel in ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in FORBIDDEN_ATTRS):
+                    continue
+                base = node.func.value
+                # jnp.sort(...) / lax.sort(...) / jax.lax.sort(...)
+                name = base.id if isinstance(base, ast.Name) else (
+                    base.attr if isinstance(base, ast.Attribute)
+                    else None)
+                if name in DEVICE_NS:
+                    bad.append(f"{rel}:{node.lineno}: "
+                               f"{name}.{node.func.attr}() — route "
+                               "through exec/kernels.py")
+    assert not bad, "\n".join(bad)
+
+
 def test_no_raw_sleeps_or_timeouts_in_parallel():
     """Robustness gate (ISSUE 2): presto_tpu/parallel/retry.py is the
     ONLY module in the parallel package allowed to call `time.sleep` or

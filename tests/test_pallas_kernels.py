@@ -368,6 +368,13 @@ def test_expanding_join_sort_order_materialization(tiny_gather):
         ex.guards = []
         ex.monitor = None
         ex.mem = None
+        # ordering-aware execution state (a bare harness Executor skips
+        # __init__; mirror its round-8 fields)
+        ex.session = type("S", (), {"properties": {}})()
+        ex.sort_stats = {}
+        ex._sort_memo = {}
+        ex._perm_memo = {}
+        ex._batch_order = {}
         from presto_tpu.exec.executor import EvalContext
 
         ex.ctx = EvalContext()

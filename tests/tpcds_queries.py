@@ -3012,3 +3012,86 @@ ORDER BY reason_d, avg_q, avg_c, avg_f
 LIMIT 100
 """,
 })
+
+
+# the host sqlite (3.34) predates FULL OUTER JOIN support (added in
+# 3.39): the oracle for q51/q97 emulates it as LEFT JOIN ++ build-side
+# anti rows.  Sound here because the anti probe keys are never NULL
+# (generator sks >= 1 and each CTE groups on them), so "no match" is
+# exactly "left key IS NULL after LEFT JOIN".
+SQLITE_OVERRIDES[51] = """
+WITH web_v1 AS
+ (SELECT ws_item_sk AS item_sk, d_date,
+         sum(sum(ws_sales_price)) OVER
+             (PARTITION BY ws_item_sk ORDER BY d_date
+              ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+             AS cume_sales
+  FROM web_sales, date_dim
+  WHERE ws_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY ws_item_sk, d_date),
+ store_v1 AS
+ (SELECT ss_item_sk AS item_sk, d_date,
+         sum(sum(ss_sales_price)) OVER
+             (PARTITION BY ss_item_sk ORDER BY d_date
+              ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+             AS cume_sales
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY ss_item_sk, d_date)
+SELECT item_sk, d_date, web_sales, store_sales, web_cumulative,
+       store_cumulative
+FROM (SELECT item_sk, d_date, web_sales, store_sales,
+             max(web_sales) OVER
+                 (PARTITION BY item_sk ORDER BY d_date
+                  ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+                 AS web_cumulative,
+             max(store_sales) OVER
+                 (PARTITION BY item_sk ORDER BY d_date
+                  ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+                 AS store_cumulative
+      FROM (SELECT web.item_sk AS item_sk, web.d_date AS d_date,
+                   web.cume_sales AS web_sales,
+                   store.cume_sales AS store_sales
+            FROM web_v1 web LEFT JOIN store_v1 store
+                 ON (web.item_sk = store.item_sk
+                     AND web.d_date = store.d_date)
+            UNION ALL
+            SELECT store.item_sk, store.d_date, NULL, store.cume_sales
+            FROM store_v1 store LEFT JOIN web_v1 web
+                 ON (web.item_sk = store.item_sk
+                     AND web.d_date = store.d_date)
+            WHERE web.item_sk IS NULL) AS x) AS y
+WHERE web_cumulative > store_cumulative
+ORDER BY item_sk, d_date
+LIMIT 100
+"""
+
+SQLITE_OVERRIDES[97] = """
+WITH ssci AS (
+  SELECT ss_customer_sk AS customer_sk, ss_item_sk AS item_sk
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY ss_customer_sk, ss_item_sk
+), csci AS (
+  SELECT cs_bill_customer_sk AS customer_sk, cs_item_sk AS item_sk
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY cs_bill_customer_sk, cs_item_sk
+)
+SELECT sum(CASE WHEN s_cust IS NOT NULL AND c_cust IS NULL
+                THEN 1 ELSE 0 END) AS store_only,
+       sum(CASE WHEN s_cust IS NULL AND c_cust IS NOT NULL
+                THEN 1 ELSE 0 END) AS catalog_only,
+       sum(CASE WHEN s_cust IS NOT NULL AND c_cust IS NOT NULL
+                THEN 1 ELSE 0 END) AS store_and_catalog
+FROM (
+  SELECT ssci.customer_sk AS s_cust, csci.customer_sk AS c_cust
+  FROM ssci LEFT JOIN csci ON ssci.customer_sk = csci.customer_sk
+                          AND ssci.item_sk = csci.item_sk
+  UNION ALL
+  SELECT NULL, csci.customer_sk
+  FROM csci LEFT JOIN ssci ON ssci.customer_sk = csci.customer_sk
+                          AND ssci.item_sk = csci.item_sk
+  WHERE ssci.customer_sk IS NULL
+)
+"""

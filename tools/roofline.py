@@ -158,6 +158,58 @@ def main():
     out["gather_plan_sort_ms"] = round(
         per_iter(timed(plan_loop, ridx)) * 1000, 1)
 
+    # --- ordering economics: sorted vs unsorted grouping / join build --
+    # Anchors the ordering-aware routing (plan/properties.py): what a
+    # grouping pass costs when the key arrives presorted (run-boundary
+    # scan, no sort, no unpermute) vs the sort path, and what the
+    # presorted-build join saves (1 of 3 sorts), per key count.
+    oout = {}
+    for nexp in (20, 22, 23):
+        ng = 1 << nexp
+        skey = jnp.asarray(np.sort(rng.integers(0, ng >> 3, ng))
+                           .astype(np.int32))
+        sel = jnp.ones((ng,), bool)
+
+        @jax.jit
+        def grp_sorted_path(k):
+            def body(i, s):
+                gid, rep, ex, ov = KK.group_ids_static(jnp.abs(k) + s,
+                                                       1 << 17)
+                return gid[0] + rep[0]
+            return lax.fori_loop(0, K, body, jnp.int32(0))
+
+        @jax.jit
+        def grp_presorted(k):
+            def body(i, s):
+                gid, rep, ex, ov, g = KK.group_ids_presorted_static(
+                    jnp.abs(k) + s, 1 << 17)
+                return gid[0] + rep[0]
+            return lax.fori_loop(0, K, body, jnp.int32(0))
+
+        cell = {}
+        cell["group_sort_ms"] = round(
+            per_iter(timed(grp_sorted_path, skey)) * 1000, 2)
+        cell["group_presorted_ms"] = round(
+            per_iter(timed(grp_presorted, skey)) * 1000, 2)
+        oout[f"n{ng >> 20}M"] = cell
+    # presorted-build join at the Q3 shape
+    npr_, nb_ = 6_000_000, 1_500_000
+    probe_ = jnp.asarray(rng.integers(0, nb_, npr_).astype(np.int32))
+    build_ = jnp.asarray(np.arange(nb_, dtype=np.int32))
+    ident = jnp.arange(nb_, dtype=jnp.int32)
+
+    @jax.jit
+    def bp_presorted_loop(build, probe):
+        def body(i, s):
+            order, lb, ub = KK.build_probe(build, probe ^ s,
+                                           build_order=ident)
+            return (ub[0] - lb[0]).astype(jnp.int32)
+        return lax.fori_loop(0, K, body, jnp.int32(0))
+
+    oout["build_probe_presorted_q3_ms"] = round(
+        per_iter(timed(bp_presorted_loop, build_, probe_)) * 1000, 1)
+    out["ordering"] = oout
+
     # --- build_probe at TPC-H Q3 shape: 6M probe, 1.5M build ----------
     npr, nb = 6_000_000, 1_500_000
     probe = jnp.asarray(rng.integers(0, nb, npr).astype(np.int32))
