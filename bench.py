@@ -14,7 +14,10 @@ Baselines (VERDICT r1 asked for an honest one):
 - vs_sqlite: the old oracle ratio (single-threaded row store; flattering,
   kept for continuity with BENCH_r01).
 
-Extra keys: per_query_ms (warm best per query), sf, note, scale_configs
+Extra keys: per_query_ms (warm best per query), compile_economics
+(per-query cold_ms/warm_ms + compiles/compile_ms/cache_hits/ahead_hits
+from exec/compile_cache.py; warm_compiles > 0 flags a warm-path
+retrace), sf, note, scale_configs
 (ALWAYS the committed records from BENCH_SCALE_PROGRESS.json; a default
 run never re-measures them — re-measuring is BENCH_SCALE=1 opt-in and
 runs after the line prints, under a budget sized to finish before the
@@ -89,19 +92,35 @@ def main():
 
     engine_times = {}
     sort_econ = {}
+    compile_econ = {}
     for qid in QUERY_IDS:
-        r = session.sql(QUERIES[qid])  # prewarm (gen + upload + compile)
+        t0 = time.perf_counter()
+        r = session.sql(QUERIES[qid])  # prewarm == the COLD run
+        cold = time.perf_counter() - t0
         if r.stats is not None:  # round-8 sort economics per query
             sort_econ[str(qid)] = {
                 "taken": r.stats.sorts_taken,
                 "elided": r.stats.sorts_elided,
                 "memo_hits": r.stats.sort_memo_hits}
         best = float("inf")
+        warm_compiles = 0
         for _ in range(RUNS):
             t0 = time.perf_counter()
-            session.sql(QUERIES[qid])
+            rw = session.sql(QUERIES[qid])
             best = min(best, time.perf_counter() - t0)
+            if rw.stats is not None:
+                warm_compiles += rw.stats.compiles
         engine_times[qid] = best
+        if r.stats is not None:  # round-9 compile economics per query
+            compile_econ[str(qid)] = {
+                "cold_ms": round(cold * 1000, 1),
+                "warm_ms": round(best * 1000, 1),
+                "compiles": r.stats.compiles,
+                "compile_ms": round(r.stats.compile_ms, 1),
+                "cache_hits": r.stats.compile_cache_hits,
+                "ahead_hits": r.stats.compile_ahead_hits,
+                # any nonzero here is a warm-path retrace — a regression
+                "warm_compiles": warm_compiles}
 
     total_engine = sum(engine_times.values())
     # rows processed: dominated by lineitem scans per query
@@ -130,6 +149,7 @@ def main():
         "perf_gate": gate,
         "recovery_ms": recovery_ms,
         "sort_economics": sort_econ or None,
+        "compile_economics": compile_econ or None,
         "sf": SF,
         "scale_configs": {k: v for k, v in (load_scale_progress() or {}).items()
                           if k != "sf1_test_tier"} or None,

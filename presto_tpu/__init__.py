@@ -14,28 +14,24 @@ Presto, see SURVEY.md) around the XLA execution model:
   become ICI collectives under shard_map (`presto_tpu.parallel`).
 """
 
-import os as _os
-
 import jax
 
 # The engine's BIGINT/DOUBLE are 64-bit end to end (reference: long/double
 # Blocks); must be set before any jnp array is created.
 jax.config.update("jax_enable_x64", True)
 
-# Persistent XLA compilation cache: the analog of the reference's codegen
-# cache (presto-main/.../sql/gen/PageFunctionCompiler.java memoizes compiled
+# Persistent XLA compilation cache + the engine-level executable memo
+# (exec/compile_cache.py): the analog of the reference's codegen cache
+# (presto-main/.../sql/gen/PageFunctionCompiler.java memoizes compiled
 # projections/filters; compiled classes are reused across queries).  XLA
 # compiles a whole fragment per (query shape, sf) — at SF100 a single
 # compile runs tens of minutes, so cold costs must be paid once per
-# machine, not once per process.  PRESTO_TPU_XLA_CACHE=0 disables;
-# any other value overrides the directory.
-_cache = _os.environ.get("PRESTO_TPU_XLA_CACHE", "/tmp/presto_tpu_xla_cache")
-if _cache != "0":
-    jax.config.update("jax_compilation_cache_dir", _cache)
-    # cache every compile that takes noticeable time (default threshold
-    # 1s would skip the many small per-fragment programs whose compiles
-    # still add up across the 22-query suite)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+# machine, not once per process.  Dir from PRESTO_TPU_COMPILE_CACHE
+# (legacy alias PRESTO_TPU_XLA_CACHE, =0 disables) or the
+# compile_cache_dir session property, re-checked per query.
+from presto_tpu.exec import compile_cache as _compile_cache  # noqa: E402
+
+_compile_cache.configure()
 
 from presto_tpu.session import Session, connect  # noqa: E402
 

@@ -61,6 +61,7 @@ def _hand_q1(session):
     import jax
     import jax.numpy as jnp
 
+    from presto_tpu.exec import compile_cache as CC
     from presto_tpu.exec import kernels as K
     from presto_tpu.exec.executor import scan_batch
     from presto_tpu.plan import nodes as P
@@ -74,7 +75,7 @@ def _hand_q1(session):
             "l_extendedprice", "l_discount", "l_tax")})
     b = scan_batch(t, node)
 
-    @jax.jit
+    @CC.build_jit
     def frag(b):
         sel = b.sel & (b.columns["l_shipdate"].data <= 10471)
         key = (b.columns["l_returnflag"].data * 8

@@ -23,6 +23,7 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu.batch import (Batch, Column, batch_from_numpy,
                               decode_host_column, to_numpy)
+from presto_tpu.exec import compile_cache as CC
 from presto_tpu.exec import gather as GA
 from presto_tpu.exec import kernels as K
 from presto_tpu.exec.compiler import EvalContext, eval_expr, eval_predicate, to_column
@@ -64,12 +65,15 @@ def execute_query(session, text: str) -> QueryResult:
 
     mon = QueryMonitor.begin(session, text)
     from presto_tpu import session_ctx
+    from presto_tpu.exec import compile_cache as CC
 
     session_ctx.activate(session)  # zone + query-stable now()
+    CC.configure(session)  # honor a per-session compile_cache_dir
     try:
-        with mon.phase("parse"):
-            stmt = parse(text)
-        result = _dispatch_statement(session, text, stmt, mon)
+        with CC.recording(mon.stats):  # compile-economics counters
+            with mon.phase("parse"):
+                stmt = parse(text)
+            result = _dispatch_statement(session, text, stmt, mon)
         mon.finish(result)
         result.stats = mon.stats  # this query's stats, race-free under
         return result             # concurrent sessions (vs last_stats)
@@ -628,41 +632,64 @@ def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
         _collect_tablescans(plan.root, scan_nodes)
 
         bound = _static_root_bound(plan.root)
-        meta_box: list = []  # static pack layout, captured at trace time
-
-        def fn(batches):
-            ex = Executor(session, static=True,
-                          scan_inputs={id(n): b for n, b in zip(scan_nodes, batches)},
-                          sort_stats=sort_counts)
-            ex.ctx.scalar_results = scalar_results
-            out = ex.exec_node(plan.root)
-            if bound is not None and out.sel.shape[0] > 4 * bound:
-                out = _compact_batch(out, bound)
-            if ex.guards:
-                guard = jnp.any(jnp.stack([jnp.asarray(g) for g in ex.guards]))
-            else:
-                guard = jnp.asarray(False)
-            meta_box.clear()
-            if out.capacity > _PACK_FETCH_MAX:
-                # unbounded root over a scan-sized capacity: keep the
-                # Batch so to_numpy's selective fetch (pull sel, gather
-                # survivors) can avoid shipping the full columns
-                meta_box.append(None)
-                return out, guard
-            # one flat buffer -> ONE host fetch (see kernels.pack_fetch)
-            buf, meta = K.pack_fetch(out, guard)
-            meta_box.append(meta)
-            return buf
-
-        jitted = jax.jit(fn)
         f32 = bool(session.properties.get("float32_compute", False))
         batches = [scan_batch(session.catalog.get(n.table), n, f32)
                    for n in scan_nodes]
-        buf = jitted(batches)  # traces; may raise StaticFallback
-        meta = meta_box[0]
+        # process-wide executable memo (exec/compile_cache.py): keyed by
+        # the plan's serde fingerprint + catalog identity + properties +
+        # scan dtype layout, so a second session (or the same SQL under
+        # a different text) reuses the executable instead of retracing.
+        # Baked scalar-subquery values ride the key: same catalog+plan
+        # => same values, anything else must not share.
+        plan_fp = CC.plan_fingerprint(
+            (plan.root, sorted(plan.subplans.items())))
+        gkey = None if plan_fp is None else CC.fingerprint(
+            "compiled", plan_fp, CC.session_fingerprint(session),
+            _volatile_nonce(text), CC.avals_fingerprint(batches),
+            sorted(scalar_results.items()))
+
+        def build():
+            meta_box: list = []  # static pack layout, set at trace time
+
+            def fn(batches):
+                ex = Executor(session, static=True,
+                              scan_inputs={id(n): b for n, b
+                                           in zip(scan_nodes, batches)},
+                              sort_stats=sort_counts)
+                ex.ctx.scalar_results = scalar_results
+                out = ex.exec_node(plan.root)
+                if bound is not None and out.sel.shape[0] > 4 * bound:
+                    out = _compact_batch(out, bound)
+                if ex.guards:
+                    guard = jnp.any(jnp.stack(
+                        [jnp.asarray(g) for g in ex.guards]))
+                else:
+                    guard = jnp.asarray(False)
+                meta_box.clear()
+                if out.capacity > _PACK_FETCH_MAX:
+                    # unbounded root over a scan-sized capacity: keep
+                    # the Batch so to_numpy's selective fetch (pull sel,
+                    # gather survivors) can avoid shipping full columns
+                    meta_box.append(None)
+                    return out, guard
+                # flat buffer -> ONE host fetch (see kernels.pack_fetch)
+                buf, meta = K.pack_fetch(out, guard)
+                meta_box.append(meta)
+                return buf
+
+            # AOT lower+compile: traces now (may raise StaticFallback),
+            # counts compiles/compile_ms, and loads from the persistent
+            # disk cache when this program was compiled before
+            jitted = CC.build_jit(fn, example=(batches,))
+            return (plan, jitted, scan_nodes, meta_box[0],
+                    dict(sort_counts))
+
         # cache only after success; sort_counts are the program's
         # trace-time routing decisions, replayed into stats per run
-        cache[key] = (plan, jitted, scan_nodes, meta, dict(sort_counts))
+        entry = CC.get_or_build(gkey, build)
+        cache[key] = entry
+        plan, jitted, scan_nodes, meta, sort_counts = entry
+        buf = jitted(batches)
     else:
         plan, jitted, scan_nodes, meta, sort_counts = entry
         f32 = bool(session.properties.get("float32_compute", False))

@@ -54,6 +54,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from presto_tpu.exec import compile_cache
+
 # ---------------------------------------------------------------------------
 # routing constants (pinned by tools/roofline.py's gather sweep)
 # ---------------------------------------------------------------------------
@@ -154,7 +156,7 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@partial(jax.jit, static_argnames=("W", "IB"))
+@partial(compile_cache.static_jit, static_argnames=("W", "IB"))
 def _blocked_gather_call(blk, idx2, src, *, W: int, IB: int):
     """One Pallas launch: grid step i copies source window
     [blk[i]*W, blk[i]*W + W) into VMEM (sequential DMA, pipelined by

@@ -62,6 +62,19 @@ class QueryStats:
     sorts_elided: int = 0
     sort_memo_hits: int = 0
     ordering_guard_trips: int = 0
+    # compile economics (exec/compile_cache.py): XLA programs this query
+    # BUILT (compiles; compile_ms is the AOT lower+compile wall),
+    # executables it reused from the shared memo / persistent disk cache
+    # (compile_cache_hits — disk hits observed via jax.monitoring), and
+    # shared-memo entries a compile-ahead thread had ready before the
+    # query thread asked (compile_ahead_hits).  A warm same-process
+    # re-run of a cached query reports compiles == 0 (asserted in
+    # tier-1); a cold process over a warmed cache dir reports
+    # compile_cache_hits > 0.
+    compiles: int = 0
+    compile_ms: float = 0.0
+    compile_cache_hits: int = 0
+    compile_ahead_hits: int = 0
     # cluster-mode recovery counters (parallel/retry.RunContext.count):
     # http_retries, pages_retried, workers_quarantined, workers_readmitted,
     # hedges_launched, hedges_won, task_cancels, query_retries,

@@ -62,6 +62,7 @@ from urllib.parse import urlsplit
 import numpy as np
 
 from presto_tpu import session_ctx as _sctx
+from presto_tpu.exec import compile_cache as CC
 from presto_tpu.parallel import faults as F
 from presto_tpu.parallel import retry as R
 from presto_tpu.plan import serde as plan_serde
@@ -887,6 +888,43 @@ class _ClusterExecutor:
         self._publish_cols(self._exec_once(root, exch, None))
 
 
+def _warm_task(session, spec: "TaskSpec") -> None:
+    """Compile-ahead analog for cluster workers (exec/compile_cache.py):
+    at task-ACCEPT time, deserialize the fragment and pre-read this
+    worker's table splits (generation / disk decode into the host-side
+    caches, where the per-table locks make the later executor read a
+    hit).  For a task whose exchange inputs are still streaming in,
+    this work previously started at FIRST-PAGE time — serially behind
+    the wait.  Runs on the bounded compile-ahead pool; best-effort."""
+    from presto_tpu.plan import nodes as P
+
+    root = plan_serde.loads(spec.fragment)
+    scans: List[P.TableScan] = []
+
+    def walk(n):
+        if isinstance(n, P.TableScan) \
+                and not n.table.startswith("__exch_"):
+            scans.append(n)
+        for f in dataclasses.fields(n):
+            v = getattr(n, f.name)
+            if isinstance(v, P.PlanNode):
+                walk(v)
+            elif isinstance(v, list):
+                for x in v:
+                    if isinstance(x, P.PlanNode):
+                        walk(x)
+
+    walk(root)
+    for node in scans:
+        table = session.catalog.get(node.table)
+        ranges = table.splits(spec.nworkers)
+        mine = [r for i, r in enumerate(ranges)
+                if i % spec.nworkers == spec.windex]
+        needed = list(dict.fromkeys(node.assignments.values()))
+        for r in mine:
+            table.read(needed, split=r)
+
+
 # ---------------------------------------------------------------------------
 # worker server (the worker JVM analog)
 # ---------------------------------------------------------------------------
@@ -936,7 +974,13 @@ class WorkerServer:
         # replays — the per-bucket-retry test's evidence that survivors
         # re-execute ONLY the victim's work
         self.counters = {"executed": 0, "replayed": 0,
-                         "buffered_bytes": 0, "peak_buffered_bytes": 0}
+                         "buffered_bytes": 0, "peak_buffered_bytes": 0,
+                         # compile economics (exec/compile_cache.py):
+                         # per-task builds/hits aggregate here and are
+                         # served via /v1/info like the work counters
+                         "compiles": 0, "compile_ms": 0.0,
+                         "compile_cache_hits": 0,
+                         "compile_ahead_hits": 0, "tasks_warmed": 0}
         self.lock = threading.Lock()
         self.exec_lock = threading.Lock()
         handler = _make_worker_handler(self)
@@ -979,6 +1023,16 @@ class WorkerServer:
                     "range_boundaries": None,
                     "range_event": threading.Event()}
             self.tasks[spec.task_id] = task
+
+        # task-accept warm (compile-ahead analog): a task that will wait
+        # on exchange pages pre-reads its scan splits on the bounded
+        # pool NOW instead of at first-page time.  Same kill switches
+        # as compile-ahead; never affects results.
+        if spec.inputs and not getattr(spec, "replay", False) \
+                and CC.ahead_enabled(self.session):
+            if CC.submit(lambda: _warm_task(self.session, spec)):
+                with self.lock:
+                    self.counters["tasks_warmed"] += 1
 
         key_dir = None
         if getattr(spec, "durable_dir", None) and \
@@ -1080,9 +1134,16 @@ class WorkerServer:
                 # its timeout from the same query-level deadline
                 wctx = R.RunContext(
                     deadline=R.Deadline(spec.properties.get("deadline_s")))
-                with R.activate(wctx):
+                bag = CC.CompileStats()
+                with R.activate(wctx), CC.recording(bag):
                     _ClusterExecutor(task_session, spec, publish=publish,
                                      task_state=task).run()
+                with self.lock:
+                    for k in ("compiles", "compile_cache_hits",
+                              "compile_ahead_hits"):
+                        self.counters[k] += getattr(bag, k)
+                    self.counters["compile_ms"] = round(
+                        self.counters["compile_ms"] + bag.compile_ms, 1)
                 if attempt_dir is not None:
                     os.makedirs(attempt_dir, exist_ok=True)
                     with open(os.path.join(attempt_dir, "_DONE"),
@@ -1453,7 +1514,7 @@ class ClusterSession:
         mon.stats.execution_mode = "distributed"
         ctx = self._query_ctx(mon.stats.query_id)
         mon.stats.recovery = ctx.recovery  # live view, not a copy
-        with R.activate(ctx):
+        with R.activate(ctx), CC.recording(mon.stats):
             try:
                 result = self._sql_attempts(text, ctx)
             except BaseException as e:

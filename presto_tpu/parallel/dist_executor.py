@@ -30,6 +30,7 @@ except ImportError:  # moved to core in newer jax; 0.4.x path:
     from jax.experimental.shard_map import shard_map
 
 from presto_tpu.batch import Batch, Column
+from presto_tpu.exec import compile_cache as CC
 from presto_tpu.exec.executor import Executor
 from presto_tpu.parallel import exchange as EX
 from presto_tpu.parallel.mesh import AXIS, make_mesh
@@ -158,7 +159,10 @@ def _build_and_run(session, stmt, cache, key, ndev):
     except TypeError:  # pre-0.5 jax spells the kwarg check_rep
         sharded = shard_map(fn, mesh=mesh, in_specs=(PS(AXIS),),
                             out_specs=PS(), check_rep=False)
-    jitted = jax.jit(sharded)
+    # counted build (exec/compile_cache.py): the whole-mesh program's
+    # compile lands in this query's compile-economics counters; the
+    # live jit (no AOT pin) keeps input resharding automatic
+    jitted = CC.build_jit(sharded)
     entry = (dplan, jitted, scan_nodes, mesh)
     # trace/compile before caching so failures propagate to the caller
     out_batch, guard = jitted(

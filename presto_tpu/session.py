@@ -76,6 +76,18 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "cluster_hedge_min_s": 0.25,    # ... with at least this headroom
     "cluster_health_trip_after": 3,   # consecutive failures to quarantine
     "cluster_health_probation_s": 5.0,  # re-probe a quarantined worker
+    # compilation economics (exec/compile_cache.py): persistent XLA
+    # executable cache directory ("" = env PRESTO_TPU_COMPILE_CACHE /
+    # legacy PRESTO_TPU_XLA_CACHE / the /tmp default; "0" or "off"
+    # disables persistence) and the background compile-ahead that
+    # AOT-compiles chunked fragments 2..N while fragment 1 executes
+    # (kill switch; env PRESTO_TPU_COMPILE_AHEAD=off|on overrides
+    # process-wide, and the unforced default is on only with >1 usable
+    # core — on a single core a "background" compile can only steal the
+    # query's cycles.  Never changes results, only when programs
+    # compile).
+    "compile_cache_dir": "",
+    "compile_ahead": True,
     # transitive semi-join pushdown (plan/optimizer); chunked planning
     # turns it off — the inferred probe-side semi never compacts at
     # chunk capacities

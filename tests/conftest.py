@@ -51,7 +51,9 @@ def _bound_suite_memory():
     import jax as _jax
 
     from presto_tpu.catalog import release_device_caches
+    from presto_tpu.exec import compile_cache
 
     release_device_caches()
+    compile_cache.clear()  # executable memo would pin what jax frees
     _jax.clear_caches()
     gc.collect()

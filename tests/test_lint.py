@@ -63,6 +63,49 @@ def test_no_raw_device_sorts_outside_kernels():
     assert not bad, "\n".join(bad)
 
 
+def test_no_raw_jax_jit_outside_compile_economics():
+    """Compile-economics gate (ISSUE 4): every engine-level jax.jit
+    must route through exec/compile_cache.py (build_jit / static_jit)
+    so XLA compiles are counted, memoized process-wide, and eligible
+    for compile-ahead — the two executors (exec/chunked.py,
+    exec/executor.py) are the only other modules allowed to spell
+    jax.jit, for their own routed build sites.  A raw jax.jit anywhere
+    else is an unaccounted compile the telemetry (QueryStats.compiles)
+    and the persistent-cache economics cannot see.  Flags ANY reference
+    to the attribute (calls AND partial(jax.jit, ...) uses) plus
+    `from jax import jit` imports."""
+    import ast
+
+    ALLOWED = {os.path.join("exec", "chunked.py"),
+               os.path.join("exec", "executor.py"),
+               os.path.join("exec", "compile_cache.py")}
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg)
+            if rel in ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "jit" \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "jax":
+                    bad.append(f"{rel}:{node.lineno}: jax.jit — route "
+                               "through exec/compile_cache.build_jit")
+                if isinstance(node, ast.ImportFrom) \
+                        and node.module == "jax" \
+                        and any(a.name == "jit" for a in node.names):
+                    bad.append(f"{rel}:{node.lineno}: from jax import "
+                               "jit — route through exec/compile_cache")
+    assert not bad, "\n".join(bad)
+
+
 def test_no_raw_sleeps_or_timeouts_in_parallel():
     """Robustness gate (ISSUE 2): presto_tpu/parallel/retry.py is the
     ONLY module in the parallel package allowed to call `time.sleep` or

@@ -210,6 +210,59 @@ def main():
         per_iter(timed(bp_presorted_loop, build_, probe_)) * 1000, 1)
     out["ordering"] = oout
 
+    # --- compile economics: compile-ms vs fragment count x mult -------
+    # Frames the exec/compile_cache.py design: what a cold chunked plan
+    # pays in XLA compiles (per fragment, per bound-mult variant) and
+    # what the persistent disk cache gives back on the next process.
+    # Each "fragment" is a filter->group->reduce chain at a distinct
+    # static capacity (mult quantizes capacity, so each mult variant is
+    # a fresh executable — exactly the chunked runner's key structure).
+    from presto_tpu.exec import compile_cache as CC
+
+    def fragment_fn(cap):
+        def fn(x, key):
+            sel = x > 0.0
+            gid = jnp.clip(key, 0, 255)
+            v = jnp.where(sel, x * 1.0001 + 3.0, 0.0)
+            sums = jax.ops.segment_sum(v, gid, num_segments=256)
+            top = jax.lax.top_k(jnp.where(sel, x, -jnp.inf),
+                                min(cap, x.shape[0]))[0]
+            return sums, top, jnp.sum(sel)
+        return fn
+
+    n = 1 << 20
+    xa = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    ka = jnp.asarray(rng.integers(0, 256, n).astype(np.int32))
+    # persist even sub-0.2s compiles so the cached leg measures the
+    # disk-served path at this sweep's program sizes, and use a FRESH
+    # cache dir so the uncached leg is honestly uncached
+    import tempfile
+
+    jax.config.update("jax_compilation_cache_dir",
+                      tempfile.mkdtemp(prefix="roofline_cc_"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    cout = {}
+    for nfrag in (1, 2, 4):
+        for mult in (1, 4):
+            caps = [1024 * mult + 128 * i for i in range(nfrag)]
+
+            def compile_all():
+                t0 = time.perf_counter()
+                for cap in caps:
+                    CC.build_jit(fragment_fn(cap), example=(xa, ka))
+                return (time.perf_counter() - t0) * 1000
+
+            uncached = compile_all()   # fresh HLO: full XLA compile
+            jax.clear_caches()         # drop in-memory, keep disk
+            # trace again, executable loads from the persistent cache
+            cached = compile_all()
+            cout[f"f{nfrag}_m{mult}"] = {
+                "uncached_ms": round(uncached, 1),
+                "cached_ms": round(cached, 1)}
+    cout["counters"] = {k: round(v, 1) if isinstance(v, float) else v
+                        for k, v in CC.stats().items()}
+    out["compile"] = cout
+
     # --- build_probe at TPC-H Q3 shape: 6M probe, 1.5M build ----------
     npr, nb = 6_000_000, 1_500_000
     probe = jnp.asarray(rng.integers(0, nb, npr).astype(np.int32))
