@@ -93,6 +93,7 @@ def main():
     engine_times = {}
     sort_econ = {}
     compile_econ = {}
+    df_econ = {}
     for qid in QUERY_IDS:
         t0 = time.perf_counter()
         r = session.sql(QUERIES[qid])  # prewarm == the COLD run
@@ -102,6 +103,14 @@ def main():
                 "taken": r.stats.sorts_taken,
                 "elided": r.stats.sorts_elided,
                 "memo_hits": r.stats.sort_memo_hits}
+        if r.stats is not None:  # round-10 dynamic-filter economics
+            df_econ[str(qid)] = {
+                "produced": r.stats.df_filters_produced,
+                "applied": r.stats.df_filters_applied,
+                "rows_pruned": r.stats.df_rows_pruned,
+                "chunks_pruned": r.stats.df_chunks_pruned,
+                "splits_pruned": r.stats.df_splits_pruned,
+                "wait_ms": round(r.stats.df_wait_ms, 1)}
         best = float("inf")
         warm_compiles = 0
         for _ in range(RUNS):
@@ -150,6 +159,7 @@ def main():
         "recovery_ms": recovery_ms,
         "sort_economics": sort_econ or None,
         "compile_economics": compile_econ or None,
+        "dynamic_filter": df_econ or None,
         "sf": SF,
         "scale_configs": {k: v for k, v in (load_scale_progress() or {}).items()
                           if k != "sf1_test_tier"} or None,

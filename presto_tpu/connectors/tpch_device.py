@@ -585,6 +585,24 @@ class TpchChunkGrid:
         order grain instead of lineitem grain."""
         return self.cap_orders
 
+    def chunk_column_domain(self, table: str, col: str, i: int):
+        """Zone map of `col` over chunk i, or None when unknowable —
+        the dynamic-filtering chunk-pruning hook (exec/chunked.py):
+        chunks whose range misses a runtime filter's domain are skipped
+        before their program is ever dispatched.  Only the bucket
+        column has a closed form: chunk i covers order rows
+        [edges[i], edges[i+1]), and the sparse dbgen orderkey layout
+        (8 keys per 32-key block) is monotone in the row index."""
+        if table not in ("lineitem", "orders") or \
+                col not in ("l_orderkey", "o_orderkey"):
+            return None
+        o0 = self.order_edges[i]
+        o1 = self.order_edges[i + 1]
+        if o1 <= o0:
+            return None
+        key = lambda oi: (oi // 8) * 32 + oi % 8 + 1  # noqa: E731
+        return int(key(o0)), int(key(o1 - 1))
+
     def chunk_args(self, i: int):
         """Traced scalars for chunk i — a fixed pytree so ONE jitted
         program serves every chunk."""

@@ -242,6 +242,7 @@ def _execute_prepared(session, dplan, frags, runner, table_family,
                                           _merge_sort_stats)
 
     runner.buffers.clear()
+    runner.run_stats = {}  # per-run counters (chunk pruning)
     try:
         final_batch = _run_fragments(session, frags, runner, table_family,
                                      consumer_eid)
@@ -250,8 +251,10 @@ def _execute_prepared(session, dplan, frags, runner, table_family,
     finally:
         if mon is not None:
             # trace-time routing decisions of the per-chunk programs
-            # (warm runs replay the same totals, not re-accumulate)
+            # (warm runs replay the same totals, not re-accumulate) +
+            # this run's host-side dynamic-filter chunk pruning
             _merge_sort_stats(mon.stats, runner.sort_stats)
+            _merge_sort_stats(mon.stats, runner.run_stats)
         runner.buffers.clear()  # don't pin HBM between runs
 
 
@@ -305,6 +308,86 @@ def _root_order_insensitive(root) -> bool:
         node = node.source
     return type(node).__name__ == "Aggregate" \
         and getattr(node, "step", "SINGLE") == "PARTIAL"
+
+
+class _PrunedGridView:
+    """Grid façade exposing only the chunks whose zone ranges overlap a
+    runtime-filter domain (dynamic filtering at chunk grain): the loop
+    streams the kept chunks and never dispatches the rest."""
+
+    def __init__(self, base, keep):
+        self.base = base
+        self.keep = list(keep)
+        self.nchunks = len(self.keep)
+
+    def __getattr__(self, name):
+        return getattr(self.base, name)
+
+    def chunk_args(self, i: int):
+        return self.base.chunk_args(self.keep[i])
+
+
+def _rf_resident_domains(root, resident) -> Dict[str, object]:
+    """{filter id: storage.shard.Domain} for every rf-producing join in
+    this fragment whose BUILD input is a resident batch (an exchange
+    buffer or a resident catalog scan) reachable through Filter /
+    identity-Project edges.  Filters applied deeper in the fragment make
+    the resident values a SUPERSET of the final build keys — chunk
+    pruning on a superset is sound, merely less sharp."""
+    import numpy as np
+
+    from presto_tpu.plan import ir
+    from presto_tpu.storage.shard import Domain
+
+    out: Dict[str, object] = {}
+
+    def resolve(node, sym):
+        while True:
+            if isinstance(node, P.TableScan):
+                return (node, sym) if id(node) in resident else None
+            if isinstance(node, P.Filter):
+                node = node.source
+            elif isinstance(node, P.Project):
+                e = node.assignments.get(sym)
+                if not isinstance(e, ir.Ref):
+                    return None
+                sym = e.name
+                node = node.source
+            else:
+                return None
+
+    def walk(node):
+        for s in getattr(node, "sources", []):
+            walk(s)
+        if not isinstance(node, P.Join) \
+                or node.join_type not in ("INNER", "SEMI"):
+            return
+        for spec in getattr(node, "rf_produce", None) or []:
+            hit = resolve(node.right, spec["build_sym"])
+            if hit is None:
+                continue
+            scan, sym = hit
+            b = resident[id(scan)]
+            col = b.columns.get(sym)
+            if col is None or col.dictionary is not None \
+                    or getattr(col.data, "ndim", 1) != 1 \
+                    or jnp.issubdtype(col.data.dtype, jnp.floating):
+                continue
+            live = np.asarray(b.sel)
+            if col.valid is not None:
+                live = live & np.asarray(col.valid)
+            vals = np.asarray(col.data)[live]
+            if vals.size == 0:
+                out[spec["fid"]] = Domain(values=[])  # prunes everything
+                continue
+            uniq = np.unique(vals.astype(np.int64))
+            if uniq.size <= 4096:  # Domain.overlaps scans values per chunk
+                out[spec["fid"]] = Domain(values=[int(v) for v in uniq])
+            else:
+                out[spec["fid"]] = Domain(int(uniq[0]), int(uniq[-1]))
+
+    walk(root)
+    return out
 
 
 class _MeshGridView:
@@ -433,6 +516,9 @@ class _FragmentRunner:
         self._bound_cache: Dict[object, int] = {}  # fid -> stats bound
         # trace-time sort-economics counters across fragment programs
         self.sort_stats: Dict[str, int] = {}
+        # PER-RUN counters (chunk pruning happens host-side every run,
+        # unlike the trace-time totals above which warm runs replay)
+        self.run_stats: Dict[str, int] = {}
 
     # ---- fragment execution ------------------------------------------
     def _scan_builder(self, node: P.TableScan, chunk_args, grid):
@@ -713,6 +799,7 @@ class _FragmentRunner:
         mode, which is always correct."""
         resident, chunk_nodes = self._split_scans(fscans, chunked=True)
         grid = self._fragment_grid(chunk_nodes)
+        grid = self._rf_chunk_view(frag, resident, chunk_nodes, grid)
         mult = self.bound_mult.get(frag.fid, 1)
         ids = list(resident)
         mesh_n = int(self.session.properties.get("chunk_mesh_devices", 1))
@@ -790,6 +877,50 @@ class _FragmentRunner:
         if bool(jnp.any(jnp.stack(guards))):
             raise Unchunkable("static guard tripped in chunk loop")
         return K.concat_batches(parts) if len(parts) > 1 else parts[0]
+
+    def _rf_chunk_view(self, frag, resident, chunk_nodes, grid):
+        """Dynamic filtering at chunk grain: build summaries from the
+        fragment's RESIDENT inputs (exchange buffers / resident scans —
+        available host-side BEFORE the loop) are compared against the
+        grid's per-chunk zone maps; chunks whose ranges miss every
+        runtime domain are never dispatched.  Strictly best-effort: no
+        grid hook or no resident build means no pruning, and the
+        in-trace row filter still applies inside every kept chunk."""
+        from presto_tpu.plan import runtime_filters as RF
+
+        if not RF.enabled(self.session):
+            return grid
+        hook = getattr(grid, "chunk_column_domain", None)
+        if hook is None:
+            return grid
+        doms = _rf_resident_domains(frag.root, resident)
+        if not doms:
+            return grid
+        keep = None
+        for n in chunk_nodes:
+            for spec in getattr(n, "rf_consume", None) or []:
+                dom = doms.get(spec["fid"])
+                col = spec.get("column")
+                if dom is None or col is None:
+                    continue
+                kept = []
+                for i in (range(grid.nchunks) if keep is None else keep):
+                    zr = hook(n.table, col, i)
+                    if zr is None or dom.overlaps(zr[0], zr[1]):
+                        kept.append(i)
+                keep = kept
+        if keep is None or len(keep) == grid.nchunks:
+            return grid
+        pruned = grid.nchunks - len(keep)
+        if not keep:
+            # degenerate all-pruned grid: keep one chunk — the in-trace
+            # filter masks its rows, so the output is empty anyway and
+            # every downstream shape stays well-formed
+            keep = [0]
+            pruned = grid.nchunks - 1
+        self.run_stats["df_chunks_pruned"] = \
+            self.run_stats.get("df_chunks_pruned", 0) + pruned
+        return _PrunedGridView(grid, keep)
 
     def _fold_exec(self, frag, cap: int, A: int, part0):
         """Bounded-accumulator fold program (_chunk_loop_accumulate):

@@ -46,10 +46,16 @@ class ExecutionError(Exception):
 
 
 def _merge_sort_stats(stats, counts: dict) -> None:
-    """Fold an executor's sort-economics counters into QueryStats."""
+    """Fold an executor's sort-economics + dynamic-filtering counters
+    into QueryStats."""
     for k in ("sorts_taken", "sorts_elided", "sort_memo_hits",
-              "ordering_guard_trips"):
+              "ordering_guard_trips",
+              "df_filters_produced", "df_filters_applied",
+              "df_rows_pruned", "df_chunks_pruned", "df_splits_pruned"):
         setattr(stats, k, getattr(stats, k, 0) + int(counts.get(k, 0)))
+    if counts.get("df_wait_ms"):
+        stats.df_wait_ms = getattr(stats, "df_wait_ms", 0.0) \
+            + float(counts["df_wait_ms"])
 
 
 class StaticFallback(Exception):
@@ -879,6 +885,13 @@ class Executor:
         self._sort_memo: Dict[tuple, tuple] = {}
         self._perm_memo: Dict[tuple, tuple] = {}
         self._batch_order: Dict[int, tuple] = {}
+        # dynamic filtering (plan/runtime_filters.py): filter id ->
+        # device summary (exec/kernels.rf_build), registered by producer
+        # joins BEFORE their probe subtree executes; _rf_host carries the
+        # host-side min/max Domain for stripe/zone-map pruning (dynamic
+        # mode only — static mode must stay sync-free)
+        self._rf: Dict[str, dict] = {}
+        self._rf_host: Dict[str, object] = {}
         # static mode: expression-level overflow checks (decimal casts)
         # append to the SAME guard list, so a violation aborts the
         # compiled program to the dynamic path, which raises properly
@@ -965,6 +978,116 @@ class Executor:
     def _ordering_enabled(self) -> bool:
         return bool(self.session.properties.get(
             "ordering_aware_execution", True))
+
+    # ---- dynamic filtering (plan/runtime_filters.py) -----------------
+    def _df_enabled(self) -> bool:
+        from presto_tpu.plan import runtime_filters as RF
+
+        return RF.enabled(self.session)
+
+    def rf_inject(self, summaries: Dict[str, dict]) -> None:
+        """Register remotely produced filter summaries (the cluster side
+        channel) so probe scans in this executor consume them."""
+        self._rf.update(summaries)
+
+    def _rf_build_complete(self, node) -> bool:
+        """May this executor derive a filter from the join's build batch?
+        True iff the batch it will see is the COMPLETE build key set.
+        Single-device executors always see the whole build; sharded
+        executors (DistExecutor, the cluster FragmentExecutor) override
+        this — a shard/bucket/split-local build is a PARTIAL key set,
+        and a membership filter over a partial set would prune probe
+        rows that match on other shards."""
+        return True
+
+    def _rf_register(self, specs, right: Batch) -> None:
+        """Producer side: derive + register the build-key summaries of
+        one join.  Skips keys the kernels can't summarize (dictionary
+        codes, float storage, limb pairs) — the consumer then simply
+        never finds the id and runs filter-free."""
+        for spec in specs:
+            col = right.columns.get(spec["build_sym"])
+            if col is None or col.dictionary is not None \
+                    or getattr(col.data, "ndim", 1) != 1 \
+                    or jnp.issubdtype(col.data.dtype, jnp.floating):
+                continue
+            live = right.sel
+            if col.valid is not None:
+                live = live & col.valid
+            self._rf[spec["fid"]] = K.rf_build(col, live)
+            self._count("df_filters_produced")
+            if not self.static:
+                # LAZY host min/max domain for stripe/zone-map pruning:
+                # the refs are stashed and only synced if a consumer
+                # scan's table actually supports domain pushdown —
+                # generator/device tables never pay the fetch
+                self._rf_host[spec["fid"]] = (col, live)
+
+    def _rf_host_domain(self, fid: str):
+        entry = self._rf_host.get(fid)
+        if entry is None:
+            return None
+        from presto_tpu.storage.shard import Domain
+
+        if isinstance(entry, Domain):
+            return entry
+        col, live = entry
+        lo, hi = (int(v) for v in jax.device_get(K.rf_domain(col, live)))
+        dom = Domain(lo, hi) if lo <= hi else Domain(values=[])
+        self._rf_host[fid] = dom
+        return dom
+
+    def _rf_scan_domains(self, node: P.TableScan):
+        """{source column: Domain} of runtime filters consumable by this
+        scan as zone-map constraints (dynamic mode only; the caller
+        checks the table supports pushdown before we pay any sync)."""
+        specs = getattr(node, "rf_consume", None)
+        if not specs or self.static or not self._df_enabled():
+            return None
+        out = {}
+        for spec in specs:
+            dom = self._rf_host_domain(spec["fid"])
+            col = spec.get("column")
+            if dom is not None and col is not None:
+                out[col] = dom
+        return out or None
+
+    def _rf_apply(self, node: P.TableScan, b: Batch) -> Batch:
+        """Consumer side: AND every registered filter's membership mask
+        into the scan's sel.  Unproduced ids are skipped — dynamic
+        filtering is strictly best-effort and never changes results."""
+        specs = getattr(node, "rf_consume", None)
+        if not specs or not self._df_enabled():
+            return b
+        sel = b.sel
+        applied = False
+        for spec in specs:
+            summary = self._rf.get(spec["fid"])
+            if summary is None:
+                continue
+            col = b.columns.get(spec["sym"])
+            if col is None or col.dictionary is not None \
+                    or getattr(col.data, "ndim", 1) != 1 \
+                    or jnp.issubdtype(col.data.dtype, jnp.floating):
+                continue
+            mask = K.rf_probe(summary, col)
+            if self.static:
+                sel = sel & mask  # counted at trace time only
+            else:
+                sel2 = sel & mask
+                # ONE host fetch for both counts (dynamic mode only)
+                before, after = jax.device_get((jnp.sum(sel),
+                                                jnp.sum(sel2)))
+                self._count("df_rows_pruned", int(before) - int(after))
+                sel = sel2
+            self._count("df_filters_applied")
+            applied = True
+        if not applied:
+            return b
+        out = b.with_sel(sel)
+        # masking never moves rows; like Filter it punches interior holes
+        self._copy_order(b, out, tail_ok=False)
+        return out
 
     def _key_fp(self, cols, sel, layout):
         """(fingerprint, refs) identifying a packed key by the IDENTITY
@@ -1193,11 +1316,27 @@ class Executor:
     # ---- leaves ------------------------------------------------------
     def _exec_tablescan(self, node: P.TableScan) -> Batch:
         if self.scan_inputs is not None:
-            return self.scan_inputs[id(node)]
+            return self._rf_apply(node, self.scan_inputs[id(node)])
         table = self.session.catalog.get(node.table)
-        return scan_batch(
+        rdoms = self._rf_scan_domains(node) \
+            if getattr(table, "supports_domain_pushdown", False) else None
+        if rdoms and hasattr(table, "pruned_stats"):
+            # runtime domains intersected with the static scan_domains
+            # prune EXTRA stripes — count only the delta the runtime
+            # half removed (the static half prunes with filtering off)
+            from presto_tpu.plan.domains import merge_domain_maps
+
+            static = getattr(node, "scan_domains", None)
+            kept_static, _tot = table.pruned_stats(static or None)
+            kept_merged, _tot = table.pruned_stats(
+                merge_domain_maps(static or {}, rdoms))
+            self._count("df_splits_pruned",
+                        max(kept_static - kept_merged, 0))
+        b = scan_batch(
             table, node,
-            bool(self.session.properties.get("float32_compute", False)))
+            bool(self.session.properties.get("float32_compute", False)),
+            runtime_domains=rdoms)
+        return self._rf_apply(node, b)
 
     def _exec_values(self, node: P.Values) -> Batch:
         arrays = {}
@@ -2753,8 +2892,19 @@ class Executor:
     def _exec_join(self, node: P.Join) -> Batch:
         from presto_tpu.memory.context import batch_bytes
 
-        left = self.exec_node(node.left)
-        right = self.exec_node(node.right)
+        produce = getattr(node, "rf_produce", None)
+        if produce and node.join_type in ("INNER", "SEMI") \
+                and self._df_enabled() and self._rf_build_complete(node):
+            # dynamic filtering: run the BUILD side first and register
+            # its key summary, so the probe subtree's scans consume the
+            # completed filter before they execute (the reference gates
+            # probe-side scan startup on build completion the same way)
+            right = self.exec_node(node.right)
+            self._rf_register(produce, right)
+            left = self.exec_node(node.left)
+        else:
+            left = self.exec_node(node.left)
+            right = self.exec_node(node.right)
         left = self._maybe_compact_static(
             left, getattr(node, "left_est_hint", None))
         if getattr(node, "index_lookup", None) is None:
@@ -3454,12 +3604,16 @@ def _tuples_to_dict_column(tuples: np.ndarray, valid, typ) -> Column:
     return Column(jnp.asarray(codes), valid, typ, _Dict(u))
 
 
-def scan_batch(table, node: P.TableScan, f32: bool = False) -> Batch:
+def scan_batch(table, node: P.TableScan, f32: bool = False,
+               runtime_domains=None) -> Batch:
     """Read + ingest a table's columns, with a per-table device-column
     cache (upload + dictionary-encode once per process; reference analog:
     a connector page source feeding a cache — here the 'page' is the whole
     column and lives in HBM).  f32=True stores DOUBLE columns as float32
-    (see the float32_compute session property)."""
+    (see the float32_compute session property).  `runtime_domains`
+    (dynamic filtering) intersect with the statically pushed-down
+    scan_domains for zone-map stripe pruning — query-specific, so the
+    read bypasses the device cache exactly like a static domain scan."""
     base = getattr(table, "_device_cols", None)
     if base is None:
         base = table._device_cols = {}
@@ -3480,6 +3634,11 @@ def scan_batch(table, node: P.TableScan, f32: bool = False) -> Batch:
 
     needed = list(dict.fromkeys(node.assignments.values()))
     domains = getattr(node, "scan_domains", None)
+    if runtime_domains and getattr(table, "supports_domain_pushdown",
+                                   False):
+        from presto_tpu.plan.domains import merge_domain_maps
+
+        domains = merge_domain_maps(domains or {}, runtime_domains)
     if domains and getattr(table, "supports_domain_pushdown", False):
         # selective scan: the reader prunes stripes/row groups on the
         # pushed-down domains, so the result is QUERY-specific — it

@@ -1004,6 +1004,167 @@ def concat_batches(batches: List[Batch]) -> Batch:
 
 
 # ---------------------------------------------------------------------------
+# runtime filters (dynamic filtering)
+#
+# Build-side key summaries probed on the probe side BEFORE the join ever
+# sees the rows (reference: DynamicFilterService + LocalDynamicFiltersCollector
+# feeding TupleDomains into probe-side page sources).  Two membership
+# structures, routed by build capacity:
+#   exact  — the sorted build keys themselves + a searchsorted probe
+#            (no false positives; masked rows ride as trailing sentinels)
+#   bloom  — a blocked bloom bitset over splitmix64-mixed keys (bits set
+#            within one 64-bit block per key; false positives possible,
+#            false negatives never — the correctness contract)
+# Everything is pure jnp so a filter built inside a compiled fragment
+# stays inside the trace.  Host (numpy) twins serve the cluster side
+# channel and chunk/zone-map pruning; this module is the ONLY home for
+# the membership mixing (tests/test_lint.py enforces).
+# ---------------------------------------------------------------------------
+
+
+RF_EXACT_MAX = 1 << 17   # build capacities up to this probe exactly
+RF_BLOOM_K = 3           # bits set/tested per key
+RF_BLOOM_BITS_PER_KEY = 16  # target bitset density (m/n); FPR ~ 0.5%
+RF_WIRE_MAX = 1 << 16    # largest exact key set shipped over the wire
+
+
+def rf_bloom_bits(n_keys: int) -> int:
+    """Bloom bitset size for n keys: ~RF_BLOOM_BITS_PER_KEY bits per
+    key, power-of-two (block index = h % nblocks needs no division by a
+    traced value), floor 1024.  FPR ~ (1 - e^(-k*n/m))^k ~ 0.5% at
+    k=3, m/n=16 — tests/test_dynamic_filters.py pins the measured rate."""
+    n = max(int(n_keys), 1)
+    return 1 << max(int(np.ceil(np.log2(n * RF_BLOOM_BITS_PER_KEY))), 10)
+
+
+def _rf_mix64(v: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer over int64 key values (the same mixing
+    family as _hash_keys / hll_hash64), uint64 out."""
+    z = v.astype(jnp.uint64) + jnp.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return z ^ (z >> jnp.uint64(31))
+
+
+def _rf_bloom_positions(h: jnp.ndarray, nbits: int):
+    """RF_BLOOM_K bit positions per hash, all inside ONE 64-bit block
+    (blocked bloom: the probe's k gathers hit one cache line)."""
+    nblocks = max(nbits // 64, 1)
+    block = (h % jnp.uint64(nblocks)).astype(jnp.int64) * 64
+    return [block + ((h >> jnp.uint64(8 + 6 * j)) & jnp.uint64(63))
+            .astype(jnp.int64) for j in range(RF_BLOOM_K)]
+
+
+def rf_build(col: Column, live, structure: str = "auto") -> dict:
+    """Build-side runtime-filter summary over the live rows of an
+    integer-orderable key column.  Returns an all-jnp dict (trace-safe):
+    {"kind": "exact", "keys": sorted i64 with dead rows as I64_MAX
+    sentinels} or {"kind": "bloom", "bits": bool[nbits]}."""
+    d = _orderable_int(col)
+    live = live & _valid_arr(col)
+    n = int(d.shape[0])
+    kind = structure
+    if kind == "auto":
+        kind = "exact" if n <= RF_EXACT_MAX else "bloom"
+    if kind == "exact":
+        return {"kind": "exact",
+                "keys": sort_values(jnp.where(live, d, I64_MAX))}
+    nbits = rf_bloom_bits(n)
+    h = _rf_mix64(d)
+    # dead rows scatter into the overflow slot nbits (sliced off)
+    idx = jnp.concatenate([jnp.where(live, p, nbits)
+                           for p in _rf_bloom_positions(h, nbits)])
+    bits = jnp.zeros((nbits + 1,), bool).at[idx].set(True)
+    return {"kind": "bloom", "bits": bits[:nbits]}
+
+
+def rf_probe(summary: dict, col: Column) -> jnp.ndarray:
+    """Probe-side membership mask: True = the row MAY have a build match
+    (exact/domain: iff; bloom: false positives possible, false negatives
+    never).  NULL probe rows map False — an equi-join NULL never
+    matches, so pruning them is always sound for INNER/SEMI consumers."""
+    d = _orderable_int(col)
+    valid = _valid_arr(col)
+    kind = summary["kind"]
+    if kind == "domain":
+        return valid & (d >= summary["lo"]) & (d <= summary["hi"])
+    if kind == "exact":
+        keys = summary["keys"]
+        nb = keys.shape[0]
+        if nb == 0:
+            return jnp.zeros(d.shape, bool)  # empty build: nothing matches
+        pos = jnp.clip(jnp.searchsorted(keys, d), 0, nb - 1)
+        # a probe value equal to the dead-row sentinel could only
+        # "match" a masked build slot — keep it (false positive, safe)
+        return valid & (keys[pos] == d)
+    bits = summary["bits"]
+    h = _rf_mix64(d)
+    m = valid
+    for p in _rf_bloom_positions(h, int(bits.shape[0])):
+        m = m & bits[p]
+    return m
+
+
+def rf_domain(col: Column, live):
+    """(lo, hi) traced min/max of the live key values — the runtime
+    TupleDomain half of the filter.  Empty live set -> (I64_MAX,
+    I64_MIN), which callers map to an impossible Domain."""
+    d = _orderable_int(col)
+    live = live & _valid_arr(col)
+    if d.shape[0] == 0:
+        return jnp.asarray(I64_MAX), jnp.asarray(I64_MIN)
+    return (jnp.min(jnp.where(live, d, I64_MAX)),
+            jnp.max(jnp.where(live, d, I64_MIN)))
+
+
+def rf_summary_host(values: np.ndarray, max_exact: int = RF_WIRE_MAX) -> dict:
+    """Host-side summary from live build key VALUES (integers): the wire
+    form shipped over the cluster side channel and compared against
+    shard zone maps / chunk grids.  {"lo", "hi", "vals": sorted-unique
+    list, or None when the set is too large to ship exactly}."""
+    v = np.asarray(values).astype(np.int64, copy=False)
+    if v.size == 0:
+        return {"lo": None, "hi": None, "vals": []}  # impossible domain
+    uniq = np.unique(v)
+    return {"lo": int(uniq[0]), "hi": int(uniq[-1]),
+            "vals": [int(x) for x in uniq] if uniq.size <= max_exact
+            else None}
+
+
+def rf_union_host(parts: list) -> Optional[dict]:
+    """Union partial host summaries (one per repartition bucket of the
+    build side) into one complete summary — every build row lands in
+    exactly one bucket, so the union over all buckets IS the build key
+    set.  Any part without an exact value list degrades the union to a
+    min/max domain; returns None for no parts."""
+    if not parts:
+        return None
+    los = [p["lo"] for p in parts if p.get("lo") is not None]
+    his = [p["hi"] for p in parts if p.get("hi") is not None]
+    if not los:
+        return {"lo": None, "hi": None, "vals": []}
+    lo, hi = min(los), max(his)
+    if any(p.get("vals") is None for p in parts):
+        return {"lo": lo, "hi": hi, "vals": None}
+    vals = sorted({v for p in parts for v in p["vals"]})
+    if len(vals) > RF_WIRE_MAX:
+        return {"lo": lo, "hi": hi, "vals": None}
+    return {"lo": lo, "hi": hi, "vals": vals}
+
+
+def rf_host_to_device(summary: dict) -> Optional[dict]:
+    """Lift a wire/host summary into a probe-able device summary."""
+    vals = summary.get("vals")
+    if vals is not None:
+        return {"kind": "exact",
+                "keys": jnp.asarray(np.asarray(vals, dtype=np.int64))}
+    if summary.get("lo") is None:
+        return {"kind": "exact", "keys": jnp.zeros((0,), jnp.int64)}
+    return {"kind": "domain", "lo": jnp.int64(summary["lo"]),
+            "hi": jnp.int64(summary["hi"])}
+
+
+# ---------------------------------------------------------------------------
 # sort
 # ---------------------------------------------------------------------------
 

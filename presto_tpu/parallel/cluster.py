@@ -65,6 +65,7 @@ from presto_tpu import session_ctx as _sctx
 from presto_tpu.exec import compile_cache as CC
 from presto_tpu.parallel import faults as F
 from presto_tpu.parallel import retry as R
+from presto_tpu.plan import runtime_filters as DF
 from presto_tpu.plan import serde as plan_serde
 from presto_tpu.native import serde as pserde
 
@@ -659,65 +660,162 @@ class _ClusterExecutor:
         self.publish = publish or (lambda bucket, page, enc=PAGE_ENC_PTPG:
                                    None)
         self.task_state = task_state or {}
+        # dynamic-filtering accounting for this task (folded into the
+        # worker's /v1/info counters / the coordinator's QueryStats)
+        self.df_counts: Dict[str, float] = {}
+        self._df_summaries: Dict[str, dict] = {}
+        self._df_pushed: set = set()
 
     def _exchange_batches(self):
+        inputs = {}
+        push_cfg = self.spec.properties.get("df_push") or {}
+        push_eids = {cfg["eid"] for cfg in push_cfg.values()}
+        # pull filter-producing BUILD inputs first, push their completed
+        # summaries, and only then pull the rest — a probe-side producer
+        # waiting on the side channel (dynamic_filtering_wait_ms) is
+        # unblocked before this task asks it for pages
+        ordered = sorted(self.spec.inputs,
+                         key=lambda i: 0 if i["eid"] in push_eids else 1)
+        for inp in ordered:
+            merged, batch = self._pull_one(inp)
+            inputs[f"__exch_{inp['eid']}"] = batch
+            for fid, cfg in push_cfg.items():
+                if cfg["eid"] == inp["eid"] and fid not in self._df_pushed:
+                    self._df_pushed.add(fid)
+                    self._df_push(fid, cfg, merged)
+        return inputs
+
+    def _pull_one(self, inp):
+        """Pull + merge one exchange input; returns (host columns
+        {sym: (data, valid)}, device Batch)."""
         from presto_tpu.batch import Batch, column_from_numpy
         import jax.numpy as jnp
 
-        inputs = {}
-        for inp in self.spec.inputs:
-            if inp["kind"] in ("repartition", "range"):
-                # range: consumer shard w owns key range w (sample sort)
-                bucket, ups = self.spec.windex, inp["upstreams"]
-            elif inp["kind"] == "scatter":
-                # producers hold identical replicated copies, round-robin
-                # sliced into buckets; one producer is the source of truth
-                bucket, ups = self.spec.windex, inp["upstreams"][:1]
-            else:  # gather / broadcast
-                bucket, ups = 0, inp["upstreams"]
-            parts = []
-            # broadcast buckets have MANY readers: acking would release
-            # pages other consumers still need
-            exclusive = inp["kind"] != "broadcast"
-            for up in ups:
-                # coordinator-side upstreams are mutable [url, tid]
-                # slots shared with the hedge monitor, so the pull
-                # follows a hedge winner mid-stream; worker-side specs
-                # carry deserialized copies that never mutate
-                for buf in pull_pages(up[0], up[1], bucket, ack=exclusive,
-                                      slot=up):
-                    if buf:
-                        parts.append(unpack_columns(buf))
-            merged: Dict[str, tuple] = {}
-            types = inp["types"]
-            for name in types:
-                datas = [p[name][0] for p in parts if name in p]
-                vals = [p[name][1] for p in parts if name in p]
-                if datas:
-                    data = np.concatenate(datas)
-                    if any(v is not None for v in vals):
-                        valid = np.concatenate(
-                            [v if v is not None
-                             else np.ones(len(d), dtype=bool)
-                             for v, d in zip(vals, datas)])
-                    else:
-                        valid = None
+        if inp["kind"] in ("repartition", "range"):
+            # range: consumer shard w owns key range w (sample sort)
+            bucket, ups = self.spec.windex, inp["upstreams"]
+        elif inp["kind"] == "scatter":
+            # producers hold identical replicated copies, round-robin
+            # sliced into buckets; one producer is the source of truth
+            bucket, ups = self.spec.windex, inp["upstreams"][:1]
+        else:  # gather / broadcast
+            bucket, ups = 0, inp["upstreams"]
+        parts = []
+        # broadcast buckets have MANY readers: acking would release
+        # pages other consumers still need
+        exclusive = inp["kind"] != "broadcast"
+        for up in ups:
+            # coordinator-side upstreams are mutable [url, tid]
+            # slots shared with the hedge monitor, so the pull
+            # follows a hedge winner mid-stream; worker-side specs
+            # carry deserialized copies that never mutate
+            for buf in pull_pages(up[0], up[1], bucket, ack=exclusive,
+                                  slot=up):
+                if buf:
+                    parts.append(unpack_columns(buf))
+        merged: Dict[str, tuple] = {}
+        types = inp["types"]
+        for name in types:
+            datas = [p[name][0] for p in parts if name in p]
+            vals = [p[name][1] for p in parts if name in p]
+            if datas:
+                data = np.concatenate(datas)
+                if any(v is not None for v in vals):
+                    valid = np.concatenate(
+                        [v if v is not None
+                         else np.ones(len(d), dtype=bool)
+                         for v, d in zip(vals, datas)])
                 else:
-                    t = types[name]
-                    data = np.empty(0, dtype=object if t.is_string
-                                    else t.numpy_dtype())
                     valid = None
-                merged[name] = (data, valid)
-            cols = {}
-            n = 0
-            for name, (data, valid) in merged.items():
-                c = column_from_numpy(data, types[name],
-                                      valid if valid is not None else None)
-                cols[name] = c
-                n = len(data)
-            inputs[f"__exch_{inp['eid']}"] = Batch(
-                cols, jnp.ones((n,), dtype=bool))
-        return inputs
+            else:
+                t = types[name]
+                data = np.empty(0, dtype=object if t.is_string
+                                else t.numpy_dtype())
+                valid = None
+            merged[name] = (data, valid)
+        cols = {}
+        n = 0
+        for name, (data, valid) in merged.items():
+            c = column_from_numpy(data, types[name],
+                                  valid if valid is not None else None)
+            cols[name] = c
+            n = len(data)
+        return merged, Batch(cols, jnp.ones((n,), dtype=bool))
+
+    # ---- dynamic filtering side channel ------------------------------
+    def _df_push(self, fid: str, cfg: dict, merged) -> None:
+        """Producer side: summarize this task's view of the build keys
+        (complete for broadcast/gather inputs, one repartition bucket
+        otherwise — consumers union the parts) and POST it to every
+        probe-side task the coordinator routed at schedule time.
+        Strictly best-effort: a failed delivery costs nothing."""
+        from presto_tpu.exec import kernels as K
+
+        entry = merged.get(cfg["sym"])
+        if entry is None:
+            return
+        data, valid = entry
+        data = np.asarray(data)
+        if data.dtype == object or data.dtype.kind not in "iub":
+            return
+        vals = data if valid is None else data[np.asarray(valid, bool)]
+        payload = plan_serde.dumps(
+            {"fid": fid, "part": int(cfg.get("part", 0)),
+             **K.rf_summary_host(vals)})
+        for url, tid in cfg.get("targets") or []:
+            try:
+                _http(f"{url}/v1/task/{tid}/dynfilter", payload,
+                      method="POST", timeout=R.ACK_TIMEOUT_S)
+            except R.DeadlineExceeded:
+                raise
+            except Exception:
+                pass  # undelivered filter == filter-free probe (today)
+
+    def _df_receive(self) -> Dict[str, dict]:
+        """Probe side: wait up to dynamic_filtering_wait_ms for every
+        expected filter's parts, then union them into device summaries.
+        Incomplete filters are dropped — the scan runs filter-free, so a
+        slow or crashed build worker can never stall the probe beyond
+        the budget (0 by default: never wait at all)."""
+        from presto_tpu.exec import kernels as K
+
+        expect = self.spec.properties.get("df_expect") or {}
+        if not expect:
+            return {}
+        budget_s = float(self.spec.properties.get(
+            "dynamic_filtering_wait_ms") or 0) / 1000.0
+        ev = self.task_state.get("df_event")
+        store = self.task_state.get("dynfilters")
+
+        def complete():
+            return all(len((store or {}).get(fid, {})) >= int(n)
+                       for fid, n in expect.items())
+
+        t0 = time.monotonic()
+        if ev is not None and store is not None and budget_s > 0:
+            while not complete():
+                rem = budget_s - (time.monotonic() - t0)
+                if rem <= 0:
+                    break
+                ev.clear()
+                if complete():  # re-check after clear: no lost wakeup
+                    break
+                ev.wait(rem)
+            waited = (time.monotonic() - t0) * 1000.0
+            self.df_counts["df_wait_ms"] = round(
+                self.df_counts.get("df_wait_ms", 0.0) + waited, 1)
+        out = {}
+        for fid, n in expect.items():
+            got = (store or {}).get(fid, {})
+            if len(got) < int(n):
+                continue  # incomplete: best-effort degrade
+            merged = K.rf_union_host(list(got.values()))
+            if merged is None:
+                continue
+            s = K.rf_host_to_device(merged)
+            if s is not None:
+                out[fid] = s
+        return out
 
     def _scan_tables(self, root):
         from presto_tpu.plan import nodes as P
@@ -752,10 +850,33 @@ class _ClusterExecutor:
 
         spec = self.spec
 
+        kind_of_eid = {inp["eid"]: inp["kind"] for inp in self.spec.inputs}
+
         class FragmentExecutor(Executor):
             # split-subset scans are not whole tables: the index join's
             # natural-order layout assumption does not hold here
             allow_index_join = False
+
+            def _rf_build_complete(ex_self, node) -> bool:
+                """This task sees its SPLIT of every scanned table and
+                its BUCKET of every repartition exchange — both partial
+                key sets.  Only builds fed entirely by broadcast/gather
+                exchange buffers (or Values) are complete here; partial
+                builds reach consumers through the coordinator-routed
+                side channel instead, which unions the buckets."""
+                def complete(n):
+                    if isinstance(n, P.TableScan):
+                        if n.table.startswith("__exch_"):
+                            eid = int(n.table[len("__exch_"):])
+                            return kind_of_eid.get(eid) in ("broadcast",
+                                                            "gather")
+                        return False  # split-local rows
+                    if isinstance(n, P.Values):
+                        return True
+                    srcs = n.sources
+                    return bool(srcs) and all(complete(s) for s in srcs)
+
+                return complete(node.right)
 
             def _exec_tablescan(ex_self, node: P.TableScan) -> Batch:
                 if node.table in exch:
@@ -783,11 +904,23 @@ class _ClusterExecutor:
                         else node.types[sym].numpy_dtype())
                     cols[sym] = column_from_numpy(arr, node.types[sym])
                     n = len(arr)
-                return Batch(cols, jnp.ones((n,), dtype=bool))
+                # dynamic filtering: locally produced + side-channel
+                # injected summaries prune this split's rows before the
+                # fragment's operators see them
+                return ex_self._rf_apply(
+                    node, Batch(cols, jnp.ones((n,), dtype=bool)))
 
         ex = FragmentExecutor(self.session)
         ex.ctx = EvalContext(dict(self.spec.scalar_results))
+        if self._df_summaries:
+            # side-channel filters (complete unions only) consumed by
+            # this fragment's probe scans; locally produced filters are
+            # registered by the executor's own join path
+            ex.rf_inject(self._df_summaries)
         out = ex.exec_node(root)
+        for k, v in ex.sort_stats.items():
+            if k.startswith("df_") and v:
+                self.df_counts[k] = self.df_counts.get(k, 0) + v
 
         # materialize to host with validity preserved — ONE device_get for
         # the whole batch (per-column fetches pay a full RPC round trip
@@ -866,6 +999,9 @@ class _ClusterExecutor:
 
     def run(self) -> None:
         root = plan_serde.loads(self.spec.fragment)
+        # dynamic filtering: bounded wait for side-channel summaries
+        # BEFORE any scan executes (wait_ms=0 skips straight through)
+        self._df_summaries = self._df_receive()
         exch = self._exchange_batches()
         scan_tables = self._scan_tables(root)
 
@@ -980,7 +1116,12 @@ class WorkerServer:
                          # served via /v1/info like the work counters
                          "compiles": 0, "compile_ms": 0.0,
                          "compile_cache_hits": 0,
-                         "compile_ahead_hits": 0, "tasks_warmed": 0}
+                         "compile_ahead_hits": 0, "tasks_warmed": 0,
+                         # dynamic filtering (plan/runtime_filters.py):
+                         # per-task filter activity aggregates here so
+                         # tests/operators can see cluster-wide pruning
+                         "df_filters_produced": 0, "df_filters_applied": 0,
+                         "df_rows_pruned": 0, "df_wait_ms": 0.0}
         self.lock = threading.Lock()
         self.exec_lock = threading.Lock()
         handler = _make_worker_handler(self)
@@ -1021,7 +1162,9 @@ class WorkerServer:
             task = {"state": "RUNNING", "error": None,
                     "pages": {}, "complete": False,
                     "range_boundaries": None,
-                    "range_event": threading.Event()}
+                    "range_event": threading.Event(),
+                    # dynamic-filter side channel: fid -> {part: payload}
+                    "dynfilters": {}, "df_event": threading.Event()}
             self.tasks[spec.task_id] = task
 
         # task-accept warm (compile-ahead analog): a task that will wait
@@ -1135,15 +1278,23 @@ class WorkerServer:
                 wctx = R.RunContext(
                     deadline=R.Deadline(spec.properties.get("deadline_s")))
                 bag = CC.CompileStats()
+                cex = _ClusterExecutor(task_session, spec, publish=publish,
+                                       task_state=task)
                 with R.activate(wctx), CC.recording(bag):
-                    _ClusterExecutor(task_session, spec, publish=publish,
-                                     task_state=task).run()
+                    cex.run()
                 with self.lock:
                     for k in ("compiles", "compile_cache_hits",
                               "compile_ahead_hits"):
                         self.counters[k] += getattr(bag, k)
                     self.counters["compile_ms"] = round(
                         self.counters["compile_ms"] + bag.compile_ms, 1)
+                    for k, v in cex.df_counts.items():
+                        if k == "df_wait_ms":
+                            self.counters[k] = round(
+                                self.counters.get(k, 0.0) + v, 1)
+                        else:
+                            self.counters[k] = \
+                                self.counters.get(k, 0) + int(v)
                 if attempt_dir is not None:
                     os.makedirs(attempt_dir, exist_ok=True)
                     with open(os.path.join(attempt_dir, "_DONE"),
@@ -1218,6 +1369,31 @@ def _make_worker_handler(server: WorkerServer):
                 server.submit(spec)
                 self._send(200, json.dumps(
                     {"taskId": spec.task_id}).encode(), "application/json")
+            elif self.path.startswith("/v1/task/") \
+                    and self.path.endswith("/dynfilter"):
+                # dynamic-filter side channel (plan/runtime_filters.py):
+                # a build-side task delivers its completed key summary;
+                # the consuming task's bounded wait (_df_receive) sees it
+                tid = self.path.split("/")[3]
+                with server.lock:
+                    task = server.tasks.get(tid)
+                if task is None:
+                    self._send(404, b"{}")
+                    return
+                try:
+                    payload = plan_serde.loads(body)
+                    fid = payload["fid"]
+                    part = int(payload.get("part", 0))
+                except (ValueError, TypeError, KeyError):
+                    self._send(400, b"{}")
+                    return
+                with server.lock:
+                    task.setdefault("dynfilters", {}) \
+                        .setdefault(fid, {})[part] = payload
+                ev = task.get("df_event")
+                if ev is not None:
+                    ev.set()
+                self._send(200, b"{}", "application/json")
             elif self.path.startswith("/v1/task/") \
                     and self.path.endswith("/range"):
                 # range boundaries for sample-sort partitioning
@@ -1514,12 +1690,17 @@ class ClusterSession:
         mon.stats.execution_mode = "distributed"
         ctx = self._query_ctx(mon.stats.query_id)
         mon.stats.recovery = ctx.recovery  # live view, not a copy
+        self._coord_df = {}
         with R.activate(ctx), CC.recording(mon.stats):
             try:
                 result = self._sql_attempts(text, ctx)
             except BaseException as e:
                 mon.fail(e)
                 raise
+        if self._coord_df:
+            from presto_tpu.exec.executor import _merge_sort_stats
+
+            _merge_sort_stats(mon.stats, self._coord_df)
         mon.finish(result.rows)
         return result
 
@@ -1744,6 +1925,45 @@ class ClusterSession:
             run_on = run_on_of[frag.fid]
             placements[frag.fid] = [
                 [url, f"t_{uuid.uuid4().hex[:12]}"] for url in run_on]
+        # dynamic-filtering routing (plan/runtime_filters.py): the
+        # coordinator computes, AT SCHEDULE TIME, which fragment can
+        # summarize each filter's build keys from an exchange input and
+        # which fragments' scans consume that filter remotely — producer
+        # tasks then POST completed summaries straight to the consumer
+        # tasks (placements are pre-assigned, so the routing table is
+        # known before anything runs).  Broadcast/gather build inputs
+        # give every producer task the COMPLETE key set (nparts=1);
+        # repartition inputs are per-bucket partials consumers union.
+        df_push_of: Dict[int, dict] = {}
+        df_expect_of: Dict[int, dict] = {}
+        if DF.enabled(self.session):
+            wiring = {f.fid: _rf_fragment_wiring(f) for f in fragments}
+            for frag in fragments:
+                _produced, pushable, _consumed = wiring[frag.fid]
+                for fid, cfg in pushable.items():
+                    targets = []
+                    remote_fids = []
+                    for g in fragments:
+                        gp, _gpu, gc = wiring[g.fid]
+                        if fid in gc and fid not in gp:
+                            remote_fids.append(g.fid)
+                            targets += [list(slot)
+                                        for slot in placements[g.fid]
+                                        if slot[0] is not None]
+                    if not targets:
+                        continue
+                    if cfg["kind"] in ("broadcast", "gather"):
+                        nparts, partial = 1, False
+                    elif cfg["kind"] == "repartition":
+                        nparts = len(placements[frag.fid])
+                        partial = True
+                    else:
+                        continue  # scatter/range builds: not routed yet
+                    df_push_of.setdefault(frag.fid, {})[fid] = {
+                        "eid": cfg["eid"], "sym": cfg["sym"],
+                        "partial": partial, "targets": targets}
+                    for gfid in remote_fids:
+                        df_expect_of.setdefault(gfid, {})[fid] = nparts
         coordinator_spec = None
         self._task_specs: Dict[str, tuple] = {}  # tid -> (spec, fid)
         phased = bool(self.session.properties.get(
@@ -1814,10 +2034,27 @@ class ClusterSession:
                             # the mesh (session_ctx contract)
                             "query_start_us": _sctx.query_start_us(),
                             # workers inherit the remaining query budget
-                            "deadline_s": deadline_s},
+                            "deadline_s": deadline_s,
+                            # dynamic filtering: kill switch + side-channel
+                            # wait budget travel with every task
+                            "dynamic_filtering": self.session.properties
+                            .get("dynamic_filtering", True),
+                            "dynamic_filtering_wait_ms":
+                            self.session.properties.get(
+                                "dynamic_filtering_wait_ms", 0)},
                         durable_dir=ddir, durable_key=dkey,
                         attempt=attempt, replay=replay,
                     )
+                    pushcfg = df_push_of.get(frag.fid)
+                    if pushcfg:
+                        spec.properties["df_push"] = {
+                            fid: {"eid": c["eid"], "sym": c["sym"],
+                                  "part": (w if c["partial"] else 0),
+                                  "targets": c["targets"]}
+                            for fid, c in pushcfg.items()}
+                    if frag.fid in df_expect_of:
+                        spec.properties["df_expect"] = \
+                            df_expect_of[frag.fid]
                     if url is None:  # final fragment: run on the coordinator
                         coordinator_spec = spec
                     else:
@@ -1856,9 +2093,13 @@ class ClusterSession:
         # the final fragment executes here, pulling pages (and thereby
         # blocking) until upstream production drains
         pages: Dict[int, List[bytes]] = {}
-        _ClusterExecutor(self.session, coordinator_spec,
-                         publish=lambda b, p, enc=PAGE_ENC_PTPG:
-                         pages.setdefault(b, []).append(p)).run()
+        cex = _ClusterExecutor(self.session, coordinator_spec,
+                               publish=lambda b, p, enc=PAGE_ENC_PTPG:
+                               pages.setdefault(b, []).append(p))
+        cex.run()
+        # coordinator-side filter activity folds into this query's stats
+        # (worker-side activity aggregates on each worker's /v1/info)
+        self._coord_df = dict(cex.df_counts)
         merged = [unpack_columns(p) for p in pages.get(0, [])]
         # single final page expected (gather output); concat defensively
         if len(merged) == 1:
@@ -2029,6 +2270,62 @@ def main(argv=None):
 
 if __name__ == "__main__":
     main()
+
+
+def _rf_fragment_wiring(frag: Fragment):
+    """Dynamic-filter wiring of one fragment: (produced, pushable,
+    consumed).  `produced` = filter ids whose producer join executes in
+    this fragment (its local executor registers them); `pushable` maps
+    the subset whose BUILD keys arrive via an exchange input — i.e. this
+    fragment's task can summarize the build host-side right after the
+    pull and POST the summary to remote consumers — to {"eid", "sym",
+    "kind"}; `consumed` = filter ids this fragment's scans consume."""
+    from presto_tpu.plan import ir
+    from presto_tpu.plan import nodes as P
+
+    kind_of = {i.eid: i.kind for i in frag.inputs}
+    produced: set = set()
+    pushable: Dict[str, dict] = {}
+    consumed: set = set()
+
+    def resolve_exch(node, sym):
+        while True:
+            if isinstance(node, P.TableScan):
+                if node.table.startswith("__exch_") \
+                        and sym in node.assignments:
+                    return int(node.table[len("__exch_"):]), sym
+                return None
+            if isinstance(node, P.Filter):
+                node = node.source
+            elif isinstance(node, P.Project):
+                e = node.assignments.get(sym)
+                if not isinstance(e, ir.Ref):
+                    return None
+                sym = e.name
+                node = node.source
+            else:
+                return None
+
+    def walk(node):
+        for s in getattr(node, "sources", []):
+            walk(s)
+        if isinstance(node, P.TableScan):
+            for spec in getattr(node, "rf_consume", None) or []:
+                consumed.add(spec["fid"])
+            return
+        if isinstance(node, P.Join) and node.join_type in ("INNER",
+                                                           "SEMI"):
+            for spec in getattr(node, "rf_produce", None) or []:
+                produced.add(spec["fid"])
+                hit = resolve_exch(node.right, spec["build_sym"])
+                if hit is not None:
+                    eid, sym = hit
+                    pushable[spec["fid"]] = {
+                        "eid": eid, "sym": sym,
+                        "kind": kind_of.get(eid, "")}
+
+    walk(frag.root)
+    return produced, pushable, consumed
 
 
 def _classify_exchange_inputs(root):

@@ -49,6 +49,25 @@ class DistExecutor(Executor):
         super().__init__(session, static=True, scan_inputs=scan_inputs)
         self.ndev = ndev
 
+    def _rf_build_complete(self, node) -> bool:
+        """Inside the shard_map, a join's build batch is this SHARD's
+        view: only a build that is replicated on every shard (gathered /
+        broadcast, or Values) is the complete key set.  Repartition
+        buckets and raw sharded scans are partial — filtering a
+        pre-exchange probe scan with them would drop rows that match on
+        other shards, so those joins produce no runtime filter here."""
+        def complete(n):
+            if isinstance(n, P.Exchange):
+                return n.kind in ("gather", "broadcast")
+            if isinstance(n, P.TableScan):
+                return False  # sharded_scan slices rows per shard
+            if isinstance(n, P.Values):
+                return True  # replicated by construction
+            srcs = n.sources
+            return bool(srcs) and all(complete(s) for s in srcs)
+
+        return complete(node.right)
+
     def _exec_exchange(self, node: P.Exchange) -> Batch:
         b = self.exec_node(node.source)
         if node.kind in ("gather", "broadcast"):

@@ -158,6 +158,19 @@ def domains_from_conjuncts(conjuncts, assignments: Dict[str, str]
     return {c: d for c, d in out.items()}
 
 
+def merge_domain_maps(static: Dict[str, Domain],
+                      runtime: Dict[str, Domain]) -> Dict[str, Domain]:
+    """INTERSECT runtime-derived domains (dynamic filtering,
+    plan/runtime_filters.py) with the statically extracted ones instead
+    of replacing them: both constraints hold conjunctively, so a stripe
+    must overlap BOTH to survive.  A column present in only one map
+    keeps that map's domain unchanged."""
+    out = dict(static or {})
+    for col, dom in (runtime or {}).items():
+        out[col] = _merge(out[col], dom) if col in out else dom
+    return out
+
+
 def domains_pickle_safe(domains: Dict[str, Domain]) -> Dict[str, Domain]:
     """numpy scalars -> python scalars so plan fragments serialize
     identically everywhere."""

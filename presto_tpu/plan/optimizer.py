@@ -49,6 +49,13 @@ def optimize(plan: P.QueryPlan, session) -> P.QueryPlan:
         from presto_tpu.plan import properties as OP
 
         OP.annotate(out, session)
+    # dynamic filtering (plan/runtime_filters.py): wire build-side
+    # runtime-filter producers to probe-side scan consumers.  After the
+    # structural passes so the join tree and scan assignments are final;
+    # the annotations are advisory and survive fragment serde.
+    from presto_tpu.plan import runtime_filters as RF
+
+    RF.annotate(out, session)
     return out
 
 

@@ -256,6 +256,16 @@ class PrestoTpuServer:
             "outputRows": st.output_rows,
             "peakMemoryBytes": st.peak_memory_bytes,
             "spilledBytes": st.spilled_bytes,
+            # dynamic filtering (plan/runtime_filters.py): per-query
+            # filter economics for the UI's query pane
+            "dynamicFilters": {
+                "produced": getattr(st, "df_filters_produced", 0),
+                "applied": getattr(st, "df_filters_applied", 0),
+                "rowsPruned": getattr(st, "df_rows_pruned", 0),
+                "chunksPruned": getattr(st, "df_chunks_pruned", 0),
+                "splitsPruned": getattr(st, "df_splits_pruned", 0),
+                "waitMillis": round(getattr(st, "df_wait_ms", 0.0), 1),
+            },
             "planText": plan_text,
             "nodes": nodes,
         }

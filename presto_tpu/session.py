@@ -88,6 +88,17 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # compile).
     "compile_cache_dir": "",
     "compile_ahead": True,
+    # dynamic filtering (plan/runtime_filters.py + exec/kernels.py rf_*):
+    # selective-join build sides publish runtime key summaries (min/max
+    # domain + exact or bloom membership) that probe-side scans consume
+    # to skip rows / chunks / splits before the join.  Never changes
+    # results (kill switch: env PRESTO_TPU_DYNAMIC_FILTERS=off).
+    "dynamic_filtering": True,
+    # cluster mode: how long a probe-side task waits for a not-yet-
+    # delivered filter summary before scanning filter-free (ms).  0 =
+    # never wait — a slow or crashed build worker can then never stall
+    # the probe; unreceived filters degrade to today's behaviour.
+    "dynamic_filtering_wait_ms": 0,
     # transitive semi-join pushdown (plan/optimizer); chunked planning
     # turns it off — the inferred probe-side semi never compacts at
     # chunk capacities

@@ -288,6 +288,81 @@ def test_worker_crash_mid_wave_remaps_to_survivors(tpch_catalog_tiny):
                 w.stop()
 
 
+# ---- dynamic filtering under faults (ISSUE 5 satellite) ---------------
+
+
+DF_QUERY = ("SELECT count(*) c, sum(l_extendedprice) s FROM lineitem, "
+            "part WHERE p_partkey = l_partkey "
+            "AND p_container = 'MED BOX'")
+
+
+def _df_counters(url):
+    import json
+    import urllib.request
+
+    req = C._signed_request("GET", f"{url}/v1/info")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())["counters"]
+
+
+def test_df_push_drop_leaves_probe_filter_free(chaos):
+    """A dropped build-summary POST (the /dynfilter side channel) leaves
+    the probe running filter-free after its bounded wait: identical
+    results, df_filters_applied == 0, and NO query-level retry — an
+    undelivered filter is a perf miss, never a failure."""
+    session, cs, workers, _want = chaos
+    want = norm(session.sql(DF_QUERY).rows)
+    session.set("broadcast_join_threshold_rows", 0)  # side-channel shape
+    session.set("dynamic_filtering_wait_ms", 300)
+    F.install(F.FaultPlan.parse("client:POST:/dynfilter:1+:drop"))
+    before = [_df_counters(w.url) for w in workers]
+    try:
+        assert norm(cs.sql(DF_QUERY).rows) == want
+        after = [_df_counters(w.url) for w in workers]
+        applied = sum(a["df_filters_applied"] - b["df_filters_applied"]
+                      for a, b in zip(after, before))
+        pruned = sum(a["df_rows_pruned"] - b["df_rows_pruned"]
+                     for a, b in zip(after, before))
+        assert applied == 0 and pruned == 0, (applied, pruned)
+        rec = session.last_stats.recovery
+        assert "query_retries" not in rec, rec
+        assert "deadline_expired" not in rec, rec
+    finally:
+        session.set("broadcast_join_threshold_rows", 1_000_000)
+        session.set("dynamic_filtering_wait_ms", 0)
+        _reset(session, cs, workers)
+
+
+def test_df_build_crash_degrades_filter_free(tpch_catalog_tiny):
+    """A build-side worker crash mid-query: the probe never stalls on
+    the filter (wait budget 0), the retry remaps to the survivor — ONE
+    query retry, no storm — and results are identical with
+    df_filters_applied == 0 on the surviving worker."""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    session.set("broadcast_join_threshold_rows", 0)  # side-channel shape
+    workers = [C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                              faults=F.FaultPlan([])).start()
+               for _ in range(2)]
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    try:
+        want = norm(session.sql(DF_QUERY).rows)
+        assert norm(cs.sql(DF_QUERY).rows) == want  # prewarm
+        before = _df_counters(workers[0].url)
+        workers[1].faults = F.FaultPlan.parse("exec:EXEC:*:1:crash")
+        assert norm(cs.sql(DF_QUERY).rows) == want
+        rec = session.last_stats.recovery
+        assert rec.get("query_retries", 0) == 1, rec
+        assert "deadline_expired" not in rec, rec
+        after = _df_counters(workers[0].url)
+        assert after["df_filters_applied"] == \
+            before["df_filters_applied"], (before, after)
+        assert workers[1].crashed
+    finally:
+        for w in workers:
+            if not w.crashed:
+                w.stop()
+
+
 def test_env_fault_plan_roundtrip(monkeypatch):
     monkeypatch.setenv("PRESTO_TPU_FAULTS",
                        "server:GET:/results/:3:drop;exec:EXEC:*:1:fail")

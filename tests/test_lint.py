@@ -106,6 +106,56 @@ def test_no_raw_jax_jit_outside_compile_economics():
     assert not bad, "\n".join(bad)
 
 
+def test_no_raw_membership_mixing_outside_kernels():
+    """Dynamic-filtering gate (ISSUE 5): the runtime-filter membership
+    primitives — device searchsorted probes and the splitmix64 mixing
+    constants — must stay inside exec/kernels.py (rf_build / rf_probe /
+    rf_summary_host and friends) on the engine's DATA PATH, so filter
+    probing is routed, counted (df_filters_applied), and covered by the
+    CPU-interpret equivalence tests.  Checked over the planner, storage,
+    server, cluster, and executor layers; generator connectors and the
+    exchange hash partitioner keep their own (pre-existing) mixing."""
+    import ast
+
+    SPLITMIX = {0x9E3779B97F4A7C15, 0xBF58476D1CE4E5B9,
+                0x94D049BB133111EB}
+    DEVICE_NS = {"jnp", "lax"}
+    pkg = os.path.join(ROOT, "presto_tpu")
+    checked = []
+    for sub in ("plan", "storage", "server"):
+        d = os.path.join(pkg, sub)
+        checked += [os.path.join(d, f) for f in sorted(os.listdir(d))
+                    if f.endswith(".py")]
+    checked += [os.path.join(pkg, "parallel", f)
+                for f in ("cluster.py", "faults.py", "retry.py",
+                          "dist_executor.py")]
+    checked += [os.path.join(pkg, "exec", f)
+                for f in ("executor.py", "chunked.py", "compile_cache.py",
+                          "gather.py")]
+    bad = []
+    for path in checked:
+        rel = os.path.relpath(path, pkg)
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "searchsorted":
+                base = node.func.value
+                name = base.id if isinstance(base, ast.Name) else (
+                    base.attr if isinstance(base, ast.Attribute) else None)
+                if name in DEVICE_NS:
+                    bad.append(f"{rel}:{node.lineno}: {name}.searchsorted"
+                               " — route through exec/kernels.rf_probe")
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, int) \
+                    and node.value in SPLITMIX:
+                bad.append(f"{rel}:{node.lineno}: splitmix64 constant "
+                           f"{hex(node.value)} — membership mixing "
+                           "belongs in exec/kernels.py")
+    assert not bad, "\n".join(bad)
+
+
 def test_no_raw_sleeps_or_timeouts_in_parallel():
     """Robustness gate (ISSUE 2): presto_tpu/parallel/retry.py is the
     ONLY module in the parallel package allowed to call `time.sleep` or

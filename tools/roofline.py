@@ -263,6 +263,69 @@ def main():
                         for k, v in CC.stats().items()}
     out["compile"] = cout
 
+    # --- dynamic filtering: probe selectivity x membership structure --
+    # Pins the routing constants in exec/kernels.py (RF_EXACT_MAX, bloom
+    # sizing): what the probe-side mask costs per structure at q17-like
+    # shapes (6M-row probe, 16k-key build), and what the downstream join
+    # gets back when the mask's selectivity lets the probe COMPACT to a
+    # fraction of its capacity before build_probe (on this engine the
+    # static join cost scales with capacity, so compaction is where
+    # pruned rows turn into wall-clock).  Swept at 1/10/50/90% probe
+    # selectivity; "off" is the unfiltered join baseline.
+    from presto_tpu import types as PT
+    from presto_tpu.batch import Column as PCol
+
+    dout = {}
+    nprobe_df = 1 << 22
+    nbuild_df = 1 << 14
+    dsel = jnp.ones((nbuild_df,), bool)
+    for pct in (1, 10, 50, 90):
+        # build keys live in the first pct% of the probe key domain, so
+        # P(probe row survives) == pct/100 exactly
+        dom = 1 << 20
+        cut = max(dom * pct // 100, 1)
+        bvals = jnp.asarray(rng.integers(0, cut, nbuild_df))
+        pvals = jnp.asarray(rng.integers(0, dom, nprobe_df))
+        bcol = PCol(bvals, None, PT.BIGINT, None)
+        pcol = PCol(pvals, None, PT.BIGINT, None)
+        cell = {}
+        for structure in ("exact", "bloom"):
+            summary = KK.rf_build(bcol, dsel, structure=structure)
+
+            @jax.jit
+            def probe_loop(pv):
+                def body(i, s):
+                    m = KK.rf_probe(summary,
+                                    PCol(pv ^ s, None, PT.BIGINT, None))
+                    return jnp.sum(m).astype(jnp.int64)
+
+                return lax.fori_loop(0, K, body, jnp.int64(0))
+
+            cell[f"{structure}_probe_ms"] = round(
+                per_iter(timed(probe_loop, pvals)) * 1000, 2)
+        # downstream: full-capacity join (off) vs masked+compacted join
+        mask = KK.rf_probe(KK.rf_build(bcol, dsel, structure="exact"),
+                           pcol)
+        ncap = 1 << max(int(np.ceil(np.log2(nprobe_df * pct / 100))), 12)
+        idx = KK.nonzero_i32(mask, ncap, 0)
+        pkept = pvals[idx]
+        sb = jnp.sort(bvals)
+
+        @jax.jit
+        def join_full(pv):
+            def body(i, s):
+                _o, lb, ub = KK.build_probe(sb, pv ^ s)
+                return (ub[0] - lb[0]).astype(jnp.int32)
+
+            return lax.fori_loop(0, K, body, jnp.int32(0))
+
+        cell["join_off_ms"] = round(
+            per_iter(timed(join_full, pvals)) * 1000, 2)
+        cell["join_filtered_ms"] = round(
+            per_iter(timed(join_full, pkept)) * 1000, 2)
+        dout[f"sel{pct}"] = cell
+    out["dynfilter"] = dout
+
     # --- build_probe at TPC-H Q3 shape: 6M probe, 1.5M build ----------
     npr, nb = 6_000_000, 1_500_000
     probe = jnp.asarray(rng.integers(0, nb, npr).astype(np.int32))
