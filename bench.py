@@ -139,6 +139,7 @@ def main():
     vs_sqlite = sqlite_speedup(engine_times)
     gate = perf_gate(engine_times)
     recovery_ms = recovery_bench()
+    serve = serve_gate_summary()
 
     # ONE line on stdout, emitted IMMEDIATELY after the SF1 measurements
     # (round-2 lesson: the scale configs below can outlive the caller's
@@ -157,6 +158,7 @@ def main():
                          for q, t in engine_times.items()},
         "perf_gate": gate,
         "recovery_ms": recovery_ms,
+        "serve": serve,
         "sort_economics": sort_econ or None,
         "compile_economics": compile_econ or None,
         "dynamic_filter": df_econ or None,
@@ -233,6 +235,237 @@ def perf_gate(engine_times):
                              f"{GATE_RTT_FLOOR_MS:.0f}ms RTT floor)")
     return ("FAIL: " + "; ".join(f"q{k} {v}" for k, v in bad.items())) \
         if bad else "pass"
+
+
+SERVE_RECORD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "SERVE_r01.json")
+
+
+def load_serve_record():
+    try:
+        with open(SERVE_RECORD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def serve_gate_summary():
+    """The serving QPS gate as registered in the default bench artifact:
+    reports the COMMITTED SERVE_r01.json record (bench.py --serve
+    re-measures it) so a default run exits 0 on committed records and a
+    regressed serve round is visibly red in the record's own gate."""
+    rec = load_serve_record()
+    if rec is None:
+        return None
+    return {"qps_per_chip": rec.get("qps_per_chip"),
+            "p50_ms": rec.get("p50_ms"), "p95_ms": rec.get("p95_ms"),
+            "p99_ms": rec.get("p99_ms"), "gate": rec.get("gate"),
+            "asof": rec.get("asof")}
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def serve_bench():
+    """Closed-loop concurrency benchmark (`bench.py --serve`): N client
+    sessions issue a mixed q1 / q6 / point-lookup / prepared-EXECUTE
+    workload over the HTTP protocol against an in-process server with
+    admission control — the serving tier under real contention
+    (docs/SERVING.md).  Closed loop: each session issues its next query
+    when the previous one completes, so offered load tracks capacity.
+    Emits p50/p95/p99 per class, QPS/chip, admission queue depth, and
+    cache hit rates to SERVE_r01.json with a regression gate vs the
+    committed record; compiles are prewarmed OUT of the timed loop
+    (cold-start economics are the main bench's compile_economics)."""
+    import threading
+
+    import jax
+
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+    from presto_tpu.client import StatementClient
+    from presto_tpu.server import PrestoTpuServer
+    from presto_tpu.server.resource_groups import ResourceGroupManager
+    from tests.tpch_queries import QUERIES
+
+    sf = float(os.environ.get("BENCH_SERVE_SF", "0.01"))
+    n_sessions = int(os.environ.get("BENCH_SERVE_SESSIONS", "8"))
+    per_session = int(os.environ.get("BENCH_SERVE_QUERIES", "25"))
+    concurrency = int(os.environ.get("BENCH_SERVE_CONCURRENCY", "4"))
+
+    session = presto_tpu.connect(
+        tpch_catalog(sf, cache_dir="/tmp/presto_tpu_cache"))
+    if os.environ.get("BENCH_F32", "1") != "0":
+        session.set("float32_compute", True)
+    rgm = ResourceGroupManager()
+    rgm.add_group("global.serve", hard_concurrency_limit=concurrency,
+                  max_queued=10_000)
+    rgm.add_selector("global.serve")
+    srv = PrestoTpuServer(session, max_concurrent=concurrency,
+                          resource_groups=rgm).start()
+
+    max_key = max(int(6_000_000 * sf * 4), 8)
+
+    def point_sql(seed):
+        k = 1 + (seed * 7919) % max_key
+        return (f"SELECT count(*) c, sum(l_extendedprice) s "
+                f"FROM lineitem WHERE l_orderkey = {k}")
+
+    def run_one(sql):
+        rows = list(StatementClient(srv.uri, sql).rows())
+        return rows
+
+    run_one("PREPARE serve_point FROM SELECT count(*) c, "
+            "sum(l_extendedprice) s FROM lineitem WHERE l_orderkey = ?")
+
+    def pick(seed):
+        r = seed % 8
+        if r == 0:
+            return "q1", QUERIES[1]
+        if r in (1, 5):
+            return "q6", QUERIES[6]
+        if r in (2, 6):
+            return "point", point_sql(seed)
+        return "execute", \
+            f"EXECUTE serve_point USING {1 + (seed * 4547) % max_key}"
+
+    # prewarm: one of each class so the timed loop measures serving,
+    # not first-compile
+    for cls, sql in (pick(0), pick(1), pick(2), pick(3)):
+        run_one(sql)
+
+    lat = {"q1": [], "q6": [], "point": [], "execute": []}
+    lat_lock = threading.Lock()
+    failures = []
+    depth_samples = []
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            try:
+                depth_samples.append(sum(
+                    g["queued"] for g in rgm.info() if g["name"] == "global"))
+            except Exception:
+                pass
+            stop.wait(0.02)
+
+    def client(sid):
+        for i in range(per_session):
+            cls, sql = pick(sid * per_session + i + 17)
+            t0 = time.perf_counter()
+            try:
+                run_one(sql)
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                failures.append(f"{cls}: {type(e).__name__}: {e}")
+                continue
+            dt = (time.perf_counter() - t0) * 1000.0
+            with lat_lock:
+                lat[cls].append(dt)
+
+    samp = threading.Thread(target=sampler, daemon=True)
+    samp.start()
+    t_wall = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(sid,))
+               for sid in range(n_sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_wall
+    stop.set()
+    samp.join(timeout=2)
+
+    import urllib.request
+
+    info = json.loads(urllib.request.urlopen(
+        f"{srv.uri}/v1/info", timeout=30).read())
+    # prepared economics summed over the run's history
+    binds = hits = fallbacks = 0
+    for st in session.history_snapshot():
+        binds += getattr(st, "prepared_binds", 0)
+        hits += getattr(st, "prepared_plan_hits", 0)
+        fallbacks += getattr(st, "prepared_fallbacks", 0)
+    srv.stop()
+
+    all_lat = sorted(x for v in lat.values() for x in v)
+    total = len(all_lat)
+    chips = 1 if jax.devices()[0].platform == "cpu" else len(jax.devices())
+    record = {
+        "metric": "serve_closed_loop_qps_per_chip",
+        "platform": jax.devices()[0].platform,
+        "sf": sf,
+        "sessions": n_sessions,
+        "per_session": per_session,
+        "concurrency_limit": concurrency,
+        "queries": total,
+        "failures": len(failures),
+        "failure_samples": failures[:5],
+        "wall_s": round(wall, 2),
+        "qps": round(total / wall, 2) if wall else None,
+        "qps_per_chip": round(total / wall / chips, 2) if wall else None,
+        "p50_ms": _percentile(all_lat, 0.50),
+        "p95_ms": _percentile(all_lat, 0.95),
+        "p99_ms": _percentile(all_lat, 0.99),
+        "per_class_p50_ms": {k: round(_percentile(sorted(v), 0.50), 1)
+                             for k, v in lat.items() if v},
+        "per_class_p99_ms": {k: round(_percentile(sorted(v), 0.99), 1)
+                             for k, v in lat.items() if v},
+        "admission": {
+            "peak_queue_depth": max(depth_samples, default=0),
+            "mean_queue_depth": round(
+                sum(depth_samples) / len(depth_samples), 2)
+            if depth_samples else 0,
+            "admitted": info["serving"]["admitted"],
+            "shed": info["serving"]["shed"],
+        },
+        "caches": {
+            "result_cache": info["serving"]["resultCache"],
+            "prepared": {"binds": binds, "plan_hits": hits,
+                         "fallbacks": fallbacks},
+        },
+        "asof": _today(),
+    }
+    for k in ("p50_ms", "p95_ms", "p99_ms"):
+        if record[k] is not None:
+            record[k] = round(record[k], 1)
+    record["gate"] = _serve_gate(record, load_serve_record())
+    try:
+        with open(SERVE_RECORD_PATH, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+    except OSError:
+        pass
+    print(json.dumps(record), flush=True)
+    return record
+
+
+SERVE_GATE_QPS_RATIO = 0.75  # FAIL below this share of the committed QPS
+SERVE_GATE_P99_RATIO = 1.5   # FAIL above this multiple of committed p99
+
+
+def _serve_gate(record, committed):
+    """Regression gate vs the committed record, platform-matched (a CPU
+    dev box must not gate against chip numbers or vice versa)."""
+    if record["failures"]:
+        return f"FAIL: {record['failures']} query failures"
+    if committed is None \
+            or committed.get("platform") != record["platform"] \
+            or committed.get("sf") != record["sf"]:
+        return "pass (no comparable committed record)"
+    prev_qps = committed.get("qps_per_chip")
+    if prev_qps and record["qps_per_chip"] is not None \
+            and record["qps_per_chip"] < SERVE_GATE_QPS_RATIO * prev_qps:
+        return (f"FAIL: qps/chip {record['qps_per_chip']} < "
+                f"{SERVE_GATE_QPS_RATIO}x committed {prev_qps}")
+    prev_p99 = committed.get("p99_ms")
+    if prev_p99 and record["p99_ms"] is not None \
+            and record["p99_ms"] > SERVE_GATE_P99_RATIO * prev_p99:
+        return (f"FAIL: p99 {record['p99_ms']}ms > "
+                f"{SERVE_GATE_P99_RATIO}x committed {prev_p99}ms")
+    return "pass"
 
 
 def recovery_bench():
@@ -478,4 +711,7 @@ def sqlite_speedup(engine_times):
 
 
 if __name__ == "__main__":
-    main()
+    if "--serve" in sys.argv:
+        serve_bench()
+    else:
+        main()

@@ -28,6 +28,10 @@ class EvalContext:
                  guards: list | None = None):
         self.scalar_results = scalar_results or {}  # plan_id -> (value, valid)
         self.guards = guards  # Executor.guards in static mode, else None
+        # prepared-statement parameters (server/serving.py): position ->
+        # (value, valid) — host scalars in dynamic mode, traced 0-d
+        # device scalars in compiled mode (the ScalarSub channel)
+        self.params = None
 
 
 def eval_expr(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> ColVal:
@@ -43,6 +47,13 @@ def eval_expr(expr: ir.RowExpr, batch: Batch, ctx: EvalContext) -> ColVal:
         if expr.value is None:
             return ColVal(False, False, expr.type)
         return ColVal(expr.value, None, expr.type)
+    if isinstance(expr, ir.Param):
+        if ctx.params is None or expr.position >= len(ctx.params):
+            raise TypeError(
+                f"parameter ${expr.position} is not bound "
+                "(EXECUTE ... USING)")
+        v, valid = ctx.params[expr.position]
+        return ColVal(v, valid, expr.type)
     if isinstance(expr, ir.ScalarSub):
         v, valid = ctx.scalar_results[expr.plan_id]
         if isinstance(valid, (bool, type(None))):  # host-evaluated subplan

@@ -90,22 +90,23 @@ def execute_query(session, text: str) -> QueryResult:
 
 def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
     if isinstance(stmt, ast.Prepare):
-        if not hasattr(session, "prepared_statements"):
-            session.prepared_statements = {}
-        # validate now so PREPARE surfaces syntax errors immediately; `0`
-        # stands in for `?` because it parses in every placeholder
-        # position incl. number-only ones like LIMIT
-        parse(stmt.statement_text.replace("?", "0"))
-        session.prepared_statements[stmt.name] = stmt.statement_text
+        # serving-tier registry (server/serving.py): parses + validates
+        # the template ONCE, infers parameter types for DESCRIBE INPUT,
+        # and mirrors into session.prepared_statements (compat surface)
+        from presto_tpu.server import serving as SV
+
+        SV.prepare(session, stmt.name, stmt.statement_text)
         return QueryResult([("result", T.BOOLEAN)], [(True,)])
     if isinstance(stmt, ast.Execute):
-        prepared = getattr(session, "prepared_statements", {}).get(stmt.name)
-        if prepared is None:
-            raise ExecutionError(f"prepared statement '{stmt.name}' not found")
-        sql = _substitute_parameters(prepared, stmt.parameters)
-        return _dispatch_statement(session, sql, parse(sql), mon)
+        # typed aval-abstracted binding when possible (plan + executable
+        # shared across parameter values), else text substitution
+        from presto_tpu.server import serving as SV
+
+        return SV.execute_prepared(session, stmt, mon, _dispatch_statement)
     if isinstance(stmt, ast.Deallocate):
-        getattr(session, "prepared_statements", {}).pop(stmt.name, None)
+        from presto_tpu.server import serving as SV
+
+        SV.deallocate(session, stmt.name)  # unknown name is an error
         return QueryResult([("result", T.BOOLEAN)], [(True,)])
     if isinstance(stmt, ast.TransactionStatement):
         if stmt.action == "START":
@@ -185,13 +186,12 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
             text_plan = explain_text(session, stmt.statement)
         return QueryResult([("Query Plan", T.VARCHAR)], [(text_plan,)])
     if isinstance(stmt, ast.DescribeInput):
-        # reference: DescribeInputRewrite — parameter positions; types
-        # are unresolved without binding, reported as 'unknown'
-        prepared = getattr(session, "prepared_statements", {}).get(stmt.name)
-        if prepared is None:
-            raise ExecutionError(f"prepared statement '{stmt.name}' not found")
-        rows = [(i, "unknown")
-                for i in range(_count_placeholders(prepared))]
+        # reference: DescribeInputRewrite — parameter positions + types
+        # inferred from the template's column comparisons (serving tier;
+        # positions the inference cannot resolve report 'unknown')
+        from presto_tpu.server import serving as SV
+
+        rows = SV.describe_input(session, stmt.name)
         return QueryResult([("Position", T.BIGINT), ("Type", T.VARCHAR)],
                            rows)
     if isinstance(stmt, ast.DescribeOutput):
@@ -599,7 +599,22 @@ def _volatile_nonce(text: str) -> int:
     return session_ctx.query_seq()
 
 
-def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
+def bind_param_values(session, params):
+    """Host (value, Type) pairs -> device 0-d scalars with the dtypes the
+    traced program expects.  DOUBLE follows the session's
+    float32_compute lane so a bound parameter never promotes an f32
+    column expression back to f64 (serving tier, server/serving.py)."""
+    f32 = bool(session.properties.get("float32_compute", False))
+    out = []
+    for v, t in params:
+        dt = t.numpy_dtype()
+        if f32 and t.name == "DOUBLE":
+            dt = jnp.float32
+        out.append(jnp.asarray(v, dtype=dt))
+    return tuple(out)
+
+
+def run_compiled(session, text: str, stmt, mon=None, params=None) -> QueryResult:
     """Compiled execution: the WHOLE plan traces into one jitted XLA
     program over the scan batches (the reference compiles expressions to
     bytecode per operator, sql/gen/; we compile the entire fragment DAG —
@@ -607,10 +622,18 @@ def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
 
     Static shapes come from connector stats (plan/stats.py).  Runtime
     guards verify the static assumptions (group capacity, join fanout);
-    a tripped guard re-runs the query in dynamic eager mode."""
+    a tripped guard re-runs the query in dynamic eager mode.
+
+    `params`: prepared-statement bindings as (host_value, Type) pairs
+    (server/serving.py).  They trace as 0-d device scalars, so the
+    executable is VALUE-free: the memo keys on their avals and a new
+    binding is a device transfer, not a retrace — the caller's `text`
+    must then be the type-signature key, not the rendered SQL."""
     cache = getattr(session, "_compiled_cache", None)
     if cache is None:
         cache = session._compiled_cache = {}
+    host_params = tuple((v, None) for v, _t in params) \
+        if params is not None else None
     # raw text key (whitespace normalization would merge queries that
     # differ only inside string literals)
     key = (text, getattr(session.catalog, "version", 0),
@@ -619,14 +642,14 @@ def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
     entry = cache.get(key)
     if entry == "DYNAMIC":  # static assumptions known-violated for this query
         plan = plan_statement(session, stmt)
-        return Executor(session, monitor=mon).run(plan)
+        return Executor(session, monitor=mon, params=host_params).run(plan)
     if entry is None:
         plan = plan_statement(session, stmt)
         if _plan_has_long_decimal(plan.root):
             # two-limb Int128 columns don't pack through the compiled
             # fetch plane yet; the dynamic executor carries them exactly
             cache[key] = "DYNAMIC"
-            return Executor(session, monitor=mon).run(plan)
+            return Executor(session, monitor=mon, params=host_params).run(plan)
         # uncorrelated scalar subqueries: evaluate eagerly (tiny), bake in;
         # populate ctx as we go — later subplans may reference earlier ones
         sort_counts = {}  # trace-time sort routing decisions
@@ -641,6 +664,8 @@ def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
         f32 = bool(session.properties.get("float32_compute", False))
         batches = [scan_batch(session.catalog.get(n.table), n, f32)
                    for n in scan_nodes]
+        pvals = bind_param_values(session, params) \
+            if params is not None else None
         # process-wide executable memo (exec/compile_cache.py): keyed by
         # the plan's serde fingerprint + catalog identity + properties +
         # scan dtype layout, so a second session (or the same SQL under
@@ -652,17 +677,20 @@ def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
         gkey = None if plan_fp is None else CC.fingerprint(
             "compiled", plan_fp, CC.session_fingerprint(session),
             _volatile_nonce(text), CC.avals_fingerprint(batches),
+            CC.avals_fingerprint(pvals) if pvals is not None else "",
             sorted(scalar_results.items()))
 
         def build():
             meta_box: list = []  # static pack layout, set at trace time
 
-            def fn(batches):
+            def trace(batches, pvals):
                 ex = Executor(session, static=True,
                               scan_inputs={id(n): b for n, b
                                            in zip(scan_nodes, batches)},
                               sort_stats=sort_counts)
                 ex.ctx.scalar_results = scalar_results
+                if pvals is not None:
+                    ex.ctx.params = tuple((pv, None) for pv in pvals)
                 out = ex.exec_node(plan.root)
                 if bound is not None and out.sel.shape[0] > 4 * bound:
                     out = _compact_batch(out, bound)
@@ -685,8 +713,19 @@ def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
 
             # AOT lower+compile: traces now (may raise StaticFallback),
             # counts compiles/compile_ms, and loads from the persistent
-            # disk cache when this program was compiled before
-            jitted = CC.build_jit(fn, example=(batches,))
+            # disk cache when this program was compiled before.  The
+            # parameterless signature is kept distinct so existing
+            # programs keep their persistent-cache identity.
+            if params is None:
+                def fn(batches):
+                    return trace(batches, None)
+
+                jitted = CC.build_jit(fn, example=(batches,))
+            else:
+                def fn(batches, pvals):
+                    return trace(batches, pvals)
+
+                jitted = CC.build_jit(fn, example=(batches, pvals))
             return (plan, jitted, scan_nodes, meta_box[0],
                     dict(sort_counts))
 
@@ -695,13 +734,18 @@ def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
         entry = CC.get_or_build(gkey, build)
         cache[key] = entry
         plan, jitted, scan_nodes, meta, sort_counts = entry
-        buf = jitted(batches)
+        buf = jitted(batches) if params is None else jitted(batches, pvals)
     else:
         plan, jitted, scan_nodes, meta, sort_counts = entry
         f32 = bool(session.properties.get("float32_compute", False))
         batches = [scan_batch(session.catalog.get(n.table), n, f32)
                    for n in scan_nodes]
-        buf = jitted(batches)
+        if params is None:
+            buf = jitted(batches)
+        else:
+            # warm prepared EXECUTE: binding is a device transfer into
+            # the cached executable — no parse, no plan, no compile
+            buf = jitted(batches, bind_param_values(session, params))
     if mon is not None:
         _merge_sort_stats(mon.stats, sort_counts)
     ex = Executor(session)
@@ -718,7 +762,7 @@ def run_compiled(session, text: str, stmt, mon=None) -> QueryResult:
         # remember to go straight to dynamic next time (no retrace loop)
         cache[key] = "DYNAMIC"
         plan2 = plan_statement(session, stmt)
-        return Executor(session, monitor=mon).run(plan2)
+        return Executor(session, monitor=mon, params=host_params).run(plan2)
     return result
 
 
@@ -864,7 +908,7 @@ class Executor:
     allow_index_join = True
 
     def __init__(self, session, static: bool = False, scan_inputs=None,
-                 monitor=None, mem=None, sort_stats=None):
+                 monitor=None, mem=None, sort_stats=None, params=None):
         self.session = session
         self.static = static  # compiled mode: no host syncs, static shapes
         self.scan_inputs = scan_inputs  # {node id: Batch} traced jit args
@@ -896,6 +940,9 @@ class Executor:
         # append to the SAME guard list, so a violation aborts the
         # compiled program to the dynamic path, which raises properly
         self.ctx = EvalContext(guards=self.guards if static else None)
+        # prepared-statement parameters (server/serving.py): position ->
+        # (value, valid) pairs ir.Param evaluation reads
+        self.ctx.params = params
         self.monitor = monitor  # QueryMonitor collecting per-node stats
         # memory accounting: only for monitored (top-level) executions —
         # helper executors (subplan eval, CTAS materialization) must not

@@ -1689,7 +1689,10 @@ def emit_cast(v: ColVal, to: T.Type, safe: bool = False,
                       if hasattr(v.data, "shape") else _np_dtype(to).type(0).item(),
                       v.valid if v.valid is not None else False, to)
     data = v.data
-    if not v.is_scalar:
+    if not v.is_scalar or hasattr(data, "dtype"):
+        # arrays AND device 0-d scalars (ir.Param bindings, distributed
+        # ScalarSub values) stay on device: int()/float() would force a
+        # host sync — and abort the trace under jit
         if to.is_integer and (frm.is_floating or frm.is_decimal):
             data = jnp.trunc(jnp.asarray(data)).astype(_np_dtype(to))
         else:

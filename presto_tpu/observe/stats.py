@@ -88,6 +88,22 @@ class QueryStats:
     df_chunks_pruned: int = 0
     df_splits_pruned: int = 0
     df_wait_ms: float = 0.0
+    # serving tier (server/serving.py): prepared-statement economics —
+    # binds through the typed aval path (plan + executable shared across
+    # parameter VALUES), warm binds that skipped parse/plan/compile
+    # entirely (a registry dict hit + device transfer), and EXECUTEs
+    # that fell back to text substitution (string/NULL params, static
+    # parameter positions like LIMIT ?, subquery params) where the plan
+    # is value-keyed.  result_cache_hit flags a query served straight
+    # from the serving result cache with no execution at all;
+    # resource_group / admission_wait_ms record the admission decision
+    # (reference: query JSON resourceGroupId + queuedTime).
+    prepared_binds: int = 0
+    prepared_plan_hits: int = 0
+    prepared_fallbacks: int = 0
+    result_cache_hit: int = 0
+    resource_group: str = ""
+    admission_wait_ms: float = 0.0
     # cluster-mode recovery counters (parallel/retry.RunContext.count):
     # http_retries, pages_retried, workers_quarantined, workers_readmitted,
     # hedges_launched, hedges_won, task_cancels, query_retries,

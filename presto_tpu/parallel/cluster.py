@@ -1647,9 +1647,16 @@ class ClusterSession:
     """Coordinator: plans on the local session, schedules fragments over
     the worker set, returns results like Session.sql."""
 
-    def __init__(self, session, worker_urls: List[str]):
+    def __init__(self, session, worker_urls: List[str],
+                 resource_groups=None):
         self.session = session
         self.workers = list(worker_urls)
+        # coordinator admission control (server/resource_groups.py,
+        # docs/SERVING.md): when a ResourceGroupManager is attached,
+        # every ClusterSession.sql queues/sheds against per-group
+        # concurrency + memory budgets BEFORE planning — the cluster
+        # analog of the protocol server's serving tier
+        self.resource_groups = resource_groups
         # circuit breaker shared across this session's queries: trips on
         # consecutive failures, re-admits through probation (reference:
         # failureDetector/HeartbeatFailureDetector)
@@ -1688,15 +1695,41 @@ class ClusterSession:
 
         mon = QueryMonitor.begin(self.session, text)
         mon.stats.execution_mode = "distributed"
-        ctx = self._query_ctx(mon.stats.query_id)
-        mon.stats.recovery = ctx.recovery  # live view, not a copy
-        self._coord_df = {}
-        with R.activate(ctx), CC.recording(mon.stats):
+        group = None
+        if self.resource_groups is not None:
+            # admission BEFORE planning: a queued query must not hold
+            # planner/compile resources (reference: DispatchManager
+            # admits via resource groups before query execution starts)
+            t0a = time.monotonic()
             try:
-                result = self._sql_attempts(text, ctx)
+                group = self.resource_groups.acquire(
+                    self.session.user, self.session.source,
+                    timeout=float(self.session.properties.get(
+                        "admission_queue_timeout_s", 60.0)),
+                    memory_bytes=int(self.session.properties.get(
+                        "query_max_memory_bytes", 0)))
             except BaseException as e:
                 mon.fail(e)
                 raise
+            mon.stats.admission_wait_ms = (time.monotonic() - t0a) * 1000.0
+            mon.stats.resource_group = group.full_name
+        t0q = time.monotonic()
+        ctx = self._query_ctx(mon.stats.query_id)
+        mon.stats.recovery = ctx.recovery  # live view, not a copy
+        self._coord_df = {}
+        try:
+            with R.activate(ctx), CC.recording(mon.stats):
+                try:
+                    result = self._sql_attempts(text, ctx)
+                except BaseException as e:
+                    mon.fail(e)
+                    raise
+        finally:
+            if group is not None:
+                self.resource_groups.release(
+                    group, cpu_s=time.monotonic() - t0q,
+                    memory_bytes=int(self.session.properties.get(
+                        "query_max_memory_bytes", 0)))
         if self._coord_df:
             from presto_tpu.exec.executor import _merge_sort_stats
 

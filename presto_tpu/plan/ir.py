@@ -93,6 +93,24 @@ class CastExpr(RowExpr):
 
 
 @dataclass(frozen=True)
+class Param(RowExpr):
+    """A bound prepared-statement parameter (reference: spi/relation has
+    no analog — the reference rewrites parameters to constants at
+    analysis; we keep them SYMBOLIC so the plan and its compiled
+    executable are value-free).  Evaluation reads
+    `EvalContext.params[position]`: a host scalar in dynamic mode, a
+    traced 0-d device scalar in compiled mode (the same channel
+    ScalarSub uses for distributed subquery values) — so parameter
+    binding is a dict lookup plus device transfer, never a retrace."""
+
+    position: int
+    type: Type
+
+    def __str__(self):
+        return f"$param_{self.position}"
+
+
+@dataclass(frozen=True)
 class ScalarSub(RowExpr):
     """Uncorrelated scalar subquery, referencing a pre-evaluated subplan.
     (Reference: EnforceSingleRowNode + uncorrelated Apply — here the

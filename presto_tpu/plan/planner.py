@@ -1090,6 +1090,16 @@ class Planner:
                 return ir.Ref(sym, f.type)
         if isinstance(e, ast.Literal):
             return _literal_to_ir(e)
+        if isinstance(e, ast.Parameter):
+            # the serving tier (server/serving.py) binds `type_` from the
+            # EXECUTE parameter values before planning; an unbound `?`
+            # outside a prepared statement is a semantic error, like the
+            # reference's "Incorrect number of parameters"
+            if e.type_ is None:
+                raise SemanticError(
+                    "query parameter ? is only valid in a prepared "
+                    "statement (PREPARE ... / EXECUTE ... USING)")
+            return ir.Param(e.position, e.type_)
         if isinstance(e, ast.IntervalLiteral):
             # INTERVAL DAY TO SECOND carries MICROSECONDS (reference
             # stores millis, spi/type/IntervalDayTimeType; micros match

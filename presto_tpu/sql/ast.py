@@ -40,6 +40,18 @@ class Literal(Expr):
 
 
 @dataclass
+class Parameter(Expr):
+    """A `?` placeholder in a prepared statement (reference:
+    sql/tree/Parameter).  `type_` is bound by the serving tier at
+    EXECUTE time (server/serving.py) from the parameter values'
+    engine types, so the SAME template plans once per type signature
+    and the plan/executable are value-free (ir.Param)."""
+
+    position: int  # 0-based, textual order == EXECUTE ... USING order
+    type_: object = None  # presto_tpu.types.Type once bound
+
+
+@dataclass
 class IntervalLiteral(Expr):
     value: int
     unit: str  # DAY | MONTH | YEAR

@@ -94,6 +94,7 @@ class Parser:
         self.text = text
         self.toks = tokenize(text)
         self.i = 0
+        self._n_params = 0  # `?` placeholders seen, in textual order
 
     # ---- token helpers ----------------------------------------------
     def peek(self, ahead=0) -> Token:
@@ -871,6 +872,14 @@ class Parser:
 
     def _primary(self) -> ast.Expr:
         t = self.peek()
+        if t.kind == "op" and t.value == "?":
+            # prepared-statement parameter (reference: SqlBase.g4
+            # parameter); positions follow textual order, which is the
+            # EXECUTE ... USING binding order
+            self.next()
+            p = ast.Parameter(self._n_params)
+            self._n_params += 1
+            return p
         if t.kind == "number":
             self.next()
             if "." in t.value or "e" in t.value.lower():

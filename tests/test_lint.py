@@ -157,21 +157,30 @@ def test_no_raw_membership_mixing_outside_kernels():
 
 
 def test_no_raw_sleeps_or_timeouts_in_parallel():
-    """Robustness gate (ISSUE 2): presto_tpu/parallel/retry.py is the
-    ONLY module in the parallel package allowed to call `time.sleep` or
-    hard-code a timeout.  Everything else must route waits through
-    retry.Backoff / retry._sleep and derive per-call timeouts from the
+    """Robustness gate (ISSUE 2, extended by ISSUE 6 to the serving
+    modules): presto_tpu/parallel/retry.py is the ONLY module in the
+    parallel package allowed to call `time.sleep` or hard-code a
+    timeout; everything else routes waits through retry.Backoff /
+    retry._sleep and derives per-call timeouts from the
     retry.*_TIMEOUT_S constants (each capped by the query Deadline), so
-    one query-level budget governs every RPC.  This test forbids NEW
-    call sites from creeping back in."""
+    one query-level budget governs every RPC.  The serving tier
+    (server/serving.py, server/protocol.py, server/resource_groups.py)
+    is held to the same rule: no time.sleep at all, and every wait's
+    timeout is a NAMED module constant (ADMIT_POLL_S, LONG_POLL_S, ...)
+    or a session-property-derived value — never an inline number.  This
+    test forbids NEW call sites from creeping back in."""
     import ast
 
     pdir = os.path.join(ROOT, "presto_tpu", "parallel")
+    checked = [(fn, os.path.join(pdir, fn))
+               for fn in sorted(os.listdir(pdir))
+               if fn.endswith(".py") and fn != "retry.py"]
+    sdir = os.path.join(ROOT, "presto_tpu", "server")
+    checked += [(f"server/{fn}", os.path.join(sdir, fn))
+                for fn in ("serving.py", "protocol.py",
+                           "resource_groups.py")]
     bad = []
-    for fn in sorted(os.listdir(pdir)):
-        if not fn.endswith(".py") or fn == "retry.py":
-            continue
-        path = os.path.join(pdir, fn)
+    for fn, path in checked:
         with open(path, encoding="utf-8") as f:
             tree = ast.parse(f.read(), path)
         for node in ast.walk(tree):
@@ -182,13 +191,14 @@ def test_no_raw_sleeps_or_timeouts_in_parallel():
                     and isinstance(func.value, ast.Name) \
                     and func.value.id == "time":
                 bad.append(f"{fn}:{node.lineno}: bare time.sleep() — "
-                           "use retry.Backoff / retry._sleep")
+                           "use retry.Backoff / an Event wait on a "
+                           "named-constant timeout")
             for kw in node.keywords:
                 if kw.arg == "timeout" \
                         and isinstance(kw.value, ast.Constant) \
                         and isinstance(kw.value.value, (int, float)):
                     bad.append(
                         f"{fn}:{kw.value.lineno}: hard-coded "
-                        f"timeout={kw.value.value!r} — use a "
-                        "retry.*_TIMEOUT_S constant")
+                        f"timeout={kw.value.value!r} — use a named "
+                        "*_S / *_TIMEOUT_S constant")
     assert not bad, "\n".join(bad)
