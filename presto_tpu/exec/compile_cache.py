@@ -225,6 +225,42 @@ def avals_fingerprint(tree) -> str:
     return fingerprint(str(treedef), shapes)
 
 
+def fused_key(fragment_bytes: bytes, ndev: int, session,
+              scalar_results, ext_inputs) -> Optional[str]:
+    """Executable-memo key for a fused super-fragment (fragment fusion,
+    plan/distribute.fuse_fragments): one executable per (fused pipeline
+    fingerprint, mesh shape, catalog identity+version, property map),
+    reused forever — the cluster analog of the chunked/compiled memo
+    keys, compounding with the persistent disk cache.
+
+    Host values baked into the trace must ride the key: coordinator-
+    evaluated scalar-subquery results, and the dictionary VALUES of any
+    string-typed external exchange input (partition_hash bakes a
+    host-computed per-code hash LUT).  Oversized string externals
+    return None — the build still runs, uncached."""
+    h = hashlib.sha256(fragment_bytes)
+    for _pid, val in sorted(scalar_results.items()):
+        h.update(repr(val).encode())
+        h.update(b"\x00")
+    nvals = 0
+    for eid in sorted(ext_inputs):
+        for sym in sorted(ext_inputs[eid]["cols"]):
+            data, _valid = ext_inputs[eid]["cols"][sym]
+            import numpy as _np
+
+            arr = _np.asarray(data)
+            if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+                uniq = _np.unique(arr.astype(str))
+                nvals += len(uniq)
+                if nvals > 100_000:
+                    return None  # hashing the dictionary costs too much
+                for v in uniq.tolist():
+                    h.update(str(v).encode("utf-8", "replace"))
+                    h.update(b"\x01")
+    return fingerprint("fused", h.hexdigest(), ndev,
+                       session_fingerprint(session))
+
+
 def session_fingerprint(session) -> tuple:
     """The session-dependent key components every executable bakes in at
     trace time: catalog identity+version and the full property map."""

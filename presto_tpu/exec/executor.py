@@ -51,7 +51,9 @@ def _merge_sort_stats(stats, counts: dict) -> None:
     for k in ("sorts_taken", "sorts_elided", "sort_memo_hits",
               "ordering_guard_trips",
               "df_filters_produced", "df_filters_applied",
-              "df_rows_pruned", "df_chunks_pruned", "df_splits_pruned"):
+              "df_rows_pruned", "df_chunks_pruned", "df_splits_pruned",
+              "fragments_fused", "exchange_bytes_host",
+              "exchange_bytes_collective"):
         setattr(stats, k, getattr(stats, k, 0) + int(counts.get(k, 0)))
     if counts.get("df_wait_ms"):
         stats.df_wait_ms = getattr(stats, "df_wait_ms", 0.0) \

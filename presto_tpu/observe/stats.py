@@ -88,6 +88,17 @@ class QueryStats:
     df_chunks_pruned: int = 0
     df_splits_pruned: int = 0
     df_wait_ms: float = 0.0
+    # fragment fusion (plan/distribute.fuse_fragments): fragments this
+    # cluster query spliced into fused shard_map super-fragments (0 =
+    # the per-fragment HTTP path ran, incl. after a fused-attempt
+    # fallback), exchange page bytes that crossed the host HTTP path
+    # (pulled for non-result exchange edges: coordinator-observed +
+    # fused-task counters; per-worker aggregates live on /v1/info), and
+    # the trace-time estimate of bytes the fused program moved through
+    # ICI collectives instead (all_to_all / all_gather payloads x ndev).
+    fragments_fused: int = 0
+    exchange_bytes_host: int = 0
+    exchange_bytes_collective: int = 0
     # serving tier (server/serving.py): prepared-statement economics —
     # binds through the typed aval path (plan + executable shared across
     # parameter VALUES), warm binds that skipped parse/plan/compile

@@ -665,6 +665,20 @@ class _ClusterExecutor:
         self.df_counts: Dict[str, float] = {}
         self._df_summaries: Dict[str, dict] = {}
         self._df_pushed: set = set()
+        # fragment fusion: does this task execute a fused super-fragment
+        # (plan root with inline Exchange nodes) over the local mesh?
+        self._fused_ndev = int(spec.properties.get("fused_ndev") or 0)
+        # exchange-economics accounting (fragment fusion, observe/stats):
+        # exchange_bytes_host counts page bytes PULLED for exchange
+        # edges whose producer is not the result root (result delivery
+        # is paid identically by both paths and is not an exchange);
+        # exchange_bytes_collective is the fused program's trace-time
+        # ICI estimate (parallel/dist_executor.DistExecutor).
+        self.counters: Dict[str, int] = {}
+        self._pulled_host: Dict[int, dict] = {}  # eid -> host columns
+
+    def _count(self, key: str, n: int = 1) -> None:
+        self.counters[key] = self.counters.get(key, 0) + int(n)
 
     def _exchange_batches(self):
         inputs = {}
@@ -678,6 +692,7 @@ class _ClusterExecutor:
                          key=lambda i: 0 if i["eid"] in push_eids else 1)
         for inp in ordered:
             merged, batch = self._pull_one(inp)
+            self._pulled_host[inp["eid"]] = merged
             inputs[f"__exch_{inp['eid']}"] = batch
             for fid, cfg in push_cfg.items():
                 if cfg["eid"] == inp["eid"] and fid not in self._df_pushed:
@@ -712,6 +727,12 @@ class _ClusterExecutor:
             for buf in pull_pages(up[0], up[1], bucket, ack=exclusive,
                                   slot=up):
                 if buf:
+                    if not inp.get("result_root"):
+                        # bytes that crossed the host HTTP path for an
+                        # inter-stage exchange (fragment-fusion metric;
+                        # result delivery is excluded — both paths pay
+                        # it identically)
+                        self._count("exchange_bytes_host", len(buf))
                     parts.append(unpack_columns(buf))
         merged: Dict[str, tuple] = {}
         types = inp["types"]
@@ -733,6 +754,12 @@ class _ClusterExecutor:
                                 else t.numpy_dtype())
                 valid = None
             merged[name] = (data, valid)
+        if self._fused_ndev:
+            # the fused path device-places these itself, sharded or
+            # replicated over the mesh (dist_executor._ext_*_batch) —
+            # building a throwaway single-device Batch here would
+            # upload every external input twice
+            return merged, None
         cols = {}
         n = 0
         for name, (data, valid) in merged.items():
@@ -921,10 +948,15 @@ class _ClusterExecutor:
         for k, v in ex.sort_stats.items():
             if k.startswith("df_") and v:
                 self.df_counts[k] = self.df_counts.get(k, 0) + v
+        return self._fetch_out_cols(out)
 
-        # materialize to host with validity preserved — ONE device_get for
-        # the whole batch (per-column fetches pay a full RPC round trip
-        # each on remote XLA clients; see batch.to_numpy)
+    def _fetch_out_cols(self, out):
+        """Device Batch -> host {sym: (data, valid)} of live rows, with
+        dictionary decode — ONE device_get for the whole batch
+        (per-column fetches pay a full RPC round trip each on remote
+        XLA clients; see batch.to_numpy)."""
+        import jax
+
         pulled = jax.device_get(
             (out.sel, {sym: (out.columns[sym].data, out.columns[sym].valid)
                        for sym in self.spec.out_symbols}))
@@ -941,6 +973,35 @@ class _ClusterExecutor:
             valid = None if valid is None else np.asarray(valid)[live]
             cols[sym] = (data, valid)
         return cols
+
+    def _exec_fused(self, root):
+        """Fragment fusion: execute a fused super-fragment (inline
+        Exchange nodes) as ONE shard_map program over this process's
+        mesh (parallel/dist_executor.run_fused_fragment).  A tripped
+        guard (exchange capacity overflow / static-shape violation)
+        raises FusedGuardTripped -> task FAILED -> the coordinator
+        retries on the per-fragment HTTP path."""
+        from presto_tpu.parallel import dist_executor as DX
+
+        ext = {inp["eid"]: {"kind": inp["kind"],
+                            "cols": self._pulled_host[inp["eid"]]}
+               for inp in self.spec.inputs}
+        out, guard, counters = DX.run_fused_fragment(
+            self.session, root, self._fused_ndev, ext,
+            dict(self.spec.scalar_results), self.spec.fragment)
+        if guard:
+            raise DX.FusedGuardTripped(
+                "fused super-fragment guard tripped (capacity overflow "
+                "or static assumption violated)")
+        self._count("tasks_fused")
+        self._count("fragments_fused",
+                    int(self.spec.properties.get("fragments_fused") or 0))
+        self._count("exchange_bytes_collective",
+                    int(counters.get("exchange_bytes_collective", 0)))
+        for k, v in counters.items():
+            if k.startswith("df_") and v:
+                self.df_counts[k] = self.df_counts.get(k, 0) + v
+        return self._fetch_out_cols(out)
 
     def _publish_cols(self, cols):
         """Partition one superstep's output and publish a page per
@@ -999,6 +1060,19 @@ class _ClusterExecutor:
 
     def run(self) -> None:
         root = plan_serde.loads(self.spec.fragment)
+        if self._fused_ndev:
+            # fused super-fragment: pull the (rare) non-fused external
+            # inputs, then run the whole pipeline as one mesh program.
+            # The dynamic-filter side channel is skipped — filters whose
+            # producer join lives inside the fused trace are produced
+            # and applied IN-trace by the executor itself.
+            self._exchange_batches()
+            cols = self._exec_fused(root)
+            if self.spec.out_kind == "range":
+                self._publish_range(cols)
+            else:
+                self._publish_cols(cols)
+            return
         # dynamic filtering: bounded wait for side-channel summaries
         # BEFORE any scan executes (wait_ms=0 skips straight through)
         self._df_summaries = self._df_receive()
@@ -1090,13 +1164,27 @@ class WorkerServer:
 
     def __init__(self, catalog_spec: str, host: str = "127.0.0.1",
                  port: int = 0, secret: Optional[bytes] = None,
-                 faults: Optional["F.FaultPlan"] = None):
+                 faults: Optional["F.FaultPlan"] = None,
+                 mesh_devices: Optional[int] = None):
         import presto_tpu
 
         # scripted failures for THIS worker (tests pass a plan per
         # server; subprocess workers inherit PRESTO_TPU_FAULTS)
         self.faults = faults if faults is not None else F.FaultPlan.from_env()
         self.crashed = False
+        # fragment fusion: a worker that EXCLUSIVELY owns a local device
+        # mesh declares it (operator-granted: PRESTO_TPU_WORKER_MESH or
+        # the constructor/--mesh arg, never inferred — an in-process
+        # worker shares its process's devices with the coordinator and
+        # other workers and must not claim them).  The coordinator
+        # schedules fused super-fragments onto declared meshes only.
+        if mesh_devices is None:
+            mesh_devices = int(
+                os.environ.get("PRESTO_TPU_WORKER_MESH", "0") or 0)
+        self.mesh_devices = max(int(mesh_devices), 0)
+        import socket as _socket
+
+        self.mesh_id = f"{_socket.gethostname()}:{os.getpid()}"
         self.secret = secret if secret is not None else cluster_secret()
         if self.secret is None and not _is_loopback(host):
             raise ValueError(
@@ -1121,7 +1209,15 @@ class WorkerServer:
                          # per-task filter activity aggregates here so
                          # tests/operators can see cluster-wide pruning
                          "df_filters_produced": 0, "df_filters_applied": 0,
-                         "df_rows_pruned": 0, "df_wait_ms": 0.0}
+                         "df_rows_pruned": 0, "df_wait_ms": 0.0,
+                         # fragment fusion (plan/distribute.py): fused
+                         # super-fragment tasks executed here, original
+                         # fragments they absorbed, exchange page bytes
+                         # this worker pulled over HTTP, and the fused
+                         # programs' trace-time ICI byte estimate
+                         "tasks_fused": 0, "fragments_fused": 0,
+                         "exchange_bytes_host": 0,
+                         "exchange_bytes_collective": 0}
         self.lock = threading.Lock()
         self.exec_lock = threading.Lock()
         handler = _make_worker_handler(self)
@@ -1172,6 +1268,7 @@ class WorkerServer:
         # pool NOW instead of at first-page time.  Same kill switches
         # as compile-ahead; never affects results.
         if spec.inputs and not getattr(spec, "replay", False) \
+                and not spec.properties.get("fused_ndev") \
                 and CC.ahead_enabled(self.session):
             if CC.submit(lambda: _warm_task(self.session, spec)):
                 with self.lock:
@@ -1295,6 +1392,15 @@ class WorkerServer:
                         else:
                             self.counters[k] = \
                                 self.counters.get(k, 0) + int(v)
+                    for k, v in cex.counters.items():
+                        self.counters[k] = \
+                            self.counters.get(k, 0) + int(v)
+                    # per-task exchange/fusion counters ride the status
+                    # response so the coordinator can fold them into
+                    # this query's QueryStats without extra endpoints
+                    task["counters"] = {**{k: v for k, v
+                                           in cex.df_counts.items()},
+                                        **dict(cex.counters)}
                 if attempt_dir is not None:
                     os.makedirs(attempt_dir, exist_ok=True)
                     with open(os.path.join(attempt_dir, "_DONE"),
@@ -1429,6 +1535,10 @@ def _make_worker_handler(server: WorkerServer):
                 self._send(200, json.dumps(
                     {"nodeId": f"worker:{server.port}",
                      "state": "active",
+                     # fragment fusion: the mesh this worker DECLARES
+                     # it owns exclusively (0 = none; never inferred)
+                     "meshDevices": server.mesh_devices,
+                     "meshId": server.mesh_id,
                      "counters": counters}).encode(), "application/json")
                 return
             if len(parts) >= 4 and parts[:2] == ["v1", "task"]:
@@ -1441,7 +1551,8 @@ def _make_worker_handler(server: WorkerServer):
                 if parts[3] == "status":
                     self._send(200, json.dumps(
                         {"state": task["state"],
-                         "error": task["error"]}).encode(),
+                         "error": task["error"],
+                         "counters": task.get("counters") or {}}).encode(),
                         "application/json")
                     return
                 # /v1/task/{tid}/results/{bucket}/{token}[/ack]
@@ -1527,6 +1638,29 @@ def _make_worker_handler(server: WorkerServer):
                 self._send(404, b"{}")
 
     return Handler
+
+
+def _coordinator_passthrough(fragments: List[Fragment]) -> List[Fragment]:
+    """When fusion absorbed the plan's ROOT fragment, the fused
+    super-fragment (which ends in the Output node) must run on the mesh
+    owner, not the coordinator — so the coordinator gets a trivial
+    passthrough fragment that pulls the fused task's gathered result
+    pages.  That pull is result DELIVERY (both execution models pay it
+    identically), not an inter-stage exchange."""
+    from presto_tpu.plan import nodes as P
+
+    last = fragments[-1]
+    if not getattr(last, "fused", False):
+        return fragments
+    eid = max([i.eid for f in fragments for i in f.inputs],
+              default=-1) + 1
+    types = dict(last.root.outputs())
+    scan = P.TableScan(f"__exch_{eid}", {s: s for s in types}, types)
+    passthrough = Fragment(
+        fid=len(fragments), root=scan,
+        inputs=[ExchangeInput(eid, "gather", [], last.fid)],
+        has_scan=False, on_workers=False, out_kind="gather", out_keys=[])
+    return fragments + [passthrough]
 
 
 # ---------------------------------------------------------------------------
@@ -1666,6 +1800,45 @@ class ClusterSession:
             probation_s=float(self.session.properties.get(
                 "cluster_health_probation_s", 5.0)))
         self._benched: List[str] = []  # quarantined, awaiting probation
+        # fragment fusion: per-worker mesh declarations (/v1/info
+        # meshDevices/meshId), fetched lazily once per worker; the
+        # fused-fragment count + exchange counters of the last
+        # successful attempt, folded into QueryStats by sql()
+        self._worker_meta: Dict[str, dict] = {}
+        self._fused_count = 0
+        self._coord_counters: Dict[str, int] = {}
+
+    def _worker_info(self, url: str, ctx: R.RunContext) -> dict:
+        """Cached /v1/info mesh declaration of one worker ({} when the
+        worker can't answer — it simply isn't a fusion target)."""
+        meta = self._worker_meta.get(url)
+        if meta is None:
+            try:
+                info = json.loads(_http(f"{url}/v1/info",
+                                        timeout=R.PROBE_TIMEOUT_S,
+                                        ctx=ctx))
+                meta = {"meshDevices": int(info.get("meshDevices") or 0),
+                        "meshId": info.get("meshId") or url}
+            except R.DeadlineExceeded:
+                raise
+            except Exception:  # noqa: BLE001 — probe failure = no mesh
+                meta = {"meshDevices": 0, "meshId": url}
+            self._worker_meta[url] = meta
+        return meta
+
+    def _fusion_mesh(self, layout, ctx) -> Tuple[Optional[str], int]:
+        """Placement-aware fusion target: the worker declaring the
+        largest exclusively-owned mesh of at least
+        `fragment_fusion_min_devices` chips (None = every exchange edge
+        is cross-host and nothing fuses)."""
+        min_dev = int(self.session.properties.get(
+            "fragment_fusion_min_devices", 2))
+        best, best_n = None, 0
+        for url in dict.fromkeys(layout):
+            n = self._worker_info(url, ctx)["meshDevices"]
+            if n >= max(min_dev, 2) and n > best_n:
+                best, best_n = url, n
+        return best, best_n
 
     def _query_ctx(self, query_id: str = "") -> R.RunContext:
         """Per-query RunContext: ONE deadline budget every RPC timeout
@@ -1734,7 +1907,16 @@ class ClusterSession:
             from presto_tpu.exec.executor import _merge_sort_stats
 
             _merge_sort_stats(mon.stats, self._coord_df)
+        # fragment fusion: the successful attempt's plan-time decision
+        # (fragments spliced) + the exchange-economics counters the
+        # coordinator observed / collected from fused task statuses
+        mon.stats.fragments_fused = self._fused_count
+        for k in ("exchange_bytes_host", "exchange_bytes_collective"):
+            setattr(mon.stats, k, getattr(mon.stats, k, 0)
+                    + int(self._coord_counters.get(k, 0)))
         mon.finish(result.rows)
+        if getattr(result, "stats", None) is None:
+            result.stats = mon.stats  # race-free vs session.last_stats
         return result
 
     def _sql_attempts(self, text: str, ctx: R.RunContext):
@@ -1765,13 +1947,16 @@ class ClusterSession:
         # dead workers' slots onto survivors.
         layout = list(self.workers)
         try:
+            fuse_ok = True
             for attempt in range(attempts):
                 try:
                     return self._run_distributed(plan, layout, ddir,
-                                                 attempt)
+                                                 attempt,
+                                                 allow_fusion=fuse_ok)
                 except (Undistributable, NotImplementedError):
                     # plan shape the cluster can't place — single-node
                     # fallback
+                    self._fused_count = 0
                     return self.session.sql(text)
                 except R.DeadlineExceeded:
                     # the deadline is a query-level budget: never retry
@@ -1784,6 +1969,7 @@ class ClusterSession:
                     # re-run; completed tasks replay from the durable
                     # store when enabled.  Survivorship is the circuit
                     # breaker's call, not a one-shot probe's.
+                    was_fused = self._fused_count > 0
                     survivors = []
                     for url in self.workers:
                         if self.health.probe(url,
@@ -1792,6 +1978,25 @@ class ClusterSession:
                         elif url not in self._benched:
                             self._benched.append(url)
                             ctx.count("workers_quarantined", url=url)
+                    if was_fused:
+                        # ANY failure of a fused attempt (guard trip,
+                        # fused-task fault, mesh-owner crash) degrades
+                        # to the per-fragment HTTP path — a same-pool
+                        # retry is NOT deterministic here because the
+                        # execution model changes (the ISSUE's
+                        # byte-identical fallback contract)
+                        fuse_ok = False
+                        ctx.count("fused_fallbacks")
+                        if attempt == attempts - 1:
+                            raise
+                        if survivors:
+                            layout = [u if u in survivors
+                                      else survivors[i % len(survivors)]
+                                      for i, u in enumerate(layout)]
+                            self.workers = survivors
+                        ctx.count("query_retries",
+                                  survivors=len(survivors))
+                        continue
                     if not survivors or attempt == attempts - 1 \
                             or set(survivors) >= set(layout):
                         # same pool => deterministic failure; re-running
@@ -1836,7 +2041,9 @@ class ClusterSession:
             ex.ctx.scalar_results.update(scalar_results)
             return _single_value(ex.exec_node(sub))
 
-    def _run_distributed(self, plan, layout=None, ddir=None, attempt=0):
+    def _run_distributed(self, plan, layout=None, ddir=None, attempt=0,
+                         allow_fusion=True):
+        from presto_tpu.plan import distribute as DIST
         from presto_tpu.plan import nodes as P
         from presto_tpu.plan.distribute import distribute
         from presto_tpu.session import QueryResult
@@ -1845,6 +2052,11 @@ class ClusterSession:
 
         layout = layout if layout is not None else list(self.workers)
         nw = len(layout)
+        # per-attempt counter reset FIRST: an attempt that dies during
+        # planning must not leak the previous attempt's fusion counters
+        # into this query's stats
+        self._fused_count = 0
+        self._coord_counters = {}
         scalar_results: Dict[int, tuple] = {}
         for pid, sub in sorted(plan.subplans.items()):
             # deepcopy: distribute() rewrites nodes in place, and a
@@ -1854,6 +2066,28 @@ class ClusterSession:
         dplan = distribute(P.QueryPlan(copy.deepcopy(plan.root), {}),
                            self.session, nw)
         fragments = cut_fragments(dplan.root)
+        # fragment fusion (plan/distribute.fuse_fragments): when a
+        # worker declares an exclusively-owned mesh, every exchange
+        # edge between fragments placed on that mesh is mesh-local —
+        # splice them back into one traced shard_map program and
+        # schedule it as ONE task on the mesh owner.  Cross-host edges
+        # (no declared mesh, or kinds excluded by
+        # fragment_fusion_kinds) keep the per-fragment HTTP path.
+        if allow_fusion and len(fragments) > 1 \
+                and DIST.fusion_enabled(self.session):
+            mesh_url, mesh_ndev = self._fusion_mesh(layout, R.current())
+            if mesh_url is not None:
+                kinds = DIST.fusion_kinds(self.session)
+                fused, nfused = DIST.fuse_fragments(
+                    fragments, lambda frag, inp: inp.kind in kinds)
+                if nfused:
+                    fused = _coordinator_passthrough(fused)
+                    for f in fused:
+                        if getattr(f, "fused", False):
+                            f.fused_url = mesh_url
+                            f.fused_ndev = mesh_ndev
+                    fragments = fused
+                    self._fused_count = nfused
         coordinator_result = self._schedule(fragments, scalar_results,
                                             layout, ddir, attempt)
 
@@ -1892,6 +2126,11 @@ class ClusterSession:
         for frag in fragments:
             if frag.fid == nfr - 1:
                 run_on_of[frag.fid] = [None]  # coordinator-local output
+            elif getattr(frag, "fused", False):
+                # fused super-fragment: ONE task on the declared-mesh
+                # owner; the shard_map supplies the parallelism the
+                # per-fragment path got from the worker fan-out
+                run_on_of[frag.fid] = [frag.fused_url]
             elif frag.on_workers:
                 run_on_of[frag.fid] = list(layout)
             else:
@@ -1970,13 +2209,20 @@ class ClusterSession:
         df_push_of: Dict[int, dict] = {}
         df_expect_of: Dict[int, dict] = {}
         if DF.enabled(self.session):
+            # fused super-fragments are excluded from the side channel:
+            # a filter whose producer join lives inside the fused trace
+            # is produced AND applied in-trace by the executor itself
             wiring = {f.fid: _rf_fragment_wiring(f) for f in fragments}
             for frag in fragments:
+                if getattr(frag, "fused", False):
+                    continue
                 _produced, pushable, _consumed = wiring[frag.fid]
                 for fid, cfg in pushable.items():
                     targets = []
                     remote_fids = []
                     for g in fragments:
+                        if getattr(g, "fused", False):
+                            continue
                         gp, _gpu, gc = wiring[g.fid]
                         if fid in gc and fid not in gp:
                             remote_fids.append(g.fid)
@@ -2020,6 +2266,8 @@ class ClusterSession:
                 if phases[frag.fid] != phase:
                     continue
                 out_symbols = [s for s, _ in frag.root.outputs()]
+                from presto_tpu.plan import nodes as _P
+
                 inputs = []
                 for inp in frag.inputs:
                     prod = fragments[inp.producer]
@@ -2027,6 +2275,10 @@ class ClusterSession:
                         "eid": inp.eid, "kind": inp.kind,
                         "types": dict(prod.root.outputs()),
                         "upstreams": placements[inp.producer],
+                        # pulls from the result-root producer are result
+                        # delivery, not an inter-stage exchange — the
+                        # exchange_bytes_host counter skips them
+                        "result_root": isinstance(prod.root, _P.Output),
                     })
                 run_on = run_on_of[frag.fid]
                 if frag.out_kind in ("repartition", "scatter", "range"):
@@ -2038,8 +2290,14 @@ class ClusterSession:
                 tasks: List[list] = []
                 rem = ctx.deadline.remaining()
                 deadline_s = None if rem == float("inf") else max(rem, 0.0)
+                fused = getattr(frag, "fused", False)
                 for w, (url, tid) in enumerate(placements[frag.fid]):
-                    dkey = f"f{frag.fid}_w{w}" if ddir is not None else None
+                    # fused tasks skip the durable exchange: the fused
+                    # fragment layout differs from the retry's cut
+                    # layout, so a durable key could alias a DIFFERENT
+                    # fragment's pages onto the unfused re-run
+                    dkey = f"f{frag.fid}_w{w}" \
+                        if ddir is not None and not fused else None
                     # a completed durable output from a prior attempt means
                     # this slot REPLAYS from disk — only the victim's lost
                     # work re-executes (per-bucket retry, P12)
@@ -2078,6 +2336,12 @@ class ClusterSession:
                         durable_dir=ddir, durable_key=dkey,
                         attempt=attempt, replay=replay,
                     )
+                    if fused:
+                        # the worker routes this task through the fused
+                        # mesh path (run_fused_fragment) at this ndev
+                        spec.properties["fused_ndev"] = frag.fused_ndev
+                        spec.properties["fragments_fused"] = \
+                            len(getattr(frag, "fused_fids", []))
                     pushcfg = df_push_of.get(frag.fid)
                     if pushcfg:
                         spec.properties["df_push"] = {
@@ -2133,6 +2397,30 @@ class ClusterSession:
         # coordinator-side filter activity folds into this query's stats
         # (worker-side activity aggregates on each worker's /v1/info)
         self._coord_df = dict(cex.df_counts)
+        # exchange economics: coordinator-observed host bytes, plus the
+        # fused tasks' counters (ICI byte estimate, external-input host
+        # bytes) pulled from their status — only when fusion ran, so
+        # the unfused path's RPC sequence stays byte-identical for the
+        # deterministic fault plans
+        for k, v in cex.counters.items():
+            self._coord_counters[k] = \
+                self._coord_counters.get(k, 0) + int(v)
+        if self._fused_count:
+            for frag in fragments:
+                if not getattr(frag, "fused", False):
+                    continue
+                for slot in placements[frag.fid]:
+                    try:
+                        st = json.loads(_http(
+                            f"{slot[0]}/v1/task/{slot[1]}/status",
+                            ctx=ctx))
+                        for k, v in (st.get("counters") or {}).items():
+                            if k.startswith("exchange_bytes_"):
+                                self._coord_counters[k] = \
+                                    self._coord_counters.get(k, 0) \
+                                    + int(v)
+                    except Exception:  # noqa: BLE001 — telemetry only
+                        pass
         merged = [unpack_columns(p) for p in pages.get(0, [])]
         # single final page expected (gather output); concat defensively
         if len(merged) == 1:
@@ -2290,13 +2578,18 @@ def main(argv=None):
     ap.add_argument("--platform", default="cpu",
                     help="jax platform for this worker (default cpu: "
                          "worker processes must not contend for the TPU)")
+    ap.add_argument("--mesh", type=int, default=None,
+                    help="device-mesh size this worker EXCLUSIVELY owns "
+                         "(fragment-fusion target; default env "
+                         "PRESTO_TPU_WORKER_MESH, else 0 = no mesh)")
     args = ap.parse_args(argv)
     os.environ["PRESTO_TPU_WORKER_PROC"] = "1"  # crash faults really exit
     if args.platform != "default":
         import jax
 
         jax.config.update("jax_platforms", args.platform)
-    w = WorkerServer(args.catalog, args.host, args.port)
+    w = WorkerServer(args.catalog, args.host, args.port,
+                     mesh_devices=args.mesh)
     print(json.dumps({"url": w.url}), flush=True)
     w.serve_forever()
 

@@ -38,6 +38,12 @@ from presto_tpu.plan import nodes as P
 from presto_tpu.plan.distribute import Undistributable, distribute
 
 
+class FusedGuardTripped(Exception):
+    """A fused super-fragment's traced guard fired at runtime (exchange
+    capacity overflow / static-shape violation): the task reports
+    FAILED and the coordinator retries on the per-fragment HTTP path."""
+
+
 class DistExecutor(Executor):
     """Per-shard executor: inherits the whole static (compiled-mode)
     operator repertoire and adds Exchange lowering."""
@@ -45,8 +51,9 @@ class DistExecutor(Executor):
     # per-shard scan slices break the index join's whole-table layout
     allow_index_join = False
 
-    def __init__(self, session, ndev: int, scan_inputs):
-        super().__init__(session, static=True, scan_inputs=scan_inputs)
+    def __init__(self, session, ndev: int, scan_inputs, sort_stats=None):
+        super().__init__(session, static=True, scan_inputs=scan_inputs,
+                         sort_stats=sort_stats)
         self.ndev = ndev
 
     def _rf_build_complete(self, node) -> bool:
@@ -68,8 +75,22 @@ class DistExecutor(Executor):
 
         return complete(node.right)
 
+    def _exchange_bytes(self, b: Batch) -> int:
+        """Trace-time byte estimate of one collective exchange: every
+        shard contributes its per-shard payload, so the mesh moves
+        ~per-shard-bytes x ndev over ICI (never the host)."""
+        total = int(b.sel.size)  # bool mask, 1 byte/row
+        for c in b.columns.values():
+            total += int(c.data.size) * c.data.dtype.itemsize
+            if c.valid is not None:
+                total += int(c.valid.size)
+        return total * self.ndev
+
     def _exec_exchange(self, node: P.Exchange) -> Batch:
         b = self.exec_node(node.source)
+        if node.kind != "scatter":  # scatter is a sel mask: no transfer
+            self._count("exchange_bytes_collective",
+                        self._exchange_bytes(b))
         if node.kind in ("gather", "broadcast"):
             return EX.all_gather_batch(b, AXIS)
         if node.kind == "scatter":
@@ -101,6 +122,16 @@ def _traced_single_value(b: Batch, guards: list):
     if col.type.is_decimal:
         val = val.astype(jnp.float64) / (10 ** col.type.decimal_scale)
     return val, valid
+
+
+def _shard_mapped(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions (check_vma vs pre-0.5 check_rep)."""
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
 
 
 # ---------------------------------------------------------------------------
@@ -172,12 +203,7 @@ def _build_and_run(session, stmt, cache, key, ndev):
         g = jax.lax.psum(g.astype(jnp.int32), AXIS) > 0
         return out, g
 
-    try:
-        sharded = shard_map(fn, mesh=mesh, in_specs=(PS(AXIS),),
-                            out_specs=PS(), check_vma=False)
-    except TypeError:  # pre-0.5 jax spells the kwarg check_rep
-        sharded = shard_map(fn, mesh=mesh, in_specs=(PS(AXIS),),
-                            out_specs=PS(), check_rep=False)
+    sharded = _shard_mapped(fn, mesh, (PS(AXIS),), PS())
     # counted build (exec/compile_cache.py): the whole-mesh program's
     # compile lands in this query's compile-economics counters; the
     # live jit (no AOT pin) keeps input resharding automatic
@@ -254,3 +280,139 @@ def sharded_scan(table, node: P.TableScan, mesh, ndev: int) -> Batch:
         c = cache[colname]
         cols[sym] = Column(c.data, c.valid, node.types[sym], c.dictionary)
     return Batch(cols, cache[sel_key])
+
+
+# ---------------------------------------------------------------------------
+# fused super-fragments (fragment fusion, plan/distribute.fuse_fragments)
+# ---------------------------------------------------------------------------
+
+
+def _ext_shard_batch(host_cols, node: P.TableScan, mesh, ndev: int) -> Batch:
+    """External (non-fused) exchange input -> row-sharded device Batch:
+    rows padded to a multiple of ndev with dead (sel=False) rows, like
+    sharded_scan.  The fused plan re-establishes hashed/range
+    distribution in-trace via the wrap exchange the fusion pass spliced
+    in; 'any'-distributed inputs (scatter) are correct as-is."""
+    from presto_tpu.batch import column_from_numpy
+
+    spec = NamedSharding(mesh, PS(AXIS))
+    n = 0
+    for _sym, (data, _valid) in host_cols.items():
+        n = len(data)
+        break
+    npad = max(int(np.ceil(n / ndev)) * ndev, ndev)
+    cols = {}
+    for sym in node.assignments.values():
+        data, valid = host_cols[sym]
+        col = column_from_numpy(np.asarray(data), node.types[sym],
+                                valid if valid is not None else None)
+        arr = np.asarray(col.data)
+        arr = np.concatenate(
+            [arr, np.zeros((npad - n,) + arr.shape[1:], dtype=arr.dtype)])
+        v = col.valid
+        if v is not None:
+            v = jax.device_put(np.concatenate(
+                [np.asarray(v), np.zeros((npad - n,), bool)]), spec)
+        cols[sym] = Column(jax.device_put(arr, spec), v, col.type,
+                           col.dictionary)
+    sel = jax.device_put(np.arange(npad) < n, spec)
+    return Batch(cols, sel)
+
+
+def _ext_repl_batch(host_cols, node: P.TableScan, mesh) -> Batch:
+    """External gather/broadcast input -> replicated device Batch
+    (every shard sees every row, matching the edge's semantics)."""
+    from presto_tpu.batch import column_from_numpy
+
+    spec = NamedSharding(mesh, PS())
+    n = 0
+    cols = {}
+    for sym in node.assignments.values():
+        data, valid = host_cols[sym]
+        col = column_from_numpy(np.asarray(data), node.types[sym],
+                                valid if valid is not None else None)
+        v = None if col.valid is None else \
+            jax.device_put(np.asarray(col.valid), spec)
+        cols[sym] = Column(jax.device_put(np.asarray(col.data), spec), v,
+                           col.type, col.dictionary)
+        n = len(data)
+    return Batch(cols, jax.device_put(np.ones((n,), bool), spec))
+
+
+def run_fused_fragment(session, root, ndev: int, ext_inputs,
+                       scalar_results, fragment_bytes: bytes):
+    """Execute a fused super-fragment — a plan root with INLINE Exchange
+    nodes (plan/distribute.fuse_fragments) — as ONE shard_map program
+    over this process's local mesh: base-table scans shard over the
+    mesh, every inline exchange lowers to a collective, and the stages
+    between them never touch the host.
+
+    `ext_inputs`: {eid: {"kind", "cols" {sym: (data, valid)}}} — the
+    already-pulled host columns of NON-fused exchange edges.  `scalar
+    _results`: {pid: (value, valid)} host scalars baked into the trace
+    (they ride the executable-memo key).
+
+    Returns (out_batch, guard_host, counters): the device result (one
+    replicated copy, or per-shard concatenation when the fused root is
+    sharded), the host guard bool (True => the caller must degrade to
+    the per-fragment path), and the trace-time exchange counters
+    {exchange_bytes_collective, ...}.  The compiled program is memoized
+    process-wide (exec/compile_cache.fused_key) — one executable per
+    (fused pipeline, mesh), reused across queries and sessions."""
+    from presto_tpu.exec import executor as X
+    from presto_tpu.plan import distribute as D
+
+    mesh = make_mesh(ndev)
+    scan_nodes: List[P.TableScan] = []
+    X._collect_tablescans(root, scan_nodes)
+    real = [n for n in scan_nodes if not n.table.startswith("__exch_")]
+    exch = [n for n in scan_nodes if n.table.startswith("__exch_")]
+    kind_of = {eid: e["kind"] for eid, e in ext_inputs.items()}
+    shard_nodes = [n for n in exch
+                   if kind_of.get(int(n.table[len("__exch_"):]))
+                   not in ("gather", "broadcast")]
+    repl_nodes = [n for n in exch if n not in shard_nodes]
+    replicated_out = D.fused_root_replicated(root, kind_of)
+
+    counters: dict = {}
+
+    def build():
+        def fn(scan_b, shard_b, repl_b):
+            nodes = real + shard_nodes + repl_nodes
+            batches = list(scan_b) + list(shard_b) + list(repl_b)
+            stats: dict = {}
+            ex = DistExecutor(session, ndev,
+                              {id(n): b for n, b in zip(nodes, batches)},
+                              sort_stats=stats)
+            for pid, val in sorted(scalar_results.items()):
+                ex.ctx.scalar_results[pid] = val
+            out = ex.exec_node(root)
+            if ex.guards:
+                g = jnp.any(jnp.stack([jnp.asarray(x) for x in ex.guards]))
+            else:
+                g = jnp.zeros((), bool)
+            g = jax.lax.psum(g.astype(jnp.int32), AXIS) > 0
+            # trace-time counters: re-filled on every (re)trace, replayed
+            # from the memoized entry on executable reuse
+            counters.clear()
+            counters.update(stats)
+            return out, g
+
+        out_spec = PS() if replicated_out else PS(AXIS)
+        sharded = _shard_mapped(fn, mesh, (PS(AXIS), PS(AXIS), PS()),
+                                (out_spec, PS()))
+        return CC.build_jit(sharded), counters
+
+    key = CC.fused_key(fragment_bytes, ndev, session, scalar_results,
+                       ext_inputs)
+    jitted, counters = CC.get_or_build(key, build)
+    scan_feed = [sharded_scan(session.catalog.get(n.table), n, mesh, ndev)
+                 for n in real]
+    shard_feed = [_ext_shard_batch(
+        ext_inputs[int(n.table[len("__exch_"):])]["cols"], n, mesh, ndev)
+        for n in shard_nodes]
+    repl_feed = [_ext_repl_batch(
+        ext_inputs[int(n.table[len("__exch_"):])]["cols"], n, mesh)
+        for n in repl_nodes]
+    out_batch, guard = jitted(scan_feed, shard_feed, repl_feed)
+    return out_batch, bool(guard), dict(counters)

@@ -17,7 +17,8 @@ Distribution lattice per node:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+import os
+from typing import Dict, List, Optional, Tuple
 
 from presto_tpu.plan import ir
 from presto_tpu.plan import nodes as P
@@ -608,3 +609,211 @@ class Distributer:
         src, _ = self.visit(node.source)
         node.source = src
         return node, REPLICATED if node.kind in ("gather", "broadcast") else ANY
+
+
+# ---------------------------------------------------------------------------
+# fragment fusion (ROADMAP open item 1): splice mesh-local exchange edges
+# back into ONE traced program
+# ---------------------------------------------------------------------------
+#
+# The cluster path (parallel/cluster.py) cuts the distributed plan at its
+# Exchange nodes and moves pages over HTTP between fragments.  When the
+# producer and consumer of an exchange edge are placed on chips of the
+# SAME ICI mesh, that host round-trip (pack -> POST -> poll -> GET ->
+# unpack, per page) is pure overhead: the identical exchange lowers to a
+# collective (`lax.all_to_all` for hash repartition, `all_gather` for
+# broadcast/gather — parallel/exchange.py) inside the shard_map program
+# the mesh executes anyway.  `fuse_fragments` contracts those edges: the
+# consumer absorbs the producer's plan with the original Exchange node
+# restored INLINE, so a scan -> repartition -> join -> aggregate pipeline
+# compiles as one XLA program with zero host hops between stages.  The
+# per-fragment HTTP path remains the fallback for cross-host edges,
+# capacity-overflow guard trips, and fault recovery (any fused-attempt
+# failure retries with fusion disabled — parallel/cluster.py).
+
+#: exchange kinds the mesh collective kernels implement in-trace
+#: (parallel/exchange.py + DistExecutor._exec_exchange) — all of them;
+#: `fragment_fusion_kinds` can restrict for A/B runs
+FUSIBLE_KINDS = frozenset(
+    {"repartition", "broadcast", "gather", "scatter", "range"})
+
+
+def fusion_enabled(session) -> bool:
+    """Fragment-fusion master switch: session property `fragment_fusion`
+    (default on) gated by the PRESTO_TPU_FRAGMENT_FUSION env kill
+    switch (off|0|false disables process-wide)."""
+    env = os.environ.get("PRESTO_TPU_FRAGMENT_FUSION", "").lower()
+    if env in ("off", "0", "false"):
+        return False
+    return bool(session.properties.get("fragment_fusion", True))
+
+
+def fusion_kinds(session) -> frozenset:
+    """Edge kinds eligible for fusion (session property
+    `fragment_fusion_kinds`, csv)."""
+    raw = session.properties.get("fragment_fusion_kinds", "")
+    if not raw:
+        return FUSIBLE_KINDS
+    return frozenset(k.strip() for k in str(raw).split(",")
+                     if k.strip()) & FUSIBLE_KINDS
+
+
+def _rewrite_exch_scans(root, on_scan):
+    """Generic rebuild of a fragment plan tree: `on_scan(eid, node)`
+    returns a replacement for each `__exch_{eid}` scan (or the node
+    itself).  Mirrors cut_fragments' rewrite, including the carry of
+    optimizer instance attrs that are not dataclass fields."""
+
+    def rewrite(n):
+        if isinstance(n, P.TableScan):
+            if n.table.startswith("__exch_"):
+                return on_scan(int(n.table[len("__exch_"):]), n)
+            return n
+        changed = {}
+        for f in dataclasses.fields(n):
+            v = getattr(n, f.name)
+            if isinstance(v, P.PlanNode):
+                nv = rewrite(v)
+                if nv is not v:
+                    changed[f.name] = nv
+            elif isinstance(v, list) and v \
+                    and all(isinstance(x, P.PlanNode) for x in v):
+                nv = [rewrite(x) for x in v]
+                if any(a is not b for a, b in zip(nv, v)):
+                    changed[f.name] = nv
+        if not changed:
+            return n
+        nn = dataclasses.replace(n, **changed)
+        fields = {f.name for f in dataclasses.fields(n)}
+        for k, v in n.__dict__.items():
+            if k not in fields and k not in nn.__dict__:
+                setattr(nn, k, v)
+        return nn
+
+    return rewrite(root)
+
+
+def fuse_fragments(fragments: list, fusible) -> Tuple[list, int]:
+    """The fusion pass.  `fragments` is cut_fragments' output (duck-typed
+    parallel/cluster.Fragment dataclasses, topological — producers
+    first); `fusible(consumer_frag, exchange_input) -> bool` classifies
+    each exchange edge (the caller folds placement in: an edge is only
+    fusible when producer and consumer land on the same mesh).
+
+    Every fused edge splices the producer fragment's plan into the
+    consumer with the Exchange node restored inline, so the consumer
+    becomes a SUPER-fragment whose inline exchanges lower to collectives
+    (parallel/dist_executor.run_fused_fragment).  A producer's surviving
+    (non-fused) inputs migrate to the consumer.  Non-fused repartition /
+    range inputs that feed a super-fragment are wrapped in an in-trace
+    re-exchange, restoring the hashed/range distribution contract the
+    consumer plan was built against (the single fused task pulls ALL
+    buckets of such an edge, so the wire partitioning is lost).
+
+    Returns (new fragment list — renumbered, producers-first — and the
+    number of fragments absorbed).  Fused fragments carry `fused=True`
+    and `fused_fids` (the original fids they absorbed)."""
+    if len(fragments) <= 1:
+        return fragments, 0
+    spliced: Dict[int, object] = {}    # old fid -> rewritten root
+    ext_inputs: Dict[int, list] = {}   # old fid -> surviving inputs
+    has_scan: Dict[int, bool] = {}
+    absorbed_into: Dict[int, List[int]] = {}  # old fid -> absorbed fids
+    absorbed: set = set()
+    # range ExchangeInputs carry plain keys; the sort tuples live on the
+    # producer fragment's out_keys — needed to rebuild the inline node
+    okeys_of = {}
+    for f in fragments:
+        for inp in f.inputs:
+            okeys_of[inp.eid] = fragments[inp.producer].out_keys
+
+    for frag in fragments:
+        by_eid = {i.eid: i for i in frag.inputs}
+        kept: list = []
+        taken: List[int] = []
+        hscan = [frag.has_scan]
+
+        def on_scan(eid, node):
+            inp = by_eid.get(eid)
+            if inp is None:  # an absorbed producer's migrated input
+                return node
+            if fusible(frag, inp):
+                ex = P.Exchange(spliced[inp.producer], inp.kind,
+                                list(inp.keys))
+                if inp.kind == "range":
+                    ex.sort_keys = list(okeys_of[eid])
+                absorbed.add(inp.producer)
+                taken.extend([inp.producer]
+                             + absorbed_into.get(inp.producer, []))
+                kept.extend(ext_inputs.pop(inp.producer, []))
+                hscan[0] = hscan[0] or has_scan[inp.producer]
+                return ex
+            kept.append(inp)
+            return node
+
+        root = _rewrite_exch_scans(frag.root, on_scan)
+        if taken:
+            # super-fragment: restore the distribution contract of the
+            # remaining EXTERNAL repartition/range inputs in-trace
+            wrap_of = {i.eid: i for i in kept
+                       if i.kind in ("repartition", "range")}
+
+            def wrap(eid, node):
+                inp = wrap_of.get(eid)
+                if inp is None:
+                    return node
+                ex = P.Exchange(node, inp.kind, list(inp.keys))
+                if inp.kind == "range":
+                    ex.sort_keys = list(okeys_of[eid])
+                return ex
+
+            root = _rewrite_exch_scans(root, wrap)
+        spliced[frag.fid] = root
+        ext_inputs[frag.fid] = kept
+        has_scan[frag.fid] = hscan[0]
+        absorbed_into[frag.fid] = taken
+
+    survivors = [f for f in fragments if f.fid not in absorbed]
+    renum = {f.fid: i for i, f in enumerate(survivors)}
+    out = []
+    for f in survivors:
+        inputs = [dataclasses.replace(i, producer=renum[i.producer])
+                  for i in ext_inputs[f.fid]]
+        nf = dataclasses.replace(f, fid=renum[f.fid],
+                                 root=spliced[f.fid], inputs=inputs,
+                                 has_scan=has_scan[f.fid])
+        if absorbed_into[f.fid]:
+            nf.fused = True
+            nf.fused_fids = list(absorbed_into[f.fid])
+        out.append(nf)
+    return out, len(absorbed)
+
+
+def fused_root_replicated(root, exch_kinds: Dict[int, str]) -> bool:
+    """Is a fused super-fragment's output REPLICATED across the mesh
+    (every shard holds the full result — emit one shard's copy) or
+    per-shard (concatenate shards)?  Mirrors the coarse replicated/
+    sharded projection of the Dist lattice distribute() used to build
+    the plan; `exch_kinds` maps external `__exch_{eid}` inputs to their
+    edge kind."""
+
+    def walk(n) -> bool:
+        if isinstance(n, P.Exchange):
+            return n.kind in ("gather", "broadcast")
+        if isinstance(n, P.TableScan):
+            if n.table.startswith("__exch_"):
+                eid = int(n.table[len("__exch_"):])
+                return exch_kinds.get(eid) in ("gather", "broadcast")
+            return False  # sharded_scan slices rows per shard
+        if isinstance(n, P.Values):
+            return True
+        if isinstance(n, P.Union):
+            return False  # distribute() scatters replicated sources
+        srcs = n.sources
+        if not srcs:
+            return False
+        if len(srcs) > 1:  # joins: replicated iff every side is
+            return all(walk(s) for s in srcs)
+        return walk(srcs[0])
+
+    return walk(root)

@@ -60,6 +60,20 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # session time zone for the WITH TIME ZONE surface (reference:
     # Session.getTimeZoneKey / SystemSessionProperties)
     "time_zone": "UTC",
+    # fragment fusion (plan/distribute.fuse_fragments, ROADMAP item 1):
+    # mesh-local exchange edges of a cluster plan splice back into ONE
+    # traced shard_map program whose exchanges lower to ICI collectives
+    # — zero host round-trips between fused stages.  A worker is a
+    # fusion target only when it DECLARES an exclusively-owned mesh
+    # (PRESTO_TPU_WORKER_MESH / WorkerServer(mesh_devices=)) of at
+    # least `fragment_fusion_min_devices` chips.  Kill switches:
+    # session fragment_fusion=False or env PRESTO_TPU_FRAGMENT_FUSION=
+    # off; any fused-attempt failure retries on the per-fragment HTTP
+    # path.  `fragment_fusion_kinds` (csv) restricts which edge kinds
+    # fuse, for A/B runs and partial-fusion coverage.
+    "fragment_fusion": True,
+    "fragment_fusion_min_devices": 2,
+    "fragment_fusion_kinds": "",
     # cluster scheduling policy (reference: PhasedExecutionSchedule vs
     # AllAtOnceExecutionPolicy, execution-policy session property):
     # phased gates probe-side stage startup on build-side completion,

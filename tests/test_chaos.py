@@ -363,6 +363,67 @@ def test_df_build_crash_degrades_filter_free(tpch_catalog_tiny):
                 w.stop()
 
 
+# ---- fragment fusion under faults (ISSUE 8 satellite) -----------------
+
+
+FUSE_QUERY = ("SELECT o_orderpriority, count(*) c, "
+              "checksum(o_orderkey) k FROM orders "
+              "GROUP BY o_orderpriority ORDER BY 1")
+
+
+def test_fused_task_fault_degrades_to_fragment_path(tpch_catalog_tiny):
+    """A scripted failure INSIDE a fused super-fragment degrades to the
+    per-fragment HTTP path: one unfused retry, identical checksums, and
+    fragments_fused == 0 on the successful attempt."""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    want = norm(session.sql(FUSE_QUERY).rows)
+    w = C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache", mesh_devices=4,
+                       faults=F.FaultPlan.parse("exec:EXEC:*:1:fail")
+                       ).start()
+    cs = C.ClusterSession(session, [w.url])
+    try:
+        r = cs.sql(FUSE_QUERY)
+        assert norm(r.rows) == want
+        st = r.stats
+        assert st.fragments_fused == 0, "retry must run unfused"
+        rec = st.recovery
+        assert rec.get("fused_fallbacks", 0) == 1, rec
+        assert rec.get("query_retries", 0) == 1, rec
+        assert len(w.faults.fired) == 1  # the fault hit the fused task
+        # the retry really took the HTTP fragment path
+        assert st.exchange_bytes_host > 0
+    finally:
+        w.stop()
+
+
+@pytest.mark.slow
+def test_fused_worker_crash_degrades_to_survivor(tpch_catalog_tiny):
+    """The mesh owner crashes mid-fused-task: the retry runs the
+    fragment-cut path on the (meshless) survivor with identical
+    checksums and fragments_fused == 0.  (Tier-2: the injected-fault
+    variant above covers the tier-1 degrade contract.)"""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    want = norm(session.sql(FUSE_QUERY).rows)
+    meshy = C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                           mesh_devices=4,
+                           faults=F.FaultPlan.parse("exec:EXEC:*:1:crash")
+                           ).start()
+    plain = C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache").start()
+    cs = C.ClusterSession(session, [meshy.url, plain.url])
+    try:
+        r = cs.sql(FUSE_QUERY)
+        assert norm(r.rows) == want
+        st = r.stats
+        assert st.fragments_fused == 0
+        assert st.recovery.get("fused_fallbacks", 0) == 1, st.recovery
+        assert meshy.crashed
+        assert cs.workers == [plain.url]
+    finally:
+        for w in (meshy, plain):
+            if not w.crashed:
+                w.stop()
+
+
 def test_env_fault_plan_roundtrip(monkeypatch):
     monkeypatch.setenv("PRESTO_TPU_FAULTS",
                        "server:GET:/results/:3:drop;exec:EXEC:*:1:fail")

@@ -65,8 +65,13 @@ DIST_QIDS = VERIFY_QIDS[::5]
 # program on the 1-core CI box (q67's ~30s); their dynamic/compiled
 # legs are covered by test_tpcds.py and q87 keeps the verifier's mesh
 # leg exercised in tier 1
+# round 12 adds 77/80/22 to the tier-2 set: together ~45s of re-verify
+# on the 1-core box, and their dynamic/compiled legs stay covered by
+# test_tpcds.py every run (budget fit for the fragment-fusion tier-1
+# additions; the full verifier corpus still runs in tier 2)
 @pytest.mark.parametrize("qid", [
-    pytest.param(q, marks=pytest.mark.slow) if q in (14, 67) else q
+    pytest.param(q, marks=pytest.mark.slow)
+    if q in (14, 67, 77, 80, 22) else q
     for q in VERIFY_QIDS])
 def test_override_query_checksum_across_executors(sessions, qid):
     dyn, comp, dist = sessions

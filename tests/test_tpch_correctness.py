@@ -16,7 +16,14 @@ def session(tpch_catalog_tiny):
     return presto_tpu.connect(tpch_catalog_tiny)
 
 
-@pytest.mark.parametrize("qid", sorted(QUERIES))
+# q21 is the suite's single heaviest dynamic-mode compile (~40s on the
+# 1-core CI box); its correctness stays covered every run by
+# test_distributed.test_all_22_tpch_queries_distribute (collective
+# path) and the tier-2 run keeps this oracle leg (round-12 budget fit,
+# same rule as the round-6 demotions)
+@pytest.mark.parametrize("qid", [
+    pytest.param(q, marks=pytest.mark.slow) if q == 21 else q
+    for q in sorted(QUERIES)])
 def test_tpch_query(qid, session, tpch_sqlite_tiny):
     sql = QUERIES[qid]
     actual = session.sql(sql)

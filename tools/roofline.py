@@ -326,6 +326,108 @@ def main():
         dout[f"sel{pct}"] = cell
     out["dynfilter"] = dout
 
+    # --- exchange economics: host HTTP shuffle vs in-trace all_to_all --
+    # Anchors the fragment-fusion profitability threshold
+    # (plan/distribute.fuse_fragments): what one repartition edge costs
+    # on the per-fragment HTTP path (pack PTPG page -> loopback POST ->
+    # GET -> unpack -> host hash_partition — the floor; real DCN adds
+    # network) vs lowered into the traced program as ONE lax.all_to_all
+    # over the mesh.  Swept rows x ndev; cells the host can't run
+    # (fewer local devices than ndev) are skipped.
+    from presto_tpu.batch import Batch as PBatch
+    from presto_tpu.parallel import cluster as CL
+    from presto_tpu.parallel import exchange as EXC
+    from presto_tpu.parallel.mesh import AXIS, make_mesh
+    from presto_tpu.parallel import dist_executor as DX
+    from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+    import threading
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    page_store = {}
+
+    class _Echo(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            page_store["page"] = self.rfile.read(
+                int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def do_GET(self):
+            body = page_store.get("page", b"")
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    echo = ThreadingHTTPServer(("127.0.0.1", 0), _Echo)
+    threading.Thread(target=echo.serve_forever, daemon=True).start()
+    echo_url = f"http://127.0.0.1:{echo.server_address[1]}/page"
+
+    ndev_avail = len(jax.devices())
+    xout = {}
+    for rexp in (16, 18, 20):
+        rows = 1 << rexp
+        kh = rng.integers(0, 1 << 31, rows).astype(np.int64)
+        vh = rng.normal(size=rows)
+        cols = {"k": (kh, None), "v": (vh, None)}
+        cell = {"bytes": int(kh.nbytes + vh.nbytes)}
+
+        def host_trip(nd):
+            page = CL.pack_columns(cols)
+            req = urllib.request.Request(echo_url, data=page,
+                                         method="POST")
+            urllib.request.urlopen(req, timeout=30).read()
+            body = urllib.request.urlopen(echo_url, timeout=30).read()
+            out_cols = CL.unpack_columns(body)
+            CL.hash_partition(out_cols, ["k"], nd)
+
+        for nd in (2, 4, 8):
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                host_trip(nd)
+                best = min(best, time.perf_counter() - t0)
+            cell[f"host_nd{nd}_ms"] = round(best * 1000, 2)
+            if nd > ndev_avail:
+                cell[f"coll_nd{nd}_ms"] = None  # not enough devices
+                continue
+            mesh = make_mesh(nd)
+            spec = NamedSharding(mesh, PSpec(AXIS))
+            kd = jax.device_put(kh, spec)
+            vd = jax.device_put(vh, spec)
+
+            def inner(k, v):
+                from presto_tpu import types as _PT
+                from presto_tpu.batch import Column as _PCol
+
+                def body(i, s):
+                    b = PBatch(
+                        {"k": _PCol(k ^ s, None, _PT.BIGINT, None),
+                         "v": _PCol(v, None, _PT.DOUBLE, None)},
+                        jnp.ones(k.shape, bool))
+                    ob, _ov = EXC.repartition_batch(
+                        b, [b.columns["k"]], nd, AXIS)
+                    # REAL loop-carried dep through the exchanged data
+                    # (a maskable dep lets XLA DCE the all_to_all)
+                    return s + ob.columns["k"].data[0]
+                return lax.fori_loop(0, K, body, jnp.int64(0))
+
+            coll = jax.jit(DX._shard_mapped(
+                inner, mesh, (PSpec(AXIS), PSpec(AXIS)), PSpec()))
+            t = per_iter(timed(coll, kd, vd))
+            cell[f"coll_nd{nd}_ms"] = round(t * 1000, 2)
+        xout[f"r{rows >> 10}k"] = cell
+    echo.shutdown()
+    out["exchange"] = xout
+
     # --- build_probe at TPC-H Q3 shape: 6M probe, 1.5M build ----------
     npr, nb = 6_000_000, 1_500_000
     probe = jnp.asarray(rng.integers(0, nb, npr).astype(np.int32))
