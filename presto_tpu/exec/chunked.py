@@ -41,7 +41,6 @@ from __future__ import annotations
 import dataclasses
 import sys
 import threading
-import time
 from typing import Dict, List, Optional
 
 import jax
@@ -50,6 +49,7 @@ import jax.numpy as jnp
 from presto_tpu.batch import Batch, Column
 from presto_tpu.exec import compile_cache as CC
 from presto_tpu.exec import kernels as K
+from presto_tpu.observe import trace as TR
 from presto_tpu.plan import nodes as P
 
 
@@ -166,13 +166,9 @@ def run_chunked(session, stmt, text: str, mon=None):
     cache = getattr(session, "_chunked_cache", None)
     if cache is None:
         cache = session._chunked_cache = {}
-    # raw text key: whitespace normalization would merge queries that
-    # differ only inside string literals
-    from presto_tpu.exec.executor import _volatile_nonce
+    from presto_tpu.exec.executor import query_cache_key
 
-    key = (text, getattr(session.catalog, "version", 0),
-           tuple(sorted((k, repr(v)) for k, v in session.properties.items())),
-           _volatile_nonce(text))
+    key = query_cache_key(session, text)
     prepared = cache.get(key)
     if prepared is not None:
         return _execute_prepared(session, *prepared, mon=mon)
@@ -260,12 +256,17 @@ def _execute_prepared(session, dplan, frags, runner, table_family,
 
 def _run_fragments(session, frags, runner, table_family, consumer_eid):
     from presto_tpu.exec.executor import StaticFallback
+    from presto_tpu.observe import trace as TR
 
     final_batch = None
     for frag in frags:
         fscans: List[P.TableScan] = []
         _collect_scans(frag.root, fscans)
         chunked = any(s.table in table_family for s in fscans)
+        t0 = TR.clock_ns()
+        span_cm = TR.maybe_span(f"fragment f{frag.fid}", kind="fragment",
+                                fid=frag.fid, chunked=chunked)
+        span_cm.__enter__()
         try:
             if chunked:
                 out = runner.run_chunk_loop(frag, fscans)
@@ -289,6 +290,10 @@ def _run_fragments(session, frags, runner, table_family, consumer_eid):
             # a chunk-loop shape the static executor can't bound: let
             # the caller fall back to whole-table paths
             raise Unchunkable(f"static fallback: {e}")
+        finally:
+            span_cm.__exit__(None, None, None)
+            # per-RUN fragment wall (EXPLAIN ANALYZE attribution)
+            runner.frag_wall_ns[frag.fid] = TR.clock_ns() - t0
         eid = consumer_eid.get(frag.fid)
         if eid is None:  # no consumer: the root fragment's result
             final_batch = out
@@ -519,6 +524,9 @@ class _FragmentRunner:
         # PER-RUN counters (chunk pruning happens host-side every run,
         # unlike the trace-time totals above which warm runs replay)
         self.run_stats: Dict[str, int] = {}
+        # per-RUN fragment wall clocks (EXPLAIN ANALYZE attribution +
+        # the chunked fragment trace spans)
+        self.frag_wall_ns: Dict[object, int] = {}
 
     # ---- fragment execution ------------------------------------------
     def _scan_builder(self, node: P.TableScan, chunk_args, grid):
@@ -853,7 +861,7 @@ class _FragmentRunner:
         profile = bool(self.session.properties.get("chunk_profile",
                                                    False))
         for i in range(1, grid.nchunks):
-            t0 = time.perf_counter() if profile else 0.0
+            t0 = TR.clock_ns() if profile else 0
             out, guard, ov = jitted(res_list, grid.chunk_args(i))
             part, cnt = cjit(out)  # async: no host sync in this loop
             if profile:
@@ -862,7 +870,7 @@ class _FragmentRunner:
                 # production runs)
                 jax.block_until_ready(part)
                 print(f"chunk_profile: chunk {i} "
-                      f"{(time.perf_counter() - t0) * 1e3:.0f}ms",
+                      f"{(TR.clock_ns() - t0) / 1e6:.0f}ms",
                       file=sys.stderr)
             guards.append(guard)
             overflows.append(ov)
@@ -1068,13 +1076,13 @@ class _FragmentRunner:
             profile = bool(self.session.properties.get("chunk_profile",
                                                        False))
             for i in range(1, grid.nchunks):
-                t0 = time.perf_counter() if profile else 0.0
+                t0 = TR.clock_ns() if profile else 0
                 out, guard, ov = jitted(res_list, grid.chunk_args(i))
                 part, cnt = cjit(out)
                 if profile:  # diagnostics only: syncing kills pipelining
                     jax.block_until_ready(part)
                     print(f"chunk_profile: chunk {i} "
-                          f"{(time.perf_counter() - t0) * 1e3:.0f}ms",
+                          f"{(TR.clock_ns() - t0) / 1e6:.0f}ms",
                           file=sys.stderr)
                 if any(part.columns[name].dictionary is not d
                        for name, d in dicts0.items()):

@@ -46,11 +46,12 @@ import hashlib
 import itertools
 import os
 import threading
-import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Optional
 
 import jax
+
+from presto_tpu.observe import trace as TR
 
 DEFAULT_CACHE_DIR = "/tmp/presto_tpu_xla_cache"
 
@@ -302,15 +303,18 @@ class Executable:
         self._fellback = False
 
     def aot_compile(self, example_args) -> None:
-        t0 = time.perf_counter()
+        t0 = TR.clock_ns()
         # lower against shape structs, not the concrete arrays: AOT must
         # not pin (or later donate) multi-GB example buffers.  Leaves
         # that aren't plain strong-typed arrays stay concrete — a
         # weak-typed scalar lowered strong would mismatch at call time.
-        shapes = jax.tree_util.tree_map(_shape_struct, example_args)
-        self._compiled = self._jitted.lower(*shapes).compile()
+        # The span puts the compile on the query's trace timeline —
+        # compile-ahead builds appear on their own pool-thread lane.
+        with TR.maybe_span("xla_compile", kind="compile"):
+            shapes = jax.tree_util.tree_map(_shape_struct, example_args)
+            self._compiled = self._jitted.lower(*shapes).compile()
         _note("compiles")
-        _note("compile_ms", (time.perf_counter() - t0) * 1000.0)
+        _note("compile_ms", (TR.clock_ns() - t0) / 1e6)
 
     def lower(self, *args, **kw):
         return self._jitted.lower(*args, **kw)
@@ -522,10 +526,14 @@ def submit(job: Callable[[], Any], stats_sink=None) -> bool:
     swallowed — the foreground will rebuild and surface the error
     properly."""
 
+    # the submitting thread's trace context rides along, so background
+    # builds appear on the query's trace under the pool thread's lane
+    tracer = TR.current()
+
     def wrapped():
         try:
             with recording(stats_sink if stats_sink is not None
-                           else CompileStats()):
+                           else CompileStats()), TR.activate(tracer):
                 job()
         except BaseException:
             pass  # foreground retries and reports

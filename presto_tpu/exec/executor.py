@@ -74,11 +74,18 @@ def execute_query(session, text: str) -> QueryResult:
     mon = QueryMonitor.begin(session, text)
     from presto_tpu import session_ctx
     from presto_tpu.exec import compile_cache as CC
+    from presto_tpu.observe import profile as PR
+    from presto_tpu.observe import trace as TR
 
     session_ctx.activate(session)  # zone + query-stable now()
     CC.configure(session)  # honor a per-session compile_cache_dir
     try:
-        with CC.recording(mon.stats):  # compile-economics counters
+        # tracer activation makes nested instrumentation (compile
+        # spans, cluster RPCs, chunked fragments) land on THIS query's
+        # trace; maybe_profile wraps the query in jax.profiler capture
+        # when profile_query / PRESTO_TPU_PROFILE asks for one
+        with CC.recording(mon.stats), TR.activate(mon.tracer), \
+                PR.maybe_profile(session):  # compile-economics counters
             with mon.phase("parse"):
                 stmt = parse(text)
             result = _dispatch_statement(session, text, stmt, mon)
@@ -267,10 +274,7 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
 
         needs_chunks = False
         plan_probe = None
-        warm_key = (text, getattr(session.catalog, "version", 0),
-                    tuple(sorted((k, repr(v))
-                                 for k, v in session.properties.items())),
-                    _volatile_nonce(text))
+        warm_key = query_cache_key(session, text)
         if warm_key in getattr(session, "_chunked_cache", {}):
             needs_chunks = True  # memo hit: skip the planning probe
         elif mode == "chunked" or CH.catalog_may_need_chunks(session):
@@ -601,6 +605,18 @@ def _volatile_nonce(text: str) -> int:
     return session_ctx.query_seq()
 
 
+def query_cache_key(session, text: str) -> tuple:
+    """The per-session program-cache key shared by the compiled and
+    chunked executors (and EXPLAIN ANALYZE's profiled lookups): raw
+    text (whitespace normalization would merge queries differing only
+    inside string literals) x catalog version x the full property map x
+    the volatile nonce."""
+    return (text, getattr(session.catalog, "version", 0),
+            tuple(sorted((k, repr(v))
+                         for k, v in session.properties.items())),
+            _volatile_nonce(text))
+
+
 def bind_param_values(session, params):
     """Host (value, Type) pairs -> device 0-d scalars with the dtypes the
     traced program expects.  DOUBLE follows the session's
@@ -636,11 +652,7 @@ def run_compiled(session, text: str, stmt, mon=None, params=None) -> QueryResult
         cache = session._compiled_cache = {}
     host_params = tuple((v, None) for v, _t in params) \
         if params is not None else None
-    # raw text key (whitespace normalization would merge queries that
-    # differ only inside string literals)
-    key = (text, getattr(session.catalog, "version", 0),
-           tuple(sorted((k, repr(v)) for k, v in session.properties.items())),
-           _volatile_nonce(text))
+    key = query_cache_key(session, text)
     entry = cache.get(key)
     if entry == "DYNAMIC":  # static assumptions known-violated for this query
         plan = plan_statement(session, stmt)
@@ -866,11 +878,27 @@ def explain_distributed_text(session, stmt) -> str:
 
 
 def explain_analyze_text(session, stmt, mon) -> str:
-    """EXPLAIN ANALYZE: execute in dynamic mode with per-node stats, then
-    render the plan annotated with rows/time (reference:
-    ExplainAnalyzeOperator + PlanPrinter stats rendering)."""
+    """EXPLAIN ANALYZE, profiled per execution mode.
+
+    dynamic/auto: execute eagerly with per-node stats and render the
+    plan annotated with rows/time (reference: ExplainAnalyzeOperator +
+    PlanPrinter stats rendering) — the richest attribution, one host
+    sync per operator.
+
+    compiled/chunked (execution_mode set accordingly): execute through
+    the REAL compiled path, then attach per-fragment measured wall plus
+    XLA cost analysis (FLOPs, HBM bytes, roofline-estimated wall) read
+    off the fragment executables — the compiler-sourced attribution for
+    programs that have no per-operator boundary at runtime.  Cluster
+    mode has its own path (parallel/cluster.ClusterSession handles
+    EXPLAIN ANALYZE with per-task attribution from worker spans)."""
     from presto_tpu.observe.stats import annotated_plan
 
+    mode = str(session.properties.get("execution_mode", "auto"))
+    if mode == "compiled":
+        return _explain_analyze_compiled(session, stmt, mon)
+    if mode == "chunked":
+        return _explain_analyze_chunked(session, stmt, mon)
     mon.stats.execution_mode = "dynamic"
     mon.collect_node_stats = True  # ANALYZE implies per-node stats
     with mon.phase("plan"):
@@ -883,17 +911,119 @@ def explain_analyze_text(session, stmt, mon) -> str:
     return annotated_plan(plan.root, plan.subplans, mon.stats)
 
 
+def _phase_summary(stats) -> str:
+    ph = ", ".join(f"{k}: {v / 1e6:.1f}ms"
+                   for k, v in stats.phase_ns.items())
+    return (f"Query {stats.query_id}: {ph}; output rows: "
+            f"{stats.output_rows}")
+
+
+def _explain_analyze_compiled(session, stmt, mon) -> str:
+    """Profiled EXPLAIN ANALYZE through run_compiled: the whole plan is
+    ONE fused XLA program (one 'fragment'); its cost analysis comes off
+    the AOT executable the compiled cache holds."""
+    from presto_tpu.observe import profile as PR
+    from presto_tpu.observe.stats import trace_summary_line
+
+    mon.stats.execution_mode = "compiled"
+    text = mon.stats.sql  # a valid (distinct) program-cache key
+    with mon.phase("execute"):
+        result = run_compiled(session, text, stmt, mon=mon)
+    mon.stats.output_rows = len(result)
+    mon.rows_preset = True
+    wall_ms = mon.stats.phase_ns.get("execute", 0) / 1e6
+    entry = getattr(session, "_compiled_cache", {}).get(
+        query_cache_key(session, text))
+    lines = []
+    if entry is None or entry == "DYNAMIC":
+        # static assumptions were violated: the query really ran on the
+        # dynamic path — say so instead of attributing a program that
+        # never executed
+        plan = plan_statement(session, stmt)
+        lines.append(P.plan_tree_str(plan.root))
+        lines.append("\nFragment 0 (compiled -> DYNAMIC fallback: "
+                     "static assumptions violated):")
+        lines.append(f"   {PR.cost_line(None, wall_ms, 'dynamic re-run')}")
+    else:
+        plan, jitted, _scan_nodes, _meta, _sort_counts = entry
+        lines.append(P.plan_tree_str(plan.root))
+        for pid, sub in sorted(plan.subplans.items()):
+            lines.append(f"\nSubplan {pid} (evaluated eagerly, baked "
+                         "into the trace):")
+            lines.append(P.plan_tree_str(sub, 1))
+        cost = PR.executable_cost(jitted)
+        lines.append("\nFragment 0 (compiled, whole plan as one fused "
+                     "XLA program):")
+        lines.append(f"   {PR.cost_line(cost, wall_ms)}")
+    lines.append("")
+    lines.append(_phase_summary(mon.stats))
+    lines.append(trace_summary_line(mon.stats))
+    return "\n".join(lines)
+
+
+def _explain_analyze_chunked(session, stmt, mon) -> str:
+    """Profiled EXPLAIN ANALYZE through the chunked executor: one
+    attribution block per fragment — measured wall from the per-run
+    fragment timings, XLA cost analysis summed over the fragment's
+    program family (chunk-loop + fold + compact executables)."""
+    from presto_tpu.exec import chunked as CH
+    from presto_tpu.observe import profile as PR
+    from presto_tpu.observe.stats import trace_summary_line
+
+    mon.stats.execution_mode = "chunked"
+    text = mon.stats.sql
+    with mon.phase("execute"):
+        result = CH.run_chunked(session, stmt, text, mon=mon)
+    mon.stats.output_rows = len(result)
+    mon.rows_preset = True
+    entry = getattr(session, "_chunked_cache", {}).get(
+        query_cache_key(session, text))
+    lines = []
+    if entry is None:
+        lines.append("(chunked prepared state unavailable)")
+    else:
+        _dplan, frags, runner, _table_family, _consumer_eid = entry
+        def frag_key(key, fid):
+            # runner._jit keys: (fid, mult) for the main program,
+            # ("fold"|"compact"|"mesh", fid, ...) for the auxiliaries
+            if not isinstance(key, tuple):
+                return key == fid
+            if key[0] in ("fold", "compact", "mesh"):
+                return len(key) >= 2 and key[1] == fid
+            return key[0] == fid
+
+        for frag in frags:
+            wall_ns = runner.frag_wall_ns.get(frag.fid, 0)
+            cost = PR.merge_costs(
+                PR.executable_cost(ex)
+                for key, ex in runner._jit.items()
+                if frag_key(key, frag.fid))
+            note = "dynamic fragment" \
+                if frag.fid in runner.dynamic_fids else ""
+            lines.append(f"Fragment {frag.fid} (chunked"
+                         + (", dynamic" if note else "") + "):")
+            lines.append(f"   {PR.cost_line(cost, wall_ns / 1e6, note)}")
+            lines.append(P.plan_tree_str(frag.root, 1))
+            lines.append("")
+    lines.append(_phase_summary(mon.stats))
+    lines.append(trace_summary_line(mon.stats))
+    return "\n".join(lines)
+
+
 def explain_query(session, text: str, analyze: bool = False) -> str:
     stmt = parse(text)
     if isinstance(stmt, ast.Explain):
         analyze = analyze or stmt.analyze
         stmt = stmt.statement
     if analyze:
+        from presto_tpu.observe import profile as PR
+        from presto_tpu.observe import trace as TR
         from presto_tpu.observe.stats import QueryMonitor
 
         mon = QueryMonitor.begin(session, text)
         try:
-            text_plan = explain_analyze_text(session, stmt, mon)
+            with TR.activate(mon.tracer), PR.maybe_profile(session):
+                text_plan = explain_analyze_text(session, stmt, mon)
         except BaseException as e:
             mon.fail(e)
             raise
@@ -1335,16 +1465,23 @@ class Executor:
             raise ExecutionError(f"no executor for {type(node).__name__}")
         node_stats = self.monitor is not None and self.monitor.collect_node_stats
         if not node_stats and self.mem is None:
-            return method(node)
+            # jax.named_scope at the operator-lowering site: inside a
+            # static trace every op this node emits is scoped under the
+            # plan-node name, so profiler timelines (PRESTO_TPU_PROFILE)
+            # map back to plan nodes even though the compiled program is
+            # one fused blob.  Trace-time only — a warm compiled run
+            # never re-enters this path, so the hot loop pays nothing.
+            with jax.named_scope(type(node).__name__):
+                return method(node)
         # node stats collection (reference: OperationTimer around every
         # operator call, operator/Driver.java:380); the row count forces a
         # device sync, which is why it is opt-in / EXPLAIN ANALYZE only
-        import time as _time
-
         from presto_tpu.memory.context import batch_bytes
+        from presto_tpu.observe import trace as _TR
 
-        t0 = _time.perf_counter_ns()
-        b = method(node)
+        t0 = _TR.clock_ns()
+        with jax.named_scope(type(node).__name__):
+            b = method(node)
         if self.mem is not None:
             # live-set accounting: a node's output is resident until the
             # parent consumes it; child outputs die here (GC'd by Python,
@@ -1354,7 +1491,7 @@ class Executor:
                 self.mem.set_bytes(id(child), 0)
         if node_stats:
             rows = int(b.row_count())
-            self.monitor.record_node(node, rows, _time.perf_counter_ns() - t0)
+            self.monitor.record_node(node, rows, _TR.clock_ns() - t0)
         return b
 
     def _exec_window(self, node: P.Window) -> Batch:

@@ -1,9 +1,15 @@
-"""Observability: query/operator stats, events, EXPLAIN ANALYZE.
+"""Observability: query/operator stats, events, tracing, metrics,
+EXPLAIN ANALYZE.
 
 Reference parity: the metrics pipeline of SURVEY.md §5 — OperatorStats/
 QueryStats recorded around every operator call (operator/Driver.java:380),
 QueryMonitor events to pluggable EventListeners (event/QueryMonitor.java),
-and EXPLAIN ANALYZE rendering (operator/ExplainAnalyzeOperator.java).
+and EXPLAIN ANALYZE rendering (operator/ExplainAnalyzeOperator.java) —
+plus the TPU-native additions: span-based query tracing stitched across
+coordinator→worker HTTP hops (observe/trace.py), a process-wide metrics
+registry served as Prometheus text from /v1/metrics (observe/metrics.py),
+and XLA cost-analysis / jax.profiler attribution for fused programs
+(observe/profile.py).  See docs/OBSERVABILITY.md.
 """
 
 from presto_tpu.observe.stats import NodeStats, QueryMonitor, QueryStats

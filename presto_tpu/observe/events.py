@@ -10,6 +10,8 @@ audit, and external metrics sinks.
 from __future__ import annotations
 
 import dataclasses
+import logging
+import os
 from typing import Optional
 
 
@@ -55,12 +57,35 @@ class EventListener:
         pass
 
 
+#: listener classes whose failure was already logged once (the debug
+#: log is once-per-class so a hot listener bug can't flood stderr)
+_logged_listener_classes: set = set()
+
+
 def dispatch(listeners, method: str, event) -> None:
     for lis in listeners:
         try:
             getattr(lis, method)(event)
-        except Exception:
-            pass  # listener failures never fail the query (reference behavior)
+        except Exception as e:  # noqa: BLE001 — listener failures never
+            # fail the query (reference behavior), but they are no
+            # longer SILENT: every drop counts into the
+            # presto_tpu_listener_errors_total metric (by listener
+            # class), and PRESTO_TPU_DEBUG logs the first failure per
+            # listener class with the exception
+            cls = type(lis).__name__
+            try:
+                from presto_tpu.observe import metrics as M
+
+                M.listener_error(cls)
+            except Exception:  # noqa: BLE001 — metrics must not raise here
+                pass
+            if os.environ.get("PRESTO_TPU_DEBUG") \
+                    and cls not in _logged_listener_classes:
+                _logged_listener_classes.add(cls)
+                logging.getLogger("presto_tpu.observe").warning(
+                    "event listener %s.%s failed (suppressed; counted in "
+                    "listener_errors): %s: %s",
+                    cls, method, type(e).__name__, e)
 
 
 class FileAuditLogListener(EventListener):
@@ -86,14 +111,27 @@ class FileAuditLogListener(EventListener):
                      "create_time": event.create_time})
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
+        from presto_tpu.observe.metrics import querystats_counter_fields
+
         s = event.stats
-        self._write({
+        record = {
             "event": "query_completed", "query_id": event.query_id,
             "user": self.user, "sql": event.sql, "state": event.state,
             "error": event.error,
             "execution_mode": s.execution_mode,
-            "output_rows": int(s.output_rows),
             "total_ms": s.total_ns / 1e6,
-            "peak_memory_bytes": int(s.peak_memory_bytes),
-            "spilled_bytes": int(s.spilled_bytes),
-        })
+            "phase_ms": {k: round(v / 1e6, 3)
+                         for k, v in s.phase_ns.items()},
+        }
+        # EVERY numeric QueryStats counter rides the audit record —
+        # enumerated from the dataclass (the same list the metrics
+        # exporter and the schema-drift test walk), so a new subsystem's
+        # counters (compile/df/fusion/serving/recovery, and whatever
+        # comes next) can never silently miss the audit log again
+        for name in querystats_counter_fields():
+            v = getattr(s, name, 0)
+            record[name] = float(v) if isinstance(v, float) else int(v)
+        record["recovery"] = dict(s.recovery)
+        record["resource_group"] = s.resource_group or None
+        record["trace_id"] = s.trace_id or None
+        self._write(record)

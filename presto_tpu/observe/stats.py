@@ -7,7 +7,10 @@ QueryStateMachine (execution/QueryStateMachine.java: QUEUED → PLANNING →
 RUNNING → FINISHED/FAILED).  Per-node stats are collected in dynamic
 execution; compiled/distributed execution reports fragment-level timings
 (the whole plan is one fused XLA program — there is no per-operator
-boundary at runtime, which is the point of the design).
+boundary at runtime, which is the point of the design; attribution
+INSIDE those programs comes from XLA cost analysis + the profiler via
+observe/profile.py, and the host-visible lifecycle from the span
+recorder in observe/trace.py).
 """
 
 from __future__ import annotations
@@ -115,6 +118,15 @@ class QueryStats:
     result_cache_hit: int = 0
     resource_group: str = ""
     admission_wait_ms: float = 0.0
+    # tracing (observe/trace.py): this query's trace id, the recorded
+    # span dicts (coordinator + merged worker spans; chrome-exportable
+    # via trace.chrome_trace / GET /v1/query/{id}/trace), and the count
+    # of foreign-trace spans the coordinator refused to merge (a worker
+    # that never saw the X-Presto-Trace header recorded a worker-LOCAL
+    # trace — the degradation is counted, never an error)
+    trace_id: str = ""
+    trace_spans: Optional[list] = None
+    trace_spans_dropped: int = 0
     # cluster-mode recovery counters (parallel/retry.RunContext.count):
     # http_retries, pages_retried, workers_quarantined, workers_readmitted,
     # hedges_launched, hedges_won, task_cancels, query_retries,
@@ -142,6 +154,8 @@ class QueryMonitor:
     (reference: QueryStateMachine + event/QueryMonitor.java)."""
 
     def __init__(self, session, sql: str):
+        from presto_tpu.observe import trace as TR
+
         self.session = session
         self.stats = QueryStats(
             query_id=f"q_{next(_query_ids)}",
@@ -151,6 +165,18 @@ class QueryMonitor:
         self.collect_node_stats = bool(
             session.properties.get("collect_node_stats", False))
         self.rows_preset = False  # EXPLAIN ANALYZE pins the analyzed count
+        # tracing (observe/trace.py): one tracer per query when enabled;
+        # the query root span opens here and closes in finish()/fail().
+        # execute_query / ClusterSession.sql ACTIVATE the tracer on the
+        # query thread so nested instrumentation (compile_cache, the
+        # cluster client, chunked fragments) finds it.
+        self.tracer = None
+        if TR.enabled(session):
+            self.tracer = TR.Tracer()
+            self.stats.trace_id = self.tracer.trace_id
+            self.tracer.begin_root(
+                "query", kind="query", query_id=self.stats.query_id,
+                sql=sql[:200])
 
     @classmethod
     def begin(cls, session, sql: str):
@@ -169,9 +195,17 @@ class QueryMonitor:
         self.stats.state = {"parse": "PLANNING", "plan": "PLANNING",
                             "execute": "RUNNING"}.get(name, "RUNNING")
         t0 = time.perf_counter_ns()
+        # entered manually (not `with`) so spans recorded INSIDE the
+        # phase nest under it on this thread's stack
+        cm = self.tracer.span(name, kind="phase") \
+            if self.tracer is not None else None
+        if cm is not None:
+            cm.__enter__()
         try:
             yield
         finally:
+            if cm is not None:
+                cm.__exit__(None, None, None)
             self.stats.phase_ns[name] = (
                 self.stats.phase_ns.get(name, 0) + time.perf_counter_ns() - t0)
 
@@ -181,6 +215,21 @@ class QueryMonitor:
         ns.rows_out = rows_out
         ns.wall_ns += wall_ns
         ns.invocations += 1
+
+    def _close_trace(self) -> None:
+        """End the root span, export the span dicts onto the stats, and
+        fold the finished query into the metrics registry — the one
+        funnel every execution mode's completion passes through."""
+        from presto_tpu.observe import metrics as M
+
+        if self.tracer is not None:
+            self.tracer.end(self.tracer.root, state=self.stats.state)
+            self.stats.trace_spans = self.tracer.snapshot()
+            self.stats.trace_spans_dropped = self.tracer.dropped
+        try:
+            M.observe_query(self.stats)
+        except Exception:
+            pass  # metrics export must never fail a query
 
     def finish(self, result) -> None:
         from presto_tpu.observe.events import QueryCompletedEvent, dispatch
@@ -204,6 +253,7 @@ class QueryMonitor:
                 self.stats.output_rows = len(result)
             except TypeError:
                 pass
+        self._close_trace()
         dispatch(self.session.event_listeners, "query_completed",
                  QueryCompletedEvent(self.stats.query_id, self.stats.sql,
                                      "FINISHED", self.stats))
@@ -214,6 +264,7 @@ class QueryMonitor:
         self.stats.state = "FAILED"
         self.stats.end_time = time.time()
         self.stats.error = f"{type(error).__name__}: {error}"
+        self._close_trace()
         dispatch(self.session.event_listeners, "query_completed",
                  QueryCompletedEvent(self.stats.query_id, self.stats.sql,
                                      "FAILED", self.stats, self.stats.error))
@@ -242,4 +293,17 @@ def annotated_plan(plan_root, subplans, stats: QueryStats) -> str:
     ph = ", ".join(f"{k}: {v / 1e6:.1f}ms" for k, v in stats.phase_ns.items())
     lines.append(f"\nQuery {stats.query_id}: {ph}; output rows: "
                  f"{stats.output_rows}")
+    lines.append(trace_summary_line(stats))
     return "\n".join(lines)
+
+
+def trace_summary_line(stats: QueryStats) -> str:
+    """The EXPLAIN ANALYZE trace attachment: where to fetch the chrome
+    trace-event JSON (served by /v1/query/{id}/trace; also on
+    QueryResult.stats.trace_spans) and how big it is."""
+    if not stats.trace_id:
+        return "Trace: disabled (trace_detail=off)"
+    n = "recording" if stats.trace_spans is None \
+        else f"{len(stats.trace_spans)} spans"
+    return (f"Trace: {stats.trace_id} ({n}; chrome-trace JSON at "
+            f"/v1/query/{stats.query_id}/trace, loads in Perfetto)")

@@ -63,6 +63,7 @@ import numpy as np
 
 from presto_tpu import session_ctx as _sctx
 from presto_tpu.exec import compile_cache as CC
+from presto_tpu.observe import trace as TR
 from presto_tpu.parallel import faults as F
 from presto_tpu.parallel import retry as R
 from presto_tpu.plan import runtime_filters as DF
@@ -109,7 +110,7 @@ def _sign(secret: bytes, method: str, path: str, body: bytes,
           ts: Optional[str] = None) -> str:
     """Header value `ts:mac` — the timestamp is signed, giving captured
     requests a bounded replay window even over plaintext DCN."""
-    ts = ts if ts is not None else str(int(time.time()))
+    ts = ts if ts is not None else str(int(TR.wall_s()))
     mac = hmac.new(secret, digestmod=hashlib.sha256)
     mac.update(method.encode())
     mac.update(b"\n")
@@ -125,7 +126,7 @@ def _verify_auth(secret: bytes, header: str, method: str, path: str,
                  body: bytes) -> bool:
     ts, _, _ = header.partition(":")
     try:
-        skew = abs(time.time() - int(ts))
+        skew = abs(TR.wall_s() - int(ts))
     except ValueError:
         return False
     if skew > _AUTH_MAX_SKEW:
@@ -430,6 +431,14 @@ def _signed_request(method: str, url: str,
     """THE request builder: every outbound control/data-plane request is
     constructed (and HMAC-signed over the full request target) here."""
     req = urllib.request.Request(url, data=body, method=method)
+    # trace-context propagation (observe/trace.py): every outbound
+    # request carries this thread's trace context so worker-side task
+    # spans stitch into the coordinator's trace; a stripped header
+    # (PRESTO_TPU_TRACE_PROPAGATION=off) degrades the worker to a
+    # worker-local trace, never an error
+    tctx = TR.wire_context()
+    if tctx is not None:
+        req.add_header(TR.TRACE_HEADER, tctx)
     secret = cluster_secret()
     if secret is not None:
         parts = urlsplit(url)  # sign the full request target (path?query)
@@ -703,6 +712,24 @@ class _ClusterExecutor:
     def _pull_one(self, inp):
         """Pull + merge one exchange input; returns (host columns
         {sym: (data, valid)}, device Batch)."""
+        from presto_tpu.batch import Batch, column_from_numpy
+        import jax.numpy as jnp
+
+        # trace_detail=full: each exchange pull is its own span
+        full = str(self.spec.properties.get(
+            "trace_detail", "basic")).lower() == "full"
+        pull_cm = TR.maybe_span(f"pull eid{inp['eid']}",
+                                eid=inp["eid"], kind_=inp["kind"]) \
+            if full else None
+        if pull_cm is not None:
+            pull_cm.__enter__()
+        try:
+            return self._pull_one_inner(inp)
+        finally:
+            if pull_cm is not None:
+                pull_cm.__exit__(None, None, None)
+
+    def _pull_one_inner(self, inp):
         from presto_tpu.batch import Batch, column_from_numpy
         import jax.numpy as jnp
 
@@ -988,7 +1015,8 @@ class _ClusterExecutor:
                for inp in self.spec.inputs}
         out, guard, counters = DX.run_fused_fragment(
             self.session, root, self._fused_ndev, ext,
-            dict(self.spec.scalar_results), self.spec.fragment)
+            dict(self.spec.scalar_results), self.spec.fragment,
+            profile=bool(self.spec.properties.get("profile_fragment")))
         if guard:
             raise DX.FusedGuardTripped(
                 "fused super-fragment guard tripped (capacity overflow "
@@ -998,10 +1026,93 @@ class _ClusterExecutor:
                     int(self.spec.properties.get("fragments_fused") or 0))
         self._count("exchange_bytes_collective",
                     int(counters.get("exchange_bytes_collective", 0)))
+        for k in ("xla_flops", "xla_bytes_accessed"):
+            if counters.get(k):  # EXPLAIN ANALYZE cost attribution
+                self.counters[k] = int(counters[k])
         for k, v in counters.items():
             if k.startswith("df_") and v:
                 self.df_counts[k] = self.df_counts.get(k, 0) + v
         return self._fetch_out_cols(out)
+
+    def _profile_cost(self, root) -> None:
+        """EXPLAIN ANALYZE only: AOT-lower a STATIC trace of this cut
+        fragment over the worker's scan + exchange batches and read
+        XLA's cost analysis off the compiled program — the
+        compiler-sourced FLOPs/bytes attribution the eager superstep
+        execution can't provide.  Strictly best-effort: a fragment the
+        static executor can't bound simply reports no cost block."""
+        import jax.numpy as jnp
+
+        from presto_tpu.batch import Batch, column_from_numpy
+        from presto_tpu.exec.executor import Executor
+        from presto_tpu.observe import profile as PR
+        from presto_tpu.plan import nodes as P
+
+        try:
+            spec = self.spec
+            scan_nodes: List[P.PlanNode] = []
+
+            def walk(n):
+                if isinstance(n, P.TableScan):
+                    scan_nodes.append(n)
+                for f in dataclasses.fields(n):
+                    v = getattr(n, f.name)
+                    if isinstance(v, P.PlanNode):
+                        walk(v)
+                    elif isinstance(v, list):
+                        for x in v:
+                            if isinstance(x, P.PlanNode):
+                                walk(x)
+
+            walk(root)
+            exch = getattr(self, "_exch", {})
+            batches = []
+            for node in scan_nodes:
+                if node.table in exch:
+                    b = exch[node.table]
+                    cols = {s: b.columns[c]
+                            for s, c in node.assignments.items()}
+                    batches.append(Batch(cols, b.sel))
+                    continue
+                table = self.session.catalog.get(node.table)
+                ranges = table.splits(spec.nworkers)
+                mine = [r for i, r in enumerate(ranges)
+                        if i % spec.nworkers == spec.windex]
+                needed = list(dict.fromkeys(node.assignments.values()))
+                datas = [table.read(needed, split=r) for r in mine]
+                cols = {}
+                n = 0
+                for sym, cname in node.assignments.items():
+                    parts = [d[cname] for d in datas]
+                    arr = np.concatenate(parts) if parts else np.empty(
+                        0, dtype=object if node.types[sym].is_string
+                        else node.types[sym].numpy_dtype())
+                    cols[sym] = column_from_numpy(arr, node.types[sym])
+                    n = len(arr)
+                batches.append(Batch(cols, jnp.ones((n,), dtype=bool)))
+
+            def fn(bs):
+                ex = Executor(self.session, static=True,
+                              scan_inputs={id(nd): b for nd, b
+                                           in zip(scan_nodes, bs)})
+                ex.allow_index_join = False
+                ex.ctx.scalar_results = dict(spec.scalar_results)
+                out = ex.exec_node(root)
+                if ex.guards:
+                    g = jnp.any(jnp.stack(
+                        [jnp.asarray(x) for x in ex.guards]))
+                else:
+                    g = jnp.asarray(False)
+                return out, g
+
+            jitted = CC.build_jit(fn, example=(batches,))
+            cost = PR.executable_cost(jitted)
+            if cost:
+                self.counters["xla_flops"] = int(cost.get("flops", 0))
+                self.counters["xla_bytes_accessed"] = int(
+                    cost.get("bytes_accessed", 0))
+        except Exception:  # noqa: BLE001 — diagnostics must not fail tasks
+            pass
 
     def _publish_cols(self, cols):
         """Partition one superstep's output and publish a page per
@@ -1060,6 +1171,17 @@ class _ClusterExecutor:
 
     def run(self) -> None:
         root = plan_serde.loads(self.spec.fragment)
+        self._run_root(root)
+        if self.spec.properties.get("profile_fragment") \
+                and not self._fused_ndev:
+            # EXPLAIN ANALYZE attribution for CUT fragments: the normal
+            # execution above ran eagerly (host supersteps), so the XLA
+            # cost analysis comes from a diagnostic static trace of the
+            # same fragment over this worker's batches — an extra
+            # compile paid ONLY when profiling was requested
+            self._profile_cost(root)
+
+    def _run_root(self, root) -> None:
         if self._fused_ndev:
             # fused super-fragment: pull the (rare) non-fused external
             # inputs, then run the whole pipeline as one mesh program.
@@ -1077,6 +1199,7 @@ class _ClusterExecutor:
         # BEFORE any scan executes (wait_ms=0 skips straight through)
         self._df_summaries = self._df_receive()
         exch = self._exchange_batches()
+        self._exch = exch  # kept for the EXPLAIN ANALYZE cost trace
         scan_tables = self._scan_tables(root)
 
         if self.spec.out_kind == "range":
@@ -1251,7 +1374,7 @@ class WorkerServer:
             os._exit(1)
         threading.Thread(target=self.stop, daemon=True).start()
 
-    def submit(self, spec: TaskSpec):
+    def submit(self, spec: TaskSpec, trace_ctx: Optional[str] = None):
         with self.lock:
             # pages: bucket -> list of page bytes (None = acked/pruned);
             # complete flips when the producer will publish no more
@@ -1262,6 +1385,13 @@ class WorkerServer:
                     # dynamic-filter side channel: fid -> {part: payload}
                     "dynfilters": {}, "df_event": threading.Event()}
             self.tasks[spec.task_id] = task
+        # tracing (observe/trace.py): the task records its spans on a
+        # worker-side tracer seeded from the X-Presto-Trace header, so
+        # the coordinator can merge them into ONE query trace (they ride
+        # the task status payload).  A missing/dropped header degrades
+        # to a worker-LOCAL trace — fresh trace id, still well-formed —
+        # which the coordinator's merge then refuses and counts.
+        wtrace_id, wparent = TR.from_wire(trace_ctx)
 
         # task-accept warm (compile-ahead analog): a task that will wait
         # on exchange pages pre-reads its scan splits on the bounded
@@ -1377,8 +1507,27 @@ class WorkerServer:
                 bag = CC.CompileStats()
                 cex = _ClusterExecutor(task_session, spec, publish=publish,
                                        task_state=task)
-                with R.activate(wctx), CC.recording(bag):
-                    cex.run()
+                tracer = TR.Tracer(trace_id=wtrace_id,
+                                   lane=f"worker:{self.port}",
+                                   root_parent=wparent)
+                tspan = tracer.begin_root(
+                    f"task {spec.task_id}", kind="task",
+                    task_id=spec.task_id, windex=spec.windex,
+                    attempt=spec.attempt,
+                    fused=bool(spec.properties.get("fused_ndev")),
+                    local_trace=wtrace_id is None)
+                try:
+                    with R.activate(wctx), CC.recording(bag), \
+                            TR.activate(tracer):
+                        cex.run()
+                finally:
+                    tracer.end(tspan)
+                    spans = tracer.snapshot()
+                    with self.lock:
+                        task["spans"] = spans
+                    # chaos-test observability: the last task's spans
+                    # survive the coordinator's task DELETE
+                    self.last_task_spans = spans
                 with self.lock:
                     for k in ("compiles", "compile_cache_hits",
                               "compile_ahead_hits"):
@@ -1472,7 +1621,8 @@ def _make_worker_handler(server: WorkerServer):
                         {"error": f"bad task payload: {e}"}).encode(),
                         "application/json")
                     return
-                server.submit(spec)
+                server.submit(spec,
+                              trace_ctx=self.headers.get(TR.TRACE_HEADER))
                 self._send(200, json.dumps(
                     {"taskId": spec.task_id}).encode(), "application/json")
             elif self.path.startswith("/v1/task/") \
@@ -1522,6 +1672,25 @@ def _make_worker_handler(server: WorkerServer):
         def do_GET(self):
             if self._fault_gate():
                 return
+            if self.path == "/v1/metrics":
+                # Prometheus scrape (observe/metrics.py): the process
+                # registry — which pre-registers every QueryStats
+                # counter even though workers never run whole queries —
+                # plus this worker's task-accounting counters as gauges.
+                # Served WITHOUT the HMAC (a scraper can't sign the
+                # rolling timestamp): the payload is aggregate counters
+                # only — no SQL text, no task payloads, no page data —
+                # and the loopback-bind rule still applies to the
+                # socket itself.
+                from presto_tpu.observe import metrics as M
+
+                with server.lock:
+                    counters = dict(server.counters)
+                counters["mesh_devices"] = server.mesh_devices
+                body = M.render_scrape(counters).encode()
+                self._send(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+                return
             if not self._authorized():
                 self._send(401, b"{}", "application/json")
                 return
@@ -1552,7 +1721,10 @@ def _make_worker_handler(server: WorkerServer):
                     self._send(200, json.dumps(
                         {"state": task["state"],
                          "error": task["error"],
-                         "counters": task.get("counters") or {}}).encode(),
+                         "counters": task.get("counters") or {},
+                         # worker-side spans for the coordinator's
+                         # trace merge (set when execution ends)
+                         "spans": task.get("spans") or []}).encode(),
                         "application/json")
                     return
                 # /v1/task/{tid}/results/{bucket}/{token}[/ack]
@@ -1705,12 +1877,16 @@ class _HedgeMonitor(threading.Thread):
     def run(self):
         backoff = self.ctx.policy.backoff()
         try:
-            while not self._halt.is_set():
-                pending = sum(self._scan(entries)
-                              for entries in self.waves.values())
-                if pending == 0 or self.ctx.deadline.expired():
-                    return
-                backoff.sleep(self.ctx.deadline)
+            # the query tracer rides onto this thread so hedge task
+            # submissions carry the trace header and hedge spans land
+            # on the hedge-monitor lane of the query's trace
+            with TR.activate(getattr(self.cs, "_tracer", None)):
+                while not self._halt.is_set():
+                    pending = sum(self._scan(entries)
+                                  for entries in self.waves.values())
+                    if pending == 0 or self.ctx.deadline.expired():
+                        return
+                    backoff.sleep(self.ctx.deadline)
         except Exception:  # noqa: BLE001 — hedging is strictly best-effort
             pass
 
@@ -1725,6 +1901,7 @@ class _HedgeMonitor(threading.Thread):
                 e["done"] = now
                 if e["hedge"] is not None:  # original won: reap the hedge
                     self.all_tasks.append(tuple(e["hedge"]))
+                    self._end_span(e, won=tid, lost=e["hedge"][1])
                 continue
             if e["hedge"] is not None \
                     and self._state(*e["hedge"]) == "FINISHED":
@@ -1736,6 +1913,7 @@ class _HedgeMonitor(threading.Thread):
                 e["done"] = now
                 self.ctx.count("hedges_won", task=tid,
                                winner=e["hedge"][1])
+                self._end_span(e, won=e["hedge"][1], lost=tid)
                 continue
             pending += 1
         if pending == 0:
@@ -1775,6 +1953,22 @@ class _HedgeMonitor(threading.Thread):
         e["hedge"] = [target, hspec.task_id]
         self.all_tasks.append((target, hspec.task_id))
         self.ctx.count("hedges_launched", task=tid0, target=target)
+        # the hedged attempt is its own trace lane (the hedge-monitor
+        # thread): closed by _end_span with the winning/LOSING task ids
+        # marked, so a hedge race is visible in the timeline instead of
+        # inferred from counters
+        tracer = getattr(self.cs, "_tracer", None)
+        if tracer is not None:
+            e["span"] = tracer.begin(
+                f"hedge {tid0}", kind="attempt", task=tid0,
+                hedge_task=hspec.task_id, target=target)
+
+    def _end_span(self, e, won: str, lost: str) -> None:
+        sp = e.pop("span", None)
+        if sp is not None:
+            tracer = getattr(self.cs, "_tracer", None)
+            if tracer is not None:
+                tracer.end(sp, won=won, lost=lost)
 
 
 class ClusterSession:
@@ -1890,10 +2084,15 @@ class ClusterSession:
         ctx = self._query_ctx(mon.stats.query_id)
         mon.stats.recovery = ctx.recovery  # live view, not a copy
         self._coord_df = {}
+        # tracer shared with the hedge monitor + the status-time span
+        # collection; worker task spans merge into it before finish()
+        self._tracer = mon.tracer
+        self._frag_profile = {}
         try:
-            with R.activate(ctx), CC.recording(mon.stats):
+            with R.activate(ctx), CC.recording(mon.stats), \
+                    TR.activate(mon.tracer):
                 try:
-                    result = self._sql_attempts(text, ctx)
+                    result = self._sql_attempts(text, ctx, mon)
                 except BaseException as e:
                     mon.fail(e)
                     raise
@@ -1919,16 +2118,29 @@ class ClusterSession:
             result.stats = mon.stats  # race-free vs session.last_stats
         return result
 
-    def _sql_attempts(self, text: str, ctx: R.RunContext):
+    def _sql_attempts(self, text: str, ctx: R.RunContext, mon=None):
         import shutil
 
         from presto_tpu.exec.executor import plan_statement
         from presto_tpu.plan.distribute import Undistributable
         from presto_tpu.sql.parser import parse
+        from presto_tpu.sql import ast as _ast
 
         self._refresh_pool(ctx)
         stmt = parse(text)
-        plan = plan_statement(self.session, stmt)
+        if isinstance(stmt, _ast.Explain):
+            if stmt.analyze and mon is not None:
+                # cluster-profiled EXPLAIN ANALYZE: execute the inner
+                # statement distributed with per-fragment profiling and
+                # render fragments annotated with task wall + XLA cost
+                return self._explain_analyze(stmt.statement, ctx, mon)
+            self._fused_count = 0
+            return self.session.sql(text)  # plain EXPLAIN: local render
+        if mon is not None:
+            with mon.phase("plan"):
+                plan = plan_statement(self.session, stmt)
+        else:
+            plan = plan_statement(self.session, stmt)
         attempts = 1 + int(self.session.properties.get(
             "cluster_query_retries", 1))
         # durable exchange (P12): pages persist on (shared) disk for the
@@ -1946,6 +2158,11 @@ class ClusterSession:
         # consistent with pages already durably produced) and remaps the
         # dead workers' slots onto survivors.
         layout = list(self.workers)
+        # entered manually so attempt spans + worker RPCs land inside
+        # the execute phase on this query's trace
+        phase_cm = mon.phase("execute") if mon is not None else None
+        if phase_cm is not None:
+            phase_cm.__enter__()
         try:
             fuse_ok = True
             for attempt in range(attempts):
@@ -2009,6 +2226,8 @@ class ClusterSession:
                     ctx.count("query_retries", survivors=len(survivors))
             raise RuntimeError("unreachable")
         finally:
+            if phase_cm is not None:
+                phase_cm.__exit__(None, None, None)
             if ddir is not None:
                 shutil.rmtree(ddir, ignore_errors=True)
 
@@ -2088,6 +2307,7 @@ class ClusterSession:
                             f.fused_ndev = mesh_ndev
                     fragments = fused
                     self._fused_count = nfused
+        self._last_fragments = fragments  # EXPLAIN ANALYZE rendering
         coordinator_result = self._schedule(fragments, scalar_results,
                                             layout, ddir, attempt)
 
@@ -2332,10 +2552,18 @@ class ClusterSession:
                             .get("dynamic_filtering", True),
                             "dynamic_filtering_wait_ms":
                             self.session.properties.get(
-                                "dynamic_filtering_wait_ms", 0)},
+                                "dynamic_filtering_wait_ms", 0),
+                            # tracing detail travels with the task so
+                            # "full" turns on worker page-pull spans
+                            "trace_detail": self.session.properties.get(
+                                "trace_detail", "basic")},
                         durable_dir=ddir, durable_key=dkey,
                         attempt=attempt, replay=replay,
                     )
+                    if getattr(self, "_profile_fragments", False):
+                        # EXPLAIN ANALYZE: workers attach XLA cost
+                        # analysis to their task counters
+                        spec.properties["profile_fragment"] = True
                     if fused:
                         # the worker routes this task through the fused
                         # mesh path (run_fused_fragment) at this ndev
@@ -2360,7 +2588,7 @@ class ClusterSession:
                         self._task_specs[tid] = (spec, frag.fid)
                         tasks.append(placements[frag.fid][w])
                 self.schedule_trace.append(
-                    (frag.fid, phases[frag.fid], time.time()))
+                    (frag.fid, phases[frag.fid], TR.wall_s()))
                 if tasks:
                     all_tasks.extend(tasks)
                     prev_wave_tasks.extend(tasks)
@@ -2421,6 +2649,7 @@ class ClusterSession:
                                     + int(v)
                     except Exception:  # noqa: BLE001 — telemetry only
                         pass
+        self._collect_task_traces(fragments, placements, ctx)
         merged = [unpack_columns(p) for p in pages.get(0, [])]
         # single final page expected (gather output); concat defensively
         if len(merged) == 1:
@@ -2439,6 +2668,127 @@ class ClusterSession:
                             else np.ones(len(d) - len(pd), bool)])
                 out[k] = (d, v)
         return out
+
+    def _explain_analyze(self, stmt, ctx, mon):
+        """Cluster-profiled EXPLAIN ANALYZE: run the statement through
+        the real distributed path with per-fragment profiling enabled
+        (workers attach XLA cost analysis to their task counters —
+        fused tasks read it off the fused executable, cut tasks off a
+        diagnostic static trace), then render every fragment annotated
+        with measured task wall + FLOPs/HBM bytes + the roofline
+        estimate.  One attempt; an undistributable plan falls back to
+        the profiled single-node path."""
+        from presto_tpu import types as T
+        from presto_tpu.exec.executor import explain_analyze_text
+        from presto_tpu.observe import profile as PR
+        from presto_tpu.observe.stats import trace_summary_line
+        from presto_tpu.plan import nodes as P
+        from presto_tpu.plan.distribute import Undistributable
+        from presto_tpu.exec.executor import plan_statement
+        from presto_tpu.session import QueryResult
+
+        self._profile_fragments = True
+        try:
+            with mon.phase("plan"):
+                plan = plan_statement(self.session, stmt)
+            try:
+                phase_cm = mon.phase("execute")
+                phase_cm.__enter__()
+                try:
+                    result = self._run_distributed(plan)
+                finally:
+                    phase_cm.__exit__(None, None, None)
+            except (Undistributable, NotImplementedError):
+                self._fused_count = 0
+                text = explain_analyze_text(self.session, stmt, mon)
+                return QueryResult([("Query Plan", T.VARCHAR)],
+                                   [(text,)])
+        finally:
+            self._profile_fragments = False
+        mon.stats.output_rows = len(result.rows)
+        mon.rows_preset = True
+        lines = []
+        profile = getattr(self, "_frag_profile", {})
+        fragments = getattr(self, "_last_fragments", [])
+        nfr = len(fragments)
+        for frag in fragments:
+            p = profile.get(frag.fid) or {}
+            fused = bool(getattr(frag, "fused", False))
+            if frag.fid == nfr - 1:
+                kind = "coordinator result delivery"
+            elif fused:
+                kind = (f"fused shard_map x{frag.fused_ndev} devices, "
+                        f"absorbed {len(getattr(frag, 'fused_fids', []))}"
+                        " fragments")
+            else:
+                kind = "cut, HTTP exchange"
+            lines.append(f"Fragment {frag.fid} ({kind}, "
+                         f"tasks={p.get('tasks', 0)}):")
+            cost = {"flops": float(p.get("xla_flops", 0)),
+                    "bytes_accessed":
+                        float(p.get("xla_bytes_accessed", 0))} \
+                if p.get("has_cost") else None
+            note = "coordinator-local" if frag.fid == nfr - 1 \
+                else "untraceable fragment"
+            lines.append("   " + PR.cost_line(
+                cost, p.get("wall_ms") or None, note))
+            lines.append(P.plan_tree_str(frag.root, 1))
+            lines.append("")
+        lines.append(f"Query {mon.stats.query_id}: "
+                     + ", ".join(f"{k}: {v / 1e6:.1f}ms"
+                                 for k, v in mon.stats.phase_ns.items())
+                     + f"; output rows: {mon.stats.output_rows}; "
+                     f"fragments_fused: {self._fused_count}")
+        lines.append(trace_summary_line(mon.stats))
+        return QueryResult([("Query Plan", T.VARCHAR)],
+                           [("\n".join(lines),)])
+
+    def _collect_task_traces(self, fragments, placements, ctx) -> None:
+        """Post-success trace merge: pull each worker task's recorded
+        spans off its status payload and graft the ones carrying THIS
+        query's trace id into the coordinator tracer — the coordinator
+        and every worker then share ONE trace (hedge winners included:
+        slots were repointed, so the winning attempt's spans are read).
+        Also assembles the per-fragment profile (max task wall + the
+        XLA cost counters the EXPLAIN ANALYZE path requested).  Runs
+        only when tracing/profiling is on, so a trace_detail=off run's
+        RPC sequence is byte-identical to the pre-tracing engine."""
+        tracer = getattr(self, "_tracer", None)
+        profiling = bool(getattr(self, "_profile_fragments", False))
+        if tracer is None and not profiling:
+            return
+        self._frag_profile = {}
+        for frag in fragments:
+            prof = {"wall_ms": 0.0, "tasks": 0,
+                    "fused": bool(getattr(frag, "fused", False)),
+                    "xla_flops": 0, "xla_bytes_accessed": 0,
+                    "has_cost": False}
+            for slot in placements.get(frag.fid, []):
+                if slot[0] is None:
+                    continue  # the coordinator's own final fragment
+                try:
+                    st = json.loads(_http(
+                        f"{slot[0]}/v1/task/{slot[1]}/status",
+                        timeout=R.PROBE_TIMEOUT_S, ctx=ctx))
+                except R.DeadlineExceeded:
+                    raise
+                except Exception:  # noqa: BLE001 — telemetry only
+                    continue
+                spans = st.get("spans") or []
+                if tracer is not None:
+                    tracer.add_spans(spans)
+                prof["tasks"] += 1
+                for d in spans:
+                    if d.get("kind") == "task":
+                        dur = (float(d.get("end_us", 0))
+                               - float(d.get("start_us", 0))) / 1e3
+                        prof["wall_ms"] = max(prof["wall_ms"], dur)
+                counters = st.get("counters") or {}
+                for k in ("xla_flops", "xla_bytes_accessed"):
+                    if counters.get(k):
+                        prof[k] += int(counters[k])
+                        prof["has_cost"] = True
+            self._frag_profile[frag.fid] = prof
 
     def _coordinate_range(self, frag, tasks, out_buckets):
         """Pull key samples from every range producer, compute global
@@ -2533,11 +2883,11 @@ def launch_local_cluster(session, catalog_spec: str, nworkers: int = 2,
         procs.append(p)
     import select
 
-    deadline = time.time() + timeout
+    deadline = TR.wall_s() + timeout
     try:
         for p in procs:
             while True:
-                remaining = deadline - time.time()
+                remaining = deadline - TR.wall_s()
                 if remaining <= 0:
                     raise TimeoutError("cluster startup timed out")
                 ready, _, _ = select.select([p.stdout], [], [],

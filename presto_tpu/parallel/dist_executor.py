@@ -340,7 +340,8 @@ def _ext_repl_batch(host_cols, node: P.TableScan, mesh) -> Batch:
 
 
 def run_fused_fragment(session, root, ndev: int, ext_inputs,
-                       scalar_results, fragment_bytes: bytes):
+                       scalar_results, fragment_bytes: bytes,
+                       profile: bool = False):
     """Execute a fused super-fragment — a plan root with INLINE Exchange
     nodes (plan/distribute.fuse_fragments) — as ONE shard_map program
     over this process's local mesh: base-table scans shard over the
@@ -415,4 +416,17 @@ def run_fused_fragment(session, root, ndev: int, ext_inputs,
         ext_inputs[int(n.table[len("__exch_"):])]["cols"], n, mesh)
         for n in repl_nodes]
     out_batch, guard = jitted(scan_feed, shard_feed, repl_feed)
-    return out_batch, bool(guard), dict(counters)
+    out_counters = dict(counters)
+    if profile:
+        # EXPLAIN ANALYZE attribution: XLA cost analysis of the fused
+        # program (the memoized executable is a live jit — lower
+        # against the feeds; a diagnostic cost paid only when profiling)
+        from presto_tpu.observe import profile as PR
+
+        cost = PR.executable_cost(
+            jitted, args=(scan_feed, shard_feed, repl_feed))
+        if cost:
+            out_counters["xla_flops"] = int(cost.get("flops", 0))
+            out_counters["xla_bytes_accessed"] = int(
+                cost.get("bytes_accessed", 0))
+    return out_batch, bool(guard), out_counters

@@ -18,6 +18,8 @@ import time
 import urllib.request
 from typing import Callable, Dict, List, Optional
 
+from presto_tpu.observe import trace as TR
+
 # A single observation contributes DECAY_ALPHA to the ratio, so the
 # threshold must exceed it by enough that one transient miss (GC pause,
 # dropped packet) cannot flip a node: with alpha=0.05, three consecutive
@@ -84,7 +86,7 @@ class HeartbeatFailureDetector:
             node.failure_ratio = (DECAY_ALPHA * obs
                                   + (1 - DECAY_ALPHA) * node.failure_ratio)
             if ok:
-                node.last_seen = time.time()
+                node.last_seen = TR.wall_s()
             if was_alive and not node.alive and self.on_failure is not None:
                 self.on_failure(node.uri)
 
@@ -116,8 +118,8 @@ class ClusterSizeMonitor:
         self.min_nodes = min_nodes
 
     def wait_for_minimum_nodes(self, timeout: float = 10.0) -> bool:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = TR.wall_s() + timeout
+        while TR.wall_s() < deadline:
             if len(self.detector.alive_nodes()) >= self.min_nodes:
                 return True
             time.sleep(0.05)

@@ -9,8 +9,12 @@ sequence numbers: re-fetching a token re-serves the same page
 (at-least-once delivery with client dedup, the elasticity seam of
 SURVEY.md §2.6).  Also serves the introspection endpoints
 (server/QueryResource.java `/v1/query`, ClusterStatsResource
-`/v1/cluster`), node info/status for the failure detector, and the
-graceful-shutdown state machine (server/GracefulShutdownHandler.java).
+`/v1/cluster`), the Prometheus scrape (`/v1/metrics`,
+observe/metrics.py — the primary metrics surface; /v1/info remains as
+the JSON compatibility view), per-query chrome traces
+(`/v1/query/{id}/trace`, observe/trace.py — loads in Perfetto), node
+info/status for the failure detector, and the graceful-shutdown state
+machine (server/GracefulShutdownHandler.java).
 
 Execution is in-process on the embedded engine (the coordinator IS the
 mesh driver under SPMD — workers are TPU chips, not task servers; the
@@ -78,7 +82,9 @@ class PrestoTpuServer:
         self.jobs: Dict[str, _QueryJob] = {}
         self.jobs_lock = threading.Lock()
         self.node_id = f"node_{uuid.uuid4().hex[:8]}"
-        self.start_time = time.time()
+        from presto_tpu.observe import trace as TR
+
+        self.start_time = TR.wall_s()
         self.shutting_down = threading.Event()
         self.active_queries = 0
         self._sema = threading.Semaphore(max_concurrent)
@@ -368,14 +374,63 @@ class PrestoTpuServer:
                 "planHits": getattr(st, "prepared_plan_hits", 0),
                 "fallbacks": getattr(st, "prepared_fallbacks", 0),
             },
+            # tracing (observe/trace.py): the chrome trace lives at
+            # /v1/query/{id}/trace; spanCount hints whether it's worth
+            # fetching (0 = tracing was off for this query)
+            "traceId": getattr(st, "trace_id", "") or None,
+            "traceUri": f"/v1/query/{st.query_id}/trace",
+            "spanCount": len(getattr(st, "trace_spans", None) or []),
             "planText": plan_text,
             "nodes": nodes,
         }
 
+    def metrics_payload(self) -> str:
+        """GET /v1/metrics: the Prometheus text exposition of the
+        process-wide registry (observe/metrics.py), which every
+        QueryStats counter / recovery action / serving decision rolls
+        into at query completion.  Serving-tier aggregates are exported
+        as gauges at scrape time."""
+        from presto_tpu.observe import metrics as M
+
+        M.REGISTRY.gauge("presto_tpu_server_active_queries",
+                         "Queries admitted and not yet finished") \
+            .set(self.active_queries)
+        M.REGISTRY.gauge("presto_tpu_serving_admitted_total",
+                         "Queries admitted by the serving tier") \
+            .set(self.serving.queries_admitted)
+        M.REGISTRY.gauge("presto_tpu_serving_shed_total",
+                         "Queries shed by admission control") \
+            .set(self.serving.queries_shed)
+        M.REGISTRY.gauge("presto_tpu_serving_drained_total",
+                         "Queued queries drained at shutdown") \
+            .set(self.serving.queries_drained)
+        M.REGISTRY.gauge("presto_tpu_serving_peak_queue_depth",
+                         "Peak admission queue depth") \
+            .set(self.serving.peak_queue_depth)
+        if self.serving.result_cache is not None:
+            rc = self.serving.result_cache.stats()
+            for k, v in rc.items():
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    M.REGISTRY.gauge(
+                        f"presto_tpu_result_cache_{k}",
+                        f"Result cache {k}").set(v)
+        return M.render_scrape()
+
+    def trace_payload(self, st) -> dict:
+        """GET /v1/query/{id}/trace: the query's chrome trace-event
+        JSON (observe/trace.py) — open in Perfetto / chrome://tracing."""
+        from presto_tpu.observe import trace as TR
+
+        return TR.chrome_trace(st.trace_spans or [],
+                               getattr(st, "trace_id", ""))
+
     def info_payload(self) -> dict:
+        from presto_tpu.observe import trace as TR
+
         out = {
             "nodeId": self.node_id,
-            "uptimeMillis": int((time.time() - self.start_time) * 1000),
+            "uptimeMillis": int((TR.wall_s() - self.start_time) * 1000),
             "state": "SHUTTING_DOWN" if self.shutting_down.is_set()
                      else "ACTIVE",
             "coordinator": True,
@@ -484,11 +539,27 @@ def _make_handler(server: PrestoTpuServer):
                 return self._json(server.results_payload(job, token))
             if parts == ["v1", "query"]:
                 return self._json(server.query_list_payload())
+            if parts[:2] == ["v1", "query"] and len(parts) == 4 \
+                    and parts[3] == "trace":
+                for st in server.session.history_snapshot():
+                    if st.query_id == parts[2]:
+                        return self._json(server.trace_payload(st))
+                return self._json({"error": "unknown query"}, 404)
             if parts[:2] == ["v1", "query"] and len(parts) == 3:
                 for st in server.session.history_snapshot():
                     if st.query_id == parts[2]:
                         return self._json(server.query_detail_payload(st))
                 return self._json({"error": "unknown query"}, 404)
+            if parts == ["v1", "metrics"]:
+                body = server.metrics_payload().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if parts == ["v1", "info"]:
                 return self._json(server.info_payload())
             if parts == ["v1", "status"]:  # heartbeat probe target

@@ -51,6 +51,19 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # per-plan-node stats collection in dynamic mode (forced by EXPLAIN
     # ANALYZE; costs one host sync per operator — reference: OperationTimer)
     "collect_node_stats": False,
+    # observability (observe/trace.py + observe/profile.py,
+    # docs/OBSERVABILITY.md): span recording detail — "basic" (default)
+    # records query/phase/fragment/task/attempt/compile spans and
+    # merges worker spans into one trace; "full" adds per-page-pull
+    # spans in cluster mode; "off" disables the recorder entirely (the
+    # observability_overhead A/B lever; /v1/query/{id}/trace then
+    # serves an empty trace).  profile_query: a directory path to
+    # capture a jax.profiler trace of each query into (also env
+    # PRESTO_TPU_PROFILE; "" = off) — jax.named_scope annotations at
+    # every operator-lowering site map the profiler timeline back to
+    # plan node names.
+    "trace_detail": "basic",
+    "profile_query": "",
     # memory management (reference: query.max-memory-per-node +
     # experimental.spill-enabled, FeaturesConfig/MemoryManagerConfig)
     "query_max_memory_bytes": 4 << 30,

@@ -424,6 +424,106 @@ def test_fused_worker_crash_degrades_to_survivor(tpch_catalog_tiny):
                 w.stop()
 
 
+# ---- trace propagation under chaos (ISSUE 9 satellite) ----------------
+
+
+def _assert_one_well_formed_trace(st):
+    """Every recorded span carries the query's trace id and every
+    parent resolves inside the merged set (or is the root)."""
+    spans = st.trace_spans or []
+    assert spans
+    assert {d["trace_id"] for d in spans} == {st.trace_id}
+    ids = {d["span_id"] for d in spans}
+    for d in spans:
+        assert d["parent_id"] == "" or d["parent_id"] in ids, d
+    return spans
+
+
+@pytest.mark.slow
+def test_hedged_straggler_yields_one_trace_with_loser_marked(chaos):
+    """The hedged run produces a SINGLE well-formed trace: the hedge
+    attempt is its own span (hedge-monitor lane) whose args mark the
+    losing task, and the winner's worker-side task span is merged.
+    (Tier-2: the scripted 8s straggler delay is real wall time; the
+    tier-1 dropped-header test covers the degrade contract and
+    test_straggler_hedged_duplicate_wins covers hedging itself.)"""
+    session, cs, workers, want = chaos
+    workers[1].faults = F.FaultPlan.parse("exec:EXEC:*:1:delay:8.0")
+    try:
+        r = cs.sql(QUERY)
+        assert norm(r.rows) == want
+        assert r.stats.recovery.get("hedges_won", 0) >= 1
+        spans = _assert_one_well_formed_trace(r.stats)
+        hedges = [d for d in spans if d["kind"] == "attempt"
+                  and d["name"].startswith("hedge")]
+        assert hedges, [d["name"] for d in spans]
+        h = hedges[0]
+        assert h["args"].get("lost") and h["args"].get("won"), h
+        assert h["args"]["lost"] != h["args"]["won"]
+        # the winning attempt's worker task span made it into the trace
+        won = h["args"]["won"]
+        assert any(d["kind"] == "task" and d["args"].get("task_id") == won
+                   for d in spans), won
+    finally:
+        _reset(session, cs, workers)
+
+
+@pytest.mark.slow
+def test_crash_remap_yields_one_well_formed_trace(tpch_catalog_tiny):
+    """A worker crash + query retry still merges into ONE well-formed
+    trace (second-attempt task spans under the same trace id); spans
+    from the crashed worker are simply absent, never an error.
+    (Tier-2: spins its own 2-worker cluster + prewarm.)"""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    workers = [C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                              faults=F.FaultPlan([])).start()
+               for _ in range(2)]
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    try:
+        want = norm(session.sql(QUERY).rows)
+        assert norm(cs.sql(QUERY).rows) == want  # prewarm
+        workers[1].faults = F.FaultPlan.parse("exec:EXEC:*:1:crash")
+        r = cs.sql(QUERY)
+        assert norm(r.rows) == want
+        assert r.stats.recovery.get("query_retries", 0) == 1
+        spans = _assert_one_well_formed_trace(r.stats)
+        assert any(d["kind"] == "task" for d in spans)
+        # every merged task span came from the surviving worker
+        lanes = {d["lane"] for d in spans if d["kind"] == "task"}
+        assert lanes == {f"worker:{workers[0].port}"}, lanes
+    finally:
+        for w in workers:
+            if not w.crashed:
+                w.stop()
+
+
+def test_dropped_trace_header_degrades_to_worker_local(chaos,
+                                                       monkeypatch):
+    """PRESTO_TPU_TRACE_PROPAGATION=off strips the X-Presto-Trace
+    header: workers record worker-LOCAL traces (fresh trace ids), the
+    coordinator's merge refuses and counts them, the query succeeds,
+    and the coordinator-side trace stays well-formed."""
+    session, cs, workers, want = chaos
+    monkeypatch.setenv("PRESTO_TPU_TRACE_PROPAGATION", "off")
+    try:
+        r = cs.sql(QUERY)
+        assert norm(r.rows) == want
+        st = r.stats
+        spans = _assert_one_well_formed_trace(st)
+        assert {d["lane"] for d in spans} == {"coordinator"}
+        assert st.trace_spans_dropped >= 1
+        # the worker really did record a LOCAL trace of its own
+        locals_ = [w.last_task_spans for w in workers
+                   if getattr(w, "last_task_spans", None)]
+        assert locals_
+        for wspans in locals_:
+            assert all(d["trace_id"] != st.trace_id for d in wspans)
+            assert any(d["args"].get("local_trace") for d in wspans
+                       if d["kind"] == "task")
+    finally:
+        _reset(session, cs, workers)
+
+
 def test_env_fault_plan_roundtrip(monkeypatch):
     monkeypatch.setenv("PRESTO_TPU_FAULTS",
                        "server:GET:/results/:3:drop;exec:EXEC:*:1:fail")

@@ -156,6 +156,49 @@ def test_no_raw_membership_mixing_outside_kernels():
     assert not bad, "\n".join(bad)
 
 
+def test_no_raw_span_timing_outside_observe():
+    """Observability gate (ISSUE 9): wall/span clock reads —
+    `time.time()`, `time.perf_counter()`, `time.perf_counter_ns()` —
+    are confined to `observe/` (trace.clock_ns / trace.wall_s are the
+    routed entry points) across the engine's query-lifecycle layers,
+    so every duration that can land in a span, a QueryStats field, or
+    a metric flows through the same clocks the tracer uses.
+    `time.monotonic()` stays allowed: the retry/deadline layer's
+    budget arithmetic is not span timing.  Scope: the executors, the
+    cluster/dist layers, and the server modules (PR-2's named-constant
+    rule pattern); CLI/bench/verifier tooling keeps its own timers."""
+    import ast
+
+    CHECKED = [
+        os.path.join("exec", f) for f in
+        ("executor.py", "chunked.py", "compile_cache.py", "compiler.py",
+         "gather.py", "kernels.py", "window.py")
+    ] + [
+        os.path.join("parallel", f) for f in
+        ("cluster.py", "dist_executor.py", "exchange.py", "mesh.py")
+    ] + [
+        os.path.join("server", f) for f in
+        ("protocol.py", "serving.py", "resource_groups.py",
+         "discovery.py", "metastore.py")
+    ]
+    FORBIDDEN = {"time", "perf_counter", "perf_counter_ns"}
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for rel in CHECKED:
+        path = os.path.join(pkg, rel)
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in FORBIDDEN \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "time":
+                bad.append(f"{rel}:{node.lineno}: time.{node.attr} — "
+                           "route through observe/trace.clock_ns() / "
+                           "wall_s()")
+    assert not bad, "\n".join(bad)
+
+
 def test_no_raw_sleeps_or_timeouts_in_parallel():
     """Robustness gate (ISSUE 2, extended by ISSUE 6 to the serving
     modules): presto_tpu/parallel/retry.py is the ONLY module in the

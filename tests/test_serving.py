@@ -455,7 +455,7 @@ def test_cluster_coordinator_admission(monkeypatch):
         rows = [(1,)]
 
     monkeypatch.setattr(ClusterSession, "_sql_attempts",
-                        lambda self, text, ctx: _R())
+                        lambda self, text, ctx, mon=None: _R())
     cs.sql("SELECT 1")
     g = rgm._resolve("global.c")
     assert g.total_admitted == 1 and g.running == 0
