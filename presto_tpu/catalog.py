@@ -69,6 +69,14 @@ class ConnectorTable:
         elided sort back, never correctness.  Empty = unordered."""
         return []
 
+    # ---- write-layout SPI (exec/writer.py): the physical properties a
+    # write DECLARED (bucketed_by/bucket_count/sorted_by/partitioned_by)
+    # and — when the written file sequence verified as globally ordered
+    # — the ordering() claim derived from them.  SHOW CREATE TABLE and
+    # DESCRIBE surface these so a round-trip reproduces the layout. ----
+    def write_properties(self) -> Optional[dict]:
+        return None
+
     # ---- bucketing SPI (reference: Connector.getNodePartitioningProvider,
     # presto-spi/.../spi/connector/Connector.java:74 + BucketNodeMap;
     # here the metadata that lets grouped/chunked execution stream this
@@ -121,7 +129,22 @@ class MemoryTable(ConnectorTable):
         return {c: self.data[c][a:b] for c in cols}
 
     # ---- write SPI (reference: ConnectorPageSinkProvider; the memory
-    # connector's MemoryPagesStore.add) ----
+    # connector's MemoryPagesStore.add).  The memory connector has no
+    # staged sink; engine writes adapt through connectors.AppendPageSink
+    # and the writer records layout properties post-commit. ----
+    def record_write_properties(self, props, ordered: bool = False) -> None:
+        self._write_props = props
+        self._layout_ordered = bool(ordered)
+
+    def write_properties(self):
+        return getattr(self, "_write_props", None)
+
+    def ordering(self):
+        if getattr(self, "_layout_ordered", False) and self._write_props:
+            return [(c, bool(a))
+                    for c, a in self._write_props.get("sorted_by", [])]
+        return []
+
     def append(self, arrays: Dict[str, np.ndarray]) -> int:
         n = len(next(iter(arrays.values()))) if arrays else 0
         if n == 0:

@@ -5,7 +5,18 @@ Reference parity: presto-local-file + the presto-raptor storage model
 directory of .ptsh shard files written by the engine itself (CTAS /
 INSERT target) and scanned with stripe-level zone-map pruning
 (presto-orc's row-group pruning analog).
-"""
+
+Snapshot layer (PR: writable engine): `schema.json` doubles as the
+table MANIFEST — the authoritative, atomically-replaced (tmp +
+os.replace) list of live shard files plus the recorded write layout
+(bucketed_by / sorted_by / partitioned_by, exec/writer.py).  Writes
+stage invisible `.stg` files and publish by renaming + rewriting the
+manifest in one generation bump; readers resolve their file list
+through the manifest, so an in-flight reader keeps the previous
+generation's files (retired files are garbage-collected one generation
+later, or at DROP).  This is what makes CREATE OR REPLACE a
+refresh-and-serve cut-over and localfile writes transactional
+(transaction.py snapshots/restores the manifest)."""
 
 from __future__ import annotations
 
@@ -17,11 +28,12 @@ import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.catalog import ConnectorTable
+from presto_tpu.connectors import PageSink, StagedFileSink, files_ordered
 from presto_tpu.storage.shard import Domain, ShardReader, write_shard
 
 
 class LocalFileTable(ConnectorTable):
-    """A directory of shard files + a schema.json sidecar."""
+    """A directory of shard files + a schema.json manifest sidecar."""
 
     # zone maps in the PTSH stripes serve the engine's TupleDomain
     # pushdown (plan/domains.py -> read(domains=...))
@@ -36,16 +48,114 @@ class LocalFileTable(ConnectorTable):
             with open(meta_path) as f:
                 meta = json.load(f)
             schema = {c: T.parse_type(t) for c, t in meta["schema"].items()}
+            self._manifest = meta
+            if "shards" not in meta:
+                # legacy directory (no manifest): adopt the files present
+                self._manifest["shards"] = [
+                    p for p in sorted(os.listdir(directory))
+                    if p.endswith(".ptsh")]
         else:
-            with open(meta_path, "w") as f:
-                json.dump({"schema": {c: str(t) for c, t in schema.items()}}, f)
+            self._manifest = {
+                "schema": {c: str(t) for c, t in schema.items()},
+                "shards": [], "retired": [], "file_meta": {},
+                "write_props": None, "layout_ordered": False,
+                "generation": 0}
+            self._write_manifest()
         super().__init__(name, schema)
+
+    # ---- manifest (the snapshot layer) -------------------------------
+    def _write_manifest(self) -> None:
+        meta_path = os.path.join(self.dir, "schema.json")
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._manifest, f)
+        os.replace(tmp, meta_path)  # atomic publish
+
+    def snapshot_state(self) -> dict:
+        """Transactional snapshot: the manifest IS the table state
+        (files are immutable once published)."""
+        return json.loads(json.dumps(self._manifest))
+
+    def restore_state(self, state: dict) -> None:
+        self._manifest = state
+        self.schema = {c: T.parse_type(t)
+                       for c, t in state.get("schema", {}).items()} \
+            or self.schema  # a replace may have changed the schema
+        self._write_manifest()
+        self._invalidate()
+
+    def write_properties(self) -> Optional[dict]:
+        return self._manifest.get("write_props")
+
+    def record_write_properties(self, props: Optional[dict],
+                                ordered: bool = False) -> None:
+        """Declare a layout on an (empty) table — CREATE TABLE ... WITH
+        (sorted_by=...); later INSERTs apply and re-verify it."""
+        self._manifest["write_props"] = props
+        self._manifest["layout_ordered"] = bool(ordered)
+        self._write_manifest()
+
+    def ordering(self) -> List[Tuple[str, bool]]:
+        """The recorded sort order, claimed ONLY when the committed file
+        sequence verified as globally nondecreasing (layout_ordered) —
+        consumed by ordering-aware execution behind the same runtime
+        monotonicity guards as generator declarations."""
+        wp = self._manifest.get("write_props")
+        if not wp or not self._manifest.get("layout_ordered"):
+            return []
+        return [(c, bool(a)) for c, a in wp.get("sorted_by", [])]
+
+    def _commit_write(self, new_files: List[str], file_meta: Dict[str, dict],
+                      write_props: Optional[dict], replace: bool,
+                      schema: Optional[Dict[str, T.Type]] = None,
+                      gc: bool = False) -> None:
+        """Atomic publish of a staged write: adopt the new files (after
+        the old ones unless replacing), optionally garbage-collect files
+        retired by PREVIOUS generations (kept at least one generation
+        for in-flight readers; `gc` stays False while a transaction
+        could still roll the manifest back), verify the ordering claim
+        over the resulting file sequence, and rewrite the manifest in
+        one os.replace."""
+        m = self._manifest
+        old_shards = [] if replace else list(m.get("shards", []))
+        shards = old_shards + new_files
+        meta = dict(m.get("file_meta", {}))
+        if replace:
+            meta = {}
+        meta.update(file_meta)
+        # one-generation GC of previously retired files
+        prev_retired = list(m.get("retired", []))
+        retired = list(m.get("shards", [])) if replace else []
+        if not gc:
+            retired = prev_retired + retired
+        else:
+            for p in prev_retired:
+                try:
+                    os.remove(os.path.join(self.dir, p))
+                except OSError:
+                    pass
+        wp = write_props if write_props is not None \
+            else (None if replace else m.get("write_props"))
+        sorted_by = (wp or {}).get("sorted_by") or []
+        ordered = bool(sorted_by) and all(a for _c, a in sorted_by) \
+            and files_ordered([(meta.get(s) or {}).get("ranges")
+                               for s in shards])
+        if schema is not None:
+            self.schema = dict(schema)
+            m["schema"] = {c: str(t) for c, t in schema.items()}
+        m["shards"] = shards
+        m["retired"] = retired
+        m["file_meta"] = {s: meta[s] for s in shards if s in meta}
+        m["write_props"] = wp
+        m["layout_ordered"] = bool(ordered)
+        m["generation"] = int(m.get("generation", 0)) + 1
+        self._write_manifest()
+        self._invalidate()
 
     # ---- read path ---------------------------------------------------
     def _shards(self) -> List[str]:
-        return sorted(
-            os.path.join(self.dir, p) for p in os.listdir(self.dir)
-            if p.endswith(".ptsh"))
+        return [os.path.join(self.dir, p)
+                for p in self._manifest.get("shards", [])]
 
     def _readers(self) -> List[ShardReader]:
         paths = tuple(self._shards())
@@ -124,39 +234,58 @@ class LocalFileTable(ConnectorTable):
     SCALE_UP_BACKLOG = 2
     MAX_WRITERS = 4
 
+    sink_file_prefix = "shard"
+    sink_file_ext = ".ptsh"
+
+    def _sink_write_file(self, path: str, arrays, schema) -> None:
+        write_shard(path, arrays, schema)
+
+    def page_sink(self, write_props=None, replace: bool = False,
+                  schema: Optional[Dict[str, T.Type]] = None,
+                  defer_gc: bool = False) -> PageSink:
+        return StagedFileSink(self, write_props, replace=replace,
+                              schema=schema, defer_gc=bool(defer_gc))
+
     def append(self, arrays: Dict[str, np.ndarray]) -> int:
+        """Bulk append (legacy SPI, kept for the scaled-writer path):
+        pages fan out over writer threads into ONE staged sink, then
+        commit atomically.  Engine statements route through
+        exec/writer.py instead; this surface serves direct API users and
+        the P4 scaled-writer redistribution."""
         n = len(next(iter(arrays.values()))) if arrays else 0
         if n == 0:
             return 0
+        sink = self.page_sink()
         pages = -(-n // self.WRITER_PAGE_ROWS)
-        if pages <= 1:
-            idx = len(self._shards())
-            path = os.path.join(self.dir, f"shard_{idx:06d}.ptsh")
-            write_shard(path, {c: arrays[c] for c in self.schema},
-                        self.schema)
-            self.last_writers_used = 1
-            self._invalidate()
-            return n
-        self._scaled_append(arrays, n, pages)
-        self._invalidate()
+        try:
+            if pages <= 1:
+                sink.append_page({c: arrays[c] for c in self.schema})
+                self.last_writers_used = 1
+            else:
+                self._scaled_append(sink, arrays, n, pages)
+            sink.finish()
+        except BaseException:
+            sink.abort()
+            raise
         return n
 
-    def _scaled_append(self, arrays, n: int, pages: int) -> None:
+    def _scaled_append(self, sink: "LocalFilePageSink", arrays,
+                       n: int, pages: int) -> None:
         """P4 scaled-writer redistribution, local adaptation (reference:
         execution/scheduler/ScaledWriterScheduler.java — writer tasks
         start at one and scale up while the produced-page backlog
         outpaces the active writers).  Here the writers are shard-writer
-        threads; each page becomes one shard file, so the readers'
-        split/stripe machinery parallelizes the read back."""
+        threads; each page becomes one staged shard file whose explicit
+        seq preserves row order, so the readers' split/stripe machinery
+        parallelizes the read back."""
         import queue
         import threading
 
         q: "queue.Queue" = queue.Queue()
-        base = len(self._shards())
         for p in range(pages):
             lo = p * self.WRITER_PAGE_ROWS
             hi = min(n, lo + self.WRITER_PAGE_ROWS)
-            q.put((base + p, lo, hi))
+            q.put((p, lo, hi))
         errors: List[BaseException] = []
 
         def writer():
@@ -166,10 +295,8 @@ class LocalFileTable(ConnectorTable):
                 except queue.Empty:
                     return
                 try:
-                    path = os.path.join(self.dir,
-                                        f"shard_{idx:06d}.ptsh")
-                    write_shard(path, {c: arrays[c][lo:hi]
-                                       for c in self.schema}, self.schema)
+                    sink.append_page({c: arrays[c][lo:hi]
+                                      for c in self.schema}, seq=idx)
                 except BaseException as e:  # surfaced to the caller
                     errors.append(e)
                 finally:
@@ -198,24 +325,34 @@ class LocalFileTable(ConnectorTable):
         compaction-style delete; row-level deletes rewrite the shard)."""
         data = self.read()
         deleted = int((~keep_mask).sum())
-        for p in self._shards():
-            os.remove(p)
         kept = {c: v[keep_mask] for c, v in data.items()}
+        new_files: List[str] = []
         if len(next(iter(kept.values()), [])) > 0:
-            write_shard(os.path.join(self.dir, "shard_000000.ptsh"),
-                        kept, self.schema)
-        self._invalidate()
+            gen = int(self._manifest.get("generation", 0)) + 1
+            fname = f"shard_g{gen:04d}_000000.ptsh"
+            write_shard(os.path.join(self.dir, fname), kept, self.schema)
+            new_files = [fname]
+        # the rewrite RETIRES the old shards (GC'd by a later commit /
+        # drop) so a transactional rollback can restore the pre-delete
+        # manifest; the layout's ordering claim dies with the rewrite
+        self._commit_write(new_files, {}, None, replace=True)
         return deleted
 
     def drop_data(self) -> None:
         """Remove managed storage on DROP TABLE (the table owns its
         directory; leaving shards behind would resurrect old data on a
-        same-name re-create)."""
-        for p in self._shards():
-            os.remove(p)
-        meta = os.path.join(self.dir, "schema.json")
-        if os.path.exists(meta):
-            os.remove(meta)
+        same-name re-create).  Removes live, retired, AND staged files."""
+        for p in os.listdir(self.dir):
+            if p.endswith(".ptsh") or p.endswith(".stg") \
+                    or p == "schema.json":
+                try:
+                    os.remove(os.path.join(self.dir, p))
+                except OSError:
+                    pass
+        self._manifest = {"schema": self._manifest.get("schema", {}),
+                          "shards": [], "retired": [], "file_meta": {},
+                          "write_props": None, "layout_ordered": False,
+                          "generation": 0}
         self._invalidate()
 
     def _invalidate(self):

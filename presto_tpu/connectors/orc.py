@@ -7,39 +7,146 @@ ORC library.  Splits map to stripes, the reference's parallelism grain.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from presto_tpu.catalog import ConnectorTable
+from presto_tpu.connectors import StagedFileSink, files_ordered
 from presto_tpu.storage.orc import OrcFile
 
 _STR_NROWS = 5
+MANIFEST_NAME = "_manifest.json"
 
 
 class OrcTable(ConnectorTable):
-    """A .orc file, or a directory of them with one schema."""
+    """A .orc file, or a directory of them with one schema.
+
+    Engine-written directories carry a `_manifest.json` sidecar (the
+    same snapshot/commit layer as the parquet and localfile
+    connectors): authoritative file list + recorded write layout +
+    verified ordering claim; externally-registered paths keep the
+    legacy directory glob."""
 
     supports_null_append = True  # null channel in the format
+    sink_file_prefix = "part"
+    sink_file_ext = ".orc"
 
     def __init__(self, name: str, path: str, schema=None):
         self.path = path
-        files = self._files()
+        self._manifest: Optional[dict] = None
         if schema is None:
+            mp = os.path.join(path, MANIFEST_NAME) \
+                if os.path.isdir(path) else None
+            if mp and os.path.exists(mp):
+                with open(mp) as f:
+                    self._manifest = json.load(f)
+            files = self._files()
             if not files:
                 raise FileNotFoundError(f"no orc files under {path}")
             f0 = OrcFile(files[0])
             schema = {c.name: c.sql_type() for c in f0.columns}
         else:
-            if files:  # see ParquetTable: no silent stale-part absorb
+            if self._legacy_files():  # no silent stale-part absorb
                 raise ValueError(
                     f"target directory {path} already contains orc "
                     "files; register it read-only or choose a new path")
             os.makedirs(path, exist_ok=True)
+            self._manifest = {"files": [], "retired": [], "file_meta": {},
+                              "write_props": None, "layout_ordered": False,
+                              "generation": 0}
+            self._write_manifest()
         super().__init__(name, schema)
 
-    def _files(self) -> List[str]:
+    # -- manifest (snapshot layer; see connectors/localfile.py) --------
+    def _write_manifest(self) -> None:
+        mp = os.path.join(self.path, MANIFEST_NAME)
+        tmp = mp + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._manifest, f)
+        os.replace(tmp, mp)  # atomic publish
+
+    def snapshot_state(self) -> Optional[dict]:
+        if self._manifest is None:
+            return None
+        state = json.loads(json.dumps(self._manifest))
+        state["__schema"] = {c: str(t) for c, t in self.schema.items()}
+        return state
+
+    def restore_state(self, state: dict) -> None:
+        from presto_tpu import types as T
+
+        state = dict(state)
+        schema = state.pop("__schema", None)
+        self._manifest = state
+        if schema:
+            self.schema = {c: T.parse_type(t) for c, t in schema.items()}
+        self._write_manifest()
+        self._invalidate()
+
+    def write_properties(self) -> Optional[dict]:
+        return None if self._manifest is None \
+            else self._manifest.get("write_props")
+
+    def record_write_properties(self, props: Optional[dict],
+                                ordered: bool = False) -> None:
+        self._adopt_manifest()
+        self._manifest["write_props"] = props
+        self._manifest["layout_ordered"] = bool(ordered)
+        self._write_manifest()
+
+    def ordering(self) -> List[Tuple[str, bool]]:
+        m = self._manifest
+        if m is None or not m.get("write_props") \
+                or not m.get("layout_ordered"):
+            return []
+        return [(c, bool(a))
+                for c, a in m["write_props"].get("sorted_by", [])]
+
+    def _adopt_manifest(self) -> None:
+        if self._manifest is None:
+            self._manifest = {
+                "files": [os.path.basename(p)
+                          for p in self._legacy_files()],
+                "retired": [], "file_meta": {}, "write_props": None,
+                "layout_ordered": False, "generation": 0}
+
+    def _commit_write(self, new_files, file_meta, write_props, replace,
+                      schema=None, gc: bool = True) -> None:
+        m = self._manifest
+        shards = ([] if replace else list(m.get("files", []))) + new_files
+        meta = {} if replace else dict(m.get("file_meta", {}))
+        meta.update(file_meta)
+        prev_retired = list(m.get("retired", []))
+        retired = list(m.get("files", [])) if replace else []
+        if not gc:
+            retired = prev_retired + retired
+        else:
+            for p in prev_retired:
+                try:
+                    os.remove(os.path.join(self.path, p))
+                except OSError:
+                    pass
+        wp = write_props if write_props is not None \
+            else (None if replace else m.get("write_props"))
+        sorted_by = (wp or {}).get("sorted_by") or []
+        ordered = bool(sorted_by) and all(a for _c, a in sorted_by) \
+            and files_ordered([(meta.get(s) or {}).get("ranges")
+                               for s in shards])
+        if schema is not None:
+            self.schema = dict(schema)
+        m["files"] = shards
+        m["retired"] = retired
+        m["file_meta"] = {s: meta[s] for s in shards if s in meta}
+        m["write_props"] = wp
+        m["layout_ordered"] = bool(ordered)
+        m["generation"] = int(m.get("generation", 0)) + 1
+        self._write_manifest()
+        self._invalidate()
+
+    def _legacy_files(self) -> List[str]:
         if os.path.isfile(self.path):
             return [self.path]
         if not os.path.isdir(self.path):
@@ -48,6 +155,12 @@ class OrcTable(ConnectorTable):
             os.path.join(self.path, p) for p in os.listdir(self.path)
             if p.endswith(".orc"))
 
+    def _files(self) -> List[str]:
+        if self._manifest is not None:
+            return [os.path.join(self.path, p)
+                    for p in self._manifest.get("files", [])]
+        return self._legacy_files()
+
     def _readers(self) -> List[OrcFile]:
         paths = tuple(self._files())
         cached = getattr(self, "_orc_cache", None)
@@ -55,31 +168,55 @@ class OrcTable(ConnectorTable):
             self._orc_cache = (paths, [OrcFile(p) for p in paths])
         return self._orc_cache[1]
 
+    def _invalidate(self):
+        self._orc_cache = None
+        super()._invalidate()
+
     # -- write path (reference: presto-orc OrcWriter behind the hive
     # sink) --------------------------------------------------------
-    def append(self, arrays) -> int:
+    def _sink_write_file(self, path: str, arrays, schema) -> None:
         from presto_tpu.storage.orc import write_orc
 
-        n = len(next(iter(arrays.values()))) if arrays else 0
-        if n == 0:
-            return 0
+        write_orc(path, arrays, schema,
+                  stripe_rows=getattr(self, "stripe_rows", 0))
+
+    def page_sink(self, write_props=None, replace: bool = False,
+                  schema=None, defer_gc: bool = False) -> StagedFileSink:
         if os.path.isfile(self.path):
             raise ValueError(
                 "single-file orc table is read-only; register a "
                 "directory to INSERT")
         os.makedirs(self.path, exist_ok=True)
-        idx = len(self._files())
-        write_orc(os.path.join(self.path, f"part_{idx:06d}.orc"),
-                  {c: arrays[c] for c in self.schema}, self.schema,
-                  stripe_rows=getattr(self, "stripe_rows", 0))
-        self._orc_cache = None
-        self._invalidate()
+        self._adopt_manifest()
+        return StagedFileSink(self, write_props, replace=replace,
+                              schema=schema, defer_gc=bool(defer_gc))
+
+    def append(self, arrays) -> int:
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        if n == 0:
+            return 0
+        sink = self.page_sink()
+        try:
+            sink.append_page(dict(arrays))
+            sink.finish()
+        except BaseException:
+            sink.abort()
+            raise
         return n
 
     def drop_data(self) -> None:
         if os.path.isdir(self.path):
-            for p in self._files():
-                os.remove(p)
+            for p in os.listdir(self.path):
+                if p.endswith(".orc") or p.endswith(".stg") \
+                        or p == MANIFEST_NAME:
+                    try:
+                        os.remove(os.path.join(self.path, p))
+                    except OSError:
+                        pass
+            self._manifest = {"files": [], "retired": [], "file_meta": {},
+                              "write_props": None,
+                              "layout_ordered": False, "generation": 0}
+            self._invalidate()
 
     def row_count(self) -> int:
         return sum(f.num_rows for f in self._readers())

@@ -10,6 +10,7 @@ reference's Parquet stripes.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -17,13 +18,25 @@ import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.catalog import ConnectorTable
+from presto_tpu.connectors import StagedFileSink, files_ordered
 from presto_tpu.storage.parquet import ParquetFile, write_parquet
+
+MANIFEST_NAME = "_manifest.json"
 
 
 class ParquetTable(ConnectorTable):
-    """A .parquet file, or a directory of them with one schema."""
+    """A .parquet file, or a directory of them with one schema.
+
+    Engine-written directories carry a `_manifest.json` sidecar (the
+    snapshot/commit layer — same design as the localfile schema.json
+    manifest): the authoritative file list, the recorded write layout
+    (bucketed_by/sorted_by/partitioned_by), and the verified ordering
+    claim.  Externally-registered paths (no sidecar) keep the legacy
+    directory-glob behavior and the `ordering=` declaration param."""
 
     supports_null_append = True  # null channel in the format
+    sink_file_prefix = "part"
+    sink_file_ext = ".parquet"
 
     def __init__(self, name: str, path: str,
                  schema: Optional[Dict[str, T.Type]] = None,
@@ -35,7 +48,13 @@ class ParquetTable(ConnectorTable):
         # monotonicity guards, so a false declaration costs the elided
         # sort back, never correctness
         self._ordering = [(c, bool(a)) for c, a in (ordering or [])]
+        self._manifest: Optional[dict] = None
         if schema is None:
+            mp = os.path.join(path, MANIFEST_NAME) \
+                if os.path.isdir(path) else None
+            if mp and os.path.exists(mp):
+                with open(mp) as f:
+                    self._manifest = json.load(f)
             files = self._files()
             if not files:
                 raise FileNotFoundError(f"no parquet files under {path}")
@@ -44,18 +63,108 @@ class ParquetTable(ConnectorTable):
         else:
             # a FRESH table (CTAS) must not silently absorb another
             # table-lifetime's part files sitting in the directory
-            if self._files():
+            if self._legacy_files():
                 raise ValueError(
                     f"target directory {path} already contains parquet "
                     "files; register it read-only or choose a new path")
             os.makedirs(path, exist_ok=True)
+            self._manifest = {"files": [], "retired": [], "file_meta": {},
+                              "write_props": None, "layout_ordered": False,
+                              "generation": 0}
+            self._write_manifest()
         super().__init__(name, schema)
 
+    # -- manifest (snapshot layer; see connectors/localfile.py) --------
+    def _write_manifest(self) -> None:
+        mp = os.path.join(self.path, MANIFEST_NAME)
+        tmp = mp + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._manifest, f)
+        os.replace(tmp, mp)  # atomic publish
+
+    def snapshot_state(self) -> Optional[dict]:
+        if self._manifest is None:
+            return None
+        state = json.loads(json.dumps(self._manifest))
+        # schema rides the snapshot: a replace may change it, and the
+        # manifest itself doesn't persist it (the files carry it)
+        state["__schema"] = {c: str(t) for c, t in self.schema.items()}
+        return state
+
+    def restore_state(self, state: dict) -> None:
+        state = dict(state)
+        schema = state.pop("__schema", None)
+        self._manifest = state
+        if schema:
+            self.schema = {c: T.parse_type(t) for c, t in schema.items()}
+        self._write_manifest()
+        self._invalidate()
+
+    def write_properties(self) -> Optional[dict]:
+        return None if self._manifest is None \
+            else self._manifest.get("write_props")
+
+    def record_write_properties(self, props: Optional[dict],
+                                ordered: bool = False) -> None:
+        self._adopt_manifest()
+        self._manifest["write_props"] = props
+        self._manifest["layout_ordered"] = bool(ordered)
+        self._write_manifest()
+
     def ordering(self):
+        m = self._manifest
+        if m is not None and m.get("write_props"):
+            if not m.get("layout_ordered"):
+                return []
+            return [(c, bool(a))
+                    for c, a in m["write_props"].get("sorted_by", [])]
         return list(self._ordering)
 
+    def _adopt_manifest(self) -> None:
+        """First engine write to a legacy-registered directory adopts
+        the files present into a fresh manifest generation."""
+        if self._manifest is None:
+            self._manifest = {
+                "files": [os.path.basename(p)
+                          for p in self._legacy_files()],
+                "retired": [], "file_meta": {}, "write_props": None,
+                "layout_ordered": False, "generation": 0}
+
+    def _commit_write(self, new_files, file_meta, write_props, replace,
+                      schema=None, gc: bool = True) -> None:
+        m = self._manifest
+        shards = ([] if replace else list(m.get("files", []))) + new_files
+        meta = {} if replace else dict(m.get("file_meta", {}))
+        meta.update(file_meta)
+        prev_retired = list(m.get("retired", []))
+        retired = list(m.get("files", [])) if replace else []
+        if not gc:
+            retired = prev_retired + retired
+        else:
+            for p in prev_retired:
+                try:
+                    os.remove(os.path.join(self.path, p))
+                except OSError:
+                    pass
+        wp = write_props if write_props is not None \
+            else (None if replace else m.get("write_props"))
+        sorted_by = (wp or {}).get("sorted_by") or []
+        ordered = bool(sorted_by) and all(a for _c, a in sorted_by) \
+            and files_ordered([(meta.get(s) or {}).get("ranges")
+                               for s in shards])
+        if schema is not None:
+            self.schema = dict(schema)
+        m["files"] = shards
+        m["retired"] = retired
+        m["file_meta"] = {s: meta[s] for s in shards if s in meta}
+        m["write_props"] = wp
+        m["layout_ordered"] = bool(ordered)
+        m["generation"] = int(m.get("generation", 0)) + 1
+        self._write_manifest()
+        self._invalidate()
+
     # -- layout --------------------------------------------------------
-    def _files(self) -> List[str]:
+    def _legacy_files(self) -> List[str]:
         if os.path.isfile(self.path):
             return [self.path]
         if not os.path.isdir(self.path):
@@ -63,6 +172,12 @@ class ParquetTable(ConnectorTable):
         return sorted(
             os.path.join(self.path, p) for p in os.listdir(self.path)
             if p.endswith(".parquet"))
+
+    def _files(self) -> List[str]:
+        if self._manifest is not None:
+            return [os.path.join(self.path, p)
+                    for p in self._manifest.get("files", [])]
+        return self._legacy_files()
 
     def _readers(self) -> List[ParquetFile]:
         paths = tuple(self._files())
@@ -166,24 +281,45 @@ class ParquetTable(ConnectorTable):
         return True
 
     # -- write path (reference: the hive connector's parquet sink) ----
-    def append(self, arrays: Dict[str, np.ndarray]) -> int:
-        n = len(next(iter(arrays.values()))) if arrays else 0
-        if n == 0:
-            return 0
+    def _sink_write_file(self, path: str, arrays, schema) -> None:
+        write_parquet(path, arrays, schema,
+                      row_group_rows=getattr(self, "row_group_rows", 0))
+
+    def page_sink(self, write_props=None, replace: bool = False,
+                  schema: Optional[Dict[str, T.Type]] = None,
+                  defer_gc: bool = False) -> StagedFileSink:
         if os.path.isfile(self.path):
             raise ValueError(
                 "single-file parquet table is read-only; register a "
                 "directory to INSERT")
         os.makedirs(self.path, exist_ok=True)
-        idx = len(self._files())
-        out = os.path.join(self.path, f"part_{idx:06d}.parquet")
-        write_parquet(out, {c: arrays[c] for c in self.schema},
-                      self.schema,
-                      row_group_rows=getattr(self, "row_group_rows", 0))
-        self._invalidate()
+        self._adopt_manifest()
+        return StagedFileSink(self, write_props, replace=replace,
+                              schema=schema, defer_gc=bool(defer_gc))
+
+    def append(self, arrays: Dict[str, np.ndarray]) -> int:
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        if n == 0:
+            return 0
+        sink = self.page_sink()
+        try:
+            sink.append_page(dict(arrays))
+            sink.finish()
+        except BaseException:
+            sink.abort()
+            raise
         return n
 
     def drop_data(self) -> None:
         if os.path.isdir(self.path):
-            for p in self._files():
-                os.remove(p)
+            for p in os.listdir(self.path):
+                if p.endswith(".parquet") or p.endswith(".stg") \
+                        or p == MANIFEST_NAME:
+                    try:
+                        os.remove(os.path.join(self.path, p))
+                    except OSError:
+                        pass
+            self._manifest = {"files": [], "retired": [], "file_meta": {},
+                              "write_props": None,
+                              "layout_ordered": False, "generation": 0}
+            self._invalidate()

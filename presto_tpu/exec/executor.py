@@ -136,6 +136,11 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
     if isinstance(stmt, ast.ShowColumns):
         t = session.catalog.get(stmt.table)
         rows = [(c, str(ty)) for c, ty in t.schema.items()]
+        # recorded physical-layout properties surface as trailing
+        # marker rows (tables without a recorded layout are unchanged)
+        from presto_tpu.exec.writer import describe_extra_rows
+
+        rows += describe_extra_rows(t)
         return QueryResult([("Column", T.VARCHAR), ("Type", T.VARCHAR)], rows)
     if isinstance(stmt, ast.ShowFunctions):
         from presto_tpu.functions import aggregate as _agg
@@ -218,16 +223,18 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
         return QueryResult(
             [("Column Name", T.VARCHAR), ("Type", T.VARCHAR)], rows)
     if isinstance(stmt, ast.CreateTableAs):
-        session.access_control.check_can_create_table(session.user, stmt.name)
-        if stmt.name in session.catalog:
-            if stmt.if_not_exists:
-                return QueryResult([("rows", T.BIGINT)], [(0,)])
-            raise ExecutionError(f"Table '{stmt.name}' already exists")
-        arrays, types = execute_plan_to_host(session, ast.QueryStatement(stmt.query))
-        session.txn.record_create(stmt.name)
-        _create_table(session, stmt.name, types, stmt.properties, arrays)
-        n = len(next(iter(arrays.values()))) if arrays else 0
-        return QueryResult([("rows", T.BIGINT)], [(n,)])
+        # PageSink write pipeline (exec/writer.py): TableWriter /
+        # TableFinish plan, staged sinks, bucketed/sorted/partitioned
+        # layout, atomic commit
+        from presto_tpu.exec import writer as W
+
+        return W.run_write(session, text, stmt, mon)
+    if isinstance(stmt, ast.ShowCreateTable):
+        from presto_tpu.exec import writer as W
+
+        t = session.catalog.get(stmt.table)
+        return QueryResult([("Create Table", T.VARCHAR)],
+                           [(W.render_create_table(t),)])
     if isinstance(stmt, ast.CreateTable):
         session.access_control.check_can_create_table(session.user, stmt.name)
         if stmt.name in session.catalog:
@@ -248,8 +255,9 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
         session.catalog.drop(stmt.name, stmt.if_exists)
         return QueryResult([("result", T.BOOLEAN)], [(True,)])
     if isinstance(stmt, ast.InsertInto):
-        n = _insert_into(session, stmt)
-        return QueryResult([("rows", T.BIGINT)], [(n,)])
+        from presto_tpu.exec import writer as W
+
+        return W.run_write(session, text, stmt, mon)
     if isinstance(stmt, ast.Delete):
         n = _delete_from(session, stmt)
         return QueryResult([("rows", T.BIGINT)], [(n,)])
@@ -357,138 +365,33 @@ def _substitute_parameters(sql: str, params) -> str:
 
 
 def _create_table(session, name, schema, properties, arrays):
-    """Create + register a table on the connector chosen by WITH
+    """Create + register an EMPTY table on the connector chosen by WITH
     properties (reference: StaticCatalogStore catalogs + per-connector
-    getPageSinkProvider; default is the memory connector)."""
-    connector = str(properties.get("connector", "memory")).lower()
-    from presto_tpu.connectors.hive import create_hive_table, is_hive_name
+    metadata.createTable; default is the memory connector).  CTAS and
+    INSERT route through exec/writer.py instead — `arrays` is kept for
+    API compatibility and must be None.  Declared layout properties
+    (sorted_by/bucketed_by/partitioned_by) record onto the empty table
+    so later INSERTs apply and verify them."""
+    assert arrays is None, "CTAS routes through exec/writer.run_write"
+    from presto_tpu.exec import writer as W
 
-    if connector == "hive" or is_hive_name(session.catalog, name):
-        # a name under an attached hive catalog's prefix routes to the
-        # hive connector (reference: the catalog name selects the
-        # connector in MetadataManager.createTable)
-        t = create_hive_table(session.catalog, name, schema, properties)
-        if arrays is not None:
-            if not t.supports_null_append:
-                # same guard as INSERT: the csv sink's "" NULL encoding
-                # would silently conflate NULL with empty VARCHAR
-                for c, a in arrays.items():
-                    if isinstance(a, np.ma.MaskedArray) \
-                            and a.mask is not np.ma.nomask and np.any(a.mask):
-                        raise ExecutionError(
-                            f"CTAS with NULL values in column '{c}' is "
-                            "not supported by this storage format")
-            t.append({c: arrays[c] for c in t.schema})
+    connector = W.target_connector(properties, session, name)
+    if connector == "hive":
+        from presto_tpu.connectors.hive import create_hive_table
+
+        create_hive_table(session.catalog, name, schema, properties)
         return
-    if arrays is not None and connector not in ("parquet", "orc"):
-        # parquet/orc sinks carry nulls natively (definition levels /
-        # PRESENT streams); the memory/shard sinks store raw arrays
-        clean = {}
-        for c, a in arrays.items():
-            if isinstance(a, np.ma.MaskedArray):
-                if a.mask is not np.ma.nomask and np.any(a.mask):
-                    raise ExecutionError(
-                        f"CTAS with NULL values in column '{c}' is not "
-                        "supported by this connector")
-                a = a.data
-            clean[c] = np.asarray(a)
-        arrays = clean
-    if connector == "memory":
-        session.catalog.register_memory(name, schema,
-                                        arrays if arrays is not None else
-                                        {c: np.empty(0, t.numpy_dtype()
-                                                     if not t.is_string else object)
-                                         for c, t in schema.items()})
-        return
-    if connector == "blackhole":
-        from presto_tpu.connectors.localfile import BlackholeTable
-
-        t = BlackholeTable(name, schema)
-        session.catalog.register(t)
-        if arrays is not None:
-            t.append(arrays)
-        return
-    if connector in ("localfile", "parquet", "orc"):
-        import tempfile
-
-        if connector == "localfile":
-            from presto_tpu.connectors.localfile import \
-                LocalFileTable as cls
-        elif connector == "parquet":
-            from presto_tpu.connectors.parquet import ParquetTable as cls
-        else:
-            from presto_tpu.connectors.orc import OrcTable as cls
-        directory = properties.get("path") or properties.get(
-            "directory") or os.path.join(
-            session.properties.get("localfile_root",
-                                   os.path.join(tempfile.gettempdir(),
-                                                "presto_tpu_tables")),
-            name)
-        t = cls(name, directory, schema)
-        session.catalog.register(t)
-        if arrays is not None:
-            t.append(arrays)
-        return
-    raise ExecutionError(f"unknown connector '{connector}'")
-
-
-def _insert_into(session, stmt: ast.InsertInto) -> int:
-    """INSERT INTO t [(cols)] query — reference: TableWriterOperator +
-    TableFinishOperator; here the query materializes to host columns that
-    are coerced to the target schema and appended via the connector sink."""
-    session.access_control.check_can_insert(session.user, stmt.table)
-    table = session.catalog.get(stmt.table)
-    if not hasattr(table, "append"):
-        raise ExecutionError(f"table '{stmt.table}' does not support INSERT")
-    session.txn.record_table_write(table)
-    arrays, types = execute_plan_to_host(session, ast.QueryStatement(stmt.query))
-    src_cols = list(arrays)
-    targets = stmt.columns if stmt.columns is not None else list(table.schema)
-    if len(src_cols) != len(targets):
-        raise ExecutionError(
-            f"INSERT column count mismatch: query produces {len(src_cols)}, "
-            f"target list has {len(targets)}")
-    unknown = [c for c in targets if c not in table.schema]
-    if unknown:
-        raise ExecutionError(f"unknown INSERT columns: {unknown}")
-    missing = [c for c in table.schema if c not in targets]
-    if missing:
-        raise ExecutionError(
-            f"INSERT must cover all columns (missing {missing}); "
-            "partial inserts with null fill are not supported yet")
-    out = {}
-    for tgt, src in zip(targets, src_cols):
-        want = table.schema[tgt]
-        a = arrays[src]
-        if isinstance(a, np.ma.MaskedArray):
-            if getattr(table, "supports_null_append", False):
-                pass  # the sink writes a null channel (parquet/orc)
-            elif a.mask is not np.ma.nomask and np.any(a.mask):
-                # the memory/shard sinks store raw arrays (no validity
-                # mask); silently writing fill values would corrupt NULLs
-                raise ExecutionError(
-                    f"INSERT of NULL values into column '{tgt}' is not "
-                    "supported by this connector")
-            else:
-                a = a.data
-        if not isinstance(a, np.ma.MaskedArray):
-            a = np.asarray(a)
-        have = types.get(src, want)
-        if have != want and not T.can_coerce(have, want) \
-                and not (have.is_numeric and want.is_numeric):
-            raise ExecutionError(
-                f"cannot insert {have} into {tgt} ({want})")
-        if want.is_decimal and a.dtype.kind == "f":
-            # decoded decimals arrive as unscaled floats; rescale like
-            # batch.column_from_numpy, never truncate (and never wrap)
-            scaled = a * (10 ** want.decimal_scale)
-            T.check_decimal_overflow(scaled, what="inserted value")
-            a = np.round(scaled).astype(np.int64)
-        elif not want.is_string and a.dtype != want.numpy_dtype() \
-                and a.dtype != object:
-            a = a.astype(want.numpy_dtype())
-        out[tgt] = a
-    return table.append(out)
+    try:
+        t, _ = W.build_target_table(session, name, schema, properties)
+    except W.WriteError as e:
+        raise ExecutionError(str(e)) from e
+    try:
+        wp = W.WriteProperties.parse(properties, schema, connector)
+    except W.WriteError as e:
+        raise ExecutionError(str(e)) from e
+    if wp is not None and hasattr(t, "record_write_properties"):
+        t.record_write_properties(wp.to_dict(), ordered=False)
+    session.catalog.register(t)
 
 
 def _delete_from(session, stmt: ast.Delete) -> int:
@@ -784,6 +687,12 @@ def plan_statement(session, stmt) -> P.QueryPlan:
     """Plan + authorize: every table the plan scans is checked against
     the session's access control (reference: AccessControlManager
     .checkCanSelectFromColumns during analysis)."""
+    if isinstance(stmt, (ast.CreateTableAs, ast.InsertInto)):
+        # write statements plan as Output <- TableFinish <- TableWriter
+        # over the (normally optimized) query plan (exec/writer.py)
+        from presto_tpu.exec import writer as W
+
+        return W.plan_write_statement(session, stmt)
     planner = Planner(session)
     plan = planner.plan_statement(stmt)
     if session.properties.get("optimizer_enabled", True):
@@ -3773,6 +3682,39 @@ class Executor:
     def _exec_output(self, node: P.Output) -> Batch:
         b = self.exec_node(node.source)
         return b.select([s for s in node.symbols])
+
+    # ---- write pipeline (exec/writer.py; reference:
+    # TableWriterOperator + TableFinishOperator) -----------------------
+    def _exec_tablewriter(self, node) -> Batch:
+        ctx = getattr(self, "write_ctx", None)
+        if ctx is None:
+            raise ExecutionError(
+                "TableWriter requires a write context — write statements "
+                "execute through exec/writer.run_write")
+        from presto_tpu.exec import writer as W
+
+        inner = node.source  # the query's Output node
+        b = self.exec_node(inner)
+        arrays, types = W._host_arrays(inner, b)
+        try:
+            n = ctx.write_page(arrays, types)
+        except W.WriteError as e:
+            raise ExecutionError(str(e)) from e
+        return batch_from_numpy({node.rows_symbol:
+                                 np.asarray([n], dtype=np.int64)},
+                                {node.rows_symbol: T.BIGINT})
+
+    def _exec_tablefinish(self, node) -> Batch:
+        b = self.exec_node(node.source)
+        ctx = getattr(self, "write_ctx", None)
+        if ctx is not None:
+            from presto_tpu.exec import writer as W
+
+            try:
+                ctx.finish()  # commit: staged files publish atomically
+            except W.WriteError as e:
+                raise ExecutionError(str(e)) from e
+        return b
 
 
 def _tuples_to_dict_column(tuples: np.ndarray, valid, typ) -> Column:

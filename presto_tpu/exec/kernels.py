@@ -1258,6 +1258,56 @@ def sort_values(x: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# write-path layout kernels (exec/writer.py): bucket assignment shares
+# the splitmix64 mixing with the runtime-filter family above, so a
+# bucket-aligned dynamic filter and an engine-written bucket layout
+# agree on which keys co-locate; the sort permutation rides the same
+# routed sort entry points the executor's accounting sees.
+# ---------------------------------------------------------------------------
+
+
+def write_bucket_ids(values, bucket_count: int) -> np.ndarray:
+    """Hash-bucket assignment for a write's bucket column(s): splitmix64
+    over each int64 key column, XOR-combined, modulo bucket_count
+    (reference: HiveBucketing.getHiveBucket feeding HivePageSink's
+    per-bucket writers).  Host numpy in, host numpy out — the writer
+    partitions host pages; the mix itself runs through the device kernel
+    so there is exactly ONE splitmix implementation, shared with the
+    runtime-filter membership family above."""
+    cols = values if isinstance(values, (list, tuple)) else [values]
+    h = None
+    for v in cols:
+        m = _rf_mix64(jnp.asarray(
+            np.ascontiguousarray(v, dtype=np.int64)))
+        h = m if h is None else h ^ m
+    b = (h % jnp.uint64(max(int(bucket_count), 1))).astype(jnp.int32)
+    return np.asarray(jax.device_get(b))
+
+
+def write_sort_perm(keys: List[np.ndarray],
+                    ascending: Optional[List[bool]] = None) -> np.ndarray:
+    """Lexicographic sort permutation for a write page: keys in priority
+    order (keys[0] primary), each already an orderable host int/float
+    array (string columns enter as sorted-dictionary codes, so code
+    order == value order).  Successive stable sorts from minor to major
+    key — the classic lexsort construction — with every device sort
+    routed through argsort_stable."""
+    n = len(keys[0]) if keys else 0
+    perm = np.arange(n, dtype=np.int64)
+    asc = ascending if ascending is not None else [True] * len(keys)
+    for key, up in reversed(list(zip(keys, asc))):
+        k = np.ascontiguousarray(np.asarray(key)[perm])
+        if not up:
+            if k.dtype.kind in ("i", "u"):
+                k = ~k  # exact order-reversing bijection on ints
+            else:
+                k = -k
+        o = np.asarray(jax.device_get(argsort_stable(jnp.asarray(k))))
+        perm = perm[o]
+    return perm
+
+
+# ---------------------------------------------------------------------------
 # Pallas TPU kernels (hot ops the XLA autovectorizer doesn't fuse:
 # the multi-aggregate segmented reduction).  CPU test meshes run the
 # same kernels under the Pallas interpreter.

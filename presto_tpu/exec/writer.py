@@ -1,0 +1,1083 @@
+"""TableWriter subsystem: CTAS / INSERT streamed through connector
+PageSinks (reference: TableWriterOperator + TableFinishOperator over
+ConnectorPageSink, PAPER.md §L4).
+
+What used to be `executor._insert_into` — materialize the WHOLE query
+to host numpy, then one bulk `table.append` — becomes a write pipeline:
+
+    begin_write -> append_page(s) -> finish
+
+- the plan grows TableWriter / TableFinish nodes (plan/nodes.py), so
+  EXPLAIN shows the write and the dynamic executor runs it like any
+  other operator;
+- chunked mode streams an over-threshold scan split-by-split, appending
+  each chunk to the sink (bounded host memory, no whole-result
+  materialization);
+- distributed mode fans splits over writer workers, each appending its
+  OWN pages (files), with the coordinator running the single
+  finish/commit step (the DrJAX sharded-materialization shape: no host
+  gather between produce and persist);
+- compiled mode executes the source query as one compiled program and
+  feeds its fetched columns to the sink.
+
+Write layout properties (`WITH (bucketed_by=..., bucket_count=...,
+sorted_by=..., partitioned_by=...)`) are applied here — bucket
+assignment through the splitmix mixing in exec/kernels.py
+(kernels.write_bucket_ids), within-bucket sorts through the routed sort
+entry points (kernels.write_sort_perm) — and then RECORDED into the
+catalog entry (ConnectorTable.ordering()/write_properties()), so
+ordering-aware execution, zone-map stripe pruning, and bucket-aligned
+dynamic filters fire on engine-written tables exactly as on
+generator-declared ones.  An ordering claim is only recorded when the
+written file sequence VERIFIES as globally nondecreasing on the sort
+keys (per-page sort + monotone page boundaries); hash-bucketed layouts
+keep their per-file sort (zone maps) without the table-level claim.
+
+Commit is transactional: file sinks stage invisible files and publish
+atomically (manifest rewrite); transaction.py snapshots the manifest
+(record_table_write / record_presnapshot) so ROLLBACK restores the
+pre-write snapshot, and a CREATE OR REPLACE cut-over leaves concurrent
+readers on the previous snapshot's files (docs/WRITES.md, the
+refresh-and-serve recipe).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import batch_from_numpy
+from presto_tpu.connectors import AppendPageSink, open_sink
+from presto_tpu.exec import kernels as K
+from presto_tpu.observe import trace as TR
+from presto_tpu.plan import nodes as P
+from presto_tpu.session import QueryResult
+from presto_tpu.sql import ast
+
+
+class WriteError(Exception):
+    pass
+
+
+#: default rows per streamed write chunk (session: write_page_rows)
+DEFAULT_WRITE_PAGE_ROWS = 1 << 20
+#: cap on auto-sized distributed writer workers (session:
+#: write_parallelism; 0 = auto: one thread per core up to this cap)
+MAX_WRITE_WORKERS = 8
+
+
+# ---------------------------------------------------------------------------
+# write properties
+# ---------------------------------------------------------------------------
+
+
+def _namelist(v) -> List[str]:
+    """Property value -> column name list: ARRAY['a','b'] parses to a
+    python list; 'a,b' (the hive partitioned_by convention already used
+    by connectors/hive.py) splits on commas."""
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [str(x).strip() for x in v if str(x).strip()]
+    return [s.strip() for s in str(v).split(",") if s.strip()]
+
+
+@dataclass
+class WriteProperties:
+    """Parsed physical-layout write properties (reference: the hive
+    connector's bucketed_by/bucket_count/sorted_by table properties,
+    HiveTableProperties.java)."""
+
+    bucketed_by: List[str] = field(default_factory=list)
+    bucket_count: int = 0
+    sorted_by: List[Tuple[str, bool]] = field(default_factory=list)
+    partitioned_by: List[str] = field(default_factory=list)
+    # range: buckets are contiguous slices of the globally sorted rows
+    #   (sorted_by leads with the bucket columns) — the layout that makes
+    #   the whole-table scan order a verifiable ordering claim, same
+    #   trick as the TPC chunk grids ("range-bucketing colocates
+    #   equi-joins exactly like hash-bucketing", exec/chunked.py);
+    # hash: splitmix64 bucket assignment (kernels.write_bucket_ids) —
+    #   the only kind streamed (multi-page) writes can keep consistent.
+    bucketing: str = "hash"
+
+    def empty(self) -> bool:
+        return not (self.bucketed_by or self.sorted_by
+                    or self.partitioned_by)
+
+    @classmethod
+    def parse(cls, props: dict, schema: Dict[str, T.Type],
+              connector: str) -> Optional["WriteProperties"]:
+        if not props:
+            return None
+        bby = _namelist(props.get("bucketed_by"))
+        sby_raw = _namelist(props.get("sorted_by"))
+        pby = _namelist(props.get("partitioned_by"))
+        if connector == "hive":
+            # hive's own partitioned_by semantics (partition columns move
+            # to the end of the schema) stay with the hive connector
+            pby = []
+        if not (bby or sby_raw or pby):
+            return None
+        sby: List[Tuple[str, bool]] = []
+        for item in sby_raw:
+            parts = item.split()
+            col = parts[0]
+            asc = True
+            if len(parts) > 1:
+                d = parts[1].lower()
+                if d not in ("asc", "desc"):
+                    raise WriteError(f"sorted_by entry '{item}': expected "
+                                     "'col [asc|desc]'")
+                asc = d == "asc"
+            sby.append((col, asc))
+
+        def canon(col: str) -> str:
+            for c in schema:
+                if c.lower() == col.lower():
+                    return c
+            raise WriteError(f"write property references unknown column "
+                             f"'{col}' (have {list(schema)})")
+
+        bby = [canon(c) for c in bby]
+        sby = [(canon(c), a) for c, a in sby]
+        pby = [canon(c) for c in pby]
+        count = int(props.get("bucket_count", 8)) if bby else 0
+        if bby and count <= 0:
+            raise WriteError("bucket_count must be positive")
+        # range bucketing iff the bucket columns are exactly the leading
+        # ASCENDING sorted_by prefix: the global sort then makes bucket
+        # slices contiguous AND the full-table scan order claimable
+        kind = "hash"
+        if bby and len(sby) >= len(bby) and all(
+                sby[i][0] == bby[i] and sby[i][1]
+                for i in range(len(bby))):
+            kind = "range"
+        if kind == "hash":
+            for c in bby:
+                t = schema[c]
+                if t.is_string or getattr(t, "is_decimal", False) \
+                        or t.numpy_dtype().kind not in ("i", "u"):
+                    raise WriteError(
+                        f"hash bucketing needs an integer column; "
+                        f"'{c}' is {t} (declare it as the leading "
+                        "sorted_by prefix for range bucketing)")
+        return cls(bucketed_by=bby, bucket_count=count, sorted_by=sby,
+                   partitioned_by=pby, bucketing=kind)
+
+    def to_dict(self) -> dict:
+        return {"bucketed_by": list(self.bucketed_by),
+                "bucket_count": self.bucket_count,
+                "sorted_by": [[c, bool(a)] for c, a in self.sorted_by],
+                "partitioned_by": list(self.partitioned_by),
+                "bucketing": self.bucketing}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["WriteProperties"]:
+        if not d:
+            return None
+        return cls(bucketed_by=list(d.get("bucketed_by", [])),
+                   bucket_count=int(d.get("bucket_count", 0)),
+                   sorted_by=[(c, bool(a))
+                              for c, a in d.get("sorted_by", [])],
+                   partitioned_by=list(d.get("partitioned_by", [])),
+                   bucketing=d.get("bucketing", "hash"))
+
+
+# ---------------------------------------------------------------------------
+# page layout: partition split -> bucket split -> within-bucket sort
+# ---------------------------------------------------------------------------
+
+
+def _orderable_host(a: np.ndarray) -> np.ndarray:
+    """Host sort key for one column: strings become sorted-dictionary
+    codes (order-exact within one page), masked rows get a nulls-last
+    flag handled by the caller."""
+    if isinstance(a, np.ma.MaskedArray):
+        a = a.filled("" if a.dtype.kind in ("U", "S", "O") else 0)
+    if a.dtype.kind in ("U", "S", "O"):
+        _, codes = np.unique(a.astype(str), return_inverse=True)
+        return codes.astype(np.int64)
+    return np.asarray(a)
+
+
+def _null_flags(a: np.ndarray) -> Optional[np.ndarray]:
+    if isinstance(a, np.ma.MaskedArray) and a.mask is not np.ma.nomask \
+            and np.any(a.mask):
+        return np.ma.getmaskarray(a).astype(np.int8)  # 1 = null -> last
+    return None
+
+
+def _page_sort(arrays: Dict[str, np.ndarray],
+               sorted_by: List[Tuple[str, bool]]) -> Dict[str, np.ndarray]:
+    keys: List[np.ndarray] = []
+    asc: List[bool] = []
+    for col, up in sorted_by:
+        nf = _null_flags(arrays[col])
+        if nf is not None:
+            keys.append(nf)  # nulls last regardless of direction
+            asc.append(True)
+        keys.append(_orderable_host(arrays[col]))
+        asc.append(up)
+    if not keys:
+        return arrays
+    perm = K.write_sort_perm(keys, asc)
+    return {c: a[perm] for c, a in arrays.items()}
+
+
+def _key_ranges(arrays: Dict[str, np.ndarray],
+                sorted_by: List[Tuple[str, bool]]) -> Optional[list]:
+    """[first-row, last-row] sort-key tuples of an ALREADY-SORTED page
+    (json-able), or None when unavailable (empty page / NULL sort keys)
+    — pages without ranges can never support a table-level ordering
+    claim.  Since the page is sorted, first/last rows are the
+    lexicographic extremes, which is exactly what the boundary verifier
+    (connectors.files_ordered) needs."""
+    first, last = [], []
+    for col, _asc in sorted_by:
+        a = arrays[col]
+        if isinstance(a, np.ma.MaskedArray):
+            if a.mask is not np.ma.nomask and np.any(a.mask):
+                return None  # NULL keys: boundary tuples unrepresentable
+            a = a.data
+        if len(a) == 0:
+            return None
+        lo, hi = a[0], a[-1]
+        first.append(str(lo) if a.dtype.kind in ("U", "S", "O")
+                     else lo.item() if hasattr(lo, "item") else lo)
+        last.append(str(hi) if a.dtype.kind in ("U", "S", "O")
+                    else hi.item() if hasattr(hi, "item") else hi)
+    return [first, last]
+
+
+def pages_ordered(metas: list, sorted_by: List[Tuple[str, bool]]) -> bool:
+    """True when the page/file sequence is globally nondecreasing on the
+    sort keys: each page internally sorted (the writer sorted it —
+    pages lacking key_ranges don't qualify) and every boundary
+    lexicographically monotone.  This is the verifier that upgrades a
+    per-file sort into a ConnectorTable.ordering() claim; anything
+    unverifiable simply records no claim."""
+    from presto_tpu.connectors import files_ordered
+
+    if not sorted_by or not all(a for _c, a in sorted_by):
+        return False  # descending keys: ordering() claims are asc-only
+    return files_ordered([m.key_ranges for m in metas])
+
+
+class PageLayout:
+    """Applies the write properties to one host page, yielding
+    (bucket, partition, arrays, key_ranges) sub-pages in publish order
+    (partition-major, then bucket, preserving the global sort for range
+    bucketing)."""
+
+    def __init__(self, props: Optional[WriteProperties],
+                 streaming: bool = False):
+        self.props = props
+        # streamed (multi-page) writes can't range-bucket — bucket b's
+        # key range would differ per page — so they fall back to hash
+        self.streaming = streaming
+        if props is not None and streaming and props.bucketing == "range":
+            props.bucketing = "hash"
+
+    def split(self, arrays: Dict[str, np.ndarray]):
+        wp = self.props
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        if wp is None or wp.empty() or n == 0:
+            yield None, None, arrays, None
+            return
+        for part, sub in self._partitions(arrays, n):
+            if wp.bucketed_by:
+                if wp.bucketing == "range":
+                    yield from self._range_buckets(part, sub)
+                else:
+                    yield from self._hash_buckets(part, sub)
+            else:
+                page = _page_sort(sub, wp.sorted_by)
+                yield None, part, page, _key_ranges(page, wp.sorted_by)
+
+    def _partitions(self, arrays, n):
+        wp = self.props
+        if not wp.partitioned_by:
+            yield None, arrays
+            return
+        code = np.zeros(n, dtype=np.int64)
+        uniques = []
+        for c in wp.partitioned_by:
+            vals = arrays[c]
+            if isinstance(vals, np.ma.MaskedArray):
+                raise WriteError(
+                    f"NULL partition values in '{c}' are not supported")
+            u, inv = np.unique(np.asarray(vals), return_inverse=True)
+            uniques.append(u)
+            code = code * (len(u) + 1) + inv
+        for pc in np.unique(code):
+            idx = np.flatnonzero(code == pc)
+            sub = {c: a[idx] for c, a in arrays.items()}
+            part = tuple((c, sub[c][0].item()
+                          if hasattr(sub[c][0], "item") else sub[c][0])
+                         for c in wp.partitioned_by)
+            yield part, sub
+
+    def _range_buckets(self, part, sub):
+        wp = self.props
+        page = _page_sort(sub, wp.sorted_by)
+        n = len(next(iter(page.values())))
+        edges = np.linspace(0, n, wp.bucket_count + 1).astype(int)
+        for b, (lo, hi) in enumerate(zip(edges[:-1], edges[1:])):
+            if lo >= hi:
+                continue
+            bp = {c: a[lo:hi] for c, a in page.items()}
+            yield b, part, bp, _key_ranges(bp, wp.sorted_by)
+
+    def _hash_buckets(self, part, sub):
+        wp = self.props
+        keys = [_mixable_int(sub[c], c) for c in wp.bucketed_by]
+        bids = K.write_bucket_ids(keys, wp.bucket_count)
+        for b in range(wp.bucket_count):
+            idx = np.flatnonzero(bids == b)
+            if len(idx) == 0:
+                continue
+            bp = {c: a[idx] for c, a in sub.items()}
+            bp = _page_sort(bp, wp.sorted_by)
+            yield b, part, bp, _key_ranges(bp, wp.sorted_by)
+
+
+def _mixable_int(a: np.ndarray, col: str) -> np.ndarray:
+    if isinstance(a, np.ma.MaskedArray):
+        if a.mask is not np.ma.nomask and np.any(a.mask):
+            raise WriteError(f"NULL bucket keys in '{col}' are not "
+                             "supported")
+        a = a.data
+    a = np.asarray(a)
+    if a.dtype.kind not in ("i", "u", "b"):
+        raise WriteError(f"hash bucketing needs integer keys; '{col}' "
+                         f"is {a.dtype}")
+    return a.astype(np.int64, copy=False)
+
+
+# ---------------------------------------------------------------------------
+# column coercion (the old _insert_into rules + null-fill)
+# ---------------------------------------------------------------------------
+
+
+def coerce_insert_page(arrays: Dict[str, np.ndarray],
+                       types: Dict[str, T.Type],
+                       targets: List[str], table, sink) -> Dict[str, np.ndarray]:
+    """Coerce a query-output page onto the target schema for INSERT:
+    positional target mapping, type coercion checks, decimal rescale,
+    NULL handling — and the null-fill path for partial column lists on
+    sinks whose storage carries a null channel (parquet/orc).  Raw-array
+    sinks keep the original clear error."""
+    src_cols = list(arrays)
+    if len(src_cols) != len(targets):
+        raise WriteError(
+            f"INSERT column count mismatch: query produces "
+            f"{len(src_cols)}, target list has {len(targets)}")
+    unknown = [c for c in targets if c not in table.schema]
+    if unknown:
+        raise WriteError(f"unknown INSERT columns: {unknown}")
+    n = len(arrays[src_cols[0]]) if src_cols else 0
+    missing = [c for c in table.schema if c not in targets]
+    if missing and not sink.supports_null_append:
+        raise WriteError(
+            f"INSERT must cover all columns (missing {missing}); "
+            "partial inserts with null fill are not supported by this "
+            "connector")
+    out: Dict[str, np.ndarray] = {}
+    for tgt, src in zip(targets, src_cols):
+        want = table.schema[tgt]
+        a = arrays[src]
+        if isinstance(a, np.ma.MaskedArray):
+            if sink.supports_null_append:
+                pass  # the sink writes a null channel (parquet/orc)
+            elif a.mask is not np.ma.nomask and np.any(a.mask):
+                # raw-array sinks have no validity mask; silently
+                # writing fill values would corrupt NULLs
+                raise WriteError(
+                    f"INSERT of NULL values into column '{tgt}' is not "
+                    "supported by this connector")
+            else:
+                a = a.data
+        if not isinstance(a, np.ma.MaskedArray):
+            a = np.asarray(a)
+        have = types.get(src, want)
+        if have != want and not T.can_coerce(have, want) \
+                and not (have.is_numeric and want.is_numeric):
+            raise WriteError(f"cannot insert {have} into {tgt} ({want})")
+        if want.is_decimal and a.dtype.kind == "f":
+            # decoded decimals arrive as unscaled floats; rescale like
+            # batch.column_from_numpy, never truncate (and never wrap)
+            scaled = a * (10 ** want.decimal_scale)
+            T.check_decimal_overflow(scaled, what="inserted value")
+            a = np.round(scaled).astype(np.int64)
+        elif not want.is_string and a.dtype != want.numpy_dtype() \
+                and a.dtype != object:
+            a = a.astype(want.numpy_dtype())
+        out[tgt] = a
+    for c in missing:  # null-fill: an all-masked column of the right dtype
+        t = table.schema[c]
+        fill = np.full(n, "", dtype=object) if t.is_string \
+            else np.zeros(n, dtype=t.numpy_dtype())
+        out[c] = np.ma.masked_array(fill, mask=np.ones(n, dtype=bool))
+    return {c: out[c] for c in table.schema}
+
+
+def clean_ctas_page(arrays: Dict[str, np.ndarray], sink,
+                    what: str = "CTAS") -> Dict[str, np.ndarray]:
+    """CTAS pages define the schema, so no type coercion — only the
+    NULL-channel rule: null-carrying sinks take masked arrays verbatim,
+    raw-array sinks reject actual NULLs loudly."""
+    if sink.supports_null_append:
+        return dict(arrays)
+    clean = {}
+    for c, a in arrays.items():
+        if isinstance(a, np.ma.MaskedArray):
+            if a.mask is not np.ma.nomask and np.any(a.mask):
+                raise WriteError(
+                    f"{what} with NULL values in column '{c}' is not "
+                    "supported by this connector")
+            a = a.data
+        clean[c] = np.asarray(a)
+    return clean
+
+
+# ---------------------------------------------------------------------------
+# WriteContext: the runtime state behind TableWriter/TableFinish
+# ---------------------------------------------------------------------------
+
+
+class WriteContext:
+    """One write's engine-side state: the sink, the layout transform,
+    the coercion rule, counters, and the commit/abort protocol.  Shared
+    by every execution mode; thread-safe for distributed writer
+    workers (compute in parallel, append under the lock)."""
+
+    def __init__(self, session, table, sink, props: Optional[WriteProperties],
+                 targets: Optional[List[str]] = None, is_ctas: bool = True,
+                 streaming: bool = False, on_commit=None):
+        self.session = session
+        self.table = table
+        self.sink = sink
+        self.props = props
+        self.layout = PageLayout(props, streaming=streaming)
+        self.targets = targets
+        self.is_ctas = is_ctas
+        self.on_commit = on_commit  # callable(ctx) after sink commit
+        self.rows = 0
+        self.bytes = 0
+        self.write_ns = 0
+        self._lock = threading.Lock()
+        self._done = False
+        self._aborted = False
+
+    # -- page path -----------------------------------------------------
+    def write_page(self, arrays: Dict[str, np.ndarray],
+                   types: Dict[str, T.Type]) -> int:
+        t0 = TR.clock_ns()
+        if self.is_ctas:
+            page = clean_ctas_page(arrays, self.sink)
+        else:
+            page = coerce_insert_page(arrays, types, self.targets,
+                                      self.table, self.sink)
+        n = len(next(iter(page.values()))) if page else 0
+        if n == 0:
+            return 0
+        subs = list(self.layout.split(page))
+        with self._lock:
+            for bucket, part, sub, ranges in subs:
+                self.sink.append_page(sub, bucket=bucket, partition=part,
+                                      key_ranges=ranges)
+            self.rows += n
+            self.bytes += sum(int(getattr(a, "nbytes", 0))
+                              for a in page.values())
+            self.write_ns += TR.clock_ns() - t0
+        return n
+
+    # -- commit protocol ----------------------------------------------
+    def finish(self):
+        with self._lock:
+            if self._done:
+                return self.sink.finished
+            t0 = TR.clock_ns()
+            # non-staged sinks (AppendPageSink) can't verify an ordering
+            # claim against pre-existing rows themselves — the writer
+            # does it here; staged file sinks verify inside their own
+            # commit (manifest ranges cover pre-existing files too)
+            if isinstance(self.sink, AppendPageSink):
+                self._record_append_claim()
+            res = self.sink.finish()
+            if res.bytes:
+                self.bytes = res.bytes
+            if self.on_commit is not None:
+                self.on_commit(self)
+            self._done = True
+            self.write_ns += TR.clock_ns() - t0
+            return res
+
+    def abort(self):
+        with self._lock:
+            if self._done or self._aborted:
+                return
+            self._aborted = True
+            self.sink.abort()
+
+    @property
+    def files(self) -> int:
+        res = self.sink.finished
+        return len(res.files) if res is not None else 0
+
+    def _record_append_claim(self):
+        """Record write_props (+ a verified ordering claim) on an
+        append-SPI table (memory connector): the claim holds when this
+        write's page sequence is monotone AND the table was empty before
+        it (a fresh CTAS / first INSERT)."""
+        wp = self.props
+        table = self.table
+        rec = getattr(table, "record_write_properties", None)
+        if wp is None or wp.empty() or rec is None:
+            return
+        prior = getattr(table, "_rows", None)
+        fresh = (prior == self.rows) if prior is not None else False
+        ordered = bool(wp.sorted_by) and fresh \
+            and pages_ordered(self.sink.pages, wp.sorted_by)
+        rec(wp.to_dict(), ordered)
+
+
+# ---------------------------------------------------------------------------
+# target-table construction (the getPageSinkProvider dispatch)
+# ---------------------------------------------------------------------------
+
+
+def _default_directory(session, name: str) -> str:
+    import tempfile
+
+    root = session.properties.get("localfile_root") or os.path.join(
+        tempfile.gettempdir(), "presto_tpu_tables")
+    return os.path.join(root, name.replace(".", "_"))
+
+
+def build_target_table(session, name: str, schema: Dict[str, T.Type],
+                       properties: dict):
+    """Construct (but do NOT register) the CTAS target table for the
+    WITH-selected connector — registration is the TableFinish commit.
+    Returns (table, connector)."""
+    connector = str(properties.get("connector", "memory")).lower()
+    if connector == "memory":
+        from presto_tpu.catalog import MemoryTable
+
+        empty = {c: np.empty(0, t.numpy_dtype()
+                             if not t.is_string else object)
+                 for c, t in schema.items()}
+        return MemoryTable(name, schema, empty), connector
+    if connector == "blackhole":
+        from presto_tpu.connectors.localfile import BlackholeTable
+
+        return BlackholeTable(name, schema), connector
+    if connector in ("localfile", "parquet", "orc"):
+        directory = properties.get("path") or properties.get(
+            "directory") or _default_directory(session, name)
+        if connector == "localfile":
+            from presto_tpu.connectors.localfile import LocalFileTable as cls
+        elif connector == "parquet":
+            from presto_tpu.connectors.parquet import ParquetTable as cls
+        else:
+            from presto_tpu.connectors.orc import OrcTable as cls
+        return cls(name, directory, schema), connector
+    raise WriteError(f"unknown connector '{connector}'")
+
+
+def target_connector(properties: dict, session=None, name: str = "") -> str:
+    c = str(properties.get("connector", "memory")).lower()
+    if session is not None and c != "hive":
+        from presto_tpu.connectors.hive import is_hive_name
+
+        # a name under an attached hive catalog's prefix routes to the
+        # hive connector (reference: the catalog name selects the
+        # connector in MetadataManager.createTable)
+        if is_hive_name(session.catalog, name):
+            return "hive"
+    return c
+
+
+def connector_kind(table) -> str:
+    mod = type(table).__module__
+    for k in ("localfile", "parquet", "orc", "hive"):
+        if mod.endswith(k):
+            if type(table).__name__ == "BlackholeTable":
+                return "blackhole"
+            return k
+    return "memory"
+
+
+# ---------------------------------------------------------------------------
+# write planning (TableWriter/TableFinish wrap the optimized query plan)
+# ---------------------------------------------------------------------------
+
+
+def output_schema(out: P.Output) -> Tuple[Dict[str, T.Type], List[str]]:
+    """The host-array schema a materialized Output produces: duplicate
+    names suffix `_i` exactly like executor.execute_plan_to_host, so
+    CTAS schemas match the arrays byte-for-byte."""
+    types = dict(out.source.outputs())
+    schema: Dict[str, T.Type] = {}
+    order: List[str] = []
+    used: Dict[str, int] = {}
+    for name, sym in zip(out.names, out.symbols):
+        n = name
+        i = used.get(name, 0)
+        used[name] = i + 1
+        if i:
+            n = f"{name}_{i}"
+        schema[n] = types.get(sym, T.VARCHAR)
+        order.append(n)
+    return schema, order
+
+
+def plan_write_statement(session, stmt) -> P.QueryPlan:
+    """Plan a CTAS/INSERT as Output <- TableFinish <- TableWriter <-
+    <optimized query plan> (reference: LogicalPlanner.createTableWriterPlan).
+    The inner query plans + optimizes through the normal path, so
+    ordering propagation / dynamic filters / CBO all apply to the
+    source side of a write."""
+    from presto_tpu.exec.executor import plan_statement
+
+    from presto_tpu.plan.planner import Planner
+
+    inner = plan_statement(session, ast.QueryStatement(stmt.query))
+    if isinstance(stmt, ast.CreateTableAs):
+        target, props = stmt.name, (stmt.properties or {})
+        schema, order = output_schema(inner.root)
+        columns = order
+        connector = target_connector(props, session, target)
+        wp = WriteProperties.parse(props, schema, connector)
+    else:
+        target = stmt.table
+        table = session.catalog.get(target)
+        columns = stmt.columns if stmt.columns is not None \
+            else list(table.schema)
+        connector = connector_kind(table)
+        wp = WriteProperties.from_dict(
+            table.write_properties()
+            if hasattr(table, "write_properties") else None)
+    return Planner.wrap_write(
+        inner, target, connector, columns,
+        wp.to_dict() if wp is not None else None)
+
+
+# ---------------------------------------------------------------------------
+# execution modes
+# ---------------------------------------------------------------------------
+
+
+def _host_arrays(out: P.Output, batch) -> Tuple[Dict[str, np.ndarray],
+                                                Dict[str, T.Type]]:
+    from presto_tpu.batch import to_numpy
+
+    arrays, sel = to_numpy(batch)
+    types = dict(out.source.outputs())
+    schema, order = output_schema(out)
+    result = {}
+    used: Dict[str, int] = {}
+    for name, sym in zip(out.names, out.symbols):
+        n = name
+        i = used.get(name, 0)
+        used[name] = i + 1
+        if i:
+            n = f"{name}_{i}"
+        v = arrays[sym][sel]
+        result[n] = v if isinstance(v, np.ma.MaskedArray) else np.asarray(v)
+    return result, schema
+
+
+def _stream_target(session, plan: P.QueryPlan):
+    """(scan_node, inner_output) when the write's source is a streamable
+    single-scan pipeline (Output <- Project/Filter* <- TableScan, no
+    subplans): these are the plans chunked/distributed writes can
+    evaluate split-by-split with bounded host memory."""
+    if plan.subplans:
+        return None
+    tw = plan.root.source.source  # Output <- TableFinish <- TableWriter
+    inner = tw.source
+    node = inner.source
+    while isinstance(node, (P.Project, P.Filter)):
+        node = node.source
+    if not isinstance(node, P.TableScan):
+        return None
+    scans: List[P.TableScan] = []
+
+    def walk(n):
+        if isinstance(n, P.TableScan):
+            scans.append(n)
+        for s in n.sources:
+            walk(s)
+
+    walk(inner)
+    if len(scans) != 1 or scans[0] is not node:
+        return None
+    return node, inner
+
+
+def _split_batch(session, table, scan: P.TableScan, split):
+    data = table.read(list(dict.fromkeys(scan.assignments.values())),
+                      split=split)
+    arrays = {}
+    for sym, src in scan.assignments.items():
+        arrays[sym] = data[src]
+    return batch_from_numpy(arrays, dict(scan.types))
+
+
+def _stream_write(session, plan: P.QueryPlan, ctx: WriteContext,
+                  scan: P.TableScan, inner: P.Output,
+                  workers: int, mon=None) -> int:
+    """Chunked / distributed write: evaluate the source pipeline one
+    split at a time, appending each chunk's page to the sink — bounded
+    host memory, no whole-result materialization.  workers > 1 fans
+    splits over writer threads (each producing its OWN staged files);
+    the caller's finish() is the coordinator's single commit step."""
+    from presto_tpu.exec.executor import Executor
+
+    table = session.catalog.get(scan.table)
+    chunk_rows = int(session.properties.get(
+        "write_page_rows", DEFAULT_WRITE_PAGE_ROWS))
+    n_splits = max(-(-int(table.row_count()) // max(chunk_rows, 1)), 1)
+    splits = table.splits(n_splits) or [(0, table.row_count())]
+    errors: List[BaseException] = []
+    total = [0]
+    total_lock = threading.Lock()
+
+    def run_splits(assigned):
+        try:
+            for sp in assigned:
+                b = _split_batch(session, table, scan, sp)
+                ex = Executor(session, scan_inputs={id(scan): b})
+                out = ex.exec_node(inner)
+                arrays, types = _host_arrays(inner, out)
+                n = ctx.write_page(arrays, types)
+                with total_lock:
+                    total[0] += n
+        except BaseException as e:
+            errors.append(e)
+
+    if workers <= 1:
+        run_splits(splits)
+    else:
+        lanes = [splits[i::workers] for i in range(workers)]
+        threads = [threading.Thread(target=run_splits, args=(lane,),
+                                    daemon=True)
+                   for lane in lanes if lane]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    if errors:
+        raise errors[0]
+    return total[0]
+
+
+def _compiled_arrays(session, text: str, query: ast.Query, mon):
+    """Compiled-mode source execution: the SELECT compiles/runs as ONE
+    XLA program (executor.run_compiled, sharing its executable memo
+    under a write-scoped key) and the fetched result converts back to
+    host columns.  Returns None when the materialized rows don't
+    round-trip losslessly to arrays (exotic object columns) — the
+    caller falls back to the dynamic pipeline."""
+    from presto_tpu.exec.executor import run_compiled
+
+    res = run_compiled(session, f"__write__:{text}",
+                       ast.QueryStatement(query), mon=mon)
+    arrays: Dict[str, np.ndarray] = {}
+    types: Dict[str, T.Type] = {}
+    used: Dict[str, int] = {}
+    for i, (name, typ) in enumerate(res.columns):
+        n = name
+        k = used.get(name, 0)
+        used[name] = k + 1
+        if k:
+            n = f"{name}_{k}"
+        vals = [r[i] for r in res.rows]
+        has_null = any(v is None for v in vals)
+        if typ.is_string:
+            a = np.asarray([("" if v is None else v) for v in vals],
+                           dtype=object)
+        else:
+            dt = np.float64 if (typ.is_decimal
+                                or typ.name == "DOUBLE") else None
+            try:
+                a = np.asarray([(0 if v is None else v) for v in vals],
+                               dtype=dt)
+            except (TypeError, ValueError):
+                return None
+            if a.dtype == object:
+                return None
+        if has_null:
+            a = np.ma.masked_array(
+                a, mask=np.asarray([v is None for v in vals]))
+        arrays[n] = a
+        types[n] = typ
+    return arrays, types
+
+
+# ---------------------------------------------------------------------------
+# the statement entry point
+# ---------------------------------------------------------------------------
+
+
+def run_write(session, text: str, stmt, mon) -> QueryResult:
+    """CTAS / INSERT lifecycle: authorize -> plan (TableWriter) ->
+    begin_write -> execute in the session's mode (appending pages) ->
+    finish/commit -> row-count result."""
+    is_ctas = isinstance(stmt, ast.CreateTableAs)
+    if is_ctas:
+        session.access_control.check_can_create_table(session.user,
+                                                      stmt.name)
+        or_replace = bool(getattr(stmt, "or_replace", False))
+        if stmt.name in session.catalog and not or_replace:
+            if stmt.if_not_exists:
+                return QueryResult([("rows", T.BIGINT)], [(0,)])
+            raise WriteError(f"Table '{stmt.name}' already exists")
+    else:
+        session.access_control.check_can_insert(session.user, stmt.table)
+
+    from presto_tpu.exec import chunked as CH
+    from presto_tpu.exec.executor import Executor, plan_statement
+
+    with mon.phase("plan"):
+        plan = plan_statement(session, stmt)
+    tw: P.TableWriter = plan.root.source.source
+    wp = WriteProperties.from_dict(tw.write_props)
+
+    ctx = _begin_write(session, stmt, plan, tw, wp)
+    try:
+        with mon.phase("execute"):
+            stream = _stream_target(session, plan)
+            mode = session.properties.get("execution_mode", "auto")
+            threshold = int(session.properties.get(
+                "chunked_rows_threshold", CH.DEFAULT_STREAM_THRESHOLD))
+            executed = False
+            if stream is not None:
+                scan, inner = stream
+                table = session.catalog.get(scan.table)
+                if session.properties.get("distributed", False):
+                    mon.stats.execution_mode = "distributed"
+                    workers = int(session.properties.get(
+                        "write_parallelism", 0)) or min(
+                        MAX_WRITE_WORKERS, max(os.cpu_count() or 2, 2))
+                    ctx.layout.streaming = True
+                    _demote_range_bucketing(ctx)
+                    _stream_write(session, plan, ctx, scan, inner,
+                                  workers, mon)
+                    executed = True
+                elif mode == "chunked" or (
+                        mode == "auto"
+                        and table.row_count() > threshold):
+                    mon.stats.execution_mode = "chunked"
+                    ctx.layout.streaming = True
+                    _demote_range_bucketing(ctx)
+                    _stream_write(session, plan, ctx, scan, inner, 1, mon)
+                    executed = True
+            if not executed and mode == "compiled":
+                from presto_tpu.exec.executor import StaticFallback
+
+                try:
+                    got = _compiled_arrays(session, text, stmt.query, mon)
+                except StaticFallback:
+                    got = None
+                if got is not None:
+                    mon.stats.execution_mode = "compiled"
+                    arrays, types = got
+                    ctx.write_page(arrays, types)
+                    executed = True
+            if not executed:
+                # the normal executor pipeline: TableWriter/TableFinish
+                # run as plan nodes (dynamic mode)
+                mon.stats.execution_mode = "dynamic"
+                ex = Executor(session, monitor=mon)
+                ex.write_ctx = ctx
+                ex.run(plan)
+            ctx.finish()  # idempotent (TableFinish commits inline)
+    except BaseException:
+        ctx.abort()
+        raise
+    mon.stats.rows_written = ctx.rows
+    mon.stats.bytes_written = ctx.bytes
+    mon.stats.write_files = ctx.files
+    mon.stats.write_ms = ctx.write_ns / 1e6
+    return QueryResult([("rows", T.BIGINT)], [(ctx.rows,)])
+
+
+def _demote_range_bucketing(ctx: WriteContext) -> None:
+    """Streamed writes can't hold the whole result, so range bucketing
+    (which needs ONE global sort) degrades to hash bucketing — pages
+    stay per-bucket sorted for zone maps; the table-level ordering claim
+    simply doesn't record unless the boundary verifier still passes."""
+    wp = ctx.props
+    if wp is not None and wp.bucketing == "range":
+        wp.bucketing = "hash"
+
+
+def _begin_write(session, stmt, plan: P.QueryPlan, tw: P.TableWriter,
+                 wp: Optional[WriteProperties]) -> WriteContext:
+    """Build the target table / sink and wire the commit callback
+    (catalog registration + transaction undo records)."""
+    is_ctas = isinstance(stmt, ast.CreateTableAs)
+    inner: P.Output = tw.source
+    if not is_ctas:
+        table = session.catalog.get(stmt.table)
+        if not (hasattr(table, "page_sink") or hasattr(table, "append")):
+            raise WriteError(
+                f"table '{stmt.table}' does not support INSERT")
+        # transactional snapshot BEFORE the first page: manifest
+        # snapshot for staged sinks, data pre-image for memory tables
+        session.txn.record_table_write(table)
+        iprops = wp if wp is not None else None
+        sink = open_sink(table, iprops, defer_gc=session.txn.active)
+        return WriteContext(session, table, sink, iprops,
+                            targets=list(tw.columns), is_ctas=False,
+                            on_commit=lambda c: _invalidate_server_caches(
+                                session))
+
+    schema, _order = output_schema(inner)
+    props = stmt.properties or {}
+    connector = tw.connector
+    or_replace = bool(getattr(stmt, "or_replace", False))
+    replacing = or_replace and stmt.name in session.catalog
+    old_table = session.catalog.get(stmt.name) if replacing else None
+
+    session.txn.check_write_allowed()
+    if connector == "hive":
+        from presto_tpu.connectors.hive import create_hive_table
+
+        if replacing:
+            raise WriteError("CREATE OR REPLACE is not supported for "
+                             "hive tables")
+        table = create_hive_table(session.catalog, stmt.name, schema,
+                                  props)  # registers itself
+        session.txn.record_create(stmt.name)
+        sink = open_sink(table, wp)
+        return WriteContext(session, table, sink, wp, is_ctas=True,
+                            on_commit=lambda c: _invalidate_server_caches(
+                                session))
+
+    new_dir = props.get("path") or props.get("directory")
+    old_dir = getattr(old_table, "dir", None) \
+        or getattr(old_table, "path", None)
+    in_place = (replacing and connector in ("localfile", "parquet", "orc")
+                and connector_kind(old_table) == connector
+                and (not new_dir or (old_dir is not None
+                                     and os.path.abspath(str(new_dir))
+                                     == os.path.abspath(str(old_dir)))))
+    if replacing and not in_place and old_dir is not None \
+            and new_dir is not None \
+            and os.path.abspath(str(new_dir)) \
+            == os.path.abspath(str(old_dir)):
+        raise WriteError(
+            f"CREATE OR REPLACE of '{stmt.name}' cannot reuse the old "
+            f"storage directory across connectors; choose a new path")
+    if in_place:
+        # same-storage replace: the staged sink publishes a NEW manifest
+        # generation over the SAME directory — concurrent readers on the
+        # previous generation keep their files (snapshot isolation)
+        table = old_table
+        session.txn.record_presnapshot(table)  # pre-commit manifest
+        sink = table.page_sink(wp, replace=True, schema=schema,
+                               defer_gc=session.txn.active)
+    else:
+        table, _ = build_target_table(session, stmt.name, schema, props)
+        sink = open_sink(table, wp)
+
+    def on_commit(ctx: WriteContext):
+        txn = session.txn
+        if replacing:
+            txn.record_replace(stmt.name, old_table,
+                               in_place=in_place)
+        else:
+            txn.record_create(stmt.name)
+        if not in_place:
+            session.catalog.register(ctx.table)
+            if replacing and old_table is not None \
+                    and old_table is not ctx.table \
+                    and hasattr(old_table, "drop_data") \
+                    and txn.current is None:
+                # cross-storage replace: old managed storage goes away
+                # (same-storage replaces retire files via the manifest)
+                old_table.drop_data()
+        else:
+            session.catalog.version += 1
+        _invalidate_server_caches(session)
+
+    return WriteContext(session, table, sink, wp, is_ctas=True,
+                        on_commit=on_commit)
+
+
+def _invalidate_server_caches(session) -> None:
+    """Engine-path writes must invalidate the serving result cache the
+    same way protocol-path writes do (server/serving.py belt rule)."""
+    tier = getattr(session, "_serving_tier", None)
+    if tier is not None:
+        try:
+            tier.on_write_statement()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# SHOW CREATE TABLE rendering
+# ---------------------------------------------------------------------------
+
+
+def render_create_table(table) -> str:
+    """CREATE TABLE DDL with the recorded physical-layout properties —
+    executing the rendered statement (fresh name/path) reproduces the
+    layout (reference: ShowCreateTable rewrite)."""
+    cols = ",\n".join(f"   {c} {str(t).lower()}"
+                      for c, t in table.schema.items())
+    props = [("connector", f"'{connector_kind(table)}'")]
+    d = getattr(table, "dir", None) or getattr(table, "path", None)
+    if d:
+        props.append(("directory", f"'{d}'"))
+    wp = WriteProperties.from_dict(
+        table.write_properties()
+        if hasattr(table, "write_properties") else None)
+    if wp is not None and not wp.empty():
+        if wp.bucketed_by:
+            props.append(("bucketed_by", _render_array(wp.bucketed_by)))
+            props.append(("bucket_count", str(wp.bucket_count)))
+        if wp.sorted_by:
+            props.append(("sorted_by", _render_array(
+                [f"{c} {'asc' if a else 'desc'}" for c, a in wp.sorted_by])))
+        if wp.partitioned_by:
+            props.append(("partitioned_by",
+                          _render_array(wp.partitioned_by)))
+    with_clause = ",\n".join(f"   {k} = {v}" for k, v in props)
+    return (f"CREATE TABLE {table.name} (\n{cols}\n)\n"
+            f"WITH (\n{with_clause}\n)")
+
+
+def _render_array(items: List[str]) -> str:
+    return "ARRAY[" + ", ".join(f"'{i}'" for i in items) + "]"
+
+
+def describe_extra_rows(table) -> List[tuple]:
+    """Layout rows DESCRIBE/SHOW COLUMNS append for tables with recorded
+    write properties (tables without them are unchanged)."""
+    wp = WriteProperties.from_dict(
+        table.write_properties()
+        if hasattr(table, "write_properties") else None)
+    if wp is None or wp.empty():
+        return []
+    rows = []
+    if wp.sorted_by:
+        rows.append(("# sorted_by", ", ".join(
+            f"{c} {'ASC' if a else 'DESC'}" for c, a in wp.sorted_by)))
+    if wp.bucketed_by:
+        rows.append(("# bucketed_by",
+                     f"{', '.join(wp.bucketed_by)} "
+                     f"({wp.bucketing}, {wp.bucket_count} buckets)"))
+    if wp.partitioned_by:
+        rows.append(("# partitioned_by", ", ".join(wp.partitioned_by)))
+    return rows

@@ -118,6 +118,16 @@ class QueryStats:
     result_cache_hit: int = 0
     resource_group: str = ""
     admission_wait_ms: float = 0.0
+    # write subsystem (exec/writer.py, PageSink SPI): rows/bytes a
+    # CTAS/INSERT streamed into connector sinks, files the commit
+    # published (0 for append-SPI connectors like memory), and the wall
+    # spent in page coercion/layout/sink appends + the finish/commit
+    # step.  Exported like every numeric counter through the metrics
+    # registry (observe/metrics.py).
+    rows_written: int = 0
+    bytes_written: int = 0
+    write_files: int = 0
+    write_ms: float = 0.0
     # tracing (observe/trace.py): this query's trace id, the recorded
     # span dicts (coordinator + merged worker spans; chrome-exportable
     # via trace.chrome_trace / GET /v1/query/{id}/trace), and the count

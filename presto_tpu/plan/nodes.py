@@ -288,6 +288,50 @@ class Exchange(PlanNode):
 
 
 @dataclass
+class TableWriter(PlanNode):
+    """Streams the source relation into a connector PageSink
+    (reference: sql/planner/plan/TableWriterNode + TableWriterOperator).
+    The sink handle itself is runtime state carried by the executor's
+    WriteContext (exec/writer.py) — the node holds only the write's
+    metadata so plans stay data-only and EXPLAIN can render the target.
+    Output: one row with the appended row count."""
+
+    source: PlanNode
+    target: str = ""            # table name being written
+    connector: str = ""         # memory | localfile | parquet | orc | ...
+    columns: List[str] = field(default_factory=list)  # target column order
+    write_props: Optional[dict] = None  # bucketed_by/sorted_by/... summary
+    rows_symbol: str = "rows$w"
+
+    def outputs(self):
+        from presto_tpu import types as _T
+
+        return [(self.rows_symbol, _T.BIGINT)]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class TableFinish(PlanNode):
+    """Commit point of a write plan (reference: TableFinishNode +
+    TableFinishOperator): runs ONCE on the coordinator after every
+    TableWriter page landed, publishing the staged output atomically
+    (manifest rewrite / catalog registration) and emitting the final
+    row count."""
+
+    source: PlanNode  # the TableWriter
+
+    def outputs(self):
+        return self.source.outputs()
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
 class Output(PlanNode):
     source: PlanNode
     names: List[str] = field(default_factory=list)  # user-visible column names
@@ -357,6 +401,10 @@ def plan_tree_str(node: PlanNode, indent: int = 0, annotate=None) -> str:
         detail = f" partition={node.partition_by} order={node.order_by}"
     elif isinstance(node, Exchange):
         detail = f" {node.kind}" + (f" keys={node.keys}" if node.keys else "")
+    elif isinstance(node, TableWriter):
+        props = {k: v for k, v in (node.write_props or {}).items() if v}
+        detail = f" {node.target} [{node.connector}]" + (
+            f" {props}" if props else "")
     lines = [pad + name + detail + (annotate(node) if annotate else "")]
     for s in node.sources:
         lines.append(plan_tree_str(s, indent + 1, annotate))

@@ -100,6 +100,23 @@ class Planner:
         raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def wrap_write(inner: P.QueryPlan, target: str, connector: str,
+                   columns, write_props) -> P.QueryPlan:
+        """Wrap an (already optimized) query plan as a write plan:
+        Output <- TableFinish <- TableWriter <- inner (reference:
+        LogicalPlanner.createTableWriterPlan).  The write metadata is
+        plain data on the nodes; the runtime sink state lives in the
+        executor's WriteContext (exec/writer.py)."""
+        tw = P.TableWriter(source=inner.root, target=target,
+                           connector=connector, columns=list(columns),
+                           write_props=write_props)
+        tf = P.TableFinish(source=tw)
+        out = P.Output(source=tf, names=["rows"],
+                       symbols=[tw.rows_symbol])
+        return P.QueryPlan(root=out, subplans=inner.subplans)
+
+    # ------------------------------------------------------------------
     def plan_query(self, q: ast.Query, outer: Optional[Scope] = None):
         """Returns (plan, scope, output names)."""
         if q.ctes:

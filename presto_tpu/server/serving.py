@@ -564,6 +564,11 @@ class ServingTier:
                 max_result_rows=int(session.properties.get(
                     "result_cache_max_rows", 10_000)))
         self.result_cache = result_cache
+        # engine-path writes (session.sql CTAS/INSERT through
+        # exec/writer.py) invalidate through this back-reference — the
+        # belt on top of the catalog-version keying, same rule as the
+        # protocol path's textual detection
+        session._serving_tier = self
         self.draining = threading.Event()
         self._lock = threading.Lock()
         self.queries_admitted = 0

@@ -148,6 +148,14 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "max_reorder_joins": 8,  # Memo/Rule fixpoint pass
     "spill_path": "",  # "" = <tmp>/presto_tpu_spill
     "localfile_root": "",  # "" = <tmp>/presto_tpu_tables (file connectors)
+    # write subsystem (exec/writer.py, docs/WRITES.md): rows per
+    # streamed write chunk (chunked-mode CTAS/INSERT appends one sink
+    # page per chunk — the bounded-host-memory knob), and the writer
+    # worker count for distributed writes (0 = auto: one thread per
+    # core up to 8; each worker writes its OWN staged files, the
+    # coordinator runs the single finish/commit)
+    "write_page_rows": 1 << 20,
+    "write_parallelism": 0,
     "spill_partition_count": 8,  # Grace hash fan-out (GenericPartitioningSpiller)
     "max_spill_bytes": 64 << 30,
     # force grouped execution above this input row count regardless of the

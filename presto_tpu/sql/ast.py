@@ -341,10 +341,25 @@ class ShowStats(Statement):
 
 @dataclass
 class CreateTableAs(Statement):
+    """CREATE [OR REPLACE] TABLE t [WITH (...)] AS query.  OR REPLACE is
+    the refresh-and-serve cut-over: the new snapshot stages invisibly
+    and publishes atomically while concurrent readers keep the previous
+    one (exec/writer.py, docs/WRITES.md)."""
+
     name: str
     query: Query
     properties: dict = field(default_factory=dict)
     if_not_exists: bool = False
+    or_replace: bool = False
+
+
+@dataclass
+class ShowCreateTable(Statement):
+    """SHOW CREATE TABLE t — renders DDL including the recorded
+    physical-layout write properties (reference: ShowQueriesRewrite's
+    SHOW CREATE handling)."""
+
+    table: str
 
 
 @dataclass

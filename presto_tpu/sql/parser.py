@@ -200,6 +200,9 @@ class Parser:
             if self.accept_kw("COLUMNS"):
                 self.expect_kw("FROM")
                 return ast.ShowColumns(self.dotted_name())
+            if self.accept_kw("CREATE"):
+                self.expect_kw("TABLE")
+                return ast.ShowCreateTable(self.dotted_name())
             if self._accept_word("FUNCTIONS"):
                 return ast.ShowFunctions()
             if self.accept_kw("SESSION"):
@@ -211,8 +214,8 @@ class Parser:
             if self._accept_word("STATS"):
                 self.expect_kw("FOR")
                 return ast.ShowStats(self.dotted_name())
-            self.err("expected TABLES, COLUMNS, FUNCTIONS, SESSION, "
-                     "CATALOGS, SCHEMAS or STATS")
+            self.err("expected TABLES, COLUMNS, CREATE TABLE, FUNCTIONS, "
+                     "SESSION, CATALOGS, SCHEMAS or STATS")
         if self._accept_word("DESCRIBE") or self.accept_kw("DESC"):
             # DESCRIBE INPUT/OUTPUT <prepared>; DESCRIBE t == SHOW
             # COLUMNS FROM t (reference: SqlBase.g4)
@@ -222,6 +225,12 @@ class Parser:
                 return ast.DescribeOutput(self.ident())
             return ast.ShowColumns(self.dotted_name())
         if self.accept_kw("CREATE"):
+            or_replace = False
+            if self.accept_kw("OR"):
+                # CREATE OR REPLACE TABLE ... AS: atomic refresh cut-over
+                if not self._accept_word("REPLACE"):
+                    self.err("expected REPLACE after CREATE OR")
+                or_replace = True
             self.expect_kw("TABLE")
             if_not_exists = False
             if self.accept_kw("IF"):
@@ -230,6 +239,8 @@ class Parser:
                 if_not_exists = True
             name = self.dotted_name()
             if self.accept_op("("):  # CREATE TABLE t (col type, ...)
+                if or_replace:
+                    self.err("CREATE OR REPLACE requires AS <query>")
                 columns = []
                 while True:
                     cname = self.ident()
@@ -244,6 +255,7 @@ class Parser:
             stmt = ast.CreateTableAs(name, self.parse_query())
             stmt.properties = props  # connector choice rides WITH(...)
             stmt.if_not_exists = if_not_exists
+            stmt.or_replace = or_replace
             return stmt
         if self.accept_kw("DROP"):
             self.expect_kw("TABLE")
@@ -1002,7 +1014,10 @@ class Parser:
 
     def _with_properties(self) -> dict:
         """WITH (k = v, ...) table properties (reference: SqlBase.g4
-        `properties`; e.g. WITH (connector = 'localfile'))."""
+        `properties`; e.g. WITH (connector = 'localfile')).  ARRAY['a',
+        'b'] values parse to python lists — the write-layout properties
+        (bucketed_by/sorted_by/partitioned_by) use them, matching the
+        hive connector's table-property shapes."""
         props: dict = {}
         if not (self.at_kw("WITH") and self.peek(1).kind == "op"
                 and self.peek(1).value == "("):
@@ -1012,6 +1027,23 @@ class Parser:
         while True:
             key = self.ident()
             self.expect_op("=")
+            if (self.peek().kind in ("ident", "kw")
+                    and str(self.peek().value).upper() == "ARRAY"
+                    and self.peek(1).kind == "op"
+                    and self.peek(1).value == "["):
+                self.next()
+                self.expect_op("[")
+                items = []
+                if not self.accept_op("]"):
+                    while True:
+                        items.append(self.next().value)
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op("]")
+                props[key] = items
+                if not self.accept_op(","):
+                    break
+                continue
             t = self.next()
             if t.kind == "number":
                 props[key] = float(t.value) if "." in t.value else int(t.value)

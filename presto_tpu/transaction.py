@@ -4,10 +4,22 @@ Reference parity: transaction/TransactionManager + the access-mode
 checks in transaction/TransactionAccessControl — START TRANSACTION
 [READ ONLY] / COMMIT / ROLLBACK, single-statement autocommit otherwise.
 Isolation is snapshot-by-undo: the first write to a table inside a
-transaction records an undo entry (memory-connector pre-image, or the
-inverse DDL action); ROLLBACK replays undos in reverse.  Connectors
-without pre-image support (localfile shards) reject transactional
-writes, like reference connectors that lack transaction support.
+transaction records an undo entry, and ROLLBACK replays undos in
+reverse.  Two snapshot kinds:
+
+- memory-connector pre-image (copy the arrays);
+- SINK SNAPSHOT: staged-sink connectors (localfile manifest, the
+  parquet/orc sidecar manifests) expose snapshot_state()/restore_state()
+  — the undo restores the pre-write manifest generation, and because
+  committed writes only ADD files (previous generations are retired
+  lazily, never deleted while a transaction is open), the restored
+  manifest's files are all still on disk.  This is also what gives the
+  refresh-and-serve scenario its isolation: a reader holding generation
+  N's file list is untouched by the commit that publishes N+1
+  (exec/writer.py, docs/WRITES.md).
+
+Connectors with neither snapshot form reject transactional writes, like
+reference connectors that lack transaction support.
 """
 
 from __future__ import annotations
@@ -56,7 +68,16 @@ class TransactionManager:
                 table.data = data
                 table._rows = rows
                 table._invalidate()
+            elif kind == "sink_state":
+                table, state = payload
+                table.restore_state(state)
             elif kind == "uncreate":
+                try:
+                    t = cat.get(payload)
+                except KeyError:
+                    t = None
+                if t is not None and hasattr(t, "drop_data"):
+                    t.drop_data()  # staged CTAS files go with the undo
                 cat.drop(payload, if_exists=True)
             elif kind == "reregister":
                 cat.register(payload)
@@ -67,16 +88,22 @@ class TransactionManager:
             raise TransactionError("read-only transaction")
 
     def record_table_write(self, table) -> None:
-        """Before mutating `table`, snapshot its pre-image once."""
+        """Before mutating `table`, snapshot its pre-image once: a data
+        copy for memory tables, the manifest for staged-sink tables."""
         self.check_write_allowed()
         if self.current is None:
             return  # autocommit
         if id(table) in self.current._snapshotted:
             return
+        if hasattr(table, "snapshot_state"):
+            self.current._snapshotted.add(id(table))
+            self.current.undo.append(
+                ("sink_state", (table, table.snapshot_state())))
+            return
         if not hasattr(table, "data"):
             raise TransactionError(
                 f"table '{table.name}' does not support transactional "
-                "writes (memory connector only)")
+                "writes (no pre-image or manifest snapshot)")
         self.current._snapshotted.add(id(table))
         self.current.undo.append(
             ("table_preimage",
@@ -88,11 +115,42 @@ class TransactionManager:
         if self.current is not None:
             self.current.undo.append(("uncreate", name))
 
+    def record_replace(self, name: str, old_table,
+                       in_place: bool = False) -> None:
+        """CREATE OR REPLACE undo: a cross-storage replace re-registers
+        the old table object over the new one; an in-place
+        (same-manifest) replace is covered by the manifest snapshot the
+        writer records via record_presnapshot BEFORE the sink commit."""
+        self.check_write_allowed()
+        if self.current is None or in_place:
+            return
+        self.current.undo.append(("reregister", old_table))
+
+    def record_presnapshot(self, table) -> None:
+        """Snapshot a staged-sink table's manifest BEFORE a replace
+        commit (exec/writer.py calls this ahead of sink.finish)."""
+        self.check_write_allowed()
+        if self.current is None or not hasattr(table, "snapshot_state"):
+            return
+        if id(table) in self.current._snapshotted:
+            return
+        self.current._snapshotted.add(id(table))
+        self.current.undo.append(
+            ("sink_state", (table, table.snapshot_state())))
+
     def record_drop(self, table) -> None:
         self.check_write_allowed()
         if self.current is not None:
-            if not hasattr(table, "data"):
+            if not hasattr(table, "data") \
+                    and not hasattr(table, "snapshot_state"):
                 raise TransactionError(
                     f"DROP of '{table.name}' is not transactional "
                     "(storage would be deleted); COMMIT first")
             self.current.undo.append(("reregister", table))
+
+    @property
+    def active(self) -> bool:
+        """True while an explicit transaction is open — staged-sink
+        commits defer retired-file garbage collection so a later
+        ROLLBACK can still restore the pre-write manifest's files."""
+        return self.current is not None

@@ -172,7 +172,7 @@ def test_no_raw_span_timing_outside_observe():
     CHECKED = [
         os.path.join("exec", f) for f in
         ("executor.py", "chunked.py", "compile_cache.py", "compiler.py",
-         "gather.py", "kernels.py", "window.py")
+         "gather.py", "kernels.py", "window.py", "writer.py")
     ] + [
         os.path.join("parallel", f) for f in
         ("cluster.py", "dist_executor.py", "exchange.py", "mesh.py")
@@ -196,6 +196,56 @@ def test_no_raw_span_timing_outside_observe():
                 bad.append(f"{rel}:{node.lineno}: time.{node.attr} — "
                            "route through observe/trace.clock_ns() / "
                            "wall_s()")
+    assert not bad, "\n".join(bad)
+
+
+def test_no_adhoc_write_io_outside_storage_layers():
+    """Write-subsystem gate (ISSUE 10): file-creation / write I/O —
+    `open(path, "w"/"wb"/"a"/"ab"/"x"/"xb")` — is confined to the
+    layers that own persistence: `storage/` (encoders), `connectors/`
+    (sinks + manifests), and `exec/writer.py` (the TableWriter
+    orchestration).  An ad-hoc write in the plan/exec/server layers
+    would bypass the PageSink staging/commit protocol (atomic manifest
+    publishes, transactional snapshots) that makes engine writes safe.
+    `server/metastore.py` is the metastore's OWN persistence layer and
+    keeps its atomic tmp+replace writes; `memory/spill.py` is the spill
+    subsystem's storage (pre-existing, cipher-wrapped)."""
+    import ast
+
+    WRITE_MODES = {"w", "wb", "a", "ab", "x", "xb", "w+", "wb+"}
+    CHECKED_DIRS = ["plan", "exec", "server"]
+    ALLOWED = {os.path.join("exec", "writer.py"),
+               os.path.join("server", "metastore.py")}
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for sub in CHECKED_DIRS:
+        d = os.path.join(pkg, sub)
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.join(sub, fn)
+            if rel in ALLOWED:
+                continue
+            with open(os.path.join(d, fn), encoding="utf-8") as f:
+                tree = ast.parse(f.read(), rel)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "open"):
+                    continue
+                mode = None
+                if len(node.args) > 1 and isinstance(node.args[1],
+                                                     ast.Constant):
+                    mode = node.args[1].value
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value,
+                                                       ast.Constant):
+                        mode = kw.value.value
+                if isinstance(mode, str) and mode in WRITE_MODES:
+                    bad.append(
+                        f"{rel}:{node.lineno}: open(..., {mode!r}) — "
+                        "write I/O belongs in storage/, connectors/, or "
+                        "exec/writer.py (PageSink staging/commit)")
     assert not bad, "\n".join(bad)
 
 
