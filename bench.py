@@ -170,6 +170,7 @@ def main():
         "recovery_ms": recovery_ms,
         "serve": serve,
         "write": write_gate_summary(),
+        "spill": spill_gate_summary(),
         "observability_overhead": obs_overhead,
         "sort_economics": sort_econ or None,
         "compile_economics": compile_econ or None,
@@ -706,6 +707,153 @@ MULTICHIP_RECORD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r06.json")
 
 
+SPILL_RECORD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "SPILL_r01.json")
+
+
+def load_spill_record():
+    try:
+        with open(SPILL_RECORD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def spill_gate_summary():
+    """The spill degradation-curve benchmark as registered in the
+    default bench artifact: the COMMITTED SPILL_r01.json record
+    (bench.py --spill re-measures it) — a default run exits 0 on
+    committed records and a broken tier is visibly red in the record's
+    own gate."""
+    rec = load_spill_record()
+    if rec is None:
+        return None
+    return {"tiers": {q: {t: leg.get("wall_ms") for t, leg in legs.items()}
+                      for q, legs in (rec.get("tiers") or {}).items()},
+            "checksums_equal": rec.get("checksums_equal"),
+            "gate": rec.get("gate"), "asof": rec.get("asof")}
+
+
+def spill_bench():
+    """`bench.py --spill`: the beyond-HBM degradation curve (ISSUE 11).
+
+    Two query shapes — q18 (join-heavy, the ROADMAP item-1 gate shape)
+    and a q67-class high-cardinality GROUP BY — run at every forced
+    degradation tier (resident / partial spill / recursive
+    partitioning), recording wall-clock, spill bytes/partitions/
+    restores/recursions, and CHECKSUM EQUIVALENCE against the resident
+    run; then a descending HBM-budget sweep on q18 records where the
+    memory-driven planner flips resident -> hybrid -> hard-fail.
+    Emits SPILL_r01.json and one JSON line.  Env: BENCH_SPILL_SF."""
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+    from tests.tpch_queries import QUERIES
+
+    sf = float(os.environ.get("BENCH_SPILL_SF", "0.1"))
+    q67_class = ("SELECT l_orderkey, count(*) c, sum(l_quantity) sq, "
+                 "min(l_extendedprice) mn, max(l_discount) mx "
+                 "FROM lineitem GROUP BY l_orderkey ORDER BY l_orderkey")
+    shapes = {"q18": QUERIES[18], "q67_class": q67_class}
+
+    def mk_session():
+        s = presto_tpu.connect(
+            tpch_catalog(sf, cache_dir="/tmp/presto_tpu_cache"))
+        s.set("execution_mode", "dynamic")
+        return s
+
+    def cksum(rows):
+        # floats to 8 significant digits: partition-wise sums
+        # legitimately reassociate float addition (see
+        # tests/test_spill_tiers.canon)
+        return hash(tuple(sorted(
+            tuple(float(f"{v:.8g}") if isinstance(v, float) else v
+                  for v in r) for r in rows)))
+
+    session = mk_session()
+    tiers = {}
+    all_equal = True
+    for name, sql in shapes.items():
+        legs = {}
+        t0 = time.perf_counter()
+        base = session.sql(sql)
+        legs["resident"] = {
+            "wall_ms": round((time.perf_counter() - t0) * 1000, 1),
+            "spill_bytes": 0, "tier": base.stats.degradation_tier}
+        want = cksum(base.rows)
+        for mode, tier in (("partial", 1), ("recursive", 2)):
+            session.set("force_spill", mode)
+            try:
+                t0 = time.perf_counter()
+                r = session.sql(sql)
+                wall = (time.perf_counter() - t0) * 1000
+            finally:
+                session.set("force_spill", "")
+            equal = cksum(r.rows) == want
+            all_equal = all_equal and equal \
+                and r.stats.degradation_tier == tier
+            legs[mode] = {
+                "wall_ms": round(wall, 1), "tier": r.stats.degradation_tier,
+                "spill_bytes": r.stats.spill_bytes,
+                "spill_partitions": r.stats.spill_partitions,
+                "spill_restores": r.stats.spill_restores,
+                "spill_recursions": r.stats.spill_recursions,
+                "checksum_equal": equal}
+        tiers[name] = legs
+
+    # descending HBM-budget sweep: where does the memory-driven planner
+    # flip resident -> hybrid -> hard-fail?  q18's semi-join-pruned
+    # LIVE set sits far under the capacity peak (the df-resident
+    # re-probe holds it resident until scan accounting itself fails);
+    # the q67-class aggregation has no filter escape, so it walks the
+    # full resident -> partial band before the scan floor
+    sweep = {}
+    for name in shapes:
+        session.sql(shapes[name])
+        peak = session.last_stats.peak_memory_bytes or (64 << 20)
+        want = cksum(session.sql(shapes[name]).rows)
+        legs = []
+        for frac in (1.0, 0.6, 0.4, 0.25, 0.15, 0.1, 0.05):
+            budget = int(peak * frac)
+            s2 = mk_session()
+            s2.set("query_max_memory_bytes", budget)
+            t0 = time.perf_counter()
+            try:
+                r = s2.sql(shapes[name])
+                legs.append({
+                    "budget_bytes": budget, "frac_of_resident_peak": frac,
+                    "outcome": ["resident", "partial", "recursive"][
+                        r.stats.degradation_tier],
+                    "wall_ms": round((time.perf_counter() - t0) * 1000, 1),
+                    "spill_bytes": r.stats.spill_bytes,
+                    "checksum_equal": cksum(r.rows) == want})
+                all_equal = all_equal and cksum(r.rows) == want
+            except Exception as e:
+                legs.append({"budget_bytes": budget,
+                             "frac_of_resident_peak": frac,
+                             "outcome": f"fail ({type(e).__name__})"})
+        sweep[name] = legs
+
+    record = {
+        "metric": "spill_degradation_curve",
+        "sf": sf,
+        "platform": "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu"
+        else "chip",
+        "tiers": tiers,
+        "budget_sweep": sweep,
+        "checksums_equal": all_equal,
+        "gate": "pass" if all_equal
+        else "FAIL: a degradation tier diverged from the resident run",
+        "asof": _today(),
+        "note": ("forced tiers via the force_spill session knob "
+                 "(PRESTO_TPU_FORCE_SPILL env equivalent); sweep budgets "
+                 "are fractions of the resident run's peak_memory_bytes; "
+                 "dynamic execution mode (the spillable path)"),
+    }
+    with open(SPILL_RECORD_PATH, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps(record), flush=True)
+
+
 def load_multichip_record():
     try:
         with open(MULTICHIP_RECORD_PATH) as f:
@@ -1058,5 +1206,7 @@ if __name__ == "__main__":
         multichip_bench()
     elif "--write" in sys.argv:
         write_bench()
+    elif "--spill" in sys.argv:
+        spill_bench()
     else:
         main()

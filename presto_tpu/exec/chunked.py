@@ -270,7 +270,15 @@ def _run_fragments(session, frags, runner, table_family, consumer_eid):
         try:
             if chunked:
                 out = runner.run_chunk_loop(frag, fscans)
-            elif frag.fid in runner.dynamic_fids:
+            elif frag.fid in runner.dynamic_fids \
+                    or _spill_routes_dynamic(session, frag.root):
+                # spill-tiered degradation (exec/spill_exec.py) cannot
+                # run inside a static trace; when a deterministic spill
+                # knob is armed, run-once join/aggregate fragments (the
+                # buffered-exchange consumers holding the big hash
+                # state) execute on the dynamic, spillable path.  Chunk
+                # LOOPS stay static: their per-chunk working set is
+                # already bounded by the chunk capacity.
                 out = runner.run_once_dynamic(frag, fscans)
             else:
                 try:
@@ -300,6 +308,27 @@ def _run_fragments(session, frags, runner, table_family, consumer_eid):
         else:
             runner.buffers[eid] = out
     return final_batch
+
+
+def _spill_routes_dynamic(session, root) -> bool:
+    """True when an armed spill knob should send this run-once fragment
+    to the dynamic executor: the fragment contains a spill-eligible
+    operator (grouped aggregate, or an INNER/LEFT/FULL equi-join)."""
+    from presto_tpu.exec import spill_exec as SE
+
+    if not SE.routing_enabled(session):
+        return False
+
+    def walk(node) -> bool:
+        t = type(node).__name__
+        if t == "Aggregate" and node.group_keys:
+            return True
+        if t == "Join" and node.criteria \
+                and node.join_type in ("INNER", "LEFT", "FULL", "RIGHT"):
+            return True
+        return any(walk(s) for s in getattr(node, "sources", []))
+
+    return walk(root)
 
 
 def _root_order_insensitive(root) -> bool:
@@ -772,7 +801,11 @@ class _FragmentRunner:
         from presto_tpu.exec.executor import Executor
 
         resident, _ = self._split_scans(fscans, chunked=False)
-        ex = Executor(self.session, scan_inputs=resident)
+        # sort_stats is the shared counter funnel: spill-degradation
+        # counters from fragment executors merge into QueryStats at the
+        # end of the chunked run like the sort/df economics do
+        ex = Executor(self.session, scan_inputs=resident,
+                      sort_stats=self.sort_stats)
         return ex.exec_node(frag.root)
 
     def run_chunk_loop(self, frag, fscans) -> Batch:

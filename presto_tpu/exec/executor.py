@@ -46,18 +46,35 @@ class ExecutionError(Exception):
 
 
 def _merge_sort_stats(stats, counts: dict) -> None:
-    """Fold an executor's sort-economics + dynamic-filtering counters
-    into QueryStats."""
+    """Fold an executor's sort-economics + dynamic-filtering +
+    spill-degradation counters into QueryStats."""
     for k in ("sorts_taken", "sorts_elided", "sort_memo_hits",
               "ordering_guard_trips",
               "df_filters_produced", "df_filters_applied",
               "df_rows_pruned", "df_chunks_pruned", "df_splits_pruned",
               "fragments_fused", "exchange_bytes_host",
-              "exchange_bytes_collective"):
+              "exchange_bytes_collective",
+              "spill_partitions", "spill_bytes", "spill_restores",
+              "spill_recursions"):
         setattr(stats, k, getattr(stats, k, 0) + int(counts.get(k, 0)))
     if counts.get("df_wait_ms"):
         stats.df_wait_ms = getattr(stats, "df_wait_ms", 0.0) \
             + float(counts["df_wait_ms"])
+    # degradation_tier is a high-water mark, not a sum
+    stats.degradation_tier = max(getattr(stats, "degradation_tier", 0),
+                                 int(counts.get("degradation_tier", 0)))
+    # legacy aliases (pre-round-15 dashboards + tests key on these)
+    stats.spilled_partitions = getattr(stats, "spilled_partitions", 0) \
+        + int(counts.get("spill_partitions", 0))
+    stats.spilled_bytes = getattr(stats, "spilled_bytes", 0) \
+        + int(counts.get("spill_bytes", 0))
+    # spill-I/O recovery events ride the recovery dict (the
+    # docs/ROBUSTNESS.md schema): enospc failures + transparent rewrites
+    for k in ("spill_enospc", "spill_rewrites", "spill_df_resident"):
+        if counts.get(k):
+            rec = getattr(stats, "recovery", None)
+            if rec is not None:
+                rec[k] = rec.get(k, 0) + int(counts[k])
 
 
 class StaticFallback(Exception):
@@ -1506,29 +1523,27 @@ class Executor:
         b = self.exec_node(node.source)
         if any(a.distinct for a in node.aggs.values()):
             return self._exec_aggregate_with_distinct(node, b)
-        # hash/agg state is ~2x its input in the worst case
-        if node.group_keys and self._should_spill(2 * batch_bytes(b),
-                                                  b.capacity):
-            holder = [b]
-            del b  # holder owns the only reference; grace path frees it
-            return self._aggregate_grouped(node, holder)
+        if node.group_keys and not self.static:
+            from presto_tpu.exec import spill_exec as SE
+
+            # hash/agg state is ~2x its input in the worst case
+            dec = SE.plan_degradation(
+                self, node, SE.WORKING_SET_FACTOR * batch_bytes(b),
+                b.capacity)
+            if dec.degrade:
+                holder = [b]
+                del b  # holder owns the only reference; spill path frees it
+                return SE.hybrid_aggregate(self, node, holder, dec)
+            if dec.mem_key:
+                try:
+                    return self._aggregate(b, node.group_keys, node.aggs,
+                                           node)
+                finally:
+                    # converted revocable operator-state reservation
+                    self.mem.set_bytes(dec.mem_key, 0)
         return self._aggregate(b, node.group_keys, node.aggs, node)
 
-    # ---- spill / grouped execution -----------------------------------
-    def _should_spill(self, est_bytes: int, capacity: int) -> bool:
-        """Grouped execution trigger: the operator's estimated working set
-        would blow the query memory budget (reference:
-        MemoryRevokingScheduler threshold -> operator startMemoryRevoke;
-        here we decide BEFORE building)."""
-        if self.static or self.mem is None:
-            return False
-        if not self.session.properties.get("spill_enabled", True):
-            return False
-        trigger = int(self.session.properties.get("spill_trigger_rows", 0))
-        if trigger and capacity >= trigger:
-            return True
-        return self.mem.would_exceed(est_bytes)
-
+    # ---- spill / grouped execution (exec/spill_exec.py) --------------
     def _make_spiller(self):
         from presto_tpu.memory.spill import (FileSpiller, SpillCipher,
                                              SpillSpaceTracker,
@@ -1539,23 +1554,15 @@ class Executor:
         if tracker is None:
             tracker = self.session._spill_tracker = SpillSpaceTracker(
                 int(self.session.properties.get("max_spill_bytes", 64 << 30)))
+        tracker.max_bytes = int(
+            self.session.properties.get("max_spill_bytes", 64 << 30))
         cipher = None
         if self.session.properties.get("spill_encryption", False):
             cipher = SpillCipher()  # ephemeral per-query key
-        return FileSpiller(path, tracker, cipher)
-
-    def _record_spill(self, spiller) -> None:
-        if self.monitor is not None:
-            self.monitor.stats.spilled_partitions += len(spiller.files)
-            self.monitor.stats.spilled_bytes += sum(s for _, s in spiller.files)
-
-    def _partition_spill(self, b: Batch, part: np.ndarray, spiller,
-                         nparts: int):
-        """Fan rows out to per-partition spill files by precomputed
-        partition id (reference: GenericPartitioningSpiller)."""
-        sel = np.asarray(b.sel)
-        return [spiller.spill(b.with_sel(jnp.asarray(sel & (part == p))))
-                for p in range(nparts)]
+        return FileSpiller(
+            path, tracker, cipher,
+            verify_writes=bool(self.session.properties.get(
+                "spill_verify_writes", False)))
 
     def _grouped_recovery(self, nparts: int):
         """Per-bucket checkpoint hooks for recoverable grouped execution
@@ -1616,80 +1623,6 @@ class Executor:
             shutil.rmtree(d, ignore_errors=True)
 
         return load, store, bucket_done, finish
-
-    def _join_grouped(self, holder: list, node: P.Join) -> Batch:
-        """Grace hash join: both sides partitioned by join-key hash into
-        disjoint buckets processed one at a time — the probe-side analog
-        of the reference's spilled HashBuilderOperator + per-partition
-        PartitionedConsumption.  Correct for INNER/LEFT/FULL equi-joins:
-        every match pair lands in one bucket, and unmatched rows surface
-        exactly once (in their own bucket).  SEMI/ANTI stay unspilled —
-        their null-semantics can couple buckets.  `holder` carries the
-        sole references to the inputs so their device arrays free once
-        both sides are spilled."""
-        left, right = holder
-        holder.clear()
-        nparts = int(self.session.properties.get("spill_partition_count", 8))
-        lkeys = [left.columns[lk] for lk, _ in node.criteria]
-        rkeys = [right.columns[rk] for _, rk in node.criteria]
-        lkeys, rkeys = _unify_key_dictionaries(lkeys, rkeys)
-        lpart = np.asarray(K._hash_keys(lkeys, left.sel)) % nparts
-        rpart = np.asarray(K._hash_keys(rkeys, right.sel)) % nparts
-        spiller = self._make_spiller()
-        try:
-            lh = self._partition_spill(left, lpart, spiller, nparts)
-            rh = self._partition_spill(right, rpart, spiller, nparts)
-            self._record_spill(spiller)
-            # last references: inputs (and unified key copies) free now;
-            # table-scan columns stay alive in the catalog cache by design
-            del left, right, lkeys, rkeys
-            load, store, bucket_done, finish = self._grouped_recovery(nparts)
-            outs = []
-            for p in range(nparts):
-                cached = load(p)
-                if cached is None:
-                    lb = spiller.unspill(lh[p])
-                    rb = spiller.unspill(rh[p])
-                    cached = K.compact(self._join_batches(lb, rb, node))
-                    store(p, cached)
-                outs.append(cached)
-                bucket_done()
-            finish()
-            return K.concat_batches(outs)
-        finally:
-            spiller.close()
-
-    def _aggregate_grouped(self, node: P.Aggregate, holder: list) -> Batch:
-        """Bucket-at-a-time aggregation (P8 Lifespan analog): partition
-        by group-key hash, aggregate each bucket independently, concat —
-        groups never span buckets so no merge step is needed (reference:
-        SpillableHashAggregationBuilder's partition-merge, simplified by
-        hash-disjointness).  `holder` carries the sole reference to the
-        input batch so its device arrays free once spilled."""
-        b = holder.pop()
-        nparts = int(self.session.properties.get("spill_partition_count", 8))
-        spiller = self._make_spiller()
-        try:
-            part = np.asarray(K._hash_keys(
-                [b.columns[k] for k in node.group_keys], b.sel)) % nparts
-            handles = self._partition_spill(b, part, spiller, nparts)
-            self._record_spill(spiller)
-            del b  # last reference: device input frees; buckets stream back
-            load, store, bucket_done, finish = self._grouped_recovery(nparts)
-            outs = []
-            for p, h in enumerate(handles):
-                cached = load(p)
-                if cached is None:
-                    pb = spiller.unspill(h)
-                    cached = K.compact(
-                        self._aggregate(pb, node.group_keys, node.aggs, node))
-                    store(p, cached)
-                outs.append(cached)
-                bucket_done()
-            finish()
-            return K.concat_batches(outs)
-        finally:
-            spiller.close()
 
     def _exec_aggregate_with_distinct(self, node: P.Aggregate, b: Batch) -> Batch:
         """Rewrite: pre-group by (keys + distinct arg) then count non-null
@@ -3012,14 +2945,44 @@ class Executor:
             node = P.Join(node.right, node.left, "LEFT",
                           [(rk, lk) for lk, rk in node.criteria], node.filter)
             left, right = right, left
-        # join build+probe state is ~2x the inputs in the worst case
-        if (node.join_type in ("INNER", "LEFT", "FULL") and node.criteria
-                and self._should_spill(
-                    2 * (batch_bytes(left) + batch_bytes(right)),
-                    left.capacity + right.capacity)):
-            holder = [left, right]
-            del left, right  # holder owns the refs; grace path frees them
-            return self._join_grouped(holder, node)
+        # spill-tiered degradation (exec/spill_exec.py): correct for
+        # INNER/LEFT/FULL equi-joins — every match pair lands in one
+        # key-hash partition and unmatched rows surface exactly once.
+        # SEMI/ANTI stay unspilled: their null-semantics couple
+        # partitions.  The PR-5 dynamic filter above already pruned the
+        # probe sel, and the live_est_fn re-probe lets a filter-shrunken
+        # probe keep the join fully resident (compacted) — the
+        # interaction the robust-HHJ paper highlights.
+        if node.join_type in ("INNER", "LEFT", "FULL") and node.criteria \
+                and not self.static:
+            from presto_tpu.exec import spill_exec as SE
+
+            def live_est():
+                nl = int(jax.device_get(left.row_count()))
+                nr = int(jax.device_get(right.row_count()))
+                bl = batch_bytes(left) * nl / max(left.capacity, 1)
+                br = batch_bytes(right) * nr / max(right.capacity, 1)
+                return SE.WORKING_SET_FACTOR * (bl + br)
+
+            dec = SE.plan_degradation(
+                self, node,
+                SE.WORKING_SET_FACTOR * (batch_bytes(left)
+                                         + batch_bytes(right)),
+                left.capacity + right.capacity, live_est_fn=live_est)
+            if dec.degrade:
+                holder = [left, right]
+                del left, right  # holder owns the refs; spill path frees
+                return SE.hybrid_join(self, holder, node, dec)
+            if dec.mem_key:
+                try:
+                    if dec.budget == -1:
+                        # filter-kept residency: shed the pruned rows so
+                        # the live working set is what HBM actually holds
+                        left = K.compact(left)
+                        right = K.compact(right)
+                    return self._join_batches(left, right, node)
+                finally:
+                    self.mem.set_bytes(dec.mem_key, 0)
         out = self._join_batches(left, right, node)
         if node.join_type in ("SEMI", "ANTI", "MARK"):
             # probe masked in place: row positions untouched

@@ -1257,6 +1257,25 @@ def sort_values(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.sort(x)
 
 
+def spill_partition_ids(cols: List[Column], sel, nparts: int,
+                        level: int = 0) -> np.ndarray:
+    """Partition id per row for spill-tiered execution (exec/spill_exec.py)
+    — the same splitmix64 mixing family as rf_* and write_bucket_ids, so a
+    bucket-aligned dynamic filter, an engine-written layout, and a spill
+    partition agree on which keys co-locate.  `level` salts the mix for
+    recursive re-partitioning: rows of one level-N partition share a
+    residue of the level-N mix, so an unsalted re-partition could never
+    split them — a remix with a different salt decorrelates the levels.
+    Host numpy out (the spill fan-out masks host-side); dead rows get an
+    arbitrary id (they are dropped by the per-partition sel mask)."""
+    key = _hash_keys(cols, sel)
+    z = key.astype(jnp.uint64)
+    if level:
+        z = _rf_mix64(z + jnp.uint64(level))
+    p = (z % jnp.uint64(max(int(nparts), 1))).astype(jnp.int32)
+    return np.asarray(jax.device_get(p))
+
+
 # ---------------------------------------------------------------------------
 # write-path layout kernels (exec/writer.py): bucket assignment shares
 # the splitmix64 mixing with the runtime-filter family above, so a

@@ -1,0 +1,475 @@
+"""Spill-tiered execution: memory-pressure-driven graceful degradation
+for hash joins and grouped aggregation.
+
+The blueprint is *Design Trade-offs for a Robust Dynamic Hybrid Hash
+Join* (PAPERS.md): an operator whose state outgrows HBM must degrade in
+steps, never fall off a cliff.  The tier model (docs/SPILL.md):
+
+  tier 0 — resident: the whole working set fits; the normal
+           build_probe / sort-grouping path runs, nothing here engages.
+  tier 1 — partial spill (hybrid): both inputs are partitioned by the
+           splitmix64 mixing family (kernels.spill_partition_ids — the
+           same family as the rf_* runtime filters and write buckets).
+           Partitions whose combined working set fits the budget stay
+           ON-CHIP and run through the normal join/aggregation path in
+           ONE pass; cold partitions spill to disk as checksummed PTPG
+           frames (memory/spill.FileSpiller) and stream back one at a
+           time.
+  tier 2 — recursive partitioning: a spilled partition that STILL does
+           not fit re-partitions with a level-salted remix (the unsalted
+           hash could never split rows sharing a level-N residue) and
+           recurses, to a bounded depth.  Past the bound the query fails
+           LOUDLY (SpillRecursionError) — a hot-key partition that
+           cannot split must never silently blow the budget.
+
+Join correctness: partitioning is on the equi-join key hash, so every
+match pair lands in one partition and unmatched (LEFT/FULL) rows
+surface exactly once, in their own partition.  Aggregation correctness:
+groups never span partitions, so per-partition re-aggregation on
+unspill is mergeable by construction — the concat IS the merge.
+
+Ordering with dynamic filtering (the interaction the paper highlights):
+`Executor._exec_join` runs the PR-5 build-side filter BEFORE calling
+into this module, and `plan_degradation` re-probes the LIVE row estimate
+when the capacity estimate trips — a probe the filter shrank enough is
+compacted and kept fully resident instead of spilled
+(recovery counter `spill_df_resident`).
+
+Memory handshake (memory/context.py): the operator first declares its
+estimated state as a REVOCABLE reservation.  If the pool refuses it, or
+the query limit could not absorb its conversion, the reservation is
+revoked — that revocation IS the degradation trigger.  Otherwise it
+converts to a regular reservation and the operator stays resident with
+its state accounted.
+
+Everything here is deterministic and chaos-testable: the
+`PRESTO_TPU_FORCE_SPILL` env / `force_spill` session property forces
+each tier regardless of memory, `spill_threshold_bytes` forces it by
+size, and the spill-I/O fault kinds in parallel/faults.py (truncate /
+corrupt / enospc) must surface as typed failures or transparent
+re-spills — never wrong results.
+
+No file I/O lives here: the spiller (memory/spill.py) owns every byte
+that touches disk (tests/test_lint.py enforces).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch
+from presto_tpu.exec import kernels as K
+from presto_tpu.memory.context import ExceededMemoryLimitError, batch_bytes
+from presto_tpu.memory.spill import SpillError, SpillSpaceExhausted
+
+TIER_RESIDENT = 0
+TIER_PARTIAL = 1
+TIER_RECURSIVE = 2
+
+#: worst-case working-set multiplier over the input bytes (sort
+#: scratch + packed keys + gathered output), shared with the trigger
+#: estimates in Executor._exec_join/_exec_aggregate
+WORKING_SET_FACTOR = 2
+
+_FORCE_ENV = "PRESTO_TPU_FORCE_SPILL"
+_MAX_PARTS = 64  # fan-out ceiling per partitioning pass
+
+
+class SpillRecursionError(SpillError):
+    """A partition still exceeded its budget at the recursion bound —
+    typically a single hot key that no re-partitioning can split.  The
+    loud alternative to silently blowing HBM."""
+
+
+@dataclasses.dataclass
+class Degradation:
+    """One operator's degradation decision (plan_degradation)."""
+
+    degrade: bool
+    budget: int = 0        # resident working-set byte budget (0 = spill all)
+    nparts: int = 0
+    max_depth: int = 3     # bounded recursion (re-partition levels)
+    forced: str = ""       # "" | "partial" | "recursive"
+    mem_key: int = 0       # converted revocable reservation to release
+
+
+def force_mode(session) -> str:
+    """The deterministic tier-forcing knob: env PRESTO_TPU_FORCE_SPILL
+    outranks the `force_spill` session property; values `partial` /
+    `recursive` force that tier, anything else means memory-driven."""
+    mode = os.environ.get(_FORCE_ENV, "") \
+        or str(session.properties.get("force_spill", "") or "")
+    return mode.strip().lower()
+
+
+def routing_enabled(session) -> bool:
+    """True when spill degradation can engage WITHOUT a memory context —
+    the deterministic knobs.  The chunked runner uses this to route
+    run-once join/aggregate fragments through the dynamic (spillable)
+    executor instead of the static trace."""
+    if not session.properties.get("spill_enabled", True):
+        return False
+    return force_mode(session) in ("partial", "recursive") \
+        or int(session.properties.get("spill_threshold_bytes", 0)) > 0 \
+        or int(session.properties.get("spill_trigger_rows", 0)) > 0
+
+
+def plan_degradation(ex, node, est_bytes: int, capacity: int,
+                     live_est_fn=None) -> Degradation:
+    """Decide the operator's tier BEFORE it builds state.
+
+    Order of authority: static mode / kill switch -> forced tier ->
+    size threshold -> row trigger -> the revocable-memory handshake.
+    `live_est_fn` (optional, host-syncing) re-estimates from LIVE rows
+    when the capacity estimate trips — the dynamic-filter interaction:
+    a filter-shrunken probe whose live bytes fit stays resident."""
+    session = ex.session
+    if ex.static or not session.properties.get("spill_enabled", True):
+        return Degradation(False)
+    nparts = int(session.properties.get("spill_partition_count", 8))
+    max_depth = int(session.properties.get("spill_max_recursion_depth", 3))
+
+    mode = force_mode(session)
+    if mode in ("partial", "recursive"):
+        budget = est_bytes // 2 if mode == "partial" else 0
+        return Degradation(True, budget, nparts, max_depth, forced=mode)
+
+    threshold = int(session.properties.get("spill_threshold_bytes", 0))
+    trigger = int(session.properties.get("spill_trigger_rows", 0))
+    mem = ex.mem
+
+    degrade = False
+    budget = 0
+    mem_key = 0
+    if threshold and est_bytes > threshold:
+        degrade, budget = True, threshold
+    elif trigger and capacity >= trigger:
+        degrade, budget = True, 0  # classic Grace: every partition spills
+    elif mem is not None:
+        key = -id(node)  # operator-STATE ledger; the output ledger
+        # (set_bytes in _exec_node_inner) keys on +id(node)
+        pressure = not mem.set_revocable(key, est_bytes) \
+            or mem.would_exceed(est_bytes)
+        if not pressure:
+            try:
+                mem.convert_revocable(key)
+                return Degradation(False, mem_key=key)
+            except ExceededMemoryLimitError:
+                pressure = True
+        if pressure:
+            mem.revoke(key)
+            if live_est_fn is not None:
+                # dynamic-filter interaction: the capacity estimate counts
+                # filter-pruned rows; if the LIVE working set fits, the
+                # caller compacts and stays resident (tier 0)
+                live_est = int(live_est_fn())
+                if live_est < est_bytes and not mem.would_exceed(live_est) \
+                        and mem.set_revocable(key, live_est):
+                    try:
+                        mem.convert_revocable(key)
+                        _count(ex, "spill_df_resident")
+                        return Degradation(False, mem_key=key,
+                                           budget=-1)  # -1: compact inputs
+                    except ExceededMemoryLimitError:
+                        mem.revoke(key)
+            degrade, budget = True, mem.headroom()
+    if not degrade:
+        return Degradation(False)
+    # planner-stats gating of the fan-out: size nparts so ONE
+    # partitioning pass normally suffices (est/nparts fits the budget)
+    # instead of discovering the recursion tier the hard way
+    if budget > 0:
+        while nparts < _MAX_PARTS and est_bytes / nparts > budget:
+            nparts *= 2
+    return Degradation(True, budget, nparts, max_depth, mem_key=mem_key)
+
+
+# ---------------------------------------------------------------------------
+# counters: routed through the executor's sort_stats dict (the same
+# funnel the sort/df economics use), merged into QueryStats by
+# executor._merge_sort_stats — which works for the chunked runner's
+# fragment executors too, where no QueryMonitor is in scope
+# ---------------------------------------------------------------------------
+
+
+def _count(ex, key: str, n: int = 1) -> None:
+    ex.sort_stats[key] = ex.sort_stats.get(key, 0) + n
+
+
+def _note_tier(ex, tier: int) -> None:
+    ex.sort_stats["degradation_tier"] = max(
+        ex.sort_stats.get("degradation_tier", 0), tier)
+
+
+# ---------------------------------------------------------------------------
+# partition planning
+# ---------------------------------------------------------------------------
+
+
+def _partition_bytes(b: Batch, part: np.ndarray, nparts: int) -> np.ndarray:
+    """Estimated LIVE bytes per partition: live row count per partition
+    (host bincount over the already-host partition ids) times the
+    batch's bytes-per-row."""
+    sel = np.asarray(b.sel)
+    live = np.bincount(part[sel], minlength=nparts).astype(np.float64)
+    bpr = batch_bytes(b) / max(b.capacity, 1)
+    return live * bpr
+
+
+def _choose_resident(combined: np.ndarray, dec: Degradation) -> set:
+    """Pick the resident partition set: smallest-first while the
+    cumulative working set fits the budget (the hybrid in hybrid hash
+    join).  Forced modes are deterministic regardless of memory:
+    `partial` keeps the smaller half resident, `recursive` spills all."""
+    nparts = len(combined)
+    if dec.forced == "partial":
+        order = np.argsort(combined, kind="stable")
+        return set(int(p) for p in order[:max(nparts // 2, 1)])
+    if dec.forced == "recursive" or dec.budget <= 0:
+        return set()
+    resident: set = set()
+    cum = 0.0
+    for p in np.argsort(combined, kind="stable"):
+        if WORKING_SET_FACTOR * (cum + combined[p]) > dec.budget:
+            break
+        resident.add(int(p))
+        cum += combined[p]
+    return resident
+
+
+def _needs_recurse(dec: Degradation, level: int, est: int) -> bool:
+    if dec.forced == "recursive":
+        return level == 1  # exactly one deterministic re-partition round
+    if dec.forced == "partial" or dec.budget <= 0:
+        return False
+    return est > dec.budget
+
+
+def _check_depth(dec: Degradation, level: int) -> None:
+    if level > dec.max_depth:
+        raise SpillRecursionError(
+            f"spill partition still exceeds the {dec.budget / 1e6:.1f}MB "
+            f"budget after {dec.max_depth} recursive re-partitions "
+            "(hot key that cannot split?); raise "
+            "spill_max_recursion_depth or query_max_memory_bytes")
+
+
+def _mask_part(b: Batch, part: np.ndarray, keep) -> Batch:
+    return b.with_sel(b.sel & jnp.asarray(np.isin(part, keep)))
+
+
+def _spill_parts(ex, spiller, b: Batch, part: np.ndarray,
+                 cold: List[int]) -> Dict[int, str]:
+    handles = {}
+    for p in cold:
+        handles[p] = spiller.spill(_mask_part(b, part, [p]))
+    _count(ex, "spill_partitions", len(cold))
+    return handles
+
+
+def _restore(ex, spiller, handle: str) -> Batch:
+    _count(ex, "spill_restores")
+    return spiller.unspill(handle)
+
+
+def _fold_spiller(ex, spiller) -> None:
+    """Fold one spiller's written bytes + transparent rewrites into the
+    counters once its files are accounted."""
+    _count(ex, "spill_bytes", sum(s for _, s in spiller.files))
+    if spiller.rewrites:
+        _count(ex, "spill_rewrites", spiller.rewrites)
+
+
+def _count_enospc(ex) -> None:
+    _count(ex, "spill_enospc")
+
+
+# ---------------------------------------------------------------------------
+# hybrid hash join
+# ---------------------------------------------------------------------------
+
+
+def hybrid_join(ex, holder: list, node, dec: Degradation) -> Batch:
+    """Partition-wise hybrid hash join (tiers 1-2).  `holder` carries
+    the sole references to both inputs so their device arrays free as
+    soon as the cold partitions are spilled and the resident slice is
+    compacted."""
+    from presto_tpu.exec.executor import _unify_key_dictionaries
+
+    left, right = holder
+    holder.clear()
+    lkeys = [left.columns[lk] for lk, _ in node.criteria]
+    rkeys = [right.columns[rk] for _, rk in node.criteria]
+    lkeys, rkeys = _unify_key_dictionaries(lkeys, rkeys)
+    lpart = K.spill_partition_ids(lkeys, left.sel, dec.nparts)
+    rpart = K.spill_partition_ids(rkeys, right.sel, dec.nparts)
+    combined = _partition_bytes(left, lpart, dec.nparts) \
+        + _partition_bytes(right, rpart, dec.nparts)
+    resident = _choose_resident(combined, dec)
+    cold = [p for p in range(dec.nparts) if p not in resident]
+    if cold:
+        _note_tier(ex, TIER_PARTIAL)
+    # else: the per-partition replan found everything fits (the capacity
+    # estimate was pessimistic) — effectively tier 0, nothing spills
+    spiller = ex._make_spiller()
+    try:
+        try:
+            lh = _spill_parts(ex, spiller, left, lpart, cold)
+            rh = _spill_parts(ex, spiller, right, rpart, cold)
+        except SpillSpaceExhausted:
+            _count_enospc(ex)
+            raise
+        _fold_spiller(ex, spiller)
+        outs = []
+        if resident:
+            keep = sorted(resident)
+            lres = K.compact(_mask_part(left, lpart, keep))
+            rres = K.compact(_mask_part(right, rpart, keep))
+            del left, right, lkeys, rkeys  # cold copies live on disk now
+            # the whole resident set joins in ONE normal build_probe
+            # pass: partitions are key-disjoint, so the union of
+            # per-partition joins IS the join of the union
+            outs.append(K.compact(ex._join_batches(lres, rres, node)))
+            del lres, rres
+        else:
+            del left, right, lkeys, rkeys
+        load, store, bucket_done, finish = ex._grouped_recovery(dec.nparts)
+        for p in cold:
+            cached = load(p)
+            if cached is None:
+                lb = _restore(ex, spiller, lh[p])
+                rb = _restore(ex, spiller, rh[p])
+                cached = _join_partition(ex, node, lb, rb, dec, level=1)
+                store(p, cached)
+            outs.append(cached)
+            bucket_done()
+        finish()
+        return K.concat_batches(outs)
+    finally:
+        spiller.close()
+
+
+def _join_partition(ex, node, lb: Batch, rb: Batch, dec: Degradation,
+                    level: int) -> Batch:
+    """Process one unspilled partition pair: join it if it fits,
+    recursively re-partition (level-salted) if it does not."""
+    from presto_tpu.exec.executor import _unify_key_dictionaries
+
+    est = WORKING_SET_FACTOR * (batch_bytes(lb) + batch_bytes(rb))
+    if not _needs_recurse(dec, level, est):
+        return K.compact(ex._join_batches(lb, rb, node))
+    _check_depth(dec, level)
+    _note_tier(ex, TIER_RECURSIVE)
+    _count(ex, "spill_recursions")
+    lkeys = [lb.columns[lk] for lk, _ in node.criteria]
+    rkeys = [rb.columns[rk] for _, rk in node.criteria]
+    lkeys, rkeys = _unify_key_dictionaries(lkeys, rkeys)
+    lpart = K.spill_partition_ids(lkeys, lb.sel, dec.nparts, level=level)
+    rpart = K.spill_partition_ids(rkeys, rb.sel, dec.nparts, level=level)
+    spiller = ex._make_spiller()
+    try:
+        try:
+            lh = _spill_parts(ex, spiller, lb, lpart,
+                              list(range(dec.nparts)))
+            rh = _spill_parts(ex, spiller, rb, rpart,
+                              list(range(dec.nparts)))
+        except SpillSpaceExhausted:
+            _count_enospc(ex)
+            raise
+        _fold_spiller(ex, spiller)
+        del lb, rb, lkeys, rkeys, lpart, rpart
+        outs = []
+        for p in range(dec.nparts):
+            slb = _restore(ex, spiller, lh[p])
+            srb = _restore(ex, spiller, rh[p])
+            outs.append(_join_partition(ex, node, slb, srb, dec, level + 1))
+        return K.concat_batches(outs)
+    finally:
+        spiller.close()
+
+
+# ---------------------------------------------------------------------------
+# spill-tiered grouped aggregation
+# ---------------------------------------------------------------------------
+
+
+def hybrid_aggregate(ex, node, holder: list, dec: Degradation) -> Batch:
+    """Partition-wise tiered aggregation: partition by group-key hash,
+    aggregate the resident union in one pass, spill cold
+    group-partitions and re-aggregate each on unspill.  Groups are
+    partition-disjoint, so the concat IS the merge (*Partial Partial
+    Aggregates*' mergeable-by-construction property)."""
+    b = holder.pop()
+    part = K.spill_partition_ids([b.columns[k] for k in node.group_keys],
+                                 b.sel, dec.nparts)
+    pbytes = _partition_bytes(b, part, dec.nparts)
+    resident = _choose_resident(pbytes, dec)
+    cold = [p for p in range(dec.nparts) if p not in resident]
+    if cold:
+        _note_tier(ex, TIER_PARTIAL)
+    spiller = ex._make_spiller()
+    try:
+        try:
+            handles = _spill_parts(ex, spiller, b, part, cold)
+        except SpillSpaceExhausted:
+            _count_enospc(ex)
+            raise
+        _fold_spiller(ex, spiller)
+        outs = []
+        if resident:
+            bres = K.compact(_mask_part(b, part, sorted(resident)))
+            del b  # cold copies live on disk; resident slice compacted
+            outs.append(K.compact(
+                ex._aggregate(bres, node.group_keys, node.aggs, node)))
+            del bres
+        else:
+            del b
+        load, store, bucket_done, finish = ex._grouped_recovery(dec.nparts)
+        for p in cold:
+            cached = load(p)
+            if cached is None:
+                pb = _restore(ex, spiller, handles[p])
+                cached = _agg_partition(ex, node, pb, dec, level=1)
+                store(p, cached)
+            outs.append(cached)
+            bucket_done()
+        finish()
+        return K.concat_batches(outs)
+    finally:
+        spiller.close()
+
+
+def _agg_partition(ex, node, pb: Batch, dec: Degradation,
+                   level: int) -> Batch:
+    est = WORKING_SET_FACTOR * batch_bytes(pb)
+    if not _needs_recurse(dec, level, est):
+        return K.compact(
+            ex._aggregate(pb, node.group_keys, node.aggs, node))
+    _check_depth(dec, level)
+    _note_tier(ex, TIER_RECURSIVE)
+    _count(ex, "spill_recursions")
+    part = K.spill_partition_ids(
+        [pb.columns[k] for k in node.group_keys], pb.sel, dec.nparts,
+        level=level)
+    spiller = ex._make_spiller()
+    try:
+        try:
+            handles = _spill_parts(ex, spiller, pb, part,
+                                   list(range(dec.nparts)))
+        except SpillSpaceExhausted:
+            _count_enospc(ex)
+            raise
+        _fold_spiller(ex, spiller)
+        del pb, part
+        outs = []
+        for p in range(dec.nparts):
+            spb = _restore(ex, spiller, handles[p])
+            outs.append(_agg_partition(ex, node, spb, dec, level + 1))
+        return K.concat_batches(outs)
+    finally:
+        spiller.close()

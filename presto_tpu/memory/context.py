@@ -68,6 +68,14 @@ class QueryMemoryContext:
         self.by_node: Dict[int, int] = {}
         self.current = 0
         self.peak = 0
+        # revocable ledger (reference: the revocable half of
+        # AggregatedMemoryContext + MemoryRevokingScheduler): bytes an
+        # operator holds but can give back by spilling.  Reserved in the
+        # POOL (they are real HBM) but not against the query limit until
+        # converted — exactly the reference's accounting split.
+        self.revocable_by_node: Dict[int, int] = {}
+        self.revocable = 0
+        self.revocations = 0
 
     def set_bytes(self, node_id: int, bytes_: int) -> None:
         """Absolute reservation for one node (operators re-declare as
@@ -93,10 +101,75 @@ class QueryMemoryContext:
         BEFORE allocating (the MemoryRevokingScheduler threshold role)."""
         return self.current + extra_bytes > self.limit
 
+    def headroom(self) -> int:
+        """Bytes this query may still allocate before tripping its limit
+        — the resident budget the degradation planner hands to
+        exec/spill_exec (partitions whose working set fits stay
+        on-chip; the rest spill)."""
+        return max(self.limit - self.current, 0)
+
+    # ---- revocable reservations (spill-tiered operators) -------------
+    def set_revocable(self, node_id: int, bytes_: int) -> bool:
+        """Declare revocable operator state (a hash-join build, GROUP BY
+        accumulators).  Reserved in the pool, NOT counted against the
+        query limit — the operator promises it can revoke (spill) on
+        demand.  Returns False when the POOL cannot fit it: that is the
+        memory-pressure signal telling the caller to degrade instead of
+        building resident state."""
+        delta = bytes_ - self.revocable_by_node.get(node_id, 0)
+        if delta > 0:
+            try:
+                self.pool.reserve(self.query_id, delta)
+            except ExceededMemoryLimitError:
+                return False
+        elif delta < 0:
+            self.pool.free(self.query_id, -delta)
+        if bytes_ <= 0:
+            self.revocable_by_node.pop(node_id, None)
+        else:
+            self.revocable_by_node[node_id] = bytes_
+        self.revocable += delta
+        return True
+
+    def revoke(self, node_id: int) -> int:
+        """Release one node's revocable reservation (the operator is
+        spilling its state).  Returns the bytes revoked."""
+        amt = self.revocable_by_node.pop(node_id, 0)
+        if amt:
+            self.pool.free(self.query_id, amt)
+            self.revocable -= amt
+            self.revocations += 1
+        return amt
+
+    def convert_revocable(self, node_id: int) -> None:
+        """Promote a revocable reservation to a regular one — the
+        operator decided to stay resident, so its state now counts
+        against the query limit (reference: the revoke-or-convert choice
+        at HashBuilderOperator.finishMemoryRevoke).  Raises
+        ExceededMemoryLimitError when the limit cannot take it; the
+        revocable reservation is left intact so the caller can revoke()
+        and degrade."""
+        amt = self.revocable_by_node.get(node_id, 0)
+        if not amt:
+            return
+        if self.current + amt > self.limit:
+            raise ExceededMemoryLimitError(
+                f"query {self.query_id} cannot convert {amt / 1e6:.1f}MB "
+                f"revocable: {(self.current + amt) / 1e6:.1f}MB > "
+                f"{self.limit / 1e6:.1f}MB")
+        # pool reservation carries over unchanged; only the ledger moves
+        self.revocable_by_node.pop(node_id)
+        self.revocable -= amt
+        self.by_node[node_id] = self.by_node.get(node_id, 0) + amt
+        self.current += amt
+        self.peak = max(self.peak, self.current)
+
     def release_all(self) -> None:
-        self.pool.free(self.query_id, self.current)
+        self.pool.free(self.query_id, self.current + self.revocable)
         self.by_node.clear()
+        self.revocable_by_node.clear()
         self.current = 0
+        self.revocable = 0
 
 
 def batch_bytes(batch) -> int:

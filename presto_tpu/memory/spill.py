@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 import uuid
 from typing import Dict, List, Optional, Tuple
 
@@ -28,23 +29,38 @@ class SpillError(Exception):
     pass
 
 
+class SpillSpaceExhausted(SpillError):
+    """Typed ENOSPC: the node-wide spill-disk bound (`max_spill_bytes`)
+    cannot take another frame.  The query FAILS with this error — after
+    releasing every reservation it holds (the spiller's close() frees
+    its files' bytes; a refused frame is deleted before the raise) — so
+    concurrent queries sharing the tracker keep their full budget."""
+
+
 class SpillSpaceTracker:
     """Bounds total spill bytes on disk (reference:
-    spiller/SpillSpaceTracker.java, max-spill-per-node)."""
+    spiller/SpillSpaceTracker.java, max-spill-per-node).  Thread-safe:
+    concurrent server queries share one tracker per session, and a
+    reserve racing a release must never lose bytes in either
+    direction."""
 
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
         self.used = 0
+        self._lock = threading.Lock()
 
     def reserve(self, bytes_: int) -> None:
-        if self.used + bytes_ > self.max_bytes:
-            raise SpillError(
-                f"spill space exhausted: {(self.used + bytes_) / 1e6:.1f}MB "
-                f"> {self.max_bytes / 1e6:.1f}MB")
-        self.used += bytes_
+        with self._lock:
+            if self.used + bytes_ > self.max_bytes:
+                raise SpillSpaceExhausted(
+                    f"spill space exhausted: "
+                    f"{(self.used + bytes_) / 1e6:.1f}MB "
+                    f"> {self.max_bytes / 1e6:.1f}MB")
+            self.used += bytes_
 
     def free(self, bytes_: int) -> None:
-        self.used = max(0, self.used - bytes_)
+        with self._lock:
+            self.used = max(0, self.used - bytes_)
 
 
 class SpillCipher:
@@ -74,22 +90,32 @@ class SpillCipher:
 class FileSpiller:
     """Spills Batches to PTPG files and reads them back (reference:
     FileSingleStreamSpiller); pass a SpillCipher to encrypt files at rest
-    (spill_encryption session property)."""
+    (spill_encryption session property).
+
+    Integrity contract: every spill frame is written CHECKSUMMED, and
+    every unspill verifies the checksum with the declared-encoding check
+    (`require_checksum` — a frame whose flags byte lost the CHECKSUMMED
+    bit is itself corrupt, not exempt).  Any damage surfaces as a typed
+    `SpillError`, never as silently-wrong rows.  `verify_writes=True`
+    additionally reads each frame back right after writing and RE-SPILLS
+    once on mismatch (`rewrites` counts them) — turning a write-path
+    corruption into a transparent recovery instead of a failed query."""
 
     def __init__(self, directory: str,
                  tracker: Optional[SpillSpaceTracker] = None,
-                 cipher: Optional[SpillCipher] = None):
+                 cipher: Optional[SpillCipher] = None,
+                 verify_writes: bool = False):
         self.dir = directory
         self.tracker = tracker
         self.cipher = cipher
+        self.verify_writes = verify_writes
+        self.rewrites = 0
         self.files: List[Tuple[str, int]] = []
         self._meta: Dict[str, dict] = {}
         os.makedirs(directory, exist_ok=True)
 
     def spill(self, batch: Batch) -> str:
         """Write a compacted host copy of the batch; returns a handle."""
-        import io
-
         arrays: Dict[str, np.ndarray] = {}
         meta: Dict[str, tuple] = {}
         sel = np.asarray(batch.sel)
@@ -100,14 +126,17 @@ class FileSpiller:
                 arrays[f"v_{name}"] = np.asarray(c.valid)[sel]
             meta[name] = (c.type, c.dictionary)
         path = os.path.join(self.dir, f"spill_{uuid.uuid4().hex}.ptpg")
-        if self.cipher is not None:
-            buf = io.BytesIO()
-            serde.write_stream(buf, arrays)
-            with open(path, "wb") as f:
-                f.write(self.cipher.encrypt(buf.getvalue()))
-        else:
-            with open(path, "wb") as f:
-                serde.write_stream(f, arrays)
+        self._write_file(path, arrays)
+        if self.verify_writes:
+            try:
+                self._read_file(path)
+            except SpillError:
+                # transparent re-spill: the data is still in memory, so a
+                # damaged write heals here instead of failing the query
+                # at unspill time (chaos: faults `corrupt`/`truncate`)
+                self.rewrites += 1
+                self._write_file(path, arrays)
+                self._read_file(path)  # second damage = real disk trouble
         size = os.path.getsize(path)
         if self.tracker is not None:
             try:
@@ -119,17 +148,49 @@ class FileSpiller:
         self._meta[path] = meta
         return path
 
-    def unspill(self, handle: str) -> Batch:
+    def _write_file(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
         import io
 
-        meta = self._meta[handle]
+        from presto_tpu.parallel import faults as F
+
+        rule = F.apply_spill("WRITE", path)
+        if rule is not None and rule.action == "enospc":
+            raise SpillSpaceExhausted(
+                "injected fault: spill device out of space")
         if self.cipher is not None:
-            with open(handle, "rb") as f:
-                z = serde.read_stream(
-                    io.BytesIO(self.cipher.decrypt(f.read())))
+            buf = io.BytesIO()
+            serde.write_stream(buf, arrays)
+            with open(path, "wb") as f:
+                f.write(self.cipher.encrypt(buf.getvalue()))
         else:
+            with open(path, "wb") as f:
+                serde.write_stream(f, arrays)
+        if rule is not None and rule.action in ("truncate", "corrupt"):
+            F.damage_spill_file(path, rule.action)
+
+    def _read_file(self, handle: str) -> Dict[str, np.ndarray]:
+        """Read + verify one spill file; every failure mode (truncation,
+        checksum mismatch, a stripped CHECKSUMMED flag, a cipher left
+        half-decrypted) maps to the one typed SpillError the executor's
+        chaos contract is built on."""
+        import io
+
+        try:
+            if self.cipher is not None:
+                with open(handle, "rb") as f:
+                    return serde.read_stream(
+                        io.BytesIO(self.cipher.decrypt(f.read())),
+                        require_checksum=True)
             with open(handle, "rb") as f:
-                z = serde.read_stream(f)
+                return serde.read_stream(f, require_checksum=True)
+        except SpillError:
+            raise
+        except (ValueError, OSError) as e:
+            raise SpillError(f"corrupt spill frame {handle}: {e}") from e
+
+    def unspill(self, handle: str) -> Batch:
+        meta = self._meta[handle]
+        z = self._read_file(handle)
         cols = {}
         n = 0
         for name, (typ, dictionary) in meta.items():

@@ -84,9 +84,12 @@ def write_stream(f, arrays: Dict[str, np.ndarray], compress: bool = True) -> int
     return total
 
 
-def read_stream(f) -> Dict[str, np.ndarray]:
+def read_stream(f, require_checksum: bool = False) -> Dict[str, np.ndarray]:
     """Read back a write_stream file: concatenation of length-prefixed
-    frames until EOF."""
+    frames until EOF.  `require_checksum=True` is the declared-encoding
+    check for frames the WRITER always checksums (spill files): a frame
+    whose CHECKSUMMED flag went missing is itself evidence of corruption
+    and must fail, not silently skip verification."""
     out: Dict[str, np.ndarray] = {}
     while True:
         header = f.read(8)
@@ -98,7 +101,8 @@ def read_stream(f) -> Dict[str, np.ndarray]:
         frame = f.read(flen)
         if len(frame) != flen:
             raise ValueError("truncated PTPG stream")
-        out.update(deserialize_columns(frame))
+        out.update(deserialize_columns(frame,
+                                       require_checksum=require_checksum))
 
 
 def frame_ok(buf: bytes) -> bool:
@@ -115,13 +119,19 @@ def frame_ok(buf: bytes) -> bool:
     return True
 
 
-def deserialize_columns(buf: bytes) -> Dict[str, np.ndarray]:
+def deserialize_columns(buf: bytes,
+                        require_checksum: bool = False) -> Dict[str, np.ndarray]:
     if len(buf) < 24 or buf[:4] != MAGIC:
         raise ValueError("not a PTPG frame")
     body, (csum,) = buf[:-8], struct.unpack("<Q", buf[-8:])
     _, version, flags, ncols, nrows = struct.unpack("<4sBBHQ", body[:16])
     if version != VERSION:
         raise ValueError(f"unsupported PTPG version {version}")
+    if require_checksum and not flags & FLAG_CHECKSUM:
+        # magic-gated validation is not enough: a corrupted flags byte
+        # with an intact magic would otherwise skip verification entirely
+        raise ValueError("PTPG frame lost its CHECKSUMMED flag "
+                         "(declared-encoding mismatch; corrupt frame)")
     if flags & FLAG_CHECKSUM and native.xxh64(body) != csum:
         raise ValueError("PTPG checksum mismatch (corrupt page)")
     o = 16

@@ -53,6 +53,19 @@ class QueryStats:
     spilled_bytes: int = 0
     spilled_partitions: int = 0
     recovered_buckets: int = 0  # grouped-execution buckets loaded from ckpt
+    # spill-tiered degradation (exec/spill_exec.py, docs/SPILL.md):
+    # partitions spilled as checksummed PTPG frames, bytes written,
+    # partitions restored (unspilled), recursive re-partition rounds,
+    # and the query's deepest tier engaged (0 resident / 1 partial
+    # spill / 2 recursive partitioning — a high-water mark, not a sum).
+    # spilled_bytes/spilled_partitions above stay as legacy aliases.
+    # Spill-I/O recovery events (spill_enospc, spill_rewrites,
+    # spill_df_resident) ride the `recovery` dict below.
+    spill_partitions: int = 0
+    spill_bytes: int = 0
+    spill_restores: int = 0
+    spill_recursions: int = 0
+    degradation_tier: int = 0
     # sort economics (ordering-aware execution, plan/properties.py):
     # sorts the executor routed (taken) vs avoided (elided: presorted
     # kernel variants, memo replays, satisfied ORDER BYs), memo replays

@@ -21,14 +21,21 @@ Choke points:
 - `exec` — `WorkerServer.submit`'s task thread, before the fragment
   runs (`delay` = straggler, `fail` = task FAILED, `crash` = the worker
   dies mid-wave).
+- `spill` — `memory/spill.FileSpiller` around each spill-file write
+  (method `WRITE`, path = the spill file path): `truncate` cuts the
+  written frame in half, `corrupt` destroys bytes mid-frame while
+  leaving the magic intact (the checksum must still catch it), and
+  `enospc` makes the write fail as if `SpillSpaceTracker` hit its
+  bound.  Every spill fault must surface as a clean typed failure or a
+  transparent re-spill (spill_verify_writes) — never wrong results.
 
 Grammar (env `PRESTO_TPU_FAULTS`, inherited by worker subprocesses, or
 programmatic via `FaultPlan(...)` / `install(...)`):
 
     rule[;rule...]          rule = where:method:path:nth:action[:arg]
 
-    where  = client | server | exec
-    method = GET | POST | DELETE | EXEC | PAGE | * (any); PAGE is the
+    where  = client | server | exec | spill
+    method = GET | POST | DELETE | EXEC | PAGE | WRITE | * (any); PAGE is the
              client-side delivered-page pseudo-method — its nth counts
              200-with-body results responses, so a `partial` rule
              corrupts exactly the nth delivered page
@@ -37,6 +44,7 @@ programmatic via `FaultPlan(...)` / `install(...)`):
     nth    = fire on the nth match, 1-based; append '+' to keep firing
              on every later match too (e.g. '3+')
     action = delay | http500 | reset | drop | partial | fail | crash
+             | truncate | corrupt | enospc   (spill choke point only)
     arg    = seconds for delay, probability for any action via 'p0.5'
              suffix is NOT supported in the compact form — use JSON
 
@@ -61,7 +69,8 @@ from typing import List, Optional
 from presto_tpu.parallel import retry as R
 
 _FAULTS_ENV = "PRESTO_TPU_FAULTS"
-_ACTIONS = ("delay", "http500", "reset", "drop", "partial", "fail", "crash")
+_ACTIONS = ("delay", "http500", "reset", "drop", "partial", "fail", "crash",
+            "truncate", "corrupt", "enospc")
 
 
 @dataclasses.dataclass
@@ -202,6 +211,37 @@ def corrupt_page(body: bytes) -> bytes:
         return body
     half = len(body) // 2
     return body[:half] + b"\x00" * (len(body) - half)
+
+
+def apply_spill(method: str, path: str) -> Optional[FaultRule]:
+    """Spill choke point (memory/spill.FileSpiller, around each spill
+    file write).  Pure match — the SPILLER interprets the rule (it owns
+    the file and the typed error), keeping this module free of spill
+    imports: `enospc` raises the spiller's typed space error BEFORE the
+    write; `truncate`/`corrupt` damage the file AFTER it (see
+    damage_spill_file)."""
+    return client_plan().match("spill", method, path)
+
+
+def damage_spill_file(path: str, action: str) -> None:
+    """Apply a `truncate`/`corrupt` spill fault to a written file.
+    truncate: cut the file in half (the reader's length-prefixed frame
+    walk hits a short read).  corrupt: destroy bytes mid-frame while
+    leaving the 8-byte length prefix AND the PTPG magic intact — the
+    scenario where only the checksum (declared-encoding verified) stands
+    between the engine and wrong results."""
+    size = os.path.getsize(path)
+    if action == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        return
+    if action == "corrupt" and size > 16:
+        pos = max(16, size // 2)
+        with open(path, "r+b") as f:
+            f.seek(pos)
+            tail = f.read(min(64, size - pos))
+            f.seek(pos)
+            f.write(bytes(b ^ 0xFF for b in tail))
 
 
 def apply_server(rule: FaultRule, handler, server) -> bool:

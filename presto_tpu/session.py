@@ -162,6 +162,19 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # memory probe (0 = memory-driven only); the deterministic test knob,
     # like the reference's tiny operator-memory configs in spill tests
     "spill_trigger_rows": 0,
+    # spill-tiered degradation (exec/spill_exec.py, docs/SPILL.md):
+    # force hybrid spilling when an operator's estimated state exceeds
+    # this many bytes (0 = memory-context-driven), force a specific tier
+    # deterministically ("partial" | "recursive"; env
+    # PRESTO_TPU_FORCE_SPILL outranks), bound the recursive
+    # re-partitioning depth (past it the query fails LOUDLY with
+    # SpillRecursionError), and optionally read each spill frame back
+    # right after writing so write-path corruption heals by a
+    # transparent re-spill instead of failing the query at unspill
+    "spill_threshold_bytes": 0,
+    "force_spill": "",
+    "spill_max_recursion_depth": 3,
+    "spill_verify_writes": False,
 }
 
 

@@ -249,6 +249,61 @@ def test_no_adhoc_write_io_outside_storage_layers():
     assert not bad, "\n".join(bad)
 
 
+def test_spill_file_io_confined_to_spill_module():
+    """Spill-subsystem gate (ISSUE 11, same pattern as the writer-I/O
+    rule): every byte the spill tier puts on or takes off disk flows
+    through `memory/spill.py` — the one module whose reads are
+    checksum-verified (declared-encoding), whose writes are tracked by
+    `SpillSpaceTracker`, and whose files the fault harness can damage
+    deterministically.  `exec/spill_exec.py` (the degradation
+    orchestrator) and the rest of `memory/` may not call `open()` at
+    all, in ANY mode — an ad-hoc read there would bypass verification,
+    an ad-hoc write the space accounting."""
+    import ast
+
+    CHECKED = [os.path.join("exec", "spill_exec.py"),
+               os.path.join("memory", "context.py"),
+               os.path.join("memory", "__init__.py")]
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for rel in CHECKED:
+        with open(os.path.join(pkg, rel), encoding="utf-8") as f:
+            tree = ast.parse(f.read(), rel)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "open":
+                bad.append(f"{rel}:{node.lineno}: open() — spill file "
+                           "I/O belongs in memory/spill.py (checksum-"
+                           "verified reads, tracked writes)")
+    assert not bad, "\n".join(bad)
+
+
+def test_no_sleeps_or_timeout_literals_in_spill_exec():
+    """The degradation orchestrator is driven by memory pressure and
+    deterministic knobs, never by wall-clock waits: no `time.sleep`, no
+    hard-coded `timeout=` literals (the parallel-package rule, applied
+    to the new module)."""
+    import ast
+
+    path = os.path.join(ROOT, "presto_tpu", "exec", "spill_exec.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), path)
+    bad = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "sleep":
+            bad.append(f"exec/spill_exec.py:{node.lineno}: sleep()")
+        for kw in node.keywords:
+            if kw.arg == "timeout" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, (int, float)):
+                bad.append(f"exec/spill_exec.py:{kw.value.lineno}: "
+                           f"hard-coded timeout={kw.value.value!r}")
+    assert not bad, "\n".join(bad)
+
+
 def test_no_raw_sleeps_or_timeouts_in_parallel():
     """Robustness gate (ISSUE 2, extended by ISSUE 6 to the serving
     modules): presto_tpu/parallel/retry.py is the ONLY module in the

@@ -43,10 +43,27 @@ def test_spilled_aggregation_identical(session):
 
 def test_spilled_join_identical(session):
     expected = session.sql(JOIN_SQL).rows
-    session.set("query_max_memory_bytes", 2_500_000)
+    session.set("query_max_memory_bytes", 2_200_000)
     actual = session.sql(JOIN_SQL).rows
     assert actual == expected
     assert session.last_stats.spilled_partitions > 0
+    assert session.last_stats.degradation_tier == 1  # partial spill
+    # hybrid, not cliff: some partitions stayed resident
+    assert session.last_stats.spill_partitions < 2 * 8
+
+
+def test_filter_shrunken_probe_stays_resident(session):
+    """The robust-HHJ interaction: at a limit where the CAPACITY
+    estimate trips, the live re-probe sees the filter-pruned working
+    set fits — the join compacts and stays fully resident (tier 0)
+    instead of spilling."""
+    expected = session.sql(JOIN_SQL).rows
+    session.set("query_max_memory_bytes", 2_500_000)
+    actual = session.sql(JOIN_SQL).rows
+    assert actual == expected
+    st = session.last_stats
+    assert st.degradation_tier == 0 and st.spill_partitions == 0
+    assert st.recovery.get("spill_df_resident", 0) > 0
 
 
 @pytest.mark.slow
@@ -125,12 +142,87 @@ def test_spiller_roundtrip(tmp_path):
 
 
 def test_spill_space_tracker(tmp_path):
+    from presto_tpu.memory.spill import SpillSpaceExhausted
+
     tracker = SpillSpaceTracker(10)
     tracker.reserve(8)
-    with pytest.raises(SpillError):
+    with pytest.raises(SpillSpaceExhausted):  # typed ENOSPC, a SpillError
         tracker.reserve(5)
     tracker.free(8)
     tracker.reserve(5)
+
+
+def test_spill_space_tracker_concurrent_hammer():
+    """Concurrent queries share one tracker: reserve/release races must
+    neither leak bytes nor under-account, and the bound must hold as a
+    typed error (satellite of ISSUE 11)."""
+    import threading
+
+    from presto_tpu.memory.spill import SpillSpaceExhausted
+
+    tracker = SpillSpaceTracker(1000)
+    errors = []
+    denied = [0]
+
+    def worker(seed):
+        import random
+
+        rng = random.Random(seed)
+        held = []
+        for _ in range(500):
+            amt = rng.randint(1, 60)
+            try:
+                tracker.reserve(amt)
+                held.append(amt)
+            except SpillSpaceExhausted:
+                denied[0] += 1
+            except Exception as e:  # anything untyped is a bug
+                errors.append(e)
+            if held and rng.random() < 0.6:
+                tracker.free(held.pop())
+        for amt in held:
+            tracker.free(amt)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert denied[0] > 0          # the bound actually engaged
+    assert tracker.used == 0      # no leaked bytes after full release
+
+
+def test_revocable_memory_context():
+    """The revocable handshake behind spill-tiered operators: declared
+    state reserves POOL bytes but not query-limit bytes; convert
+    promotes it (and can refuse); revoke releases it and counts."""
+    pool = MemoryPool(1000)
+    ctx = QueryMemoryContext("q", pool, 300)
+    assert ctx.set_revocable(-1, 250)
+    assert ctx.current == 0 and ctx.revocable == 250
+    assert pool.reserved == 250          # pool sees it; the limit doesn't
+    assert not ctx.would_exceed(200)     # revocable doesn't count here
+    ctx.convert_revocable(-1)
+    assert ctx.current == 250 and ctx.revocable == 0
+    assert pool.reserved == 250          # conversion moves ledgers only
+    ctx.set_bytes(-1, 0)
+    assert ctx.current == 0 and pool.reserved == 0
+    # conversion past the limit refuses but leaves the reservation intact
+    ctx.set_bytes(2, 200)
+    assert ctx.set_revocable(-3, 150)
+    with pytest.raises(ExceededMemoryLimitError):
+        ctx.convert_revocable(-3)
+    assert ctx.revocable == 150 and ctx.revocations == 0
+    assert ctx.revoke(-3) == 150         # the degradation trigger
+    assert ctx.revocations == 1 and ctx.revocable == 0
+    assert pool.reserved == 200
+    # a pool that cannot fit the declaration signals pressure (False)
+    big = QueryMemoryContext("q2", pool, 10_000)
+    assert not big.set_revocable(-1, 900)
+    assert pool.reserved == 200          # refused reservation left no trace
+    ctx.release_all()
+    assert pool.reserved == 0
 
 
 def test_recoverable_grouped_execution(session, tpch_sqlite_tiny):
