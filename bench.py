@@ -473,6 +473,8 @@ def write_bench():
 
 
 SERVE_RECORD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "SERVE_r02.json")
+SERVE_R01_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "SERVE_r01.json")
 
 
@@ -484,9 +486,19 @@ def load_serve_record():
         return None
 
 
+def load_serve_r01():
+    """The pre-coalescing round-11 record: the baseline the SERVE_r02
+    coalescing speedup claims are measured against."""
+    try:
+        with open(SERVE_R01_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def serve_gate_summary():
     """The serving QPS gate as registered in the default bench artifact:
-    reports the COMMITTED SERVE_r01.json record (bench.py --serve
+    reports the COMMITTED SERVE_r02.json record (bench.py --serve
     re-measures it) so a default run exits 0 on committed records and a
     regressed serve round is visibly red in the record's own gate."""
     rec = load_serve_record()
@@ -495,6 +507,7 @@ def serve_gate_summary():
     return {"qps_per_chip": rec.get("qps_per_chip"),
             "p50_ms": rec.get("p50_ms"), "p95_ms": rec.get("p95_ms"),
             "p99_ms": rec.get("p99_ms"), "gate": rec.get("gate"),
+            "coalesce_burst": rec.get("coalesce_burst"),
             "asof": rec.get("asof")}
 
 
@@ -512,10 +525,20 @@ def serve_bench():
     admission control — the serving tier under real contention
     (docs/SERVING.md).  Closed loop: each session issues its next query
     when the previous one completes, so offered load tracks capacity.
-    Emits p50/p95/p99 per class, QPS/chip, admission queue depth, and
-    cache hit rates to SERVE_r01.json with a regression gate vs the
-    committed record; compiles are prewarmed OUT of the timed loop
-    (cold-start economics are the main bench's compile_economics)."""
+
+    Round-16 (query coalescing): the point-lookup class is PREPARED
+    (`point_exec`, an EXECUTE of one shared signature — the
+    coalescing-heavy class; concurrent binds batch into one vmap
+    launch), with a small `point_adhoc` class preserving the round-11
+    ad-hoc text measurement (its per-literal compile bill was the old
+    `point` class's 151ms p50).  A second phase runs a point_exec-only
+    burst with coalescing OFF then ON (same box, same isolation) and
+    records the launch-amortization speedup plus the comparison against
+    SERVE_r01's pre-coalescing point+execute classes — the ROADMAP
+    gate's QPS/chip claim.  Emits everything to SERVE_r02.json with a
+    regression gate vs the committed record; compiles are prewarmed OUT
+    of the timed loops (cold-start economics are the main bench's
+    compile_economics)."""
     import threading
 
     import jax
@@ -531,6 +554,7 @@ def serve_bench():
     n_sessions = int(os.environ.get("BENCH_SERVE_SESSIONS", "8"))
     per_session = int(os.environ.get("BENCH_SERVE_QUERIES", "25"))
     concurrency = int(os.environ.get("BENCH_SERVE_CONCURRENCY", "4"))
+    burst_per_session = int(os.environ.get("BENCH_SERVE_BURST", "40"))
 
     session = presto_tpu.connect(
         tpch_catalog(sf, cache_dir="/tmp/presto_tpu_cache"))
@@ -557,23 +581,29 @@ def serve_bench():
     run_one("PREPARE serve_point FROM SELECT count(*) c, "
             "sum(l_extendedprice) s FROM lineitem WHERE l_orderkey = ?")
 
+    def exec_sql(seed):
+        return f"EXECUTE serve_point USING {1 + (seed * 4547) % max_key}"
+
     def pick(seed):
         r = seed % 8
         if r == 0:
             return "q1", QUERIES[1]
         if r in (1, 5):
             return "q6", QUERIES[6]
-        if r in (2, 6):
-            return "point", point_sql(seed)
-        return "execute", \
-            f"EXECUTE serve_point USING {1 + (seed * 4547) % max_key}"
+        if r == 2:
+            # the preserved round-11 ad-hoc point variant: every
+            # distinct literal is a distinct text — the per-literal
+            # compile bill the prepared signature amortizes away
+            return "point_adhoc", point_sql(seed)
+        # the coalescing-heavy class: one prepared signature, binds-only
+        return "point_exec", exec_sql(seed)
 
     # prewarm: one of each class so the timed loop measures serving,
     # not first-compile
     for cls, sql in (pick(0), pick(1), pick(2), pick(3)):
         run_one(sql)
 
-    lat = {"q1": [], "q6": [], "point": [], "execute": []}
+    lat = {"q1": [], "q6": [], "point_adhoc": [], "point_exec": []}
     lat_lock = threading.Lock()
     failures = []
     depth_samples = []
@@ -618,17 +648,82 @@ def serve_bench():
 
     info = json.loads(urllib.request.urlopen(
         f"{srv.uri}/v1/info", timeout=30).read())
-    # prepared economics summed over the run's history
+
+    # ---- coalesce burst: the point_exec class in isolation, OFF vs ON
+    # (distinct key offsets per leg keep the result cache out of the
+    # measurement; the serving history's coalesce counters attribute
+    # the ON leg's batching)
+    def burst(leg_tag, offset):
+        errs = []
+
+        def bclient(sid, n, base):
+            for i in range(n):
+                try:
+                    run_one(exec_sql(base + sid * n + i))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(f"{leg_tag}: {type(e).__name__}: {e}")
+
+        def wave(n, base):
+            ths = [threading.Thread(target=bclient, args=(sid, n, base))
+                   for sid in range(n_sessions)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+
+        # untimed prewarm: a concurrent mini-wave builds the leg's
+        # program key AND (on the coalescing leg) the pow2 batch-size
+        # buckets — compiles are out of the timed loop in every leg,
+        # matching the mixed phase's prewarm policy
+        wave(4, offset + 500_000)
+        t0 = time.perf_counter()
+        wave(burst_per_session, offset)
+        w = time.perf_counter() - t0
+        failures.extend(errs)
+        return n_sessions * burst_per_session / w if w else 0.0
+
+    session.set("query_coalescing", "off")
+    burst_qps_off = burst("burst_off", 1_000_003)
+    session.set("query_coalescing", "auto")
+    # a batch can never exceed the admission concurrency; waiting the
+    # window for more is pure latency, so the burst dispatches as soon
+    # as every in-flight slot has joined
+    session.set("coalesce_max_batch", concurrency)
+    co_before = (srv.serving.coalescer_stats() or {})
+    burst_qps_on = burst("burst_on", 2_000_003)
+    co_after = (srv.serving.coalescer_stats() or {})
+    session.set("coalesce_max_batch", 16)
+
+    # prepared + coalescing economics summed over the run's history
     binds = hits = fallbacks = 0
+    co_sizes = []
     for st in session.history_snapshot():
         binds += getattr(st, "prepared_binds", 0)
         hits += getattr(st, "prepared_plan_hits", 0)
         fallbacks += getattr(st, "prepared_fallbacks", 0)
+        if getattr(st, "coalesced_batch_size", 0) > 1:
+            co_sizes.append(st.coalesced_batch_size)
     srv.stop()
 
     all_lat = sorted(x for v in lat.values() for x in v)
     total = len(all_lat)
     chips = 1 if jax.devices()[0].platform == "cpu" else len(jax.devices())
+
+    # SERVE_r01 comparison: the pre-coalescing record's point (ad-hoc)
+    # + execute (prepared) classes, as per-class QPS derived from its
+    # committed mix (2/8 point + 3/8 execute of `queries` over wall_s)
+    r01 = load_serve_r01()
+    vs_r01 = None
+    if r01 and r01.get("wall_s"):
+        r01_pe_qps = (5 / 8) * r01["queries"] / r01["wall_s"] / chips
+        vs_r01 = {
+            "r01_point_execute_qps_per_chip": round(r01_pe_qps, 2),
+            "r02_coalesced_burst_qps_per_chip": round(
+                burst_qps_on / chips, 2),
+            "speedup": round(burst_qps_on / chips / r01_pe_qps, 2)
+            if r01_pe_qps else None,
+        }
+
     record = {
         "metric": "serve_closed_loop_qps_per_chip",
         "platform": jax.devices()[0].platform,
@@ -649,6 +744,24 @@ def serve_bench():
                              for k, v in lat.items() if v},
         "per_class_p99_ms": {k: round(_percentile(sorted(v), 0.99), 1)
                              for k, v in lat.items() if v},
+        "per_class_qps": {k: round(len(v) / wall, 1)
+                          for k, v in lat.items() if v},
+        "coalesce_burst": {
+            "queries_per_leg": n_sessions * burst_per_session,
+            "qps_off": round(burst_qps_off, 1),
+            "qps_on": round(burst_qps_on, 1),
+            "speedup_on_vs_off": round(burst_qps_on / burst_qps_off, 2)
+            if burst_qps_off else None,
+            "batches": (co_after.get("batches", 0)
+                        - co_before.get("batches", 0)),
+            "riders_coalesced": (co_after.get("ridersCoalesced", 0)
+                                 - co_before.get("ridersCoalesced", 0)),
+            "fallbacks": co_after.get("fallbacks", 0),
+            "vs_serve_r01": vs_r01,
+        },
+        "coalescing": info["serving"].get("coalescing"),
+        "mean_coalesced_batch": round(
+            sum(co_sizes) / len(co_sizes), 2) if co_sizes else 0.0,
         "admission": {
             "peak_queue_depth": max(depth_samples, default=0),
             "mean_queue_depth": round(
@@ -700,6 +813,12 @@ def _serve_gate(record, committed):
             and record["p99_ms"] > SERVE_GATE_P99_RATIO * prev_p99:
         return (f"FAIL: p99 {record['p99_ms']}ms > "
                 f"{SERVE_GATE_P99_RATIO}x committed {prev_p99}ms")
+    prev_burst = (committed.get("coalesce_burst") or {}).get("qps_on")
+    cur_burst = (record.get("coalesce_burst") or {}).get("qps_on")
+    if prev_burst and cur_burst \
+            and cur_burst < SERVE_GATE_QPS_RATIO * prev_burst:
+        return (f"FAIL: coalesced burst qps {cur_burst} < "
+                f"{SERVE_GATE_QPS_RATIO}x committed {prev_burst}")
     return "pass"
 
 

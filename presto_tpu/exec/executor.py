@@ -700,6 +700,168 @@ def run_compiled(session, text: str, stmt, mon=None, params=None) -> QueryResult
     return result
 
 
+class Unbatchable(Exception):
+    """Raised when a prepared program's shape cannot serve a coalesced
+    batch (long decimals, unbounded pack-skipping roots, trace failures
+    under vmap): the coalescer's riders re-run solo — never a wrong
+    result, never a stall."""
+
+
+def run_compiled_batched(session, text: str, stmt, params_list,
+                         mons) -> list:
+    """Query coalescing's device lane: serve N concurrent EXECUTEs of
+    ONE prepared signature with ONE XLA launch (server/serving.py's
+    QueryCoalescer is the admission-side batcher that collects them).
+
+    The PR-6 symbolic-parameter channel makes the prepared trace
+    value-free, so batching is a `jax.vmap` of that same trace over a
+    LEADING parameter axis: each rider's bound scalars stack into
+    shape-(B,) arrays, the scan batches broadcast (in_axes=None — the
+    table is shared, only the parameters vary), and the packed result
+    buffer comes back with a leading batch axis that unstacks into
+    per-rider results.  Batch sizes quantize to the next power of two
+    (the PR-4 `_pow2` discipline) with pad slots filled by replaying
+    rider 0's values — a padded slot computes a real (discarded) result,
+    so near-identical batch sizes share ONE executable instead of
+    minting a fresh compile per arrival count.  The executable memoizes
+    in exec/compile_cache.py keyed by (plan fingerprint x session
+    fingerprint x scan avals x stacked-parameter avals), so a warm
+    coalesced batch records compiles == 0.
+
+    `params_list`: one (host_value, Type)-pair tuple per rider, all of
+    the same type signature.  `mons`: the riders' QueryMonitors (batch
+    facts + sort economics are recorded per rider).  Returns one
+    QueryResult per rider, in order.  Raises Unbatchable when this
+    program cannot batch; the caller re-runs every rider solo."""
+    from presto_tpu.exec.chunked import _pow2
+
+    cache = getattr(session, "_coalesced_cache", None)
+    if cache is None:
+        cache = session._coalesced_cache = {}
+    nbatch = len(params_list)
+    bpad = _pow2(nbatch)
+    solo_key = query_cache_key(session, text)
+    if getattr(session, "_compiled_cache", {}).get(solo_key) == "DYNAMIC":
+        # static assumptions known-violated for this signature: the solo
+        # path already degraded to dynamic — batching would re-trip
+        raise Unbatchable("signature marked DYNAMIC")
+    key = (solo_key, bpad)
+
+    def stack_params():
+        cols = []
+        for j in range(len(params_list[0])):
+            vals = [bind_param_values(session, (p[j],))[0]
+                    for p in params_list]
+            vals += [vals[0]] * (bpad - nbatch)  # pad: replay rider 0
+            cols.append(jnp.stack(vals))
+        return tuple(cols)
+
+    entry = cache.get(key)
+    if entry is None:
+        plan = plan_statement(session, stmt)
+        if _plan_has_long_decimal(plan.root):
+            raise Unbatchable("long-decimal output")
+        sort_counts = {}
+        ex0 = Executor(session, sort_stats=sort_counts)
+        scalar_results = ex0.ctx.scalar_results
+        for pid, sub in sorted(plan.subplans.items()):
+            scalar_results[pid] = _single_value(ex0.exec_node(sub))
+        scan_nodes: list = []
+        _collect_tablescans(plan.root, scan_nodes)
+        bound = _static_root_bound(plan.root)
+        f32 = bool(session.properties.get("float32_compute", False))
+        batches = [scan_batch(session.catalog.get(n.table), n, f32)
+                   for n in scan_nodes]
+        stacked = stack_params()
+        plan_fp = CC.plan_fingerprint(
+            (plan.root, sorted(plan.subplans.items())))
+        gkey = None if plan_fp is None else CC.fingerprint(
+            "coalesced", plan_fp, CC.session_fingerprint(session),
+            CC.avals_fingerprint(batches), CC.avals_fingerprint(stacked),
+            sorted(scalar_results.items()))
+
+        def build():
+            meta_box: list = []
+
+            def trace_one(batches, pvals):
+                ex = Executor(session, static=True,
+                              scan_inputs={id(n): b for n, b
+                                           in zip(scan_nodes, batches)},
+                              sort_stats=sort_counts)
+                ex.ctx.scalar_results = scalar_results
+                ex.ctx.params = tuple((pv, None) for pv in pvals)
+                out = ex.exec_node(plan.root)
+                if bound is not None and out.sel.shape[0] > 4 * bound:
+                    out = _compact_batch(out, bound)
+                if ex.guards:
+                    guard = jnp.any(jnp.stack(
+                        [jnp.asarray(g) for g in ex.guards]))
+                else:
+                    guard = jnp.asarray(False)
+                if out.capacity > _PACK_FETCH_MAX:
+                    # the solo path's selective-fetch lane doesn't have
+                    # a batched twin: results this wide stay solo
+                    raise Unbatchable("result capacity exceeds the "
+                                      "packed-fetch plane")
+                buf, meta = K.pack_fetch(out, guard)
+                meta_box.clear()
+                meta_box.append(meta)
+                return buf
+
+            def fn(batches, stacked):
+                return jax.vmap(
+                    lambda pv: trace_one(batches, pv),
+                    in_axes=(0,))(stacked)
+
+            try:
+                jitted = CC.build_jit(fn, example=(batches, stacked))
+            except Unbatchable:
+                raise
+            except (StaticFallback, jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError) as e:
+                raise Unbatchable(str(e)) from e
+            return (plan, jitted, scan_nodes, meta_box[0],
+                    dict(sort_counts))
+
+        entry = CC.get_or_build(gkey, build)
+        cache[key] = entry
+        warm = False
+    else:
+        stacked = stack_params()
+        warm = True
+    plan, jitted, scan_nodes, meta, sort_counts = entry
+    f32 = bool(session.properties.get("float32_compute", False))
+    batches = [scan_batch(session.catalog.get(n.table), n, f32)
+               for n in scan_nodes]
+    buf, side = jax.device_get(jitted(batches, stacked))
+    results = []
+    any_guard = False
+    for i in range(nbatch):
+        datas, sel, guard_h = K.unpack_fetch(
+            (buf[i], [s[i] for s in side]), meta)
+        any_guard = any_guard or bool(guard_h)
+        results.append(Executor(session).materialize_host(
+            plan, meta, datas, sel))
+    if any_guard:
+        # a static assumption tripped for at least one binding; the data
+        # is static so it would trip again — degrade the whole signature
+        # to the dynamic path and re-run every rider solo
+        scache = getattr(session, "_compiled_cache", None)
+        if scache is None:
+            scache = session._compiled_cache = {}
+        scache[solo_key] = "DYNAMIC"
+        cache.pop(key, None)
+        raise Unbatchable("runtime guard tripped in batched program")
+    for mon in mons:
+        if mon is not None:
+            _merge_sort_stats(mon.stats, sort_counts)
+            mon.stats.coalesced_batch_size = nbatch
+            mon.stats.execution_mode = "compiled"
+            if warm:
+                mon.stats.prepared_plan_hits += 1
+    return results
+
+
 def plan_statement(session, stmt) -> P.QueryPlan:
     """Plan + authorize: every table the plan scans is checked against
     the session's access control (reference: AccessControlManager

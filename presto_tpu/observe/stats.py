@@ -128,6 +128,21 @@ class QueryStats:
     prepared_binds: int = 0
     prepared_plan_hits: int = 0
     prepared_fallbacks: int = 0
+    # query coalescing (server/serving.QueryCoalescer): concurrent
+    # EXECUTEs of the SAME prepared signature stacked into a leading
+    # batch axis and served by ONE vmap-batched XLA launch.
+    # coalesced_batch_size: how many queries shared this query's launch
+    # (0 = ran solo; every batch member records the same size).
+    # coalesce_ms: micro-batch window wait the LEADER paid collecting
+    # riders (riders record 0 — their wait overlaps the leader's).
+    # coalesce_batches: batches this query led (leader-only, 0 or 1).
+    # coalesce_fallbacks: batch memberships abandoned for a solo re-run
+    # (batched build/launch failed or the leader faulted — correctness
+    # kept, amortization lost).
+    coalesced_batch_size: int = 0
+    coalesce_ms: float = 0.0
+    coalesce_batches: int = 0
+    coalesce_fallbacks: int = 0
     result_cache_hit: int = 0
     resource_group: str = ""
     admission_wait_ms: float = 0.0

@@ -21,6 +21,11 @@ Choke points:
 - `exec` — `WorkerServer.submit`'s task thread, before the fragment
   runs (`delay` = straggler, `fail` = task FAILED, `crash` = the worker
   dies mid-wave).
+- `coalesce` — the query coalescer's batch leader
+  (server/serving.QueryCoalescer._lead, method `BATCH`, path = the
+  prepared signature's cache key): `fail` kills the batched launch so
+  every batch member re-runs solo — the chaos hook behind the
+  riders-survive-leader-failure guarantee.
 - `spill` — `memory/spill.FileSpiller` around each spill-file write
   (method `WRITE`, path = the spill file path): `truncate` cuts the
   written frame in half, `corrupt` destroys bytes mid-frame while
@@ -34,8 +39,9 @@ programmatic via `FaultPlan(...)` / `install(...)`):
 
     rule[;rule...]          rule = where:method:path:nth:action[:arg]
 
-    where  = client | server | exec | spill
-    method = GET | POST | DELETE | EXEC | PAGE | WRITE | * (any); PAGE is the
+    where  = client | server | exec | spill | coalesce
+    method = GET | POST | DELETE | EXEC | PAGE | WRITE | BATCH | * (any);
+             PAGE is the
              client-side delivered-page pseudo-method — its nth counts
              200-with-body results responses, so a `partial` rule
              corrupts exactly the nth delivered page

@@ -374,6 +374,17 @@ class PrestoTpuServer:
                 "planHits": getattr(st, "prepared_plan_hits", 0),
                 "fallbacks": getattr(st, "prepared_fallbacks", 0),
             },
+            # query coalescing (server/serving.QueryCoalescer): how many
+            # queries shared this query's XLA launch (0 = solo), the
+            # micro-batch window wait the leader paid, and batch
+            # memberships abandoned for a solo re-run
+            "coalescing": {
+                "batchSize": getattr(st, "coalesced_batch_size", 0),
+                "windowWaitMillis": round(
+                    getattr(st, "coalesce_ms", 0.0), 2),
+                "batchesLed": getattr(st, "coalesce_batches", 0),
+                "fallbacks": getattr(st, "coalesce_fallbacks", 0),
+            },
             # tracing (observe/trace.py): the chrome trace lives at
             # /v1/query/{id}/trace; spanCount hints whether it's worth
             # fetching (0 = tracing was off for this query)
@@ -407,6 +418,17 @@ class PrestoTpuServer:
         M.REGISTRY.gauge("presto_tpu_serving_peak_queue_depth",
                          "Peak admission queue depth") \
             .set(self.serving.peak_queue_depth)
+        co = self.serving.coalescer_stats()
+        if co is not None:
+            import re as _re
+
+            for k, v in co.items():
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    snake = _re.sub(r"(?<!^)(?=[A-Z])", "_", k).lower()
+                    M.REGISTRY.gauge(
+                        f"presto_tpu_coalesce_{snake}",
+                        f"Query coalescer {k}").set(v)
         if self.serving.result_cache is not None:
             rc = self.serving.result_cache.stats()
             for k, v in rc.items():
@@ -446,6 +468,7 @@ class PrestoTpuServer:
             "shed": self.serving.queries_shed,
             "drained": self.serving.queries_drained,
             "peakQueueDepth": self.serving.peak_queue_depth,
+            "coalescing": self.serving.coalescer_stats(),
             "resultCache": (self.serving.result_cache.stats()
                             if self.serving.result_cache is not None
                             else None),

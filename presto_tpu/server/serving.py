@@ -30,6 +30,17 @@ Three pieces, composable and individually optional:
   (server/resource_groups.py) behind one `admit`/`release` surface with
   queue-depth gauges, shed counters, and a drain switch graceful
   shutdown uses to cancel queued-but-not-started queries.
+- `QueryCoalescer`: the admission-side micro-batcher behind query
+  coalescing — concurrent EXECUTEs of the SAME prepared signature that
+  arrive within `coalesce_window_ms` of each other stack their bound
+  parameters into a leading batch axis and ride ONE vmap-batched XLA
+  launch (exec/executor.run_compiled_batched), so one device dispatch
+  serves N users.  Default `auto`: a window only opens when another
+  same-signature query is already in flight, so an idle EXECUTE never
+  pays the window latency.  Anything that cannot batch (substitution
+  fallbacks, volatile templates, long decimals, oversized results,
+  tripped guards, a faulted leader) exits the batch and runs solo —
+  never a wrong result, never a stall beyond the window.
 - `ResultCache`: a bounded LRU serving IDENTICAL re-submitted SELECTs
   without execution, keyed by query text x catalog token+version x the
   session property map.  Any engine write bumps the catalog version, so
@@ -46,6 +57,7 @@ benchmark with the SERVE_r01.json record).
 from __future__ import annotations
 
 import copy
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -242,48 +254,122 @@ def execute_prepared(session, stmt: ast.Execute, mon, dispatch):
     # session fingerprint inside run_compiled's own key)
     key_text = "$prepared$" + CC.fingerprint(entry.text, sig)
 
-    mode = session.properties.get("execution_mode", "auto")
-    compiled_cache = getattr(session, "_compiled_cache", {})
-    marker = compiled_cache.get(
-        (key_text, getattr(session.catalog, "version", 0),
-         tuple(sorted((k, repr(v))
-                      for k, v in session.properties.items())), 0))
-    if mode in ("auto", "compiled") and marker != "DYNAMIC":
-        import jax
+    # result cache, per rider and BEFORE any batching: the substituted
+    # template text is the canonical cache identity (identical to what
+    # a client submitting the rendered SELECT directly would key on),
+    # so identical re-submitted EXECUTE values serve from the cache
+    # without joining a batch, and hit accounting is independent of
+    # whether the original execution was coalesced
+    tier = getattr(session, "_serving_tier", None)
+    cache_sql = None
+    if tier is not None and tier.result_cache is not None:
+        cache_sql = _prepared_cache_text(entry, stmt)
+    if cache_sql is not None:
+        hit = tier.result_lookup(cache_sql)
+        if hit is not None:
+            mon.stats.result_cache_hit = 1
+            mon.stats.execution_mode = "cached"
+            return _result_from_cache(hit)
 
-        try:
-            if marker is not None:
-                # warm bind: plan + executable replay from the session
-                # view over the process-wide memo — zero parse/plan work
-                mon.stats.prepared_plan_hits += 1
+    mode = session.properties.get("execution_mode", "auto")
+
+    def run_typed_solo():
+        compiled_cache = getattr(session, "_compiled_cache", {})
+        marker = compiled_cache.get(
+            (key_text, getattr(session.catalog, "version", 0),
+             tuple(sorted((k, repr(v))
+                          for k, v in session.properties.items())), 0))
+        if mode in ("auto", "compiled") and marker != "DYNAMIC":
+            import jax
+
+            try:
+                if marker is not None:
+                    # warm bind: plan + executable replay from the
+                    # session view over the process-wide memo — zero
+                    # parse/plan work
+                    mon.stats.prepared_plan_hits += 1
+                with mon.phase("execute"):
+                    mon.stats.execution_mode = "compiled"
+                    return EX.run_compiled(session, key_text, typed,
+                                           mon=mon, params=bound)
+            except (EX.StaticFallback,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError):
+                if mode == "compiled":
+                    raise
+        # dynamic path: plan memoized per key (value-free — ir.Param
+        # reads the binding at evaluation time)
+        plans = session.__dict__.setdefault("_prepared_dyn_plans", {})
+        dyn_key = (key_text, getattr(session.catalog, "version", 0),
+                   tuple(sorted((k, repr(v))
+                                for k, v in session.properties.items())))
+        plan = plans.get(dyn_key)
+        if plan is None:
+            with mon.phase("plan"):
+                plan = EX.plan_statement(session, typed)
+            if len(plans) >= MAX_TYPED_ENTRIES:
+                plans.clear()
+            plans[dyn_key] = plan
+        else:
+            mon.stats.prepared_plan_hits += 1
+        mon.stats.execution_mode = "dynamic"
+        host_params = tuple((v, None) for v, _t in bound)
+        with mon.phase("execute"):
+            ex = EX.Executor(session, monitor=mon, params=host_params)
+            return ex.run(plan)
+
+    # coalescing needs ≥1 bound scalar to stack (a 0-param template has
+    # no batch axis to map) and a compiled-capable mode
+    if mode in ("auto", "compiled") and bound \
+            and coalesce_mode(session) != "off":
+        gk = (key_text,) + CC.session_fingerprint(session)
+
+        def run_batched(riders, rider_mons):
             with mon.phase("execute"):
-                mon.stats.execution_mode = "compiled"
-                return EX.run_compiled(session, key_text, typed, mon=mon,
-                                       params=bound)
-        except (EX.StaticFallback, jax.errors.ConcretizationTypeError,
-                jax.errors.TracerArrayConversionError):
-            if mode == "compiled":
-                raise
-    # dynamic path: plan memoized per key (value-free — ir.Param reads
-    # the binding at evaluation time)
-    plans = session.__dict__.setdefault("_prepared_dyn_plans", {})
-    dyn_key = (key_text, getattr(session.catalog, "version", 0),
-               tuple(sorted((k, repr(v))
-                            for k, v in session.properties.items())))
-    plan = plans.get(dyn_key)
-    if plan is None:
-        with mon.phase("plan"):
-            plan = EX.plan_statement(session, typed)
-        if len(plans) >= MAX_TYPED_ENTRIES:
-            plans.clear()
-        plans[dyn_key] = plan
+                return EX.run_compiled_batched(session, key_text, typed,
+                                               riders, rider_mons)
+
+        result = coalescer_for(session).submit(
+            session, gk, bound, mon, run_batched, run_typed_solo)
     else:
-        mon.stats.prepared_plan_hits += 1
-    mon.stats.execution_mode = "dynamic"
-    host_params = tuple((v, None) for v, _t in bound)
-    with mon.phase("execute"):
-        ex = EX.Executor(session, monitor=mon, params=host_params)
-        return ex.run(plan)
+        result = run_typed_solo()
+    if cache_sql is not None and result is not None:
+        cols = [{"name": n, "type": str(t).lower()}
+                for n, t in result.columns]
+        tier.result_store(cache_sql, cols, [list(r) for r in result.rows])
+    return result
+
+
+def _prepared_cache_text(entry, stmt) -> Optional[str]:
+    """The canonical result-cache identity of a typed EXECUTE: the
+    substituted template text — the SAME key an ad-hoc submission of the
+    rendered SELECT produces, so prepared and ad-hoc reads of identical
+    values share cache entries.  None when rendering fails (the
+    execution path raises the canonical error instead)."""
+    from presto_tpu.exec import executor as EX
+
+    try:
+        return EX._substitute_parameters(entry.text, stmt.parameters)
+    except Exception:
+        return None
+
+
+def _result_from_cache(hit):
+    """Result-cache entry -> QueryResult.  Entries store the protocol
+    wire shape ({"name","type"} column dicts + list rows), shared with
+    direct SELECT submissions through server/protocol.py."""
+    from presto_tpu import types as T
+    from presto_tpu.session import QueryResult
+
+    columns, rows, _size = hit
+    cols = []
+    for c in columns:
+        try:
+            typ = T.parse_type(c["type"])
+        except Exception:
+            typ = T.VARCHAR
+        cols.append((c["name"], typ))
+    return QueryResult(cols, [tuple(r) for r in rows])
 
 
 def _fold_param_literals(parameters) -> Optional[list]:
@@ -393,6 +479,210 @@ def _walk_nodes(node, cls):
     if isinstance(node, ast.Node):
         for c in node.children():
             yield from _walk_nodes(c, cls)
+
+
+# ---------------------------------------------------------------------------
+# query coalescing
+# ---------------------------------------------------------------------------
+
+#: micro-batch window (ms) a leader holds open collecting riders; a few
+#: ms is the point where one saved device dispatch repays the wait many
+#: times over (tools/roofline.py --sweep coalesce measures the curve)
+COALESCE_WINDOW_MS_DEFAULT = 2.0
+#: batch-size ceiling (stacked parameters quantize to pow2 below this)
+COALESCE_MAX_BATCH_DEFAULT = 16
+#: rider backstop on the leader's batched launch: generous — the first
+#: batch of a size bucket pays an XLA compile — and load-bearing only
+#: if a leader thread dies without running its finally block (the
+#: leader ALWAYS sets the group's done event; an expired rider re-runs
+#: solo, same as any other batch fallback)
+COALESCE_RIDER_WAIT_S = 300.0
+
+
+def coalesce_mode(session) -> str:
+    """'off' | 'on' | 'auto'.  Env PRESTO_TPU_QUERY_COALESCING=off is
+    the process kill switch; session property `query_coalescing`
+    accepts off/on/auto or a bool.  `auto` (the default) opens a batch
+    window only when another query of the same prepared signature is
+    already in flight — an idle EXECUTE never pays the window."""
+    env = os.environ.get("PRESTO_TPU_QUERY_COALESCING", "").lower()
+    if env in ("off", "0", "false"):
+        return "off"
+    v = session.properties.get("query_coalescing", "auto")
+    if isinstance(v, str):
+        lv = v.lower()
+        if lv in ("off", "false", "0"):
+            return "off"
+        if lv in ("on", "true", "1", "force"):
+            return "on"
+        return "auto"
+    return "on" if v else "off"
+
+
+class _CoalesceGroup:
+    """One micro-batch rendezvous: the leader (rider 0) holds the
+    window open, closes the group, runs the batched launch, and
+    distributes results; riders block on `done` and read their slot."""
+
+    __slots__ = ("riders", "mons", "closed", "full", "done", "results",
+                 "fallback")
+
+    def __init__(self, bound, mon):
+        self.riders = [bound]
+        self.mons = [mon]
+        self.closed = False
+        self.full = threading.Event()
+        self.done = threading.Event()
+        self.results = None
+        self.fallback = False
+
+
+class QueryCoalescer:
+    """Admission-side query coalescing (ROADMAP 3(a)): concurrent
+    EXECUTEs of one prepared signature — same plan fingerprint x
+    catalog token x property map, i.e. the same `gk` — that arrive
+    within the micro-batch window are grouped, their bound parameters
+    stacked into a leading axis, and dispatched as ONE vmap-batched
+    executable (exec/executor.run_compiled_batched).  The first
+    arrival leads: it waits out `coalesce_window_ms` (or until
+    `coalesce_max_batch` riders joined), runs the batch, and hands each
+    rider its slot.  ANY batch failure — Unbatchable shapes, tripped
+    guards, an injected leader fault — flips the group to fallback and
+    every member re-runs solo in its own thread: zero wrong results,
+    zero surfaced failures, bounded added latency (the window).
+
+    Per-session like the prepared registry (the protocol server
+    multiplexes one session, so this is the server's coalescer)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groups: Dict[tuple, _CoalesceGroup] = {}
+        self._active: Dict[tuple, int] = {}  # gk -> in-flight count
+        self.batches = 0
+        self.riders_coalesced = 0
+        self.fallbacks = 0
+        self.window_timeouts = 0  # windows that closed with one member
+
+    def submit(self, session, gk, bound, mon, run_batched, run_solo):
+        """Coalescing entry point for one EXECUTE.  `bound`: the
+        rider's (value, Type) parameter pairs.  `run_batched(riders,
+        mons)` runs the stacked launch; `run_solo()` is the classic
+        typed path.  Returns the rider's QueryResult either way."""
+        window_s = max(float(session.properties.get(
+            "coalesce_window_ms", COALESCE_WINDOW_MS_DEFAULT)), 0.0) / 1e3
+        max_batch = max(int(session.properties.get(
+            "coalesce_max_batch", COALESCE_MAX_BATCH_DEFAULT)), 1)
+        mode = coalesce_mode(session)
+        g = None
+        idx = 0
+        with self._lock:
+            cur = self._groups.get(gk)
+            if cur is not None and not cur.closed \
+                    and len(cur.riders) < max_batch:
+                g = cur
+                idx = len(g.riders)
+                g.riders.append(bound)
+                g.mons.append(mon)
+                if len(g.riders) >= max_batch:
+                    g.full.set()
+            elif max_batch > 1 and (
+                    mode == "on"
+                    or (mode == "auto" and self._active.get(gk, 0) > 0)):
+                g = _CoalesceGroup(bound, mon)
+                self._groups[gk] = g
+            self._active[gk] = self._active.get(gk, 0) + 1
+        try:
+            if g is None:
+                # no concurrency observed (auto mode): run solo, but the
+                # _active mark lets the NEXT same-signature arrival open
+                # a window while this one executes
+                return run_solo()
+            if idx > 0:
+                return self._ride(g, idx, mon, run_solo)
+            return self._lead(gk, g, mon, window_s, run_batched, run_solo)
+        finally:
+            with self._lock:
+                n = self._active.get(gk, 0) - 1
+                if n > 0:
+                    self._active[gk] = n
+                else:
+                    self._active.pop(gk, None)
+
+    # -- leader --------------------------------------------------------
+    def _lead(self, gk, g, mon, window_s, run_batched, run_solo):
+        t0 = time.monotonic()
+        if window_s > 0:
+            g.full.wait(timeout=window_s)
+        with self._lock:
+            g.closed = True  # late arrivals form their own group
+            if self._groups.get(gk) is g:
+                del self._groups[gk]
+        mon.stats.coalesce_ms += (time.monotonic() - t0) * 1000.0
+        if len(g.riders) == 1:
+            # window expired with no riders: solo, nothing to unstack
+            with self._lock:
+                self.window_timeouts += 1
+            try:
+                return run_solo()
+            finally:
+                g.done.set()
+        try:
+            # deterministic chaos hook (parallel/faults.py):
+            # coalesce:BATCH:<path>:nth:fail kills the leader's launch
+            from presto_tpu.parallel import faults as F
+
+            rule = F.client_plan().match("coalesce", "BATCH", str(gk[0]))
+            if rule is not None and rule.action == "fail":
+                raise RuntimeError("injected fault: coalesce batch leader")
+            g.results = run_batched(list(g.riders), list(g.mons))
+            mon.stats.coalesce_batches += 1
+            with self._lock:
+                self.batches += 1
+                self.riders_coalesced += len(g.riders)
+        except Exception:
+            # Unbatchable shapes, tripped guards, injected faults: the
+            # whole group degrades to solo re-runs — a genuine query
+            # error resurfaces identically from run_solo below
+            g.fallback = True
+        finally:
+            g.done.set()
+        if g.fallback:
+            mon.stats.coalesce_fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
+            return run_solo()
+        return g.results[0]
+
+    # -- rider ---------------------------------------------------------
+    def _ride(self, g, idx, mon, run_solo):
+        g.done.wait(timeout=COALESCE_RIDER_WAIT_S)
+        if g.fallback or g.results is None:
+            mon.stats.coalesce_fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
+            return run_solo()  # the rider's own thread re-runs solo
+        return g.results[idx]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "batches": self.batches,
+                "ridersCoalesced": self.riders_coalesced,
+                "fallbacks": self.fallbacks,
+                "windowTimeouts": self.window_timeouts,
+                "meanBatchSize": round(
+                    self.riders_coalesced / self.batches, 2)
+                if self.batches else 0.0,
+            }
+
+
+def coalescer_for(session) -> QueryCoalescer:
+    """The session's coalescer, created on first use (same lifetime
+    rule as the prepared registry)."""
+    c = getattr(session, "_query_coalescer", None)
+    if c is None:
+        c = session._query_coalescer = QueryCoalescer()
+    return c
 
 
 # ---------------------------------------------------------------------------
@@ -643,11 +933,16 @@ class ServingTier:
             self.result_cache.invalidate()
 
     # -- introspection -------------------------------------------------
+    def coalescer_stats(self) -> Optional[dict]:
+        c = getattr(self.session, "_query_coalescer", None)
+        return c.stats() if c is not None else None
+
     def stats(self) -> dict:
         out = {"admitted": self.queries_admitted,
                "shed": self.queries_shed,
                "drained": self.queries_drained,
                "peakQueueDepth": self.peak_queue_depth,
+               "coalescing": self.coalescer_stats(),
                "resultCache": (self.result_cache.stats()
                                if self.result_cache is not None else None)}
         if self.resource_groups is not None:

@@ -137,6 +137,20 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # identical re-submitted SELECTs without execution (keyed by text x
     # catalog token+version x properties; any engine write invalidates)
     "prepared_typed_binding": True,
+    # query coalescing (server/serving.QueryCoalescer + exec/executor.
+    # run_compiled_batched): concurrent EXECUTEs of the SAME prepared
+    # signature arriving within the micro-batch window stack their
+    # bound parameters into a leading axis and share ONE vmap-batched
+    # XLA launch.  query_coalescing: auto (default — a window opens
+    # only when another same-signature query is in flight) | on | off
+    # (env kill switch PRESTO_TPU_QUERY_COALESCING=off); the window is
+    # coalesce_window_ms and batches cap at coalesce_max_batch (stacked
+    # sizes quantize to pow2 below the cap so near-identical batch
+    # sizes share executables).  Never changes results: anything that
+    # cannot batch exits the group and runs solo.
+    "query_coalescing": "auto",
+    "coalesce_window_ms": 2.0,
+    "coalesce_max_batch": 16,
     "admission_queue_timeout_s": 60.0,
     "result_cache_enabled": True,
     "result_cache_max_entries": 256,

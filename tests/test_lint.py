@@ -156,6 +156,47 @@ def test_no_raw_membership_mixing_outside_kernels():
     assert not bad, "\n".join(bad)
 
 
+def test_no_raw_vmap_outside_exec():
+    """Query-coalescing gate (ISSUE 12): `jax.vmap` — the batched-
+    execution primitive behind coalesced prepared EXECUTEs — is
+    confined to `exec/` modules (run_compiled_batched in
+    exec/executor.py is the routed entry), so every batched launch
+    flows through the executable memo, the compile accounting, and the
+    pow2 batch-size bucketing.  A raw vmap in the server/plan/parallel
+    layers would mint unaccounted executables per batch size.  Flags
+    attribute references (calls AND partial uses) plus `from jax
+    import vmap` imports, same pattern as the jit rule."""
+    import ast
+
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg)
+            if rel.startswith("exec" + os.sep):
+                continue
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "vmap" \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "jax":
+                    bad.append(f"{rel}:{node.lineno}: jax.vmap — route "
+                               "through exec/executor."
+                               "run_compiled_batched")
+                if isinstance(node, ast.ImportFrom) \
+                        and node.module == "jax" \
+                        and any(a.name == "vmap" for a in node.names):
+                    bad.append(f"{rel}:{node.lineno}: from jax import "
+                               "vmap — batched execution belongs in "
+                               "exec/")
+    assert not bad, "\n".join(bad)
+
+
 def test_no_raw_span_timing_outside_observe():
     """Observability gate (ISSUE 9): wall/span clock reads —
     `time.time()`, `time.perf_counter()`, `time.perf_counter_ns()` —

@@ -503,6 +503,222 @@ def test_serve_gate_registered_in_bench_artifact():
     assert bench._percentile([1, 2, 3, 4], 0.5) == 3
 
 
+# ---------------------------------------------------------------------------
+# query coalescing (ISSUE 12): vmap-batched prepared execution
+# ---------------------------------------------------------------------------
+
+
+def _coalesce_session(**props):
+    """Session with int/double/date/decimal columns — the q6-shape
+    parameter dtypes the coalescer must carry bit-identically."""
+    s = presto_tpu.connect(**dict({"query_coalescing": "on",
+                                   "coalesce_window_ms": 250.0}, **props))
+    n = 300
+    s.catalog.register_memory(
+        "cq", {"k": T.BIGINT, "x": T.DOUBLE, "dt": T.DATE,
+               "p": T.decimal(12, 2), "q": T.BIGINT},
+        {"k": np.arange(n, dtype=np.int64),
+         "x": (np.arange(n, dtype=np.float64) * 0.37) % 11.0,
+         "dt": 9_000 + np.arange(n, dtype=np.int64) % 900,
+         "p": (np.arange(n, dtype=np.int64) * 173) % 100_000,  # unscaled
+         "q": np.arange(n, dtype=np.int64) % 50})
+    return s
+
+
+_COALESCE_TEMPLATE = (
+    "PREPARE cq6 FROM SELECT count(*) c, sum(p * x) r, sum(q) s "
+    "FROM cq WHERE dt >= ? AND x < ? AND p BETWEEN ? AND ? AND k < ?")
+
+
+def _execute_concurrently(s, sqls, window_open=None):
+    """Issue `sqls` from one thread each, released together through a
+    barrier so they land inside one coalescing window.  Returns results
+    in submission order; raises the first worker error."""
+    barrier = threading.Barrier(len(sqls))
+    out = [None] * len(sqls)
+    errs = []
+
+    def run(i, sql):
+        try:
+            barrier.wait(timeout=30)
+            out[i] = s.sql(sql)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(i, q))
+               for i, q in enumerate(sqls)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if errs:
+        raise errs[0]
+    return out
+
+
+def test_coalesced_equivalence_across_dtypes():
+    """Batched-vs-solo checksum equivalence with int, double, date, and
+    decimal parameters (q6-shape): a 4-wide batch returns exactly what
+    four solo executions return, every rider records the batch size,
+    and the warm batch compiles nothing."""
+    s = _coalesce_session()
+    s.sql(_COALESCE_TEMPLATE)
+    binds = [("DATE '1995-01-01'", 8.5, "10.00", "700.00", 250),
+             ("DATE '1996-06-15'", 3.25, "0.05", "999.99", 300),
+             ("DATE '1994-12-31'", 10.0, "250.50", "251.50", 120),
+             ("DATE '1995-07-04'", 1.0, "0.01", "900.00", 77)]
+    execs = [f"EXECUTE cq6 USING {d}, {x}, {lo}, {hi}, {k}"
+             for d, x, lo, hi, k in binds]
+    solo = []
+    s.set("query_coalescing", "off")
+    for e in execs:
+        solo.append(s.sql(e).rows)
+    s.set("query_coalescing", "on")
+    batched = _execute_concurrently(s, execs)
+    for r, expect in zip(batched, solo):
+        assert r.rows == expect
+        assert r.stats.coalesced_batch_size == 4
+        assert r.stats.execution_mode == "compiled"
+    # warm: a second 4-wide batch with fresh values compiles NOTHING —
+    # the pow2 bucket's executable replays from the memo
+    binds2 = [f"EXECUTE cq6 USING DATE '1995-03-0{i + 1}', "
+              f"{2.0 + i}, 1.0{i}, 88{i}.00, {40 + i}" for i in range(4)]
+    warm = _execute_concurrently(s, binds2)
+    for r in warm:
+        assert r.stats.compiles == 0
+        assert r.stats.coalesced_batch_size == 4
+    s.set("query_coalescing", "off")
+    for r, e in zip(warm, binds2):
+        assert r.rows == s.sql(e).rows
+
+
+def test_coalesce_batch_sizes_and_pow2_padding():
+    """Size 2 batches exactly; size 3 pads to the pow2 bucket (4) and a
+    following size-4 batch REUSES that bucket's executable: compiles ==
+    0 for every member."""
+    s = _coalesce_session()
+    s.sql("PREPARE pk FROM SELECT count(*) c, sum(x) v FROM cq "
+          "WHERE k < ?")
+    two = _execute_concurrently(
+        s, ["EXECUTE pk USING 120", "EXECUTE pk USING 55"])
+    assert [r.rows for r in two] == [[(120, pytest.approx(
+        sum((i * 0.37) % 11.0 for i in range(120))))], [(55, pytest.approx(
+            sum((i * 0.37) % 11.0 for i in range(55))))]]
+    assert all(r.stats.coalesced_batch_size == 2 for r in two)
+    three = _execute_concurrently(
+        s, [f"EXECUTE pk USING {k}" for k in (10, 20, 30)])
+    assert all(r.stats.coalesced_batch_size == 3 for r in three)
+    assert [r.rows[0][0] for r in three] == [10, 20, 30]
+    four = _execute_concurrently(
+        s, [f"EXECUTE pk USING {k}" for k in (11, 22, 33, 44)])
+    assert [r.rows[0][0] for r in four] == [11, 22, 33, 44]
+    assert all(r.stats.coalesced_batch_size == 4 for r in four)
+    # 3 padded to 4 built the bucket; the true 4 replays it
+    assert all(r.stats.compiles == 0 for r in four)
+
+
+def test_coalesce_window_timeout_runs_solo():
+    """A lone EXECUTE under forced coalescing waits out the window and
+    runs solo: correct rows, batch size 0, the window wait recorded."""
+    s = _coalesce_session(coalesce_window_ms=40.0)
+    s.sql("PREPARE pk FROM SELECT count(*) FROM cq WHERE k < ?")
+    r = s.sql("EXECUTE pk USING 100")
+    assert r.rows == [(100,)]
+    assert r.stats.coalesced_batch_size == 0
+    assert r.stats.coalesce_ms >= 30.0  # paid the (empty) window
+    c = s._query_coalescer.stats()
+    assert c["windowTimeouts"] >= 1 and c["batches"] == 0
+
+
+def test_mixed_signatures_never_co_batch():
+    """Two different prepared signatures submitted concurrently batch
+    only within their own signature — the group key is the template x
+    type-signature fingerprint, so cross-batching is structural."""
+    s = _coalesce_session()
+    s.sql("PREPARE pa FROM SELECT count(*) c FROM cq WHERE k < ?")
+    s.sql("PREPARE pb FROM SELECT sum(x) v FROM cq WHERE x < ?")
+    rs = _execute_concurrently(s, [
+        "EXECUTE pa USING 100", "EXECUTE pb USING 5.5",
+        "EXECUTE pa USING 200", "EXECUTE pb USING 2.5"])
+    assert rs[0].rows == [(100,)] and rs[2].rows == [(200,)]
+    exp_b = [sum(v for i in range(300)
+                 if (v := (i * 0.37) % 11.0) < lim) for lim in (5.5, 2.5)]
+    assert rs[1].rows[0][0] == pytest.approx(exp_b[0])
+    assert rs[3].rows[0][0] == pytest.approx(exp_b[1])
+    for r in rs:
+        assert r.stats.coalesced_batch_size <= 2  # own signature only
+
+
+def test_coalesce_leader_fault_riders_rerun_solo():
+    """Chaos: an injected fault kills the batch leader's launch — every
+    member re-runs solo with correct results, zero surfaced failures,
+    and the fallback is counted."""
+    from presto_tpu.parallel import faults as F
+
+    s = _coalesce_session()
+    s.sql("PREPARE pk FROM SELECT count(*) FROM cq WHERE k < ?")
+    F.install(F.FaultPlan.parse("coalesce:BATCH:*:1:fail"))
+    try:
+        rs = _execute_concurrently(
+            s, [f"EXECUTE pk USING {k}" for k in (60, 70, 80)])
+    finally:
+        F.install(None)
+    assert [r.rows for r in rs] == [[(60,)], [(70,)], [(80,)]]
+    assert sum(r.stats.coalesce_fallbacks for r in rs) == 3
+    c = s._query_coalescer.stats()
+    assert c["fallbacks"] >= 1 and c["batches"] == 0
+    # the harness is gone: the next batch coalesces normally
+    rs2 = _execute_concurrently(
+        s, [f"EXECUTE pk USING {k}" for k in (61, 71, 81)])
+    assert [r.rows for r in rs2] == [[(61,)], [(71,)], [(81,)]]
+    assert all(r.stats.coalesced_batch_size == 3 for r in rs2)
+
+
+def test_result_cache_hit_accounting_unchanged_under_coalescing():
+    """A coalesced batch populates the result cache per-rider (keyed by
+    the substituted template text), identical re-submitted EXECUTE
+    values hit BEFORE joining any batch, and the hit accounting is the
+    same whether coalescing is on or off."""
+    s = _coalesce_session()
+    tier = ServingTier(s)  # installs the result cache + backref
+    s.sql("PREPARE pk FROM SELECT count(*) FROM cq WHERE k < ?")
+    first = s.sql("EXECUTE pk USING 90")  # solo (window timeout), stores
+    assert first.rows == [(90,)]
+    assert tier.result_cache.stats()["stores"] == 1
+    hit = s.sql("EXECUTE pk USING 90")
+    assert hit.rows == [(90,)]
+    assert hit.stats.result_cache_hit == 1
+    assert hit.stats.execution_mode == "cached"
+    assert tier.result_cache.stats()["hits"] == 1
+    # a concurrent wave of the SAME value: every member serves from the
+    # cache without forming a batch
+    before = s._query_coalescer.stats()["batches"]
+    rs = _execute_concurrently(s, ["EXECUTE pk USING 90"] * 3)
+    assert all(r.rows == [(90,)] and r.stats.result_cache_hit == 1
+               for r in rs)
+    assert tier.result_cache.stats()["hits"] == 4
+    assert s._query_coalescer.stats()["batches"] == before
+    # a coalesced batch of DISTINCT values stores per-rider
+    stores0 = tier.result_cache.stats()["stores"]
+    rs = _execute_concurrently(
+        s, [f"EXECUTE pk USING {k}" for k in (31, 42, 53)])
+    assert [r.rows[0][0] for r in rs] == [31, 42, 53]
+    assert tier.result_cache.stats()["stores"] == stores0 + 3
+    # ... and each re-submission now hits without executing
+    again = s.sql("EXECUTE pk USING 42")
+    assert again.rows == [(42,)] and again.stats.result_cache_hit == 1
+    # coalescing OFF (separate session — the cache keys on the property
+    # map): the store-then-hit accounting is identical
+    s2 = _coalesce_session(query_coalescing="off")
+    tier2 = ServingTier(s2)
+    s2.sql("PREPARE pk FROM SELECT count(*) FROM cq WHERE k < ?")
+    s2.sql("EXECUTE pk USING 90")
+    off = s2.sql("EXECUTE pk USING 90")
+    assert off.rows == [(90,)] and off.stats.result_cache_hit == 1
+    assert tier2.result_cache.stats()["stores"] == 1
+    assert tier2.result_cache.stats()["hits"] == 1
+
+
 def test_serving_tier_embedded_admission():
     """ServingTier.admit/release work embedded (no HTTP): the surface
     bench.py --serve and the protocol server share."""

@@ -428,6 +428,72 @@ def main():
     echo.shutdown()
     out["exchange"] = xout
 
+    # --- query coalescing: B solo launches vs ONE vmap-batched launch -
+    # Anchors the coalescer defaults (server/serving.py coalesce_window_
+    # ms / coalesce_max_batch) with measurements instead of guesses:
+    # what B separate dispatches of the prepared point-lookup shape
+    # (q6-class filter + two reductions with a scalar parameter) cost
+    # vs ONE jax.vmap-of-the-same-trace launch at batch B — on a
+    # tunneled TPU the solo column pays B round trips, the batched
+    # column one — plus the pow2 padding discipline's waste (wall at
+    # the padded bucket vs at the exact batch size).  Honest CPU
+    # caveat (docs/PERF.md round 16): on CPU a single reduction
+    # already saturates every core and dispatch costs ~40us, so the
+    # solo column WINS here — the sweep exists to measure the
+    # crossover on real chips, where per-dispatch overhead is ~ms.
+    nrow_c = 1 << 16  # the serving bench's point-lookup scan scale
+    ckeys = jnp.asarray(rng.integers(0, nrow_c, nrow_c).astype(np.int64))
+    cvals = jnp.asarray(rng.normal(size=nrow_c))
+
+    def point_fn(k):
+        m = ckeys == k
+        return (jnp.sum(m.astype(jnp.int64)),
+                jnp.sum(jnp.where(m, cvals, 0.0)))
+
+    solo_j = jax.jit(point_fn)
+
+    def solo_wall(nb):
+        ks = [jnp.int64((i * 7919) % nrow_c) for i in range(nb)]
+        float(solo_j(ks[0])[0])  # warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for k in ks:
+                c_, _s = solo_j(k)
+                float(c_)  # force each launch home, like a real EXECUTE
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def batched_wall(nb):
+        ks = jnp.asarray([(i * 7919) % nrow_c for i in range(nb)],
+                         dtype=jnp.int64)
+        f = jax.jit(jax.vmap(point_fn))  # one executable per batch size
+        float(f(ks)[0][0])  # warm (the bucket's one-time compile)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            c_, _s = f(ks)
+            float(c_[0])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    coout = {}
+    for nb in (1, 2, 4, 8, 16, 32):
+        sw = solo_wall(nb)
+        bw = batched_wall(nb)
+        coout[f"b{nb}"] = {"solo_ms": round(sw * 1000, 2),
+                           "vmap_ms": round(bw * 1000, 2),
+                           "speedup": round(sw / bw, 2)}
+    pad = {}
+    for nb in (3, 5, 9):
+        exact = batched_wall(nb)
+        bucket = batched_wall(1 << (nb - 1).bit_length())
+        pad[f"b{nb}"] = {"exact_ms": round(exact * 1000, 2),
+                         "padded_ms": round(bucket * 1000, 2),
+                         "pad_overhead": round(bucket / exact, 2)
+                         if exact else None}
+    out["coalesce"] = {"rows": nrow_c, "batch": coout, "pad_waste": pad}
+
     # --- build_probe at TPC-H Q3 shape: 6M probe, 1.5M build ----------
     npr, nb = 6_000_000, 1_500_000
     probe = jnp.asarray(rng.integers(0, nb, npr).astype(np.int32))
