@@ -17,7 +17,9 @@ Baselines (VERDICT r1 asked for an honest one):
 Extra keys: per_query_ms (warm best per query), compile_economics
 (per-query cold_ms/warm_ms + compiles/compile_ms/cache_hits/ahead_hits
 from exec/compile_cache.py; warm_compiles > 0 flags a warm-path
-retrace), sf, note, scale_configs
+retrace), agg_economics (per-query plan/agg_strategy.py block:
+strategy chosen, observed partial reduction ratio, bypass flips /
+re-enables), sf, note, scale_configs
 (ALWAYS the committed records from BENCH_SCALE_PROGRESS.json; a default
 run never re-measures them — re-measuring is BENCH_SCALE=1 opt-in and
 runs after the line prints, under a budget sized to finish before the
@@ -95,6 +97,7 @@ def main():
     compile_econ = {}
     df_econ = {}
     ff_econ = {}
+    agg_econ = {}
     for qid in QUERY_IDS:
         t0 = time.perf_counter()
         r = session.sql(QUERIES[qid])  # prewarm == the COLD run
@@ -112,6 +115,12 @@ def main():
                 "exchange_bytes_host": r.stats.exchange_bytes_host,
                 "exchange_bytes_collective":
                     r.stats.exchange_bytes_collective}
+        if r.stats is not None:  # round-17 adaptive-agg economics
+            agg_econ[str(qid)] = {
+                "strategy": dict(r.stats.agg_strategy) or None,
+                "ratio": round(r.stats.partial_agg_ratio, 3),
+                "bypass_flips": r.stats.partial_aggs_bypassed,
+                "reenabled": r.stats.partial_aggs_reenabled}
         if r.stats is not None:  # round-10 dynamic-filter economics
             df_econ[str(qid)] = {
                 "produced": r.stats.df_filters_produced,
@@ -176,6 +185,7 @@ def main():
         "compile_economics": compile_econ or None,
         "dynamic_filter": df_econ or None,
         "fragment_fusion": ff_econ or None,
+        "agg_economics": agg_econ or None,
         "multichip": multichip_summary(),
         "sf": SF,
         "scale_configs": {k: v for k, v in (load_scale_progress() or {}).items()

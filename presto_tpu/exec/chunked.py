@@ -50,6 +50,7 @@ from presto_tpu.batch import Batch, Column
 from presto_tpu.exec import compile_cache as CC
 from presto_tpu.exec import kernels as K
 from presto_tpu.observe import trace as TR
+from presto_tpu.plan import agg_strategy as AS
 from presto_tpu.plan import nodes as P
 
 
@@ -424,6 +425,20 @@ def _rf_resident_domains(root, resident) -> Dict[str, object]:
     return out
 
 
+class _LaneFrag:
+    """A fragment façade for an alternate execution LANE of the same
+    fragment (the adaptive partial-agg pass-through lane): its own fid
+    key and root, sharing the base fragment's scan subtree so the
+    runner's scan_inputs id-keying and executable caches line up."""
+
+    __slots__ = ("fid", "root", "inputs")
+
+    def __init__(self, fid, root, inputs=()):
+        self.fid = fid
+        self.root = root
+        self.inputs = list(inputs)
+
+
 class _MeshGridView:
     """Presents a base chunk grid as a grid of SUPERSTEPS: superstep i
     covers micro-chunks [i*n, (i+1)*n), one per mesh device, with args
@@ -548,6 +563,13 @@ class _FragmentRunner:
         self.dynamic_fids = set()  # run-once fids that fell back dynamic
         self.bound_mult: Dict[object, int] = {}  # fid -> compact growth
         self._bound_cache: Dict[object, int] = {}  # fid -> stats bound
+        # adaptive partial aggregation (plan/agg_strategy.py): fid ->
+        # FlipState (persists across runs of this prepared query, so a
+        # warm run starts from the flip the last run learned) or False
+        # when the fragment is known not monitorable; fid -> _LaneFrag
+        # for the pass-through lane
+        self.agg_monitors: Dict[object, object] = {}
+        self._bypass_lanes: Dict[object, _LaneFrag] = {}
         # trace-time sort-economics counters across fragment programs
         self.sort_stats: Dict[str, int] = {}
         # PER-RUN counters (chunk pruning happens host-side every run,
@@ -606,12 +628,18 @@ class _FragmentRunner:
         self._bound_cache[frag.fid] = bound
         return bound
 
-    def _execute(self, frag, scan_inputs, bound_cap):
+    def _execute(self, frag, scan_inputs, bound_cap,
+                 capture_partial_rows=False):
         from presto_tpu.exec.executor import (Executor, _compact_batch,
                                               _static_root_bound)
 
         ex = Executor(self.session, static=True, scan_inputs=scan_inputs,
                       sort_stats=self.sort_stats)
+        if capture_partial_rows:
+            # the monitored partial-agg lane also returns the live row
+            # count INTO the partial stage (traced scalar; the runner's
+            # ratio monitor reads it per chunk)
+            ex.capture_partial_agg_rows = True
         # sort-order materialization hint (gather.py): a chunk
         # fragment's OUTPUT rows are compacted, buffered, and consumed
         # by the next fragment's aggregate/TopN/join — all of which
@@ -642,6 +670,11 @@ class _FragmentRunner:
             guard = jnp.any(jnp.stack([jnp.asarray(g) for g in ex.guards]))
         else:
             guard = jnp.asarray(False)
+        if capture_partial_rows:
+            rows = getattr(ex, "captured_agg_rows", None)
+            if rows is None:
+                rows = jnp.asarray(0, jnp.int32)
+            return out, guard, overflow, rows
         return out, guard, overflow
 
     def _split_scans(self, fscans, chunked: bool):
@@ -733,6 +766,94 @@ class _FragmentRunner:
 
         return self._cached_exec((frag.fid, mult), gkey, build, ahead)
 
+    # ---- adaptive partial aggregation (plan/agg_strategy.py) ---------
+    def _agg_monitor(self, frag):
+        """The per-fragment FlipState when this chunk-loop fragment's
+        root chain is a bypassable PARTIAL aggregate (None otherwise).
+        Persists across runs — the runner is the prepared-query cache
+        entry, so a warm run resumes from the learned flip."""
+        if not AS.enabled(self.session):
+            return None
+        with self._jit_lock:
+            cached = self.agg_monitors.get(frag.fid)
+            if cached is None:
+                agg = AS.find_partial_agg(frag.root)
+                cached = AS.FlipState() \
+                    if agg is not None and AS.bypassable(agg) else False
+                self.agg_monitors[frag.fid] = cached
+        return cached or None
+
+    def _bypass_lane(self, frag) -> Optional[_LaneFrag]:
+        """The pass-through lane fragment: the PARTIAL aggregate swapped
+        for its per-row partial-schema Project, sharing the scan
+        subtree.  Its own fid/serde fingerprint key both the runner's
+        local executable dict and the shared compile-cache memo, so the
+        flip never recompiles a warm query — both lanes are pre-keyed."""
+        lane = self._bypass_lanes.get(frag.fid)
+        if lane is None:
+            root = AS.bypass_root(frag.root)
+            if root is None:
+                return None
+            lane = self._bypass_lanes[frag.fid] = _LaneFrag(
+                (frag.fid, "bypass"), root, frag.inputs
+                if hasattr(frag, "inputs") else ())
+        return lane
+
+    def _loop_exec_pa(self, frag, resident, ids, chunk_nodes, grid, mult,
+                      ahead=False):
+        """The MONITORED grouped lane: same per-chunk program as
+        _loop_exec plus a fourth output — the live row count into the
+        partial stage — feeding the reduction-ratio monitor.  Distinct
+        compile-cache kind ("loop_pa") and local key, so monitored and
+        plain programs never collide."""
+        args = [resident[i] for i in ids]
+        gkey = self._gkey(frag, "loop_pa", mult,
+                          CC.avals_fingerprint(args))
+        nodes = list(chunk_nodes)
+
+        def build():
+            bound = _pow2(self._fragment_bound(frag, grid) * mult)
+
+            def fn(batches, cargs):
+                scan_inputs = dict(zip(ids, batches))
+                for n in nodes:
+                    scan_inputs[id(n)] = self._scan_builder(n, cargs, grid)
+                return self._execute(frag, scan_inputs, bound,
+                                     capture_partial_rows=True)
+
+            return CC.build_jit(fn, example=(args, grid.chunk_args(0)))
+
+        return self._cached_exec((frag.fid, "pa", mult), gkey, build,
+                                 ahead)
+
+    def _pa_flush(self, mon, pending, buffered, chunk_cap, remaining,
+                  budget) -> None:
+        """Host-sync the window's (rows in, groups out) scalars and feed
+        the flip state — ONE device fetch per RATIO_WINDOW chunks, so
+        the pipelined loop stalls once per window, not per chunk.  A
+        flip is memory-vetoed when pass-through buffering of the
+        remaining chunks (at chunk capacity, no reduction) would blow
+        the exchange-buffer budget — bypass trades exchange volume for
+        compute, and the trade is only taken when the buffer affords
+        it."""
+        obs = jax.device_get(list(pending))
+        pending.clear()
+        thr = AS.min_reduction(self.session)
+        for rows, groups in obs:
+            ratio = float(rows) / max(float(groups), 1.0)
+            self.run_stats["partial_agg_ratio"] = ratio
+            event = mon.observe(ratio, thr)
+            if event == "flipped":
+                if buffered + chunk_cap * max(remaining, 0) > budget:
+                    mon.bypassed = False  # veto: buffer can't afford it
+                    mon.strikes = 0
+                else:
+                    self.run_stats["partial_aggs_bypassed"] = \
+                        self.run_stats.get("partial_aggs_bypassed", 0) + 1
+            elif event == "reenabled":
+                self.run_stats["partial_aggs_reenabled"] = \
+                    self.run_stats.get("partial_aggs_reenabled", 0) + 1
+
     def compile_ahead(self, frags, table_family) -> int:
         """Background AOT-compile of fragments 2..N on the shared pool
         while fragment 1 executes in the query thread (reference role:
@@ -768,6 +889,11 @@ class _FragmentRunner:
                 if mesh_n > 1:
                     self._mesh_exec(frag, chunk_nodes, resident, ids,
                                     grid, mesh_n, m, ahead=True)
+                elif self._agg_monitor(frag) is not None:
+                    # monitored fragments run the loop_pa lane — ahead-
+                    # compile THAT program, not the plain one
+                    self._loop_exec_pa(frag, resident, ids, chunk_nodes,
+                                       grid, m, ahead=True)
                 else:
                     self._loop_exec(frag, resident, ids, chunk_nodes,
                                     grid, m, ahead=True)
@@ -844,13 +970,24 @@ class _FragmentRunner:
         mult = self.bound_mult.get(frag.fid, 1)
         ids = list(resident)
         mesh_n = int(self.session.properties.get("chunk_mesh_devices", 1))
+        mon = jitted4 = None
         if mesh_n > 1:
             jitted = self._mesh_exec(frag, chunk_nodes, resident, ids,
                                      grid, mesh_n, mult)
             grid = _MeshGridView(grid, mesh_n)
         else:
-            jitted = self._loop_exec(frag, resident, ids, chunk_nodes,
-                                     grid, mult)
+            # adaptive partial aggregation: a bypassable PARTIAL-agg
+            # fragment runs the MONITORED grouped lane (adds the
+            # rows-into-partial scalar); the fallback paths see the
+            # same program through a 3-tuple view
+            mon = self._agg_monitor(frag)
+            if mon is not None:
+                jitted4 = self._loop_exec_pa(frag, resident, ids,
+                                             chunk_nodes, grid, mult)
+                jitted = lambda rl, ca: jitted4(rl, ca)[:3]  # noqa: E731
+            else:
+                jitted = self._loop_exec(frag, resident, ids, chunk_nodes,
+                                         grid, mult)
         res_list = [resident[i] for i in ids]
         budget = int(self.session.properties.get(
             "chunk_buffer_max_rows", 64_000_000))
@@ -866,7 +1003,14 @@ class _FragmentRunner:
         if not pipelined or grid.nchunks <= 1:
             return self._chunk_loop_syncing(jitted, res_list, grid, budget)
 
-        out0, g0, ov0 = jitted(res_list, grid.chunk_args(0))
+        if mon is not None:
+            # chunk 0 always runs the grouped lane: it calibrates the
+            # compact capacity AND (when a warm run resumes bypassed)
+            # doubles as the hysteresis probe
+            out0, g0, ov0, rin0 = jitted4(res_list, grid.chunk_args(0))
+        else:
+            out0, g0, ov0 = jitted(res_list, grid.chunk_args(0))
+            rin0 = None
         part0 = K.compact(out0)  # the ONE sync: calibrates capacity
         n0 = part0.capacity
         cap = 1 << max(16, (4 * max(n0, 1)).bit_length())
@@ -893,9 +1037,40 @@ class _FragmentRunner:
         counts = []
         profile = bool(self.session.properties.get("chunk_profile",
                                                    False))
+        # adaptive monitor state: pending (rows in, groups out) scalars
+        # flushed (one host sync) every RATIO_WINDOW chunks; bypassed
+        # chunks run the pass-through lane and buffer uncompacted
+        bjit = None
+        chunk_cap = int(out0.sel.shape[0])
+        buffered = int(n0)
+        bypassed_chunks = 0
+        flips_before = self.run_stats.get("partial_aggs_bypassed", 0)
+        pending = [(rin0, n0)] if mon is not None else []
         for i in range(1, grid.nchunks):
+            if mon is not None and mon.bypassed and not mon.probe_due():
+                if bjit is None:
+                    lane = self._bypass_lane(frag)
+                    if lane is None:  # lost the row form: stay grouped
+                        mon.bypassed = False
+                    else:
+                        bjit = self._loop_exec(lane, resident, ids,
+                                               chunk_nodes, grid, mult)
+            if bjit is not None and mon is not None and mon.bypassed \
+                    and not mon.probe_due():
+                out, guard, ov = bjit(res_list, grid.chunk_args(i))
+                parts.append(out)  # pass-through rows, uncompacted
+                buffered += chunk_cap
+                bypassed_chunks += 1
+                mon.note_bypassed()
+                guards.append(guard)
+                overflows.append(ov)
+                continue
             t0 = TR.clock_ns() if profile else 0
-            out, guard, ov = jitted(res_list, grid.chunk_args(i))
+            if mon is not None:
+                out, guard, ov, rin = jitted4(res_list, grid.chunk_args(i))
+            else:
+                out, guard, ov = jitted(res_list, grid.chunk_args(i))
+                rin = None
             part, cnt = cjit(out)  # async: no host sync in this loop
             if profile:
                 # per-chunk wall time, device-synced (diagnostics only —
@@ -909,6 +1084,20 @@ class _FragmentRunner:
             overflows.append(ov)
             counts.append(cnt)
             parts.append(part)
+            buffered += cap
+            if mon is not None:
+                pending.append((rin, cnt))
+                if len(pending) >= AS.RATIO_WINDOW:
+                    self._pa_flush(mon, pending, buffered, chunk_cap,
+                                   grid.nchunks - 1 - i, budget)
+        if mon is not None and pending:
+            self._pa_flush(mon, pending, buffered, chunk_cap, 0, budget)
+        if mon is not None and bypassed_chunks \
+                and self.run_stats.get("partial_aggs_bypassed",
+                                       0) == flips_before:
+            # a warm run resumed an earlier flip: no new flip event, but
+            # this run DID serve pass-through chunks — count the bypass
+            self.run_stats["partial_aggs_bypassed"] = flips_before + 1
         cap_overflow = bool(jnp.any(jnp.stack(
             [c > cap for c in counts]))) if counts else False
         if cap_overflow:

@@ -47,7 +47,7 @@ class ExecutionError(Exception):
 
 def _merge_sort_stats(stats, counts: dict) -> None:
     """Fold an executor's sort-economics + dynamic-filtering +
-    spill-degradation counters into QueryStats."""
+    spill-degradation + adaptive-aggregation counters into QueryStats."""
     for k in ("sorts_taken", "sorts_elided", "sort_memo_hits",
               "ordering_guard_trips",
               "df_filters_produced", "df_filters_applied",
@@ -55,8 +55,19 @@ def _merge_sort_stats(stats, counts: dict) -> None:
               "fragments_fused", "exchange_bytes_host",
               "exchange_bytes_collective",
               "spill_partitions", "spill_bytes", "spill_restores",
-              "spill_recursions"):
+              "spill_recursions",
+              "partial_aggs_bypassed", "partial_aggs_reenabled"):
         setattr(stats, k, getattr(stats, k, 0) + int(counts.get(k, 0)))
+    if counts.get("partial_agg_ratio"):
+        # a gauge, not a sum: the last ratio a partial stage observed
+        stats.partial_agg_ratio = float(counts["partial_agg_ratio"])
+    for k, v in counts.items():
+        # "agg_strategy::<name>" -> QueryStats.agg_strategy[name] (the
+        # per-strategy execution counter, exported with labels)
+        if k.startswith("agg_strategy::") and v:
+            name = k.split("::", 1)[1]
+            stats.agg_strategy[name] = \
+                stats.agg_strategy.get(name, 0) + int(v)
     if counts.get("df_wait_ms"):
         stats.df_wait_ms = getattr(stats, "df_wait_ms", 0.0) \
             + float(counts["df_wait_ms"])
@@ -1148,6 +1159,13 @@ class Executor:
             "ordering_guard_trips": 0}
         self._sort_memo: Dict[tuple, tuple] = {}
         self._perm_memo: Dict[tuple, tuple] = {}
+        # group-id mapping memo (round 17): key fingerprint ->
+        # (refs, (gid, rep_rows, n_groups)) — a repeat grouping over
+        # identical key arrays (AVG/STDDEV fold passes over a resident
+        # build) replays the mapping instead of rebuilding the group
+        # index; refs pin the fingerprinted arrays (id-reuse aliasing,
+        # same discipline as _sort_memo)
+        self._gid_memo: Dict[tuple, tuple] = {}
         self._batch_order: Dict[int, tuple] = {}
         # dynamic filtering (plan/runtime_filters.py): filter id ->
         # device summary (exec/kernels.rf_build), registered by producer
@@ -1683,8 +1701,35 @@ class Executor:
         from presto_tpu.memory.context import batch_bytes
 
         b = self.exec_node(node.source)
+        strat = getattr(node, "agg_strategy", None)
+        if strat and node.group_keys and node.step != "FINAL":
+            # planner strategy counter (plan/agg_strategy.py) — counted
+            # where the aggregate EXECUTES (trace-time in static mode,
+            # like the sort economics); FINAL merges are the other half
+            # of an already-counted two-phase pair
+            self._count("agg_strategy::" + strat)
         if any(a.distinct for a in node.aggs.values()):
             return self._exec_aggregate_with_distinct(node, b)
+        # monitored chunked lane (exec/chunked.py): record the live row
+        # count INTO the first PARTIAL stage as a traced scalar — the
+        # runner's reduction-ratio monitor reads it per chunk
+        if getattr(self, "capture_partial_agg_rows", False) \
+                and node.step == "PARTIAL" and node.group_keys \
+                and getattr(self, "captured_agg_rows", None) is None:
+            self.captured_agg_rows = jnp.sum(b.sel, dtype=jnp.int32)
+        # adaptive partial-aggregation bypass (plan/agg_strategy.py):
+        # consulted BEFORE spill planning, so a bypassed partial never
+        # builds grouped state or reserves revocable memory
+        flip = self._pa_flip_state(node)
+        if flip is not None and flip.bypassed and not flip.probe_due():
+            flip.note_bypassed()
+            self._count("partial_aggs_bypassed")
+            return self._pa_passthrough(node, b)
+        rows_in = None
+        if flip is not None:
+            # device scalar now (the spill path may free b); host-synced
+            # only after the grouped pass ran
+            rows_in = jnp.sum(b.sel, dtype=jnp.int64)
         if node.group_keys and not self.static:
             from presto_tpu.exec import spill_exec as SE
 
@@ -1698,12 +1743,62 @@ class Executor:
                 return SE.hybrid_aggregate(self, node, holder, dec)
             if dec.mem_key:
                 try:
-                    return self._aggregate(b, node.group_keys, node.aggs,
-                                           node)
+                    out = self._aggregate(b, node.group_keys, node.aggs,
+                                          node)
                 finally:
                     # converted revocable operator-state reservation
                     self.mem.set_bytes(dec.mem_key, 0)
-        return self._aggregate(b, node.group_keys, node.aggs, node)
+                self._pa_observe(flip, rows_in, out)
+                return out
+        out = self._aggregate(b, node.group_keys, node.aggs, node)
+        self._pa_observe(flip, rows_in, out)
+        return out
+
+    # ---- adaptive partial aggregation (plan/agg_strategy.py) ---------
+    def _pa_flip_state(self, node):
+        """The hysteresis flip state for a bypassable PARTIAL aggregate,
+        or None (static traces make their flip decisions in the chunked
+        runner, outside the program)."""
+        if self.static or getattr(node, "step", "SINGLE") != "PARTIAL" \
+                or not node.group_keys:
+            return None
+        from presto_tpu.plan import agg_strategy as AS
+
+        if not AS.enabled(self.session):
+            return None
+        return AS.flip_state(self.session, node)
+
+    def _pa_passthrough(self, node: P.Aggregate, b: Batch) -> Batch:
+        """Serve a bypassed PARTIAL aggregate: every live row projected
+        into the partial-output schema (count -> 0/1, sum -> x, ...) —
+        no group build; the FINAL stage re-groups the raw stream."""
+        from presto_tpu.plan import agg_strategy as AS
+
+        proj = AS.passthrough_project(node)
+        cols = {}
+        for sym, e in proj.assignments.items():
+            cols[sym] = to_column(eval_expr(e, b, self.ctx), b.capacity)
+        return Batch(cols, b.sel)
+
+    def _pa_observe(self, flip, rows_in, out: Batch) -> None:
+        """Feed the grouped pass's reduction ratio into the flip state
+        (one host fetch; dynamic mode only — callers pass flip=None in
+        static traces).  The spill path skips observation: a degraded
+        build's partition-local group counts are not the fragment
+        ratio."""
+        if flip is None or rows_in is None:
+            return
+        from presto_tpu.plan import agg_strategy as AS
+
+        groups = int(out.capacity)  # dynamic grouping: sel == ones(n)
+        rows = int(jax.device_get(rows_in))
+        ratio = rows / max(groups, 1)
+        self.sort_stats["partial_agg_ratio"] = ratio
+        event = flip.observe(ratio, AS.min_reduction(self.session))
+        if event == "flipped":
+            self._count("partial_aggs_bypassed")
+        elif event == "reenabled":
+            self._count("partial_aggs_reenabled")
 
     # ---- spill / grouped execution (exec/spill_exec.py) --------------
     def _make_spiller(self):
@@ -1884,10 +1979,24 @@ class Executor:
                 self._count("ordering_guard_trips")
         if gid is None:
             fp, refs = self._key_fp(pack_cols, b.sel, layout)
-            pair = self._memo_pair(key, fp, refs)
-            self._count("sorts_taken")  # the unpermute co-sort
-            gid, rep_rows, n_groups = K.group_ids(key, b.sel,
-                                                  sorted_pair=pair)
+            hit = self._gid_memo.get(fp) if fp is not None \
+                and self._ordering_enabled() else None
+            if hit is not None:
+                # group-id mapping memo: a second grouping over the SAME
+                # key arrays (AVG/STDDEV fold passes over a resident
+                # build, distinct pre-passes) reuses the whole
+                # (gid, representatives, count) mapping — both the
+                # grouping sort AND the unpermute co-sort elide
+                gid, rep_rows, n_groups = hit[1]
+                self._count("sort_memo_hits")
+                self._count("sorts_elided", 2)
+            else:
+                pair = self._memo_pair(key, fp, refs)
+                self._count("sorts_taken")  # the unpermute co-sort
+                gid, rep_rows, n_groups = K.group_ids(key, b.sel,
+                                                      sorted_pair=pair)
+                if fp is not None:
+                    self._gid_memo[fp] = (refs, (gid, rep_rows, n_groups))
         out_cols: Dict[str, Column] = {}
         raw, _ = K.take_columns({k: b.columns[k] for k in group_keys},
                                 rep_rows)
@@ -3458,13 +3567,11 @@ class Executor:
         if jt == "INNER":
             return out.with_sel(match_ok)
         if jt in ("SEMI", "ANTI"):
-            hit = jax.ops.segment_max(match_ok.astype(jnp.int32), lidx,
-                                      num_segments=n) > 0
+            hit = K.segment_any(match_ok, lidx, n)
             want = hit if jt == "SEMI" else ~hit
             return left.with_sel(left.sel & want)
         if jt == "LEFT":
-            any_ok = jax.ops.segment_max(match_ok.astype(jnp.int32), lidx,
-                                         num_segments=n) > 0
+            any_ok = K.segment_any(match_ok, lidx, n)
             first_slot = k == 0
             keep = jnp.where(any_ok[lidx], match_ok, first_slot & left.sel[lidx])
             rvalid = match_ok
@@ -3522,16 +3629,15 @@ class Executor:
             return out.with_sel(sel & match_ok)
         if jt in ("SEMI", "ANTI"):
             # any passing match per left row?
-            hit = jax.ops.segment_max((sel & match_ok).astype(jnp.int32), lidx,
-                                      num_segments=left.capacity) > 0
+            hit = K.segment_any(sel & match_ok, lidx, left.capacity)
             want = hit if jt == "SEMI" else ~hit
             return left.with_sel(left.sel & want)
         if jt == "LEFT":
             # keep one row for unmatched-left; for matched rows apply filter;
             # rows whose every match fails the filter must still appear once
             if node.filter is not None:
-                any_ok = jax.ops.segment_max((sel & match_ok).astype(jnp.int32), lidx,
-                                             num_segments=left.capacity) > 0
+                any_ok = K.segment_any(sel & match_ok, lidx,
+                                       left.capacity)
                 first_of_row = k == 0
                 keep = jnp.where(any_ok[lidx], match_ok, first_of_row)
                 # null out right side where match failed

@@ -501,6 +501,15 @@ def segment_max(x, gid, n_groups):
     return jax.ops.segment_max(x, gid, num_segments=n_groups + 1)[:n_groups]
 
 
+def segment_any(mask, gid, n: int):
+    """True where ANY row of the segment has `mask` set — the join
+    layer's "any passing match per probe row" reduction.  Exact
+    num_segments with no dead slot: gid here is a probe-row index,
+    always in range (unlike the grouping kernels' sentinel slot)."""
+    return jax.ops.segment_max(mask.astype(jnp.int32), gid,
+                               num_segments=n) > 0
+
+
 # ---------------------------------------------------------------------------
 # join probe
 # ---------------------------------------------------------------------------

@@ -131,6 +131,19 @@ def plan_degradation(ex, node, est_bytes: int, capacity: int,
     session = ex.session
     if ex.static or not session.properties.get("spill_enabled", True):
         return Degradation(False)
+    # adaptive partial aggregation (plan/agg_strategy.py): a bypassed
+    # partial emits pass-through rows and never builds grouped state —
+    # consult the flip decision BEFORE reserving revocable memory.
+    # (The executor already serves the bypass before planning spill;
+    # this guard keeps the invariant even for callers that plan
+    # degradation directly.)
+    if getattr(node, "step", "SINGLE") == "PARTIAL":
+        from presto_tpu.plan import agg_strategy as AS
+
+        if AS.enabled(session):
+            st = AS.flip_state(session, node)
+            if st is not None and st.bypassed:
+                return Degradation(False)
     nparts = int(session.properties.get("spill_partition_count", 8))
     max_depth = int(session.properties.get("spill_max_recursion_depth", 3))
 

@@ -132,6 +132,8 @@ class FileAuditLogListener(EventListener):
             v = getattr(s, name, 0)
             record[name] = float(v) if isinstance(v, float) else int(v)
         record["recovery"] = dict(s.recovery)
+        record["agg_strategy"] = dict(getattr(s, "agg_strategy", None)
+                                      or {})
         record["resource_group"] = s.resource_group or None
         record["trace_id"] = s.trace_id or None
         self._write(record)

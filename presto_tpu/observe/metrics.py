@@ -299,6 +299,10 @@ def ensure_query_metrics() -> None:
     REGISTRY.counter("presto_tpu_query_recovery_total",
                      "Cluster recovery actions by kind "
                      "(docs/ROBUSTNESS.md schema)", ("kind",))
+    REGISTRY.counter("presto_tpu_query_agg_strategy_total",
+                     "Grouped aggregates executed per planned strategy "
+                     "(plan/agg_strategy.py: one_pass/final_only/"
+                     "two_phase)", ("strategy",))
     REGISTRY.histogram("presto_tpu_query_wall_ms",
                        "End-to-end query wall time (ms)")
     REGISTRY.counter("presto_tpu_listener_errors_total",
@@ -324,6 +328,9 @@ def observe_query(stats) -> None:
     for kind, n in (getattr(stats, "recovery", None) or {}).items():
         REGISTRY.counter("presto_tpu_query_recovery_total", "",
                          ("kind",)).inc(float(n), kind=kind)
+    for strat, n in (getattr(stats, "agg_strategy", None) or {}).items():
+        REGISTRY.counter("presto_tpu_query_agg_strategy_total", "",
+                         ("strategy",)).inc(float(n), strategy=strat)
     REGISTRY.histogram("presto_tpu_query_wall_ms").observe(
         getattr(stats, "total_ns", 0) / 1e6)
 

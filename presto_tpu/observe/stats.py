@@ -143,6 +143,21 @@ class QueryStats:
     coalesce_ms: float = 0.0
     coalesce_batches: int = 0
     coalesce_fallbacks: int = 0
+    # adaptive aggregation economics (plan/agg_strategy.py, ROADMAP 2):
+    # partial_agg_ratio — the LAST reduction ratio a partial stage
+    # observed (live rows in / groups out; ~1.0 means the partial stage
+    # reduced nothing).  partial_aggs_bypassed — bypass events: chunked
+    # flips to the pass-through lane plus pass-through executions served
+    # in dynamic/cluster mode.  partial_aggs_reenabled — hysteresis
+    # recoveries (a probe saw the ratio come back and re-armed the
+    # partial stage).  agg_strategy — how each executed grouped
+    # aggregate was planned: strategy name -> count (one_pass /
+    # final_only / two_phase; exported like `recovery` as
+    # presto_tpu_query_agg_strategy_total{strategy}).
+    partial_agg_ratio: float = 0.0
+    partial_aggs_bypassed: int = 0
+    partial_aggs_reenabled: int = 0
+    agg_strategy: Dict[str, int] = dataclasses.field(default_factory=dict)
     result_cache_hit: int = 0
     resource_group: str = ""
     admission_wait_ms: float = 0.0

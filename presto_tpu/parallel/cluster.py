@@ -975,6 +975,14 @@ class _ClusterExecutor:
         for k, v in ex.sort_stats.items():
             if k.startswith("df_") and v:
                 self.df_counts[k] = self.df_counts.get(k, 0) + v
+            elif v and (k.startswith("agg_strategy::")
+                        or k in ("partial_aggs_bypassed",
+                                 "partial_aggs_reenabled")):
+                # adaptive-agg flip decisions + strategy counts ride the
+                # task status back to the coordinator (plan/agg_strategy)
+                self._count(k, v)
+            elif k == "partial_agg_ratio" and v:
+                self.counters[k] = round(float(v), 4)  # gauge, not a sum
         return self._fetch_out_cols(out)
 
     def _fetch_out_cols(self, out):
@@ -2102,9 +2110,9 @@ class ClusterSession:
                     group, cpu_s=time.monotonic() - t0q,
                     memory_bytes=int(self.session.properties.get(
                         "query_max_memory_bytes", 0)))
-        if self._coord_df:
-            from presto_tpu.exec.executor import _merge_sort_stats
+        from presto_tpu.exec.executor import _merge_sort_stats
 
+        if self._coord_df:
             _merge_sort_stats(mon.stats, self._coord_df)
         # fragment fusion: the successful attempt's plan-time decision
         # (fragments spliced) + the exchange-economics counters the
@@ -2113,6 +2121,14 @@ class ClusterSession:
         for k in ("exchange_bytes_host", "exchange_bytes_collective"):
             setattr(mon.stats, k, getattr(mon.stats, k, 0)
                     + int(self._coord_counters.get(k, 0)))
+        # adaptive aggregation: per-task flip decisions + strategy
+        # counts collected from worker task statuses and the
+        # coordinator's own fragment executor (plan/agg_strategy.py)
+        agg_counts = {k: v for k, v in self._coord_counters.items()
+                      if k.startswith("agg_strategy::")
+                      or k.startswith("partial_agg")}
+        if agg_counts:
+            _merge_sort_stats(mon.stats, agg_counts)
         mon.finish(result.rows)
         if getattr(result, "stats", None) is None:
             result.stats = mon.stats  # race-free vs session.last_stats
@@ -2650,6 +2666,7 @@ class ClusterSession:
                     except Exception:  # noqa: BLE001 — telemetry only
                         pass
         self._collect_task_traces(fragments, placements, ctx)
+        self._collect_agg_economics(fragments, placements, ctx)
         merged = [unpack_columns(p) for p in pages.get(0, [])]
         # single final page expected (gather output); concat defensively
         if len(merged) == 1:
@@ -2742,6 +2759,53 @@ class ClusterSession:
         lines.append(trace_summary_line(mon.stats))
         return QueryResult([("Query Plan", T.VARCHAR)],
                            [("\n".join(lines),)])
+
+    def _collect_agg_economics(self, fragments, placements, ctx) -> None:
+        """Post-success adaptive-agg counter collection: every worker
+        task of a fragment carrying a PARTIAL aggregate made its OWN
+        per-task flip decision (per-task ratio, plan/agg_strategy.py);
+        the decision counters ride the task status and fold into this
+        query's QueryStats here.  Best-effort and gated on the fragments
+        actually containing partial aggregates, so plans without them
+        keep their RPC sequence unchanged."""
+        from presto_tpu.plan import agg_strategy as AGS
+        from presto_tpu.plan import nodes as P
+
+        if not AGS.enabled(self.session):
+            return
+        if getattr(ctx, "recovery", None):
+            # degraded run (retries/hedges/worker deaths): a status GET
+            # to a dead worker stalls the probe timeout per slot —
+            # telemetry is not worth post-success stalls here, and the
+            # deterministic chaos fault plans keep their RPC sequences
+            return
+
+        def has_partial(node) -> bool:
+            if isinstance(node, P.Aggregate) and node.step == "PARTIAL":
+                return True
+            return any(has_partial(s)
+                       for s in getattr(node, "sources", []))
+
+        want = [f for f in fragments
+                if getattr(f, "on_workers", True) and has_partial(f.root)]
+        for frag in want:
+            for slot in placements.get(frag.fid, []):
+                if slot[0] is None:
+                    continue  # the coordinator's own fragment
+                try:
+                    st = json.loads(_http(
+                        f"{slot[0]}/v1/task/{slot[1]}/status",
+                        timeout=R.PROBE_TIMEOUT_S, ctx=ctx))
+                except Exception:  # noqa: BLE001 — telemetry only
+                    continue
+                for k, v in (st.get("counters") or {}).items():
+                    if k.startswith("agg_strategy::") \
+                            or k == "partial_aggs_bypassed" \
+                            or k == "partial_aggs_reenabled":
+                        self._coord_counters[k] = \
+                            self._coord_counters.get(k, 0) + int(v)
+                    elif k == "partial_agg_ratio" and v:
+                        self._coord_counters[k] = float(v)
 
     def _collect_task_traces(self, fragments, placements, ctx) -> None:
         """Post-success trace merge: pull each worker task's recorded

@@ -20,6 +20,7 @@ import dataclasses
 import os
 from typing import Dict, List, Optional, Tuple
 
+from presto_tpu.plan import agg_strategy as AS
 from presto_tpu.plan import ir
 from presto_tpu.plan import nodes as P
 from presto_tpu import types as T
@@ -222,10 +223,50 @@ class Distributer:
                         for a in node.aggs.values())
         cap = getattr(node, "capacity_hint", None)
         small = cap is not None and cap <= self.partial_agg_groups
-        if node.group_keys and (has_distinct or not mergeable or not small):
+        # aggregation strategy (plan/agg_strategy.py): a final_only
+        # aggregate routes rows to their group's shard and aggregates
+        # ONCE — the global-table route, no partial stage planned at
+        # all.  Chunked distribution (self.bucketed) is exempt: a
+        # repartition exchange between chunk fragments buffers at input
+        # scale, where the per-chunk partial state is tiny — there the
+        # partial stays planned and the RUNTIME bypass adapts instead.
+        strategy = getattr(node, "agg_strategy", None) \
+            if AS.enabled(self.session) else None
+        # final_only (repartition + single pass, the global-table route)
+        # is consumed only where it can actually win:
+        # - skew floor: with fewer distinct keys than ~4x the shard
+        #   count, the hash repartition lands everything on a few
+        #   shards (q1's four group combos over 8 devices overflow the
+        #   in-trace all_to_all capacity);
+        # - exchange-volume guard: the repartition moves EVERY input
+        #   row, while two-phase exchanges ~ndev x groups partial rows —
+        #   a strongly-reducing input (a 5-group GROUP BY over 15k rows)
+        #   stays on the tiny-partial split; final_only wins exactly
+        #   when the partial would NOT have reduced the exchange much.
+        est = getattr(node, "input_est_hint", None)
+        final_only = (node.group_keys and mergeable and not has_distinct
+                      and not self.bucketed and strategy == AS.FINAL_ONLY
+                      and cap is not None and cap >= 4 * self.ndev
+                      and est is not None
+                      and est <= cap * self.ndev * 4)
+        # chunked (virtual-time-axis) distribution: a repartition
+        # exchange between chunk fragments buffers at input scale
+        # either way, so a high-estimated-NDV GROUP BY keeps the
+        # partial/final split WITH THE RUNTIME BYPASS ARMED — the
+        # partial probes its own reduction ratio and flips to
+        # pass-through when it isn't paying (the adaptive plan is never
+        # much worse than single-phase and wins whenever the estimate
+        # was wrong the other way)
+        adaptive_chunked = (self.bucketed and node.group_keys and mergeable
+                            and not has_distinct
+                            and strategy in (AS.TWO_PHASE, AS.ONE_PASS))
+        if node.group_keys and (has_distinct or not mergeable
+                                or (not small and not adaptive_chunked)
+                                or final_only):
             # repartition rows so each group lands wholly on one shard,
             # then aggregate locally in a single phase (handles DISTINCT
-            # and non-decomposable aggregates for free)
+            # and non-decomposable aggregates for free; also the
+            # final_only strategy's single global grouping pass)
             node.source = P.Exchange(src, "repartition", list(node.group_keys))
             return node, Dist("hashed", tuple(node.group_keys))
         if not mergeable:
@@ -354,6 +395,20 @@ class Distributer:
         partial = P.Aggregate(src, list(node.group_keys), partial_aggs, "PARTIAL")
         partial.capacity_hint = getattr(node, "capacity_hint", None)
         partial.key_stats = getattr(node, "key_stats", {})
+        if AS.enabled(self.session):
+            # the split plans two phases: the partial carries the
+            # strategy (one_pass keeps the per-shard run-boundary
+            # grouping; anything else is two_phase with the runtime
+            # bypass armed) so executors count what actually ran and
+            # the flip monitor knows its node.  Ordering hints move to
+            # the partial with it — the partial's source IS the node's
+            # source, so the claims (still guard-verified) transfer.
+            s = getattr(node, "agg_strategy", None)
+            partial.agg_strategy = s if s == AS.ONE_PASS else AS.TWO_PHASE
+            for h in ("ordering_hint", "ordering_pack_order",
+                      "ordering_hint_safe", "input_est_hint"):
+                if hasattr(node, h):
+                    setattr(partial, h, getattr(node, h))
         gathered = P.Exchange(partial, "gather")
         final = P.Aggregate(gathered, list(node.group_keys), final_aggs, "FINAL")
         final.capacity_hint = getattr(node, "capacity_hint", None)

@@ -1331,6 +1331,15 @@ class PushPartialAggregationThroughExchange(Rule):
         if any(a.distinct or a.fn not in _MERGEABLE
                for a in node.aggs.values()):
             return None
+        from presto_tpu.plan import agg_strategy as AS
+
+        if AS.enabled(self.session) \
+                and getattr(node, "agg_strategy", None) == AS.FINAL_ONLY:
+            # final_only strategy: the single aggregation over the
+            # repartition IS the global-table route — pushing a partial
+            # through the exchange would re-plan the stage this
+            # strategy exists to avoid
+            return None
         src = ex.source
         d = Distributer(self.session)
         partial_aggs, final_aggs = d.decompose_aggs(node.aggs)
@@ -1341,6 +1350,8 @@ class PushPartialAggregationThroughExchange(Rule):
                               "PARTIAL")
         partial.capacity_hint = getattr(node, "capacity_hint", None)
         partial.key_stats = getattr(node, "key_stats", {})
+        if AS.enabled(self.session):
+            partial.agg_strategy = AS.TWO_PHASE  # runtime bypass armed
         new_ex = P.Exchange(partial, "repartition", list(ex.keys))
         final = P.Aggregate(new_ex, list(node.group_keys), final_aggs,
                             "FINAL")

@@ -56,6 +56,13 @@ def optimize(plan: P.QueryPlan, session) -> P.QueryPlan:
     from presto_tpu.plan import runtime_filters as RF
 
     RF.annotate(out, session)
+    # aggregation strategy (plan/agg_strategy.py): one_pass / final_only
+    # / two_phase per grouped Aggregate, from the ordering facts and NDV
+    # estimates the passes above just attached.  distribute() and the
+    # executor consume it; the string annotation rides fragment serde.
+    from presto_tpu.plan import agg_strategy as AS
+
+    AS.annotate(out, session)
     return out
 
 

@@ -48,6 +48,21 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # because literals and data round identically.
     "float32_compute": False,
     "partial_aggregation_max_groups": 8192,  # partial+gather vs repartition agg
+    # adaptive aggregation economics (plan/agg_strategy.py, docs/PERF.md
+    # round 17): the planner picks one_pass / final_only / two_phase per
+    # grouped Aggregate from ordering facts + NDV estimates, and the
+    # runtime monitors every two-phase partial stage's reduction ratio
+    # (rows in / groups out), flipping it to pass-through when the
+    # partial stops paying for itself — per-fragment, hysteresis-
+    # guarded, revisitable, checksum-neutral.  Kill switches: this
+    # property or env PRESTO_TPU_ADAPTIVE_AGG=off.
+    # partial_agg_min_reduction: reduction below this flips the stage
+    # (default measured by tools/roofline.py's `agg` sweep).
+    # agg_final_only_max_groups: NDV-estimate ceiling for the planner's
+    # single global-table route (no partial stage planned at all).
+    "adaptive_partial_agg": True,
+    "partial_agg_min_reduction": 1.3,
+    "agg_final_only_max_groups": 4096,
     # per-plan-node stats collection in dynamic mode (forced by EXPLAIN
     # ANALYZE; costs one host sync per operator — reference: OperationTimer)
     "collect_node_stats": False,

@@ -1,0 +1,476 @@
+"""Adaptive aggregation economics (ISSUE 13, plan/agg_strategy.py).
+
+Two adaptive levels under test:
+
+1. planner strategy — one_pass (presorted run-boundary) / final_only
+   (single global grouping pass) / two_phase (partial+final with the
+   runtime bypass armed), chosen from ordering facts + NDV estimates
+   and counted per executed aggregate in QueryStats.agg_strategy;
+2. runtime bypass — chunked/cluster partial stages monitor their
+   reduction ratio (rows in / groups out) and flip to pass-through
+   when the partial stops paying, hysteresis-guarded and
+   checksum-neutral (on == off asserted here).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Column
+from presto_tpu.catalog import tpch_catalog
+from presto_tpu.plan import agg_strategy as AS
+from presto_tpu.plan import ir
+from presto_tpu.plan import nodes as P
+
+SF = 0.02
+CACHE = "/tmp/presto_tpu_cache"
+
+# per-chunk groups ~= rows (each (partkey, quantity) pair is ~unique in
+# a chunk): the q67-class shape whose partial stage reduces nothing
+Q67_CLASS = ("SELECT l_partkey, l_quantity, count(*) c, "
+             "sum(l_extendedprice) s, avg(l_discount) a FROM lineitem "
+             "GROUP BY l_partkey, l_quantity ORDER BY s DESC, "
+             "l_partkey LIMIT 50")
+# q1-class: a handful of groups — the partial stage reduces thousands
+# of rows per chunk to ~8 and must NEVER bypass
+Q1_CLASS = ("SELECT l_returnflag, l_linestatus, count(*) c, "
+            "sum(l_quantity) s FROM lineitem "
+            "GROUP BY l_returnflag, l_linestatus ORDER BY 1, 2")
+
+
+def norm(rows):
+    return [tuple(round(v, 2) if isinstance(v, float) else v for v in r)
+            for r in rows]
+
+
+def chunked_session(**props):
+    s = presto_tpu.connect(tpch_catalog(SF, cache_dir=CACHE))
+    s.properties["chunked_rows_threshold"] = 50_000
+    s.properties["chunk_orders"] = 2_000  # ~15 chunks at SF0.02
+    for k, v in props.items():
+        s.set(k, v)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# hysteresis unit
+# ---------------------------------------------------------------------------
+
+def test_flip_state_hysteresis_and_reenable():
+    st = AS.FlipState()
+    thr = 1.3
+    # one bad window is not enough (FLIP_STRIKES == 2)
+    assert st.observe(1.0, thr) == ""
+    assert not st.bypassed
+    assert st.observe(1.1, thr) == "flipped"
+    assert st.bypassed
+    # while bypassed, serves accumulate until the probe is due
+    for _ in range(AS.RECHECK_EVERY - 1):
+        st.note_bypassed()
+        assert not st.probe_due()
+    st.note_bypassed()
+    assert st.probe_due()
+    # a probe that still sees a bad ratio stays bypassed (and resets
+    # the probe cadence)
+    assert st.observe(1.2, thr) == ""
+    assert st.bypassed and not st.probe_due()
+    # recovery needs REENABLE_FACTOR headroom, not just the threshold
+    for _ in range(AS.RECHECK_EVERY):
+        st.note_bypassed()
+    assert st.observe(thr * 1.1, thr) == ""  # above thr, below 2x thr
+    assert st.bypassed
+    for _ in range(AS.RECHECK_EVERY):
+        st.note_bypassed()
+    assert st.observe(thr * AS.REENABLE_FACTOR + 0.1, thr) == "reenabled"
+    assert not st.bypassed
+    # a single bad window after recovery does not immediately re-flip
+    assert st.observe(1.0, thr) == ""
+    assert st.observe(2.0, thr) == ""  # good window clears the strike
+    assert st.observe(1.0, thr) == ""
+    assert st.observe(1.0, thr) == "flipped"
+
+
+# ---------------------------------------------------------------------------
+# pass-through transform units
+# ---------------------------------------------------------------------------
+
+def test_passthrough_exprs_cover_decomposed_partials():
+    """Every partial the split plans for count/sum/avg/min/max/stddev
+    has a per-row form; FILTER/checksum partials do not (the fragment
+    is then not bypassable)."""
+    x = ir.Ref("x", T.DOUBLE)
+    assert isinstance(AS._row_expr(ir.AggCall("count", (), T.BIGINT)),
+                      ir.Lit)
+    assert AS._row_expr(ir.AggCall("count", (x,), T.BIGINT)) is not None
+    assert AS._row_expr(ir.AggCall("sum", (x,), T.DOUBLE)) is x
+    assert AS._row_expr(ir.AggCall("min", (x,), T.DOUBLE)) is x
+    assert AS._row_expr(
+        ir.AggCall("partial_sum_double", (x,), T.DOUBLE)) is not None
+    assert AS._row_expr(
+        ir.AggCall("partial_sum_sq_double", (x,), T.DOUBLE)) is not None
+    # no row form: FILTER, DISTINCT, checksum
+    flt = ir.Call("gt", (x, ir.Lit(0.0, T.DOUBLE)), T.BOOLEAN)
+    assert AS._row_expr(
+        ir.AggCall("sum", (x,), T.DOUBLE, False, flt)) is None
+    assert AS._row_expr(ir.AggCall("sum", (x,), T.DOUBLE, True)) is None
+    assert AS._row_expr(ir.AggCall("checksum", (x,), T.BIGINT)) is None
+
+
+def test_strategy_annotation_rides_plan_serde():
+    from presto_tpu.plan import serde
+
+    node = P.Aggregate(P.Values(["k"], [T.BIGINT], [[1]]),
+                       ["k"], {"c": ir.AggCall("count", (), T.BIGINT)},
+                       "PARTIAL")
+    node.agg_strategy = AS.TWO_PHASE
+    back = serde.loads(serde.dumps(node))
+    assert getattr(back, "agg_strategy", None) == AS.TWO_PHASE
+
+
+# ---------------------------------------------------------------------------
+# planner strategy choice
+# ---------------------------------------------------------------------------
+
+def test_presorted_input_plans_one_pass_zero_partial():
+    """Acceptance: a presorted-input GROUP BY plans the run-boundary
+    one-pass strategy with NO partial stage, and the agg_strategy
+    counter says so."""
+    s = presto_tpu.connect(tpch_catalog(0.01, cache_dir=CACHE))
+    sql = ("SELECT o_orderkey, count(*) c FROM orders "
+           "GROUP BY o_orderkey ORDER BY o_orderkey LIMIT 10")
+    plan_text = s.sql("EXPLAIN " + sql).rows[0][0]
+    assert "PARTIAL" not in plan_text
+    r = s.sql(sql)
+    assert r.stats.agg_strategy == {"one_pass": 1}
+    assert r.stats.sorts_elided > 0  # the run-boundary scan ran
+    assert r.stats.partial_aggs_bypassed == 0
+
+
+def test_low_ndv_counts_final_only_single_device():
+    s = presto_tpu.connect(tpch_catalog(0.01, cache_dir=CACHE))
+    r = s.sql("SELECT l_returnflag, count(*) c FROM lineitem "
+              "GROUP BY l_returnflag")
+    assert r.stats.agg_strategy == {"final_only": 1}
+
+
+def test_final_only_distribution_plans_no_partial_stage():
+    """Mid-NDV, low-reduction input: the distributed plan routes
+    repartition + ONE grouping pass — no PARTIAL aggregate anywhere."""
+    from presto_tpu.exec.executor import plan_statement
+    from presto_tpu.plan.distribute import distribute
+    from presto_tpu.sql.parser import parse
+
+    s = presto_tpu.connect(tpch_catalog(0.01, cache_dir=CACHE))
+    sql = ("SELECT o_custkey, count(*) c, sum(o_totalprice) s "
+           "FROM orders WHERE o_orderkey <= 6000 GROUP BY o_custkey")
+    plan = plan_statement(s, parse(sql))
+
+    def steps(node, out):
+        if isinstance(node, P.Aggregate):
+            out.append((node.step, getattr(node, "agg_strategy", None)))
+        for src in getattr(node, "sources", []):
+            steps(src, out)
+
+    got = []
+    steps(plan.root, got)
+    assert got and got[0][1] == AS.FINAL_ONLY, got
+    dplan = distribute(plan, s, ndev=2)
+    dsteps = []
+    steps(dplan.root, dsteps)
+    assert all(step == "SINGLE" for step, _ in dsteps), dsteps
+    # kill switch restores the partial/final split
+    s.set("adaptive_partial_agg", False)
+    plan2 = plan_statement(s, parse(sql))
+    dplan2 = distribute(plan2, s, ndev=2)
+    dsteps2 = []
+    steps(dplan2.root, dsteps2)
+    assert any(step == "PARTIAL" for step, _ in dsteps2), dsteps2
+
+
+def test_mis_estimated_ndv_degrades_via_runtime_guard():
+    """A lying LOW ndv estimate routes final_only with a tiny capacity
+    hint; the static grouping's overflow guard catches the lie and the
+    query degrades to the dynamic path with correct results — a wrong
+    estimate can never produce wrong rows (the inverse lie — a HIGH
+    estimate on a non-reducing input — is what the runtime bypass
+    handles, exercised by the chunked q67-class test)."""
+    from presto_tpu.plan import stats as PS
+
+    s = presto_tpu.connect(tpch_catalog(0.01, cache_dir=CACHE))
+    sql = ("SELECT o_custkey, count(*) c FROM orders "
+           "GROUP BY o_custkey ORDER BY c DESC, o_custkey LIMIT 10")
+    want = norm(s.sql(sql).rows)
+    t = s.catalog.get("orders")
+    real = t.column_stats("o_custkey")
+    t.column_stats = lambda col, _r=t.column_stats: (
+        PS.ColStats(real.min, real.max, 4) if col == "o_custkey"
+        else _r(col))
+    try:
+        s2 = presto_tpu.connect(s.catalog)
+        r = s2.sql(sql)
+        assert norm(r.rows) == want
+    finally:
+        del t.column_stats  # restore the class method
+
+
+# ---------------------------------------------------------------------------
+# chunked runtime bypass (the tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def adaptive_chunked():
+    return chunked_session()
+
+
+def test_chunked_q67_class_bypasses_with_equal_checksums(adaptive_chunked):
+    """Acceptance: the q67-class chunked run flips its partial stage to
+    pass-through (partial_aggs_bypassed >= 1), the observed ratio is
+    ~1, and the bypass is checksum-neutral vs the kill switch AND vs
+    the single-device executors."""
+    s = adaptive_chunked
+    r = s.sql(Q67_CLASS)
+    assert r.stats.execution_mode == "chunked"
+    assert r.stats.partial_aggs_bypassed >= 1
+    assert 0 < r.stats.partial_agg_ratio < AS.min_reduction(s)
+    assert r.stats.agg_strategy.get("two_phase", 0) >= 1
+    off = chunked_session(adaptive_partial_agg=False)
+    r_off = off.sql(Q67_CLASS)
+    assert r_off.stats.partial_aggs_bypassed == 0
+    assert norm(r.rows) == norm(r_off.rows)
+
+
+@pytest.mark.slow
+def test_chunked_q67_class_matches_single_device(adaptive_chunked):
+    """Cross-executor leg of the acceptance (tier-2 for budget, like
+    the round-6 demotions): the bypassed chunked plan agrees with the
+    single-device compiled AND dynamic executors."""
+    r = adaptive_chunked.sql(Q67_CLASS)
+    whole = presto_tpu.connect(tpch_catalog(SF, cache_dir=CACHE))
+    assert norm(whole.sql(Q67_CLASS).rows) == norm(r.rows)
+    whole.set("execution_mode", "dynamic")
+    assert norm(whole.sql(Q67_CLASS).rows) == norm(r.rows)
+
+
+def test_chunked_warm_rerun_after_flip_compiles_zero(adaptive_chunked):
+    """Acceptance: both lanes are pre-keyed in the compile cache — a
+    warm re-run after a mid-query flip builds NOTHING (compiles == 0)
+    and still serves the bypassed plan."""
+    s = adaptive_chunked
+    s.sql(Q67_CLASS)  # ensure both lanes built (flip happened here)
+    r = s.sql(Q67_CLASS)
+    assert r.stats.compiles == 0
+    # the warm run resumed the flip: bypass still reported
+    assert r.stats.partial_aggs_bypassed >= 1
+
+
+def test_chunked_q1_class_low_ndv_never_bypasses(adaptive_chunked):
+    s = adaptive_chunked
+    r = s.sql(Q1_CLASS)
+    assert r.stats.execution_mode == "chunked"
+    assert r.stats.partial_aggs_bypassed == 0
+    assert r.stats.partial_aggs_reenabled == 0
+    whole = presto_tpu.connect(tpch_catalog(SF, cache_dir=CACHE))
+    assert norm(r.rows) == norm(whole.sql(Q1_CLASS).rows)
+
+
+# ---------------------------------------------------------------------------
+# dynamic-executor bypass + spill interaction
+# ---------------------------------------------------------------------------
+
+def _partial_agg_plan(session, sql):
+    """(partial-step Aggregate node, its session) from a single-device
+    plan — the unit handle for executor-level partial-agg behavior."""
+    from presto_tpu.exec.executor import plan_statement
+    from presto_tpu.sql.parser import parse
+
+    plan = plan_statement(session, parse(sql))
+    node = plan.root
+    while not isinstance(node, P.Aggregate):
+        node = node.source
+    node.step = "PARTIAL"
+    return node
+
+
+def test_bypassed_partial_skips_spill_reservation():
+    """Satellite acceptance: an armed spill + a bypassed partial never
+    builds spillable state — plan_degradation is consulted AFTER the
+    flip decision and a bypassed stage reserves no revocable memory."""
+    from presto_tpu.exec import spill_exec as SE
+    from presto_tpu.exec.executor import Executor
+
+    s = presto_tpu.connect(tpch_catalog(0.01, cache_dir=CACHE))
+    node = _partial_agg_plan(
+        s, "SELECT l_partkey, sum(l_quantity) s FROM lineitem "
+           "GROUP BY l_partkey")
+    st = AS.flip_state(s, node)
+    assert st is not None
+    calls = []
+    orig = SE.plan_degradation
+
+    def spy(ex, n, est, cap, **kw):
+        calls.append(n)
+        return orig(ex, n, est, cap, **kw)
+
+    SE.plan_degradation = spy
+    try:
+        st.bypassed = True
+        ex = Executor(s)
+        out = ex.exec_node(node)
+        assert not calls, "bypassed partial still planned degradation"
+        assert ex.sort_stats.get("partial_aggs_bypassed") == 1
+        # pass-through: one output row per input row, partial schema
+        assert int(out.sel.shape[0]) > 10_000
+        assert set(node.aggs) <= set(out.columns)
+        st.bypassed = False
+        ex2 = Executor(s)
+        out2 = ex2.exec_node(node)
+        assert calls, "grouped partial must plan degradation again"
+        assert int(jnp.sum(out2.sel)) < int(jnp.sum(out.sel))
+    finally:
+        SE.plan_degradation = orig
+        st.bypassed = False
+
+
+def test_plan_degradation_consults_flip_state_directly():
+    """Even a direct caller of plan_degradation (the spill layer's own
+    belt-and-suspenders) sees no-degrade for a bypassed partial with a
+    FORCED spill tier armed."""
+    from presto_tpu.exec import spill_exec as SE
+    from presto_tpu.exec.executor import Executor
+
+    s = presto_tpu.connect(tpch_catalog(0.01, cache_dir=CACHE))
+    node = _partial_agg_plan(
+        s, "SELECT l_suppkey, sum(l_tax) s FROM lineitem "
+           "GROUP BY l_suppkey")
+    st = AS.flip_state(s, node)
+    s.set("force_spill", "partial")
+    try:
+        ex = Executor(s)
+        st.bypassed = True
+        dec = SE.plan_degradation(ex, node, 1 << 30, 1 << 20)
+        assert not dec.degrade and not dec.mem_key
+        st.bypassed = False
+        dec2 = SE.plan_degradation(ex, node, 1 << 30, 1 << 20)
+        assert dec2.degrade
+    finally:
+        s.set("force_spill", "")
+        st.bypassed = False
+
+
+def test_dynamic_partial_observes_ratio_and_flips():
+    """Dynamic/cluster lane: each partial execution feeds the session's
+    flip state; FLIP_STRIKES consecutive non-reducing executions flip
+    it, and later executions are served as pass-through (what a
+    cluster worker does task-over-task)."""
+    from presto_tpu.exec.executor import Executor
+
+    s = presto_tpu.connect(tpch_catalog(0.01, cache_dir=CACHE))
+    node = _partial_agg_plan(
+        s, "SELECT l_orderkey, l_linenumber, count(*) c FROM lineitem "
+           "GROUP BY l_orderkey, l_linenumber")
+    # (l_orderkey, l_linenumber) is the primary key: ratio == 1.0
+    stats = {}
+    for i in range(AS.FLIP_STRIKES):
+        ex = Executor(s, sort_stats=stats)
+        ex.exec_node(node)
+    st = AS.flip_state(s, node)
+    assert st.bypassed, stats
+    assert stats.get("partial_agg_ratio") == pytest.approx(1.0)
+    ex = Executor(s, sort_stats=stats)
+    ex.exec_node(node)
+    assert stats.get("partial_aggs_bypassed", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# cluster: per-task decisions ride task status to the coordinator
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cluster_partial_agg_counters_ride_task_status():
+    """Tier-2 (in-process cluster spin-up ~10s on the 1-core box); the
+    per-task flip mechanism itself is tier-1-covered by
+    test_dynamic_partial_observes_ratio_and_flips — this leg checks the
+    counters RIDE TASK STATUS into coordinator QueryStats."""
+    from presto_tpu.parallel import cluster as C
+
+    session = presto_tpu.connect(tpch_catalog(0.01, cache_dir=CACHE))
+    workers = [C.WorkerServer(f"tpch:0.01:{CACHE}").start()
+               for _ in range(2)]
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    sql = ("SELECT l_partkey, l_quantity, count(*) c FROM lineitem "
+           "GROUP BY l_partkey, l_quantity ORDER BY c DESC, "
+           "l_partkey LIMIT 20")
+    try:
+        want = None
+        seen_bypass = 0
+        for _ in range(AS.FLIP_STRIKES + 1):
+            r = cs.sql(sql)
+            if want is None:
+                want = norm(r.rows)
+            assert norm(r.rows) == want
+            seen_bypass = max(seen_bypass,
+                              r.stats.partial_aggs_bypassed)
+            assert r.stats.agg_strategy.get("two_phase", 0) >= 1
+        # the workers' per-task ratio flipped their partials; the
+        # decision rode the task status into coordinator QueryStats
+        assert seen_bypass >= 1
+        assert norm(session.sql(sql).rows) == want
+    finally:
+        for w in workers:
+            w.stop()
+
+
+# ---------------------------------------------------------------------------
+# group-id mapping memo (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+def test_group_id_mapping_memoized_for_repeat_grouping():
+    """AVG/STDDEV-style fold passes re-grouping IDENTICAL key arrays
+    reuse the (gid, representatives, count) mapping — K.group_ids runs
+    once, not once per pass (the PR-3 sort-permutation-memo
+    discipline, now covering the whole group index)."""
+    from presto_tpu.exec import kernels as K
+    from presto_tpu.exec.executor import Executor
+
+    s = presto_tpu.connect(tpch_catalog(0.01, cache_dir=CACHE))
+    n = 50_000
+    keys = jnp.arange(n, dtype=jnp.int64) % 1000
+    vals = jnp.arange(n, dtype=jnp.float64) * 0.5
+    b = Batch({"k": Column(keys, None, T.BIGINT),
+               "v": Column(vals, None, T.DOUBLE)},
+              jnp.ones((n,), bool))
+    ex = Executor(s)
+    calls = []
+    orig = K.group_ids
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    K.group_ids = spy
+    try:
+        avg = ex._aggregate(
+            b, ["k"],
+            {"a": ir.AggCall("avg", (ir.Ref("v", T.DOUBLE),), T.DOUBLE)})
+        sd = ex._aggregate(
+            b, ["k"],
+            {"d": ir.AggCall("stddev", (ir.Ref("v", T.DOUBLE),),
+                             T.DOUBLE)})
+    finally:
+        K.group_ids = orig
+    assert len(calls) == 1, "group index rebuilt for identical keys"
+    assert avg.capacity == sd.capacity == 1000
+    # kill switch disables the memo with the rest of the sort economics
+    s.set("ordering_aware_execution", False)
+    ex2 = Executor(s)
+    calls.clear()
+    K.group_ids = spy
+    try:
+        ex2._aggregate(b, ["k"], {"a": ir.AggCall(
+            "avg", (ir.Ref("v", T.DOUBLE),), T.DOUBLE)})
+        ex2._aggregate(b, ["k"], {"d": ir.AggCall(
+            "stddev", (ir.Ref("v", T.DOUBLE),), T.DOUBLE)})
+    finally:
+        K.group_ids = orig
+    assert len(calls) == 2

@@ -150,6 +150,12 @@ def test_push_partial_aggregation_through_exchange(tpch_catalog_tiny):
 
     s = presto_tpu.connect(tpch_catalog_tiny)
     s.properties["partial_aggregation_max_groups"] = 4  # force repartition
+    # ... and keep the round-17 strategy pass out of the final_only
+    # route for the same simulated-big-ndv reason: a genuinely high
+    # estimate reads two_phase, which is the shape this rule serves
+    # (final_only deliberately suppresses the push — the single
+    # grouping pass over the repartition IS that strategy)
+    s.properties["agg_final_only_max_groups"] = 2
     sql = ("SELECT o_custkey, count(*) AS c, sum(o_totalprice) AS t "
            "FROM orders GROUP BY o_custkey")
     plan = plan_statement(s, parse(sql))

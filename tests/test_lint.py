@@ -197,6 +197,67 @@ def test_no_raw_vmap_outside_exec():
     assert not bad, "\n".join(bad)
 
 
+def test_grouping_primitives_confined_to_agg_layer():
+    """Adaptive-aggregation gate (ISSUE 13): the aggregation grouping
+    primitives — raw `jax.ops.segment_*` scatters and the kernel-layer
+    `segment_*` / `group_ids*` wrappers — are confined to the
+    aggregation execution layer, so every grouping pass is routed
+    (strategy-counted via agg_strategy, ratio-monitored by the partial
+    bypass) and covered by the kernel equivalence tests.  Raw
+    `jax.ops.segment_*` lives ONLY in exec/kernels.py; the K.* wrappers
+    may be called from exec/kernels.py + exec/spill_exec.py and the
+    executor-family modules that lower Aggregate/Window nodes
+    (executor, dec128, window).  A grouping primitive appearing in
+    plan/ server/ parallel/ storage/ would bypass the adaptive
+    machinery entirely."""
+    import ast
+
+    pkg = os.path.join(ROOT, "presto_tpu")
+    RAW_OK = {os.path.join("exec", "kernels.py")}
+    WRAPPER_OK = RAW_OK | {
+        os.path.join("exec", f) for f in
+        ("spill_exec.py", "executor.py", "dec128.py", "window.py")}
+    GROUPING = ("segment_", "group_ids")
+    KERNEL_NS = {"K", "KK", "kernels"}
+    bad = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg)
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                attr = node.func.attr
+                if not attr.startswith(GROUPING):
+                    continue
+                base = node.func.value
+                # raw jax.ops.segment_* (ops is itself an attribute of
+                # jax, or imported as a bare name)
+                is_raw = (isinstance(base, ast.Attribute)
+                          and base.attr == "ops") \
+                    or (isinstance(base, ast.Name) and base.id == "ops")
+                # kernel-layer wrapper through the conventional aliases
+                is_wrapper = isinstance(base, ast.Name) \
+                    and base.id in KERNEL_NS
+                if is_raw and rel not in RAW_OK:
+                    bad.append(f"{rel}:{node.lineno}: raw jax.ops.{attr}"
+                               " — grouping scatters belong in "
+                               "exec/kernels.py (use K.segment_*/"
+                               "K.segment_any)")
+                elif is_wrapper and rel not in WRAPPER_OK:
+                    bad.append(f"{rel}:{node.lineno}: K.{attr} — "
+                               "grouping belongs in the aggregation "
+                               "execution layer (exec/kernels.py + "
+                               "exec/spill_exec.py and the executor "
+                               "family)")
+    assert not bad, "\n".join(bad)
+
+
 def test_no_raw_span_timing_outside_observe():
     """Observability gate (ISSUE 9): wall/span clock reads —
     `time.time()`, `time.perf_counter()`, `time.perf_counter_ns()` —

@@ -87,3 +87,31 @@ def test_override_query_checksum_across_executors(sessions, qid):
         cs_dist = _checksum(dist.sql(sql).rows)
         assert cs_dyn == cs_dist, \
             f"q{qid}: dynamic vs distributed disagree"
+
+
+@pytest.mark.slow
+def test_q67_agg_economics_counters(sessions):
+    """Adaptive-agg economics on the verifier sweep's worst shape
+    (ISSUE 13): q67's rollup expansion is all high-cardinality GROUP
+    BYs — every executed grouped aggregate must carry a planned
+    strategy (the agg_strategy counter), the checksum must agree
+    between the dynamic and compiled executors WITH the adaptive
+    machinery armed, and the kill switch must not change results."""
+    dyn, comp, _dist = sessions
+    sql = QUERIES[67]
+    r = dyn.sql(sql)
+    assert r.rows, "q67: empty result verifies nothing"
+    assert r.stats.agg_strategy, \
+        "q67 executed grouped aggregates without a strategy count"
+    assert sum(r.stats.agg_strategy.values()) >= 1
+    assert set(r.stats.agg_strategy) <= {"one_pass", "final_only",
+                                         "two_phase"}
+    cs = _checksum(r.rows)
+    assert cs == _checksum(comp.sql(sql).rows), \
+        "q67: dynamic vs compiled disagree with adaptive agg on"
+    dyn.set("adaptive_partial_agg", False)
+    try:
+        assert cs == _checksum(dyn.sql(sql).rows), \
+            "q67: adaptive_partial_agg on==off checksums differ"
+    finally:
+        dyn.set("adaptive_partial_agg", True)

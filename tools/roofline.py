@@ -210,6 +210,91 @@ def main():
         per_iter(timed(bp_presorted_loop, build_, probe_)) * 1000, 1)
     out["ordering"] = oout
 
+    # --- aggregation economics: reduction ratio x strategy ------------
+    # Anchors plan/agg_strategy.py: what one GROUP BY pass costs under
+    # each strategy as the partial stage's reduction ratio (rows /
+    # groups) varies.  two_phase = 8 per-chunk partial groupings + a
+    # final merge over the partial outputs (the chunked/cluster
+    # pipeline); final_only = ONE global grouping pass (what the
+    # runtime bypass degenerates to — pass-through rows cost nothing to
+    # produce); presorted = the PR-3 run-boundary scan (no sort at
+    # all).  The partial_agg_min_reduction default comes from the
+    # measured two_phase/final_only crossover: below it the partial
+    # stage costs a full grouping pass per chunk and buys back almost
+    # nothing in the final stage.
+    aout = {}
+    crossovers = []
+    NCHUNK = 8
+    for nexp in (20, 22, 23):  # 1M / 4M / 8M keys
+        n = 1 << nexp
+        acell = {}
+        for red in (1, 2, 10, 100):
+            ndv = max(n // red, 1)
+            keys = jnp.asarray(rng.integers(0, ndv, n).astype(np.int32))
+            skeys = jnp.asarray(np.sort(np.asarray(keys)))
+            vals = jnp.asarray(rng.normal(size=n).astype(np.float32))
+            gcap = min(1 << max(ndv - 1, 1).bit_length(), n)
+            ccap = min(gcap, n // NCHUNK)  # per-chunk groups bound
+            rows_c = n // NCHUNK
+
+            @jax.jit
+            def two_phase(k, v):
+                def body(i, s):
+                    pk_parts = []
+                    pv_parts = []
+                    for c in range(NCHUNK):
+                        kc = lax.dynamic_slice(k, (c * rows_c,),
+                                               (rows_c,)) + s
+                        vc = lax.dynamic_slice(v, (c * rows_c,),
+                                               (rows_c,))
+                        gid, rep, ex, ov = KK.group_ids_static(kc, ccap)
+                        pv_parts.append(KK.segment_sum(vc, gid, ccap))
+                        pk_parts.append(kc[rep])
+                    pk = jnp.concatenate(pk_parts)
+                    pv = jnp.concatenate(pv_parts)
+                    gid, rep, ex, ov = KK.group_ids_static(pk, gcap)
+                    fin = KK.segment_sum(pv, gid, gcap)
+                    # real loop-carried data dependence: XLA cannot
+                    # hoist or elide the grouping passes
+                    return (rep[0] ^ fin[0].astype(jnp.int32)) & 1
+                return lax.fori_loop(0, K, body, jnp.int32(0))
+
+            @jax.jit
+            def final_only(k, v):
+                def body(i, s):
+                    gid, rep, ex, ov = KK.group_ids_static(k + s, gcap)
+                    fin = KK.segment_sum(v, gid, gcap)
+                    return (rep[0] ^ fin[0].astype(jnp.int32)) & 1
+                return lax.fori_loop(0, K, body, jnp.int32(0))
+
+            @jax.jit
+            def presorted(k, v):
+                def body(i, s):
+                    gid, rep, ex, ov, g = KK.group_ids_presorted_static(
+                        k + s, gcap)
+                    fin = KK.segment_sum(v, gid, gcap)
+                    return (rep[0] ^ fin[0].astype(jnp.int32)) & 1
+                return lax.fori_loop(0, K, body, jnp.int32(0))
+
+            cell = {
+                "two_phase_ms": round(
+                    per_iter(timed(two_phase, keys, vals)) * 1000, 2),
+                "final_only_ms": round(
+                    per_iter(timed(final_only, keys, vals)) * 1000, 2),
+                "presorted_ms": round(
+                    per_iter(timed(presorted, skeys, vals)) * 1000, 2),
+            }
+            if cell["final_only_ms"] < cell["two_phase_ms"]:
+                crossovers.append(red)
+            acell[f"r{red}x"] = cell
+        aout[f"n{n >> 20}M"] = acell
+    # the largest reduction ratio at which single-phase still beat
+    # two-phase: the bypass threshold should sit just above ratio 1
+    # (never flip a genuinely reducing partial) but below the smallest
+    # measured win — the committed default is 1.3
+    aout["single_phase_won_at_ratios"] = sorted(set(crossovers))
+    out["agg"] = aout
+
     # --- compile economics: compile-ms vs fragment count x mult -------
     # Frames the exec/compile_cache.py design: what a cold chunked plan
     # pays in XLA compiles (per fragment, per bound-mult variant) and
