@@ -833,7 +833,7 @@ def _serve_gate(record, committed):
 
 
 MULTICHIP_RECORD_PATH = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r06.json")
+    os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r07.json")
 
 
 SPILL_RECORD_PATH = os.path.join(
@@ -992,7 +992,7 @@ def load_multichip_record():
 
 
 def multichip_summary():
-    """The committed fused-vs-fragment-cut record (bench.py --multichip
+    """The committed fused-vs-cut-vs-auto record (bench.py --multichip
     re-measures it); a default run reports it without re-measuring."""
     rec = load_multichip_record()
     if rec is None:
@@ -1001,20 +1001,33 @@ def multichip_summary():
             "n_devices": rec.get("n_devices"), "sf": rec.get("sf"),
             "queries": {q: {"fused_warm_ms": v.get("fused_warm_ms"),
                             "cut_warm_ms": v.get("cut_warm_ms"),
-                            "speedup": v.get("speedup")}
+                            "auto_warm_ms": v.get("auto_warm_ms"),
+                            "speedup": v.get("speedup"),
+                            "auto_vs_best": v.get("auto_vs_best")}
                         for q, v in (rec.get("queries") or {}).items()},
             "gate": rec.get("gate"), "asof": rec.get("asof")}
+
+
+#: the auto leg must land within this factor of the BETTER forced leg
+#: (the round-18 fusion-cost acceptance bar: no silent fuse-regressions)
+MULTICHIP_AUTO_RATIO = 1.1
 
 
 def multichip_bench():
     """`bench.py --multichip`: the distributed gate queries (q3/q18)
     over an in-process cluster whose worker declares the local device
-    mesh — fragment-FUSED (one traced shard_map program, exchanges as
-    collectives) vs fragment-CUT (per-fragment HTTP pages), cold + warm
-    wall-clock with checksum equality and the exchange-byte counters.
-    Writes MULTICHIP_r06.json; on a CPU host the record anchors the
-    MECHANISM (and the host-exchange bytes deleted), chip wall-clock
-    comes from re-running this on real hardware."""
+    mesh — three legs per query: fragment_fusion=force (round 12's
+    one-shard_map-program policy), =off (per-fragment HTTP pages), and
+    =auto (the round-18 plan/fusion_cost.py per-edge cost model; runs
+    LAST so the decision memo has both forced legs' observed walls —
+    exactly the steady state a production A/B reaches).  Cold + warm
+    wall-clock, checksum equality across all three, exchange-byte
+    counters, and the per-edge skip reasons.  The gate requires the
+    auto leg within MULTICHIP_AUTO_RATIO of the BETTER forced leg on
+    every query — a silent fuse-regression (the old q18 2056ms-vs-747ms
+    shape) is now a red record.  Writes MULTICHIP_r07.json; on a CPU
+    host the record anchors the MECHANISM, chip wall-clock comes from
+    re-running this on real hardware."""
     import jax
 
     import presto_tpu
@@ -1035,7 +1048,8 @@ def multichip_bench():
         return sorted(tuple(round(x, 4) if isinstance(x, float) else x
                             for x in r) for r in rows)
 
-    def leg(q):
+    def leg(q, mode):
+        session.set("fragment_fusion", mode)
         t0 = time.perf_counter()
         r = cs.sql(q)
         cold = (time.perf_counter() - t0) * 1000
@@ -1046,7 +1060,7 @@ def multichip_bench():
             best = min(best, (time.perf_counter() - t0) * 1000)
         return r, round(cold, 1), round(best, 1)
 
-    record = {"metric": "multichip_fused_vs_cut_wall_ms",
+    record = {"metric": "multichip_fused_vs_cut_vs_auto_wall_ms",
               "platform": jax.devices()[0].platform,
               "n_devices": ndev, "sf": sf, "runs": runs,
               "queries": {}, "asof": _today()}
@@ -1054,19 +1068,29 @@ def multichip_bench():
     try:
         for qid in (3, 18):
             q = QUERIES[qid]
-            session.set("fragment_fusion", True)
-            rf, f_cold, f_warm = leg(q)
-            session.set("fragment_fusion", False)
-            rc, c_cold, c_warm = leg(q)
-            session.set("fragment_fusion", True)
-            equal = norm(rf.rows) == norm(rc.rows)
+            rf, f_cold, f_warm = leg(q, "force")
+            rc, c_cold, c_warm = leg(q, "off")
+            ra, a_cold, a_warm = leg(q, "auto")
+            session.set("fragment_fusion", "auto")
+            equal = norm(rf.rows) == norm(rc.rows) == norm(ra.rows)
+            best_forced = min(f_warm, c_warm)
+            auto_ok = a_warm <= MULTICHIP_AUTO_RATIO * best_forced
             if not equal or rf.stats.fragments_fused == 0:
                 failures.append(f"q{qid}")
+            if not auto_ok:
+                failures.append(f"q{qid}-auto")
             record["queries"][f"q{qid}"] = {
                 "fused_cold_ms": f_cold, "fused_warm_ms": f_warm,
                 "cut_cold_ms": c_cold, "cut_warm_ms": c_warm,
+                "auto_cold_ms": a_cold, "auto_warm_ms": a_warm,
                 "speedup": round(c_warm / f_warm, 2) if f_warm else None,
+                "auto_vs_best": round(a_warm / best_forced, 2)
+                if best_forced else None,
                 "fragments_fused": rf.stats.fragments_fused,
+                "auto_fragments_fused": ra.stats.fragments_fused,
+                "auto_fusion_skips": dict(ra.stats.fusion_skips),
+                "auto_edges_mispredicted":
+                    ra.stats.fusion_edges_mispredicted,
                 "exchange_bytes_host_fused":
                     rf.stats.exchange_bytes_host,
                 "exchange_bytes_collective":
@@ -1076,7 +1100,8 @@ def multichip_bench():
     finally:
         worker.stop()
     record["gate"] = ("FAIL: " + ",".join(failures)) if failures else \
-        "pass (fused>0, checksums equal; wall-clock is platform-bound)"
+        (f"pass (fused>0, checksums equal, auto <= "
+         f"{MULTICHIP_AUTO_RATIO}x best forced leg)")
     try:
         with open(MULTICHIP_RECORD_PATH, "w") as f:
             json.dump(record, f, indent=1, sort_keys=True)

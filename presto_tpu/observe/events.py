@@ -134,6 +134,8 @@ class FileAuditLogListener(EventListener):
         record["recovery"] = dict(s.recovery)
         record["agg_strategy"] = dict(getattr(s, "agg_strategy", None)
                                       or {})
+        record["fusion_skips"] = dict(getattr(s, "fusion_skips", None)
+                                      or {})
         record["resource_group"] = s.resource_group or None
         record["trace_id"] = s.trace_id or None
         self._write(record)

@@ -303,6 +303,10 @@ def ensure_query_metrics() -> None:
                      "Grouped aggregates executed per planned strategy "
                      "(plan/agg_strategy.py: one_pass/final_only/"
                      "two_phase)", ("strategy",))
+    REGISTRY.counter("presto_tpu_query_fusion_skips_total",
+                     "Exchange edges kept on the HTTP path per skip "
+                     "reason (plan/fusion_cost.py: cost/kind/memo/"
+                     "cross_host)", ("reason",))
     REGISTRY.histogram("presto_tpu_query_wall_ms",
                        "End-to-end query wall time (ms)")
     REGISTRY.counter("presto_tpu_listener_errors_total",
@@ -331,6 +335,9 @@ def observe_query(stats) -> None:
     for strat, n in (getattr(stats, "agg_strategy", None) or {}).items():
         REGISTRY.counter("presto_tpu_query_agg_strategy_total", "",
                          ("strategy",)).inc(float(n), strategy=strat)
+    for reason, n in (getattr(stats, "fusion_skips", None) or {}).items():
+        REGISTRY.counter("presto_tpu_query_fusion_skips_total", "",
+                         ("reason",)).inc(float(n), reason=reason)
     REGISTRY.histogram("presto_tpu_query_wall_ms").observe(
         getattr(stats, "total_ns", 0) / 1e6)
 

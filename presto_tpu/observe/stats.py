@@ -115,6 +115,20 @@ class QueryStats:
     fragments_fused: int = 0
     exchange_bytes_host: int = 0
     exchange_bytes_collective: int = 0
+    # fusion economics (plan/fusion_cost.py): per-edge fuse-vs-cut
+    # verdicts of the cost model — exchange edges spliced into a fused
+    # program (== fragments_fused), edges kept on the HTTP path, edges
+    # where the runtime decision memo overrode the model (a recorded
+    # misprediction of THIS shape flipped them), the wall spent pricing
+    # edges, and the per-reason skip counts: cost (model priced CUT
+    # cheaper), kind (fragment_fusion_kinds excluded), memo (decision-
+    # memo override), cross_host (no declared mesh) — exported like
+    # agg_strategy as presto_tpu_query_fusion_skips_total{reason}.
+    fusion_edges_fused: int = 0
+    fusion_edges_cut: int = 0
+    fusion_edges_mispredicted: int = 0
+    fusion_cost_ms: float = 0.0
+    fusion_skips: Dict[str, int] = dataclasses.field(default_factory=dict)
     # serving tier (server/serving.py): prepared-statement economics —
     # binds through the typed aval path (plan + executable shared across
     # parameter VALUES), warm binds that skipped parse/plan/compile

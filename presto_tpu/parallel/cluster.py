@@ -308,6 +308,11 @@ class ExchangeInput:
     kind: str  # repartition | broadcast | gather | range | scatter
     keys: List[str]
     producer: int  # fragment id
+    # edge-byte annotations (plan/fusion_cost.annotate_exchange_bytes
+    # stamps the Exchange node at distribute() time; cut_fragments
+    # carries them here so the fusion cost model prices real volumes)
+    est_rows: Optional[int] = None
+    est_bytes: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -342,7 +347,10 @@ def cut_fragments(root) -> List[Fragment]:
                 pf = build(n.source, n.kind, okeys)
                 eid = eid_counter[0]
                 eid_counter[0] += 1
-                inputs.append(ExchangeInput(eid, n.kind, list(n.keys), pf))
+                inputs.append(ExchangeInput(
+                    eid, n.kind, list(n.keys), pf,
+                    est_rows=getattr(n, "est_rows_hint", None),
+                    est_bytes=getattr(n, "est_bytes_hint", None)))
                 types = dict(n.outputs())
                 return P.TableScan(f"__exch_{eid}",
                                    {s: s for s in types}, types)
@@ -2009,6 +2017,11 @@ class ClusterSession:
         self._worker_meta: Dict[str, dict] = {}
         self._fused_count = 0
         self._coord_counters: Dict[str, int] = {}
+        # per-edge fusion economics of the last attempt
+        # (plan/fusion_cost.decide_edges; folded into QueryStats.fusion_*)
+        self._fusion_skips: Dict[str, int] = {}
+        self._fusion_mispredicted = 0
+        self._fusion_cost_ms = 0.0
 
     def _worker_info(self, url: str, ctx: R.RunContext) -> dict:
         """Cached /v1/info mesh declaration of one worker ({} when the
@@ -2092,6 +2105,9 @@ class ClusterSession:
         ctx = self._query_ctx(mon.stats.query_id)
         mon.stats.recovery = ctx.recovery  # live view, not a copy
         self._coord_df = {}
+        self._fusion_skips = {}
+        self._fusion_mispredicted = 0
+        self._fusion_cost_ms = 0.0
         # tracer shared with the hedge monitor + the status-time span
         # collection; worker task spans merge into it before finish()
         self._tracer = mon.tracer
@@ -2116,8 +2132,20 @@ class ClusterSession:
             _merge_sort_stats(mon.stats, self._coord_df)
         # fragment fusion: the successful attempt's plan-time decision
         # (fragments spliced) + the exchange-economics counters the
-        # coordinator observed / collected from fused task statuses
+        # coordinator observed / collected from fused task statuses,
+        # plus the per-edge verdict economics (plan/fusion_cost.py):
+        # edges fused/cut, memo-vs-model disagreements, decision wall,
+        # and the per-reason skip counts (cost / kind / memo /
+        # cross_host) that make a cost-cut edge distinguishable from a
+        # kind-filtered or cross-host one
         mon.stats.fragments_fused = self._fused_count
+        mon.stats.fusion_edges_fused = self._fused_count
+        mon.stats.fusion_edges_cut = sum(self._fusion_skips.values())
+        mon.stats.fusion_edges_mispredicted = self._fusion_mispredicted
+        mon.stats.fusion_cost_ms = self._fusion_cost_ms
+        for k, v in self._fusion_skips.items():
+            mon.stats.fusion_skips[k] = \
+                mon.stats.fusion_skips.get(k, 0) + int(v)
         for k in ("exchange_bytes_host", "exchange_bytes_collective"):
             setattr(mon.stats, k, getattr(mon.stats, k, 0)
                     + int(self._coord_counters.get(k, 0)))
@@ -2292,6 +2320,10 @@ class ClusterSession:
         # into this query's stats
         self._fused_count = 0
         self._coord_counters = {}
+        self._fusion_skips = {}
+        self._fusion_mispredicted = 0
+        self._fusion_cost_ms = 0.0
+        self._last_fusion_decisions = None
         scalar_results: Dict[int, tuple] = {}
         for pid, sub in sorted(plan.subplans.items()):
             # deepcopy: distribute() rewrites nodes in place, and a
@@ -2301,20 +2333,48 @@ class ClusterSession:
         dplan = distribute(P.QueryPlan(copy.deepcopy(plan.root), {}),
                            self.session, nw)
         fragments = cut_fragments(dplan.root)
-        # fragment fusion (plan/distribute.fuse_fragments): when a
-        # worker declares an exclusively-owned mesh, every exchange
-        # edge between fragments placed on that mesh is mesh-local —
-        # splice them back into one traced shard_map program and
-        # schedule it as ONE task on the mesh owner.  Cross-host edges
-        # (no declared mesh, or kinds excluded by
-        # fragment_fusion_kinds) keep the per-fragment HTTP path.
+        # fragment fusion (plan/distribute.fuse_fragments + the
+        # plan/fusion_cost.py economics): when a worker declares an
+        # exclusively-owned mesh, every exchange edge between fragments
+        # placed on that mesh is mesh-ELIGIBLE — the cost model then
+        # prices each edge both ways (CUT = pack + host hop + unpack +
+        # per-fragment dispatch vs FUSED = in-trace collective +
+        # serialization penalty) and only net-win edges splice into a
+        # traced shard_map program scheduled on the mesh owner.
+        # `fragment_fusion=force` restores round 12's fuse-everything;
+        # cross-host edges (no declared mesh) and kind-excluded edges
+        # keep the per-fragment HTTP path either way, with the skip
+        # reason counted per edge (QueryStats.fusion_skips).
+        from presto_tpu.plan import fusion_cost as FC
+
+        plan_fp = ""
+        memo_on = FC.memo_enabled(self.session)
+        if len(fragments) > 1 and memo_on \
+                and not getattr(self, "_profile_fragments", False):
+            # the decision memo records this shape's execute wall even
+            # on forced/off legs — an A/B run teaches the auto mode
+            plan_fp = FC.fingerprint(fragments)
         if allow_fusion and len(fragments) > 1 \
                 and DIST.fusion_enabled(self.session):
+            mode = DIST.fusion_mode(self.session)
             mesh_url, mesh_ndev = self._fusion_mesh(layout, R.current())
-            if mesh_url is not None:
+            if mesh_url is None:
+                # no declared mesh: every edge is cross-host
+                self._fusion_skips = {"cross_host": sum(
+                    len(f.inputs) for f in fragments)}
+            else:
                 kinds = DIST.fusion_kinds(self.session)
+                t0c = TR.wall_s()
+                verdict, skips, mispred, _fp, decisions = FC.decide_edges(
+                    fragments, mesh_ndev, self.session, mode, kinds,
+                    fp=plan_fp)
+                self._fusion_cost_ms = (TR.wall_s() - t0c) * 1000.0
+                self._fusion_skips = skips
+                self._fusion_mispredicted = mispred
+                self._last_fusion_decisions = decisions
                 fused, nfused = DIST.fuse_fragments(
-                    fragments, lambda frag, inp: inp.kind in kinds)
+                    fragments,
+                    lambda frag, inp: verdict.get(inp.eid, False))
                 if nfused:
                     fused = _coordinator_passthrough(fused)
                     for f in fused:
@@ -2324,8 +2384,17 @@ class ClusterSession:
                     fragments = fused
                     self._fused_count = nfused
         self._last_fragments = fragments  # EXPLAIN ANALYZE rendering
+        t0s = TR.wall_s()
         coordinator_result = self._schedule(fragments, scalar_results,
                                             layout, ddir, attempt)
+        if plan_fp:
+            # runtime feedback (plan/fusion_cost.DecisionMemo): record
+            # the observed execute wall under the mode that ran, so a
+            # mispredicted edge set flips on the NEXT execution of this
+            # plan shape — hysteresis-guarded, never mid-query
+            FC.MEMO.observe(
+                plan_fp, "fused" if self._fused_count else "cut",
+                (TR.wall_s() - t0s) * 1000.0)
 
         # shape the final columns like Session.sql
         out = dplan.root
@@ -2750,6 +2819,24 @@ class ClusterSession:
             lines.append("   " + PR.cost_line(
                 cost, p.get("wall_ms") or None, note))
             lines.append(P.plan_tree_str(frag.root, 1))
+            lines.append("")
+        # per-edge fuse-vs-cut verdicts (plan/fusion_cost.py) next to
+        # the XLA cost attribution: what the model priced each exchange
+        # edge at and why it fused or stayed an HTTP cut — the same
+        # decisions QueryStats.fusion_skips aggregates
+        decisions = getattr(self, "_last_fusion_decisions", None)
+        if decisions:
+            lines.append("Fusion edges (cut vs fused, "
+                         "plan/fusion_cost.py):")
+            for d in decisions:
+                price = f"cut={d.cut_est_ms:.1f}ms"
+                if d.fused_est_ms is not None:
+                    price += f" fused={d.fused_est_ms:.1f}ms"
+                verdict = "FUSE" if d.fuse else f"CUT ({d.reason})"
+                lines.append(
+                    f"   edge {d.eid} {d.kind} f{d.producer}->"
+                    f"f{d.consumer} ~{d.est_bytes:,}B {price} "
+                    f"-> {verdict}")
             lines.append("")
         lines.append(f"Query {mon.stats.query_id}: "
                      + ", ".join(f"{k}: {v / 1e6:.1f}ms"

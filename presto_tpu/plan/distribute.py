@@ -77,7 +77,15 @@ def distribute(plan: P.QueryPlan, session, ndev: int,
     root = IterativeOptimizer(
         [PushPartialAggregationThroughExchange(session)]).optimize(root)
     out = P.Output(root, plan.root.names, plan.root.symbols)
-    return P.QueryPlan(out, subplans)
+    dplan = P.QueryPlan(out, subplans)
+    # fragment-fusion economics (plan/fusion_cost.py): stamp every
+    # Exchange node with stats-derived est_rows/est_bytes hints so the
+    # coordinator's per-edge fuse-vs-cut pricing (and anything reading
+    # the serde'd fragments) knows what each edge moves
+    from presto_tpu.plan import fusion_cost as FC
+
+    FC.annotate_exchange_bytes(dplan, session)
+    return dplan
 
 
 # aggregate fns that have a (partial fns -> final merge fn) decomposition
@@ -693,14 +701,34 @@ FUSIBLE_KINDS = frozenset(
     {"repartition", "broadcast", "gather", "scatter", "range"})
 
 
-def fusion_enabled(session) -> bool:
-    """Fragment-fusion master switch: session property `fragment_fusion`
-    (default on) gated by the PRESTO_TPU_FRAGMENT_FUSION env kill
-    switch (off|0|false disables process-wide)."""
+def fusion_mode(session) -> str:
+    """Fragment-fusion policy: session property `fragment_fusion` —
+    `auto` (default: the plan/fusion_cost.py per-edge cost model +
+    decision memo pick fuse-vs-cut per exchange edge), `force` (round
+    12's fuse-every-eligible-edge policy, byte-identical), `off` (the
+    per-fragment HTTP path).  Legacy booleans map True -> force /
+    False -> off so pre-round-18 callers keep their exact behavior.
+    The PRESTO_TPU_FRAGMENT_FUSION env kill switch (off|0|false)
+    disables process-wide."""
     env = os.environ.get("PRESTO_TPU_FRAGMENT_FUSION", "").lower()
     if env in ("off", "0", "false"):
-        return False
-    return bool(session.properties.get("fragment_fusion", True))
+        return "off"
+    v = session.properties.get("fragment_fusion", "auto")
+    if v is True:
+        return "force"
+    if v is False or v is None:
+        return "off"
+    v = str(v).strip().lower()
+    if v in ("force", "on", "true", "1"):
+        return "force"
+    if v in ("off", "false", "0", ""):
+        return "off"
+    return "auto"
+
+
+def fusion_enabled(session) -> bool:
+    """Fragment-fusion master switch (any mode but `off`)."""
+    return fusion_mode(session) != "off"
 
 
 def fusion_kinds(session) -> frozenset:
@@ -748,12 +776,14 @@ def _rewrite_exch_scans(root, on_scan):
     return rewrite(root)
 
 
-def fuse_fragments(fragments: list, fusible) -> Tuple[list, int]:
+def fuse_fragments(fragments: list, verdict) -> Tuple[list, int]:
     """The fusion pass.  `fragments` is cut_fragments' output (duck-typed
     parallel/cluster.Fragment dataclasses, topological — producers
-    first); `fusible(consumer_frag, exchange_input) -> bool` classifies
-    each exchange edge (the caller folds placement in: an edge is only
-    fusible when producer and consumer land on the same mesh).
+    first); `verdict(consumer_frag, exchange_input) -> bool` is the
+    PER-EDGE fuse decision (the caller folds placement, kind filters,
+    and the plan/fusion_cost.py cost model in: an edge only fuses when
+    producer and consumer land on the same mesh AND the edge priced as
+    a net win — or `fragment_fusion=force` said fuse everything).
 
     Every fused edge splices the producer fragment's plan into the
     consumer with the Exchange node restored inline, so the consumer
@@ -792,7 +822,7 @@ def fuse_fragments(fragments: list, fusible) -> Tuple[list, int]:
             inp = by_eid.get(eid)
             if inp is None:  # an absorbed producer's migrated input
                 return node
-            if fusible(frag, inp):
+            if verdict(frag, inp):
                 ex = P.Exchange(spliced[inp.producer], inp.kind,
                                 list(inp.keys))
                 if inp.kind == "range":

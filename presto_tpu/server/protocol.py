@@ -362,6 +362,25 @@ class PrestoTpuServer:
                 "splitsPruned": getattr(st, "df_splits_pruned", 0),
                 "waitMillis": round(getattr(st, "df_wait_ms", 0.0), 1),
             },
+            # fragment fusion (plan/fusion_cost.py): the per-edge
+            # fuse-vs-cut economics — edges spliced vs kept on the HTTP
+            # path, memo-vs-model disagreements, the pricing wall, and
+            # the per-reason skip counts that make a cost-cut edge
+            # distinguishable from a kind-filtered or cross-host one
+            "fragmentFusion": {
+                "fragmentsFused": getattr(st, "fragments_fused", 0),
+                "edgesFused": getattr(st, "fusion_edges_fused", 0),
+                "edgesCut": getattr(st, "fusion_edges_cut", 0),
+                "edgesMispredicted": getattr(
+                    st, "fusion_edges_mispredicted", 0),
+                "costMillis": round(
+                    getattr(st, "fusion_cost_ms", 0.0), 2),
+                "skips": dict(getattr(st, "fusion_skips", None) or {}),
+                "exchangeBytesHost": getattr(
+                    st, "exchange_bytes_host", 0),
+                "exchangeBytesCollective": getattr(
+                    st, "exchange_bytes_collective", 0),
+            },
             # serving tier (server/serving.py): admission + prepared +
             # result-cache facts (reference parity: the query JSON's
             # resourceGroupId and queuedTime)

@@ -94,14 +94,26 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # — zero host round-trips between fused stages.  A worker is a
     # fusion target only when it DECLARES an exclusively-owned mesh
     # (PRESTO_TPU_WORKER_MESH / WorkerServer(mesh_devices=)) of at
-    # least `fragment_fusion_min_devices` chips.  Kill switches:
-    # session fragment_fusion=False or env PRESTO_TPU_FRAGMENT_FUSION=
-    # off; any fused-attempt failure retries on the per-fragment HTTP
-    # path.  `fragment_fusion_kinds` (csv) restricts which edge kinds
-    # fuse, for A/B runs and partial-fusion coverage.
-    "fragment_fusion": True,
+    # least `fragment_fusion_min_devices` chips.  Modes (round 18,
+    # plan/fusion_cost.py): `auto` (default) prices every mesh-local
+    # exchange edge CUT vs FUSED with the calibrated exchange roofline
+    # + a per-plan-shape decision memo fed by observed execute walls;
+    # `force` restores round 12's fuse-every-eligible-edge policy
+    # byte-identically (legacy boolean True maps here); `off` keeps the
+    # per-fragment HTTP path (False maps here; env kill
+    # PRESTO_TPU_FRAGMENT_FUSION=off).  Any fused-attempt failure
+    # retries on the HTTP path.  `fragment_fusion_kinds` (csv)
+    # restricts which edge kinds fuse, for A/B runs and partial-fusion
+    # coverage; `fusion_profile` points at a calibration JSON written
+    # by `tools/roofline.py --calibrate` (else PRESTO_TPU_FUSION_PROFILE
+    # env, else baked per-platform defaults); `fragment_fusion_memo`
+    # (default on) is the runtime-feedback kill switch — off = pure
+    # model, nothing recorded.
+    "fragment_fusion": "auto",
     "fragment_fusion_min_devices": 2,
     "fragment_fusion_kinds": "",
+    "fragment_fusion_memo": True,
+    "fusion_profile": "",
     # cluster scheduling policy (reference: PhasedExecutionSchedule vs
     # AllAtOnceExecutionPolicy, execution-policy session property):
     # phased gates probe-side stage startup on build-side completion,

@@ -406,6 +406,57 @@ def test_no_sleeps_or_timeout_literals_in_spill_exec():
     assert not bad, "\n".join(bad)
 
 
+def test_fusion_cost_constants_confined_to_fusion_cost():
+    """Fragment-fusion-economics gate (ISSUE 14): the calibrated
+    exchange-roofline constants and profile reads live ONLY in
+    plan/fusion_cost.py — distribute.py and cluster.py consume per-edge
+    VERDICTS (decide_edges / fuse_fragments), never prices.  Forbidden
+    elsewhere in the package: reads of the PRESTO_TPU_FUSION_PROFILE
+    env var or the `fusion_profile` session property (session.py only
+    REGISTERS the knob's default), and any reference to the pricing
+    fields/methods (host_ms_per_mb, coll_ms_per_mb, serial_ms, cut_ms,
+    fused_base_ms, ...) — a magic bandwidth number in the planner or
+    the coordinator would fork the model."""
+    import ast
+
+    ALLOWED = {os.path.join("plan", "fusion_cost.py")}
+    # session.py's defaults dict registers the knob name; that is not a
+    # profile READ
+    REGISTER_OK = {"session.py"}
+    FORBIDDEN_STRINGS = {"PRESTO_TPU_FUSION_PROFILE", "fusion_profile"}
+    FORBIDDEN_ATTRS = {"host_edge_ms", "host_ms_per_mb", "coll_edge_ms",
+                       "coll_ms_per_mb", "serial_ms", "serial_free",
+                       "cut_ms", "fused_base_ms", "serial_penalty_ms",
+                       "DEFAULT_PROFILES"}
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg)
+            if rel in ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value in FORBIDDEN_STRINGS \
+                        and rel not in REGISTER_OK:
+                    bad.append(f"{rel}:{node.lineno}: {node.value!r} — "
+                               "profile reads belong in "
+                               "plan/fusion_cost.load_profile")
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in FORBIDDEN_ATTRS:
+                    bad.append(f"{rel}:{node.lineno}: .{node.attr} — "
+                               "fusion pricing belongs in "
+                               "plan/fusion_cost.py (consume "
+                               "decide_edges verdicts instead)")
+    assert not bad, "\n".join(bad)
+
+
 def test_no_raw_sleeps_or_timeouts_in_parallel():
     """Robustness gate (ISSUE 2, extended by ISSUE 6 to the serving
     modules): presto_tpu/parallel/retry.py is the ONLY module in the

@@ -237,6 +237,13 @@ def test_query_detail_plan_and_timeline(server):
     assert "Join" in detail["planText"]  # the plan pane has a real plan
     assert "phaseMillis" in detail and detail["phaseMillis"]
     assert detail["executionMode"]
+    # round-18 fusion economics block (plan/fusion_cost.py): always
+    # present so the UI can render the per-edge verdict breakdown;
+    # single-node runs report zeros and an empty skip map
+    ff = detail["fragmentFusion"]
+    assert set(ff) >= {"fragmentsFused", "edgesFused", "edgesCut",
+                       "edgesMispredicted", "costMillis", "skips"}
+    assert isinstance(ff["skips"], dict)
 
 
 def test_query_detail_node_stats_dynamic(server):

@@ -37,6 +37,155 @@ def timed(fn, *args, runs=3):
     return best
 
 
+def exchange_sweep(per_iter, rng):
+    """Exchange economics: host HTTP shuffle vs in-trace all_to_all.
+
+    Anchors the fragment-fusion cost model (plan/fusion_cost.py): what
+    one repartition edge costs on the per-fragment HTTP path (pack PTPG
+    page -> loopback POST -> GET -> unpack -> host hash_partition — the
+    floor; real DCN adds network) vs lowered into the traced program as
+    ONE lax.all_to_all over the mesh.  Swept rows x ndev; cells the
+    host can't run (fewer local devices than ndev) are skipped.  The
+    `--calibrate` mode fits these cells into a per-platform fusion
+    profile (least-squares intercept + slope per lane)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from presto_tpu.batch import Batch as PBatch
+    from presto_tpu.parallel import cluster as CL
+    from presto_tpu.parallel import exchange as EXC
+    from presto_tpu.parallel.mesh import AXIS, make_mesh
+    from presto_tpu.parallel import dist_executor as DX
+    from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+    import threading
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    page_store = {}
+
+    class _Echo(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            page_store["page"] = self.rfile.read(
+                int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def do_GET(self):
+            body = page_store.get("page", b"")
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    echo = ThreadingHTTPServer(("127.0.0.1", 0), _Echo)
+    threading.Thread(target=echo.serve_forever, daemon=True).start()
+    echo_url = f"http://127.0.0.1:{echo.server_address[1]}/page"
+
+    ndev_avail = len(jax.devices())
+    xout = {}
+    for rexp in (16, 18, 20):
+        rows = 1 << rexp
+        kh = rng.integers(0, 1 << 31, rows).astype(np.int64)
+        vh = rng.normal(size=rows)
+        cols = {"k": (kh, None), "v": (vh, None)}
+        cell = {"bytes": int(kh.nbytes + vh.nbytes)}
+
+        def host_trip(nd):
+            page = CL.pack_columns(cols)
+            req = urllib.request.Request(echo_url, data=page,
+                                         method="POST")
+            urllib.request.urlopen(req, timeout=30).read()
+            body = urllib.request.urlopen(echo_url, timeout=30).read()
+            out_cols = CL.unpack_columns(body)
+            CL.hash_partition(out_cols, ["k"], nd)
+
+        for nd in (2, 4, 8):
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                host_trip(nd)
+                best = min(best, time.perf_counter() - t0)
+            cell[f"host_nd{nd}_ms"] = round(best * 1000, 2)
+            if nd > ndev_avail:
+                cell[f"coll_nd{nd}_ms"] = None  # not enough devices
+                continue
+            mesh = make_mesh(nd)
+            spec = NamedSharding(mesh, PSpec(AXIS))
+            kd = jax.device_put(kh, spec)
+            vd = jax.device_put(vh, spec)
+
+            def inner(k, v):
+                from presto_tpu import types as _PT
+                from presto_tpu.batch import Column as _PCol
+
+                def body(i, s):
+                    b = PBatch(
+                        {"k": _PCol(k ^ s, None, _PT.BIGINT, None),
+                         "v": _PCol(v, None, _PT.DOUBLE, None)},
+                        jnp.ones(k.shape, bool))
+                    ob, _ov = EXC.repartition_batch(
+                        b, [b.columns["k"]], nd, AXIS)
+                    # REAL loop-carried dep through the exchanged data
+                    # (a maskable dep lets XLA DCE the all_to_all)
+                    return s + ob.columns["k"].data[0]
+                return lax.fori_loop(0, K, body, jnp.int64(0))
+
+            coll = jax.jit(DX._shard_mapped(
+                inner, mesh, (PSpec(AXIS), PSpec(AXIS)), PSpec()))
+            t = per_iter(timed(coll, kd, vd))
+            cell[f"coll_nd{nd}_ms"] = round(t * 1000, 2)
+        xout[f"r{rows >> 10}k"] = cell
+    echo.shutdown()
+    return xout
+
+
+def calibrate(out_path=None):
+    """`tools/roofline.py --calibrate [out.json]`: run ONLY the
+    exchange sweep and fit a per-platform fusion-cost profile
+    (plan/fusion_cost.profile_from_exchange_sweep) the engine loads via
+    the PRESTO_TPU_FUSION_PROFILE env var or the `fusion_profile`
+    session property.  Default output: fusion_profile_<platform>.json
+    next to this script."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import presto_tpu  # noqa: F401  (x64 + compile cache)
+    from presto_tpu.plan import fusion_cost as FC
+
+    rng = np.random.default_rng(0)
+    rtt = timed(jax.jit(lambda x: x + 1.0), jnp.float32(1.0))
+
+    def per_iter(t):
+        return max(t - rtt, 1e-9) / K
+
+    platform = jax.devices()[0].platform
+    sweep = exchange_sweep(per_iter, rng)
+    prof = FC.profile_from_exchange_sweep(sweep, platform)
+    prof["calibrated_from"] = "tools/roofline.py --calibrate (exchange sweep)"
+    prof["n_devices"] = len(jax.devices())
+    prof["sweep"] = sweep
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"fusion_profile_{platform}.json")
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(prof, f, indent=1, sort_keys=True)
+    print(json.dumps({"profile": {k: v for k, v in prof.items()
+                                  if k != "sweep"},
+                      "path": out_path}), flush=True)
+    return prof
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -412,106 +561,9 @@ def main():
     out["dynfilter"] = dout
 
     # --- exchange economics: host HTTP shuffle vs in-trace all_to_all --
-    # Anchors the fragment-fusion profitability threshold
-    # (plan/distribute.fuse_fragments): what one repartition edge costs
-    # on the per-fragment HTTP path (pack PTPG page -> loopback POST ->
-    # GET -> unpack -> host hash_partition — the floor; real DCN adds
-    # network) vs lowered into the traced program as ONE lax.all_to_all
-    # over the mesh.  Swept rows x ndev; cells the host can't run
-    # (fewer local devices than ndev) are skipped.
-    from presto_tpu.batch import Batch as PBatch
-    from presto_tpu.parallel import cluster as CL
-    from presto_tpu.parallel import exchange as EXC
-    from presto_tpu.parallel.mesh import AXIS, make_mesh
-    from presto_tpu.parallel import dist_executor as DX
-    from jax.sharding import NamedSharding, PartitionSpec as PSpec
-
-    import threading
-    import urllib.request
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-    page_store = {}
-
-    class _Echo(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-
-        def log_message(self, *a):
-            pass
-
-        def do_POST(self):
-            page_store["page"] = self.rfile.read(
-                int(self.headers.get("Content-Length", 0)))
-            self.send_response(200)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
-
-        def do_GET(self):
-            body = page_store.get("page", b"")
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-    echo = ThreadingHTTPServer(("127.0.0.1", 0), _Echo)
-    threading.Thread(target=echo.serve_forever, daemon=True).start()
-    echo_url = f"http://127.0.0.1:{echo.server_address[1]}/page"
-
-    ndev_avail = len(jax.devices())
-    xout = {}
-    for rexp in (16, 18, 20):
-        rows = 1 << rexp
-        kh = rng.integers(0, 1 << 31, rows).astype(np.int64)
-        vh = rng.normal(size=rows)
-        cols = {"k": (kh, None), "v": (vh, None)}
-        cell = {"bytes": int(kh.nbytes + vh.nbytes)}
-
-        def host_trip(nd):
-            page = CL.pack_columns(cols)
-            req = urllib.request.Request(echo_url, data=page,
-                                         method="POST")
-            urllib.request.urlopen(req, timeout=30).read()
-            body = urllib.request.urlopen(echo_url, timeout=30).read()
-            out_cols = CL.unpack_columns(body)
-            CL.hash_partition(out_cols, ["k"], nd)
-
-        for nd in (2, 4, 8):
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                host_trip(nd)
-                best = min(best, time.perf_counter() - t0)
-            cell[f"host_nd{nd}_ms"] = round(best * 1000, 2)
-            if nd > ndev_avail:
-                cell[f"coll_nd{nd}_ms"] = None  # not enough devices
-                continue
-            mesh = make_mesh(nd)
-            spec = NamedSharding(mesh, PSpec(AXIS))
-            kd = jax.device_put(kh, spec)
-            vd = jax.device_put(vh, spec)
-
-            def inner(k, v):
-                from presto_tpu import types as _PT
-                from presto_tpu.batch import Column as _PCol
-
-                def body(i, s):
-                    b = PBatch(
-                        {"k": _PCol(k ^ s, None, _PT.BIGINT, None),
-                         "v": _PCol(v, None, _PT.DOUBLE, None)},
-                        jnp.ones(k.shape, bool))
-                    ob, _ov = EXC.repartition_batch(
-                        b, [b.columns["k"]], nd, AXIS)
-                    # REAL loop-carried dep through the exchanged data
-                    # (a maskable dep lets XLA DCE the all_to_all)
-                    return s + ob.columns["k"].data[0]
-                return lax.fori_loop(0, K, body, jnp.int64(0))
-
-            coll = jax.jit(DX._shard_mapped(
-                inner, mesh, (PSpec(AXIS), PSpec(AXIS)), PSpec()))
-            t = per_iter(timed(coll, kd, vd))
-            cell[f"coll_nd{nd}_ms"] = round(t * 1000, 2)
-        xout[f"r{rows >> 10}k"] = cell
-    echo.shutdown()
-    out["exchange"] = xout
+    # (exchange_sweep above; `--calibrate` fits it into the fusion-cost
+    # profile plan/fusion_cost.py loads)
+    out["exchange"] = exchange_sweep(per_iter, rng)
 
     # --- query coalescing: B solo launches vs ONE vmap-batched launch -
     # Anchors the coalescer defaults (server/serving.py coalesce_window_
@@ -599,4 +651,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--calibrate" in sys.argv:
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        calibrate(args[0] if args else None)
+    else:
+        main()
