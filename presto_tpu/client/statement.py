@@ -30,10 +30,24 @@ class StatementClient:
         self._current_data: list = []
         self._started = False
 
+    # one re-dispatch per request: a fleet front door in redirect mode
+    # answers 307 with the owning coordinator's Location, and urllib
+    # refuses to auto-follow a redirected POST body — follow it here
+    MAX_REDIRECTS = 4
+
     def _request(self, method: str, url: str, body: Optional[bytes] = None):
-        req = urllib.request.Request(url, data=body, method=method)
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            return json.loads(resp.read().decode())
+        for _ in range(self.MAX_REDIRECTS):
+            req = urllib.request.Request(url, data=body, method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                loc = e.headers.get("Location") if e.code in (307, 308) \
+                    else None
+                if not loc:
+                    raise
+                url = loc
+        raise QueryError(f"redirect loop at {url}")
 
     def _absorb(self, payload: dict) -> None:
         self.query_id = payload.get("id", self.query_id)

@@ -349,6 +349,34 @@ def listener_error(listener_class: str) -> None:
                      ("listener",)).inc(listener=listener_class)
 
 
+def set_fleet_gauges(fleet_stats: Dict[str, object]) -> None:
+    """Fleet-coordination gauges (server/fleet.py): ring size, slot
+    leases in flight, gossip/invalidation traffic, front-door routing.
+    Scrape-time refresh like the serving-tier gauges — the fleet stats
+    dict is the source of truth, the registry is the exposition."""
+    ring = fleet_stats.get("ring")
+    if isinstance(ring, (list, tuple)):
+        REGISTRY.gauge("presto_tpu_fleet_coordinators",
+                       "Coordinators on the ownership ring"
+                       ).set(len(ring))
+    slots = fleet_stats.get("slots")
+    if isinstance(slots, dict):
+        for k, v in slots.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                name = "".join(c if c.isalnum() or c == "_" else "_"
+                               for c in str(k)).lower()
+                REGISTRY.gauge(f"presto_tpu_fleet_slot_{name}",
+                               f"Worker slot-lease {k}").set(v)
+    for k, v in fleet_stats.items():
+        if k in ("ring", "slots") or not isinstance(v, (int, float)) \
+                or isinstance(v, bool):
+            continue
+        name = "".join(c if c.isalnum() or c == "_" else "_"
+                       for c in str(k)).lower()
+        REGISTRY.gauge(f"presto_tpu_fleet_{name}",
+                       f"Fleet {k}").set(v)
+
+
 def render_scrape(extra_counters: Optional[Dict[str, float]] = None,
                   prefix: str = "presto_tpu_worker_") -> str:
     """The /v1/metrics payload: the registry, plus (on workers) the

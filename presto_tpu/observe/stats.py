@@ -239,7 +239,12 @@ class QueryMonitor:
         # cluster client, chunked fragments) finds it.
         self.tracer = None
         if TR.enabled(session):
-            self.tracer = TR.Tracer()
+            # fleet deployments tag each coordinator's spans with its
+            # own lane (chrome pid row) so one merged trace separates
+            # per-coordinator activity; solo sessions keep the classic
+            # "coordinator" lane
+            self.tracer = TR.Tracer(lane=getattr(
+                session, "_trace_lane", None) or "coordinator")
             self.stats.trace_id = self.tracer.trace_id
             self.tracer.begin_root(
                 "query", kind="query", query_id=self.stats.query_id,

@@ -160,6 +160,12 @@ PAGE_ENC_PTPG = "ptpg"   # native frame: verified via pserde.frame_ok
 PAGE_ENC_JSON = "json"   # tagged JSON (range samples): must parse
 PAGE_ENC_HEADER = "X-Page-Encoding"
 
+# orphan-task sweep slack past the query deadline: a live coordinator
+# DELETEs its tasks well inside this window (the reap loop runs under
+# ACK_TIMEOUT_S per task); only a DEAD coordinator's tasks survive to
+# expiry, and the worker frees them itself (WorkerServer.reap_expired)
+ORPHAN_GRACE_S = 5.0
+
 
 def _page_ok(body: bytes, enc: str) -> bool:
     """Receipt-time integrity check by DECLARED encoding; an empty
@@ -989,6 +995,14 @@ class _ClusterExecutor:
                 # adaptive-agg flip decisions + strategy counts ride the
                 # task status back to the coordinator (plan/agg_strategy)
                 self._count(k, v)
+            elif k == "degradation_tier" and v:
+                # spill tier is a high-water mark across supersteps
+                self.counters[k] = max(int(self.counters.get(k, 0)),
+                                       int(v))
+            elif v and k.startswith("spill_"):
+                # spill-tier activity on worker fragments rides the task
+                # status back to the coordinator (exec/spill_exec.py)
+                self._count(k, v)
             elif k == "partial_agg_ratio" and v:
                 self.counters[k] = round(float(v), 4)  # gauge, not a sum
         return self._fetch_out_cols(out)
@@ -1336,7 +1350,7 @@ class WorkerServer:
         # counts fragment executions, `replayed` counts durable-page
         # replays — the per-bucket-retry test's evidence that survivors
         # re-execute ONLY the victim's work
-        self.counters = {"executed": 0, "replayed": 0,
+        self.counters = {"executed": 0, "replayed": 0, "tasks_reaped": 0,
                          "buffered_bytes": 0, "peak_buffered_bytes": 0,
                          # compile economics (exec/compile_cache.py):
                          # per-task builds/hits aggregate here and are
@@ -1380,6 +1394,27 @@ class WorkerServer:
         self.httpd.shutdown()
         self.httpd.server_close()
 
+    def reap_expired(self) -> int:
+        """Orphan-task sweep: drop every resident task whose query
+        deadline (plus grace) has passed without the coordinator's
+        DELETE — the crash-recovery path for a dead coordinator's
+        tasks, freeing their page buffers exactly like an explicit
+        DELETE.  Runs opportunistically on task submission and /v1/info
+        so an idle worker still converges when probed."""
+        now = time.monotonic()
+        reaped = 0
+        with self.lock:
+            for tid in [t for t, e in self.tasks.items()
+                        if e.get("expires_at") is not None
+                        and now > e["expires_at"]]:
+                gone = self.tasks.pop(tid)
+                self.counters["buffered_bytes"] -= sum(
+                    len(p[0]) for ps in gone["pages"].values()
+                    for p in ps if p is not None)
+                self.counters["tasks_reaped"] += 1
+                reaped += 1
+        return reaped
+
     def simulate_crash(self):
         """The `crash` fault action: a subprocess worker dies for real;
         an in-process worker (chaos tests) stops serving, so every later
@@ -1391,6 +1426,12 @@ class WorkerServer:
         threading.Thread(target=self.stop, daemon=True).start()
 
     def submit(self, spec: TaskSpec, trace_ctx: Optional[str] = None):
+        # a coordinator that dies mid-query never DELETEs its tasks;
+        # each task therefore carries its query deadline, and the
+        # sweep (reap_expired) drops residents past deadline + grace
+        deadline_s = spec.properties.get("deadline_s")
+        expires_at = None if deadline_s is None else \
+            time.monotonic() + float(deadline_s) + ORPHAN_GRACE_S
         with self.lock:
             # pages: bucket -> list of page bytes (None = acked/pruned);
             # complete flips when the producer will publish no more
@@ -1398,6 +1439,7 @@ class WorkerServer:
                     "pages": {}, "complete": False,
                     "range_boundaries": None,
                     "range_event": threading.Event(),
+                    "expires_at": expires_at,
                     # dynamic-filter side channel: fid -> {part: payload}
                     "dynfilters": {}, "df_event": threading.Event()}
             self.tasks[spec.task_id] = task
@@ -1628,6 +1670,7 @@ def _make_worker_handler(server: WorkerServer):
                 self._send(401, b"{}", "application/json")
                 return
             if self.path == "/v1/task":
+                server.reap_expired()
                 try:
                     spec = plan_serde.loads(body)
                     if not isinstance(spec, TaskSpec):
@@ -1712,6 +1755,7 @@ def _make_worker_handler(server: WorkerServer):
                 return
             parts = self.path.strip("/").split("/")
             if self.path.startswith("/v1/info"):
+                server.reap_expired()
                 with server.lock:
                     if "reset_peak" in self.path:
                         server.counters["peak_buffered_bytes"] = \
@@ -1961,10 +2005,17 @@ class _HedgeMonitor(threading.Thread):
         target = targets[(fid + spec.windex) % len(targets)]
         hspec = dataclasses.replace(spec, task_id=tid0 + "_h",
                                     replay=False)
+        fleet = getattr(self.cs, "fleet", None)
+        if fleet is not None and not fleet.lease_slot(target, timeout_s=0.0):
+            # hedges are opportunistic: never queue for a saturated
+            # worker's slot, just skip the hedge this round
+            return
         try:
             _http_retry(f"{target}/v1/task", plan_serde.dumps(hspec),
                         method="POST", ctx=self.ctx)
         except Exception:  # noqa: BLE001 — failed hedge changes nothing
+            if fleet is not None:
+                fleet.release_slot(target)
             return
         e["hedge"] = [target, hspec.task_id]
         self.all_tasks.append((target, hspec.task_id))
@@ -1992,9 +2043,15 @@ class ClusterSession:
     the worker set, returns results like Session.sql."""
 
     def __init__(self, session, worker_urls: List[str],
-                 resource_groups=None):
+                 resource_groups=None, fleet=None):
         self.session = session
         self.workers = list(worker_urls)
+        # coordinator fleet (server/fleet.py): when attached, every task
+        # POST first leases the worker's slot through the shared board
+        # (N coordinators never oversubscribe one worker) and HealthBoard
+        # verdicts gossip both ways — a peer's quarantine benches the
+        # worker here too, and this session's quarantines reach peers
+        self.fleet = fleet
         # coordinator admission control (server/resource_groups.py,
         # docs/SERVING.md): when a ResourceGroupManager is attached,
         # every ClusterSession.sql queues/sheds against per-group
@@ -2010,6 +2067,8 @@ class ClusterSession:
             probation_s=float(self.session.properties.get(
                 "cluster_health_probation_s", 5.0)))
         self._benched: List[str] = []  # quarantined, awaiting probation
+        if fleet is not None:
+            fleet.subscribe(on_health=self._on_peer_health)
         # fragment fusion: per-worker mesh declarations (/v1/info
         # meshDevices/meshId), fetched lazily once per worker; the
         # fused-fragment count + exchange counters of the last
@@ -2022,6 +2081,40 @@ class ClusterSession:
         self._fusion_skips: Dict[str, int] = {}
         self._fusion_mispredicted = 0
         self._fusion_cost_ms = 0.0
+
+    def _on_peer_health(self, worker_url: str, verdict: str) -> None:
+        """Receive side of fleet health gossip: a peer coordinator's
+        'open' verdict trips OUR breaker and benches the worker, so this
+        coordinator stops scheduling onto a worker a peer already found
+        dead instead of rediscovering the failure query by query.
+        Probation re-admission (_refresh_pool) is unchanged — a wrong
+        gossip costs one probation interval."""
+        if verdict != "open":
+            return  # recovery is probation's call, never gossip's
+        self.health.force_open(worker_url)
+        if worker_url in self.workers and worker_url not in self._benched:
+            self.workers = [u for u in self.workers if u != worker_url]
+            self._benched.append(worker_url)
+
+    def _lease_for_post(self, url: str, ctx: R.RunContext) -> None:
+        """Slot lease ahead of a task POST (fleet deployments only): the
+        shared board (server/fleet.SlotLeaseBoard) blocks while the
+        worker is saturated by OTHER coordinators; a timeout surfaces as
+        a typed upstream failure instead of oversubscribing the worker."""
+        if self.fleet is None:
+            return
+        import presto_tpu.server.fleet as FL
+
+        rem = ctx.deadline.remaining()
+        budget = FL.LEASE_TIMEOUT_S if rem == float("inf") \
+            else max(min(FL.LEASE_TIMEOUT_S, rem), 0.0)
+        if self.fleet.lease_slot(url, timeout_s=budget):
+            ctx.count("slot_leases", url=url)
+            return
+        ctx.count("slot_lease_timeouts", url=url)
+        raise UpstreamFailed(
+            f"worker {url} slot lease timed out after {budget:.1f}s "
+            f"(fleet saturated)")
 
     def _worker_info(self, url: str, ctx: R.RunContext) -> dict:
         """Cached /v1/info mesh declaration of one worker ({} when the
@@ -2157,6 +2250,14 @@ class ClusterSession:
                       or k.startswith("partial_agg")}
         if agg_counts:
             _merge_sort_stats(mon.stats, agg_counts)
+        # spill tiering on worker fragments: counters collected from
+        # task statuses (_collect_spill_stats) + the coordinator's own
+        # fragment executor fold in exactly like single-node spill
+        spill_counts = {k: v for k, v in self._coord_counters.items()
+                        if k.startswith("spill_")
+                        or k == "degradation_tier"}
+        if spill_counts:
+            _merge_sort_stats(mon.stats, spill_counts)
         mon.finish(result.rows)
         if getattr(result, "stats", None) is None:
             result.stats = mon.stats  # race-free vs session.last_stats
@@ -2239,6 +2340,10 @@ class ClusterSession:
                         elif url not in self._benched:
                             self._benched.append(url)
                             ctx.count("workers_quarantined", url=url)
+                            if self.fleet is not None:
+                                # tell peer coordinators before they
+                                # rediscover the corpse query by query
+                                self.fleet.gossip_health(url, "open")
                     if was_fused:
                         # ANY failure of a fused attempt (guard trip,
                         # fused-task fault, mesh-owner crash) degrades
@@ -2477,6 +2582,14 @@ class ClusterSession:
                         ctx.count("task_cancels", url=url, task=tid)
                 except Exception:
                     pass
+                finally:
+                    # one lease per all_tasks entry (task POSTs and
+                    # hedge launches both record here): release even
+                    # when the DELETE can't reach the worker — the
+                    # lease guards COORDINATOR-side concurrency, and a
+                    # dead worker's board entry vanishes on unregister
+                    if self.fleet is not None:
+                        self.fleet.release_slot(url)
         return coordinator_result
 
     def _run_fragments(self, fragments, scalar_results, run_on_of,
@@ -2641,7 +2754,21 @@ class ClusterSession:
                             # tracing detail travels with the task so
                             # "full" turns on worker page-pull spans
                             "trace_detail": self.session.properties.get(
-                                "trace_detail", "basic")},
+                                "trace_detail", "basic"),
+                            # spill tiering (exec/spill_exec.py): the
+                            # degradation knobs travel with every task so
+                            # cluster fragment executors arm the same
+                            # spill tiers the single-node engine does —
+                            # a worker fragment past its memory budget
+                            # degrades to hybrid spill instead of OOMing
+                            **{k: self.session.properties.get(k)
+                               for k in ("spill_enabled", "force_spill",
+                                         "spill_threshold_bytes",
+                                         "spill_trigger_rows",
+                                         "spill_max_recursion_depth",
+                                         "spill_path",
+                                         "spill_verify_writes",
+                                         "query_max_memory_bytes")}},
                         durable_dir=ddir, durable_key=dkey,
                         attempt=attempt, replay=replay,
                     )
@@ -2668,8 +2795,17 @@ class ClusterSession:
                     if url is None:  # final fragment: run on the coordinator
                         coordinator_spec = spec
                     else:
-                        _http_retry(f"{url}/v1/task", plan_serde.dumps(spec),
-                                    method="POST")
+                        self._lease_for_post(url, ctx)
+                        try:
+                            _http_retry(f"{url}/v1/task",
+                                        plan_serde.dumps(spec),
+                                        method="POST")
+                        except BaseException:
+                            # failed POST holds no task: give the slot
+                            # back now instead of waiting for reclaim
+                            if self.fleet is not None:
+                                self.fleet.release_slot(url)
+                            raise
                         self._task_specs[tid] = (spec, frag.fid)
                         tasks.append(placements[frag.fid][w])
                 self.schedule_trace.append(
@@ -2736,6 +2872,7 @@ class ClusterSession:
                         pass
         self._collect_task_traces(fragments, placements, ctx)
         self._collect_agg_economics(fragments, placements, ctx)
+        self._collect_spill_stats(fragments, placements, ctx)
         merged = [unpack_columns(p) for p in pages.get(0, [])]
         # single final page expected (gather output); concat defensively
         if len(merged) == 1:
@@ -2893,6 +3030,40 @@ class ClusterSession:
                             self._coord_counters.get(k, 0) + int(v)
                     elif k == "partial_agg_ratio" and v:
                         self._coord_counters[k] = float(v)
+
+    def _collect_spill_stats(self, fragments, placements, ctx) -> None:
+        """Post-success spill-degradation collection: worker fragment
+        executors run the same spill tiers as the single-node engine
+        (exec/spill_exec.py, knobs threaded via spec.properties); their
+        spill_* counters and degradation tier ride the task status and
+        fold into this query's QueryStats here.  Gated on the spill
+        knobs actually being armed (SE.routing_enabled), so the default
+        configuration keeps its RPC sequence byte-identical."""
+        from presto_tpu.exec import spill_exec as SE
+
+        if not SE.routing_enabled(self.session):
+            return
+        if getattr(ctx, "recovery", None):
+            # degraded run: same no-post-success-stalls rule as the
+            # adaptive-agg collection above
+            return
+        for frag in fragments:
+            for slot in placements.get(frag.fid, []):
+                if slot[0] is None:
+                    continue  # the coordinator's own fragment
+                try:
+                    st = json.loads(_http(
+                        f"{slot[0]}/v1/task/{slot[1]}/status",
+                        timeout=R.PROBE_TIMEOUT_S, ctx=ctx))
+                except Exception:  # noqa: BLE001 — telemetry only
+                    continue
+                for k, v in (st.get("counters") or {}).items():
+                    if k == "degradation_tier":
+                        self._coord_counters[k] = max(
+                            int(self._coord_counters.get(k, 0)), int(v))
+                    elif k.startswith("spill_") and v:
+                        self._coord_counters[k] = \
+                            self._coord_counters.get(k, 0) + int(v)
 
     def _collect_task_traces(self, fragments, placements, ctx) -> None:
         """Post-success trace merge: pull each worker task's recorded

@@ -229,6 +229,17 @@ class HealthBoard:
                 return True
             return False
 
+    def force_open(self, url: str) -> None:
+        """Trip the breaker without local evidence — a PEER coordinator
+        found the worker dead and gossiped the verdict (server/fleet.py).
+        Probation still applies, so a wrong verdict costs one probation
+        interval, not the worker."""
+        with self._lock:
+            e = self._entry(url)
+            e["fails"] = max(e["fails"], self.trip_after)
+            e["state"] = _OPEN
+            e["opened"] = self.clock()
+
     def state(self, url: str) -> str:
         with self._lock:
             return self._entry(url)["state"]

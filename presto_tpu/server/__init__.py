@@ -8,7 +8,8 @@ GracefulShutdownHandler — plus the discovery/failure-detection loop
 
 from presto_tpu.server.protocol import PrestoTpuServer
 
-__all__ = ["PrestoTpuServer", "ServingTier"]
+__all__ = ["PrestoTpuServer", "ServingTier", "FleetDirectory",
+           "FleetMember", "OwnershipRing"]
 
 
 def __getattr__(name):  # lazy: serving pulls in the executor stack
@@ -16,4 +17,8 @@ def __getattr__(name):  # lazy: serving pulls in the executor stack
         from presto_tpu.server.serving import ServingTier
 
         return ServingTier
+    if name in ("FleetDirectory", "FleetMember", "OwnershipRing"):
+        from presto_tpu.server import fleet as _fleet
+
+        return getattr(_fleet, name)
     raise AttributeError(name)

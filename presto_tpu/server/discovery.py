@@ -109,6 +109,28 @@ class HeartbeatFailureDetector:
             return [u for u, n in self.nodes.items() if not n.alive]
 
 
+def watch_fleet(directory, interval: float = 0.5,
+                ) -> HeartbeatFailureDetector:
+    """Pin coordinator-fleet membership (server/fleet.FleetDirectory) to
+    the heartbeat failure detector: every registered coordinator is
+    pinged like any other node, and one that crosses the failure
+    threshold LEAVES the fleet — its ring arc reassigns to survivors and
+    its worker slot leases are reclaimed in one sweep, so a dead
+    coordinator can neither own signatures nor squat fleet capacity.
+    The caller starts/stops the returned detector."""
+
+    def on_failure(uri: str) -> None:
+        for cid, curi in list(directory.coordinators().items()):
+            if curi == uri:
+                directory.leave(cid)
+
+    det = HeartbeatFailureDetector(interval=interval,
+                                   on_failure=on_failure)
+    for uri in directory.coordinators().values():
+        det.register(uri)
+    return det
+
+
 class ClusterSizeMonitor:
     """Gates query admission on minimum cluster size (reference:
     execution/ClusterSizeMonitor.java, used at SqlQueryExecution.java:342)."""

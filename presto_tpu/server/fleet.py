@@ -1,0 +1,477 @@
+"""Coordinator fleet control plane: N coordinators, one worker fleet.
+
+Reference parity: the OSS reference runs exactly ONE coordinator per
+cluster (SURVEY.md §L4 — parse/plan/schedule serialize through a single
+JVM); disaggregated-coordinator Presto (and every production fork) adds
+what this module provides: a consistent-hash ownership ring mapping hot
+serving state (prepared-statement signatures, result-cache keys) to an
+owning coordinator, a membership directory behind the discovery
+service, per-worker task-slot leases so N schedulers share one worker
+fleet without oversubscribing it, and best-effort gossip (health
+verdicts, cache invalidations) between coordinators.
+
+Design rules (docs/SERVING.md "Multi-coordinator topology"):
+
+- ROUTING IS AN OPTIMIZATION, NEVER A CORRECTNESS SURFACE.  Any
+  coordinator can execute any statement; the ring only concentrates
+  same-signature EXECUTEs on one owner so vmap query-coalescing batches
+  (server/serving.QueryCoalescer) still form at fleet scale instead of
+  fragmenting 1/N per coordinator.
+- INVALIDATION IS BELT, VERSION KEYS ARE SUSPENDERS.  Result-cache /
+  prepared keys already carry the catalog token+version (PR-9), so a
+  peer that never hears a write broadcast degrades to a key MISS on the
+  bumped version — never a stale hit.  The broadcast exists to free
+  peer memory promptly and to cover catalogs mutated out-of-band.
+- LEASES ARE THE ONLY OVERSUBSCRIPTION GUARD.  A coordinator must hold
+  a worker's slot lease before POSTing a task to it; releases are
+  idempotent and a dead coordinator's leases are reclaimed when the
+  directory unregisters it (heartbeat failure or explicit leave).
+
+The lint suite (tests/test_lint.py) confines ring-hash/ownership and
+slot-lease arithmetic to THIS module, the same discipline that keeps
+spill math in exec/spill_exec.py and fusion pricing in
+plan/fusion_cost.py.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import threading
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# named timing constants (the serving lint rule forbids inline timeout
+# literals in server modules)
+# ---------------------------------------------------------------------------
+
+# virtual nodes per coordinator on the ring: enough that a join/leave
+# moves ~K/N keys with low variance, few enough that membership changes
+# rebuild the ring in microseconds
+FLEET_VNODES = 64
+# peer RPC budget for best-effort gossip (invalidation broadcast, health
+# verdicts, prepared replication): these NEVER block a query result, so
+# the budget is short and a miss just degrades to the version-key check
+GOSSIP_TIMEOUT_S = 2.0
+# front-door proxy budget: a proxied statement's full round trip to its
+# owning coordinator (submit + first-response grace), NOT the query
+# deadline — long queries continue through the returned nextUri
+PROXY_TIMEOUT_S = 60.0
+# slot-lease acquisition bound: a coordinator that cannot lease a slot
+# within this budget surfaces a typed error instead of oversubscribing
+LEASE_TIMEOUT_S = 30.0
+
+
+def _ring_hash(key: str) -> int:
+    """Stable 64-bit point on the ring.  blake2b, NOT hash(): Python's
+    string hash is per-process salted, and every fleet member must
+    compute the IDENTICAL ring from the same membership."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "big")
+
+
+class OwnershipRing:
+    """Consistent-hash ring: signature/cache keys -> owning coordinator.
+
+    Each member contributes FLEET_VNODES virtual points; a key is owned
+    by the first point clockwise from its hash.  Join/leave therefore
+    moves only ~K/N of K keys (tests/test_fleet.py asserts the bound),
+    so a coordinator crash reshuffles one ring arc, not the whole key
+    space — riders of unaffected signatures keep their coalescing owner.
+    """
+
+    def __init__(self, vnodes: int = FLEET_VNODES):
+        self.vnodes = max(int(vnodes), 1)
+        self._points: List[Tuple[int, str]] = []  # sorted (hash, member)
+        self._members: set = set()
+        self._lock = threading.Lock()
+
+    def add(self, member: str) -> None:
+        with self._lock:
+            if member in self._members:
+                return
+            self._members.add(member)
+            for v in range(self.vnodes):
+                h = _ring_hash(f"{member}#vn{v}")
+                bisect.insort(self._points, (h, member))
+
+    def remove(self, member: str) -> None:
+        with self._lock:
+            if member not in self._members:
+                return
+            self._members.discard(member)
+            self._points = [p for p in self._points if p[1] != member]
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def owner(self, key: str) -> Optional[str]:
+        """The coordinator owning `key` (None on an empty ring)."""
+        with self._lock:
+            if not self._points:
+                return None
+            h = _ring_hash(key)
+            i = bisect.bisect_right(self._points, (h, "￿"))
+            if i >= len(self._points):
+                i = 0  # wrap: first point clockwise from 0
+            return self._points[i][1]
+
+
+def affinity_key(sql: str) -> Optional[str]:
+    """The ring key of a statement, or None when the statement has no
+    affinity (writes/DDL/PREPARE run wherever they land).
+
+    EXECUTEs key on the prepared-statement NAME — every bind of one
+    signature routes to one owner so coalescing batches form fleet-wide.
+    Ad-hoc reads key on their text so identical dashboard queries hit
+    one coordinator's result cache instead of N cold ones."""
+    head = sql.lstrip().split(None, 2)
+    if not head:
+        return None
+    kw = head[0].upper()
+    if kw == "EXECUTE" and len(head) > 1:
+        name = head[1].split("(", 1)[0].strip().strip(";")
+        return f"prepared::{name.lower()}"
+    if kw in ("SELECT", "WITH", "VALUES", "TABLE"):
+        return f"sql::{' '.join(sql.split())}"
+    return None
+
+
+class SlotLeaseBoard:
+    """Per-worker task-slot accounting for the WHOLE fleet: the ONLY
+    place slot arithmetic happens (lint-confined).  A worker advertises
+    `slots` concurrent tasks; every coordinator leases before POSTing
+    and releases after DELETE, so N schedulers can never oversubscribe
+    one worker.  Leases are tagged by coordinator so a dead
+    coordinator's leases are reclaimed in one sweep."""
+
+    def __init__(self):
+        self._cap: Dict[str, int] = {}
+        self._held: Dict[str, Dict[str, int]] = {}  # url -> coord -> n
+        self._cond = threading.Condition()
+        self.leases_granted = 0
+        self.lease_waits = 0
+        self.leases_reclaimed = 0
+
+    def register_worker(self, url: str, slots: int) -> None:
+        with self._cond:
+            self._cap[url] = max(int(slots), 1)
+            self._held.setdefault(url, {})
+            self._cond.notify_all()
+
+    def unregister_worker(self, url: str) -> None:
+        with self._cond:
+            self._cap.pop(url, None)
+            self._held.pop(url, None)
+            self._cond.notify_all()
+
+    def _in_flight(self, url: str) -> int:
+        return sum(self._held.get(url, {}).values())
+
+    def lease(self, coord_id: str, url: str,
+              timeout_s: float = LEASE_TIMEOUT_S) -> bool:
+        """Acquire one task slot on `url`; blocks while the worker is
+        saturated.  False on timeout (the caller surfaces a typed
+        error rather than oversubscribing).  Unregistered workers are
+        unmanaged: lease freely (single-coordinator compatibility)."""
+        with self._cond:
+            if url not in self._cap:
+                return True
+            if self._in_flight(url) >= self._cap[url]:
+                self.lease_waits += 1
+                granted = self._cond.wait_for(
+                    lambda: url not in self._cap
+                    or self._in_flight(url) < self._cap[url],
+                    timeout=timeout_s)
+                if not granted:
+                    return False
+            if url in self._cap:
+                held = self._held.setdefault(url, {})
+                held[coord_id] = held.get(coord_id, 0) + 1
+                self.leases_granted += 1
+            return True
+
+    def release(self, coord_id: str, url: str) -> None:
+        with self._cond:
+            held = self._held.get(url)
+            if held and held.get(coord_id, 0) > 0:
+                held[coord_id] -= 1
+                if held[coord_id] == 0:
+                    del held[coord_id]
+                self._cond.notify_all()
+
+    def reclaim(self, coord_id: str) -> int:
+        """Release EVERY lease a (dead) coordinator holds; returns the
+        count so recovery tests can assert the sweep."""
+        with self._cond:
+            n = 0
+            for held in self._held.values():
+                n += held.pop(coord_id, 0)
+            if n:
+                self.leases_reclaimed += n
+                self._cond.notify_all()
+            return n
+
+    def in_flight(self) -> Dict[str, int]:
+        with self._cond:
+            return {url: self._in_flight(url) for url in self._cap}
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"workers": len(self._cap),
+                    "inFlight": sum(self._in_flight(u) for u in self._cap),
+                    "leasesGranted": self.leases_granted,
+                    "leaseWaits": self.lease_waits,
+                    "leasesReclaimed": self.leases_reclaimed}
+
+
+class FleetDirectory:
+    """The discovery-service side of the fleet: coordinator membership
+    (feeding the ownership ring), the slot-lease board, and the gossip
+    relay.  One instance per fleet; in-process coordinators share it
+    directly, and server/discovery.watch_fleet() pins membership to the
+    heartbeat failure detector so a dead coordinator leaves the ring
+    (and its leases are reclaimed) without an explicit goodbye."""
+
+    def __init__(self, vnodes: int = FLEET_VNODES):
+        self.ring = OwnershipRing(vnodes=vnodes)
+        self.slots = SlotLeaseBoard()
+        self._uris: Dict[str, str] = {}
+        self._members: Dict[str, "FleetMember"] = {}
+        self._lock = threading.Lock()
+        self.epoch = 0  # bumps on every membership change
+
+    # -- membership ----------------------------------------------------
+    def join(self, coord_id: str, uri: str) -> "FleetMember":
+        member = FleetMember(coord_id, uri, self)
+        with self._lock:
+            self._uris[coord_id] = uri
+            self._members[coord_id] = member
+            self.epoch += 1
+        self.ring.add(coord_id)
+        return member
+
+    def leave(self, coord_id: str) -> int:
+        """Remove a coordinator (crash or drain): ring shrinks, leases
+        reclaim.  Returns the reclaimed-lease count."""
+        self.ring.remove(coord_id)
+        with self._lock:
+            self._uris.pop(coord_id, None)
+            self._members.pop(coord_id, None)
+            self.epoch += 1
+        return self.slots.reclaim(coord_id)
+
+    def uri_of(self, coord_id: str) -> Optional[str]:
+        with self._lock:
+            return self._uris.get(coord_id)
+
+    def coordinators(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._uris)
+
+    # -- gossip relay (in-process members get direct callbacks; remote
+    # members are reached over their /v1/fleet endpoints by the sender)
+    def relay_invalidate(self, origin_id: str, token: str,
+                         version: int) -> None:
+        with self._lock:
+            members = [m for cid, m in self._members.items()
+                       if cid != origin_id]
+        for m in members:
+            m.on_invalidate(origin_id, token, version)
+
+    def relay_health(self, origin_id: str, worker_url: str,
+                     verdict: str) -> None:
+        with self._lock:
+            members = [m for cid, m in self._members.items()
+                       if cid != origin_id]
+        for m in members:
+            m.on_health(origin_id, worker_url, verdict)
+
+
+class FleetMember:
+    """One coordinator's fleet handle: ring view, lease client, and the
+    gossip send/receive surface.  Attach it to a ServingTier
+    (serving.attach_fleet) and/or a ClusterSession (fleet= kwarg); the
+    protocol server routes through it when present."""
+
+    def __init__(self, coord_id: str, uri: str,
+                 directory: Optional[FleetDirectory] = None,
+                 peers: Optional[Dict[str, str]] = None):
+        self.coord_id = coord_id
+        self.uri = uri
+        self.directory = directory
+        # static peer map for cross-process fleets (bench subprocess
+        # coordinators): same ids => every process derives the SAME ring
+        self._static_peers = dict(peers or {})
+        self._static_ring: Optional[OwnershipRing] = None
+        if directory is None:
+            self._static_ring = OwnershipRing()
+            self._static_ring.add(coord_id)
+            for cid in self._static_peers:
+                self._static_ring.add(cid)
+        # receive-side hooks, wired by the embedding tier
+        self._invalidate_cbs: List[Callable[[str, int], None]] = []
+        self._health_cbs: List[Callable[[str, str], None]] = []
+        # test hook for the dropped-broadcast fault leg: when set, sends
+        # are counted as dropped instead of delivered (the version-key
+        # check must then carry correctness alone)
+        self.drop_broadcasts = False
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "invalidations_sent": 0, "invalidations_received": 0,
+            "invalidations_dropped": 0, "health_gossip_sent": 0,
+            "health_gossip_received": 0, "prepares_replicated": 0,
+            "routed_away": 0, "routed_here": 0}
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    # -- ring view -----------------------------------------------------
+    def _ring(self) -> OwnershipRing:
+        return self.directory.ring if self.directory is not None \
+            else self._static_ring
+
+    def owner_of(self, key: str) -> Optional[str]:
+        return self._ring().owner(key)
+
+    def owns(self, key: str) -> bool:
+        owner = self.owner_of(key)
+        return owner is None or owner == self.coord_id
+
+    def owner_uri(self, key: str) -> Optional[str]:
+        """The owning coordinator's URI, or None when this member owns
+        the key (or the owner is unknown — execute locally, routing is
+        an optimization)."""
+        owner = self.owner_of(key)
+        if owner is None or owner == self.coord_id:
+            return None
+        if self.directory is not None:
+            return self.directory.uri_of(owner)
+        return self._static_peers.get(owner)
+
+    def peer_uris(self) -> Dict[str, str]:
+        if self.directory is not None:
+            return {cid: uri for cid, uri
+                    in self.directory.coordinators().items()
+                    if cid != self.coord_id}
+        return dict(self._static_peers)
+
+    # -- gossip send ---------------------------------------------------
+    def _post_peer(self, uri: str, path: str, payload: dict) -> bool:
+        try:
+            req = urllib.request.Request(
+                f"{uri}{path}", data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=GOSSIP_TIMEOUT_S):
+                return True
+        except Exception:  # noqa: BLE001 — gossip is best-effort
+            return False
+
+    def broadcast_invalidate(self, token: str, version: int) -> int:
+        """Version-stamped invalidation to every peer; best-effort (a
+        missed peer degrades to a version-key miss).  Returns the
+        delivered-peer count."""
+        if self.drop_broadcasts:
+            self._count("invalidations_dropped")
+            return 0
+        payload = {"origin": self.coord_id, "token": token,
+                   "version": int(version)}
+        delivered = 0
+        if self.directory is not None:
+            self.directory.relay_invalidate(self.coord_id, token,
+                                            int(version))
+            delivered = len(self.peer_uris())
+        else:
+            for uri in self._static_peers.values():
+                if self._post_peer(uri, "/v1/fleet/invalidate", payload):
+                    delivered += 1
+        self._count("invalidations_sent", delivered)
+        return delivered
+
+    def gossip_health(self, worker_url: str, verdict: str) -> None:
+        """Relay a HealthBoard verdict ('open' = breaker tripped,
+        'closed' = worker recovered) so peers stop scheduling onto a
+        worker one coordinator already found dead."""
+        if self.drop_broadcasts:
+            return
+        self._count("health_gossip_sent")
+        if self.directory is not None:
+            self.directory.relay_health(self.coord_id, worker_url, verdict)
+        else:
+            payload = {"origin": self.coord_id, "worker": worker_url,
+                       "verdict": verdict}
+            for uri in self._static_peers.values():
+                self._post_peer(uri, "/v1/fleet/health", payload)
+
+    def replicate_prepare(self, sql: str) -> int:
+        """Best-effort PREPARE replication so an EXECUTE routed (or
+        failed over) to any coordinator finds the signature.  A peer the
+        replication never reached answers with the typed
+        unknown-prepared error and the client re-PREPAREs."""
+        if self.drop_broadcasts:
+            return 0
+        delivered = 0
+        for uri in self.peer_uris().values():
+            if self._post_peer(uri, "/v1/fleet/prepare", {"sql": sql}):
+                delivered += 1
+        self._count("prepares_replicated", delivered)
+        return delivered
+
+    # -- gossip receive ------------------------------------------------
+    def subscribe(self, on_invalidate: Optional[Callable] = None,
+                  on_health: Optional[Callable] = None) -> None:
+        with self._lock:
+            if on_invalidate is not None:
+                self._invalidate_cbs.append(on_invalidate)
+            if on_health is not None:
+                self._health_cbs.append(on_health)
+
+    def on_invalidate(self, origin_id: str, token: str,
+                      version: int) -> None:
+        self._count("invalidations_received")
+        with self._lock:
+            cbs = list(self._invalidate_cbs)
+        for cb in cbs:
+            try:
+                cb(token, int(version))
+            except Exception:  # noqa: BLE001 — receive is best-effort too
+                pass
+
+    def on_health(self, origin_id: str, worker_url: str,
+                  verdict: str) -> None:
+        self._count("health_gossip_received")
+        with self._lock:
+            cbs = list(self._health_cbs)
+        for cb in cbs:
+            try:
+                cb(worker_url, verdict)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- slot leases ---------------------------------------------------
+    def lease_slot(self, worker_url: str,
+                   timeout_s: float = LEASE_TIMEOUT_S) -> bool:
+        if self.directory is None:
+            return True  # no shared board: unmanaged fleet
+        return self.directory.slots.lease(self.coord_id, worker_url,
+                                          timeout_s=timeout_s)
+
+    def release_slot(self, worker_url: str) -> None:
+        if self.directory is not None:
+            self.directory.slots.release(self.coord_id, worker_url)
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            out = {"coordId": self.coord_id,
+                   "ring": self._ring().members(),
+                   **dict(self.counters)}
+        if self.directory is not None:
+            out["epoch"] = self.directory.epoch
+            out["slots"] = self.directory.slots.stats()
+        return out

@@ -63,7 +63,7 @@ class PrestoTpuServer:
 
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
                  max_concurrent: int = 4, resource_groups=None,
-                 authenticator=None, serving=None):
+                 authenticator=None, serving=None, fleet=None):
         from presto_tpu.server.serving import ServingTier
 
         self.session = session
@@ -75,6 +75,19 @@ class PrestoTpuServer:
             session, resource_groups=resource_groups)
         if serving is not None and resource_groups is None:
             self.resource_groups = serving.resource_groups
+        # coordinator fleet (server/fleet.FleetMember): the front door
+        # routes same-signature EXECUTEs (and cacheable reads) to their
+        # ring owner — proxy by default, 307-redirect for clients that
+        # follow it — so coalescing batches and cache hits concentrate
+        # instead of fragmenting 1/N per coordinator.  `fleet=None` is
+        # the single-coordinator path, byte-identical to round 18.
+        self.fleet = fleet
+        if fleet is not None:
+            self.serving.attach_fleet(fleet)
+        self._proxied: Dict[str, str] = {}  # proxied query id -> owner uri
+        self._proxied_lock = threading.Lock()
+        self.fleet_counters = {"proxied": 0, "redirected": 0,
+                               "proxy_failures": 0}
         # security.PasswordAuthenticator | None — when set, every /v1
         # request must carry HTTP Basic credentials (reference:
         # password authenticators wired through http-server.authentication)
@@ -228,8 +241,16 @@ class PrestoTpuServer:
                     elif first in ("INSERT", "DELETE", "UPDATE", "CREATE",
                                    "DROP", "ALTER"):
                         # write/DDL statement: explicit invalidation on
-                        # top of the catalog-version keying
+                        # top of the catalog-version keying (with a
+                        # fleet attached this also broadcasts to peers)
                         self.serving.on_write_statement()
+                if self.fleet is not None and job.sql.lstrip().split(
+                        None, 1)[0].upper() == "PREPARE":
+                    # best-effort signature replication: an EXECUTE
+                    # routed or failed over to any peer should find the
+                    # prepared name (a peer it never reached answers
+                    # the typed unknown-statement error instead)
+                    self.fleet.replicate_prepare(job.sql)
             except Exception as e:  # noqa: BLE001 — protocol reports all errors
                 job.error = f"{type(e).__name__}: {e}"
                 job.state = "FAILED"
@@ -261,6 +282,79 @@ class PrestoTpuServer:
                      "processedRows": len(rows), "peakMemoryBytes": 0,
                      "spilledBytes": 0, "resultCacheHit": True}
         job.state = "FINISHED"
+
+    # -- fleet front door ---------------------------------------------
+    def route_target(self, sql: str) -> Optional[str]:
+        """The owning coordinator's URI when this statement belongs to a
+        ring peer, else None (execute locally).  Routing is an
+        optimization: any error resolves to local execution."""
+        if self.fleet is None:
+            return None
+        mode = str(self.session.properties.get(
+            "fleet_affinity", "proxy")).lower()
+        if mode == "off":
+            return None
+        from presto_tpu.server import fleet as FL
+
+        key = FL.affinity_key(sql)
+        if key is None:
+            return None
+        return self.fleet.owner_uri(key)
+
+    def proxy_submit(self, sql: str, owner: str) -> Optional[dict]:
+        """Forward a statement to its owning coordinator and re-home the
+        payload's URIs so the (dumb) client keeps talking to THIS
+        server; follow-up polls forward through the proxied-query map.
+        None on any proxy failure — the caller executes locally."""
+        import urllib.request
+
+        from presto_tpu.server import fleet as FL
+
+        try:
+            req = urllib.request.Request(
+                f"{owner}/v1/statement", data=sql.encode(), method="POST")
+            with urllib.request.urlopen(
+                    req, timeout=FL.PROXY_TIMEOUT_S) as resp:
+                payload = json.loads(resp.read().decode())
+        except Exception:  # noqa: BLE001 — degrade to local execution
+            self.fleet_counters["proxy_failures"] += 1
+            return None
+        qid = payload.get("id")
+        if qid:
+            with self._proxied_lock:
+                self._proxied[qid] = owner
+        self.fleet_counters["proxied"] += 1
+        self.fleet.counters["routed_away"] += 1
+        return self._rehome(payload, owner)
+
+    def proxy_fetch(self, owner: str, path: str,
+                    method: str = "GET") -> Optional[dict]:
+        """Forward a follow-up (page poll / cancel) for a proxied query
+        to its owner; None when the owner is unreachable."""
+        import urllib.request
+
+        from presto_tpu.server import fleet as FL
+
+        try:
+            req = urllib.request.Request(f"{owner}{path}", method=method)
+            with urllib.request.urlopen(
+                    req, timeout=FL.PROXY_TIMEOUT_S) as resp:
+                return self._rehome(json.loads(resp.read().decode()),
+                                    owner)
+        except Exception:  # noqa: BLE001
+            self.fleet_counters["proxy_failures"] += 1
+            return None
+
+    def _rehome(self, payload: dict, owner: str) -> dict:
+        for k in ("nextUri", "infoUri"):
+            v = payload.get(k)
+            if isinstance(v, str) and v.startswith(owner):
+                payload[k] = self.uri + v[len(owner):]
+        return payload
+
+    def proxied_owner(self, qid: str) -> Optional[str]:
+        with self._proxied_lock:
+            return self._proxied.get(qid)
 
     # -- protocol payloads --------------------------------------------
     def results_payload(self, job: _QueryJob, token: int) -> dict:
@@ -456,6 +550,9 @@ class PrestoTpuServer:
                     M.REGISTRY.gauge(
                         f"presto_tpu_result_cache_{k}",
                         f"Result cache {k}").set(v)
+        if self.fleet is not None:
+            M.set_fleet_gauges({**self.fleet.stats(),
+                                **self.fleet_counters})
         return M.render_scrape()
 
     def trace_payload(self, st) -> dict:
@@ -492,6 +589,8 @@ class PrestoTpuServer:
                             if self.serving.result_cache is not None
                             else None),
         }
+        if self.fleet is not None:
+            out["fleet"] = {**self.fleet.stats(), **self.fleet_counters}
         return out
 
 
@@ -551,16 +650,74 @@ def _make_handler(server: PrestoTpuServer):
         def do_POST(self):
             if not self._authenticate():
                 return
+            parts = [p for p in self.path.split("/") if p]
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            if parts[:2] == ["v1", "fleet"] and len(parts) == 3:
+                return self._fleet_post(parts[2], body)
             if self.path != "/v1/statement":
                 return self._json({"error": "not found"}, 404)
             if server.shutting_down.is_set():
                 return self._json({"error": "shutting down"}, 503)
-            n = int(self.headers.get("Content-Length", 0))
-            sql = self.rfile.read(n).decode()
+            sql = body.decode()
+            owner = server.route_target(sql)
+            if owner is not None:
+                mode = str(server.session.properties.get(
+                    "fleet_affinity", "proxy")).lower()
+                if mode == "redirect":
+                    # dumb-LB escape hatch: clients that follow 307
+                    # (method+body preserved) talk to the owner directly
+                    # from here on — no proxy hop per page
+                    server.fleet_counters["redirected"] += 1
+                    server.fleet.counters["routed_away"] += 1
+                    loc = f"{owner}/v1/statement"
+                    payload = json.dumps({"redirect": loc}).encode()
+                    self.send_response(307)
+                    self.send_header("Location", loc)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                proxied = server.proxy_submit(sql, owner)
+                if proxied is not None:
+                    return self._json(proxied)
+                # owner unreachable: routing is an optimization — run it
+                # here (the version-keyed caches keep this correct)
             job = server.submit(sql)
             # brief grace so fast queries return data on the first response
             job.done.wait(timeout=FIRST_RESPONSE_GRACE_S)
             self._json(server.results_payload(job, 0))
+
+        def _fleet_post(self, action: str, body: bytes):
+            """Peer-to-peer fleet bus: invalidation broadcast, health
+            gossip, prepared replication (server/fleet.py)."""
+            if server.fleet is None:
+                return self._json({"error": "no fleet attached"}, 404)
+            try:
+                payload = json.loads(body.decode() or "{}")
+            except ValueError:
+                return self._json({"error": "bad fleet payload"}, 400)
+            if action == "invalidate":
+                server.fleet.on_invalidate(
+                    str(payload.get("origin", "")),
+                    str(payload.get("token", "")),
+                    int(payload.get("version", 0) or 0))
+                return self._json({"ok": True})
+            if action == "health":
+                server.fleet.on_health(
+                    str(payload.get("origin", "")),
+                    str(payload.get("worker", "")),
+                    str(payload.get("verdict", "open")))
+                return self._json({"ok": True})
+            if action == "prepare":
+                try:
+                    server.session.sql(str(payload.get("sql", "")))
+                except Exception as e:  # noqa: BLE001 — reported to peer
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 400)
+                return self._json({"ok": True})
+            return self._json({"error": "not found"}, 404)
 
         def do_GET(self):
             if not self._authenticate():
@@ -569,6 +726,11 @@ def _make_handler(server: PrestoTpuServer):
             if parts[:2] == ["v1", "statement"] and len(parts) == 4:
                 job = server.jobs.get(parts[2])
                 if job is None:
+                    owner = server.proxied_owner(parts[2])
+                    if owner is not None:
+                        proxied = server.proxy_fetch(owner, self.path)
+                        if proxied is not None:
+                            return self._json(proxied)
                     return self._json({"error": "unknown query"}, 404)
                 try:
                     token = int(parts[3])
@@ -649,6 +811,12 @@ def _make_handler(server: PrestoTpuServer):
                     if job.state in ("QUEUED",):
                         job.state = "CANCELED"
                     return self._json({"canceled": True}, 200)
+                owner = server.proxied_owner(parts[2])
+                if owner is not None:
+                    proxied = server.proxy_fetch(owner, self.path,
+                                                 method="DELETE")
+                    if proxied is not None:
+                        return self._json(proxied)
             self._json({"error": "not found"}, 404)
 
         def do_PUT(self):

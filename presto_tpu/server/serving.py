@@ -859,6 +859,13 @@ class ServingTier:
         # belt on top of the catalog-version keying, same rule as the
         # protocol path's textual detection
         session._serving_tier = self
+        # coordinator fleet (server/fleet.FleetMember): when attached,
+        # engine writes broadcast a version-stamped invalidation to peer
+        # coordinators and peer broadcasts clear THIS tier's cache.
+        # Best-effort both ways — the catalog token+version in every
+        # cache key is the correctness backstop (a missed broadcast
+        # degrades to a key miss, never a stale hit).
+        self.fleet = None
         self.draining = threading.Event()
         self._lock = threading.Lock()
         self.queries_admitted = 0
@@ -928,9 +935,31 @@ class ServingTier:
     def on_write_statement(self) -> None:
         """Explicit invalidation rule: any non-read statement through
         the tier clears the cache (belt) on top of the catalog-version
-        keying (suspenders)."""
+        keying (suspenders).  With a fleet attached, the write also
+        broadcasts a version-stamped invalidation so PEER coordinators
+        drop their pre-write entries promptly (fleet_invalidate knob;
+        a dropped broadcast still misses on the bumped version key)."""
         if self.result_cache is not None:
             self.result_cache.invalidate()
+        if self.fleet is not None and bool(
+                self.session.properties.get("fleet_invalidate", True)):
+            from presto_tpu.exec.compile_cache import catalog_token
+
+            self.fleet.broadcast_invalidate(
+                catalog_token(self.session.catalog),
+                getattr(self.session.catalog, "version", 0))
+
+    def attach_fleet(self, member) -> None:
+        """Join this tier to a coordinator fleet: writes broadcast
+        invalidations (see on_write_statement) and peer broadcasts clear
+        this tier's result cache."""
+        self.fleet = member
+
+        def on_invalidate(_token: str, _version: int) -> None:
+            if self.result_cache is not None:
+                self.result_cache.invalidate()
+
+        member.subscribe(on_invalidate=on_invalidate)
 
     # -- introspection -------------------------------------------------
     def coalescer_stats(self) -> Optional[dict]:

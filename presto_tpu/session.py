@@ -179,6 +179,22 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "coalesce_window_ms": 2.0,
     "coalesce_max_batch": 16,
     "admission_queue_timeout_s": 60.0,
+    # coordinator fleet (server/fleet.py; docs/SERVING.md "Multi-
+    # coordinator topology"): coordinator_count is the serving-fleet
+    # size (1 = classic single coordinator; bench.py --serve
+    # --coordinators N overrides per run); fleet_affinity is the front
+    # door's routing mode for statements owned by a ring peer — proxy
+    # (default: forward and re-home URIs, dumb clients keep one
+    # endpoint) | redirect (307 to the owner; clients that follow it
+    # skip the proxy hop) | off (execute wherever the statement lands;
+    # coalescing batches then fragment 1/N); fleet_invalidate gates the
+    # best-effort version-stamped invalidation broadcast on engine
+    # writes (the catalog token+version baked into every cache key is
+    # the correctness backstop — a dropped broadcast degrades to a key
+    # miss, never a stale hit)
+    "coordinator_count": 1,
+    "fleet_affinity": "proxy",
+    "fleet_invalidate": True,
     "result_cache_enabled": True,
     "result_cache_max_entries": 256,
     "result_cache_max_bytes": 64 << 20,

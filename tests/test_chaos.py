@@ -532,3 +532,65 @@ def test_env_fault_plan_roundtrip(monkeypatch):
     w = object.__new__(C.WorkerServer)  # no bind: just the env pickup
     w.faults = F.FaultPlan.from_env()
     assert len(w.faults.rules) == 2
+
+
+# ---- coordinator crash (ISSUE 16): fleet failover ---------------------
+
+
+def test_coordinator_crash_failover_reclaim_and_orphan_reap(chaos):
+    """A coordinator dies mid-query: its worker slot leases are
+    reclaimed in one directory sweep, the task it never DELETEd is
+    reaped by the worker's deadline+grace sweep (buffers freed exactly
+    like an explicit DELETE), and the SURVIVOR coordinator serves the
+    retried submit with an identical checksum — zero wrong results,
+    zero leaked worker tasks."""
+    import time as _time
+    import urllib.request as _rq
+
+    from presto_tpu.server import fleet as FL
+
+    session, cs, workers, want = chaos
+    d = FL.FleetDirectory()
+    ma = d.join("A", "http://a.invalid")
+    mb = d.join("B", "http://b.invalid")
+    for w in workers:
+        d.slots.register_worker(w.url, 4)
+    # A was mid-query at the crash: it holds one slot lease per worker
+    # and left a buffered task on worker 0 it will never DELETE
+    assert ma.lease_slot(workers[0].url)
+    assert ma.lease_slot(workers[1].url)
+    w0 = workers[0]
+    page = b"x" * 4096
+    with w0.lock:
+        w0.tasks["q-dead-A.0.0"] = {
+            "state": "RUNNING", "error": None,
+            "pages": {0: [(page, C.PAGE_ENC_PTPG)]}, "complete": True,
+            "range_boundaries": None, "range_event": None,
+            "expires_at": _time.monotonic() - 1.0,  # deadline long past
+            "dynfilters": {}, "df_event": None}
+        w0.counters["buffered_bytes"] += len(page)
+        buffered_before = w0.counters["buffered_bytes"]
+        reaped_before = w0.counters["tasks_reaped"]
+    # the crash: heartbeat failure -> directory.leave (discovery's
+    # watch_fleet path) -> BOTH leases reclaimed in one sweep
+    assert d.leave("A") == 2
+    assert d.slots.stats()["inFlight"] == 0
+    assert d.slots.stats()["leasesReclaimed"] == 2
+    # the worker's opportunistic sweep (rides /v1/info) reaps the
+    # orphan and frees its page buffer
+    info = _rq.urlopen(w0.url + "/v1/info", timeout=30).read()
+    assert b"tasks_reaped" in info
+    with w0.lock:
+        assert "q-dead-A.0.0" not in w0.tasks
+        assert w0.counters["tasks_reaped"] == reaped_before + 1
+        assert w0.counters["buffered_bytes"] == buffered_before - len(page)
+    # the survivor serves the retried submit over the same fleet —
+    # identical checksum, leases cycle back to zero, no task residue
+    cb = C.ClusterSession(session, [w.url for w in workers], fleet=mb)
+    r = cb.sql(QUERY)
+    assert norm(r.rows) == want
+    st = d.slots.stats()
+    assert st["inFlight"] == 0 and st["leasesGranted"] > 2
+    for w in workers:
+        with w.lock:
+            assert not w.tasks  # survivor DELETEd everything it made

@@ -425,3 +425,46 @@ def test_phased_execution_build_before_probe(cluster):
             assert states and all(s == "FINISHED" for s in states), trace
     finally:
         session.set("phased_execution", False)
+
+
+@pytest.mark.slow
+def test_cluster_forced_spill_q18_checksum(cluster):
+    """ISSUE 16 satellite: spill is ARMED in cluster fragment executors.
+    The coordinator's spill knobs ride every task's session properties,
+    so a forced-spill q18 over real worker processes degrades to tier 1
+    on the workers and still checksums identically to the resident
+    single-node run — and the workers' spill counters fold back into
+    the coordinator's QueryStats."""
+    from tests.tpch_queries import QUERIES
+
+    session, cs = cluster
+    want = norm(session.sql(QUERIES[18]).rows)
+    session.set("force_spill", "partial")
+    try:
+        r = cs.sql(QUERIES[18])
+    finally:
+        session.set("force_spill", "")
+    assert norm(r.rows) == want
+    st = r.stats
+    assert st.degradation_tier >= 1
+    assert st.spill_partitions > 0 and st.spill_bytes > 0
+
+
+def test_cluster_spill_knobs_reach_workers(cluster):
+    """Tier-1 leg of spill arming: the force_spill knob set on the
+    coordinator session rides task properties to worker processes, the
+    worker aggregation degrades to tier 1, identical rows come back,
+    and the tier high-water mark lands in coordinator QueryStats.  The
+    q18 deep-spill checksum runs in the slow lane."""
+    session, cs = cluster
+    q = ("SELECT o_orderpriority, count(*) c, sum(o_totalprice) s "
+         "FROM orders GROUP BY o_orderpriority ORDER BY 1")
+    want = norm(session.sql(q).rows)
+    session.set("force_spill", "partial")
+    try:
+        r = cs.sql(q)
+    finally:
+        session.set("force_spill", "")
+    assert norm(r.rows) == want
+    assert r.stats.degradation_tier >= 1
+    assert r.stats.spill_partitions > 0

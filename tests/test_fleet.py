@@ -1,0 +1,512 @@
+"""Coordinator fleet tests (ISSUE 16): the ownership ring, slot-lease
+board, signature-affinity front-door routing (proxy and 307-redirect),
+fleet-scale query coalescing, and cross-coordinator cache coherence —
+including the dropped-broadcast fault leg, where the catalog-version key
+(PR-9) must carry correctness alone.
+
+Reference analogs: disaggregated-coordinator Presto's ResourceManager /
+coordinator discovery; here the ring + leases + gossip live in
+server/fleet.py and every coordinator stays able to execute every
+statement (routing is an optimization, never a correctness surface)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu import types as T
+from presto_tpu.client import StatementClient, connect_http
+from presto_tpu.client.statement import QueryError
+from presto_tpu.server import PrestoTpuServer
+from presto_tpu.server import fleet as FL
+
+
+# ---------------------------------------------------------------------------
+# ownership ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_owner_stable_and_identical_across_instances():
+    """Every member must derive the IDENTICAL ring from the same
+    membership (blake2b, not per-process-salted hash()), regardless of
+    join order."""
+    a = FL.OwnershipRing()
+    b = FL.OwnershipRing()
+    for m in ("c1", "c2", "c3"):
+        a.add(m)
+    for m in ("c3", "c1", "c2"):
+        b.add(m)
+    keys = [f"sig{i}" for i in range(500)]
+    assert [a.owner(k) for k in keys] == [b.owner(k) for k in keys]
+
+
+def test_ring_distribution_roughly_balanced():
+    ring = FL.OwnershipRing()
+    for m in ("c1", "c2", "c3", "c4"):
+        ring.add(m)
+    counts = {}
+    n = 4000
+    for i in range(n):
+        counts[ring.owner(f"k{i}")] = counts.get(ring.owner(f"k{i}"), 0) + 1
+    for m in ("c1", "c2", "c3", "c4"):
+        # 64 vnodes/member keep the spread well inside 2x of fair share
+        assert n / 8 < counts[m] < n / 2, counts
+
+
+def test_ring_rebalance_moves_about_k_over_n_keys():
+    """Join moves ~K/N keys; leave restores the ORIGINAL owners of the
+    moved arc (consistent hashing's whole point: a crash reshuffles one
+    arc, not the key space)."""
+    ring = FL.OwnershipRing()
+    for m in ("c1", "c2", "c3"):
+        ring.add(m)
+    keys = [f"k{i}" for i in range(3000)]
+    before = {k: ring.owner(k) for k in keys}
+    ring.add("c4")
+    moved = [k for k in keys if ring.owner(k) != before[k]]
+    # expected 1/4; allow generous variance either side
+    assert 0.12 * len(keys) < len(moved) < 0.40 * len(keys), len(moved)
+    # every moved key moved TO the joiner, never between old members
+    assert all(ring.owner(k) == "c4" for k in moved)
+    ring.remove("c4")
+    assert {k: ring.owner(k) for k in keys} == before
+
+
+def test_ring_empty_and_single_member():
+    ring = FL.OwnershipRing()
+    assert ring.owner("x") is None
+    ring.add("only")
+    assert ring.owner("x") == "only"
+
+
+# ---------------------------------------------------------------------------
+# affinity keys
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_key_classes():
+    assert FL.affinity_key("EXECUTE my_q USING 1, 2") == "prepared::my_q"
+    assert FL.affinity_key("  execute My_Q(3)") == "prepared::my_q"
+    # ad-hoc reads key on normalized text
+    k1 = FL.affinity_key("SELECT  1\nFROM t")
+    assert k1 == FL.affinity_key("SELECT 1 FROM t")
+    # writes / DDL / PREPARE have no affinity (run wherever they land)
+    assert FL.affinity_key("INSERT INTO t VALUES (1)") is None
+    assert FL.affinity_key("PREPARE p FROM SELECT 1") is None
+    assert FL.affinity_key("CREATE TABLE x AS SELECT 1") is None
+    assert FL.affinity_key("") is None
+
+
+# ---------------------------------------------------------------------------
+# slot-lease board
+# ---------------------------------------------------------------------------
+
+
+def test_slot_lease_caps_and_reclaim():
+    b = FL.SlotLeaseBoard()
+    b.register_worker("http://w1", 2)
+    assert b.lease("A", "http://w1")
+    assert b.lease("A", "http://w1")
+    # saturated: a zero-budget lease fails instead of oversubscribing
+    assert not b.lease("B", "http://w1", timeout_s=0.01)
+    st = b.stats()
+    assert st["inFlight"] == 2 and st["leaseWaits"] == 1
+    # dead-coordinator sweep frees EVERY lease it held
+    assert b.reclaim("A") == 2
+    assert b.stats()["inFlight"] == 0
+    assert b.lease("B", "http://w1", timeout_s=0.01)
+    # release is idempotent per-held-lease
+    b.release("B", "http://w1")
+    b.release("B", "http://w1")
+    assert b.stats()["inFlight"] == 0
+
+
+def test_slot_lease_unregistered_worker_is_unmanaged():
+    """Single-coordinator compatibility: workers nobody registered lease
+    freely (no board entry = no cap to enforce)."""
+    b = FL.SlotLeaseBoard()
+    for _ in range(10):
+        assert b.lease("A", "http://unknown")
+    assert b.stats()["inFlight"] == 0
+
+
+def test_slot_lease_blocks_until_release():
+    b = FL.SlotLeaseBoard()
+    b.register_worker("http://w1", 1)
+    assert b.lease("A", "http://w1")
+    got = []
+
+    def waiter():
+        got.append(b.lease("B", "http://w1", timeout_s=10.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    b.release("A", "http://w1")
+    t.join(timeout=10)
+    assert got == [True]
+    assert b.stats()["leaseWaits"] == 1
+
+
+def test_directory_leave_shrinks_ring_and_reclaims_leases():
+    d = FL.FleetDirectory()
+    a = d.join("A", "http://a")
+    d.join("B", "http://b")
+    d.slots.register_worker("http://w1", 4)
+    assert a.lease_slot("http://w1") and a.lease_slot("http://w1")
+    assert d.ring.members() == ["A", "B"]
+    assert d.leave("A") == 2  # reclaimed-lease count
+    assert d.ring.members() == ["B"]
+    assert d.slots.stats()["inFlight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# front door: proxy vs redirect equivalence over live servers
+# ---------------------------------------------------------------------------
+
+
+def _session(**props):
+    s = presto_tpu.connect(**props)
+    s.catalog.register_memory(
+        "t", {"k": T.BIGINT, "x": T.DOUBLE, "g": T.BIGINT},
+        {"k": np.arange(200, dtype=np.int64),
+         "x": np.arange(200, dtype=np.float64) * 1.5,
+         "g": np.arange(200, dtype=np.int64) % 7})
+    return s
+
+
+def _two_door_fleet(**props):
+    """Two in-process coordinators over ONE shared catalog object (the
+    in-process fleet topology: version-keyed caches see the same bumps),
+    joined through a FleetDirectory."""
+    d = FL.FleetDirectory()
+    sa = _session(**props)
+    sb = presto_tpu.connect(**props)
+    sb.catalog = sa.catalog
+    srv_a = PrestoTpuServer(sa).start()
+    srv_b = PrestoTpuServer(sb).start()
+    ma = d.join("A", srv_a.uri)
+    mb = d.join("B", srv_b.uri)
+    srv_a.fleet = ma
+    srv_a.serving.attach_fleet(ma)
+    srv_b.fleet = mb
+    srv_b.serving.attach_fleet(mb)
+    return d, (srv_a, ma), (srv_b, mb)
+
+
+def test_proxy_routes_execute_to_ring_owner():
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet()
+    try:
+        connect_http(srv_a.uri).execute(
+            "PREPARE pq FROM SELECT count(*) c FROM t WHERE k < ?")
+        key = FL.affinity_key("EXECUTE pq USING 120")
+        owner = d.ring.owner(key)
+        non_owner = srv_a if owner == "B" else srv_b
+        owner_srv = srv_b if owner == "B" else srv_a
+        rows = connect_http(non_owner.uri).execute(
+            "EXECUTE pq USING 120").fetchall()
+        assert rows == [(120,)]
+        assert non_owner.fleet_counters["proxied"] == 1
+        assert non_owner.fleet_counters["proxy_failures"] == 0
+        assert owner_srv.fleet_counters["proxied"] == 0
+        # the door that owns the signature executes locally
+        rows2 = connect_http(owner_srv.uri).execute(
+            "EXECUTE pq USING 50").fetchall()
+        assert rows2 == [(50,)]
+        assert owner_srv.fleet_counters["proxied"] == 0
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_redirect_mode_follows_307_to_owner_and_matches_proxy():
+    """redirect-vs-proxy equivalence: the same EXECUTE through the same
+    non-owner door returns identical rows in both modes; only the
+    transport differs (Location hop vs server-side forward)."""
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet()
+    try:
+        connect_http(srv_a.uri).execute(
+            "PREPARE pq FROM SELECT count(*) c, sum(x) s FROM t "
+            "WHERE k < ?")
+        key = FL.affinity_key("EXECUTE pq USING 99")
+        owner = d.ring.owner(key)
+        non_owner = srv_a if owner == "B" else srv_b
+        via_proxy = connect_http(non_owner.uri).execute(
+            "EXECUTE pq USING 99").fetchall()
+        non_owner.session.properties["fleet_affinity"] = "redirect"
+        via_redirect = connect_http(non_owner.uri).execute(
+            "EXECUTE pq USING 99").fetchall()
+        assert via_proxy == via_redirect
+        assert non_owner.fleet_counters["proxied"] == 1
+        assert non_owner.fleet_counters["redirected"] == 1
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_affinity_off_executes_locally():
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet(fleet_affinity="off")
+    try:
+        connect_http(srv_a.uri).execute(
+            "PREPARE pq FROM SELECT count(*) c FROM t WHERE k < ?")
+        for srv in (srv_a, srv_b):
+            rows = connect_http(srv.uri).execute(
+                "EXECUTE pq USING 30").fetchall()
+            assert rows == [(30,)]
+            assert srv.fleet_counters["proxied"] == 0
+            assert srv.fleet_counters["redirected"] == 0
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_proxy_falls_back_to_local_when_owner_is_down():
+    """Routing is an optimization, never a correctness surface: a dead
+    owner means the non-owner executes the statement itself."""
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet()
+    try:
+        connect_http(srv_a.uri).execute(
+            "PREPARE pq FROM SELECT count(*) c FROM t WHERE k < ?")
+        key = FL.affinity_key("EXECUTE pq USING 44")
+        owner = d.ring.owner(key)
+        owner_srv = srv_a if owner == "A" else srv_b
+        non_owner = srv_b if owner == "A" else srv_a
+        owner_srv.stop()
+        rows = connect_http(non_owner.uri).execute(
+            "EXECUTE pq USING 44").fetchall()
+        assert rows == [(44,)]
+        assert non_owner.fleet_counters["proxy_failures"] == 1
+    finally:
+        for srv in (srv_a, srv_b):
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — one is already stopped
+                pass
+
+
+def test_prepare_replicates_to_peers():
+    """An EXECUTE landing on (or failing over to) ANY door finds the
+    signature: PREPARE through one door best-effort replicates."""
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet(fleet_affinity="off")
+    try:
+        connect_http(srv_a.uri).execute(
+            "PREPARE pq FROM SELECT count(*) c FROM t WHERE k < ?")
+        assert ma.counters["prepares_replicated"] == 1
+        # executable on B WITHOUT routing (affinity off)
+        rows = connect_http(srv_b.uri).execute(
+            "EXECUTE pq USING 77").fetchall()
+        assert rows == [(77,)]
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet-scale coalescing: the affinity burst
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_burst_forms_coalescing_batches_fleet_wide():
+    """The tentpole's perf claim in miniature: concurrent EXECUTEs of
+    ONE signature arrive at BOTH doors; the ring routes them all to the
+    owner, whose vmap coalescer batches them — coalesce batches form at
+    fleet scale instead of fragmenting per coordinator."""
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet(
+        coalesce_max_batch=4)
+    try:
+        connect_http(srv_a.uri).execute(
+            "PREPARE pq FROM SELECT count(*) c, sum(x) s FROM t "
+            "WHERE k < ?")
+        key = FL.affinity_key("EXECUTE pq USING 1")
+        owner_srv = srv_a if d.ring.owner(key) == "A" else srv_b
+        # prewarm the batch-size buckets out of the asserted burst
+        connect_http(owner_srv.uri).execute("EXECUTE pq USING 5")
+        before = (owner_srv.serving.coalescer_stats() or {})
+        errs = []
+
+        def client(sid):
+            uri = (srv_a if sid % 2 == 0 else srv_b).uri
+            for i in range(8):
+                try:
+                    rows = connect_http(uri).execute(
+                        f"EXECUTE pq USING {10 + sid * 8 + i}").fetchall()
+                    assert rows == [(10 + sid * 8 + i,
+                                     pytest.approx((10 + sid * 8 + i - 1)
+                                                   * (10 + sid * 8 + i)
+                                                   / 2 * 1.5))]
+                except Exception as e:  # noqa: BLE001
+                    errs.append(f"{type(e).__name__}: {e}")
+
+        ths = [threading.Thread(target=client, args=(sid,))
+               for sid in range(6)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        after = (owner_srv.serving.coalescer_stats() or {})
+        assert not errs
+        assert after.get("batches", 0) > before.get("batches", 0)
+        # the burst really crossed doors: half the clients hit the
+        # non-owner and were routed
+        non_owner = srv_b if owner_srv is srv_a else srv_a
+        assert non_owner.fleet_counters["proxied"] > 0
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_coordinator_crash_reprepare_is_transparent():
+    """The owner dies holding the only copy of a signature (replication
+    was dropped): EXECUTE through the survivor surfaces the TYPED
+    unknown-prepared error — never a wrong result — and a re-PREPARE
+    there makes the same EXECUTE succeed."""
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet()
+    try:
+        ma.drop_broadcasts = True  # replication never reaches B
+        connect_http(srv_a.uri).execute(
+            "PREPARE pq FROM SELECT count(*) c FROM t WHERE k < ?")
+        assert ma.counters["prepares_replicated"] == 0
+        srv_a.stop()
+        d.leave("A")  # heartbeat failure detector's verdict
+        with pytest.raises(QueryError) as ei:
+            connect_http(srv_b.uri).execute(
+                "EXECUTE pq USING 10").fetchall()
+        assert "not found" in str(ei.value)
+        connect_http(srv_b.uri).execute(
+            "PREPARE pq FROM SELECT count(*) c FROM t WHERE k < ?")
+        rows = connect_http(srv_b.uri).execute(
+            "EXECUTE pq USING 10").fetchall()
+        assert rows == [(10,)]
+    finally:
+        srv_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-coordinator cache coherence (belt AND suspenders)
+# ---------------------------------------------------------------------------
+
+
+def test_write_through_a_never_leaves_stale_hit_on_b():
+    """CTAS/INSERT through door A must not let door B serve a pre-write
+    cached result — covered by the invalidation broadcast (belt) AND,
+    in the second leg, with broadcasts DROPPED, by the catalog
+    token+version already in every cache key (suspenders)."""
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet(fleet_affinity="off")
+    try:
+        q = "SELECT count(*) c FROM t"
+        assert connect_http(srv_b.uri).execute(q).fetchall() == [(200,)]
+        # cached on B now
+        assert connect_http(srv_b.uri).execute(q).fetchall() == [(200,)]
+        # leg 1: broadcast delivered — B's cache is invalidated promptly
+        connect_http(srv_a.uri).execute(
+            "INSERT INTO t VALUES (1000, 1.0, 0)")
+        assert mb.counters["invalidations_received"] >= 1
+        assert connect_http(srv_b.uri).execute(q).fetchall() == [(201,)]
+        # leg 2: the broadcast is dropped (fault hook) — the bumped
+        # catalog version makes B's key MISS; never a stale hit
+        ma.drop_broadcasts = True
+        received_before = mb.counters["invalidations_received"]
+        connect_http(srv_a.uri).execute(
+            "INSERT INTO t VALUES (1001, 2.0, 1)")
+        assert ma.counters["invalidations_dropped"] >= 1
+        assert mb.counters["invalidations_received"] == received_before
+        assert connect_http(srv_b.uri).execute(q).fetchall() == [(202,)]
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_fleet_invalidate_knob_disables_broadcast_not_correctness():
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet(
+        fleet_affinity="off", fleet_invalidate=False)
+    try:
+        q = "SELECT sum(k) s FROM t"
+        base = connect_http(srv_b.uri).execute(q).fetchall()
+        connect_http(srv_a.uri).execute(
+            "INSERT INTO t VALUES (5000, 0.0, 0)")
+        assert ma.counters["invalidations_sent"] == 0
+        got = connect_http(srv_b.uri).execute(q).fetchall()
+        assert got == [(base[0][0] + 5000,)]
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# peer health gossip at the cluster layer
+# ---------------------------------------------------------------------------
+
+
+def test_peer_health_gossip_benches_worker_on_survivors():
+    """Coordinator A quarantines a worker; the gossiped verdict trips
+    B's breaker WITHOUT local evidence (retry.HealthBoard.force_open)
+    and removes the worker from B's schedulable set.  Recovery is never
+    gossip's call: probation still applies locally."""
+    from presto_tpu.parallel import cluster as C
+
+    d = FL.FleetDirectory()
+    ma = d.join("A", "http://a.invalid")
+    mb = d.join("B", "http://b.invalid")
+    bad, ok = "http://127.0.0.1:9", "http://127.0.0.1:10"
+    cb = C.ClusterSession(presto_tpu.connect(), [bad, ok], fleet=mb)
+    assert bad in cb.workers
+    # A's quarantine site gossips exactly this
+    ma.gossip_health(bad, "open")
+    assert mb.counters["health_gossip_received"] == 1
+    assert cb.health.state(bad) == "open"
+    assert bad not in cb.workers and ok in cb.workers
+    # a 'closed' verdict is ignored — recovery needs LOCAL probation
+    ma.gossip_health(bad, "closed")
+    assert cb.health.state(bad) == "open"
+    assert bad not in cb.workers
+
+
+# ---------------------------------------------------------------------------
+# introspection
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_stats_ride_info_and_metrics():
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet()
+    try:
+        info = json.loads(urllib.request.urlopen(
+            srv_a.uri + "/v1/info", timeout=30).read())
+        assert info["fleet"]["coordId"] == "A"
+        assert info["fleet"]["ring"] == ["A", "B"]
+        assert "slots" in info["fleet"]
+        scrape = urllib.request.urlopen(
+            srv_a.uri + "/v1/metrics", timeout=30).read().decode()
+        assert "presto_tpu_fleet_coordinators 2" in scrape
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_watch_fleet_unregisters_dead_coordinator():
+    """Discovery integration: the heartbeat failure detector maps a dead
+    coordinator URI to directory.leave — ring shrinks, leases reclaim —
+    without an explicit goodbye."""
+    from presto_tpu.server import discovery as D
+
+    d = FL.FleetDirectory()
+    a = d.join("A", "http://127.0.0.1:1")  # nothing listens: born dead
+    sb = _session()
+    srv_b = PrestoTpuServer(sb).start()
+    d.join("B", srv_b.uri)
+    d.slots.register_worker("http://w1", 2)
+    assert a.lease_slot("http://w1")
+    det = D.watch_fleet(d, interval=0.05).start()
+    try:
+        import time as _time
+
+        t0 = _time.monotonic()
+        while "A" in d.ring.members() \
+                and _time.monotonic() - t0 < FL.GOSSIP_TIMEOUT_S * 10:
+            _time.sleep(0.05)
+        assert d.ring.members() == ["B"]
+        assert d.slots.stats()["inFlight"] == 0  # A's leases reclaimed
+    finally:
+        det.stop()
+        srv_b.stop()

@@ -479,7 +479,7 @@ def test_no_raw_sleeps_or_timeouts_in_parallel():
     sdir = os.path.join(ROOT, "presto_tpu", "server")
     checked += [(f"server/{fn}", os.path.join(sdir, fn))
                 for fn in ("serving.py", "protocol.py",
-                           "resource_groups.py")]
+                           "resource_groups.py", "fleet.py")]
     bad = []
     for fn, path in checked:
         with open(path, encoding="utf-8") as f:
@@ -502,4 +502,47 @@ def test_no_raw_sleeps_or_timeouts_in_parallel():
                         f"{fn}:{kw.value.lineno}: hard-coded "
                         f"timeout={kw.value.value!r} — use a named "
                         "*_S / *_TIMEOUT_S constant")
+    assert not bad, "\n".join(bad)
+
+
+def test_fleet_ring_and_lease_arithmetic_confined_to_fleet():
+    """Fleet-coordination gate (ISSUE 16): consistent-hash ring
+    arithmetic and slot-lease accounting live ONLY in server/fleet.py —
+    the protocol front door and the cluster scheduler consume VERDICTS
+    (affinity_key / owns / owner_uri / lease_slot / release_slot),
+    never ring points or ledger internals.  A second bisect over a
+    private point list, or lease math inlined at a POST site, would
+    fork the ownership model exactly the way a magic bandwidth number
+    forks fusion pricing — so the same confinement discipline applies:
+    the ring-hash helper, the ring's point list, the lease board's
+    in-flight ledger and counters, and raw bisect ring lookups are
+    forbidden everywhere else in the package."""
+    import ast
+
+    ALLOWED = {os.path.join("server", "fleet.py")}
+    FORBIDDEN = {"_ring_hash", "_points", "_in_flight",
+                 "leases_granted", "lease_waits", "leases_reclaimed",
+                 "insort", "bisect_right", "bisect_left"}
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg)
+            if rel in ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in FORBIDDEN:
+                    bad.append(f"{rel}:{node.lineno}: .{node.attr} — "
+                               "ring/lease arithmetic belongs in "
+                               "server/fleet.py (consume owns/"
+                               "lease_slot verdicts instead)")
+                if isinstance(node, ast.Name) and node.id == "_ring_hash":
+                    bad.append(f"{rel}:{node.lineno}: _ring_hash — "
+                               "ring hashing belongs in server/fleet.py")
     assert not bad, "\n".join(bad)

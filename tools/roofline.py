@@ -186,6 +186,107 @@ def calibrate(out_path=None):
     return prof
 
 
+def fleet_sweep(max_coord=4):
+    """`tools/roofline.py --fleet [N]`: coordinator-dispatch saturation
+    sweep for the multi-coordinator fleet (server/fleet.py, ISSUE 16).
+
+    The serving tier's admission gate (concurrency slots + queue) makes
+    a SINGLE front door admission-bound long before the executor is
+    compute-bound; this sweep measures aggregate EXECUTE throughput as
+    coordinators are added — in-process servers over ONE shared catalog
+    and one FleetDirectory, signature-affinity proxying on — and reports
+    where the marginal door stops paying (<10% QPS gain), i.e. where
+    dispatch has saturated the machine rather than the admission gate.
+    Prints ONE JSON line; the committed scaling record is SERVE_r03.json
+    (bench.py --serve --coordinators N)."""
+    import threading
+
+    import numpy as np
+
+    import presto_tpu
+    from presto_tpu import types as T
+    from presto_tpu.client import connect_http
+    from presto_tpu.server import PrestoTpuServer
+    from presto_tpu.server import fleet as FL
+
+    nrow, clients, per_client = 100_000, 8, 25
+    out = {"metric": "fleet_dispatch_saturation", "rows": nrow,
+           "clients": clients, "per_client": per_client,
+           "cores": os.cpu_count()}
+
+    def one_leg(ncoord):
+        d = FL.FleetDirectory()
+        servers = []
+        base = None
+        for i in range(ncoord):
+            s = presto_tpu.connect(coalesce_max_batch=4)
+            if base is None:
+                base = s
+                s.catalog.register_memory(
+                    "t", {"k": T.BIGINT, "x": T.DOUBLE},
+                    {"k": np.arange(nrow, dtype=np.int64),
+                     "x": np.arange(nrow, dtype=np.float64) * 1.5})
+            else:
+                s.catalog = base.catalog
+            srv = PrestoTpuServer(s).start()
+            m = d.join(f"c{i}", srv.uri)
+            srv.fleet = m
+            srv.serving.attach_fleet(m)
+            servers.append(srv)
+        try:
+            connect_http(servers[0].uri).execute(
+                "PREPARE fq FROM SELECT count(*) c, sum(x) s FROM t "
+                "WHERE k < ?")
+            for srv in servers:  # per-door warm (compile + route maps)
+                connect_http(srv.uri).execute("EXECUTE fq USING 10")
+            lat, errs = [], []
+
+            def run(cid):
+                uri = servers[cid % ncoord].uri
+                for i in range(per_client):
+                    t0 = time.perf_counter()
+                    try:
+                        connect_http(uri).execute(
+                            f"EXECUTE fq USING {100 + cid * 997 + i}"
+                        ).fetchall()
+                        lat.append(time.perf_counter() - t0)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(str(e))
+
+            ths = [threading.Thread(target=run, args=(c,))
+                   for c in range(clients)]
+            t0 = time.perf_counter()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            wall = time.perf_counter() - t0
+            lat.sort()
+            return {"coordinators": ncoord,
+                    "queries": len(lat), "failures": len(errs),
+                    "qps": round(len(lat) / wall, 1),
+                    "p50_ms": round(lat[len(lat) // 2] * 1000, 1),
+                    "p99_ms": round(lat[int(len(lat) * 0.99)] * 1000, 1)}
+        finally:
+            for srv in servers:
+                srv.stop()
+
+    legs, prev_qps, saturated_at = {}, None, None
+    n = 1
+    while n <= max_coord:
+        leg = one_leg(n)
+        legs[f"c{n}"] = leg
+        if prev_qps is not None and saturated_at is None \
+                and leg["qps"] < prev_qps * 1.10:
+            saturated_at = n  # the marginal door stopped paying
+        prev_qps = leg["qps"]
+        n *= 2
+    out["legs"] = legs
+    out["saturated_at_coordinators"] = saturated_at
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -654,5 +755,8 @@ if __name__ == "__main__":
     if "--calibrate" in sys.argv:
         args = [a for a in sys.argv[1:] if not a.startswith("--")]
         calibrate(args[0] if args else None)
+    elif "--fleet" in sys.argv:
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        fleet_sweep(int(args[0]) if args else 4)
     else:
         main()
