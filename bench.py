@@ -1510,6 +1510,166 @@ def recovery_bench():
         return None
 
 
+CHAOS_RECORD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "CHAOS_r01.json")
+
+
+def load_chaos_record():
+    try:
+        with open(CHAOS_RECORD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+CHAOS_GATE_MTTR_RATIO = 3.0  # FAIL above this multiple of committed MTTR
+
+
+def _chaos_gate(record, committed):
+    """Regression gate vs the committed record (committed-record exit-0
+    discipline, like the other *_r*.json records): a failed leg FAILs;
+    MTTR regressions gate platform-matched with generous headroom —
+    recovery walls are single-digit-to-hundreds of ms, so scheduler
+    noise needs a wide band."""
+    for leg in ("task_rerun", "worker_crash", "coordinator_adoption"):
+        if not record[leg].get("ok"):
+            return f"FAIL: {leg} leg did not recover"
+    if committed is None \
+            or committed.get("platform") != record["platform"]:
+        return "pass (no comparable committed record)"
+    for leg in ("task_rerun", "worker_crash", "coordinator_adoption"):
+        old = committed.get(leg, {}).get("mttr_ms")
+        new = record[leg].get("mttr_ms")
+        if old and new and new > old * CHAOS_GATE_MTTR_RATIO:
+            return (f"FAIL: {leg} MTTR {new}ms vs committed {old}ms "
+                    f"(> {CHAOS_GATE_MTTR_RATIO}x)")
+    return "pass"
+
+
+def chaos_bench():
+    """`--chaos`: MTTR-style recovery latencies under seeded FaultPlans
+    (docs/ROBUSTNESS.md "Recovery matrix"), each vs a fault-free
+    baseline on the same in-process cluster: single-task rerun
+    (task-granular restart inside the attempt), worker crash mid-wave
+    (survivor remap), and coordinator death with journaled adoption
+    (ring-successor resume over the durable exchange).  Emits
+    CHAOS_r01.json; the committed record is the regression reference."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import presto_tpu
+    from presto_tpu.catalog import tpch_catalog
+    from presto_tpu.parallel import cluster as C
+    from presto_tpu.parallel import faults as F
+    from presto_tpu.server import fleet as FL
+
+    q = ("SELECT o_orderpriority, count(*) c FROM orders "
+         "GROUP BY o_orderpriority ORDER BY 1")
+    cat = tpch_catalog(0.01, cache_dir="/tmp/presto_tpu_cache")
+    session = presto_tpu.connect(cat)
+    session.properties["cluster_query_deadline_s"] = 120.0
+    workers = [C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                              faults=F.FaultPlan([])).start()
+               for _ in range(2)]
+    urls = [w.url for w in workers]
+    cs = C.ClusterSession(session, urls)
+    tmp = tempfile.mkdtemp(prefix="pt_chaos_bench_")
+    record = {"platform": jax.devices()[0].platform, "sf": 0.01,
+              "task_rerun": {"ok": False}, "worker_crash": {"ok": False},
+              "coordinator_adoption": {"ok": False}, "asof": _today()}
+    try:
+        want = cs.sql(q).rows  # prewarm: compile + page-path caches
+        walls = []
+        for _ in range(3):
+            t0 = time.monotonic()
+            cs.sql(q)
+            walls.append((time.monotonic() - t0) * 1000)
+        record["baseline_ms"] = round(sorted(walls)[1], 1)
+
+        # leg 1: ONE task fails mid-wave -> same-attempt slot rerun
+        plan = F.FaultPlan.parse("exec:EXEC:*:1:fail")
+        workers[1].faults = plan
+        t0 = time.monotonic()
+        ok = cs.sql(q).rows == want
+        done = time.monotonic()
+        rec = session.last_stats.recovery
+        record["task_rerun"] = {
+            "ok": bool(ok and plan.fired
+                       and rec.get("tasks_rerun", 0) == 1),
+            "wall_ms": round((done - t0) * 1000, 1),
+            "mttr_ms": round((done - plan.fired[0][0]) * 1000, 1)
+            if plan.fired else None,
+            "tasks_rerun": rec.get("tasks_rerun", 0)}
+        workers[1].faults = F.FaultPlan([])
+
+        # leg 2: coordinator A dies with the query journaled mid-flight;
+        # B (the ring successor) adopts and resumes from the durable
+        # exchange — MTTR is death verdict -> adopted rows in hand
+        props = {"spill_path": os.path.join(tmp, "spill"),
+                 "query_journal_path": os.path.join(tmp, "journal"),
+                 "cluster_query_retries": 0, "cluster_task_restarts": 0,
+                 "cluster_query_deadline_s": 120.0}
+        d = FL.FleetDirectory()
+        ma = d.join("A", "http://a.invalid")
+        mb = d.join("B", "http://b.invalid")
+        for w in workers:
+            d.slots.register_worker(w.url, 8)
+        sa = presto_tpu.connect(cat)
+        sa.properties.update(props)
+        ca = C.ClusterSession(sa, urls, fleet=ma)
+        ca._journal_keep = True  # A dies before its cleanup runs
+        workers[1].faults = F.FaultPlan.parse("exec:EXEC:*:1:fail")
+        try:
+            ca.sql(q)
+        except Exception:
+            pass  # the scripted death of coordinator A
+        workers[1].faults = F.FaultPlan([])
+        t0 = time.monotonic()
+        d.leave("A")
+        sb = presto_tpu.connect(cat)
+        sb.properties.update(props)
+        cb = C.ClusterSession(sb, urls, fleet=mb)
+        out = cb.adopt_journaled("A")
+        done = time.monotonic()
+        rec = sb.last_stats.recovery
+        record["coordinator_adoption"] = {
+            "ok": bool(len(out) == 1
+                       and not isinstance(out[0][1], Exception)
+                       and out[0][1].rows == want
+                       and rec.get("queries_adopted", 0) == 1),
+            "mttr_ms": round((done - t0) * 1000, 1),
+            "queries_adopted": rec.get("queries_adopted", 0),
+            "adoption_ms": rec.get("adoption_ms", 0)}
+
+        # leg 3 (destructive, last): worker crash mid-wave -> survivors
+        plan = F.FaultPlan.parse("exec:EXEC:*:1:crash")
+        workers[1].faults = plan
+        ok = cs.sql(q).rows == want
+        done = time.monotonic()
+        record["worker_crash"] = {
+            "ok": bool(ok and plan.fired),
+            "mttr_ms": round((done - plan.fired[0][0]) * 1000, 1)
+            if plan.fired else None}
+    except Exception as e:
+        print(f"bench: chaos bench FAILED ({type(e).__name__}: {e})",
+              file=sys.stderr)
+    finally:
+        for w in workers:
+            if not w.crashed:
+                w.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    record["gate"] = _chaos_gate(record, load_chaos_record())
+    try:
+        with open(CHAOS_RECORD_PATH, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+    except OSError:
+        pass
+    print(json.dumps(record), flush=True)
+    return record
+
+
 def load_scale_progress():
     try:
         with open(SCALE_PROGRESS_PATH) as f:
@@ -1723,5 +1883,7 @@ if __name__ == "__main__":
         write_bench()
     elif "--spill" in sys.argv:
         spill_bench()
+    elif "--chaos" in sys.argv:
+        chaos_bench()
     else:
         main()

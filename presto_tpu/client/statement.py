@@ -3,6 +3,13 @@
 Reference parity: StatementClientV1 state machine — advance() fetches
 the next QueryResults page; duplicate token fetches are safe
 (at-least-once + dedup, server/TaskResource.java:244-307 analog).
+
+Fleet failover: `backup_uris` names the OTHER doors of a coordinator
+fleet.  When the door this client is polling stops answering, the same
+path is retried against each backup — any door resolves a journaled
+in-flight query through its proxied/journal_lookup chain
+(server/protocol.py), so a coordinator death mid-poll degrades to a
+door switch instead of a client error.
 """
 
 from __future__ import annotations
@@ -19,10 +26,13 @@ class QueryError(Exception):
 
 
 class StatementClient:
-    def __init__(self, server_uri: str, sql: str, poll_interval: float = 0.05):
+    def __init__(self, server_uri: str, sql: str,
+                 poll_interval: float = 0.05,
+                 backup_uris: Optional[List[str]] = None):
         self.server_uri = server_uri.rstrip("/")
         self.sql = sql
         self.poll_interval = poll_interval
+        self.backup_uris = [u.rstrip("/") for u in (backup_uris or [])]
         self.query_id: Optional[str] = None
         self.columns: Optional[List[dict]] = None
         self.stats: dict = {}
@@ -35,7 +45,8 @@ class StatementClient:
     # refuses to auto-follow a redirected POST body — follow it here
     MAX_REDIRECTS = 4
 
-    def _request(self, method: str, url: str, body: Optional[bytes] = None):
+    def _request_once(self, method: str, url: str,
+                      body: Optional[bytes] = None):
         for _ in range(self.MAX_REDIRECTS):
             req = urllib.request.Request(url, data=body, method=method)
             try:
@@ -48,6 +59,34 @@ class StatementClient:
                     raise
                 url = loc
         raise QueryError(f"redirect loop at {url}")
+
+    def _request(self, method: str, url: str, body: Optional[bytes] = None):
+        try:
+            return self._request_once(method, url, body)
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            if isinstance(e, urllib.error.HTTPError):
+                raise  # the door answered; failover is for dead doors
+            last = e
+        # the door died (connection refused/reset): replay the SAME
+        # path through each backup door — its journal_lookup/proxy
+        # chain resolves the query wherever it now lives, and from here
+        # on this client polls the door that answered
+        prefix_len = len(self.server_uri)
+        path = url[prefix_len:] if url.startswith(self.server_uri) else None
+        if path is not None:
+            for backup in self.backup_uris:
+                if backup == self.server_uri:
+                    continue
+                try:
+                    payload = self._request_once(method,
+                                                 f"{backup}{path}", body)
+                except (urllib.error.HTTPError, QueryError):
+                    raise
+                except (urllib.error.URLError, ConnectionError, OSError):
+                    continue
+                self.server_uri = backup
+                return payload
+        raise last
 
     def _absorb(self, payload: dict) -> None:
         self.query_id = payload.get("id", self.query_id)

@@ -1831,8 +1831,14 @@ class Executor:
         from presto_tpu.memory.spill import (default_spill_dir, load_batch,
                                              save_batch)
 
-        enabled = bool(self.session.properties.get(
-            "recoverable_grouped_execution", False))
+        # "auto" (the session default) means ON only for CLUSTER
+        # durable-exchange recovery (parallel/cluster.py) — the
+        # single-node checkpoint path here stays opt-in via an explicit
+        # True/"on"
+        rge = self.session.properties.get(
+            "recoverable_grouped_execution", False)
+        enabled = rge is True or str(rge).strip().lower() in (
+            "true", "on", "1")
         # without a monitor there is no query text to fingerprint; sharing
         # a checkpoint key across unknown queries could serve query A's
         # buckets to query B, so recovery requires the monitored path

@@ -342,6 +342,18 @@ def observe_query(stats) -> None:
         getattr(stats, "total_ns", 0) / 1e6)
 
 
+def record_recovery(kind: str, n: int = 1) -> None:
+    """Count a recovery action that happens OUTSIDE a query's own
+    RunContext — e.g. protocol-level adoption of a dead peer's
+    journaled queries (server/protocol._adopt_from), which runs before
+    any QueryStats exists to fold the counter through observe_query.
+    Same family as the per-query recovery keys, so dashboards see one
+    `presto_tpu_query_recovery_total{kind}` surface either way."""
+    ensure_query_metrics()
+    REGISTRY.counter("presto_tpu_query_recovery_total", "",
+                     ("kind",)).inc(float(n), kind=kind)
+
+
 def listener_error(listener_class: str) -> None:
     """Count one swallowed event-listener failure (observe/events.py)."""
     REGISTRY.counter("presto_tpu_listener_errors_total",

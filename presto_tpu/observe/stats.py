@@ -197,7 +197,11 @@ class QueryStats:
     # cluster-mode recovery counters (parallel/retry.RunContext.count):
     # http_retries, pages_retried, workers_quarantined, workers_readmitted,
     # hedges_launched, hedges_won, task_cancels, query_retries,
-    # deadline_expired — see docs/ROBUSTNESS.md for the schema
+    # deadline_expired, tasks_rerun (task-granular restart),
+    # journal_writes, queries_adopted, adoption_ms (journaled
+    # failover, parallel/journal.py) — see docs/ROBUSTNESS.md for the
+    # schema; every key auto-exports through
+    # presto_tpu_query_recovery_total{kind} (observe/metrics.py)
     recovery: Dict[str, int] = dataclasses.field(default_factory=dict)
     # id(plan node) -> NodeStats; populated in dynamic mode
     node_stats: Dict[int, NodeStats] = dataclasses.field(default_factory=dict)

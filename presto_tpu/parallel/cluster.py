@@ -65,6 +65,7 @@ from presto_tpu import session_ctx as _sctx
 from presto_tpu.exec import compile_cache as CC
 from presto_tpu.observe import trace as TR
 from presto_tpu.parallel import faults as F
+from presto_tpu.parallel import journal as J
 from presto_tpu.parallel import retry as R
 from presto_tpu.plan import runtime_filters as DF
 from presto_tpu.plan import serde as plan_serde
@@ -545,8 +546,11 @@ def _get_page(url: str, task_id: str, bucket: int, token: int,
         # partial-transfer rule's nth is deterministic (503 polls and
         # empty bodies don't consume it)
         prule = F.client_plan().match("client", "PAGE", path)
-        if prule is not None and prule.action == "partial":
-            body = F.corrupt_page(body)
+        if prule is not None:
+            if prule.action == "partial":
+                body = F.corrupt_page(body)
+            else:  # may raise: consumer fails AFTER the page exists
+                F.apply_delivered_page(prule)
     return status, body, complete, enc
 
 
@@ -577,6 +581,28 @@ def pull_pages(url: str, task_id: str, bucket: int,
     pages: List[bytes] = []
     token = 0
     errors_500 = 0
+
+    def _restarted() -> bool:
+        # task-granular restart (ctx.task_restarter, set by the
+        # coordinator around its own pulls): offer the dead slot to the
+        # restarter BEFORE escalating to UpstreamFailed.  On success the
+        # slot is repointed at a fresh replica on a survivor; attempts
+        # execute deterministically, so the already-consumed token
+        # prefix is identical and the pull simply continues — one task
+        # re-ran, not the wave.
+        rs = getattr(ctx, "task_restarter", None)
+        if rs is None or slot is None:
+            return False
+        try:
+            ok = bool(rs(slot))
+        except R.DeadlineExceeded:
+            raise
+        except Exception:  # noqa: BLE001 — a broken restart escalates
+            ok = False
+        if ok:
+            backoff.reset()
+        return ok
+
     while True:
         if slot is not None:
             url, task_id = slot[0], slot[1]
@@ -628,15 +654,24 @@ def pull_pages(url: str, task_id: str, bucket: int,
                 if b"page already released" in detail:
                     # at-least-once bookkeeping says a task retry is the
                     # only fix — no point retrying the request
+                    if _restarted():
+                        errors_500 = 0
+                        continue
                     raise UpstreamFailed(
                         f"task {task_id} on {url} failed: {detail!r}")
                 # transient (flaky server / injected fault) vs genuine
                 # task failure: the status endpoint knows
                 if _task_state(url, task_id, ctx) == "FAILED":
+                    if _restarted():
+                        errors_500 = 0
+                        continue
                     raise UpstreamFailed(
                         f"task {task_id} on {url} failed: {detail!r}")
                 errors_500 += 1
                 if errors_500 >= ctx.policy.max_attempts:
+                    if _restarted():
+                        errors_500 = 0
+                        continue
                     raise UpstreamFailed(
                         f"task {task_id} on {url}: {errors_500} "
                         f"consecutive 500s: {detail!r}")
@@ -651,6 +686,9 @@ def pull_pages(url: str, task_id: str, bucket: int,
             if not ctx.health.probe(url, lambda u: _probe(u, ctx)) \
                     and ctx.health.state(url) != "closed":
                 ctx.count("workers_quarantined", url=url)
+                if _restarted():
+                    errors_500 = 0
+                    continue
                 raise UpstreamFailed(f"worker {url} unreachable: {e}")
             ctx.count("http_retries", url=url, error=type(e).__name__)
         ctx.deadline.check(f"pages from {task_id}@{url}")
@@ -1318,13 +1356,21 @@ class WorkerServer:
     def __init__(self, catalog_spec: str, host: str = "127.0.0.1",
                  port: int = 0, secret: Optional[bytes] = None,
                  faults: Optional["F.FaultPlan"] = None,
-                 mesh_devices: Optional[int] = None):
+                 mesh_devices: Optional[int] = None,
+                 lease_board=None):
         import presto_tpu
 
         # scripted failures for THIS worker (tests pass a plan per
         # server; subprocess workers inherit PRESTO_TPU_FAULTS)
         self.faults = faults if faults is not None else F.FaultPlan.from_env()
         self.crashed = False
+        # in-process fleets hand the worker the shared SlotLeaseBoard so
+        # reap_expired can release a reaped orphan's still-held lease
+        # tag (fleet.SlotLeaseBoard.reclaim_task) the moment the task
+        # dies, instead of waiting for the directory's dead-coordinator
+        # sweep.  Cross-process workers leave this None — the sweep
+        # remains the backstop there.
+        self.lease_board = lease_board
         # fragment fusion: a worker that EXCLUSIVELY owns a local device
         # mesh declares it (operator-granted: PRESTO_TPU_WORKER_MESH or
         # the constructor/--mesh arg, never inferred — an in-process
@@ -1403,6 +1449,7 @@ class WorkerServer:
         so an idle worker still converges when probed."""
         now = time.monotonic()
         reaped = 0
+        freed = []
         with self.lock:
             for tid in [t for t, e in self.tasks.items()
                         if e.get("expires_at") is not None
@@ -1413,6 +1460,16 @@ class WorkerServer:
                     for p in ps if p is not None)
                 self.counters["tasks_reaped"] += 1
                 reaped += 1
+                if gone.get("lease_coord"):
+                    freed.append(gone["lease_coord"])
+        # release the reaped tasks' slot-lease tags (the coordinator
+        # that POSTed them is dead and will never DELETE): reap-freed
+        # and sweep-freed leases both count as reclaimed, and a tag the
+        # sweep already freed no-ops — tasks_reaped and leases_reclaimed
+        # agree in the coordinator-crash chaos test
+        if self.lease_board is not None:
+            for coord in freed:
+                self.lease_board.reclaim_task(coord, self.url)
         return reaped
 
     def simulate_crash(self):
@@ -1440,6 +1497,10 @@ class WorkerServer:
                     "range_boundaries": None,
                     "range_event": threading.Event(),
                     "expires_at": expires_at,
+                    # the coordinator holding this task's slot lease
+                    # (fleet fleets only): reap_expired releases the
+                    # tag when it reaps the task
+                    "lease_coord": spec.properties.get("lease_coord"),
                     # dynamic-filter side channel: fid -> {part: payload}
                     "dynfilters": {}, "df_event": threading.Event()}
             self.tasks[spec.task_id] = task
@@ -2081,6 +2142,16 @@ class ClusterSession:
         self._fusion_skips: Dict[str, int] = {}
         self._fusion_mispredicted = 0
         self._fusion_cost_ms = 0.0
+        # fault tolerance (parallel/journal.py): `_resume` is set by
+        # resume_sql so _sql_attempts runs an ADOPTED query against its
+        # journaled durable dir at attempt+1 (completed tasks replay).
+        # `_journal_keep` is the chaos hook: when True, a FAILED
+        # journaled query leaves its journal entry + durable dir behind
+        # — simulating a coordinator that died before cleanup, so
+        # adoption is deterministically testable (precedent:
+        # FleetMember.drop_broadcasts)
+        self._resume = None
+        self._journal_keep = False
 
     def _on_peer_health(self, worker_url: str, verdict: str) -> None:
         """Receive side of fleet health gossip: a peer coordinator's
@@ -2115,6 +2186,71 @@ class ClusterSession:
         raise UpstreamFailed(
             f"worker {url} slot lease timed out after {budget:.1f}s "
             f"(fleet saturated)")
+
+    def _make_restarter(self, all_tasks, ctx):
+        """Task-granular restart hook (the `ctx.task_restarter`
+        contract in pull_pages): when ONE task dies mid-wave, re-run
+        just that task's slot on a healthy survivor inside the SAME
+        attempt — completed siblings' durable pages stay untouched and
+        the fleet-wide `executed` delta equals the failed tasks, not
+        the wave.  The hook repoints the mutable [url, task_id] slot in
+        place (the hedge monitor's winner-swap mechanism) and returns
+        True so the pull resumes at its current token: a restarted task
+        re-publishes the identical page sequence (deterministic
+        execution), so token dedup carries the consumer across.  Fused
+        specs are excluded — their failure degrades the whole attempt
+        to the cut path (_sql_attempts' fused-fallback contract)."""
+        limit = int(self.session.properties.get(
+            "cluster_task_restarts", 2))
+        if limit <= 0 or len(self.workers) < 2:
+            return None
+        counts: Dict[str, int] = {}
+        lock = threading.Lock()
+
+        def _restart(slot) -> bool:
+            url0, tid0 = slot[0], slot[1]
+            spec, fid = self._task_specs.get(tid0, (None, None))
+            if spec is None or spec.properties.get("fused_ndev"):
+                return False
+            base = tid0.split("_r", 1)[0]
+            with lock:
+                n = counts.get(base, 0) + 1
+                if n > limit:
+                    return False  # budget spent: whole-attempt retry
+                counts[base] = n
+            targets = [u for u in self.workers
+                       if u != url0 and self.health.allow(u)]
+            if not targets:
+                return False
+            # deterministic survivor pick (same form the hedge uses)
+            target = targets[(fid + spec.windex + n) % len(targets)]
+            rspec = dataclasses.replace(spec, task_id=f"{base}_r{n}",
+                                        replay=False)
+            if self.fleet is not None \
+                    and not self.fleet.lease_slot(target, timeout_s=0.0):
+                # never queue a restart behind a saturated survivor —
+                # the whole-attempt path will remap with fresh leases
+                return False
+            try:
+                _http_retry(f"{target}/v1/task",
+                            plan_serde.dumps(rspec), method="POST",
+                            ctx=ctx)
+            except Exception:  # noqa: BLE001 — attempt-level retry next
+                if self.fleet is not None:
+                    self.fleet.release_slot(target)
+                return False
+            self._task_specs[rspec.task_id] = (rspec, fid)
+            # all_tasks holds the ALIASED slot list, which is about to
+            # point at the restarted task — snapshot the failed original
+            # as a tuple first (the hedge's loser-cleanup idiom) so the
+            # DELETE sweep reaps BOTH and the lease count stays balanced
+            # (one lease per entry: the original POST's plus this one)
+            all_tasks.append((url0, tid0))
+            slot[0], slot[1] = target, rspec.task_id
+            ctx.count("tasks_rerun", task=tid0, target=target)
+            return True
+
+        return _restart
 
     def _worker_info(self, url: str, ctx: R.RunContext) -> dict:
         """Cached /v1/info mesh declaration of one worker ({} when the
@@ -2291,10 +2427,30 @@ class ClusterSession:
         # durable exchange (P12): pages persist on (shared) disk for the
         # query's lifetime so a retry replays completed tasks instead of
         # re-executing them (reference: REMOTE_MATERIALIZED exchanges +
-        # per-lifespan rescheduling, StageExecutionId.java:28-45)
+        # per-lifespan rescheduling, StageExecutionId.java:28-45).
+        # `recoverable_grouped_execution` defaults to "auto": ON for
+        # cluster queries whenever a spill/durable path is configured
+        # (the durable store rides the spill tier's disk budget);
+        # explicit true/false is respected either way.
+        resume = getattr(self, "_resume", None)
+        rge = self.session.properties.get(
+            "recoverable_grouped_execution", False)
+        rge_s = str(rge).strip().lower()
+        spill_cfg = bool(self.session.properties.get(
+            "spill_enabled", False)) or \
+            bool(str(self.session.properties.get("spill_path", "") or ""))
+        rge_on = rge is True or rge_s in ("true", "on", "1") or \
+            (rge_s == "auto" and spill_cfg)
         ddir = None
-        if bool(self.session.properties.get(
-                "recoverable_grouped_execution", False)):
+        base_attempt = 0
+        if resume is not None:
+            # adoption resume (resume_sql): the SAME durable dir at the
+            # journaled attempt + 1, so the durable store IS the
+            # completed-task map — finished tasks replay from disk and
+            # only the dead coordinator's lost work re-executes
+            ddir = resume.get("ddir")
+            base_attempt = int(resume.get("attempt", 0)) + 1
+        elif rge_on:
             base = str(self.session.properties.get("spill_path", "")) or \
                 os.path.join("/tmp", "presto_tpu_spill")
             ddir = os.path.join(base, "exchange", uuid.uuid4().hex[:16])
@@ -2303,22 +2459,50 @@ class ClusterSession:
         # consistent with pages already durably produced) and remaps the
         # dead workers' slots onto survivors.
         layout = list(self.workers)
+        # query journaling (parallel/journal.py): persist this query's
+        # resumable state to the fleet-visible journal so a ring
+        # successor can adopt it if THIS coordinator dies mid-flight
+        jr, jqid, jentry = None, None, None
+        coord = self.fleet.coord_id if self.fleet is not None else "solo"
+        if ddir is not None and (resume is not None or J.enabled(
+                self.session.properties, self.fleet is not None)):
+            jr = J.QueryJournal(J.root_dir(self.session.properties),
+                                coord_id=coord)
+            jqid = (resume or {}).get("queryId") or \
+                f"jq_{uuid.uuid4().hex[:12]}"
+            jentry = J.entry_for(jqid, text, coord,
+                                 self.session.properties, ddir=ddir,
+                                 layout=list(layout),
+                                 attempt=base_attempt)
+            if jr.write(jentry):
+                ctx.count("journal_writes")
+                if self.fleet is not None:
+                    self.fleet.replicate_journal(jentry)
+        t0r = time.monotonic()
         # entered manually so attempt spans + worker RPCs land inside
         # the execute phase on this query's trace
         phase_cm = mon.phase("execute") if mon is not None else None
         if phase_cm is not None:
             phase_cm.__enter__()
+        ok = False
         try:
             fuse_ok = True
-            for attempt in range(attempts):
+            for attempt in range(base_attempt, base_attempt + attempts):
                 try:
-                    return self._run_distributed(plan, layout, ddir,
-                                                 attempt,
-                                                 allow_fusion=fuse_ok)
+                    result = self._run_distributed(plan, layout, ddir,
+                                                   attempt,
+                                                   allow_fusion=fuse_ok)
+                    ok = True
+                    if resume is not None:
+                        ctx.count("queries_adopted")
+                        ctx.count("adoption_ms", n=max(int(
+                            (time.monotonic() - t0r) * 1000.0), 1))
+                    return result
                 except (Undistributable, NotImplementedError):
                     # plan shape the cluster can't place — single-node
                     # fallback
                     self._fused_count = 0
+                    ok = True
                     return self.session.sql(text)
                 except R.DeadlineExceeded:
                     # the deadline is a query-level budget: never retry
@@ -2353,7 +2537,7 @@ class ClusterSession:
                         # byte-identical fallback contract)
                         fuse_ok = False
                         ctx.count("fused_fallbacks")
-                        if attempt == attempts - 1:
+                        if attempt == base_attempt + attempts - 1:
                             raise
                         if survivors:
                             layout = [u if u in survivors
@@ -2362,8 +2546,11 @@ class ClusterSession:
                             self.workers = survivors
                         ctx.count("query_retries",
                                   survivors=len(survivors))
+                        self._journal_retry(jr, jentry, ctx,
+                                            attempt + 1, layout)
                         continue
-                    if not survivors or attempt == attempts - 1 \
+                    if not survivors or attempt == base_attempt \
+                            + attempts - 1 \
                             or set(survivors) >= set(layout):
                         # same pool => deterministic failure; re-running
                         # would fail identically
@@ -2373,12 +2560,77 @@ class ClusterSession:
                               for i, u in enumerate(layout)]
                     self.workers = survivors
                     ctx.count("query_retries", survivors=len(survivors))
+                    self._journal_retry(jr, jentry, ctx, attempt + 1,
+                                        layout)
             raise RuntimeError("unreachable")
         finally:
             if phase_cm is not None:
                 phase_cm.__exit__(None, None, None)
-            if ddir is not None:
+            # a coordinator ALIVE to observe the outcome cleans up —
+            # journal entries and the durable dir outlive only a
+            # coordinator that died (the `_journal_keep` chaos hook
+            # simulates exactly that death-before-cleanup window)
+            keep = (not ok) and bool(getattr(self, "_journal_keep",
+                                             False))
+            if jr is not None and not keep:
+                jr.remove(jqid)
+            if ddir is not None and not keep:
                 shutil.rmtree(ddir, ignore_errors=True)
+
+    def _journal_retry(self, jr, jentry, ctx, next_attempt,
+                       layout) -> None:
+        """Advance the journal entry before a whole-attempt retry so an
+        adopter resumes past attempts this coordinator already
+        burned (durable keys are attempt-scoped on the publish side)."""
+        if jr is None:
+            return
+        jentry["attempt"] = int(next_attempt)
+        jentry["layout"] = list(layout)
+        if jr.write(jentry):
+            ctx.count("journal_writes")
+            if self.fleet is not None:
+                self.fleet.replicate_journal(jentry)
+
+    def resume_sql(self, text: str, ddir, attempt: int,
+                   query_id: str = ""):
+        """Adopter entry point: re-run a journaled statement against
+        the SAME durable-exchange dir at the journaled attempt + 1, so
+        every task whose durable output completed REPLAYS from disk and
+        only the dead coordinator's lost work re-executes."""
+        self._resume = {"ddir": ddir, "attempt": int(attempt),
+                        "queryId": query_id}
+        try:
+            return self.sql(text)
+        finally:
+            self._resume = None
+
+    def adopt_journaled(self, dead_coord_id: str):
+        """Fleet adoption (discovery.watch_fleet -> ring successor):
+        resume every in-flight journaled query the dead coordinator
+        owned.  Corrupt/unreadable entries are SKIPPED (journal read
+        faults surface as read_errors, never as wrong results).
+        Returns [(query_id, result-or-exception)] in journal order."""
+        import shutil
+
+        jr = J.QueryJournal(J.root_dir(self.session.properties),
+                            coord_id=self.fleet.coord_id
+                            if self.fleet is not None else "solo")
+        out = []
+        for e in jr.entries(coord=dead_coord_id):
+            qid = str(e.get("queryId", ""))
+            try:
+                res = self.resume_sql(str(e.get("sql", "")),
+                                      e.get("ddir"),
+                                      int(e.get("attempt", 0)),
+                                      query_id=qid)
+                out.append((qid, res))
+            except Exception as exc:  # noqa: BLE001 — per-query isolation
+                out.append((qid, exc))
+            finally:
+                jr.remove(qid)
+                if e.get("ddir"):
+                    shutil.rmtree(e["ddir"], ignore_errors=True)
+        return out
 
     def _eval_subplan(self, sub, scalar_results) -> tuple:
         """Uncorrelated scalar subplan -> (value, valid), distributed the
@@ -2560,6 +2812,7 @@ class ClusterSession:
                 fragments, scalar_results, run_on_of, consumer_of,
                 placements, all_tasks, ddir=ddir, attempt=attempt)
         finally:
+            ctx.task_restarter = None
             hedge = getattr(self, "_hedge", None)
             if hedge is not None:
                 hedge.stop()
@@ -2709,13 +2962,23 @@ class ClusterSession:
                 rem = ctx.deadline.remaining()
                 deadline_s = None if rem == float("inf") else max(rem, 0.0)
                 fused = getattr(frag, "fused", False)
+                # content-addressed durable key: a fingerprint of the
+                # fragment's serialized root + exchange shape, NOT its
+                # fid.  Stable under the fused->unfused renumbering, so
+                # FUSED tasks participate in replay too: a fused root's
+                # serde bytes differ from every cut fragment's (keys
+                # can't alias across execution models), while fragments
+                # the fallback leaves untouched keep byte-identical
+                # roots and REPLAY their completed durable pages.
+                dkey_base = None
+                if ddir is not None:
+                    hh = hashlib.blake2b(payload_root, digest_size=8)
+                    hh.update(repr((frag.out_kind, frag.out_keys,
+                                    out_buckets, len(run_on))).encode())
+                    dkey_base = f"x{hh.hexdigest()}"
                 for w, (url, tid) in enumerate(placements[frag.fid]):
-                    # fused tasks skip the durable exchange: the fused
-                    # fragment layout differs from the retry's cut
-                    # layout, so a durable key could alias a DIFFERENT
-                    # fragment's pages onto the unfused re-run
-                    dkey = f"f{frag.fid}_w{w}" \
-                        if ddir is not None and not fused else None
+                    dkey = f"{dkey_base}_w{w}" \
+                        if dkey_base is not None else None
                     # a completed durable output from a prior attempt means
                     # this slot REPLAYS from disk — only the victim's lost
                     # work re-executes (per-bucket retry, P12)
@@ -2755,6 +3018,12 @@ class ClusterSession:
                             # "full" turns on worker page-pull spans
                             "trace_detail": self.session.properties.get(
                                 "trace_detail", "basic"),
+                            # slot-lease provenance: the worker tags the
+                            # task with the leasing coordinator so
+                            # reap_expired can release a lease that
+                            # coordinator died still holding
+                            "lease_coord": self.fleet.coord_id
+                            if self.fleet is not None else None,
                             # spill tiering (exec/spill_exec.py): the
                             # degradation knobs travel with every task so
                             # cluster fragment executors arm the same
@@ -2836,6 +3105,12 @@ class ClusterSession:
             if watch:
                 self._hedge = _HedgeMonitor(self, watch, all_tasks, ctx)
                 self._hedge.start()
+        # task-granular restart: arm the pull-side hook so one task's
+        # mid-wave death re-runs ONLY that slot on a survivor inside
+        # this same attempt (pull_pages consults ctx.task_restarter
+        # before surfacing UpstreamFailed); disarmed in _schedule's
+        # finally so cancellation never races a restart POST
+        ctx.task_restarter = self._make_restarter(all_tasks, ctx)
         # the final fragment executes here, pulling pages (and thereby
         # blocking) until upstream production drains
         pages: Dict[int, List[bytes]] = {}

@@ -33,14 +33,25 @@ Choke points:
   `enospc` makes the write fail as if `SpillSpaceTracker` hit its
   bound.  Every spill fault must surface as a clean typed failure or a
   transparent re-spill (spill_verify_writes) — never wrong results.
+- `journal` — the query journal (parallel/journal.py) around each
+  entry write (method `WRITE`) and each adopter-side read (method
+  `READ`), path = the journal entry path: `fail`/`enospc` fail the op
+  cleanly (the query degrades to journal-less execution), `drop`
+  loses a write silently, `corrupt`/`truncate`/`partial` damage the
+  bytes so the adopter's read returns None and the entry is SKIPPED,
+  `delay` stalls the op.  `client:PROXY` is the companion
+  coordinator-death-mid-poll hook: server/protocol.proxy_fetch matches
+  it before forwarding, so a scripted rule makes the owner door
+  unreachable at exactly the nth client poll.
 
 Grammar (env `PRESTO_TPU_FAULTS`, inherited by worker subprocesses, or
 programmatic via `FaultPlan(...)` / `install(...)`):
 
     rule[;rule...]          rule = where:method:path:nth:action[:arg]
 
-    where  = client | server | exec | spill | coalesce
-    method = GET | POST | DELETE | EXEC | PAGE | WRITE | BATCH | * (any);
+    where  = client | server | exec | spill | coalesce | journal
+    method = GET | POST | DELETE | EXEC | PAGE | PROXY | WRITE | READ
+             | BATCH | * (any);
              PAGE is the
              client-side delivered-page pseudo-method — its nth counts
              200-with-body results responses, so a `partial` rule
@@ -209,6 +220,24 @@ def apply_client(method: str, path: str) -> Optional[FaultRule]:
     return rule  # partial: caller truncates the response body
 
 
+def apply_delivered_page(rule: FaultRule) -> None:
+    """Non-`partial` actions on a DELIVERED page (the PAGE
+    pseudo-method, matched by cluster._get_page after the body is in
+    hand).  Raising HERE models a consumer that received the page but
+    failed processing it: the producer has demonstrably COMPLETED that
+    page — and durably published it when the exchange is durable — so
+    the rule's nth is deterministic even against a slow producer, where
+    a plain GET rule would race the producer's 503-poll window."""
+    if rule.action == "http500":
+        raise urllib.error.HTTPError(
+            "delivered page", 500, "injected fault", None,
+            io.BytesIO(b"injected fault"))
+    if rule.action == "reset":
+        raise ConnectionResetError("injected fault: delivered-page reset")
+    if rule.action == "drop":
+        raise urllib.error.URLError(TimeoutError("injected fault: drop"))
+
+
 def corrupt_page(body: bytes) -> bytes:
     """The `partial` action: keep the length, destroy the tail — the
     PTPG checksum catches it downstream and the pull re-requests the
@@ -248,6 +277,15 @@ def damage_spill_file(path: str, action: str) -> None:
             tail = f.read(min(64, size - pos))
             f.seek(pos)
             f.write(bytes(b ^ 0xFF for b in tail))
+
+
+def apply_journal(method: str, path: str) -> Optional[FaultRule]:
+    """Journal choke point (parallel/journal.QueryJournal, around each
+    entry WRITE and adopter READ).  Pure match like `apply_spill` — the
+    JOURNAL interprets the rule (it owns the file and the degrade
+    semantics: a failed write means journal-less execution, a corrupt
+    read means the adopter skips the entry)."""
+    return client_plan().match("journal", method, path)
 
 
 def apply_server(rule: FaultRule, handler, server) -> bool:

@@ -1,0 +1,244 @@
+"""Query journal: fleet-visible resumable state for in-flight queries.
+
+The coordinator journals each distributed query's resumable state —
+statement text, session-property fingerprint, prepared binds, task
+layout, durable-exchange dir, completed-task map, attempt counter — to
+a shared-dir file per query (tmp+`os.replace` discipline, exactly the
+PR-9 manifest pattern), best-effort replicated over the `/v1/fleet/*`
+peer bus.  When `discovery.watch_fleet` declares a coordinator dead,
+the ring successor ADOPTS its journaled queries (server/fleet.py
+`adopter_of`) and resumes them from the durable exchange store: the
+adopter re-executes the statement with the SAME durable dir at
+attempt+1, so every task whose `_DONE` marker landed replays from disk
+instead of re-executing (parallel/cluster.py replay path, PR 2).
+
+ALL journal file I/O lives in this module — the lint rule
+(tests/test_lint.py, same pattern as the spill-I/O rule) confines the
+journal filename suffix and its open()/replace() calls here, so
+protocol/fleet/cluster code can only reach the journal through this
+API.  Reference analog: the reference engine's REMOTE_MATERIALIZED
+exchanges + per-lifespan rescheduling (StageExecutionId.java:28-45)
+persist exactly this "what finished / what must re-run" boundary.
+
+Fault surface (parallel/faults.py): `journal:WRITE:<path>` and
+`journal:READ:<path>` rules fire here — `fail`/`enospc` make the op
+fail cleanly, `corrupt`/`truncate`/`partial` damage the bytes (a
+corrupt entry reads as None and the adopter SKIPS it, never crashes),
+`drop` silently loses a write, `delay` stalls it.  Every counter the
+journal keeps (`writes`, `write_errors`, `read_errors`, `removed`)
+rides `stats()` onto /v1/info; the per-query `journal_writes` recovery
+counter is counted at the call sites via RunContext.count.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+from presto_tpu.parallel import faults as F
+from presto_tpu.parallel import retry as R
+
+#: journal entry filename suffix — the lint rule confines this string
+#: (and therefore any hand-rolled journal path) to this module
+SUFFIX = ".qj.json"
+
+#: default journal dir under the spill base (docs/admin/spill.md)
+DEFAULT_SPILL_BASE = "/tmp/presto_tpu_spill"
+
+
+def root_dir(properties: Dict) -> str:
+    """The fleet-visible journal directory: `query_journal_path` when
+    set, else `<spill base>/journal` — every coordinator that shares a
+    spill base (the durable-exchange prerequisite) shares the journal."""
+    explicit = str(properties.get("query_journal_path") or "")
+    if explicit:
+        return explicit
+    base = str(properties.get("spill_path") or "") or DEFAULT_SPILL_BASE
+    return os.path.join(base, "journal")
+
+
+def enabled(properties: Dict, fleet_attached: bool = False) -> bool:
+    """`query_journal` session property: on/off/auto.  Auto journals
+    exactly when there is a fleet to adopt the queries — a solo
+    coordinator's journal has no reader."""
+    v = properties.get("query_journal", "auto")
+    if v is True:
+        return True
+    s = str(v).strip().lower()
+    if s in ("true", "on", "1"):
+        return True
+    if v is False or s in ("false", "off", "0", ""):
+        return False
+    return bool(fleet_attached)
+
+
+def props_fingerprint(properties: Dict) -> str:
+    """Stable fingerprint of the session properties a resumed execution
+    must reproduce (the adopter asserts intent, not byte equality —
+    defaults drift across versions; the fingerprint makes drift
+    VISIBLE in the journal entry rather than silently divergent)."""
+    try:
+        blob = json.dumps(properties, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        blob = repr(sorted(properties.items(), key=lambda kv: kv[0]))
+    return hashlib.blake2b(blob.encode(), digest_size=8).hexdigest()
+
+
+def entry_for(query_id: str, sql: str, coord_id: str, properties: Dict,
+              ddir: Optional[str] = None, layout: Optional[List[str]] = None,
+              attempt: int = 0, binds: Optional[list] = None) -> Dict:
+    """A well-formed journal entry (the resumable-state schema the
+    adopter consumes; docs/ROBUSTNESS.md recovery matrix)."""
+    return {
+        "queryId": query_id,
+        "sql": sql,
+        "coord": coord_id,
+        "state": "RUNNING",
+        "propsFp": props_fingerprint(properties),
+        "binds": list(binds or []),
+        "ddir": ddir,
+        "layout": list(layout or []),
+        "attempt": int(attempt),
+        "completed": [],
+    }
+
+
+class QueryJournal:
+    """One coordinator's handle on the shared journal directory.
+
+    Thread-safe; every write is a whole-entry tmp+`os.replace` so a
+    reader (the adopter, possibly on another host over a shared
+    filesystem) never observes a torn entry — at worst a corrupt one,
+    which `read` reports as None and callers skip."""
+
+    def __init__(self, root: str, coord_id: str = ""):
+        self.root = root
+        self.coord_id = coord_id
+        self._lock = threading.Lock()
+        self.counters = {"writes": 0, "write_errors": 0,
+                         "read_errors": 0, "removed": 0}
+
+    def path(self, query_id: str) -> str:
+        return os.path.join(self.root, f"{query_id}{SUFFIX}")
+
+    # -- write ----------------------------------------------------------
+
+    def write(self, entry: Dict) -> bool:
+        """Persist one entry atomically; returns False when the write
+        failed (journal faults degrade the query to journal-less
+        execution — they NEVER fail it)."""
+        qid = str(entry.get("queryId") or "")
+        if not qid:
+            return False
+        path = self.path(qid)
+        rule = F.apply_journal("WRITE", path)
+        if rule is not None and rule.action == "delay":
+            R._sleep(rule.arg)
+            rule = None
+        if rule is not None and rule.action in ("fail", "enospc", "reset"):
+            with self._lock:
+                self.counters["write_errors"] += 1
+            return False
+        if rule is not None and rule.action == "drop":
+            # a lost write: the caller believes it persisted
+            with self._lock:
+                self.counters["writes"] += 1
+            return True
+        data = json.dumps(entry, sort_keys=True, default=str).encode()
+        if rule is not None and rule.action in ("corrupt", "partial"):
+            data = F.corrupt_page(data)
+        elif rule is not None and rule.action == "truncate":
+            data = data[:max(1, len(data) // 2)]
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError:
+            with self._lock:
+                self.counters["write_errors"] += 1
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        with self._lock:
+            self.counters["writes"] += 1
+        return True
+
+    # -- read -----------------------------------------------------------
+
+    def read(self, query_id: str) -> Optional[Dict]:
+        """Load one entry; None when absent or unreadable.  A corrupt
+        entry (seeded `journal:READ` fault or a real torn/damaged file)
+        is COUNTED and skipped — adoption must survive a bad entry."""
+        path = self.path(query_id)
+        rule = F.apply_journal("READ", path)
+        if rule is not None and rule.action == "delay":
+            R._sleep(rule.arg)
+            rule = None
+        if rule is not None and rule.action in ("fail", "drop", "reset",
+                                                "enospc"):
+            with self._lock:
+                self.counters["read_errors"] += 1
+            return None
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        if rule is not None and rule.action in ("corrupt", "partial"):
+            data = F.corrupt_page(data)
+        elif rule is not None and rule.action == "truncate":
+            data = data[:max(1, len(data) // 2)]
+        try:
+            entry = json.loads(data.decode())
+        except (ValueError, UnicodeDecodeError):
+            with self._lock:
+                self.counters["read_errors"] += 1
+            return None
+        if not isinstance(entry, dict) or not entry.get("queryId"):
+            with self._lock:
+                self.counters["read_errors"] += 1
+            return None
+        return entry
+
+    def entries(self, coord: Optional[str] = None) -> List[Dict]:
+        """Every readable entry (optionally only a given coordinator's),
+        sorted by query id for deterministic adoption order."""
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(SUFFIX):
+                continue
+            entry = self.read(name[:-len(SUFFIX)])
+            if entry is None:
+                continue
+            if coord is not None and entry.get("coord") != coord:
+                continue
+            out.append(entry)
+        return out
+
+    # -- remove ---------------------------------------------------------
+
+    def remove(self, query_id: str) -> None:
+        """Retire a finished (or terminally failed) query's entry — a
+        query whose coordinator lived to observe its outcome must never
+        be adopted."""
+        try:
+            os.remove(self.path(query_id))
+            with self._lock:
+                self.counters["removed"] += 1
+        except OSError:
+            pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counters)

@@ -289,6 +289,13 @@ class RunContext:
         self.listeners = listeners or []
         self.query_id = query_id
         self.recovery: Dict[str, int] = {}
+        # task-granular restart hook (parallel/cluster.py): set by the
+        # coordinator around its own page pulls; pull_pages offers the
+        # failing slot here BEFORE escalating to UpstreamFailed, so one
+        # dead task re-runs on a survivor inside the SAME attempt
+        # instead of re-dispatching the whole wave.  Signature:
+        # restarter(slot) -> bool (True = slot repointed, keep pulling).
+        self.task_restarter = None
         self._lock = threading.Lock()
 
     def count(self, key: str, n: int = 1, **detail) -> None:

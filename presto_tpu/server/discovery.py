@@ -114,15 +114,20 @@ def watch_fleet(directory, interval: float = 0.5,
     """Pin coordinator-fleet membership (server/fleet.FleetDirectory) to
     the heartbeat failure detector: every registered coordinator is
     pinged like any other node, and one that crosses the failure
-    threshold LEAVES the fleet — its ring arc reassigns to survivors and
-    its worker slot leases are reclaimed in one sweep, so a dead
-    coordinator can neither own signatures nor squat fleet capacity.
-    The caller starts/stops the returned detector."""
+    threshold LEAVES the fleet — its ring arc reassigns to survivors,
+    its worker slot leases are reclaimed in one sweep, and the death is
+    relayed to every survivor (FleetDirectory.leave -> relay_death) so
+    the ring successor ADOPTS its journaled in-flight queries
+    (server/protocol._on_peer_death + parallel/journal.py).  A dead
+    coordinator can neither own signatures, squat fleet capacity, nor
+    strand a polling client.  The caller starts/stops the returned
+    detector."""
 
     def on_failure(uri: str) -> None:
         for cid, curi in list(directory.coordinators().items()):
             if curi == uri:
                 directory.leave(cid)
+                det.unregister(uri)
 
     det = HeartbeatFailureDetector(interval=interval,
                                    on_failure=on_failure)

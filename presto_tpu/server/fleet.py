@@ -25,7 +25,14 @@ Design rules (docs/SERVING.md "Multi-coordinator topology"):
 - LEASES ARE THE ONLY OVERSUBSCRIPTION GUARD.  A coordinator must hold
   a worker's slot lease before POSTing a task to it; releases are
   idempotent and a dead coordinator's leases are reclaimed when the
-  directory unregisters it (heartbeat failure or explicit leave).
+  directory unregisters it (heartbeat failure or explicit leave) or,
+  per-task, when a worker reaps the orphaned task itself
+  (`SlotLeaseBoard.reclaim_task`).
+- DEATH IS A RELAYED EVENT, ADOPTION IS DETERMINISTIC.  `leave()`
+  relays the death to every survivor after the ring shrank;
+  `adopter_of(dead)` — the dead id re-hashed onto the shrunk ring —
+  names the ONE ring successor that adopts the dead door's journaled
+  in-flight queries (parallel/journal.py, server/protocol.py).
 
 The lint suite (tests/test_lint.py) confines ring-hash/ownership and
 slot-lease arithmetic to THIS module, the same discipline that keeps
@@ -215,6 +222,25 @@ class SlotLeaseBoard:
                 self._cond.notify_all()
             return n
 
+    def reclaim_task(self, coord_id: str, url: str) -> bool:
+        """Release ONE lease tag because the worker reaped the task it
+        covered (`WorkerServer.reap_expired`): the orphan's slot frees
+        as soon as the task does, not only at the directory sweep.
+        Counts toward `leases_reclaimed` — the coordinator-crash chaos
+        test asserts reaped tasks and reclaimed leases agree.  False
+        when nothing was held (the directory sweep already ran, or the
+        task was DELETEd normally) — double release must no-op."""
+        with self._cond:
+            held = self._held.get(url)
+            if not held or held.get(coord_id, 0) <= 0:
+                return False
+            held[coord_id] -= 1
+            if held[coord_id] == 0:
+                del held[coord_id]
+            self.leases_reclaimed += 1
+            self._cond.notify_all()
+            return True
+
     def in_flight(self) -> Dict[str, int]:
         with self._cond:
             return {url: self._in_flight(url) for url in self._cap}
@@ -256,13 +282,20 @@ class FleetDirectory:
 
     def leave(self, coord_id: str) -> int:
         """Remove a coordinator (crash or drain): ring shrinks, leases
-        reclaim.  Returns the reclaimed-lease count."""
+        reclaim, and the death is relayed to every survivor so the ring
+        successor can adopt the journaled in-flight queries
+        (server/protocol._on_peer_death).  Returns the reclaimed-lease
+        count."""
         self.ring.remove(coord_id)
         with self._lock:
+            was_member = coord_id in self._members
             self._uris.pop(coord_id, None)
             self._members.pop(coord_id, None)
             self.epoch += 1
-        return self.slots.reclaim(coord_id)
+        n = self.slots.reclaim(coord_id)
+        if was_member:
+            self.relay_death(coord_id)
+        return n
 
     def uri_of(self, coord_id: str) -> Optional[str]:
         with self._lock:
@@ -290,6 +323,23 @@ class FleetDirectory:
         for m in members:
             m.on_health(origin_id, worker_url, verdict)
 
+    def relay_death(self, dead_id: str) -> None:
+        """Tell every SURVIVOR a coordinator is gone (leave() calls this
+        after the ring shrank, so `adopter_of` answers identically on
+        every survivor)."""
+        with self._lock:
+            members = [m for cid, m in self._members.items()
+                       if cid != dead_id]
+        for m in members:
+            m.on_death(dead_id)
+
+    def relay_journal(self, origin_id: str, entry: dict) -> None:
+        with self._lock:
+            members = [m for cid, m in self._members.items()
+                       if cid != origin_id]
+        for m in members:
+            m.on_journal(origin_id, entry)
+
 
 class FleetMember:
     """One coordinator's fleet handle: ring view, lease client, and the
@@ -315,6 +365,8 @@ class FleetMember:
         # receive-side hooks, wired by the embedding tier
         self._invalidate_cbs: List[Callable[[str, int], None]] = []
         self._health_cbs: List[Callable[[str, str], None]] = []
+        self._death_cbs: List[Callable[[str], None]] = []
+        self._journal_cbs: List[Callable[[dict], None]] = []
         # test hook for the dropped-broadcast fault leg: when set, sends
         # are counted as dropped instead of delivered (the version-key
         # check must then carry correctness alone)
@@ -359,6 +411,27 @@ class FleetMember:
                     in self.directory.coordinators().items()
                     if cid != self.coord_id}
         return dict(self._static_peers)
+
+    def coordinator_uri(self, coord_id: str) -> Optional[str]:
+        """A specific coordinator's door URI (None when unknown)."""
+        if coord_id == self.coord_id:
+            return self.uri
+        if self.directory is not None:
+            return self.directory.uri_of(coord_id)
+        return self._static_peers.get(coord_id)
+
+    # -- adoption (journaled-query failover) ---------------------------
+    def adopter_of(self, dead_id: str) -> Optional[str]:
+        """The ring SUCCESSOR that adopts a dead coordinator's journaled
+        queries: the dead id re-hashed onto the ring AFTER it left.
+        Deterministic — every survivor derives the same ring from the
+        same membership, so they all name the same adopter and exactly
+        one door resumes each orphaned query."""
+        return self._ring().owner(f"adopt::{dead_id}")
+
+    def should_adopt(self, dead_id: str) -> bool:
+        who = self.adopter_of(dead_id)
+        return who is not None and who == self.coord_id
 
     # -- gossip send ---------------------------------------------------
     def _post_peer(self, uri: str, path: str, payload: dict) -> bool:
@@ -422,14 +495,41 @@ class FleetMember:
         self._count("prepares_replicated", delivered)
         return delivered
 
+    def replicate_journal(self, entry: dict) -> int:
+        """Best-effort journal-entry replication over the peer bus
+        (`/v1/fleet/journal`), so an adopter whose filesystem does NOT
+        share the journal dir still holds the resumable state.  Shared-
+        dir fleets get an idempotent re-write of the same entry.  Like
+        every broadcast: a miss never fails the query — the shared dir
+        (when present) is belt, replication is suspenders."""
+        if self.drop_broadcasts:
+            return 0
+        delivered = 0
+        if self.directory is not None:
+            self.directory.relay_journal(self.coord_id, entry)
+            delivered = len(self.peer_uris())
+        else:
+            payload = {"origin": self.coord_id, "entry": entry}
+            for uri in self._static_peers.values():
+                if self._post_peer(uri, "/v1/fleet/journal", payload):
+                    delivered += 1
+        self._count("journal_replicated", delivered)
+        return delivered
+
     # -- gossip receive ------------------------------------------------
     def subscribe(self, on_invalidate: Optional[Callable] = None,
-                  on_health: Optional[Callable] = None) -> None:
+                  on_health: Optional[Callable] = None,
+                  on_death: Optional[Callable] = None,
+                  on_journal: Optional[Callable] = None) -> None:
         with self._lock:
             if on_invalidate is not None:
                 self._invalidate_cbs.append(on_invalidate)
             if on_health is not None:
                 self._health_cbs.append(on_health)
+            if on_death is not None:
+                self._death_cbs.append(on_death)
+            if on_journal is not None:
+                self._journal_cbs.append(on_journal)
 
     def on_invalidate(self, origin_id: str, token: str,
                       version: int) -> None:
@@ -450,6 +550,26 @@ class FleetMember:
         for cb in cbs:
             try:
                 cb(worker_url, verdict)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def on_death(self, dead_id: str) -> None:
+        self._count("deaths_observed")
+        with self._lock:
+            cbs = list(self._death_cbs)
+        for cb in cbs:
+            try:
+                cb(dead_id)
+            except Exception:  # noqa: BLE001 — adoption is best-effort
+                pass
+
+    def on_journal(self, origin_id: str, entry: dict) -> None:
+        self._count("journal_received")
+        with self._lock:
+            cbs = list(self._journal_cbs)
+        for cb in cbs:
+            try:
+                cb(entry)
             except Exception:  # noqa: BLE001
                 pass
 

@@ -19,6 +19,15 @@ machine (server/GracefulShutdownHandler.java).
 Execution is in-process on the embedded engine (the coordinator IS the
 mesh driver under SPMD — workers are TPU chips, not task servers; the
 reference ships plan fragments to worker JVMs, SURVEY.md §3.1).
+
+Fault tolerance (docs/ROBUSTNESS.md): with a fleet attached, in-flight
+read queries journal their resumable state (parallel/journal.py) and a
+peer coordinator's death triggers ADOPTION on its ring successor — the
+adopted query re-runs under its ORIGINAL query id, so a client polling
+nextUri through any surviving door completes: the unknown-qid chain
+falls through proxied_owner -> journal_lookup, which proxies to the
+entry's (re-homed) coordinator or holds the client in RUNNING while
+the adoption races.
 """
 
 from __future__ import annotations
@@ -81,13 +90,15 @@ class PrestoTpuServer:
         # follow it — so coalescing batches and cache hits concentrate
         # instead of fragmenting 1/N per coordinator.  `fleet=None` is
         # the single-coordinator path, byte-identical to round 18.
-        self.fleet = fleet
+        self.fleet = None
+        self._journal = None
         if fleet is not None:
-            self.serving.attach_fleet(fleet)
+            self.attach_fleet(fleet)
         self._proxied: Dict[str, str] = {}  # proxied query id -> owner uri
         self._proxied_lock = threading.Lock()
         self.fleet_counters = {"proxied": 0, "redirected": 0,
-                               "proxy_failures": 0}
+                               "proxy_failures": 0, "journal_writes": 0,
+                               "queries_adopted": 0, "adoption_ms": 0}
         # security.PasswordAuthenticator | None — when set, every /v1
         # request must carry HTTP Basic credentials (reference:
         # password authenticators wired through http-server.authentication)
@@ -190,6 +201,7 @@ class PrestoTpuServer:
         if slot is not None:
             job.resource_group = slot.group.full_name
         t0 = time.monotonic()
+        journaled = False
         with self._sema:
             try:
                 if job.cancel.is_set():
@@ -204,6 +216,22 @@ class PrestoTpuServer:
                         "explicit transactions are not supported over the "
                         "shared protocol server; use an embedded session")
                 job.state = "RUNNING"
+                if self._journal is not None:
+                    first = job.sql.lstrip().split(None, 1)[0].upper()
+                    if first in ("SELECT", "WITH", "VALUES", "EXECUTE"):
+                        # journal the in-flight query (read statements
+                        # only: adoption RE-EXECUTES, so a journaled
+                        # write could double-apply) under its protocol
+                        # query id — the id the client's nextUri holds
+                        from presto_tpu.parallel import journal as _J
+
+                        ent = _J.entry_for(job.query_id, job.sql,
+                                           self.fleet.coord_id,
+                                           self.session.properties)
+                        if self._journal.write(ent):
+                            journaled = True
+                            self.fleet_counters["journal_writes"] += 1
+                            self.fleet.replicate_journal(ent)
                 self.session.apply_property_manager()
                 cached = self.serving.result_lookup(job.sql)
                 if cached is not None:
@@ -259,6 +287,10 @@ class PrestoTpuServer:
                 # the group's soft/hard CPU limits (reference:
                 # per-query cpuUsageMillis charged on completion)
                 self.serving.release(slot, cpu_s=time.monotonic() - t0)
+                if journaled:
+                    # alive to observe the outcome => clean up; only a
+                    # coordinator that DIED leaves entries for adoption
+                    self._journal.remove(job.query_id)
                 job.done.set()
                 with self.jobs_lock:
                     self.active_queries -= 1
@@ -335,6 +367,14 @@ class PrestoTpuServer:
 
         from presto_tpu.server import fleet as FL
 
+        from presto_tpu.parallel import faults as F
+
+        if F.client_plan().match("client", "PROXY",
+                                 f"{owner}{path}") is not None:
+            # scripted coordinator-death-mid-poll: the owner door is
+            # unreachable at exactly the nth proxied poll (any action)
+            self.fleet_counters["proxy_failures"] += 1
+            return None
         try:
             req = urllib.request.Request(f"{owner}{path}", method=method)
             with urllib.request.urlopen(
@@ -355,6 +395,138 @@ class PrestoTpuServer:
     def proxied_owner(self, qid: str) -> Optional[str]:
         with self._proxied_lock:
             return self._proxied.get(qid)
+
+    # -- journaled failover (parallel/journal.py) ----------------------
+    def attach_fleet(self, fleet) -> None:
+        """Wire a FleetMember into this door: ring-affinity routing in
+        the serving tier, query journaling + adoption (with `query_journal`
+        not explicitly off, this door journals in-flight read queries
+        and adopts a dead peer's journaled queries when discovery/gossip
+        declares the death — the ring successor is the deterministic
+        adopter), and the peer journal/death subscriptions.  `fleet=None`
+        at construction is the single-coordinator path, byte-identical
+        to round 18."""
+        from presto_tpu.parallel import journal as _J
+
+        self.fleet = fleet
+        self.serving.attach_fleet(fleet)
+        if _J.enabled(self.session.properties, fleet_attached=True):
+            self._journal = _J.QueryJournal(
+                _J.root_dir(self.session.properties),
+                coord_id=fleet.coord_id)
+        fleet.subscribe(on_death=self._on_peer_death,
+                        on_journal=self._on_peer_journal)
+
+    def _on_peer_journal(self, entry: dict) -> None:
+        """Best-effort replication receive: persist a peer's journal
+        entry locally so adoption works even when the journal root is
+        not a genuinely shared directory (idempotent when it is)."""
+        if self._journal is not None and entry.get("queryId"):
+            self._journal.write(dict(entry))
+
+    def _on_peer_death(self, dead_id: str) -> None:
+        """Fleet death relay (discovery.watch_fleet -> directory.leave
+        -> on_death): the ring SUCCESSOR of the dead coordinator — a
+        pure function of the post-leave ring, so every survivor picks
+        the same adopter — resumes its journaled in-flight queries."""
+        if self._journal is None or self.fleet is None \
+                or not self.fleet.should_adopt(dead_id):
+            return
+        threading.Thread(target=self._adopt_from, args=(dead_id,),
+                         daemon=True).start()
+
+    def _adopt_from(self, dead_id: str) -> None:
+        t0 = time.monotonic()
+        adopted = 0
+        for e in self._journal.entries(coord=dead_id):
+            qid = str(e.get("queryId", ""))
+            sql = str(e.get("sql", ""))
+            if not qid or not sql:
+                continue
+            with self.jobs_lock:
+                if qid in self.jobs:
+                    continue  # already adopted (or raced a re-submit)
+                job = _QueryJob(query_id=qid, sql=sql)
+                self.jobs[qid] = job
+                self.active_queries += 1
+            # re-home the entry FIRST: peers' journal_lookup proxies
+            # the client's polls here while the query re-runs
+            e["coord"] = self.fleet.coord_id
+            if self._journal.write(e):
+                self.fleet.replicate_journal(e)
+            adopted += 1
+            self._run_adopted(job, e)
+        if adopted:
+            from presto_tpu.observe import metrics as M
+
+            self.fleet_counters["queries_adopted"] += adopted
+            self.fleet_counters["adoption_ms"] += max(
+                int((time.monotonic() - t0) * 1000.0), 1)
+            M.record_recovery("queries_adopted", adopted)
+
+    def _run_adopted(self, job: _QueryJob, entry: dict) -> None:
+        """Execute one adopted query under its ORIGINAL query id.  A
+        journaled durable-exchange dir routes through the session's
+        resume path (completed tasks replay from the durable store);
+        otherwise the statement re-executes — reads only, so re-running
+        is safe (see the journaling filter in _run_job)."""
+        try:
+            job.state = "RUNNING"
+            if entry.get("ddir") and hasattr(self.session, "resume_sql"):
+                result = self.session.resume_sql(
+                    job.sql, entry.get("ddir"),
+                    int(entry.get("attempt", 0)),
+                    query_id=job.query_id)
+            else:
+                result = self.session.sql(job.sql)
+            job.columns = [{"name": n, "type": str(t).lower()}
+                           for n, t in result.columns]
+            job.rows = [list(r) for r in result.rows]
+            job.stats = {"state": "FINISHED",
+                         "processedRows": len(job.rows),
+                         "adopted": True}
+            job.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001 — adoption reports all errors
+            job.error = f"{type(e).__name__}: {e}"
+            job.state = "FAILED"
+        finally:
+            self._journal.remove(job.query_id)
+            job.done.set()
+            with self.jobs_lock:
+                self.active_queries -= 1
+
+    def journal_lookup(self, qid: str, path: str) -> Optional[dict]:
+        """Coordinator-death-mid-poll fallback for the unknown-qid
+        chain: a query id that appears in the fleet journal is in
+        flight SOMEWHERE — proxy the poll to the entry's (re-homed)
+        coordinator, then to the dead owner's ring successor, and as a
+        last resort hold the client in RUNNING against THIS door while
+        the adoption races the poll."""
+        if self._journal is None or self.fleet is None:
+            return None
+        e = self._journal.read(qid)
+        if e is None:
+            return None
+        coord = str(e.get("coord", ""))
+        if coord and coord != self.fleet.coord_id:
+            target = self.fleet.coordinator_uri(coord)
+            if target is not None and target != self.uri:
+                got = self.proxy_fetch(target, path)
+                if got is not None:
+                    return got
+            # journaled owner unreachable (it probably just died):
+            # its ring successor is the deterministic adopter
+            succ = self.fleet.adopter_of(coord)
+            if succ and succ != self.fleet.coord_id:
+                target = self.fleet.coordinator_uri(succ)
+                if target is not None and target != self.uri:
+                    got = self.proxy_fetch(target, path)
+                    if got is not None:
+                        return got
+        return {"id": qid,
+                "infoUri": f"{self.uri}/v1/query/{qid}",
+                "stats": {"state": "RUNNING"},
+                "nextUri": f"{self.uri}{path}"}
 
     # -- protocol payloads --------------------------------------------
     def results_payload(self, job: _QueryJob, token: int) -> dict:
@@ -717,6 +889,11 @@ def _make_handler(server: PrestoTpuServer):
                     return self._json(
                         {"error": f"{type(e).__name__}: {e}"}, 400)
                 return self._json({"ok": True})
+            if action == "journal":
+                server.fleet.on_journal(
+                    str(payload.get("origin", "")),
+                    payload.get("entry") or {})
+                return self._json({"ok": True})
             return self._json({"error": "not found"}, 404)
 
         def do_GET(self):
@@ -731,6 +908,13 @@ def _make_handler(server: PrestoTpuServer):
                         proxied = server.proxy_fetch(owner, self.path)
                         if proxied is not None:
                             return self._json(proxied)
+                    # coordinator-death-mid-poll: an unknown qid that
+                    # the fleet journal knows is in flight elsewhere
+                    # (or being adopted right here) keeps the client
+                    # polling instead of 404ing
+                    adopted = server.journal_lookup(parts[2], self.path)
+                    if adopted is not None:
+                        return self._json(adopted)
                     return self._json({"error": "unknown query"}, 404)
                 try:
                     token = int(parts[3])

@@ -30,8 +30,14 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # instead of the P11 range-exchange sample sort
     "distributed_sort_threshold_rows": 100_000,
     # persist per-bucket grouped-execution results so a re-run after a
-    # failure resumes from completed buckets (P8 recoverable execution)
-    "recoverable_grouped_execution": False,
+    # failure resumes from completed buckets (P8 recoverable execution).
+    # In CLUSTER mode the same knob gates the durable exchange store
+    # (replayable task output, parallel/cluster.py).  "auto" (default):
+    # ON for multi-worker cluster queries whenever a spill/durable path
+    # is configured (spill_enabled or an explicit spill_path) — the
+    # fault-tolerant execution default — and OFF for the single-node
+    # checkpoint path, which stays opt-in (True/"on").
+    "recoverable_grouped_execution": "auto",
     # test hook: abort after N grouped buckets (0 = off)
     "fault_injection_fail_after_buckets": 0,
     # fuse sum-shaped aggregates into one Pallas pass (kernels.fused_group_sums)
@@ -130,6 +136,24 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "cluster_hedge_min_s": 0.25,    # ... with at least this headroom
     "cluster_health_trip_after": 3,   # consecutive failures to quarantine
     "cluster_health_probation_s": 5.0,  # re-probe a quarantined worker
+    # task-granular restart (parallel/cluster.py, fault-tolerant
+    # execution): when ONE task dies mid-wave the coordinator re-runs
+    # just that slot on a healthy survivor inside the SAME attempt
+    # (hedge-style slot repoint; completed siblings' durable pages are
+    # untouched) — up to this many restarts per slot before escalating
+    # to the whole-attempt retry.  0 disables (whole-attempt retry
+    # only, the pre-round-20 behavior the attempt-level chaos tests
+    # pin).
+    "cluster_task_restarts": 2,
+    # query journal (parallel/journal.py): fleet-visible resumable
+    # state per in-flight distributed query, so the ring successor
+    # adopts a dead coordinator's queries (docs/ROBUSTNESS.md).
+    # "auto" (default) journals exactly when a fleet is attached;
+    # on/off force it.  query_journal_path overrides the journal dir
+    # ("" = <spill base>/journal — coordinators sharing a spill base
+    # share the journal).
+    "query_journal": "auto",
+    "query_journal_path": "",
     # compilation economics (exec/compile_cache.py): persistent XLA
     # executable cache directory ("" = env PRESTO_TPU_COMPILE_CACHE /
     # legacy PRESTO_TPU_XLA_CACHE / the /tmp default; "0" or "off"

@@ -265,8 +265,12 @@ def test_worker_crash_mid_wave_remaps_to_survivors(tpch_catalog_tiny):
     """Acceptance: a scripted worker crash mid-wave trips the circuit
     breaker; the retry remaps the dead slots onto survivors and the
     query succeeds — the crashed worker lands in quarantine, not in an
-    endless probe loop."""
+    endless probe loop.  Task-granular restart is pinned OFF: this
+    test exercises the whole-attempt remap path deliberately (the
+    in-attempt path has its own test, test_task_crash_reruns_one_slot
+    — with restarts on, this crash never escalates to a retry)."""
     session = presto_tpu.connect(tpch_catalog_tiny)
+    session.properties["cluster_task_restarts"] = 0
     workers = [C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
                               faults=F.FaultPlan([])).start()
                for _ in range(2)]
@@ -473,8 +477,11 @@ def test_crash_remap_yields_one_well_formed_trace(tpch_catalog_tiny):
     """A worker crash + query retry still merges into ONE well-formed
     trace (second-attempt task spans under the same trace id); spans
     from the crashed worker are simply absent, never an error.
-    (Tier-2: spins its own 2-worker cluster + prewarm.)"""
+    (Tier-2: spins its own 2-worker cluster + prewarm.  Task-granular
+    restart pinned OFF — this exercises the whole-attempt retry
+    trace.)"""
     session = presto_tpu.connect(tpch_catalog_tiny)
+    session.properties["cluster_task_restarts"] = 0
     workers = [C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
                               faults=F.FaultPlan([])).start()
                for _ in range(2)]
@@ -567,7 +574,8 @@ def test_coordinator_crash_failover_reclaim_and_orphan_reap(chaos):
             "pages": {0: [(page, C.PAGE_ENC_PTPG)]}, "complete": True,
             "range_boundaries": None, "range_event": None,
             "expires_at": _time.monotonic() - 1.0,  # deadline long past
-            "dynfilters": {}, "df_event": None}
+            "dynfilters": {}, "df_event": None,
+            "lease_coord": "A"}  # slot-lease provenance tag (ISSUE 17)
         w0.counters["buffered_bytes"] += len(page)
         buffered_before = w0.counters["buffered_bytes"]
         reaped_before = w0.counters["tasks_reaped"]
@@ -577,13 +585,20 @@ def test_coordinator_crash_failover_reclaim_and_orphan_reap(chaos):
     assert d.slots.stats()["inFlight"] == 0
     assert d.slots.stats()["leasesReclaimed"] == 2
     # the worker's opportunistic sweep (rides /v1/info) reaps the
-    # orphan and frees its page buffer
-    info = _rq.urlopen(w0.url + "/v1/info", timeout=30).read()
+    # orphan and frees its page buffer; its lease-release of the tag is
+    # a no-op here — the directory sweep got there first, and a double
+    # release must never over-count (ISSUE 17 satellite)
+    w0.lease_board = d.slots
+    try:
+        info = _rq.urlopen(w0.url + "/v1/info", timeout=30).read()
+    finally:
+        w0.lease_board = None
     assert b"tasks_reaped" in info
     with w0.lock:
         assert "q-dead-A.0.0" not in w0.tasks
         assert w0.counters["tasks_reaped"] == reaped_before + 1
         assert w0.counters["buffered_bytes"] == buffered_before - len(page)
+    assert d.slots.stats()["leasesReclaimed"] == 2  # double release no-ops
     # the survivor serves the retried submit over the same fleet —
     # identical checksum, leases cycle back to zero, no task residue
     cb = C.ClusterSession(session, [w.url for w in workers], fleet=mb)
@@ -594,3 +609,234 @@ def test_coordinator_crash_failover_reclaim_and_orphan_reap(chaos):
     for w in workers:
         with w.lock:
             assert not w.tasks  # survivor DELETEd everything it made
+
+
+# ---- fault-tolerant execution (ISSUE 17) ------------------------------
+
+
+def test_task_crash_reruns_one_slot(chaos):
+    """Acceptance (1): ONE task fails mid-wave -> only that slot re-runs
+    on the healthy survivor inside the SAME attempt.  tasks_rerun == 1,
+    zero query-level retries, zero quarantines (the worker is healthy —
+    only its task died), and the fleet-wide `executed` delta equals the
+    clean run's: the failed exec never counted, its rerun adds the one
+    back, and completed siblings are never re-executed."""
+    session, cs, workers, want = chaos
+    try:
+        base = [_df_counters(w.url) for w in workers]
+        assert norm(cs.sql(QUERY).rows) == want  # clean-run delta
+        mid = [_df_counters(w.url) for w in workers]
+        clean = sum(a["executed"] - b["executed"]
+                    for a, b in zip(mid, base))
+        assert clean >= 2
+        workers[1].faults = F.FaultPlan.parse("exec:EXEC:*:1:fail")
+        assert norm(cs.sql(QUERY).rows) == want
+        rec = session.last_stats.recovery
+        assert rec.get("tasks_rerun", 0) == 1, rec
+        assert "query_retries" not in rec, rec
+        assert "workers_quarantined" not in rec, rec
+        after = [_df_counters(w.url) for w in workers]
+        fault = sum(a["executed"] - b["executed"]
+                    for a, b in zip(after, mid))
+        assert fault == clean, (fault, clean)
+        for w in workers:  # original AND rerun both DELETEd
+            assert not w.tasks, list(w.tasks)
+    finally:
+        _reset(session, cs, workers)
+
+
+def test_journal_write_fault_degrades_to_journalless(chaos, tmp_path):
+    """Fault surface: a failed journal write NEVER fails the query — it
+    degrades to journal-less execution (no `journal_writes` recovery
+    counter, no entry on disk, identical results)."""
+    import os as _os
+
+    from presto_tpu.parallel import journal as J
+
+    session, cs, workers, want = chaos
+    keys = ("query_journal", "query_journal_path",
+            "recoverable_grouped_execution")
+    saved = {k: session.properties.get(k) for k in keys}
+    session.properties["query_journal"] = True
+    session.properties["query_journal_path"] = str(tmp_path)
+    session.properties["recoverable_grouped_execution"] = True
+    F.install(F.FaultPlan.parse("journal:WRITE:*:1+:fail"))
+    try:
+        assert norm(cs.sql(QUERY).rows) == want
+        rec = session.last_stats.recovery
+        assert "journal_writes" not in rec, rec
+        assert "query_retries" not in rec, rec
+        assert not any(n.endswith(J.SUFFIX)
+                       for n in _os.listdir(tmp_path))
+    finally:
+        session.properties.update(saved)
+        _reset(session, cs, workers)
+
+
+def test_coordinator_death_adoption_replays_journal(chaos, tmp_path,
+                                                    tpch_catalog_tiny):
+    """Acceptance (2): coordinator A dies with an in-flight journaled
+    query; the ring successor B adopts it and the query completes with
+    a checksum identical to the fault-free run, `queries_adopted >= 1`,
+    worker 0's completed durable pages REPLAYED (not re-executed), only
+    the lost task re-run, zero leaked worker tasks, and the journal
+    entry retired."""
+    import os as _os
+
+    from presto_tpu.server import fleet as FL
+
+    session, cs, workers, want = chaos
+    _reset(session, cs, workers)
+    props = {"spill_path": str(tmp_path / "spill"),
+             "query_journal_path": str(tmp_path / "journal"),
+             "cluster_query_retries": 0,
+             "cluster_task_restarts": 0}
+    d = FL.FleetDirectory()
+    ma = d.join("A", "http://a.invalid")
+    mb = d.join("B", "http://b.invalid")
+    for w in workers:
+        d.slots.register_worker(w.url, 8)
+    sa = presto_tpu.connect(tpch_catalog_tiny)
+    sa.properties.update(props)
+    ca = C.ClusterSession(sa, [w.url for w in workers], fleet=ma)
+    ca._journal_keep = True  # A dies before its cleanup runs
+    workers[1].faults = F.FaultPlan.parse("exec:EXEC:*:1:fail")
+    try:
+        with pytest.raises(C.UpstreamFailed):
+            ca.sql(QUERY)
+        assert sa.last_stats.recovery.get("journal_writes", 0) >= 1
+        jroot = str(tmp_path / "journal")
+        assert len(_os.listdir(jroot)) == 1  # the entry outlived A
+        # the failure detector's verdict: A leaves; B is the successor
+        d.leave("A")
+        assert mb.should_adopt("A")
+        workers[1].faults = F.FaultPlan([])
+        sb = presto_tpu.connect(tpch_catalog_tiny)
+        sb.properties.update(props)
+        cb = C.ClusterSession(sb, [w.url for w in workers], fleet=mb)
+        pre = [_df_counters(w.url) for w in workers]
+        out = cb.adopt_journaled("A")
+        assert len(out) == 1
+        _qid, res = out[0]
+        assert not isinstance(res, Exception), res
+        assert norm(res.rows) == want  # checksum identical
+        rec = sb.last_stats.recovery
+        assert rec.get("queries_adopted", 0) == 1, rec
+        assert rec.get("adoption_ms", 0) >= 1, rec
+        post = [_df_counters(w.url) for w in workers]
+        # the survivor's completed durable pages replayed from disk...
+        assert post[0]["replayed"] - pre[0]["replayed"] == 1
+        assert post[0]["executed"] - pre[0]["executed"] == 0
+        # ...and only the dead coordinator's lost work re-executed
+        assert post[1]["executed"] - pre[1]["executed"] == 1
+        assert _os.listdir(jroot) == []  # entry retired by the adopter
+        for w in workers:  # zero leaked worker tasks
+            assert not w.tasks, list(w.tasks)
+    finally:
+        _reset(session, cs, workers)
+
+
+def test_fused_attempt_crash_adopter_replays_fused_pages(
+        tpch_catalog_tiny, tmp_path):
+    """Satellite (ISSUE 17): fused attempts participate in durable
+    replay.  The durable key is content-addressed on the POST-fusion
+    fragment serde, so when the coordinator dies AFTER the fused task
+    completed (its results pull never succeeds), the adopter's
+    force-fused resume REPLAYS the fused task's durable pages instead
+    of re-executing them — and a fused root's key can never alias a cut
+    fragment's (different serde bytes)."""
+    from presto_tpu.server import fleet as FL
+
+    props = {"fragment_fusion": "force",
+             "spill_path": str(tmp_path / "spill"),
+             "query_journal_path": str(tmp_path / "journal"),
+             "cluster_query_retries": 0,
+             "cluster_task_restarts": 0}
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    want = norm(session.sql(FUSE_QUERY).rows)
+    meshy = C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                           mesh_devices=4).start()
+    plain = C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache").start()
+    d = FL.FleetDirectory()
+    ma = d.join("A", "http://a.invalid")
+    mb = d.join("B", "http://b.invalid")
+    for w in (meshy, plain):
+        d.slots.register_worker(w.url, 8)
+    sa = presto_tpu.connect(tpch_catalog_tiny)
+    sa.properties.update(props)
+    ca = C.ClusterSession(sa, [meshy.url, plain.url], fleet=ma)
+    ca._journal_keep = True
+    try:
+        # the fused task executes and durably publishes; the coordinator
+        # "dies" consuming its DELIVERED pages (the PAGE pseudo-method
+        # fires only on 200-with-body responses, so the fused task has
+        # demonstrably completed + durably published each faulted page
+        # — a plain GET rule would race the producer and cancel the
+        # fused task mid-execution; 500s are bounded by the retry
+        # budget, unlike resets, which the pull loop absorbs while the
+        # worker's health probes keep succeeding)
+        F.install(F.FaultPlan.parse("client:PAGE:/results/:1+:http500"))
+        with pytest.raises(C.UpstreamFailed):
+            ca.sql(FUSE_QUERY)
+        F.install(None)
+        assert meshy.counters["tasks_fused"] >= 1  # it really fused
+        d.leave("A")
+        sb = presto_tpu.connect(tpch_catalog_tiny)
+        sb.properties.update(props)
+        cb = C.ClusterSession(sb, [meshy.url, plain.url], fleet=mb)
+        pre = _df_counters(meshy.url)
+        out = cb.adopt_journaled("A")
+        assert len(out) == 1
+        _qid, res = out[0]
+        assert not isinstance(res, Exception), res
+        assert norm(res.rows) == want
+        post = _df_counters(meshy.url)
+        assert post["replayed"] - pre["replayed"] >= 1
+        assert post["executed"] - pre["executed"] == 0  # no re-execution
+        assert sb.last_stats.recovery.get("queries_adopted", 0) == 1
+    finally:
+        F.install(None)
+        for w in (meshy, plain):
+            if not w.crashed:
+                w.stop()
+
+
+def test_worker_reap_releases_held_lease_tags(chaos):
+    """Satellite (ISSUE 17): reap_expired releases a reaped orphan's
+    still-held slot-lease tag immediately (SlotLeaseBoard.reclaim_task)
+    instead of waiting for the directory's dead-coordinator sweep —
+    tasks_reaped and leases_reclaimed agree, and the later sweep finds
+    nothing left to reclaim."""
+    import time as _time
+
+    from presto_tpu.server import fleet as FL
+
+    session, cs, workers, want = chaos
+    d = FL.FleetDirectory()
+    ma = d.join("A", "http://a.invalid")
+    w0 = workers[0]
+    d.slots.register_worker(w0.url, 4)
+    w0.lease_board = d.slots
+    try:
+        assert ma.lease_slot(w0.url)
+        reaped0 = w0.counters["tasks_reaped"]
+        with w0.lock:
+            w0.tasks["q-lease-A.0.0"] = {
+                "state": "RUNNING", "error": None, "pages": {},
+                "complete": True, "range_boundaries": None,
+                "range_event": None,
+                "expires_at": _time.monotonic() - 1.0,
+                "dynfilters": {}, "df_event": None,
+                "lease_coord": "A"}
+        assert w0.reap_expired() == 1
+        st = d.slots.stats()
+        assert st["inFlight"] == 0
+        assert st["leasesReclaimed"] == 1
+        assert w0.counters["tasks_reaped"] - reaped0 == \
+            st["leasesReclaimed"]
+        # the directory sweep afterwards has nothing left to reclaim
+        assert d.leave("A") == 0
+        assert d.slots.stats()["leasesReclaimed"] == 1
+    finally:
+        w0.lease_board = None
+        _reset(session, cs, workers)

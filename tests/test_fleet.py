@@ -10,6 +10,8 @@ server/fleet.py and every coordinator stays able to execute every
 statement (routing is an optimization, never a correctness surface)."""
 
 import json
+import os
+import tempfile
 import threading
 import urllib.request
 
@@ -181,6 +183,11 @@ def _two_door_fleet(**props):
     """Two in-process coordinators over ONE shared catalog object (the
     in-process fleet topology: version-keyed caches see the same bumps),
     joined through a FleetDirectory."""
+    # journaling is default-ON for fleeted coordinators; isolate each
+    # fleet's journal so reused coord ids ("A"/"B") across the suite
+    # never see one another's entries through the shared spill base
+    props.setdefault("query_journal_path",
+                     tempfile.mkdtemp(prefix="pt_fleet_journal_"))
     d = FL.FleetDirectory()
     sa = _session(**props)
     sb = presto_tpu.connect(**props)
@@ -189,10 +196,8 @@ def _two_door_fleet(**props):
     srv_b = PrestoTpuServer(sb).start()
     ma = d.join("A", srv_a.uri)
     mb = d.join("B", srv_b.uri)
-    srv_a.fleet = ma
-    srv_a.serving.attach_fleet(ma)
-    srv_b.fleet = mb
-    srv_b.serving.attach_fleet(mb)
+    srv_a.attach_fleet(ma)
+    srv_b.attach_fleet(mb)
     return d, (srv_a, ma), (srv_b, mb)
 
 
@@ -510,3 +515,155 @@ def test_watch_fleet_unregisters_dead_coordinator():
     finally:
         det.stop()
         srv_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# journaled in-flight query failover (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def test_adopter_determinism_and_journal_replication():
+    """Adoption safety: every survivor derives the SAME ring successor
+    for a dead coordinator (pure function of the post-leave ring — no
+    coordination round), exactly one member volunteers, and journal
+    entries replicate best-effort over the directory relay."""
+    d = FL.FleetDirectory()
+    d.join("A", "http://a.invalid")
+    mb = d.join("B", "http://b.invalid")
+    mc = d.join("C", "http://c.invalid")
+    d.leave("A")
+    assert mb.adopter_of("A") == mc.adopter_of("A")
+    assert [m.should_adopt("A")
+            for m in (mb, mc)].count(True) == 1
+    got = []
+    mc.subscribe(on_journal=got.append)
+    entry = {"queryId": "q1", "sql": "SELECT 1", "coord": "B",
+             "state": "RUNNING"}
+    assert mb.replicate_journal(entry) >= 1
+    assert got and got[0]["queryId"] == "q1"
+    assert mc.counters["journal_received"] == 1
+
+
+def test_coordinator_death_adoption_completes_polling_client():
+    """Tentpole acceptance: coordinator A dies with an in-flight
+    journaled query; a client polling the OTHER door's statement URI
+    for that query id is held in RUNNING while the ring successor
+    adopts it from the journal, then receives the finished rows — the
+    client never sees 'unknown query'."""
+    import time as _time
+
+    from presto_tpu.parallel import journal as _J
+
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet()
+    try:
+        root = srv_b.session.properties["query_journal_path"]
+        qid = "20260806_000000_00042_chaos"
+        # A journaled the query, then died before cleanup could run
+        assert _J.QueryJournal(root, "A").write(
+            _J.entry_for(qid, "SELECT count(*) c FROM t", "A", {}))
+        srv_a.stop()
+        d.leave("A")  # failure detector's verdict -> B adopts (thread)
+        deadline = _time.monotonic() + 30.0
+        rows, state = [], None
+        url = f"{srv_b.uri}/v1/statement/{qid}/0"
+        while _time.monotonic() < deadline:
+            payload = json.loads(
+                urllib.request.urlopen(url, timeout=30).read())
+            state = payload.get("stats", {}).get("state")
+            if state == "FINISHED":
+                rows = payload.get("data", [])
+                break
+            assert state in ("QUEUED", "RUNNING"), payload
+            url = payload["nextUri"]  # RUNNING-hold re-points at B
+            _time.sleep(0.05)
+        assert state == "FINISHED"
+        assert rows == [[200]]
+        assert srv_b.fleet_counters["queries_adopted"] >= 1
+        t0 = _time.monotonic()
+        while any(n.endswith(_J.SUFFIX) for n in os.listdir(root)) \
+                and _time.monotonic() - t0 < 10.0:
+            _time.sleep(0.05)  # entry retired once the adoption lands
+        assert not any(n.endswith(_J.SUFFIX) for n in os.listdir(root))
+    finally:
+        srv_b.stop()
+
+
+def test_statement_client_fails_over_to_backup_door():
+    """StatementClient with backup_uris: the primary door is dead at
+    submit time — the POST fails over to the backup door and the query
+    runs there; server_uri re-points so every later poll goes to the
+    survivor directly."""
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet()
+    try:
+        dead_uri = srv_a.uri
+        srv_a.stop()
+        d.leave("A")
+        st = StatementClient(dead_uri, "SELECT sum(k) s FROM t",
+                             backup_uris=[srv_b.uri])
+        assert list(st.rows()) == [(sum(range(200)),)]
+        assert st.server_uri == srv_b.uri
+    finally:
+        srv_b.stop()
+
+
+def test_execute_owner_death_mid_coalesce_riders_survive():
+    """Satellite (ISSUE 17): fleet-routed EXECUTEs whose affinity owner
+    dies around the coalesce window.  Phase 1: the owner's batch leader
+    is killed by a scripted fault — riders re-run solo, every client
+    gets its own correct rows, zero surfaced failures.  Phase 2: the
+    owner itself dies — the same burst through the surviving door
+    re-routes (proxy failure -> local execution), identical results."""
+    from presto_tpu.parallel import faults as F
+
+    d, (srv_a, ma), (srv_b, mb) = _two_door_fleet(
+        coalesce_window_ms=40, coalesce_max_batch=8)
+    doors = {"A": srv_a, "B": srv_b}
+    try:
+        connect_http(srv_a.uri).execute(
+            "PREPARE pq FROM SELECT count(*) c FROM t WHERE k < ?")
+        assert ma.counters["prepares_replicated"] >= 1
+        owner = d.ring.owner(FL.affinity_key("EXECUTE pq USING 120"))
+        owner_srv = doors[owner]
+        other_srv = doors["B" if owner == "A" else "A"]
+        binds = [120, 120, 120, 50]  # same-signature riders + one solo
+
+        def burst(door):
+            out, errs = {}, []
+
+            def one(i, n):
+                try:
+                    out[i] = connect_http(door.uri).execute(
+                        f"EXECUTE pq USING {n}").fetchall()
+                except Exception as e:  # noqa: BLE001 — collected
+                    errs.append(e)
+
+            ths = [threading.Thread(target=one, args=(i, n))
+                   for i, n in enumerate(binds)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            return out, errs
+
+        # phase 1: owner alive, its coalesce leader crashes mid-window
+        F.install(F.FaultPlan.parse("coalesce:BATCH:*:1:fail"))
+        try:
+            out, errs = burst(other_srv)  # routed to the owner door
+        finally:
+            F.install(None)
+        assert not errs, errs
+        assert {i: v for i, v in out.items()} == {
+            i: [(n,)] for i, n in enumerate(binds)}
+        # phase 2: the owner dies; the survivor re-routes to itself
+        owner_srv.stop()
+        d.leave(owner)
+        out2, errs2 = burst(other_srv)
+        assert not errs2, errs2
+        assert {i: v for i, v in out2.items()} == {
+            i: [(n,)] for i, n in enumerate(binds)}
+    finally:
+        for s in (srv_a, srv_b):
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — already stopped
+                pass

@@ -1,0 +1,179 @@
+"""Query-journal unit tests (ISSUE 17): the fleet-visible resumable
+state behind journaled in-flight query failover.
+
+Every test runs against a tmp_path root — the journal dir is shared
+fleet state, so tests must never touch the default spill-base journal
+(coordinator ids like "A"/"B" recur across the suite).  The fault legs
+exercise the `journal:WRITE` / `journal:READ` choke points: a journal
+fault degrades (journal-less execution, skipped entry), never fails."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from presto_tpu.parallel import faults as F
+from presto_tpu.parallel import journal as J
+
+
+@pytest.fixture(autouse=True)
+def _no_global_faults():
+    yield
+    F.install(None)
+
+
+# ---- configuration ----------------------------------------------------
+
+
+def test_root_dir_precedence():
+    assert J.root_dir({"query_journal_path": "/j"}) == "/j"
+    assert J.root_dir({"spill_path": "/s"}) == os.path.join("/s", "journal")
+    assert J.root_dir({}) == os.path.join(J.DEFAULT_SPILL_BASE, "journal")
+    # explicit path wins over the spill base
+    assert J.root_dir({"query_journal_path": "/j",
+                       "spill_path": "/s"}) == "/j"
+
+
+def test_enabled_tri_state():
+    # auto journals exactly when a fleet exists to adopt the queries
+    assert not J.enabled({}, fleet_attached=False)
+    assert J.enabled({}, fleet_attached=True)
+    assert J.enabled({"query_journal": "auto"}, fleet_attached=True)
+    # explicit on/off is respected regardless of the fleet
+    for on in (True, "true", "on", "1"):
+        assert J.enabled({"query_journal": on}, fleet_attached=False)
+    for off in (False, "false", "off", "0", ""):
+        assert not J.enabled({"query_journal": off}, fleet_attached=True)
+
+
+def test_props_fingerprint_stable_and_sensitive():
+    a = {"x": 1, "y": "z"}
+    assert J.props_fingerprint(a) == J.props_fingerprint({"y": "z", "x": 1})
+    assert J.props_fingerprint(a) != J.props_fingerprint({"x": 2, "y": "z"})
+    # unserializable values degrade to repr, never raise
+    assert J.props_fingerprint({"f": object()})
+
+
+def test_entry_schema():
+    e = J.entry_for("q1", "SELECT 1", "A", {"k": 1}, ddir="/d",
+                    layout=["w0", "w1"], attempt=2, binds=[7])
+    assert e["queryId"] == "q1" and e["sql"] == "SELECT 1"
+    assert e["coord"] == "A" and e["state"] == "RUNNING"
+    assert e["ddir"] == "/d" and e["layout"] == ["w0", "w1"]
+    assert e["attempt"] == 2 and e["binds"] == [7]
+    assert e["completed"] == [] and e["propsFp"]
+
+
+# ---- write/read/remove round trip -------------------------------------
+
+
+def test_write_read_remove_roundtrip(tmp_path):
+    jr = J.QueryJournal(str(tmp_path), coord_id="A")
+    e = J.entry_for("q1", "SELECT 1", "A", {})
+    assert jr.write(e)
+    # whole-entry tmp+replace: no temp residue next to the entry
+    assert sorted(os.listdir(tmp_path)) == [f"q1{J.SUFFIX}"]
+    got = jr.read("q1")
+    assert got == e
+    assert jr.read("missing") is None
+    jr.remove("q1")
+    assert jr.read("q1") is None
+    jr.remove("q1")  # idempotent
+    st = jr.stats()
+    assert st["writes"] == 1 and st["removed"] == 1
+    assert st["write_errors"] == 0 and st["read_errors"] == 0
+
+
+def test_entries_filters_by_coordinator(tmp_path):
+    jr = J.QueryJournal(str(tmp_path), coord_id="A")
+    jr.write(J.entry_for("q2", "SELECT 2", "B", {}))
+    jr.write(J.entry_for("q1", "SELECT 1", "A", {}))
+    jr.write(J.entry_for("q3", "SELECT 3", "A", {}))
+    assert [e["queryId"] for e in jr.entries()] == ["q1", "q2", "q3"]
+    assert [e["queryId"] for e in jr.entries(coord="A")] == ["q1", "q3"]
+    assert [e["queryId"] for e in jr.entries(coord="C")] == []
+
+
+def test_entry_without_query_id_is_rejected(tmp_path):
+    jr = J.QueryJournal(str(tmp_path))
+    assert not jr.write({"sql": "SELECT 1"})
+    assert jr.stats()["writes"] == 0
+
+
+def test_concurrent_writes_never_tear(tmp_path):
+    jr = J.QueryJournal(str(tmp_path), coord_id="A")
+
+    def hammer(i):
+        for n in range(20):
+            jr.write(J.entry_for("q-shared", f"SELECT {i}", "A", {},
+                                 attempt=n))
+
+    ths = [threading.Thread(target=hammer, args=(i,)) for i in range(4)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    got = jr.read("q-shared")  # any writer's entry, never a torn one
+    assert got is not None and got["queryId"] == "q-shared"
+    assert jr.stats()["write_errors"] == 0
+
+
+# ---- fault surface: journal:WRITE / journal:READ ----------------------
+
+
+def test_write_fault_fails_cleanly(tmp_path):
+    jr = J.QueryJournal(str(tmp_path))
+    F.install(F.FaultPlan.parse("journal:WRITE:*:1:fail"))
+    assert not jr.write(J.entry_for("q1", "SELECT 1", "A", {}))
+    assert jr.read("q1") is None  # nothing landed
+    assert jr.stats()["write_errors"] == 1
+    # the fault was one-shot: the retry persists
+    assert jr.write(J.entry_for("q1", "SELECT 1", "A", {}))
+    assert jr.read("q1") is not None
+
+
+def test_write_drop_is_a_silent_loss(tmp_path):
+    jr = J.QueryJournal(str(tmp_path))
+    F.install(F.FaultPlan.parse("journal:WRITE:*:1:drop"))
+    # the caller believes the write persisted — that is the fault
+    assert jr.write(J.entry_for("q1", "SELECT 1", "A", {}))
+    assert jr.read("q1") is None
+    assert jr.stats()["writes"] == 1 and jr.stats()["write_errors"] == 0
+
+
+@pytest.mark.parametrize("action", ["corrupt", "truncate"])
+def test_damaged_write_reads_as_none(tmp_path, action):
+    jr = J.QueryJournal(str(tmp_path))
+    F.install(F.FaultPlan.parse(f"journal:WRITE:*:1:{action}"))
+    jr.write(J.entry_for("q1", "SELECT 1", "A", {}))
+    F.install(None)
+    # the file exists but is damaged: read reports None and counts it
+    assert os.path.exists(jr.path("q1"))
+    assert jr.read("q1") is None
+    assert jr.stats()["read_errors"] == 1
+    # ... and the adopter-facing listing skips it instead of crashing
+    assert jr.entries() == []
+
+
+def test_read_fault_skips_entry(tmp_path):
+    jr = J.QueryJournal(str(tmp_path))
+    jr.write(J.entry_for("q1", "SELECT 1", "A", {}))
+    F.install(F.FaultPlan.parse("journal:READ:*:1:corrupt"))
+    assert jr.read("q1") is None
+    assert jr.stats()["read_errors"] == 1
+    F.install(None)
+    assert jr.read("q1") is not None  # the file itself was untouched
+
+
+def test_hand_damaged_entry_is_skipped(tmp_path):
+    """A real torn/garbage file (no fault injection): unreadable entries
+    are skipped by entries() so adoption survives a bad journal."""
+    jr = J.QueryJournal(str(tmp_path))
+    jr.write(J.entry_for("q1", "SELECT 1", "A", {}))
+    with open(jr.path("q0"), "w") as f:
+        f.write("{not json")
+    with open(jr.path("q2"), "w") as f:
+        f.write(json.dumps(["not", "a", "dict"]))
+    assert [e["queryId"] for e in jr.entries()] == ["q1"]
+    assert jr.stats()["read_errors"] == 2

@@ -381,6 +381,53 @@ def test_spill_file_io_confined_to_spill_module():
     assert not bad, "\n".join(bad)
 
 
+def test_journal_io_confined_to_journal_module():
+    """Journal-subsystem gate (ISSUE 17, same pattern as the spill-I/O
+    rule): every journal byte flows through `parallel/journal.py` — the
+    one module whose writes are tmp+`os.replace` atomic, whose reads
+    validate the entry schema, and whose ops the fault harness
+    (`journal:WRITE` / `journal:READ`) can damage deterministically.
+    Two checks: (a) the journal filename suffix `.qj` appears as a
+    string constant ONLY in parallel/journal.py, so no other module can
+    hand-roll an entry path; (b) the failover layers that CONSUME the
+    journal — server/fleet.py, server/discovery.py,
+    client/statement.py — may not call `open()` at all, in any mode."""
+    import ast
+
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), pkg)
+            if rel == os.path.join("parallel", "journal.py"):
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                tree = ast.parse(f.read(), rel)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and ".qj" in node.value:
+                    bad.append(f"{rel}:{node.lineno}: journal suffix "
+                               "'.qj' — journal paths belong to "
+                               "parallel/journal.py")
+    CHECKED = [os.path.join("server", "fleet.py"),
+               os.path.join("server", "discovery.py"),
+               os.path.join("client", "statement.py")]
+    for rel in CHECKED:
+        with open(os.path.join(pkg, rel), encoding="utf-8") as f:
+            tree = ast.parse(f.read(), rel)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "open":
+                bad.append(f"{rel}:{node.lineno}: open() — journal "
+                           "file I/O belongs in parallel/journal.py "
+                           "(atomic writes, schema-validated reads)")
+    assert not bad, "\n".join(bad)
+
+
 def test_no_sleeps_or_timeout_literals_in_spill_exec():
     """The degradation orchestrator is driven by memory pressure and
     deterministic knobs, never by wall-clock waits: no `time.sleep`, no
