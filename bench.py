@@ -1189,7 +1189,7 @@ def _fleet_serve_gate(record, committed):
 
 
 MULTICHIP_RECORD_PATH = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r07.json")
+    os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r08.json")
 
 
 SPILL_RECORD_PATH = os.path.join(
@@ -1369,10 +1369,9 @@ def multichip_summary():
 MULTICHIP_AUTO_RATIO = 1.1
 
 
-def multichip_bench():
-    """`bench.py --multichip`: the distributed gate queries (q3/q18)
-    over an in-process cluster whose worker declares the local device
-    mesh — three legs per query: fragment_fusion=force (round 12's
+def multichip_bench(hosts=0):
+    """`bench.py --multichip [--hosts N]`: the distributed gate queries
+    (q3/q18) — three legs per query: fragment_fusion=force (round 12's
     one-shard_map-program policy), =off (per-fragment HTTP pages), and
     =auto (the round-18 plan/fusion_cost.py per-edge cost model; runs
     LAST so the decision memo has both forced legs' observed walls —
@@ -1381,9 +1380,13 @@ def multichip_bench():
     counters, and the per-edge skip reasons.  The gate requires the
     auto leg within MULTICHIP_AUTO_RATIO of the BETTER forced leg on
     every query — a silent fuse-regression (the old q18 2056ms-vs-747ms
-    shape) is now a red record.  Writes MULTICHIP_r07.json; on a CPU
-    host the record anchors the MECHANISM, chip wall-clock comes from
-    re-running this on real hardware."""
+    shape) is now a red record.  Without --hosts the cluster is one
+    in-process worker declaring the local device mesh; with --hosts N
+    it is N worker SUBPROCESSES joined into one jax.distributed gloo
+    mesh (round 21), so the force leg runs cross-host collectives and
+    must drive exchange_bytes_host to ~0 on the fused attempt.  Writes
+    MULTICHIP_r08.json; on a CPU host the record anchors the MECHANISM,
+    chip wall-clock comes from re-running this on real hardware."""
     import jax
 
     import presto_tpu
@@ -1393,12 +1396,20 @@ def multichip_bench():
 
     sf = float(os.environ.get("BENCH_MULTICHIP_SF", "0.01"))
     runs = int(os.environ.get("BENCH_MULTICHIP_RUNS", "3"))
-    ndev = len(jax.devices())
     session = presto_tpu.connect(
         tpch_catalog(sf, cache_dir="/tmp/presto_tpu_cache"))
-    worker = C.WorkerServer(f"tpch:{sf}:/tmp/presto_tpu_cache",
-                            mesh_devices=ndev).start()
-    cs = C.ClusterSession(session, [worker.url])
+    worker = None
+    if hosts >= 2:
+        ldev = int(os.environ.get("BENCH_MULTICHIP_LOCAL_DEVICES", "2"))
+        ndev = hosts * ldev
+        cs = C.launch_local_cluster(
+            session, f"tpch:{sf}:/tmp/presto_tpu_cache", nworkers=hosts,
+            multihost=True, local_devices=ldev)
+    else:
+        ndev = len(jax.devices())
+        worker = C.WorkerServer(f"tpch:{sf}:/tmp/presto_tpu_cache",
+                                mesh_devices=ndev).start()
+        cs = C.ClusterSession(session, [worker.url])
 
     def norm(rows):
         return sorted(tuple(round(x, 4) if isinstance(x, float) else x
@@ -1418,8 +1429,8 @@ def multichip_bench():
 
     record = {"metric": "multichip_fused_vs_cut_vs_auto_wall_ms",
               "platform": jax.devices()[0].platform,
-              "n_devices": ndev, "sf": sf, "runs": runs,
-              "queries": {}, "asof": _today()}
+              "n_devices": ndev, "hosts": max(hosts, 1), "sf": sf,
+              "runs": runs, "queries": {}, "asof": _today()}
     failures = []
     try:
         for qid in (3, 18):
@@ -1435,6 +1446,10 @@ def multichip_bench():
                 failures.append(f"q{qid}")
             if not auto_ok:
                 failures.append(f"q{qid}-auto")
+            if hosts >= 2 and rf.stats.exchange_bytes_host > 0:
+                # a fused cross-host leg that still moved HTTP bytes
+                # means some collective-eligible edge fell off the mesh
+                failures.append(f"q{qid}-dcn")
             record["queries"][f"q{qid}"] = {
                 "fused_cold_ms": f_cold, "fused_warm_ms": f_warm,
                 "cut_cold_ms": c_cold, "cut_warm_ms": c_warm,
@@ -1451,13 +1466,19 @@ def multichip_bench():
                     rf.stats.exchange_bytes_host,
                 "exchange_bytes_collective":
                     rf.stats.exchange_bytes_collective,
+                "exchange_bytes_dcn": rf.stats.exchange_bytes_dcn,
                 "exchange_bytes_host_cut": rc.stats.exchange_bytes_host,
                 "checksums_equal": equal}
     finally:
-        worker.stop()
+        if worker is not None:
+            worker.stop()
+        for p in getattr(cs, "_procs", []):
+            p.kill()
     record["gate"] = ("FAIL: " + ",".join(failures)) if failures else \
         (f"pass (fused>0, checksums equal, auto <= "
-         f"{MULTICHIP_AUTO_RATIO}x best forced leg)")
+         f"{MULTICHIP_AUTO_RATIO}x best forced leg"
+         + (", host bytes 0 on fused cross-host legs)" if hosts >= 2
+            else ")"))
     try:
         with open(MULTICHIP_RECORD_PATH, "w") as f:
             json.dump(record, f, indent=1, sort_keys=True)
@@ -1878,7 +1899,9 @@ if __name__ == "__main__":
     elif "--serve" in sys.argv:
         serve_bench()
     elif "--multichip" in sys.argv:
-        multichip_bench()
+        multichip_hosts = int(sys.argv[sys.argv.index("--hosts") + 1]) \
+            if "--hosts" in sys.argv else 0
+        multichip_bench(multichip_hosts)
     elif "--write" in sys.argv:
         write_bench()
     elif "--spill" in sys.argv:

@@ -238,8 +238,18 @@ def fused_key(fragment_bytes: bytes, ndev: int, session,
     evaluated scalar-subquery results, and the dictionary VALUES of any
     string-typed external exchange input (partition_hash bakes a
     host-computed per-code hash LUT).  Oversized string externals
-    return None — the build still runs, uncached."""
+    return None — the build still runs, uncached.
+
+    The MESH SHAPE rides the key too: the same fused fragment traced at
+    the same ndev compiles a DIFFERENT program on a multi-process
+    global mesh (per-process shard feeds, DCN collectives), so the
+    process topology (count, index) is a key component alongside ndev —
+    a single-host executable must never serve a gang member."""
+    from presto_tpu.parallel import mesh as _MH
+
     h = hashlib.sha256(fragment_bytes)
+    h.update(f"procs={_MH.process_count()}/{_MH.process_index()}"
+             .encode())
     for _pid, val in sorted(scalar_results.items()):
         h.update(repr(val).encode())
         h.update(b"\x00")

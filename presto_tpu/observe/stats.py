@@ -115,6 +115,10 @@ class QueryStats:
     fragments_fused: int = 0
     exchange_bytes_host: int = 0
     exchange_bytes_collective: int = 0
+    # multi-host lane: the slice of the collective estimate that rode
+    # the cross-process (DCN) fabric — a gang-fused query moves bytes
+    # here instead of exchange_bytes_host
+    exchange_bytes_dcn: int = 0
     # fusion economics (plan/fusion_cost.py): per-edge fuse-vs-cut
     # verdicts of the cost model — exchange edges spliced into a fused
     # program (== fragments_fused), edges kept on the HTTP path, edges

@@ -712,9 +712,13 @@ class _ClusterExecutor:
     PAGES_PER_TASK = 4
 
     def __init__(self, session, spec: TaskSpec, publish=None,
-                 task_state=None):
+                 task_state=None, faults=None):
         self.session = session
         self.spec = spec
+        # multi-host fusion: fault plan threaded through so the
+        # dcn:COLLECTIVE choke point can fail this member BEFORE it
+        # reports ready (parallel/faults.apply_dcn)
+        self.faults = faults
         # publish(bucket, page, enc=...): the producer DECLARES each
         # page's encoding so receipt-time verification never has to
         # sniff bytes (see _page_ok)
@@ -785,7 +789,16 @@ class _ClusterExecutor:
         from presto_tpu.batch import Batch, column_from_numpy
         import jax.numpy as jnp
 
-        if inp["kind"] in ("repartition", "range"):
+        gang = self._fused_ndev \
+            and int(self.spec.properties.get("gang_size") or 0) > 1
+        if gang:
+            # multi-host fused gang: every member ingests the IDENTICAL
+            # full external input (producers feeding a fused gang write
+            # one gather bucket) and shards it onto the global mesh
+            # itself (dist_executor._put); pages are never acked — every
+            # rank reads them, and the buffer expiry reaps the leftovers
+            bucket, ups = 0, inp["upstreams"]
+        elif inp["kind"] in ("repartition", "range"):
             # range: consumer shard w owns key range w (sample sort)
             bucket, ups = self.spec.windex, inp["upstreams"]
         elif inp["kind"] == "scatter":
@@ -797,7 +810,7 @@ class _ClusterExecutor:
         parts = []
         # broadcast buckets have MANY readers: acking would release
         # pages other consumers still need
-        exclusive = inp["kind"] != "broadcast"
+        exclusive = inp["kind"] != "broadcast" and not gang
         for up in ups:
             # coordinator-side upstreams are mutable [url, tid]
             # slots shared with the hedge monitor, so the pull
@@ -1069,6 +1082,58 @@ class _ClusterExecutor:
             cols[sym] = (data, valid)
         return cols
 
+    # ---- multi-host gang barrier (cross-host fusion) -----------------
+    def _gang_props(self):
+        p = self.spec.properties
+        return (str(p.get("gang_epoch") or ""), str(p.get("gang_home")
+                or ""), int(p.get("gang_rank") or 0),
+                int(p.get("gang_size") or 0))
+
+    def _gang_barrier(self) -> None:
+        """Report this rank ready on the gang's HTTP barrier (rank 0's
+        worker, POST /v1/gang) and poll until admitted.  The barrier is
+        the LAST exit before jax collectives: a member that died or hit
+        the dcn:COLLECTIVE fault simply never reports, this rank times
+        out with a clean task FAILURE, and the coordinator's was_fused
+        fallback reruns the attempt unfused over HTTP."""
+        epoch, home, rank, size = self._gang_props()
+        if self.faults is not None:
+            F.apply_dcn(self.faults, self.spec.task_id)
+        ctx = R.current()
+        local = R.Deadline(R.GANG_BARRIER_TIMEOUT_S)
+        backoff = ctx.policy.backoff()
+        payload = json.dumps({"op": "ready", "epoch": epoch,
+                              "rank": rank, "size": size}).encode()
+        while True:
+            try:
+                resp = json.loads(_http(
+                    f"{home}/v1/gang", payload, method="POST",
+                    timeout=ctx.deadline.cap(R.ACK_TIMEOUT_S)))
+                if resp.get("go"):
+                    return
+            except R.DeadlineExceeded:
+                raise
+            except Exception:  # noqa: BLE001 — home may lag our start
+                pass
+            ctx.deadline.check(f"gang {epoch} barrier")
+            if local.expired():
+                raise TimeoutError(
+                    f"gang {epoch} rank {rank}: barrier timed out "
+                    "(mesh member missing or collective lane faulted)")
+            backoff.sleep(local)
+
+    def _gang_done(self) -> None:
+        """Best-effort done-report so the board retires the epoch and
+        admits the next gang without waiting out GANG_EXEC_TIMEOUT_S."""
+        epoch, home, rank, _ = self._gang_props()
+        try:
+            _http(f"{home}/v1/gang",
+                  json.dumps({"op": "done", "epoch": epoch,
+                              "rank": rank}).encode(),
+                  method="POST", timeout=R.ACK_TIMEOUT_S)
+        except Exception:  # noqa: BLE001 — eviction deadline covers us
+            pass
+
     def _exec_fused(self, root):
         """Fragment fusion: execute a fused super-fragment (inline
         Exchange nodes) as ONE shard_map program over this process's
@@ -1100,7 +1165,45 @@ class _ClusterExecutor:
         for k, v in counters.items():
             if k.startswith("df_") and v:
                 self.df_counts[k] = self.df_counts.get(k, 0) + v
+        if int(self.spec.properties.get("gang_size") or 0) > 1:
+            # collective bytes that crossed process boundaries ride the
+            # data-center network, not ICI — mirrored into the dcn
+            # counter so QueryStats can tell the lanes apart
+            self._count("exchange_bytes_dcn",
+                        int(counters.get("exchange_bytes_collective", 0)))
+            return self._fetch_out_cols_local(out)
         return self._fetch_out_cols(out)
+
+    def _fetch_out_cols_local(self, out):
+        """Gang variant of _fetch_out_cols: on a multi-process mesh the
+        output arrays are GLOBAL — only this process's shards are
+        addressable, so each rank fetches its own rows.  A replicated
+        output exists in full on every rank; rank 0 publishes it and
+        the other ranks publish zero rows, so the downstream union of
+        gang buckets is exact either way."""
+        from presto_tpu.parallel import dist_executor as DX
+
+        def host(a):
+            if getattr(a.sharding, "is_fully_replicated", False):
+                return np.asarray(a.addressable_shards[0].data), True
+            return DX.local_shard_rows(a), False
+
+        rank = int(self.spec.properties.get("gang_rank") or 0)
+        sel, sel_repl = host(out.sel)
+        live = np.flatnonzero(np.asarray(sel))
+        cols: Dict[str, tuple] = {}
+        for sym in self.spec.out_symbols:
+            c = out.columns[sym]
+            data = host(c.data)[0][live]
+            if c.dictionary is not None:
+                data = c.dictionary.values[
+                    np.clip(data, 0, max(len(c.dictionary.values) - 1, 0))]
+            valid = None if c.valid is None else host(c.valid)[0][live]
+            if sel_repl and rank != 0:
+                data = data[:0]
+                valid = None if valid is None else valid[:0]
+            cols[sym] = (data, valid)
+        return cols
 
     def _profile_cost(self, root) -> None:
         """EXPLAIN ANALYZE only: AOT-lower a STATIC trace of this cut
@@ -1257,7 +1360,18 @@ class _ClusterExecutor:
             # producer join lives inside the fused trace are produced
             # and applied IN-trace by the executor itself.
             self._exchange_batches()
-            cols = self._exec_fused(root)
+            gang = int(self.spec.properties.get("gang_size") or 0) > 1
+            if gang:
+                # cross-host gang: all inputs staged, all ranks meet at
+                # the HTTP barrier before the first collective — a rank
+                # that never arrives fails THIS rank cleanly (timeout)
+                # instead of hanging inside gloo/ICI
+                self._gang_barrier()
+            try:
+                cols = self._exec_fused(root)
+            finally:
+                if gang:
+                    self._gang_done()
             if self.spec.out_kind == "range":
                 self._publish_range(cols)
             else:
@@ -1349,6 +1463,78 @@ def make_catalog(spec: str):
     raise ValueError(f"unknown catalog spec {spec}")
 
 
+class _GangBoard:
+    """Barrier-epoch board a gang's rank-0 worker serves via POST
+    /v1/gang (round 21 multi-host fusion).  Every gang member reports
+    ready{epoch, rank, size} and polls until {"go": true}; the board
+    admits ONE gang at a time — a multi-controller jax program must
+    execute the same collectives in the same order on every process, so
+    concurrent gangs are serialized here, oldest-fully-ready first.  An
+    epoch retires when all its ranks report done; a waiting epoch whose
+    barrier deadline passes (a member died or the dcn:COLLECTIVE fault
+    fired before its ready report) is evicted so later gangs admit, and
+    an ADMITTED epoch is evicted after GANG_EXEC_TIMEOUT_S (a member
+    died mid-collective without reporting done)."""
+
+    def __init__(self):
+        self._gangs: Dict[str, dict] = {}
+        self._order: List[str] = []
+        self._active: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def _expire(self) -> None:
+        if self._active is not None:
+            g = self._gangs.get(self._active)
+            if g is None or g["exec_deadline"].expired():
+                self._gangs.pop(self._active, None)
+                self._active = None
+        for e in [e for e in self._order if e in self._gangs
+                  and e != self._active
+                  and self._gangs[e]["barrier_deadline"].expired()]:
+            self._gangs.pop(e, None)
+        self._order = [e for e in self._order if e in self._gangs]
+
+    def ready(self, epoch: str, rank: int, size: int) -> dict:
+        with self._lock:
+            g = self._gangs.get(epoch)
+            if g is None:
+                g = self._gangs[epoch] = {
+                    "size": max(int(size), 1), "ready": set(),
+                    "done": set(),
+                    "barrier_deadline":
+                        R.Deadline(R.GANG_BARRIER_TIMEOUT_S),
+                    "exec_deadline": R.Deadline(R.GANG_EXEC_TIMEOUT_S)}
+                self._order.append(epoch)
+            g["ready"].add(int(rank))
+            self._expire()
+            if self._active is None:
+                for e in self._order:
+                    gg = self._gangs[e]
+                    if len(gg["ready"]) >= gg["size"]:
+                        self._active = e
+                        gg["exec_deadline"] = \
+                            R.Deadline(R.GANG_EXEC_TIMEOUT_S)
+                        break
+            go = self._active == epoch
+            first = go and not g.get("announced")
+            if first:
+                g["announced"] = True
+            return {"go": go, "admitted": first}
+
+    def done(self, epoch: str, rank: int) -> dict:
+        with self._lock:
+            g = self._gangs.get(epoch)
+            if g is not None:
+                g["done"].add(int(rank))
+                if len(g["done"]) >= g["size"]:
+                    self._gangs.pop(epoch, None)
+                    self._order = [e for e in self._order
+                                   if e in self._gangs]
+                    if self._active == epoch:
+                        self._active = None
+            return {"ok": True}
+
+
 class WorkerServer:
     """One worker process: accepts tasks, executes fragments, serves
     result buffers (reference: SqlTaskManager + TaskResource)."""
@@ -1357,7 +1543,7 @@ class WorkerServer:
                  port: int = 0, secret: Optional[bytes] = None,
                  faults: Optional["F.FaultPlan"] = None,
                  mesh_devices: Optional[int] = None,
-                 lease_board=None):
+                 lease_board=None, dist_spec: Optional[dict] = None):
         import presto_tpu
 
         # scripted failures for THIS worker (tests pass a plan per
@@ -1384,6 +1570,25 @@ class WorkerServer:
         import socket as _socket
 
         self.mesh_id = f"{_socket.gethostname()}:{os.getpid()}"
+        # multi-host collective data plane (round 21): a worker whose
+        # process joined a jax.distributed mesh (parallel/mesh.py,
+        # --distributed-coordinator/--process-id or PRESTO_TPU_MULTIHOST)
+        # declares its process identity via /v1/info; the coordinator
+        # assembles a gang from a COMPLETE declared process set.  Chaos
+        # tests pass dist_spec explicitly to declare a fake identity
+        # without touching the jax backend — the scripted faults then
+        # exercise gang scheduling, the barrier, and the HTTP fallback
+        # deterministically.
+        from presto_tpu.parallel import mesh as MH
+
+        if dist_spec is not None:
+            self.dist_spec: Optional[dict] = dict(dist_spec)
+        elif MH.is_multihost():
+            self.dist_spec = MH.multihost_spec()
+        else:
+            self.dist_spec = None
+        # gang barrier-epoch board (rank 0's worker is the gang home)
+        self.gang_board = _GangBoard()
         self.secret = secret if secret is not None else cluster_secret()
         if self.secret is None and not _is_loopback(host):
             raise ValueError(
@@ -1416,7 +1621,11 @@ class WorkerServer:
                          # programs' trace-time ICI byte estimate
                          "tasks_fused": 0, "fragments_fused": 0,
                          "exchange_bytes_host": 0,
-                         "exchange_bytes_collective": 0}
+                         "exchange_bytes_collective": 0,
+                         # multi-host lane: trace-time bytes the fused
+                         # program moved over the cross-process (DCN)
+                         # fabric, and gang barrier rendezvous served
+                         "exchange_bytes_dcn": 0, "gangs_admitted": 0}
         self.lock = threading.Lock()
         self.exec_lock = threading.Lock()
         handler = _make_worker_handler(self)
@@ -1625,7 +1834,7 @@ class WorkerServer:
                     deadline=R.Deadline(spec.properties.get("deadline_s")))
                 bag = CC.CompileStats()
                 cex = _ClusterExecutor(task_session, spec, publish=publish,
-                                       task_state=task)
+                                       task_state=task, faults=self.faults)
                 tracer = TR.Tracer(trace_id=wtrace_id,
                                    lane=f"worker:{self.port}",
                                    root_parent=wparent)
@@ -1783,6 +1992,29 @@ def _make_worker_handler(server: WorkerServer):
                     plan_serde.loads(body))
                 task["range_event"].set()
                 self._send(200, b"{}", "application/json")
+            elif self.path == "/v1/gang":
+                # multi-host gang barrier (rank 0's worker is the home):
+                # ready{epoch,rank,size} polls until {"go":true}; done
+                # {epoch,rank} retires the epoch (see _GangBoard)
+                try:
+                    msg = json.loads(body)
+                    op = msg["op"]
+                    epoch = str(msg["epoch"])
+                except (ValueError, TypeError, KeyError):
+                    self._send(400, b"{}")
+                    return
+                if op == "ready":
+                    resp = server.gang_board.ready(
+                        epoch, int(msg.get("rank", 0)),
+                        int(msg.get("size", 1)))
+                    if resp.get("admitted"):
+                        with server.lock:
+                            server.counters["gangs_admitted"] += 1
+                else:
+                    resp = server.gang_board.done(
+                        epoch, int(msg.get("rank", 0)))
+                self._send(200, json.dumps(resp).encode(),
+                           "application/json")
             elif self.path == "/v1/shutdown":
                 self._send(200, b"{}", "application/json")
                 threading.Thread(target=server.stop, daemon=True).start()
@@ -1829,6 +2061,10 @@ def _make_worker_handler(server: WorkerServer):
                      # it owns exclusively (0 = none; never inferred)
                      "meshDevices": server.mesh_devices,
                      "meshId": server.mesh_id,
+                     # multi-host fusion: jax.distributed membership this
+                     # process DECLARES (parallel/mesh.py); absent keys =
+                     # single-host worker
+                     **(server.dist_spec or {}),
                      "counters": counters}).encode(), "application/json")
                 return
             if len(parts) >= 4 and parts[:2] == ["v1", "task"]:
@@ -2262,27 +2498,66 @@ class ClusterSession:
                                         timeout=R.PROBE_TIMEOUT_S,
                                         ctx=ctx))
                 meta = {"meshDevices": int(info.get("meshDevices") or 0),
-                        "meshId": info.get("meshId") or url}
+                        "meshId": info.get("meshId") or url,
+                        # multi-host fusion: jax.distributed membership
+                        # this worker DECLARES (parallel/mesh.py)
+                        "distCoordinator":
+                            info.get("distCoordinator") or "",
+                        "distProcessId":
+                            int(info.get("distProcessId") or 0),
+                        "distNumProcesses":
+                            int(info.get("distNumProcesses") or 1),
+                        "globalDevices":
+                            int(info.get("globalDevices") or 0)}
             except R.DeadlineExceeded:
                 raise
             except Exception:  # noqa: BLE001 — probe failure = no mesh
-                meta = {"meshDevices": 0, "meshId": url}
+                meta = {"meshDevices": 0, "meshId": url,
+                        "distCoordinator": "", "distProcessId": 0,
+                        "distNumProcesses": 1, "globalDevices": 0}
             self._worker_meta[url] = meta
         return meta
 
-    def _fusion_mesh(self, layout, ctx) -> Tuple[Optional[str], int]:
-        """Placement-aware fusion target: the worker declaring the
-        largest exclusively-owned mesh of at least
-        `fragment_fusion_min_devices` chips (None = every exchange edge
-        is cross-host and nothing fuses)."""
+    def _fusion_mesh(self, layout, ctx) \
+            -> Tuple[Optional[List[str]], int, int]:
+        """Placement-aware fusion target: (urls, ndev, nproc).
+
+        Single-host: the worker declaring the largest exclusively-owned
+        mesh of at least `fragment_fusion_min_devices` chips — urls is
+        that one worker, nproc == 1.  Multi-host (`multihost_fusion`,
+        default on): workers declaring jax.distributed membership form
+        a GANG when every process id 0..n-1 of one distributed
+        coordinator is present in the layout; the gang owns the GLOBAL
+        mesh (globalDevices) and outbids any single host it beats on
+        device count — urls is the gang in rank order, nproc == n.
+        (None, 0, 1) = every exchange edge is cross-host and nothing
+        fuses."""
         min_dev = int(self.session.properties.get(
             "fragment_fusion_min_devices", 2))
-        best, best_n = None, 0
+        best, best_n, best_np = None, 0, 1
+        groups: Dict[str, Dict[int, tuple]] = {}
         for url in dict.fromkeys(layout):
-            n = self._worker_info(url, ctx)["meshDevices"]
-            if n >= max(min_dev, 2) and n > best_n:
-                best, best_n = url, n
-        return best, best_n
+            info = self._worker_info(url, ctx)
+            n = info["meshDevices"]
+            if info["distCoordinator"]:
+                # a multi-controller member is NEVER a single-host
+                # target: its jax.devices() are the GLOBAL set, and a
+                # lone shard_map over them would hang waiting for peers
+                groups.setdefault(info["distCoordinator"], {})[
+                    info["distProcessId"]] = (url, info)
+            elif n >= max(min_dev, 2) and n > best_n:
+                best, best_n, best_np = [url], n, 1
+        if bool(self.session.properties.get("multihost_fusion", True)):
+            for members in groups.values():
+                nproc = max(m[1]["distNumProcesses"]
+                            for m in members.values())
+                if nproc < 2 or set(members) != set(range(nproc)):
+                    continue  # incomplete gang: a rank is missing
+                gdev = members[0][1]["globalDevices"]
+                if gdev >= max(min_dev, 2) and gdev > best_n:
+                    best = [members[r][0] for r in range(nproc)]
+                    best_n, best_np = gdev, nproc
+        return best, best_n, best_np
 
     def _query_ctx(self, query_id: str = "") -> R.RunContext:
         """Per-query RunContext: ONE deadline budget every RPC timeout
@@ -2375,7 +2650,8 @@ class ClusterSession:
         for k, v in self._fusion_skips.items():
             mon.stats.fusion_skips[k] = \
                 mon.stats.fusion_skips.get(k, 0) + int(v)
-        for k in ("exchange_bytes_host", "exchange_bytes_collective"):
+        for k in ("exchange_bytes_host", "exchange_bytes_collective",
+                  "exchange_bytes_dcn"):
             setattr(mon.stats, k, getattr(mon.stats, k, 0)
                     + int(self._coord_counters.get(k, 0)))
         # adaptive aggregation: per-task flip decisions + strategy
@@ -2714,17 +2990,20 @@ class ClusterSession:
         if allow_fusion and len(fragments) > 1 \
                 and DIST.fusion_enabled(self.session):
             mode = DIST.fusion_mode(self.session)
-            mesh_url, mesh_ndev = self._fusion_mesh(layout, R.current())
-            if mesh_url is None:
+            mesh_urls, mesh_ndev, mesh_nproc = self._fusion_mesh(
+                layout, R.current())
+            if mesh_urls is None:
                 # no declared mesh: every edge is cross-host
                 self._fusion_skips = {"cross_host": sum(
                     len(f.inputs) for f in fragments)}
             else:
                 kinds = DIST.fusion_kinds(self.session)
                 t0c = TR.wall_s()
+                # nproc > 1 prices edges on the DCN lane (dcn_edge_ms /
+                # dcn_ms_per_mb) — the cross_host_collective verdict
                 verdict, skips, mispred, _fp, decisions = FC.decide_edges(
                     fragments, mesh_ndev, self.session, mode, kinds,
-                    fp=plan_fp)
+                    fp=plan_fp, nproc=mesh_nproc)
                 self._fusion_cost_ms = (TR.wall_s() - t0c) * 1000.0
                 self._fusion_skips = skips
                 self._fusion_mispredicted = mispred
@@ -2736,8 +3015,12 @@ class ClusterSession:
                     fused = _coordinator_passthrough(fused)
                     for f in fused:
                         if getattr(f, "fused", False):
-                            f.fused_url = mesh_url
+                            f.fused_url = mesh_urls[0]
                             f.fused_ndev = mesh_ndev
+                            # cross-host gang: one task per mesh member,
+                            # rank order (scheduled by _schedule)
+                            f.fused_gang = list(mesh_urls) \
+                                if mesh_nproc > 1 else []
                     fragments = fused
                     self._fused_count = nfused
         self._last_fragments = fragments  # EXPLAIN ANALYZE rendering
@@ -2789,10 +3072,18 @@ class ClusterSession:
             if frag.fid == nfr - 1:
                 run_on_of[frag.fid] = [None]  # coordinator-local output
             elif getattr(frag, "fused", False):
-                # fused super-fragment: ONE task on the declared-mesh
-                # owner; the shard_map supplies the parallelism the
-                # per-fragment path got from the worker fan-out
-                run_on_of[frag.fid] = [frag.fused_url]
+                gang = getattr(frag, "fused_gang", None) or []
+                if len(gang) > 1:
+                    # cross-host fused super-fragment: one GANG of tasks,
+                    # one per mesh member in rank order, sharing a
+                    # barrier epoch (multi-controller jax: every process
+                    # must execute the same collectives)
+                    run_on_of[frag.fid] = list(gang)
+                else:
+                    # fused super-fragment: ONE task on the declared-mesh
+                    # owner; the shard_map supplies the parallelism the
+                    # per-fragment path got from the worker fan-out
+                    run_on_of[frag.fid] = [frag.fused_url]
             elif frag.on_workers:
                 run_on_of[frag.fid] = list(layout)
             else:
@@ -2952,9 +3243,17 @@ class ClusterSession:
                         "result_root": isinstance(prod.root, _P.Output),
                     })
                 run_on = run_on_of[frag.fid]
-                if frag.out_kind in ("repartition", "scatter", "range"):
-                    out_buckets = len(run_on_of.get(
-                        consumer_of.get(frag.fid, -1), [None]))
+                cfid = consumer_of.get(frag.fid, -1)
+                cfrag = fragments[cfid] if 0 <= cfid < nfr else None
+                if cfrag is not None and \
+                        len(getattr(cfrag, "fused_gang", None) or []) > 1:
+                    # producer feeding a cross-host fused gang: write ONE
+                    # gather-style bucket every rank reads in full — each
+                    # gang member ingests the identical input and the
+                    # fused program shards it over the global mesh itself
+                    out_buckets = 1
+                elif frag.out_kind in ("repartition", "scatter", "range"):
+                    out_buckets = len(run_on_of.get(cfid, [None]))
                 else:
                     out_buckets = 1
                 payload_root = plan_serde.dumps(frag.root)
@@ -2962,6 +3261,12 @@ class ClusterSession:
                 rem = ctx.deadline.remaining()
                 deadline_s = None if rem == float("inf") else max(rem, 0.0)
                 fused = getattr(frag, "fused", False)
+                gang = getattr(frag, "fused_gang", None) or []
+                # one barrier epoch per gang per attempt: ranks of THIS
+                # attempt rendezvous; a retry gets a fresh epoch so a
+                # straggler from the dead attempt can never join it
+                gang_epoch = f"g_{uuid.uuid4().hex[:12]}" \
+                    if fused and len(gang) > 1 else None
                 # content-addressed durable key: a fingerprint of the
                 # fragment's serialized root + exchange shape, NOT its
                 # fid.  Stable under the fused->unfused renumbering, so
@@ -3047,10 +3352,16 @@ class ClusterSession:
                         spec.properties["profile_fragment"] = True
                     if fused:
                         # the worker routes this task through the fused
-                        # mesh path (run_fused_fragment) at this ndev
+                        # mesh path (run_fused_fragment) at this ndev —
+                        # GLOBAL device count for a cross-host gang
                         spec.properties["fused_ndev"] = frag.fused_ndev
                         spec.properties["fragments_fused"] = \
                             len(getattr(frag, "fused_fids", []))
+                        if gang_epoch is not None:
+                            spec.properties["gang_rank"] = w
+                            spec.properties["gang_size"] = len(gang)
+                            spec.properties["gang_epoch"] = gang_epoch
+                            spec.properties["gang_home"] = gang[0]
                     pushcfg = df_push_of.get(frag.fid)
                     if pushcfg:
                         spec.properties["df_push"] = {
@@ -3098,7 +3409,11 @@ class ClusterSession:
                 f.fid for f in fragments
                 if f.fid != nfr - 1 and f.out_kind != "range"
                 and consumer_of.get(f.fid) == nfr - 1
-                and len(placements[f.fid]) > 1]
+                and len(placements[f.fid]) > 1
+                # never hedge a gang member: a lone re-run of one rank
+                # would wait out the barrier instead of helping — gang
+                # failure is the was_fused fallback's job
+                and not getattr(f, "fused", False)]
             watch = [(slot, placements_fid)
                      for placements_fid in hedged_fids
                      for slot in placements[placements_fid]]
@@ -3454,12 +3769,19 @@ class ClusterSession:
 
 
 def launch_local_cluster(session, catalog_spec: str, nworkers: int = 2,
-                         timeout: Optional[float] = None
-                         ) -> "ClusterSession":
+                         timeout: Optional[float] = None,
+                         multihost: bool = False,
+                         local_devices: int = 0) -> "ClusterSession":
     """Spawn worker OS processes on this host and return a ClusterSession
     driving them (the in-process DistributedQueryRunner analog, but with
     REAL process isolation — each worker is its own interpreter + XLA
-    client; reference: TestingPrestoServer boots real HTTP servers)."""
+    client; reference: TestingPrestoServer boots real HTTP servers).
+
+    multihost=True boots the workers as one N-process `jax.distributed`
+    mesh (worker k = process k, gloo collectives over loopback — the CI
+    stand-in for a real multi-host DCN fabric); `local_devices` forces
+    that many virtual CPU devices per process so the GLOBAL mesh has
+    nworkers x local_devices devices."""
     import subprocess
     import sys
 
@@ -3469,12 +3791,27 @@ def launch_local_cluster(session, catalog_spec: str, nworkers: int = 2,
     env = dict(os.environ)
     env[_SECRET_ENV] = cluster_secret().decode()
     env["PRESTO_TPU_WORKER_PROC"] = "1"  # crash faults really _exit
+    extra: List[str] = []
+    if multihost:
+        import socket
+
+        with socket.socket() as s:  # free port for the jax coordinator
+            s.bind(("127.0.0.1", 0))
+            dist_port = s.getsockname()[1]
+        extra = ["--distributed-coordinator", f"127.0.0.1:{dist_port}",
+                 "--num-processes", str(nworkers)]
+        env["JAX_PLATFORMS"] = "cpu"
+    if local_devices:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count="
+                            f"{local_devices}").strip()
     procs = []
     urls = []
-    for _ in range(nworkers):
+    for k in range(nworkers):
         p = subprocess.Popen(
             [sys.executable, "-m", "presto_tpu.parallel.cluster",
-             "--catalog", catalog_spec],
+             "--catalog", catalog_spec]
+            + (extra + ["--process-id", str(k)] if multihost else []),
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             text=True, env=env)
         procs.append(p)
@@ -3529,12 +3866,33 @@ def main(argv=None):
                     help="device-mesh size this worker EXCLUSIVELY owns "
                          "(fragment-fusion target; default env "
                          "PRESTO_TPU_WORKER_MESH, else 0 = no mesh)")
+    ap.add_argument("--distributed-coordinator", default=None,
+                    help="jax.distributed coordinator host:port — this "
+                         "worker joins the GLOBAL multi-host mesh as one "
+                         "process (cross-host collective fusion); also "
+                         "settable via PRESTO_TPU_MULTIHOST="
+                         "addr:port,nproc,pid")
+    ap.add_argument("--num-processes", type=int, default=1,
+                    help="total processes in the jax.distributed mesh")
+    ap.add_argument("--process-id", type=int, default=0,
+                    help="this worker's rank in the jax.distributed mesh")
     args = ap.parse_args(argv)
     os.environ["PRESTO_TPU_WORKER_PROC"] = "1"  # crash faults really exit
     if args.platform != "default":
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+        os.environ.setdefault("PRESTO_TPU_PLATFORM", args.platform)
+    # multi-host membership initializes BEFORE any backend use — jax
+    # devices() after distributed init returns the GLOBAL device set
+    # (parallel/mesh.py is the single owner of jax.distributed)
+    from presto_tpu.parallel import mesh as MH
+
+    if args.distributed_coordinator:
+        MH.init_multihost(args.distributed_coordinator,
+                          args.num_processes, args.process_id)
+    else:
+        MH.init_multihost_from_env()
     w = WorkerServer(args.catalog, args.host, args.port,
                      mesh_devices=args.mesh)
     print(json.dumps({"url": w.url}), flush=True)

@@ -33,9 +33,35 @@ from presto_tpu.batch import Batch, Column
 from presto_tpu.exec import compile_cache as CC
 from presto_tpu.exec.executor import Executor
 from presto_tpu.parallel import exchange as EX
+from presto_tpu.parallel import mesh as MH
 from presto_tpu.parallel.mesh import AXIS, make_mesh
 from presto_tpu.plan import nodes as P
 from presto_tpu.plan.distribute import Undistributable, distribute
+
+
+def _put(arr, spec):
+    """device_put that also works on a multi-process global mesh.  A
+    plain device_put cannot target non-addressable devices, so on a
+    multihost mesh the feed goes through make_array_from_callback:
+    every gang member holds an IDENTICAL full host copy (same catalog
+    chunk, same pulled exchange pages, same padding) and materializes
+    only its addressable shards of the global array."""
+    if not MH.is_multihost():
+        return jax.device_put(arr, spec)
+    harr = np.asarray(arr)
+    return jax.make_array_from_callback(harr.shape, spec,
+                                        lambda idx: harr[idx])
+
+
+def local_shard_rows(arr) -> np.ndarray:
+    """Process-local rows of a row-sharded global array: addressable
+    shards concatenated in mesh-index order.  The gang output contract
+    reads through this — each rank publishes exactly these rows, and
+    the coordinator's gather passthrough reassembles the global result
+    rank by rank."""
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: (s.index[0].start or 0))
+    return np.concatenate([np.asarray(s.data) for s in shards])
 
 
 class FusedGuardTripped(Exception):
@@ -268,13 +294,13 @@ def sharded_scan(table, node: P.TableScan, mesh, ndev: int) -> Batch:
             if valid is not None:
                 valid = np.concatenate([np.asarray(valid),
                                         np.zeros((npad - n_rows,), bool)])
-                valid = jax.device_put(valid, spec)
-            cache[c] = Column(jax.device_put(arr, spec), valid, col.type,
+                valid = _put(valid, spec)
+            cache[c] = Column(_put(arr, spec), valid, col.type,
                               col.dictionary)
     sel_key = "__sel__"
     if sel_key not in cache:
         sel = np.arange(npad) < n_rows
-        cache[sel_key] = jax.device_put(sel, spec)
+        cache[sel_key] = _put(sel, spec)
     cols = {}
     for sym, colname in node.assignments.items():
         c = cache[colname]
@@ -311,11 +337,11 @@ def _ext_shard_batch(host_cols, node: P.TableScan, mesh, ndev: int) -> Batch:
             [arr, np.zeros((npad - n,) + arr.shape[1:], dtype=arr.dtype)])
         v = col.valid
         if v is not None:
-            v = jax.device_put(np.concatenate(
+            v = _put(np.concatenate(
                 [np.asarray(v), np.zeros((npad - n,), bool)]), spec)
-        cols[sym] = Column(jax.device_put(arr, spec), v, col.type,
+        cols[sym] = Column(_put(arr, spec), v, col.type,
                            col.dictionary)
-    sel = jax.device_put(np.arange(npad) < n, spec)
+    sel = _put(np.arange(npad) < n, spec)
     return Batch(cols, sel)
 
 
@@ -332,11 +358,11 @@ def _ext_repl_batch(host_cols, node: P.TableScan, mesh) -> Batch:
         col = column_from_numpy(np.asarray(data), node.types[sym],
                                 valid if valid is not None else None)
         v = None if col.valid is None else \
-            jax.device_put(np.asarray(col.valid), spec)
-        cols[sym] = Column(jax.device_put(np.asarray(col.data), spec), v,
+            _put(np.asarray(col.valid), spec)
+        cols[sym] = Column(_put(np.asarray(col.data), spec), v,
                            col.type, col.dictionary)
         n = len(data)
-    return Batch(cols, jax.device_put(np.ones((n,), bool), spec))
+    return Batch(cols, _put(np.ones((n,), bool), spec))
 
 
 def run_fused_fragment(session, root, ndev: int, ext_inputs,

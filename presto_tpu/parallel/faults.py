@@ -33,6 +33,12 @@ Choke points:
   `enospc` makes the write fail as if `SpillSpaceTracker` hit its
   bound.  Every spill fault must surface as a clean typed failure or a
   transparent re-spill (spill_verify_writes) — never wrong results.
+- `dcn` — the multi-host collective lane (method `COLLECTIVE`, path =
+  the task id): matched by a gang member BEFORE it reports ready at the
+  barrier epoch, so `fail` makes the whole gang time out at the barrier
+  and the attempt degrade to the unfused HTTP exchange path — the
+  scripted stand-in for a DCN fabric fault / collective error that
+  never risks wedging a real collective mid-flight.
 - `journal` — the query journal (parallel/journal.py) around each
   entry write (method `WRITE`) and each adopter-side read (method
   `READ`), path = the journal entry path: `fail`/`enospc` fail the op
@@ -49,9 +55,9 @@ programmatic via `FaultPlan(...)` / `install(...)`):
 
     rule[;rule...]          rule = where:method:path:nth:action[:arg]
 
-    where  = client | server | exec | spill | coalesce | journal
+    where  = client | server | exec | spill | coalesce | journal | dcn
     method = GET | POST | DELETE | EXEC | PAGE | PROXY | WRITE | READ
-             | BATCH | * (any);
+             | BATCH | COLLECTIVE | * (any);
              PAGE is the
              client-side delivered-page pseudo-method — its nth counts
              200-with-body results responses, so a `partial` rule
@@ -326,6 +332,23 @@ def _abort_connection(handler) -> None:
         handler.connection.close()
     except OSError:
         pass
+
+
+def apply_dcn(plan: FaultPlan, task_id: str) -> None:
+    """DCN/collective-lane choke point: called by a gang member on its
+    task thread BEFORE it reports ready at the barrier epoch.  `fail`
+    raises here, so the member never reports ready, the rest of the
+    gang times out at the barrier (retry.GANG_BARRIER_TIMEOUT_S), every
+    gang task FAILS cleanly without entering a jax collective, and the
+    coordinator retries the attempt on the unfused HTTP path.  `delay`
+    models a slow fabric link (a straggler at the barrier)."""
+    rule = plan.match("dcn", "COLLECTIVE", task_id)
+    if rule is None:
+        return
+    if rule.action == "delay":
+        R._sleep(rule.arg)
+    elif rule.action in ("fail", "drop", "reset"):
+        raise RuntimeError("injected fault: dcn collective lane")
 
 
 def apply_exec(plan: FaultPlan, task_id: str, server) -> None:

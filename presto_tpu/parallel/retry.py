@@ -57,6 +57,15 @@ PROBE_TIMEOUT_S = _env_f("PRESTO_TPU_PROBE_TIMEOUT", 3.0)    # health probe
 RANGE_TIMEOUT_S = _env_f("PRESTO_TPU_RANGE_TIMEOUT", 300.0)  # boundaries
 SHUTDOWN_TIMEOUT_S = _env_f("PRESTO_TPU_SHUTDOWN_TIMEOUT", 10.0)
 STARTUP_TIMEOUT_S = _env_f("PRESTO_TPU_STARTUP_TIMEOUT", 120.0)
+# multi-host gang barrier (round 21): how long one gang member waits at
+# the pre-collective barrier epoch for the rest of the gang before the
+# task FAILS cleanly (never entering the jax collective) and the
+# coordinator degrades the attempt to the unfused HTTP path
+GANG_BARRIER_TIMEOUT_S = _env_f("PRESTO_TPU_GANG_BARRIER_TIMEOUT", 30.0)
+# how long an ADMITTED gang may hold the (serializing) barrier board
+# before the home evicts its epoch — the backstop for a member dying
+# mid-collective without ever reporting done
+GANG_EXEC_TIMEOUT_S = _env_f("PRESTO_TPU_GANG_EXEC_TIMEOUT", 300.0)
 
 _DEADLINE_ENV = "PRESTO_TPU_QUERY_DEADLINE"
 

@@ -94,12 +94,40 @@ DEFAULT_PROFILES: Dict[str, dict] = {
         "serial_ms": 160.0,
         "serial_free": 5,
     },
+    # the CROSS-HOST lane measured on the CI box (tools/roofline.py
+    # --calibrate --multiproc: 2- and 4-process gloo loopback meshes,
+    # committed record tools/fusion_profile_cpu-multiproc.json): the
+    # dcn tables are keyed by PROCESS count and price the in-trace
+    # collective when the fusion target spans processes.  The fori_loop
+    # sweep amortises launch, so the intercept fits to ~0 (pinned to
+    # the same 0.1ms floor as the coll lane); the slope is ~2.5x the
+    # host memcpy rate, so on CPU-gloo SMALL cross-host edges fuse
+    # (they dodge host_edge_ms + dispatch) and big ones cut — the memo
+    # then refines that crossover per plan shape.
+    "cpu-multiproc": {
+        "platform": "cpu",
+        "host_edge_ms": 3.1,
+        "host_ms_per_mb": 11.9,
+        "coll_edge_ms": {2: 0.1, 4: 0.1, 8: 0.1},
+        "coll_ms_per_mb": {2: 31.2, 4: 29.8, 8: 25.3},
+        "dcn_edge_ms": {2: 0.1, 4: 0.1},
+        "dcn_ms_per_mb": {2: 31.9, 4: 29.6},
+        "dispatch_ms": 9.0,
+        "serial_ms": 160.0,
+        "serial_free": 5,
+    },
     "tpu": {
         "platform": "tpu",
         "host_edge_ms": 4.0,
         "host_ms_per_mb": 25.0,     # PTPG pack + DCN hop + unpack
         "coll_edge_ms": {2: 0.05, 4: 0.05, 8: 0.08},
         "coll_ms_per_mb": {2: 0.03, 4: 0.03, 8: 0.03},  # ~40GB/s ICI
+        # documented PRIORS pending an on-pod --calibrate --multiproc
+        # run: per-host DCN is ~2.5GB/s with ~1ms launch overhead, so
+        # cross-host collectives beat the HTTP path (~25ms/MB pack+hop)
+        # by ~60x per byte — the DrJAX composition this round targets
+        "dcn_edge_ms": {2: 1.0, 4: 1.2, 8: 1.5},
+        "dcn_ms_per_mb": {2: 0.4, 4: 0.4, 8: 0.45},
         "dispatch_ms": 6.0,
         "serial_ms": 2.0,           # XLA overlaps collectives on-chip
         "serial_free": 8,
@@ -118,6 +146,10 @@ class FusionProfile:
         default_factory=dict)      # per-ndev collective launch overhead
     coll_ms_per_mb: Dict[int, float] = dataclasses.field(
         default_factory=dict)      # per-ndev collective cost per MB
+    dcn_edge_ms: Dict[int, float] = dataclasses.field(
+        default_factory=dict)      # per-NPROC cross-host launch overhead
+    dcn_ms_per_mb: Dict[int, float] = dataclasses.field(
+        default_factory=dict)      # per-NPROC cross-host cost per MB
     dispatch_ms: float = 9.0         # per-fragment task overhead (cut)
     serial_ms: float = 160.0         # per extra group member past free
     serial_free: int = 5
@@ -138,9 +170,18 @@ class FusionProfile:
         return (self.host_edge_ms + self.dispatch_ms
                 + nbytes / 1e6 * self.host_ms_per_mb)
 
-    def fused_base_ms(self, nbytes: int, ndev: int) -> float:
+    def fused_base_ms(self, nbytes: int, ndev: int,
+                      nproc: int = 1) -> float:
         """Price of the edge as an in-trace collective, BEFORE the
-        marginal serialization penalty of growing the fused group."""
+        marginal serialization penalty of growing the fused group.
+        When the fusion target spans `nproc` > 1 processes the edge
+        crosses the DCN fabric — the dcn tables (keyed by process
+        count) price that lane; the slower hop dominates the mesh-local
+        ICI leg, so the model charges it alone."""
+        if nproc > 1 and (self.dcn_edge_ms or self.dcn_ms_per_mb):
+            return (self._nd(self.dcn_edge_ms, nproc, 2.0)
+                    + nbytes / 1e6
+                    * self._nd(self.dcn_ms_per_mb, nproc, 40.0))
         return (self._nd(self.coll_edge_ms, ndev, 1.0)
                 + nbytes / 1e6 * self._nd(self.coll_ms_per_mb, ndev, 8.0))
 
@@ -162,16 +203,21 @@ def _profile_from_dict(d: dict) -> FusionProfile:
         host_ms_per_mb=float(d.get("host_ms_per_mb", 11.9)),
         coll_edge_ms=_int_keys(d.get("coll_edge_ms")),
         coll_ms_per_mb=_int_keys(d.get("coll_ms_per_mb")),
+        dcn_edge_ms=_int_keys(d.get("dcn_edge_ms")),
+        dcn_ms_per_mb=_int_keys(d.get("dcn_ms_per_mb")),
         dispatch_ms=float(d.get("dispatch_ms", 9.0)),
         serial_ms=float(d.get("serial_ms", 160.0)),
         serial_free=int(d.get("serial_free", 5)),
     )
 
 
-def load_profile(session=None) -> FusionProfile:
+def load_profile(session=None, multihost: bool = False) -> FusionProfile:
     """Session `fusion_profile` (a JSON path) > PRESTO_TPU_FUSION_PROFILE
     env > baked per-platform default.  A missing/bad file degrades to
-    the default — calibration is an optimization, never a failure."""
+    the default — calibration is an optimization, never a failure.
+    `multihost=True` (the fusion target spans processes) prefers the
+    baked `<platform>-multiproc` entry, whose dcn tables carry the
+    measured cross-process collective lane."""
     path = None
     if session is not None:
         try:
@@ -189,6 +235,8 @@ def load_profile(session=None) -> FusionProfile:
     from presto_tpu.observe import profile as OP
 
     plat = OP.platform()
+    if multihost and f"{plat}-multiproc" in DEFAULT_PROFILES:
+        return _profile_from_dict(DEFAULT_PROFILES[f"{plat}-multiproc"])
     return _profile_from_dict(
         DEFAULT_PROFILES.get(plat, DEFAULT_PROFILES["cpu"]))
 
@@ -222,6 +270,7 @@ def profile_from_exchange_sweep(sweep: dict, platform: str) -> dict:
 
     host_pts: List[Tuple[float, float]] = []
     coll_pts: Dict[int, List[Tuple[float, float]]] = {}
+    dcn_pts: Dict[int, List[Tuple[float, float]]] = {}
     for cell in sweep.values():
         if not isinstance(cell, dict) or "bytes" not in cell:
             continue
@@ -234,6 +283,9 @@ def profile_from_exchange_sweep(sweep: dict, platform: str) -> dict:
             elif k.startswith("coll_nd") and k.endswith("_ms"):
                 nd = int(k[len("coll_nd"):-len("_ms")])
                 coll_pts.setdefault(nd, []).append((mb, float(v)))
+            elif k.startswith("dcn_np") and k.endswith("_ms"):
+                np_ = int(k[len("dcn_np"):-len("_ms")])
+                dcn_pts.setdefault(np_, []).append((mb, float(v)))
     h_edge, h_mb = fit(host_pts)
     base = DEFAULT_PROFILES.get(platform, DEFAULT_PROFILES["cpu"])
     prof = dict(base)
@@ -248,6 +300,13 @@ def profile_from_exchange_sweep(sweep: dict, platform: str) -> dict:
             c_edge, c_mb = fit(pts)
             prof["coll_edge_ms"][nd] = round(c_edge, 3)
             prof["coll_ms_per_mb"][nd] = round(c_mb, 3)
+    if dcn_pts:
+        prof["dcn_edge_ms"] = {}
+        prof["dcn_ms_per_mb"] = {}
+        for np_, pts in sorted(dcn_pts.items()):
+            d_edge, d_mb = fit(pts)
+            prof["dcn_edge_ms"][np_] = round(d_edge, 3)
+            prof["dcn_ms_per_mb"][np_] = round(d_mb, 3)
     return prof
 
 
@@ -329,15 +388,22 @@ class EdgeDecision:
     fused_est_ms: Optional[float]
     fuse: bool
     reason: str = ""
+    #: which collective fabric a FUSE verdict lowers onto: "ici" for a
+    #: mesh-local edge, "dcn" when the fusion target spans processes —
+    #: the cross_host_collective verdict (repartition -> all_to_all over
+    #: DCN, broadcast/gather -> all_gather)
+    lane: str = "ici"
 
 
 def price_edges(fragments, ndev: int, profile: FusionProfile,
-                kinds) -> List[EdgeDecision]:
+                kinds, nproc: int = 1) -> List[EdgeDecision]:
     """Model-only pricing pass: walk edges producers-first (the order
     `fuse_fragments` contracts them), price CUT vs FUSED with the
     marginal serialization penalty of the contraction, and greedily
     fuse net-win edges.  Union-find tracks fused-group sizes so each
-    contraction is charged for the parallelism it destroys."""
+    contraction is charged for the parallelism it destroys.  `nproc` >
+    1 means the fusion target is a multi-process gang: the collective
+    leg prices on the DCN lane."""
     parent = {f.fid: f.fid for f in fragments}
     gsize = {f.fid: 1 for f in fragments}
 
@@ -347,6 +413,7 @@ def price_edges(fragments, ndev: int, profile: FusionProfile,
             x = parent[x]
         return x
 
+    lane = "dcn" if nproc > 1 else "ici"
     out: List[EdgeDecision] = []
     for frag in fragments:
         for inp in frag.inputs:
@@ -363,17 +430,17 @@ def price_edges(fragments, ndev: int, profile: FusionProfile,
             pen = (profile.serial_penalty_ms(merged)
                    - profile.serial_penalty_ms(gsize[rc])
                    - profile.serial_penalty_ms(gsize[rp]))
-            fused = profile.fused_base_ms(nb, ndev) + pen
+            fused = profile.fused_base_ms(nb, ndev, nproc) + pen
             if fused < cut:
                 parent[rp] = rc
                 gsize[rc] = merged
                 out.append(EdgeDecision(
                     inp.eid, inp.kind, frag.fid, inp.producer, nb,
-                    round(cut, 3), round(fused, 3), True))
+                    round(cut, 3), round(fused, 3), True, "", lane))
             else:
                 out.append(EdgeDecision(
                     inp.eid, inp.kind, frag.fid, inp.producer, nb,
-                    round(cut, 3), round(fused, 3), False, "cost"))
+                    round(cut, 3), round(fused, 3), False, "cost", lane))
     return out
 
 
@@ -502,23 +569,26 @@ def memo_enabled(session) -> bool:
 
 
 def decide_edges(fragments, ndev: int, session, mode: str,
-                 kinds, fp: str = "") -> Tuple[
+                 kinds, fp: str = "", nproc: int = 1) -> Tuple[
                      Dict[int, bool], Dict[str, int], int,
                      str, List[EdgeDecision]]:
     """The coordinator's one entry point: price every exchange edge and
     return (verdict {eid: fuse?}, skip-reason counts, mispredicted-edge
     count, plan fingerprint, per-edge decisions).  `fp` is the caller's
     precomputed plan fingerprint (computed here when omitted and the
-    memo is on).
+    memo is on).  `nproc` is the process span of the fusion target the
+    caller chose (1 = mesh-local; > 1 prices the DCN lane and stamps
+    FUSE verdicts lane="dcn").
 
     mode "force" reproduces round 12: every kind-eligible edge fuses,
     the model prices nothing.  mode "auto" runs the greedy model, then
     applies the decision memo's override (if this shape has observed
     walls contradicting the model, the edges flip — each flipped edge
     counts as mispredicted)."""
-    profile = load_profile(session)
+    profile = load_profile(session, multihost=nproc > 1)
     if not fp and memo_enabled(session):
         fp = fingerprint(fragments)
+    lane = "dcn" if nproc > 1 else "ici"
     if mode == "force":
         decisions = []
         for frag in fragments:
@@ -528,10 +598,10 @@ def decide_edges(fragments, ndev: int, session, mode: str,
                     inp.eid, inp.kind, frag.fid, inp.producer,
                     int(getattr(inp, "est_bytes", None)
                         or DEFAULT_EDGE_BYTES),
-                    0.0, None, ok, "" if ok else "kind"))
+                    0.0, None, ok, "" if ok else "kind", lane))
         mispredicted = 0
     else:
-        decisions = price_edges(fragments, ndev, profile, kinds)
+        decisions = price_edges(fragments, ndev, profile, kinds, nproc)
         override = MEMO.verdict(fp) if fp else None
         mispredicted = 0
         if override is not None:

@@ -120,6 +120,16 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "fragment_fusion_kinds": "",
     "fragment_fusion_memo": True,
     "fusion_profile": "",
+    # cross-host collective fusion (round 21): workers that joined one
+    # `jax.distributed` multi-process mesh (cluster worker
+    # --distributed-coordinator / PRESTO_TPU_MULTIHOST) form a GANG the
+    # classifier may fuse cross-host exchange edges onto — repartition
+    # lowers to all_to_all and broadcast/gather to all_gather over the
+    # DCN fabric, priced by the profile's dcn_edge_ms/dcn_ms_per_mb
+    # lane.  Off = mesh members are plain HTTP workers; any gang
+    # failure (member death, collective fault) already degrades to the
+    # HTTP exchange path on its own.
+    "multihost_fusion": True,
     # cluster scheduling policy (reference: PhasedExecutionSchedule vs
     # AllAtOnceExecutionPolicy, execution-policy session property):
     # phased gates probe-side stage startup on build-side completion,

@@ -474,6 +474,7 @@ def test_fusion_cost_constants_confined_to_fusion_cost():
     FORBIDDEN_ATTRS = {"host_edge_ms", "host_ms_per_mb", "coll_edge_ms",
                        "coll_ms_per_mb", "serial_ms", "serial_free",
                        "cut_ms", "fused_base_ms", "serial_penalty_ms",
+                       "dcn_edge_ms", "dcn_ms_per_mb",
                        "DEFAULT_PROFILES"}
     pkg = os.path.join(ROOT, "presto_tpu")
     bad = []
@@ -501,6 +502,50 @@ def test_fusion_cost_constants_confined_to_fusion_cost():
                                "fusion pricing belongs in "
                                "plan/fusion_cost.py (consume "
                                "decide_edges verdicts instead)")
+    assert not bad, "\n".join(bad)
+
+
+def test_jax_distributed_confined_to_mesh_module():
+    """Multi-host gate (ISSUE 18): `jax.distributed` — the multi-
+    controller runtime behind cross-host collective fusion — is
+    confined to parallel/mesh.py (init_multihost /
+    init_multihost_from_env are the routed entries), so process-group
+    initialisation happens exactly once, BEFORE any backend touch, and
+    every other layer reasons about membership via the /v1/info
+    declarations and mesh.multihost_spec().  A second initialize
+    anywhere else would either crash (backend already live) or fork
+    the process group.  Flags `jax.distributed` attribute chains and
+    `from jax import distributed` imports."""
+    import ast
+
+    ALLOWED = {os.path.join("parallel", "mesh.py")}
+    pkg = os.path.join(ROOT, "presto_tpu")
+    bad = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg)
+            if rel in ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "distributed" \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "jax":
+                    bad.append(f"{rel}:{node.lineno}: jax.distributed "
+                               "— multi-controller init belongs in "
+                               "parallel/mesh.py")
+                if isinstance(node, ast.ImportFrom) \
+                        and node.module == "jax" \
+                        and any(a.name == "distributed"
+                                for a in node.names):
+                    bad.append(f"{rel}:{node.lineno}: from jax import "
+                               "distributed — route through "
+                               "parallel/mesh.py")
     assert not bad, "\n".join(bad)
 
 

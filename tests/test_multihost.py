@@ -1,0 +1,271 @@
+"""Cross-host collective data plane (round 21): gang assembly, the HTTP
+barrier board, chaos degradation, and the real 2-process gloo mesh.
+
+Fast tests run IN-PROCESS: workers DECLARE a fake jax.distributed
+membership via WorkerServer(dist_spec=...) without ever calling
+jax.distributed.initialize — gang assembly, scheduling, the barrier
+protocol, and both chaos fallbacks (member death, dcn:COLLECTIVE fault)
+are all exercised against the declarations alone, and the fault paths
+by design fail BEFORE any collective would run.  The slow test boots a
+REAL 2-process jax.distributed mesh (gloo over loopback — the CI
+stand-in for the TPU DCN fabric) as worker subprocesses and checks
+force/off checksums against the sqlite oracle."""
+
+import pytest
+
+import presto_tpu
+from presto_tpu.parallel import cluster as C
+from presto_tpu.parallel import faults as F
+from presto_tpu.parallel import retry as R
+from tests.sqlite_oracle import assert_same_results, to_sqlite
+from tests.tpch_queries import QUERIES
+
+
+def norm(rows):
+    return [tuple(round(x, 4) if isinstance(x, float) else x for x in r)
+            for r in rows]
+
+
+GANG_QUERY = ("SELECT o_orderpriority, count(*) c, "
+              "checksum(o_orderkey) k FROM orders "
+              "GROUP BY o_orderpriority ORDER BY 1")
+
+
+def _dist_spec(rank, nproc=2, gdev=4, coord="127.0.0.1:9999"):
+    return {"distCoordinator": coord, "distProcessId": rank,
+            "distNumProcesses": nproc, "globalDevices": gdev}
+
+
+def _fake_gang(catalog="tpch:0.01:/tmp/presto_tpu_cache", nproc=2,
+               gdev=4, faults=None):
+    return [C.WorkerServer(catalog, dist_spec=_dist_spec(k, nproc, gdev),
+                           faults=(faults or {}).get(k)).start()
+            for k in range(nproc)]
+
+
+# ---- gang assembly from /v1/info declarations -------------------------
+
+
+def test_fusion_mesh_assembles_gang_in_rank_order(tpch_catalog_tiny):
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    workers = _fake_gang()
+    # layout order deliberately REVERSED: rank order must come from the
+    # declarations, not the worker list
+    cs = C.ClusterSession(session, [w.url for w in reversed(workers)])
+    try:
+        urls, ndev, nproc = cs._fusion_mesh(cs.workers, cs._query_ctx())
+        assert urls == [w.url for w in workers]  # rank order
+        assert (ndev, nproc) == (4, 2)
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_incomplete_gang_is_not_a_fusion_target(tpch_catalog_tiny):
+    """A missing rank (declared nproc=2, only rank 0 in the layout)
+    means the gang can never rendezvous — nothing fuses."""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    w0 = C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                        dist_spec=_dist_spec(0)).start()
+    cs = C.ClusterSession(session, [w0.url])
+    try:
+        urls, ndev, nproc = cs._fusion_mesh(cs.workers, cs._query_ctx())
+        assert urls is None and nproc == 1
+        r = cs.sql(GANG_QUERY)
+        assert r.stats.fragments_fused == 0
+        assert r.stats.fusion_skips.get("cross_host", 0) > 0
+    finally:
+        w0.stop()
+
+
+def test_multihost_fusion_property_disables_gangs(tpch_catalog_tiny):
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    workers = _fake_gang()
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    try:
+        session.set("multihost_fusion", False)
+        urls, _ndev, _nproc = cs._fusion_mesh(cs.workers, cs._query_ctx())
+        assert urls is None, "gate off: mesh members are plain workers"
+    finally:
+        session.set("multihost_fusion", True)
+        for w in workers:
+            w.stop()
+
+
+def test_mesh_member_never_a_single_host_target(tpch_catalog_tiny):
+    """A multi-controller member also declaring a local mesh must NOT be
+    picked as a single-host fusion target — its jax.devices() are the
+    GLOBAL set, and a lone shard_map over them would hang."""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    w0 = C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache", mesh_devices=8,
+                        dist_spec=_dist_spec(0)).start()
+    cs = C.ClusterSession(session, [w0.url])
+    try:
+        urls, _, _ = cs._fusion_mesh(cs.workers, cs._query_ctx())
+        assert urls is None
+    finally:
+        w0.stop()
+
+
+# ---- the barrier board ------------------------------------------------
+
+
+def test_gang_board_admits_one_gang_at_a_time():
+    b = C._GangBoard()
+    # oldest FULLY-READY gang admits first: B completes while A still
+    # waits on a rank, so B goes — and nothing else until B retires
+    assert b.ready("A", 0, 2) == {"go": False, "admitted": False}
+    assert b.ready("B", 0, 2)["go"] is False
+    rb = b.ready("B", 1, 2)
+    assert rb == {"go": True, "admitted": True}
+    assert b.ready("B", 1, 2) == {"go": True, "admitted": False}
+    assert b.ready("A", 1, 2)["go"] is False, "one gang at a time"
+    b.done("B", 0)
+    assert b.ready("A", 0, 2)["go"] is False, "B not fully done"
+    b.done("B", 1)
+    assert b.ready("A", 1, 2)["go"] is True, "B retired -> A admits"
+
+
+def test_gang_board_evicts_stalled_waiters():
+    b = C._GangBoard()
+    b.ready("dead", 0, 2)  # rank 1 never arrives...
+    b._gangs["dead"]["barrier_deadline"] = R.Deadline(0.0)  # ...and the
+    # barrier deadline lapses: the waiter must not block the line
+    assert b.ready("live", 0, 1)["go"] is True
+
+
+def test_gang_board_evicts_hung_admitted_epoch(monkeypatch):
+    b = C._GangBoard()
+    assert b.ready("hung", 0, 1)["go"] is True
+    monkeypatch.setattr(R, "GANG_EXEC_TIMEOUT_S", 0.0)
+    # the admitted gang never reports done; a fresh ready re-arms the
+    # exec deadline lazily, so expire it and admit the next in line
+    b._gangs["hung"]["exec_deadline"] = R.Deadline(0.0)
+    assert b.ready("next", 0, 1)["go"] is True
+
+
+# ---- gang execution + chaos degradation (in-process) ------------------
+
+
+def test_fake_gang_executes_with_barrier(tpch_catalog_tiny):
+    """Declared 2-rank gang in ONE process: scheduling, the ready/done
+    barrier round trip, per-rank publication, and result reassembly all
+    run for real (the 'global' mesh is 4 local virtual devices, so the
+    collectives happen to work without a second process)."""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    want = norm(session.sql(GANG_QUERY).rows)
+    workers = _fake_gang()
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    try:
+        session.set("fragment_fusion", "force")
+        r = cs.sql(GANG_QUERY)
+        assert norm(r.rows) == want
+        st = r.stats
+        assert st.fragments_fused > 0
+        assert st.exchange_bytes_dcn > 0
+        assert st.exchange_bytes_host == 0
+        import json as _json
+
+        info = _json.loads(C._http(f"{workers[0].url}/v1/info"))
+        assert info["counters"]["gangs_admitted"] >= 1
+        assert info["distProcessId"] == 0  # declaration served
+    finally:
+        session.set("fragment_fusion", "auto")
+        for w in workers:
+            w.stop()
+
+
+def test_gang_member_death_degrades_to_http(tpch_catalog_tiny,
+                                            monkeypatch):
+    """Rank 1's worker dies before its gang task runs: rank 0 times out
+    at the barrier (never entering a collective), the attempt fails
+    cleanly, and the retry runs the unfused HTTP path on the survivor
+    with identical checksums — no retry storm."""
+    monkeypatch.setattr(R, "GANG_BARRIER_TIMEOUT_S", 3.0)
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    want = norm(session.sql(GANG_QUERY).rows)
+    workers = _fake_gang(
+        faults={1: F.FaultPlan.parse("exec:EXEC:*:1:crash")})
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    try:
+        session.set("fragment_fusion", "force")
+        r = cs.sql(GANG_QUERY)
+        assert norm(r.rows) == want
+        st = r.stats
+        assert st.fragments_fused == 0, "retry must run unfused"
+        assert st.recovery.get("fused_fallbacks", 0) == 1, st.recovery
+        assert st.recovery.get("query_retries", 0) == 1, st.recovery
+        assert st.exchange_bytes_dcn == 0
+        assert st.exchange_bytes_host > 0  # the HTTP path really ran
+        assert workers[1].crashed
+    finally:
+        session.set("fragment_fusion", "auto")
+        for w in workers:
+            if not w.crashed:
+                w.stop()
+
+
+def test_dcn_collective_fault_degrades_to_http(tpch_catalog_tiny,
+                                               monkeypatch):
+    """The dcn:COLLECTIVE choke point fires on rank 1 BEFORE its ready
+    report: the whole gang times out at the barrier and the attempt
+    degrades to the unfused HTTP exchange with identical checksums,
+    fragments_fused == 0, and exactly one retry."""
+    monkeypatch.setattr(R, "GANG_BARRIER_TIMEOUT_S", 3.0)
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    want = norm(session.sql(GANG_QUERY).rows)
+    workers = _fake_gang(
+        faults={1: F.FaultPlan.parse("dcn:COLLECTIVE:*:1:fail")})
+    cs = C.ClusterSession(session, [w.url for w in workers])
+    try:
+        session.set("fragment_fusion", "force")
+        r = cs.sql(GANG_QUERY)
+        assert norm(r.rows) == want
+        st = r.stats
+        assert st.fragments_fused == 0
+        assert st.recovery.get("fused_fallbacks", 0) == 1, st.recovery
+        assert st.recovery.get("query_retries", 0) == 1, st.recovery
+        assert len(workers[1].faults.fired) == 1
+        assert st.exchange_bytes_host > 0
+        # forced-unfused leg for the checksum triple-check
+        session.set("fragment_fusion", "off")
+        r_off = cs.sql(GANG_QUERY)
+        assert norm(r_off.rows) == norm(r.rows)
+    finally:
+        session.set("fragment_fusion", "auto")
+        for w in workers:
+            w.stop()
+
+
+# ---- the real thing: 2-process gloo mesh over loopback ----------------
+
+
+@pytest.mark.slow
+def test_multihost_gang_e2e_oracle_checksums(tpch_catalog_tiny,
+                                             tpch_sqlite_tiny):
+    """q3 over a REAL 2-process jax.distributed CPU mesh (2x2 global
+    devices, gloo collectives over loopback): the forced-fused leg
+    matches the forced-off leg AND the sqlite oracle, with zero HTTP
+    exchange bytes on the fused attempt."""
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    cs = C.launch_local_cluster(
+        session, "tpch:0.01:/tmp/presto_tpu_cache", nworkers=2,
+        multihost=True, local_devices=2)
+    try:
+        session.set("fragment_fusion", "off")
+        r_off = cs.sql(QUERIES[3])
+        assert r_off.stats.fragments_fused == 0
+        session.set("fragment_fusion", "force")
+        r_f = cs.sql(QUERIES[3])
+        st = r_f.stats
+        assert st.fragments_fused > 0
+        assert st.exchange_bytes_dcn > 0
+        assert st.exchange_bytes_host == 0
+        assert norm(r_f.rows) == norm(r_off.rows)
+        expected = tpch_sqlite_tiny.execute(
+            to_sqlite(QUERIES[3])).fetchall()
+        assert_same_results(r_f.rows, expected, ordered=True)
+    finally:
+        session.set("fragment_fusion", "auto")
+        for p in getattr(cs, "_procs", []):
+            p.kill()

@@ -148,13 +148,123 @@ def exchange_sweep(per_iter, rng):
     return xout
 
 
-def calibrate(out_path=None):
-    """`tools/roofline.py --calibrate [out.json]`: run ONLY the
-    exchange sweep and fit a per-platform fusion-cost profile
+def dcn_child(coord, nproc, pid, ldev):
+    """`--dcn-child` (spawned by dcn_sweep, never by hand): process
+    `pid` of an `nproc`-process jax.distributed CPU mesh with `ldev`
+    virtual local devices, timing the SAME repartition fori_loop the
+    exchange sweep uses — but over the GLOBAL mesh, so every
+    all_to_all crosses process boundaries through gloo loopback (the
+    CI stand-in for the TPU DCN fabric).  Rank 0 prints ONE JSON line
+    {"r64k": ms_per_iter, ...}; other ranks print nothing."""
+    import numpy as np
+
+    from presto_tpu.parallel import mesh as MH
+
+    MH.init_multihost(coord, nproc, pid)
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+    import presto_tpu  # noqa: F401  (x64 + compile cache)
+    from presto_tpu.batch import Batch as PBatch
+    from presto_tpu.parallel import dist_executor as DX
+    from presto_tpu.parallel import exchange as EXC
+    from presto_tpu.parallel.mesh import AXIS, make_mesh
+
+    nd = nproc * ldev
+    mesh = make_mesh(nd)
+    rng = np.random.default_rng(0)
+    rtt = timed(jax.jit(lambda x: x + 1.0), jnp.float32(1.0))
+    out = {}
+    for rexp in (16, 18, 20):
+        rows = 1 << rexp
+        kh = rng.integers(0, 1 << 31, rows).astype(np.int64)
+        vh = rng.normal(size=rows)
+        spec = NamedSharding(mesh, PSpec(AXIS))
+        kd = DX._put(kh, spec)
+        vd = DX._put(vh, spec)
+
+        def inner(k, v):
+            from presto_tpu import types as _PT
+            from presto_tpu.batch import Column as _PCol
+
+            def body(i, s):
+                b = PBatch(
+                    {"k": _PCol(k ^ s, None, _PT.BIGINT, None),
+                     "v": _PCol(v, None, _PT.DOUBLE, None)},
+                    jnp.ones(k.shape, bool))
+                ob, _ov = EXC.repartition_batch(
+                    b, [b.columns["k"]], nd, AXIS)
+                return s + ob.columns["k"].data[0]
+            return lax.fori_loop(0, K, body, jnp.int64(0))
+
+        coll = jax.jit(DX._shard_mapped(
+            inner, mesh, (PSpec(AXIS), PSpec(AXIS)), PSpec()))
+        t = max(timed(coll, kd, vd) - rtt, 1e-9) / K
+        out[f"r{rows >> 10}k"] = round(t * 1000, 2)
+    if pid == 0:
+        print(json.dumps(out), flush=True)
+
+
+def dcn_sweep(nprocs=(2, 4), local_devices=2):
+    """Multi-process collective lane: for each process count, boot that
+    many `--dcn-child` subprocesses as one jax.distributed mesh and
+    collect rank 0's per-iteration all_to_all walls.  Returns cells
+    keyed like the exchange sweep ({"r64k": {"dcn_np2_ms": ..}, ...});
+    a process count that fails to boot (no gloo, port trouble) is
+    skipped — calibration degrades, never fails."""
+    import socket
+    import subprocess
+
+    cells = {}
+    for nproc in nprocs:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count="
+                     f"{local_devices}"])
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--dcn-child",
+             f"127.0.0.1:{port}", str(nproc), str(pid),
+             str(local_devices)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env) for pid in range(nproc)]
+        try:
+            line = procs[0].communicate(timeout=600)[0].strip()
+            for p in procs[1:]:
+                p.communicate(timeout=60)
+            walls = json.loads(line.splitlines()[-1])
+        except Exception:  # noqa: BLE001 — skip the lane, keep priors
+            for p in procs:
+                p.kill()
+            print(json.dumps({"dcn_skipped": nproc}),
+                  file=sys.stderr, flush=True)
+            continue
+        for label, ms in walls.items():
+            cells.setdefault(label, {})[f"dcn_np{nproc}_ms"] = ms
+    return cells
+
+
+def calibrate(out_path=None, multiproc=False):
+    """`tools/roofline.py --calibrate [--multiproc] [out.json]`: run
+    ONLY the exchange sweep and fit a per-platform fusion-cost profile
     (plan/fusion_cost.profile_from_exchange_sweep) the engine loads via
     the PRESTO_TPU_FUSION_PROFILE env var or the `fusion_profile`
     session property.  Default output: fusion_profile_<platform>.json
-    next to this script."""
+    next to this script.
+
+    `--multiproc` adds the dcn lane (dcn_sweep subprocess meshes) and
+    writes `fusion_profile_<platform>-multiproc.json` — the numbers
+    that seed DEFAULT_PROFILES["cpu-multiproc"]; on a TPU pod the same
+    flag measures the real DCN fabric and replaces the documented
+    tpu dcn priors."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -170,6 +280,10 @@ def calibrate(out_path=None):
 
     platform = jax.devices()[0].platform
     sweep = exchange_sweep(per_iter, rng)
+    if multiproc:
+        for label, cell in dcn_sweep().items():
+            sweep.setdefault(label, {}).update(cell)
+        platform = f"{platform}-multiproc"
     prof = FC.profile_from_exchange_sweep(sweep, platform)
     prof["calibrated_from"] = "tools/roofline.py --calibrate (exchange sweep)"
     prof["n_devices"] = len(jax.devices())
@@ -752,9 +866,13 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--calibrate" in sys.argv:
+    if "--dcn-child" in sys.argv:
         args = [a for a in sys.argv[1:] if not a.startswith("--")]
-        calibrate(args[0] if args else None)
+        dcn_child(args[0], int(args[1]), int(args[2]), int(args[3]))
+    elif "--calibrate" in sys.argv:
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        calibrate(args[0] if args else None,
+                  multiproc="--multiproc" in sys.argv)
     elif "--fleet" in sys.argv:
         args = [a for a in sys.argv[1:] if not a.startswith("--")]
         fleet_sweep(int(args[0]) if args else 4)
