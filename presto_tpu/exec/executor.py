@@ -53,7 +53,8 @@ def _merge_sort_stats(stats, counts: dict) -> None:
               "df_filters_produced", "df_filters_applied",
               "df_rows_pruned", "df_chunks_pruned", "df_splits_pruned",
               "fragments_fused", "exchange_bytes_host",
-              "exchange_bytes_collective",
+              "exchange_bytes_collective", "exchange_bytes_sketch",
+              "approx_rewrites",
               "spill_partitions", "spill_bytes", "spill_restores",
               "spill_recursions",
               "partial_aggs_bypassed", "partial_aggs_reenabled"):
@@ -645,10 +646,14 @@ def run_compiled(session, text: str, stmt, mon=None, params=None) -> QueryResult
                 else:
                     guard = jnp.asarray(False)
                 meta_box.clear()
-                if out.capacity > _PACK_FETCH_MAX:
-                    # unbounded root over a scan-sized capacity: keep
-                    # the Batch so to_numpy's selective fetch (pull sel,
-                    # gather survivors) can avoid shipping full columns
+                if out.capacity > _PACK_FETCH_MAX or any(
+                        getattr(c.data, "ndim", 1) > 1
+                        for c in out.columns.values()):
+                    # unbounded root over a scan-sized capacity — or a
+                    # matrix-shaped column (sketch state, Int128 limbs)
+                    # the u32 pack cannot flatten: keep the Batch so
+                    # to_numpy's selective fetch (pull sel, gather
+                    # survivors) can avoid shipping full columns
                     meta_box.append(None)
                     return out, guard
                 # flat buffer -> ONE host fetch (see kernels.pack_fetch)
@@ -2262,8 +2267,55 @@ class Executor:
             return Column(cnt, None, T.BIGINT)
         if a.fn == "approx_distinct":
             h = K.hll_hash64(col)  # value hash: matches distributed merge
-            est = K.hll_registers_and_estimate(h, valid, gid, n_groups)
+            est = K.hll_registers_and_estimate(h, valid, gid, n_groups,
+                                               m=_hll_m(a))
             return Column(est, None, T.BIGINT)
+        if a.fn == "$hll_partial":
+            # mergeable sketch partial: the state column IS the aggregate
+            # output — (n_groups, m) uint8 registers, m from the TYPE
+            h = K.hll_hash64(col)
+            regs = K.hll_partial(h, valid, gid, n_groups,
+                                 m=a.type.params[0])
+            return Column(regs, None, a.type)
+        if a.fn == "$hll_est":
+            # final over partial states: fold register rows (elementwise
+            # max) per group, then estimate; empty groups estimate 0,
+            # matching the single-pass kernel (approx_distinct never
+            # returns NULL)
+            return Column(K.hll_merge_estimate(col.data, valid, gid,
+                                               n_groups), None, T.BIGINT)
+        if a.fn == "$hll_merge":
+            # rollup merge: partial states in, folded state out (the
+            # chunked loop's re-aggregation of partial pages)
+            return Column(K.hll_merge(col.data, valid, gid, n_groups),
+                          None, a.type)
+        if a.fn == "$kll_partial":
+            kk = a.type.params[0] // 2
+            x = col.data.astype(jnp.float64) if col.data.dtype != \
+                jnp.float64 else col.data
+            return Column(K.kll_partial(x, valid, gid, n_groups, kk),
+                          None, a.type)
+        if a.fn == "$kll_pct":
+            pv = eval_expr(a.args[1], b, self.ctx)
+            p = pv.data if getattr(pv.data, "ndim", 0) == 0 else pv.data[0]
+            kk = a.args[0].type.params[0] // 2
+            vals, ok = K.kll_percentile(col.data, valid, gid, n_groups,
+                                        p, kk)
+            return Column(vals.astype(a.type.numpy_dtype()), ok, a.type)
+        if a.fn in ("approx_count", "approx_sum"):
+            # COUNT/SUM ... WITH ERROR: deterministic 1-in-8 value-hash
+            # sample, scaled by exactly 8 — partition-independent, so
+            # partials (the fn is its own partial) merge by plain sum
+            keep = valid & K.sketch_sample_mask(K.hll_hash64(col))
+            if a.fn == "approx_count":
+                s = K.segment_sum(keep.astype(jnp.int32), gid, n_groups)
+                return Column(s.astype(jnp.int64) * 8, None, T.BIGINT)
+            x = jnp.where(keep, col.data, jnp.zeros_like(col.data))
+            s = K.segment_sum(x, gid, n_groups)
+            if a.type.is_integer:
+                s = s.astype(jnp.int64)
+            return Column(s.astype(a.type.numpy_dtype()) * 8, nonempty,
+                          a.type)
         if a.fn == "checksum":
             # order-independent 64-bit checksum: wrapping sum of row
             # hashes (reference: ChecksumAggregationFunction, xor-based;
@@ -3952,6 +4004,16 @@ class Executor:
             except W.WriteError as e:
                 raise ExecutionError(str(e)) from e
         return b
+
+
+def _hll_m(a: ir.AggCall) -> int:
+    """Register count for an approx_distinct call: the optional second
+    argument is a max-standard-error LITERAL (reference:
+    ApproximateCountDistinctAggregation's maxStandardError)."""
+    if len(a.args) >= 2 and isinstance(a.args[1], ir.Lit) \
+            and a.args[1].value is not None:
+        return K.hll_m_for_error(float(a.args[1].value))
+    return 1024
 
 
 def _tuples_to_dict_column(tuples: np.ndarray, valid, typ) -> Column:

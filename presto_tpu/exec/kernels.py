@@ -577,12 +577,141 @@ def hll_registers_and_estimate(h: jnp.ndarray, valid: jnp.ndarray,
         jnp.where(valid, rho, 0.0), seg, num_segments=n_groups * m + 1,
     )[:-1].reshape(n_groups, m)
     M = jnp.maximum(M, 0.0)  # empty registers: segment_max identity is -inf
+    return hll_estimate(M)
+
+
+def hll_m_for_error(e: float) -> int:
+    """Register count for a requested standard error e: the power of two
+    with 1.04/sqrt(m) <= e, clamped to [64, 65536] (reference:
+    HyperLogLog's indexBitLength from maxStandardError)."""
+    m = 64
+    while m < 65536 and 1.04 / np.sqrt(m) > e:
+        m *= 2
+    return m
+
+
+def hll_estimate(M: jnp.ndarray) -> jnp.ndarray:
+    """Bias-corrected HLL estimate with small-range linear counting from
+    an (n_groups, m) register matrix (any integer/float register dtype)."""
+    m = M.shape[1]
+    Mf = M.astype(jnp.float64)
     alpha = 0.7213 / (1.0 + 1.079 / m)
-    E = alpha * m * m / jnp.sum(2.0 ** (-M), axis=1)
-    zeros = jnp.sum(M == 0.0, axis=1)
+    E = alpha * m * m / jnp.sum(2.0 ** (-Mf), axis=1)
+    zeros = jnp.sum(Mf == 0.0, axis=1)
     linear = m * jnp.log(m / jnp.maximum(zeros, 1).astype(jnp.float64))
     est = jnp.where((E <= 2.5 * m) & (zeros > 0), linear, E)
     return jnp.round(est).astype(jnp.int64)
+
+
+def hll_partial(h: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
+                n_groups: int, m: int = 1024) -> jnp.ndarray:
+    """Per-group HLL register ROWS as the mergeable partial state: one
+    (n_groups, m) uint8 matrix built by a single segment_max.  Unlike
+    hll_registers_and_estimate this never shrinks m — the state's shape
+    is part of its TYPE (types.hll_state(m)) and must agree across
+    chunks/shards so partials fold with elementwise max."""
+    log2m = int(np.log2(m))
+    reg = (h & jnp.uint64(m - 1)).astype(jnp.int64)
+    w = ((h >> jnp.uint64(log2m)) & jnp.uint64(0xFFFFFFFF)).astype(jnp.float64)
+    rho = jnp.where(w > 0, 32.0 - jnp.floor(jnp.log2(jnp.maximum(w, 1.0))),
+                    33.0)
+    seg = gid * m + reg
+    seg = jnp.where(valid, seg, n_groups * m)  # dead rows -> overflow slot
+    M = jax.ops.segment_max(
+        jnp.where(valid, rho, 0.0), seg, num_segments=n_groups * m + 1,
+    )[:-1].reshape(n_groups, m)
+    return jnp.maximum(M, 0.0).astype(jnp.uint8)
+
+
+def hll_merge(regs: jnp.ndarray, valid, gid: jnp.ndarray,
+              n_groups: int) -> jnp.ndarray:
+    """Fold partial register rows per group — HLL union IS elementwise
+    max, so a 2-D segment_max over the row axis merges any number of
+    partial sketches exactly (order- and partition-independent)."""
+    g = gid if valid is None else jnp.where(valid, gid, n_groups)
+    M = jax.ops.segment_max(regs.astype(jnp.int32), g,
+                            num_segments=n_groups + 1)[:n_groups]
+    return jnp.maximum(M, 0).astype(jnp.uint8)
+
+
+def hll_merge_estimate(regs: jnp.ndarray, valid, gid: jnp.ndarray,
+                       n_groups: int) -> jnp.ndarray:
+    """Final aggregate over partial HLL states: merge rows per group,
+    then estimate.  Estimates are bit-identical to the single-pass
+    kernel at equal m because max is associative over the same rho set."""
+    return hll_estimate(hll_merge(regs, valid, gid, n_groups))
+
+
+def kll_partial(x: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
+                n_groups: int, K: int) -> jnp.ndarray:
+    """Fixed-shape per-group quantile summary (KLL-style single
+    compactor level): K evenly-spaced order statistics + their integer
+    weights, concatenated into a (n_groups, 2K) float64 state row.  One
+    global (group, value) lexsort builds every group's summary; weight
+    w_j = floor((j+1)*cnt/K) - floor(j*cnt/K) telescopes to exactly cnt,
+    so merged rank queries stay within ~1/K of truth per merge level."""
+    n = x.shape[0]
+    if n == 0:
+        return jnp.zeros((n_groups, 2 * K), jnp.float64)
+    xf = jnp.where(valid, x.astype(jnp.float64), jnp.inf)
+    g = jnp.where(valid, gid, n_groups)       # invalid rows: dead group
+    order = jnp.lexsort((xf, g))
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                              num_segments=n_groups + 1)[:n_groups]
+    starts = jnp.cumsum(cnt) - cnt
+    cf = cnt.astype(jnp.float64)[:, None]
+    j = jnp.arange(K, dtype=jnp.float64)[None, :]
+    # j-th summary value = the floor((j+0.5)*cnt/K)-th smallest of the
+    # group (midpoint rule keeps both tails represented)
+    r = jnp.floor((j + 0.5) * cf / K).astype(jnp.int64)
+    r = jnp.clip(r, 0, jnp.maximum(cnt - 1, 0)[:, None])
+    pos = jnp.clip(starts[:, None] + r, 0, n - 1)
+    vals = xf[order][pos]
+    wts = jnp.floor((j + 1.0) * cf / K) - jnp.floor(j * cf / K)
+    vals = jnp.where(wts > 0, vals, 0.0)  # empty groups gather junk
+    return jnp.concatenate([vals, wts], axis=1)
+
+
+def kll_percentile(state: jnp.ndarray, valid, gid: jnp.ndarray,
+                   n_groups: int, p: float, K: int) -> tuple:
+    """Final aggregate over partial KLL states: flatten every state
+    row's (value, weight) pairs, lexsort by (group, value), and read the
+    first value whose within-group cumulative weight reaches the target
+    rank floor(p*(N-1))+1.  Zero-weight entries can never win: their
+    cumulative weight equals the previous positive entry's, which sits
+    earlier in sort order.  Returns (values, nonempty)."""
+    n = state.shape[0]
+    if n == 0:
+        return (jnp.zeros((n_groups,), jnp.float64),
+                jnp.zeros((n_groups,), jnp.bool_))
+    vals, wts = state[:, :K], state[:, K:]
+    ok = jnp.ones((n,), jnp.bool_) if valid is None else valid
+    g_flat = jnp.repeat(jnp.where(ok, gid, n_groups), K)
+    v_flat = vals.reshape(-1)
+    w_flat = jnp.where(ok[:, None], wts, 0.0).reshape(-1)
+    order = jnp.lexsort((v_flat, g_flat))
+    vs, ws, gs = v_flat[order], w_flat[order], g_flat[order]
+    totw = jax.ops.segment_sum(ws, gs, num_segments=n_groups + 1)[:n_groups]
+    offs = jnp.cumsum(totw) - totw            # weight of earlier groups
+    cumw = jnp.cumsum(ws)                     # global prefix (dead group last)
+    g_safe = jnp.minimum(gs, n_groups - 1)
+    t = jnp.clip(jnp.floor(p * jnp.maximum(totw - 1, 0)) + 1.0, 1.0,
+                 jnp.maximum(totw, 1.0))
+    cand = (cumw - offs[g_safe] >= t[g_safe]) & (gs < n_groups)
+    idx = jnp.where(cand, jnp.arange(vs.shape[0]), vs.shape[0])
+    first = jax.ops.segment_min(idx, gs, num_segments=n_groups + 1)[:n_groups]
+    out = vs[jnp.clip(first, 0, vs.shape[0] - 1)]
+    return out, totw > 0
+
+
+def sketch_sample_mask(h: jnp.ndarray) -> jnp.ndarray:
+    """Deterministic 1-in-8 value sample for COUNT/SUM ... WITH ERROR:
+    keep rows whose value hash lands in one of 8 residue classes.  The
+    kept fraction is exactly 1/8 of DISTINCT hash space, so the x8
+    scale-up is an exact power-of-two multiply and every execution mode
+    (single, chunked, sharded) samples the SAME rows — estimates are
+    bit-identical regardless of partitioning."""
+    return (h & jnp.uint64(7)) == jnp.uint64(0)
 
 
 def group_percentile(x: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
@@ -707,13 +836,18 @@ def take_rows(arrays: List[jnp.ndarray], idx: jnp.ndarray,
         # gathering from an EMPTY source (e.g. a zero-row exchange
         # buffer): every index is dead and the caller masks the result —
         # type-correct zeros avoid an out-of-range XLA gather
-        return [jnp.zeros((idx.shape[0],), a.dtype) for a in arrays]
+        return [jnp.zeros((idx.shape[0],) + a.shape[1:], a.dtype)
+                for a in arrays]
     words: List[jnp.ndarray] = []    # u32 columns going into the pack
     spec: List = [None] * len(arrays)  # how to rebuild each output
     out: List = [None] * len(arrays)
     for i, a in enumerate(arrays):
         dt = a.dtype
-        if dt == jnp.bool_:
+        if a.ndim > 1:
+            # matrix-shaped rows (sketch register states): whole-row
+            # gather — the u32 pack is strictly rank-1 per word
+            spec[i] = ("direct", None)
+        elif dt == jnp.bool_:
             spec[i] = ("bool", len(words))
             words.append(a.astype(jnp.uint32))
         elif jnp.issubdtype(dt, jnp.floating) and dt.itemsize == 8:
@@ -732,7 +866,8 @@ def take_rows(arrays: List[jnp.ndarray], idx: jnp.ndarray,
                 a.astype(jnp.int32), jnp.uint32))
     n_src = arrays[0].shape[0] if arrays else 0
     route = G.gather_route(n_src, idx.shape[0], len(words), presorted)
-    if route == "staged" and all(w.ndim == 1 for w in words):
+    if route == "staged" and all(w.ndim == 1 for w in words) \
+            and all(a.ndim == 1 for a in arrays):
         # 2-D words (Int128 limb columns) keep the flat path — the u32
         # matrix pack is rank-1-per-word on both routes
         return _take_rows_staged(arrays, idx, words, spec, presorted)

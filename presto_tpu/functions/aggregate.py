@@ -48,6 +48,14 @@ def resolve(name: str, arg_types: List[T.Type], distinct: bool = False) -> T.Typ
         return T.BIGINT
     if name == "approx_distinct":
         return T.BIGINT
+    if name == "approx_count":
+        # COUNT(x) WITH ERROR (seeded-sample estimate; sql/parser.py)
+        return T.BIGINT
+    if name == "approx_sum":
+        # SUM(x) WITH ERROR: same result type as the exact sum
+        if not arg_types or not arg_types[0].is_numeric:
+            raise TypeError(f"sum over {arg_types or 'no args'}")
+        return _numeric_sum_type(arg_types[0])
     if name == "sum":
         if arg_types[0].name in ("INTERVAL_DAY_TIME",
                                  "INTERVAL_YEAR_MONTH"):
@@ -221,6 +229,7 @@ AGG_NAMES = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "every", "approx_distinct", "corr", "covar_samp",
     "covar_pop", "approx_percentile", "checksum", "min_by", "max_by",
+    "approx_count", "approx_sum",
     "geometric_mean", "array_agg", "map_agg", "multimap_agg",
     "approx_set", "merge", "qdigest_agg", "tdigest_agg",
     "regr_slope", "regr_intercept", "skewness", "kurtosis", "entropy",

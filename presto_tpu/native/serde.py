@@ -43,10 +43,18 @@ def serialize_columns(arrays: Dict[str, np.ndarray], compress: bool = True) -> b
     for name, arr in arrays.items():
         a = np.ascontiguousarray(arr)
         nb = name.encode("utf-8")
-        dt = a.dtype.str.encode("ascii")
+        if a.ndim > 1:
+            # matrix column (sketch state rows): a numpy SUBARRAY dtype
+            # string — "(1024,)|u1" — carries the row shape, so the
+            # reader's np.frombuffer(count=n) returns (n, *shape) and
+            # the frame layout is unchanged
+            shape = ",".join(str(s) for s in a.shape[1:])
+            dt = f"({shape},){a.dtype.str}".encode("ascii")
+        else:
+            dt = a.dtype.str.encode("ascii")
         enc, width, base = ENC_PLAIN, 0, 0
         payload = a.view(np.uint8).reshape(-1).tobytes() if a.size else b""
-        if a.dtype == np.int64 and a.size >= 8:
+        if a.dtype == np.int64 and a.ndim == 1 and a.size >= 8:
             packed = native.delta_pack(a)
             if packed is not None and len(packed[0]) < len(payload) // 2:
                 payload, width, base = packed

@@ -119,6 +119,18 @@ class QueryStats:
     # the cross-process (DCN) fabric — a gang-fused query moves bytes
     # here instead of exchange_bytes_host
     exchange_bytes_dcn: int = 0
+    # sketch lane (ROADMAP 6, docs/PERF.md): bytes of fixed-width
+    # mergeable sketch state (HLL registers / KLL summaries) that moved
+    # over merge edges INSTEAD of a hash repartition of input rows — a
+    # sketch-only aggregate reports 0 repartition exchange bytes and
+    # puts its (tiny) partial-state gather here.  On the fused mesh the
+    # global-HLL edge lowers to one lax.pmax; those payload bytes count
+    # here, not in exchange_bytes_collective.
+    exchange_bytes_sketch: int = 0
+    # opt-in approximation rewrites (plan/optimizer.py behind session
+    # prefer_approx_distinct): count(DISTINCT x) calls replaced with
+    # approx_distinct(x) in this query's plan
+    approx_rewrites: int = 0
     # fusion economics (plan/fusion_cost.py): per-edge fuse-vs-cut
     # verdicts of the cost model — exchange edges spliced into a fused
     # program (== fragments_fused), edges kept on the HTTP path, edges

@@ -320,6 +320,14 @@ class ExchangeInput:
     # carries them here so the fusion cost model prices real volumes)
     est_rows: Optional[int] = None
     est_bytes: Optional[int] = None
+    # sketch-state edge (plan/distribute stamps Exchange.sketch_only):
+    # fixed-width mergeable rows — fusion_cost prices it on the
+    # near-zero sketch lane so the fold fuses by default
+    sketch: bool = False
+    # "pmax" on global all-$hll_partial gather edges: the fused splice
+    # restores it onto the inline Exchange so the merge lowers to ONE
+    # lax.pmax collective (parallel/dist_executor._exec_exchange)
+    sketch_merge: str = ""
 
 
 @dataclasses.dataclass
@@ -357,7 +365,9 @@ def cut_fragments(root) -> List[Fragment]:
                 inputs.append(ExchangeInput(
                     eid, n.kind, list(n.keys), pf,
                     est_rows=getattr(n, "est_rows_hint", None),
-                    est_bytes=getattr(n, "est_bytes_hint", None)))
+                    est_bytes=getattr(n, "est_bytes_hint", None),
+                    sketch=bool(getattr(n, "sketch_only", False)),
+                    sketch_merge=str(getattr(n, "sketch_merge", ""))))
                 types = dict(n.outputs())
                 return P.TableScan(f"__exch_{eid}",
                                    {s: s for s in types}, types)
@@ -1159,6 +1169,8 @@ class _ClusterExecutor:
                     int(self.spec.properties.get("fragments_fused") or 0))
         self._count("exchange_bytes_collective",
                     int(counters.get("exchange_bytes_collective", 0)))
+        self._count("exchange_bytes_sketch",
+                    int(counters.get("exchange_bytes_sketch", 0)))
         for k in ("xla_flops", "xla_bytes_accessed"):
             if counters.get(k):  # EXPLAIN ANALYZE cost attribution
                 self.counters[k] = int(counters[k])
@@ -1622,6 +1634,7 @@ class WorkerServer:
                          "tasks_fused": 0, "fragments_fused": 0,
                          "exchange_bytes_host": 0,
                          "exchange_bytes_collective": 0,
+                         "exchange_bytes_sketch": 0,
                          # multi-host lane: trace-time bytes the fused
                          # program moved over the cross-process (DCN)
                          # fabric, and gang barrier rendezvous served
@@ -2651,7 +2664,7 @@ class ClusterSession:
             mon.stats.fusion_skips[k] = \
                 mon.stats.fusion_skips.get(k, 0) + int(v)
         for k in ("exchange_bytes_host", "exchange_bytes_collective",
-                  "exchange_bytes_dcn"):
+                  "exchange_bytes_sketch", "exchange_bytes_dcn"):
             setattr(mon.stats, k, getattr(mon.stats, k, 0)
                     + int(self._coord_counters.get(k, 0)))
         # adaptive aggregation: per-task flip decisions + strategy

@@ -114,8 +114,27 @@ class DistExecutor(Executor):
 
     def _exec_exchange(self, node: P.Exchange) -> Batch:
         b = self.exec_node(node.source)
+        if node.kind == "gather" and \
+                getattr(node, "sketch_merge", "") == "pmax":
+            # sketch-state merge: HLL union is elementwise max over
+            # aligned register rows, so this gather collapses to ONE
+            # psum-shaped collective (lax.pmax) — the edge moves only
+            # the fixed-width state, never repartitioned rows.  Only
+            # stamped for global all-$hll_partial edges (grouped states
+            # order their group slots data-dependently per shard; KLL
+            # merges by sort, not max) — see plan/distribute.py.
+            self._count("exchange_bytes_sketch", self._exchange_bytes(b))
+            cols = {s: Column(jax.lax.pmax(c.data, AXIS), c.valid,
+                              c.type, c.dictionary)
+                    for s, c in b.columns.items()}
+            return Batch(cols, b.sel)
         if node.kind != "scatter":  # scatter is a sel mask: no transfer
-            self._count("exchange_bytes_collective",
+            # sketch-only edges (grouped HLL / KLL state gathers) still
+            # lower to all_gather but carry fixed-width state, never
+            # repartitioned input rows — ledgered on the sketch lane
+            self._count("exchange_bytes_sketch"
+                        if getattr(node, "sketch_only", False)
+                        else "exchange_bytes_collective",
                         self._exchange_bytes(b))
         if node.kind in ("gather", "broadcast"):
             return EX.all_gather_batch(b, AXIS)

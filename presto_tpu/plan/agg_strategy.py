@@ -62,6 +62,22 @@ _KILL_ENV = "PRESTO_TPU_ADAPTIVE_AGG"
 ONE_PASS = "one_pass"
 FINAL_ONLY = "final_only"
 TWO_PHASE = "two_phase"
+SKETCH = "sketch"
+
+# sketch aggregate family: fixed-width mergeable device states (HLL
+# registers / KLL summaries / deterministic samples).  Their partials
+# NEVER overflow — the state is O(1) per group regardless of input
+# cardinality — so the bypass/hysteresis economics above do not apply:
+# a sketch partial is ALWAYS worth keeping, and distribution never cuts
+# a hash-repartition edge for a sketch-only aggregate (the merge is one
+# elementwise collective over registers, see plan/distribute.py).
+SKETCH_FNS = frozenset({"approx_distinct", "approx_percentile",
+                        "approx_count", "approx_sum"})
+
+
+def sketch_fns(node: P.Aggregate) -> frozenset:
+    """The sketch-family fns this Aggregate uses (empty when none)."""
+    return frozenset(a.fn for a in node.aggs.values()) & SKETCH_FNS
 
 # hysteresis constants (module-level, not session knobs: the knob that
 # matters — the reduction threshold — is partial_agg_min_reduction;
@@ -105,6 +121,13 @@ def choose(node: P.Aggregate, session) -> str:
     estimates.  Presorted wins unconditionally; a confidently-small NDV
     with real reduction routes final-only; everything else keeps
     two-phase with the runtime bypass armed."""
+    if sketch_fns(node):
+        # fixed-width mergeable states: the partial stage never
+        # overflows and never loses, regardless of NDV — keep it
+        # unconditionally and keep the capacity check out of the way
+        # (a FINAL_ONLY stamp would route the hash-repartition edge the
+        # sketch exists to delete)
+        return SKETCH
     if getattr(node, "ordering_hint", None) is not None \
             and getattr(node, "ordering_hint_safe", False):
         # run-boundary one-pass grouping: no sort, no partial stage

@@ -17,6 +17,7 @@ Distribution lattice per node:
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -94,6 +95,18 @@ _MERGEABLE = {"count", "count_if", "sum", "min", "max", "avg",
               "stddev", "stddev_samp", "stddev_pop",
               "variance", "var_samp", "var_pop",
               "min_by", "max_by", "checksum"}
+
+
+def _sketch_mergeable(a: ir.AggCall) -> bool:
+    """True when this sketch-family aggregate decomposes into a
+    fixed-width device state (plan/agg_strategy.SKETCH_FNS).  The
+    array-of-percentiles / weighted approx_percentile overloads have no
+    fixed-shape state and keep the single-phase repartition route."""
+    if a.distinct:
+        return False
+    if a.fn == "approx_percentile":
+        return len(a.args) == 2 and a.type.name != "ARRAY"
+    return a.fn in ("approx_distinct", "approx_count", "approx_sum")
 
 
 class Distributer:
@@ -211,13 +224,6 @@ class Distributer:
 
     # ---- aggregation --------------------------------------------------
     def _visit_aggregate(self, node: P.Aggregate):
-        if node.aggs and all(a.fn == "approx_distinct" and not a.distinct
-                             for a in node.aggs.values()):
-            # HLL partial/final merge (reference:
-            # ApproximateCountDistinctAggregation merging airlift HLL
-            # state): rewrite into standard mergeable aggregates over
-            # per-row (register, rho) columns, then distribute THAT
-            return self.visit(self._rewrite_approx_distinct(node))
         src, dist = self.visit(node.source)
         node.source = src
         if dist.kind == "replicated":
@@ -227,8 +233,15 @@ class Distributer:
             # co-located: every group entirely on one shard
             return node, Dist("hashed", dist.keys)
         has_distinct = any(a.distinct for a in node.aggs.values())
-        mergeable = all(a.fn in _MERGEABLE and not a.distinct
+        mergeable = all((a.fn in _MERGEABLE or _sketch_mergeable(a))
+                        and not a.distinct
                         for a in node.aggs.values())
+        # sketch aggregates (HLL registers / KLL summaries / seeded
+        # samples): the partial state is fixed-width per group no matter
+        # the input cardinality, so a hash repartition is NEVER cut for
+        # them — the partial/final split's gather edge merges states
+        # with one elementwise fold (lax.pmax on the fused mesh lane)
+        has_sketch = any(_sketch_mergeable(a) for a in node.aggs.values())
         cap = getattr(node, "capacity_hint", None)
         small = cap is not None and cap <= self.partial_agg_groups
         # aggregation strategy (plan/agg_strategy.py): a final_only
@@ -269,7 +282,8 @@ class Distributer:
                             and not has_distinct
                             and strategy in (AS.TWO_PHASE, AS.ONE_PASS))
         if node.group_keys and (has_distinct or not mergeable
-                                or (not small and not adaptive_chunked)
+                                or (not small and not adaptive_chunked
+                                    and not has_sketch)
                                 or final_only):
             # repartition rows so each group lands wholly on one shard,
             # then aggregate locally in a single phase (handles DISTINCT
@@ -282,107 +296,6 @@ class Distributer:
                 f"global aggregate with non-mergeable fns "
                 f"{[a.fn for a in node.aggs.values()]}")
         return self._split_partial_final(node, src)
-
-    def _rewrite_approx_distinct(self, node: P.Aggregate) -> P.PlanNode:
-        """approx_distinct(x) GROUP BY K becomes (m = 1024 registers):
-
-            Agg(K, est-inputs) over Agg(K + [reg], M := max(rho)) over
-            Project(reg := $hll_reg(x), rho := $hll_rho(x))
-
-        followed by a Project computing the bias-corrected HLL estimate
-        with small-range linear counting — every aggregate in the tree
-        is mergeable, so the existing partial/final machinery
-        distributes it."""
-        from presto_tpu.functions.scalar import HLL_M as m
-
-        src = node.source
-        src_types = dict(src.outputs())
-        keys = list(node.group_keys)
-        proj = {k: ir.Ref(k, src_types[k]) for k in keys}
-        inner_aggs = {}
-        per_sym = {}
-        for sym, a in node.aggs.items():
-            reg_s = self.fresh(sym + "_reg")
-            rho_s = self.fresh(sym + "_rho")
-            arg = a.args[0]
-            proj[reg_s] = ir.Call("$hll_reg", (arg,), T.BIGINT)
-            proj[rho_s] = ir.Call("$hll_rho", (arg,), T.DOUBLE)
-            M_s = self.fresh(sym + "_M")
-            inner_aggs[M_s] = ir.AggCall("max", (ir.Ref(rho_s, T.DOUBLE),),
-                                         T.DOUBLE, False, a.filter)
-            per_sym[sym] = (reg_s, M_s)
-        # one shared register column keyes the inner grouping; with
-        # several approx_distincts we need one inner agg per register
-        # column, so keep it simple: one rewrite handles ONE register
-        # grouping — multiple aggs share x's register column only if the
-        # args match; otherwise group by all reg columns (registers of
-        # different args are independent, the cross product is bounded
-        # by m^k which is fine for the typical k=1)
-        reg_cols = list(dict.fromkeys(r for r, _ in per_sym.values()))
-        inner = P.Aggregate(P.Project(src, proj), keys + reg_cols,
-                            inner_aggs, "SINGLE")
-        mid_types = dict(inner.outputs())
-        mid = {k: ir.Ref(k, mid_types[k]) for k in keys}
-        outer_aggs = {}
-        est_inputs = {}
-        for sym, (reg_s, M_s) in per_sym.items():
-            Mref = ir.Ref(M_s, T.DOUBLE)
-            pw_s = self.fresh(sym + "_pw")
-            z_s = self.fresh(sym + "_z")
-            mid[pw_s] = ir.Call("power", (ir.Lit(2.0, T.DOUBLE),
-                                          ir.Call("neg", (Mref,), T.DOUBLE)), T.DOUBLE)
-            mid[z_s] = ir.Call("gt", (Mref, ir.Lit(0.0, T.DOUBLE)),
-                               T.BOOLEAN)
-            s_s = self.fresh(sym + "_s")
-            c_s = self.fresh(sym + "_c")
-            nz_s = self.fresh(sym + "_nz")
-            outer_aggs[s_s] = ir.AggCall("sum", (ir.Ref(pw_s, T.DOUBLE),),
-                                         T.DOUBLE)
-            outer_aggs[c_s] = ir.AggCall("count", (ir.Ref(pw_s, T.DOUBLE),),
-                                         T.BIGINT)
-            outer_aggs[nz_s] = ir.AggCall("count_if",
-                                          (ir.Ref(z_s, T.BOOLEAN),),
-                                          T.BIGINT)
-            est_inputs[sym] = (s_s, c_s, nz_s)
-        outer = P.Aggregate(P.Project(inner, mid), keys, outer_aggs,
-                            "SINGLE")
-        outer.capacity_hint = getattr(node, "capacity_hint", None)
-        outer.key_stats = getattr(node, "key_stats", {})
-        out_types = dict(outer.outputs())
-        final_proj = {k: ir.Ref(k, out_types[k]) for k in keys}
-        alpha = 0.7213 / (1.0 + 1.079 / m)
-        for sym, (s_s, c_s, nz_s) in est_inputs.items():
-            S = ir.Ref(s_s, T.DOUBLE)
-            C = ir.Ref(c_s, T.BIGINT)
-            NZ = ir.Ref(nz_s, T.BIGINT)
-
-            def D(fn, *args):
-                return ir.Call(fn, tuple(args), T.DOUBLE)
-
-            # empty registers contribute 2^0 each: denom = S + (m - C)
-            denom = D("add", S, D("sub", ir.Lit(float(m), T.DOUBLE),
-                                  ir.CastExpr(C, T.DOUBLE)))
-            E = D("div", ir.Lit(alpha * m * m, T.DOUBLE), denom)
-            zeros = D("sub", ir.Lit(float(m), T.DOUBLE),
-                      ir.CastExpr(NZ, T.DOUBLE))
-            linear = D("mul", ir.Lit(float(m), T.DOUBLE),
-                       D("ln", D("div", ir.Lit(float(m), T.DOUBLE),
-                                 ir.Call("greatest",
-                                         (zeros, ir.Lit(1.0, T.DOUBLE)),
-                                         T.DOUBLE))))
-            cond = ir.Call(
-                "and", (ir.Call("le", (E, ir.Lit(2.5 * m, T.DOUBLE)),
-                                T.BOOLEAN),
-                        ir.Call("gt", (zeros, ir.Lit(0.0, T.DOUBLE)),
-                                T.BOOLEAN)), T.BOOLEAN)
-            est = ir.Call("if", (cond, linear, E), T.DOUBLE)
-            # all-NULL / fully-filtered groups: S is NULL -> the whole
-            # expression is NULL; the single-device kernel returns 0
-            final_proj[sym] = ir.Call(
-                "coalesce",
-                (ir.CastExpr(ir.Call("round", (est,), T.DOUBLE), T.BIGINT),
-                 ir.Lit(0, T.BIGINT)), T.BIGINT)
-        return P.Project(outer, final_proj)
 
     def decompose_aggs(self, aggs):
         """(partial_aggs, final_aggs) for a mergeable aggregate map, or
@@ -412,12 +325,26 @@ class Distributer:
             # the partial with it — the partial's source IS the node's
             # source, so the claims (still guard-verified) transfer.
             s = getattr(node, "agg_strategy", None)
-            partial.agg_strategy = s if s == AS.ONE_PASS else AS.TWO_PHASE
+            partial.agg_strategy = s if s in (AS.ONE_PASS, AS.SKETCH) \
+                else AS.TWO_PHASE
             for h in ("ordering_hint", "ordering_pack_order",
                       "ordering_hint_safe", "input_est_hint"):
                 if hasattr(node, h):
                     setattr(partial, h, getattr(node, h))
         gathered = P.Exchange(partial, "gather")
+        if any(_sketch_mergeable(a) for a in node.aggs.values()):
+            # sketch-state edge: fixed-width mergeable rows.  Stamped so
+            # fusion_cost prices it on the near-zero sketch lane and
+            # cluster fragment cutting knows no repartition was needed.
+            gathered.sketch_only = True
+            if not node.group_keys and all(
+                    a.fn == "$hll_partial" for a in partial_aggs.values()):
+                # global HLL merge IS elementwise max over aligned
+                # register rows: the fused mesh lane lowers this gather
+                # to ONE lax.pmax collective (grouped states shard their
+                # group slots data-dependently, so anything grouped —
+                # and KLL's sort-merge — stays on all_gather + re-group)
+                gathered.sketch_merge = "pmax"
         final = P.Aggregate(gathered, list(node.group_keys), final_aggs, "FINAL")
         final.capacity_hint = getattr(node, "capacity_hint", None)
         final.key_stats = getattr(node, "key_stats", {})
@@ -470,10 +397,50 @@ class Distributer:
                 partial_aggs[p] = a
                 final_aggs[sym] = ir.AggCall("sum", (ir.Ref(p, T.BIGINT),),
                                              T.BIGINT)
-            elif fn in ("approx_distinct", "approx_percentile",
-                        "geometric_mean", "corr", "covar_samp", "covar_pop"):
-                # sketch-merge across shards not implemented yet ->
-                # single-device execution stays correct
+            elif fn == "approx_distinct":
+                # partial = (n_groups, m) HLL register rows; final folds
+                # rows with elementwise max and estimates (exec/kernels
+                # hll_partial / hll_merge_estimate) — estimates match
+                # the single-pass kernel bit-for-bit at equal m
+                from presto_tpu.exec.kernels import hll_m_for_error
+
+                m = 1024
+                if len(a.args) >= 2 and isinstance(a.args[1], ir.Lit) \
+                        and a.args[1].value is not None:
+                    m = hll_m_for_error(float(a.args[1].value))
+                st = T.hll_state(m)
+                p = self.fresh(sym)
+                partial_aggs[p] = ir.AggCall("$hll_partial", (a.args[0],),
+                                             st, False, a.filter)
+                final_aggs[sym] = ir.AggCall("$hll_est",
+                                             (ir.Ref(p, st),), T.BIGINT)
+            elif fn == "approx_percentile" and _sketch_mergeable(a):
+                # partial = (n_groups, 2K) quantile summary rows; the
+                # percentile fraction literal rides the FINAL call.  K
+                # sizes rank error ~1/K per merge level (session knob
+                # approx_percentile_accuracy, default 0.01 -> K=200)
+                acc = float(self.session.properties.get(
+                    "approx_percentile_accuracy", 0.01))
+                kk = max(16, int(math.ceil(2.0 / max(acc, 1e-6))))
+                st = T.kll_state(2 * kk)
+                p = self.fresh(sym)
+                partial_aggs[p] = ir.AggCall("$kll_partial", (a.args[0],),
+                                             st, False, a.filter)
+                final_aggs[sym] = ir.AggCall(
+                    "$kll_pct", (ir.Ref(p, st), a.args[1]), a.type)
+            elif fn in ("approx_count", "approx_sum"):
+                # the seeded sample is value-hash-determined, so the fn
+                # is its own partial and the final just sums partials
+                p = self.fresh(sym)
+                partial_aggs[p] = a
+                final_aggs[sym] = ir.AggCall(
+                    "merge_count" if fn == "approx_count" else "sum",
+                    (ir.Ref(p, a.type),), a.type)
+            elif fn in ("approx_percentile", "geometric_mean", "corr",
+                        "covar_samp", "covar_pop"):
+                # array/weighted percentile forms and moment aggregates:
+                # no fixed-shape partial state -> single-device
+                # execution stays correct
                 raise Undistributable(f"aggregate {fn}")
             elif fn in ("stddev", "stddev_samp", "stddev_pop", "variance",
                         "var_samp", "var_pop"):
@@ -827,6 +794,12 @@ def fuse_fragments(fragments: list, verdict) -> Tuple[list, int]:
                                 list(inp.keys))
                 if inp.kind == "range":
                     ex.sort_keys = list(okeys_of[eid])
+                if getattr(inp, "sketch", False):
+                    # restore the sketch-edge stamps cut_fragments
+                    # carried: the inline gather keeps its pmax lowering
+                    ex.sketch_only = True
+                    if getattr(inp, "sketch_merge", ""):
+                        ex.sketch_merge = inp.sketch_merge
                 absorbed.add(inp.producer)
                 taken.extend([inp.producer]
                              + absorbed_into.get(inp.producer, []))

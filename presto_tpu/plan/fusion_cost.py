@@ -93,6 +93,14 @@ DEFAULT_PROFILES: Dict[str, dict] = {
         "dispatch_ms": 9.0,
         "serial_ms": 160.0,
         "serial_free": 5,
+        # sketch-state edges (plan/distribute stamps Exchange.sketch
+        # _only): a fixed-width register fold (lax.pmax / tiny
+        # all_gather), priced near-zero so the cost model fuses it by
+        # default — the whole point of the sketch is deleting the
+        # repartition; the tools/roofline.py `sketch` sweep anchors the
+        # per-MB rate (the state is <= m bytes/group regardless of rows)
+        "sketch_edge_ms": 0.05,
+        "sketch_ms_per_mb": 0.5,
     },
     # the CROSS-HOST lane measured on the CI box (tools/roofline.py
     # --calibrate --multiproc: 2- and 4-process gloo loopback meshes,
@@ -115,6 +123,8 @@ DEFAULT_PROFILES: Dict[str, dict] = {
         "dispatch_ms": 9.0,
         "serial_ms": 160.0,
         "serial_free": 5,
+        "sketch_edge_ms": 0.05,
+        "sketch_ms_per_mb": 0.5,
     },
     "tpu": {
         "platform": "tpu",
@@ -131,6 +141,10 @@ DEFAULT_PROFILES: Dict[str, dict] = {
         "dispatch_ms": 6.0,
         "serial_ms": 2.0,           # XLA overlaps collectives on-chip
         "serial_free": 8,
+        # on chip the register fold rides the same ~40GB/s ICI as the
+        # coll lane but skips the variable-shape exchange machinery
+        "sketch_edge_ms": 0.03,
+        "sketch_ms_per_mb": 0.03,
     },
 }
 
@@ -153,6 +167,8 @@ class FusionProfile:
     dispatch_ms: float = 9.0         # per-fragment task overhead (cut)
     serial_ms: float = 160.0         # per extra group member past free
     serial_free: int = 5
+    sketch_edge_ms: float = 0.05     # fixed-width sketch-fold launch
+    sketch_ms_per_mb: float = 0.5    # marginal sketch-state cost per MB
 
     def _nd(self, table: Dict[int, float], ndev: int,
             default: float) -> float:
@@ -185,6 +201,14 @@ class FusionProfile:
         return (self._nd(self.coll_edge_ms, ndev, 1.0)
                 + nbytes / 1e6 * self._nd(self.coll_ms_per_mb, ndev, 8.0))
 
+    def sketch_ms(self, nbytes: int) -> float:
+        """Price of a sketch-state edge fused: the fixed-width register
+        fold (one elementwise collective / tiny gather).  Near-zero and
+        independent of the input cardinality that produced the state —
+        the lane exists so the model fuses sketch edges by default
+        instead of pricing them like a variable-shape exchange."""
+        return self.sketch_edge_ms + nbytes / 1e6 * self.sketch_ms_per_mb
+
     def serial_penalty_ms(self, group: int) -> float:
         """Group-size serialization potential: a fused program of
         `group` fragments pays serial_ms for every member past
@@ -208,6 +232,8 @@ def _profile_from_dict(d: dict) -> FusionProfile:
         dispatch_ms=float(d.get("dispatch_ms", 9.0)),
         serial_ms=float(d.get("serial_ms", 160.0)),
         serial_free=int(d.get("serial_free", 5)),
+        sketch_edge_ms=float(d.get("sketch_edge_ms", 0.05)),
+        sketch_ms_per_mb=float(d.get("sketch_ms_per_mb", 0.5)),
     )
 
 
@@ -321,7 +347,12 @@ def _row_bytes(outputs) -> int:
     string estimate for varchars, two limbs for long decimals."""
     w = 0
     for _sym, t in outputs:
-        if getattr(t, "is_string", False):
+        name = getattr(t, "name", "")
+        if name == "HLL_STATE":
+            w += int(t.params[0]) + 1  # m uint8 registers per group row
+        elif name == "KLL_STATE":
+            w += int(t.params[0]) * 8 + 1  # 2K float64s per group row
+        elif getattr(t, "is_string", False):
             w += 4 + 16 + 1  # i32 code + amortized dictionary entry
         elif getattr(t, "is_long_decimal", False):
             w += 16 + 1  # two Int128 limbs
@@ -430,17 +461,24 @@ def price_edges(fragments, ndev: int, profile: FusionProfile,
             pen = (profile.serial_penalty_ms(merged)
                    - profile.serial_penalty_ms(gsize[rc])
                    - profile.serial_penalty_ms(gsize[rp]))
-            fused = profile.fused_base_ms(nb, ndev, nproc) + pen
+            if getattr(inp, "sketch", False):
+                # sketch-state edge: a fixed-width register fold, priced
+                # on the near-zero sketch lane so it fuses by default
+                fused = profile.sketch_ms(nb) + pen
+                elane = "sketch"
+            else:
+                fused = profile.fused_base_ms(nb, ndev, nproc) + pen
+                elane = lane
             if fused < cut:
                 parent[rp] = rc
                 gsize[rc] = merged
                 out.append(EdgeDecision(
                     inp.eid, inp.kind, frag.fid, inp.producer, nb,
-                    round(cut, 3), round(fused, 3), True, "", lane))
+                    round(cut, 3), round(fused, 3), True, "", elane))
             else:
                 out.append(EdgeDecision(
                     inp.eid, inp.kind, frag.fid, inp.producer, nb,
-                    round(cut, 3), round(fused, 3), False, "cost", lane))
+                    round(cut, 3), round(fused, 3), False, "cost", elane))
     return out
 
 

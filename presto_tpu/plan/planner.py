@@ -1069,9 +1069,15 @@ class Planner:
                 agg_map[id(fc)] = (s, st)
                 continue
             arg_refs = []
-            for a in fc.args:
+            for i, a in enumerate(fc.args):
                 ae = self.analyze(a, scope)
-                if isinstance(ae, ir.Ref):
+                if isinstance(ae, ir.Ref) or (i > 0 and isinstance(ae, ir.Lit)):
+                    # parameter-position literals (percentile fraction,
+                    # approx_distinct max error, min_by n) stay literal:
+                    # the distributed partial/final split needs their
+                    # VALUES at plan time (sketch register/summary widths
+                    # are static shapes), and a projected aggarg column
+                    # would not survive to the FINAL aggregate's input
                     arg_refs.append(ae)
                 else:
                     s2 = self.symbols.new("aggarg")

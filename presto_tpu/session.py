@@ -69,6 +69,14 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     "adaptive_partial_agg": True,
     "partial_agg_min_reduction": 1.3,
     "agg_final_only_max_groups": 4096,
+    # sketch aggregates (exec/kernels.py HLL/KLL, docs/PERF.md):
+    # prefer_approx_distinct opts the planner into rewriting
+    # count(DISTINCT x) -> approx_distinct(x) (~3.25% std error at the
+    # default 1024 registers; counted in QueryStats.approx_rewrites).
+    # approx_percentile_accuracy sizes the mergeable quantile summary —
+    # rank error ~accuracy, state width 2*ceil(2/accuracy) f64 per group.
+    "prefer_approx_distinct": False,
+    "approx_percentile_accuracy": 0.01,
     # per-plan-node stats collection in dynamic mode (forced by EXPLAIN
     # ANALYZE; costs one host sync per operator — reference: OperationTimer)
     "collect_node_stats": False,

