@@ -533,6 +533,18 @@ def serve_gate_summary():
             "cores": r03.get("cores"),
             "gate": r03.get("gate"),
             "asof": r03.get("asof")}
+    # round-20 incremental MVs: the committed SERVE_r04 dashboard
+    # record — p99 flat across refresh cut-overs, routed >= 5x faster
+    # than recomputing the view
+    r04 = load_serve_r04()
+    if r04 is not None:
+        out["mv_dashboard"] = {
+            "p99_flat_ratio": r04.get("p99_flat_ratio"),
+            "routed_speedup": r04.get("routed_speedup"),
+            "wrong_results": r04.get("wrong_results"),
+            "refresh_modes": r04.get("refresh_modes"),
+            "gate": r04.get("gate"),
+            "asof": r04.get("asof")}
     return out
 
 
@@ -913,6 +925,315 @@ def _serve_gate(record, committed):
                 f"{SERVE_GATE_P99_RATIO}x committed {prev_dash}ms "
                 f"(box-scaled x{round(1 / scale, 2)})")
     return "pass"
+
+
+# ---------------------------------------------------------------------------
+# round-20 MV-routed dashboard serving (`bench.py --serve [--mv]`): a
+# dashboard query stream served from a materialized view while a
+# background loop ingests batches and REFRESHes the view — the
+# incremental-MV record (SERVE_r04.json)
+# ---------------------------------------------------------------------------
+
+SERVE_R04_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "SERVE_r04.json")
+
+# churn p99 <= this multiple of steady p99 — enforced when the box has
+# a second core for the co-located refresh compute (on a 1-core box the
+# warm ~45ms delta refresh steals the ONLY serving core, a physical
+# limit no engine dodges; the ratio is still measured and committed
+# there, the same core-aware enforcement rule FLEET_GATE_QPS_SCALING
+# uses)
+MV_GATE_P99_FLAT = 1.3
+MV_GATE_ROUTED_SPEEDUP = 5.0  # routed read vs full view recompute
+
+
+def load_serve_r04():
+    try:
+        with open(SERVE_R04_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def mv_serve_bench():
+    """MV-routed dashboard serving under refresh churn (`bench.py
+    --serve --mv`; a plain `--serve` run appends this phase): N client
+    sessions hammer the dashboard rollup over HTTP while an ingest
+    loop appends batches to the source and REFRESHes the materialized
+    view through the same protocol front door.  The result cache is
+    OFF for this phase so every response is an actual routed read —
+    otherwise the steady leg would be pure cache hits and the
+    p99-flatness ratio would compare a memcpy against an MV scan.
+
+    Every response is verified against the workload's arithmetic
+    invariant: batch b appends `rep` rows of value b to EVERY group,
+    so any consistent snapshot after k batches reads count = k*rep and
+    sum = rep*k*(k-1)/2 in every group, and approx_distinct(v) ~= k.
+    A response mixing files from two snapshots cannot satisfy it, so
+    `wrong_results` counts cut-over isolation violations, not just
+    transport errors.  A final routed-vs-recompute leg times the
+    identical dashboard text with MV routing on (rollup read) and off
+    (full view recompute over the grown source) and asserts the two
+    row sets are IDENTICAL — exact aggregates and sketch estimates
+    both — before recording the O(history) -> O(rollup) speedup.
+    Emits SERVE_r04.json with the box-fingerprint-scaled gate."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    import presto_tpu
+    from presto_tpu.client import StatementClient
+    from presto_tpu.server import PrestoTpuServer
+
+    n_groups = int(os.environ.get("BENCH_MV_GROUPS", "64"))
+    rep = int(os.environ.get("BENCH_MV_REP", "512"))
+    seed_batches = int(os.environ.get("BENCH_MV_SEED", "6"))
+    refreshes = int(os.environ.get("BENCH_MV_REFRESHES", "6"))
+    n_sessions = int(os.environ.get("BENCH_MV_SESSIONS", "4"))
+    steady_q = int(os.environ.get("BENCH_MV_STEADY_QUERIES", "30"))
+    compare_iters = int(os.environ.get("BENCH_MV_COMPARE", "7"))
+
+    session = presto_tpu.connect()
+    session.set("result_cache_enabled", False)
+    srv = PrestoTpuServer(session).start()
+    session.sql("CREATE TABLE events (g BIGINT, v BIGINT)")
+    tbl = session.catalog.get("events")
+
+    def ingest(b):
+        tbl.append({
+            "g": np.repeat(np.arange(n_groups, dtype=np.int64), rep),
+            "v": np.full(n_groups * rep, b, dtype=np.int64)})
+
+    for b in range(seed_batches):
+        ingest(b)
+
+    dash = ("SELECT g, count(*) AS c, sum(v) AS s, "
+            "approx_distinct(v) AS ad FROM events GROUP BY g")
+    session.sql("CREATE MATERIALIZED VIEW mv_events "
+                f"WITH (connector='memory') AS {dash}")
+    # prewarm the delta-refresh path out of the timed loop (first
+    # refresh compiles the delta query, ~600ms; warm refreshes ~45ms —
+    # same prewarm policy as serve_bench's client classes)
+    ingest(seed_batches)
+    session.sql("REFRESH MATERIALIZED VIEW mv_events")
+    warm_batches = seed_batches + 1
+
+    def run_one(sql):
+        return list(StatementClient(srv.uri, sql).rows())
+
+    failures = []
+    wrong = []
+    unrouted = 0
+
+    def check(rows):
+        if len(rows) != n_groups \
+                or {r[0] for r in rows} != set(range(n_groups)):
+            return "incomplete group set"
+        counts = {r[1] for r in rows}
+        if len(counts) != 1:
+            return f"torn counts across groups: {sorted(counts)[:4]}"
+        c = counts.pop()
+        if c % rep:
+            return f"count {c} is not a whole number of batches"
+        k = c // rep
+        if not seed_batches <= k <= seed_batches + 1 + refreshes:
+            return f"count {c} outside any published snapshot"
+        want_s = rep * k * (k - 1) // 2
+        for g_, _c, s_, ad_ in rows:
+            if s_ != want_s:
+                return f"group {g_}: sum {s_} != {want_s} at k={k}"
+            if abs(ad_ - k) > max(1, 0.25 * k):
+                return f"group {g_}: approx_distinct {ad_} far from {k}"
+        return None
+
+    # prewarm + route probe: the dashboard text must actually MV-route
+    probe = session.sql(dash)
+    if probe.stats.execution_mode != "mv_routed":
+        unrouted += 1
+    err = check(probe.rows)
+    if err:
+        wrong.append(f"probe: {err}")
+    run_one(dash)
+
+    lat_steady, lat_churn = [], []
+    lat_lock = threading.Lock()
+
+    def wave(lat_list, n_per_session=None, until=None):
+        def go(_sid):
+            i = 0
+            while (until.is_set() is False if until is not None
+                   else i < n_per_session):
+                t0 = time.perf_counter()
+                try:
+                    rows = run_one(dash)
+                except Exception as e:  # noqa: BLE001 — recorded below
+                    failures.append(f"{type(e).__name__}: {e}")
+                    i += 1
+                    continue
+                dt = (time.perf_counter() - t0) * 1000.0
+                bad = check(rows)
+                with lat_lock:
+                    if bad:
+                        wrong.append(bad)
+                    lat_list.append(dt)
+                i += 1
+        ths = [threading.Thread(target=go, args=(sid,))
+               for sid in range(n_sessions)]
+        for t in ths:
+            t.start()
+        return ths
+
+    # steady leg: no ingest, no refresh — the flatness baseline
+    for t in wave(lat_steady, n_per_session=steady_q):
+        t.join()
+
+    # churn leg: clients hammer while the ingest loop appends a batch
+    # and REFRESHes the view.  Refresh runs EMBEDDED (the coordinator's
+    # maintenance path — co-located with serving but never occupying a
+    # client admission slot; the protocol REFRESH head has its own
+    # integration tests), so what this leg measures is the cut-over
+    # itself: whether publishing a new snapshot perturbs in-flight
+    # routed reads.
+    stop = threading.Event()
+    refresh_modes = {}
+    last_refresh = {}
+    ths = wave(lat_churn, until=stop)
+    try:
+        for b in range(warm_batches, warm_batches + refreshes):
+            ingest(b)
+            r = session.sql("REFRESH MATERIALIZED VIEW mv_events")
+            mode = r.rows[0][1]
+            last_refresh = {
+                "mv_delta_splits": r.stats.mv_delta_splits,
+                "mv_source_splits": r.stats.mv_source_splits}
+            refresh_modes[mode] = refresh_modes.get(mode, 0) + 1
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        for t in ths:
+            t.join()
+
+    # routed-vs-recompute: the same text against the same final state
+    def best_ms(n):
+        res, best = None, float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            res = session.sql(dash)
+            best = min(best, (time.perf_counter() - t0) * 1000.0)
+        return res, best
+
+    routed_res, routed_ms = best_ms(compare_iters)
+    if routed_res.stats.execution_mode != "mv_routed":
+        unrouted += 1
+    session.set("materialized_view_routing", False)
+    recompute_res, recompute_ms = best_ms(max(3, compare_iters // 2))
+    session.set("materialized_view_routing", True)
+    if sorted(routed_res.rows) != sorted(recompute_res.rows):
+        wrong.append("routed rows != recompute rows at final state")
+    srv.stop()
+
+    s_sorted = sorted(lat_steady)
+    c_sorted = sorted(lat_churn)
+    p99_steady = _percentile(s_sorted, 0.99)
+    p99_churn = _percentile(c_sorted, 0.99)
+    record = {
+        "metric": "mv_dashboard_p99_flat_across_refresh_cutovers",
+        "platform": jax.devices()[0].platform,
+        "cores": os.cpu_count(),
+        "groups": n_groups,
+        "rows_per_batch": n_groups * rep,
+        "batches": warm_batches + refreshes,
+        "sessions": n_sessions,
+        "queries_steady": len(lat_steady),
+        "queries_churn": len(lat_churn),
+        "refreshes": refreshes,
+        "refresh_modes": refresh_modes,
+        "last_refresh": last_refresh,
+        "failures": len(failures),
+        "failure_samples": failures[:5],
+        "wrong_results": len(wrong),
+        "wrong_samples": wrong[:5],
+        "unrouted": unrouted,
+        "p50_steady_ms": round(_percentile(s_sorted, 0.50) or 0, 1),
+        "p99_steady_ms": round(p99_steady or 0, 1),
+        "p50_churn_ms": round(_percentile(c_sorted, 0.50) or 0, 1),
+        "p99_churn_ms": round(p99_churn or 0, 1),
+        "p99_flat_ratio": round(p99_churn / p99_steady, 2)
+        if p99_steady and p99_churn is not None else None,
+        "routed_ms": round(routed_ms, 2),
+        "recompute_ms": round(recompute_ms, 2),
+        "routed_speedup": round(recompute_ms / routed_ms, 1)
+        if routed_ms else None,
+        "box_sort_ms": _box_speed_ms(),
+        "asof": _today(),
+    }
+    record["gate"] = _mv_serve_gate(record, load_serve_r04())
+    try:
+        with open(SERVE_R04_PATH, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+    except OSError:
+        pass
+    print(json.dumps(record), flush=True)
+    return record
+
+
+def _mv_serve_gate(record, committed):
+    """SERVE_r04's gate: correctness legs are absolute (zero failures,
+    zero invariant violations, every dashboard query actually
+    MV-routed); the p99-flatness and routed-speedup legs are ratios
+    measured WITHIN the run, box-independent by construction; the one
+    absolute leg — churn p99 against the committed record — is scaled
+    through the records' box fingerprints like _serve_gate's."""
+    if record["failures"]:
+        return f"FAIL: {record['failures']} query failures"
+    if record["wrong_results"]:
+        return (f"FAIL: {record['wrong_results']} responses violated "
+                "the snapshot-consistency invariant")
+    if record.get("unrouted"):
+        return (f"FAIL: {record['unrouted']} dashboard probes missed "
+                "the MV route")
+    flat = record.get("p99_flat_ratio")
+    if flat is not None and flat > MV_GATE_P99_FLAT \
+            and (record.get("cores") or 1) >= 2:
+        return (f"FAIL: churn p99 {record['p99_churn_ms']}ms is "
+                f"{flat}x steady p99 {record['p99_steady_ms']}ms "
+                f"(> {MV_GATE_P99_FLAT}x — refresh cut-overs are "
+                "visible to readers)")
+    sp = record.get("routed_speedup")
+    if sp is not None and sp < MV_GATE_ROUTED_SPEEDUP:
+        return (f"FAIL: routed read {record['routed_ms']}ms only "
+                f"{sp}x faster than recompute "
+                f"{record['recompute_ms']}ms "
+                f"(< {MV_GATE_ROUTED_SPEEDUP}x)")
+    note = ""
+    if flat is not None and flat > MV_GATE_P99_FLAT:
+        # only reachable on a <2-core box (the >=2-core case FAILed
+        # above): the refresh compute shares the lone serving core
+        note = (f" (1-core box: flatness {flat}x measured, "
+                "not enforced)")
+    if committed is None \
+            or committed.get("platform") != record["platform"]:
+        return "pass (no comparable committed record)" + note
+    prev_box = committed.get("box_sort_ms")
+    cur_box = record.get("box_sort_ms")
+    if not (prev_box and cur_box):
+        return ("pass (committed record has no box fingerprint — "
+                "absolute p99 leg skipped)") + note
+    scale = prev_box / cur_box
+    prev_p99 = committed.get("p99_churn_ms")
+    # the absolute leg shares the flatness leg's core condition: on a
+    # 1-core box churn p99 is scheduler-interleaving noise (observed
+    # 27ms..95ms from the same tree), not an engine signal — there the
+    # within-run ratio legs above carry the gate
+    if prev_p99 and record.get("p99_churn_ms") is not None \
+            and (record.get("cores") or 1) >= 2 \
+            and record["p99_churn_ms"] \
+            > SERVE_GATE_P99_RATIO * prev_p99 / scale:
+        return (f"FAIL: churn p99 {record['p99_churn_ms']}ms > "
+                f"{SERVE_GATE_P99_RATIO}x committed {prev_p99}ms "
+                f"(box-scaled x{round(1 / scale, 2)})")
+    return "pass" + note
 
 
 # ---------------------------------------------------------------------------
@@ -1964,8 +2285,11 @@ if __name__ == "__main__":
     elif "--serve" in sys.argv and "--coordinators" in sys.argv:
         serve_fleet_n = int(sys.argv[sys.argv.index("--coordinators") + 1])
         fleet_serve_bench(serve_fleet_n)
+    elif "--serve" in sys.argv and "--mv" in sys.argv:
+        mv_serve_bench()
     elif "--serve" in sys.argv:
         serve_bench()
+        mv_serve_bench()
     elif "--multichip" in sys.argv:
         multichip_hosts = int(sys.argv[sys.argv.index("--hosts") + 1]) \
             if "--hosts" in sys.argv else 0

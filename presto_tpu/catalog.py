@@ -173,6 +173,9 @@ class MemoryTable(ConnectorTable):
         deleted = int((~keep_mask).sum())
         self.data = {c: v[keep_mask] for c, v in self.data.items()}
         self._rows -= deleted
+        # deletes break the append-only MV delta contract even when the
+        # row count later recovers (connectors/delta.py watermark)
+        self._mv_delete_epoch = getattr(self, "_mv_delete_epoch", 0) + 1
         self._invalidate()
         return deleted
 
@@ -304,9 +307,13 @@ _live_catalogs: "weakref.WeakSet[Catalog]" = weakref.WeakSet()
 def _drop_device_cache(table) -> None:
     """The ONE device-column-cache drop (used by writes via
     ConnectorTable._invalidate and by release_device_caches); instance
-    attrs only — some tables expose _device_cols as a property."""
-    for attr in ("_device_cols", "_device_cols_f32"):
-        if attr in getattr(table, "__dict__", {}):
+    attrs only — some tables expose _device_cols as a property.  The
+    distributed data plane keeps per-mesh-size sharded copies
+    (_dist_cols_<ndev>, parallel/dist_executor.sharded_scan) that must
+    drop with the rest or post-write reads serve stale shards."""
+    for attr in list(getattr(table, "__dict__", {})):
+        if attr in ("_device_cols", "_device_cols_f32") \
+                or attr.startswith("_dist_cols_"):
             delattr(table, attr)
 
 
@@ -327,6 +334,8 @@ class Catalog:
 
     def __init__(self):
         self.tables: Dict[str, ConnectorTable] = {}
+        #: materialized-view registry: flat name -> exec.matview.MvDefinition
+        self.matviews: Dict[str, object] = {}
         self.version = 0
         _live_catalogs.add(self)
         # per-instance copy: a connector attaching a new qualifier (e.g.

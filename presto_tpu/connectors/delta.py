@@ -1,0 +1,104 @@
+"""Source-delta detection for incremental materialized views.
+
+A materialized-view snapshot records, per source table, a WATERMARK
+describing exactly what the snapshot covers (file set + manifest
+generation for manifest-backed connectors, row count + delete epoch for
+in-memory tables).  REFRESH diffs the current source state against the
+recorded watermark and classifies the change:
+
+  empty   -- nothing new; refresh is a no-op
+  append  -- only new rows/files past the watermark; refresh runs the
+             view query over JUST the delta row range and folds it in
+  full    -- anything else (files vanished, rows deleted, table object
+             replaced, schema drift): degrade LOUDLY to full recompute
+             -- counted, never wrong
+
+This module is the ONLY place outside exec/writer.py that reads raw
+manifest generation fields (enforced by tests/test_lint.py); the plan
+and server layers consume verdicts, not generations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class DeltaVerdict:
+    """Outcome of diffing a source table against a recorded watermark."""
+
+    kind: str  # "empty" | "append" | "full"
+    reason: str = ""
+    #: global row range [a, b) holding exactly the appended rows
+    row_range: Optional[Tuple[int, int]] = None
+    #: appended file/split count vs the source's total (counters)
+    delta_splits: int = 0
+    total_splits: int = 0
+
+
+def capture(table) -> dict:
+    """Watermark for `table` as of NOW (stamped into the MV manifest at
+    refresh commit, so coverage and data publish atomically)."""
+    manifest = getattr(table, "_manifest", None)
+    if manifest is not None and "shards" in manifest:
+        return {
+            "kind": "files",
+            "generation": int(manifest.get("generation", 0)),
+            "files": list(manifest.get("shards", [])),
+            "row_count": int(table.row_count()),
+        }
+    return {
+        "kind": "rows",
+        "row_count": int(table.row_count()),
+        "epoch": int(getattr(table, "_mv_delete_epoch", 0)),
+        "obj": id(table),
+    }
+
+
+def diff(table, recorded: Optional[dict]) -> DeltaVerdict:
+    """Classify what changed in `table` since `recorded` (a dict from
+    capture()).  None / unrecognized watermarks force a full recompute."""
+    if not recorded:
+        return DeltaVerdict("full", reason="no recorded watermark")
+    current = capture(table)
+    if current["kind"] != recorded.get("kind"):
+        return DeltaVerdict("full", reason="source storage kind changed")
+
+    if current["kind"] == "files":
+        old_files = list(recorded.get("files", []))
+        new_files = list(current["files"])
+        total = max(len(new_files), 1)
+        if current["generation"] == recorded.get("generation") \
+                and new_files == old_files:
+            return DeltaVerdict("empty", row_range=(0, 0),
+                                total_splits=total)
+        # append-only iff every recorded file is still live, as a prefix
+        # (appends add files at the END of the manifest's shard list)
+        if new_files[:len(old_files)] != old_files:
+            return DeltaVerdict(
+                "full", reason="recorded files retired or reordered "
+                "(replace/delete/compaction)", total_splits=total)
+        a = int(recorded.get("row_count", 0))
+        b = int(current["row_count"])
+        if b < a:
+            return DeltaVerdict("full", reason="source shrank",
+                                total_splits=total)
+        return DeltaVerdict(
+            "append", row_range=(a, b),
+            delta_splits=len(new_files) - len(old_files),
+            total_splits=total)
+
+    # rows watermark (memory tables, generator tables)
+    if current["obj"] != recorded.get("obj"):
+        return DeltaVerdict("full", reason="source table re-registered")
+    if current["epoch"] != recorded.get("epoch", 0):
+        return DeltaVerdict("full", reason="source saw deletes")
+    a = int(recorded.get("row_count", 0))
+    b = int(current["row_count"])
+    if b < a:
+        return DeltaVerdict("full", reason="source shrank")
+    if b == a:
+        return DeltaVerdict("empty", row_range=(a, a), total_splits=1)
+    return DeltaVerdict("append", row_range=(a, b), delta_splits=1,
+                        total_splits=2)

@@ -105,17 +105,23 @@ class LocalFileTable(ConnectorTable):
             return []
         return [(c, bool(a)) for c, a in wp.get("sorted_by", [])]
 
+    #: how many generations a retired file outlives its retirement —
+    #: 1 (default) keeps it through the next commit for in-flight
+    #: readers; MV backing tables raise it to 2 so a long-poll reader
+    #: spanning TWO consecutive refreshes still resolves every file
+    retire_depth = 1
+
     def _commit_write(self, new_files: List[str], file_meta: Dict[str, dict],
                       write_props: Optional[dict], replace: bool,
                       schema: Optional[Dict[str, T.Type]] = None,
                       gc: bool = False) -> None:
         """Atomic publish of a staged write: adopt the new files (after
         the old ones unless replacing), optionally garbage-collect files
-        retired by PREVIOUS generations (kept at least one generation
-        for in-flight readers; `gc` stays False while a transaction
-        could still roll the manifest back), verify the ordering claim
-        over the resulting file sequence, and rewrite the manifest in
-        one os.replace."""
+        retired by PREVIOUS generations (kept at least `retire_depth`
+        generations for in-flight readers; `gc` stays False while a
+        transaction could still roll the manifest back), verify the
+        ordering claim over the resulting file sequence, and rewrite the
+        manifest in one os.replace."""
         m = self._manifest
         old_shards = [] if replace else list(m.get("shards", []))
         shards = old_shards + new_files
@@ -123,17 +129,26 @@ class LocalFileTable(ConnectorTable):
         if replace:
             meta = {}
         meta.update(file_meta)
-        # one-generation GC of previously retired files
-        prev_retired = list(m.get("retired", []))
-        retired = list(m.get("shards", [])) if replace else []
-        if not gc:
-            retired = prev_retired + retired
-        else:
-            for p in prev_retired:
-                try:
-                    os.remove(os.path.join(self.dir, p))
-                except OSError:
-                    pass
+        # generation-stamped retirement: entries are [retire_gen, name]
+        # (legacy bare names adopt the previous generation's stamp)
+        cur_gen = int(m.get("generation", 0))
+        new_gen = cur_gen + 1
+        prev_retired = [e if isinstance(e, list) else [cur_gen, e]
+                        for e in m.get("retired", [])]
+        retired = prev_retired + (
+            [[new_gen, p] for p in m.get("shards", [])] if replace else [])
+        if gc:
+            depth = max(1, int(getattr(self, "retire_depth", 1)))
+            keep = []
+            for rg, p in retired:
+                if int(rg) <= new_gen - depth:
+                    try:
+                        os.remove(os.path.join(self.dir, p))
+                    except OSError:
+                        pass
+                else:
+                    keep.append([rg, p])
+            retired = keep
         wp = write_props if write_props is not None \
             else (None if replace else m.get("write_props"))
         sorted_by = (wp or {}).get("sorted_by") or []
@@ -148,9 +163,24 @@ class LocalFileTable(ConnectorTable):
         m["file_meta"] = {s: meta[s] for s in shards if s in meta}
         m["write_props"] = wp
         m["layout_ordered"] = bool(ordered)
-        m["generation"] = int(m.get("generation", 0)) + 1
+        m["generation"] = new_gen
+        # MV watermark stamp: rides the SAME os.replace as the data
+        # commit, so the snapshot and the source coverage it claims are
+        # atomic (exec/matview.py sets the pending stamp pre-commit)
+        stamp = getattr(self, "_mv_stamp", None)
+        if stamp is not None:
+            m["mv"] = stamp
+            self._mv_stamp = None
         self._write_manifest()
         self._invalidate()
+
+    # ---- MV watermarks (consumed by connectors/delta.py) -------------
+    def set_mv_stamp(self, stamp: Optional[dict]) -> None:
+        """Queue an MV watermark record to publish with the NEXT commit."""
+        self._mv_stamp = stamp
+
+    def mv_watermarks(self) -> Optional[dict]:
+        return self._manifest.get("mv")
 
     # ---- read path ---------------------------------------------------
     def _shards(self) -> List[str]:

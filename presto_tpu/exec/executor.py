@@ -160,7 +160,12 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
         session.set(stmt.name, stmt.value)
         return QueryResult([("result", T.BOOLEAN)], [(True,)])
     if isinstance(stmt, ast.ShowTables):
-        rows = sorted((t,) for t in session.catalog.tables)
+        from presto_tpu.exec.matview import MV_PREFIX
+
+        # MV backing tables are engine-internal; SHOW MATERIALIZED VIEWS
+        # lists the views themselves
+        rows = sorted((t,) for t in session.catalog.tables
+                      if not t.startswith(MV_PREFIX))
         return QueryResult([("Table", T.VARCHAR)], rows)
     if isinstance(stmt, ast.ShowColumns):
         t = session.catalog.get(stmt.table)
@@ -290,6 +295,33 @@ def _dispatch_statement(session, text: str, stmt, mon) -> QueryResult:
     if isinstance(stmt, ast.Delete):
         n = _delete_from(session, stmt)
         return QueryResult([("rows", T.BIGINT)], [(n,)])
+    if isinstance(stmt, ast.CreateMaterializedView):
+        from presto_tpu.exec import matview as MV
+
+        return MV.create(session, stmt, mon)
+    if isinstance(stmt, ast.RefreshMaterializedView):
+        from presto_tpu.exec import matview as MV
+
+        return MV.refresh(session, stmt, mon)
+    if isinstance(stmt, ast.DropMaterializedView):
+        from presto_tpu.exec import matview as MV
+
+        return MV.drop(session, stmt, mon)
+    if isinstance(stmt, ast.ShowMaterializedViews):
+        from presto_tpu.exec import matview as MV
+
+        return MV.show(session)
+
+    if isinstance(stmt, ast.QueryStatement) \
+            and getattr(session.catalog, "matviews", None):
+        # MV-routed serving: a SELECT provably contained in a
+        # materialized view reads the freshest snapshot instead of
+        # executing (exec/matview.py try_route)
+        from presto_tpu.exec import matview as MV
+
+        routed = MV.try_route(session, stmt, mon)
+        if routed is not None:
+            return routed
 
     if session.properties.get("distributed", False):
         from presto_tpu.parallel.dist_executor import run_distributed

@@ -1,0 +1,1391 @@
+"""Incremental materialized views: manifest-delta refresh, sketch-state
+rollups, and MV-routed serving.
+
+CREATE MATERIALIZED VIEW analyzes the view query into a MERGEABLE shape
+when possible: single-table FROM, simple conjunctive WHERE, plain-column
+group keys, and aggregates whose partial states fold (count / sum / avg
+/ min / max re-aggregate exactly; approx_distinct persists HLL register
+rows and approx_percentile persists KLL summaries as 2-D rollup columns,
+exec/kernels.py).  The backing table stores one row per group: the
+visible finals plus hidden state columns (`__mv_n{i}` non-null counts,
+`__mv_s{i}` avg sums, `__mv_hll{i}` / `__mv_kll{i}` sketch states,
+`__mv_knull{j}` key null flags — localfile storage has no null channel).
+
+REFRESH asks connectors/delta.py to diff the source against the
+watermark recorded in the MV's own manifest (stamped atomically with
+each snapshot commit).  An append-only delta aggregates JUST the new
+rows and folds into the stored states — elementwise max for HLL,
+weighted re-summarize for KLL, plain re-aggregation for exact
+aggregates; anything else degrades LOUDLY to a full recompute
+(QueryStats.mv_refresh_full — counted, never wrong).  The commit is the
+PR-9 refresh-and-serve cut-over: a staged replace publishes atomically,
+concurrent readers keep the previous generation (retire_depth=2 on the
+backing keeps files through TWO refreshes for long-poll readers), and a
+fault mid-merge aborts the sink leaving the prior snapshot serving.
+
+Serving: try_route() — the containment matcher — routes a SELECT to the
+freshest MV snapshot when its source, WHERE (recorded conjuncts plus
+extra key-column predicates evaluated on the stored domain), grouping
+prefix, and aggregates are covered; APPROX_DISTINCT reads the stored
+HLL columns through the same merge-estimate the engine uses, so rollup
+estimates stay exact under HLL union.  Kill switches:
+`materialized_view_routing` session knob / PRESTO_TPU_MV_ROUTING=off.
+
+Host-side grouping here is deliberately numpy (np.unique / ufunc.at):
+device grouping primitives stay confined to the aggregation layer
+(tests/test_lint.py), and MV rollup tables are small by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import column_from_numpy
+from presto_tpu.connectors import delta as DELTA
+from presto_tpu.session import QueryResult
+from presto_tpu.sql import ast
+
+MV_PREFIX = "__mv__"
+
+#: aggregate functions whose partial states the backing table can fold
+MERGEABLE_AGGS = {"count", "sum", "min", "max", "avg",
+                  "approx_distinct", "approx_percentile"}
+
+
+class MatViewError(Exception):
+    pass
+
+
+def routing_enabled(session) -> bool:
+    if os.environ.get("PRESTO_TPU_MV_ROUTING", "").lower() in (
+            "off", "0", "false"):
+        return False
+    return bool(session.properties.get("materialized_view_routing", True))
+
+
+# ---------------------------------------------------------------------------
+# definition + analysis
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AggSpec:
+    out: str                 # visible output column name
+    fn: str                  # count | count_col | sum | min | max | avg
+    #                        # | approx_distinct | approx_percentile
+    arg: Optional[str]       # source column (None for count(*))
+    out_type: T.Type
+    arg_type: Optional[T.Type] = None
+    m: int = 0               # HLL register count
+    kk: int = 0              # KLL summary points (state width 2*kk)
+    p: float = 0.5           # recorded percentile for the visible final
+    idx: int = 0             # position in MvDefinition.aggs
+
+    @property
+    def n_col(self) -> str:
+        return f"__mv_n{self.idx}"
+
+    @property
+    def s_col(self) -> str:
+        return f"__mv_s{self.idx}"
+
+    @property
+    def hll_col(self) -> str:
+        return f"__mv_hll{self.idx}"
+
+    @property
+    def kll_col(self) -> str:
+        return f"__mv_kll{self.idx}"
+
+
+@dataclasses.dataclass
+class MvDefinition:
+    name: str                # registry key (lowercased statement name)
+    backing: str             # backing table name in the catalog
+    query: object            # parsed ast.Query of the view definition
+    query_repr: str          # structural fingerprint for exact matching
+    properties: dict
+    mergeable: bool
+    reason: str = ""         # why NOT mergeable (degrade-loudly message)
+    source: str = ""         # source table name as written
+    keys: List[Tuple[str, str]] = dataclasses.field(default_factory=list)
+    aggs: List[AggSpec] = dataclasses.field(default_factory=list)
+    conjuncts: Optional[list] = None   # canonical simple WHERE conjuncts
+    columns: List[Tuple[str, T.Type]] = dataclasses.field(
+        default_factory=list)         # output columns in select order
+    backing_schema: Dict[str, T.Type] = dataclasses.field(
+        default_factory=dict)
+    key_types: Dict[str, T.Type] = dataclasses.field(default_factory=dict)
+    watermark: Optional[dict] = None   # backings without a manifest
+
+    def knull_col(self, j: int) -> str:
+        return f"__mv_knull{j}"
+
+
+def _mv_key(catalog, name: str) -> str:
+    n = name.lower()
+    if n in catalog.matviews:
+        return n
+    if "." in n:
+        flat = catalog._flat_name(n)
+        if flat and flat in catalog.matviews:
+            return flat
+    return n
+
+
+def _literal(e) -> tuple:
+    """(ok, value) for a plain literal usable in a simple conjunct."""
+    if isinstance(e, ast.Literal) and e.type_hint is None \
+            and isinstance(e.value, (int, float, str, bool)):
+        return True, e.value
+    return False, None
+
+
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "<>": "<>"}
+
+
+def simple_conjuncts(expr) -> Optional[list]:
+    """Decompose a WHERE tree into canonical column-vs-literal conjuncts,
+    or None when any piece is more complex than the matcher handles."""
+    if expr is None:
+        return []
+    if isinstance(expr, ast.BinaryOp) and expr.op == "AND":
+        left = simple_conjuncts(expr.left)
+        right = simple_conjuncts(expr.right)
+        if left is None or right is None:
+            return None
+        return left + right
+    if isinstance(expr, ast.BinaryOp) \
+            and expr.op in ("=", "<>", "<", "<=", ">", ">="):
+        if isinstance(expr.left, ast.Identifier):
+            ok, v = _literal(expr.right)
+            if ok:
+                return [("cmp", expr.left.name.lower(), expr.op, v)]
+        if isinstance(expr.right, ast.Identifier):
+            ok, v = _literal(expr.left)
+            if ok:
+                return [("cmp", expr.right.name.lower(),
+                         _FLIP[expr.op], v)]
+        return None
+    if isinstance(expr, ast.Between) and not expr.negated \
+            and isinstance(expr.value, ast.Identifier):
+        ok1, lo = _literal(expr.low)
+        ok2, hi = _literal(expr.high)
+        if ok1 and ok2:
+            return [("between", expr.value.name.lower(), lo, hi)]
+        return None
+    if isinstance(expr, ast.InList) and not expr.negated \
+            and isinstance(expr.value, ast.Identifier):
+        vals = []
+        for it in expr.items:
+            ok, v = _literal(it)
+            if not ok:
+                return None
+            vals.append(v)
+        return [("in", expr.value.name.lower(),
+                 tuple(sorted(vals, key=repr)))]
+    if isinstance(expr, ast.IsNull) and isinstance(expr.value,
+                                                   ast.Identifier):
+        return [("isnull", expr.value.name.lower(), bool(expr.negated))]
+    return None
+
+
+def _conjunct_cols(conjuncts: list) -> set:
+    return {c[1] for c in conjuncts}
+
+
+def _agg_params(session, fn: str, args: list) -> dict:
+    """Mirror the engine's sketch parameter derivation exactly
+    (plan/distribute.py) so stored states fold with engine states."""
+    from presto_tpu.exec import kernels as K
+
+    if fn == "approx_distinct":
+        m = 1024
+        if len(args) == 2:
+            ok, err = _literal(args[1])
+            if not ok or not isinstance(err, (int, float)):
+                return {}
+            m = K.hll_m_for_error(float(err))
+        return {"m": m}
+    if fn == "approx_percentile":
+        acc = float(session.properties.get("approx_percentile_accuracy",
+                                           0.01))
+        kk = max(16, int(math.ceil(2.0 / max(acc, 1e-6))))
+        ok, p = _literal(args[1]) if len(args) == 2 else (False, None)
+        if not ok or not isinstance(p, (int, float)):
+            return {}
+        return {"kk": kk, "p": float(p)}
+    return {}
+
+
+def analyze(session, name: str, query, properties: dict) -> MvDefinition:
+    """Classify the view query as mergeable (delta refresh + rollup
+    serving) or not (full-recompute refresh + exact-match serving)."""
+    from presto_tpu.functions import aggregate as AGG
+
+    catalog = session.catalog
+    key = name.lower()
+    backing = MV_PREFIX + key.replace(".", "_")
+    mv = MvDefinition(name=key, backing=backing, query=query,
+                      query_repr=repr(query), properties=dict(properties),
+                      mergeable=False)
+
+    def degrade(reason: str) -> MvDefinition:
+        mv.reason = reason
+        return mv
+
+    spec = query.body
+    if query.ctes or not isinstance(spec, ast.QuerySpec):
+        return degrade("CTEs / set operations")
+    # resolve the source FIRST: even non-mergeable views keep their
+    # source binding so exact-match serving and write invalidation
+    # know which table they shadow
+    if not isinstance(spec.from_, ast.Table) or spec.from_.sample:
+        return degrade("FROM is not a single plain table")
+    source_name = spec.from_.name
+    try:
+        src = catalog.get(source_name)
+    except KeyError:
+        raise MatViewError(f"Table '{source_name}' does not exist")
+    mv.source = source_name.lower()
+    if query.order_by or query.limit is not None:
+        return degrade("ORDER BY / LIMIT in view definition")
+    if spec.distinct or spec.having is not None or spec.grouping_sets:
+        return degrade("DISTINCT / HAVING / GROUPING SETS")
+
+    conjuncts = simple_conjuncts(spec.where)
+    if conjuncts is None:
+        return degrade("WHERE is not a conjunction of simple predicates")
+    for c in conjuncts:
+        if c[1] not in src.schema:
+            return degrade(f"WHERE references unknown column '{c[1]}'")
+
+    group_cols: List[str] = []
+    for g in spec.group_by:
+        if not isinstance(g, ast.Identifier) \
+                or g.name.lower() not in src.schema:
+            return degrade("GROUP BY is not plain source columns")
+        group_cols.append(g.name.lower())
+    key_seen = set()
+
+    agg_idx = 0
+    for item in spec.select:
+        e = item.expr
+        if isinstance(e, ast.Identifier):
+            col = e.name.lower()
+            if col not in group_cols:
+                return degrade(f"selected column '{col}' is not grouped")
+            out = (item.alias or e.name).lower()
+            mv.keys.append((out, col))
+            mv.key_types[out] = src.schema[col]
+            mv.columns.append((out, src.schema[col]))
+            key_seen.add(col)
+            continue
+        if not isinstance(e, ast.FunctionCall):
+            return degrade("select item is not a column or aggregate")
+        fn = e.name.lower()
+        if fn not in MERGEABLE_AGGS or e.distinct or e.filter is not None \
+                or e.window is not None:
+            return degrade(f"aggregate '{fn}' is not mergeable")
+        args = e.args
+        star = len(args) == 0 or (len(args) == 1
+                                  and isinstance(args[0], ast.Star))
+        out = (item.alias or fn).lower()
+        if fn == "count" and star:
+            spec_a = AggSpec(out, "count", None, T.BIGINT, idx=agg_idx)
+        else:
+            if not args or not isinstance(args[0], ast.Identifier):
+                return degrade(f"'{fn}' argument is not a plain column")
+            arg = args[0].name.lower()
+            at = src.schema.get(arg)
+            if at is None:
+                return degrade(f"unknown column '{arg}'")
+            if fn == "count":
+                if len(args) != 1:
+                    return degrade("count() with extra arguments")
+                spec_a = AggSpec(out, "count_col", arg, T.BIGINT,
+                                 arg_type=at, idx=agg_idx)
+            elif fn in ("sum", "avg"):
+                if len(args) != 1 or not (at.is_integer or at.is_floating):
+                    return degrade(f"'{fn}' needs a plain int/float column")
+                spec_a = AggSpec(out, fn, arg,
+                                 AGG.resolve(fn, [at]), arg_type=at,
+                                 idx=agg_idx)
+            elif fn in ("min", "max"):
+                if len(args) != 1 or not (at.is_integer or at.is_floating
+                                          or at.is_temporal
+                                          or at.name == "BOOLEAN"):
+                    return degrade(f"'{fn}' over {at} is not mergeable")
+                spec_a = AggSpec(out, fn, arg, at, arg_type=at,
+                                 idx=agg_idx)
+            elif fn == "approx_distinct":
+                if len(args) not in (1, 2) or at.is_decimal:
+                    return degrade("approx_distinct arguments")
+                params = _agg_params(session, fn, args)
+                if not params:
+                    return degrade("approx_distinct error argument")
+                spec_a = AggSpec(out, fn, arg, T.BIGINT, arg_type=at,
+                                 m=params["m"], idx=agg_idx)
+            else:  # approx_percentile
+                if len(args) != 2 or not (at.is_integer or at.is_floating):
+                    return degrade(
+                        "approx_percentile needs (numeric column, p)")
+                params = _agg_params(session, fn, args)
+                if not params:
+                    return degrade("approx_percentile percentile argument")
+                spec_a = AggSpec(out, fn, arg, at, arg_type=at,
+                                 kk=params["kk"], p=params["p"],
+                                 idx=agg_idx)
+        mv.aggs.append(spec_a)
+        mv.columns.append((out, spec_a.out_type))
+        agg_idx += 1
+
+    if set(group_cols) - key_seen:
+        return degrade("GROUP BY column missing from SELECT")
+    if len({o for o, _ in mv.keys} | {a.out for a in mv.aggs}) \
+            != len(mv.keys) + len(mv.aggs):
+        return degrade("duplicate output column names")
+    if not mv.aggs:
+        return degrade("no aggregates to materialize")
+
+    # backing schema: visible columns in select order + hidden states
+    schema: Dict[str, T.Type] = {}
+    for out, t in mv.columns:
+        schema[out] = t
+    for j, (out, _col) in enumerate(mv.keys):
+        schema[mv.knull_col(j)] = T.BOOLEAN
+    for a in mv.aggs:
+        if a.fn in ("sum", "min", "max", "avg", "approx_percentile"):
+            schema[a.n_col] = T.BIGINT
+        if a.fn == "avg":
+            schema[a.s_col] = T.DOUBLE
+        if a.fn == "approx_distinct":
+            schema[a.hll_col] = T.hll_state(a.m)
+        if a.fn == "approx_percentile":
+            schema[a.kll_col] = T.kll_state(2 * a.kk)
+    mv.backing_schema = schema
+    mv.conjuncts = conjuncts
+    mv.mergeable = True
+    return mv
+
+
+# ---------------------------------------------------------------------------
+# host-side aggregation + fold (numpy; device sketch kernels for states)
+# ---------------------------------------------------------------------------
+
+
+def _split_col(a) -> Tuple[np.ndarray, np.ndarray]:
+    """(filled values, valid mask) from a connector host column."""
+    if isinstance(a, np.ma.MaskedArray):
+        valid = ~np.ma.getmaskarray(a)
+        fill = "" if a.dtype == object or a.dtype.kind in ("U", "S") else 0
+        return np.asarray(a.filled(fill)), np.asarray(valid)
+    a = np.asarray(a)
+    return a, np.ones(len(a), dtype=bool)
+
+
+def _eval_conjunct(conj, vals: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    kind = conj[0]
+    if kind == "isnull":
+        return valid if conj[2] else ~valid
+    if kind == "cmp":
+        _, _c, op, v = conj
+        with np.errstate(invalid="ignore"):
+            if op == "=":
+                m = vals == v
+            elif op == "<>":
+                m = vals != v
+            elif op == "<":
+                m = vals < v
+            elif op == "<=":
+                m = vals <= v
+            elif op == ">":
+                m = vals > v
+            else:
+                m = vals >= v
+        return valid & np.asarray(m, dtype=bool)
+    if kind == "between":
+        _, _c, lo, hi = conj
+        with np.errstate(invalid="ignore"):
+            m = (vals >= lo) & (vals <= hi)
+        return valid & np.asarray(m, dtype=bool)
+    # in
+    _, _c, items = conj
+    return valid & np.isin(vals, np.array(list(items), dtype=vals.dtype
+                                          if vals.dtype != object
+                                          else object))
+
+
+def _apply_where(mv: MvDefinition, data: dict, n: int) -> np.ndarray:
+    mask = np.ones(n, dtype=bool)
+    for conj in mv.conjuncts or []:
+        vals, valid = _split_col(data[conj[1]])
+        mask &= _eval_conjunct(conj, vals, valid)
+    return mask
+
+
+def _factorize(cols: List[Tuple[np.ndarray, np.ndarray]], n: int):
+    """Group ids over (values, valid) key columns: NULL is its own key.
+    Returns (gid, n_groups, first_row_index_per_group)."""
+    if not cols:
+        return np.zeros(n, dtype=np.int64), 1, np.zeros(1, dtype=np.int64)
+    codes = []
+    for vals, valid in cols:
+        _u, inv = np.unique(vals, return_inverse=True)
+        inv = inv.astype(np.int64) + 1
+        inv[~valid] = 0
+        codes.append(inv)
+    stacked = np.stack(codes, axis=1)
+    _uniq, gid = np.unique(stacked, axis=0, return_inverse=True)
+    gid = gid.reshape(-1).astype(np.int64)
+    n_groups = int(gid.max()) + 1 if len(gid) else 0
+    first = np.full(n_groups, n, dtype=np.int64)
+    np.minimum.at(first, gid, np.arange(n, dtype=np.int64))
+    return gid, n_groups, first
+
+
+def _minmax_sentinel(dtype, is_min: bool):
+    if np.issubdtype(dtype, np.floating):
+        return np.inf if is_min else -np.inf
+    info = np.iinfo(dtype) if np.issubdtype(dtype, np.integer) else None
+    if info is not None:
+        return info.max if is_min else info.min
+    return True if is_min else False  # booleans
+
+
+def _hll_states(arg_vals, valid, gid, n_groups, m, arg_type):
+    from presto_tpu.exec import kernels as K
+    import jax.numpy as jnp
+
+    if len(arg_vals) == 0 or n_groups == 0:
+        return np.zeros((n_groups, m), dtype=np.uint8)
+    col = column_from_numpy(arg_vals, arg_type, valid)
+    h = K.hll_hash64(col)
+    st = K.hll_partial(h, jnp.asarray(valid), jnp.asarray(gid),
+                       n_groups, m)
+    return np.asarray(st, dtype=np.uint8)
+
+
+def _kll_summarize(gv: np.ndarray, gw: np.ndarray, kk: int):
+    """Compress value-sorted (value, weight) pairs of ONE group into at
+    most kk pairs.  Equal values are merged first (lossless); while the
+    surviving pair count fits in kk the summary IS the exact weighted
+    multiset, so readouts equal the engine's exact group_percentile and
+    delta-merged results match a full recompute bit-for-bit.  Only past
+    kk distinct values does it resample: bucket j owns the weight-rank
+    interval [floor(j*W/kk), floor((j+1)*W/kk)) and its representative
+    is the value COVERING the bucket's first rank — value and weight
+    stay aligned, unlike a naive midpoint gather."""
+    uniq, inv = np.unique(gv, return_inverse=True)
+    w = np.zeros(len(uniq), dtype=np.float64)
+    np.add.at(w, inv, gw)
+    if len(uniq) <= kk:
+        return uniq, w
+    W = float(w.sum())
+    cum = np.cumsum(w)
+    edges = np.floor(np.arange(kk + 1, dtype=np.float64) * W / kk)
+    wgt = edges[1:] - edges[:-1]
+    idx = np.searchsorted(cum, edges[:-1], side="right")
+    idx = np.minimum(idx, len(uniq) - 1)
+    return uniq[idx], wgt
+
+
+def _kll_states(arg_vals, valid, gid, n_groups, kk):
+    """Per-group quantile summaries from raw rows, built host-side so
+    that groups with <= kk distinct values store their EXACT weighted
+    multiset (the device kll_partial kernel resamples unconditionally,
+    which loses rank fidelity on small groups and would break the
+    merge == full-recompute identity)."""
+    out = np.zeros((n_groups, 2 * kk), dtype=np.float64)
+    if n_groups == 0 or len(arg_vals) == 0:
+        return out
+    x = np.asarray(arg_vals, dtype=np.float64)
+    g = np.asarray(gid, dtype=np.int64)
+    keep = np.asarray(valid, dtype=bool)
+    x, g = x[keep], g[keep]
+    if len(x) == 0:
+        return out
+    order = np.lexsort((x, g))
+    x, g = x[order], g[order]
+    bounds = np.searchsorted(g, np.arange(n_groups + 1, dtype=np.int64),
+                             side="left")
+    for grp in range(n_groups):
+        s, e = bounds[grp], bounds[grp + 1]
+        if s == e:
+            continue
+        v, w = _kll_summarize(x[s:e], np.ones(e - s, dtype=np.float64), kk)
+        out[grp, :len(v)] = v
+        out[grp, kk:kk + len(w)] = w
+    return out
+
+
+def _hll_estimate(states: np.ndarray) -> np.ndarray:
+    from presto_tpu.exec import kernels as K
+    import jax.numpy as jnp
+
+    if len(states) == 0:
+        return np.zeros(0, dtype=np.int64)
+    return np.asarray(K.hll_estimate(jnp.asarray(states)),
+                      dtype=np.int64)
+
+
+def _kll_fold(states: np.ndarray, gid: np.ndarray, n_groups: int,
+              kk: int) -> np.ndarray:
+    """Fold partial KLL summaries per group: flatten every contributing
+    state's (value, weight) pairs and re-summarize via _kll_summarize.
+    While a group's pairs keep fitting in kk slots the fold is lossless,
+    so delta-merged percentiles match a full recompute bit-for-bit
+    (tests/test_matview.py)."""
+    out = np.zeros((n_groups, 2 * kk), dtype=np.float64)
+    if len(states) == 0 or n_groups == 0:
+        return out
+    vals = states[:, :kk]
+    wts = states[:, kk:]
+    g = np.repeat(np.asarray(gid, dtype=np.int64), kk)
+    v = vals.ravel()
+    w = wts.ravel()
+    keep = w > 0
+    g, v, w = g[keep], v[keep], w[keep]
+    order = np.lexsort((v, g))
+    g, v, w = g[order], v[order], w[order]
+    bounds = np.searchsorted(g, np.arange(n_groups + 1, dtype=np.int64),
+                             side="left")
+    for grp in range(n_groups):
+        s, e = bounds[grp], bounds[grp + 1]
+        if s == e:
+            continue
+        sv, sw = _kll_summarize(v[s:e], w[s:e], kk)
+        out[grp, :len(sv)] = sv
+        out[grp, kk:kk + len(sw)] = sw
+    return out
+
+
+def _kll_readout(states: np.ndarray, kk: int, p: float):
+    """Percentile from stored KLL states with the engine's weighted-rank
+    readout (kernels.kll_percentile): target rank floor(p*(W-1))+1, first
+    value whose cumulative weight reaches it."""
+    n = len(states)
+    out = np.zeros(n, dtype=np.float64)
+    nonempty = np.zeros(n, dtype=bool)
+    for g in range(n):
+        w = states[g, kk:]
+        keep = w > 0
+        if not keep.any():
+            continue
+        v = states[g, :kk][keep]
+        ww = w[keep]
+        order = np.argsort(v, kind="stable")
+        v, ww = v[order], ww[order]
+        W = float(ww.sum())
+        t = math.floor(p * (W - 1)) + 1
+        cum = np.cumsum(ww)
+        i = int(np.searchsorted(cum, t, side="left"))
+        out[g] = v[min(i, len(v) - 1)]
+        nonempty[g] = True
+    return out, nonempty
+
+
+def _cast_final(vals: np.ndarray, typ: T.Type) -> np.ndarray:
+    if typ.is_integer or typ.is_temporal:
+        return np.asarray(vals).astype(np.int64)
+    return np.asarray(vals)
+
+
+def aggregate_rows(mv: MvDefinition, data: dict, n: int) -> dict:
+    """View-query aggregation over host rows -> MV-shaped arrays (one
+    row per group, visible finals + hidden states)."""
+    mask = _apply_where(mv, data, n)
+    key_cols = []
+    for _out, col in mv.keys:
+        vals, valid = _split_col(data[col])
+        key_cols.append((vals[mask], valid[mask]))
+    gid, n_groups, first = _factorize(key_cols, int(mask.sum()))
+    out: Dict[str, np.ndarray] = {}
+    for j, (kout, _col) in enumerate(mv.keys):
+        vals, valid = key_cols[j]
+        sel = np.minimum(first, max(len(vals) - 1, 0))
+        out[kout] = vals[sel] if len(vals) else vals
+        out[mv.knull_col(j)] = ~(valid[sel] if len(valid) else valid)
+    for a in mv.aggs:
+        if a.arg is not None:
+            av, avalid = _split_col(data[a.arg])
+            av, avalid = av[mask], avalid[mask]
+        else:
+            av = avalid = None
+        _agg_into(out, a, av, avalid, gid, n_groups)
+    return out
+
+
+def _agg_into(out: dict, a: AggSpec, av, avalid, gid, n_groups) -> None:
+    """One aggregate's visible final + hidden state columns."""
+    if a.fn == "count":
+        cnt = np.zeros(n_groups, dtype=np.int64)
+        np.add.at(cnt, gid, 1)
+        out[a.out] = cnt
+        return
+    if a.fn == "count_col":
+        cnt = np.zeros(n_groups, dtype=np.int64)
+        np.add.at(cnt, gid, avalid.astype(np.int64))
+        out[a.out] = cnt
+        return
+    nn = np.zeros(n_groups, dtype=np.int64)
+    if avalid is not None:
+        np.add.at(nn, gid, avalid.astype(np.int64))
+    if a.fn in ("sum", "avg"):
+        acc = np.zeros(n_groups, dtype=np.float64
+                       if a.arg_type.is_floating or a.fn == "avg"
+                       else np.int64)
+        vv = av.astype(acc.dtype)
+        np.add.at(acc, gid[avalid], vv[avalid])
+        if a.fn == "sum":
+            out[a.out] = _cast_final(acc, a.out_type)
+            out[a.n_col] = nn
+        else:
+            out[a.s_col] = acc.astype(np.float64)
+            out[a.n_col] = nn
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out[a.out] = np.where(nn > 0, acc / np.maximum(nn, 1), 0.0)
+        return
+    if a.fn in ("min", "max"):
+        is_min = a.fn == "min"
+        dt = np.float64 if a.arg_type.is_floating else (
+            np.bool_ if a.arg_type.name == "BOOLEAN" else np.int64)
+        acc = np.full(n_groups, _minmax_sentinel(np.dtype(dt), is_min),
+                      dtype=dt)
+        vv = av.astype(dt)
+        if is_min:
+            np.minimum.at(acc, gid[avalid], vv[avalid])
+        else:
+            np.maximum.at(acc, gid[avalid], vv[avalid])
+        out[a.out] = np.where(nn > 0, acc, np.zeros(1, dtype=dt))
+        out[a.n_col] = nn
+        return
+    if a.fn == "approx_distinct":
+        st = _hll_states(av, avalid, gid, n_groups, a.m, a.arg_type)
+        out[a.hll_col] = st
+        out[a.out] = _hll_estimate(st)
+        return
+    # approx_percentile
+    st = _kll_states(av, avalid, gid, n_groups, a.kk)
+    vals, _ne = _kll_readout(st, a.kk, a.p)
+    out[a.kll_col] = st
+    out[a.n_col] = nn
+    out[a.out] = np.where(nn > 0, _cast_final(vals, a.out_type),
+                          np.zeros(1, dtype=_cast_final(vals,
+                                                        a.out_type).dtype))
+
+
+def fold_groups(mv: MvDefinition, arrays: dict, group_keys: List[str],
+                percentiles: Optional[Dict[str, float]] = None) -> dict:
+    """Re-aggregate MV-shaped arrays onto a (sub)set of the MV's key
+    columns by folding the stored partial states: additive exact states,
+    elementwise-max HLL registers, weighted KLL re-summarize.  The merge
+    path (stored + delta) and the serving rollup path share this."""
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    key_out = [k for k in group_keys]
+    key_idx = {out: j for j, (out, _c) in enumerate(mv.keys)}
+    key_cols = []
+    for out in key_out:
+        vals = np.asarray(arrays[out])
+        valid = ~np.asarray(arrays[mv.knull_col(key_idx[out])], dtype=bool)
+        key_cols.append((vals, valid))
+    gid, n_groups, first = _factorize(key_cols, n)
+    merged: Dict[str, np.ndarray] = {}
+    for jj, out in enumerate(key_out):
+        vals, valid = key_cols[jj]
+        sel = np.minimum(first, max(n - 1, 0))
+        merged[out] = vals[sel] if n else vals
+        merged[f"__fold_knull{jj}"] = ~(valid[sel] if n else valid)
+    for a in mv.aggs:
+        p = (percentiles or {}).get(a.out, a.p)
+        _fold_agg(mv, merged, a, arrays, gid, n_groups, p)
+    return merged
+
+
+def _fold_agg(mv, merged, a: AggSpec, arrays, gid, n_groups,
+              p: float) -> None:
+    def _sum64(col, dtype):
+        acc = np.zeros(n_groups, dtype=dtype)
+        np.add.at(acc, gid, np.asarray(arrays[col]).astype(dtype))
+        return acc
+
+    if a.fn in ("count", "count_col"):
+        merged[a.out] = _sum64(a.out, np.int64)
+        return
+    nn = _sum64(a.n_col, np.int64) if a.n_col in arrays else None
+    if a.fn == "sum":
+        dt = np.float64 if a.out_type.is_floating else np.int64
+        acc = _sum64(a.out, dt)
+        merged[a.out] = np.where(nn > 0, acc, np.zeros(1, dtype=dt))
+        merged[a.n_col] = nn
+        return
+    if a.fn == "avg":
+        s = _sum64(a.s_col, np.float64)
+        merged[a.s_col] = s
+        merged[a.n_col] = nn
+        with np.errstate(invalid="ignore", divide="ignore"):
+            merged[a.out] = np.where(nn > 0, s / np.maximum(nn, 1), 0.0)
+        return
+    if a.fn in ("min", "max"):
+        is_min = a.fn == "min"
+        vals = np.asarray(arrays[a.out])
+        dt = vals.dtype
+        acc = np.full(n_groups, _minmax_sentinel(dt, is_min), dtype=dt)
+        rows_n = np.asarray(arrays[a.n_col], dtype=np.int64)
+        live = rows_n > 0
+        if is_min:
+            np.minimum.at(acc, gid[live], vals[live])
+        else:
+            np.maximum.at(acc, gid[live], vals[live])
+        merged[a.out] = np.where(nn > 0, acc, np.zeros(1, dtype=dt))
+        merged[a.n_col] = nn
+        return
+    if a.fn == "approx_distinct":
+        st = np.asarray(arrays[a.hll_col], dtype=np.uint8)
+        acc = np.zeros((n_groups, a.m), dtype=np.uint8)
+        np.maximum.at(acc, gid, st)   # HLL union IS elementwise max
+        merged[a.hll_col] = acc
+        merged[a.out] = _hll_estimate(acc)
+        return
+    # approx_percentile
+    st = np.asarray(arrays[a.kll_col], dtype=np.float64)
+    acc = _kll_fold(st, gid, n_groups, a.kk)
+    vals, _ne = _kll_readout(acc, a.kk, p)
+    merged[a.kll_col] = acc
+    merged[a.n_col] = nn
+    merged[a.out] = np.where(nn > 0, _cast_final(vals, a.out_type),
+                             np.zeros(1, dtype=_cast_final(
+                                 vals, a.out_type).dtype))
+
+
+def merge_states(mv: MvDefinition, stored: dict, delta: dict) -> dict:
+    """Fold a delta's MV-shaped arrays into the stored snapshot's."""
+    n_s = len(next(iter(stored.values()))) if stored else 0
+    if n_s == 0:
+        return delta
+    combined = {}
+    for c in mv.backing_schema:
+        a, b = np.asarray(stored[c]), np.asarray(delta[c])
+        combined[c] = np.concatenate([a, b.astype(a.dtype, copy=False)])
+    folded = fold_groups(mv, combined, [out for out, _c in mv.keys])
+    # fold emits positional null flags; restore backing column names
+    for j in range(len(mv.keys)):
+        folded[mv.knull_col(j)] = folded.pop(f"__fold_knull{j}")
+    return {c: folded[c] for c in mv.backing_schema}
+
+
+# ---------------------------------------------------------------------------
+# backing snapshot I/O
+# ---------------------------------------------------------------------------
+
+
+def _read_backing(mv: MvDefinition, backing) -> dict:
+    if backing.row_count() == 0:
+        return {}
+    return {c: np.asarray(a)
+            for c, a in backing.read(list(mv.backing_schema)).items()}
+
+
+def _commit_snapshot(session, mv: MvDefinition, backing, arrays: dict,
+                     stamp: dict) -> None:
+    """Publish a snapshot atomically (PR-9 cut-over): stage every shard,
+    then one manifest replace flips readers to the new generation WITH
+    the watermark it covers.  Any failure aborts the sink — staged files
+    are deleted and the PRIOR snapshot keeps serving."""
+    if hasattr(backing, "page_sink"):
+        sink = backing.page_sink(None, replace=True,
+                                 schema=mv.backing_schema)
+        try:
+            sink.append_page(
+                {c: arrays[c] for c in mv.backing_schema})
+            backing.set_mv_stamp({"source": mv.source,
+                                  "watermark": stamp})
+            sink.finish()
+        except BaseException:
+            backing._mv_stamp = None
+            try:
+                sink.abort()
+            except Exception:
+                pass
+            raise
+        mv.watermark = stamp
+    else:  # memory backing: swap columns wholesale
+        backing.data = {c: np.asarray(arrays[c])
+                        for c in mv.backing_schema}
+        backing._rows = len(next(iter(backing.data.values()))) \
+            if backing.data else 0
+        backing._invalidate()
+        mv.watermark = stamp
+    session.catalog.version += 1
+    _notify_write(session, mv)
+
+
+def _notify_write(session, mv: MvDefinition) -> None:
+    from presto_tpu.exec import writer as W
+
+    try:
+        W._invalidate_server_caches(
+            session, tables={mv.name, mv.backing, mv.source})
+    except TypeError:  # older serving tier without table scoping
+        W._invalidate_server_caches(session)
+
+
+def _recorded_watermark(mv: MvDefinition, backing) -> Optional[dict]:
+    rec = None
+    if hasattr(backing, "mv_watermarks"):
+        rec = backing.mv_watermarks()
+    if rec is None and mv.watermark is not None:
+        return mv.watermark
+    if isinstance(rec, dict):
+        return rec.get("watermark")
+    return None
+
+
+def _stats(mon):
+    return getattr(mon, "stats", None) if mon is not None else None
+
+
+def _bump(mon, field: str, by: int = 1) -> None:
+    st = _stats(mon)
+    if st is not None and hasattr(st, field):
+        setattr(st, field, getattr(st, field) + by)
+
+
+# ---------------------------------------------------------------------------
+# statement handlers (wired from executor._dispatch_statement)
+# ---------------------------------------------------------------------------
+
+
+def create(session, stmt, mon) -> QueryResult:
+    from presto_tpu import types as TT
+    from presto_tpu.catalog import MemoryTable
+    from presto_tpu.exec import writer as W
+
+    catalog = session.catalog
+    key = _mv_key(catalog, stmt.name)
+    session.access_control.check_can_create_table(session.user, stmt.name)
+    if key in catalog.matviews:
+        if stmt.if_not_exists:
+            return QueryResult([("result", TT.BOOLEAN)], [(True,)])
+        if not stmt.or_replace:
+            raise MatViewError(
+                f"Materialized view '{stmt.name}' already exists")
+        _drop_backing(session, catalog.matviews[key])
+    elif stmt.name in catalog:
+        raise MatViewError(
+            f"Table '{stmt.name}' already exists")
+
+    mv = analyze(session, key, stmt.query, stmt.properties)
+    if mv.mergeable:
+        props = dict(stmt.properties)
+        props.setdefault("connector", "localfile")
+        backing, _conn = W.build_target_table(
+            session, mv.backing, mv.backing_schema, props)
+        if hasattr(backing, "drop_data") and backing.row_count() > 0:
+            backing.drop_data()  # stale directory from a dead MV
+        # long-poll readers may span TWO refresh cut-overs; keep retired
+        # shards an extra generation before GC (tests/test_matview.py)
+        backing.retire_depth = 2
+        catalog.register(backing)
+        _refresh_into(session, mv, backing, mon, force_full=True)
+    else:
+        _bump(mon, "mv_refresh_full")
+        arrays, types_ = _full_recompute(session, mv)
+        mv.columns = list(types_.items())
+        schema = dict(types_)
+        backing = MemoryTable(mv.backing, schema, arrays)
+        mv.backing_schema = schema
+        catalog.register(backing)
+        mv.watermark = DELTA.capture(catalog.get(mv.source)) \
+            if mv.source else None
+    catalog.matviews[key] = mv
+    return QueryResult([("result", TT.BOOLEAN)], [(True,)])
+
+
+def drop(session, stmt, mon) -> QueryResult:
+    from presto_tpu import types as TT
+
+    catalog = session.catalog
+    key = _mv_key(catalog, stmt.name)
+    mv = catalog.matviews.get(key)
+    if mv is None:
+        if stmt.if_exists:
+            return QueryResult([("result", TT.BOOLEAN)], [(False,)])
+        raise MatViewError(
+            f"Materialized view '{stmt.name}' does not exist")
+    session.access_control.check_can_drop_table(session.user, stmt.name)
+    _drop_backing(session, mv)
+    del catalog.matviews[key]
+    _notify_write(session, mv)
+    return QueryResult([("result", TT.BOOLEAN)], [(True,)])
+
+
+def _drop_backing(session, mv: MvDefinition) -> None:
+    catalog = session.catalog
+    t = catalog.tables.get(mv.backing)
+    if t is not None and hasattr(t, "drop_data"):
+        t.drop_data()
+    catalog.tables.pop(mv.backing, None)
+    catalog.version += 1
+
+
+def show(session) -> QueryResult:
+    from presto_tpu import types as TT
+
+    rows = sorted(
+        (mv.name, mv.mergeable,
+         mv.source if mv.mergeable else (mv.reason or ""))
+        for mv in session.catalog.matviews.values())
+    return QueryResult(
+        [("Materialized View", TT.VARCHAR), ("Mergeable", TT.BOOLEAN),
+         ("Detail", TT.VARCHAR)], rows)
+
+
+def refresh(session, stmt, mon) -> QueryResult:
+    from presto_tpu import types as TT
+
+    catalog = session.catalog
+    key = _mv_key(catalog, stmt.name)
+    mv = catalog.matviews.get(key)
+    if mv is None:
+        raise MatViewError(
+            f"Materialized view '{stmt.name}' does not exist")
+    backing = catalog.tables.get(mv.backing)
+    if backing is None:
+        raise MatViewError(
+            f"Materialized view '{stmt.name}' lost its backing table")
+    if mv.mergeable:
+        n, mode = _refresh_into(session, mv, backing, mon)
+    else:
+        source = catalog.get(mv.source) if mv.source else None
+        verdict = DELTA.diff(source, mv.watermark) if source is not None \
+            else DELTA.DeltaVerdict("full", reason="no source table")
+        if verdict.kind == "empty":
+            return QueryResult(
+                [("rows", TT.BIGINT), ("refresh", TT.VARCHAR)],
+                [(0, "noop")])
+        _bump(mon, "mv_refresh_full")
+        _bump(mon, "mv_source_splits", verdict.total_splits)
+        arrays, types_ = _full_recompute(session, mv)
+        mv.columns = list(types_.items())
+        mv.backing_schema = dict(types_)
+        backing.schema = dict(types_)
+        stamp = DELTA.capture(source) if source is not None else None
+        backing.data = {c: (v if isinstance(v, np.ma.MaskedArray)
+                            else np.asarray(v))
+                        for c, v in arrays.items()}
+        backing._rows = len(next(iter(arrays.values()))) if arrays else 0
+        backing._invalidate()
+        mv.watermark = stamp
+        session.catalog.version += 1
+        _notify_write(session, mv)
+        n, mode = backing._rows, "full: non-mergeable view"
+    return QueryResult([("rows", TT.BIGINT), ("refresh", TT.VARCHAR)],
+                       [(n, mode)])
+
+
+def _full_recompute(session, mv: MvDefinition):
+    """Run the view query through the regular engine (any execution
+    mode) and return (arrays, types) — the never-wrong fallback."""
+    from presto_tpu.exec.executor import execute_plan_to_host
+
+    return execute_plan_to_host(session, ast.QueryStatement(mv.query))
+
+
+def _source_columns(mv: MvDefinition) -> List[str]:
+    cols = {c for _out, c in mv.keys}
+    cols |= {a.arg for a in mv.aggs if a.arg is not None}
+    cols |= _conjunct_cols(mv.conjuncts or [])
+    return sorted(cols)
+
+
+def _refresh_into(session, mv: MvDefinition, backing, mon,
+                  force_full: bool = False):
+    """Mergeable refresh: delta-fold when the source verdict allows it,
+    loud full recompute otherwise.  Returns (rows, mode_string)."""
+    catalog = session.catalog
+    try:
+        source = catalog.get(mv.source)
+    except KeyError:
+        raise MatViewError(
+            f"Materialized view '{mv.name}' source '{mv.source}' "
+            "does not exist")
+    mode_knob = str(session.properties.get("mv_refresh_mode", "auto"))
+    recorded = None if force_full else _recorded_watermark(mv, backing)
+    verdict = DELTA.diff(source, recorded)
+    if not force_full and mode_knob != "full":
+        if verdict.kind == "empty":
+            return 0, "noop"
+    if mode_knob == "delta" and verdict.kind != "append" \
+            and not force_full:
+        raise MatViewError(
+            f"mv_refresh_mode=delta but delta refresh of '{mv.name}' "
+            f"is impossible: {verdict.reason or verdict.kind}")
+
+    cols = _source_columns(mv)
+    delta_ok = (not force_full and mode_knob != "full"
+                and verdict.kind == "append")
+    # capture AFTER the verdict; pin the read to the captured row count
+    # so the stamped watermark covers exactly the rows aggregated
+    current = DELTA.capture(source)
+    if delta_ok:
+        a = verdict.row_range[0]
+        b = int(current["row_count"])
+        data = source.read(cols, split=(a, b)) if cols else {}
+        delta_mv = aggregate_rows(mv, data, b - a)
+        stored = _read_backing(mv, backing)
+        merged = merge_states(mv, stored, delta_mv) if stored \
+            else delta_mv
+        _bump(mon, "mv_refresh_delta")
+        _bump(mon, "mv_delta_splits", verdict.delta_splits)
+        _bump(mon, "mv_source_splits", verdict.total_splits)
+        mode = "delta"
+    else:
+        n_rows = int(current["row_count"])
+        data = source.read(cols, split=(0, n_rows)) if cols else {}
+        merged = aggregate_rows(mv, data, n_rows)
+        _bump(mon, "mv_refresh_full")
+        _bump(mon, "mv_source_splits", verdict.total_splits)
+        mode = "full" if force_full or mode_knob == "full" \
+            else f"full: {verdict.reason or verdict.kind}"
+    _commit_snapshot(session, mv, backing, merged, current)
+    n = len(next(iter(merged.values()))) if merged else 0
+    return n, mode
+
+
+# ---------------------------------------------------------------------------
+# serving: the containment matcher (MV-routed SELECTs)
+# ---------------------------------------------------------------------------
+
+
+def _py(v):
+    if isinstance(v, np.generic):
+        v = v.item()
+    return v
+
+
+def _to_result(cols, order_by, limit) -> Optional[QueryResult]:
+    """cols: [(name, Type, values, valid)] -> QueryResult with host rows,
+    applying output-column ORDER BY and LIMIT (or None to decline)."""
+    names = [c[0] for c in cols]
+    n = len(cols[0][2]) if cols else 0
+    rows = []
+    for i in range(n):
+        rows.append(tuple(
+            _py(vals[i]) if bool(valid[i]) else None
+            for _nm, _t, vals, valid in cols))
+    for si in reversed(order_by or []):
+        e = si.expr
+        if not isinstance(e, ast.Identifier) or e.name not in names:
+            return None
+        asc = bool(si.ascending)
+        if si.nulls_first is not None and bool(si.nulls_first) == asc:
+            return None  # non-default null placement
+        j = names.index(e.name)
+        rows.sort(key=lambda r: (r[j] is None,
+                                 r[j] if r[j] is not None else 0),
+                  reverse=not asc)
+    if limit is not None:
+        rows = rows[:int(limit)]
+    return QueryResult([(nm, t) for nm, t, _v, _m in cols], rows)
+
+
+def _final_validity(mv: MvDefinition, arrays: dict, a: AggSpec,
+                    n: int) -> np.ndarray:
+    if a.fn in ("count", "count_col", "approx_distinct"):
+        return np.ones(n, dtype=bool)
+    return np.asarray(arrays[a.n_col], dtype=np.int64) > 0
+
+
+def _match_agg(session, mv: MvDefinition, e: ast.FunctionCall) \
+        -> Optional[Tuple[AggSpec, Optional[float]]]:
+    """Match a query aggregate to a stored AggSpec; the optional float
+    is a percentile override read out of the stored KLL state."""
+    fn = e.name.lower()
+    if e.distinct or e.filter is not None or e.window is not None:
+        return None
+    args = e.args
+    star = len(args) == 0 or (len(args) == 1
+                              and isinstance(args[0], ast.Star))
+    if fn == "count" and star:
+        for a in mv.aggs:
+            if a.fn == "count":
+                return a, None
+        return None
+    if not args or not isinstance(args[0], ast.Identifier):
+        return None
+    arg = args[0].name.lower()
+    want = {"count": "count_col"}.get(fn, fn)
+    for a in mv.aggs:
+        if a.fn != want or a.arg != arg:
+            continue
+        if fn == "approx_distinct":
+            params = _agg_params(session, fn, args)
+            if params.get("m") != a.m:
+                continue
+            return a, None
+        if fn == "approx_percentile":
+            if len(args) != 2:
+                continue
+            ok, p = _literal(args[1])
+            if not ok or not isinstance(p, (int, float)):
+                continue
+            return a, float(p)
+        if len(args) != 1:
+            continue
+        return a, None
+    return None
+
+
+def try_route(session, stmt, mon) -> Optional[QueryResult]:
+    """Route a SELECT to a materialized view snapshot when the MV
+    provably contains it; None falls through to the engine."""
+    catalog = session.catalog
+    if not catalog.matviews or not routing_enabled(session):
+        return None
+    if getattr(session.txn, "current", None) is not None:
+        return None
+    q = getattr(stmt, "query", None)
+    if q is None or q.ctes:
+        return None
+    spec = q.body
+    if not isinstance(spec, ast.QuerySpec) \
+            or not isinstance(spec.from_, ast.Table) or spec.from_.sample:
+        return None
+    tname = spec.from_.name.lower()
+
+    mv_key = _mv_key(catalog, tname)
+    if mv_key in catalog.matviews:
+        res = _route_direct(session, catalog.matviews[mv_key], q, spec)
+        if res is not None:
+            _bump(mon, "mv_routed")
+            st = _stats(mon)
+            if st is not None:
+                st.execution_mode = "mv_routed"
+        return res
+
+    try:
+        src = catalog.get(tname)
+    except KeyError:
+        return None
+    for mv in catalog.matviews.values():
+        backing = catalog.tables.get(mv.backing)
+        if backing is None:
+            continue
+        try:
+            if catalog.get(mv.source) is not src:
+                continue
+        except KeyError:
+            continue
+        if mv.mergeable:
+            res = _route_rollup(session, mv, backing, q, spec)
+        else:
+            res = _route_exact(mv, backing, q)
+        if res is not None:
+            _bump(mon, "mv_routed")
+            st = _stats(mon)
+            if st is not None:
+                st.execution_mode = "mv_routed"
+            return res
+    return None
+
+
+def _route_exact(mv: MvDefinition, backing, q) -> Optional[QueryResult]:
+    """Non-mergeable MVs serve structurally identical queries only."""
+    if repr(q) != mv.query_repr:
+        return None
+    data = backing.read(list(mv.backing_schema))
+    cols = []
+    for nm, t in mv.columns:
+        a = data[nm]
+        if isinstance(a, np.ma.MaskedArray):
+            vals, valid = np.asarray(a.filled(
+                "" if a.dtype == object else 0)), ~np.ma.getmaskarray(a)
+        else:
+            vals, valid = np.asarray(a), np.ones(len(a), dtype=bool)
+        cols.append((nm, t, vals, valid))
+    n = len(cols[0][2]) if cols else 0
+    rows = [tuple(_py(vals[i]) if bool(valid[i]) else None
+                  for _nm, _t, vals, valid in cols) for i in range(n)]
+    return QueryResult([(nm, t) for nm, t, _v, _m in cols], rows)
+
+
+def _route_direct(session, mv: MvDefinition, q, spec) \
+        -> Optional[QueryResult]:
+    """SELECT ... FROM <mv>: read the stored finals as a table."""
+    catalog = session.catalog
+    backing = catalog.tables.get(mv.backing)
+    if backing is None:
+        return None
+    if spec.distinct or spec.having is not None or spec.grouping_sets \
+            or spec.group_by:
+        return None
+    arrays = backing.read(list(mv.backing_schema))
+    arrays = {c: np.asarray(a) if not isinstance(a, np.ma.MaskedArray)
+              else a for c, a in arrays.items()}
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    finals: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    if mv.mergeable:
+        key_idx = {out: j for j, (out, _c) in enumerate(mv.keys)}
+        for nm, _t in mv.columns:
+            if nm in key_idx:
+                valid = ~np.asarray(arrays[mv.knull_col(key_idx[nm])],
+                                    dtype=bool)
+                finals[nm] = (np.asarray(arrays[nm]), valid)
+            else:
+                a = next(x for x in mv.aggs if x.out == nm)
+                finals[nm] = (np.asarray(arrays[nm]),
+                              _final_validity(mv, arrays, a, n))
+    else:
+        for nm, _t in mv.columns:
+            a = arrays[nm]
+            if isinstance(a, np.ma.MaskedArray):
+                finals[nm] = (np.asarray(a.filled(
+                    "" if a.dtype == object else 0)),
+                    ~np.ma.getmaskarray(a))
+            else:
+                finals[nm] = (np.asarray(a), np.ones(n, dtype=bool))
+    conjs = simple_conjuncts(spec.where)
+    if conjs is None:
+        return None
+    mask = np.ones(n, dtype=bool)
+    for c in conjs:
+        if c[1] not in finals:
+            return None
+        vals, valid = finals[c[1]]
+        mask &= _eval_conjunct(c, vals, valid)
+    typemap = dict(mv.columns)
+    out_cols = []
+    for item in spec.select:
+        e = item.expr
+        if isinstance(e, ast.Star):
+            if item.alias:
+                return None
+            for nm, t in mv.columns:
+                vals, valid = finals[nm]
+                out_cols.append((nm, t, vals[mask], valid[mask]))
+            continue
+        if not isinstance(e, ast.Identifier) or e.name not in finals:
+            return None
+        vals, valid = finals[e.name]
+        out_cols.append(((item.alias or e.name), typemap[e.name],
+                         vals[mask], valid[mask]))
+    if not out_cols:
+        return None
+    return _to_result(out_cols, q.order_by, q.limit)
+
+
+def _route_rollup(session, mv: MvDefinition, backing, q, spec) \
+        -> Optional[QueryResult]:
+    """The containment matcher proper: query groups ⊆ MV keys, query
+    WHERE ⊇ MV WHERE with extras on key columns, aggregates covered by
+    stored finals/states.  Equal group sets serve stored finals
+    directly; strict subsets fold the rollup states."""
+    if spec.distinct or spec.having is not None or spec.grouping_sets:
+        return None
+    conjs = simple_conjuncts(spec.where)
+    if conjs is None:
+        return None
+    mv_set = {c for c in (mv.conjuncts or [])}
+    q_set = set(conjs)
+    if not mv_set <= q_set:
+        return None
+    src_to_out = {c: out for out, c in mv.keys}
+    extra = [c for c in conjs if c not in mv_set]
+    if any(c[1] not in src_to_out for c in extra):
+        return None
+
+    group_srcs = []
+    for g in spec.group_by:
+        if not isinstance(g, ast.Identifier) \
+                or g.name.lower() not in src_to_out:
+            return None
+        group_srcs.append(g.name.lower())
+
+    # select coverage: group identifiers + matched aggregates
+    items = []      # ("key", out_name) | ("agg", AggSpec, p_override)
+    names_types = []
+    for item in spec.select:
+        e = item.expr
+        if isinstance(e, ast.Identifier):
+            col = e.name.lower()
+            if col not in group_srcs:
+                return None
+            out = src_to_out[col]
+            items.append(("key", out))
+            names_types.append((item.alias or e.name,
+                                mv.key_types[out]))
+            continue
+        if not isinstance(e, ast.FunctionCall):
+            return None
+        m = _match_agg(session, mv, e)
+        if m is None:
+            return None
+        a, p_override = m
+        items.append(("agg", a, p_override))
+        names_types.append((item.alias or e.name.lower(), a.out_type))
+
+    arrays = _read_backing(mv, backing)
+    if not arrays:
+        arrays = {c: (np.zeros((0, int(t.params[0])), t.numpy_dtype())
+                      if t.name in ("HLL_STATE", "KLL_STATE")
+                      else np.empty(0, t.numpy_dtype()
+                                    if not t.is_string else object))
+                  for c, t in mv.backing_schema.items()}
+    n = len(next(iter(arrays.values()))) if arrays else 0
+
+    # extra key predicates: constant within a group, so filtering stored
+    # rows before any fold filters exactly the covered source rows
+    mask = np.ones(n, dtype=bool)
+    key_idx = {out: j for j, (out, _c) in enumerate(mv.keys)}
+    for c in extra:
+        out = src_to_out[c[1]]
+        valid = ~np.asarray(arrays[mv.knull_col(key_idx[out])],
+                            dtype=bool)
+        mask &= _eval_conjunct(c, np.asarray(arrays[out]), valid)
+    if not mask.all():
+        arrays = {c: np.asarray(a)[mask] for c, a in arrays.items()}
+        n = int(mask.sum())
+
+    group_outs = [src_to_out[c] for c in group_srcs]
+    if set(group_srcs) == {c for _o, c in mv.keys}:
+        # fast path: stored grain == query grain; finals serve as-is
+        folded = arrays
+        knull = {out: np.asarray(arrays[mv.knull_col(key_idx[out])],
+                                 dtype=bool) for out in group_outs}
+    else:
+        overrides = {it[1].out: it[2] for it in items
+                     if it[0] == "agg" and it[2] is not None}
+        folded = fold_groups(mv, arrays, group_outs,
+                             percentiles=overrides)
+        n = len(next(iter(folded.values()))) if folded else 0
+        knull = {out: np.asarray(folded[f"__fold_knull{j}"], dtype=bool)
+                 for j, out in enumerate(group_outs)}
+
+    out_cols = []
+    for (nm, t), it in zip(names_types, items):
+        if it[0] == "key":
+            out = it[1]
+            out_cols.append((nm, t, np.asarray(folded[out]),
+                             ~knull[out]))
+            continue
+        a, p_override = it[1], it[2]
+        vals = np.asarray(folded[a.out])
+        if a.fn == "approx_percentile" and p_override is not None \
+                and folded is arrays:
+            # fast path with a different percentile: read the stored
+            # KLL states back out at the query's p
+            st = np.asarray(arrays[a.kll_col], dtype=np.float64)
+            raw, _ne = _kll_readout(st, a.kk, p_override)
+            vals = _cast_final(raw, a.out_type)
+        out_cols.append((nm, t, vals,
+                         _final_validity(mv, folded, a, n)))
+    if not out_cols:
+        return None
+    return _to_result(out_cols, q.order_by, q.limit)

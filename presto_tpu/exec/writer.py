@@ -939,7 +939,7 @@ def _begin_write(session, stmt, plan: P.QueryPlan, tw: P.TableWriter,
         return WriteContext(session, table, sink, iprops,
                             targets=list(tw.columns), is_ctas=False,
                             on_commit=lambda c: _invalidate_server_caches(
-                                session))
+                                session, tables={table.name}))
 
     schema, _order = output_schema(inner)
     props = stmt.properties or {}
@@ -961,7 +961,7 @@ def _begin_write(session, stmt, plan: P.QueryPlan, tw: P.TableWriter,
         sink = open_sink(table, wp)
         return WriteContext(session, table, sink, wp, is_ctas=True,
                             on_commit=lambda c: _invalidate_server_caches(
-                                session))
+                                session, tables={stmt.name}))
 
     new_dir = props.get("path") or props.get("directory")
     old_dir = getattr(old_table, "dir", None) \
@@ -1008,19 +1008,21 @@ def _begin_write(session, stmt, plan: P.QueryPlan, tw: P.TableWriter,
                 old_table.drop_data()
         else:
             session.catalog.version += 1
-        _invalidate_server_caches(session)
+        _invalidate_server_caches(session, tables={stmt.name})
 
     return WriteContext(session, table, sink, wp, is_ctas=True,
                         on_commit=on_commit)
 
 
-def _invalidate_server_caches(session) -> None:
+def _invalidate_server_caches(session, tables=None) -> None:
     """Engine-path writes must invalidate the serving result cache the
-    same way protocol-path writes do (server/serving.py belt rule)."""
+    same way protocol-path writes do (server/serving.py belt rule);
+    `tables` scopes the eviction to entries referencing the written
+    tables (None still clears everything)."""
     tier = getattr(session, "_serving_tier", None)
     if tier is not None:
         try:
-            tier.on_write_statement()
+            tier.on_write_statement(tables=tables)
         except Exception:
             pass
 

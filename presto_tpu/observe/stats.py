@@ -78,6 +78,16 @@ class QueryStats:
     sorts_elided: int = 0
     sort_memo_hits: int = 0
     ordering_guard_trips: int = 0
+    # materialized views (exec/matview.py): refreshes that delta-folded
+    # vs degraded to full recompute (degrade is LOUD — it shows here and
+    # in the REFRESH result row), splits the delta actually scanned vs
+    # the source total (delta cost ∝ delta, not history), and SELECTs
+    # the containment matcher served from an MV snapshot.
+    mv_refresh_delta: int = 0
+    mv_refresh_full: int = 0
+    mv_delta_splits: int = 0
+    mv_source_splits: int = 0
+    mv_routed: int = 0
     # compile economics (exec/compile_cache.py): XLA programs this query
     # BUILT (compiles; compile_ms is the AOT lower+compile wall),
     # executables it reused from the shared memo / persistent disk cache

@@ -308,12 +308,12 @@ class FleetDirectory:
     # -- gossip relay (in-process members get direct callbacks; remote
     # members are reached over their /v1/fleet endpoints by the sender)
     def relay_invalidate(self, origin_id: str, token: str,
-                         version: int) -> None:
+                         version: int, tables=None) -> None:
         with self._lock:
             members = [m for cid, m in self._members.items()
                        if cid != origin_id]
         for m in members:
-            m.on_invalidate(origin_id, token, version)
+            m.on_invalidate(origin_id, token, version, tables=tables)
 
     def relay_health(self, origin_id: str, worker_url: str,
                      verdict: str) -> None:
@@ -445,19 +445,22 @@ class FleetMember:
         except Exception:  # noqa: BLE001 — gossip is best-effort
             return False
 
-    def broadcast_invalidate(self, token: str, version: int) -> int:
+    def broadcast_invalidate(self, token: str, version: int,
+                             tables=None) -> int:
         """Version-stamped invalidation to every peer; best-effort (a
-        missed peer degrades to a version-key miss).  Returns the
-        delivered-peer count."""
+        missed peer degrades to a version-key miss).  `tables` scopes
+        the peers' eviction to entries referencing the written tables
+        (None = clear everything).  Returns the delivered-peer count."""
         if self.drop_broadcasts:
             self._count("invalidations_dropped")
             return 0
         payload = {"origin": self.coord_id, "token": token,
-                   "version": int(version)}
+                   "version": int(version),
+                   "tables": sorted(tables) if tables else None}
         delivered = 0
         if self.directory is not None:
             self.directory.relay_invalidate(self.coord_id, token,
-                                            int(version))
+                                            int(version), tables=tables)
             delivered = len(self.peer_uris())
         else:
             for uri in self._static_peers.values():
@@ -532,13 +535,16 @@ class FleetMember:
                 self._journal_cbs.append(on_journal)
 
     def on_invalidate(self, origin_id: str, token: str,
-                      version: int) -> None:
+                      version: int, tables=None) -> None:
         self._count("invalidations_received")
         with self._lock:
             cbs = list(self._invalidate_cbs)
         for cb in cbs:
             try:
-                cb(token, int(version))
+                try:
+                    cb(token, int(version), tables)
+                except TypeError:
+                    cb(token, int(version))  # two-arg subscribers
             except Exception:  # noqa: BLE001 — receive is best-effort too
                 pass
 

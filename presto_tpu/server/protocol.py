@@ -267,11 +267,16 @@ class PrestoTpuServer:
                         self.serving.result_store(job.sql, job.columns,
                                                   job.rows)
                     elif first in ("INSERT", "DELETE", "UPDATE", "CREATE",
-                                   "DROP", "ALTER"):
+                                   "DROP", "ALTER", "REFRESH"):
                         # write/DDL statement: explicit invalidation on
-                        # top of the catalog-version keying (with a
-                        # fleet attached this also broadcasts to peers)
-                        self.serving.on_write_statement()
+                        # top of the catalog-version keying, SCOPED to
+                        # the written tables when the statement parses
+                        # (with a fleet attached this also broadcasts
+                        # the same table set to peers)
+                        from presto_tpu.server.serving import write_targets
+
+                        self.serving.on_write_statement(
+                            tables=write_targets(job.sql))
                 if self.fleet is not None and job.sql.lstrip().split(
                         None, 1)[0].upper() == "PREPARE":
                     # best-effort signature replication: an EXECUTE
@@ -871,10 +876,12 @@ def _make_handler(server: PrestoTpuServer):
             except ValueError:
                 return self._json({"error": "bad fleet payload"}, 400)
             if action == "invalidate":
+                tables = payload.get("tables")
                 server.fleet.on_invalidate(
                     str(payload.get("origin", "")),
                     str(payload.get("token", "")),
-                    int(payload.get("version", 0) or 0))
+                    int(payload.get("version", 0) or 0),
+                    tables=set(tables) if tables else None)
                 return self._json({"ok": True})
             if action == "health":
                 server.fleet.on_health(

@@ -57,6 +57,7 @@ benchmark with the SERVE_r01.json record).
 from __future__ import annotations
 
 import copy
+import dataclasses
 import os
 import threading
 import time
@@ -694,6 +695,73 @@ def coalescer_for(session) -> QueryCoalescer:
 _CACHEABLE_HEADS = ("SELECT", "WITH", "VALUES")
 
 
+def _norm_table_names(names) -> frozenset:
+    """Normalize table names for scoped-invalidation matching: both the
+    full lowered name and its bare last component, so a write to
+    'memory.default.t' still clears entries that read 't'."""
+    out = set()
+    for n in names:
+        n = str(n).lower()
+        out.add(n)
+        out.add(n.split(".")[-1])
+    return frozenset(out)
+
+
+def referenced_tables(sql: str):
+    """Tables a read statement touches (frozenset of normalized names),
+    or None when the text cannot be analyzed — None-scoped entries fall
+    on EVERY invalidation, so a parse failure degrades to the old
+    clear-the-world behavior, never to a stale hit."""
+    from presto_tpu.sql import ast
+    from presto_tpu.sql.parser import parse
+
+    try:
+        stmt = parse(sql)
+    except Exception:
+        return None
+    names = set()
+
+    def walk(node):
+        if isinstance(node, ast.Table):
+            names.add(node.name)
+        if dataclasses.is_dataclass(node):
+            for f in dataclasses.fields(node):
+                walk(getattr(node, f.name))
+        elif isinstance(node, (list, tuple)):
+            for x in node:
+                walk(x)
+        elif isinstance(node, dict):
+            for x in node.values():
+                walk(x)
+
+    try:
+        walk(stmt)
+    except Exception:
+        return None
+    return _norm_table_names(names)
+
+
+def write_targets(sql: str):
+    """Tables a write/DDL statement mutates, or None when the statement
+    shape is not recognized (None broadcasts a FULL invalidation)."""
+    from presto_tpu.sql import ast
+    from presto_tpu.sql.parser import parse
+
+    try:
+        stmt = parse(sql)
+    except Exception:
+        return None
+    name = getattr(stmt, "name", None) or getattr(stmt, "table", None)
+    if isinstance(stmt, (ast.CreateTableAs, ast.CreateTable,
+                         ast.InsertInto, ast.DropTable, ast.Delete,
+                         ast.CreateMaterializedView,
+                         ast.RefreshMaterializedView,
+                         ast.DropMaterializedView)) \
+            and isinstance(name, str):
+        return _norm_table_names([name])
+    return None
+
+
 class ResultCache:
     """Bounded LRU over materialized results (reference analog: none in
     the OSS reference — this is the hot-dashboard tier every production
@@ -711,12 +779,18 @@ class ResultCache:
         self.max_result_rows = max_result_rows
         self._lock = threading.Lock()
         self._entries: OrderedDict = OrderedDict()
+        # parallel map key -> frozenset of referenced tables (or None
+        # when the text resisted analysis); entries stay 3-tuples so
+        # the protocol wire consumers are untouched
+        self._entry_tables: Dict[tuple, Optional[frozenset]] = {}
         self._bytes = 0
         self.hits = 0
         self.misses = 0
         self.stores = 0
         self.evictions = 0
         self.invalidations = 0
+        self.invalidations_scoped = 0
+        self.invalidations_full = 0
 
     # -- keying --------------------------------------------------------
     @staticmethod
@@ -765,10 +839,12 @@ class ResultCache:
             return False
         k = self.key(session, sql)
         version = k[2]
+        tables = referenced_tables(sql)
         with self._lock:
             if k in self._entries:
                 return True
             self._entries[k] = (columns, rows, size)
+            self._entry_tables[k] = tables
             self._bytes += size
             self.stores += 1
             # sweep entries from older catalog versions: they can never
@@ -778,21 +854,38 @@ class ResultCache:
                      if ok[1] == k[1] and ok[2] != version]
             for ok in stale:
                 self._bytes -= self._entries.pop(ok)[2]
+                self._entry_tables.pop(ok, None)
                 self.evictions += 1
             while len(self._entries) > self.max_entries \
                     or self._bytes > self.max_bytes:
-                _ok, (_c, _r, sz) = self._entries.popitem(last=False)
+                ok, (_c, _r, sz) = self._entries.popitem(last=False)
+                self._entry_tables.pop(ok, None)
                 self._bytes -= sz
                 self.evictions += 1
         return True
 
-    def invalidate(self) -> None:
-        """Explicit full invalidation (DDL/DML through the serving
-        tier, or external catalog mutation the version cannot see)."""
+    def invalidate(self, tables=None) -> None:
+        """Explicit invalidation (DDL/DML through the serving tier, or
+        external catalog mutation the version cannot see).  With a
+        `tables` set, only entries that REFERENCE one of those tables
+        fall (plus entries whose reads resisted analysis); None keeps
+        the old clear-the-world behavior."""
         with self._lock:
             self.invalidations += 1
-            self._entries.clear()
-            self._bytes = 0
+            if tables is None:
+                self.invalidations_full += 1
+                self._entries.clear()
+                self._entry_tables.clear()
+                self._bytes = 0
+                return
+            self.invalidations_scoped += 1
+            touched = _norm_table_names(tables)
+            doomed = [k for k in self._entries
+                      if self._entry_tables.get(k) is None
+                      or (self._entry_tables[k] & touched)]
+            for k in doomed:
+                self._bytes -= self._entries.pop(k)[2]
+                self._entry_tables.pop(k, None)
 
     def stats(self) -> dict:
         with self._lock:
@@ -801,6 +894,8 @@ class ResultCache:
                     "hits": self.hits, "misses": self.misses,
                     "stores": self.stores, "evictions": self.evictions,
                     "invalidations": self.invalidations,
+                    "invalidationsScoped": self.invalidations_scoped,
+                    "invalidationsFull": self.invalidations_full,
                     "hitRate": round(self.hits / total, 4) if total else 0.0}
 
 
@@ -932,32 +1027,38 @@ class ServingTier:
         if self.result_cache is not None:
             self.result_cache.put(self.session, sql, columns, rows)
 
-    def on_write_statement(self) -> None:
+    def on_write_statement(self, tables=None) -> None:
         """Explicit invalidation rule: any non-read statement through
-        the tier clears the cache (belt) on top of the catalog-version
-        keying (suspenders).  With a fleet attached, the write also
-        broadcasts a version-stamped invalidation so PEER coordinators
+        the tier invalidates the cache (belt) on top of the catalog-
+        version keying (suspenders).  `tables` scopes the invalidation
+        to entries referencing the written tables — a write to one hot
+        table no longer evicts every OTHER dashboard's entries; None
+        (unanalyzable statement) keeps the full clear.  With a fleet
+        attached, the write also broadcasts a version-stamped
+        invalidation carrying the same table set so PEER coordinators
         drop their pre-write entries promptly (fleet_invalidate knob;
         a dropped broadcast still misses on the bumped version key)."""
         if self.result_cache is not None:
-            self.result_cache.invalidate()
+            self.result_cache.invalidate(tables=tables)
         if self.fleet is not None and bool(
                 self.session.properties.get("fleet_invalidate", True)):
             from presto_tpu.exec.compile_cache import catalog_token
 
             self.fleet.broadcast_invalidate(
                 catalog_token(self.session.catalog),
-                getattr(self.session.catalog, "version", 0))
+                getattr(self.session.catalog, "version", 0),
+                tables=tables)
 
     def attach_fleet(self, member) -> None:
         """Join this tier to a coordinator fleet: writes broadcast
         invalidations (see on_write_statement) and peer broadcasts clear
-        this tier's result cache."""
+        this tier's result cache (scoped to the broadcast table set)."""
         self.fleet = member
 
-        def on_invalidate(_token: str, _version: int) -> None:
+        def on_invalidate(_token: str, _version: int,
+                          tables=None) -> None:
             if self.result_cache is not None:
-                self.result_cache.invalidate()
+                self.result_cache.invalidate(tables=tables)
 
         member.subscribe(on_invalidate=on_invalidate)
 

@@ -77,6 +77,13 @@ DEFAULT_SESSION_PROPERTIES: Dict[str, Any] = {
     # rank error ~accuracy, state width 2*ceil(2/accuracy) f64 per group.
     "prefer_approx_distinct": False,
     "approx_percentile_accuracy": 0.01,
+    # materialized views (exec/matview.py, docs/SERVING.md): routing
+    # sends contained SELECTs to the freshest MV snapshot (env kill:
+    # PRESTO_TPU_MV_ROUTING=off); refresh mode auto|delta|full — auto
+    # delta-folds appends and degrades LOUDLY to full recompute, delta
+    # errors when a delta is impossible, full always recomputes.
+    "materialized_view_routing": True,
+    "mv_refresh_mode": "auto",
     # per-plan-node stats collection in dynamic mode (forced by EXPLAIN
     # ANALYZE; costs one host sync per operator — reference: OperationTimer)
     "collect_node_stats": False,

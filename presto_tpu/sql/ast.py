@@ -388,6 +388,36 @@ class DropTable(Statement):
 
 
 @dataclass
+class CreateMaterializedView(Statement):
+    """CREATE [OR REPLACE] MATERIALIZED VIEW v [WITH (...)] AS query.
+    The backing table stores the rollup state (exact aggregate partials
+    plus sketch register/summary columns) so REFRESH can fold a source
+    delta in without rescanning history (exec/matview.py)."""
+
+    name: str
+    query: Query
+    properties: dict = field(default_factory=dict)
+    if_not_exists: bool = False
+    or_replace: bool = False
+
+
+@dataclass
+class RefreshMaterializedView(Statement):
+    name: str
+
+
+@dataclass
+class DropMaterializedView(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowMaterializedViews(Statement):
+    pass
+
+
+@dataclass
 class Delete(Statement):
     """DELETE FROM t [WHERE pred] — reference: SqlBase.g4 delete,
     executed as a keep-mask rewrite (MetadataDeleteOperator analog)."""

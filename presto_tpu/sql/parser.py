@@ -214,8 +214,13 @@ class Parser:
             if self._accept_word("STATS"):
                 self.expect_kw("FOR")
                 return ast.ShowStats(self.dotted_name())
+            if self._accept_word("MATERIALIZED"):
+                if not self._accept_word("VIEWS"):
+                    self.err("expected VIEWS after SHOW MATERIALIZED")
+                return ast.ShowMaterializedViews()
             self.err("expected TABLES, COLUMNS, CREATE TABLE, FUNCTIONS, "
-                     "SESSION, CATALOGS, SCHEMAS or STATS")
+                     "SESSION, CATALOGS, SCHEMAS, STATS or MATERIALIZED "
+                     "VIEWS")
         if self._accept_word("DESCRIBE") or self.accept_kw("DESC"):
             # DESCRIBE INPUT/OUTPUT <prepared>; DESCRIBE t == SHOW
             # COLUMNS FROM t (reference: SqlBase.g4)
@@ -231,6 +236,20 @@ class Parser:
                 if not self._accept_word("REPLACE"):
                     self.err("expected REPLACE after CREATE OR")
                 or_replace = True
+            if self._accept_word("MATERIALIZED"):
+                if not self._accept_word("VIEW"):
+                    self.err("expected VIEW after CREATE MATERIALIZED")
+                if_not_exists = False
+                if self.accept_kw("IF"):
+                    self.expect_kw("NOT")
+                    self.expect_kw("EXISTS")
+                    if_not_exists = True
+                name = self.dotted_name()
+                props = self._with_properties()
+                self.expect_kw("AS")
+                return ast.CreateMaterializedView(
+                    name, self.parse_query(), properties=props,
+                    if_not_exists=if_not_exists, or_replace=or_replace)
             self.expect_kw("TABLE")
             if_not_exists = False
             if self.accept_kw("IF"):
@@ -258,6 +277,15 @@ class Parser:
             stmt.or_replace = or_replace
             return stmt
         if self.accept_kw("DROP"):
+            if self._accept_word("MATERIALIZED"):
+                if not self._accept_word("VIEW"):
+                    self.err("expected VIEW after DROP MATERIALIZED")
+                if_exists = False
+                if self.accept_kw("IF"):
+                    self.expect_kw("EXISTS")
+                    if_exists = True
+                return ast.DropMaterializedView(self.dotted_name(),
+                                                if_exists)
             self.expect_kw("TABLE")
             if_exists = False
             if self.accept_kw("IF"):
@@ -325,6 +353,12 @@ class Parser:
             elif v.kind == "kw" and v.value in ("TRUE", "FALSE"):
                 value = v.value == "TRUE"
             return ast.SetSession(name, value)
+        if self._accept_word("REFRESH"):
+            if not self._accept_word("MATERIALIZED"):
+                self.err("expected MATERIALIZED VIEW after REFRESH")
+            if not self._accept_word("VIEW"):
+                self.err("expected VIEW after REFRESH MATERIALIZED")
+            return ast.RefreshMaterializedView(self.dotted_name())
         return ast.QueryStatement(self.parse_query())
 
     # ---- queries ----------------------------------------------------

@@ -131,7 +131,7 @@ def write_shard(path: str, arrays: Dict[str, np.ndarray],
 def _zone(a: np.ndarray):
     from presto_tpu import native
 
-    if a.dtype == np.bool_ or a.size == 0:
+    if a.dtype == np.bool_ or a.size == 0 or a.ndim > 1:
         return None, None
     lo, hi = native.minmax(a.astype(np.int64) if a.dtype == np.int32 else a)
     if isinstance(lo, float) and (np.isnan(lo) or np.isnan(hi)):
@@ -219,6 +219,15 @@ class ShardReader:
                 keep.append(i)
         return keep
 
+    def _empty_column(self, c: str) -> np.ndarray:
+        typ = self.schema[c]
+        dtype = typ.numpy_dtype()
+        # sketch-state columns are 2-D (n_rows, width) matrices; an empty
+        # read must keep the width so downstream concat/merge stays valid
+        if typ.name in ("HLL_STATE", "KLL_STATE") and typ.params:
+            return np.zeros((0, int(typ.params[0])), dtype=dtype)
+        return np.empty(0, dtype)
+
     def read(self, columns: Optional[List[str]] = None,
              stripes: Optional[List[int]] = None,
              decode_strings: bool = True) -> Dict[str, np.ndarray]:
@@ -234,7 +243,7 @@ class ShardReader:
         out: Dict[str, np.ndarray] = {}
         for c in cols:
             a = (np.concatenate(parts[c]) if parts[c]
-                 else np.empty(0, self.schema[c].numpy_dtype()))
+                 else self._empty_column(c))
             if decode_strings and self.schema[c].is_string:
                 d = self.dictionary(c)
                 if d is not None:

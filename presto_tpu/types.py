@@ -339,6 +339,10 @@ def parse_type(text: str) -> Type:
                 fields.append((None, parse_type(p)))
             return row_of(fields)
         args = [int(a) for a in inner.split(",") if a.strip().isdigit()]
+        if base == "HLL_STATE":
+            return hll_state(args[0] if args else 1024)
+        if base == "KLL_STATE":
+            return kll_state(args[0] if args else 400)
         if base == "DECIMAL":
             return decimal(*args) if args else decimal(18, 0)
         if base in ("VARCHAR", "CHAR"):

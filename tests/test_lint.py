@@ -691,3 +691,46 @@ def test_sketch_bit_twiddling_confined_to_kernels():
                            f"{node.value} — the estimator belongs in "
                            "exec/kernels.hll_estimate")
     assert not bad, "\n".join(bad)
+
+
+def test_manifest_generation_diffing_confined_to_connectors():
+    """Manifest-delta gate (ISSUE 20): raw manifest generation state —
+    the `"generation"` / `"retired"` manifest fields and the
+    `_manifest` dict itself — may be read only under `connectors/`
+    (where `connectors/delta.py` turns generations into DeltaVerdicts
+    and `localfile.py` owns retirement/GC) and in `exec/writer.py`
+    (which publishes commits).  Everything else — the MV refresh logic,
+    the planner, the serving tier — consumes watermark captures and
+    verdicts, never generations: a second diff implementation would
+    fork the append-detection rules and silently disagree about what
+    counts as a delta."""
+    import ast
+
+    pkg = os.path.join(ROOT, "presto_tpu")
+    FIELDS = {"generation", "retired"}
+    bad = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), pkg)
+            if rel.startswith("connectors" + os.sep) \
+                    or rel == os.path.join("exec", "writer.py"):
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                tree = ast.parse(f.read(), rel)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value in FIELDS:
+                    bad.append(
+                        f"{rel}:{node.lineno}: manifest field "
+                        f"'{node.value}' — generation diffing belongs "
+                        "in connectors/delta.py (capture/diff)")
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "_manifest":
+                    bad.append(
+                        f"{rel}:{node.lineno}: raw _manifest access — "
+                        "manifest state belongs to connectors/ and "
+                        "exec/writer.py")
+    assert not bad, "\n".join(bad)

@@ -1,0 +1,493 @@
+"""Incremental materialized views (ISSUE 20): manifest-delta refresh,
+sketch-state rollups, MV-routed serving.
+
+The load-bearing guarantees:
+  * refresh-MERGED results are bit-identical to a full recompute — for
+    exact aggregates AND sketch estimates — across execution modes,
+    dtypes, null masks, empty deltas, and all-null deltas;
+  * a fault injected mid-merge leaves the PRIOR snapshot serving;
+  * an MV reader mid-poll across TWO consecutive refreshes still
+    resolves a complete file list (backing retire_depth=2);
+  * delta refresh cost scales with the delta, not the history
+    (mv_delta_splits << mv_source_splits);
+  * non-append sources degrade LOUDLY to full recompute — counted,
+    never wrong.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.session import Session
+
+MV_SQL = ("SELECT k, count(*) AS c, count(v) AS cv, sum(v) AS sv, "
+          "avg(x) AS ax, min(v) AS mn, max(x) AS mx, "
+          "approx_distinct(v) AS ad, approx_percentile(x, 0.5) AS p50 "
+          "FROM src GROUP BY k")
+
+
+def _session(tmp_path, mode="dynamic"):
+    s = Session()
+    s.set("localfile_root", str(tmp_path))
+    if mode == "distributed":
+        s.set("distributed", True)
+    else:
+        s.set("execution_mode", mode)
+    return s
+
+
+def _append(s, name, rows):
+    """Append host rows (None = NULL) straight onto a memory table —
+    SQL INSERT has no null channel on raw-array sinks, so null-bearing
+    test data takes the same path the catalog fixtures use."""
+    t = s.catalog.get(name)
+    arrays = {}
+    for j, c in enumerate(t.schema):
+        vals = [r[j] for r in rows]
+        mask = np.array([v is None for v in vals])
+        typ = t.schema[c]
+        if typ.numpy_dtype() == object or not typ.is_numeric:
+            base = np.array([("" if v is None else v) for v in vals],
+                            dtype=object)
+        else:
+            base = np.array([(0 if v is None else v) for v in vals],
+                            dtype=typ.numpy_dtype())
+        arrays[c] = np.ma.masked_array(base, mask=mask) if mask.any() \
+            else base
+    t.append(arrays)
+
+
+def _mk_src(s, connector="localfile"):
+    """Source table: the localfile flavor exercises the MANIFEST delta
+    path (no null channel, so no NULLs); the memory flavor exercises
+    the row-count/delete-epoch watermark WITH null masks."""
+    if connector == "memory":
+        s.sql("CREATE TABLE src (k VARCHAR, v BIGINT, x DOUBLE)")
+        _append(s, "src", [("a", 1, 1.5), ("a", 2, 2.5), ("b", 3, 3.5),
+                           ("a", None, 4.5), (None, 5, None)])
+    else:
+        s.sql("CREATE TABLE src (k VARCHAR, v BIGINT, x DOUBLE) "
+              "WITH (connector='localfile')")
+        s.sql("INSERT INTO src VALUES ('a', 1, 1.5), ('a', 2, 2.5), "
+              "('b', 3, 3.5), ('a', 4, 4.5), ('c', 5, 0.125)")
+
+
+def _engine_rows(s, sql):
+    s.set("materialized_view_routing", False)
+    try:
+        return s.sql(sql).rows
+    finally:
+        s.set("materialized_view_routing", True)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_mv_lifecycle(tmp_path):
+    s = _session(tmp_path)
+    _mk_src(s)
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    rows = s.sql("SHOW MATERIALIZED VIEWS").rows
+    assert rows == [("mv1", True, "src")]
+    # backing tables are engine-internal
+    assert all(not r[0].startswith("__mv__")
+               for r in s.sql("SHOW TABLES").rows)
+    with pytest.raises(Exception):
+        s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    s.sql(f"CREATE MATERIALIZED VIEW IF NOT EXISTS mv1 AS {MV_SQL}")
+    s.sql(f"CREATE OR REPLACE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    s.sql("DROP MATERIALIZED VIEW mv1")
+    assert s.sql("SHOW MATERIALIZED VIEWS").rows == []
+    with pytest.raises(Exception):
+        s.sql("DROP MATERIALIZED VIEW mv1")
+    s.sql("DROP MATERIALIZED VIEW IF EXISTS mv1")
+
+
+def test_mv_name_cannot_shadow_table(tmp_path):
+    s = _session(tmp_path)
+    _mk_src(s)
+    with pytest.raises(Exception):
+        s.sql("CREATE MATERIALIZED VIEW src AS SELECT k, count(*) AS c "
+              "FROM src GROUP BY k")
+
+
+# ---------------------------------------------------------------------------
+# refresh-merge identity (satellite: exact + sketch, modes x dtypes x
+# masks x empty-delta x all-null-delta)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("connector", ["localfile", "memory"])
+@pytest.mark.parametrize("mode", ["dynamic", "compiled", "distributed"])
+def test_refresh_merge_identity_across_modes(tmp_path, mode, connector):
+    s = _session(tmp_path, mode)
+    _mk_src(s, connector)
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    probe = MV_SQL + " ORDER BY k"
+
+    def check():
+        routed = s.sql(probe)
+        assert routed.stats.execution_mode == "mv_routed"
+        assert routed.rows == _engine_rows(s, probe)
+        # refresh-merged snapshot == full-recompute snapshot, column by
+        # column including the sketch-estimate finals
+        s.sql("CREATE OR REPLACE MATERIALIZED VIEW mv_full AS " + MV_SQL)
+        a = s.sql("SELECT * FROM mv1 ORDER BY k").rows
+        b = s.sql("SELECT * FROM mv_full ORDER BY k").rows
+        assert a == b
+
+    check()
+    # append delta: new + existing groups, negatives, exact dyadics
+    if connector == "memory":  # null masks ride the memory flavor
+        _append(s, "src", [("b", 4, 4.5), ("c", -5, 5.5),
+                           ("c", None, None), (None, 6, 0.25)])
+    else:
+        s.sql("INSERT INTO src VALUES ('b', 4, 4.5), ('c', -5, 5.5), "
+              "('d', 6, 0.25)")
+    r = s.sql("REFRESH MATERIALIZED VIEW mv1")
+    assert r.rows[0][1] == "delta"
+    check()
+    # empty delta: refresh is a no-op
+    r = s.sql("REFRESH MATERIALIZED VIEW mv1")
+    assert r.rows == [(0, "noop")]
+    if connector == "memory":
+        # all-null delta: every aggregate argument NULL
+        _append(s, "src", [("a", None, None), ("e", None, None)])
+        r = s.sql("REFRESH MATERIALIZED VIEW mv1")
+        assert r.rows[0][1] == "delta"
+        check()
+    # forced full recompute agrees with the merged state
+    s.set("mv_refresh_mode", "full")
+    r = s.sql("REFRESH MATERIALIZED VIEW mv1")
+    assert r.rows[0][1] == "full"
+    s.set("mv_refresh_mode", "auto")
+    check()
+
+
+def test_chunked_mode_routes_and_matches_exact(tpch_catalog_tiny,
+                                               tmp_path):
+    """Chunked execution only engages on bucketed device tables, so the
+    chunked-mode leg rides tpch lineitem: the un-routed probe must
+    actually run CHUNKED, while the MV-routed answer must equal the
+    exact single-pass result.  Grouping by l_suppkey keeps the group
+    count under the single-pass register-shrink threshold (8192 groups
+    at m=1024, where HLL mode-identity intentionally ends) and
+    quantity's distinct values per group under the summary capacity,
+    so the stored sketch states are exact and both sketch readouts
+    match the engine bit-for-bit.  (Chunked percentile itself is only
+    rank-error-bounded — see test_approx_aggregates — which is why the
+    identity oracle here is the exact path, not the chunked one.)"""
+    import presto_tpu
+
+    mv_sql = ("SELECT l_suppkey, count(*) AS c, avg(l_quantity) AS aq, "
+              "approx_distinct(l_partkey) AS ad, "
+              "approx_percentile(l_quantity, 0.5) AS p50 "
+              "FROM lineitem GROUP BY l_suppkey")
+    probe = mv_sql + " ORDER BY l_suppkey"
+    chunked = presto_tpu.connect(tpch_catalog_tiny)
+    chunked.set("execution_mode", "chunked")
+    chunked.properties["chunked_rows_threshold"] = 50_000
+    chunked.set("localfile_root", str(tmp_path))
+    exact = presto_tpu.connect(tpch_catalog_tiny)
+    try:
+        chunked.sql("CREATE MATERIALIZED VIEW mv_li "
+                    "WITH (connector='memory') AS " + mv_sql)
+        routed = chunked.sql(probe)
+        assert routed.stats.execution_mode == "mv_routed"
+        engine = chunked.sql(probe)  # cached matview still routes
+        assert engine.stats.execution_mode == "mv_routed"
+        un_routed = _engine_rows(chunked, probe)
+        assert chunked.sql(probe).rows == routed.rows
+        # the un-routed probe really exercised the chunked runner
+        chunked.set("materialized_view_routing", False)
+        assert chunked.sql(probe).stats.execution_mode == "chunked"
+        chunked.set("materialized_view_routing", True)
+        # identity oracle: the exact single-pass engine
+        assert routed.rows == _engine_rows(exact, probe)
+        # chunked exact aggregates agree; sketch columns are bounded,
+        # not identical, on the chunked path
+        assert [r[:3] for r in un_routed] == [r[:3] for r in routed.rows]
+        # immutable source: refresh is a clean no-op
+        assert chunked.sql("REFRESH MATERIALIZED VIEW mv_li"
+                           ).rows == [(0, "noop")]
+    finally:
+        chunked.sql("DROP MATERIALIZED VIEW IF EXISTS mv_li")
+
+
+def test_refresh_merge_identity_int_key_dtypes(tmp_path):
+    """Non-string keys + BIGINT/DOUBLE aggregate args; values chosen
+    exactly representable so '==' is a fair comparison."""
+    s = _session(tmp_path)
+    s.sql("CREATE TABLE src (k BIGINT, v BIGINT, x DOUBLE)")
+    _append(s, "src", [(10, 100, 0.5), (10, 200, 1.5),
+                       (20, 300, 2.25), (20, None, None)])
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    s.sql("INSERT INTO src VALUES (20, 400, 3.75), (30, 500, 4.0)")
+    assert s.sql("REFRESH MATERIALIZED VIEW mv1").rows[0][1] == "delta"
+    probe = MV_SQL + " ORDER BY k"
+    assert s.sql(probe).rows == _engine_rows(s, probe)
+
+
+def test_refresh_delta_cost_scales_with_delta(tmp_path):
+    """The tentpole economics: a refresh after ONE appended file scans
+    one split while the source holds many (mv_delta_splits <<
+    mv_source_splits)."""
+    s = _session(tmp_path)
+    s.sql("CREATE TABLE src (k VARCHAR, v BIGINT, x DOUBLE) "
+          "WITH (connector='localfile')")
+    for i in range(6):
+        s.sql(f"INSERT INTO src VALUES ('g{i % 2}', {i}, {i}.5)")
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    s.sql("INSERT INTO src VALUES ('g0', 99, 9.5)")
+    r = s.sql("REFRESH MATERIALIZED VIEW mv1")
+    assert r.rows[0][1] == "delta"
+    assert r.stats.mv_refresh_delta == 1
+    assert r.stats.mv_delta_splits == 1
+    assert r.stats.mv_source_splits >= 6
+    assert r.stats.mv_delta_splits < r.stats.mv_source_splits
+
+
+def test_refresh_degrades_loudly_on_delete(tmp_path):
+    s = _session(tmp_path)
+    _mk_src(s)
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    s.sql("DELETE FROM src WHERE v = 1")
+    r = s.sql("REFRESH MATERIALIZED VIEW mv1")
+    assert r.rows[0][1].startswith("full:")  # the loud part
+    assert r.stats.mv_refresh_full == 1
+    assert r.stats.mv_refresh_delta == 0
+    probe = MV_SQL + " ORDER BY k"
+    assert s.sql(probe).rows == _engine_rows(s, probe)  # never wrong
+    # delta-forced mode refuses instead of silently recomputing
+    s.sql("DELETE FROM src WHERE v = 2")
+    s.set("mv_refresh_mode", "delta")
+    with pytest.raises(Exception, match="delta"):
+        s.sql("REFRESH MATERIALIZED VIEW mv1")
+
+
+# ---------------------------------------------------------------------------
+# chaos: fault mid-merge leaves the prior snapshot serving
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_fault_mid_merge_keeps_prior_snapshot(tmp_path):
+    s = _session(tmp_path)
+    _mk_src(s)
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    probe = MV_SQL + " ORDER BY k"
+    before = s.sql(probe).rows
+    backing = s.catalog.tables["__mv__mv1"]
+
+    s.sql("INSERT INTO src VALUES ('z', 42, 42.5)")
+    real = backing._sink_write_file
+
+    def boom(*a, **kw):
+        raise OSError("injected mid-merge fault")
+
+    backing._sink_write_file = boom
+    try:
+        with pytest.raises(Exception):
+            s.sql("REFRESH MATERIALIZED VIEW mv1")
+    finally:
+        backing._sink_write_file = real
+    # prior snapshot intact: routed rows unchanged, no staged debris,
+    # no watermark stamp leaked into a future commit
+    assert s.sql(probe).rows == before
+    assert not glob.glob(os.path.join(backing.dir, "*.stg"))
+    assert getattr(backing, "_mv_stamp", None) is None
+    # the interrupted refresh retries cleanly and lands the delta
+    r = s.sql("REFRESH MATERIALIZED VIEW mv1")
+    assert r.rows[0][1] == "delta"
+    assert s.sql(probe).rows == _engine_rows(s, probe)
+
+
+def test_chaos_prior_snapshot_rows_stable(tmp_path):
+    """Sharper form of the above: the routed rows after the fault are
+    EXACTLY the pre-fault rows (old watermark, old data)."""
+    s = _session(tmp_path)
+    _mk_src(s)
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    before = s.sql("SELECT * FROM mv1 ORDER BY k").rows
+    backing = s.catalog.tables["__mv__mv1"]
+    s.sql("INSERT INTO src VALUES ('z', 42, 42.5)")
+    backing._sink_write_file = lambda *a, **kw: (_ for _ in ()).throw(
+        OSError("injected"))
+    try:
+        with pytest.raises(Exception):
+            s.sql("REFRESH MATERIALIZED VIEW mv1")
+    finally:
+        del backing._sink_write_file
+    assert s.sql("SELECT * FROM mv1 ORDER BY k").rows == before
+
+
+# ---------------------------------------------------------------------------
+# satellite: reader mid-poll across TWO consecutive refreshes
+# ---------------------------------------------------------------------------
+
+
+def test_mv_reader_survives_two_refresh_cutovers(tmp_path):
+    """A long-poll reader resolves the backing's file list, then TWO
+    refresh cut-overs land before it opens the files.  retire_depth=2
+    on MV backing keeps each retired generation through the NEXT commit
+    too, so every file in the captured list still exists; a third
+    cut-over may finally GC them (bounded, not leaked)."""
+    s = _session(tmp_path)
+    _mk_src(s)
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    backing = s.catalog.tables["__mv__mv1"]
+    assert backing.retire_depth == 2
+    polled = [os.path.join(backing.dir, p)
+              for p in backing._manifest["shards"]]
+    assert polled and all(os.path.exists(p) for p in polled)
+
+    for i in (101, 102):  # two consecutive refresh cut-overs
+        s.sql(f"INSERT INTO src VALUES ('r', {i}, {i}.5)")
+        assert s.sql("REFRESH MATERIALIZED VIEW mv1").rows[0][1] \
+            == "delta"
+        # mid-poll guarantee: the OLD file list is still fully on disk
+        assert all(os.path.exists(p) for p in polled), \
+            f"refresh #{i - 100} broke a mid-poll reader's file list"
+    # and the reader's data is actually readable end to end
+    from presto_tpu.storage.shard import ShardReader
+
+    for p in polled:
+        ShardReader(p).read(None)
+    # GC is deferred, not disabled: two MORE cut-overs retire them
+    for i in (103, 104):
+        s.sql(f"INSERT INTO src VALUES ('r', {i}, {i}.5)")
+        s.sql("REFRESH MATERIALIZED VIEW mv1")
+    assert not all(os.path.exists(p) for p in polled)
+
+
+def test_regular_table_gc_still_one_generation(tmp_path):
+    """Regression guard for the pre-existing behavior: NON-MV localfile
+    tables still GC retired files after ONE generation (retire_depth
+    stays 1) — a file retired by a replace commit survives that commit
+    and is removed by the next GC-ing commit (DELETE rewrites never GC
+    so a transaction can roll back; sink commits do)."""
+    s = _session(tmp_path)
+    s.sql("CREATE TABLE t (x BIGINT) WITH (connector='localfile')")
+    s.sql("INSERT INTO t VALUES (1), (2), (3)")
+    t = s.catalog.tables["t"]
+    assert getattr(t, "retire_depth", 1) == 1
+    old = [os.path.join(t.dir, p) for p in t._manifest["shards"]]
+    assert old
+    s.sql("DELETE FROM t WHERE x = 1")   # replace commit: retires old
+    assert all(os.path.exists(p) for p in old)
+    s.sql("INSERT INTO t VALUES (9)")    # next sink commit: GCs them
+    assert not any(os.path.exists(p) for p in old)
+
+
+# ---------------------------------------------------------------------------
+# serving: the containment matcher
+# ---------------------------------------------------------------------------
+
+
+def test_routing_containment_matrix(tmp_path):
+    s = _session(tmp_path)
+    _mk_src(s)
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    routed_cases = [
+        # same grain
+        "SELECT k, count(*) AS c FROM src GROUP BY k ORDER BY k",
+        # rollup to the global grain (HLL union via stored registers,
+        # KLL re-summarize) + percentile the MV never stored
+        "SELECT count(*) AS c, sum(v) AS sv, approx_distinct(v) AS ad "
+        "FROM src",
+        "SELECT approx_percentile(x, 0.9) AS p90 FROM src",
+        # predicate subsumption: extra equality on a key column
+        "SELECT k, sum(v) AS sv FROM src WHERE k = 'a' GROUP BY k",
+        "SELECT count(*) AS c FROM src WHERE k IN ('a', 'b')",
+        "SELECT count(*) AS c FROM src WHERE k IS NOT NULL",
+        # ORDER BY + LIMIT host-side
+        "SELECT k, max(x) AS mx FROM src GROUP BY k ORDER BY k DESC "
+        "LIMIT 2",
+    ]
+    for sql in routed_cases:
+        r = s.sql(sql)
+        assert r.stats.execution_mode == "mv_routed", sql
+        assert r.rows == _engine_rows(s, sql), sql
+    declined_cases = [
+        "SELECT k, sum(x) AS sx FROM src GROUP BY k",   # agg not stored
+        "SELECT v, count(*) AS c FROM src GROUP BY v",  # non-key group
+        "SELECT k, count(*) AS c FROM src WHERE v > 1 GROUP BY k",
+        "SELECT k, count(DISTINCT v) AS c FROM src GROUP BY k",
+        # different register count than the stored HLL state
+        "SELECT approx_distinct(v, 0.01) AS ad FROM src",
+    ]
+    for sql in declined_cases:
+        r = s.sql(sql)
+        assert r.stats.execution_mode != "mv_routed", sql
+        assert r.rows == _engine_rows(s, sql), sql
+
+
+def test_routing_counts_and_kill_switches(tmp_path, monkeypatch):
+    s = _session(tmp_path)
+    _mk_src(s)
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    sql = "SELECT k, count(*) AS c FROM src GROUP BY k"
+    r = s.sql(sql)
+    assert r.stats.execution_mode == "mv_routed"
+    assert r.stats.mv_routed == 1
+    s.set("materialized_view_routing", False)
+    assert s.sql(sql).stats.execution_mode != "mv_routed"
+    s.set("materialized_view_routing", True)
+    monkeypatch.setenv("PRESTO_TPU_MV_ROUTING", "off")
+    assert s.sql(sql).stats.execution_mode != "mv_routed"
+    monkeypatch.delenv("PRESTO_TPU_MV_ROUTING")
+    assert s.sql(sql).stats.execution_mode == "mv_routed"
+
+
+def test_routing_serves_latest_snapshot_and_writes_invalidate(tmp_path):
+    """Engine writes to the source do NOT silently change routed
+    results (MV staleness is by design, refresh is the cut-over), and a
+    refresh immediately flips what routing serves."""
+    s = _session(tmp_path)
+    _mk_src(s)
+    s.sql(f"CREATE MATERIALIZED VIEW mv1 AS {MV_SQL}")
+    sql = "SELECT k, count(*) AS c FROM src GROUP BY k ORDER BY k"
+    before = s.sql(sql).rows
+    s.sql("INSERT INTO src VALUES ('b', 7, 7.5)")
+    assert s.sql(sql).rows == before  # stale until refreshed, by design
+    s.sql("REFRESH MATERIALIZED VIEW mv1")
+    after = s.sql(sql).rows
+    assert after != before
+    assert after == _engine_rows(s, sql)
+
+
+def test_non_mergeable_mv_full_refresh_and_exact_match(tmp_path):
+    s = _session(tmp_path)
+    _mk_src(s)
+    sql = ("SELECT k, count(*) AS c FROM src GROUP BY k HAVING "
+           "count(*) > 1")
+    s.sql(f"CREATE MATERIALIZED VIEW mvh AS {sql}")
+    rows = s.sql("SHOW MATERIALIZED VIEWS").rows
+    assert rows[0][0] == "mvh" and rows[0][1] is False
+    r = s.sql(sql)  # structurally identical -> served from the MV
+    assert r.stats.execution_mode == "mv_routed"
+    assert r.rows == _engine_rows(s, sql)
+    s.sql("INSERT INTO src VALUES ('b', 8, 8.5)")
+    r = s.sql("REFRESH MATERIALIZED VIEW mvh")
+    assert r.rows[0][1].startswith("full")  # loud: not mergeable
+    assert s.sql(sql).rows == _engine_rows(s, sql)
+
+
+def test_memory_source_delete_epoch_degrades(tmp_path):
+    """In-memory sources have no manifest; the delete epoch + row count
+    watermark still classifies appends vs destructive changes."""
+    s = _session(tmp_path)
+    s.sql("CREATE TABLE m (k VARCHAR, v BIGINT)")
+    s.sql("INSERT INTO m VALUES ('a', 1), ('b', 2)")
+    s.sql("CREATE MATERIALIZED VIEW mvm AS SELECT k, sum(v) AS sv "
+          "FROM m GROUP BY k")
+    s.sql("INSERT INTO m VALUES ('a', 3)")
+    assert s.sql("REFRESH MATERIALIZED VIEW mvm").rows[0][1] == "delta"
+    s.sql("DELETE FROM m WHERE v = 1")
+    r = s.sql("REFRESH MATERIALIZED VIEW mvm")
+    assert r.rows[0][1].startswith("full:")
+    probe = "SELECT k, sum(v) AS sv FROM m GROUP BY k ORDER BY k"
+    assert s.sql(probe).rows == _engine_rows(s, probe)

@@ -198,6 +198,69 @@ def test_result_cache_lru_and_bytes_bound():
     assert not big.put(s, "SELECT 9", cols, [[1], [2], [3]])
 
 
+def test_result_cache_table_scoped_invalidation():
+    """Writes invalidate only the entries that reference the written
+    table; everything else keeps serving (ISSUE 20 satellite)."""
+    from presto_tpu.server.serving import referenced_tables, write_targets
+
+    s = _session()
+    s.catalog.register_memory("u", {"a": T.BIGINT},
+                              {"a": np.arange(3, dtype=np.int64)})
+    rc = ResultCache(max_entries=8)
+    cols = [{"name": "c", "type": "bigint"}]
+    assert rc.put(s, "SELECT count(*) FROM t", cols, [[200]])
+    assert rc.put(s, "SELECT count(*) FROM u", cols, [[3]])
+    assert rc.put(s, "SELECT 1", cols, [[1]])
+    rc.invalidate(tables={"u"})
+    assert rc.get(s, "SELECT count(*) FROM t") is not None
+    assert rc.get(s, "SELECT count(*) FROM u") is None
+    # provably table-free entries survive every scoped invalidation
+    assert rc.get(s, "SELECT 1") is not None
+    st = rc.stats()
+    assert st["invalidationsScoped"] == 1
+    assert st["invalidationsFull"] == 0
+    rc.invalidate()  # no table set -> full clear
+    assert rc.stats()["entries"] == 0
+    assert rc.stats()["invalidationsFull"] == 1
+    # the scoping helpers behind the cache
+    assert "t" in referenced_tables("SELECT * FROM t JOIN u ON 1=1")
+    assert "u" in referenced_tables("SELECT * FROM t JOIN u ON 1=1")
+    assert write_targets("INSERT INTO u VALUES (1)") == frozenset({"u"})
+    assert write_targets("REFRESH MATERIALIZED VIEW mv1") \
+        == frozenset({"mv1"})
+    assert write_targets("SELECT 1") is None
+
+
+def test_result_cache_scoped_invalidation_through_server():
+    """Protocol integration: a server write takes the SCOPED
+    invalidation path (table set derived from the statement), not a
+    full flush, and reads stay correct afterwards.  Locally the
+    catalog-version cache key is the correctness backstop — the scoped
+    drop is what rides the fleet broadcast so PEER coordinators (whose
+    catalog version did not bump) keep serving unrelated entries."""
+    s = _session()
+    s.catalog.register_memory("u", {"a": T.BIGINT},
+                              {"a": np.arange(3, dtype=np.int64)})
+    srv = PrestoTpuServer(s).start()
+    try:
+        qt = "SELECT g, count(*) c FROM t GROUP BY g ORDER BY g"
+        qu = "SELECT count(*) cu FROM u"
+        first = connect_http(srv.uri).execute(qt).fetchall()
+        connect_http(srv.uri).execute(qu).fetchall()
+        connect_http(srv.uri).execute("INSERT INTO u VALUES (9)")
+        info = json.loads(urllib.request.urlopen(
+            f"{srv.uri}/v1/info").read())
+        cache = info["serving"]["resultCache"]
+        assert cache["invalidationsScoped"] >= 1
+        assert cache["invalidationsFull"] == 0
+        # correctness after the scoped drop: u recomputes fresh, t is
+        # unchanged
+        assert connect_http(srv.uri).execute(qu).fetchall() == [(4,)]
+        assert connect_http(srv.uri).execute(qt).fetchall() == first
+    finally:
+        srv.stop()
+
+
 def test_result_cache_serves_identical_query_checksum_equal():
     """Protocol integration: the identical re-submitted query serves
     from the cache with rows equal to the uncached execution."""
@@ -474,19 +537,69 @@ def test_serve_gate_units():
     import bench
 
     rec = {"platform": "cpu", "sf": 0.01, "failures": 0,
-           "qps_per_chip": 100.0, "p99_ms": 200.0}
+           "qps_per_chip": 100.0, "p99_ms": 200.0,
+           "box_sort_ms": 100.0}
     assert bench._serve_gate(dict(rec), None).startswith("pass")
     committed = {"platform": "cpu", "sf": 0.01,
-                 "qps_per_chip": 100.0, "p99_ms": 200.0}
+                 "qps_per_chip": 100.0, "p99_ms": 200.0,
+                 "box_sort_ms": 100.0}
     assert bench._serve_gate(dict(rec), committed) == "pass"
     slow = dict(rec, qps_per_chip=10.0)
     assert bench._serve_gate(slow, committed).startswith("FAIL")
     spiky = dict(rec, p99_ms=900.0)
     assert bench._serve_gate(spiky, committed).startswith("FAIL")
+    # box-fingerprint scaling: a box 2x slower than the committed one
+    # halves the qps bar (70 qps passes where an equal box would FAIL)
+    # and doubles the p99 bar
+    slow_box = dict(rec, qps_per_chip=70.0, p99_ms=500.0,
+                    box_sort_ms=200.0)
+    assert bench._serve_gate(slow_box, committed) == "pass"
+    # no fingerprint on the committed record -> absolute legs skipped
+    assert bench._serve_gate(
+        dict(rec, qps_per_chip=10.0),
+        {k: v for k, v in committed.items() if k != "box_sort_ms"},
+    ).startswith("pass (committed record has no box fingerprint")
     other = dict(committed, platform="tpu")
     assert bench._serve_gate(dict(rec), other).startswith("pass (no")
     failed = dict(rec, failures=3)
     assert bench._serve_gate(failed, committed).startswith("FAIL")
+
+
+def test_mv_serve_gate_units():
+    """SERVE_r04's gate (bench.py --serve --mv): correctness legs are
+    absolute; the p99-flatness leg and the committed-record absolute
+    leg are core-aware (a 1-core box cannot hide co-located refresh
+    compute — the FLEET_GATE enforcement precedent)."""
+    import bench
+
+    rec = {"platform": "cpu", "cores": 4, "failures": 0,
+           "wrong_results": 0, "unrouted": 0,
+           "p99_steady_ms": 10.0, "p99_churn_ms": 12.0,
+           "p99_flat_ratio": 1.2, "routed_ms": 1.0,
+           "recompute_ms": 500.0, "routed_speedup": 500.0,
+           "box_sort_ms": 100.0}
+    committed = dict(rec)
+    assert bench._mv_serve_gate(dict(rec), None).startswith("pass")
+    assert bench._mv_serve_gate(dict(rec), committed) == "pass"
+    for bad in ({"failures": 2}, {"wrong_results": 1}, {"unrouted": 1},
+                {"routed_speedup": 3.0},
+                {"p99_flat_ratio": 2.0, "p99_churn_ms": 20.0}):
+        assert bench._mv_serve_gate(dict(rec, **bad),
+                                    committed).startswith("FAIL"), bad
+    # 1-core box: flatness measured, not enforced — but the
+    # correctness legs stay absolute
+    one_core = dict(rec, cores=1, p99_flat_ratio=2.0,
+                    p99_churn_ms=20.0)
+    out = bench._mv_serve_gate(one_core, committed)
+    assert out.startswith("pass") and "not enforced" in out
+    assert bench._mv_serve_gate(dict(one_core, wrong_results=1),
+                                committed).startswith("FAIL")
+    # absolute churn-p99 leg vs the committed record, box-scaled,
+    # >=2 cores only
+    spiky = dict(rec, p99_churn_ms=40.0, p99_flat_ratio=1.2)
+    assert bench._mv_serve_gate(spiky, committed).startswith("FAIL")
+    assert bench._mv_serve_gate(dict(spiky, cores=1),
+                                committed).startswith("pass")
 
 
 def test_serve_gate_registered_in_bench_artifact():
